@@ -1,0 +1,22 @@
+"""mythril_tpu — a TPU-native symbolic-execution security analyzer for EVM bytecode.
+
+A ground-up reimplementation of the capability surface of Mythril
+(reference: /root/reference, jaggedsoft/mythril v0.22.8) designed for
+TPU hardware from the start:
+
+- the LASER symbolic EVM's per-state Python loop (reference
+  mythril/laser/ethereum/svm.py) is re-expressed as a batched,
+  SoA bit-vector interpreter: `vmap` over thousands of (contract, path)
+  lanes, `shard_map` over a device mesh;
+- the z3-backed SMT layer (reference mythril/laser/smt/) is replaced by
+  an in-house term graph lowered to fixed-width XLA integer ops, solved
+  by an on-chip portfolio local search with a native host fallback;
+- keccak256 is evaluated for real (batched on device) instead of being
+  modeled as an uninterpreted function wherever possible.
+
+Public surface mirrors the reference so `myth analyze` workflows carry
+over: mythril_tpu.smt, mythril_tpu.laser, mythril_tpu.analysis,
+mythril_tpu.interfaces.cli.
+"""
+
+__version__ = "0.1.0"

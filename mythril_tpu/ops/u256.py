@@ -1,0 +1,414 @@
+"""256-bit unsigned/signed arithmetic on JAX arrays, TPU-first.
+
+A 256-bit EVM word is represented as 16 little-endian limbs of 16 bits
+each, stored in ``uint32`` (shape ``[..., 16]``).  16-bit limbs are
+chosen so that a limb product fits exactly in uint32 (no 64-bit
+intermediates, which TPUs emulate slowly), and accumulated partial
+products stay far below 2**32 so carry propagation is cheap and branch
+free.  Every function broadcasts over arbitrary leading batch
+dimensions and is `vmap`/`jit`/`shard_map` safe: static shapes, no
+data-dependent Python control flow.
+
+This module is the arithmetic substrate for both the batched concrete
+interpreter and the constraint-arena evaluator; it supplies the
+semantics of the reference's per-opcode integer ops
+(reference: mythril/laser/ethereum/instructions.py — ADD/MUL/SUB/DIV/
+SDIV/MOD/SMOD/ADDMOD/MULMOD/EXP/SIGNEXTEND/LT/GT/SLT/SGT/EQ/ISZERO/
+AND/OR/XOR/NOT/BYTE/SHL/SHR/SAR handlers), evaluated here on whole
+batches of lanes at once instead of one Python object at a time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+LIMBS = 16  # limbs per 256-bit word
+LIMB_BITS = 16
+LIMB_MASK = np.uint32(0xFFFF)
+BITS = LIMBS * LIMB_BITS  # 256
+U32 = jnp.uint32
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion helpers (python ints are the spec oracle)
+# ---------------------------------------------------------------------------
+
+
+def from_int(x: int, limbs: int = LIMBS) -> np.ndarray:
+    """Python int -> limb vector (numpy uint32[limbs])."""
+    x &= (1 << (limbs * LIMB_BITS)) - 1
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & 0xFFFF for i in range(limbs)], dtype=np.uint32
+    )
+
+
+def to_int(a) -> int:
+    """Limb vector -> python int (host only)."""
+    a = np.asarray(a)
+    return sum(int(a[..., i]) << (LIMB_BITS * i) for i in range(a.shape[-1]))
+
+
+def zeros(shape=(), limbs: int = LIMBS):
+    return jnp.zeros(shape + (limbs,), dtype=U32)
+
+
+def const(x: int, shape=(), limbs: int = LIMBS):
+    w = jnp.asarray(from_int(x, limbs))
+    return jnp.broadcast_to(w, shape + (limbs,))
+
+
+# ---------------------------------------------------------------------------
+# carry machinery
+# ---------------------------------------------------------------------------
+
+
+def _carry(s):
+    """Propagate carries over raw limb sums (each < 2**31). Drops overflow."""
+    n = s.shape[-1]
+    out = []
+    c = jnp.zeros(s.shape[:-1], dtype=U32)
+    for i in range(n):
+        t = s[..., i] + c
+        out.append(t & LIMB_MASK)
+        c = t >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def add(a, b):
+    """(a + b) mod 2**(16*limbs)."""
+    return _carry(a + b)
+
+
+def sub(a, b):
+    """(a - b) mod 2**(16*limbs), two's complement."""
+    s = a + (LIMB_MASK - b)
+    one = jnp.zeros(s.shape, dtype=U32).at[..., 0].set(1)
+    return _carry(s + one)
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
+
+
+def _schoolbook(a, b, out_limbs):
+    """Partial-product sum with lo/hi accumulators, truncated to out_limbs."""
+    n = a.shape[-1]
+    lo = [jnp.zeros(a.shape[:-1], dtype=U32) for _ in range(out_limbs)]
+    hi = [jnp.zeros(a.shape[:-1], dtype=U32) for _ in range(out_limbs)]
+    for i in range(n):
+        for j in range(min(n, out_limbs - i)):
+            p = a[..., i] * b[..., j]
+            k = i + j
+            lo[k] = lo[k] + (p & LIMB_MASK)
+            hi[k] = hi[k] + (p >> LIMB_BITS)
+    s = [lo[0]] + [lo[k] + hi[k - 1] for k in range(1, out_limbs)]
+    return _carry(jnp.stack(s, axis=-1))
+
+
+def mul(a, b):
+    """(a * b) mod 2**256 (schoolbook, lo/hi accumulators)."""
+    return _schoolbook(a, b, a.shape[-1])
+
+
+def mul_wide(a, b):
+    """Full 512-bit product of two 256-bit words -> [..., 32] limbs."""
+    return _schoolbook(a, b, 2 * a.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def ult(a, b):
+    """a < b unsigned."""
+    res = jnp.zeros(a.shape[:-1], dtype=bool)
+    decided = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in reversed(range(a.shape[-1])):
+        ai, bi = a[..., i], b[..., i]
+        res = jnp.where(~decided & (ai < bi), True, res)
+        decided = decided | (ai != bi)
+    return res
+
+
+def ule(a, b):
+    return ~ult(b, a)
+
+
+def sign_bit(a):
+    """True if the 256-bit value is negative (bit 255 set)."""
+    return (a[..., -1] >> (LIMB_BITS - 1)) & 1
+
+
+def slt(a, b):
+    sa, sb = sign_bit(a), sign_bit(b)
+    return jnp.where(sa != sb, sa == 1, ult(a, b))
+
+
+# ---------------------------------------------------------------------------
+# bitwise
+# ---------------------------------------------------------------------------
+
+
+def bit_and(a, b):
+    return a & b
+
+
+def bit_or(a, b):
+    return a | b
+
+
+def bit_xor(a, b):
+    return a ^ b
+
+
+def bit_not(a):
+    return a ^ LIMB_MASK
+
+
+# ---------------------------------------------------------------------------
+# shifts (shift amount: uint32 scalar-per-lane, broadcast over batch dims)
+# ---------------------------------------------------------------------------
+
+
+def _limb_gather(a, idx):
+    """a[..., idx] with idx [..., n] possibly out of range -> 0."""
+    n = a.shape[-1]
+    safe = jnp.clip(idx, 0, n - 1)
+    v = jnp.take_along_axis(a, safe.astype(jnp.int32), axis=-1)
+    return jnp.where((idx < 0) | (idx >= n), jnp.uint32(0), v)
+
+
+def shl(a, s):
+    """a << s; s is uint32 with shape == batch dims. s >= 256 -> 0."""
+    n = a.shape[-1]
+    s = s.astype(jnp.int32)
+    ls, bs = s // LIMB_BITS, (s % LIMB_BITS).astype(U32)
+    k = jnp.arange(n, dtype=jnp.int32)
+    idx1 = k - ls[..., None]
+    idx2 = idx1 - 1
+    v1 = _limb_gather(a, idx1)
+    v2 = _limb_gather(a, idx2)
+    bs_ = bs[..., None]
+    out = ((v1 << bs_) | jnp.where(bs_ == 0, 0, v2 >> (LIMB_BITS - bs_))) & LIMB_MASK
+    return jnp.where((s >= n * LIMB_BITS)[..., None], jnp.uint32(0), out)
+
+
+def lshr(a, s):
+    """a >> s logical; s >= 256 -> 0."""
+    n = a.shape[-1]
+    s = s.astype(jnp.int32)
+    ls, bs = s // LIMB_BITS, (s % LIMB_BITS).astype(U32)
+    k = jnp.arange(n, dtype=jnp.int32)
+    idx1 = k + ls[..., None]
+    idx2 = idx1 + 1
+    v1 = _limb_gather(a, idx1)
+    v2 = _limb_gather(a, idx2)
+    bs_ = bs[..., None]
+    out = ((v1 >> bs_) | jnp.where(bs_ == 0, 0, v2 << (LIMB_BITS - bs_))) & LIMB_MASK
+    return jnp.where((s >= n * LIMB_BITS)[..., None], jnp.uint32(0), out)
+
+
+def ashr(a, s):
+    """a >> s arithmetic; s >= 256 -> 0 or all-ones by sign."""
+    n = a.shape[-1]
+    neg_ = sign_bit(a) == 1
+    s_cl = jnp.minimum(s.astype(jnp.int32), n * LIMB_BITS)
+    logical = lshr(a, s_cl.astype(U32))
+    # fill the top s bits with the sign
+    k = jnp.arange(n, dtype=jnp.int32)
+    # bit position of limb start after shift: bits >= 256 - s get filled
+    fill_from = n * LIMB_BITS - s_cl  # first filled bit index
+    limb_lo = k * LIMB_BITS
+    # mask of filled bits per limb
+    start = jnp.clip(fill_from[..., None] - limb_lo, 0, LIMB_BITS)
+    # bits [start, 16) set; 1 << 16 still fits in uint32
+    fill_mask = (jnp.uint32(0x10000) - (jnp.uint32(1) << start.astype(U32))) & LIMB_MASK
+    filled = logical | fill_mask
+    return jnp.where(neg_[..., None], filled, logical)
+
+
+def shift_amount(a):
+    """Clamp a 256-bit shift amount to uint32 (anything >= 2**16 saturates)."""
+    high = jnp.any(a[..., 1:] != 0, axis=-1)
+    return jnp.where(high, jnp.uint32(0xFFFF), a[..., 0])
+
+
+# ---------------------------------------------------------------------------
+# division / modulo (EVM semantics: x/0 == 0, x%0 == 0)
+# ---------------------------------------------------------------------------
+
+
+def _shl1_with_bit(r, bit):
+    """r = (r << 1) | bit, over r's limbs."""
+    n = r.shape[-1]
+    out = []
+    for i in range(n):
+        lo = bit if i == 0 else (r[..., i - 1] >> (LIMB_BITS - 1))
+        out.append(((r[..., i] << 1) | lo) & LIMB_MASK)
+    return jnp.stack(out, axis=-1)
+
+
+def udivmod(num, den):
+    """Unsigned long division. num: [..., L] limbs, den: [..., D<=L+1] limbs.
+
+    Returns (q [..., L], r [..., D]). Division by zero yields (0, 0).
+    """
+    nl = num.shape[-1]
+    dl = den.shape[-1]
+    wl = dl + 1  # remainder working width (r < 2*den after shift)
+    d = jnp.pad(den, [(0, 0)] * (den.ndim - 1) + [(0, wl - dl)])
+    r = jnp.zeros(num.shape[:-1] + (wl,), dtype=U32)
+    q = jnp.zeros_like(num)
+    dz = is_zero(den)
+
+    def body(i, carry):
+        q, r = carry
+        j = nl * LIMB_BITS - 1 - i
+        limb, bit = j // LIMB_BITS, j % LIMB_BITS
+        nbit = (jnp.take(num, limb, axis=-1) >> bit.astype(U32)) & 1
+        r = _shl1_with_bit(r, nbit)
+        ge = ~ult(r, d)
+        r = jnp.where(ge[..., None], sub(r, d), r)
+        onehot = (jnp.arange(nl) == limb).astype(U32)
+        q = q | (jnp.where(ge, jnp.uint32(1), jnp.uint32(0))[..., None]
+                 << bit.astype(U32)) * onehot
+        return q, r
+
+    q, r = lax.fori_loop(0, nl * LIMB_BITS, body, (q, r))
+    q = jnp.where(dz[..., None], jnp.uint32(0), q)
+    r = jnp.where(dz[..., None], jnp.uint32(0), r[..., :dl])
+    return q, r
+
+
+def udiv(a, b):
+    return udivmod(a, b)[0]
+
+
+def urem(a, b):
+    return udivmod(a, b)[1]
+
+
+def _abs(a):
+    return jnp.where((sign_bit(a) == 1)[..., None], neg(a), a)
+
+
+def sdiv(a, b):
+    """EVM SDIV: truncated toward zero; MIN_INT / -1 == MIN_INT."""
+    q = udiv(_abs(a), _abs(b))
+    flip = sign_bit(a) != sign_bit(b)
+    return jnp.where(flip[..., None], neg(q), q)
+
+
+def srem(a, b):
+    """EVM SMOD: sign follows the dividend."""
+    r = urem(_abs(a), _abs(b))
+    return jnp.where((sign_bit(a) == 1)[..., None], neg(r), r)
+
+
+def _widen(a, limbs):
+    return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, limbs - a.shape[-1])])
+
+
+def addmod(a, b, m):
+    """(a + b) mod m over the full 257-bit sum (reference: ADDMOD)."""
+    wide = add(_widen(a, LIMBS + 1), _widen(b, LIMBS + 1))
+    _, r = udivmod(wide, m)
+    return r
+
+
+def mulmod(a, b, m):
+    """(a * b) mod m over the full 512-bit product (reference: MULMOD)."""
+    wide = mul_wide(a, b)
+    _, r = udivmod(wide, m)
+    return r
+
+
+def exp(a, e):
+    """a ** e mod 2**256 by square-and-multiply (256 steps)."""
+
+    def body(i, carry):
+        result, base = carry
+        limb, bit = i // LIMB_BITS, i % LIMB_BITS
+        ebit = (jnp.take(e, limb, axis=-1) >> bit.astype(U32)) & 1
+        result = jnp.where((ebit == 1)[..., None], mul(result, base), result)
+        base = mul(base, base)
+        return result, base
+
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    one = jnp.broadcast_to(one, a.shape)
+    result, _ = lax.fori_loop(0, BITS, body, (one, a))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# EVM-specific bit ops
+# ---------------------------------------------------------------------------
+
+
+def byte_op(i, x):
+    """EVM BYTE: i-th byte counted from the most-significant end."""
+    big = jnp.any(i[..., 1:] != 0, axis=-1) | (i[..., 0] >= 32)
+    ib = jnp.minimum(i[..., 0], 31).astype(jnp.int32)
+    b = 31 - ib  # byte index from LSB
+    limb = b // 2
+    shift = (8 * (b % 2)).astype(U32)
+    v = jnp.take_along_axis(x, limb[..., None], axis=-1)[..., 0]
+    out_lo = (v >> shift) & 0xFF
+    out = jnp.zeros(x.shape, dtype=U32).at[..., 0].set(out_lo)
+    return jnp.where(big[..., None], jnp.uint32(0), out)
+
+
+def signextend(b, x):
+    """EVM SIGNEXTEND: extend the sign of the low (b+1) bytes."""
+    big = jnp.any(b[..., 1:] != 0, axis=-1) | (b[..., 0] >= 31)
+    bb = jnp.minimum(b[..., 0], 31).astype(jnp.int32)
+    t = 8 * bb + 7  # sign bit index
+    limb = t // LIMB_BITS
+    bit = (t % LIMB_BITS).astype(U32)
+    v = jnp.take_along_axis(x, limb[..., None], axis=-1)[..., 0]
+    sign = (v >> bit) & 1
+    k = jnp.arange(LIMBS, dtype=jnp.int32)
+    nbits = jnp.clip(t[..., None] + 1 - k * LIMB_BITS, 0, LIMB_BITS)
+    mask_low = ((jnp.uint32(1) << nbits.astype(U32)) - 1) & LIMB_MASK
+    ext = jnp.where((sign == 1)[..., None], x | (mask_low ^ LIMB_MASK), x & mask_low)
+    return jnp.where(big[..., None], x, ext)
+
+
+# ---------------------------------------------------------------------------
+# byte packing (memory/calldata interop): 32 big-endian bytes <-> limbs
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_word(b):
+    """[..., 32] uint8/uint32 big-endian bytes -> [..., 16] limbs."""
+    b = b.astype(U32)
+    hi = b[..., 0:32:2]  # even positions: high byte of each 16-bit group
+    lo = b[..., 1:32:2]
+    be_limbs = (hi << 8) | lo  # big-endian limb order
+    return be_limbs[..., ::-1]
+
+
+def word_to_bytes(w):
+    """[..., 16] limbs -> [..., 32] uint8 big-endian bytes."""
+    be = w[..., ::-1]
+    hi = (be >> 8) & 0xFF
+    lo = be & 0xFF
+    out = jnp.stack([hi, lo], axis=-1).reshape(w.shape[:-1] + (32,))
+    return out.astype(jnp.uint8)
+
+
+def bool_to_word(c):
+    """bool [...] -> 0/1 word."""
+    z = jnp.zeros(c.shape + (LIMBS,), dtype=U32)
+    return z.at[..., 0].set(c.astype(U32))

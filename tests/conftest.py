@@ -1,0 +1,24 @@
+"""Test configuration.
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding
+logic (mythril_tpu.parallel) is exercised without TPU hardware, per the
+reference's "test chain interaction without a chain" strategy
+(reference: tests/__init__.py + mocked RPC in tests/mythril/).
+
+NOTE: this machine pins JAX_PLATFORMS=axon through a sitecustomize that
+overrides environment variables, so the platform switch must go through
+jax.config (env vars are silently ignored). XLA_FLAGS still must be set
+before first backend init.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,193 @@
+"""Property tests: u256 limb arithmetic vs python-int EVM semantics.
+
+Python ints are the spec oracle, mirroring the reference's reliance on
+z3/py ints for arithmetic semantics (reference:
+mythril/laser/ethereum/instructions.py arithmetic handlers).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.ops import u256
+
+M = 1 << 256
+HALF = 1 << 255
+
+u256_ints = st.one_of(
+    st.integers(min_value=0, max_value=M - 1),
+    st.sampled_from(
+        [0, 1, 2, M - 1, M - 2, HALF, HALF - 1, HALF + 1, (1 << 128) - 1, 1 << 128]
+    ),
+)
+
+
+def as_signed(x):
+    return x - M if x >= HALF else x
+
+
+def roundtrip(x):
+    return u256.to_int(u256.from_int(x))
+
+
+# jit once per op so hypothesis examples re-run from the compile cache
+J = {
+    name: jax.jit(getattr(u256, name))
+    for name in [
+        "add", "sub", "mul", "udiv", "urem", "sdiv", "srem", "ult", "eq",
+        "slt", "bit_and", "bit_or", "bit_xor", "bit_not", "shl", "lshr",
+        "ashr", "addmod", "mulmod", "exp", "byte_op", "signextend",
+        "bytes_to_word", "word_to_bytes",
+    ]
+}
+
+
+@given(u256_ints)
+def test_roundtrip(x):
+    assert roundtrip(x) == x
+
+
+def _binop(fn, a, b):
+    fn = J.get(getattr(fn, "__name__", None), fn)
+    return u256.to_int(fn(jnp.asarray(u256.from_int(a)), jnp.asarray(u256.from_int(b))))
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, u256_ints)
+def test_add_sub_mul(a, b):
+    assert _binop(u256.add, a, b) == (a + b) % M
+    assert _binop(u256.sub, a, b) == (a - b) % M
+    assert _binop(u256.mul, a, b) == (a * b) % M
+
+
+@settings(deadline=None, max_examples=40)
+@given(u256_ints, u256_ints)
+def test_divmod(a, b):
+    q = _binop(u256.udiv, a, b)
+    r = _binop(u256.urem, a, b)
+    if b == 0:
+        assert q == 0 and r == 0
+    else:
+        assert q == a // b and r == a % b
+
+
+@settings(deadline=None, max_examples=40)
+@given(u256_ints, u256_ints)
+def test_signed_divmod(a, b):
+    sa, sb = as_signed(a), as_signed(b)
+    q = _binop(u256.sdiv, a, b)
+    r = _binop(u256.srem, a, b)
+    if sb == 0:
+        assert q == 0 and r == 0
+    else:
+        expect_q = abs(sa) // abs(sb)
+        if (sa < 0) != (sb < 0):
+            expect_q = -expect_q
+        expect_r = abs(sa) % abs(sb)
+        if sa < 0:
+            expect_r = -expect_r
+        assert q == expect_q % M
+        assert r == expect_r % M
+
+
+def test_sdiv_min_by_minus_one():
+    assert _binop(u256.sdiv, HALF, M - 1) == HALF
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, u256_ints)
+def test_compare(a, b):
+    av, bv = jnp.asarray(u256.from_int(a)), jnp.asarray(u256.from_int(b))
+    assert bool(J["ult"](av, bv)) == (a < b)
+    assert bool(J["eq"](av, bv)) == (a == b)
+    assert bool(J["slt"](av, bv)) == (as_signed(a) < as_signed(b))
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, u256_ints)
+def test_bitwise(a, b):
+    assert _binop(u256.bit_and, a, b) == a & b
+    assert _binop(u256.bit_or, a, b) == a | b
+    assert _binop(u256.bit_xor, a, b) == a ^ b
+    av = jnp.asarray(u256.from_int(a))
+    assert u256.to_int(J["bit_not"](av)) == (~a) % M
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, st.integers(min_value=0, max_value=300))
+def test_shifts(a, s):
+    av = jnp.asarray(u256.from_int(a))
+    sv = jnp.uint32(s)
+    assert u256.to_int(J["shl"](av, sv)) == ((a << s) % M if s < 256 else 0)
+    assert u256.to_int(J["lshr"](av, sv)) == (a >> s if s < 256 else 0)
+    sa = as_signed(a)
+    expect_sar = sa >> s if s < 256 else (-1 if sa < 0 else 0)
+    assert u256.to_int(J["ashr"](av, sv)) == expect_sar % M
+
+
+@settings(deadline=None, max_examples=30)
+@given(u256_ints, u256_ints, u256_ints)
+def test_addmod_mulmod(a, b, m):
+    av, bv, mv = (jnp.asarray(u256.from_int(x)) for x in (a, b, m))
+    am = u256.to_int(J["addmod"](av, bv, mv))
+    mm = u256.to_int(J["mulmod"](av, bv, mv))
+    if m == 0:
+        assert am == 0 and mm == 0
+    else:
+        assert am == (a + b) % m
+        assert mm == (a * b) % m
+
+
+@settings(deadline=None, max_examples=15)
+@given(u256_ints, st.integers(min_value=0, max_value=M - 1))
+def test_exp(a, e):
+    av, ev = jnp.asarray(u256.from_int(a)), jnp.asarray(u256.from_int(e))
+    assert u256.to_int(J["exp"](av, ev)) == pow(a, e, M)
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, st.integers(min_value=0, max_value=40))
+def test_byte(x, i):
+    xv, iv = jnp.asarray(u256.from_int(x)), jnp.asarray(u256.from_int(i))
+    got = u256.to_int(J["byte_op"](iv, xv))
+    expect = (x >> (8 * (31 - i))) & 0xFF if i < 32 else 0
+    assert got == expect
+
+
+@settings(deadline=None, max_examples=60)
+@given(u256_ints, st.integers(min_value=0, max_value=40))
+def test_signextend(x, b):
+    xv, bv = jnp.asarray(u256.from_int(x)), jnp.asarray(u256.from_int(b))
+    got = u256.to_int(J["signextend"](bv, xv))
+    if b >= 31:
+        expect = x
+    else:
+        t = 8 * (b + 1)
+        low = x % (1 << t)
+        if low >= (1 << (t - 1)):
+            low -= 1 << t
+        expect = low % M
+    assert got == expect
+
+
+@settings(deadline=None, max_examples=40)
+@given(u256_ints, u256_ints)
+def test_bytes_roundtrip(a, b):
+    av = jnp.asarray(u256.from_int(a))
+    by = J["word_to_bytes"](av)
+    expect = a.to_bytes(32, "big")
+    assert bytes(np.asarray(by).tolist()) == expect
+    assert u256.to_int(J["bytes_to_word"](by)) == a
+
+
+def test_batched_vmap_matches_scalar():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 16, size=(64, 16), dtype=np.uint32)
+    b = rng.integers(0, 1 << 16, size=(64, 16), dtype=np.uint32)
+    av, bv = jnp.asarray(a), jnp.asarray(b)
+    out = jax.jit(u256.mul)(av, bv)
+    for i in range(0, 64, 7):
+        assert u256.to_int(out[i]) == (u256.to_int(a[i]) * u256.to_int(b[i])) % M
