@@ -1,0 +1,97 @@
+// Native keccak-256 (EVM variant) for the host fast path.
+//
+// Replaces the reference's pysha3 C extension dependency
+// (reference: mythril/support/support_utils.py:29-41). Exposed over a
+// plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint64_t kRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
+};
+
+constexpr int kRot[5][5] = {
+    {0, 36, 3, 41, 18},
+    {1, 44, 10, 45, 2},
+    {62, 6, 43, 15, 61},
+    {28, 55, 25, 21, 56},
+    {27, 20, 39, 8, 14},
+};
+
+inline uint64_t rol(uint64_t v, int n) {
+  n &= 63;
+  return n == 0 ? v : (v << n) | (v >> (64 - n));
+}
+
+void keccak_f(uint64_t st[25]) {
+  for (int rnd = 0; rnd < 24; ++rnd) {
+    uint64_t c[5], d[5], b[25];
+    for (int x = 0; x < 5; ++x)
+      c[x] = st[x] ^ st[x + 5] ^ st[x + 10] ^ st[x + 15] ^ st[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rol(c[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) st[i] ^= d[i % 5];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rol(st[x + 5 * y], kRot[x][y]);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        st[x + 5 * y] =
+            b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y]);
+    st[0] ^= kRC[rnd];
+  }
+}
+
+constexpr size_t kRate = 136;
+
+}  // namespace
+
+extern "C" {
+
+void mtpu_keccak256(const char* data, size_t len, char* out32) {
+  uint64_t st[25] = {0};
+  size_t off = 0;
+  // full blocks
+  while (len - off >= kRate) {
+    for (size_t i = 0; i < kRate / 8; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, data + off + 8 * i, 8);
+      st[i] ^= lane;  // little-endian host assumed (x86/ARM/TPU hosts)
+    }
+    keccak_f(st);
+    off += kRate;
+  }
+  // final partial block with multi-rate padding 0x01 ... 0x80
+  unsigned char block[kRate] = {0};
+  std::memcpy(block, data + off, len - off);
+  block[len - off] = 0x01;
+  block[kRate - 1] |= 0x80;
+  for (size_t i = 0; i < kRate / 8; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    st[i] ^= lane;
+  }
+  keccak_f(st);
+  std::memcpy(out32, st, 32);
+}
+
+// Batched variant: n messages of fixed stride, used for bulk selector
+// recovery and corpus code hashing.
+void mtpu_keccak256_batch(const char* data, size_t stride, size_t len,
+                          size_t n, char* out) {
+  for (size_t i = 0; i < n; ++i)
+    mtpu_keccak256(data + i * stride, len, out + 32 * i);
+}
+
+}  // extern "C"

@@ -1,0 +1,148 @@
+"""Batched keccak-256 on device (JAX, TPU-first).
+
+64-bit lanes are pairs of uint32 (no 64-bit ints on TPU). The whole
+permutation is elementwise XOR/shift/rotate, so it vectorizes over an
+arbitrary batch of messages — this is what lets the solver *compute*
+keccak for thousands of candidate models at once instead of modeling it
+as an uninterpreted function the way the reference does
+(reference: mythril/laser/ethereum/keccak_function_manager.py — the
+interval/injectivity encoding exists there only because z3 cannot
+execute keccak; on TPU we can, in batch).
+
+Message length is static per call site (EVM keccak inputs in symbolic
+execution are almost always 32 or 64 bytes: storage-slot hashing).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mythril_tpu.support.keccak import RC as _RC_INT
+
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_RATE = 136
+
+_RC_LO = np.array([rc & 0xFFFFFFFF for rc in _RC_INT], dtype=np.uint32)
+_RC_HI = np.array([rc >> 32 for rc in _RC_INT], dtype=np.uint32)
+
+
+def _rol64(lo, hi, n):
+    """Rotate a (lo, hi) uint32 pair left by static n."""
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        return (
+            (lo << n) | (hi >> (32 - n)),
+            (hi << n) | (lo >> (32 - n)),
+        )
+    n -= 32
+    return (
+        (hi << n) | (lo >> (32 - n)),
+        (lo << n) | (hi >> (32 - n)),
+    )
+
+
+def keccak_f(lo, hi):
+    """keccak-f[1600] on [..., 25] uint32 lane pairs.
+
+    The 24 rounds run under lax.fori_loop so the compiled graph holds a
+    single round body (an unrolled version takes ~25s to compile per
+    input shape; this takes ~2s)."""
+    from jax import lax
+
+    def round_fn(rnd, carry):
+        lo, hi = carry
+        lo, hi = _round(lo, hi, rnd)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, 24, round_fn, (lo, hi))
+    return lo, hi
+
+
+def _round(lo, hi, rnd):
+    clo = [lo[..., x] ^ lo[..., x + 5] ^ lo[..., x + 10] ^ lo[..., x + 15] ^ lo[..., x + 20] for x in range(5)]
+    chi_ = [hi[..., x] ^ hi[..., x + 5] ^ hi[..., x + 10] ^ hi[..., x + 15] ^ hi[..., x + 20] for x in range(5)]
+    dlo, dhi = [], []
+    for x in range(5):
+        rl, rh = _rol64(clo[(x + 1) % 5], chi_[(x + 1) % 5], 1)
+        dlo.append(clo[(x + 4) % 5] ^ rl)
+        dhi.append(chi_[(x + 4) % 5] ^ rh)
+    alo = [lo[..., i] ^ dlo[i % 5] for i in range(25)]
+    ahi = [hi[..., i] ^ dhi[i % 5] for i in range(25)]
+    blo, bhi = [None] * 25, [None] * 25
+    for x in range(5):
+        for y in range(5):
+            rl, rh = _rol64(alo[x + 5 * y], ahi[x + 5 * y], _ROT[x][y])
+            blo[y + 5 * ((2 * x + 3 * y) % 5)] = rl
+            bhi[y + 5 * ((2 * x + 3 * y) % 5)] = rh
+    outlo, outhi = [], []
+    for i in range(25):
+        x, y = i % 5, i // 5
+        i1, i2 = (x + 1) % 5 + 5 * y, (x + 2) % 5 + 5 * y
+        outlo.append(blo[i] ^ ((~blo[i1]) & blo[i2]))
+        outhi.append(bhi[i] ^ ((~bhi[i1]) & bhi[i2]))
+    outlo[0] = outlo[0] ^ jnp.take(jnp.asarray(_RC_LO), rnd)
+    outhi[0] = outhi[0] ^ jnp.take(jnp.asarray(_RC_HI), rnd)
+    lo = jnp.stack(outlo, axis=-1)
+    hi = jnp.stack(outhi, axis=-1)
+    return lo, hi
+
+
+def keccak256(msg):
+    """Batched keccak-256. msg: [..., L] uint8 (static L) -> [..., 32] uint8."""
+    length = msg.shape[-1]
+    batch = msg.shape[:-1]
+    # pad to the next multiple of RATE; when only one byte is free the
+    # 0x01 and 0x80 markers land on the same byte (0x81), which is what
+    # multi-rate padding specifies
+    padded_len = (length // _RATE + 1) * _RATE
+    pad = jnp.zeros(batch + (padded_len - length,), dtype=jnp.uint8)
+    pad = pad.at[..., 0].set(0x01)
+    pad = pad.at[..., -1].set(pad[..., -1] | 0x80)
+    data = jnp.concatenate([msg.astype(jnp.uint8), pad], axis=-1)
+
+    lo = jnp.zeros(batch + (25,), dtype=jnp.uint32)
+    hi = jnp.zeros(batch + (25,), dtype=jnp.uint32)
+    for off in range(0, padded_len, _RATE):
+        block = data[..., off : off + _RATE].astype(jnp.uint32)
+        # little-endian lanes: byte 8i+j contributes to lane i bits 8j
+        lanes = block.reshape(batch + (_RATE // 8, 8))
+        blo = (lanes[..., 0] | (lanes[..., 1] << 8) | (lanes[..., 2] << 16)
+               | (lanes[..., 3] << 24))
+        bhi = (lanes[..., 4] | (lanes[..., 5] << 8) | (lanes[..., 6] << 16)
+               | (lanes[..., 7] << 24))
+        nl = _RATE // 8
+        lo = lo.at[..., :nl].set(lo[..., :nl] ^ blo)
+        hi = hi.at[..., :nl].set(hi[..., :nl] ^ bhi)
+        lo, hi = keccak_f(lo, hi)
+
+    # squeeze 32 bytes = lanes 0..3, little-endian
+    out_lanes_lo = lo[..., :4]
+    out_lanes_hi = hi[..., :4]
+    by = []
+    for j in range(4):
+        by.append((out_lanes_lo >> (8 * j)) & 0xFF)
+    for j in range(4):
+        by.append((out_lanes_hi >> (8 * j)) & 0xFF)
+    # interleave: per lane, 8 bytes (4 from lo, 4 from hi)
+    stacked = jnp.stack(by, axis=-1)  # [..., 4 lanes, 8 bytes]
+    return stacked.reshape(batch + (32,)).astype(jnp.uint8)
+
+
+def keccak256_word(msg):
+    """keccak-256 of [..., L] uint8 returned as a u256 limb word [..., 16]."""
+    from mythril_tpu.ops import u256
+
+    return u256.bytes_to_word(keccak256(msg).astype(jnp.uint32))

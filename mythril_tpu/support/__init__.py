@@ -1,0 +1,1 @@
+"""Host-side support: keccak, model cache, signatures, config, loaders."""

@@ -22,3 +22,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compile cache: amortize keccak/divmod compiles across runs
+os.makedirs("/tmp/mtpu_xla_cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/mtpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
