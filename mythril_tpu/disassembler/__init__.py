@@ -1,0 +1,3 @@
+"""Bytecode disassembly: hex -> instruction list, selector recovery, easm."""
+
+from mythril_tpu.disassembler.disassembly import Disassembly  # noqa: F401

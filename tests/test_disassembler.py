@@ -1,0 +1,102 @@
+"""Disassembler: roundtrip, selector recovery, metadata skipping, easm."""
+
+from mythril_tpu.disassembler import Disassembly
+from mythril_tpu.disassembler.asm import (
+    assemble,
+    disassemble,
+    find_metadata_length,
+    instruction_list_to_easm,
+    push,
+    safe_decode,
+    to_dense,
+)
+
+
+def test_assemble_disassemble_roundtrip():
+    src = [
+        "PUSH1 0x60",
+        "PUSH1 0x40",
+        "MSTORE",
+        "CALLDATASIZE",
+        "ISZERO",
+        "PUSH2 0x00ff",
+        "JUMPI",
+        "JUMPDEST",
+        "STOP",
+    ]
+    code = assemble(src)
+    instrs = disassemble(code)
+    assert [i.opcode for i in instrs] == [s.split()[0] for s in src]
+    assert instrs[0].argument == "0x60"
+    assert instrs[5].argument == "0x00ff"
+    assert instrs[5].address == 7
+
+
+def test_truncated_push_padded():
+    # PUSH2 with only one data byte at end of code
+    instrs = disassemble(bytes([0x61, 0xAA]))
+    assert instrs[0].opcode == "PUSH2"
+    assert instrs[0].argument == "0xaa00"
+
+
+def test_dense_arrays_jumpdest_mask():
+    code = assemble(["PUSH1 0x5b", "JUMPDEST", "PUSH2 0x5b5b", "JUMPDEST", "STOP"])
+    ops, jd = to_dense(code)
+    # 0x5b byte inside push data must NOT be a valid dest
+    assert jd[2] and jd[6]
+    assert not jd[1] and not jd[4] and not jd[5]
+    assert ops[2] == 0x5B
+
+
+def test_metadata_stripped():
+    code = assemble(["PUSH1 0x00", "STOP"])
+    meta = b"\xa1\x65bzzr0" + bytes(34)
+    blob = code + meta + len(meta).to_bytes(2, "big")
+    assert find_metadata_length(blob) == len(meta) + 2
+    assert [i.opcode for i in disassemble(blob)] == ["PUSH1", "STOP"]
+
+
+def test_dispatcher_function_recovery():
+    # minimal solidity-style dispatcher:
+    #   CALLDATALOAD >> 224 == 0xa9059cbb ? jump 0x40 : fallthrough
+    src = [
+        "PUSH1 0x00",
+        "CALLDATALOAD",
+        "PUSH1 0xe0",
+        "SHR",
+        "DUP1",
+        "PUSH4 0xa9059cbb",
+        "EQ",
+        "PUSH1 0x40",
+        "JUMPI",
+        "DUP1",
+        "PUSH4 0x23b872dd",
+        "EQ",
+        "PUSH1 0x60",
+        "JUMPI",
+        "STOP",
+    ]
+    dis = Disassembly(assemble(src).hex())
+    assert "0xa9059cbb" in dis.func_hashes
+    assert "0x23b872dd" in dis.func_hashes
+    addrs = dis.address_to_function_name
+    assert 0x40 in addrs and 0x60 in addrs
+
+
+def test_easm_and_hex_input():
+    dis = Disassembly("0x6001600201")
+    easm = dis.get_easm()
+    assert "PUSH1 0x01" in easm and "ADD" in easm
+    assert safe_decode("0x6001") == b"\x60\x01"
+
+
+def test_code_hash_is_keccak():
+    from mythril_tpu.support.keccak import keccak256
+
+    dis = Disassembly("0x6001")
+    assert dis.code_hash == "0x" + keccak256(b"\x60\x01").hex()
+
+
+def test_push_helper():
+    assert push(0x60) == "PUSH1 0x60"
+    assert push(0xA9059CBB) == "PUSH4 0xa9059cbb"
