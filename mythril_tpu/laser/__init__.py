@@ -1,0 +1,14 @@
+"""LASER-TPU: the symbolic EVM.
+
+Two engines share this package:
+
+- `mythril_tpu.laser.batch` — the batched concrete interpreter: a
+  `jit`-compiled state-transition kernel over a StateBatch pytree
+  (thousands of lanes per step). This is the lifted form of the
+  reference's one-state-at-a-time hot loop
+  (reference: mythril/laser/ethereum/svm.py:235 exec /
+  instructions.py Instruction.evaluate).
+- `mythril_tpu.laser.ethereum` — the symbolic engine: path-state
+  objects over the in-house SMT layer, driving detection modules, with
+  the batch engine and the device solver as accelerators.
+"""

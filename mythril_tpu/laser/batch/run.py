@@ -1,0 +1,38 @@
+"""Batch run loop: iterate the step kernel until every lane halts.
+
+This is the lifted `LaserEVM.exec` worklist loop (reference:
+mythril/laser/ethereum/svm.py:235-271) for the concrete/concolic case —
+no branching worklist, every lane advances each step under one jit'd
+`lax.while_loop`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mythril_tpu.laser.batch.state import CodeTable, StateBatch, Status
+from mythril_tpu.laser.batch.step import step
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps", "unroll"))
+def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
+        unroll: int = 1):
+    """Run all lanes to completion (or step budget). Returns
+    (final_batch, steps_executed)."""
+
+    def cond(carry):
+        b, i = carry
+        return (i < max_steps) & jnp.any(b.status == Status.RUNNING)
+
+    def body(carry):
+        b, i = carry
+        for _ in range(unroll):
+            b = step(b, code)
+        return b, i + unroll
+
+    out, steps = lax.while_loop(cond, body, (batch, jnp.int32(0)))
+    return out, steps

@@ -1,0 +1,202 @@
+"""StateBatch: the EVM machine state as a structure of arrays.
+
+The reference keeps one Python object graph per path state
+(reference: mythril/laser/ethereum/state/global_state.py,
+machine_state.py, memory.py, account.py) and copies it on every
+instruction (the #1 CPU cost per SURVEY §3.2). Here a *batch* of N
+machine states is one pytree of fixed-shape arrays; "copying" a state
+is free (functional updates), and forking a path is a lane copy.
+
+Shapes (N = lanes):
+  pc            i32[N]
+  stack         u32[N, STACK_CAP, 16]   (256-bit words as 16x16-bit limbs)
+  sp            i32[N]                  (next free slot)
+  mem           u8[N, MEM_CAP]
+  msize_words   i32[N]                  (EVM memory size in 32-byte words)
+  storage_*     bounded key/value journal per lane
+  status        i32[N]                  (Status enum)
+  gas_min/max   u32[N]                  (accumulated bounds, reference:
+                                         machine_state.py min_gas_used)
+plus per-lane environment words (caller, callvalue, calldata, block ctx).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mythril_tpu.ops import u256
+
+STACK_CAP = 128  # configurable; EVM max is 1024, real contracts stay shallow
+MEM_CAP = 4096  # bytes of modelled memory per lane
+STORAGE_CAP = 64  # journal entries per lane
+CALLDATA_CAP = 512  # bytes of calldata per lane
+HASH_CAP = 128  # max SHA3 input bytes handled on device (single rate block)
+
+
+class Status:
+    RUNNING = 0
+    STOPPED = 1
+    RETURNED = 2
+    REVERTED = 3
+    INVALID = 4  # ASSERT_FAIL / designated invalid opcode
+    ERR_STACK = 5  # under/overflow
+    ERR_JUMP = 6  # invalid jump destination
+    ERR_MEM = 7  # memory model capacity exceeded
+    UNSUPPORTED = 8  # opcode outside the device set -> host takes over
+
+    HALTED = (STOPPED, RETURNED, REVERTED, INVALID, ERR_STACK, ERR_JUMP,
+              ERR_MEM, UNSUPPORTED)
+
+
+class CodeTable(NamedTuple):
+    """Shared contract store: lanes reference rows by code_id."""
+
+    ops: jnp.ndarray  # u8[C, CODE_CAP + 33] (zero-padded for PUSH reads)
+    jumpdest: jnp.ndarray  # bool[C, CODE_CAP]
+    length: jnp.ndarray  # i32[C]
+
+
+class StateBatch(NamedTuple):
+    code_id: jnp.ndarray
+    pc: jnp.ndarray
+    stack: jnp.ndarray
+    sp: jnp.ndarray
+    mem: jnp.ndarray
+    msize_words: jnp.ndarray
+    storage_keys: jnp.ndarray
+    storage_vals: jnp.ndarray
+    storage_cnt: jnp.ndarray
+    status: jnp.ndarray
+    gas_min: jnp.ndarray
+    gas_max: jnp.ndarray
+    ret_offset: jnp.ndarray
+    ret_len: jnp.ndarray
+    # environment (reference: laser/ethereum/state/environment.py)
+    address: jnp.ndarray  # u32[N,16]
+    caller: jnp.ndarray
+    origin: jnp.ndarray
+    callvalue: jnp.ndarray
+    gasprice: jnp.ndarray
+    balance: jnp.ndarray  # active account balance
+    calldata: jnp.ndarray  # u8[N, CALLDATA_CAP]
+    calldatasize: jnp.ndarray  # i32[N]
+    # block context
+    timestamp: jnp.ndarray
+    number: jnp.ndarray
+    coinbase: jnp.ndarray
+    difficulty: jnp.ndarray
+    gaslimit: jnp.ndarray
+    chainid: jnp.ndarray
+    basefee: jnp.ndarray
+
+    @property
+    def n_lanes(self) -> int:
+        return self.pc.shape[0]
+
+    @property
+    def active(self):
+        return self.status == Status.RUNNING
+
+
+def make_code_table(codes, code_cap: int = None) -> CodeTable:
+    """Build a CodeTable from a list of bytecode byte strings."""
+    from mythril_tpu.disassembler.asm import to_dense
+
+    code_cap = code_cap or max((len(c) for c in codes), default=1)
+    ops = np.zeros((len(codes), code_cap + 33), dtype=np.uint8)
+    jd = np.zeros((len(codes), code_cap), dtype=bool)
+    length = np.zeros((len(codes),), dtype=np.int32)
+    for i, code in enumerate(codes):
+        o, j = to_dense(code, max_len=code_cap)
+        ops[i, :code_cap] = o
+        jd[i] = j
+        length[i] = min(len(code), code_cap)
+    return CodeTable(jnp.asarray(ops), jnp.asarray(jd), jnp.asarray(length))
+
+
+def _word_rows(n, value: int = 0):
+    return jnp.broadcast_to(jnp.asarray(u256.from_int(value)), (n, u256.LIMBS))
+
+
+def make_batch(
+    n: int,
+    code_ids=None,
+    calldata=None,
+    callvalue: int = 0,
+    caller: int = 0xDEADBEEFDEADBEEF,
+    address: int = 0xAFFEAFFE,
+    balance: int = 10**18,
+    timestamp: int = 1_600_000_000,
+    number: int = 10_000_000,
+    chainid: int = 1,
+    gasprice: int = 10,
+) -> StateBatch:
+    """Fresh batch at pc=0 with empty stacks and zeroed memory/storage."""
+    code_ids = (
+        jnp.zeros((n,), jnp.int32)
+        if code_ids is None
+        else jnp.asarray(code_ids, jnp.int32)
+    )
+    cd = np.zeros((n, CALLDATA_CAP), dtype=np.uint8)
+    cds = np.zeros((n,), dtype=np.int32)
+    if calldata is not None:
+        for i, data in enumerate(calldata):
+            m = min(len(data), CALLDATA_CAP)
+            cd[i, :m] = np.frombuffer(bytes(data[:m]), dtype=np.uint8)
+            cds[i] = len(data)
+    return StateBatch(
+        code_id=code_ids,
+        pc=jnp.zeros((n,), jnp.int32),
+        stack=jnp.zeros((n, STACK_CAP, u256.LIMBS), jnp.uint32),
+        sp=jnp.zeros((n,), jnp.int32),
+        mem=jnp.zeros((n, MEM_CAP), jnp.uint8),
+        msize_words=jnp.zeros((n,), jnp.int32),
+        storage_keys=jnp.zeros((n, STORAGE_CAP, u256.LIMBS), jnp.uint32),
+        storage_vals=jnp.zeros((n, STORAGE_CAP, u256.LIMBS), jnp.uint32),
+        storage_cnt=jnp.zeros((n,), jnp.int32),
+        status=jnp.zeros((n,), jnp.int32),
+        gas_min=jnp.zeros((n,), jnp.uint32),
+        gas_max=jnp.zeros((n,), jnp.uint32),
+        ret_offset=jnp.zeros((n,), jnp.int32),
+        ret_len=jnp.zeros((n,), jnp.int32),
+        address=_word_rows(n, address),
+        caller=_word_rows(n, caller),
+        origin=_word_rows(n, caller),
+        callvalue=_word_rows(n, callvalue),
+        gasprice=_word_rows(n, gasprice),
+        balance=_word_rows(n, balance),
+        calldata=jnp.asarray(cd),
+        calldatasize=jnp.asarray(cds),
+        timestamp=_word_rows(n, timestamp),
+        number=_word_rows(n, number),
+        coinbase=_word_rows(n, 0),
+        difficulty=_word_rows(n, 0x0BAD),
+        gaslimit=_word_rows(n, 8_000_000),
+        chainid=_word_rows(n, chainid),
+        basefee=_word_rows(n, 7),
+    )
+
+
+def storage_dict(batch: StateBatch, lane: int) -> dict:
+    """Host-side view of one lane's storage journal (latest write wins)."""
+    keys = np.asarray(batch.storage_keys[lane])
+    vals = np.asarray(batch.storage_vals[lane])
+    cnt = int(batch.storage_cnt[lane])
+    out = {}
+    for i in range(cnt):
+        out[u256.to_int(keys[i])] = u256.to_int(vals[i])
+    return {k: v for k, v in out.items() if v != 0}
+
+
+def stack_list(batch: StateBatch, lane: int) -> list:
+    """Host-side view of one lane's stack (bottom to top)."""
+    sp = int(batch.sp[lane])
+    return [u256.to_int(np.asarray(batch.stack[lane, i])) for i in range(sp)]
+
+
+def mem_bytes(batch: StateBatch, lane: int, offset: int, length: int) -> bytes:
+    return bytes(np.asarray(batch.mem[lane, offset : offset + length]).tolist())

@@ -1,0 +1,614 @@
+"""The batched EVM state-transition kernel.
+
+One call executes one instruction on every live lane of a StateBatch —
+the lifted form of the reference's `Instruction.evaluate(global_state)`
+dispatch (reference: mythril/laser/ethereum/instructions.py:231 and the
+per-opcode handlers it selects). Design rules:
+
+- *execute-all-and-mask* for cheap ops: every cheap handler's result is
+  computed for all lanes and merged by opcode mask (wide SIMD beats
+  branching on TPU);
+- *cond-gating* for expensive handlers (division loops, EXP, keccak,
+  memory copies, storage journal): `lax.cond(jnp.any(mask), ...)` skips
+  the whole phase when no lane needs it this step;
+- exactly ONE consolidated stack scatter per step (every opcode writes
+  at most one result slot; SWAP's second slot is handled separately),
+  because [N, STACK_CAP, 16] scatters dominate bandwidth otherwise.
+
+Unknown opcodes mark the lane INVALID (the reference raises
+InvalidInstruction and drops the state, svm.py:254); opcodes outside
+the device set (CALL family, CREATE, EXTCODE*) mark UNSUPPORTED so the
+host symbolic engine can take the lane over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from mythril_tpu.laser.batch.state import (
+    CALLDATA_CAP,
+    HASH_CAP,
+    MEM_CAP,
+    STACK_CAP,
+    CodeTable,
+    StateBatch,
+    Status,
+)
+from mythril_tpu.ops import u256
+from mythril_tpu.ops.keccak import keccak_f
+from mythril_tpu.support.opcodes import OPCODES
+
+W = u256.LIMBS
+
+# ---------------------------------------------------------------------------
+# opcode byte constants
+# ---------------------------------------------------------------------------
+_B = {name: entry[0] for name, entry in OPCODES.items()}
+
+STOP, ADD, MUL, SUB, DIV, SDIV, MOD, SMOD = (
+    _B["STOP"], _B["ADD"], _B["MUL"], _B["SUB"], _B["DIV"], _B["SDIV"],
+    _B["MOD"], _B["SMOD"],
+)
+ADDMOD, MULMOD, EXP, SIGNEXTEND = _B["ADDMOD"], _B["MULMOD"], _B["EXP"], _B["SIGNEXTEND"]
+LT, GT, SLT, SGT, EQ, ISZERO = _B["LT"], _B["GT"], _B["SLT"], _B["SGT"], _B["EQ"], _B["ISZERO"]
+AND, OR, XOR, NOT, BYTE, SHL, SHR, SAR = (
+    _B["AND"], _B["OR"], _B["XOR"], _B["NOT"], _B["BYTE"], _B["SHL"],
+    _B["SHR"], _B["SAR"],
+)
+SHA3 = _B["SHA3"]
+ADDRESS, BALANCE, ORIGIN, CALLER, CALLVALUE = (
+    _B["ADDRESS"], _B["BALANCE"], _B["ORIGIN"], _B["CALLER"], _B["CALLVALUE"],
+)
+CALLDATALOAD, CALLDATASIZE, CALLDATACOPY = (
+    _B["CALLDATALOAD"], _B["CALLDATASIZE"], _B["CALLDATACOPY"],
+)
+CODESIZE, CODECOPY, GASPRICE = _B["CODESIZE"], _B["CODECOPY"], _B["GASPRICE"]
+RETURNDATASIZE = _B["RETURNDATASIZE"]
+BLOCKHASH, COINBASE, TIMESTAMP, NUMBER, DIFFICULTY, GASLIMIT = (
+    _B["BLOCKHASH"], _B["COINBASE"], _B["TIMESTAMP"], _B["NUMBER"],
+    _B["DIFFICULTY"], _B["GASLIMIT"],
+)
+CHAINID, SELFBALANCE, BASEFEE = _B["CHAINID"], _B["SELFBALANCE"], _B["BASEFEE"]
+POP, MLOAD, MSTORE, MSTORE8, SLOAD, SSTORE = (
+    _B["POP"], _B["MLOAD"], _B["MSTORE"], _B["MSTORE8"], _B["SLOAD"], _B["SSTORE"],
+)
+JUMP, JUMPI, PC, MSIZE, GAS, JUMPDEST = (
+    _B["JUMP"], _B["JUMPI"], _B["PC"], _B["MSIZE"], _B["GAS"], _B["JUMPDEST"],
+)
+RETURN, REVERT, INVALID_OP, SELFDESTRUCT = (
+    _B["RETURN"], _B["REVERT"], _B["ASSERT_FAIL"], _B["SUICIDE"],
+)
+
+_UNSUPPORTED_NAMES = [
+    "CREATE", "CALL", "CALLCODE", "DELEGATECALL", "CREATE2", "STATICCALL",
+    "EXTCODESIZE", "EXTCODECOPY", "EXTCODEHASH", "RETURNDATACOPY",
+    "BEGINSUB", "RETURNSUB", "JUMPSUB",
+]
+
+# ---------------------------------------------------------------------------
+# static per-opcode tables (numpy, baked into the jit as constants)
+# ---------------------------------------------------------------------------
+_VALID = np.zeros(256, dtype=bool)
+_POPS = np.zeros(256, dtype=np.int32)
+_NET_SP = np.zeros(256, dtype=np.int32)
+_GAS_MIN = np.zeros(256, dtype=np.uint32)
+_GAS_MAX = np.zeros(256, dtype=np.uint32)
+_SUPPORTED = np.zeros(256, dtype=bool)
+for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
+    _VALID[_byte] = True
+    _POPS[_byte] = _pops
+    _NET_SP[_byte] = _pushes - _pops
+    _GAS_MIN[_byte] = _gmin
+    _GAS_MAX[_byte] = _gmax
+    _SUPPORTED[_byte] = _name not in _UNSUPPORTED_NAMES
+
+
+def _m(mask, x, y):
+    """Masked select with trailing-dim broadcast."""
+    extra = x.ndim - mask.ndim
+    return jnp.where(mask.reshape(mask.shape + (1,) * extra), x, y)
+
+
+def _peek(stack, sp, k):
+    """stack[lane][sp-1-k] -> [N, W]."""
+    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
+    return jnp.take_along_axis(
+        stack, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+
+
+def _peek_dyn(stack, sp, k):
+    """k per lane (DUP/SWAP)."""
+    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
+    return jnp.take_along_axis(
+        stack, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+
+
+def _stack_write(stack, idx, val, mask):
+    oh = (jnp.arange(STACK_CAP)[None, :] == idx[:, None]) & mask[:, None]
+    return jnp.where(oh[:, :, None], val[:, None, :], stack)
+
+
+def _word_to_i32(a):
+    """u256 word -> (int32 value, overflow mask). Values >= 2**31 overflow."""
+    lo = a[..., 0] + (a[..., 1] << 16)
+    big = jnp.any(a[..., 2:] != 0, axis=-1) | (lo >= jnp.uint32(1 << 31))
+    return lo.astype(jnp.int32), big
+
+
+def _mem_gas(words):
+    w = words.astype(jnp.uint32)
+    return 3 * w + (w * w) // 512
+
+
+def step(batch: StateBatch, code: CodeTable) -> StateBatch:
+    n = batch.pc.shape[0]
+    lanes = jnp.arange(n)
+
+    # ---- fetch -----------------------------------------------------------
+    code_len = code.length[batch.code_id]
+    oob = batch.pc >= code_len  # running off the code ends the tx
+    pc_safe = jnp.clip(batch.pc, 0, code.ops.shape[1] - 33)
+    op = code.ops[batch.code_id, pc_safe].astype(jnp.int32)
+
+    active = batch.active
+    halt_oob = active & oob
+    live = active & ~oob
+
+    valid = jnp.asarray(_VALID)[op]
+    supported = jnp.asarray(_SUPPORTED)[op]
+    pops = jnp.asarray(_POPS)[op]
+    net_sp = jnp.asarray(_NET_SP)[op]
+    underflow = batch.sp < pops
+    overflow = batch.sp + net_sp > STACK_CAP
+
+    is_invalid_op = live & (~valid | (op == INVALID_OP))
+    is_unsupported = live & valid & ~supported & (op != INVALID_OP)
+    stack_err = live & valid & supported & (underflow | overflow)
+    ex = live & valid & supported & ~stack_err & (op != INVALID_OP)  # executing
+
+    # ---- operands --------------------------------------------------------
+    a = _peek(batch.stack, batch.sp, 0)
+    b = _peek(batch.stack, batch.sp, 1)
+    c = _peek(batch.stack, batch.sp, 2)
+
+    status = batch.status
+    status = jnp.where(halt_oob, Status.STOPPED, status)
+    status = jnp.where(is_invalid_op, Status.INVALID, status)
+    status = jnp.where(is_unsupported, Status.UNSUPPORTED, status)
+    status = jnp.where(stack_err, Status.ERR_STACK, status)
+
+    # result accumulation: one stack slot per opcode. Pop-then-push ops
+    # write at sp-pops; DUP writes the new top (sp); SWAP writes sp-1.
+    res_val = jnp.zeros((n, W), jnp.uint32)
+    res_mask = jnp.zeros((n,), bool)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    res_idx = jnp.where(
+        is_dup, batch.sp, jnp.where(is_swap, batch.sp - 1, batch.sp - pops))
+    res_idx = jnp.clip(res_idx, 0, STACK_CAP - 1)
+
+    mem = batch.mem
+    msize = batch.msize_words
+    gas_dyn_min = jnp.zeros((n,), jnp.uint32)
+    gas_dyn_max = jnp.zeros((n,), jnp.uint32)
+    skeys, svals, scnt = batch.storage_keys, batch.storage_vals, batch.storage_cnt
+    ret_offset, ret_len = batch.ret_offset, batch.ret_len
+
+    def put(res_val, res_mask, mask, val):
+        return _m(mask, val, res_val), res_mask | mask
+
+    # ---- cheap binary arithmetic / compares / bitwise --------------------
+    cheap_bin = {
+        ADD: u256.add(a, b),
+        SUB: u256.sub(a, b),
+        MUL: u256.mul(a, b),
+        AND: a & b,
+        OR: a | b,
+        XOR: a ^ b,
+        LT: u256.bool_to_word(u256.ult(a, b)),
+        GT: u256.bool_to_word(u256.ult(b, a)),
+        SLT: u256.bool_to_word(u256.slt(a, b)),
+        SGT: u256.bool_to_word(u256.slt(b, a)),
+        EQ: u256.bool_to_word(u256.eq(a, b)),
+        BYTE: u256.byte_op(a, b),
+        SHL: u256.shl(b, u256.shift_amount(a)),
+        SHR: u256.lshr(b, u256.shift_amount(a)),
+        SAR: u256.ashr(b, u256.shift_amount(a)),
+        SIGNEXTEND: u256.signextend(a, b),
+    }
+    for byte_, val in cheap_bin.items():
+        res_val, res_mask = put(res_val, res_mask, ex & (op == byte_), val)
+
+    # unary
+    res_val, res_mask = put(
+        res_val, res_mask, ex & (op == ISZERO),
+        u256.bool_to_word(u256.is_zero(a)))
+    res_val, res_mask = put(res_val, res_mask, ex & (op == NOT), u256.bit_not(a))
+
+    # ---- expensive arithmetic (gated) ------------------------------------
+    div_mask = ex & ((op == DIV) | (op == SDIV) | (op == MOD) | (op == SMOD))
+
+    def do_div(args):
+        res_val, res_mask = args
+        q, r = u256.udivmod(a, b)
+        qs = u256.sdiv(a, b)
+        rs = u256.srem(a, b)
+        val = _m(op == DIV, q, _m(op == SDIV, qs, _m(op == MOD, r, rs)))
+        return put(res_val, res_mask, div_mask, val)
+
+    res_val, res_mask = lax.cond(
+        jnp.any(div_mask), do_div, lambda x: x, (res_val, res_mask))
+
+    modmask = ex & ((op == ADDMOD) | (op == MULMOD))
+
+    def do_modops(args):
+        res_val, res_mask = args
+        am = u256.addmod(a, b, c)
+        mm = u256.mulmod(a, b, c)
+        return put(res_val, res_mask, modmask, _m(op == ADDMOD, am, mm))
+
+    res_val, res_mask = lax.cond(
+        jnp.any(modmask), do_modops, lambda x: x, (res_val, res_mask))
+
+    exp_mask = ex & (op == EXP)
+
+    def do_exp(args):
+        res_val, res_mask = args
+        return put(res_val, res_mask, exp_mask, u256.exp(a, b))
+
+    res_val, res_mask = lax.cond(
+        jnp.any(exp_mask), do_exp, lambda x: x, (res_val, res_mask))
+    # dynamic gas: 50 per byte of exponent (b)
+    high_limb = jnp.max(
+        jnp.where(b != 0, jnp.arange(1, W + 1, dtype=jnp.int32)[None, :], 0),
+        axis=-1)  # 1-based index of highest nonzero limb, 0 if b == 0
+    top_limb = jnp.take_along_axis(
+        b, jnp.clip(high_limb - 1, 0, W - 1)[:, None], axis=-1)[:, 0]
+    exp_bytes = jnp.where(
+        high_limb > 0, 2 * high_limb - (top_limb < 256), 0).astype(jnp.uint32)
+    exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
+    gas_dyn_min = gas_dyn_min + 50 * exp_bytes
+    gas_dyn_max = gas_dyn_max + 50 * exp_bytes
+
+    # ---- environment / block pushes --------------------------------------
+    zero_w = jnp.zeros((n, W), jnp.uint32)
+    budget = jnp.uint32(8_000_000)  # block gas limit for symbolic txs
+    gas_left = budget - jnp.minimum(batch.gas_min, budget)
+    gas_word = jnp.zeros((n, W), jnp.uint32)
+    gas_word = gas_word.at[:, 0].set(gas_left & 0xFFFF)
+    gas_word = gas_word.at[:, 1].set(gas_left >> 16)
+    msize_word = jnp.zeros((n, W), jnp.uint32)
+    msize_bytes = (msize * 32).astype(jnp.uint32)
+    msize_word = msize_word.at[:, 0].set(msize_bytes & 0xFFFF)
+    msize_word = msize_word.at[:, 1].set(msize_bytes >> 16)
+    pc_word = jnp.zeros((n, W), jnp.uint32)
+    pc_word = pc_word.at[:, 0].set(batch.pc.astype(jnp.uint32) & 0xFFFF)
+    pc_word = pc_word.at[:, 1].set(batch.pc.astype(jnp.uint32) >> 16)
+    cds_word = jnp.zeros((n, W), jnp.uint32)
+    cds_word = cds_word.at[:, 0].set(batch.calldatasize.astype(jnp.uint32))
+    csize_word = jnp.zeros((n, W), jnp.uint32)
+    csize_word = csize_word.at[:, 0].set(code_len.astype(jnp.uint32))
+
+    env_pushes = {
+        ADDRESS: batch.address,
+        CALLER: batch.caller,
+        ORIGIN: batch.origin,
+        CALLVALUE: batch.callvalue,
+        GASPRICE: batch.gasprice,
+        TIMESTAMP: batch.timestamp,
+        NUMBER: batch.number,
+        COINBASE: batch.coinbase,
+        DIFFICULTY: batch.difficulty,
+        GASLIMIT: batch.gaslimit,
+        CHAINID: batch.chainid,
+        BASEFEE: batch.basefee,
+        SELFBALANCE: batch.balance,
+        CALLDATASIZE: cds_word,
+        CODESIZE: csize_word,
+        RETURNDATASIZE: zero_w,
+        MSIZE: msize_word,
+        PC: pc_word,
+        GAS: gas_word,
+    }
+    for byte_, val in env_pushes.items():
+        res_val, res_mask = put(res_val, res_mask, ex & (op == byte_), val)
+
+    # BALANCE: own account -> balance, anything else -> 0 (no world state
+    # on device; the symbolic engine handles foreign accounts)
+    bal_mask = ex & (op == BALANCE)
+    res_val, res_mask = put(
+        res_val, res_mask, bal_mask,
+        _m(u256.eq(a, batch.address), batch.balance, zero_w))
+    # BLOCKHASH: zero (reference returns a symbol; concolic tests skip it)
+    res_val, res_mask = put(res_val, res_mask, ex & (op == BLOCKHASH), zero_w)
+
+    # ---- CALLDATALOAD ----------------------------------------------------
+    cdl_mask = ex & (op == CALLDATALOAD)
+    off_i, off_big = _word_to_i32(a)
+    cd_idx = jnp.clip(off_i[:, None], 0, CALLDATA_CAP) + jnp.arange(32)[None, :]
+    cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < CALLDATA_CAP)
+    cd_bytes = jnp.take_along_axis(
+        batch.calldata, jnp.clip(cd_idx, 0, CALLDATA_CAP - 1), axis=1)
+    cd_bytes = jnp.where(cd_in, cd_bytes, 0).astype(jnp.uint32)
+    cd_word = u256.bytes_to_word(cd_bytes)
+    res_val, res_mask = put(
+        res_val, res_mask, cdl_mask, _m(off_big, zero_w, cd_word))
+
+    # ---- PUSHn -----------------------------------------------------------
+    push_mask = ex & (op >= 0x60) & (op <= 0x7F)
+    push_n = (op - 0x5F).astype(jnp.int32)
+    pidx = pc_safe[:, None] + 1 + jnp.arange(32)[None, :]
+    pbytes = code.ops[batch.code_id[:, None], pidx].astype(jnp.uint32)
+    pword = u256.bytes_to_word(pbytes)
+    shift = (8 * (32 - push_n)).astype(jnp.uint32)
+    pword = u256.lshr(pword, shift)
+    res_val, res_mask = put(res_val, res_mask, push_mask, pword)
+
+    # ---- DUP / SWAP ------------------------------------------------------
+    dup_mask = ex & (op >= 0x80) & (op <= 0x8F)
+    dup_n = (op - 0x80).astype(jnp.int32)
+    res_val, res_mask = put(
+        res_val, res_mask, dup_mask, _peek_dyn(batch.stack, batch.sp, dup_n))
+
+    swap_mask = ex & (op >= 0x90) & (op <= 0x9F)
+    swap_n = (op - 0x8F).astype(jnp.int32)
+    swap_deep = _peek_dyn(batch.stack, batch.sp, swap_n)
+    # top goes to the deep slot via a dedicated scatter; deep value goes to
+    # the top through the consolidated result write
+    res_val, res_mask = put(res_val, res_mask, swap_mask, swap_deep)
+
+    # ---- SHA3 (gated) ----------------------------------------------------
+    sha_mask = ex & (op == SHA3)
+    len_i, len_big = _word_to_i32(b)
+    sha_err = sha_mask & (len_big | (len_i > HASH_CAP) | off_big)
+
+    def do_sha3(args):
+        res_val, res_mask = args
+        block_idx = jnp.clip(off_i, 0, MEM_CAP)[:, None] + jnp.arange(136)[None, :]
+        inb = (jnp.arange(136)[None, :] < len_i[:, None]) & (block_idx < MEM_CAP)
+        raw = jnp.take_along_axis(mem, jnp.clip(block_idx, 0, MEM_CAP - 1), axis=1)
+        raw = jnp.where(inb, raw, 0).astype(jnp.uint32)
+        # multi-rate padding at dynamic position: 0x01 at len, 0x80 at 135
+        raw = raw | jnp.where(jnp.arange(136)[None, :] == len_i[:, None], 0x01, 0)
+        raw = raw.at[:, 135].set(raw[:, 135] | 0x80)
+        lanes8 = raw.reshape(n, 17, 8)
+        blo = (lanes8[..., 0] | (lanes8[..., 1] << 8) | (lanes8[..., 2] << 16)
+               | (lanes8[..., 3] << 24))
+        bhi = (lanes8[..., 4] | (lanes8[..., 5] << 8) | (lanes8[..., 6] << 16)
+               | (lanes8[..., 7] << 24))
+        lo = jnp.zeros((n, 25), jnp.uint32).at[:, :17].set(blo)
+        hi = jnp.zeros((n, 25), jnp.uint32).at[:, :17].set(bhi)
+        lo, hi = keccak_f(lo, hi)
+        by = []
+        for lane_i in range(4):
+            for half, arr in ((0, lo), (1, hi)):
+                for j in range(4):
+                    by.append((arr[:, lane_i] >> (8 * j)) & 0xFF)
+        digest = jnp.stack(by, axis=-1)  # [n, 32] bytes, lane-ordered LE
+        word = u256.bytes_to_word(digest)
+        return put(res_val, res_mask, sha_mask & ~sha_err, word)
+
+    res_val, res_mask = lax.cond(
+        jnp.any(sha_mask), do_sha3, lambda x: x, (res_val, res_mask))
+    # inputs beyond the device cap go back to the host engine
+    status = jnp.where(sha_err, Status.UNSUPPORTED, status)
+    sha_words = jnp.where(sha_mask & ~sha_err, (len_i + 31) // 32, 0).astype(jnp.uint32)
+    gas_dyn_min = gas_dyn_min + 6 * sha_words
+    gas_dyn_max = gas_dyn_max + 6 * sha_words
+
+    # ---- memory ----------------------------------------------------------
+    def expand(mask, off_i32, nbytes, msize, gmin, gmax, status):
+        """Memory expansion accounting + capacity check.
+
+        Zero-length accesses never expand memory (EVM semantics), so
+        huge offsets with len 0 are fine."""
+        nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32), mask.shape)
+        end = off_i32 + nb
+        nz = mask & (nb > 0)
+        bad = nz & (end > MEM_CAP)
+        grow_mask = nz & ~bad
+        new_words = jnp.where(grow_mask, (end + 31) // 32, 0)
+        grow = jnp.maximum(new_words, msize)
+        delta = (_mem_gas(grow) - _mem_gas(msize)).astype(jnp.uint32)
+        gmin = gmin + jnp.where(grow_mask, delta, 0)
+        gmax = gmax + jnp.where(grow_mask, delta, 0)
+        msize = jnp.where(grow_mask, grow, msize)
+        status = jnp.where(bad, Status.ERR_MEM, status)
+        return msize, gmin, gmax, status, mask & ~bad
+
+    mload_mask = ex & (op == MLOAD)
+    mload_ok = mload_mask & ~off_big
+    status = jnp.where(mload_mask & off_big, Status.ERR_MEM, status)
+    msize, gas_dyn_min, gas_dyn_max, status, mload_ok = expand(
+        mload_ok, off_i, 32, msize, gas_dyn_min, gas_dyn_max, status)
+
+    def do_mload(args):
+        res_val, res_mask = args
+        idx = jnp.clip(off_i, 0, MEM_CAP - 32)[:, None] + jnp.arange(32)[None, :]
+        byts = jnp.take_along_axis(mem, idx, axis=1).astype(jnp.uint32)
+        return put(res_val, res_mask, mload_ok, u256.bytes_to_word(byts))
+
+    res_val, res_mask = lax.cond(
+        jnp.any(mload_ok), do_mload, lambda x: x, (res_val, res_mask))
+
+    mstore_mask = ex & (op == MSTORE)
+    mstore_ok = mstore_mask & ~off_big
+    status = jnp.where(mstore_mask & off_big, Status.ERR_MEM, status)
+    msize, gas_dyn_min, gas_dyn_max, status, mstore_ok = expand(
+        mstore_ok, off_i, 32, msize, gas_dyn_min, gas_dyn_max, status)
+
+    def do_mstore(mem):
+        j = jnp.arange(MEM_CAP)[None, :]
+        rel = j - off_i[:, None]
+        inw = (rel >= 0) & (rel < 32) & mstore_ok[:, None]
+        wbytes = u256.word_to_bytes(b)  # [n, 32]
+        src = jnp.take_along_axis(
+            wbytes, jnp.clip(rel, 0, 31).astype(jnp.int32), axis=1)
+        return jnp.where(inw, src, mem)
+
+    mem = lax.cond(jnp.any(mstore_ok), do_mstore, lambda m: m, mem)
+
+    m8_mask = ex & (op == MSTORE8)
+    m8_ok = m8_mask & ~off_big
+    status = jnp.where(m8_mask & off_big, Status.ERR_MEM, status)
+    msize, gas_dyn_min, gas_dyn_max, status, m8_ok = expand(
+        m8_ok, off_i, 1, msize, gas_dyn_min, gas_dyn_max, status)
+
+    def do_mstore8(mem):
+        j = jnp.arange(MEM_CAP)[None, :]
+        hit = (j == off_i[:, None]) & m8_ok[:, None]
+        return jnp.where(hit, (b[:, 0] & 0xFF).astype(jnp.uint8)[:, None], mem)
+
+    mem = lax.cond(jnp.any(m8_ok), do_mstore8, lambda m: m, mem)
+
+    # ---- CALLDATACOPY / CODECOPY (gated) ---------------------------------
+    copy_mask = ex & ((op == CALLDATACOPY) | (op == CODECOPY))
+    dst_i, dst_big = _word_to_i32(a)
+    src_i, src_big = _word_to_i32(b)
+    cplen_i, cplen_big = _word_to_i32(c)
+    copy_bad = copy_mask & (dst_big | src_big | cplen_big)
+    copy_ok = copy_mask & ~copy_bad
+    status = jnp.where(copy_bad, Status.ERR_MEM, status)
+    msize, gas_dyn_min, gas_dyn_max, status, copy_ok = expand(
+        copy_ok, dst_i, cplen_i, msize, gas_dyn_min, gas_dyn_max, status)
+    copy_words = jnp.where(copy_ok, (cplen_i + 31) // 32, 0).astype(jnp.uint32)
+    gas_dyn_min = gas_dyn_min + 3 * copy_words
+    gas_dyn_max = gas_dyn_max + 3 * copy_words
+
+    def do_copy(mem):
+        j = jnp.arange(MEM_CAP)[None, :]
+        rel = j - dst_i[:, None]
+        inw = (rel >= 0) & (rel < cplen_i[:, None]) & copy_ok[:, None]
+        sidx = src_i[:, None] + rel
+        # calldata source
+        cd_ok = (sidx >= 0) & (sidx < batch.calldatasize[:, None]) & (sidx < CALLDATA_CAP)
+        from_cd = jnp.take_along_axis(
+            batch.calldata, jnp.clip(sidx, 0, CALLDATA_CAP - 1), axis=1)
+        from_cd = jnp.where(cd_ok, from_cd, 0)
+        # code source
+        co_ok = (sidx >= 0) & (sidx < code_len[:, None])
+        from_co = code.ops[
+            batch.code_id[:, None],
+            jnp.clip(sidx, 0, code.ops.shape[1] - 1)]
+        from_co = jnp.where(co_ok, from_co, 0)
+        src = jnp.where((op == CALLDATACOPY)[:, None], from_cd, from_co)
+        return jnp.where(inw, src, mem)
+
+    mem = lax.cond(jnp.any(copy_ok), do_copy, lambda m: m, mem)
+
+    # ---- storage (gated) -------------------------------------------------
+    sload_mask = ex & (op == SLOAD)
+
+    def do_sload(args):
+        res_val, res_mask = args
+        hit = jnp.all(skeys == a[:, None, :], axis=-1)  # [n, S]
+        hit = hit & (jnp.arange(skeys.shape[1])[None, :] < scnt[:, None])
+        any_hit = jnp.any(hit, axis=-1)
+        last = jnp.argmax(
+            jnp.where(hit, jnp.arange(skeys.shape[1])[None, :] + 1, 0), axis=-1)
+        val = jnp.take_along_axis(svals, last[:, None, None], axis=1)[:, 0, :]
+        val = _m(any_hit, val, jnp.zeros_like(val))
+        return put(res_val, res_mask, sload_mask, val)
+
+    res_val, res_mask = lax.cond(
+        jnp.any(sload_mask), do_sload, lambda x: x, (res_val, res_mask))
+
+    sstore_mask = ex & (op == SSTORE)
+
+    def do_sstore(args):
+        skeys, svals, scnt, status = args
+        s_cap = skeys.shape[1]
+        hit = jnp.all(skeys == a[:, None, :], axis=-1)
+        hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
+        any_hit = jnp.any(hit, axis=-1)
+        last = jnp.argmax(jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+        slot = jnp.where(any_hit, last, scnt)
+        full = sstore_mask & ~any_hit & (scnt >= s_cap)
+        write = sstore_mask & ~full
+        oh = (jnp.arange(s_cap)[None, :] == slot[:, None]) & write[:, None]
+        skeys = jnp.where(oh[:, :, None], a[:, None, :], skeys)
+        svals = jnp.where(oh[:, :, None], b[:, None, :], svals)
+        scnt = jnp.where(write & ~any_hit, scnt + 1, scnt)
+        status = jnp.where(full, Status.ERR_MEM, status)
+        return skeys, svals, scnt, status
+
+    skeys, svals, scnt, status = lax.cond(
+        jnp.any(sstore_mask), do_sstore, lambda x: x, (skeys, svals, scnt, status))
+
+    # ---- LOGn: pure pops (topics + data range) ---------------------------
+    log_mask = ex & (op >= 0xA0) & (op <= 0xA4)
+    log_len_i, log_len_big = _word_to_i32(b)
+    log_ok = log_mask & ~off_big & ~log_len_big
+    msize, gas_dyn_min, gas_dyn_max, status, log_ok = expand(
+        log_ok, off_i, log_len_i, msize, gas_dyn_min, gas_dyn_max, status)
+    gas_dyn_min = gas_dyn_min + jnp.where(
+        log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
+    gas_dyn_max = gas_dyn_max + jnp.where(
+        log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
+
+    # ---- halts -----------------------------------------------------------
+    stop_mask = ex & ((op == STOP) | (op == SELFDESTRUCT))
+    status = jnp.where(stop_mask, Status.STOPPED, status)
+
+    retrev_mask = ex & ((op == RETURN) | (op == REVERT))
+    rr_len_i, rr_len_big = _word_to_i32(b)
+    rr_ok = retrev_mask & ~off_big & ~rr_len_big
+    status = jnp.where(retrev_mask & (off_big | rr_len_big), Status.ERR_MEM, status)
+    msize, gas_dyn_min, gas_dyn_max, status, rr_ok = expand(
+        rr_ok, off_i, rr_len_i, msize, gas_dyn_min, gas_dyn_max, status)
+    ret_offset = jnp.where(rr_ok, off_i, ret_offset)
+    ret_len = jnp.where(rr_ok, rr_len_i, ret_len)
+    status = jnp.where(
+        rr_ok, jnp.where(op == RETURN, Status.RETURNED, Status.REVERTED), status)
+
+    # ---- jumps + pc ------------------------------------------------------
+    jump_mask = ex & (op == JUMP)
+    jumpi_mask = ex & (op == JUMPI)
+    dest_i, dest_big = _word_to_i32(a)
+    taken = jumpi_mask & ~u256.is_zero(b)
+    do_jump = jump_mask | taken
+    dest_ok = (
+        ~dest_big
+        & (dest_i < code_len)
+        & (dest_i < code.jumpdest.shape[1])
+        & code.jumpdest[batch.code_id, jnp.clip(dest_i, 0, code.jumpdest.shape[1] - 1)]
+    )
+    status = jnp.where(do_jump & ~dest_ok, Status.ERR_JUMP, status)
+
+    push_len = jnp.where(push_mask, push_n, 0)
+    pc_next = batch.pc + 1 + push_len
+    pc_new = jnp.where(do_jump & dest_ok, dest_i, pc_next)
+    still_running = status == Status.RUNNING
+    pc_new = jnp.where(ex & still_running, pc_new, batch.pc)
+
+    # ---- consolidated stack/sp write ------------------------------------
+    stack = _stack_write(batch.stack, res_idx, res_val, res_mask & ex)
+    # SWAP second slot: old top -> deep position
+    stack = _stack_write(
+        stack, jnp.clip(batch.sp - 1 - swap_n, 0, STACK_CAP - 1), a, swap_mask)
+    sp = jnp.where(ex, batch.sp + net_sp, batch.sp)
+
+    # ---- gas -------------------------------------------------------------
+    gas_min = batch.gas_min + jnp.where(ex, jnp.asarray(_GAS_MIN)[op], 0) + gas_dyn_min
+    gas_max = batch.gas_max + jnp.where(ex, jnp.asarray(_GAS_MAX)[op], 0) + gas_dyn_max
+
+    return batch._replace(
+        pc=pc_new,
+        stack=stack,
+        sp=sp,
+        mem=mem,
+        msize_words=msize,
+        storage_keys=skeys,
+        storage_vals=svals,
+        storage_cnt=scnt,
+        status=status,
+        gas_min=gas_min,
+        gas_max=gas_max,
+        ret_offset=ret_offset,
+        ret_len=ret_len,
+    )
