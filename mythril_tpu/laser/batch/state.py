@@ -47,9 +47,10 @@ class Status:
     ERR_JUMP = 6  # invalid jump destination
     ERR_MEM = 7  # memory model capacity exceeded
     UNSUPPORTED = 8  # opcode outside the device set -> host takes over
+    ERR_OOG = 9  # minimum gas bound exceeded the lane's gas budget
 
     HALTED = (STOPPED, RETURNED, REVERTED, INVALID, ERR_STACK, ERR_JUMP,
-              ERR_MEM, UNSUPPORTED)
+              ERR_MEM, UNSUPPORTED, ERR_OOG)
 
 
 class CodeTable(NamedTuple):
@@ -73,6 +74,7 @@ class StateBatch(NamedTuple):
     status: jnp.ndarray
     gas_min: jnp.ndarray
     gas_max: jnp.ndarray
+    gas_budget: jnp.ndarray  # u32[N]; lane OOGs when gas_min exceeds it
     ret_offset: jnp.ndarray
     ret_len: jnp.ndarray
     # environment (reference: laser/ethereum/state/environment.py)
@@ -134,6 +136,7 @@ def make_batch(
     number: int = 10_000_000,
     chainid: int = 1,
     gasprice: int = 10,
+    gas_budget: int = 8_000_000,
 ) -> StateBatch:
     """Fresh batch at pc=0 with empty stacks and zeroed memory/storage."""
     code_ids = (
@@ -161,6 +164,7 @@ def make_batch(
         status=jnp.zeros((n,), jnp.int32),
         gas_min=jnp.zeros((n,), jnp.uint32),
         gas_max=jnp.zeros((n,), jnp.uint32),
+        gas_budget=jnp.full((n,), gas_budget, jnp.uint32),
         ret_offset=jnp.zeros((n,), jnp.int32),
         ret_len=jnp.zeros((n,), jnp.int32),
         address=_word_rows(n, address),

@@ -272,12 +272,14 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     exp_bytes = jnp.where(
         high_limb > 0, 2 * high_limb - (top_limb < 256), 0).astype(jnp.uint32)
     exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
-    gas_dyn_min = gas_dyn_min + 50 * exp_bytes
+    # 10/byte is the Frontier/Homestead price (the true minimum across
+    # forks); 50/byte (EIP-160) bounds the maximum
+    gas_dyn_min = gas_dyn_min + 10 * exp_bytes
     gas_dyn_max = gas_dyn_max + 50 * exp_bytes
 
     # ---- environment / block pushes --------------------------------------
     zero_w = jnp.zeros((n, W), jnp.uint32)
-    budget = jnp.uint32(8_000_000)  # block gas limit for symbolic txs
+    budget = batch.gas_budget
     gas_left = budget - jnp.minimum(batch.gas_min, budget)
     gas_word = jnp.zeros((n, W), jnp.uint32)
     gas_word = gas_word.at[:, 0].set(gas_left & 0xFFFF)
@@ -362,10 +364,34 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     # the top through the consolidated result write
     res_val, res_mask = put(res_val, res_mask, swap_mask, swap_deep)
 
+    def expand(mask, off_i32, nbytes, msize, gmin, gmax, status):
+        """Memory expansion accounting + capacity check.
+
+        Zero-length accesses never expand memory (EVM semantics), so
+        huge offsets with len 0 are fine."""
+        nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32), mask.shape)
+        end = off_i32 + nb
+        nz = mask & (nb > 0)
+        bad = nz & (end > MEM_CAP)
+        grow_mask = nz & ~bad
+        new_words = jnp.where(grow_mask, (end + 31) // 32, 0)
+        grow = jnp.maximum(new_words, msize)
+        delta = (_mem_gas(grow) - _mem_gas(msize)).astype(jnp.uint32)
+        gmin = gmin + jnp.where(grow_mask, delta, 0)
+        gmax = gmax + jnp.where(grow_mask, delta, 0)
+        msize = jnp.where(grow_mask, grow, msize)
+        status = jnp.where(bad, Status.ERR_MEM, status)
+        return msize, gmin, gmax, status, mask & ~bad
+
     # ---- SHA3 (gated) ----------------------------------------------------
     sha_mask = ex & (op == SHA3)
     len_i, len_big = _word_to_i32(b)
     sha_err = sha_mask & (len_big | (len_i > HASH_CAP) | off_big)
+    # charge memory expansion over the hashed range (reference: sha3_
+    # extends memory via mem_extend before hashing)
+    msize, gas_dyn_min, gas_dyn_max, status, sha_ok = expand(
+        sha_mask & ~sha_err, off_i, len_i, msize, gas_dyn_min, gas_dyn_max,
+        status)
 
     def do_sha3(args):
         res_val, res_mask = args
@@ -391,36 +417,17 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
                     by.append((arr[:, lane_i] >> (8 * j)) & 0xFF)
         digest = jnp.stack(by, axis=-1)  # [n, 32] bytes, lane-ordered LE
         word = u256.bytes_to_word(digest)
-        return put(res_val, res_mask, sha_mask & ~sha_err, word)
+        return put(res_val, res_mask, sha_ok, word)
 
     res_val, res_mask = lax.cond(
         jnp.any(sha_mask), do_sha3, lambda x: x, (res_val, res_mask))
     # inputs beyond the device cap go back to the host engine
     status = jnp.where(sha_err, Status.UNSUPPORTED, status)
-    sha_words = jnp.where(sha_mask & ~sha_err, (len_i + 31) // 32, 0).astype(jnp.uint32)
+    sha_words = jnp.where(sha_ok, (len_i + 31) // 32, 0).astype(jnp.uint32)
     gas_dyn_min = gas_dyn_min + 6 * sha_words
     gas_dyn_max = gas_dyn_max + 6 * sha_words
 
     # ---- memory ----------------------------------------------------------
-    def expand(mask, off_i32, nbytes, msize, gmin, gmax, status):
-        """Memory expansion accounting + capacity check.
-
-        Zero-length accesses never expand memory (EVM semantics), so
-        huge offsets with len 0 are fine."""
-        nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32), mask.shape)
-        end = off_i32 + nb
-        nz = mask & (nb > 0)
-        bad = nz & (end > MEM_CAP)
-        grow_mask = nz & ~bad
-        new_words = jnp.where(grow_mask, (end + 31) // 32, 0)
-        grow = jnp.maximum(new_words, msize)
-        delta = (_mem_gas(grow) - _mem_gas(msize)).astype(jnp.uint32)
-        gmin = gmin + jnp.where(grow_mask, delta, 0)
-        gmax = gmax + jnp.where(grow_mask, delta, 0)
-        msize = jnp.where(grow_mask, grow, msize)
-        status = jnp.where(bad, Status.ERR_MEM, status)
-        return msize, gmin, gmax, status, mask & ~bad
-
     mload_mask = ex & (op == MLOAD)
     mload_ok = mload_mask & ~off_big
     status = jnp.where(mload_mask & off_big, Status.ERR_MEM, status)
@@ -596,6 +603,10 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     # ---- gas -------------------------------------------------------------
     gas_min = batch.gas_min + jnp.where(ex, jnp.asarray(_GAS_MIN)[op], 0) + gas_dyn_min
     gas_max = batch.gas_max + jnp.where(ex, jnp.asarray(_GAS_MAX)[op], 0) + gas_dyn_max
+    # out-of-gas: even the minimum-cost path exceeded this lane's budget
+    # (reference: OutOfGasException via check_gas, machine_state.py:83-264)
+    oog = active & (gas_min > batch.gas_budget) & (status != Status.UNSUPPORTED)
+    status = jnp.where(oog, Status.ERR_OOG, status)
 
     return batch._replace(
         pc=pc_new,
