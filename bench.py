@@ -1,0 +1,64 @@
+"""Benchmark: batched interpreter throughput in state-transitions/sec.
+
+One state-transition = one EVM instruction applied to one path state —
+the unit of work of the reference's `execute_state` hot loop
+(mythril/laser/ethereum/svm.py:303), which processes exactly one per
+Python-interpreter iteration. Here a single jit'd step advances every
+lane of a StateBatch at once on the TPU.
+
+Baseline: the reference engine executes ~2,000 state-transitions/sec
+single-threaded (order-of-magnitude from its own instruction-profiler
+machinery; it publishes no numbers — see BASELINE.md — and cannot run
+in this image since z3 is not installed). vs_baseline uses that
+documented nominal figure.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_STATES_PER_SEC = 2_000.0
+N_LANES = 4096
+N_STEPS = 256
+
+
+def main() -> None:
+    import jax
+
+    from __graft_entry__ import _demo_workload
+    from mythril_tpu.laser.batch.run import run
+
+    batch, code = _demo_workload(N_LANES)
+
+    # warmup / compile
+    out, steps = run(batch, code, max_steps=8)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    out, steps = run(batch, code, max_steps=N_STEPS)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    # the demo contract loops forever, so every lane stays live
+    n_live = int((out.status == 0).sum())
+    assert n_live == N_LANES, f"lanes died: {n_live}/{N_LANES}"
+    transitions = N_LANES * int(steps)
+    rate = transitions / dt
+
+    print(
+        f"bench: {transitions} transitions in {dt:.3f}s on "
+        f"{jax.devices()[0]}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "state_transitions_per_sec",
+        "value": round(rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(rate / BASELINE_STATES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

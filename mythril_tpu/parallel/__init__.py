@@ -1,0 +1,16 @@
+"""Device-mesh parallelism for the batched symbolic engine.
+
+The reference is single-process/single-thread (SURVEY §2.4); its only
+scaling axes are the worklist and per-contract loops. Here scaling is
+explicit: path states are lanes of a StateBatch, and lanes shard over a
+`jax.sharding.Mesh` ("dp" axis) so one jit'd step advances the frontier
+on every chip, with XLA inserting ICI collectives as needed.
+"""
+
+from mythril_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicate_table,
+    replicated,
+    shard_batch,
+)

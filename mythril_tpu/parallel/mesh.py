@@ -1,0 +1,47 @@
+"""Mesh construction and StateBatch sharding.
+
+Maps the reference's concurrency surface (worklist scheduling,
+per-contract loops — SURVEY §2.4) onto a jax device mesh: every
+StateBatch field has lanes as its leading axis, so a single
+`NamedSharding(mesh, P("dp"))` on that axis data-parallelizes the whole
+interpreter; shared tables (CodeTable) are replicated. Collectives for
+frontier rebalancing ride ICI via jnp ops under jit — nothing here
+talks to devices directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over the first n devices."""
+    devices = list(devices or jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DP_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for any [N, ...] lane-major array: split lanes over dp."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place every StateBatch leaf lane-sharded over the mesh. Lane count
+    must divide evenly by the mesh size (pad upstream)."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def replicate_table(table, mesh: Mesh):
+    rep = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, rep), table)
