@@ -34,8 +34,9 @@ def main() -> None:
 
     batch, code = _demo_workload(N_LANES)
 
-    # warmup / compile
-    out, steps = run(batch, code, max_steps=8)
+    # warmup / compile — same static max_steps as the timed call, or the
+    # timed region would include a fresh trace+compile
+    out, steps = run(batch, code, max_steps=N_STEPS)
     jax.block_until_ready(out)
 
     t0 = time.perf_counter()

@@ -1,0 +1,40 @@
+"""Top-level exception types.
+
+Reference parity: mythril/exceptions.py:1-48.
+"""
+
+
+class MythrilBaseException(Exception):
+    """The base exception for the framework."""
+
+
+class CompilerError(MythrilBaseException):
+    """Solc compilation failed."""
+
+
+class UnsatError(MythrilBaseException):
+    """A solver query had no model (reference: mythril/exceptions.py)."""
+
+
+class SolverTimeOutException(UnsatError):
+    """A solver query timed out (treated as unsat by issue builders)."""
+
+
+class NoContractFoundError(MythrilBaseException):
+    """The supplied input contained no contract."""
+
+
+class CriticalError(MythrilBaseException):
+    """Fatal user-facing error; the CLI prints it and exits."""
+
+
+class AddressNotFoundError(MythrilBaseException):
+    """The searched address was not found."""
+
+
+class DetectorNotFoundError(MythrilBaseException):
+    """An unknown detection module name was requested."""
+
+
+class IllegalArgumentError(ValueError):
+    """An argument combination is invalid."""

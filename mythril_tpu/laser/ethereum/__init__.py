@@ -1,0 +1,1 @@
+"""Symbolic EVM execution (the LASER equivalent, TPU-first)."""

@@ -1,0 +1,113 @@
+"""mythril_tpu.laser.smt — the SMT abstraction layer (L0).
+
+Reference parity: mythril/laser/smt/__init__.py:1-29. The reference
+re-exports a typed facade over z3; this package exports the same
+surface over mythril_tpu's own term DAG + solver stack (no z3 in the
+loop — the solver portfolio is simplification + bit-parallel local
+search + native CDCL bit-blasting, see laser/smt/solver/).
+"""
+
+from mythril_tpu.laser.smt.array import Array, BaseArray, K
+from mythril_tpu.laser.smt.bitvec import BitVec
+from mythril_tpu.laser.smt.bitvec_helper import (
+    BVAddNoOverflow,
+    BVMulNoOverflow,
+    BVSubNoUnderflow,
+    Concat,
+    Extract,
+    If,
+    LShR,
+    SGT,
+    SLT,
+    SignExt,
+    Sum,
+    UDiv,
+    UGE,
+    UGT,
+    ULE,
+    ULT,
+    URem,
+    SRem,
+    ZeroExt,
+)
+from mythril_tpu.laser.smt.bool import And, Bool, Implies, Not, Or, Xor, is_false, is_true
+from mythril_tpu.laser.smt.expression import Expression, simplify
+from mythril_tpu.laser.smt.function import Function
+from mythril_tpu.laser.smt.model import Model
+from mythril_tpu.laser.smt import terms
+
+
+class SymbolFactory:
+    """Factory for symbols and values (reference: symbol_factory)."""
+
+    @staticmethod
+    def Bool(value: bool, annotations=None) -> Bool:
+        return Bool(terms.bool_const(value), annotations)
+
+    @staticmethod
+    def BoolVal(value: bool, annotations=None) -> Bool:
+        return Bool(terms.bool_const(value), annotations)
+
+    @staticmethod
+    def BoolSym(name: str, annotations=None) -> Bool:
+        return Bool(terms.bool_var(name), annotations)
+
+    @staticmethod
+    def BitVecVal(value: int, size: int, annotations=None) -> BitVec:
+        return BitVec(terms.bv_const(value, size), annotations)
+
+    @staticmethod
+    def BitVecSym(name: str, size: int, annotations=None) -> BitVec:
+        return BitVec(terms.bv_var(name, size), annotations)
+
+
+symbol_factory = SymbolFactory()
+
+from mythril_tpu.laser.smt.solver import (  # noqa: E402  (needs symbol_factory)
+    IndependenceSolver,
+    Optimize,
+    Solver,
+)
+
+__all__ = [
+    "Array",
+    "BaseArray",
+    "K",
+    "BitVec",
+    "Bool",
+    "And",
+    "Or",
+    "Not",
+    "Xor",
+    "Implies",
+    "is_false",
+    "is_true",
+    "Expression",
+    "simplify",
+    "Function",
+    "Model",
+    "Solver",
+    "Optimize",
+    "IndependenceSolver",
+    "symbol_factory",
+    "If",
+    "UGT",
+    "UGE",
+    "ULT",
+    "ULE",
+    "SGT",
+    "SLT",
+    "Concat",
+    "Extract",
+    "URem",
+    "SRem",
+    "UDiv",
+    "LShR",
+    "Sum",
+    "SignExt",
+    "ZeroExt",
+    "BVAddNoOverflow",
+    "BVMulNoOverflow",
+    "BVSubNoUnderflow",
+    "terms",
+]

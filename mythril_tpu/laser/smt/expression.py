@@ -1,0 +1,46 @@
+"""Expression base: a term plus annotations.
+
+Reference parity: mythril/laser/smt/expression.py:10 (`Expression`
+generic over z3.ExprRef, carrying `annotations` used for taint
+tracking by detection modules). Here the payload is our own `Term`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from mythril_tpu.laser.smt import terms
+
+
+class Expression:
+    """A symbolic expression: immutable term + mutable annotation set."""
+
+    def __init__(self, raw: terms.Term, annotations: Optional[Set] = None):
+        self.raw = raw
+        self._annotations = set(annotations) if annotations else set()
+
+    @property
+    def annotations(self) -> Set:
+        return self._annotations
+
+    def annotate(self, annotation) -> None:
+        self._annotations.add(annotation)
+
+    def get_annotations(self, annotation_type):
+        return [a for a in self._annotations if isinstance(a, annotation_type)]
+
+    def simplify(self) -> None:
+        """Terms constant-fold at construction; kept for API parity
+        (reference Expression.simplify calls z3.simplify in place)."""
+
+    def __repr__(self):
+        return repr(self.raw)
+
+    def size(self) -> int:
+        return self.raw.width
+
+
+def simplify(expression: Expression) -> Expression:
+    """Return a simplified copy (reference: smt.simplify)."""
+    expression.simplify()
+    return expression

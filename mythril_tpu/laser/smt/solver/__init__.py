@@ -1,0 +1,30 @@
+"""Solver layer (reference: mythril/laser/smt/solver/__init__.py).
+
+The reference flips z3's `parallel.enable` here when --parallel-solving
+is set; in this framework parallel solving is the device portfolio
+(see mythril_tpu/parallel/) and needs no global toggle.
+"""
+
+from mythril_tpu.laser.smt.solver.independence_solver import IndependenceSolver
+from mythril_tpu.laser.smt.solver.solver import (
+    BaseSolver,
+    Optimize,
+    Solver,
+    check_terms,
+    sat,
+    unknown,
+    unsat,
+)
+from mythril_tpu.laser.smt.solver.solver_statistics import SolverStatistics
+
+__all__ = [
+    "BaseSolver",
+    "Solver",
+    "Optimize",
+    "IndependenceSolver",
+    "SolverStatistics",
+    "check_terms",
+    "sat",
+    "unsat",
+    "unknown",
+]

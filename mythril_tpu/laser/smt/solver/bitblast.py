@@ -1,0 +1,365 @@
+"""Bit-blasting: word-level terms -> CNF for the native CDCL solver.
+
+The reference never does this itself — z3 bit-blasts internally. Here
+it is explicit: every BV term becomes a list of SAT literals (LSB
+first), every Bool term a single literal, gates are Tseitin-encoded
+with structural sharing via a per-blast cache.
+
+Literal encoding is DIMACS: ±(var). SAT var 1 is reserved as the
+constant TRUE (unit clause [1]), so constants are literals 1 / -1 and
+every gate can short-circuit on them without special cases downstream.
+
+Expects *lowered* terms: no arrays, no UFs, no sdiv/srem (see
+preprocess.py which rewrites those to udiv/urem + ite).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.terms import Term
+
+TRUE_LIT = 1
+FALSE_LIT = -1
+
+
+class Blaster:
+    def __init__(self):
+        self.nvars = 1  # var 1 = constant TRUE
+        self.clauses: List[List[int]] = [[TRUE_LIT]]
+        self.bv_cache: Dict[int, List[int]] = {}
+        self.bool_cache: Dict[int, int] = {}
+        self.gate_cache: Dict[Tuple, int] = {}
+        self.var_bits: Dict[str, List[int]] = {}  # bv var name -> sat vars
+        self.bool_vars: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def new_var(self) -> int:
+        self.nvars += 1
+        return self.nvars
+
+    def add(self, *lits: int) -> None:
+        # drop clauses satisfied by the constant; strip false constant lits
+        out = []
+        for l in lits:
+            if l == TRUE_LIT:
+                return
+            if l == FALSE_LIT:
+                continue
+            out.append(l)
+        self.clauses.append(out)
+
+    # ---- gates ---------------------------------------------------------
+    def g_and(self, *ins: int) -> int:
+        lits = []
+        for l in ins:
+            if l == FALSE_LIT:
+                return FALSE_LIT
+            if l == TRUE_LIT:
+                continue
+            lits.append(l)
+        if not lits:
+            return TRUE_LIT
+        lits = sorted(set(lits))
+        if len(lits) == 1:
+            return lits[0]
+        for l in lits:
+            if -l in lits:
+                return FALSE_LIT
+        key = ("and",) + tuple(lits)
+        o = self.gate_cache.get(key)
+        if o is None:
+            o = self.new_var()
+            for l in lits:
+                self.clauses.append([-o, l])
+            self.clauses.append([o] + [-l for l in lits])
+            self.gate_cache[key] = o
+        return o
+
+    def g_or(self, *ins: int) -> int:
+        return -self.g_and(*[-l for l in ins])
+
+    def g_xor(self, a: int, b: int) -> int:
+        if a == FALSE_LIT:
+            return b
+        if b == FALSE_LIT:
+            return a
+        if a == TRUE_LIT:
+            return -b
+        if b == TRUE_LIT:
+            return -a
+        if a == b:
+            return FALSE_LIT
+        if a == -b:
+            return TRUE_LIT
+        if abs(b) < abs(a):
+            a, b = b, a
+        key = ("xor", a, b)
+        o = self.gate_cache.get(key)
+        if o is None:
+            o = self.new_var()
+            self.clauses += [[-o, a, b], [-o, -a, -b], [o, -a, b], [o, a, -b]]
+            self.gate_cache[key] = o
+        return o
+
+    def g_ite(self, c: int, a: int, b: int) -> int:
+        """c ? a : b"""
+        if c == TRUE_LIT:
+            return a
+        if c == FALSE_LIT:
+            return b
+        if a == b:
+            return a
+        if a == TRUE_LIT and b == FALSE_LIT:
+            return c
+        if a == FALSE_LIT and b == TRUE_LIT:
+            return -c
+        if a == TRUE_LIT:  # o = c | b
+            return self.g_or(c, b)
+        if a == FALSE_LIT:  # o = ~c & b
+            return self.g_and(-c, b)
+        if b == TRUE_LIT:  # o = ~c | a
+            return self.g_or(-c, a)
+        if b == FALSE_LIT:  # o = c & a
+            return self.g_and(c, a)
+        key = ("ite", c, a, b)
+        o = self.gate_cache.get(key)
+        if o is None:
+            o = self.new_var()
+            self.clauses += [[-o, -c, a], [o, -c, -a], [-o, c, b], [o, c, -b]]
+            self.gate_cache[key] = o
+        return o
+
+    def g_maj(self, a: int, b: int, c: int) -> int:
+        """Majority (full-adder carry)."""
+        consts = [l for l in (a, b, c) if l in (TRUE_LIT, FALSE_LIT)]
+        if len(consts) >= 2:
+            return TRUE_LIT if consts.count(TRUE_LIT) >= 2 else FALSE_LIT
+        if a == TRUE_LIT:
+            return self.g_or(b, c)
+        if a == FALSE_LIT:
+            return self.g_and(b, c)
+        if b == TRUE_LIT:
+            return self.g_or(a, c)
+        if b == FALSE_LIT:
+            return self.g_and(a, c)
+        if c == TRUE_LIT:
+            return self.g_or(a, b)
+        if c == FALSE_LIT:
+            return self.g_and(a, b)
+        key = ("maj",) + tuple(sorted((a, b, c), key=abs))
+        o = self.gate_cache.get(key)
+        if o is None:
+            o = self.new_var()
+            self.clauses += [
+                [-o, a, b], [-o, a, c], [-o, b, c],
+                [o, -a, -b], [o, -a, -c], [o, -b, -c],
+            ]
+            self.gate_cache[key] = o
+        return o
+
+    # ---- word-level building blocks -----------------------------------
+    def const_bits(self, value: int, width: int) -> List[int]:
+        return [TRUE_LIT if (value >> i) & 1 else FALSE_LIT for i in range(width)]
+
+    def adder(self, a: List[int], b: List[int], cin: int = FALSE_LIT) -> Tuple[List[int], int]:
+        out = []
+        c = cin
+        for i in range(len(a)):
+            s = self.g_xor(self.g_xor(a[i], b[i]), c)
+            c = self.g_maj(a[i], b[i], c)
+            out.append(s)
+        return out, c
+
+    def negate(self, a: List[int]) -> List[int]:
+        out, _ = self.adder([-l for l in a], self.const_bits(1, len(a)))
+        return out
+
+    def mul_bits(self, a: List[int], b: List[int], out_width: int) -> List[int]:
+        """Shift-add multiplier producing out_width low bits."""
+        acc = self.const_bits(0, out_width)
+        for i in range(min(len(b), out_width)):
+            if b[i] == FALSE_LIT:
+                continue
+            row = [FALSE_LIT] * i + [
+                self.g_and(b[i], a[j]) for j in range(min(len(a), out_width - i))
+            ]
+            row += [FALSE_LIT] * (out_width - len(row))
+            acc, _ = self.adder(acc, row)
+        return acc
+
+    def eq_bits(self, a: List[int], b: List[int]) -> int:
+        return self.g_and(*[-self.g_xor(x, y) for x, y in zip(a, b)])
+
+    def ult_bits(self, a: List[int], b: List[int]) -> int:
+        # LSB-up ripple: lt = (~a&b) | (a==b & lt_prev)
+        lt = FALSE_LIT
+        for x, y in zip(a, b):
+            lt = self.g_ite(self.g_xor(x, y), self.g_and(-x, y), lt)
+        return lt
+
+    def shift_bits(self, a: List[int], sh: List[int], kind: str) -> List[int]:
+        """Barrel shifter; kind in {shl, lshr, ashr}."""
+        w = len(a)
+        nstages = max(1, (w - 1).bit_length())
+        fill = a[-1] if kind == "ashr" else FALSE_LIT
+        cur = list(a)
+        for s in range(nstages):
+            k = 1 << s
+            bit = sh[s] if s < len(sh) else FALSE_LIT
+            if bit == FALSE_LIT:
+                continue
+            shifted = [FALSE_LIT] * w
+            for i in range(w):
+                if kind == "shl":
+                    shifted[i] = cur[i - k] if i - k >= 0 else FALSE_LIT
+                else:
+                    shifted[i] = cur[i + k] if i + k < w else fill
+            cur = [self.g_ite(bit, shifted[i], cur[i]) for i in range(w)]
+        # any set bit at position >= nstages means shift >= w
+        big = self.g_or(*sh[nstages:]) if len(sh) > nstages else FALSE_LIT
+        if big != FALSE_LIT:
+            cur = [self.g_ite(big, fill, cur[i]) for i in range(w)]
+        return cur
+
+    # ------------------------------------------------------------------
+    def blast_bv(self, t: Term) -> List[int]:
+        cached = self.bv_cache.get(t._id)
+        if cached is not None:
+            return cached
+        bits = self._blast_bv(t)
+        assert len(bits) == t.width, f"{t.op}: {len(bits)} != {t.width}"
+        self.bv_cache[t._id] = bits
+        return bits
+
+    def _blast_bv(self, t: Term) -> List[int]:
+        op = t.op
+        w = t.width
+        if op == "const":
+            return self.const_bits(t.args[0], w)
+        if op == "var":
+            name = t.args[0]
+            bits = self.var_bits.get(name)
+            if bits is None:
+                bits = [self.new_var() for _ in range(w)]
+                self.var_bits[name] = bits
+            return bits
+        if op in ("add", "sub", "mul", "udiv", "urem", "and", "or", "xor",
+                  "shl", "lshr", "ashr"):
+            a = self.blast_bv(t.args[0])
+            b = self.blast_bv(t.args[1])
+            if op == "add":
+                return self.adder(a, b)[0]
+            if op == "sub":
+                return self.adder(a, [-l for l in b], TRUE_LIT)[0]
+            if op == "mul":
+                return self.mul_bits(a, b, w)
+            if op in ("udiv", "urem"):
+                return self._divmod(t, a, b, op)
+            if op == "and":
+                return [self.g_and(x, y) for x, y in zip(a, b)]
+            if op == "or":
+                return [self.g_or(x, y) for x, y in zip(a, b)]
+            if op == "xor":
+                return [self.g_xor(x, y) for x, y in zip(a, b)]
+            return self.shift_bits(a, b, op)
+        if op == "not":
+            return [-l for l in self.blast_bv(t.args[0])]
+        if op == "concat":
+            hi, lo = t.args
+            return self.blast_bv(lo) + self.blast_bv(hi)
+        if op == "extract":
+            hi, lo, src = t.args
+            return self.blast_bv(src)[lo : hi + 1]
+        if op == "zext":
+            return self.blast_bv(t.args[0]) + self.const_bits(0, t.args[1])
+        if op == "sext":
+            bits = self.blast_bv(t.args[0])
+            return bits + [bits[-1]] * t.args[1]
+        if op == "ite":
+            c = self.blast_bool(t.args[0])
+            a = self.blast_bv(t.args[1])
+            b = self.blast_bv(t.args[2])
+            return [self.g_ite(c, x, y) for x, y in zip(a, b)]
+        raise NotImplementedError(f"blast bv: {op}")
+
+    def _divmod(self, t: Term, a: List[int], b: List[int], op: str) -> List[int]:
+        """q,r fresh with the division relation (EVM: x/0 = x%0 = 0)."""
+        w = t.width
+        key = ("divmod", t.args[0]._id, t.args[1]._id)
+        qr = self.gate_cache.get(key)
+        if qr is None:
+            q = [self.new_var() for _ in range(w)]
+            r = [self.new_var() for _ in range(w)]
+            b_zero = self.eq_bits(b, self.const_bits(0, w))
+            # b == 0 -> q == 0 and r == 0
+            for l in q + r:
+                self.add(-b_zero, -l)
+            # b != 0 -> a == q*b + r (in 2w bits, exact) and r < b
+            prod = self.mul_bits(q + self.const_bits(0, w), b + self.const_bits(0, w), 2 * w)
+            total, carry = self.adder(prod, r + self.const_bits(0, w))
+            a_ext = a + self.const_bits(0, w)
+            rel = self.eq_bits(total, a_ext)
+            r_lt_b = self.ult_bits(r, b)
+            self.add(b_zero, rel)
+            self.add(b_zero, r_lt_b)
+            qr = (q, r)
+            self.gate_cache[key] = qr
+        return qr[0] if op == "udiv" else qr[1]
+
+    # ------------------------------------------------------------------
+    def blast_bool(self, t: Term) -> int:
+        cached = self.bool_cache.get(t._id)
+        if cached is not None:
+            return cached
+        lit = self._blast_bool(t)
+        self.bool_cache[t._id] = lit
+        return lit
+
+    def _blast_bool(self, t: Term) -> int:
+        op = t.op
+        if op == "true":
+            return TRUE_LIT
+        if op == "false":
+            return FALSE_LIT
+        if op == "bvar":
+            name = t.args[0]
+            v = self.bool_vars.get(name)
+            if v is None:
+                v = self.bool_vars[name] = self.new_var()
+            return v
+        if op == "band":
+            return self.g_and(*[self.blast_bool(a) for a in t.args])
+        if op == "bor":
+            return self.g_or(*[self.blast_bool(a) for a in t.args])
+        if op == "bnot":
+            return -self.blast_bool(t.args[0])
+        if op == "bxor":
+            return self.g_xor(self.blast_bool(t.args[0]), self.blast_bool(t.args[1]))
+        if op == "ite":  # bool-sorted ite
+            return self.g_ite(
+                self.blast_bool(t.args[0]),
+                self.blast_bool(t.args[1]),
+                self.blast_bool(t.args[2]),
+            )
+        if op in ("eq", "ult", "ule", "slt", "sle"):
+            a = self.blast_bv(t.args[0])
+            b = self.blast_bv(t.args[1])
+            if op == "eq":
+                return self.eq_bits(a, b)
+            if op == "ult":
+                return self.ult_bits(a, b)
+            if op == "ule":
+                return -self.ult_bits(b, a)
+            # signed: flip MSBs and compare unsigned
+            af = a[:-1] + [-a[-1]]
+            bf = b[:-1] + [-b[-1]]
+            if op == "slt":
+                return self.ult_bits(af, bf)
+            return -self.ult_bits(bf, af)
+        raise NotImplementedError(f"blast bool: {op}")
+
+    # ------------------------------------------------------------------
+    def assert_true(self, t: Term) -> None:
+        self.add(self.blast_bool(t))

@@ -1,0 +1,93 @@
+"""IndependenceSolver: partition constraints into independent buckets.
+
+Reference parity: mythril/laser/smt/solver/independence_solver.py:
+87-153 with DependenceMap (:40-85). Constraints sharing no free
+variables are solved as separate queries; any bucket unsat makes the
+conjunction unsat, and on sat the bucket models merge (the buckets
+share no symbols, so the union assignment is consistent).
+
+This is also the unit the TPU portfolio dispatcher parallelizes over
+(SURVEY §2.4): independent sub-queries map onto device lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.model import Model
+from mythril_tpu.laser.smt.solver.solver import BaseSolver, check_terms, sat, unsat
+from mythril_tpu.laser.smt.solver.solver_statistics import stat_smt_query
+
+
+class _Bucket:
+    def __init__(self):
+        self.variables: Set[str] = set()
+        self.conditions: List[terms.Term] = []
+
+
+class DependenceMap:
+    """Union of constraint buckets keyed by shared free variables."""
+
+    def __init__(self):
+        self.buckets: List[_Bucket] = []
+        self.variable_map: Dict[str, _Bucket] = {}
+
+    def add_condition(self, condition: terms.Term) -> None:
+        names = set(terms.free_vars(condition).keys())
+        touched: List[_Bucket] = []
+        for name in names:
+            b = self.variable_map.get(name)
+            if b is not None and b not in touched:
+                touched.append(b)
+        if not touched:
+            bucket = _Bucket()
+        elif len(touched) == 1:
+            bucket = touched[0]
+        else:
+            bucket = self._merge_buckets(touched)
+        bucket.conditions.append(condition)
+        bucket.variables |= names
+        if bucket not in self.buckets:
+            self.buckets.append(bucket)
+        for name in names:
+            self.variable_map[name] = bucket
+
+    def _merge_buckets(self, to_merge: List[_Bucket]) -> _Bucket:
+        out = _Bucket()
+        for b in to_merge:
+            out.variables |= b.variables
+            out.conditions.extend(b.conditions)
+            if b in self.buckets:
+                self.buckets.remove(b)
+        for name in out.variables:
+            self.variable_map[name] = out
+        return out
+
+
+class IndependenceSolver(BaseSolver):
+    """Solves a conjunction bucket-by-bucket."""
+
+    @stat_smt_query
+    def check(self, *extra) -> str:
+        self.add(*extra)
+        self._model = None
+        dep_map = DependenceMap()
+        for c in self.constraints:
+            dep_map.add_condition(c)
+        merged: Dict = {}
+        per_bucket_ms = max(
+            500, self.timeout // max(1, len(dep_map.buckets))
+        )
+        worst = sat
+        for bucket in dep_map.buckets:
+            status, model = check_terms(bucket.conditions, timeout_ms=per_bucket_ms)
+            if status == unsat:
+                return unsat
+            if status != sat:
+                worst = status
+                continue
+            merged.update(model.assignment)
+        if worst == sat:
+            self._model = Model(merged)
+        return worst

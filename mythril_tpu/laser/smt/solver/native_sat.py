@@ -1,0 +1,84 @@
+"""ctypes binding to the native CDCL solver (native/cdcl.cpp).
+
+The reference's equivalent boundary is the z3 python binding
+(reference: mythril/laser/smt/solver/solver.py → z3.Solver.check).
+Here the boundary carries only CNF: word-level reasoning stays in
+Python/JAX, the native side does pure SAT.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from typing import Dict, List, Optional
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))),
+    "native",
+    "libmythril_native.so",
+)
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.cdcl_new.restype = ctypes.c_void_p
+        lib.cdcl_delete.argtypes = [ctypes.c_void_p]
+        lib.cdcl_new_var.argtypes = [ctypes.c_void_p]
+        lib.cdcl_new_var.restype = ctypes.c_int
+        lib.cdcl_add_clause.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.cdcl_add_clause.restype = ctypes.c_int
+        lib.cdcl_solve.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.cdcl_solve.restype = ctypes.c_int
+        lib.cdcl_value.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.cdcl_value.restype = ctypes.c_int
+        lib.cdcl_conflicts.argtypes = [ctypes.c_void_p]
+        lib.cdcl_conflicts.restype = ctypes.c_int64
+        _lib = lib
+    return _lib
+
+
+SAT, UNSAT, UNKNOWN = 1, -1, 0
+
+_CHUNK = 20_000  # conflicts between wall-clock checks
+
+
+def solve_cnf(
+    nvars: int, clauses: List[List[int]], timeout_ms: Optional[int] = None
+) -> (int, Optional[List[int]]):
+    """Solve a CNF (DIMACS-style int lits). Returns (status, bits).
+
+    bits[v] for v in 0..nvars-1 (DIMACS var v+1), only on SAT.
+    Chunked conflict budgets bound wall-clock to ~timeout_ms.
+    """
+    lib = _load()
+    s = lib.cdcl_new()
+    try:
+        for _ in range(nvars):
+            lib.cdcl_new_var(s)
+        for c in clauses:
+            arr = (ctypes.c_int * len(c))(*c)
+            if not lib.cdcl_add_clause(s, arr, len(c)):
+                return UNSAT, None
+        deadline = None if timeout_ms is None else time.monotonic() + timeout_ms / 1000.0
+        budget = _CHUNK
+        while True:
+            r = lib.cdcl_solve(s, budget)
+            if r == SAT:
+                return SAT, [max(lib.cdcl_value(s, v), 0) for v in range(nvars)]
+            if r == UNSAT:
+                return UNSAT, None
+            if deadline is not None and time.monotonic() >= deadline:
+                return UNKNOWN, None
+            budget += _CHUNK
+    finally:
+        lib.cdcl_delete(s)

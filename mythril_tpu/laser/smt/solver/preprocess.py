@@ -1,0 +1,326 @@
+"""Word-level preprocessing: lower full terms to the blaster fragment.
+
+Passes (all sound, run before bit-blasting):
+  1. equality propagation — toplevel `x == const` facts substitute
+     through the whole constraint set (the workhorse: most EVM path
+     constraints pin calldata selectors / callvalue to constants);
+  2. signed div/rem lowering — sdiv/srem rewritten to udiv/urem with
+     conditional negation;
+  3. UF elimination (Ackermann) — each application becomes a fresh
+     variable plus pairwise functional-consistency implications
+     (keccak modeling rides on this, reference:
+     mythril/laser/ethereum/keccak_function_manager.py);
+  4. array elimination — selects pushed through store chains / ites to
+     base arrays, then each base select becomes a fresh variable plus
+     pairwise read-consistency implications.
+
+Returns the lowered constraints plus a `Recon` describing how to
+rebuild a full model (array tables, UF tables, propagated bindings)
+from the CNF assignment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.terms import Term
+
+
+# ---------------------------------------------------------------------------
+# generic bottom-up rewriter
+# ---------------------------------------------------------------------------
+
+_BIN = {
+    "add": terms.add, "sub": terms.sub, "mul": terms.mul,
+    "udiv": terms.udiv, "sdiv": terms.sdiv, "urem": terms.urem,
+    "srem": terms.srem, "and": terms.bvand, "or": terms.bvor,
+    "xor": terms.bvxor, "shl": terms.shl, "lshr": terms.lshr,
+    "ashr": terms.ashr, "concat": terms.concat, "eq": terms.eq,
+    "ult": terms.ult, "ule": terms.ule, "slt": terms.slt,
+    "sle": terms.sle, "bxor": terms.bxor,
+}
+
+
+def rebuild(op: str, args: tuple, old: Term) -> Term:
+    if op in _BIN:
+        return _BIN[op](args[0], args[1])
+    if op == "not":
+        return terms.bvnot(args[0])
+    if op == "bnot":
+        return terms.bnot(args[0])
+    if op == "band":
+        return terms.band(*args)
+    if op == "bor":
+        return terms.bor(*args)
+    if op == "ite":
+        return terms.ite(args[0], args[1], args[2])
+    if op == "extract":
+        return terms.extract(args[0], args[1], args[2])
+    if op == "zext":
+        return terms.zext(args[0], args[1])
+    if op == "sext":
+        return terms.sext(args[0], args[1])
+    if op == "select":
+        return terms.select(args[0], args[1])
+    if op == "store":
+        return terms.store(args[0], args[1], args[2])
+    if op == "K":
+        return terms.const_array(args[0], old.sort.width)
+    if op == "uf":
+        return terms.apply_uf(args[0], old.width, args[1:])
+    # leaves rebuild to themselves
+    return old
+
+
+def transform(t: Term, leaf_fn, memo: Dict[int, Term]) -> Term:
+    """Bottom-up rebuild; leaf_fn may replace leaf terms (vars)."""
+    got = memo.get(t._id)
+    if got is not None:
+        return got
+    stack = [(t, False)]
+    while stack:
+        cur, ready = stack.pop()
+        if cur._id in memo:
+            continue
+        if not ready:
+            stack.append((cur, True))
+            for a in terms.children(cur):
+                if a._id not in memo:
+                    stack.append((a, False))
+            continue
+        if cur.op in ("var", "bvar", "avar", "const", "true", "false"):
+            memo[cur._id] = leaf_fn(cur)
+            continue
+        new_args = tuple(
+            memo[a._id] if isinstance(a, Term) else a for a in cur.args
+        )
+        if all(n is o for n, o in zip(new_args, cur.args)):
+            memo[cur._id] = cur
+        else:
+            memo[cur._id] = rebuild(cur.op, new_args, cur)
+    return memo[t._id]
+
+
+def substitute(t: Term, mapping: Dict[Term, Term], memo: Optional[Dict] = None) -> Term:
+    if memo is None:
+        memo = {}
+    return transform(t, lambda leaf: mapping.get(leaf, leaf), memo)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: equality propagation
+# ---------------------------------------------------------------------------
+
+
+def propagate_equalities(
+    constraints: List[Term], max_rounds: int = 8
+) -> Tuple[List[Term], Dict[str, Term]]:
+    """Extract toplevel `var == const` / bvar facts and substitute.
+
+    Returns (residual constraints, bindings name->const term)."""
+    bindings: Dict[str, Term] = {}
+    cur = list(constraints)
+    for _ in range(max_rounds):
+        mapping: Dict[Term, Term] = {}
+        residual: List[Term] = []
+        for c in cur:
+            m = _as_binding(c)
+            if m is not None:
+                var, val = m
+                if var not in mapping and var.args[0] not in bindings:
+                    mapping[var] = val
+                    bindings[var.args[0]] = val
+                    continue
+            residual.append(c)
+        if not mapping:
+            return cur, bindings
+        memo: Dict[int, Term] = {}
+        cur = [substitute(c, mapping, memo) for c in residual]
+        # substituting can expose falsity immediately
+        if any(c is terms.FALSE for c in cur):
+            return [terms.FALSE], bindings
+        cur = [c for c in cur if c is not terms.TRUE]
+    return cur, bindings
+
+
+def _as_binding(c: Term):
+    if c.op == "eq":
+        a, b = c.args
+        if a.op == "const" and b.op == "var":
+            return b, a
+        if b.op == "const" and a.op == "var":
+            return a, b
+    if c.op == "bvar":
+        return c, terms.TRUE
+    if c.op == "bnot" and c.args[0].op == "bvar":
+        return c.args[0], terms.FALSE
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass 2: signed division lowering
+# ---------------------------------------------------------------------------
+
+
+def lower_signed(constraints: List[Term]) -> List[Term]:
+    memo: Dict[int, Term] = {}
+
+    def walk(t: Term) -> Term:
+        got = memo.get(t._id)
+        if got is not None:
+            return got
+        new_args = tuple(walk(a) if isinstance(a, Term) else a for a in t.args)
+        out = rebuild(t.op, new_args, t) if new_args != t.args else t
+        if out.op in ("sdiv", "srem"):
+            a, b = out.args
+            w = out.width
+            zero = terms.bv_const(0, w)
+            na = terms.slt(a, zero)
+            nb = terms.slt(b, zero)
+            abs_a = terms.ite(na, terms.sub(zero, a), a)
+            abs_b = terms.ite(nb, terms.sub(zero, b), b)
+            if out.op == "sdiv":
+                q = terms.udiv(abs_a, abs_b)
+                out = terms.ite(terms.bxor(na, nb), terms.sub(zero, q), q)
+            else:
+                r = terms.urem(abs_a, abs_b)
+                out = terms.ite(na, terms.sub(zero, r), r)
+        memo[t._id] = out
+        return out
+
+    import sys
+
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(200000)
+    try:
+        return [walk(c) for c in constraints]
+    finally:
+        sys.setrecursionlimit(old)
+
+
+# ---------------------------------------------------------------------------
+# passes 3+4: UF and array elimination
+# ---------------------------------------------------------------------------
+
+
+class Recon:
+    """Everything needed to turn a CNF model into a full model."""
+
+    def __init__(self):
+        self.bindings: Dict[str, Term] = {}  # propagated equalities
+        self.uf_apps: Dict[str, List[Tuple[Tuple[Term, ...], str]]] = {}
+        self.sel_apps: Dict[str, List[Tuple[Term, str]]] = {}
+
+
+def eliminate_uf_and_arrays(constraints: List[Term], recon: Recon) -> List[Term]:
+    """Replace uf apps and base-array selects by fresh vars + axioms."""
+    side: List[Term] = []
+    memo: Dict[int, Term] = {}
+
+    def push_select(arr: Term, idx: Term) -> Term:
+        """select with store chains / K / ite pushed to base arrays."""
+        if arr.op == "store":
+            base, i, v = arr.args
+            same = terms.eq(i, idx)
+            if same is terms.TRUE:
+                return v
+            if same is terms.FALSE:
+                return push_select(base, idx)
+            return terms.ite(same, v, push_select(base, idx))
+        if arr.op == "K":
+            return arr.args[0]
+        if arr.op == "ite":
+            return terms.ite(
+                arr.args[0], push_select(arr.args[1], idx), push_select(arr.args[2], idx)
+            )
+        if arr.op == "avar":
+            name = arr.args[0]
+            apps = recon.sel_apps.setdefault(name, [])
+            for prev_idx, fresh in apps:
+                if prev_idx is idx:
+                    return terms.bv_var(fresh, arr.sort.range_width)
+            fresh = f"sel!{name}!{len(apps)}"
+            out = terms.bv_var(fresh, arr.sort.range_width)
+            # read consistency vs every earlier select on this array
+            for prev_idx, prev_fresh in apps:
+                prev_out = terms.bv_var(prev_fresh, arr.sort.range_width)
+                side.append(
+                    terms.implies(terms.eq(prev_idx, idx), terms.eq(prev_out, out))
+                )
+            apps.append((idx, fresh))
+            return out
+        raise NotImplementedError(f"select base: {arr.op}")
+
+    def walk(t: Term) -> Term:
+        got = memo.get(t._id)
+        if got is not None:
+            return got
+        new_args = tuple(walk(a) if isinstance(a, Term) else a for a in t.args)
+        out = rebuild(t.op, new_args, t) if new_args != t.args else t
+        if out.op == "select":
+            out = walk(push_select(out.args[0], out.args[1]))
+        elif out.op == "uf":
+            name = out.args[0]
+            args = tuple(out.args[1:])
+            apps = recon.uf_apps.setdefault(name, [])
+            found = None
+            for prev_args, fresh in apps:
+                if prev_args == args:
+                    found = fresh
+                    break
+            if found is None:
+                found = f"uf!{name}!{len(apps)}"
+                new = terms.bv_var(found, out.width)
+                for prev_args, prev_fresh in apps:
+                    if len(prev_args) != len(args):
+                        continue
+                    same = terms.band(
+                        *[terms.eq(x, y) for x, y in zip(prev_args, args)]
+                    )
+                    prev_out = terms.bv_var(prev_fresh, out.width)
+                    side.append(terms.implies(same, terms.eq(prev_out, new)))
+                apps.append((args, found))
+            out = terms.bv_var(found, out.width)
+        memo[t._id] = out
+        return out
+
+    import sys
+
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(200000)
+    try:
+        lowered = [walk(c) for c in constraints]
+    finally:
+        sys.setrecursionlimit(old)
+
+    # side conditions may themselves contain selects/ufs (idx terms were
+    # already walked, so they are clean) — but eq() of walked terms is fine
+    return lowered + side
+
+
+# ---------------------------------------------------------------------------
+# the full pipeline
+# ---------------------------------------------------------------------------
+
+
+def lower(constraints: List[Term]) -> Tuple[List[Term], Recon]:
+    recon = Recon()
+    cur = [c for c in constraints if c is not terms.TRUE]
+    if any(c is terms.FALSE for c in cur):
+        return [terms.FALSE], recon
+    # split conjunctions for better equality extraction
+    flat: List[Term] = []
+    for c in cur:
+        if c.op == "band":
+            flat.extend(c.args)
+        else:
+            flat.append(c)
+    cur, bindings = propagate_equalities(flat)
+    recon.bindings = bindings
+    cur = lower_signed(cur)
+    cur = eliminate_uf_and_arrays(cur, recon)
+    # a second propagation round: elimination may expose new equalities
+    cur2, bindings2 = propagate_equalities(cur, max_rounds=4)
+    recon.bindings.update(bindings2)
+    return cur2, recon

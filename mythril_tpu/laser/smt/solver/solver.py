@@ -1,0 +1,229 @@
+"""Solver / Optimize: the word-level SMT entry points.
+
+Reference parity: mythril/laser/smt/solver/solver.py:16-105 (`Solver`
+with timeout + add/check/model, `Optimize` with minimize/maximize).
+The engine differs by design: instead of z3's C++ stack the pipeline
+is  lower (preprocess.py) → bit-blast (bitblast.py) → native CDCL
+(native/cdcl.cpp),  with every SAT model verified against the
+original constraints by concrete evaluation before it is returned —
+an end-to-end soundness check z3 users get implicitly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Union
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.bool import Bool
+from mythril_tpu.laser.smt.bitvec import BitVec
+from mythril_tpu.laser.smt.evalterm import eval_term
+from mythril_tpu.laser.smt.model import Model
+from mythril_tpu.laser.smt.solver import native_sat
+from mythril_tpu.laser.smt.solver.bitblast import Blaster
+from mythril_tpu.laser.smt.solver.preprocess import Recon, lower
+from mythril_tpu.laser.smt.solver.solver_statistics import stat_smt_query
+
+sat = "sat"
+unsat = "unsat"
+unknown = "unknown"
+
+
+class BaseSolver:
+    def __init__(self, timeout: int = 10_000):
+        self.timeout = timeout  # milliseconds, reference default 10s
+        self.constraints: List[terms.Term] = []
+        self._model: Optional[Model] = None
+
+    def set_timeout(self, timeout: int) -> None:
+        self.timeout = timeout
+
+    def add(self, *constraints) -> None:
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                self.add(*c)
+            elif isinstance(c, Bool):
+                self.constraints.append(c.raw)
+            elif isinstance(c, terms.Term):
+                self.constraints.append(c)
+            elif isinstance(c, bool):
+                self.constraints.append(terms.bool_const(c))
+            else:
+                raise TypeError(f"cannot add {type(c)} as constraint")
+
+    append = add
+
+    def model(self) -> Model:
+        if self._model is None:
+            raise ValueError("no model available (last check was not sat)")
+        return self._model
+
+    # ------------------------------------------------------------------
+    @stat_smt_query
+    def check(self, *extra) -> str:
+        self.add(*extra)
+        self._model = None
+        status, model = check_terms(self.constraints, timeout_ms=self.timeout)
+        if status == sat:
+            self._model = model
+        return status
+
+
+class Solver(BaseSolver):
+    """A solver object with the reference Solver's interface."""
+
+
+class Optimize(BaseSolver):
+    """Solver with min/max objectives, via binary search on the bound.
+
+    Reference parity: mythril/laser/smt/solver/solver.py `Optimize`
+    (z3.Optimize); used by analysis/solver.py to minimize calldatasize
+    and callvalue when concretizing transaction sequences.
+    """
+
+    def __init__(self, timeout: int = 10_000):
+        super().__init__(timeout=timeout)
+        self.objectives: List[(terms.Term, bool)] = []
+
+    def minimize(self, element: BitVec) -> None:
+        self.objectives.append((element.raw, True))
+
+    def maximize(self, element: BitVec) -> None:
+        self.objectives.append((element.raw, False))
+
+    @stat_smt_query
+    def check(self, *extra) -> str:
+        self.add(*extra)
+        self._model = None
+        deadline = time.monotonic() + self.timeout / 1000.0
+        status, model = check_terms(self.constraints, timeout_ms=self.timeout)
+        if status != sat:
+            return status
+        # refine objectives one at a time (lexicographic, like z3's default)
+        constraints = list(self.constraints)
+        for obj, is_min in self.objectives:
+            budget_ms = max(200, int((deadline - time.monotonic()) * 1000))
+            model = self._refine(constraints, obj, is_min, model, budget_ms)
+            constraints.append(
+                terms.eq(obj, terms.bv_const(eval_term(obj, model.assignment), obj.width))
+            )
+        self._model = model
+        return sat
+
+    @staticmethod
+    def _refine(
+        constraints: List[terms.Term],
+        obj: terms.Term,
+        is_min: bool,
+        model: Model,
+        budget_ms: int,
+    ) -> Model:
+        """Binary search the objective value downward (or upward)."""
+        deadline = time.monotonic() + budget_ms / 1000.0
+        best = eval_term(obj, model.assignment)
+        lo, hi = (0, best) if is_min else (best, (1 << obj.width) - 1)
+        while lo < hi and time.monotonic() < deadline:
+            mid = (lo + hi) // 2 if is_min else (lo + hi + 1) // 2
+            bound = (
+                terms.ule(obj, terms.bv_const(mid, obj.width))
+                if is_min
+                else terms.ule(terms.bv_const(mid, obj.width), obj)
+            )
+            ms = max(100, int((deadline - time.monotonic()) * 1000))
+            status, candidate = check_terms(constraints + [bound], timeout_ms=ms)
+            if status == sat:
+                model = candidate
+                best = eval_term(obj, candidate.assignment)
+                if is_min:
+                    hi = min(mid, best)
+                else:
+                    lo = max(mid, best)
+            elif status == unsat:
+                if is_min:
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+            else:  # unknown: stop refining, keep best so far
+                break
+        return model
+
+
+# ---------------------------------------------------------------------------
+# the core check pipeline
+# ---------------------------------------------------------------------------
+
+
+def check_terms(
+    raw_constraints: List[terms.Term], timeout_ms: int = 10_000
+) -> (str, Optional[Model]):
+    t_total = time.monotonic()
+    lowered, recon = lower(raw_constraints)
+    if any(c is terms.FALSE for c in lowered):
+        return unsat, None
+    if not lowered:
+        return sat, _reconstruct({}, {}, recon, raw_constraints)
+
+    blaster = Blaster()
+    try:
+        for c in lowered:
+            blaster.assert_true(c)
+    except NotImplementedError:
+        return unknown, None
+
+    remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
+    status, bits = native_sat.solve_cnf(blaster.nvars, blaster.clauses, remaining)
+    if status == native_sat.UNSAT:
+        return unsat, None
+    if status == native_sat.UNKNOWN:
+        return unknown, None
+
+    # decode CNF bits -> word-level assignment for the lowered vars
+    base: Dict[str, int] = {}
+    for name, var_bits in blaster.var_bits.items():
+        val = 0
+        for i, lit in enumerate(var_bits):
+            if bits[lit - 1]:
+                val |= 1 << i
+        base[name] = val
+    bools: Dict[str, int] = {
+        name: bits[v - 1] for name, v in blaster.bool_vars.items()
+    }
+    model = _reconstruct(base, bools, recon, raw_constraints)
+    if model is None:
+        return unknown, None
+    return sat, model
+
+
+def _reconstruct(
+    base: Dict[str, int],
+    bools: Dict[str, int],
+    recon: Recon,
+    raw_constraints: List[terms.Term],
+) -> Optional[Model]:
+    """CNF assignment -> full model over the original vocabulary."""
+    assignment: Dict = dict(base)
+    assignment.update(bools)
+    # propagated bindings are constant terms
+    for name, val in recon.bindings.items():
+        v = val.value
+        assignment.setdefault(name, v if v is not None else 0)
+    # arrays: evaluate each recorded select index under the assignment
+    for arr_name, apps in recon.sel_apps.items():
+        table = {}
+        for idx_term, fresh in apps:
+            idx_val = eval_term(idx_term, assignment)
+            table.setdefault(idx_val, assignment.get(fresh, 0))
+        assignment[arr_name] = (0, table)
+    # UFs: same, keyed on evaluated argument tuples
+    for uf_name, apps in recon.uf_apps.items():
+        table = {}
+        for arg_terms_, fresh in apps:
+            key = tuple(eval_term(a, assignment) for a in arg_terms_)
+            table.setdefault(key, assignment.get(fresh, 0))
+        assignment[uf_name] = table
+    model = Model(assignment)
+    # soundness gate: the model must satisfy every original constraint
+    for c in raw_constraints:
+        if not eval_term(c, assignment):
+            return None
+    return model

@@ -1,0 +1,46 @@
+"""Query counting + cumulative solver time.
+
+Reference parity: mythril/laser/smt/solver/solver_statistics.py:8-43
+(`SolverStatistics` singleton + `stat_smt_query` decorator).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import wraps
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class SolverStatistics(object, metaclass=Singleton):
+    """Solver query stats; enabled by the analyzer before fire_lasers."""
+
+    def __init__(self):
+        self.enabled = False
+        self.query_count = 0
+        self.solver_time = 0.0
+
+    def __repr__(self):
+        return (
+            f"Solver statistics:\n"
+            f"Query count: {self.query_count}\n"
+            f"Solver time: {self.solver_time}"
+        )
+
+
+def stat_smt_query(func):
+    """Measure and count every solver query routed through `func`."""
+    stat_store = SolverStatistics()
+
+    @wraps(func)
+    def function_wrapper(*args, **kwargs):
+        if not stat_store.enabled:
+            return func(*args, **kwargs)
+        stat_store.query_count += 1
+        begin = time.time()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            stat_store.solver_time += time.time() - begin
+
+    return function_wrapper

@@ -1,0 +1,24 @@
+"""Global runtime flag bag.
+
+Reference parity: mythril/support/support_args.py:1-16 — a singleton
+`args` written by MythrilAnalyzer and read by deep layers (storage
+model, svm exec loop, solver timeouts) without explicit plumbing.
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class Args(object, metaclass=Singleton):
+    def __init__(self):
+        self.solver_timeout = 10_000  # ms per query (CLI --solver-timeout)
+        self.sparse_pruning = False
+        self.unconstrained_storage = False
+        self.parallel_solving = False
+        self.call_depth_limit = 3
+        self.iprof = False
+        self.solver_log = None
+
+
+args = Args()

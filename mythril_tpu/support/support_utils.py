@@ -1,0 +1,51 @@
+"""General utility objects: Singleton metaclass, code hashing, zpad.
+
+Reference parity: mythril/support/support_utils.py:9-41.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from mythril_tpu.support.keccak import keccak256
+
+
+class Singleton(type):
+    """A metaclass type implementing the singleton pattern.
+
+    As in the reference, instances are per-process and not thread- or
+    process-safe (reference: support/support_utils.py:16-19).
+    """
+
+    _instances: Dict = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super(Singleton, cls).__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+
+def get_code_hash(code) -> str:
+    """keccak of the runtime bytecode as '0x...' hex.
+
+    Accepts '0x'-prefixed hex strings or raw bytes
+    (reference: support/support_utils.py:22-41 get_code_hash).
+    """
+    if isinstance(code, str):
+        code = code[2:] if code.startswith("0x") else code
+        try:
+            code = bytes.fromhex(code)
+        except ValueError:
+            return hex(hash(code))  # unhexable code string: stable fallback
+    return "0x" + keccak256(bytes(code)).hex()
+
+
+def zpad(x: bytes, length: int) -> bytes:
+    """Left zero pad value `x` at least to length `length`."""
+    return b"\x00" * max(0, length - len(x)) + x
+
+
+def sha3(data) -> bytes:
+    if isinstance(data, str):
+        data = data.encode()
+    return keccak256(bytes(data))

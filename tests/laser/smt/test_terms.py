@@ -1,0 +1,127 @@
+"""Term DAG: constant folding, simplification, evaluation.
+
+Mirrors the role of the reference's tests/laser/smt tests, plus
+property tests of the evaluator against Python integer semantics.
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.evalterm import eval_term
+
+W = 256
+MASK = (1 << W) - 1
+
+
+def const(v):
+    return terms.bv_const(v, W)
+
+
+def test_constant_folding_basics():
+    a, b = const(7), const(5)
+    assert terms.add(a, b).value == 12
+    assert terms.sub(b, a).value == (5 - 7) & MASK
+    assert terms.mul(a, b).value == 35
+    assert terms.udiv(a, b).value == 1
+    assert terms.udiv(a, const(0)).value == 0  # EVM x/0 = 0
+    assert terms.urem(a, const(0)).value == 0
+    assert terms.eq(a, a) is terms.TRUE
+    assert terms.ult(b, a) is terms.TRUE
+    assert terms.ult(a, a) is terms.FALSE
+
+
+def test_hash_consing():
+    x = terms.bv_var("x", W)
+    assert terms.add(x, const(1)) is terms.add(x, const(1))
+    assert terms.add(x, const(0)) is x
+    assert terms.mul(x, const(1)) is x
+    assert terms.mul(x, const(0)).value == 0
+    assert terms.bvand(x, const(0)).value == 0
+    assert terms.bvand(x, const(MASK)) is x
+    assert terms.sub(x, x).value == 0
+    assert terms.bvxor(x, x).value == 0
+
+
+def test_bool_simplification():
+    p = terms.bool_var("p")
+    assert terms.band(p, terms.TRUE) is p
+    assert terms.band(p, terms.FALSE) is terms.FALSE
+    assert terms.bor(p, terms.TRUE) is terms.TRUE
+    assert terms.bnot(terms.bnot(p)) is p
+    assert terms.band(p, terms.bnot(p)) is terms.FALSE
+    assert terms.bor(p, terms.bnot(p)) is terms.TRUE
+
+
+def test_extract_concat_rules():
+    x = terms.bv_var("x", W)
+    lo = terms.extract(127, 0, x)
+    hi = terms.extract(255, 128, x)
+    assert terms.concat(hi, lo) is x
+    e = terms.extract(15, 8, terms.extract(31, 0, x))
+    assert e is terms.extract(15, 8, x)
+
+
+def test_select_store():
+    arr = terms.array_var("storage", 256, 256)
+    k1, k2 = const(1), const(2)
+    v = const(0xBEEF)
+    a2 = terms.store(arr, k1, v)
+    assert terms.select(a2, k1) is v
+    assert terms.select(a2, k2).op == "select"
+    karr = terms.const_array(const(0), 256)
+    assert terms.select(karr, terms.bv_var("i", W)).value == 0
+
+
+_OPS = [
+    ("add", terms.add, lambda a, b: (a + b) & MASK),
+    ("sub", terms.sub, lambda a, b: (a - b) & MASK),
+    ("mul", terms.mul, lambda a, b: (a * b) & MASK),
+    ("udiv", terms.udiv, lambda a, b: (a // b) if b else 0),
+    ("urem", terms.urem, lambda a, b: (a % b) if b else 0),
+    ("and", terms.bvand, lambda a, b: a & b),
+    ("or", terms.bvor, lambda a, b: a | b),
+    ("xor", terms.bvxor, lambda a, b: a ^ b),
+]
+
+
+@pytest.mark.parametrize("name,op,pyop", _OPS, ids=[o[0] for o in _OPS])
+def test_eval_matches_python(name, op, pyop):
+    rng = random.Random(name)
+    x = terms.bv_var("x", W)
+    y = terms.bv_var("y", W)
+    t = op(x, y)
+    for _ in range(50):
+        a = rng.getrandbits(W)
+        b = rng.getrandbits(W) if rng.random() < 0.7 else rng.getrandbits(8)
+        assert eval_term(t, {"x": a, "y": b}) == pyop(a, b)
+
+
+def test_eval_signed_ops():
+    rng = random.Random(42)
+    x = terms.bv_var("x", W)
+    y = terms.bv_var("y", W)
+
+    def sgn(v):
+        return v - (1 << W) if v >> (W - 1) else v
+
+    for _ in range(100):
+        a, b = rng.getrandbits(W), rng.getrandbits(W)
+        asn = {"x": a, "y": b}
+        sa, sb = sgn(a), sgn(b)
+        if sb != 0:
+            q = abs(sa) // abs(sb)
+            if (sa < 0) != (sb < 0):
+                q = -q
+            assert eval_term(terms.sdiv(x, y), asn) == q & MASK
+            r = abs(sa) % abs(sb)
+            if sa < 0:
+                r = -r
+            assert eval_term(terms.srem(x, y), asn) == r & MASK
+        assert eval_term(terms.slt(x, y), asn) == int(sa < sb)
+        sh = b % 300
+        asn2 = {"x": a, "y": sh}
+        assert eval_term(terms.shl(x, y), asn2) == ((a << sh) & MASK if sh < W else 0)
+        assert eval_term(terms.lshr(x, y), asn2) == (a >> sh if sh < W else 0)
+        assert eval_term(terms.ashr(x, y), asn2) == (sgn(a) >> min(sh, W)) & MASK
