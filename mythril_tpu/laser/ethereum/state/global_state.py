@@ -1,0 +1,105 @@
+"""GlobalState: one symbolic path state.
+
+Reference parity: mythril/laser/ethereum/state/global_state.py:21-163 —
+world state + environment + machine state + transaction stack + CFG
+node + annotations.  `__copy__` (:62-80) clones the mutable parts and
+re-binds the environment's active account into the copied world state
+(the subtle aliasing rule every fork depends on); `new_bitvec` (:) names
+fresh symbols `{txid}_{name}` so witnesses map back to transactions.
+"""
+
+from __future__ import annotations
+
+from copy import copy
+from typing import Dict, Iterable, List, Optional
+
+from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
+from mythril_tpu.laser.ethereum.state.environment import Environment
+from mythril_tpu.laser.ethereum.state.machine_state import MachineState
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.smt import BitVec, symbol_factory
+
+
+class GlobalState:
+    """One state of the symbolic machine: a point on one path."""
+
+    def __init__(
+        self,
+        world_state: WorldState,
+        environment: Environment,
+        node=None,
+        machine_state: Optional[MachineState] = None,
+        transaction_stack: Optional[List] = None,
+        last_return_data=None,
+        annotations: Optional[List[StateAnnotation]] = None,
+    ):
+        self.world_state = world_state
+        self.environment = environment
+        self.node = node
+        self.mstate = (
+            machine_state if machine_state else MachineState(gas_limit=1000000000)
+        )
+        self.transaction_stack = transaction_stack if transaction_stack else []
+        self.op_code = ""
+        self.last_return_data = last_return_data
+        self._annotations = annotations or []
+
+    @property
+    def accounts(self) -> Dict:
+        return self.world_state.accounts
+
+    def __copy__(self) -> "GlobalState":
+        world_state = copy(self.world_state)
+        environment = copy(self.environment)
+        mstate = copy(self.mstate)
+        transaction_stack = copy(self.transaction_stack)
+        environment.active_account = world_state[environment.active_account.address]
+        new = GlobalState(
+            world_state,
+            environment,
+            self.node,
+            mstate,
+            transaction_stack=transaction_stack,
+            last_return_data=self.last_return_data,
+            annotations=[copy(a) for a in self._annotations],
+        )
+        new.op_code = self.op_code
+        return new
+
+    # -- accessors -------------------------------------------------------
+    def get_current_instruction(self) -> Dict:
+        """The instruction record at the current pc."""
+        instructions = self.environment.code.instruction_list
+        if self.mstate.pc >= len(instructions):
+            raise IndexError
+        return instructions[self.mstate.pc]
+
+    @property
+    def current_transaction(self):
+        try:
+            return self.transaction_stack[-1][0]
+        except IndexError:
+            return None
+
+    @property
+    def instruction(self) -> Dict:
+        return self.get_current_instruction()
+
+    def new_bitvec(self, name: str, size: int = 256, annotations=None) -> BitVec:
+        transaction_id = self.current_transaction.id
+        return symbol_factory.BitVecSym(
+            f"{transaction_id}_{name}", size, annotations=annotations
+        )
+
+    # -- annotations -----------------------------------------------------
+    def annotate(self, annotation: StateAnnotation) -> None:
+        self._annotations.append(annotation)
+        if annotation.persist_to_world_state:
+            self.world_state.annotate(annotation)
+
+    @property
+    def annotations(self) -> List[StateAnnotation]:
+        return self._annotations
+
+    def get_annotations(self, annotation_type: type) -> Iterable[StateAnnotation]:
+        return filter(lambda x: isinstance(x, annotation_type), self._annotations)

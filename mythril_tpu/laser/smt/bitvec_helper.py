@@ -139,12 +139,16 @@ def BVSubNoUnderflow(a: BitVec, b, signed: bool = False) -> Bool:
     ra, rb = _both(a, b)
     w = a.size()
     if signed:
+        # signed underflow: neg - pos wrapping to a non-negative result
         s = terms.sub(ra, rb)
-        pos_minus_neg = terms.band(
-            terms.sle(terms.bv_const(0, w), ra), terms.slt(rb, terms.bv_const(0, w))
+        neg_minus_pos = terms.band(
+            terms.slt(ra, terms.bv_const(0, w)),
+            terms.slt(terms.bv_const(0, w), rb),
         )
         return Bool(
-            terms.bnot(terms.band(pos_minus_neg, terms.slt(s, terms.bv_const(0, w)))),
+            terms.bnot(
+                terms.band(neg_minus_pos, terms.sle(terms.bv_const(0, w), s))
+            ),
             _anns(a, b),
         )
     return Bool(terms.ule(rb, ra), _anns(a, b))

@@ -54,3 +54,8 @@ class Model:
         if raw.sort.kind == "bool":
             return Bool(terms.bool_const(bool(val)))
         return BitVec(terms.bv_const(val, raw.width))
+
+    def eval_int(self, expression: Union[BitVec, Bool, terms.Term]) -> int:
+        """Evaluate to a plain Python int (completion: unknowns -> 0)."""
+        raw = expression.raw if hasattr(expression, "raw") else expression
+        return int(eval_term(raw, self.assignment))

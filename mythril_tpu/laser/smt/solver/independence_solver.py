@@ -34,7 +34,10 @@ class DependenceMap:
         self.variable_map: Dict[str, _Bucket] = {}
 
     def add_condition(self, condition: terms.Term) -> None:
-        names = set(terms.free_vars(condition).keys())
+        # dependence_symbols includes UF names: constraints sharing an
+        # uninterpreted function (e.g. keccak) must land in one bucket
+        # or functional consistency is lost across sub-queries
+        names = terms.dependence_symbols(condition)
         touched: List[_Bucket] = []
         for name in names:
             b = self.variable_map.get(name)
@@ -70,10 +73,9 @@ class IndependenceSolver(BaseSolver):
 
     @stat_smt_query
     def check(self, *extra) -> str:
-        self.add(*extra)
         self._model = None
         dep_map = DependenceMap()
-        for c in self.constraints:
+        for c in self.constraints + self._norm(extra):
             dep_map.add_condition(c)
         merged: Dict = {}
         per_bucket_ms = max(

@@ -39,19 +39,25 @@ class BaseSolver:
         self.timeout = timeout
 
     def add(self, *constraints) -> None:
-        for c in constraints:
-            if isinstance(c, (list, tuple)):
-                self.add(*c)
-            elif isinstance(c, Bool):
-                self.constraints.append(c.raw)
-            elif isinstance(c, terms.Term):
-                self.constraints.append(c)
-            elif isinstance(c, bool):
-                self.constraints.append(terms.bool_const(c))
-            else:
-                raise TypeError(f"cannot add {type(c)} as constraint")
+        self.constraints.extend(self._norm(constraints))
 
     append = add
+
+    @staticmethod
+    def _norm(constraints) -> List[terms.Term]:
+        out: List[terms.Term] = []
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                out.extend(BaseSolver._norm(c))
+            elif isinstance(c, Bool):
+                out.append(c.raw)
+            elif isinstance(c, terms.Term):
+                out.append(c)
+            elif isinstance(c, bool):
+                out.append(terms.bool_const(c))
+            else:
+                raise TypeError(f"cannot add {type(c)} as constraint")
+        return out
 
     def model(self) -> Model:
         if self._model is None:
@@ -61,9 +67,12 @@ class BaseSolver:
     # ------------------------------------------------------------------
     @stat_smt_query
     def check(self, *extra) -> str:
-        self.add(*extra)
+        # extras are assumptions scoped to this call (z3 semantics);
+        # they are NOT persisted into self.constraints
         self._model = None
-        status, model = check_terms(self.constraints, timeout_ms=self.timeout)
+        status, model = check_terms(
+            self.constraints + self._norm(extra), timeout_ms=self.timeout
+        )
         if status == sat:
             self._model = model
         return status
@@ -93,14 +102,14 @@ class Optimize(BaseSolver):
 
     @stat_smt_query
     def check(self, *extra) -> str:
-        self.add(*extra)
+        base = self.constraints + self._norm(extra)
         self._model = None
         deadline = time.monotonic() + self.timeout / 1000.0
-        status, model = check_terms(self.constraints, timeout_ms=self.timeout)
+        status, model = check_terms(base, timeout_ms=self.timeout)
         if status != sat:
             return status
         # refine objectives one at a time (lexicographic, like z3's default)
-        constraints = list(self.constraints)
+        constraints = list(base)
         for obj, is_min in self.objectives:
             budget_ms = max(200, int((deadline - time.monotonic()) * 1000))
             model = self._refine(constraints, obj, is_min, model, budget_ms)
@@ -164,11 +173,17 @@ def check_terms(
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
     blaster = Blaster()
+    import sys
+
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(200000)
     try:
         for c in lowered:
             blaster.assert_true(c)
-    except NotImplementedError:
+    except (NotImplementedError, RecursionError):
         return unknown, None
+    finally:
+        sys.setrecursionlimit(old_limit)
 
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
     status, bits = native_sat.solve_cnf(blaster.nvars, blaster.clauses, remaining)

@@ -24,6 +24,7 @@ mythril/support/model.py:15).
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional, Tuple, Union
 
 # ---------------------------------------------------------------------------
@@ -127,14 +128,20 @@ class Term:
         return to_str(self, max_depth=6)
 
 
-_TABLE: Dict[Tuple[str, Tuple, Sort], Term] = {}
+# Weak interning: entries die with their Term, so transient
+# simplification intermediates are collectible instead of pinning
+# memory for the whole analysis run. A key tuple holds strong refs to
+# child terms, but the key itself is dropped when its value is
+# collected, releasing the children transitively.
+_TABLE: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
 
 
 def _mk(op: str, args: Tuple[Payload, ...], sort: Sort) -> Term:
     key = (op, args, sort)
     t = _TABLE.get(key)
     if t is None:
-        t = _TABLE[key] = Term(op, args, sort)
+        t = Term(op, args, sort)
+        _TABLE[key] = t
     return t
 
 
@@ -668,6 +675,28 @@ def free_vars(t: Term, out: Optional[dict] = None) -> Dict[str, Term]:
         seen.add(cur._id)
         if cur.op in ("var", "bvar", "avar"):
             out[cur.args[0]] = cur
+        for c in children(cur):
+            stack.append(c)
+    return out
+
+
+def dependence_symbols(t: Term) -> set:
+    """Names that couple constraints for independence partitioning:
+    free variables PLUS uninterpreted-function names — two constraints
+    over the same UF must be solved together or functional consistency
+    (f(x)=f(y) when x=y) is lost across buckets."""
+    out = set()
+    stack = [t]
+    seen = set()
+    while stack:
+        cur = stack.pop()
+        if cur._id in seen:
+            continue
+        seen.add(cur._id)
+        if cur.op in ("var", "bvar", "avar"):
+            out.add(cur.args[0])
+        elif cur.op == "uf":
+            out.add("uf!" + cur.args[0])
         for c in children(cur):
             stack.append(c)
     return out

@@ -325,15 +325,17 @@ struct Solver {
   }
 
   static int64_t luby(int64_t i) {
-    // Luby sequence * 1 (unit = restart interval factor)
-    int64_t k = 1;
-    while ((1LL << (k + 1)) <= i + 1) k++;
-    while ((1LL << k) - 1 != i + 1 && i > 0) {
-      i = i - ((1LL << k) - 1);
-      k = 1;
-      while ((1LL << (k + 1)) <= i + 1) k++;
+    // Luby sequence: 1,1,2,1,1,2,4,1,1,2,1,1,2,4,8,... (0-indexed).
+    // Find the smallest complete subsequence (length 2^seq - 1)
+    // containing index i, then recurse into its position.
+    int64_t size = 1, seq = 0;
+    while (size < i + 1) { seq++; size = 2 * size + 1; }
+    while (size - 1 != i) {
+      size = (size - 1) >> 1;
+      seq--;
+      i = i % size;
     }
-    return 1LL << (k - 1);
+    return 1LL << seq;
   }
 
   // returns 1 sat, -1 unsat, 0 budget exhausted

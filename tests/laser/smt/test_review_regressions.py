@@ -1,0 +1,49 @@
+"""Regressions for solver-layer bugs found in review: UF-coupled
+independence partitioning, assumption scoping, signed-underflow
+semantics, and deep-term blasting."""
+
+from mythril_tpu.laser.smt import BVSubNoUnderflow, symbol_factory
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.solver import IndependenceSolver, Solver, sat, unsat
+
+
+def test_independence_solver_couples_through_uf():
+    # [x==0, keccak(x)==1] and [y==0, keccak(y)==2] share only the UF;
+    # solving them separately would wrongly report sat
+    x = terms.bv_var("x", 8)
+    y = terms.bv_var("y", 8)
+    s = IndependenceSolver()
+    s.add(terms.eq(x, terms.bv_const(0, 8)))
+    s.add(terms.eq(terms.apply_uf("keccak", 8, (x,)), terms.bv_const(1, 8)))
+    s.add(terms.eq(y, terms.bv_const(0, 8)))
+    s.add(terms.eq(terms.apply_uf("keccak", 8, (y,)), terms.bv_const(2, 8)))
+    assert s.check() == unsat
+
+
+def test_check_assumptions_are_scoped():
+    x = symbol_factory.BitVecSym("scoped_x", 8)
+    s = Solver()
+    s.add(x > 0)
+    assert s.check(x == 1) == sat
+    # the x==1 probe must not leak into the persistent constraint set
+    assert s.check(x == 2) == sat
+
+
+def test_signed_sub_no_underflow():
+    mk = lambda v: symbol_factory.BitVecVal(v, 4)
+    # -8 - 1 underflows 4-bit signed range
+    assert BVSubNoUnderflow(mk(0x8), mk(1), signed=True).value is False
+    # 7 - (-8) overflows but does not *underflow*
+    assert BVSubNoUnderflow(mk(7), mk(0x8), signed=True).value is True
+    # plain small case
+    assert BVSubNoUnderflow(mk(3), mk(2), signed=True).value is True
+
+
+def test_deep_term_does_not_crash():
+    x = symbol_factory.BitVecSym("deep_x", 32)
+    acc = x
+    for _ in range(3000):
+        acc = acc + 1
+    s = Solver(timeout=15000)
+    s.add(acc == 5)
+    assert s.check() in (sat, "unknown")

@@ -1,0 +1,72 @@
+"""State-model unit tests (reference test strategy: tests/laser/state/
+mstack/mstate tests — SURVEY.md §4)."""
+
+import pytest
+
+from mythril_tpu.laser.ethereum.evm_exceptions import (
+    StackOverflowException,
+    StackUnderflowException,
+)
+from mythril_tpu.laser.ethereum.state.machine_state import MachineStack, MachineState
+from mythril_tpu.laser.smt import symbol_factory
+
+
+def test_stack_append_converts_ints():
+    stack = MachineStack()
+    stack.append(5)
+    assert stack[0].value == 5
+    assert stack[0].size() == 256
+
+
+def test_stack_overflow():
+    stack = MachineStack()
+    for i in range(1024):
+        stack.append(i)
+    with pytest.raises(StackOverflowException):
+        stack.append(1)
+
+
+def test_stack_underflow():
+    with pytest.raises(StackUnderflowException):
+        MachineStack().pop()
+
+
+def test_stack_no_concat():
+    with pytest.raises(NotImplementedError):
+        MachineStack([symbol_factory.BitVecVal(0, 256)]) + MachineStack()
+
+
+def test_mstate_pop_order():
+    state = MachineState(gas_limit=8000000)
+    for v in (1, 2, 3):
+        state.stack.append(v)
+    a, b = state.pop(2)
+    assert (a.value, b.value) == (3, 2)
+    assert state.pop().value == 1
+
+
+def test_memory_gas_quadratic():
+    state = MachineState(gas_limit=8000000)
+    # growing to 32 words costs 3*32 + 32*32/512 = 98
+    assert state.calculate_memory_gas(0, 1024) == 3 * 32 + (32 * 32) // 512
+
+
+def test_mem_extend_rounds_to_words():
+    state = MachineState(gas_limit=8000000)
+    state.mem_extend(0, 33)
+    assert state.memory_size == 64
+
+
+def test_memory_word_roundtrip():
+    state = MachineState(gas_limit=8000000)
+    state.mem_extend(0, 32)
+    state.memory.write_word_at(0, 0xDEADBEEF)
+    assert state.memory.get_word_at(0) == 0xDEADBEEF
+
+
+def test_memory_symbolic_word_roundtrip():
+    state = MachineState(gas_limit=8000000)
+    state.mem_extend(0, 32)
+    x = symbol_factory.BitVecSym("x", 256)
+    state.memory.write_word_at(0, x)
+    assert (state.memory.get_word_at(0) == x).value is True

@@ -1,0 +1,66 @@
+"""WorldState / Account / Storage tests (reference:
+tests/laser/state/storage_test.py, world_state_account_exist_load)."""
+
+from copy import copy
+
+from mythril_tpu.laser.ethereum.state.account import Account, Storage
+from mythril_tpu.laser.ethereum.state.world_state import (
+    WorldState,
+    generate_contract_address,
+)
+from mythril_tpu.laser.smt import symbol_factory
+
+
+def test_concrete_storage_defaults_zero():
+    s = Storage(concrete=True)
+    assert s[symbol_factory.BitVecVal(1, 256)].value == 0
+
+
+def test_symbolic_storage_roundtrip():
+    s = Storage(concrete=False, address=symbol_factory.BitVecVal(0xAA, 256))
+    key = symbol_factory.BitVecVal(1, 256)
+    s[key] = symbol_factory.BitVecVal(77, 256)
+    assert s[key].value == 77
+
+
+def test_storage_copy_isolated():
+    s = Storage(concrete=True)
+    key = symbol_factory.BitVecVal(1, 256)
+    s[key] = symbol_factory.BitVecVal(1, 256)
+    s2 = copy(s)
+    s2[key] = symbol_factory.BitVecVal(2, 256)
+    assert s[key].value == 1
+    assert s2[key].value == 2
+
+
+def test_world_state_autocreate_account():
+    ws = WorldState()
+    acc = ws[symbol_factory.BitVecVal(0xDEAD, 256)]
+    assert acc.address.value == 0xDEAD
+    assert 0xDEAD in ws.accounts
+
+
+def test_world_state_copy_isolates_storage():
+    ws = WorldState()
+    acc = ws.create_account(balance=10, address=0xAA, concrete_storage=True)
+    key = symbol_factory.BitVecVal(0, 256)
+    acc.storage[key] = symbol_factory.BitVecVal(5, 256)
+    ws2 = copy(ws)
+    ws2.accounts[0xAA].storage[key] = symbol_factory.BitVecVal(9, 256)
+    assert ws.accounts[0xAA].storage[key].value == 5
+    assert ws2.accounts[0xAA].storage[key].value == 9
+
+
+def test_balance_through_shared_array():
+    ws = WorldState()
+    acc = ws.create_account(balance=100, address=0xBB)
+    assert acc.balance().value == 100
+    acc.add_balance(50)
+    assert acc.balance().value == 150
+
+
+def test_create_address_matches_known_vector():
+    # well-known vector: sender 0x6ac7ea33f8831ea9dcc53393aaa88b25a785dbf0, nonce 0
+    # -> 0xcd234a471b72ba2f1ccf0a70fcaba648a5eecd8d (the "cryptokitties" example)
+    addr = generate_contract_address(0x6AC7EA33F8831EA9DCC53393AAA88B25A785DBF0, 0)
+    assert addr == 0xCD234A471B72BA2F1CCF0A70FCABA648A5EECD8D
