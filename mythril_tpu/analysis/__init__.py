@@ -1,0 +1,1 @@
+"""Analysis / detection layer (reference: mythril/analysis/)."""

@@ -1,0 +1,94 @@
+"""Two-phase issue flow.
+
+Reference parity: mythril/analysis/potential_issues.py:8-108 —
+detection modules pre-solve only their cheap local property and attach
+a `PotentialIssue` to the state; at transaction end
+`check_potential_issues` (called from the engine) solves the full
+path + property constraints and, on sat, builds the concrete
+transaction sequence and promotes the potential issue to a real one.
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+
+
+class PotentialIssue:
+    """An issue whose cheap precondition was satisfiable; final
+    validation is deferred to transaction end."""
+
+    def __init__(
+        self,
+        contract,
+        function_name,
+        address,
+        swc_id,
+        title,
+        bytecode,
+        detector,
+        severity=None,
+        description_head="",
+        description_tail="",
+        constraints=None,
+    ):
+        self.title = title
+        self.contract = contract
+        self.function_name = function_name
+        self.address = address
+        self.description_head = description_head
+        self.description_tail = description_tail
+        self.severity = severity
+        self.swc_id = swc_id
+        self.bytecode = bytecode
+        self.constraints = constraints or []
+        self.detector = detector
+
+
+class PotentialIssuesAnnotation(StateAnnotation):
+    def __init__(self):
+        self.potential_issues = []
+
+
+def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnotation:
+    """The state's potential-issues annotation (created on demand)."""
+    for annotation in state.annotations:
+        if isinstance(annotation, PotentialIssuesAnnotation):
+            return annotation
+    annotation = PotentialIssuesAnnotation()
+    state.annotate(annotation)
+    return annotation
+
+
+def check_potential_issues(state: GlobalState) -> None:
+    """Validate each pending potential issue against the full path
+    constraints; sat -> concrete tx sequence -> Issue on the detector."""
+    annotation = get_potential_issues_annotation(state)
+    for potential_issue in annotation.potential_issues[:]:
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints + potential_issue.constraints
+            )
+        except UnsatError:
+            continue
+
+        annotation.potential_issues.remove(potential_issue)
+        potential_issue.detector.cache.add(potential_issue.address)
+        potential_issue.detector.issues.append(
+            Issue(
+                contract=potential_issue.contract,
+                function_name=potential_issue.function_name,
+                address=potential_issue.address,
+                title=potential_issue.title,
+                bytecode=potential_issue.bytecode,
+                swc_id=potential_issue.swc_id,
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                severity=potential_issue.severity,
+                description_head=potential_issue.description_head,
+                description_tail=potential_issue.description_tail,
+                transaction_sequence=transaction_sequence,
+            )
+        )

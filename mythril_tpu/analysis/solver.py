@@ -1,0 +1,205 @@
+"""Witness concretization: path constraints -> exploit transactions.
+
+Reference parity: mythril/analysis/solver.py:47-242 —
+`get_transaction_sequence` poses one Optimize query (minimizing
+calldata sizes and call values, with balance sanity bounds), then
+extracts per-transaction concrete calldata/value/caller and the
+initial account state from the model, patching keccak placeholder
+values with real hashes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple, Union
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.ethereum.keccak_function_manager import (
+    hash_matcher,
+    keccak_function_manager,
+)
+from mythril_tpu.laser.ethereum.state.constraints import Constraints
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.transaction import BaseTransaction
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+from mythril_tpu.laser.smt import UGE, symbol_factory
+from mythril_tpu.laser.smt.model import Model
+from mythril_tpu.support.model import get_model
+
+log = logging.getLogger(__name__)
+
+
+def pretty_print_model(model: Model) -> str:
+    """Human-readable assignment dump."""
+    ret = ""
+    for d in model.decls():
+        value = model[d]
+        try:
+            condition = "0x%x" % int(value)
+        except (TypeError, ValueError):
+            condition = str(value)
+        ret += "%s: %s\n" % (d.name(), condition)
+    return ret
+
+
+def get_transaction_sequence(
+    global_state: GlobalState, constraints: Constraints
+) -> Dict:
+    """Generate the concrete transaction sequence witnessing
+    `constraints` (raises UnsatError when impossible)."""
+    transaction_sequence = global_state.world_state.transaction_sequence
+
+    concrete_transactions = []
+
+    tx_constraints, minimize = _set_minimisation_constraints(
+        transaction_sequence, constraints.copy(), [], 5000, global_state.world_state
+    )
+    model = get_model(tx_constraints, minimize=minimize)
+
+    # initial state includes the creation account (its code technically
+    # only exists after tx 1; reports follow the reference's convention)
+    initial_world_state = transaction_sequence[0].world_state
+    initial_accounts = initial_world_state.accounts
+
+    for transaction in transaction_sequence:
+        concrete_transactions.append(_get_concrete_transaction(model, transaction))
+
+    min_price_dict: Dict[str, int] = {}
+    for address in initial_accounts.keys():
+        min_price_dict[address] = model.eval_int(
+            initial_world_state.starting_balances[
+                symbol_factory.BitVecVal(address, 256)
+            ]
+        )
+
+    concrete_initial_state = _get_concrete_state(initial_accounts, min_price_dict)
+    if isinstance(transaction_sequence[0], ContractCreationTransaction):
+        code = transaction_sequence[0].code
+        _replace_with_actual_sha(concrete_transactions, model, code)
+    else:
+        _replace_with_actual_sha(concrete_transactions, model)
+    _add_calldata_placeholder(concrete_transactions, transaction_sequence)
+
+    return {"initialState": concrete_initial_state, "steps": concrete_transactions}
+
+
+def _add_calldata_placeholder(
+    concrete_transactions: List[Dict[str, str]],
+    transaction_sequence: List[BaseTransaction],
+) -> None:
+    """Mirror `input` into `calldata` (for a creation tx, without the
+    deployment bytecode prefix)."""
+    for tx in concrete_transactions:
+        tx["calldata"] = tx["input"]
+    if not isinstance(transaction_sequence[0], ContractCreationTransaction):
+        return
+    code_len = len(transaction_sequence[0].code.bytecode)
+    concrete_transactions[0]["calldata"] = concrete_transactions[0]["input"][
+        code_len + 2 :
+    ]
+
+
+def _replace_with_actual_sha(
+    concrete_transactions: List[Dict[str, str]], model: Model, code=None
+) -> None:
+    """Substitute placeholder hash values (in the reserved fffffff...
+    intervals) with real keccaks of the witness preimages."""
+    concrete_hashes = keccak_function_manager.get_concrete_hash_data(model)
+    for tx in concrete_transactions:
+        if hash_matcher not in tx["input"]:
+            continue
+        if code is not None and code.bytecode in tx["input"]:
+            s_index = len(code.bytecode) + 2
+        else:
+            s_index = 10
+        for i in range(s_index, len(tx["input"])):
+            data_slice = tx["input"][i : i + 64]
+            if hash_matcher not in data_slice or len(data_slice) != 64:
+                continue
+            find_input = symbol_factory.BitVecVal(int(data_slice, 16), 256)
+            input_ = None
+            for size in concrete_hashes:
+                _, inverse = keccak_function_manager.store_function[size]
+                if find_input.value not in concrete_hashes[size]:
+                    continue
+                input_ = symbol_factory.BitVecVal(
+                    model.eval_int(inverse(find_input)), size
+                )
+            if input_ is None:
+                continue
+            keccak = keccak_function_manager.find_concrete_keccak(input_)
+            hex_keccak = "{:064x}".format(keccak.value)
+            tx["input"] = tx["input"][:s_index] + tx["input"][s_index:].replace(
+                tx["input"][i : 64 + i], hex_keccak
+            )
+
+
+def _get_concrete_state(
+    initial_accounts: Dict, min_price_dict: Dict[str, int]
+) -> Dict:
+    accounts = {}
+    for address, account in initial_accounts.items():
+        data: Dict[str, Union[int, str]] = {
+            "nonce": account.nonce,
+            "code": account.code.bytecode,
+            "storage": str(account.storage),
+            "balance": hex(min_price_dict.get(address, 0)),
+        }
+        accounts[hex(address)] = data
+    return {"accounts": accounts}
+
+
+def _get_concrete_transaction(model: Model, transaction: BaseTransaction) -> Dict:
+    address = hex(transaction.callee_account.address.value)
+    value = model.eval_int(transaction.call_value)
+    caller = "0x" + ("%x" % model.eval_int(transaction.caller)).zfill(40)
+
+    input_ = ""
+    if isinstance(transaction, ContractCreationTransaction):
+        address = ""
+        input_ += transaction.code.bytecode
+
+    input_ += "".join(
+        "{:02x}".format(b if isinstance(b, int) else (b.value or 0))
+        for b in transaction.call_data.concrete(model)
+    )
+
+    return {
+        "input": "0x" + input_,
+        "value": "0x%x" % value,
+        "origin": caller,
+        "address": "%s" % address,
+    }
+
+
+def _set_minimisation_constraints(
+    transaction_sequence, constraints, minimize, max_size, world_state
+) -> Tuple[Constraints, tuple]:
+    """Bound calldata sizes and starting balances; minimize calldata
+    size + call value per transaction (reference: solver.py:205)."""
+    for transaction in transaction_sequence:
+        max_calldata_size = symbol_factory.BitVecVal(max_size, 256)
+        constraints.append(UGE(max_calldata_size, transaction.call_data.calldatasize))
+
+        minimize.append(transaction.call_data.calldatasize)
+        minimize.append(transaction.call_value)
+        constraints.append(
+            UGE(
+                symbol_factory.BitVecVal(1000000000000000000000, 256),
+                world_state.starting_balances[transaction.caller],
+            )
+        )
+
+    for account in world_state.accounts.values():
+        # each account starts with < 100 ETH: keeps witnesses readable
+        # and avoids balance-overflow artifacts
+        constraints.append(
+            UGE(
+                symbol_factory.BitVecVal(100000000000000000000, 256),
+                world_state.starting_balances[account.address],
+            )
+        )
+
+    return constraints, tuple(minimize)

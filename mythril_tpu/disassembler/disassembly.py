@@ -61,6 +61,12 @@ class Disassembly:
                 self._signatures = {}
         return self._signatures
 
+    def assign_bytecode(self, bytecode: str) -> None:
+        """Replace this disassembly's code in place — used when a
+        creation transaction returns the runtime bytecode (reference:
+        transaction_models.py:246-262 via Disassembly.assign_bytecode)."""
+        self.__init__(bytecode, enable_online_lookup=self.enable_online_lookup)
+
     def get_easm(self) -> str:
         return asm.instruction_list_to_easm(self.instruction_list)
 

@@ -10,6 +10,7 @@ state's shared symbolic balance array.
 
 from __future__ import annotations
 
+from copy import copy
 from typing import Any, Dict, Optional, Union
 
 from mythril_tpu.disassembler.disassembly import Disassembly
@@ -67,10 +68,7 @@ class Storage:
 
     def __copy__(self) -> "Storage":
         new = Storage(concrete=self.concrete, address=self.address, dynamic_loader=self.dynld)
-        new._standard_storage = type(self._standard_storage).__new__(
-            type(self._standard_storage)
-        )
-        new._standard_storage.__dict__ = dict(self._standard_storage.__dict__)
+        new._standard_storage = copy(self._standard_storage)
         new.printable_storage = dict(self.printable_storage)
         new.storage_keys_loaded = set(self.storage_keys_loaded)
         return new

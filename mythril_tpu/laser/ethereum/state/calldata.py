@@ -43,8 +43,13 @@ class BaseCalldata:
         raise NotImplementedError()
 
     def get_word_at(self, offset: int) -> BitVec:
-        """The 32-byte big-endian word starting at `offset`."""
-        parts = self[offset : offset + 32]
+        """The 32-byte big-endian word starting at `offset`.
+
+        Indexes byte-by-byte instead of slicing so a fully symbolic
+        offset works: the word length is statically 32, only the
+        per-byte indices stay symbolic.
+        """
+        parts = [self._load(offset + i) for i in range(32)]
         return simplify(Concat(*parts))
 
     def __getitem__(self, item: Union[int, slice, BitVec]) -> Any:
@@ -59,7 +64,18 @@ class BaseCalldata:
                 if isinstance(start, BitVec)
                 else symbol_factory.BitVecVal(start, 256)
             )
+            stop_bv = (
+                stop if isinstance(stop, BitVec) else symbol_factory.BitVecVal(stop, 256)
+            )
+            # symbolic base with a decidable span: iterate by count —
+            # symbolic indices are fine, only the length must be concrete
+            span = simplify(stop_bv - current_index)
             parts = []
+            if span.value is not None:
+                for _ in range(span.value):
+                    parts.append(self._load(current_index))
+                    current_index = simplify(current_index + step)
+                return parts
             while True:
                 done = simplify(current_index != stop).value
                 if done is None:

@@ -97,6 +97,11 @@ class GlobalState:
         if annotation.persist_to_world_state:
             self.world_state.annotate(annotation)
 
+    def add_annotations(self, annotations: List[StateAnnotation]) -> None:
+        """Bulk-attach annotations (used when propagating
+        persist_over_calls annotations across frames)."""
+        self._annotations += annotations
+
     @property
     def annotations(self) -> List[StateAnnotation]:
         return self._annotations

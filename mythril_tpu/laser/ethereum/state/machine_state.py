@@ -72,6 +72,7 @@ class MachineState:
         min_gas_used: int = 0,
     ):
         self.pc = pc
+        self.constraints = constraints
         self.stack = MachineStack(stack)
         self.subroutine_stack = MachineStack(subroutine_stack)
         self.memory = memory or Memory()

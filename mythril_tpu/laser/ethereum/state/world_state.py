@@ -133,7 +133,9 @@ class WorldState:
             dynamic_loader=dynamic_loader,
             nonce=nonce,
         )
-        if balance is not None:
+        # truthy check: a concrete 0 / None leaves the balance symbolic
+        # (pinning unknown balances to 0 would prune solvent-sender paths)
+        if balance:
             new_account.set_balance(balance)
         self.put_account(new_account)
         return new_account

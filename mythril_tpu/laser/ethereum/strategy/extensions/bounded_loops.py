@@ -1,0 +1,120 @@
+"""Loop-bound pruning as a decorator strategy.
+
+Reference parity: mythril/laser/ethereum/strategy/extensions/
+bounded_loops.py:13-145 — a `JumpdestCountAnnotation` records the
+trace of executed jumpdest addresses per path; when the tail of the
+trace is a contiguously repeating cycle, the repeat count is measured
+(rolling-hash compare) and states past the bound are skipped. Creation
+transactions get a bound of at least 8 so constructors with loops can
+still deploy.
+"""
+
+from __future__ import annotations
+
+import logging
+from copy import copy
+from typing import Dict, List, cast
+
+from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.strategy import BasicSearchStrategy
+from mythril_tpu.laser.ethereum.transaction import ContractCreationTransaction
+
+log = logging.getLogger(__name__)
+
+
+class JumpdestCountAnnotation(StateAnnotation):
+    """Per-path trace of reached instruction addresses."""
+
+    def __init__(self) -> None:
+        self._reached_count: Dict[int, int] = {}
+        self.trace: List[int] = []
+
+    def __copy__(self):
+        result = JumpdestCountAnnotation()
+        result._reached_count = copy(self._reached_count)
+        result.trace = copy(self.trace)
+        return result
+
+
+class BoundedLoopsStrategy(BasicSearchStrategy):
+    """Skips states whose jumpdest trace ends in > bound repetitions of
+    the same cycle."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy, *args) -> None:
+        self.super_strategy = super_strategy
+        self.bound = args[0][0]
+        log.info(
+            "Loaded search strategy extension: Loop bounds (limit = %d)", self.bound
+        )
+        BasicSearchStrategy.__init__(
+            self, super_strategy.work_list, super_strategy.max_depth
+        )
+
+    @staticmethod
+    def calculate_hash(i: int, j: int, trace: List[int]) -> int:
+        """Pack trace[i:j] into one integer key."""
+        key = 0
+        for itr in range(i, j):
+            key |= trace[itr] << ((itr - i) * 8)
+        return key
+
+    @staticmethod
+    def count_key(trace: List[int], key: int, start: int, size: int) -> int:
+        """Count how many times the cycle `key` repeats contiguously,
+        walking backwards from `start`."""
+        count = 1
+        i = start
+        while i >= 0:
+            if BoundedLoopsStrategy.calculate_hash(i, i + size, trace) != key:
+                break
+            count += 1
+            i -= size
+        return count
+
+    @staticmethod
+    def get_loop_count(trace: List[int]) -> int:
+        """Length of the repeating suffix of the trace, in cycles."""
+        found = False
+        for i in range(len(trace) - 3, 0, -1):
+            if trace[i] == trace[-2] and trace[i + 1] == trace[-1]:
+                found = True
+                break
+        if found:
+            key = BoundedLoopsStrategy.calculate_hash(i + 1, len(trace) - 1, trace)
+            size = len(trace) - i - 2
+            count = BoundedLoopsStrategy.count_key(trace, key, i + 1, size)
+        else:
+            count = 0
+        return count
+
+    def get_strategic_global_state(self) -> GlobalState:
+        while True:
+            state = self.super_strategy.get_strategic_global_state()
+
+            annotations = cast(
+                List[JumpdestCountAnnotation],
+                list(state.get_annotations(JumpdestCountAnnotation)),
+            )
+            if len(annotations) == 0:
+                annotation = JumpdestCountAnnotation()
+                state.annotate(annotation)
+            else:
+                annotation = annotations[0]
+
+            cur_instr = state.get_current_instruction()
+            annotation.trace.append(cur_instr["address"])
+
+            if cur_instr["opcode"].upper() != "JUMPDEST":
+                return state
+
+            count = BoundedLoopsStrategy.get_loop_count(annotation.trace)
+            # give the creation tx a better chance to finish its loops
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ) and count < max(8, self.bound):
+                return state
+            elif count > self.bound:
+                log.debug("Loop bound reached, skipping state")
+                continue
+            return state

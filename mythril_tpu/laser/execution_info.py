@@ -1,0 +1,10 @@
+"""Execution-info record interface (reference:
+mythril/laser/execution_info.py)."""
+
+from abc import ABC, abstractmethod
+
+
+class ExecutionInfo(ABC):
+    @abstractmethod
+    def as_dict(self):
+        """A primitive-types-only dictionary describing this record."""

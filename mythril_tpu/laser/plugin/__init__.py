@@ -1,0 +1,10 @@
+"""Laser plugin runtime (reference: mythril/laser/plugin/)."""
+
+from mythril_tpu.laser.plugin.builder import PluginBuilder
+from mythril_tpu.laser.plugin.interface import LaserPlugin
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+from mythril_tpu.laser.plugin.signals import (
+    PluginSignal,
+    PluginSkipState,
+    PluginSkipWorldState,
+)

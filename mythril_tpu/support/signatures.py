@@ -1,0 +1,185 @@
+"""4-byte function-selector database.
+
+Reference parity: mythril/support/signatures.py:79-276 — a sqlite
+database at ~/.mythril/signatures.db mapping selectors to text
+signatures, a per-run Solidity-source cache, optional 4byte.directory
+online lookup, and a multiprocessing lock around writes (the only
+concurrency guard in the reference, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import re
+import sqlite3
+from collections import defaultdict
+from typing import DefaultDict, List, Set
+
+from mythril_tpu.support.keccak import keccak256
+from mythril_tpu.support.support_utils import Singleton
+
+log = logging.getLogger(__name__)
+
+lock = multiprocessing.Lock()
+
+
+def synchronized(sync_lock):
+    """Decorator synchronizing multi-process DB access."""
+
+    def wrapper(f):
+        def inner_wrapper(*args, **kw):
+            with sync_lock:
+                return f(*args, **kw)
+
+        return inner_wrapper
+
+    return wrapper
+
+
+class SQLiteDB:
+    """Context manager committing at exit."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.conn = None
+        self.cursor = None
+
+    def __enter__(self):
+        self.conn = sqlite3.connect(self.path)
+        self.cursor = self.conn.cursor()
+        return self.cursor
+
+    def __exit__(self, exc_class, exc, traceback):
+        self.conn.commit()
+        self.conn.close()
+
+    def __repr__(self):
+        return f"<SQLiteDB path={self.path}>"
+
+
+class SignatureDB(object, metaclass=Singleton):
+    def __init__(self, enable_online_lookup: bool = False, path: str = None) -> None:
+        self.enable_online_lookup = enable_online_lookup
+        self.online_lookup_miss: Set[str] = set()
+        self.online_lookup_timeout = 0
+        # per-run cache of signatures recovered from Solidity sources
+        self.solidity_sigs: DefaultDict[str, List[str]] = defaultdict(list)
+        if path is None:
+            path = os.environ.get("MYTHRIL_DIR") or os.path.join(
+                os.path.expanduser("~"), ".mythril"
+            )
+        os.makedirs(path, exist_ok=True)
+        self.path = os.path.join(path, "signatures.db")
+
+        log.info("Using signature database at %s", self.path)
+        with SQLiteDB(self.path) as cur:
+            cur.execute(
+                "CREATE TABLE IF NOT EXISTS signatures"
+                "(byte_sig VARCHAR(10), text_sig VARCHAR(255),"
+                "PRIMARY KEY (byte_sig, text_sig))"
+            )
+
+    def __getitem__(self, item: str) -> List[str]:
+        return self.get(byte_sig=item)
+
+    @staticmethod
+    def _normalize_byte_sig(byte_sig: str) -> str:
+        if not byte_sig.startswith("0x"):
+            byte_sig = "0x" + byte_sig
+        if not len(byte_sig) == 10:
+            raise ValueError(
+                "Invalid byte signature %s, must have 10 characters" % byte_sig
+            )
+        return byte_sig
+
+    @synchronized(lock)
+    def add(self, byte_sig: str, text_sig: str) -> None:
+        byte_sig = self._normalize_byte_sig(byte_sig)
+        with SQLiteDB(self.path) as cur:
+            cur.execute(
+                "INSERT OR IGNORE INTO signatures (byte_sig, text_sig) VALUES (?,?)",
+                (byte_sig, text_sig),
+            )
+
+    def get(self, byte_sig: str, online_timeout: int = 2) -> List[str]:
+        """Resolve a selector: solidity-source cache, then sqlite, then
+        (optionally) 4byte.directory."""
+        byte_sig = self._normalize_byte_sig(byte_sig)
+
+        text_sigs = self.solidity_sigs.get(byte_sig)
+        if text_sigs:
+            return text_sigs
+
+        with SQLiteDB(self.path) as cur:
+            cur.execute(
+                "SELECT text_sig FROM signatures WHERE byte_sig=?", (byte_sig,)
+            )
+            text_sigs = [r[0] for r in cur.fetchall()]
+        if text_sigs:
+            return text_sigs
+
+        if not self.enable_online_lookup or byte_sig in self.online_lookup_miss:
+            return []
+        try:
+            online_results = self.lookup_online(byte_sig, timeout=online_timeout)
+        except Exception as e:
+            log.debug("online signature lookup failed: %s", e)
+            return []
+        if not online_results:
+            self.online_lookup_miss.add(byte_sig)
+            return []
+        for sig in online_results:
+            self.add(byte_sig, sig)
+        return online_results
+
+    @staticmethod
+    def lookup_online(byte_sig: str, timeout: int, proxies=None) -> List[str]:
+        """Query 4byte.directory for a selector."""
+        import json
+        import urllib.request
+
+        url = (
+            "https://www.4byte.directory/api/v1/signatures/?hex_signature="
+            + byte_sig
+        )
+        with urllib.request.urlopen(url, timeout=timeout) as response:
+            payload = json.loads(response.read().decode())
+        return [r["text_signature"] for r in payload.get("results", [])]
+
+    def import_solidity_file(
+        self, file_path: str, solc_binary: str = "solc", solc_settings_json: str = None
+    ) -> None:
+        """Harvest function signatures from a Solidity source file by
+        matching declarations textually (canonicalized arg types)."""
+        try:
+            with open(file_path, encoding="utf-8") as f:
+                code = f.read()
+        except OSError as e:
+            log.debug("could not read solidity file: %s", e)
+            return
+
+        funcs = re.findall(
+            r"function\s+([A-Za-z_$][A-Za-z0-9_$]*)\s*\(([^)]*)\)", code
+        )
+        for name, arglist in funcs:
+            types = []
+            for arg in arglist.split(","):
+                arg = arg.strip()
+                if not arg:
+                    continue
+                arg_type = arg.split()[0]
+                # canonical ABI names
+                if arg_type == "uint":
+                    arg_type = "uint256"
+                elif arg_type == "int":
+                    arg_type = "int256"
+                types.append(arg_type)
+            text_sig = "{}({})".format(name, ",".join(types))
+            byte_sig = "0x" + keccak256(text_sig.encode())[:4].hex()
+            self.solidity_sigs[byte_sig].append(text_sig)
+            self.add(byte_sig, text_sig)
+
+    def __repr__(self):
+        return f"<SignatureDB path='{self.path}'>"

@@ -1,0 +1,12 @@
+"""Analysis start-time singleton (reference:
+mythril/support/start_time.py:1-9); Issue.discovery_time is measured
+against it."""
+
+from time import time
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class StartTime(object, metaclass=Singleton):
+    def __init__(self):
+        self.global_start_time = time()

@@ -1,0 +1,133 @@
+"""Precompile vectors (reference test strategy: tests/laser/Precompiles/)."""
+
+import hashlib
+
+import pytest
+
+from mythril_tpu.crypto import bn128
+from mythril_tpu.laser.ethereum import natives
+
+
+def as_words(*ints):
+    out = []
+    for v in ints:
+        out += list(v.to_bytes(32, "big"))
+    return out
+
+
+def test_ecrecover_known_vector():
+    # the canonical CallEcrecover vector from the Ethereum test suite
+    h = bytes.fromhex(
+        "456e9aea5e197a1f1af7a3e85a3212fa4049a3ba34c2289b4c860fc0b0c64ef3"
+    )
+    v = 28
+    r = int("9242685bf161793cc25603c231bc2f568eb630ea16aa137d2664ac8038825608", 16)
+    s = int("4f8ae3bd7535248d0bd448298cc2e2071e56992d0774dc340c368ae950852ada", 16)
+    data = list(h) + as_words(v, r, s)
+    out = natives.ecrecover(data)
+    assert bytes(out[12:]).hex() == "7156526fbd7a3c72969b54f64e42c10fbb768c8a"
+    assert out[:12] == [0] * 12
+
+
+def test_ecrecover_invalid_v_returns_empty():
+    assert natives.ecrecover([0] * 32 + as_words(26, 1, 1)) == []
+
+
+def test_sha256_matches_hashlib():
+    data = list(b"hello world")
+    assert bytes(natives.sha256(data)) == hashlib.sha256(b"hello world").digest()
+
+
+def test_ripemd160_padded_to_32():
+    out = natives.ripemd160(list(b"abc"))
+    assert len(out) == 32
+    assert out[:12] == [0] * 12
+    assert (
+        bytes(out[12:]).hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+    )
+
+
+def test_identity():
+    assert natives.identity([1, 2, 3]) == [1, 2, 3]
+
+
+def test_mod_exp_simple():
+    # 3^5 mod 7 = 5
+    data = as_words(1, 1, 1) + [3, 5, 7]
+    assert natives.mod_exp(data) == [5]
+
+
+def test_mod_exp_zero_modulus():
+    data = as_words(1, 1, 1) + [3, 5, 0]
+    assert natives.mod_exp(data) == [0]
+
+
+def test_ec_add_doubles_generator():
+    data = as_words(1, 2, 1, 2)
+    out = natives.ec_add(data)
+    x = int.from_bytes(bytes(out[:32]), "big")
+    y = int.from_bytes(bytes(out[32:]), "big")
+    expected = bn128.double(bn128.G1)
+    assert (x, y) == (expected[0].n, expected[1].n)
+
+
+def test_ec_add_identity():
+    data = as_words(1, 2, 0, 0)
+    out = natives.ec_add(data)
+    assert int.from_bytes(bytes(out[:32]), "big") == 1
+    assert int.from_bytes(bytes(out[32:]), "big") == 2
+
+
+def test_ec_mul_matches_add():
+    data = as_words(1, 2, 2)
+    out = natives.ec_mul(data)
+    doubled = natives.ec_add(as_words(1, 2, 1, 2))
+    assert out == doubled
+
+
+def test_ec_mul_invalid_point():
+    assert natives.ec_mul(as_words(1, 3, 2)) == []
+
+
+def test_ec_pair_empty_input_is_one():
+    assert natives.ec_pair([]) == [0] * 31 + [1]
+
+
+def test_ec_pair_bilinear():
+    # e(G1, G2) * e(-G1, G2) == 1
+    g2 = (
+        bn128.G2[0].coeffs,
+        bn128.G2[1].coeffs,
+    )
+    neg_g1_y = bn128.field_modulus - 2
+    pairs = as_words(
+        1, 2, g2[0][1], g2[0][0], g2[1][1], g2[1][0],
+        1, neg_g1_y, g2[0][1], g2[0][0], g2[1][1], g2[1][0],
+    )
+    assert natives.ec_pair(pairs) == [0] * 31 + [1]
+
+
+def test_ec_pair_bad_length():
+    assert natives.ec_pair([0] * 100) == []
+
+
+def test_blake2b_eip152_vector():
+    # EIP-152 test vector 5: F(blake2b-IV-with-params, "abc", t=3, final)
+    rounds = (12).to_bytes(4, "big")
+    h = bytes.fromhex(
+        "48c9bdf267e6096a3ba7ca8485ae67bb2bf894fe72f36e3cf1361d5f3af54fa5"
+        "d182e6ad7f520e511f6c3e2b8c68059b6bbd41fbabd9831f79217e1319cde05b"
+    )
+    m = b"abc" + b"\x00" * 125
+    t = (3).to_bytes(8, "little") + (0).to_bytes(8, "little")
+    raw = rounds + h + m + t + b"\x01"
+    assert len(raw) == 213
+    out = natives.blake2b_fcompress(list(raw))
+    assert bytes(out).hex() == (
+        "ba80a53f981c4d0d6a2797b69f12f6e94c212f14685ac4b74b12bb6fdbffa2d1"
+        "7d87c5392aab792dc252d5de4533cc9518d38aa8dbf1925ab92386edd4009923"
+    )
+
+
+def test_blake2b_bad_length():
+    assert natives.blake2b_fcompress([0] * 100) == []

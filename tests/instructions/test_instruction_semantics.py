@@ -1,0 +1,249 @@
+"""Instruction-handler unit tests on hand-built GlobalStates
+(reference test strategy: tests/instructions/)."""
+
+import pytest
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.ethereum.evm_exceptions import WriteProtection
+from mythril_tpu.laser.ethereum.instructions import Instruction
+from mythril_tpu.laser.ethereum.state.account import Account
+from mythril_tpu.laser.ethereum.state.calldata import ConcreteCalldata
+from mythril_tpu.laser.ethereum.state.environment import Environment
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.state.machine_state import MachineState
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    MessageCallTransaction,
+)
+from mythril_tpu.laser.smt import symbol_factory
+
+
+def make_state(code_hex="60006000", static=False):
+    world_state = WorldState()
+    account = world_state.create_account(balance=10, address=101)
+    account.code = Disassembly(code_hex)
+    environment = Environment(
+        account,
+        symbol_factory.BitVecVal(0xABC, 256),
+        ConcreteCalldata("1", []),
+        symbol_factory.BitVecVal(1, 256),
+        symbol_factory.BitVecVal(0, 256),
+        symbol_factory.BitVecVal(0xABC, 256),
+        static=static,
+    )
+    state = GlobalState(world_state, environment, None, MachineState(gas_limit=8000000))
+    state.transaction_stack.append(
+        (
+            MessageCallTransaction(
+                world_state=world_state,
+                gas_limit=8000000,
+                identifier="1",
+                callee_account=account,
+                caller=environment.sender,
+                call_value=0,
+            ),
+            None,
+        )
+    )
+    return state
+
+
+def bv(v, w=256):
+    return symbol_factory.BitVecVal(v, w)
+
+
+def run_op(state, op):
+    return Instruction(op, None).evaluate(state)[0]
+
+
+def test_add_wraps():
+    state = make_state()
+    state.mstate.stack.append(bv(2**256 - 1))
+    state.mstate.stack.append(bv(2))
+    out = run_op(state, "ADD")
+    assert out.mstate.stack[-1].value == 1
+
+
+def test_sub_order():
+    state = make_state()
+    state.mstate.stack.append(bv(3))
+    state.mstate.stack.append(bv(10))
+    out = run_op(state, "SUB")
+    assert out.mstate.stack[-1].value == 7
+
+
+def test_div_by_zero():
+    state = make_state()
+    state.mstate.stack.append(bv(0))
+    state.mstate.stack.append(bv(5))
+    out = run_op(state, "DIV")
+    assert out.mstate.stack[-1].value == 0
+
+
+def test_sdiv_signed():
+    state = make_state()
+    state.mstate.stack.append(bv(2))
+    state.mstate.stack.append(bv(2**256 - 4))  # -4
+    out = run_op(state, "SDIV")
+    assert out.mstate.stack[-1].value == 2**256 - 2  # -2
+
+
+def test_byte_extracts():
+    state = make_state()
+    state.mstate.stack.append(bv(0xAABBCC))
+    state.mstate.stack.append(bv(29))  # byte 29 (0-indexed from MSB)
+    out = run_op(state, "BYTE")
+    assert out.mstate.stack[-1].value == 0xAA
+
+
+def test_byte_out_of_range():
+    state = make_state()
+    state.mstate.stack.append(bv(0xAABBCC))
+    state.mstate.stack.append(bv(40))
+    out = run_op(state, "BYTE")
+    assert out.mstate.stack[-1].value == 0
+
+
+def test_shl_shr_sar():
+    state = make_state()
+    state.mstate.stack.append(bv(1))
+    state.mstate.stack.append(bv(4))
+    assert run_op(state, "SHL").mstate.stack.pop().value == 16
+
+    state.mstate.stack.append(bv(16))
+    state.mstate.stack.append(bv(4))
+    assert run_op(state, "SHR").mstate.stack.pop().value == 1
+
+    state.mstate.stack.append(bv(2**256 - 16))  # -16
+    state.mstate.stack.append(bv(2))
+    assert run_op(state, "SAR").mstate.stack.pop().value == 2**256 - 4
+
+
+def test_signextend():
+    state = make_state()
+    state.mstate.stack.append(bv(0xFF))
+    state.mstate.stack.append(bv(0))
+    out = run_op(state, "SIGNEXTEND")
+    assert out.mstate.stack[-1].value == 2**256 - 1
+
+
+def test_iszero():
+    state = make_state()
+    state.mstate.stack.append(bv(0))
+    assert run_op(state, "ISZERO").mstate.stack.pop().value == 1
+    state.mstate.stack.append(bv(7))
+    assert run_op(state, "ISZERO").mstate.stack.pop().value == 0
+
+
+def test_exp_concrete():
+    state = make_state()
+    state.mstate.stack.append(bv(10))  # exponent
+    state.mstate.stack.append(bv(2))  # base
+    out = run_op(state, "EXP")
+    assert out.mstate.stack[-1].value == 1024
+
+
+def test_addmod_mulmod():
+    state = make_state()
+    state.mstate.stack.append(bv(7))
+    state.mstate.stack.append(bv(6))
+    state.mstate.stack.append(bv(5))
+    assert run_op(state, "ADDMOD").mstate.stack.pop().value == (5 + 6) % 7
+
+    state.mstate.stack.append(bv(7))
+    state.mstate.stack.append(bv(6))
+    state.mstate.stack.append(bv(5))
+    assert run_op(state, "MULMOD").mstate.stack.pop().value == (5 * 6) % 7
+
+
+def test_sstore_in_static_call_raises():
+    state = make_state(static=True)
+    state.mstate.stack.append(bv(1))
+    state.mstate.stack.append(bv(0))
+    with pytest.raises(WriteProtection):
+        Instruction("SSTORE", None).evaluate(state)
+
+
+def test_sload_after_sstore():
+    state = make_state()
+    state.mstate.stack.append(bv(42))  # value
+    state.mstate.stack.append(bv(3))  # key
+    out = run_op(state, "SSTORE")
+    out.mstate.stack.append(bv(3))
+    out2 = run_op(out, "SLOAD")
+    assert out2.mstate.stack[-1].value == 42
+
+
+def test_mstore_mload_roundtrip():
+    state = make_state()
+    state.mstate.stack.append(bv(0xDEADBEEF))  # value
+    state.mstate.stack.append(bv(64))  # offset
+    out = run_op(state, "MSTORE")
+    out.mstate.stack.append(bv(64))
+    out2 = run_op(out, "MLOAD")
+    assert out2.mstate.stack[-1].value == 0xDEADBEEF
+
+
+def test_mstore8():
+    state = make_state()
+    state.mstate.stack.append(bv(0x1234))  # only low byte written
+    state.mstate.stack.append(bv(10))
+    out = run_op(state, "MSTORE8")
+    assert out.mstate.memory[10] == 0x34
+
+
+def test_dup_swap():
+    state = make_state()
+    state.mstate.stack.append(bv(1))
+    state.mstate.stack.append(bv(2))
+    out = run_op(state, "DUP2")
+    assert out.mstate.stack[-1].value == 1
+
+    out.mstate.stack.pop()
+    out2 = run_op(out, "SWAP1")
+    assert out2.mstate.stack[-1].value == 1
+    assert out2.mstate.stack[-2].value == 2
+
+
+def test_stack_ops_increment_pc():
+    state = make_state()
+    state.mstate.stack.append(bv(5))
+    pc_before = state.mstate.pc
+    out = run_op(state, "POP")
+    assert out.mstate.pc == pc_before + 1
+
+
+def test_sha3_concrete():
+    from mythril_tpu.support.keccak import keccak256
+
+    state = make_state()
+    # store a known word, hash 32 bytes at offset 0
+    state.mstate.stack.append(bv(1))
+    state.mstate.stack.append(bv(0))
+    out = run_op(state, "MSTORE")
+    out.mstate.stack.append(bv(32))  # length
+    out.mstate.stack.append(bv(0))  # offset
+    out2 = run_op(out, "SHA3")
+    expected = int.from_bytes(keccak256((1).to_bytes(32, "big")), "big")
+    assert out2.mstate.stack[-1].value == expected
+
+
+def test_jumpi_forks_two_states():
+    # 6000 35 600a 57 00 ... 5b 00  (CALLDATALOAD cond -> JUMPI)
+    from mythril_tpu.laser.ethereum.state.calldata import SymbolicCalldata
+
+    code = "6000356008575b00"
+    state = make_state(code)
+    state.environment.calldata = SymbolicCalldata("1")
+    cond = state.environment.calldata.get_word_at(0)
+    state.mstate.stack.append(cond)  # condition (symbolic)
+    state.mstate.stack.append(bv(5))  # dest -> address 5? adjust below
+    # find the JUMPDEST address from the disassembly
+    dest = None
+    for ins in state.environment.code.instruction_list:
+        if ins["opcode"] == "JUMPDEST":
+            dest = ins["address"]
+    state.mstate.stack.pop()
+    state.mstate.stack.append(bv(dest))
+    states = Instruction("JUMPI", None).evaluate(state)
+    assert len(states) == 2
