@@ -1,0 +1,15 @@
+"""Helpers for detection modules (reference:
+mythril/analysis/module/module_helpers.py)."""
+
+import traceback
+
+
+def is_prehook() -> bool:
+    """True when called from inside the engine's pre-hook dispatch.
+
+    Same stack-inspection trick as the reference, made robust to call
+    depth by scanning the recent frames instead of one fixed offset
+    (the post-hook dispatcher's name contains "post_hook", never
+    "pre_hook", so the scan cannot misfire).
+    """
+    return any("pre_hook" in frame for frame in traceback.format_stack()[-6:])

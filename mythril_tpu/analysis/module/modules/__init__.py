@@ -1,0 +1,2 @@
+"""The built-in detection modules (reference:
+mythril/analysis/module/modules/)."""

@@ -1,0 +1,89 @@
+"""SWC-105: anyone can profitably withdraw Ether.
+
+Reference parity: mythril/analysis/module/modules/ether_thief.py:27-102.
+The property: there is a valid end state where the attacker's balance
+exceeds their starting balance, with the attacker as sender.
+"""
+
+from __future__ import annotations
+
+import logging
+from copy import copy
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import UNPROTECTED_ETHER_WITHDRAWAL
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
+from mythril_tpu.laser.smt import UGT
+
+log = logging.getLogger(__name__)
+
+
+class EtherThief(DetectionModule):
+    """Searches for cases where Ether can be withdrawn to a
+    user-specified address."""
+
+    name = "Any sender can withdraw ETH from the contract account"
+    swc_id = UNPROTECTED_ETHER_WITHDRAWAL
+    description = (
+        "Search for cases where Ether can be withdrawn to a user-specified"
+        " address. An issue is reported if there is a valid end state where"
+        " the attacker has successfully increased their Ether balance."
+    )
+    entry_point = EntryPoint.CALLBACK
+    post_hooks = ["CALL", "STATICCALL"]
+
+    def _execute(self, state: GlobalState) -> None:
+        if state.get_current_instruction()["address"] in self.cache:
+            return
+        potential_issues = self._analyze_state(state)
+        annotation = get_potential_issues_annotation(state)
+        annotation.potential_issues.extend(potential_issues)
+
+    def _analyze_state(self, state):
+        state = copy(state)
+        instruction = state.get_current_instruction()
+
+        constraints = copy(state.world_state.constraints)
+        constraints += [
+            UGT(
+                state.world_state.balances[ACTORS.attacker],
+                state.world_state.starting_balances[ACTORS.attacker],
+            ),
+            state.environment.sender == ACTORS.attacker,
+            state.current_transaction.caller == state.current_transaction.origin,
+        ]
+
+        try:
+            # pre-solve: only raise a potential issue when the attacker
+            # profit property is satisfiable on this path
+            solver.get_model(constraints)
+
+            potential_issue = PotentialIssue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                # post hook: report the offset of the CALL itself
+                address=instruction["address"] - 1,
+                swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
+                title="Unprotected Ether Withdrawal",
+                severity="High",
+                bytecode=state.environment.code.bytecode,
+                description_head="Any sender can withdraw Ether from the contract account.",
+                description_tail="Arbitrary senders other than the contract creator can profitably extract Ether "
+                "from the contract account. Verify the business logic carefully and make sure that appropriate "
+                "security controls are in place to prevent unexpected loss of funds.",
+                detector=self,
+                constraints=constraints,
+            )
+            return [potential_issue]
+        except UnsatError:
+            return []
+
+
+detector = EtherThief()

@@ -1,0 +1,43 @@
+"""Run detection modules over a finished analysis.
+
+Reference parity: mythril/analysis/security.py:15-46 —
+`retrieve_callback_issues` collects what the hook-driven modules found
+during execution; `fire_lasers` additionally runs POST modules over
+the statespace.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from mythril_tpu.analysis.module import ModuleLoader, reset_callback_modules
+from mythril_tpu.analysis.module.base import EntryPoint
+from mythril_tpu.analysis.report import Issue
+
+log = logging.getLogger(__name__)
+
+
+def retrieve_callback_issues(white_list: Optional[List[str]] = None) -> List[Issue]:
+    """Issues discovered by callback detection modules during the run."""
+    issues: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.CALLBACK, white_list=white_list
+    ):
+        log.debug("Retrieving results for %s", module.name)
+        issues += module.issues
+    reset_callback_modules(module_names=white_list)
+    return issues
+
+
+def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issue]:
+    """Run POST modules over the statespace and collect all issues."""
+    log.info("Starting analysis")
+    issues: List[Issue] = []
+    for module in ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.POST, white_list=white_list
+    ):
+        log.info("Executing %s", module.name)
+        issues += module.execute(statespace)
+    issues += retrieve_callback_issues(white_list)
+    return issues

@@ -1,0 +1,255 @@
+"""SymExecWrapper: configure and run LASER for analysis.
+
+Reference parity: mythril/analysis/symbolic.py:39-307 — strategy
+selection, bounded-loops extension, plugin loading, creator/attacker
+accounts, detection-module hook registration, `sym_exec`, and the
+post-run extraction of `Call` records for POST modules.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Type, Union
+
+from mythril_tpu.analysis.module import (
+    EntryPoint,
+    ModuleLoader,
+    get_detection_module_hooks,
+)
+from mythril_tpu.analysis.ops import Call, VarType, get_variable
+from mythril_tpu.laser.ethereum import svm
+from mythril_tpu.laser.ethereum.natives import PRECOMPILE_COUNT
+from mythril_tpu.laser.ethereum.state.account import Account
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.strategy.basic import (
+    BasicSearchStrategy,
+    BreadthFirstSearchStrategy,
+    DepthFirstSearchStrategy,
+    ReturnRandomNaivelyStrategy,
+    ReturnWeightedRandomStrategy,
+)
+from mythril_tpu.laser.ethereum.strategy.extensions.bounded_loops import (
+    BoundedLoopsStrategy,
+)
+from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
+from mythril_tpu.laser.execution_info import ExecutionInfo
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+from mythril_tpu.laser.plugin.plugins import (
+    CallDepthLimitBuilder,
+    CoveragePluginBuilder,
+    DependencyPrunerBuilder,
+    InstructionProfilerBuilder,
+    MutationPrunerBuilder,
+)
+from mythril_tpu.laser.smt import BitVec, symbol_factory
+from mythril_tpu.support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+class SymExecWrapper:
+    """Symbolically executes a contract and pre-digests the statespace
+    for the analysis layer."""
+
+    def __init__(
+        self,
+        contract,
+        address: Union[int, str, BitVec],
+        strategy: str,
+        dynloader=None,
+        max_depth: int = 22,
+        execution_timeout: Optional[int] = None,
+        loop_bound: int = 3,
+        create_timeout: Optional[int] = None,
+        transaction_count: int = 2,
+        modules: Optional[List[str]] = None,
+        compulsory_statespace: bool = True,
+        disable_dependency_pruning: bool = False,
+        run_analysis_modules: bool = True,
+        custom_modules_directory: str = "",
+    ):
+        if isinstance(address, str):
+            address = symbol_factory.BitVecVal(int(address, 16), 256)
+        if isinstance(address, int):
+            address = symbol_factory.BitVecVal(address, 256)
+
+        if strategy == "dfs":
+            s_strategy: Type[BasicSearchStrategy] = DepthFirstSearchStrategy
+        elif strategy == "bfs":
+            s_strategy = BreadthFirstSearchStrategy
+        elif strategy == "naive-random":
+            s_strategy = ReturnRandomNaivelyStrategy
+        elif strategy == "weighted-random":
+            s_strategy = ReturnWeightedRandomStrategy
+        else:
+            raise ValueError("Invalid strategy argument supplied")
+
+        creator_account = Account(
+            hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
+        )
+        attacker_account = Account(
+            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
+        )
+
+        requires_statespace = (
+            compulsory_statespace
+            or len(ModuleLoader().get_detection_modules(EntryPoint.POST, modules)) > 0
+        )
+        has_creation_code = bool(getattr(contract, "creation_code", None))
+        if not has_creation_code:
+            self.accounts = {hex(ACTORS.attacker.value): attacker_account}
+        else:
+            self.accounts = {
+                hex(ACTORS.creator.value): creator_account,
+                hex(ACTORS.attacker.value): attacker_account,
+            }
+
+        self.laser = svm.LaserEVM(
+            dynamic_loader=dynloader,
+            max_depth=max_depth,
+            execution_timeout=execution_timeout,
+            strategy=s_strategy,
+            create_timeout=create_timeout,
+            transaction_count=transaction_count,
+            requires_statespace=requires_statespace,
+        )
+
+        if loop_bound is not None:
+            self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
+
+        plugin_loader = LaserPluginLoader()
+        plugin_loader.load(CoveragePluginBuilder())
+        plugin_loader.load(MutationPrunerBuilder())
+        plugin_loader.load(CallDepthLimitBuilder())
+        if args.iprof:
+            plugin_loader.load(InstructionProfilerBuilder())
+        plugin_loader.add_args(
+            "call-depth-limit", call_depth_limit=args.call_depth_limit
+        )
+        if not disable_dependency_pruning:
+            plugin_loader.load(DependencyPrunerBuilder())
+        plugin_loader.instrument_virtual_machine(self.laser, None)
+
+        world_state = WorldState()
+        for account in self.accounts.values():
+            world_state.put_account(account)
+
+        if run_analysis_modules:
+            analysis_modules = ModuleLoader().get_detection_modules(
+                EntryPoint.CALLBACK, modules
+            )
+            self.laser.register_hooks(
+                hook_type="pre",
+                hook_dict=get_detection_module_hooks(
+                    analysis_modules, hook_type="pre"
+                ),
+            )
+            self.laser.register_hooks(
+                hook_type="post",
+                hook_dict=get_detection_module_hooks(
+                    analysis_modules, hook_type="post"
+                ),
+            )
+
+        if has_creation_code:
+            self.laser.sym_exec(
+                creation_code=contract.creation_code,
+                contract_name=contract.name,
+                world_state=world_state,
+            )
+        else:
+            account = Account(
+                address,
+                contract.disassembly,
+                dynamic_loader=dynloader,
+                contract_name=contract.name,
+                balances=world_state.balances,
+                concrete_storage=True
+                if (dynloader is not None and dynloader.active)
+                else False,
+            )
+            if dynloader is not None:
+                try:
+                    _balance = dynloader.read_balance(
+                        "{0:#0{1}x}".format(address.value, 42)
+                    )
+                    account.set_balance(_balance)
+                except Exception:
+                    pass  # balance stays symbolic
+            world_state.put_account(account)
+            self.laser.sym_exec(world_state=world_state, target_address=address.value)
+
+        if not requires_statespace:
+            return
+
+        self.nodes = self.laser.nodes
+        self.edges = self.laser.edges
+
+        # pre-digest CALL-family operations for POST modules
+        self.calls: List[Call] = []
+        for key in self.nodes:
+            state_index = 0
+            for state in self.nodes[key].states:
+                try:
+                    instruction = state.get_current_instruction()
+                except IndexError:
+                    state_index += 1
+                    continue
+                op = instruction["opcode"]
+                if op in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
+                    stack = state.mstate.stack
+                    if op in ("CALL", "CALLCODE"):
+                        gas, to, value, meminstart, meminsz = (
+                            get_variable(stack[-1]),
+                            get_variable(stack[-2]),
+                            get_variable(stack[-3]),
+                            get_variable(stack[-4]),
+                            get_variable(stack[-5]),
+                        )
+                        if (
+                            to.type == VarType.CONCRETE
+                            and 0 < to.val <= PRECOMPILE_COUNT
+                        ):
+                            # skip precompile calls
+                            state_index += 1
+                            continue
+                        if (
+                            meminstart.type == VarType.CONCRETE
+                            and meminsz.type == VarType.CONCRETE
+                        ):
+                            self.calls.append(
+                                Call(
+                                    self.nodes[key],
+                                    state,
+                                    state_index,
+                                    op,
+                                    to,
+                                    gas,
+                                    value,
+                                    state.mstate.memory[
+                                        meminstart.val : meminsz.val + meminstart.val
+                                    ],
+                                )
+                            )
+                        else:
+                            self.calls.append(
+                                Call(
+                                    self.nodes[key],
+                                    state,
+                                    state_index,
+                                    op,
+                                    to,
+                                    gas,
+                                    value,
+                                )
+                            )
+                    else:
+                        gas, to = get_variable(stack[-1]), get_variable(stack[-2])
+                        self.calls.append(
+                            Call(self.nodes[key], state, state_index, op, to, gas)
+                        )
+                state_index += 1
+
+    @property
+    def execution_info(self) -> List[ExecutionInfo]:
+        return self.laser.execution_info

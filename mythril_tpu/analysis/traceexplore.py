@@ -1,0 +1,131 @@
+"""Serializable statespace dump for `myth analyze -j/--statespace-json`.
+
+Reference parity: mythril/analysis/traceexplore.py:52 — nodes with
+per-state machine/account snapshots, edges with branch-condition
+labels.
+"""
+
+from __future__ import annotations
+
+import re
+
+from mythril_tpu.laser.ethereum.cfg import NodeFlags
+from mythril_tpu.laser.smt import simplify
+
+colors = [
+    {
+        "border": "#26996f",
+        "background": "#2f7e5b",
+        "highlight": {"border": "#fff", "background": "#28a16f"},
+    },
+    {
+        "border": "#9e42b3",
+        "background": "#842899",
+        "highlight": {"border": "#fff", "background": "#933da6"},
+    },
+    {
+        "border": "#b82323",
+        "background": "#991d1d",
+        "highlight": {"border": "#fff", "background": "#a61f1f"},
+    },
+    {
+        "border": "#4753bf",
+        "background": "#3b46a1",
+        "highlight": {"border": "#fff", "background": "#424db3"},
+    },
+]
+
+
+def get_serializable_statespace(statespace) -> dict:
+    """Convert a finished statespace into JSON-ready nodes and edges."""
+    nodes = []
+    edges = []
+
+    color_map = {}
+    i = 0
+    for k in statespace.accounts:
+        color_map[statespace.accounts[k].contract_name] = colors[i % len(colors)]
+        i += 1
+
+    for node_key in statespace.nodes:
+        node = statespace.nodes[node_key]
+
+        code = node.get_cfg_dict()["code"]
+        code = re.sub("([0-9a-f]{8})[0-9a-f]+", lambda m: m.group(1) + "(...)", code)
+        if NodeFlags.FUNC_ENTRY in node.flags:
+            code = re.sub("JUMPDEST", node.function_name, code)
+        code_split = code.split("\\n")
+
+        truncated_code = (
+            code
+            if (len(code_split) < 7)
+            else "\\n".join(code_split[:6]) + "\\n(click to expand +)"
+        )
+        try:
+            color = color_map[node.get_cfg_dict()["contract_name"]]
+        except KeyError:
+            color = colors[i % len(colors)]
+            i += 1
+            color_map[node.get_cfg_dict()["contract_name"]] = color
+
+        def get_state_accounts(node_state):
+            state_accounts = []
+            for key in node_state.accounts:
+                account = node_state.accounts[key].as_dict
+                account.pop("code", None)
+                account["balance"] = str(account["balance"])
+
+                storage = {}
+                for storage_key in account["storage"].printable_storage:
+                    storage[str(storage_key)] = str(account["storage"][storage_key])
+                state_accounts.append({"address": key, "storage": storage})
+            return state_accounts
+
+        states = []
+        for x in node.states:
+            machine = x.mstate.as_dict
+            machine["stack"] = [str(s) for s in machine["stack"]]
+            memory = machine.pop("memory")
+            machine["memory"] = [
+                str(memory[idx]) for idx in range(min(len(memory), 128))
+            ]
+            states.append(
+                {"machine": machine, "accounts": get_state_accounts(x)}
+            )
+
+        truncated_code = truncated_code.replace("\\n", "\n")
+        code = code.replace("\\n", "\n")
+
+        nodes.append(
+            {
+                "id": str(node_key),
+                "func": str(node.function_name),
+                "label": truncated_code,
+                "code": code,
+                "truncated": truncated_code,
+                "states": states,
+                "color": color,
+                "instructions": code.split("\n"),
+            }
+        )
+
+    for edge in statespace.edges:
+        if edge.condition is None:
+            label = ""
+        else:
+            label = str(simplify(edge.condition)).replace("\n", "")
+        label = re.sub(
+            r"([^_])([\d]{2}\d+)", lambda m: m.group(1) + hex(int(m.group(2))), label
+        )
+
+        edges.append(
+            {
+                "from": str(edge.as_dict["from"]),
+                "to": str(edge.as_dict["to"]),
+                "arrows": "to",
+                "label": label,
+                "smooth": {"type": "cubicBezier"},
+            }
+        )
+
+    return {"edges": edges, "nodes": nodes}

@@ -101,5 +101,6 @@ class Edge:
     def __hash__(self) -> int:
         return hash((self.node_from, self.node_to, self.type))
 
+    @property
     def as_dict(self) -> dict:
         return {"from": self.node_from, "to": self.node_to}

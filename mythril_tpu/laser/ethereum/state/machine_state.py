@@ -136,6 +136,20 @@ class MachineState:
         return len(self.memory)
 
     @property
+    def as_dict(self):
+        """Serializable view (reference: machine_state.py:250) used by
+        the statespace dump."""
+        return dict(
+            pc=self.pc,
+            stack=self.stack,
+            memory=self.memory,
+            memsize=self.memory_size,
+            gas=self.gas_limit,
+            max_gas_used=self.max_gas_used,
+            min_gas_used=self.min_gas_used,
+        )
+
+    @property
     def memory_dict(self):
         return self.memory
 

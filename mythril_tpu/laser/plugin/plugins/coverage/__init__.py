@@ -1,0 +1,7 @@
+from mythril_tpu.laser.plugin.plugins.coverage.coverage_plugin import (
+    CoveragePluginBuilder,
+    InstructionCoveragePlugin,
+)
+from mythril_tpu.laser.plugin.plugins.coverage.coverage_strategy import (
+    CoverageStrategy,
+)

@@ -1,0 +1,103 @@
+"""Per-opcode wall-time profiler.
+
+Reference parity: mythril/laser/plugin/plugins/instruction_profiler.py
+:41-121, with one deliberate divergence: the reference's builder
+declares `plugin_name = "dependency-pruner"` (a name collision the
+survey flags as a bug, SURVEY.md §2.1); here it is
+"instruction-profiler" so both plugins can load together.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+from datetime import datetime
+from typing import Dict, Tuple
+
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.plugin.builder import PluginBuilder
+from mythril_tpu.laser.plugin.interface import LaserPlugin
+
+_InstrExecRecord = namedtuple("_InstrExecRecord", ["start_time", "end_time"])
+_InstrExecStatistic = namedtuple(
+    "_InstrExecStatistic", ["total_time", "total_nr", "min_time", "max_time"]
+)
+
+log = logging.getLogger(__name__)
+
+
+class InstructionProfilerBuilder(PluginBuilder):
+    plugin_name = "instruction-profiler"
+
+    def __call__(self, *args, **kwargs):
+        return InstructionProfiler()
+
+
+class InstructionProfiler(LaserPlugin):
+    """Wall-time per opcode via all-opcode pre/post instruction hooks;
+    summary logged at stop_sym_exec."""
+
+    def __init__(self):
+        self._reset()
+
+    def _reset(self):
+        self.records = dict()
+        self.start_time = None
+
+    def initialize(self, symbolic_vm) -> None:
+        @symbolic_vm.instr_hook("pre", None)
+        def get_start_time(op_code: str):
+            def start_time_wrapper(global_state: GlobalState):
+                self.start_time = datetime.now()
+
+            return start_time_wrapper
+
+        @symbolic_vm.instr_hook("post", None)
+        def record(op_code: str):
+            def record_opcode(global_state: GlobalState):
+                end_time = datetime.now()
+                self.records.setdefault(op_code, []).append(
+                    _InstrExecRecord(self.start_time, end_time)
+                )
+
+            return record_opcode
+
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def print_stats():
+            total, stats = self._make_stats()
+            if not total:
+                return
+            s = "Total: {} s\n".format(total)
+            for op in sorted(stats):
+                stat = stats[op]
+                s += (
+                    "[{:12s}] {:>8.4f} %,  nr {:>6},  total {:>8.4f} s,"
+                    "  avg {:>8.4f} s,  min {:>8.4f} s,  max {:>8.4f} s\n"
+                ).format(
+                    op,
+                    stat.total_time * 100 / total,
+                    stat.total_nr,
+                    stat.total_time,
+                    stat.total_time / stat.total_nr,
+                    stat.min_time,
+                    stat.max_time,
+                )
+            log.info(s)
+
+    def _make_stats(self) -> Tuple[float, Dict]:
+        periods = {
+            op: [r.end_time.timestamp() - r.start_time.timestamp() for r in rs]
+            for op, rs in self.records.items()
+        }
+        stats = dict()
+        total_time = 0.0
+        for op, times in periods.items():
+            stat = _InstrExecStatistic(
+                total_time=sum(times),
+                total_nr=len(times),
+                min_time=min(times),
+                max_time=max(times),
+            )
+            total_time += stat.total_time
+            stats[op] = stat
+        return total_time, stats

@@ -1,0 +1,74 @@
+"""Mutation pruner: skip "clean" transactions.
+
+Reference parity: mythril/laser/plugin/plugins/mutation_pruner.py:22-89.
+If a symbolic transaction T from world state S neither mutates state
+nor can carry a positive call value, then its end state S' is
+equivalent to S for analysis purposes and is dropped.
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.analysis import solver
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    ContractCreationTransaction,
+)
+from mythril_tpu.laser.plugin.builder import PluginBuilder
+from mythril_tpu.laser.plugin.interface import LaserPlugin
+from mythril_tpu.laser.plugin.plugins.plugin_annotations import MutationAnnotation
+from mythril_tpu.laser.plugin.signals import PluginSkipWorldState
+from mythril_tpu.laser.smt import UGT, symbol_factory
+from mythril_tpu.support.model import get_model
+
+
+class MutationPrunerBuilder(PluginBuilder):
+    plugin_name = "mutation-pruner"
+
+    def __call__(self, *args, **kwargs):
+        return MutationPruner()
+
+
+class MutationPruner(LaserPlugin):
+    """Annotates mutating opcodes; filters end states with no mutation
+    and a provably-zero call value."""
+
+    def initialize(self, symbolic_vm) -> None:
+        @symbolic_vm.pre_hook("SSTORE")
+        def sstore_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("CALL")
+        def call_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("STATICCALL")
+        def staticcall_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.laser_hook("add_world_state")
+        def world_state_filter_hook(global_state: GlobalState):
+            if isinstance(
+                global_state.current_transaction, ContractCreationTransaction
+            ):
+                return
+
+            if isinstance(global_state.environment.callvalue, int):
+                callvalue = symbol_factory.BitVecVal(
+                    global_state.environment.callvalue, 256
+                )
+            else:
+                callvalue = global_state.environment.callvalue
+
+            try:
+                constraints = global_state.world_state.constraints + [
+                    UGT(callvalue, symbol_factory.BitVecVal(0, 256))
+                ]
+                get_model(constraints)
+                # a positive value transfer is possible: balances mutate
+                return
+            except UnsatError:
+                pass
+
+            if len(list(global_state.get_annotations(MutationAnnotation))) == 0:
+                raise PluginSkipWorldState

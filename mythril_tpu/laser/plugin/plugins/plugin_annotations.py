@@ -1,0 +1,67 @@
+"""State annotations shared by the pruner plugins.
+
+Reference parity: mythril/laser/plugin/plugins/plugin_annotations.py:1-69.
+"""
+
+from __future__ import annotations
+
+from copy import copy
+from typing import Dict, List, Set
+
+from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
+
+
+class MutationAnnotation(StateAnnotation):
+    """Marks a state that executed a mutating instruction (SSTORE /
+    CALL / STATICCALL); survives across call frames."""
+
+    def __init__(self):
+        pass
+
+    @property
+    def persist_over_calls(self) -> bool:
+        return True
+
+
+class DependencyAnnotation(StateAnnotation):
+    """Tracks storage reads/writes and the block path per transaction."""
+
+    def __init__(self):
+        self.storage_loaded: List = []
+        self.storage_written: Dict[int, List] = {}
+        self.has_call: bool = False
+        self.path: List = [0]
+        self.blocks_seen: Set[int] = set()
+
+    def __copy__(self):
+        result = DependencyAnnotation()
+        result.storage_loaded = copy(self.storage_loaded)
+        result.storage_written = copy(self.storage_written)
+        result.has_call = self.has_call
+        result.path = copy(self.path)
+        result.blocks_seen = copy(self.blocks_seen)
+        return result
+
+    def get_storage_write_cache(self, iteration: int):
+        if iteration not in self.storage_written:
+            self.storage_written[iteration] = []
+        return self.storage_written[iteration]
+
+    def extend_storage_write_cache(self, iteration: int, value: object):
+        if iteration not in self.storage_written:
+            self.storage_written[iteration] = [value]
+        elif value not in self.storage_written[iteration]:
+            self.storage_written[iteration].append(value)
+
+
+class WSDependencyAnnotation(StateAnnotation):
+    """World-state-level stack of DependencyAnnotations, carrying them
+    from one transaction to the next."""
+
+    def __init__(self):
+        self.annotations_stack: List = []
+
+    def __copy__(self):
+        result = WSDependencyAnnotation()
+        result.annotations_stack = copy(self.annotations_stack)
+        return result

@@ -1,0 +1,106 @@
+"""Laser plugin behavior tests (reference test strategy:
+tests/plugin/ + tests/laser/strategy/test_loop_bound.py)."""
+
+import pytest
+
+from mythril_tpu.laser.ethereum.strategy.basic import BreadthFirstSearchStrategy
+from mythril_tpu.laser.ethereum.strategy.extensions.bounded_loops import (
+    BoundedLoopsStrategy,
+)
+from mythril_tpu.laser.ethereum.svm import LaserEVM
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.plugin.builder import PluginBuilder
+from mythril_tpu.laser.plugin.interface import LaserPlugin
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+from mythril_tpu.laser.plugin.plugins.coverage.coverage_plugin import (
+    InstructionCoveragePlugin,
+)
+from mythril_tpu.laser.plugin.plugins.mutation_pruner import MutationPruner
+
+
+def wrap_runtime(runtime_hex: str) -> str:
+    runtime = bytes.fromhex(runtime_hex)
+    n = len(runtime)
+    creation = bytes(
+        [0x60, n, 0x60, 0x0C, 0x60, 0x00, 0x39, 0x60, n, 0x60, 0x00, 0xF3]
+    )
+    return (creation + runtime).hex()
+
+
+def run(runtime_hex, plugins=(), tx_count=1, loop_bound=None):
+    laser = LaserEVM(
+        transaction_count=tx_count, execution_timeout=120, create_timeout=60
+    )
+    if loop_bound is not None:
+        laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
+    for plugin in plugins:
+        plugin.initialize(laser)
+    laser.sym_exec(
+        creation_code=wrap_runtime(runtime_hex),
+        contract_name="T",
+        world_state=WorldState(),
+    )
+    return laser
+
+
+def test_coverage_plugin_records_executed_instructions():
+    cov = InstructionCoveragePlugin()
+    laser = run("6001600055600060015500", plugins=[cov])
+    runtime_cov = [v for k, v in cov.coverage.items() if k == "6001600055600060015500"]
+    assert runtime_cov
+    total, mask = runtime_cov[0]
+    assert sum(mask) == total  # straight-line code: everything covered
+
+
+def test_mutation_pruner_drops_clean_transaction():
+    # non-payable no-op: revert on callvalue != 0, else STOP. The STOP
+    # path's constraints pin callvalue to 0, so the end state neither
+    # mutates storage nor moves value and the pruner discards it.
+    code = "34600557005b60006000fd"
+    laser = run(code, plugins=[MutationPruner()])
+    assert len(laser.open_states) == 0
+
+    # without the pruner the open state survives
+    laser2 = run(code)
+    assert len(laser2.open_states) == 1
+
+
+def test_mutation_pruner_keeps_mutating_transaction():
+    laser = run("6001600055600060015500", plugins=[MutationPruner()])
+    assert len(laser.open_states) == 1
+
+
+def test_bounded_loops_strategy_terminates_infinite_loop():
+    # JUMPDEST PUSH1 0 JUMP : tight infinite loop
+    laser = run("5b600056", loop_bound=3)
+    # finishes (pruned), leaving no open end states
+    assert laser.total_states < 500
+
+
+def test_plugin_loader_loads_and_deduplicates():
+    loader = LaserPluginLoader()
+    # fresh singleton state for this test
+    loader.laser_plugin_builders = {}
+
+    class DummyPlugin(LaserPlugin):
+        initialized = 0
+
+        def initialize(self, symbolic_vm):
+            DummyPlugin.initialized += 1
+
+    class DummyBuilder(PluginBuilder):
+        plugin_name = "dummy"
+
+        def __call__(self, *args, **kwargs):
+            return DummyPlugin()
+
+    builder = DummyBuilder()
+    loader.load(builder)
+    loader.load(builder)  # second load is a no-op
+    assert list(loader.laser_plugin_builders) == ["dummy"]
+    assert loader.is_enabled("dummy")
+
+    laser = LaserEVM()
+    loader.instrument_virtual_machine(laser, None)
+    assert DummyPlugin.initialized == 1
+    loader.laser_plugin_builders = {}
