@@ -1,0 +1,1 @@
+"""Chain/data access layer (reference: mythril/ethereum/)."""

@@ -1,0 +1,88 @@
+"""EVM contract model: an address with associated bytecode.
+
+Reference parity: mythril/ethereum/evmcontract.py:14-122 — creation +
+runtime `Disassembly`, bytecode hashes, and `matches_expression` for
+`leveldb-search`-style code queries. The reference subclasses
+`persistent.Persistent` for its ZODB-backed contract storage; plain
+objects serialize fine for this framework's needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.support.keccak import keccak256
+from mythril_tpu.support.support_utils import get_code_hash
+
+log = logging.getLogger(__name__)
+
+
+class EVMContract:
+    """An address with associated code."""
+
+    def __init__(
+        self, code="", creation_code="", name="Unknown", enable_online_lookup=False
+    ):
+        # compile-time linking placeholders __[lib]__ become a dummy addr
+        creation_code = re.sub(r"(_{2}.{38})", "aa" * 20, creation_code)
+        code = re.sub(r"(_{2}.{38})", "aa" * 20, code)
+
+        self.creation_code = creation_code
+        self.name = name
+        self.code = code
+        self.disassembly = Disassembly(code, enable_online_lookup=enable_online_lookup)
+        self.creation_disassembly = Disassembly(
+            creation_code, enable_online_lookup=enable_online_lookup
+        )
+
+    @property
+    def bytecode_hash(self):
+        return get_code_hash(self.code)
+
+    @property
+    def creation_bytecode_hash(self):
+        return get_code_hash(self.creation_code)
+
+    def as_dict(self):
+        return {
+            "name": self.name,
+            "code": self.code,
+            "creation_code": self.creation_code,
+            "disassembly": self.disassembly,
+        }
+
+    def get_easm(self):
+        return self.disassembly.get_easm()
+
+    def get_creation_easm(self):
+        return self.creation_disassembly.get_easm()
+
+    def matches_expression(self, expression: str) -> bool:
+        """Evaluate a `code#...# and func#...#` query against this
+        contract (reference: evmcontract.py matches_expression)."""
+        str_eval = ""
+        easm_code = None
+
+        tokens = re.split(r"\s+(and|or|not)\s+", expression, re.IGNORECASE)
+        for token in tokens:
+            if token in ("and", "or", "not"):
+                str_eval += " " + token + " "
+                continue
+
+            m = re.match(r"^code#([a-zA-Z0-9\s,\[\]]+)#", token)
+            if m:
+                if easm_code is None:
+                    easm_code = self.get_easm()
+                code = m.group(1).replace(",", "\\n")
+                str_eval += '"' + code + '" in easm_code'
+                continue
+
+            m = re.match(r"^func#([a-zA-Z0-9\s_,(\\)\[\]]+)#$", token)
+            if m:
+                sign_hash = "0x" + keccak256(m.group(1).encode())[:4].hex()
+                str_eval += '"' + sign_hash + '" in self.disassembly.func_hashes'
+                continue
+
+        return bool(eval(str_eval.strip()))  # noqa: S307 - same DSL as reference

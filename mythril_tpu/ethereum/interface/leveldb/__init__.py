@@ -1,0 +1,2 @@
+"""Direct go-ethereum LevelDB access (reference:
+mythril/ethereum/interface/leveldb/)."""

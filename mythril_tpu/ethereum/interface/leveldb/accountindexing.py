@@ -1,0 +1,130 @@
+"""Address-hash -> address index built from block receipts.
+
+Reference parity: mythril/ethereum/interface/leveldb/
+accountindexing.py:1-177 — the state trie only stores keccak(address)
+keys, so searching by address requires an index; it is built by
+scanning every block's receipts for contract-creation addresses and
+persisted back into the database under custom `AM` keys.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from mythril_tpu.ethereum.interface.leveldb import rlp_codec as rlp
+from mythril_tpu.exceptions import AddressNotFoundError
+from mythril_tpu.support.keccak import keccak256
+
+log = logging.getLogger(__name__)
+
+BATCH_SIZE = 8 * 4096
+
+
+class ReceiptForStorage:
+    """Transaction receipt as stored by geth (legacy layout):
+    [state/status, cumulative gas, bloom, tx hash, contract address,
+    logs, gas used]."""
+
+    def __init__(self, fields: List):
+        self.state_or_status = fields[0]
+        self.cumulative_gas = rlp.to_int(fields[1]) if len(fields) > 1 else 0
+        self.contract_address: Optional[bytes] = None
+        # locate the 20-byte contract-address field (position varies a
+        # little across geth versions; bloom is 256 bytes, hashes 32)
+        for field in fields:
+            if isinstance(field, bytes) and len(field) == 20:
+                self.contract_address = field
+                break
+
+
+def _decode_receipts(raw: bytes) -> List[ReceiptForStorage]:
+    decoded = rlp.decode(raw)
+    receipts = []
+    for item in decoded:
+        if isinstance(item, list):
+            receipts.append(ReceiptForStorage(item))
+    return receipts
+
+
+class AccountIndexer:
+    """Updates and queries the address index."""
+
+    def __init__(self, eth_db):
+        self.db = eth_db
+        self.lastBlock = None
+        self.lastProcessedBlock = None
+        self.updateIfNeeded()
+
+    def get_contract_by_hash(self, contract_hash: bytes) -> bytes:
+        """Map the keccak of an address to the address."""
+        address = self.db.reader._get_address_by_hash(contract_hash)
+        if address is None:
+            raise AddressNotFoundError
+        return address
+
+    def _process(self, startblock: int) -> int:
+        """Index the contract-creation addresses of a batch of blocks;
+        returns the number of addresses found."""
+        log.debug("Processing blocks %d to %d", startblock, startblock + BATCH_SIZE)
+        addresses: List[bytes] = []
+        for blockNum in range(startblock, startblock + BATCH_SIZE):
+            block_hash = self.db.reader._get_block_hash(blockNum)
+            if block_hash is None:
+                break
+            receipts_raw = self.db.reader._get_block_receipts_raw(
+                block_hash, blockNum
+            )
+            if receipts_raw is None:
+                continue
+            for receipt in _decode_receipts(receipts_raw):
+                if receipt.contract_address and receipt.contract_address != b"\x00" * 20:
+                    addresses.append(receipt.contract_address)
+
+        self.db.writer._start_writing()
+        for address in addresses:
+            self.db.writer._store_account_address(address)
+        self.db.writer._commit_batch()
+        return len(addresses)
+
+    def updateIfNeeded(self) -> None:
+        """Bring the index up to the chain head."""
+        try:
+            head_block = self.db.reader._get_head_block()
+        except Exception:
+            return
+        if head_block is None:
+            return
+        self.lastBlock = head_block.number
+
+        last_processed = self.db.reader._get_last_indexed_number()
+        if last_processed is not None:
+            self.lastProcessedBlock = rlp.to_int(last_processed)
+
+        # up to date (wait for 6 confirmations like the reference)
+        if (
+            self.lastProcessedBlock is not None
+            and self.lastBlock <= self.lastProcessedBlock + 6
+        ):
+            return
+
+        blockNum = 0
+        if self.lastProcessedBlock is not None:
+            blockNum = self.lastProcessedBlock + 1
+            print("Updating hash-to-address index...")
+        else:
+            print(
+                "Starting hash-to-address index. This may take a while..."
+            )
+
+        count = 0
+        processed = 0
+        while blockNum <= self.lastBlock:
+            count += self._process(blockNum)
+            processed += BATCH_SIZE
+            blockNum = min(blockNum + BATCH_SIZE, self.lastBlock + 1)
+            self.db.writer._set_last_indexed_number(blockNum - 1)
+            log.debug("%d blocks processed, %d addresses indexed", processed, count)
+
+        self.lastProcessedBlock = self.lastBlock

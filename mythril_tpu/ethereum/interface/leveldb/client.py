@@ -1,0 +1,201 @@
+"""Go-ethereum LevelDB client.
+
+Reference parity: mythril/ethereum/interface/leveldb/client.py:196-314
+— head-state resolution via the geth rawdb key schema, account/code/
+storage/balance reads, full contract search and hash->address lookup.
+Key schema per go-ethereum core/rawdb/schema.go.
+"""
+
+from __future__ import annotations
+
+import binascii
+import logging
+from typing import Iterator, Optional, Tuple
+
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.ethereum.interface.leveldb import rlp_codec as rlp
+from mythril_tpu.ethereum.interface.leveldb.accountindexing import AccountIndexer
+from mythril_tpu.ethereum.interface.leveldb.eth_db import ETH_DB
+from mythril_tpu.ethereum.interface.leveldb.state import State
+from mythril_tpu.exceptions import AddressNotFoundError
+from mythril_tpu.support.keccak import keccak256
+
+log = logging.getLogger(__name__)
+
+# geth rawdb key schema
+header_prefix = b"h"  # h + num(u64be) + hash -> header
+body_prefix = b"b"  # b + num(u64be) + hash -> body
+num_suffix = b"n"  # h + num(u64be) + n -> hash
+block_hash_prefix = b"H"  # H + hash -> num(u64be)
+block_receipts_prefix = b"r"  # r + num(u64be) + hash -> receipts
+head_header_key = b"LastBlock"
+# custom index keys
+address_prefix = b"AM"
+address_mapping_head_key = b"accountMapping"
+
+
+def _format_block_number(number: int) -> bytes:
+    return number.to_bytes(8, "big")
+
+
+def _encode_hex(v: bytes) -> str:
+    return "0x" + bytes(v).hex()
+
+
+class BlockHeader:
+    """Decoded geth block header (only the fields the client needs)."""
+
+    def __init__(self, fields):
+        self.prevhash = fields[0]
+        self.state_root = fields[3]
+        self.number = rlp.to_int(fields[8])
+
+
+class LevelDBReader:
+    """Read-side accessors over the raw database."""
+
+    def __init__(self, db):
+        self.db = db
+        self.head_block_header: Optional[BlockHeader] = None
+        self.head_state: Optional[State] = None
+
+    def _get_head_state(self) -> State:
+        if not self.head_state:
+            root = self._get_head_block().state_root
+            self.head_state = State(self.db, root)
+        return self.head_state
+
+    def _get_account(self, address: str):
+        state = self._get_head_state()
+        account_address = binascii.a2b_hex(address[2:] if address.startswith("0x") else address)
+        return state.get_and_cache_account(account_address)
+
+    def _get_block_hash(self, number: int) -> Optional[bytes]:
+        num = _format_block_number(number)
+        return self.db.get(header_prefix + num + num_suffix)
+
+    def _get_head_block(self) -> Optional[BlockHeader]:
+        if not self.head_block_header:
+            block_hash = self.db.get(head_header_key)
+            if block_hash is None:
+                return None
+            num = self._get_block_number(block_hash)
+            self.head_block_header = self._get_block_header(block_hash, num)
+            # walk back to a header whose state is present (fast sync)
+            while (
+                self.head_block_header is not None
+                and not self.db.get(self.head_block_header.state_root)
+                and self.head_block_header.prevhash is not None
+            ):
+                block_hash = self.head_block_header.prevhash
+                num = self._get_block_number(block_hash)
+                self.head_block_header = self._get_block_header(block_hash, num)
+        return self.head_block_header
+
+    def _get_block_number(self, block_hash: bytes) -> bytes:
+        return self.db.get(block_hash_prefix + block_hash)
+
+    def _get_block_header(self, block_hash: bytes, num: bytes) -> Optional[BlockHeader]:
+        raw = self.db.get(header_prefix + num + block_hash)
+        if raw is None:
+            return None
+        return BlockHeader(rlp.decode(raw))
+
+    def _get_address_by_hash(self, address_hash: bytes) -> Optional[bytes]:
+        return self.db.get(address_prefix + address_hash)
+
+    def _get_last_indexed_number(self) -> Optional[bytes]:
+        return self.db.get(address_mapping_head_key)
+
+    def _get_block_receipts_raw(self, block_hash: bytes, num: int) -> Optional[bytes]:
+        number = _format_block_number(num)
+        return self.db.get(block_receipts_prefix + number + block_hash)
+
+
+class LevelDBWriter:
+    """Write-side accessors (only used by the account indexer)."""
+
+    def __init__(self, db):
+        self.db = db
+        self.wb = None
+
+    def _set_last_indexed_number(self, number: int):
+        return self.db.put(address_mapping_head_key, _format_block_number(number))
+
+    def _start_writing(self):
+        self.wb = self.db.write_batch()
+
+    def _commit_batch(self):
+        self.wb.write()
+
+    def _store_account_address(self, address: bytes):
+        self.wb.put(address_prefix + keccak256(address), address)
+
+
+class EthLevelDB:
+    """Top-level client over a geth chaindata directory."""
+
+    def __init__(self, path: str, db=None):
+        self.path = path
+        # `db` injection point: tests pass an in-memory store
+        self.db = db if db is not None else ETH_DB(path)
+        self.reader = LevelDBReader(self.db)
+        self.writer = LevelDBWriter(self.db)
+
+    def get_contracts(self) -> Iterator[Tuple[EVMContract, bytes, int]]:
+        """Iterate all accounts that carry code."""
+        for account in self.reader._get_head_state().get_all_accounts():
+            if account.code is not None:
+                code = _encode_hex(account.code)
+                contract = EVMContract(code, enable_online_lookup=False)
+                yield contract, account.address, account.balance
+
+    def search(self, expression: str, callback_func) -> None:
+        """Search every contract account against a code/func
+        expression; the callback receives matches."""
+        cnt = 0
+        indexer = AccountIndexer(self)
+        for contract, address_hash, balance in self.get_contracts():
+            if contract.matches_expression(expression):
+                try:
+                    address = _encode_hex(indexer.get_contract_by_hash(address_hash))
+                except AddressNotFoundError:
+                    # unindexed (e.g. internal-tx creation): skip
+                    continue
+                callback_func(contract, address, balance)
+            cnt += 1
+            if not cnt % 1000:
+                log.info("Searched %d contracts", cnt)
+
+    def contract_hash_to_address(self, contract_hash: str) -> str:
+        """keccak(address) -> address via the index."""
+        address_hash = binascii.a2b_hex(contract_hash.replace("0x", ""))
+        indexer = AccountIndexer(self)
+        return _encode_hex(indexer.get_contract_by_hash(address_hash))
+
+    def eth_getBlockHeaderByNumber(self, number: int) -> Optional[BlockHeader]:
+        block_hash = self.reader._get_block_hash(number)
+        block_number = _format_block_number(number)
+        return self.reader._get_block_header(block_hash, block_number)
+
+    def eth_getBlockByNumber(self, number: int):
+        """Raw decoded block body."""
+        block_hash = self.reader._get_block_hash(number)
+        block_number = _format_block_number(number)
+        block_data = self.db.get(body_prefix + block_number + block_hash)
+        if block_data is None:
+            return None
+        return rlp.decode(block_data)
+
+    def eth_getCode(self, address: str) -> str:
+        account = self.reader._get_account(address)
+        return _encode_hex(account.code or b"")
+
+    def eth_getBalance(self, address: str) -> int:
+        account = self.reader._get_account(address)
+        return account.balance
+
+    def eth_getStorageAt(self, address: str, position: int) -> str:
+        account = self.reader._get_account(address)
+        value = account.get_storage_data(position)
+        return _encode_hex(value.to_bytes(32, "big"))

@@ -1,0 +1,38 @@
+"""Raw LevelDB handle.
+
+Reference parity: mythril/ethereum/interface/leveldb/eth_db.py:1-23
+(plyvel wrapper). plyvel is optional here: when missing, opening a
+real database raises a clear error, while the rest of the layer keeps
+working against any dict-like store (used by the tests).
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.exceptions import CriticalError
+
+
+class ETH_DB:
+    """plyvel-backed store with the `.get/.put/.write_batch/.iterator`
+    surface the readers use."""
+
+    def __init__(self, path: str):
+        try:
+            import plyvel
+        except ImportError:
+            raise CriticalError(
+                "LevelDB access requires the 'plyvel' package, which is not "
+                "installed in this environment. Use RPC-based loading instead."
+            )
+        self.db = plyvel.DB(path)
+
+    def get(self, key: bytes):
+        return self.db.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.db.put(key, value)
+
+    def write_batch(self):
+        return self.db.write_batch()
+
+    def iterator(self, **kwargs):
+        return self.db.iterator(**kwargs)

@@ -1,0 +1,83 @@
+"""Minimal RLP codec.
+
+The reference pulls in the `rlp` package + pyethereum sedes classes;
+this framework needs only plain encode/decode of nested byte-string
+lists (geth headers, bodies, receipts, trie nodes), so a ~70-line
+codec keeps the layer dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+RlpItem = Union[bytes, List["RlpItem"]]
+
+
+def encode(item: RlpItem) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _encode_length(len(item), 0x80) + item
+    if isinstance(item, list):
+        payload = b"".join(encode(sub) for sub in item)
+        return _encode_length(len(payload), 0xC0) + payload
+    if isinstance(item, int):
+        if item == 0:
+            return b"\x80"
+        return encode(item.to_bytes((item.bit_length() + 7) // 8, "big"))
+    raise TypeError(f"cannot RLP-encode {type(item)}")
+
+
+def _encode_length(length: int, offset: int) -> bytes:
+    if length <= 55:
+        return bytes([offset + length])
+    ln = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(ln)]) + ln
+
+
+def decode(data: bytes) -> RlpItem:
+    item, consumed = _decode_at(bytes(data), 0)
+    if consumed != len(data):
+        raise ValueError("trailing bytes after RLP item")
+    return item
+
+
+def _decode_at(data: bytes, pos: int) -> Tuple[RlpItem, int]:
+    if pos >= len(data):
+        raise ValueError("RLP input too short")
+    prefix = data[pos]
+    if prefix < 0x80:
+        return bytes([prefix]), pos + 1
+    if prefix < 0xB8:  # short string
+        length = prefix - 0x80
+        return data[pos + 1 : pos + 1 + length], pos + 1 + length
+    if prefix < 0xC0:  # long string
+        len_of_len = prefix - 0xB7
+        length = int.from_bytes(data[pos + 1 : pos + 1 + len_of_len], "big")
+        start = pos + 1 + len_of_len
+        return data[start : start + length], start + length
+    if prefix < 0xF8:  # short list
+        length = prefix - 0xC0
+        end = pos + 1 + length
+        return _decode_list(data, pos + 1, end)
+    # long list
+    len_of_len = prefix - 0xF7
+    length = int.from_bytes(data[pos + 1 : pos + 1 + len_of_len], "big")
+    start = pos + 1 + len_of_len
+    return _decode_list(data, start, start + length)
+
+
+def _decode_list(data: bytes, start: int, end: int) -> Tuple[RlpItem, int]:
+    items = []
+    pos = start
+    while pos < end:
+        item, pos = _decode_at(data, pos)
+        items.append(item)
+    if pos != end:
+        raise ValueError("malformed RLP list")
+    return items, end
+
+
+def to_int(item: bytes) -> int:
+    return int.from_bytes(item, "big")

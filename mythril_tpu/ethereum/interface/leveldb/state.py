@@ -1,0 +1,70 @@
+"""World-state access over a geth state trie.
+
+Reference parity: mythril/ethereum/interface/leveldb/state.py:1-165 —
+account lookup by address (secure trie keyed by keccak(address)),
+storage reads, and full-account iteration for contract search.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+from mythril_tpu.ethereum.interface.leveldb import rlp_codec as rlp
+from mythril_tpu.ethereum.interface.leveldb.trie import Trie
+from mythril_tpu.support.keccak import keccak256
+
+BLANK_HASH = keccak256(b"")
+
+
+class Account:
+    """One account decoded from the state trie: [nonce, balance,
+    storage_root, code_hash]."""
+
+    def __init__(self, db, address_hash: bytes, rlp_data: bytes):
+        self.db = db
+        self.address = address_hash  # keccak(address); see AccountIndexer
+        nonce, balance, storage_root, code_hash = rlp.decode(rlp_data)
+        self.nonce = rlp.to_int(nonce)
+        self.balance = rlp.to_int(balance)
+        self.storage_root = storage_root
+        self.code_hash = code_hash
+
+    @property
+    def code(self) -> Optional[bytes]:
+        if self.code_hash == BLANK_HASH:
+            return None
+        return self.db.get(self.code_hash)
+
+    def get_storage_data(self, position: int) -> int:
+        trie = Trie(self.db, self.storage_root)
+        value = trie.get(keccak256(position.to_bytes(32, "big")))
+        if value is None:
+            return 0
+        return rlp.to_int(rlp.decode(value))
+
+
+class State:
+    """The secure state trie rooted at one block's stateRoot."""
+
+    def __init__(self, db, root: bytes):
+        self.db = db
+        self.trie = Trie(db, root)
+        self.secure_key_cache: Dict[bytes, Account] = {}
+
+    def get_and_cache_account(self, address: bytes) -> Optional[Account]:
+        """Account by 20-byte address."""
+        key = keccak256(address)
+        if key in self.secure_key_cache:
+            return self.secure_key_cache[key]
+        raw = self.trie.get(key)
+        if raw is None:
+            return None
+        account = Account(self.db, key, raw)
+        self.secure_key_cache[key] = account
+        return account
+
+    def get_all_accounts(self) -> Iterator[Account]:
+        """Iterate every account in the trie (addresses are only known
+        as hashes; the AccountIndexer resolves them)."""
+        for address_hash, raw in self.trie.iter_items():
+            yield Account(self.db, address_hash, raw)

@@ -1,0 +1,119 @@
+"""Hexary Merkle-Patricia trie reader over an abstract key-value db.
+
+Replaces the pyethereum trie the reference leans on
+(mythril/ethereum/interface/leveldb/state.py), with only the read
+operations the analyzer needs: `get(key)` and leaf iteration. The db
+is anything with `.get(bytes) -> bytes` (real LevelDB or an in-memory
+dict for tests).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from mythril_tpu.ethereum.interface.leveldb import rlp_codec as rlp
+
+BLANK_ROOT = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)  # keccak256(rlp(b''))
+
+
+def _to_nibbles(key: bytes) -> List[int]:
+    nibbles = []
+    for b in key:
+        nibbles.append(b >> 4)
+        nibbles.append(b & 0x0F)
+    return nibbles
+
+
+def _decode_hp(path: bytes) -> Tuple[List[int], bool]:
+    """Hex-prefix decoding: returns (nibbles, is_leaf)."""
+    flag = path[0] >> 4
+    is_leaf = flag >= 2
+    nibbles = _to_nibbles(path)
+    # drop the flag nibble, plus the padding nibble when even-flagged
+    nibbles = nibbles[2:] if flag in (0, 2) else nibbles[1:]
+    return nibbles, is_leaf
+
+
+class Trie:
+    """Read-only secure-trie traversal (callers hash keys themselves
+    where geth does)."""
+
+    def __init__(self, db, root: bytes):
+        self.db = db
+        self.root = root
+
+    def _load_node(self, ref):
+        """A node reference is either a 32-byte hash (lookup) or an
+        embedded node (< 32 bytes, already decoded)."""
+        if isinstance(ref, list):
+            return ref
+        if ref == b"":
+            return None
+        if len(ref) == 32:
+            raw = self.db.get(ref)
+            if raw is None:
+                return None
+            return rlp.decode(raw)
+        return rlp.decode(ref)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Value at `key` (raw bytes; caller hashes for secure tries)."""
+        if self.root in (b"", None) or self.root == BLANK_ROOT:
+            return None
+        return self._get(self._load_node(self.root), _to_nibbles(key))
+
+    def _get(self, node, nibbles: List[int]) -> Optional[bytes]:
+        while True:
+            if node is None:
+                return None
+            if len(node) == 17:  # branch node
+                if not nibbles:
+                    return node[16] if node[16] != b"" else None
+                node = self._load_node(node[nibbles[0]])
+                nibbles = nibbles[1:]
+                continue
+            if len(node) == 2:  # extension or leaf
+                path, is_leaf = _decode_hp(node[0])
+                if is_leaf:
+                    return node[1] if nibbles == path else None
+                if nibbles[: len(path)] != path:
+                    return None
+                node = self._load_node(node[1])
+                nibbles = nibbles[len(path) :]
+                continue
+            raise ValueError("malformed trie node")
+
+    def iter_items(self) -> Iterator[Tuple[bytes, bytes]]:
+        """Yield (key-nibble-path packed to bytes, value) for every
+        leaf. Keys of secure tries are hashes of the original keys."""
+        if self.root in (b"", None) or self.root == BLANK_ROOT:
+            return
+        yield from self._iter(self._load_node(self.root), [])
+
+    def _iter(self, node, prefix: List[int]) -> Iterator[Tuple[bytes, bytes]]:
+        if node is None:
+            return
+        if len(node) == 17:
+            for i in range(16):
+                if node[i] != b"":
+                    yield from self._iter(self._load_node(node[i]), prefix + [i])
+            if node[16] != b"":
+                yield self._pack(prefix), node[16]
+            return
+        if len(node) == 2:
+            path, is_leaf = _decode_hp(node[0])
+            if is_leaf:
+                yield self._pack(prefix + path), node[1]
+            else:
+                yield from self._iter(self._load_node(node[1]), prefix + path)
+            return
+        raise ValueError("malformed trie node")
+
+    @staticmethod
+    def _pack(nibbles: List[int]) -> bytes:
+        assert len(nibbles) % 2 == 0
+        return bytes(
+            (nibbles[i] << 4) | nibbles[i + 1] for i in range(0, len(nibbles), 2)
+        )

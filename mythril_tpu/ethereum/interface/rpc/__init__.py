@@ -1,0 +1,1 @@
+from mythril_tpu.ethereum.interface.rpc.client import EthJsonRpc

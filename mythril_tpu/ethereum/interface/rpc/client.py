@@ -1,0 +1,122 @@
+"""Minimal Ethereum JSON-RPC client.
+
+Reference parity: mythril/ethereum/interface/rpc/client.py:30-88 —
+the `eth_*` methods the analyzer actually uses (code / storage /
+balance reads and a few block queries), with infura/ganache presets
+handled by MythrilConfig.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import requests
+from requests.adapters import HTTPAdapter
+from requests.exceptions import ConnectionError as RequestsConnectionError
+
+from mythril_tpu.ethereum.interface.rpc.exceptions import (
+    BadJsonError,
+    BadResponseError,
+    BadStatusCodeError,
+    ConnectionError,
+)
+
+log = logging.getLogger(__name__)
+
+GETH_DEFAULT_RPC_PORT = 8545
+MAX_RETRIES = 3
+JSON_MEDIA_TYPE = "application/json"
+
+BLOCK_TAGS = ("earliest", "latest", "pending")
+
+
+def hex_to_dec(x: str) -> int:
+    return int(x, 16)
+
+
+def validate_block(block) -> str:
+    if isinstance(block, str):
+        if block not in BLOCK_TAGS:
+            raise ValueError("invalid block tag")
+        return block
+    if isinstance(block, int):
+        return hex(block)
+    raise ValueError("invalid block")
+
+
+class EthJsonRpc:
+    """JSON-RPC over HTTP(S)."""
+
+    def __init__(self, host="localhost", port=GETH_DEFAULT_RPC_PORT, tls=False):
+        self.host = host
+        self.port = port
+        self.tls = tls
+        self.session = requests.Session()
+        self.session.mount(self.host, HTTPAdapter(max_retries=MAX_RETRIES))
+
+    def _call(self, method, params=None, _id=1):
+        params = params or []
+        data = {"jsonrpc": "2.0", "method": method, "params": params, "id": _id}
+        scheme = "https" if self.tls else "http"
+        if self.host:
+            url = (
+                f"{scheme}://{self.host}:{self.port}"
+                if self.port
+                else f"{scheme}://{self.host}"
+            )
+        else:
+            url = scheme
+
+        headers = {"Content-Type": JSON_MEDIA_TYPE}
+        log.debug("rpc send: %s", json.dumps(data))
+        try:
+            r = self.session.post(url, headers=headers, data=json.dumps(data))
+        except RequestsConnectionError:
+            raise ConnectionError
+        if r.status_code // 100 != 2:
+            raise BadStatusCodeError(r.status_code)
+        try:
+            response = r.json()
+        except ValueError:
+            raise BadJsonError(r.text)
+        try:
+            return response["result"]
+        except KeyError:
+            raise BadResponseError(response)
+
+    def close(self):
+        self.session.close()
+
+    # -- the eth_* surface the analyzer uses ---------------------------
+    def eth_getCode(self, address, default_block="latest"):
+        return self._call("eth_getCode", [address, validate_block(default_block)])
+
+    def eth_getBalance(self, address, default_block="latest"):
+        return hex_to_dec(
+            self._call("eth_getBalance", [address, validate_block(default_block)])
+        )
+
+    def eth_getStorageAt(self, address, position=0, block="latest"):
+        return self._call(
+            "eth_getStorageAt", [address, hex(position), validate_block(block)]
+        )
+
+    def eth_blockNumber(self):
+        return hex_to_dec(self._call("eth_blockNumber"))
+
+    def eth_getBlockByNumber(self, block, tx_objects=True):
+        return self._call(
+            "eth_getBlockByNumber", [validate_block(block), tx_objects]
+        )
+
+    def eth_getTransactionReceipt(self, tx_hash):
+        return self._call("eth_getTransactionReceipt", [tx_hash])
+
+    def eth_call(self, to_address, data=None, default_block="latest"):
+        data = data or {}
+        obj = {"to": to_address, "data": data}
+        return self._call("eth_call", [obj, validate_block(default_block)])
+
+    def web3_clientVersion(self):
+        return self._call("web3_clientVersion")

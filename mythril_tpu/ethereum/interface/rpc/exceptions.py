@@ -1,0 +1,22 @@
+"""RPC client exceptions (reference:
+mythril/ethereum/interface/rpc/exceptions.py)."""
+
+
+class EthJsonRpcError(Exception):
+    """Base RPC error."""
+
+
+class ConnectionError(EthJsonRpcError):
+    """Could not reach the RPC endpoint."""
+
+
+class BadStatusCodeError(EthJsonRpcError):
+    """Non-2xx HTTP status."""
+
+
+class BadJsonError(EthJsonRpcError):
+    """Response body was not JSON."""
+
+
+class BadResponseError(EthJsonRpcError):
+    """JSON response missing the result field."""

@@ -1,0 +1,132 @@
+"""Solc integration and calldata helpers.
+
+Reference parity: mythril/ethereum/util.py — spawn the external `solc`
+binary in standard-json mode (the compiler itself is not reimplemented,
+same as the reference), plus a small ABI encoder for `encode_calldata`
+(the reference defers to pyethereum's abi module).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from subprocess import PIPE, Popen
+from typing import List
+
+from mythril_tpu.exceptions import CompilerError
+from mythril_tpu.support.keccak import keccak256
+
+
+def safe_decode(hex_encoded_string: str) -> bytes:
+    if hex_encoded_string.startswith("0x"):
+        return bytes.fromhex(hex_encoded_string[2:])
+    return bytes.fromhex(hex_encoded_string)
+
+
+def get_solc_json(file: str, solc_binary: str = "solc", solc_settings_json: str = None):
+    """Compile a Solidity file through `solc --standard-json`."""
+    cmd = [solc_binary, "--optimize", "--standard-json", "--allow-paths", "."]
+
+    settings = json.loads(solc_settings_json) if solc_settings_json else {}
+    settings.update(
+        {
+            "outputSelection": {
+                "*": {
+                    "": ["ast"],
+                    "*": [
+                        "metadata",
+                        "evm.bytecode",
+                        "evm.deployedBytecode",
+                        "evm.methodIdentifiers",
+                    ],
+                }
+            }
+        }
+    )
+    input_json = json.dumps(
+        {
+            "language": "Solidity",
+            "sources": {file: {"urls": [file]}},
+            "settings": settings,
+        }
+    )
+
+    try:
+        p = Popen(cmd, stdin=PIPE, stdout=PIPE, stderr=PIPE)
+        stdout, _ = p.communicate(bytes(input_json, "utf8"))
+    except FileNotFoundError:
+        raise CompilerError(
+            "Compiler not found. Make sure that solc is installed and in PATH, "
+            "or set the SOLC environment variable."
+        )
+
+    result = json.loads(stdout.decode("UTF-8"))
+
+    for error in result.get("errors", []):
+        if error["severity"] == "error":
+            raise CompilerError(
+                "Solc experienced a fatal error.\n\n%s" % error["formattedMessage"]
+            )
+    return result
+
+
+def _encode_abi_value(arg_type: str, arg) -> bytes:
+    """Encode one static ABI value as a 32-byte word."""
+    if arg_type.startswith(("uint", "int")):
+        return (int(arg) % 2**256).to_bytes(32, "big")
+    if arg_type == "address":
+        if isinstance(arg, str):
+            arg = int(arg, 16)
+        return int(arg).to_bytes(32, "big")
+    if arg_type == "bool":
+        return int(bool(arg)).to_bytes(32, "big")
+    if arg_type.startswith("bytes") and arg_type != "bytes":
+        data = bytes(arg) if not isinstance(arg, str) else bytes.fromhex(arg.replace("0x", ""))
+        return data.ljust(32, b"\x00")
+    raise ValueError(f"unsupported static ABI type {arg_type}")
+
+
+def encode_calldata(func_name: str, arg_types: List[str], args: List) -> str:
+    """Selector + static ABI-encoded args (reference: encode_calldata)."""
+    signature = "{}({})".format(func_name, ",".join(arg_types))
+    selector = keccak256(signature.encode())[:4]
+    encoded = b"".join(_encode_abi_value(t, a) for t, a in zip(arg_types, args))
+    return "0x" + selector.hex() + encoded.hex()
+
+
+def get_random_address() -> str:
+    return os.urandom(20).hex()
+
+
+def get_indexed_address(index: int) -> str:
+    return "0x" + (hex(index)[2:] * 40)
+
+
+def solc_exists(version: str) -> str:
+    """Locate a solc binary for `version` (py-solc layout, then solcx,
+    then the system install)."""
+    if version.startswith("0.4"):
+        solc_path = os.path.join(
+            os.environ.get("HOME", str(Path.home())),
+            ".py-solc/solc-v" + version,
+            "bin/solc",
+        )
+        if os.path.exists(solc_path):
+            return solc_path
+    else:
+        try:
+            import solcx
+            from solcx.exceptions import SolcNotInstalled
+
+            try:
+                return solcx.install.get_executable(version)
+            except SolcNotInstalled:
+                pass
+        except ImportError:
+            pass
+
+    default_binary = "/usr/bin/solc"
+    if os.path.exists(default_binary):
+        return default_binary

@@ -1,0 +1,27 @@
+"""--epic: pipe analyzer output through a falling-character renderer
+(reference: mythril/interfaces/epic.py, the easter egg)."""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+
+
+def main() -> None:
+    green = "\033[92m"
+    reset = "\033[0m"
+    for line in sys.stdin:
+        rendered = ""
+        for ch in line.rstrip("\n"):
+            if ch.strip() and random.random() < 0.12:
+                rendered += green + ch + reset
+            else:
+                rendered += ch
+        print(rendered)
+        sys.stdout.flush()
+        time.sleep(0.01)
+
+
+if __name__ == "__main__":
+    main()

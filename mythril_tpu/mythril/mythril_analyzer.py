@@ -1,0 +1,175 @@
+"""Facade: per-contract analysis orchestration.
+
+Reference parity: mythril/mythril/mythril_analyzer.py:27-195 — sets
+the global `args`, runs SymExecWrapper + fire_lasers per contract with
+crash containment (exceptions are reported, already-found callback
+issues salvaged), and renders graph/statespace artifacts.
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from typing import List, Optional
+
+from mythril_tpu.analysis.callgraph import generate_graph
+from mythril_tpu.analysis.report import Issue, Report
+from mythril_tpu.analysis.security import fire_lasers, retrieve_callback_issues
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.analysis.traceexplore import get_serializable_statespace
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.exceptions import DetectorNotFoundError
+from mythril_tpu.laser.execution_info import ExecutionInfo
+from mythril_tpu.laser.smt.solver import SolverStatistics
+from mythril_tpu.mythril.mythril_disassembler import MythrilDisassembler
+from mythril_tpu.support.loader import DynLoader
+from mythril_tpu.support.source_support import Source
+from mythril_tpu.support.start_time import StartTime
+from mythril_tpu.support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+class MythrilAnalyzer:
+    """Runs the security analysis over the disassembler's contracts."""
+
+    def __init__(
+        self,
+        disassembler: MythrilDisassembler,
+        requires_dynld: bool = False,
+        use_onchain_data: bool = True,
+        strategy: str = "dfs",
+        address: Optional[str] = None,
+        max_depth: Optional[int] = None,
+        execution_timeout: Optional[int] = None,
+        loop_bound: Optional[int] = None,
+        create_timeout: Optional[int] = None,
+        enable_iprof: bool = False,
+        disable_dependency_pruning: bool = False,
+        solver_timeout: Optional[int] = None,
+        custom_modules_directory: str = "",
+        sparse_pruning: bool = False,
+        unconstrained_storage: bool = False,
+        parallel_solving: bool = False,
+        call_depth_limit: int = 3,
+    ):
+        self.eth = disassembler.eth
+        self.contracts: List[EVMContract] = disassembler.contracts or []
+        self.enable_online_lookup = disassembler.enable_online_lookup
+        self.use_onchain_data = use_onchain_data
+        self.strategy = strategy
+        self.address = address
+        self.max_depth = max_depth
+        self.execution_timeout = execution_timeout
+        self.loop_bound = loop_bound
+        self.create_timeout = create_timeout
+        self.disable_dependency_pruning = disable_dependency_pruning
+        self.custom_modules_directory = custom_modules_directory
+        args.sparse_pruning = sparse_pruning
+        if solver_timeout is not None:
+            args.solver_timeout = solver_timeout
+        args.parallel_solving = parallel_solving
+        args.unconstrained_storage = unconstrained_storage
+        args.call_depth_limit = call_depth_limit
+        args.iprof = enable_iprof
+
+    def dump_statespace(self, contract: EVMContract = None) -> dict:
+        """Serializable statespace of the contract."""
+        sym = SymExecWrapper(
+            contract or self.contracts[0],
+            self.address,
+            self.strategy,
+            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
+            max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            create_timeout=self.create_timeout,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            run_analysis_modules=False,
+            custom_modules_directory=self.custom_modules_directory,
+        )
+        return get_serializable_statespace(sym)
+
+    def graph_html(
+        self,
+        contract: EVMContract = None,
+        enable_physics: bool = False,
+        phrackify: bool = False,
+        transaction_count: Optional[int] = None,
+    ) -> str:
+        """Interactive callgraph HTML."""
+        sym = SymExecWrapper(
+            contract or self.contracts[0],
+            self.address,
+            self.strategy,
+            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
+            max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            transaction_count=transaction_count,
+            create_timeout=self.create_timeout,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            run_analysis_modules=False,
+            custom_modules_directory=self.custom_modules_directory,
+        )
+        return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
+
+    def fire_lasers(
+        self,
+        modules: Optional[List[str]] = None,
+        transaction_count: Optional[int] = None,
+    ) -> Report:
+        """Analyze every loaded contract; one contract crashing doesn't
+        lose the others' findings."""
+        all_issues: List[Issue] = []
+        SolverStatistics().enabled = True
+        exceptions = []
+        execution_info: Optional[List[ExecutionInfo]] = None
+        for contract in self.contracts:
+            StartTime()  # fresh discovery-time baseline per contract
+            try:
+                sym = SymExecWrapper(
+                    contract,
+                    self.address,
+                    self.strategy,
+                    dynloader=DynLoader(self.eth, active=self.use_onchain_data),
+                    max_depth=self.max_depth,
+                    execution_timeout=self.execution_timeout,
+                    loop_bound=self.loop_bound,
+                    create_timeout=self.create_timeout,
+                    transaction_count=transaction_count,
+                    modules=modules,
+                    compulsory_statespace=False,
+                    disable_dependency_pruning=self.disable_dependency_pruning,
+                    custom_modules_directory=self.custom_modules_directory,
+                )
+                issues = fire_lasers(sym, modules)
+                execution_info = sym.execution_info
+            except DetectorNotFoundError:
+                raise
+            except KeyboardInterrupt:
+                log.critical("Keyboard Interrupt")
+                issues = retrieve_callback_issues(modules)
+            except Exception:
+                log.critical(
+                    "Exception occurred, aborting analysis. Please report this "
+                    "issue to the project's issue tracker.\n"
+                    + traceback.format_exc()
+                )
+                issues = retrieve_callback_issues(modules)
+                exceptions.append(traceback.format_exc())
+            for issue in issues:
+                issue.add_code_info(contract)
+
+            all_issues += issues
+            log.info("Solver statistics: \n%s", str(SolverStatistics()))
+
+        source_data = Source()
+        source_data.get_source_from_contracts_list(self.contracts)
+
+        report = Report(
+            contracts=self.contracts,
+            exceptions=exceptions,
+            execution_info=execution_info,
+        )
+        for issue in all_issues:
+            report.append_issue(issue)
+        return report

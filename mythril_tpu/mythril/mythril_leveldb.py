@@ -1,0 +1,32 @@
+"""Facade: LevelDB contract search (reference:
+mythril/mythril/mythril_leveldb.py:5-49)."""
+
+from __future__ import annotations
+
+import re
+
+from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
+
+
+class MythrilLevelDB:
+    """Search commands over a geth chaindata LevelDB."""
+
+    def __init__(self, leveldb: EthLevelDB) -> None:
+        self.leveldb = leveldb
+
+    def search_db(self, search: str) -> None:
+        """Print every contract matching the code/func expression."""
+
+        def search_callback(_, address, balance):
+            print("Address: " + address + ", balance: " + str(balance))
+
+        try:
+            self.leveldb.search(search, search_callback)
+        except SyntaxError:
+            raise SyntaxError("Syntax error in search expression.")
+
+    def contract_hash_to_address(self, contract_hash: str) -> None:
+        """Print the address whose code hash is `contract_hash`."""
+        if not re.match(r"0x[a-fA-F0-9]{64}", contract_hash):
+            raise ValueError("Invalid address hash. Expected format is '0x...'.")
+        print(self.leveldb.contract_hash_to_address(contract_hash))

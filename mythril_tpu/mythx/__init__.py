@@ -1,0 +1,88 @@
+"""`myth pro` MythX API client surface.
+
+Reference parity: mythril/mythx/__init__.py:22-111 — submits sources
+to the MythX SaaS via the `pythx` client and converts results to a
+Report. The service requires the external `pythx` package and network
+credentials; when unavailable this module degrades to a clear error
+instead of an import crash (the SaaS itself has also been sunset
+upstream).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from mythril_tpu.analysis.report import Issue, Report
+from mythril_tpu.exceptions import CriticalError
+
+log = logging.getLogger(__name__)
+
+
+def analyze(contracts, analysis_mode: str = "quick") -> Report:
+    """Submit contracts for MythX analysis and poll for the report."""
+    try:
+        import pythx  # noqa: F401
+        from pythx import Client
+    except ImportError:
+        raise CriticalError(
+            "The 'pythx' package is required for `myth pro` but is not "
+            "installed. Install pythx and set MYTHX_API_KEY (or "
+            "MYTHX_ETH_ADDRESS/MYTHX_PASSWORD) to use the MythX API."
+        )
+
+    eth_address = os.environ.get("MYTHX_ETH_ADDRESS")
+    password = os.environ.get("MYTHX_PASSWORD")
+    if not (eth_address and password):
+        # trial credentials, as in the reference
+        eth_address = "0x0000000000000000000000000000000000000000"
+        password = "trial"
+        log.info("No MythX credentials set; using trial mode")
+
+    client = Client(eth_address=eth_address, password=password)
+
+    report = Report(contracts=contracts)
+    for contract in contracts:
+        source_codes = {}
+        source_list = []
+        sources = {}
+        main_source = None
+        if hasattr(contract, "solc_json"):
+            main_source = contract.input_file
+            for solidity_file in contract.solidity_files:
+                source_list.append(solidity_file.filename)
+                sources[solidity_file.filename] = {"source": solidity_file.data}
+
+        resp = client.analyze(
+            contract_name=contract.name,
+            bytecode=contract.creation_code or None,
+            deployed_bytecode=contract.code or None,
+            sources=sources or None,
+            main_source=main_source,
+            source_list=source_list or None,
+            analysis_mode=analysis_mode,
+        )
+        while not client.analysis_ready(resp.uuid):
+            log.info("Analysis pending...")
+            time.sleep(5)
+
+        for issue_resp in client.report(resp.uuid):
+            report.append_issue(
+                Issue(
+                    contract=contract.name,
+                    function_name=None,
+                    address=int(
+                        issue_resp.locations[0].source_map.components[0].offset
+                    )
+                    if issue_resp.locations
+                    else 0,
+                    swc_id=issue_resp.swc_id.replace("SWC-", ""),
+                    title=issue_resp.swc_title,
+                    bytecode=contract.creation_code,
+                    severity=issue_resp.severity.capitalize(),
+                    description_head=issue_resp.description_short,
+                    description_tail=issue_resp.description_long,
+                )
+            )
+    return report

@@ -1,0 +1,9 @@
+"""Extension system (reference: mythril/plugin/)."""
+
+from mythril_tpu.plugin.discovery import PluginDiscovery
+from mythril_tpu.plugin.interface import (
+    MythrilCLIPlugin,
+    MythrilLaserPlugin,
+    MythrilPlugin,
+)
+from mythril_tpu.plugin.loader import MythrilPluginLoader

@@ -1,0 +1,61 @@
+"""Entry-point based plugin discovery.
+
+Reference parity: mythril/plugin/discovery.py:9-58 — loads every
+package exposing a `mythril.plugins` setuptools entry point (the same
+group name is kept so existing third-party plugin packages resolve).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from mythril_tpu.plugin.interface import MythrilPlugin
+from mythril_tpu.support.support_utils import Singleton
+
+ENTRY_POINT_GROUP = "mythril.plugins"
+
+
+class PluginDiscovery(object, metaclass=Singleton):
+    """Discovers and builds plugins from installed python packages."""
+
+    _installed_plugins: Optional[Dict[str, Any]] = None
+
+    def init_installed_plugins(self) -> None:
+        try:
+            from importlib.metadata import entry_points
+
+            eps = entry_points()
+            if hasattr(eps, "select"):
+                group = eps.select(group=ENTRY_POINT_GROUP)
+            else:
+                group = eps.get(ENTRY_POINT_GROUP, [])
+            self._installed_plugins = {ep.name: ep.load() for ep in group}
+        except Exception:
+            self._installed_plugins = {}
+
+    @property
+    def installed_plugins(self):
+        if self._installed_plugins is None:
+            self.init_installed_plugins()
+        return self._installed_plugins
+
+    def is_installed(self, plugin_name: str) -> bool:
+        return plugin_name in self.installed_plugins.keys()
+
+    def build_plugin(self, plugin_name: str, plugin_args: Dict) -> MythrilPlugin:
+        if not self.is_installed(plugin_name):
+            raise ValueError(f"Plugin with name: `{plugin_name}` is not installed")
+        plugin = self.installed_plugins.get(plugin_name)
+        if plugin is None or not issubclass(plugin, MythrilPlugin):
+            raise ValueError(f"No valid plugin was found for {plugin_name}")
+        return plugin(**plugin_args)
+
+    def get_plugins(self, default_enabled=None) -> List[str]:
+        if default_enabled is None:
+            return list(self.installed_plugins.keys())
+        return [
+            plugin_name
+            for plugin_name, plugin_class in self.installed_plugins.items()
+            if getattr(plugin_class, "plugin_default_enabled", False)
+            == default_enabled
+        ]

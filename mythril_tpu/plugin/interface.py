@@ -1,0 +1,40 @@
+"""Third-party extension interfaces.
+
+Reference parity: mythril/plugin/interface.py:5-45 — `MythrilPlugin`
+(metadata base), `MythrilCLIPlugin`, and `MythrilLaserPlugin` (a
+MythrilPlugin that is also a laser PluginBuilder).
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+
+from mythril_tpu.laser.plugin.builder import PluginBuilder as LaserPluginBuilder
+
+
+class MythrilPlugin:
+    """Base for installable extensions: laser plugins, strategies,
+    detection modules, or CLI commands."""
+
+    author = "Default Author"
+    name = "Plugin Name"
+    plugin_license = "All rights reserved."
+    plugin_type = "Mythril Plugin"
+    plugin_version = "0.0.1 "
+    plugin_description = "This is an example plugin description"
+    plugin_default_enabled = False
+
+    def __init__(self, **kwargs):
+        pass
+
+    def __repr__(self):
+        plugin_name = type(self).__name__
+        return f"{plugin_name} - {self.plugin_version} - {self.author}"
+
+
+class MythrilCLIPlugin(MythrilPlugin):
+    """Adds commands to the CLI."""
+
+
+class MythrilLaserPlugin(MythrilPlugin, LaserPluginBuilder, ABC):
+    """Instruments the laser EVM."""

@@ -1,0 +1,70 @@
+"""Top-level plugin loader dispatching by plugin kind.
+
+Reference parity: mythril/plugin/loader.py:22-80 — detection modules
+register with the ModuleLoader; laser plugins with the
+LaserPluginLoader; instantiated once at CLI import.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from mythril_tpu.analysis.module import DetectionModule
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+from mythril_tpu.plugin.discovery import PluginDiscovery
+from mythril_tpu.plugin.interface import MythrilLaserPlugin, MythrilPlugin
+from mythril_tpu.support.support_utils import Singleton
+
+log = logging.getLogger(__name__)
+
+
+class UnsupportedPluginType(Exception):
+    """A plugin of an unsupported kind was loaded."""
+
+
+class MythrilPluginLoader(object, metaclass=Singleton):
+    """Loads MythrilPlugins, dispatching to kind-specific loaders."""
+
+    def __init__(self):
+        log.info("Initializing mythril plugin loader")
+        self.loaded_plugins = []
+        self.plugin_args: Dict[str, Dict] = dict()
+        self._load_default_enabled()
+
+    def set_args(self, plugin_name: str, **kwargs):
+        self.plugin_args[plugin_name] = kwargs
+
+    def load(self, plugin: MythrilPlugin):
+        if not isinstance(plugin, MythrilPlugin):
+            raise ValueError("Passed plugin is not of type MythrilPlugin")
+        log.info("Loading plugin: %s", str(plugin))
+
+        if isinstance(plugin, DetectionModule):
+            self._load_detection_module(plugin)
+        elif isinstance(plugin, MythrilLaserPlugin):
+            self._load_laser_plugin(plugin)
+        else:
+            raise UnsupportedPluginType("Passed plugin type is not yet supported")
+
+        self.loaded_plugins.append(plugin)
+        log.info("Finished loading plugin: %s", plugin.name)
+
+    @staticmethod
+    def _load_detection_module(plugin) -> None:
+        log.info("Loading detection module: %s", plugin.name)
+        ModuleLoader().register_module(plugin)
+
+    @staticmethod
+    def _load_laser_plugin(plugin) -> None:
+        log.info("Loading laser plugin: %s", plugin.name)
+        LaserPluginLoader().load(plugin)
+
+    def _load_default_enabled(self) -> None:
+        log.info("Loading installed analysis modules that are enabled by default")
+        for plugin_name in PluginDiscovery().get_plugins(default_enabled=True):
+            plugin = PluginDiscovery().build_plugin(
+                plugin_name, self.plugin_args.get(plugin_name, {})
+            )
+            self.load(plugin)

@@ -1,0 +1,1 @@
+"""Solidity source handling (reference: mythril/solidity/)."""

@@ -1,0 +1,198 @@
+"""Solidity files, contracts and source mappings.
+
+Reference parity: mythril/solidity/soliditycontract.py:47-229 — solc
+standard-json compilation, srcmap parsing (offset:length:fileidx per
+instruction, constructor maps included), and `get_source_info` mapping
+a bytecode address back to (file, line, code).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+import mythril_tpu.laser.ethereum.util as helper
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.ethereum.util import get_solc_json
+from mythril_tpu.exceptions import NoContractFoundError
+
+
+class SourceMapping:
+    def __init__(self, solidity_file_idx, offset, length, lineno, mapping):
+        self.solidity_file_idx = solidity_file_idx
+        self.offset = offset
+        self.length = length
+        self.lineno = lineno
+        self.solc_mapping = mapping
+
+
+class SolidityFile:
+    """One Solidity source file."""
+
+    def __init__(self, filename: str, data: str, full_contract_src_maps: Set[str]):
+        self.filename = filename
+        self.data = data
+        self.full_contract_src_maps = full_contract_src_maps
+
+
+class SourceCodeInfo:
+    def __init__(self, filename, lineno, code, mapping):
+        self.filename = filename
+        self.lineno = lineno
+        self.code = code
+        self.solc_mapping = mapping
+
+
+def get_contracts_from_file(input_file, solc_settings_json=None, solc_binary="solc"):
+    """Yield a SolidityContract for every deployable contract in the
+    file."""
+    data = get_solc_json(
+        input_file, solc_settings_json=solc_settings_json, solc_binary=solc_binary
+    )
+    try:
+        for contract_name in data["contracts"][input_file].keys():
+            if len(
+                data["contracts"][input_file][contract_name]["evm"][
+                    "deployedBytecode"
+                ]["object"]
+            ):
+                yield SolidityContract(
+                    input_file=input_file,
+                    name=contract_name,
+                    solc_settings_json=solc_settings_json,
+                    solc_binary=solc_binary,
+                )
+    except KeyError:
+        raise NoContractFoundError
+
+
+class SolidityContract(EVMContract):
+    """A contract compiled from Solidity source."""
+
+    def __init__(
+        self, input_file, name=None, solc_settings_json=None, solc_binary="solc"
+    ):
+        data = get_solc_json(
+            input_file, solc_settings_json=solc_settings_json, solc_binary=solc_binary
+        )
+
+        self.solidity_files = []
+        self.solc_json = data
+        self.input_file = input_file
+
+        for filename, contract in data["sources"].items():
+            with open(filename, "r", encoding="utf-8") as file:
+                code = file.read()
+                full_contract_src_maps = self.get_full_contract_src_maps(
+                    contract["ast"]
+                )
+                self.solidity_files.append(
+                    SolidityFile(filename, code, full_contract_src_maps)
+                )
+
+        has_contract = False
+        srcmap_constructor = []
+        srcmap = []
+
+        if name:
+            contract = data["contracts"][input_file][name]
+            if len(contract["evm"]["deployedBytecode"]["object"]):
+                code = contract["evm"]["deployedBytecode"]["object"]
+                creation_code = contract["evm"]["bytecode"]["object"]
+                srcmap = contract["evm"]["deployedBytecode"]["sourceMap"].split(";")
+                srcmap_constructor = contract["evm"]["bytecode"]["sourceMap"].split(";")
+                has_contract = True
+        else:
+            # no name given: last deployable contract in the file
+            for contract_name, contract in sorted(
+                data["contracts"][input_file].items()
+            ):
+                if len(contract["evm"]["deployedBytecode"]["object"]):
+                    name = contract_name
+                    code = contract["evm"]["deployedBytecode"]["object"]
+                    creation_code = contract["evm"]["bytecode"]["object"]
+                    srcmap = contract["evm"]["deployedBytecode"]["sourceMap"].split(";")
+                    srcmap_constructor = contract["evm"]["bytecode"][
+                        "sourceMap"
+                    ].split(";")
+                    has_contract = True
+
+        if not has_contract:
+            raise NoContractFoundError
+
+        self.mappings = []
+        self.constructor_mappings = []
+        self._get_solc_mappings(srcmap)
+        self._get_solc_mappings(srcmap_constructor, constructor=True)
+
+        super().__init__(code, creation_code, name=name)
+
+    @staticmethod
+    def get_full_contract_src_maps(ast: Dict) -> Set[str]:
+        """The whole-contract src mappings (used to recognize compiler-
+        generated code)."""
+        source_maps = set()
+        for child in ast.get("nodes", []):
+            if child.get("contractKind"):
+                source_maps.add(child["src"])
+        return source_maps
+
+    def get_source_info(self, address, constructor=False):
+        """Map a bytecode offset to (file, line, code)."""
+        disassembly = self.creation_disassembly if constructor else self.disassembly
+        mappings = self.constructor_mappings if constructor else self.mappings
+        index = helper.get_instruction_index(disassembly.instruction_list, address)
+        if index is None or index >= len(mappings):
+            return None
+
+        solidity_file = self.solidity_files[mappings[index].solidity_file_idx]
+        filename = solidity_file.filename
+        offset = mappings[index].offset
+        length = mappings[index].length
+        code = solidity_file.data.encode("utf-8")[offset : offset + length].decode(
+            "utf-8", errors="ignore"
+        )
+        lineno = mappings[index].lineno
+        return SourceCodeInfo(filename, lineno, code, mappings[index].solc_mapping)
+
+    def _is_autogenerated_code(self, offset: int, length: int, file_index: int) -> bool:
+        """Compiler-generated code has no real source line."""
+        if file_index == -1:
+            return True
+        if file_index >= len(self.solidity_files):
+            return True
+        if (
+            "{}:{}:{}".format(offset, length, file_index)
+            in self.solidity_files[file_index].full_contract_src_maps
+        ):
+            return True
+        return False
+
+    def _get_solc_mappings(self, srcmap, constructor=False):
+        """Expand a compressed solc source map (empty fields repeat the
+        previous entry)."""
+        mappings = self.constructor_mappings if constructor else self.mappings
+        prev_item = ""
+        offset = length = idx = 0
+        for item in srcmap:
+            if item == "":
+                item = prev_item
+            mapping = item.split(":")
+
+            if len(mapping) > 0 and len(mapping[0]) > 0:
+                offset = int(mapping[0])
+            if len(mapping) > 1 and len(mapping[1]) > 0:
+                length = int(mapping[1])
+            if len(mapping) > 2 and len(mapping[2]) > 0:
+                idx = int(mapping[2])
+
+            if self._is_autogenerated_code(offset, length, idx):
+                lineno = None
+            else:
+                lineno = (
+                    self.solidity_files[idx]
+                    .data.encode("utf-8")[0:offset]
+                    .count("\n".encode("utf-8"))
+                    + 1
+                )
+            prev_item = item
+            mappings.append(SourceMapping(idx, offset, length, lineno, item))

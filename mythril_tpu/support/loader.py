@@ -1,0 +1,71 @@
+"""DynLoader: on-demand chain data for lazy storage/code hydration.
+
+Reference parity: mythril/support/loader.py:15-95 — `read_storage`,
+`read_balance`, `dynld(address) -> Disassembly`, all lru-cached.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import re
+from typing import Optional
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+
+LRU_CACHE_SIZE = 4096
+
+log = logging.getLogger(__name__)
+
+
+class DynLoader:
+    """Loads storage slots, balances and dependency bytecode over RPC."""
+
+    def __init__(self, eth, active: bool = True):
+        self.eth = eth
+        self.active = active
+
+    @functools.lru_cache(LRU_CACHE_SIZE)
+    def read_storage(self, contract_address: str, index: int) -> str:
+        if not self.active:
+            raise ValueError("Loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the storage when eth is None")
+        return self.eth.eth_getStorageAt(
+            contract_address, position=index, block="latest"
+        )
+
+    @functools.lru_cache(LRU_CACHE_SIZE)
+    def read_balance(self, address: str) -> str:
+        if not self.active:
+            raise ValueError("Cannot load from storage when the loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the chain when eth is None")
+        return self.eth.eth_getBalance(address)
+
+    @functools.lru_cache(LRU_CACHE_SIZE)
+    def dynld(self, dependency_address: str) -> Optional[Disassembly]:
+        """Fetch and disassemble a dependency contract's code."""
+        if not self.active:
+            raise ValueError("Loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the chain when eth is None")
+
+        log.debug("Dynld at contract %s", dependency_address)
+        if isinstance(dependency_address, int):
+            dependency_address = "0x{:040X}".format(dependency_address)
+        else:
+            dependency_address = (
+                "0x" + "0" * (42 - len(dependency_address)) + dependency_address[2:]
+            )
+
+        m = re.match(r"^(0x[0-9a-fA-F]{40})$", dependency_address)
+        if not m:
+            return None
+        dependency_address = m.group(1)
+
+        log.debug("Dependency address: %s", dependency_address)
+        code = self.eth.eth_getCode(dependency_address)
+        if code == "0x":
+            return None
+        return Disassembly(code)

@@ -1,0 +1,145 @@
+"""RLP codec + Merkle-Patricia trie reader tests over an in-memory
+store (the reference integration-tests against a real geth LevelDB;
+an injected dict store exercises the same read paths hermetically)."""
+
+import pytest
+
+from mythril_tpu.ethereum.interface.leveldb import rlp_codec as rlp
+from mythril_tpu.ethereum.interface.leveldb.trie import Trie
+from mythril_tpu.support.keccak import keccak256
+
+
+class DictDB:
+    def __init__(self):
+        self.store = {}
+
+    def get(self, key):
+        return self.store.get(key)
+
+    def put(self, key, value):
+        self.store[key] = value
+
+
+# -- RLP ------------------------------------------------------------------
+def test_rlp_roundtrip_scalars():
+    for item in [b"", b"\x01", b"dog", b"\x80", bytes(100)]:
+        assert rlp.decode(rlp.encode(item)) == item
+
+
+def test_rlp_roundtrip_nested():
+    item = [b"cat", [b"dog", b""], [[b"\x01"], b"\xff" * 60]]
+    assert rlp.decode(rlp.encode(item)) == item
+
+
+def test_rlp_known_vectors():
+    # canonical vectors from the Ethereum wiki
+    assert rlp.encode(b"dog") == b"\x83dog"
+    assert rlp.encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp.encode(b"") == b"\x80"
+    assert rlp.encode([]) == b"\xc0"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+
+
+# -- trie -----------------------------------------------------------------
+def build_trie(items):
+    """Construct a hexary trie bottom-up in a dict store and return
+    (db, root). Uses the simple always-hash node encoding — the reader
+    accepts both hashed and embedded nodes."""
+    from collections import defaultdict
+
+    db = DictDB()
+
+    def to_nibbles(key):
+        out = []
+        for b in key:
+            out += [b >> 4, b & 0x0F]
+        return out
+
+    def hp_encode(nibbles, is_leaf):
+        flag = 2 if is_leaf else 0
+        if len(nibbles) % 2:
+            flag += 1
+            data = [flag] + nibbles
+        else:
+            data = [flag, 0] + nibbles
+        return bytes(
+            (data[i] << 4) | data[i + 1] for i in range(0, len(data), 2)
+        )
+
+    def store(node):
+        raw = rlp.encode(node)
+        h = keccak256(raw)
+        db.put(h, raw)
+        return h
+
+    def insert(items):
+        # items: list of (nibble-list, value)
+        if not items:
+            return b""
+        if len(items) == 1:
+            nibbles, value = items[0]
+            return store([hp_encode(nibbles, True), value])
+        # group by first nibble
+        groups = defaultdict(list)
+        value_here = b""
+        for nibbles, value in items:
+            if not nibbles:
+                value_here = value
+            else:
+                groups[nibbles[0]].append((nibbles[1:], value))
+        branch = [b""] * 17
+        for nib, sub in groups.items():
+            branch[nib] = insert(sub)
+        branch[16] = value_here
+        return store(branch)
+
+    root = insert([(to_nibbles(k), v) for k, v in items])
+    return db, root
+
+
+def test_trie_get_and_iterate():
+    items = [
+        (keccak256(b"alpha"), b"value-a"),
+        (keccak256(b"beta"), b"value-b"),
+        (keccak256(b"gamma"), b"value-c"),
+    ]
+    db, root = build_trie(items)
+    trie = Trie(db, root)
+
+    for key, value in items:
+        assert trie.get(key) == value
+    assert trie.get(keccak256(b"missing")) is None
+
+    found = dict(trie.iter_items())
+    assert found == dict(items)
+
+
+def test_trie_empty_root():
+    trie = Trie(DictDB(), b"")
+    assert trie.get(b"\x00" * 32) is None
+    assert list(trie.iter_items()) == []
+
+
+# -- state over trie ------------------------------------------------------
+def test_state_account_read():
+    from mythril_tpu.ethereum.interface.leveldb.state import State
+
+    address = bytes.fromhex("deadbeef" * 5)
+    code = bytes.fromhex("33ff")
+    code_hash = keccak256(code)
+    account_rlp = rlp.encode(
+        [1, 10**18, keccak256(rlp.encode(b"")), code_hash]
+    )
+    db, root = build_trie([(keccak256(address), account_rlp)])
+    db.put(code_hash, code)
+
+    state = State(db, root)
+    account = state.get_and_cache_account(address)
+    assert account.nonce == 1
+    assert account.balance == 10**18
+    assert account.code == code
+
+    accounts = list(state.get_all_accounts())
+    assert len(accounts) == 1
+    assert accounts[0].code == code

@@ -1,0 +1,156 @@
+"""CLI end-to-end tests via subprocess (reference test strategy:
+tests/cmd_line_test.py golden runs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MYTH = os.path.join(REPO, "myth")
+
+
+def run_myth(*cli_args, timeout=240):
+    return subprocess.run(
+        [sys.executable, MYTH, *cli_args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def test_version():
+    out = run_myth("version")
+    assert "version" in out.stdout.lower()
+
+
+def test_version_json():
+    out = run_myth("version", "-o", "json")
+    assert "version_str" in json.loads(out.stdout)
+
+
+def test_list_detectors():
+    out = run_myth("list-detectors")
+    assert "EtherThief" in out.stdout
+    assert len(out.stdout.strip().splitlines()) == 14
+
+
+def test_function_to_hash():
+    out = run_myth("function-to-hash", "transfer(address,uint256)")
+    assert out.stdout.strip() == "0xa9059cbb"
+
+
+def test_disassemble():
+    out = run_myth("disassemble", "-c", "33ff", "--bin-runtime")
+    assert "CALLER" in out.stdout
+    assert "SUICIDE" in out.stdout
+
+
+def test_analyze_detects_selfdestruct_text():
+    out = run_myth(
+        "analyze",
+        "-c",
+        "33ff",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "--execution-timeout",
+        "60",
+    )
+    assert "Unprotected Selfdestruct" in out.stdout
+    assert "SWC ID: 106" in out.stdout
+    assert "[ATTACKER]" in out.stdout
+
+
+def test_analyze_json_output():
+    out = run_myth(
+        "analyze",
+        "-c",
+        "33ff",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "-o",
+        "json",
+        "--execution-timeout",
+        "60",
+    )
+    data = json.loads(out.stdout)
+    assert data["success"] is True
+    assert len(data["issues"]) == 1
+    assert data["issues"][0]["swc-id"] == "106"
+
+
+def test_analyze_jsonv2_output():
+    out = run_myth(
+        "analyze",
+        "-c",
+        "33ff",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "-o",
+        "jsonv2",
+        "--execution-timeout",
+        "60",
+    )
+    data = json.loads(out.stdout)
+    assert data[0]["issues"][0]["swcID"] == "SWC-106"
+
+
+def test_analyze_clean_contract_no_issues():
+    out = run_myth(
+        "analyze",
+        "-c",
+        "6001600055",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "--execution-timeout",
+        "60",
+    )
+    assert "No issues were detected" in out.stdout
+
+
+def test_analyze_statespace_json(tmp_path):
+    out_file = tmp_path / "statespace.json"
+    run_myth(
+        "analyze",
+        "-c",
+        "600035600757005b00",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "-j",
+        str(out_file),
+        "--execution-timeout",
+        "60",
+    )
+    data = json.loads(out_file.read_text())
+    assert data["nodes"]
+
+
+def test_analyze_graph_html(tmp_path):
+    out_file = tmp_path / "graph.html"
+    run_myth(
+        "analyze",
+        "-c",
+        "600035600757005b00",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "-t",
+        "1",
+        "-g",
+        str(out_file),
+        "--execution-timeout",
+        "60",
+    )
+    assert "vis-network" in out_file.read_text()
