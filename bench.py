@@ -22,8 +22,8 @@ import sys
 import time
 
 BASELINE_STATES_PER_SEC = 2_000.0
-N_LANES = 4096
-N_STEPS = 256
+N_LANES = 16384
+N_STEPS = 1024
 
 
 def main() -> None:
