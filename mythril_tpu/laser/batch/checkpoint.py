@@ -1,0 +1,64 @@
+"""Checkpoint / resume for the batched engine.
+
+The reference has no checkpointing at all (SURVEY.md §5: its closest
+artifacts are the statespace JSON dump and inter-transaction open-state
+pruning). Because this engine's entire frontier is a pytree of fixed-
+shape arrays, a checkpoint is a plain `.npz`: every field of the
+StateBatch (and the code table it runs against), restorable onto any
+device topology — the lane axis reshards on load.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from mythril_tpu.laser.batch.state import CodeTable, StateBatch
+
+FORMAT_VERSION = 1
+
+
+def save_checkpoint(
+    path: Union[str, Path],
+    batch: StateBatch,
+    code: Optional[CodeTable] = None,
+    step: int = 0,
+) -> None:
+    """Write the frontier (and optionally the code table) to `path`."""
+    arrays = {f"batch.{name}": np.asarray(value) for name, value in batch._asdict().items()}
+    if code is not None:
+        arrays.update(
+            {f"code.{name}": np.asarray(value) for name, value in code._asdict().items()}
+        )
+    arrays["meta"] = np.frombuffer(
+        json.dumps({"version": FORMAT_VERSION, "step": int(step)}).encode(),
+        dtype=np.uint8,
+    )
+    np.savez_compressed(str(path), **arrays)
+
+
+def load_checkpoint(
+    path: Union[str, Path]
+) -> Tuple[StateBatch, Optional[CodeTable], int]:
+    """Restore (batch, code_table_or_None, step) from `path`."""
+    with np.load(str(path)) as data:
+        meta = json.loads(bytes(data["meta"]).decode())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint version {meta.get('version')}"
+            )
+        batch = StateBatch(
+            **{
+                name: data[f"batch.{name}"]
+                for name in StateBatch._fields
+            }
+        )
+        code = None
+        if f"code.{CodeTable._fields[0]}" in data:
+            code = CodeTable(
+                **{name: data[f"code.{name}"] for name in CodeTable._fields}
+            )
+    return batch, code, int(meta.get("step", 0))

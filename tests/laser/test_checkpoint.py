@@ -1,0 +1,69 @@
+"""Checkpoint/resume roundtrip for the batched engine frontier."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.batch.checkpoint import load_checkpoint, save_checkpoint
+from mythril_tpu.laser.batch.run import run
+from mythril_tpu.laser.batch.state import make_batch, make_code_table
+
+
+def demo():
+    # PUSH1 1 PUSH1 0 SSTORE STOP
+    code = make_code_table([bytes.fromhex("6001600055600060015500")])
+    batch = make_batch(8, calldata=[b"\x00" * 4] * 8)
+    return batch, code
+
+
+def test_roundtrip(tmp_path):
+    batch, code = demo()
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(path, batch, code, step=7)
+    restored, code2, step = load_checkpoint(path)
+
+    assert step == 7
+    assert code2 is not None
+    for name in batch._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(batch, name)), np.asarray(getattr(restored, name)),
+            err_msg=name,
+        )
+    for name in code._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(code, name)), np.asarray(getattr(code2, name)),
+            err_msg=name,
+        )
+
+
+def test_resume_continues_execution(tmp_path):
+    batch, code = demo()
+    # run 2 steps, checkpoint, then resume and run to completion
+    mid, steps = run(batch, code, max_steps=2)
+    path = tmp_path / "mid.npz"
+    save_checkpoint(path, mid, code, step=int(steps))
+    restored, code2, _ = load_checkpoint(path)
+
+    done_direct, _ = run(mid, code, max_steps=64)
+    done_resumed, _ = run(restored, code2, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(done_direct.status), np.asarray(done_resumed.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(done_direct.storage_vals), np.asarray(done_resumed.storage_vals)
+    )
+
+
+def test_version_guard(tmp_path):
+    batch, code = demo()
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(path, batch, code)
+    # corrupt the version
+    import json
+
+    data = dict(np.load(str(path)))
+    data["meta"] = np.frombuffer(
+        json.dumps({"version": 99}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(str(path), **data)
+    with pytest.raises(ValueError):
+        load_checkpoint(path)
