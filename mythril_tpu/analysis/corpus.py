@@ -1,0 +1,113 @@
+"""Corpus-parallel analysis: many contracts at once.
+
+The reference analyzes contracts strictly sequentially
+(mythril/mythril/mythril_analyzer.py:145-185 — a plain for-loop);
+SURVEY.md §2.4 maps that loop to this framework's corpus-sharding
+axis. Each worker process runs one contract through the standard
+SymExecWrapper + fire_lasers pipeline with fresh singleton state, so
+N workers deliver ~N× contracts/sec on the embarrassingly parallel
+part of the workload.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+def _analyze_one(payload: Tuple) -> Dict:
+    """Worker: analyze one contract, return issue dicts (run in a
+    spawned process; heavyweight imports stay inside)."""
+    (
+        code,
+        creation_code,
+        name,
+        address,
+        strategy,
+        transaction_count,
+        execution_timeout,
+        create_timeout,
+        max_depth,
+        loop_bound,
+        modules,
+        solver_timeout,
+    ) = payload
+    try:
+        from mythril_tpu.analysis.security import fire_lasers
+        from mythril_tpu.analysis.symbolic import SymExecWrapper
+        from mythril_tpu.ethereum.evmcontract import EVMContract
+        from mythril_tpu.support.support_args import args
+
+        if solver_timeout:
+            args.solver_timeout = solver_timeout
+
+        contract = EVMContract(
+            code=code or "", creation_code=creation_code or "", name=name
+        )
+        sym = SymExecWrapper(
+            contract,
+            address,
+            strategy,
+            max_depth=max_depth,
+            execution_timeout=execution_timeout,
+            loop_bound=loop_bound,
+            create_timeout=create_timeout,
+            transaction_count=transaction_count,
+            modules=modules,
+            compulsory_statespace=False,
+        )
+        issues = fire_lasers(sym, modules)
+        return {
+            "name": name,
+            "issues": [issue.as_dict for issue in issues],
+            "error": None,
+        }
+    except Exception:
+        return {"name": name, "issues": [], "error": traceback.format_exc()}
+
+
+def analyze_corpus(
+    contracts: List[Tuple[str, str, str]],
+    address: int = 0x901D573B8CE8C997DE5F19173C32D966B4Fa55FE,
+    strategy: str = "bfs",
+    transaction_count: int = 2,
+    execution_timeout: int = 60,
+    create_timeout: int = 10,
+    max_depth: int = 128,
+    loop_bound: int = 3,
+    modules: Optional[List[str]] = None,
+    solver_timeout: Optional[int] = None,
+    processes: Optional[int] = None,
+) -> List[Dict]:
+    """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
+    name), ...] across a process pool; returns one result dict per
+    contract ({name, issues, error})."""
+    payloads = [
+        (
+            code,
+            creation_code,
+            name,
+            address,
+            strategy,
+            transaction_count,
+            execution_timeout,
+            create_timeout,
+            max_depth,
+            loop_bound,
+            modules,
+            solver_timeout,
+        )
+        for code, creation_code, name in contracts
+    ]
+    processes = processes or min(len(payloads), mp.cpu_count())
+    if processes <= 1 or len(payloads) == 1:
+        return [_analyze_one(p) for p in payloads]
+
+    ctx = mp.get_context("spawn")  # fresh singletons per worker
+    with ctx.Pool(processes=processes) as pool:
+        results = pool.map(_analyze_one, payloads)
+    return results

@@ -43,7 +43,13 @@ class DetectionModule(ABC):
         self.cache: Set[int] = set()
 
     def reset_module(self):
+        # also drop the dedupe cache: it scopes one analysis, and a
+        # long-lived process (corpus mode, tests) would otherwise
+        # suppress identical addresses across unrelated contracts
+        # (the reference only clears `issues`, which leaks exactly that
+        # way when its API is driven in-process)
         self.issues = []
+        self.cache = set()
 
     def execute(self, target: GlobalState) -> Optional[List[Issue]]:
         log.debug("Entering analysis module: %s", self.__class__.__name__)

@@ -1,0 +1,38 @@
+"""Corpus-parallel analysis tests."""
+
+import pytest
+
+from mythril_tpu.analysis.corpus import analyze_corpus
+
+CONTRACTS = [
+    ("33ff", "", "Killable"),  # CALLER SELFDESTRUCT -> SWC-106
+    ("6001600055600060015500", "", "Clean"),  # plain storage write
+    ("600035600757005bfe", "", "Asserting"),  # reachable INVALID -> SWC-110
+]
+
+
+def swc_ids(result):
+    return {issue["swc-id"] for issue in result["issues"]}
+
+
+@pytest.mark.parametrize("processes", [1, 2])
+def test_corpus_analysis(processes):
+    results = analyze_corpus(
+        CONTRACTS,
+        transaction_count=1,
+        execution_timeout=90,
+        processes=processes,
+    )
+    by_name = {r["name"]: r for r in results}
+    assert by_name["Killable"]["error"] is None
+    assert "106" in swc_ids(by_name["Killable"])
+    assert swc_ids(by_name["Clean"]) == set()
+    assert "110" in swc_ids(by_name["Asserting"])
+
+
+def test_corpus_contains_worker_errors_not_raises():
+    # invalid hex must come back as a contained per-contract error
+    results = analyze_corpus(
+        [("zz-not-hex", "", "Broken")], transaction_count=1, processes=1
+    )
+    assert results[0]["error"] is not None

@@ -1,0 +1,77 @@
+"""Keccak function-manager constraint tests (reference test strategy:
+tests/laser/keccak_tests.py — sat/unsat assertions over the UF model)."""
+
+import pytest
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.ethereum.keccak_function_manager import (
+    KeccakFunctionManager,
+)
+from mythril_tpu.laser.smt import And, Not, symbol_factory
+from mythril_tpu.support.model import get_model
+
+
+@pytest.fixture()
+def km():
+    return KeccakFunctionManager()
+
+
+def test_concrete_keccak_is_real_hash(km):
+    from mythril_tpu.support.keccak import keccak256
+
+    data = symbol_factory.BitVecVal(42, 256)
+    result, cond = km.create_keccak(data)
+    expected = int.from_bytes(keccak256((42).to_bytes(32, "big")), "big")
+    assert result.value == expected
+    # the linking condition itself must be satisfiable
+    get_model((cond,))
+
+
+def test_symbolic_keccak_is_satisfiable(km):
+    x = symbol_factory.BitVecSym("kx", 256)
+    hash_x, cond = km.create_keccak(x)
+    model = get_model((cond,))
+    assert model is not None
+
+
+def test_injectivity_unsat(km):
+    """func(x) == func(y) with x != y must be unsat (inverse constraint
+    enforces injectivity)."""
+    x = symbol_factory.BitVecSym("ix", 256)
+    y = symbol_factory.BitVecSym("iy", 256)
+    hash_x, cond_x = km.create_keccak(x)
+    hash_y, cond_y = km.create_keccak(y)
+    with pytest.raises(UnsatError):
+        get_model(
+            (cond_x, cond_y, hash_x == hash_y, Not(x == y)),
+            solver_timeout=20000,
+            enforce_execution_time=False,
+        )
+
+
+def test_equal_inputs_give_equal_hashes(km):
+    x = symbol_factory.BitVecSym("ex", 256)
+    y = symbol_factory.BitVecSym("ey", 256)
+    hash_x, cond_x = km.create_keccak(x)
+    hash_y, cond_y = km.create_keccak(y)
+    model = get_model(
+        (cond_x, cond_y, x == y, hash_x == hash_y),
+        solver_timeout=20000,
+        enforce_execution_time=False,
+    )
+    assert model is not None
+
+
+def test_symbolic_can_match_concrete(km):
+    """A symbolic input can hash to a concrete input's real hash when
+    they are equal (the Or-linkage case)."""
+    concrete = symbol_factory.BitVecVal(7, 256)
+    concrete_hash, cond_c = km.create_keccak(concrete)
+    x = symbol_factory.BitVecSym("mx", 256)
+    hash_x, cond_x = km.create_keccak(x)
+    model = get_model(
+        (cond_c, cond_x, x == concrete, hash_x == concrete_hash),
+        solver_timeout=20000,
+        enforce_execution_time=False,
+    )
+    assert model is not None
