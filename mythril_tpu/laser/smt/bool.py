@@ -57,10 +57,13 @@ class Bool(Expression):
         raise NotImplementedError
 
     def __bool__(self):
+        # reference semantics (mythril/laser/smt/bool.py:73-79): a
+        # symbolic Bool is falsy. Engine algorithms rely on this — e.g.
+        # `x in list_of_bitvecs` works through __eq__ because interned
+        # terms make structural equality concrete-True while distinct
+        # terms stay symbolic (treated as not-equal).
         v = self.value
-        if v is None:
-            raise TypeError("cannot cast symbolic Bool to bool; use .value")
-        return v
+        return v if v is not None else False
 
 
 def And(*args: Union[Bool, bool]) -> Bool:

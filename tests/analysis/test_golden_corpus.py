@@ -1,0 +1,92 @@
+"""Golden end-to-end runs on the reference's precompiled contracts
+(reference test strategy: tests/cmd_line_test.py +
+testdata/outputs_expected golden files)."""
+
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.ethereum.evmcontract import EVMContract
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+EXPECTED = Path("/root/reference/tests/testdata/outputs_expected")
+
+if not INPUTS.is_dir():  # pragma: no cover
+    pytest.skip("reference testdata not available", allow_module_level=True)
+
+
+def analyze(name, tx_count=2, timeout=60):
+    code = (INPUTS / name).read_text().strip()
+    contract = EVMContract(code=code, name=name)
+    sym = SymExecWrapper(
+        contract,
+        address=0x901D573B8CE8C997DE5F19173C32D966B4FA55FE,
+        strategy="bfs",
+        execution_timeout=timeout,
+        create_timeout=10,
+        transaction_count=tx_count,
+        compulsory_statespace=False,
+    )
+    return {i.swc_id for i in fire_lasers(sym)}
+
+
+def test_easm_golden_all_inputs():
+    """Disassembly must match the reference's golden .easm files
+    byte-for-byte."""
+    count = 0
+    for f in sorted(INPUTS.glob("*.sol.o")):
+        contract = EVMContract(code=f.read_text().strip(), name=f.name)
+        gold = (EXPECTED / (f.name + ".easm")).read_text()
+        assert contract.get_easm() == gold, f.name
+        count += 1
+    assert count == 13
+
+
+def test_suicide_contract():
+    assert "106" in analyze("suicide.sol.o")
+
+
+def test_origin_contract():
+    assert "115" in analyze("origin.sol.o")
+
+
+def test_exceptions_contract():
+    assert "110" in analyze("exceptions.sol.o")
+
+
+def test_multi_contracts():
+    assert "105" in analyze("multi_contracts.sol.o")
+
+
+def test_nonascii_contract_clean():
+    assert analyze("nonascii.sol.o") == set()
+
+
+@pytest.mark.slow
+def test_overflow_contract():
+    assert "101" in analyze("overflow.sol.o", timeout=90)
+
+
+@pytest.mark.slow
+def test_underflow_contract():
+    assert "101" in analyze("underflow.sol.o", timeout=90)
+
+
+@pytest.mark.slow
+def test_ether_send_contract():
+    swcs = analyze("ether_send.sol.o", timeout=90)
+    assert "105" in swcs
+
+
+@pytest.mark.slow
+def test_kinds_of_calls_contract():
+    swcs = analyze("kinds_of_calls.sol.o", timeout=90)
+    assert "112" in swcs
+    assert "104" in swcs
+
+
+@pytest.mark.slow
+def test_returnvalue_contract():
+    assert "104" in analyze("returnvalue.sol.o", timeout=90)

@@ -27,3 +27,20 @@ jax.config.update("jax_platforms", "cpu")
 os.makedirs("/tmp/mtpu_xla_cache", exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", "/tmp/mtpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running golden analyses (run explicitly with -m slow)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    if config.getoption("-m"):
+        return
+    skip_slow = _pytest.mark.skip(reason="slow golden analysis; use -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
