@@ -68,6 +68,12 @@ class SymExecWrapper:
         run_analysis_modules: bool = True,
         custom_modules_directory: str = "",
     ):
+        # fresh per-contract solver session: the blast store shares
+        # structure within one analysis but would tax the next contract
+        from mythril_tpu.laser.smt.solver.solver import reset_blast_session
+
+        reset_blast_session()
+
         if isinstance(address, str):
             address = symbol_factory.BitVecVal(int(address, 16), 256)
         if isinstance(address, int):

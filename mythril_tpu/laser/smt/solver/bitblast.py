@@ -15,6 +15,7 @@ preprocess.py which rewrites those to udiv/urem + ite).
 
 from __future__ import annotations
 
+from array import array
 from typing import Dict, List, Tuple
 
 from mythril_tpu.laser.smt import terms
@@ -27,17 +28,24 @@ FALSE_LIT = -1
 class Blaster:
     def __init__(self):
         self.nvars = 1  # var 1 = constant TRUE
-        self.clauses: List[List[int]] = [[TRUE_LIT]]
+        # definitional clause store, flat 0-separated DIMACS stream —
+        # one bulk FFI call loads it into the native solver
+        self.flat = array("i", [TRUE_LIT, 0])
         self.bv_cache: Dict[int, List[int]] = {}
         self.bool_cache: Dict[int, int] = {}
         self.gate_cache: Dict[Tuple, int] = {}
-        self.var_bits: Dict[str, List[int]] = {}  # bv var name -> sat vars
+        self.var_bits: Dict[Tuple[str, int], List[int]] = {}  # (name, width) -> sat vars
         self.bool_vars: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def new_var(self) -> int:
         self.nvars += 1
         return self.nvars
+
+    def _emit(self, *lits: int) -> None:
+        """Append one clause of non-constant literals to the store."""
+        self.flat.extend(lits)
+        self.flat.append(0)
 
     def add(self, *lits: int) -> None:
         # drop clauses satisfied by the constant; strip false constant lits
@@ -48,7 +56,8 @@ class Blaster:
             if l == FALSE_LIT:
                 continue
             out.append(l)
-        self.clauses.append(out)
+        self.flat.extend(out)
+        self.flat.append(0)
 
     # ---- gates ---------------------------------------------------------
     def g_and(self, *ins: int) -> int:
@@ -72,8 +81,8 @@ class Blaster:
         if o is None:
             o = self.new_var()
             for l in lits:
-                self.clauses.append([-o, l])
-            self.clauses.append([o] + [-l for l in lits])
+                self._emit(-o, l)
+            self._emit(o, *[-l for l in lits])
             self.gate_cache[key] = o
         return o
 
@@ -99,7 +108,7 @@ class Blaster:
         o = self.gate_cache.get(key)
         if o is None:
             o = self.new_var()
-            self.clauses += [[-o, a, b], [-o, -a, -b], [o, -a, b], [o, a, -b]]
+            self._emit(-o, a, b); self._emit(-o, -a, -b); self._emit(o, -a, b); self._emit(o, a, -b)
             self.gate_cache[key] = o
         return o
 
@@ -127,7 +136,7 @@ class Blaster:
         o = self.gate_cache.get(key)
         if o is None:
             o = self.new_var()
-            self.clauses += [[-o, -c, a], [o, -c, -a], [-o, c, b], [o, c, -b]]
+            self._emit(-o, -c, a); self._emit(o, -c, -a); self._emit(-o, c, b); self._emit(o, c, -b)
             self.gate_cache[key] = o
         return o
 
@@ -152,10 +161,9 @@ class Blaster:
         o = self.gate_cache.get(key)
         if o is None:
             o = self.new_var()
-            self.clauses += [
-                [-o, a, b], [-o, a, c], [-o, b, c],
-                [o, -a, -b], [o, -a, -c], [o, -b, -c],
-            ]
+            for cl in ((-o, a, b), (-o, a, c), (-o, b, c),
+                       (o, -a, -b), (o, -a, -c), (o, -b, -c)):
+                self._emit(*cl)
             self.gate_cache[key] = o
         return o
 
@@ -239,11 +247,13 @@ class Blaster:
         if op == "const":
             return self.const_bits(t.args[0], w)
         if op == "var":
-            name = t.args[0]
-            bits = self.var_bits.get(name)
+            # keyed by (name, width): a persistent session may see the
+            # same name at several widths across queries
+            key = (t.args[0], w)
+            bits = self.var_bits.get(key)
             if bits is None:
                 bits = [self.new_var() for _ in range(w)]
-                self.var_bits[name] = bits
+                self.var_bits[key] = bits
             return bits
         if op in ("add", "sub", "mul", "udiv", "urem", "and", "or", "xor",
                   "shl", "lshr", "ashr"):
@@ -359,7 +369,3 @@ class Blaster:
                 return self.ult_bits(af, bf)
             return -self.ult_bits(bf, af)
         raise NotImplementedError(f"blast bool: {op}")
-
-    # ------------------------------------------------------------------
-    def assert_true(self, t: Term) -> None:
-        self.add(self.blast_bool(t))

@@ -43,6 +43,18 @@ def _load():
         lib.cdcl_value.restype = ctypes.c_int
         lib.cdcl_conflicts.argtypes = [ctypes.c_void_p]
         lib.cdcl_conflicts.restype = ctypes.c_int64
+        lib.cdcl_ensure_vars.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.cdcl_add_clauses_flat.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_longlong,
+        ]
+        lib.cdcl_add_clauses_flat.restype = ctypes.c_int
+        lib.cdcl_model_bits.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.c_int,
+        ]
         _lib = lib
     return _lib
 
@@ -52,29 +64,45 @@ SAT, UNSAT, UNKNOWN = 1, -1, 0
 _CHUNK = 20_000  # conflicts between wall-clock checks
 
 
-def solve_cnf(
-    nvars: int, clauses: List[List[int]], timeout_ms: Optional[int] = None
-) -> (int, Optional[List[int]]):
-    """Solve a CNF (DIMACS-style int lits). Returns (status, bits).
-
-    bits[v] for v in 0..nvars-1 (DIMACS var v+1), only on SAT.
-    Chunked conflict budgets bound wall-clock to ~timeout_ms.
-    """
+def solve_flat(
+    nvars: int,
+    flat_clauses,
+    units: List[int],
+    timeout_ms: Optional[int] = None,
+):
+    """Solve a CNF given as one flat 0-separated literal stream (an
+    `array('i')` — loaded into the native solver with a single
+    zero-copy FFI call) plus per-query unit assertions. Returns
+    (status, bits) with bits a bytearray indexed by var-1 on SAT."""
     lib = _load()
     s = lib.cdcl_new()
     try:
-        for _ in range(nvars):
-            lib.cdcl_new_var(s)
-        for c in clauses:
-            arr = (ctypes.c_int * len(c))(*c)
-            if not lib.cdcl_add_clause(s, arr, len(c)):
+        lib.cdcl_ensure_vars(s, nvars)
+        n = len(flat_clauses)
+        if n:
+            buf = (ctypes.c_int * n).from_buffer(flat_clauses)
+            ok = lib.cdcl_add_clauses_flat(s, buf, n)
+            del buf  # release the buffer export so the store can grow
+            if not ok:
                 return UNSAT, None
-        deadline = None if timeout_ms is None else time.monotonic() + timeout_ms / 1000.0
+        if units:
+            unit_stream = []
+            for u in units:
+                unit_stream += [u, 0]
+            arr = (ctypes.c_int * len(unit_stream))(*unit_stream)
+            if not lib.cdcl_add_clauses_flat(s, arr, len(unit_stream)):
+                return UNSAT, None
+
+        deadline = (
+            None if timeout_ms is None else time.monotonic() + timeout_ms / 1000.0
+        )
         budget = _CHUNK
         while True:
             r = lib.cdcl_solve(s, budget)
             if r == SAT:
-                return SAT, [max(lib.cdcl_value(s, v), 0) for v in range(nvars)]
+                out = (ctypes.c_ubyte * nvars)()
+                lib.cdcl_model_bits(s, out, nvars)
+                return SAT, bytearray(out)
             if r == UNSAT:
                 return UNSAT, None
             if deadline is not None and time.monotonic() >= deadline:

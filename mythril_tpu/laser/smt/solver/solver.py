@@ -162,6 +162,60 @@ class Optimize(BaseSolver):
 # ---------------------------------------------------------------------------
 
 
+# Persistent blasting session: gate clauses are pure Tseitin
+# definitions (they constrain nothing until a root literal is
+# asserted), so the store grows monotonically across queries and every
+# shared path-prefix constraint is blasted exactly once per run.
+#
+# Trade-off: each query reloads the whole store into a fresh native
+# solver (one bulk memcpy-like FFI call). That's a clear win while the
+# store stays analysis-sized (sessions reset per contract); a
+# delta-loading persistent native solver with assumption support would
+# remove the reload if profiles ever show it dominating.
+_session: Optional[Blaster] = None
+_SESSION_MAX_VARS = 2_000_000
+_SESSION_MAX_LITS = 40_000_000
+
+
+def _blast_session() -> Blaster:
+    global _session
+    if (
+        _session is None
+        or _session.nvars > _SESSION_MAX_VARS
+        or len(_session.flat) > _SESSION_MAX_LITS
+    ):
+        _session = Blaster()
+    return _session
+
+
+def reset_blast_session() -> None:
+    global _session
+    _session = None
+
+
+def _collect_vars(lowered: List[terms.Term]):
+    """Free (name, width) bit-vector vars and bool var names of a
+    lowered constraint set (iterative walk over the interned DAG)."""
+    bv_keys = set()
+    bool_names = set()
+    seen = set()
+    stack = list(lowered)
+    while stack:
+        t = stack.pop()
+        if t._id in seen:
+            continue
+        seen.add(t._id)
+        if t.op == "var":
+            bv_keys.add((t.args[0], t.width))
+        elif t.op == "bvar":
+            bool_names.add(t.args[0])
+        else:
+            for a in t.args:
+                if isinstance(a, terms.Term):
+                    stack.append(a)
+    return bv_keys, bool_names
+
+
 def check_terms(
     raw_constraints: List[terms.Term], timeout_ms: int = 10_000
 ) -> (str, Optional[Model]):
@@ -172,36 +226,52 @@ def check_terms(
     if not lowered:
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
-    blaster = Blaster()
+    blaster = _blast_session()
     import sys
 
     old_limit = sys.getrecursionlimit()
     sys.setrecursionlimit(200000)
+    units = []
     try:
         for c in lowered:
-            blaster.assert_true(c)
+            root = blaster.blast_bool(c)
+            if root == -1:  # constant false
+                return unsat, None
+            if root != 1:  # constant true contributes nothing
+                units.append(root)
     except (NotImplementedError, RecursionError):
         return unknown, None
     finally:
         sys.setrecursionlimit(old_limit)
 
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
-    status, bits = native_sat.solve_cnf(blaster.nvars, blaster.clauses, remaining)
+    status, bits = native_sat.solve_flat(
+        blaster.nvars, blaster.flat, units, remaining
+    )
     if status == native_sat.UNSAT:
         return unsat, None
     if status == native_sat.UNKNOWN:
         return unknown, None
 
-    # decode CNF bits -> word-level assignment for the lowered vars
+    # decode CNF bits -> word-level assignment, restricted to the vars
+    # this query references: the session store holds vars from every
+    # query this run, and a same-named var of another width would
+    # otherwise clobber the live one
+    bv_keys, bool_names = _collect_vars(lowered)
     base: Dict[str, int] = {}
-    for name, var_bits in blaster.var_bits.items():
+    for key in bv_keys:
+        var_bits = blaster.var_bits.get(key)
+        if var_bits is None:
+            continue
         val = 0
         for i, lit in enumerate(var_bits):
             if bits[lit - 1]:
                 val |= 1 << i
-        base[name] = val
+        base[key[0]] = val
     bools: Dict[str, int] = {
-        name: bits[v - 1] for name, v in blaster.bool_vars.items()
+        name: bits[blaster.bool_vars[name] - 1]
+        for name in bool_names
+        if name in blaster.bool_vars
     }
     model = _reconstruct(base, bools, recon, raw_constraints)
     if model is None:
@@ -218,10 +288,13 @@ def _reconstruct(
     """CNF assignment -> full model over the original vocabulary."""
     assignment: Dict = dict(base)
     assignment.update(bools)
-    # propagated bindings are constant terms
+    # propagated bindings are constant terms; they override any decoded
+    # SAT value — a persistent blast session may hold stale bits for a
+    # same-named var from an earlier query, and a bound var was never
+    # part of this query's CNF
     for name, val in recon.bindings.items():
         v = val.value
-        assignment.setdefault(name, v if v is not None else 0)
+        assignment[name] = v if v is not None else 0
     # arrays: evaluate each recorded select index under the assignment
     for arr_name, apps in recon.sel_apps.items():
         table = {}

@@ -431,4 +431,42 @@ int cdcl_value(void* s, int var) {
 
 int64_t cdcl_conflicts(void* s) { return ((Solver*)s)->conflicts; }
 
+// Create variables until the solver has at least n.
+void cdcl_ensure_vars(void* s, int n) {
+  Solver* solver = (Solver*)s;
+  while (solver->nvars < n) solver->new_var();
+}
+
+// Bulk clause load: lits is a 0-separated stream of DIMACS literals
+// ("a b 0 c d e 0 ..."), n entries total. One call replaces thousands
+// of per-clause FFI crossings. Returns 0 if the formula became
+// trivially unsat.
+int cdcl_add_clauses_flat(void* s, const int* lits, long long n) {
+  Solver* solver = (Solver*)s;
+  std::vector<int> internal;
+  internal.reserve(16);
+  for (long long i = 0; i < n; i++) {
+    int l = lits[i];
+    if (l == 0) {
+      if (!solver->ok) return 0;
+      solver->add_clause_internal(internal, false);
+      internal.clear();
+      if (!solver->ok) return 0;
+    } else {
+      int var = std::abs(l) - 1;
+      internal.push_back(mklit(var, l < 0));
+    }
+  }
+  return solver->ok ? 1 : 0;
+}
+
+// Bulk model extraction: out[v] = 1/0 for v in [0, n); unassigned
+// variables read as 0 (model completion).
+void cdcl_model_bits(void* s, unsigned char* out, int n) {
+  Solver* solver = (Solver*)s;
+  for (int v = 0; v < n; v++) {
+    out[v] = (v < solver->nvars && solver->assigns[v] == 1) ? 1 : 0;
+  }
+}
+
 }  // extern "C"

@@ -2,6 +2,7 @@
 (reference test strategy: tests/cmd_line_test.py +
 testdata/outputs_expected golden files)."""
 
+import os
 from pathlib import Path
 
 import pytest
@@ -10,11 +11,15 @@ from mythril_tpu.analysis.security import fire_lasers
 from mythril_tpu.analysis.symbolic import SymExecWrapper
 from mythril_tpu.ethereum.evmcontract import EVMContract
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
-EXPECTED = Path("/root/reference/tests/testdata/outputs_expected")
+REFERENCE_DIR = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+INPUTS = REFERENCE_DIR / "tests" / "testdata" / "inputs"
+EXPECTED = REFERENCE_DIR / "tests" / "testdata" / "outputs_expected"
 
 if not INPUTS.is_dir():  # pragma: no cover
-    pytest.skip("reference testdata not available", allow_module_level=True)
+    pytest.skip(
+        "reference testdata not found; set MYTHRIL_REFERENCE_DIR",
+        allow_module_level=True,
+    )
 
 
 def analyze(name, tx_count=2, timeout=60):
