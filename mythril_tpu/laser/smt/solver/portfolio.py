@@ -1,0 +1,545 @@
+"""On-chip portfolio search: SMT queries as TPU tensor programs.
+
+This is the north-star solver component (SURVEY.md §7.1): a lowered
+constraint set (bit-vector ops only — arrays/UF are gone after
+preprocess.lower) compiles to a flat tensor program over 16-bit limbs
+and is interpreted on device for K candidate assignments at once; a
+stochastic local search mutates candidates toward satisfying every
+constraint root. A found witness is decoded host-side and re-verified
+by the model soundness gate, so SAT answers are certain; *absence* of
+a witness proves nothing — the native CDCL solver remains the
+completeness oracle. The reference's counterpart is z3's
+`parallel.enable` thread pool (mythril/laser/smt/solver/__init__.py:8).
+
+Signed operations are compiled away with sign-bit constants:
+`slt(a,b) = ult(a^s, b^s)`, `sext_w0(x) = (x^s) - s`, `ashr` ORs a
+sign-fill mask — so the interpreter needs only unsigned primitives
+from ops/u256. Shapes are bucketed (nodes/consts/roots padded to size
+classes) so XLA compiles one interpreter per bucket, not per query.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.terms import Term
+
+LIMB_BITS = 16
+LIMB_MASK = 0xFFFF
+
+OPS = [
+    "const",    # 0: const_pool[imm0]
+    "var",      # 1: X[imm0]
+    "add", "sub", "mul", "udiv", "urem",            # 2-6
+    "bvand", "bvor", "bvxor", "bvnot",              # 7-10
+    "shl", "lshr",                                   # 11-12
+    "ashr",     # 13: imm0 = signbit const idx, imm1 = allones const idx
+    "concat",   # 14: (a << imm0) | b   (imm0 = width(b))
+    "extract",  # 15: a >> imm0, masked to node width
+    "zext",     # 16: identity (mask handles it)
+    "sext",     # 17: (a ^ pool[imm0]) - pool[imm0]
+    "ite",      # 18: bool(a) ? b : c
+    "eq",       # 19
+    "ult",      # 20
+    "ule",      # 21
+    "slt",      # 22: ult(a^pool[imm0], b^pool[imm0])
+    "sle",      # 23: ule(a^pool[imm0], b^pool[imm0])
+    "band", "bor", "bnot", "bxor", "implies",        # 24-28
+]
+OP_INDEX = {name: i for i, name in enumerate(OPS)}
+
+
+class Program:
+    """A compiled constraint set: flat node arrays + metadata."""
+
+    def __init__(self, opcodes, args, imms, widths, const_pool, var_slots,
+                 roots, roots_mask, limbs, n_real_nodes):
+        self.opcodes = opcodes          # [N] int32
+        self.args = args                # [N, 3] int32 node indices
+        self.imms = imms                # [N, 2] int32 immediates
+        self.widths = widths            # [N] int32
+        self.const_pool = const_pool    # [C, L] uint32 limbs
+        self.var_slots = var_slots      # slot -> (name, width)
+        self.roots = roots              # [R] int32 node indices
+        self.roots_mask = roots_mask    # [R] bool (False = padding)
+        self.limbs = limbs
+        self.n_real_nodes = n_real_nodes
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    size = lo
+    while size < n:
+        size *= 2
+    return size
+
+
+def compile_program(
+    lowered: List[Term], max_limbs: int = 64
+) -> Optional[Program]:
+    """Flatten the constraint DAG into tensor-program arrays; None when
+    an op falls outside the device language or widths exceed the cap."""
+    order: List[Term] = []
+    index: Dict[int, int] = {}
+
+    for root in lowered:
+        stack = [(root, False)]
+        while stack:
+            node, expanded = stack.pop()
+            if node._id in index:
+                continue
+            if expanded:
+                if node._id not in index:
+                    index[node._id] = len(order)
+                    order.append(node)
+                continue
+            stack.append((node, True))
+            for a in node.args:
+                if isinstance(a, Term) and a._id not in index:
+                    stack.append((a, False))
+
+    if not order:
+        return None
+    max_width = max((t.width or 1) for t in order)
+    L = max(16, _bucket((max_width + LIMB_BITS - 1) // LIMB_BITS, 16))
+    if L > max_limbs:
+        return None
+
+    n = len(order)
+    opcodes = np.zeros(n, dtype=np.int32)
+    args = np.zeros((n, 3), dtype=np.int32)
+    imms = np.zeros((n, 2), dtype=np.int32)
+    widths = np.ones(n, dtype=np.int32)
+    const_pool: List[int] = []
+    const_index: Dict[int, int] = {}
+    var_slots: List[Tuple[str, int]] = []
+    var_index: Dict[Tuple[str, int], int] = {}
+
+    def intern_const(value: int) -> int:
+        got = const_index.get(value)
+        if got is None:
+            got = const_index[value] = len(const_pool)
+            const_pool.append(value)
+        return got
+
+    def var_slot(key: Tuple[str, int]) -> int:
+        got = var_index.get(key)
+        if got is None:
+            got = var_index[key] = len(var_slots)
+            var_slots.append(key)
+        return got
+
+    for i, t in enumerate(order):
+        op = t.op
+        w = t.width or 1
+        widths[i] = w
+        if op == "const":
+            opcodes[i] = OP_INDEX["const"]
+            imms[i, 0] = intern_const(t.args[0])
+        elif op in ("true", "false"):
+            opcodes[i] = OP_INDEX["const"]
+            imms[i, 0] = intern_const(1 if op == "true" else 0)
+        elif op == "var":
+            opcodes[i] = OP_INDEX["var"]
+            imms[i, 0] = var_slot((t.args[0], w))
+        elif op == "bvar":
+            opcodes[i] = OP_INDEX["var"]
+            imms[i, 0] = var_slot((t.args[0], 1))
+        elif op == "extract":
+            hi, lo, a = t.args
+            opcodes[i] = OP_INDEX["extract"]
+            args[i, 0] = index[a._id]
+            imms[i, 0] = lo
+        elif op == "zext":
+            opcodes[i] = OP_INDEX["zext"]
+            args[i, 0] = index[t.args[0]._id]
+        elif op == "sext":
+            a = t.args[0]
+            opcodes[i] = OP_INDEX["sext"]
+            args[i, 0] = index[a._id]
+            imms[i, 0] = intern_const(1 << (a.width - 1))
+        elif op == "concat":
+            a, b = t.args
+            opcodes[i] = OP_INDEX["concat"]
+            args[i, 0] = index[a._id]
+            args[i, 1] = index[b._id]
+            imms[i, 0] = b.width
+        elif op in ("slt", "sle"):
+            a, b = t.args
+            opcodes[i] = OP_INDEX[op]
+            args[i, 0] = index[a._id]
+            args[i, 1] = index[b._id]
+            imms[i, 0] = intern_const(1 << (a.width - 1))
+        elif op == "ashr":
+            a, sh = t.args
+            opcodes[i] = OP_INDEX["ashr"]
+            args[i, 0] = index[a._id]
+            args[i, 1] = index[sh._id]
+            imms[i, 0] = intern_const(1 << (w - 1))
+            imms[i, 1] = intern_const((1 << w) - 1)
+        elif op == "ite":
+            c, a, b = t.args
+            opcodes[i] = OP_INDEX["ite"]
+            args[i, 0] = index[c._id]
+            args[i, 1] = index[a._id]
+            args[i, 2] = index[b._id]
+        elif op in OP_INDEX:
+            opcodes[i] = OP_INDEX[op]
+            for k, a in enumerate(t.args[:3]):
+                if isinstance(a, Term):
+                    args[i, k] = index[a._id]
+        else:
+            return None
+
+    roots = [index[c._id] for c in lowered]
+
+    n_pad = _bucket(n)
+    def pad(arr, shape, fill=0):
+        out = np.full(shape, fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    c_pad = _bucket(max(1, len(const_pool)), 16)
+    pool = np.zeros((c_pad, L), dtype=np.uint32)
+    for k, value in enumerate(const_pool):
+        for j in range(L):
+            pool[k, j] = (value >> (LIMB_BITS * j)) & LIMB_MASK
+
+    r_pad = _bucket(max(1, len(roots)), 16)
+    roots_arr = np.zeros(r_pad, dtype=np.int32)
+    roots_arr[: len(roots)] = roots
+    roots_mask = np.zeros(r_pad, dtype=bool)
+    roots_mask[: len(roots)] = True
+
+    return Program(
+        pad(opcodes, (n_pad,)),
+        pad(args, (n_pad, 3)),
+        pad(imms, (n_pad, 2)),
+        pad(widths, (n_pad,), fill=1),
+        pool,
+        var_slots,
+        roots_arr,
+        roots_mask,
+        L,
+        n,
+    )
+
+
+# ---------------------------------------------------------------------------
+# device interpreter + local search
+# ---------------------------------------------------------------------------
+
+_eval_cache: Dict[Tuple[int, int], object] = {}
+
+
+def _get_search_fn(K: int, L: int, steps: int):
+    """The jit'd evaluate-and-search kernel for (K candidates, L limbs,
+    steps); cached per shape bucket."""
+    key = (K, L, steps)
+    got = _eval_cache.get(key)
+    if got is not None:
+        return got
+
+    import jax
+    import jax.numpy as jnp
+
+    from mythril_tpu.ops import u256
+
+    def width_mask(width):
+        k = jnp.arange(L, dtype=jnp.int32)
+        bits = jnp.clip(width - k * LIMB_BITS, 0, LIMB_BITS)
+        # shift amount capped below the lane width (shift-by-16 on a
+        # 16-bit mask is what the full-limb branch handles)
+        partial = (jnp.uint32(1) << jnp.minimum(bits, 15).astype(jnp.uint32)) - 1
+        return jnp.where(bits >= LIMB_BITS, jnp.uint32(LIMB_MASK), partial)
+
+    def bcast_amount(amount):
+        """Broadcast a traced scalar shift amount to the batch shape
+        (u256 shift ops take one uint32 amount per batch element)."""
+        return jnp.full((K,), amount, dtype=jnp.uint32)
+
+    def to_bool(x):
+        return x[:, 0] != 0
+
+    FULL = jnp.int32(1 << 10)  # soft-score scale per constraint
+
+    def from_bool(hard, soft=None):
+        """Bool word: limb0 = 0/1 truth, limb1 = soft score [0, FULL]
+        (the local-search gradient; hard-only ops score 0 or FULL)."""
+        hard_u = hard.astype(jnp.uint32)
+        soft_u = (
+            (hard_u * FULL.astype(jnp.uint32))
+            if soft is None
+            else soft.astype(jnp.uint32)
+        )
+        return (
+            jnp.zeros((K, L), dtype=jnp.uint32)
+            .at[:, 0].set(hard_u)
+            .at[:, 1].set(soft_u)
+        )
+
+    def soft_of(x):
+        return x[:, 1].astype(jnp.int32)
+
+    def popcount_bits(x):
+        return jax.lax.population_count(x).sum(axis=-1).astype(jnp.int32)
+
+    def eval_program(opcodes, args, imms, widths, pool, X):
+        N = opcodes.shape[0]
+        values = jnp.zeros((N, K, L), dtype=jnp.uint32)
+
+        def body(values, i):
+            op = opcodes[i]
+            a = values[args[i, 0]]
+            b = values[args[i, 1]]
+            c = values[args[i, 2]]
+            imm0 = imms[i, 0]
+            imm1 = imms[i, 1]
+            w = widths[i]
+            k0 = jnp.broadcast_to(pool[imm0], (K, L))
+            k1 = jnp.broadcast_to(pool[imm1], (K, L))
+
+            def soft_eq(x, y, width):
+                # bit-level hamming credit: fully-equal -> FULL
+                diff = popcount_bits(u256.bit_xor(x, y))
+                width = jnp.maximum(width, 1)
+                return ((width - jnp.minimum(diff, width)) * FULL) // width
+
+            arg_w = widths[args[i, 0]]
+
+            branches = [
+                lambda: k0,                                       # const
+                lambda: X[imm0],                                  # var
+                lambda: u256.add(a, b),
+                lambda: u256.sub(a, b),
+                lambda: u256.mul(a, b),
+                lambda: u256.udiv(a, b),
+                lambda: u256.urem(a, b),
+                lambda: u256.bit_and(a, b),
+                lambda: u256.bit_or(a, b),
+                lambda: u256.bit_xor(a, b),
+                lambda: u256.bit_not(a),
+                lambda: u256.shl(a, u256.shift_amount(b)),
+                lambda: u256.lshr(a, u256.shift_amount(b)),
+                # ashr at node width: lshr | sign-fill
+                # (k0 = signbit const, k1 = allones-at-width const)
+                lambda: u256.bit_or(
+                    u256.lshr(a, u256.shift_amount(b)),
+                    jnp.where(
+                        to_bool_word(u256.bit_and(a, k0))[:, None],
+                        u256.bit_and(
+                            u256.bit_not(
+                                u256.lshr(k1, u256.shift_amount(b))
+                            ),
+                            k1,
+                        ),
+                        jnp.zeros((K, L), dtype=jnp.uint32),
+                    ),
+                ),
+                lambda: u256.bit_or(
+                    u256.shl(a, bcast_amount(imm0)), b
+                ),                                                # concat
+                lambda: u256.lshr(a, bcast_amount(imm0)),         # extract
+                lambda: a,                                        # zext
+                lambda: u256.sub(u256.bit_xor(a, k0), k0),        # sext
+                lambda: jnp.where(to_bool(a)[:, None], b, c),     # ite
+                lambda: from_bool(u256.eq(a, b), soft_eq(a, b, arg_w)),
+                lambda: from_bool(u256.ult(a, b)),
+                lambda: from_bool(u256.ule(a, b)),
+                lambda: from_bool(
+                    u256.ult(u256.bit_xor(a, k0), u256.bit_xor(b, k0))
+                ),                                                # slt
+                lambda: from_bool(
+                    u256.ule(u256.bit_xor(a, k0), u256.bit_xor(b, k0))
+                ),                                                # sle
+                lambda: from_bool(
+                    jnp.logical_and(to_bool(a), to_bool(b)),
+                    jnp.minimum(soft_of(a), soft_of(b)),
+                ),                                                # band
+                lambda: from_bool(
+                    jnp.logical_or(to_bool(a), to_bool(b)),
+                    jnp.maximum(soft_of(a), soft_of(b)),
+                ),                                                # bor
+                lambda: from_bool(
+                    jnp.logical_not(to_bool(a)), FULL - soft_of(a)
+                ),                                                # bnot
+                lambda: from_bool(jnp.logical_xor(to_bool(a), to_bool(b))),
+                lambda: from_bool(
+                    jnp.logical_or(jnp.logical_not(to_bool(a)), to_bool(b)),
+                    jnp.maximum(FULL - soft_of(a), soft_of(b)),
+                ),                                                # implies
+            ]
+            out = jax.lax.switch(op, branches)
+            mask = width_mask(w)
+            # bool nodes (width 1) keep limb1: it carries the soft score
+            mask = jnp.where(
+                w == 1, mask.at[1].set(jnp.uint32(LIMB_MASK)), mask
+            )
+            out = out & jnp.broadcast_to(mask, (K, L))
+            return values.at[i].set(out), None
+
+        values, _ = jax.lax.scan(body, values, jnp.arange(N, dtype=jnp.int32))
+        return values
+
+    def to_bool_word(x):
+        """Truthiness of a plain word value (non-bool nodes)."""
+        return jnp.logical_not(u256.is_zero(x))
+
+    def score(opcodes, args, imms, widths, pool, roots, roots_mask, X):
+        values = eval_program(opcodes, args, imms, widths, pool, X)
+        rv = values[roots]  # [R, K, L]
+        hard = (rv[..., 0] != 0) | ~roots_mask[:, None]
+        soft = jnp.where(
+            roots_mask[:, None], rv[..., 1].astype(jnp.int32), 0
+        )
+        return hard.all(axis=0), soft.sum(axis=0)  # [K] solved, [K] score
+
+    def search(opcodes, args, imms, widths, pool, roots, roots_mask,
+               var_widths, seed):
+        V = var_widths.shape[0]
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        # candidate pool: zeros, small values, random
+        X = jax.random.randint(
+            k1, (V, K, L), 0, 1 << LIMB_BITS, dtype=jnp.uint32
+        )
+        X = X.at[:, 0, :].set(0)                       # all-zero candidate
+        X = X.at[:, 1, :].set(0)
+        X = X.at[:, 1, 0].set(1)                       # all-one candidate
+        vmask = jax.vmap(width_mask)(var_widths)       # [V, L]
+        X = X & vmask[:, None, :]
+
+        solved0, score0 = score(
+            opcodes, args, imms, widths, pool, roots, roots_mask, X
+        )
+
+        limb_caps = jnp.maximum((var_widths + LIMB_BITS - 1) // LIMB_BITS, 1)
+
+        def body(state):
+            X, best_score, key, it, _ = state
+            key, kv, kk, kp, kb = jax.random.split(key, 5)
+            v = jax.random.randint(kv, (K,), 0, V)
+            kind = jax.random.randint(kk, (K,), 0, 5)
+            # only mutate limbs inside the var's width
+            limb = jax.random.randint(kp, (K,), 0, L) % limb_caps[v]
+            bits = jax.random.randint(
+                kb, (K,), 0, 1 << LIMB_BITS, dtype=jnp.uint32
+            )
+            cand = jnp.arange(K)
+            cur = X[v, cand, limb]
+            flipped = jnp.where(
+                kind == 0, cur ^ (jnp.uint32(1) << (bits & 15)),  # bit flip
+                jnp.where(kind == 1, bits,                 # randomize limb
+                          0),                              # zero limb
+            ).astype(jnp.uint32)
+            Xp = X.at[v, cand, limb].set(flipped)
+            # kinds 3/4: whole-var increment / decrement — jumps over
+            # the carry-chain local minima single bit flips get stuck in
+            rows = X[v, cand, :]                           # [K, L]
+            one = jnp.zeros((K, L), dtype=jnp.uint32).at[:, 0].set(1)
+            stepped = jnp.where(
+                (kind == 3)[:, None],
+                u256.add(rows, one),
+                u256.sub(rows, one),
+            )
+            Xp = jnp.where(
+                (kind >= 3)[None, :, None],
+                X.at[v, cand, :].set(stepped),
+                Xp,
+            )
+            Xp = Xp & vmask[:, None, :]
+            solved, new_score = score(
+                opcodes, args, imms, widths, pool, roots, roots_mask, Xp
+            )
+            accept = new_score >= best_score
+            X = jnp.where(accept[None, :, None], Xp, X)
+            best_score = jnp.maximum(best_score, new_score)
+            return X, best_score, key, it + 1, solved.any()
+
+        def cond(state):
+            _, _, _, it, done = state
+            return jnp.logical_and(it < steps, jnp.logical_not(done))
+
+        X, best_score, _, _, _ = jax.lax.while_loop(
+            cond, body, (X, score0, k2, jnp.int32(0), solved0.any())
+        )
+        solved, final_score = score(
+            opcodes, args, imms, widths, pool, roots, roots_mask, X
+        )
+        winner = jnp.argmax(final_score)
+        return solved[winner], X[:, winner, :]
+
+    import jax as _jax
+
+    fn = _jax.jit(search)
+    fn.score = _jax.jit(score)
+    _eval_cache[key] = fn
+    return fn
+
+
+def debug_eval(prog: Program, assignment: Dict[str, int], candidates: int = 2):
+    """Evaluate a compiled program under one host assignment; returns
+    (solved, soft_score) — a test/debug window into the interpreter."""
+    import jax.numpy as jnp
+
+    K = candidates
+    L = prog.limbs
+    X = np.zeros((len(prog.var_slots), K, L), dtype=np.uint32)
+    for slot, (name, _w) in enumerate(prog.var_slots):
+        value = assignment.get(name, 0)
+        for j in range(L):
+            X[slot, :, j] = (value >> (LIMB_BITS * j)) & LIMB_MASK
+    fn = _get_search_fn(K, L, 1)
+    solved, score = fn.score(
+        jnp.asarray(prog.opcodes),
+        jnp.asarray(prog.args),
+        jnp.asarray(prog.imms),
+        jnp.asarray(prog.widths),
+        jnp.asarray(prog.const_pool),
+        jnp.asarray(prog.roots),
+        jnp.asarray(prog.roots_mask),
+        jnp.asarray(X),
+    )
+    return bool(solved[0]), int(score[0])
+
+
+def device_check(
+    lowered: List[Term],
+    candidates: int = 64,
+    steps: int = 512,
+    seed: int = 7,
+) -> Optional[Dict[str, int]]:
+    """Try to find a witness for `lowered` on device. Returns a
+    {var_name: value} assignment, or None (which proves nothing)."""
+    prog = compile_program(lowered)
+    if prog is None or not prog.var_slots:
+        return None
+
+    import jax.numpy as jnp
+
+    var_widths = np.array([w for _, w in prog.var_slots], dtype=np.int32)
+    fn = _get_search_fn(candidates, prog.limbs, steps)
+    solved, winner = fn(
+        jnp.asarray(prog.opcodes),
+        jnp.asarray(prog.args),
+        jnp.asarray(prog.imms),
+        jnp.asarray(prog.widths),
+        jnp.asarray(prog.const_pool),
+        jnp.asarray(prog.roots),
+        jnp.asarray(prog.roots_mask),
+        jnp.asarray(var_widths),
+        seed,
+    )
+    if not bool(solved):
+        return None
+
+    winner = np.asarray(winner)  # [V, L]
+    assignment: Dict[str, int] = {}
+    for slot, (name, _w) in enumerate(prog.var_slots):
+        value = 0
+        for j in range(prog.limbs):
+            value |= int(winner[slot, j]) << (LIMB_BITS * j)
+        assignment[name] = value
+    return assignment

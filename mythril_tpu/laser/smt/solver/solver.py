@@ -251,6 +251,18 @@ def check_terms(
     if status == native_sat.UNSAT:
         return unsat, None
     if status == native_sat.UNKNOWN:
+        # portfolio escape hatch: the on-chip local search may still
+        # find a witness where CDCL timed out (--parallel-solving)
+        from mythril_tpu.support.support_args import args as _args
+
+        if _args.parallel_solving:
+            from mythril_tpu.laser.smt.solver import portfolio
+
+            asn = portfolio.device_check(lowered)
+            if asn is not None:
+                model = _reconstruct(asn, {}, recon, raw_constraints)
+                if model is not None:
+                    return sat, model
         return unknown, None
 
     # decode CNF bits -> word-level assignment, restricted to the vars
