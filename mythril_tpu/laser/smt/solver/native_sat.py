@@ -50,6 +50,13 @@ def _load():
             ctypes.c_longlong,
         ]
         lib.cdcl_add_clauses_flat.restype = ctypes.c_int
+        lib.cdcl_solve_assuming.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.cdcl_solve_assuming.restype = ctypes.c_int
         lib.cdcl_model_bits.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_ubyte),
@@ -110,3 +117,68 @@ def solve_flat(
             budget += _CHUNK
     finally:
         lib.cdcl_delete(s)
+
+
+class SolverSession:
+    """A persistent native solver fed clause deltas.
+
+    Pairs with the persistent Blaster: the flat definitional store only
+    ever grows, so each query loads `flat[loaded_upto:]` and solves
+    under its root literals as assumptions — learned clauses (implied
+    by the definitional clauses alone) accumulate across queries.
+    """
+
+    def __init__(self):
+        self._lib = _load()
+        self._s = self._lib.cdcl_new()
+        self.loaded_lits = 0
+        self.loaded_vars = 0
+        self.poisoned = False
+
+    def close(self):
+        if self._s is not None:
+            self._lib.cdcl_delete(self._s)
+            self._s = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def solve(self, nvars: int, flat_clauses, units: List[int],
+              timeout_ms: Optional[int] = None):
+        """Load the store delta and solve under `units` as assumptions.
+        Returns (status, bits) like solve_flat."""
+        if self.poisoned:
+            return UNSAT, None
+        lib, s = self._lib, self._s
+        if nvars > self.loaded_vars:
+            lib.cdcl_ensure_vars(s, nvars)
+            self.loaded_vars = nvars
+        n = len(flat_clauses)
+        if n > self.loaded_lits:
+            delta = flat_clauses[self.loaded_lits:]
+            buf = (ctypes.c_int * len(delta)).from_buffer(delta)
+            ok = lib.cdcl_add_clauses_flat(s, buf, len(delta))
+            del buf
+            self.loaded_lits = n
+            if not ok:
+                self.poisoned = True  # definitional store unsat: broken
+                return UNSAT, None
+
+        arr = (ctypes.c_int * len(units))(*units)
+        deadline = (
+            None if timeout_ms is None else time.monotonic() + timeout_ms / 1000.0
+        )
+        while True:
+            budget = lib.cdcl_conflicts(s) + _CHUNK
+            r = lib.cdcl_solve_assuming(s, budget, arr, len(units))
+            if r == SAT:
+                out = (ctypes.c_ubyte * nvars)()
+                lib.cdcl_model_bits(s, out, nvars)
+                return SAT, bytearray(out)
+            if r == UNSAT:
+                return UNSAT, None
+            if deadline is not None and time.monotonic() >= deadline:
+                return UNKNOWN, None

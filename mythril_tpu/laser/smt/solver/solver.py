@@ -162,34 +162,38 @@ class Optimize(BaseSolver):
 # ---------------------------------------------------------------------------
 
 
-# Persistent blasting session: gate clauses are pure Tseitin
-# definitions (they constrain nothing until a root literal is
-# asserted), so the store grows monotonically across queries and every
-# shared path-prefix constraint is blasted exactly once per run.
-#
-# Trade-off: each query reloads the whole store into a fresh native
-# solver (one bulk memcpy-like FFI call). That's a clear win while the
-# store stays analysis-sized (sessions reset per contract); a
-# delta-loading persistent native solver with assumption support would
-# remove the reload if profiles ever show it dominating.
-_session: Optional[Blaster] = None
+# Persistent solver session: gate clauses are pure Tseitin definitions
+# (they constrain nothing until a root literal is asserted), so the
+# blast store grows monotonically across queries and every shared
+# path-prefix constraint is blasted exactly once per run. The paired
+# native solver is persistent too: each query loads only the store
+# delta and solves under its root literals as *assumptions*, keeping
+# learned clauses across queries (MiniSat-style incremental solving).
+_session: Optional[tuple] = None
 _SESSION_MAX_VARS = 2_000_000
 _SESSION_MAX_LITS = 40_000_000
 
 
-def _blast_session() -> Blaster:
+def _blast_session():
     global _session
-    if (
-        _session is None
-        or _session.nvars > _SESSION_MAX_VARS
-        or len(_session.flat) > _SESSION_MAX_LITS
-    ):
-        _session = Blaster()
+    if _session is not None:
+        blaster, native = _session
+        if (
+            blaster.nvars > _SESSION_MAX_VARS
+            or len(blaster.flat) > _SESSION_MAX_LITS
+            or native.poisoned
+        ):
+            native.close()
+            _session = None
+    if _session is None:
+        _session = (Blaster(), native_sat.SolverSession())
     return _session
 
 
 def reset_blast_session() -> None:
     global _session
+    if _session is not None:
+        _session[1].close()
     _session = None
 
 
@@ -226,7 +230,7 @@ def check_terms(
     if not lowered:
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
-    blaster = _blast_session()
+    blaster, native_session = _blast_session()
     import sys
 
     old_limit = sys.getrecursionlimit()
@@ -245,7 +249,7 @@ def check_terms(
         sys.setrecursionlimit(old_limit)
 
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
-    status, bits = native_sat.solve_flat(
+    status, bits = native_session.solve(
         blaster.nvars, blaster.flat, units, remaining
     )
     if status == native_sat.UNSAT:

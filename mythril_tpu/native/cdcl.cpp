@@ -137,6 +137,11 @@ struct Solver {
   // ---- assignment ----------------------------------------------------
   int decision_level() { return (int)trail_lim.size(); }
 
+  // incremental solving: assumptions are re-asserted as the first
+  // decisions after every restart; learned clauses are implied by the
+  // clause database alone, so they stay valid across queries
+  std::vector<int> assumptions;
+
   int8_t value_lit(int l) {
     int8_t a = assigns[lit_var(l)];
     if (a < 0) return -1;
@@ -377,6 +382,24 @@ struct Solver {
             restart_num++;
             break;
           }
+          // assert pending assumptions as decisions
+          bool asserted = false;
+          while (decision_level() < (int)assumptions.size()) {
+            int p = assumptions[decision_level()];
+            int av = p >> 1;
+            int want = (p & 1) ? 0 : 1;
+            if (assigns[av] >= 0) {
+              if (assigns[av] != want) return -2;  // unsat under assumptions
+              trail_lim.push_back((int)trail.size());  // vacuous level
+              continue;
+            }
+            trail_lim.push_back((int)trail.size());
+            enqueue(p, nullptr);
+            asserted = true;
+            break;
+          }
+          if (asserted) continue;  // propagate the assumption
+
           // decide
           int v = -1;
           while (!heap.empty()) {
@@ -443,6 +466,7 @@ void cdcl_ensure_vars(void* s, int n) {
 // trivially unsat.
 int cdcl_add_clauses_flat(void* s, const int* lits, long long n) {
   Solver* solver = (Solver*)s;
+  solver->cancel_until(0);  // clause additions must happen at level 0
   std::vector<int> internal;
   internal.reserve(16);
   for (long long i = 0; i < n; i++) {
@@ -458,6 +482,30 @@ int cdcl_add_clauses_flat(void* s, const int* lits, long long n) {
     }
   }
   return solver->ok ? 1 : 0;
+}
+
+// Solve under assumptions (0-terminated not required; n literals).
+// Returns 1 SAT, -1 UNSAT (global or under these assumptions),
+// 0 budget exhausted. conflict_budget is an absolute conflict count
+// (compare against cdcl_conflicts), so chunked callers keep learned
+// progress across calls.
+int cdcl_solve_assuming(void* s, int64_t conflict_budget, const int* lits,
+                        int n) {
+  Solver* solver = (Solver*)s;
+  if (!solver->ok) return -1;
+  solver->cancel_until(0);
+  solver->assumptions.clear();
+  for (int i = 0; i < n; i++) {
+    int l = lits[i];
+    solver->assumptions.push_back(mklit(std::abs(l) - 1, l < 0));
+  }
+  int r = solver->solve(conflict_budget);
+  if (r == -2) {
+    solver->cancel_until(0);
+    return -1;
+  }
+  if (r != 1) solver->cancel_until(0);
+  return r;
 }
 
 // Bulk model extraction: out[v] = 1/0 for v in [0, n); unassigned
