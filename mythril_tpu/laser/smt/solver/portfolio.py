@@ -510,18 +510,25 @@ def device_check(
     candidates: int = 64,
     steps: int = 512,
     seed: int = 7,
+    n_devices: int = 1,
 ) -> Optional[Dict[str, int]]:
     """Try to find a witness for `lowered` on device. Returns a
-    {var_name: value} assignment, or None (which proves nothing)."""
+    {var_name: value} assignment, or None (which proves nothing).
+
+    With n_devices > 1 the search runs as a true portfolio: one
+    independent replica per device (pmap over seeds), any replica's
+    witness wins — the multi-chip scaling axis for hard queries.
+    """
     prog = compile_program(lowered)
     if prog is None or not prog.var_slots:
         return None
 
+    import jax
     import jax.numpy as jnp
 
     var_widths = np.array([w for _, w in prog.var_slots], dtype=np.int32)
     fn = _get_search_fn(candidates, prog.limbs, steps)
-    solved, winner = fn(
+    prog_args = (
         jnp.asarray(prog.opcodes),
         jnp.asarray(prog.args),
         jnp.asarray(prog.imms),
@@ -530,12 +537,24 @@ def device_check(
         jnp.asarray(prog.roots),
         jnp.asarray(prog.roots_mask),
         jnp.asarray(var_widths),
-        seed,
     )
-    if not bool(solved):
-        return None
 
-    winner = np.asarray(winner)  # [V, L]
+    if n_devices > 1:
+        replicated = jax.pmap(
+            lambda s: fn(*prog_args, s), devices=jax.devices()[:n_devices]
+        )
+        seeds = jnp.arange(seed, seed + n_devices, dtype=jnp.int32)
+        solved_all, winners = replicated(seeds)
+        solved_all = np.asarray(solved_all)
+        if not solved_all.any():
+            return None
+        winner = np.asarray(winners)[int(np.argmax(solved_all))]
+    else:
+        solved, winner = fn(*prog_args, seed)
+        if not bool(solved):
+            return None
+        winner = np.asarray(winner)  # [V, L]
+
     assignment: Dict[str, int] = {}
     for slot, (name, _w) in enumerate(prog.var_slots):
         value = 0
