@@ -80,6 +80,21 @@ class SignatureDB(object, metaclass=Singleton):
                 "(byte_sig VARCHAR(10), text_sig VARCHAR(255),"
                 "PRIMARY KEY (byte_sig, text_sig))"
             )
+            # seed common signatures on first use (the reference ships a
+            # prepopulated signatures.db asset for the same purpose)
+            cur.execute("SELECT COUNT(*) FROM signatures")
+            if cur.fetchone()[0] == 0:
+                from mythril_tpu.support.known_signatures import KNOWN_SIGNATURES
+
+                rows = [
+                    ("0x" + keccak256(sig.encode())[:4].hex(), sig)
+                    for sig in KNOWN_SIGNATURES
+                ]
+                cur.executemany(
+                    "INSERT OR IGNORE INTO signatures (byte_sig, text_sig)"
+                    " VALUES (?,?)",
+                    rows,
+                )
 
     def __getitem__(self, item: str) -> List[str]:
         return self.get(byte_sig=item)
