@@ -151,7 +151,10 @@ class SolverSession:
         """Load the store delta and solve under `units` as assumptions.
         Returns (status, bits) like solve_flat."""
         if self.poisoned:
-            return UNSAT, None
+            # a failed definitional load signals an internal blaster bug,
+            # never real unsatisfiability: degrade to unknown so paths
+            # aren't silently pruned
+            return UNKNOWN, None
         lib, s = self._lib, self._s
         if nvars > self.loaded_vars:
             lib.cdcl_ensure_vars(s, nvars)
@@ -165,7 +168,7 @@ class SolverSession:
             self.loaded_lits = n
             if not ok:
                 self.poisoned = True  # definitional store unsat: broken
-                return UNSAT, None
+                return UNKNOWN, None
 
         arr = (ctypes.c_int * len(units))(*units)
         deadline = (

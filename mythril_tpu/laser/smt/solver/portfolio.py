@@ -540,11 +540,18 @@ def device_check(
     )
 
     if n_devices > 1:
-        replicated = jax.pmap(
-            lambda s: fn(*prog_args, s), devices=jax.devices()[:n_devices]
-        )
+        pkey = ("pmap", candidates, prog.limbs, steps, n_devices)
+        replicated = _eval_cache.get(pkey)
+        if replicated is None:
+            # in_axes: program arrays broadcast, seeds split per device
+            replicated = jax.pmap(
+                fn,
+                devices=jax.devices()[:n_devices],
+                in_axes=(None,) * 8 + (0,),
+            )
+            _eval_cache[pkey] = replicated
         seeds = jnp.arange(seed, seed + n_devices, dtype=jnp.int32)
-        solved_all, winners = replicated(seeds)
+        solved_all, winners = replicated(*prog_args, seeds)
         solved_all = np.asarray(solved_all)
         if not solved_all.any():
             return None

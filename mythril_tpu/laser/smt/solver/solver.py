@@ -260,9 +260,13 @@ def check_terms(
         from mythril_tpu.support.support_args import args as _args
 
         if _args.parallel_solving:
+            import jax
+
             from mythril_tpu.laser.smt.solver import portfolio
 
-            asn = portfolio.device_check(lowered)
+            asn = portfolio.device_check(
+                lowered, n_devices=min(jax.device_count(), 8)
+            )
             if asn is not None:
                 model = _reconstruct(asn, {}, recon, raw_constraints)
                 if model is not None:

@@ -318,15 +318,30 @@ struct Solver {
       c->deleted = true;
       removed++;
     }
-    // compact learnt list and watch lists lazily (deleted flag checked)
+    // compact learnt list; detach deleted clauses from their two watch
+    // lists and free them — the solver is persistent across a whole
+    // analysis run (SolverSession), so deferring frees to cdcl_delete
+    // would leak linearly with total conflicts
     std::vector<Clause*> kept;
     for (auto* c : learnts) {
-      if (c->deleted) continue;
+      if (c->deleted) {
+        for (int widx = 0; widx < 2; widx++) {
+          auto& ws = watches[c->lits[widx]];
+          for (size_t k = 0; k < ws.size(); k++) {
+            if (ws[k] == c) {
+              ws[k] = ws.back();
+              ws.pop_back();
+              break;
+            }
+          }
+        }
+        delete c;
+        continue;
+      }
       c->keep_mark = 0;
       kept.push_back(c);
     }
-    learnts = kept;  // deleted Clause objects leak until solver delete;
-                     // acceptable for bounded queries
+    learnts = kept;
   }
 
   static int64_t luby(int64_t i) {
