@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Analyzer-level throughput benchmark over the golden contract corpus.
+
+Complements bench.py (which measures the batched TPU interpreter):
+this measures the driver metric's other half — contracts/sec and
+states-explored/sec of the full symbolic analyzer at -t 2 — over the
+reference's 13 precompiled contracts.
+
+Usage: python tools/corpus_bench.py [--processes N] [--timeout S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REFERENCE_DIR = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+INPUTS = REFERENCE_DIR / "tests" / "testdata" / "inputs"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--processes", type=int, default=os.cpu_count())
+    parser.add_argument("--timeout", type=int, default=45)
+    parser.add_argument("--tx", type=int, default=2)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.CRITICAL)
+    contracts = [
+        (f.read_text().strip(), "", f.stem) for f in sorted(INPUTS.glob("*.sol.o"))
+    ]
+    if not contracts:
+        print(json.dumps({"error": "no corpus; set MYTHRIL_REFERENCE_DIR"}))
+        return
+
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    t0 = time.perf_counter()
+    results = analyze_corpus(
+        contracts,
+        transaction_count=args.tx,
+        execution_timeout=args.timeout,
+        create_timeout=10,
+        processes=args.processes,
+    )
+    dt = time.perf_counter() - t0
+
+    issues = sum(len(r["issues"]) for r in results)
+    errors = [r["name"] for r in results if r["error"]]
+    print(
+        json.dumps(
+            {
+                "metric": "contracts_per_sec",
+                "value": round(len(contracts) / dt, 3),
+                "unit": "contracts/sec",
+                "contracts": len(contracts),
+                "wall_s": round(dt, 1),
+                "processes": args.processes,
+                "tx_count": args.tx,
+                "issues_found": issues,
+                "errors": errors,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
