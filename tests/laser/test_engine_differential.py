@@ -70,6 +70,45 @@ def random_program(rng: random.Random, n_ops: int = 24) -> bytes:
     return bytes(code)
 
 
+def random_memory_program(rng: random.Random, n_ops: int = 10) -> bytes:
+    """Memory + SHA3 template: random MSTOREs at word offsets, then
+    keccak a window and store the digest — cross-checks the two
+    engines' memory models and keccak implementations."""
+    code = bytearray()
+    for _ in range(n_ops):
+        value = rng.randbytes(rng.randrange(1, 33))
+        offset = rng.randrange(0, 8) * 32
+        code.append(0x60 + len(value) - 1)  # PUSHn value
+        code += value
+        code += bytes([0x60, offset, 0x52])  # PUSH1 offset; MSTORE
+    start = rng.randrange(0, 4) * 32
+    length = rng.choice([32, 64, 96])
+    code += bytes([0x60, length, 0x60, start, 0x20])  # SHA3(start, len)
+    code += bytes([0x60, 0x00, 0x55])  # SSTORE slot 0
+    # also store one MLOAD-ed word for the memory readback path
+    code += bytes([0x60, start, 0x51, 0x60, 0x01, 0x55])  # MLOAD; SSTORE 1
+    code.append(0x00)
+    return bytes(code)
+
+
+def random_branch_program(rng: random.Random) -> bytes:
+    """Conditional-branch template: compare two random constants,
+    JUMPI to one of two SSTORE arms — cross-checks jump resolution and
+    branch semantics concretely."""
+    a = rng.randrange(0, 256)
+    b = rng.randrange(0, 256)
+    cmp_op = rng.choice([0x10, 0x11, 0x14])  # LT GT EQ
+    # layout: PUSH1 a PUSH1 b CMP PUSH1 <dest> JUMPI
+    #         PUSH1 0xAA PUSH1 0 SSTORE STOP
+    # dest:   JUMPDEST PUSH1 0xBB PUSH1 0 SSTORE STOP
+    prefix = bytes([0x60, a, 0x60, b, cmp_op])
+    fallthrough = bytes([0x60, 0xAA, 0x60, 0x00, 0x55, 0x00])
+    dest = len(prefix) + 3 + len(fallthrough)
+    code = prefix + bytes([0x60, dest, 0x57]) + fallthrough
+    code += bytes([0x5B, 0x60, 0xBB, 0x60, 0x00, 0x55, 0x00])
+    return bytes(code)
+
+
 def run_laser(code: bytes) -> dict:
     world_state = WorldState()
     account = Account(ADDRESS, concrete_storage=True)
@@ -103,9 +142,16 @@ def run_laser(code: bytes) -> dict:
 
 @pytest.fixture(scope="module")
 def programs():
-    return [
-        random_program(random.Random(90210 + trial)) for trial in range(N_TRIALS)
-    ]
+    out = []
+    for trial in range(N_TRIALS):
+        rng = random.Random(90210 + trial)
+        if trial % 3 == 0:
+            out.append(random_program(rng))
+        elif trial % 3 == 1:
+            out.append(random_memory_program(rng))
+        else:
+            out.append(random_branch_program(rng))
+    return out
 
 
 @pytest.fixture(scope="module")
