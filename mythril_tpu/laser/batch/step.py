@@ -377,15 +377,20 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
         model-capacity status; the gas is estimated in float32 (w up to
         2**25 words keeps the estimate within ~1 part in 2**23, and the
         fixtures in this regime have order-of-magnitude margins)."""
-        nb = jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32), mask.shape)
-        end = off_i32 + nb
+        # clamp before adding: offsets just below 2**31 would wrap the
+        # int32 sum and dodge the capacity check entirely
+        off_c = jnp.minimum(off_i32, BIGOFF)
+        nb = jnp.minimum(
+            jnp.broadcast_to(jnp.asarray(nbytes, jnp.int32), mask.shape), BIGOFF
+        )
+        end = off_c + nb
         nz = mask & (nb > 0)
         over = nz & (end > MEM_CAP)
         wf = ((end + 31) // 32).astype(jnp.float32)
-        est = 3.0 * wf + wf * wf / 512.0
-        budget_left = (
-            batch.gas_budget - jnp.minimum(batch.gas_min, batch.gas_budget)
-        ).astype(jnp.float32)
+        # EVM charges the delta above the already-paid size, not the
+        # absolute cost of the new size
+        est = (3.0 * wf + wf * wf / 512.0) - _mem_gas(msize).astype(jnp.float32)
+        budget_left = gas_left.astype(jnp.float32)
         oog = over & (est > budget_left)
         bad = over & ~oog
         grow_mask = nz & ~over

@@ -294,3 +294,24 @@ def test_pc_opcode():
     src = [push(0), "POP", "PC"]  # PC at address 3 pushes 3
     out = exec_one(src)
     assert stack_list(out, 0) == [3]
+
+
+def test_int32_wrap_offset_is_oog():
+    """An MSTORE at an offset just below 2**31 must out-of-gas, not wrap
+    the int32 end-of-access computation and silently no-op."""
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.batch.run import run
+    from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
+
+    code = (
+        bytes.fromhex("6001")                      # PUSH1 1
+        + bytes([0x63, 0x7F, 0xFF, 0xFF, 0xE1])    # PUSH4 0x7FFFFFE1
+        + bytes.fromhex("5200")                    # MSTORE; STOP
+    )
+    table = make_code_table([code])
+    batch = make_batch(1)._replace(
+        gas_budget=jnp.asarray([1000], dtype=jnp.uint32)
+    )
+    out, _ = run(batch, table, max_steps=16)
+    assert int(out.status[0]) == Status.ERR_OOG
