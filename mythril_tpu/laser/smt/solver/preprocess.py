@@ -213,44 +213,70 @@ class Recon:
         self.sel_apps: Dict[str, List[Tuple[Term, str]]] = {}
 
 
+# Context-free select pushing is memoized globally: store chains are
+# shared wholesale across the engine's queries (every path prefix keeps
+# selecting from the same storage/balance chains), and the rewrite up
+# to the base array does not depend on the query. Base-array selects
+# stay as `select(avar, idx)` leaves for the per-query Ackermann logic.
+_chain_cache: Dict[Tuple[int, int], Term] = {}
+_CHAIN_CACHE_MAX = 1 << 18
+
+
+def _push_chain(arr: Term, idx: Term) -> Term:
+    key = (arr._id, idx._id)
+    got = _chain_cache.get(key)
+    if got is not None:
+        return got
+    if arr.op == "store":
+        base, i, v = arr.args
+        same = terms.eq(i, idx)
+        if same is terms.TRUE:
+            out = v
+        elif same is terms.FALSE:
+            out = _push_chain(base, idx)
+        else:
+            out = terms.ite(same, v, _push_chain(base, idx))
+    elif arr.op == "K":
+        out = arr.args[0]
+    elif arr.op == "ite":
+        out = terms.ite(
+            arr.args[0], _push_chain(arr.args[1], idx), _push_chain(arr.args[2], idx)
+        )
+    elif arr.op == "avar":
+        out = terms.select(arr, idx)
+    else:
+        raise NotImplementedError(f"select base: {arr.op}")
+    if len(_chain_cache) >= _CHAIN_CACHE_MAX:
+        _chain_cache.clear()
+    _chain_cache[key] = out
+    return out
+
+
 def eliminate_uf_and_arrays(constraints: List[Term], recon: Recon) -> List[Term]:
     """Replace uf apps and base-array selects by fresh vars + axioms."""
     side: List[Term] = []
     memo: Dict[int, Term] = {}
 
     def push_select(arr: Term, idx: Term) -> Term:
-        """select with store chains / K / ite pushed to base arrays."""
-        if arr.op == "store":
-            base, i, v = arr.args
-            same = terms.eq(i, idx)
-            if same is terms.TRUE:
-                return v
-            if same is terms.FALSE:
-                return push_select(base, idx)
-            return terms.ite(same, v, push_select(base, idx))
-        if arr.op == "K":
-            return arr.args[0]
-        if arr.op == "ite":
-            return terms.ite(
-                arr.args[0], push_select(arr.args[1], idx), push_select(arr.args[2], idx)
+        """Base-array select -> per-query fresh var + read-consistency
+        axioms (non-avar chains were already pushed by _push_chain)."""
+        if arr.op != "avar":
+            return walk(_push_chain(arr, idx))
+        name = arr.args[0]
+        apps = recon.sel_apps.setdefault(name, [])
+        for prev_idx, fresh in apps:
+            if prev_idx is idx:
+                return terms.bv_var(fresh, arr.sort.range_width)
+        fresh = f"sel!{name}!{len(apps)}"
+        out = terms.bv_var(fresh, arr.sort.range_width)
+        # read consistency vs every earlier select on this array
+        for prev_idx, prev_fresh in apps:
+            prev_out = terms.bv_var(prev_fresh, arr.sort.range_width)
+            side.append(
+                terms.implies(terms.eq(prev_idx, idx), terms.eq(prev_out, out))
             )
-        if arr.op == "avar":
-            name = arr.args[0]
-            apps = recon.sel_apps.setdefault(name, [])
-            for prev_idx, fresh in apps:
-                if prev_idx is idx:
-                    return terms.bv_var(fresh, arr.sort.range_width)
-            fresh = f"sel!{name}!{len(apps)}"
-            out = terms.bv_var(fresh, arr.sort.range_width)
-            # read consistency vs every earlier select on this array
-            for prev_idx, prev_fresh in apps:
-                prev_out = terms.bv_var(prev_fresh, arr.sort.range_width)
-                side.append(
-                    terms.implies(terms.eq(prev_idx, idx), terms.eq(prev_out, out))
-                )
-            apps.append((idx, fresh))
-            return out
-        raise NotImplementedError(f"select base: {arr.op}")
+        apps.append((idx, fresh))
+        return out
 
     def walk(t: Term) -> Term:
         got = memo.get(t._id)
