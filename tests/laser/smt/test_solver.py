@@ -231,3 +231,26 @@ def test_independence_solver():
     s2 = IndependenceSolver(timeout=20000)
     s2.add(x == 5, z == 1, z == 2)
     assert s2.check() == unsat
+
+
+def test_store_chain_shared_across_queries():
+    """The context-free select-chain cache must not leak bindings
+    between queries: the same chain queried under contradictory and
+    then satisfiable contexts gives correct verdicts and models."""
+    from mythril_tpu.laser.smt import Array, symbol_factory
+
+    storage = Array("xstorage", 256, 256)
+    k = symbol_factory.BitVecSym("xq_k", 256)
+    storage[symbol_factory.BitVecVal(1, 256)] = symbol_factory.BitVecVal(11, 256)
+    storage[symbol_factory.BitVecVal(2, 256)] = symbol_factory.BitVecVal(22, 256)
+    read = storage[k]
+
+    # query 1: k == 1 forces read == 11 -> read == 22 is unsat
+    assert check(k == 1, read == 22)[0] == unsat
+    # query 2 (same chain, new context): k == 2 gives read == 22
+    status, s = check(k == 2, read == 22)
+    assert status == sat
+    # query 3: unknown key reads the base array -> any value reachable
+    status, s = check(k == 5, read == 77)
+    assert status == sat
+    assert s.model().eval(read.raw).value == 77
