@@ -67,6 +67,7 @@ from mythril_tpu.laser.smt import (
     Not,
     SRem,
     UDiv,
+    ZeroExt,
     UGE,
     UGT,
     ULT,
@@ -400,24 +401,33 @@ class Instruction:
 
     @StateTransition()
     def addmod_(self, global_state: GlobalState) -> List[GlobalState]:
+        # computed at 257 bits: the reference's
+        # URem(URem(a,m)+URem(b,m), m) truncates the intermediate sum
+        # at 256 bits and diverges from the EVM for residues whose sum
+        # overflows (found by engine-differential testing)
         mstate = global_state.mstate
         s0, s1, s2 = (
             util.pop_bitvec(mstate),
             util.pop_bitvec(mstate),
             util.pop_bitvec(mstate),
         )
-        mstate.stack.append(URem(URem(s0, s2) + URem(s1, s2), s2))
+        wide = URem(ZeroExt(1, s0) + ZeroExt(1, s1), ZeroExt(1, s2))
+        mstate.stack.append(Extract(255, 0, wide))
         return [global_state]
 
     @StateTransition()
     def mulmod_(self, global_state: GlobalState) -> List[GlobalState]:
+        # computed at 512 bits for the same reason: residue products
+        # overflow 256 bits, so the reference's truncating formula is
+        # wrong for large operands
         mstate = global_state.mstate
         s0, s1, s2 = (
             util.pop_bitvec(mstate),
             util.pop_bitvec(mstate),
             util.pop_bitvec(mstate),
         )
-        mstate.stack.append(URem(URem(s0, s2) * URem(s1, s2), s2))
+        wide = URem(ZeroExt(256, s0) * ZeroExt(256, s1), ZeroExt(256, s2))
+        mstate.stack.append(Extract(255, 0, wide))
         return [global_state]
 
     @StateTransition()
@@ -452,7 +462,8 @@ class Instruction:
     @StateTransition()
     def signextend_(self, global_state: GlobalState) -> List[GlobalState]:
         mstate = global_state.mstate
-        s0, s1 = mstate.stack.pop(), mstate.stack.pop()
+        s0 = _to_bitvec(mstate.stack.pop())
+        s1 = _to_bitvec(mstate.stack.pop())
         try:
             s0 = util.get_concrete_int(s0)
         except TypeError:

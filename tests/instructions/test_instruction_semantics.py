@@ -247,3 +247,47 @@ def test_jumpi_forks_two_states():
     state.mstate.stack.append(bv(dest))
     states = Instruction("JUMPI", None).evaluate(state)
     assert len(states) == 2
+
+
+def test_mulmod_wide_residues():
+    """MULMOD computes at 512 bits: residue products that overflow 256
+    bits must still be exact (the upstream truncating formula diverges
+    here — found by engine-differential testing)."""
+    a = 2**255 + 12345
+    b_val = 2**254 + 999
+    m = 2**256 - 189
+    state = make_state()
+    state.mstate.stack.append(bv(m))
+    state.mstate.stack.append(bv(b_val))
+    state.mstate.stack.append(bv(a))
+    out = run_op(state, "MULMOD")
+    assert out.mstate.stack[-1].value == (a * b_val) % m
+
+
+def test_addmod_wide_residues():
+    a = 2**256 - 5
+    b_val = 2**256 - 7
+    m = 2**256 - 3
+    state = make_state()
+    state.mstate.stack.append(bv(m))
+    state.mstate.stack.append(bv(b_val))
+    state.mstate.stack.append(bv(a))
+    out = run_op(state, "ADDMOD")
+    assert out.mstate.stack[-1].value == (a + b_val) % m
+
+
+def test_signextend_accepts_bool_operand():
+    """A comparison result (Bool) on the stack must coerce, not crash
+    (found by engine-differential testing)."""
+    state = make_state()
+    state.mstate.stack.append(bv(3))
+    state.mstate.stack.append(bv(5))
+    mid = run_op(state, "LT")  # pushes a Bool
+    mid.mstate.stack.append(bv(0))
+    # stack: [..., Bool, 0] -> SIGNEXTEND(0, Bool)
+    mid.mstate.stack[-1], mid.mstate.stack[-2] = (
+        mid.mstate.stack[-2],
+        mid.mstate.stack[-1],
+    )
+    out = run_op(mid, "SIGNEXTEND")
+    assert out.mstate.stack[-1].value in (0, 2**256 - 1)

@@ -32,8 +32,8 @@ CALLER = 0xDEADBEEFDEADBEEF
 ADDRESS = 0x1234
 N_TRIALS = 48
 
-ARITH = [0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07, 0x0B, 0x10, 0x11,
-         0x12, 0x13, 0x14, 0x16, 0x17, 0x18, 0x1A, 0x1B, 0x1C, 0x1D]
+ARITH = [0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07, 0x0A, 0x0B, 0x10,
+         0x11, 0x12, 0x13, 0x14, 0x16, 0x17, 0x18, 0x1A, 0x1B, 0x1C, 0x1D]
 TERNARY = [0x08, 0x09]  # addmod, mulmod
 UNARY = [0x15, 0x19]  # iszero, not
 
