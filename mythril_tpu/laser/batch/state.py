@@ -35,6 +35,7 @@ MEM_CAP = 4096  # bytes of modelled memory per lane
 STORAGE_CAP = 64  # journal entries per lane
 CALLDATA_CAP = 512  # bytes of calldata per lane
 HASH_CAP = 128  # max SHA3 input bytes handled on device (single rate block)
+PC_BITMAP_WORDS = 768  # coverage bitmap words (EVM max code size 24576 / 32)
 
 
 class Status:
@@ -77,6 +78,7 @@ class StateBatch(NamedTuple):
     gas_budget: jnp.ndarray  # u32[N]; lane OOGs when gas_min exceeds it
     ret_offset: jnp.ndarray
     ret_len: jnp.ndarray
+    pc_seen: jnp.ndarray  # u32[N, PC_BITMAP_WORDS] executed-pc bitmap (coverage)
     # environment (reference: laser/ethereum/state/environment.py)
     address: jnp.ndarray  # u32[N,16]
     caller: jnp.ndarray
@@ -167,6 +169,7 @@ def make_batch(
         gas_budget=jnp.full((n,), gas_budget, jnp.uint32),
         ret_offset=jnp.zeros((n,), jnp.int32),
         ret_len=jnp.zeros((n,), jnp.int32),
+        pc_seen=jnp.zeros((n, PC_BITMAP_WORDS), jnp.uint32),
         address=_word_rows(n, address),
         caller=_word_rows(n, caller),
         origin=_word_rows(n, caller),

@@ -635,8 +635,20 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     oog = active & (gas_min > batch.gas_budget) & (status != Status.UNSUPPORTED)
     status = jnp.where(oog, Status.ERR_OOG, status)
 
+    # coverage bitmap: mark this step's pc for every executing lane
+    word_idx = jnp.clip(batch.pc // 32, 0, batch.pc_seen.shape[1] - 1)
+    bit = (jnp.uint32(1) << (batch.pc % 32).astype(jnp.uint32))
+    seen_words = jnp.take_along_axis(batch.pc_seen, word_idx[:, None], axis=1)[:, 0]
+    seen_words = jnp.where(ex, seen_words | bit, seen_words)
+    pc_seen = jnp.where(
+        jnp.arange(batch.pc_seen.shape[1])[None, :] == word_idx[:, None],
+        seen_words[:, None],
+        batch.pc_seen,
+    )
+
     return batch._replace(
         pc=pc_new,
+        pc_seen=pc_seen,
         stack=stack,
         sp=sp,
         mem=mem,
