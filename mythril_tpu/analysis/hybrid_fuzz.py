@@ -1,0 +1,270 @@
+"""Hybrid concolic fuzzing: device-scale execution + solver-driven
+input generation.
+
+The division of labor is the north-star split (SURVEY.md §7.1): the
+batched XLA engine executes whole generations of concrete inputs in
+one device pass and journals every JUMPI decision per lane; the host
+then picks branch directions no input has taken yet, replays the
+journaled path prefix *symbolically* through the LASER instruction
+semantics (collecting the path condition), asserts the flipped branch,
+and asks the solver for calldata that takes it. Each generation's
+witnesses become the next generation's lanes — a SAGE-style whitebox
+loop where the expensive part (execution) runs wide on the TPU and the
+clever part (constraint flipping) runs narrow on the host.
+
+Scope (v1): single contract, intra-contract paths (replay stops at
+CALL/CREATE frames).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from datetime import datetime
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.batch.run import run as batch_run
+from mythril_tpu.laser.batch.state import BRANCH_CAP, make_batch, make_code_table
+from mythril_tpu.laser.ethereum.instructions import Instruction
+from mythril_tpu.laser.ethereum.state.account import Account
+from mythril_tpu.laser.ethereum.state.calldata import SymbolicCalldata
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.time_handler import time_handler
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    MessageCallTransaction,
+    TransactionEndSignal,
+    TransactionStartSignal,
+    get_next_transaction_id,
+)
+from mythril_tpu.laser.smt import symbol_factory
+from mythril_tpu.support.model import get_model
+
+log = logging.getLogger(__name__)
+
+ADDRESS = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE
+CALLER = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
+
+
+class _ReplayAbort(Exception):
+    """Path replay left the supported scope (calls, script mismatch)."""
+
+
+def _symbolic_replay(
+    code_hex: str, calldata_len: int, script: List[Tuple[int, bool]]
+) -> Optional[List[int]]:
+    """Follow `script` = [(jumpi_pc, taken), ...] symbolically, flip the
+    LAST entry, and solve for calldata taking the flipped direction.
+    Returns concrete calldata bytes or None."""
+    world_state = WorldState()
+    account = Account(ADDRESS, concrete_storage=True)
+    account.code = Disassembly(code_hex)
+    world_state.put_account(account)
+    account.set_balance(10**18)
+
+    tx_id = get_next_transaction_id()
+    calldata = SymbolicCalldata(tx_id)
+    transaction = MessageCallTransaction(
+        world_state=world_state,
+        identifier=tx_id,
+        gas_price=10,
+        gas_limit=8_000_000,
+        origin=symbol_factory.BitVecVal(CALLER, 256),
+        caller=symbol_factory.BitVecVal(CALLER, 256),
+        callee_account=world_state[symbol_factory.BitVecVal(ADDRESS, 256)],
+        call_data=calldata,
+        call_value=0,
+    )
+    state = transaction.initial_global_state()
+    state.transaction_stack.append((transaction, None))
+    state.world_state.constraints.append(
+        calldata.calldatasize == calldata_len
+    )
+
+    time_handler.start_execution(10)
+    script = list(script)
+    flip_index = len(script) - 1
+    seen_branches = 0
+    steps = 0
+
+    while True:
+        steps += 1
+        if steps > 4096:
+            raise _ReplayAbort("step budget")
+        try:
+            instr = state.get_current_instruction()
+        except IndexError:
+            raise _ReplayAbort("walked off code before target")
+        op = instr["opcode"]
+        try:
+            successors = Instruction(op, None).evaluate(state)
+        except TransactionStartSignal:
+            raise _ReplayAbort("nested call in path")
+        except TransactionEndSignal:
+            raise _ReplayAbort("halted before target")
+
+        if op == "JUMPI":
+            if seen_branches >= len(script):
+                raise _ReplayAbort("extra branch past script")
+            want_taken = script[seen_branches][1]
+            if seen_branches == flip_index:
+                want_taken = not want_taken
+            # identify successors: fall-through has pc == index + 1
+            fallthrough = next(
+                (s for s in successors if s.mstate.pc == state.mstate.pc + 1),
+                None,
+            )
+            taken = next(
+                (s for s in successors if s.mstate.pc != state.mstate.pc + 1),
+                None,
+            )
+            chosen = taken if want_taken else fallthrough
+            if chosen is None:
+                # the wanted direction is infeasible (engine pruned it)
+                return None
+            if seen_branches == flip_index:
+                # constraints of `chosen` include the flipped condition
+                try:
+                    model = get_model(
+                        tuple(chosen.world_state.constraints),
+                        enforce_execution_time=False,
+                        solver_timeout=4000,
+                    )
+                except UnsatError:
+                    return None
+                data = calldata.concrete(model)
+                return [int(b) for b in data[:calldata_len]] + [0] * max(
+                    0, calldata_len - len(data)
+                )
+            seen_branches += 1
+            state = chosen
+        else:
+            if not successors:
+                raise _ReplayAbort("dead end")
+            state = successors[0]
+
+
+class HybridFuzzer:
+    """Generation loop: device executes, host flips branches."""
+
+    def __init__(
+        self,
+        code_hex: str,
+        calldata_len: int = 68,
+        lanes_per_generation: int = 32,
+        max_generations: int = 6,
+        flips_per_generation: int = 8,
+        seed: int = 1,
+    ):
+        self.code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
+        self.code = bytes.fromhex(self.code_hex)
+        self.calldata_len = calldata_len
+        self.lanes_per_generation = lanes_per_generation
+        self.max_generations = max_generations
+        self.flips_per_generation = flips_per_generation
+        self.rng = random.Random(seed)
+        self.covered: Set[Tuple[int, bool]] = set()
+        self.attempted: Set[Tuple[int, bool]] = set()
+        self.corpus: List[bytes] = []
+        self.storage_writes: Dict[int, Set[int]] = {}
+
+    def _seed_inputs(self) -> List[bytes]:
+        disassembly = Disassembly(self.code_hex)
+        inputs = [b"\x00" * self.calldata_len]
+        for func_hash in disassembly.func_hashes:
+            selector = bytes.fromhex(func_hash[2:])
+            inputs.append(
+                selector
+                + bytes(
+                    self.rng.randrange(256)
+                    for _ in range(self.calldata_len - 4)
+                )
+            )
+        while len(inputs) < self.lanes_per_generation:
+            inputs.append(
+                bytes(self.rng.randrange(256) for _ in range(self.calldata_len))
+            )
+        return inputs[: self.lanes_per_generation]
+
+    def _run_generation(self, inputs: List[bytes]) -> List[Dict]:
+        table = make_code_table([self.code])
+        batch = make_batch(
+            len(inputs), calldata=inputs, caller=CALLER, address=ADDRESS
+        )
+        out, _ = batch_run(batch, table, max_steps=4096)
+        br_pc = np.asarray(out.br_pc)
+        br_taken = np.asarray(out.br_taken)
+        br_cnt = np.asarray(out.br_cnt)
+        keys = np.asarray(out.storage_keys)
+        vals = np.asarray(out.storage_vals)
+        cnts = np.asarray(out.storage_cnt)
+
+        lanes = []
+        from mythril_tpu.ops import u256
+
+        for i, data in enumerate(inputs):
+            journal = [
+                (int(br_pc[i, j]), bool(br_taken[i, j]))
+                for j in range(min(int(br_cnt[i]), BRANCH_CAP))
+            ]
+            for entry in journal:
+                self.covered.add(entry)
+            for k in range(int(cnts[i])):
+                slot = u256.to_int(keys[i, k])
+                self.storage_writes.setdefault(slot, set()).add(
+                    u256.to_int(vals[i, k])
+                )
+            lanes.append({"calldata": data, "journal": journal})
+        return lanes
+
+    def run(self) -> Dict:
+        inputs = self._seed_inputs()
+        generations = 0
+        for gen in range(self.max_generations):
+            generations += 1
+            lanes = self._run_generation(inputs)
+            self.corpus.extend(lane["calldata"] for lane in lanes)
+
+            # frontier: first uncovered flipped direction per lane
+            new_inputs: List[bytes] = []
+            for lane in lanes:
+                if len(new_inputs) >= self.flips_per_generation:
+                    break
+                journal = lane["journal"]
+                for i, (pc, taken) in enumerate(journal):
+                    target = (pc, not taken)
+                    if target in self.covered or target in self.attempted:
+                        continue
+                    self.attempted.add(target)
+                    try:
+                        data = _symbolic_replay(
+                            self.code_hex, self.calldata_len, journal[: i + 1]
+                        )
+                    except _ReplayAbort as e:
+                        log.debug("replay abort at %s: %s", target, e)
+                        continue
+                    if data is not None:
+                        new_inputs.append(bytes(data))
+                        break
+            if not new_inputs:
+                break
+            # pad the next generation with corpus mutations
+            while len(new_inputs) < self.lanes_per_generation:
+                parent = self.rng.choice(self.corpus)
+                mutated = bytearray(parent)
+                mutated[self.rng.randrange(len(mutated))] = self.rng.randrange(256)
+                new_inputs.append(bytes(mutated))
+            inputs = new_inputs[: self.lanes_per_generation]
+
+        return {
+            "generations": generations,
+            "covered_branches": sorted(self.covered),
+            "corpus_size": len(self.corpus),
+            "storage_writes": {
+                hex(k): sorted(hex(v) for v in vs)
+                for k, vs in self.storage_writes.items()
+            },
+        }

@@ -36,6 +36,7 @@ STORAGE_CAP = 64  # journal entries per lane
 CALLDATA_CAP = 512  # bytes of calldata per lane
 HASH_CAP = 128  # max SHA3 input bytes handled on device (single rate block)
 PC_BITMAP_WORDS = 768  # coverage bitmap words (EVM max code size 24576 / 32)
+BRANCH_CAP = 64  # recorded JUMPI decisions per lane (concolic journal)
 
 
 class Status:
@@ -79,6 +80,9 @@ class StateBatch(NamedTuple):
     ret_offset: jnp.ndarray
     ret_len: jnp.ndarray
     pc_seen: jnp.ndarray  # u32[N, PC_BITMAP_WORDS] executed-pc bitmap (coverage)
+    br_pc: jnp.ndarray  # i32[N, BRANCH_CAP] JUMPI pcs in execution order
+    br_taken: jnp.ndarray  # u8[N, BRANCH_CAP] 1 = branch taken
+    br_cnt: jnp.ndarray  # i32[N] journal length (saturates at BRANCH_CAP)
     # environment (reference: laser/ethereum/state/environment.py)
     address: jnp.ndarray  # u32[N,16]
     caller: jnp.ndarray
@@ -170,6 +174,9 @@ def make_batch(
         ret_offset=jnp.zeros((n,), jnp.int32),
         ret_len=jnp.zeros((n,), jnp.int32),
         pc_seen=jnp.zeros((n, PC_BITMAP_WORDS), jnp.uint32),
+        br_pc=jnp.full((n, BRANCH_CAP), -1, jnp.int32),
+        br_taken=jnp.zeros((n, BRANCH_CAP), jnp.uint8),
+        br_cnt=jnp.zeros((n,), jnp.int32),
         address=_word_rows(n, address),
         caller=_word_rows(n, caller),
         origin=_word_rows(n, caller),

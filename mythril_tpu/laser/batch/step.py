@@ -635,6 +635,20 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     oog = active & (gas_min > batch.gas_budget) & (status != Status.UNSUPPORTED)
     status = jnp.where(oog, Status.ERR_OOG, status)
 
+    # concolic branch journal: record each JUMPI decision in order
+    # (saturates at BRANCH_CAP; the hybrid fuzzer reads it host-side)
+    br_cap = batch.br_pc.shape[1]
+    br_slot = jnp.clip(batch.br_cnt, 0, br_cap - 1)
+    record = jumpi_mask & (batch.br_cnt < br_cap)
+    slot_hit = (
+        jnp.arange(br_cap)[None, :] == br_slot[:, None]
+    ) & record[:, None]
+    br_pc = jnp.where(slot_hit, batch.pc[:, None], batch.br_pc)
+    br_taken = jnp.where(
+        slot_hit, taken.astype(jnp.uint8)[:, None], batch.br_taken
+    )
+    br_cnt = batch.br_cnt + record.astype(jnp.int32)
+
     # coverage bitmap: mark this step's pc for every executing lane
     word_idx = jnp.clip(batch.pc // 32, 0, batch.pc_seen.shape[1] - 1)
     bit = (jnp.uint32(1) << (batch.pc % 32).astype(jnp.uint32))
@@ -649,6 +663,9 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     return batch._replace(
         pc=pc_new,
         pc_seen=pc_seen,
+        br_pc=br_pc,
+        br_taken=br_taken,
+        br_cnt=br_cnt,
         stack=stack,
         sp=sp,
         mem=mem,

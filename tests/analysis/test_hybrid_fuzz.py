@@ -1,0 +1,63 @@
+"""Hybrid concolic fuzzing tests: device execution + solver-driven
+branch flipping must crack magic-value gates that random inputs cannot
+(each gate has ~2^-256 random probability)."""
+
+import pytest
+
+from mythril_tpu.analysis.hybrid_fuzz import HybridFuzzer
+
+
+def two_gate_contract() -> str:
+    """word0 == 0x42 guards gate 1; word1 == 0x1337 guards gate 2;
+    passing both reaches SSTORE(0, 0xbeef)."""
+    code = bytearray()
+    code += bytes.fromhex("600035")
+    code += bytes.fromhex("6042")
+    code += bytes.fromhex("14")
+    d1 = len(code) + 3 + 1
+    code += bytes([0x60, d1, 0x57, 0x00])
+    code += bytes([0x5B])
+    code += bytes.fromhex("602035")
+    code += bytes.fromhex("611337")
+    code += bytes.fromhex("14")
+    d2 = len(code) + 3 + 1
+    code += bytes([0x60, d2, 0x57, 0x00])
+    code += bytes([0x5B])
+    code += bytes.fromhex("61beef60005500")
+    return code.hex()
+
+
+def test_cracks_sequential_magic_gates():
+    fuzzer = HybridFuzzer(
+        two_gate_contract(),
+        calldata_len=64,
+        lanes_per_generation=16,
+        max_generations=6,
+        seed=3,
+    )
+    result = fuzzer.run()
+    # all four branch directions of the two gates were executed
+    pcs = {pc for pc, _ in result["covered_branches"]}
+    assert len(pcs) == 2
+    assert all(
+        (pc, flag) in result["covered_branches"]
+        for pc in pcs
+        for flag in (True, False)
+    )
+    # the double-guarded write was reached with the exact value
+    assert result["storage_writes"].get("0x0") == ["0xbeef"]
+
+
+def test_terminates_without_frontier():
+    # straight-line contract: one generation, no flips possible
+    fuzzer = HybridFuzzer(
+        "6001600055600060015500",
+        calldata_len=8,
+        lanes_per_generation=4,
+        max_generations=4,
+        seed=1,
+    )
+    result = fuzzer.run()
+    assert result["generations"] == 1
+    assert result["covered_branches"] == []
+    assert result["storage_writes"].get("0x0") == ["0x1"]
