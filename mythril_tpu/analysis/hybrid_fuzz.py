@@ -28,7 +28,7 @@ import numpy as np
 from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.batch.run import run as batch_run
-from mythril_tpu.laser.batch.state import BRANCH_CAP, make_batch, make_code_table
+from mythril_tpu.laser.batch.state import BRANCH_CAP, Status, make_batch, make_code_table
 from mythril_tpu.laser.ethereum.instructions import Instruction
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.calldata import SymbolicCalldata
@@ -170,6 +170,9 @@ class HybridFuzzer:
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[bytes] = []
         self.storage_writes: Dict[int, Set[int]] = {}
+        # concrete trigger inputs per terminal failure kind: a lane that
+        # halts INVALID is a ready-made assert-violation witness
+        self.triggers: Dict[str, List[bytes]] = {}
 
     def _seed_inputs(self) -> List[bytes]:
         disassembly = Disassembly(self.code_hex)
@@ -195,6 +198,7 @@ class HybridFuzzer:
             len(inputs), calldata=inputs, caller=CALLER, address=ADDRESS
         )
         out, _ = batch_run(batch, table, max_steps=4096)
+        status_arr = np.asarray(out.status)
         br_pc = np.asarray(out.br_pc)
         br_taken = np.asarray(out.br_taken)
         br_cnt = np.asarray(out.br_cnt)
@@ -205,7 +209,17 @@ class HybridFuzzer:
         lanes = []
         from mythril_tpu.ops import u256
 
+        _TRIGGER_KINDS = {
+            Status.INVALID: "assert-violation",
+            Status.ERR_JUMP: "invalid-jump",
+            Status.ERR_STACK: "stack-error",
+        }
         for i, data in enumerate(inputs):
+            kind = _TRIGGER_KINDS.get(int(status_arr[i]))
+            if kind is not None:
+                bucket = self.triggers.setdefault(kind, [])
+                if data not in bucket and len(bucket) < 16:
+                    bucket.append(data)
             journal = [
                 (int(br_pc[i, j]), bool(br_taken[i, j]))
                 for j in range(min(int(br_cnt[i]), BRANCH_CAP))
@@ -266,5 +280,9 @@ class HybridFuzzer:
             "storage_writes": {
                 hex(k): sorted(hex(v) for v in vs)
                 for k, vs in self.storage_writes.items()
+            },
+            "triggers": {
+                kind: [data.hex() for data in bucket]
+                for kind, bucket in self.triggers.items()
             },
         }

@@ -61,3 +61,28 @@ def test_terminates_without_frontier():
     assert result["generations"] == 1
     assert result["covered_branches"] == []
     assert result["storage_writes"].get("0x0") == ["0x1"]
+
+
+def test_finds_concrete_assert_violation_behind_gate():
+    """An INVALID (assert) guarded by a 256-bit magic word: the fuzzer
+    must produce the concrete calldata that triggers it."""
+    code = bytearray()
+    code += bytes.fromhex("600035")      # CALLDATALOAD(0)
+    code += bytes.fromhex("60a7")        # PUSH1 0xa7
+    code += bytes.fromhex("14")          # EQ
+    dest = len(code) + 3 + 1
+    code += bytes([0x60, dest, 0x57, 0x00])  # JUMPI; STOP
+    code += bytes([0x5B, 0xFE])          # JUMPDEST; INVALID
+
+    fuzzer = HybridFuzzer(
+        code.hex(),
+        calldata_len=32,
+        lanes_per_generation=8,
+        max_generations=4,
+        seed=11,
+    )
+    result = fuzzer.run()
+    witnesses = result["triggers"].get("assert-violation", [])
+    assert witnesses, "assert violation not triggered"
+    # the witness really carries the gate value in word 0
+    assert int(witnesses[0], 16) == 0xA7
