@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import logging
 import random
-from datetime import datetime
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -29,6 +28,7 @@ from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.batch.run import run as batch_run
 from mythril_tpu.laser.batch.state import BRANCH_CAP, Status, make_batch, make_code_table
+from mythril_tpu.laser.ethereum.evm_exceptions import VmException
 from mythril_tpu.laser.ethereum.instructions import Instruction
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.calldata import SymbolicCalldata
@@ -54,14 +54,14 @@ class _ReplayAbort(Exception):
 
 
 def _symbolic_replay(
-    code_hex: str, calldata_len: int, script: List[Tuple[int, bool]]
+    disassembly: Disassembly, calldata_len: int, script: List[Tuple[int, bool]]
 ) -> Optional[List[int]]:
     """Follow `script` = [(jumpi_pc, taken), ...] symbolically, flip the
     LAST entry, and solve for calldata taking the flipped direction.
     Returns concrete calldata bytes or None."""
     world_state = WorldState()
     account = Account(ADDRESS, concrete_storage=True)
-    account.code = Disassembly(code_hex)
+    account.code = disassembly
     world_state.put_account(account)
     account.set_balance(10**18)
 
@@ -105,6 +105,10 @@ def _symbolic_replay(
             raise _ReplayAbort("nested call in path")
         except TransactionEndSignal:
             raise _ReplayAbort("halted before target")
+        except VmException as e:
+            # e.g. a symbolic jump dest the concrete run resolved fine;
+            # skip this flip, keep the fuzzing run alive
+            raise _ReplayAbort(f"vm exception in replay: {e}")
 
         if op == "JUMPI":
             if seen_branches >= len(script):
@@ -166,6 +170,9 @@ class HybridFuzzer:
         self.max_generations = max_generations
         self.flips_per_generation = flips_per_generation
         self.rng = random.Random(seed)
+        # parsed once: replay and seeding share the same objects
+        self.disassembly = Disassembly(self.code_hex)
+        self.code_table = make_code_table([self.code])
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[bytes] = []
@@ -175,9 +182,8 @@ class HybridFuzzer:
         self.triggers: Dict[str, List[bytes]] = {}
 
     def _seed_inputs(self) -> List[bytes]:
-        disassembly = Disassembly(self.code_hex)
         inputs = [b"\x00" * self.calldata_len]
-        for func_hash in disassembly.func_hashes:
+        for func_hash in self.disassembly.func_hashes:
             selector = bytes.fromhex(func_hash[2:])
             inputs.append(
                 selector
@@ -193,7 +199,7 @@ class HybridFuzzer:
         return inputs[: self.lanes_per_generation]
 
     def _run_generation(self, inputs: List[bytes]) -> List[Dict]:
-        table = make_code_table([self.code])
+        table = self.code_table
         batch = make_batch(
             len(inputs), calldata=inputs, caller=CALLER, address=ADDRESS
         )
@@ -255,7 +261,7 @@ class HybridFuzzer:
                     self.attempted.add(target)
                     try:
                         data = _symbolic_replay(
-                            self.code_hex, self.calldata_len, journal[: i + 1]
+                            self.disassembly, self.calldata_len, journal[: i + 1]
                         )
                     except _ReplayAbort as e:
                         log.debug("replay abort at %s: %s", target, e)

@@ -18,7 +18,7 @@ import numpy as np
 
 from mythril_tpu.laser.batch.state import CodeTable, StateBatch
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: StateBatch gained pc_seen + branch journal
 
 
 def save_checkpoint(
