@@ -86,3 +86,28 @@ def test_finds_concrete_assert_violation_behind_gate():
     assert witnesses, "assert violation not triggered"
     # the witness really carries the gate value in word 0
     assert int(witnesses[0], 16) == 0xA7
+
+
+@pytest.mark.slow
+def test_real_contract_assert_triggers():
+    """On the reference's compiled exceptions contract the loop must
+    produce concrete calldata triggering real assert violations."""
+    import os
+    from pathlib import Path
+
+    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+    src = ref / "tests" / "testdata" / "inputs" / "exceptions.sol.o"
+    if not src.is_file():
+        pytest.skip("reference testdata absent")
+
+    fuzzer = HybridFuzzer(
+        src.read_text().strip(),
+        calldata_len=36,
+        lanes_per_generation=32,
+        max_generations=6,
+        flips_per_generation=12,
+        seed=5,
+    )
+    result = fuzzer.run()
+    assert result["triggers"].get("assert-violation"), "no assert triggers found"
+    assert len(result["covered_branches"]) > 20
