@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Hybrid concolic fuzzing CLI.
+
+Usage:
+  python tools/fuzz.py -c 600035604214... [--calldata-len 68]
+  python tools/fuzz.py -f runtime.hex --generations 8 --lanes 64
+
+Runs the TPU-batched fuzzing loop (see
+mythril_tpu/analysis/hybrid_fuzz.py) against runtime bytecode and
+prints one JSON report: covered branch directions, storage write
+observations, and concrete trigger inputs for assert violations /
+invalid jumps found along the way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="hybrid concolic fuzzer")
+    parser.add_argument("-c", "--code", help="hex runtime bytecode")
+    parser.add_argument("-f", "--codefile", help="file with hex runtime bytecode")
+    parser.add_argument("--calldata-len", type=int, default=68)
+    parser.add_argument("--lanes", type=int, default=32)
+    parser.add_argument("--generations", type=int, default=6)
+    parser.add_argument("--flips", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("-v", action="store_true", help="verbose logging")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO if args.v else logging.CRITICAL)
+    if args.code:
+        code = args.code
+    elif args.codefile:
+        code = Path(args.codefile).read_text().strip()
+    else:
+        parser.error("provide -c CODE or -f FILE")
+
+    from mythril_tpu.analysis.hybrid_fuzz import HybridFuzzer
+
+    fuzzer = HybridFuzzer(
+        code,
+        calldata_len=args.calldata_len,
+        lanes_per_generation=args.lanes,
+        max_generations=args.generations,
+        flips_per_generation=args.flips,
+        seed=args.seed,
+    )
+    result = fuzzer.run()
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()
