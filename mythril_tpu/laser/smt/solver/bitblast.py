@@ -144,7 +144,13 @@ class Blaster:
         """Majority (full-adder carry)."""
         consts = [l for l in (a, b, c) if l in (TRUE_LIT, FALSE_LIT)]
         if len(consts) >= 2:
-            return TRUE_LIT if consts.count(TRUE_LIT) >= 2 else FALSE_LIT
+            if consts.count(TRUE_LIT) >= 2:
+                return TRUE_LIT
+            if consts.count(FALSE_LIT) >= 2:
+                return FALSE_LIT
+            # one TRUE and one FALSE constant cancel: the majority is
+            # whatever the remaining input is
+            return next(l for l in (a, b, c) if l not in (TRUE_LIT, FALSE_LIT))
         if a == TRUE_LIT:
             return self.g_or(b, c)
         if a == FALSE_LIT:

@@ -254,3 +254,53 @@ def test_store_chain_shared_across_queries():
     status, s = check(k == 5, read == 77)
     assert status == sat
     assert s.model().eval(read.raw).value == 77
+
+
+def test_random_differential_wide_ops():
+    """Exhaustive 2-var 6-bit differential over the wider op set
+    (shifts, extract, concat, ite, signed compares): the solver verdict
+    must match complete enumeration exactly — both directions."""
+    from mythril_tpu.laser.smt import Extract, Concat, If, SGT
+    from mythril_tpu.laser.smt.evalterm import eval_term
+
+    rng = random.Random(777)
+    W = 6
+    for trial in range(20):
+        x = bv(f"w{trial}_x", W)
+        y = bv(f"w{trial}_y", W)
+        k1 = val(rng.getrandbits(W), W)
+        k2 = val(rng.getrandbits(W), W)
+        kind = trial % 5
+        if kind == 0:
+            cons = [(x << (y & 3)) == k1, ULT(y, 40)]
+        elif kind == 1:
+            cons = [Extract(3, 1, x) == Extract(2, 0, k1), (x ^ y) == k2]
+        elif kind == 2:
+            cons = [Concat(Extract(2, 0, x), Extract(2, 0, y)) == k1]
+        elif kind == 3:
+            cons = [If(ULT(x, y), x + k1, y - k1) == k2]
+        else:
+            cons = [SGT(x, y), (x & k1) == (y & k1)]
+
+        status, s = check(*cons)
+
+        brute_sat = False
+        for vx in range(1 << W):
+            for vy in range(1 << W):
+                asn = {f"w{trial}_x": vx, f"w{trial}_y": vy}
+                if all(eval_term(c.raw, asn) for c in cons):
+                    brute_sat = True
+                    break
+            if brute_sat:
+                break
+
+        assert (status == sat) == brute_sat, (
+            f"trial {trial} kind {kind}: solver={status} brute_sat={brute_sat}"
+        )
+        if status == sat:
+            m = s.model()
+            asn = {
+                f"w{trial}_x": m.eval(x.raw).value,
+                f"w{trial}_y": m.eval(y.raw).value,
+            }
+            assert all(eval_term(c.raw, asn) for c in cons)
