@@ -51,6 +51,9 @@ OPS = [
 ]
 OP_INDEX = {name: i for i, name in enumerate(OPS)}
 
+# the term layer names bitwise BV ops without the bv prefix
+_OP_ALIASES = {"and": "bvand", "or": "bvor", "xor": "bvxor", "not": "bvnot"}
+
 
 class Program:
     """A compiled constraint set: flat node arrays + metadata."""
@@ -185,8 +188,8 @@ def compile_program(
             args[i, 0] = index[c._id]
             args[i, 1] = index[a._id]
             args[i, 2] = index[b._id]
-        elif op in OP_INDEX:
-            opcodes[i] = OP_INDEX[op]
+        elif op in _OP_ALIASES or op in OP_INDEX:
+            opcodes[i] = OP_INDEX[_OP_ALIASES.get(op, op)]
             for k, a in enumerate(t.args[:3]):
                 if isinstance(a, Term):
                     args[i, k] = index[a._id]
