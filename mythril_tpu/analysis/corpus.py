@@ -64,10 +64,16 @@ def _analyze_one(payload: Tuple) -> Dict:
         return {
             "name": name,
             "issues": [issue.as_dict for issue in issues],
+            "states": sym.laser.total_states,
             "error": None,
         }
     except Exception:
-        return {"name": name, "issues": [], "error": traceback.format_exc()}
+        return {
+            "name": name,
+            "issues": [],
+            "states": 0,
+            "error": traceback.format_exc(),
+        }
 
 
 def analyze_corpus(
