@@ -99,6 +99,11 @@ def _symbolic_replay(
         except IndexError:
             raise _ReplayAbort("walked off code before target")
         op = instr["opcode"]
+        # peek the condition before evaluate pops it: a concrete value
+        # disambiguates single survivors whose jump target is pc + 1
+        pre_cond_value = None
+        if op == "JUMPI" and len(state.mstate.stack) >= 2:
+            pre_cond_value = getattr(state.mstate.stack[-2], "value", None)
         try:
             successors = Instruction(op, None).evaluate(state)
         except TransactionStartSignal:
@@ -116,15 +121,31 @@ def _symbolic_replay(
             want_taken = script[seen_branches][1]
             if seen_branches == flip_index:
                 want_taken = not want_taken
-            # identify successors: fall-through has pc == index + 1
-            fallthrough = next(
-                (s for s in successors if s.mstate.pc == state.mstate.pc + 1),
-                None,
-            )
-            taken = next(
-                (s for s in successors if s.mstate.pc != state.mstate.pc + 1),
-                None,
-            )
+            # identify successors. jumpi_ appends fall-through first and
+            # taken second, so a 2-successor result is unambiguous even
+            # when the jump target IS the next instruction (pc + 1); only
+            # then fall back to the pc comparison for single survivors.
+            if len(successors) == 2:
+                fallthrough, taken = successors
+            else:
+                fallthrough = taken = None
+                s = successors[0] if successors else None
+                if s is None:
+                    pass
+                elif s.mstate.pc != state.mstate.pc + 1:
+                    taken = s
+                elif pre_cond_value is not None:
+                    # target == pc + 1 with a concrete condition: jumpi_
+                    # kept exactly the branch the condition selects
+                    if pre_cond_value != 0:
+                        taken = s
+                    else:
+                        fallthrough = s
+                else:
+                    # symbolic condition with one survivor at pc + 1 can
+                    # only be the fall-through (the taken twin would have
+                    # survived too if the target were a JUMPDEST)
+                    fallthrough = s
             chosen = taken if want_taken else fallthrough
             if chosen is None:
                 # the wanted direction is infeasible (engine pruned it)

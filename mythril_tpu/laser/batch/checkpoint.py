@@ -46,16 +46,37 @@ def load_checkpoint(
     """Restore (batch, code_table_or_None, step) from `path`."""
     with np.load(str(path)) as data:
         meta = json.loads(bytes(data["meta"]).decode())
-        if meta.get("version") != FORMAT_VERSION:
+        if meta.get("version") not in (1, FORMAT_VERSION):
             raise ValueError(
                 f"unsupported checkpoint version {meta.get('version')}"
             )
-        batch = StateBatch(
-            **{
-                name: data[f"batch.{name}"]
-                for name in StateBatch._fields
+        fields = {}
+        for name in StateBatch._fields:
+            key = f"batch.{name}"
+            if key in data:
+                fields[name] = data[key]
+        missing = [n for n in StateBatch._fields if n not in fields]
+        # v1 checkpoints predate pc_seen + the branch journal; those
+        # fields start empty, so zero-fill exactly them at the stored
+        # lane count. Any other absence (any version) is corruption.
+        V1_MISSING_OK = {"pc_seen", "br_pc", "br_taken", "br_cnt"}
+        if missing and (
+            meta.get("version") != 1 or not set(missing) <= V1_MISSING_OK
+        ):
+            raise ValueError(f"checkpoint missing fields: {missing}")
+        if missing:
+            from mythril_tpu.laser.batch.state import BRANCH_CAP, PC_BITMAP_WORDS
+
+            n = int(np.asarray(fields["pc"]).shape[0])
+            empties = {
+                "pc_seen": lambda: np.zeros((n, PC_BITMAP_WORDS), np.uint32),
+                "br_pc": lambda: np.full((n, BRANCH_CAP), -1, np.int32),
+                "br_taken": lambda: np.zeros((n, BRANCH_CAP), np.uint8),
+                "br_cnt": lambda: np.zeros((n,), np.int32),
             }
-        )
+            for name in missing:
+                fields[name] = empties[name]()
+        batch = StateBatch(**fields)
         code = None
         if f"code.{CodeTable._fields[0]}" in data:
             code = CodeTable(

@@ -24,6 +24,10 @@ from mythril_tpu.laser.smt import (
 )
 from mythril_tpu.laser.smt.model import Model
 
+# Hard bound on materialized slice length: calldata past this size is not
+# meaningful EVM input, and wrap-around spans would iterate ~2^256 times.
+MAX_SLICE_ELEMENTS = 1 << 20
+
 
 class BaseCalldata:
     """Base symbolic calldata representation."""
@@ -69,10 +73,21 @@ class BaseCalldata:
             )
             # symbolic base with a decidable span: iterate by count —
             # symbolic indices are fine, only the length must be concrete
+            step_val = step.value if isinstance(step, BitVec) else step
+            if step_val is None or step_val <= 0:
+                raise Z3IndexingError("calldata slice step must be a concrete positive int")
             span = simplify(stop_bv - current_index)
             parts = []
             if span.value is not None:
-                for _ in range(span.value):
+                count = (span.value + step_val - 1) // step_val
+                if count > MAX_SLICE_ELEMENTS:
+                    # a wrap-around span (stop < start mod 2^256) would
+                    # otherwise iterate ~2^256 times
+                    raise Z3IndexingError(
+                        f"calldata slice spans {count} elements "
+                        f"(cap {MAX_SLICE_ELEMENTS})"
+                    )
+                for _ in range(count):
                     parts.append(self._load(current_index))
                     current_index = simplify(current_index + step)
                 return parts

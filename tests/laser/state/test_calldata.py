@@ -61,3 +61,24 @@ def test_basic_symbolic_reads_consistent():
     s.add(cd.calldatasize == 4)
     s.add((v1 == v2) == False)  # noqa: E712  (must be unsat)
     assert s.check() != sat
+
+
+def test_stepped_slice_element_count():
+    # step != 1 must yield ceil(span/step) elements, not span elements
+    cd = ConcreteCalldata(0, list(range(10)))
+    vals = [v.value for v in cd[0:10:2]]
+    assert vals == [0, 2, 4, 6, 8]
+    vals = [v.value for v in cd[1:8:3]]
+    assert vals == [1, 4, 7]
+
+
+def test_wraparound_slice_rejected():
+    # stop < start wraps mod 2^256 -> astronomically large span; must
+    # raise instead of hanging
+    from mythril_tpu.laser.ethereum.state.calldata import Z3IndexingError
+
+    cd = ConcreteCalldata(0, list(range(4)))
+    with pytest.raises(Z3IndexingError):
+        cd[3:1]
+    with pytest.raises(Z3IndexingError):
+        cd[0:4:0]

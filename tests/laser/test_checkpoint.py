@@ -67,3 +67,29 @@ def test_version_guard(tmp_path):
     np.savez_compressed(str(path), **data)
     with pytest.raises(ValueError):
         load_checkpoint(path)
+
+
+def test_v1_checkpoint_zero_fills_new_fields(tmp_path):
+    # v1 checkpoints predate pc_seen + the branch journal; loading one
+    # must zero-fill those fields rather than reject the file
+    import json
+
+    batch, code = demo()
+    path = tmp_path / "v1.npz"
+    save_checkpoint(path, batch, code)
+    data = dict(np.load(str(path)))
+    for key in list(data):
+        if key.split(".", 1)[-1] in ("pc_seen", "br_pc", "br_taken", "br_cnt"):
+            del data[key]
+    data["meta"] = np.frombuffer(
+        json.dumps({"version": 1, "step": 0}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(str(path), **data)
+
+    restored, code2, _ = load_checkpoint(path)
+    assert int(np.asarray(restored.br_cnt).sum()) == 0
+    done_a, _ = run(batch, code, max_steps=64)
+    done_b, _ = run(restored, code2, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(done_a.status), np.asarray(done_b.status)
+    )
