@@ -1,1740 +1,20 @@
-"""Symbolic semantics for every EVM opcode.
+"""Compatibility import surface for the symbolic EVM semantics.
 
-Reference parity: mythril/laser/ethereum/instructions.py (2415 LoC).
-One `Instruction` class dispatches `<op>_` handler methods (and
-`<op>_post` resume handlers for the CALL/CREATE family); the
-`StateTransition` decorator copies the incoming state, enforces
-static-call write protection, accumulates the opcode's gas bounds and
-increments the pc (reference instructions.py:95-198). Branching
-(`jumpi_`, reference :1543-1619) forks the path and appends the branch
-condition; CALL/CREATE raise `TransactionStartSignal`; RETURN/STOP/
-REVERT/SUICIDE end the transaction frame via `tx.end()`.
-
-Design note vs the reference: handlers here share small helpers
-(`_to_bitvec`, `_bool_to_word`) instead of repeating inline coercions,
-and concreteness checks use `.value` explicitly because this SMT
-layer's Bool refuses implicit truthiness on symbolic values.
+The implementation lives in the table-driven `vm` package (see
+mythril_tpu/laser/ethereum/vm/): opcode handlers are registered
+declaratively and dispatched through one core, replacing the
+reference's monolithic Instruction class
+(mythril/laser/ethereum/instructions.py, 2415 LoC). This module keeps
+the historical import path alive for the engine, tests and
+third-party plugins.
 """
 
-from __future__ import annotations
-
-import logging
-from copy import copy
-from typing import Callable, List, Optional, Union, cast
-
-import mythril_tpu.laser.ethereum.util as util
-from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.laser.ethereum.call import (
-    SYMBOLIC_CALLDATA_SIZE,
-    get_call_data,
-    get_call_parameters,
-    native_call,
+from mythril_tpu.laser.ethereum.vm import (  # noqa: F401
+    Frame,
+    Instruction,
+    TABLE,
+    run_opcode,
+    transfer_ether,
 )
-from mythril_tpu.laser.ethereum.evm_exceptions import (
-    InvalidInstruction,
-    InvalidJumpDestination,
-    OutOfGasException,
-    StackUnderflowException,
-    VmException,
-    WriteProtection,
-)
-from mythril_tpu.laser.ethereum.instruction_data import (
-    calculate_sha3_gas,
-    get_opcode_gas,
-)
-from mythril_tpu.laser.ethereum.keccak_function_manager import (
-    keccak_function_manager,
-)
-from mythril_tpu.laser.ethereum.state.calldata import (
-    ConcreteCalldata,
-    SymbolicCalldata,
-)
-from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction import (
-    ContractCreationTransaction,
-    MessageCallTransaction,
-    TransactionStartSignal,
-    get_next_transaction_id,
-)
-from mythril_tpu.laser.smt import (
-    BitVec,
-    Bool,
-    Concat,
-    Expression,
-    Extract,
-    If,
-    LShR,
-    Not,
-    SRem,
-    UDiv,
-    ZeroExt,
-    UGE,
-    UGT,
-    ULT,
-    URem,
-    is_false,
-    is_true,
-    simplify,
-    symbol_factory,
-)
-from mythril_tpu.support.support_utils import get_code_hash
 
-log = logging.getLogger(__name__)
-
-TT256 = 2**256
-TT256M1 = 2**256 - 1
-
-
-def transfer_ether(
-    global_state: GlobalState,
-    sender: BitVec,
-    receiver: BitVec,
-    value: Union[int, BitVec],
-) -> None:
-    """Move `value` wei with the solvency constraint UGE(balance[sender],
-    value) (reference: instructions.py:71)."""
-    value = value if isinstance(value, BitVec) else symbol_factory.BitVecVal(value, 256)
-
-    global_state.world_state.constraints.append(
-        UGE(global_state.world_state.balances[sender], value)
-    )
-    global_state.world_state.balances[receiver] += value
-    global_state.world_state.balances[sender] -= value
-
-
-def _to_bitvec(item) -> BitVec:
-    """Coerce a stack element (int / Bool / BitVec) into a 256-bit word."""
-    if isinstance(item, Bool):
-        return If(
-            item, symbol_factory.BitVecVal(1, 256), symbol_factory.BitVecVal(0, 256)
-        )
-    if isinstance(item, int):
-        return symbol_factory.BitVecVal(item, 256)
-    return item
-
-
-class StateTransition:
-    """Handler decorator: copy the state, run the mutator on the copy,
-    accumulate gas bounds, bump the pc (reference: instructions.py:95).
-
-    `is_state_mutation_instruction` raises WriteProtection inside
-    STATICCALL frames before the handler runs.
-    """
-
-    def __init__(
-        self,
-        increment_pc: bool = True,
-        enable_gas: bool = True,
-        is_state_mutation_instruction: bool = False,
-    ):
-        self.increment_pc = increment_pc
-        self.enable_gas = enable_gas
-        self.is_state_mutation_instruction = is_state_mutation_instruction
-
-    @staticmethod
-    def check_gas_usage_limit(global_state: GlobalState) -> None:
-        global_state.mstate.check_gas()
-        if isinstance(global_state.current_transaction.gas_limit, BitVec):
-            value = global_state.current_transaction.gas_limit.value
-            if value is None:
-                return
-            global_state.current_transaction.gas_limit = value
-        if (
-            global_state.mstate.min_gas_used
-            >= global_state.current_transaction.gas_limit
-        ):
-            raise OutOfGasException()
-
-    def accumulate_gas(self, global_state: GlobalState) -> GlobalState:
-        if not self.enable_gas:
-            return global_state
-        opcode = global_state.instruction["opcode"]
-        min_gas, max_gas = get_opcode_gas(opcode)
-        global_state.mstate.min_gas_used += min_gas
-        global_state.mstate.max_gas_used += max_gas
-        self.check_gas_usage_limit(global_state)
-        return global_state
-
-    def __call__(self, func: Callable) -> Callable:
-        def wrapper(
-            func_obj: "Instruction", global_state: GlobalState
-        ) -> List[GlobalState]:
-            if self.is_state_mutation_instruction and global_state.environment.static:
-                raise WriteProtection(
-                    f"The function {func.__name__[:-1]} cannot be executed in a"
-                    " static call"
-                )
-            new_states = func(func_obj, copy(global_state))
-            new_states = [self.accumulate_gas(state) for state in new_states]
-            if self.increment_pc:
-                for state in new_states:
-                    state.mstate.pc += 1
-            return new_states
-
-        return wrapper
-
-
-class Instruction:
-    """Mutates a GlobalState according to one opcode."""
-
-    def __init__(
-        self,
-        op_code: str,
-        dynamic_loader,
-        pre_hooks: List[Callable] = None,
-        post_hooks: List[Callable] = None,
-    ) -> None:
-        self.dynamic_loader = dynamic_loader
-        self.op_code = op_code.upper()
-        self.pre_hook = pre_hooks if pre_hooks else []
-        self.post_hook = post_hooks if post_hooks else []
-
-    def evaluate(self, global_state: GlobalState, post: bool = False) -> List[GlobalState]:
-        """Dispatch to the handler; PUSHn/DUPn/SWAPn/LOGn generalize to
-        one handler each (reference: instructions.py:231-263)."""
-        log.debug("Evaluating %s at %i", self.op_code, global_state.mstate.pc)
-
-        op = self.op_code.lower()
-        if self.op_code.startswith("PUSH"):
-            op = "push"
-        elif self.op_code.startswith("DUP"):
-            op = "dup"
-        elif self.op_code.startswith("SWAP"):
-            op = "swap"
-        elif self.op_code.startswith("LOG"):
-            op = "log"
-
-        instruction_mutator = (
-            getattr(self, op + "_", None)
-            if not post
-            else getattr(self, op + "_post", None)
-        )
-        if instruction_mutator is None:
-            raise NotImplementedError
-
-        for hook in self.pre_hook:
-            hook(global_state)
-        result = instruction_mutator(global_state)
-        for hook in self.post_hook:
-            hook(global_state)
-        return result
-
-    # ------------------------------------------------------------------
-    # stack manipulation
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def jumpdest_(self, global_state: GlobalState) -> List[GlobalState]:
-        return [global_state]
-
-    @StateTransition()
-    def push_(self, global_state: GlobalState) -> List[GlobalState]:
-        push_instruction = global_state.get_current_instruction()
-        push_value = push_instruction["argument"][2:]
-        try:
-            length_of_value = 2 * int(push_instruction["opcode"][4:])
-        except ValueError:
-            raise VmException("Invalid Push instruction")
-
-        # truncated PUSH data at end-of-code reads as zero-padded
-        push_value += "0" * max(length_of_value - len(push_value), 0)
-        global_state.mstate.stack.append(
-            symbol_factory.BitVecVal(int(push_value, 16), 256)
-        )
-        return [global_state]
-
-    @StateTransition()
-    def dup_(self, global_state: GlobalState) -> List[GlobalState]:
-        value = int(self.op_code[3:], 10)
-        global_state.mstate.stack.append(global_state.mstate.stack[-value])
-        return [global_state]
-
-    @StateTransition()
-    def swap_(self, global_state: GlobalState) -> List[GlobalState]:
-        depth = int(self.op_code[4:])
-        stack = global_state.mstate.stack
-        stack[-depth - 1], stack[-1] = stack[-1], stack[-depth - 1]
-        return [global_state]
-
-    @StateTransition()
-    def pop_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.pop()
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # bitwise
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def and_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        op1, op2 = _to_bitvec(stack.pop()), _to_bitvec(stack.pop())
-        stack.append(op1 & op2)
-        return [global_state]
-
-    @StateTransition()
-    def or_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        op1, op2 = _to_bitvec(stack.pop()), _to_bitvec(stack.pop())
-        stack.append(op1 | op2)
-        return [global_state]
-
-    @StateTransition()
-    def xor_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(
-            _to_bitvec(mstate.stack.pop()) ^ _to_bitvec(mstate.stack.pop())
-        )
-        return [global_state]
-
-    @StateTransition()
-    def not_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(
-            symbol_factory.BitVecVal(TT256M1, 256) - util.pop_bitvec(mstate)
-        )
-        return [global_state]
-
-    @StateTransition()
-    def byte_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        op0, op1 = mstate.stack.pop(), _to_bitvec(mstate.stack.pop())
-        try:
-            index = util.get_concrete_int(op0)
-            offset = (31 - index) * 8
-            if offset >= 0:
-                result: Union[int, Expression] = simplify(
-                    Concat(
-                        symbol_factory.BitVecVal(0, 248),
-                        Extract(offset + 7, offset, op1),
-                    )
-                )
-            else:
-                result = 0
-        except TypeError:
-            log.debug("BYTE: Unsupported symbolic byte offset")
-            result = global_state.new_bitvec(
-                str(simplify(op1)) + "[" + str(simplify(op0)) + "]", 256
-            )
-        mstate.stack.append(result)
-        return [global_state]
-
-    @StateTransition()
-    def shl_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(value << shift)
-        return [global_state]
-
-    @StateTransition()
-    def shr_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(LShR(value, shift))
-        return [global_state]
-
-    @StateTransition()
-    def sar_(self, global_state: GlobalState) -> List[GlobalState]:
-        shift, value = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(value >> shift)
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # arithmetic
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def add_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) + util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def sub_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) - util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def mul_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        mstate.stack.append(util.pop_bitvec(mstate) * util.pop_bitvec(mstate))
-        return [global_state]
-
-    @StateTransition()
-    def div_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        op0, op1 = util.pop_bitvec(mstate), util.pop_bitvec(mstate)
-        if op1.value == 0:
-            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        else:
-            mstate.stack.append(UDiv(op0, op1))
-        return [global_state]
-
-    @StateTransition()
-    def sdiv_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1 = util.pop_bitvec(mstate), util.pop_bitvec(mstate)
-        if s1.value == 0:
-            mstate.stack.append(symbol_factory.BitVecVal(0, 256))
-        else:
-            mstate.stack.append(s0 / s1)
-        return [global_state]
-
-    @StateTransition()
-    def mod_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1 = util.pop_bitvec(mstate), util.pop_bitvec(mstate)
-        mstate.stack.append(0 if s1.value == 0 else URem(s0, s1))
-        return [global_state]
-
-    @StateTransition()
-    def smod_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0, s1 = util.pop_bitvec(mstate), util.pop_bitvec(mstate)
-        mstate.stack.append(0 if s1.value == 0 else SRem(s0, s1))
-        return [global_state]
-
-    @StateTransition()
-    def addmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        # computed at 257 bits: the reference's
-        # URem(URem(a,m)+URem(b,m), m) truncates the intermediate sum
-        # at 256 bits and diverges from the EVM for residues whose sum
-        # overflows (found by engine-differential testing)
-        mstate = global_state.mstate
-        s0, s1, s2 = (
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-        )
-        wide = URem(ZeroExt(1, s0) + ZeroExt(1, s1), ZeroExt(1, s2))
-        mstate.stack.append(Extract(255, 0, wide))
-        return [global_state]
-
-    @StateTransition()
-    def mulmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        # computed at 512 bits for the same reason: residue products
-        # overflow 256 bits, so the reference's truncating formula is
-        # wrong for large operands
-        mstate = global_state.mstate
-        s0, s1, s2 = (
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-            util.pop_bitvec(mstate),
-        )
-        wide = URem(ZeroExt(256, s0) * ZeroExt(256, s1), ZeroExt(256, s2))
-        mstate.stack.append(Extract(255, 0, wide))
-        return [global_state]
-
-    @StateTransition()
-    def exp_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        base, exponent = util.pop_bitvec(state), util.pop_bitvec(state)
-
-        if base.symbolic or exponent.symbolic:
-            # term ids make a stable short name (reference hashes the
-            # z3 AST for the same reason: str() of big terms is slow)
-            state.stack.append(
-                global_state.new_bitvec(
-                    "invhash("
-                    + str(hash(simplify(base)))
-                    + ")**invhash("
-                    + str(hash(simplify(exponent)))
-                    + ")",
-                    256,
-                    base.annotations.union(exponent.annotations),
-                )
-            )
-        else:
-            state.stack.append(
-                symbol_factory.BitVecVal(
-                    pow(base.value, exponent.value, TT256),
-                    256,
-                    annotations=base.annotations.union(exponent.annotations),
-                )
-            )
-        return [global_state]
-
-    @StateTransition()
-    def signextend_(self, global_state: GlobalState) -> List[GlobalState]:
-        mstate = global_state.mstate
-        s0 = _to_bitvec(mstate.stack.pop())
-        s1 = _to_bitvec(mstate.stack.pop())
-        try:
-            s0 = util.get_concrete_int(s0)
-        except TypeError:
-            log.debug("Unsupported symbolic argument for SIGNEXTEND")
-            mstate.stack.append(
-                global_state.new_bitvec(
-                    "SIGNEXTEND({},{})".format(hash(s0), hash(s1)), 256
-                )
-            )
-            return [global_state]
-
-        if s0 <= 31:
-            testbit = s0 * 8 + 7
-            if not is_true(simplify((s1 & (1 << testbit)) == 0)):
-                mstate.stack.append(s1 | (TT256 - (1 << testbit)))
-            else:
-                mstate.stack.append(s1 & ((1 << testbit) - 1))
-        else:
-            mstate.stack.append(s1)
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # comparisons
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def lt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(ULT(util.pop_bitvec(state), util.pop_bitvec(state)))
-        return [global_state]
-
-    @StateTransition()
-    def gt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(UGT(util.pop_bitvec(state), util.pop_bitvec(state)))
-        return [global_state]
-
-    @StateTransition()
-    def slt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(util.pop_bitvec(state) < util.pop_bitvec(state))
-        return [global_state]
-
-    @StateTransition()
-    def sgt_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        state.stack.append(util.pop_bitvec(state) > util.pop_bitvec(state))
-        return [global_state]
-
-    @StateTransition()
-    def eq_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op1 = _to_bitvec(state.stack.pop())
-        op2 = _to_bitvec(state.stack.pop())
-        state.stack.append(op1 == op2)
-        return [global_state]
-
-    @StateTransition()
-    def iszero_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        val = state.stack.pop()
-        exp = Not(val) if isinstance(val, Bool) else val == 0
-        exp = If(
-            exp, symbol_factory.BitVecVal(1, 256), symbol_factory.BitVecVal(0, 256)
-        )
-        state.stack.append(simplify(exp))
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # call data
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def callvalue_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.callvalue)
-        return [global_state]
-
-    @StateTransition()
-    def calldataload_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op0 = state.stack.pop()
-        state.stack.append(global_state.environment.calldata.get_word_at(op0))
-        return [global_state]
-
-    @StateTransition()
-    def calldatasize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            log.debug("Attempt to use CALLDATASIZE in creation transaction")
-            state.stack.append(0)
-        else:
-            state.stack.append(global_state.environment.calldata.calldatasize)
-        return [global_state]
-
-    @staticmethod
-    def _calldata_copy_helper(global_state, mstate, mstart, dstart, size):
-        environment = global_state.environment
-
-        try:
-            mstart = util.get_concrete_int(mstart)
-        except TypeError:
-            log.debug("Unsupported symbolic memory offset in CALLDATACOPY")
-            return [global_state]
-
-        try:
-            dstart: Union[int, BitVec] = util.get_concrete_int(dstart)
-        except TypeError:
-            log.debug("Unsupported symbolic calldata offset in CALLDATACOPY")
-            dstart = simplify(dstart)
-
-        try:
-            size: Union[int, BitVec] = util.get_concrete_int(size)
-        except TypeError:
-            log.debug("Unsupported symbolic size in CALLDATACOPY")
-            size = SYMBOLIC_CALLDATA_SIZE  # excess gets overwritten later
-
-        size = cast(int, size)
-        if size > 0:
-            try:
-                mstate.mem_extend(mstart, size)
-            except TypeError as e:
-                log.debug("Memory allocation error: %s", e)
-                mstate.mem_extend(mstart, 1)
-                mstate.memory[mstart] = global_state.new_bitvec(
-                    "calldata_"
-                    + str(environment.active_account.contract_name)
-                    + "["
-                    + str(dstart)
-                    + ": + "
-                    + str(size)
-                    + "]",
-                    8,
-                )
-                return [global_state]
-
-            try:
-                i_data = dstart
-                new_memory = []
-                for i in range(size):
-                    new_memory.append(environment.calldata[i_data])
-                    i_data = (
-                        i_data + 1
-                        if isinstance(i_data, int)
-                        else simplify(cast(BitVec, i_data) + 1)
-                    )
-                for i in range(len(new_memory)):
-                    mstate.memory[i + mstart] = new_memory[i]
-            except IndexError:
-                log.debug("Exception copying calldata to memory")
-                mstate.memory[mstart] = global_state.new_bitvec(
-                    "calldata_"
-                    + str(environment.active_account.contract_name)
-                    + "["
-                    + str(dstart)
-                    + ": + "
-                    + str(size)
-                    + "]",
-                    8,
-                )
-        return [global_state]
-
-    @StateTransition()
-    def calldatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        op0, op1, op2 = state.stack.pop(), state.stack.pop(), state.stack.pop()
-
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            log.debug("Attempt to use CALLDATACOPY in creation transaction")
-            return [global_state]
-
-        return self._calldata_copy_helper(global_state, state, op0, op1, op2)
-
-    # ------------------------------------------------------------------
-    # environment
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def address_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.address)
-        return [global_state]
-
-    @StateTransition()
-    def balance_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        address = state.stack.pop()
-        if address.symbolic is False:
-            balance = global_state.world_state.accounts_exist_or_load(
-                hex(address.value), self.dynamic_loader
-            ).balance()
-        else:
-            # If-chain over known accounts; unknown symbolic address -> 0
-            balance = symbol_factory.BitVecVal(0, 256)
-            for account in global_state.world_state.accounts.values():
-                balance = If(address == account.address, account.balance(), balance)
-        state.stack.append(balance)
-        return [global_state]
-
-    @StateTransition()
-    def origin_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.origin)
-        return [global_state]
-
-    @StateTransition()
-    def caller_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.sender)
-        return [global_state]
-
-    @StateTransition()
-    def chainid_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.chainid)
-        return [global_state]
-
-    @StateTransition()
-    def selfbalance_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(
-            global_state.environment.active_account.balance()
-        )
-        return [global_state]
-
-    @StateTransition()
-    def basefee_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.basefee)
-        return [global_state]
-
-    @StateTransition()
-    def codesize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        environment = global_state.environment
-        disassembly = environment.code
-        calldata = environment.calldata
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            # creation code models constructor args through calldata;
-            # reserve room for them (reference: instructions.py codesize_)
-            no_of_bytes = len(disassembly.bytecode) // 2
-            if isinstance(calldata, ConcreteCalldata):
-                no_of_bytes += calldata.size
-            else:
-                no_of_bytes += 0x200  # 16 32-byte arguments
-                global_state.world_state.constraints.append(
-                    environment.calldata.calldatasize == no_of_bytes
-                )
-        else:
-            no_of_bytes = len(disassembly.bytecode) // 2
-        state.stack.append(no_of_bytes)
-        return [global_state]
-
-    @staticmethod
-    def _sha3_gas_helper(global_state, length):
-        min_gas, max_gas = calculate_sha3_gas(length)
-        global_state.mstate.min_gas_used += min_gas
-        global_state.mstate.max_gas_used += max_gas
-        StateTransition.check_gas_usage_limit(global_state)
-        return global_state
-
-    @StateTransition(enable_gas=False)
-    def sha3_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        index, op1 = state.stack.pop(), state.stack.pop()
-
-        try:
-            length = util.get_concrete_int(op1)
-        except TypeError:
-            # symbolic length: constrain to 64 (the common two-word
-            # mapping-slot pattern; reference :1010-1048)
-            length = 64
-            global_state.world_state.constraints.append(op1 == length)
-        Instruction._sha3_gas_helper(global_state, length)
-
-        state.mem_extend(index, length)
-        data_list = [
-            b if isinstance(b, BitVec) else symbol_factory.BitVecVal(b, 8)
-            for b in state.memory[index : index + length]
-        ]
-        if len(data_list) > 1:
-            data = simplify(Concat(data_list))
-        elif len(data_list) == 1:
-            data = data_list[0]
-        else:
-            state.stack.append(keccak_function_manager.get_empty_keccak_hash())
-            return [global_state]
-
-        result, condition = keccak_function_manager.create_keccak(data)
-        state.stack.append(result)
-        global_state.world_state.constraints.append(condition)
-        return [global_state]
-
-    @StateTransition()
-    def gasprice_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.gasprice)
-        return [global_state]
-
-    @staticmethod
-    def _code_copy_helper(
-        code: str,
-        memory_offset: Union[int, BitVec],
-        code_offset: Union[int, BitVec],
-        size: Union[int, BitVec],
-        op: str,
-        global_state: GlobalState,
-    ) -> List[GlobalState]:
-        try:
-            concrete_memory_offset = util.get_concrete_int(memory_offset)
-        except TypeError:
-            log.debug("Unsupported symbolic memory offset in %s", op)
-            return [global_state]
-
-        try:
-            concrete_size = util.get_concrete_int(size)
-            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-        except TypeError:
-            # symbolic size: single symbolic placeholder byte
-            global_state.mstate.mem_extend(concrete_memory_offset, 1)
-            global_state.mstate.memory[
-                concrete_memory_offset
-            ] = global_state.new_bitvec(
-                "code({})".format(
-                    global_state.environment.active_account.contract_name
-                ),
-                8,
-            )
-            return [global_state]
-
-        try:
-            concrete_code_offset = util.get_concrete_int(code_offset)
-        except TypeError:
-            log.debug("Unsupported symbolic code offset in %s", op)
-            global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-            for i in range(concrete_size):
-                global_state.mstate.memory[
-                    concrete_memory_offset + i
-                ] = global_state.new_bitvec(
-                    "code({})".format(
-                        global_state.environment.active_account.contract_name
-                    ),
-                    8,
-                )
-            return [global_state]
-
-        if code[0:2] == "0x":
-            code = code[2:]
-
-        for i in range(concrete_size):
-            if 2 * (concrete_code_offset + i + 1) > len(code):
-                break
-            global_state.mstate.memory[concrete_memory_offset + i] = int(
-                code[2 * (concrete_code_offset + i) : 2 * (concrete_code_offset + i + 1)],
-                16,
-            )
-        return [global_state]
-
-    @StateTransition()
-    def codecopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        memory_offset, code_offset, size = (
-            global_state.mstate.stack.pop(),
-            global_state.mstate.stack.pop(),
-            global_state.mstate.stack.pop(),
-        )
-        code = global_state.environment.code.bytecode
-        if code[0:2] == "0x":
-            code = code[2:]
-        code_size = len(code) // 2
-
-        if isinstance(global_state.current_transaction, ContractCreationTransaction):
-            # creation frame: bytes past the init code are constructor
-            # arguments, modeled as calldata (reference codecopy_)
-            mstate = global_state.mstate
-            offset = code_offset - code_size
-            log.debug("Copying from code offset: %s with size: %s", offset, size)
-
-            if isinstance(global_state.environment.calldata, SymbolicCalldata):
-                cco = code_offset
-                if not isinstance(cco, int):
-                    cco = cco.value if cco.value is not None else None
-                if cco is not None and cco >= code_size:
-                    return self._calldata_copy_helper(
-                        global_state, mstate, memory_offset, offset, size
-                    )
-            else:
-                # split the copy across code and calldata
-                concrete_code_offset = util.get_concrete_int(code_offset)
-                concrete_size = util.get_concrete_int(size)
-
-                code_copy_offset = concrete_code_offset
-                code_copy_size = (
-                    concrete_size
-                    if concrete_code_offset + concrete_size <= code_size
-                    else code_size - concrete_code_offset
-                )
-                code_copy_size = code_copy_size if code_copy_size >= 0 else 0
-
-                calldata_copy_offset = max(concrete_code_offset - code_size, 0)
-                calldata_copy_size = concrete_code_offset + concrete_size - code_size
-                calldata_copy_size = max(calldata_copy_size, 0)
-
-                [global_state] = self._code_copy_helper(
-                    code=global_state.environment.code.bytecode,
-                    memory_offset=memory_offset,
-                    code_offset=code_copy_offset,
-                    size=code_copy_size,
-                    op="CODECOPY",
-                    global_state=global_state,
-                )
-                return self._calldata_copy_helper(
-                    global_state=global_state,
-                    mstate=mstate,
-                    mstart=memory_offset + code_copy_size,
-                    dstart=calldata_copy_offset,
-                    size=calldata_copy_size,
-                )
-
-        return self._code_copy_helper(
-            code=global_state.environment.code.bytecode,
-            memory_offset=memory_offset,
-            code_offset=code_offset,
-            size=size,
-            op="CODECOPY",
-            global_state=global_state,
-        )
-
-    @StateTransition()
-    def extcodesize_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        addr = state.stack.pop()
-        try:
-            addr = hex(util.get_concrete_int(addr))
-        except TypeError:
-            log.debug("unsupported symbolic address for EXTCODESIZE")
-            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
-            return [global_state]
-
-        try:
-            code = global_state.world_state.accounts_exist_or_load(
-                addr, self.dynamic_loader
-            ).code.bytecode
-        except (ValueError, AttributeError) as e:
-            log.debug("error accessing contract storage due to: %s", e)
-            state.stack.append(global_state.new_bitvec("extcodesize_" + str(addr), 256))
-            return [global_state]
-
-        state.stack.append(len(code) // 2)
-        return [global_state]
-
-    @StateTransition()
-    def extcodecopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        addr, memory_offset, code_offset, size = (
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-        )
-        try:
-            addr = hex(util.get_concrete_int(addr))
-        except TypeError:
-            log.debug("unsupported symbolic address for EXTCODECOPY")
-            return [global_state]
-
-        try:
-            code = global_state.world_state.accounts_exist_or_load(
-                addr, self.dynamic_loader
-            ).code.bytecode
-        except (ValueError, AttributeError) as e:
-            log.debug("error accessing contract storage due to: %s", e)
-            return [global_state]
-
-        return self._code_copy_helper(
-            code=code,
-            memory_offset=memory_offset,
-            code_offset=code_offset,
-            size=size,
-            op="EXTCODECOPY",
-            global_state=global_state,
-        )
-
-    @StateTransition()
-    def extcodehash_(self, global_state: GlobalState) -> List[GlobalState]:
-        world_state = global_state.world_state
-        stack = global_state.mstate.stack
-        address = Extract(159, 0, stack.pop())
-
-        if address.symbolic:
-            code_hash = symbol_factory.BitVecVal(int(get_code_hash(""), 16), 256)
-        elif address.value not in world_state.accounts:
-            code_hash = symbol_factory.BitVecVal(0, 256)
-        else:
-            addr = "0x{:040x}".format(address.value)
-            code = world_state.accounts_exist_or_load(
-                addr, self.dynamic_loader
-            ).code.bytecode
-            code_hash = symbol_factory.BitVecVal(int(get_code_hash(code), 16), 256)
-        stack.append(code_hash)
-        return [global_state]
-
-    @StateTransition()
-    def returndatacopy_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        memory_offset, return_offset, size = (
-            state.stack.pop(),
-            state.stack.pop(),
-            state.stack.pop(),
-        )
-        try:
-            concrete_memory_offset = util.get_concrete_int(memory_offset)
-            concrete_return_offset = util.get_concrete_int(return_offset)
-            concrete_size = util.get_concrete_int(size)
-        except TypeError:
-            log.debug("Unsupported symbolic operand in RETURNDATACOPY")
-            return [global_state]
-
-        if global_state.last_return_data is None:
-            return [global_state]
-
-        global_state.mstate.mem_extend(concrete_memory_offset, concrete_size)
-        for i in range(concrete_size):
-            global_state.mstate.memory[concrete_memory_offset + i] = (
-                global_state.last_return_data[concrete_return_offset + i]
-                if i < len(global_state.last_return_data)
-                else 0
-            )
-        return [global_state]
-
-    @StateTransition()
-    def returndatasize_(self, global_state: GlobalState) -> List[GlobalState]:
-        if global_state.last_return_data is None:
-            log.debug("No last_return_data found, pushing unconstrained bitvec")
-            global_state.mstate.stack.append(
-                global_state.new_bitvec("returndatasize", 256)
-            )
-        else:
-            global_state.mstate.stack.append(len(global_state.last_return_data))
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # block context (symbolic: miner-influence detectors rely on the
-    # names these symbols carry)
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def blockhash_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        blocknumber = state.stack.pop()
-        state.stack.append(
-            global_state.new_bitvec("blockhash_block_" + str(blocknumber), 256)
-        )
-        return [global_state]
-
-    @StateTransition()
-    def coinbase_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("coinbase", 256))
-        return [global_state]
-
-    @StateTransition()
-    def timestamp_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("timestamp", 256))
-        return [global_state]
-
-    @StateTransition()
-    def number_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.environment.block_number)
-        return [global_state]
-
-    @StateTransition()
-    def difficulty_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(
-            global_state.new_bitvec("block_difficulty", 256)
-        )
-        return [global_state]
-
-    @StateTransition()
-    def gaslimit_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.mstate.gas_limit)
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # memory
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def mload_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        offset = state.stack.pop()
-        state.mem_extend(offset, 32)
-        state.stack.append(state.memory.get_word_at(offset))
-        return [global_state]
-
-    @StateTransition()
-    def mstore_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        mstart, value = state.stack.pop(), state.stack.pop()
-        try:
-            state.mem_extend(mstart, 32)
-        except Exception:
-            log.debug("Error extending memory")
-        state.memory.write_word_at(mstart, value)
-        return [global_state]
-
-    @StateTransition()
-    def mstore8_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        offset, value = state.stack.pop(), state.stack.pop()
-        state.mem_extend(offset, 1)
-        try:
-            value_to_write: Union[int, BitVec] = util.get_concrete_int(value) % 256
-        except TypeError:
-            value_to_write = Extract(7, 0, value)
-        state.memory[offset] = value_to_write
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # storage
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def sload_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        index = state.stack.pop()
-        state.stack.append(global_state.environment.active_account.storage[index])
-        return [global_state]
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def sstore_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        index, value = state.stack.pop(), state.stack.pop()
-        global_state.environment.active_account.storage[index] = value
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # control flow
-    # ------------------------------------------------------------------
-    @StateTransition(increment_pc=False, enable_gas=False)
-    def jump_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        disassembly = global_state.environment.code
-        try:
-            jump_addr = util.get_concrete_int(state.stack.pop())
-        except TypeError:
-            raise InvalidJumpDestination("Invalid jump argument (symbolic address)")
-        except IndexError:
-            raise StackUnderflowException()
-
-        index = util.get_instruction_index(disassembly.instruction_list, jump_addr)
-        if index is None:
-            raise InvalidJumpDestination("JUMP to invalid address")
-
-        op_code = disassembly.instruction_list[index]["opcode"]
-        if op_code != "JUMPDEST":
-            raise InvalidJumpDestination(
-                "Skipping JUMP to invalid destination (not JUMPDEST): "
-                + str(jump_addr)
-            )
-
-        new_state = copy(global_state)
-        min_gas, max_gas = get_opcode_gas("JUMP")
-        new_state.mstate.min_gas_used += min_gas
-        new_state.mstate.max_gas_used += max_gas
-        new_state.mstate.pc = index
-        new_state.mstate.depth += 1
-        return [new_state]
-
-    @StateTransition(increment_pc=False, enable_gas=False)
-    def jumpi_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        disassembly = global_state.environment.code
-        min_gas, max_gas = get_opcode_gas("JUMPI")
-        states = []
-
-        op0, condition = state.stack.pop(), state.stack.pop()
-        try:
-            jump_addr = util.get_concrete_int(op0)
-        except TypeError:
-            log.debug("Skipping JUMPI to invalid destination.")
-            global_state.mstate.pc += 1
-            global_state.mstate.min_gas_used += min_gas
-            global_state.mstate.max_gas_used += max_gas
-            return [global_state]
-
-        negated = (
-            simplify(Not(condition)) if isinstance(condition, Bool) else condition == 0
-        )
-        condi = simplify(condition) if isinstance(condition, Bool) else condition != 0
-
-        negated_cond = (type(negated) == bool and negated) or (
-            isinstance(negated, Bool) and not is_false(negated)
-        )
-        positive_cond = (type(condi) == bool and condi) or (
-            isinstance(condi, Bool) and not is_false(condi)
-        )
-
-        # fall-through branch
-        if negated_cond:
-            new_state = copy(global_state)
-            new_state.mstate.min_gas_used += min_gas
-            new_state.mstate.max_gas_used += max_gas
-            new_state.mstate.depth += 1
-            new_state.mstate.pc += 1
-            new_state.world_state.constraints.append(negated)
-            states.append(new_state)
-        else:
-            log.debug("Pruned unreachable states.")
-
-        # taken branch
-        index = util.get_instruction_index(disassembly.instruction_list, jump_addr)
-        if index is None:
-            log.debug("Invalid jump destination: %s", jump_addr)
-            return states
-        instr = disassembly.instruction_list[index]
-        if instr["opcode"] == "JUMPDEST":
-            if positive_cond:
-                new_state = copy(global_state)
-                new_state.mstate.min_gas_used += min_gas
-                new_state.mstate.max_gas_used += max_gas
-                new_state.mstate.pc = index
-                new_state.mstate.depth += 1
-                new_state.world_state.constraints.append(condi)
-                states.append(new_state)
-            else:
-                log.debug("Pruned unreachable states.")
-        return states
-
-    @StateTransition()
-    def pc_(self, global_state: GlobalState) -> List[GlobalState]:
-        index = global_state.mstate.pc
-        program_counter = global_state.environment.code.instruction_list[index][
-            "address"
-        ]
-        global_state.mstate.stack.append(program_counter)
-        return [global_state]
-
-    @StateTransition()
-    def msize_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.mstate.memory_size)
-        return [global_state]
-
-    @StateTransition()
-    def gas_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("gas", 256))
-        return [global_state]
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def log_(self, global_state: GlobalState) -> List[GlobalState]:
-        state = global_state.mstate
-        topics = int(self.op_code[3:])
-        state.stack.pop(), state.stack.pop()
-        for _ in range(topics):
-            state.stack.pop()
-        # event emission is not modeled
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # CREATE / CREATE2
-    # ------------------------------------------------------------------
-    def _create_transaction_helper(
-        self, global_state, call_value, mem_offset, mem_size, create2_salt=None
-    ) -> List[GlobalState]:
-        mstate = global_state.mstate
-        environment = global_state.environment
-        world_state = global_state.world_state
-
-        call_data = get_call_data(global_state, mem_offset, mem_offset + mem_size)
-
-        # split init bytecode (concrete prefix) from constructor args
-        code_raw = []
-        code_end = call_data.size
-        size = call_data.size
-        if isinstance(size, BitVec):
-            size = 10**5 if size.symbolic else size.value
-        for i in range(size):
-            if call_data[i].symbolic:
-                code_end = i
-                break
-            code_raw.append(call_data[i].value)
-
-        if len(code_raw) < 1:
-            global_state.mstate.stack.append(1)
-            log.debug("No code found for trying to execute a create type instruction.")
-            return [global_state]
-
-        code_str = bytes(code_raw).hex()
-
-        next_transaction_id = get_next_transaction_id()
-        constructor_arguments = ConcreteCalldata(
-            next_transaction_id, call_data[code_end:]
-        )
-        code = Disassembly(code_str)
-
-        caller = environment.active_account.address
-        gas_price = environment.gasprice
-        origin = environment.origin
-
-        contract_address: Union[BitVec, int, None] = None
-        Instruction._sha3_gas_helper(global_state, len(code_str) // 2)
-
-        if create2_salt is not None:
-            if create2_salt.symbolic:
-                if create2_salt.size() != 256:
-                    pad = symbol_factory.BitVecVal(0, 256 - create2_salt.size())
-                    create2_salt = Concat(pad, create2_salt)
-                address, constraint = keccak_function_manager.create_keccak(
-                    Concat(
-                        symbol_factory.BitVecVal(255, 8),
-                        caller,
-                        create2_salt,
-                        symbol_factory.BitVecVal(int(get_code_hash(code_str), 16), 256),
-                    )
-                )
-                contract_address = Extract(255, 96, address)
-                global_state.world_state.constraints.append(constraint)
-            else:
-                salt = "{:064x}".format(create2_salt.value)
-                addr = "{:040x}".format(caller.value)
-                contract_address = int(
-                    get_code_hash("0xff" + addr + salt + get_code_hash(code_str)[2:])[
-                        26:
-                    ],
-                    16,
-                )
-
-        transaction = ContractCreationTransaction(
-            world_state=world_state,
-            caller=caller,
-            code=code,
-            call_data=constructor_arguments,
-            gas_price=gas_price,
-            gas_limit=mstate.gas_limit,
-            origin=origin,
-            call_value=call_value,
-            contract_address=contract_address,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def create_(self, global_state: GlobalState) -> List[GlobalState]:
-        call_value, mem_offset, mem_size = global_state.mstate.pop(3)
-        return self._create_transaction_helper(
-            global_state, call_value, mem_offset, mem_size
-        )
-
-    @StateTransition()
-    def create_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self._handle_create_type_post(global_state)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def create2_(self, global_state: GlobalState) -> List[GlobalState]:
-        call_value, mem_offset, mem_size, salt = global_state.mstate.pop(4)
-        return self._create_transaction_helper(
-            global_state, call_value, mem_offset, mem_size, salt
-        )
-
-    @StateTransition()
-    def create2_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self._handle_create_type_post(global_state, opcode="create2")
-
-    @staticmethod
-    def _handle_create_type_post(global_state, opcode="create"):
-        if opcode == "create2":
-            global_state.mstate.pop(4)
-        else:
-            global_state.mstate.pop(3)
-        if global_state.last_return_data:
-            return_val = symbol_factory.BitVecVal(
-                int(global_state.last_return_data, 16), 256
-            )
-        else:
-            return_val = symbol_factory.BitVecVal(0, 256)
-        global_state.mstate.stack.append(return_val)
-        return [global_state]
-
-    # ------------------------------------------------------------------
-    # transaction-ending opcodes
-    # ------------------------------------------------------------------
-    @StateTransition()
-    def return_(self, global_state: GlobalState):
-        state = global_state.mstate
-        offset, length = state.stack.pop(), state.stack.pop()
-        if isinstance(length, BitVec) and length.symbolic:
-            return_data = [global_state.new_bitvec("return_data", 8)]
-            log.debug("Return with symbolic length or offset. Not supported")
-        else:
-            state.mem_extend(offset, length)
-            StateTransition.check_gas_usage_limit(global_state)
-            return_data = state.memory[offset : offset + length]
-        global_state.current_transaction.end(global_state, return_data)
-
-    @StateTransition(is_state_mutation_instruction=True)
-    def suicide_(self, global_state: GlobalState):
-        target = global_state.mstate.stack.pop()
-        transfer_amount = global_state.environment.active_account.balance()
-        # beneficiary may be symbolic; credit it regardless
-        global_state.world_state.balances[target] += transfer_amount
-
-        # detach a private copy of the account before mutating it
-        dead_account = copy(global_state.environment.active_account)
-        global_state.environment.active_account = dead_account
-        global_state.accounts[dead_account.address.value] = dead_account
-
-        dead_account.set_balance(0)
-        dead_account.deleted = True
-        global_state.current_transaction.end(global_state)
-
-    @StateTransition()
-    def revert_(self, global_state: GlobalState) -> None:
-        state = global_state.mstate
-        offset, length = state.stack.pop(), state.stack.pop()
-        return_data = [global_state.new_bitvec("return_data", 8)]
-        try:
-            return_data = state.memory[
-                util.get_concrete_int(offset) : util.get_concrete_int(offset + length)
-            ]
-        except TypeError:
-            log.debug("Return with symbolic length or offset. Not supported")
-        global_state.current_transaction.end(
-            global_state, return_data=return_data, revert=True
-        )
-
-    @StateTransition()
-    def assert_fail_(self, global_state: GlobalState):
-        # 0xfe: designated invalid opcode
-        raise InvalidInstruction
-
-    @StateTransition()
-    def invalid_(self, global_state: GlobalState):
-        raise InvalidInstruction
-
-    @StateTransition()
-    def stop_(self, global_state: GlobalState):
-        global_state.current_transaction.end(global_state)
-
-    # ------------------------------------------------------------------
-    # CALL family
-    # ------------------------------------------------------------------
-    @staticmethod
-    def _write_symbolic_returndata(
-        global_state: GlobalState, memory_out_offset: BitVec, memory_out_size: BitVec
-    ) -> None:
-        """Fill the output window with fresh symbols when the call's
-        effect is unknown (offsets must be concrete)."""
-        if isinstance(memory_out_offset, int):
-            memory_out_offset = symbol_factory.BitVecVal(memory_out_offset, 256)
-        if isinstance(memory_out_size, int):
-            memory_out_size = symbol_factory.BitVecVal(memory_out_size, 256)
-        if memory_out_offset.symbolic is True or memory_out_size.symbolic is True:
-            return
-        for i in range(memory_out_size.value):
-            global_state.mstate.memory[
-                memory_out_offset + i
-            ] = global_state.new_bitvec(
-                "call_output_var({})_{}".format(
-                    simplify(memory_out_offset + i), global_state.mstate.pc
-                ),
-                8,
-            )
-
-    def _push_fresh_retval(self, global_state: GlobalState) -> None:
-        instr = global_state.get_current_instruction()
-        global_state.mstate.stack.append(
-            global_state.new_bitvec("retval_" + str(instr["address"]), 256)
-        )
-
-    @StateTransition()
-    def call_(self, global_state: GlobalState) -> List[GlobalState]:
-        environment = global_state.environment
-        memory_out_size, memory_out_offset = global_state.mstate.stack[-7:-5]
-        try:
-            (
-                callee_address,
-                callee_account,
-                call_data,
-                value,
-                gas,
-                memory_out_offset,
-                memory_out_size,
-            ) = get_call_parameters(global_state, self.dynamic_loader, True)
-
-            if callee_account is not None and callee_account.code.bytecode == "":
-                # plain value transfer to a codeless account
-                log.debug("The call is related to ether transfer between accounts")
-                transfer_ether(
-                    global_state,
-                    environment.active_account.address,
-                    callee_account.address,
-                    value,
-                )
-                self._push_fresh_retval(global_state)
-                return [global_state]
-        except ValueError as e:
-            log.debug(
-                "Could not determine required parameters for call, "
-                "putting fresh symbol on the stack. \n%s",
-                e,
-            )
-            self._write_symbolic_returndata(
-                global_state, memory_out_offset, memory_out_size
-            )
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        if environment.static:
-            if isinstance(value, int) and value > 0:
-                raise WriteProtection(
-                    "Cannot call with non zero value in a static call"
-                )
-            if isinstance(value, BitVec):
-                if value.symbolic:
-                    global_state.world_state.constraints.append(
-                        value == symbol_factory.BitVecVal(0, 256)
-                    )
-                elif value.value > 0:
-                    raise WriteProtection(
-                        "Cannot call with non zero value in a static call"
-                    )
-
-        native_result = native_call(
-            global_state, callee_address, call_data, memory_out_offset, memory_out_size
-        )
-        if native_result:
-            return native_result
-
-        transaction = MessageCallTransaction(
-            world_state=global_state.world_state,
-            gas_price=environment.gasprice,
-            gas_limit=gas,
-            origin=environment.origin,
-            caller=environment.active_account.address,
-            callee_account=callee_account,
-            call_data=call_data,
-            call_value=value,
-            static=environment.static,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition()
-    def call_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self.post_handler(global_state, function_name="call")
-
-    @StateTransition()
-    def callcode_(self, global_state: GlobalState) -> List[GlobalState]:
-        environment = global_state.environment
-        memory_out_size, memory_out_offset = global_state.mstate.stack[-7:-5]
-        try:
-            (
-                callee_address,
-                callee_account,
-                call_data,
-                value,
-                gas,
-                _,
-                _,
-            ) = get_call_parameters(global_state, self.dynamic_loader, True)
-
-            if callee_account is not None and callee_account.code.bytecode == "":
-                log.debug("The call is related to ether transfer between accounts")
-                transfer_ether(
-                    global_state,
-                    environment.active_account.address,
-                    callee_account.address,
-                    value,
-                )
-                self._push_fresh_retval(global_state)
-                return [global_state]
-        except ValueError as e:
-            log.debug(
-                "Could not determine required parameters for call, "
-                "putting fresh symbol on the stack. \n%s",
-                e,
-            )
-            self._write_symbolic_returndata(
-                global_state, memory_out_offset, memory_out_size
-            )
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        # CALLCODE runs the callee's code against the caller's storage
-        transaction = MessageCallTransaction(
-            world_state=global_state.world_state,
-            gas_price=environment.gasprice,
-            gas_limit=gas,
-            origin=environment.origin,
-            code=callee_account.code,
-            caller=environment.address,
-            callee_account=environment.active_account,
-            call_data=call_data,
-            call_value=value,
-            static=environment.static,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition()
-    def callcode_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self.post_handler(global_state, function_name="callcode")
-
-    @StateTransition()
-    def delegatecall_(self, global_state: GlobalState) -> List[GlobalState]:
-        environment = global_state.environment
-        memory_out_size, memory_out_offset = global_state.mstate.stack[-6:-4]
-        try:
-            (
-                callee_address,
-                callee_account,
-                call_data,
-                value,
-                gas,
-                _,
-                _,
-            ) = get_call_parameters(global_state, self.dynamic_loader)
-
-            if callee_account is not None and callee_account.code.bytecode == "":
-                log.debug("The call is related to ether transfer between accounts")
-                transfer_ether(
-                    global_state,
-                    environment.active_account.address,
-                    callee_account.address,
-                    value,
-                )
-                self._push_fresh_retval(global_state)
-                return [global_state]
-        except ValueError as e:
-            log.debug(
-                "Could not determine required parameters for call, "
-                "putting fresh symbol on the stack. \n%s",
-                e,
-            )
-            self._write_symbolic_returndata(
-                global_state, memory_out_offset, memory_out_size
-            )
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        # DELEGATECALL preserves sender and value of the current frame
-        transaction = MessageCallTransaction(
-            world_state=global_state.world_state,
-            gas_price=environment.gasprice,
-            gas_limit=gas,
-            origin=environment.origin,
-            code=callee_account.code,
-            caller=environment.sender,
-            callee_account=environment.active_account,
-            call_data=call_data,
-            call_value=environment.callvalue,
-            static=environment.static,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition()
-    def delegatecall_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self.post_handler(global_state, function_name="delegatecall")
-
-    @StateTransition()
-    def staticcall_(self, global_state: GlobalState) -> List[GlobalState]:
-        environment = global_state.environment
-        memory_out_size, memory_out_offset = global_state.mstate.stack[-6:-4]
-        try:
-            (
-                callee_address,
-                callee_account,
-                call_data,
-                value,
-                gas,
-                memory_out_offset,
-                memory_out_size,
-            ) = get_call_parameters(global_state, self.dynamic_loader)
-
-            if callee_account is not None and callee_account.code.bytecode == "":
-                log.debug("The call is related to ether transfer between accounts")
-                transfer_ether(
-                    global_state,
-                    environment.active_account.address,
-                    callee_account.address,
-                    value,
-                )
-                self._push_fresh_retval(global_state)
-                return [global_state]
-        except ValueError as e:
-            log.debug(
-                "Could not determine required parameters for call, "
-                "putting fresh symbol on the stack. \n%s",
-                e,
-            )
-            self._write_symbolic_returndata(
-                global_state, memory_out_offset, memory_out_size
-            )
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        native_result = native_call(
-            global_state, callee_address, call_data, memory_out_offset, memory_out_size
-        )
-        if native_result:
-            return native_result
-
-        transaction = MessageCallTransaction(
-            world_state=global_state.world_state,
-            gas_price=environment.gasprice,
-            gas_limit=gas,
-            origin=environment.origin,
-            code=callee_account.code,
-            caller=environment.address,
-            callee_account=callee_account,
-            call_data=call_data,
-            call_value=value,
-            static=True,
-        )
-        raise TransactionStartSignal(transaction, self.op_code, global_state)
-
-    @StateTransition()
-    def staticcall_post(self, global_state: GlobalState) -> List[GlobalState]:
-        return self.post_handler(global_state, function_name="staticcall")
-
-    def post_handler(self, global_state, function_name: str):
-        """Resume the caller frame after a nested call returned: write
-        return data into the output window and push a retval constrained
-        to the call's outcome (reference: instructions.py:2344)."""
-        instr = global_state.get_current_instruction()
-        if function_name in ("staticcall", "delegatecall"):
-            memory_out_size, memory_out_offset = global_state.mstate.stack[-6:-4]
-        else:
-            memory_out_size, memory_out_offset = global_state.mstate.stack[-7:-5]
-
-        try:
-            with_value = function_name != "staticcall"
-            (
-                callee_address,
-                callee_account,
-                call_data,
-                value,
-                gas,
-                memory_out_offset,
-                memory_out_size,
-            ) = get_call_parameters(global_state, self.dynamic_loader, with_value)
-        except ValueError as e:
-            log.debug(
-                "Could not determine required parameters for %s, "
-                "putting fresh symbol on the stack. \n%s",
-                function_name,
-                e,
-            )
-            self._write_symbolic_returndata(
-                global_state, memory_out_offset, memory_out_size
-            )
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        if global_state.last_return_data is None:
-            return_value = global_state.new_bitvec(
-                "retval_" + str(instr["address"]), 256
-            )
-            global_state.mstate.stack.append(return_value)
-            if function_name in ("callcode", "delegatecall"):
-                self._write_symbolic_returndata(
-                    global_state, memory_out_offset, memory_out_size
-                )
-                global_state.world_state.constraints.append(return_value == 0)
-            return [global_state]
-
-        try:
-            memory_out_offset = (
-                util.get_concrete_int(memory_out_offset)
-                if isinstance(memory_out_offset, Expression)
-                else memory_out_offset
-            )
-            memory_out_size = (
-                util.get_concrete_int(memory_out_size)
-                if isinstance(memory_out_size, Expression)
-                else memory_out_size
-            )
-        except TypeError:
-            self._push_fresh_retval(global_state)
-            return [global_state]
-
-        # copy return data into the output window
-        out_sz = min(memory_out_size, len(global_state.last_return_data))
-        global_state.mstate.mem_extend(memory_out_offset, out_sz)
-        for i in range(out_sz):
-            global_state.mstate.memory[
-                i + memory_out_offset
-            ] = global_state.last_return_data[i]
-
-        return_value = global_state.new_bitvec("retval_" + str(instr["address"]), 256)
-        global_state.mstate.stack.append(return_value)
-        global_state.world_state.constraints.append(return_value == 1)
-        return [global_state]
+__all__ = ["Instruction", "transfer_ether", "Frame", "TABLE", "run_opcode"]

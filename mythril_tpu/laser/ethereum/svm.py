@@ -1,38 +1,40 @@
-"""LASER: the symbolic EVM engine.
+"""LASER — the symbolic EVM engine.
 
-Reference parity: mythril/laser/ethereum/svm.py (714 LoC) — worklist +
-strategy iterator, creation/preconfigured `sym_exec` modes, the
-multi-transaction driver that prunes unsat open states between txs,
-`execute_state` (one symbolic instruction incl. signal handling),
-`_end_message_call` resuming the caller frame via `<op>_post`
-handlers, CFG node/edge bookkeeping, and the full hook surface (6
-lifecycle hook types, per-opcode pre/post hooks, per-instruction
-hooks).
+Covers the reference engine's whole job (mythril/laser/ethereum/
+svm.py: worklist scheduling, the multi-transaction driver, frame
+enter/leave on call signals, hook surface, CFG capture) with a
+different decomposition:
 
-Layering note: the reference imports `check_potential_issues` from the
-analysis package at module scope (an L4->L6 knot, SURVEY.md §1); here
-the import is deferred to the call site so the engine stays loadable
-without the analysis layer.
+  * all hooks ride one `HookBus` (hooks.py) with batched opcode
+    channels shared with the device engine;
+  * CFG capture lives in `StateSpaceRecorder` (statespace.py);
+  * frame transitions are explicit methods (`_enter_frame`,
+    `_leave_frame`) keyed off the transaction signals instead of
+    inline exception-handler bodies;
+  * the step core returns an (outcome, successors) pair.
+
+Layering note: `check_potential_issues` is imported lazily at its
+single call site; the engine package stays importable without the
+analysis layer (SURVEY.md §1 flags the reference's import knot).
 """
 
 from __future__ import annotations
 
 import logging
 from abc import ABCMeta
-from collections import defaultdict
 from copy import copy
 from datetime import datetime, timedelta
-from typing import Callable, DefaultDict, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-from mythril_tpu.laser.ethereum.cfg import Edge, JumpType, Node, NodeFlags
-from mythril_tpu.laser.ethereum.evm_exceptions import (
-    StackUnderflowException,
-    VmException,
+from mythril_tpu.laser.ethereum.evm_exceptions import VmException
+from mythril_tpu.laser.ethereum.hooks import HookBus
+from mythril_tpu.laser.ethereum.instruction_data import (
+    get_required_stack_elements,
 )
-from mythril_tpu.laser.ethereum.instruction_data import get_required_stack_elements
 from mythril_tpu.laser.ethereum.instructions import Instruction, transfer_ether
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.statespace import StateSpaceRecorder
 from mythril_tpu.laser.ethereum.strategy.basic import DepthFirstSearchStrategy
 from mythril_tpu.laser.ethereum.time_handler import time_handler
 from mythril_tpu.laser.ethereum.transaction import (
@@ -52,13 +54,12 @@ log = logging.getLogger(__name__)
 
 
 class SVMError(Exception):
-    """An unexpected state inside symbolic execution."""
+    """Unexpected engine state."""
 
 
 class LaserEVM:
-    """The symbolic virtual machine: schedules path states off a
-    worklist, executes one instruction at a time, and hands world
-    states between transactions."""
+    """Schedules path states, steps them one instruction at a time,
+    and carries world states across transactions."""
 
     def __init__(
         self,
@@ -71,45 +72,33 @@ class LaserEVM:
         requires_statespace=True,
         iprof=None,
     ) -> None:
-        self.execution_info: List[ExecutionInfo] = []
+        self.dynamic_loader = dynamic_loader
+        self.max_depth = max_depth
+        self.transaction_count = transaction_count
+        self.execution_timeout = execution_timeout or 0
+        self.create_timeout = create_timeout or 0
+        self.iprof = iprof
 
         self.open_states: List[WorldState] = []
         self.total_states = 0
-        self.dynamic_loader = dynamic_loader
+        self.execution_info: List[ExecutionInfo] = []
 
         self.work_list: List[GlobalState] = []
         self.strategy = strategy(self.work_list, max_depth)
-        self.max_depth = max_depth
-        self.transaction_count = transaction_count
 
-        self.execution_timeout = execution_timeout or 0
-        self.create_timeout = create_timeout or 0
-
+        self.bus = HookBus()
         self.requires_statespace = requires_statespace
-        if self.requires_statespace:
-            self.nodes: Dict[int, Node] = {}
-            self.edges: List[Edge] = []
+        self._recorder = StateSpaceRecorder(keep=requires_statespace)
+        if requires_statespace:
+            self.nodes = self._recorder.nodes
+            self.edges = self._recorder.edges
 
         self.time: Optional[datetime] = None
-
-        self.pre_hooks: DefaultDict[str, List[Callable]] = defaultdict(list)
-        self.post_hooks: DefaultDict[str, List[Callable]] = defaultdict(list)
-
-        self._add_world_state_hooks: List[Callable] = []
-        self._execute_state_hooks: List[Callable] = []
-        self._start_sym_trans_hooks: List[Callable] = []
-        self._stop_sym_trans_hooks: List[Callable] = []
-        self._start_sym_exec_hooks: List[Callable] = []
-        self._stop_sym_exec_hooks: List[Callable] = []
-
-        self.iprof = iprof
-        self.instr_pre_hook: Dict[str, List[Callable]] = {}
-        self.instr_post_hook: Dict[str, List[Callable]] = {}
-        for op in OPCODES:
-            self.instr_pre_hook[op] = []
-            self.instr_post_hook[op] = []
         log.info("LASER EVM initialized with dynamic loader: %s", dynamic_loader)
 
+    # ------------------------------------------------------------------
+    # top-level drivers
+    # ------------------------------------------------------------------
     def extend_strategy(self, extension: ABCMeta, *extension_args) -> None:
         self.strategy = extension(self.strategy, extension_args)
 
@@ -120,40 +109,41 @@ class LaserEVM:
         creation_code: str = None,
         contract_name: str = None,
     ) -> None:
-        """Start symbolic execution, either against a preconfigured
-        world state + target address or from creation code."""
-        pre_configuration_mode = target_address is not None
-        scratch_mode = creation_code is not None and contract_name is not None
-        if pre_configuration_mode == scratch_mode:
+        """Run the whole analysis: either message calls against a
+        preloaded account, or a creation transaction followed by
+        message calls against the deployed contract."""
+        against_existing = target_address is not None
+        from_creation = creation_code is not None and contract_name is not None
+        if against_existing == from_creation:
             raise ValueError("Symbolic execution started with invalid parameters")
 
         log.debug("Starting LASER execution")
-        for hook in self._start_sym_exec_hooks:
-            hook()
-
+        self.bus.emit("start_sym_exec")
         time_handler.start_execution(self.execution_timeout)
         self.time = datetime.now()
 
-        if pre_configuration_mode:
+        if against_existing:
             self.open_states = [world_state]
             log.info("Starting message call transaction to %s", target_address)
-            self._execute_transactions(symbol_factory.BitVecVal(target_address, 256))
-        elif scratch_mode:
+            self._transaction_rounds(
+                symbol_factory.BitVecVal(target_address, 256)
+            )
+        else:
             log.info("Starting contract creation transaction")
-            created_account = execute_contract_creation(
+            deployed = execute_contract_creation(
                 self, creation_code, contract_name, world_state=world_state
             )
             log.info(
                 "Finished contract creation, found %d open states",
                 len(self.open_states),
             )
-            if len(self.open_states) == 0:
+            if not self.open_states:
                 log.warning(
                     "No contract was created during the execution of contract "
                     "creation. Increase the resources for creation execution "
                     "(--max-depth or --create-timeout)"
                 )
-            self._execute_transactions(created_account.address)
+            self._transaction_rounds(deployed.address)
 
         log.info("Finished symbolic execution")
         if self.requires_statespace:
@@ -163,438 +153,324 @@ class LaserEVM:
                 len(self.edges),
                 self.total_states,
             )
-        for hook in self._stop_sym_exec_hooks:
-            hook()
+        self.bus.emit("stop_sym_exec")
 
-    def _execute_transactions(self, address) -> None:
-        """Execute `transaction_count` symbolic txs against `address`,
-        pruning unsat open states between rounds (reference:
-        svm.py:189-219)."""
+    def _transaction_rounds(self, address) -> None:
+        """Fire `transaction_count` symbolic transactions at
+        `address`, dropping provably-unreachable world states between
+        rounds."""
         self.time = datetime.now()
-
-        for i in range(self.transaction_count):
-            if len(self.open_states) == 0:
+        for round_no in range(self.transaction_count):
+            if not self.open_states:
                 break
-            old_states_count = len(self.open_states)
-            self.open_states = [
-                state for state in self.open_states if state.constraints.is_possible
+            feasible = [
+                ws for ws in self.open_states if ws.constraints.is_possible
             ]
-            prune_count = old_states_count - len(self.open_states)
-            if prune_count:
-                log.info("Pruned %d unreachable states", prune_count)
+            if len(feasible) < len(self.open_states):
+                log.info(
+                    "Pruned %d unreachable states",
+                    len(self.open_states) - len(feasible),
+                )
+            self.open_states = feasible
             log.info(
-                "Starting message call transaction, iteration: %d, %d initial states",
-                i,
-                len(self.open_states),
+                "Starting message call transaction, iteration: %d, "
+                "%d initial states",
+                round_no,
+                len(feasible),
             )
-
-            for hook in self._start_sym_trans_hooks:
-                hook()
+            self.bus.emit("start_sym_trans")
             execute_message_call(self, address)
-            for hook in self._stop_sym_trans_hooks:
-                hook()
+            self.bus.emit("stop_sym_trans")
 
-    def _check_create_termination(self) -> bool:
-        if len(self.open_states) != 0:
-            return (
-                self.create_timeout > 0
-                and self.time + timedelta(seconds=self.create_timeout)
-                <= datetime.now()
-            )
-        return self._check_execution_termination()
-
-    def _check_execution_termination(self) -> bool:
+    # ------------------------------------------------------------------
+    # time budget
+    # ------------------------------------------------------------------
+    def _out_of_time(self, creating: bool) -> bool:
+        if creating and self.open_states:
+            budget = self.create_timeout
+        else:
+            budget = self.execution_timeout
         return (
-            self.execution_timeout > 0
-            and self.time + timedelta(seconds=self.execution_timeout)
-            <= datetime.now()
+            budget > 0
+            and self.time + timedelta(seconds=budget) <= datetime.now()
         )
 
+    # ------------------------------------------------------------------
+    # the hot loop
+    # ------------------------------------------------------------------
     def exec(self, create=False, track_gas=False) -> Optional[List[GlobalState]]:
-        """The hot loop: pull states off the strategy, execute one
-        instruction, filter unsat successors, refill the worklist."""
-        final_states: List[GlobalState] = []
-        for global_state in self.strategy:
-            if create and self._check_create_termination():
-                log.debug("Hit create timeout, returning.")
-                return final_states + [global_state] if track_gas else None
-            if not create and self._check_execution_termination():
-                log.debug("Hit execution timeout, returning.")
-                return final_states + [global_state] if track_gas else None
+        finals: List[GlobalState] = []
+        for state in self.strategy:
+            if self._out_of_time(create):
+                log.debug("Hit the time budget, returning.")
+                return finals + [state] if track_gas else None
 
             try:
-                new_states, op_code = self.execute_state(global_state)
+                successors, opcode = self.execute_state(state)
             except NotImplementedError:
-                log.debug("Encountered unimplemented instruction")
+                log.debug("Encountered an unimplemented instruction")
                 continue
 
             if args.sparse_pruning is False:
-                new_states = [
-                    state
-                    for state in new_states
-                    if state.world_state.constraints.is_possible
+                successors = [
+                    s
+                    for s in successors
+                    if s.world_state.constraints.is_possible
                 ]
 
-            self.manage_cfg(op_code, new_states)
-            if new_states:
-                self.work_list += new_states
+            self._recorder.observe(opcode, successors)
+            if successors:
+                self.work_list.extend(successors)
             elif track_gas:
-                final_states.append(global_state)
-            self.total_states += len(new_states)
+                finals.append(state)
+            self.total_states += len(successors)
+        return finals if track_gas else None
 
-        return final_states if track_gas else None
+    def execute_state(
+        self, state: GlobalState
+    ) -> Tuple[List[GlobalState], Optional[str]]:
+        """Advance one state by one instruction; returns (successors,
+        opcode)."""
+        self.bus.emit("execute_state", state)
 
-    def _add_world_state(self, global_state: GlobalState) -> None:
-        """Promote a finished transaction's world state to the open set
-        (unless a pruner vetoes it)."""
-        for hook in self._add_world_state_hooks:
-            try:
-                hook(global_state)
-            except PluginSkipWorldState:
-                return
-        self.open_states.append(global_state.world_state)
+        code = state.environment.code.instruction_list
+        try:
+            opcode = code[state.mstate.pc]["opcode"]
+        except IndexError:
+            # ran off the end of the code — implicit STOP
+            self._settle_world_state(state)
+            return [], None
+
+        if len(state.mstate.stack) < get_required_stack_elements(opcode):
+            shortfall = (
+                "Stack Underflow Exception due to insufficient "
+                "stack elements for the address {}".format(
+                    code[state.mstate.pc]["address"]
+                )
+            )
+            successors = self._abort_frame(state, opcode, shortfall)
+            return self.bus.emit_opcode("post", opcode, successors), opcode
+
+        try:
+            self.bus.emit(("pre", opcode), state)
+        except PluginSkipState:
+            self._settle_world_state(state)
+            return [], None
+
+        try:
+            successors = self._step(opcode, state)
+        except VmException as failure:
+            successors = self._abort_frame(state, opcode, str(failure))
+        except TransactionStartSignal as call:
+            return [self._enter_frame(call, state)], opcode
+        except TransactionEndSignal as ret:
+            successors = self._leave_frame(ret, opcode, state)
+
+        return self.bus.emit_opcode("post", opcode, successors), opcode
+
+    def _step(self, opcode: str, state: GlobalState) -> List[GlobalState]:
+        return Instruction(
+            opcode,
+            self.dynamic_loader,
+            pre_hooks=self.bus.subscribers(("instr:pre", opcode)),
+            post_hooks=self.bus.subscribers(("instr:post", opcode)),
+        ).evaluate(state)
+
+    # ------------------------------------------------------------------
+    # frame transitions
+    # ------------------------------------------------------------------
+    def _enter_frame(
+        self, call: TransactionStartSignal, caller_state: GlobalState
+    ) -> GlobalState:
+        """Push the callee frame for a CALL/CREATE-family signal."""
+        callee = call.transaction.initial_global_state()
+        callee.transaction_stack = copy(caller_state.transaction_stack) + [
+            (call.transaction, caller_state)
+        ]
+        callee.node = caller_state.node
+        callee.world_state.constraints = (
+            call.global_state.world_state.constraints
+        )
+        transfer_ether(
+            callee,
+            call.transaction.caller,
+            call.transaction.callee_account.address,
+            call.transaction.call_value,
+        )
+        log.debug("Starting new transaction %s", call.transaction)
+        return callee
+
+    def _leave_frame(
+        self,
+        ret: TransactionEndSignal,
+        opcode: str,
+        state: GlobalState,
+    ) -> List[GlobalState]:
+        """Unwind one frame on RETURN/STOP/REVERT/SELFDESTRUCT."""
+        transaction, caller_state = ret.global_state.transaction_stack[-1]
+        log.debug("Ending transaction %s.", transaction)
+
+        if caller_state is None:
+            # outermost frame: this transaction is complete
+            produced_code = (
+                not isinstance(transaction, ContractCreationTransaction)
+                or transaction.return_data
+            )
+            if produced_code and not ret.revert:
+                from mythril_tpu.analysis.potential_issues import (
+                    check_potential_issues,
+                )
+
+                check_potential_issues(state)
+                ret.global_state.world_state.node = state.node
+                self._settle_world_state(ret.global_state)
+            return []
+
+        # nested frame: resume the caller
+        self.bus.emit_opcode("post", opcode, [ret.global_state])
+        caller_state.add_annotations(
+            [a for a in state.annotations if a.persist_over_calls]
+        )
+        return self._resume_caller(
+            copy(caller_state),
+            state,
+            reverted=ret.revert,
+            returned=transaction.return_data,
+        )
+
+    def _resume_caller(
+        self,
+        caller_state: GlobalState,
+        callee_state: GlobalState,
+        reverted: bool,
+        returned,
+    ) -> List[GlobalState]:
+        """Merge the callee's effects into the caller and re-run the
+        call opcode in resume mode (`<op>/post`)."""
+        caller_state.world_state.constraints += (
+            callee_state.world_state.constraints
+        )
+        opcode = caller_state.environment.code.instruction_list[
+            caller_state.mstate.pc
+        ]["opcode"]
+        caller_state.last_return_data = returned
+
+        if not reverted:
+            caller_state.world_state = copy(callee_state.world_state)
+            caller_state.environment.active_account = callee_state.accounts[
+                caller_state.environment.active_account.address.value
+            ]
+            if isinstance(
+                callee_state.current_transaction, ContractCreationTransaction
+            ):
+                caller_state.mstate.min_gas_used += (
+                    callee_state.mstate.min_gas_used
+                )
+                caller_state.mstate.max_gas_used += (
+                    callee_state.mstate.max_gas_used
+                )
+
+        resumed = Instruction(
+            opcode,
+            self.dynamic_loader,
+            pre_hooks=self.bus.subscribers(("instr:pre", opcode)),
+            post_hooks=self.bus.subscribers(("instr:post", opcode)),
+        ).evaluate(caller_state, True)
+        for s in resumed:
+            s.node = callee_state.node
+        return resumed
+
+    def _abort_frame(
+        self, state: GlobalState, opcode: str, why: str
+    ) -> List[GlobalState]:
+        """Exceptional halt: discard the frame's effects; a nested
+        frame resumes its caller with revert semantics."""
+        _, caller_state = state.transaction_stack.pop()
+        if caller_state is None:
+            log.debug("VmException on the outermost frame: `%s`", why)
+            return []
+        self.bus.emit_opcode("post", opcode, [state])
+        return self._resume_caller(
+            caller_state, state, reverted=True, returned=None
+        )
 
     def handle_vm_exception(
         self, global_state: GlobalState, op_code: str, error_msg: str
     ) -> List[GlobalState]:
-        transaction, return_global_state = global_state.transaction_stack.pop()
+        # historical name, kept for API compatibility
+        return self._abort_frame(global_state, op_code, error_msg)
 
-        if return_global_state is None:
-            # exceptional halt of the outermost frame: all changes are
-            # discarded, so the unmodified world state adds nothing new
-            log.debug("Encountered a VmException, ending path: `%s`", error_msg)
-            new_global_states: List[GlobalState] = []
-        else:
-            self._execute_post_hook(op_code, [global_state])
-            new_global_states = self._end_message_call(
-                return_global_state, global_state, revert_changes=True, return_data=None
-            )
-        return new_global_states
-
-    def execute_state(
-        self, global_state: GlobalState
-    ) -> Tuple[List[GlobalState], Optional[str]]:
-        """Execute one instruction in `global_state` (reference:
-        svm.py:303-413)."""
-        for hook in self._execute_state_hooks:
-            hook(global_state)
-
-        instructions = global_state.environment.code.instruction_list
+    def _settle_world_state(self, state: GlobalState) -> None:
+        """Promote a finished transaction's world state into the open
+        set unless a pruner vetoes it."""
         try:
-            op_code = instructions[global_state.mstate.pc]["opcode"]
-        except IndexError:
-            # walked off the end of the code: implicit STOP
-            self._add_world_state(global_state)
-            return [], None
-
-        if len(global_state.mstate.stack) < get_required_stack_elements(op_code):
-            error_msg = (
-                "Stack Underflow Exception due to insufficient "
-                "stack elements for the address {}".format(
-                    instructions[global_state.mstate.pc]["address"]
-                )
-            )
-            new_global_states = self.handle_vm_exception(
-                global_state, op_code, error_msg
-            )
-            self._execute_post_hook(op_code, new_global_states)
-            return new_global_states, op_code
-
-        try:
-            self._execute_pre_hook(op_code, global_state)
-        except PluginSkipState:
-            self._add_world_state(global_state)
-            return [], None
-
-        try:
-            new_global_states = Instruction(
-                op_code,
-                self.dynamic_loader,
-                pre_hooks=self.instr_pre_hook[op_code],
-                post_hooks=self.instr_post_hook[op_code],
-            ).evaluate(global_state)
-
-        except VmException as e:
-            new_global_states = self.handle_vm_exception(global_state, op_code, str(e))
-
-        except TransactionStartSignal as start_signal:
-            # enter the callee frame
-            new_global_state = start_signal.transaction.initial_global_state()
-            new_global_state.transaction_stack = copy(
-                global_state.transaction_stack
-            ) + [(start_signal.transaction, global_state)]
-            new_global_state.node = global_state.node
-            new_global_state.world_state.constraints = (
-                start_signal.global_state.world_state.constraints
-            )
-
-            transfer_ether(
-                new_global_state,
-                start_signal.transaction.caller,
-                start_signal.transaction.callee_account.address,
-                start_signal.transaction.call_value,
-            )
-            log.debug("Starting new transaction %s", start_signal.transaction)
-            return [new_global_state], op_code
-
-        except TransactionEndSignal as end_signal:
-            (
-                transaction,
-                return_global_state,
-            ) = end_signal.global_state.transaction_stack[-1]
-            log.debug("Ending transaction %s.", transaction)
-
-            if return_global_state is None:
-                # outermost frame done
-                if (
-                    not isinstance(transaction, ContractCreationTransaction)
-                    or transaction.return_data
-                ) and not end_signal.revert:
-                    # deferred L6 import, see module docstring
-                    from mythril_tpu.analysis.potential_issues import (
-                        check_potential_issues,
-                    )
-
-                    check_potential_issues(global_state)
-                    end_signal.global_state.world_state.node = global_state.node
-                    self._add_world_state(end_signal.global_state)
-                new_global_states = []
-            else:
-                # nested frame done: resume the caller
-                self._execute_post_hook(op_code, [end_signal.global_state])
-
-                new_annotations = [
-                    annotation
-                    for annotation in global_state.annotations
-                    if annotation.persist_over_calls
-                ]
-                return_global_state.add_annotations(new_annotations)
-
-                new_global_states = self._end_message_call(
-                    copy(return_global_state),
-                    global_state,
-                    revert_changes=False or end_signal.revert,
-                    return_data=transaction.return_data,
-                )
-
-        self._execute_post_hook(op_code, new_global_states)
-        return new_global_states, op_code
-
-    def _end_message_call(
-        self,
-        return_global_state: GlobalState,
-        global_state: GlobalState,
-        revert_changes=False,
-        return_data=None,
-    ) -> List[GlobalState]:
-        """Resume the caller frame after a nested call: merge
-        constraints, adopt the callee's world state (unless reverted),
-        and run the `<op>_post` handler (reference: svm.py:415-468)."""
-        return_global_state.world_state.constraints += (
-            global_state.world_state.constraints
-        )
-        op_code = return_global_state.environment.code.instruction_list[
-            return_global_state.mstate.pc
-        ]["opcode"]
-
-        return_global_state.last_return_data = return_data
-        if not revert_changes:
-            return_global_state.world_state = copy(global_state.world_state)
-            return_global_state.environment.active_account = global_state.accounts[
-                return_global_state.environment.active_account.address.value
-            ]
-            if isinstance(
-                global_state.current_transaction, ContractCreationTransaction
-            ):
-                return_global_state.mstate.min_gas_used += (
-                    global_state.mstate.min_gas_used
-                )
-                return_global_state.mstate.max_gas_used += (
-                    global_state.mstate.max_gas_used
-                )
-
-        new_global_states = Instruction(
-            op_code,
-            self.dynamic_loader,
-            pre_hooks=self.instr_pre_hook[op_code],
-            post_hooks=self.instr_post_hook[op_code],
-        ).evaluate(return_global_state, True)
-
-        for state in new_global_states:
-            state.node = global_state.node
-        return new_global_states
-
-    # ------------------------------------------------------------------
-    # CFG bookkeeping
-    # ------------------------------------------------------------------
-    def manage_cfg(self, opcode: Optional[str], new_states: List[GlobalState]) -> None:
-        if opcode == "JUMP":
-            assert len(new_states) <= 1
-            for state in new_states:
-                self._new_node_state(state)
-        elif opcode == "JUMPI":
-            assert len(new_states) <= 2
-            for state in new_states:
-                self._new_node_state(
-                    state, JumpType.CONDITIONAL, state.world_state.constraints[-1]
-                )
-        elif opcode in ("SLOAD", "SSTORE") and len(new_states) > 1:
-            for state in new_states:
-                self._new_node_state(
-                    state, JumpType.CONDITIONAL, state.world_state.constraints[-1]
-                )
-        elif opcode == "RETURN":
-            for state in new_states:
-                self._new_node_state(state, JumpType.RETURN)
-
-        for state in new_states:
-            state.node.states.append(state)
-
-    def _new_node_state(
-        self, state: GlobalState, edge_type=JumpType.UNCONDITIONAL, condition=None
-    ) -> None:
-        try:
-            address = state.environment.code.instruction_list[state.mstate.pc][
-                "address"
-            ]
-        except IndexError:
+            self.bus.emit("add_world_state", state)
+        except PluginSkipWorldState:
             return
-        new_node = Node(state.environment.active_account.contract_name)
-        old_node = state.node
-        state.node = new_node
-        new_node.constraints = state.world_state.constraints
-        if self.requires_statespace:
-            self.nodes[new_node.uid] = new_node
-            self.edges.append(
-                Edge(
-                    old_node.uid, new_node.uid, edge_type=edge_type, condition=condition
-                )
-            )
+        self.open_states.append(state.world_state)
 
-        if edge_type == JumpType.RETURN:
-            new_node.flags |= NodeFlags.CALL_RETURN
-        elif edge_type == JumpType.CALL:
-            try:
-                if "retval" in str(state.mstate.stack[-1]):
-                    new_node.flags |= NodeFlags.CALL_RETURN
-                else:
-                    new_node.flags |= NodeFlags.FUNC_ENTRY
-            except StackUnderflowException:
-                new_node.flags |= NodeFlags.FUNC_ENTRY
-
-        environment = state.environment
-        disassembly = environment.code
-        if isinstance(
-            state.world_state.transaction_sequence[-1], ContractCreationTransaction
-        ):
-            environment.active_function_name = "constructor"
-        elif address in disassembly.address_to_function_name:
-            environment.active_function_name = disassembly.address_to_function_name[
-                address
-            ]
-            new_node.flags |= NodeFlags.FUNC_ENTRY
-            log.debug(
-                "- Entering function %s:%s",
-                environment.active_account.contract_name,
-                new_node.function_name,
-            )
-        elif address == 0:
-            environment.active_function_name = "fallback"
-
-        new_node.function_name = environment.active_function_name
+    # kept under its historical name for plugins/tests
+    def _add_world_state(self, global_state: GlobalState) -> None:
+        self._settle_world_state(global_state)
 
     # ------------------------------------------------------------------
-    # hook registration surface
+    # hook registration (public surface, unchanged)
     # ------------------------------------------------------------------
     def register_hooks(self, hook_type: str, hook_dict: Dict[str, List[Callable]]):
-        if hook_type == "pre":
-            entrypoint = self.pre_hooks
-        elif hook_type == "post":
-            entrypoint = self.post_hooks
-        else:
+        if hook_type not in ("pre", "post"):
             raise ValueError(
                 "Invalid hook type %s. Must be one of {pre, post}" % hook_type
             )
-        for op_code, funcs in hook_dict.items():
-            entrypoint[op_code].extend(funcs)
+        for opcode, fns in hook_dict.items():
+            self.bus.extend((hook_type, opcode), fns)
 
     def register_laser_hooks(self, hook_type: str, hook: Callable):
-        if hook_type == "add_world_state":
-            self._add_world_state_hooks.append(hook)
-        elif hook_type == "execute_state":
-            self._execute_state_hooks.append(hook)
-        elif hook_type == "start_sym_exec":
-            self._start_sym_exec_hooks.append(hook)
-        elif hook_type == "stop_sym_exec":
-            self._stop_sym_exec_hooks.append(hook)
-        elif hook_type == "start_sym_trans":
-            self._start_sym_trans_hooks.append(hook)
-        elif hook_type == "stop_sym_trans":
-            self._stop_sym_trans_hooks.append(hook)
-        else:
+        if hook_type not in (
+            "add_world_state",
+            "execute_state",
+            "start_sym_exec",
+            "stop_sym_exec",
+            "start_sym_trans",
+            "stop_sym_trans",
+        ):
             raise ValueError(f"Invalid hook type {hook_type}")
+        self.bus.on(hook_type, hook)
 
-    def register_instr_hooks(self, hook_type: str, opcode: str, hook: Callable):
-        """Per-instruction hooks; a None opcode hooks every opcode
-        through the factory form `hook(op)`."""
-        if hook_type == "pre":
-            if opcode is None:
-                for op in OPCODES:
-                    self.instr_pre_hook[op].append(hook(op))
-            else:
-                self.instr_pre_hook[opcode].append(hook)
+    def register_instr_hooks(
+        self, hook_type: str, opcode: Optional[str], hook: Callable
+    ):
+        """Per-instruction hooks; opcode None fans the factory form
+        `hook(op)` out over the whole table."""
+        phase = f"instr:{hook_type}"
+        if opcode is None:
+            for op in OPCODES:
+                self.bus.on((phase, op), hook(op))
         else:
-            if opcode is None:
-                for op in OPCODES:
-                    self.instr_post_hook[op].append(hook(op))
-            else:
-                self.instr_post_hook[opcode].append(hook)
+            self.bus.on((phase, opcode), hook)
 
     def instr_hook(self, hook_type, opcode) -> Callable:
-        def hook_decorator(func: Callable):
-            self.register_instr_hooks(hook_type, opcode, func)
+        def wrap(fn: Callable):
+            self.register_instr_hooks(hook_type, opcode, fn)
 
-        return hook_decorator
+        return wrap
 
     def laser_hook(self, hook_type: str) -> Callable:
-        def hook_decorator(func: Callable):
-            self.register_laser_hooks(hook_type, func)
-            return func
+        def wrap(fn: Callable):
+            self.register_laser_hooks(hook_type, fn)
+            return fn
 
-        return hook_decorator
-
-    def _execute_pre_hook(self, op_code: str, global_state: GlobalState) -> None:
-        if op_code not in self.pre_hooks.keys():
-            return
-        for hook in self.pre_hooks[op_code]:
-            hook(global_state)
-
-    def _execute_post_hook(
-        self, op_code: str, global_states: List[GlobalState]
-    ) -> None:
-        if op_code not in self.post_hooks.keys():
-            return
-        for hook in self.post_hooks[op_code]:
-            for global_state in global_states:
-                try:
-                    hook(global_state)
-                except PluginSkipState:
-                    global_states.remove(global_state)
+        return wrap
 
     def pre_hook(self, op_code: str) -> Callable:
-        def hook_decorator(func: Callable):
-            if op_code not in self.pre_hooks.keys():
-                self.pre_hooks[op_code] = []
-            self.pre_hooks[op_code].append(func)
-            return func
+        def wrap(fn: Callable):
+            self.bus.on(("pre", op_code), fn)
+            return fn
 
-        return hook_decorator
+        return wrap
 
     def post_hook(self, op_code: str) -> Callable:
-        def hook_decorator(func: Callable):
-            if op_code not in self.post_hooks.keys():
-                self.post_hooks[op_code] = []
-            self.post_hooks[op_code].append(func)
-            return func
+        def wrap(fn: Callable):
+            self.bus.on(("post", op_code), fn)
+            return fn
 
-        return hook_decorator
+        return wrap
