@@ -1,9 +1,10 @@
 """Interactive call-graph HTML for `myth analyze --graph`.
 
-Reference parity: mythril/analysis/callgraph.py:220-250 — extracts
-vis.js-style node/edge dicts from the statespace and renders an HTML
-page (hierarchical LR layout; `--phrack` switches to the monochrome
-zine look).
+Covers mythril/analysis/callgraph.py: turns the statespace into
+vis.js node/edge dicts and renders the HTML page (hierarchical
+left-to-right layout; `--phrack` switches to the monochrome zine
+look). The vis.js option trees are assembled from small shared pieces
+instead of spelled out literally.
 """
 
 from __future__ import annotations
@@ -14,6 +15,36 @@ from jinja2 import Environment, PackageLoader, select_autoescape
 
 from mythril_tpu.laser.ethereum.cfg import NodeFlags
 from mythril_tpu.laser.smt import simplify
+
+MAX_PREVIEW_LINES = 6
+
+
+def _edge_font(color: str, face: str = "arial") -> dict:
+    return {
+        "color": color,
+        "face": face,
+        "background": "none",
+        "strokeWidth": 0,
+        "strokeColor": "#ffffff",
+        "align": "horizontal",
+        "multi": False,
+        "vadjust": 0,
+    }
+
+
+def _node_style(font_color: str, face: str = None) -> dict:
+    font = {"align": "left", "color": font_color}
+    if face:
+        font["face"] = face
+    return {
+        "color": "#000000",
+        "borderWidth": 1,
+        "borderWidthSelected": 2,
+        "chosen": True,
+        "shape": "box",
+        "font": font,
+    }
+
 
 default_opts = {
     "autoResize": True,
@@ -34,132 +65,88 @@ default_opts = {
             "sortMethod": "directed",
         },
     },
-    "nodes": {
-        "color": "#000000",
-        "borderWidth": 1,
-        "borderWidthSelected": 2,
-        "chosen": True,
-        "shape": "box",
-        "font": {"align": "left", "color": "#FFFFFF"},
-    },
-    "edges": {
-        "font": {
-            "color": "#FFFFFF",
-            "face": "arial",
-            "background": "none",
-            "strokeWidth": 0,
-            "strokeColor": "#ffffff",
-            "align": "horizontal",
-            "multi": False,
-            "vadjust": 0,
-        }
-    },
+    "nodes": _node_style("#FFFFFF"),
+    "edges": {"font": _edge_font("#FFFFFF")},
     "physics": {"enabled": False},
 }
 
 phrack_opts = {
-    "nodes": {
-        "color": "#000000",
-        "borderWidth": 1,
-        "borderWidthSelected": 1,
-        "shapeProperties": {"borderDashes": False, "borderRadius": 0},
-        "chosen": True,
-        "shape": "box",
-        "font": {"face": "courier new", "align": "left", "color": "#000000"},
-    },
-    "edges": {
-        "font": {
-            "color": "#000000",
-            "face": "courier new",
-            "background": "none",
-            "strokeWidth": 0,
-            "strokeColor": "#ffffff",
-            "align": "horizontal",
-            "multi": False,
-            "vadjust": 0,
-        }
-    },
+    "nodes": dict(
+        _node_style("#000000", face="courier new"),
+        borderWidthSelected=1,
+        shapeProperties={"borderDashes": False, "borderRadius": 0},
+    ),
+    "edges": {"font": _edge_font("#000000", face="courier new")},
 }
+
+
+def _shade(border: str, background: str, highlight_bg: str) -> dict:
+    return {
+        "border": border,
+        "background": background,
+        "highlight": {"border": border, "background": highlight_bg},
+    }
+
 
 default_colors = [
-    {
-        "border": "#26996f",
-        "background": "#2f7e5b",
-        "highlight": {"border": "#26996f", "background": "#28a16f"},
-    },
-    {
-        "border": "#9e42b3",
-        "background": "#842899",
-        "highlight": {"border": "#9e42b3", "background": "#933da6"},
-    },
-    {
-        "border": "#b82323",
-        "background": "#991d1d",
-        "highlight": {"border": "#b82323", "background": "#a61f1f"},
-    },
-    {
-        "border": "#4753bf",
-        "background": "#3b46a1",
-        "highlight": {"border": "#4753bf", "background": "#424db3"},
-    },
+    _shade("#26996f", "#2f7e5b", "#28a16f"),
+    _shade("#9e42b3", "#842899", "#933da6"),
+    _shade("#b82323", "#991d1d", "#a61f1f"),
+    _shade("#4753bf", "#3b46a1", "#424db3"),
 ]
 
-phrack_color = {
-    "border": "#000000",
-    "background": "#ffffff",
-    "highlight": {"border": "#000000", "background": "#ffffff"},
-}
+phrack_color = _shade("#000000", "#ffffff", "#ffffff")
+
+_ELIDE_HEX = ("([0-9a-f]{8})[0-9a-f]+", lambda m: m.group(1) + "(...)")
+
+
+def _listing_line(node, state) -> str:
+    """One disassembly line for a state, or None past end-of-code."""
+    try:
+        instr = state.get_current_instruction()
+    except IndexError:
+        return None
+    if instr["opcode"].startswith("PUSH"):
+        line = "%d %s %s" % (instr["address"], instr["opcode"], instr["argument"])
+    elif (
+        instr["opcode"].startswith("JUMPDEST")
+        and NodeFlags.FUNC_ENTRY in node.flags
+        and instr["address"] == node.start_addr
+    ):
+        line = node.function_name
+    else:
+        line = "%d %s" % (instr["address"], instr["opcode"])
+    return re.sub(*_ELIDE_HEX, line)
 
 
 def extract_nodes(statespace):
     nodes = []
-    color_map = {}
-    for node_key in statespace.nodes:
-        node = statespace.nodes[node_key]
-        code_split = []
-        for state in node.states:
-            try:
-                instruction = state.get_current_instruction()
-            except IndexError:
-                continue
-            if instruction["opcode"].startswith("PUSH"):
-                code_line = "%d %s %s" % (
-                    instruction["address"],
-                    instruction["opcode"],
-                    instruction["argument"],
-                )
-            elif (
-                instruction["opcode"].startswith("JUMPDEST")
-                and NodeFlags.FUNC_ENTRY in node.flags
-                and instruction["address"] == node.start_addr
-            ):
-                code_line = node.function_name
-            else:
-                code_line = "%d %s" % (instruction["address"], instruction["opcode"])
-            code_line = re.sub(
-                "([0-9a-f]{8})[0-9a-f]+", lambda m: m.group(1) + "(...)", code_line
+    palette = {}
+    for node_key, node in statespace.nodes.items():
+        listing = [
+            line
+            for line in (_listing_line(node, s) for s in node.states)
+            if line is not None
+        ]
+        if len(listing) <= MAX_PREVIEW_LINES:
+            preview = "\n".join(listing)
+        else:
+            preview = (
+                "\n".join(listing[:MAX_PREVIEW_LINES]) + "\n(click to expand +)"
             )
-            code_split.append(code_line)
 
-        truncated_code = (
-            "\n".join(code_split)
-            if (len(code_split) < 7)
-            else "\n".join(code_split[:6]) + "\n(click to expand +)"
-        )
-
-        contract_name = node.get_cfg_dict()["contract_name"]
-        if contract_name not in color_map.keys():
-            color = default_colors[len(color_map) % len(default_colors)]
-            color_map[contract_name] = color
+        who = node.get_cfg_dict()["contract_name"]
+        if who not in palette:
+            palette[who] = default_colors[len(palette) % len(default_colors)]
 
         nodes.append(
             {
                 "id": str(node_key),
-                "color": color_map.get(contract_name, default_colors[0]),
+                "color": palette.get(who, default_colors[0]),
                 "size": 150,
-                "fullLabel": "\n".join(code_split),
-                "label": truncated_code,
-                "truncLabel": truncated_code,
+                "fullLabel": "\n".join(listing),
+                "label": preview,
+                "truncLabel": preview,
                 "isExpanded": False,
             }
         )
@@ -169,12 +156,13 @@ def extract_nodes(statespace):
 def extract_edges(statespace):
     edges = []
     for edge in statespace.edges:
-        if edge.condition is None:
-            label = ""
-        else:
+        label = ""
+        if edge.condition is not None:
             label = str(simplify(edge.condition)).replace("\n", "")
         label = re.sub(
-            r"([^_])([\d]{2}\d+)", lambda m: m.group(1) + hex(int(m.group(2))), label
+            r"([^_])([\d]{2}\d+)",
+            lambda m: m.group(1) + hex(int(m.group(2))),
+            label,
         )
         edges.append(
             {
@@ -199,14 +187,12 @@ def generate_graph(
         loader=PackageLoader("mythril_tpu.analysis"),
         autoescape=select_autoescape(["html", "xml"]),
     )
-    template = env.get_template("callgraph.html")
-    graph_opts = default_opts
-    graph_opts["physics"]["enabled"] = physics
-
-    return template.render(
+    opts = default_opts
+    opts["physics"]["enabled"] = physics
+    return env.get_template("callgraph.html").render(
         title=title,
         nodes=extract_nodes(statespace),
         edges=extract_edges(statespace),
         phrackify=phrackify,
-        opts=graph_opts,
+        opts=opts,
     )

@@ -1,64 +1,71 @@
 """SWC-127: jump to a caller-controlled destination.
 
-Reference parity: mythril/analysis/module/modules/arbitrary_jump.py:16-78.
+Covers mythril/analysis/module/modules/arbitrary_jump.py.
 """
 
 from __future__ import annotations
 
 import logging
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.dsl import (
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.solver import get_transaction_sequence
 from mythril_tpu.analysis.swc_data import ARBITRARY_JUMP
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 
 log = logging.getLogger(__name__)
 
+REMEDIATION = (
+    "It is possible to redirect the control flow to arbitrary locations in the code. "
+    "This may allow an attacker to bypass security controls or manipulate the business logic of the "
+    "smart contract. Avoid using low-level-operations and assembly to prevent this issue."
+)
 
-class ArbitraryJump(DetectionModule):
-    """Flags JUMP/JUMPI whose destination stays symbolic (and therefore
-    attacker-influenceable)."""
+
+class ArbitraryJump(ImmediateDetector):
+    """Flags JUMP/JUMPI whose destination stays symbolic (and is
+    therefore attacker-influenceable)."""
 
     name = "Caller can redirect execution to arbitrary bytecode locations"
     swc_id = ARBITRARY_JUMP
     description = "Search for jumps to arbitrary locations in the bytecode"
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
 
     def _execute(self, state: GlobalState) -> None:
+        # reference quirk kept: the cache is consulted but never fed,
+        # so repeated hits re-report (golden outputs depend on it)
         if state.get_current_instruction()["address"] in self.cache:
             return
         self.issues.extend(self._analyze_state(state))
 
-    @staticmethod
-    def _analyze_state(state):
-        jump_dest = state.mstate.stack[-1]
-        if jump_dest.symbolic is False:
+    def _analyze_state(self, state: GlobalState) -> list:
+        if state.mstate.stack[-1].symbolic is False:
             return []
         try:
-            transaction_sequence = get_transaction_sequence(
+            witness = get_transaction_sequence(
                 state, state.world_state.constraints
             )
         except UnsatError:
             return []
-        issue = Issue(
-            contract=state.environment.active_account.contract_name,
-            function_name=state.environment.active_function_name,
-            address=state.get_current_instruction()["address"],
-            swc_id=ARBITRARY_JUMP,
-            title="Jump to an arbitrary instruction",
-            severity="High",
-            bytecode=state.environment.code.bytecode,
-            description_head="The caller can redirect execution to arbitrary bytecode locations.",
-            description_tail="It is possible to redirect the control flow to arbitrary locations in the code. "
-            "This may allow an attacker to bypass security controls or manipulate the business logic of the "
-            "smart contract. Avoid using low-level-operations and assembly to prevent this issue.",
-            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            transaction_sequence=transaction_sequence,
-        )
-        return [issue]
+        return [
+            Issue(
+                swc_id=ARBITRARY_JUMP,
+                title="Jump to an arbitrary instruction",
+                severity="High",
+                description_head=(
+                    "The caller can redirect execution to arbitrary bytecode locations."
+                ),
+                description_tail=REMEDIATION,
+                gas_used=gas_range(state),
+                transaction_sequence=witness,
+                **found_at(state),
+            )
+        ]
 
 
 detector = ArbitraryJump()

@@ -1,18 +1,18 @@
 """SWC-124: write to an attacker-chosen storage slot.
 
-Reference parity: mythril/analysis/module/modules/arbitrary_write.py:21-80.
-Two-phase: the cheap local property is "the written slot can equal an
-arbitrary sentinel value"; full validation happens at transaction end.
+Covers mythril/analysis/module/modules/arbitrary_write.py. Two-phase:
+the cheap local property is "the written slot can equal an arbitrary
+sentinel value"; full validation happens at transaction end.
 """
 
 from __future__ import annotations
 
 import logging
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
+from mythril_tpu.analysis.module.dsl import (
+    DeferredDetector,
     PotentialIssue,
-    get_potential_issues_annotation,
+    found_at,
 )
 from mythril_tpu.analysis.swc_data import WRITE_TO_ARBITRARY_STORAGE
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
@@ -20,47 +20,43 @@ from mythril_tpu.laser.smt import symbol_factory
 
 log = logging.getLogger(__name__)
 
+#: arbitrary sentinel: a slot pinned by the storage layout can't equal it
+SENTINEL_SLOT = 324345425435
 
-class ArbitraryStorage(DetectionModule):
+REMEDIATION = (
+    "It is possible to write to arbitrary storage locations. By modifying the values of "
+    "storage variables, attackers may bypass security controls or manipulate the business logic of "
+    "the smart contract."
+)
+
+
+class ArbitraryStorage(DeferredDetector):
     """Searches for a feasible write to an arbitrary storage slot."""
 
     name = "Caller can write to arbitrary storage locations"
     swc_id = WRITE_TO_ARBITRARY_STORAGE
     description = "Search for any writes to an arbitrary storage slot"
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SSTORE"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
-
-    def _analyze_state(self, state):
-        write_slot = state.mstate.stack[-1]
-        # can the slot equal an arbitrary sentinel? (i.e. it is not
-        # pinned to any fixed layout location)
-        constraints = state.world_state.constraints + [
-            write_slot == symbol_factory.BitVecVal(324345425435, 256)
+    def _analyze_state(self, state: GlobalState) -> list:
+        slot = state.mstate.stack[-1]
+        reachable_with_sentinel = state.world_state.constraints + [
+            slot == symbol_factory.BitVecVal(SENTINEL_SLOT, 256)
         ]
-
-        potential_issue = PotentialIssue(
-            contract=state.environment.active_account.contract_name,
-            function_name=state.environment.active_function_name,
-            address=state.get_current_instruction()["address"],
-            swc_id=WRITE_TO_ARBITRARY_STORAGE,
-            title="Write to an arbitrary storage location",
-            severity="High",
-            bytecode=state.environment.code.bytecode,
-            description_head="The caller can write to arbitrary storage locations.",
-            description_tail="It is possible to write to arbitrary storage locations. By modifying the values of "
-            "storage variables, attackers may bypass security controls or manipulate the business logic of "
-            "the smart contract.",
-            detector=self,
-            constraints=constraints,
-        )
-        return [potential_issue]
+        return [
+            PotentialIssue(
+                swc_id=WRITE_TO_ARBITRARY_STORAGE,
+                title="Write to an arbitrary storage location",
+                severity="High",
+                description_head=(
+                    "The caller can write to arbitrary storage locations."
+                ),
+                description_tail=REMEDIATION,
+                detector=self,
+                constraints=reachable_with_sentinel,
+                **found_at(state),
+            )
+        ]
 
 
 detector = ArbitraryStorage()

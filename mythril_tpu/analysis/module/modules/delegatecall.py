@@ -1,22 +1,20 @@
 """SWC-112: delegatecall to an attacker-supplied address.
 
-Reference parity: mythril/analysis/module/modules/delegatecall.py:22-101.
+Covers mythril/analysis/module/modules/delegatecall.py.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import List
 
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
+from mythril_tpu.analysis.module.dsl import (
+    ACTORS,
+    DeferredDetector,
     PotentialIssue,
-    get_potential_issues_annotation,
+    found_at,
 )
 from mythril_tpu.analysis.swc_data import DELEGATECALL_TO_UNTRUSTED_CONTRACT
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
 from mythril_tpu.laser.ethereum.transaction.transaction_models import (
     ContractCreationTransaction,
 )
@@ -24,66 +22,57 @@ from mythril_tpu.laser.smt import UGT, symbol_factory
 
 log = logging.getLogger(__name__)
 
+REMEDIATION = (
+    "The smart contract delegates execution to a user-supplied address."
+    "This could allow an attacker to execute arbitrary code in the context of this contract "
+    "account and manipulate the state of the contract account or execute actions on its behalf."
+)
 
-class ArbitraryDelegateCall(DetectionModule):
+
+class ArbitraryDelegateCall(DeferredDetector):
     """Detects delegatecall to a user-supplied address."""
 
     name = "Delegatecall to a user-specified address"
     swc_id = DELEGATECALL_TO_UNTRUSTED_CONTRACT
-    description = "Check for invocations of delegatecall to a user-supplied address."
-    entry_point = EntryPoint.CALLBACK
+    description = (
+        "Check for invocations of delegatecall to a user-supplied address."
+    )
     pre_hooks = ["DELEGATECALL"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    def _analyze_state(self, state: GlobalState) -> list:
+        gas, target = state.mstate.stack[-1], state.mstate.stack[-2]
+        here = state.get_current_instruction()["address"]
 
-    def _analyze_state(self, state: GlobalState) -> List[PotentialIssue]:
-        gas = state.mstate.stack[-1]
-        to = state.mstate.stack[-2]
-
-        constraints = [
-            to == ACTORS.attacker,
+        property_constraints = [
+            target == ACTORS.attacker,
             UGT(gas, symbol_factory.BitVecVal(2300, 256)),
-            state.new_bitvec(
-                "retval_{}".format(state.get_current_instruction()["address"]), 256
-            )
-            == 1,
+            state.new_bitvec(f"retval_{here}", 256) == 1,
         ]
         # every message call in the sequence must come from the attacker
         for tx in state.world_state.transaction_sequence:
             if not isinstance(tx, ContractCreationTransaction):
-                constraints.append(tx.caller == ACTORS.attacker)
+                property_constraints.append(tx.caller == ACTORS.attacker)
 
-        try:
-            address = state.get_current_instruction()["address"]
-            log.debug(
-                "[DELEGATECALL] Detected potential delegatecall to a "
-                "user-supplied address: %s",
-                address,
+        log.debug(
+            "[DELEGATECALL] Detected potential delegatecall to a "
+            "user-supplied address: %s",
+            here,
+        )
+        return [
+            PotentialIssue(
+                swc_id=DELEGATECALL_TO_UNTRUSTED_CONTRACT,
+                title="Delegatecall to user-supplied address",
+                severity="High",
+                description_head=(
+                    "The contract delegates execution to another contract "
+                    "with a user-supplied address."
+                ),
+                description_tail=REMEDIATION,
+                constraints=property_constraints,
+                detector=self,
+                **found_at(state),
             )
-            return [
-                PotentialIssue(
-                    contract=state.environment.active_account.contract_name,
-                    function_name=state.environment.active_function_name,
-                    address=address,
-                    swc_id=DELEGATECALL_TO_UNTRUSTED_CONTRACT,
-                    bytecode=state.environment.code.bytecode,
-                    title="Delegatecall to user-supplied address",
-                    severity="High",
-                    description_head="The contract delegates execution to another contract with a user-supplied address.",
-                    description_tail="The smart contract delegates execution to a user-supplied address."
-                    "This could allow an attacker to execute arbitrary code in the context of this contract "
-                    "account and manipulate the state of the contract account or execute actions on its behalf.",
-                    constraints=constraints,
-                    detector=self,
-                )
-            ]
-        except UnsatError:
-            return []
+        ]
 
 
 detector = ArbitraryDelegateCall()

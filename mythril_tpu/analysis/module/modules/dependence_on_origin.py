@@ -1,9 +1,8 @@
 """SWC-115: control flow depends on tx.origin.
 
-Reference parity: mythril/analysis/module/modules/dependence_on_origin.py
-:24-112 — the ORIGIN post-hook taints the pushed symbol with a
-`TxOriginAnnotation`; the JUMPI pre-hook reports when a tainted value
-decides a branch.
+Covers mythril/analysis/module/modules/dependence_on_origin.py — the
+ORIGIN post-hook taints the pushed symbol; the JUMPI pre-hook reports
+branches decided by a tainted value.
 """
 
 from __future__ import annotations
@@ -12,83 +11,75 @@ import logging
 from copy import copy
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.dsl import (
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.swc_data import TX_ORIGIN_USAGE
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 
 log = logging.getLogger(__name__)
+
+REMEDIATION = (
+    "The tx.origin environment variable has been found to influence a control flow decision. "
+    "Note that using tx.origin as a security control might cause a situation where a user "
+    "inadvertently authorizes a smart contract to perform an action on their behalf. It is "
+    "recommended to use msg.sender instead."
+)
 
 
 class TxOriginAnnotation:
     """Symbol annotation marking a value derived from ORIGIN."""
 
 
-class TxOrigin(DetectionModule):
+class TxOrigin(ImmediateDetector):
     """Detects branches that depend on the transaction origin."""
 
     name = "Control flow depends on tx.origin"
     swc_id = TX_ORIGIN_USAGE
-    description = "Check whether control flow decisions are influenced by tx.origin"
-    entry_point = EntryPoint.CALLBACK
+    description = (
+        "Check whether control flow decisions are influenced by tx.origin"
+    )
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
-
-    @staticmethod
-    def _analyze_state(state: GlobalState) -> list:
-        issues = []
-
-        if state.get_current_instruction()["opcode"] == "JUMPI":
-            # JUMPI pre-hook: does the branch condition carry the taint?
-            for annotation in state.mstate.stack[-2].annotations:
-                if isinstance(annotation, TxOriginAnnotation):
-                    constraints = copy(state.world_state.constraints)
-                    try:
-                        transaction_sequence = solver.get_transaction_sequence(
-                            state, constraints
-                        )
-                    except UnsatError:
-                        continue
-
-                    description = (
-                        "The tx.origin environment variable has been found to influence a control flow decision. "
-                        "Note that using tx.origin as a security control might cause a situation where a user "
-                        "inadvertently authorizes a smart contract to perform an action on their behalf. It is "
-                        "recommended to use msg.sender instead."
-                    )
-                    # the JUMPI maps to the if/require in source
-                    issues.append(
-                        Issue(
-                            contract=state.environment.active_account.contract_name,
-                            function_name=state.environment.active_function_name,
-                            address=state.get_current_instruction()["address"],
-                            swc_id=TX_ORIGIN_USAGE,
-                            bytecode=state.environment.code.bytecode,
-                            title="Dependence on tx.origin",
-                            severity="Low",
-                            description_head="Use of tx.origin as a part of authorization control.",
-                            description_tail=description,
-                            gas_used=(
-                                state.mstate.min_gas_used,
-                                state.mstate.max_gas_used,
-                            ),
-                            transaction_sequence=transaction_sequence,
-                        )
-                    )
-        else:
-            # ORIGIN post-hook: taint the pushed value
+    def _analyze_state(self, state: GlobalState) -> list:
+        if state.get_current_instruction()["opcode"] != "JUMPI":
+            # ORIGIN post-hook: taint the freshly pushed value
             state.mstate.stack[-1].annotate(TxOriginAnnotation())
+            return []
 
-        return issues
+        # JUMPI pre-hook: is the branch guard tainted?
+        tainted = any(
+            isinstance(a, TxOriginAnnotation)
+            for a in state.mstate.stack[-2].annotations
+        )
+        if not tainted:
+            return []
+        try:
+            witness = solver.get_transaction_sequence(
+                state, copy(state.world_state.constraints)
+            )
+        except UnsatError:
+            return []
+        # the JUMPI maps to the if/require in source
+        return [
+            Issue(
+                swc_id=TX_ORIGIN_USAGE,
+                title="Dependence on tx.origin",
+                severity="Low",
+                description_head=(
+                    "Use of tx.origin as a part of authorization control."
+                ),
+                description_tail=REMEDIATION,
+                gas_used=gas_range(state),
+                transaction_sequence=witness,
+                **found_at(state),
+            )
+        ]
 
 
 detector = TxOrigin()

@@ -1,30 +1,40 @@
 """SWC-116/120: control flow depends on predictable block variables.
 
-Reference parity: mythril/analysis/module/modules/
-dependence_on_predictable_vars.py:36-195 — post-hooks on
-COINBASE/GASLIMIT/TIMESTAMP/NUMBER taint the pushed symbol; BLOCKHASH
-of a potentially-old block taints too; the JUMPI pre-hook reports
-branches on tainted values.
+Covers mythril/analysis/module/modules/dependence_on_predictable_vars.py
+— post-hooks on COINBASE/GASLIMIT/TIMESTAMP/NUMBER taint the pushed
+symbol; BLOCKHASH of a potentially-old block taints too; the JUMPI
+pre-hook reports branches on tainted values.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import List, cast
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.module.dsl import (
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.module.module_helpers import is_prehook
-from mythril_tpu.analysis.report import Issue
 from mythril_tpu.analysis.swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.smt import ULT, symbol_factory
 
 log = logging.getLogger(__name__)
 
-predictable_ops = ["COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER"]
+PREDICTABLE_OPS = ["COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER"]
+
+REMEDIATION = (
+    "Note that the values of variables like coinbase, gaslimit, block number and timestamp "
+    "are predictable and can be manipulated by a malicious miner. Also keep in mind that "
+    "attackers know hashes of earlier blocks. Don't use any of those environment variables "
+    "as sources of randomness and be aware that use of these variables introduces "
+    "a certain level of trust into miners."
+)
 
 
 class PredictableValueAnnotation:
@@ -38,7 +48,7 @@ class OldBlockNumberUsedAnnotation(StateAnnotation):
     """State annotation: BLOCKHASH was queried for a prior block."""
 
 
-class PredictableVariables(DetectionModule):
+class PredictableVariables(ImmediateDetector):
     """Detects control-flow decisions on predictable parameters."""
 
     name = "Control flow depends on a predictable environment variable"
@@ -47,108 +57,90 @@ class PredictableVariables(DetectionModule):
         "Check whether control flow decisions are influenced by block.coinbase,"
         "block.gaslimit, block.timestamp or block.number."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI", "BLOCKHASH"]
-    post_hooks = ["BLOCKHASH"] + predictable_ops
+    post_hooks = ["BLOCKHASH"] + PREDICTABLE_OPS
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
-
-    @staticmethod
-    def _analyze_state(state: GlobalState) -> list:
-        issues = []
-
+    def _analyze_state(self, state: GlobalState) -> list:
         if is_prehook():
             opcode = state.get_current_instruction()["opcode"]
-
             if opcode == "JUMPI":
-                for annotation in state.mstate.stack[-2].annotations:
-                    if isinstance(annotation, PredictableValueAnnotation):
-                        try:
-                            transaction_sequence = solver.get_transaction_sequence(
-                                state, state.world_state.constraints
-                            )
-                        except UnsatError:
-                            continue
-                        description = (
-                            annotation.operation
-                            + " is used to determine a control flow decision. "
-                        )
-                        description += (
-                            "Note that the values of variables like coinbase, gaslimit, block number and timestamp "
-                            "are predictable and can be manipulated by a malicious miner. Also keep in mind that "
-                            "attackers know hashes of earlier blocks. Don't use any of those environment variables "
-                            "as sources of randomness and be aware that use of these variables introduces "
-                            "a certain level of trust into miners."
-                        )
-                        swc_id = (
-                            TIMESTAMP_DEPENDENCE
-                            if "timestamp" in annotation.operation
-                            else WEAK_RANDOMNESS
-                        )
-                        issues.append(
-                            Issue(
-                                contract=state.environment.active_account.contract_name,
-                                function_name=state.environment.active_function_name,
-                                address=state.get_current_instruction()["address"],
-                                swc_id=swc_id,
-                                bytecode=state.environment.code.bytecode,
-                                title="Dependence on predictable environment variable",
-                                severity="Low",
-                                description_head="A control flow decision is made based on {}.".format(
-                                    annotation.operation
-                                ),
-                                description_tail=description,
-                                gas_used=(
-                                    state.mstate.min_gas_used,
-                                    state.mstate.max_gas_used,
-                                ),
-                                transaction_sequence=transaction_sequence,
-                            )
-                        )
-            elif opcode == "BLOCKHASH":
-                param = state.mstate.stack[-1]
-                # can the queried block be strictly older than the
-                # current one? (upper bound prevents overflow witnesses)
-                constraint = [
-                    ULT(param, state.environment.block_number),
-                    ULT(
-                        state.environment.block_number,
-                        symbol_factory.BitVecVal(2**255, 256),
-                    ),
-                ]
-                try:
-                    solver.get_model(state.world_state.constraints + constraint)
-                    state.annotate(OldBlockNumberUsedAnnotation())
-                except UnsatError:
-                    pass
-        else:
-            # post hook
-            opcode = state.environment.code.instruction_list[state.mstate.pc - 1][
-                "opcode"
+                return self._report_tainted_branch(state)
+            # BLOCKHASH pre-hook: can the queried block be strictly
+            # older than the current one? (upper bound on the block
+            # number prevents overflow witnesses)
+            height = state.mstate.stack[-1]
+            in_the_past = [
+                ULT(height, state.environment.block_number),
+                ULT(
+                    state.environment.block_number,
+                    symbol_factory.BitVecVal(2**255, 256),
+                ),
             ]
-            if opcode == "BLOCKHASH":
-                annotations = cast(
-                    List[OldBlockNumberUsedAnnotation],
-                    list(state.get_annotations(OldBlockNumberUsedAnnotation)),
-                )
-                if len(annotations):
-                    state.mstate.stack[-1].annotate(
-                        PredictableValueAnnotation("The block hash of a previous block")
-                    )
-            else:
+            try:
+                solver.get_model(state.world_state.constraints + in_the_past)
+                state.annotate(OldBlockNumberUsedAnnotation())
+            except UnsatError:
+                pass
+            return []
+
+        # post-hooks: taint the value the opcode just pushed
+        produced_by = state.environment.code.instruction_list[
+            state.mstate.pc - 1
+        ]["opcode"]
+        if produced_by == "BLOCKHASH":
+            if any(state.get_annotations(OldBlockNumberUsedAnnotation)):
                 state.mstate.stack[-1].annotate(
                     PredictableValueAnnotation(
-                        "The block.{} environment variable".format(opcode.lower())
+                        "The block hash of a previous block"
                     )
                 )
+        else:
+            state.mstate.stack[-1].annotate(
+                PredictableValueAnnotation(
+                    "The block.{} environment variable".format(
+                        produced_by.lower()
+                    )
+                )
+            )
+        return []
 
-        return issues
+    @staticmethod
+    def _report_tainted_branch(state: GlobalState) -> list:
+        findings = []
+        for taint in state.mstate.stack[-2].annotations:
+            if not isinstance(taint, PredictableValueAnnotation):
+                continue
+            try:
+                witness = solver.get_transaction_sequence(
+                    state, state.world_state.constraints
+                )
+            except UnsatError:
+                continue
+            findings.append(
+                Issue(
+                    swc_id=(
+                        TIMESTAMP_DEPENDENCE
+                        if "timestamp" in taint.operation
+                        else WEAK_RANDOMNESS
+                    ),
+                    title="Dependence on predictable environment variable",
+                    severity="Low",
+                    description_head=(
+                        "A control flow decision is made based on {}.".format(
+                            taint.operation
+                        )
+                    ),
+                    description_tail=(
+                        taint.operation
+                        + " is used to determine a control flow decision. "
+                        + REMEDIATION
+                    ),
+                    gas_used=gas_range(state),
+                    transaction_sequence=witness,
+                    **found_at(state),
+                )
+            )
+        return findings
 
 
 detector = PredictableVariables()

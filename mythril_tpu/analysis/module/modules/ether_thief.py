@@ -1,8 +1,8 @@
 """SWC-105: anyone can profitably withdraw Ether.
 
-Reference parity: mythril/analysis/module/modules/ether_thief.py:27-102.
-The property: there is a valid end state where the attacker's balance
-exceeds their starting balance, with the attacker as sender.
+Covers mythril/analysis/module/modules/ether_thief.py. The property:
+a valid end state exists where the attacker's balance exceeds their
+starting balance, with the attacker as an EOA sender.
 """
 
 from __future__ import annotations
@@ -11,21 +11,27 @@ import logging
 from copy import copy
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
+from mythril_tpu.analysis.module.dsl import (
+    ACTORS,
+    DeferredDetector,
     PotentialIssue,
-    get_potential_issues_annotation,
+    UnsatError,
+    found_at,
 )
 from mythril_tpu.analysis.swc_data import UNPROTECTED_ETHER_WITHDRAWAL
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
 from mythril_tpu.laser.smt import UGT
 
 log = logging.getLogger(__name__)
 
+REMEDIATION = (
+    "Arbitrary senders other than the contract creator can profitably extract Ether "
+    "from the contract account. Verify the business logic carefully and make sure that appropriate "
+    "security controls are in place to prevent unexpected loss of funds."
+)
 
-class EtherThief(DetectionModule):
+
+class EtherThief(DeferredDetector):
     """Searches for cases where Ether can be withdrawn to a
     user-specified address."""
 
@@ -36,54 +42,47 @@ class EtherThief(DetectionModule):
         " address. An issue is reported if there is a valid end state where"
         " the attacker has successfully increased their Ether balance."
     )
-    entry_point = EntryPoint.CALLBACK
     post_hooks = ["CALL", "STATICCALL"]
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
-
-    def _analyze_state(self, state):
+    def _analyze_state(self, state: GlobalState) -> list:
         state = copy(state)
-        instruction = state.get_current_instruction()
+        world = state.world_state
 
-        constraints = copy(state.world_state.constraints)
-        constraints += [
+        attacker_profits = copy(world.constraints) + [
             UGT(
-                state.world_state.balances[ACTORS.attacker],
-                state.world_state.starting_balances[ACTORS.attacker],
+                world.balances[ACTORS.attacker],
+                world.starting_balances[ACTORS.attacker],
             ),
             state.environment.sender == ACTORS.attacker,
-            state.current_transaction.caller == state.current_transaction.origin,
+            state.current_transaction.caller
+            == state.current_transaction.origin,
         ]
 
         try:
-            # pre-solve: only raise a potential issue when the attacker
-            # profit property is satisfiable on this path
-            solver.get_model(constraints)
+            # pre-solve: raise a potential issue only when the profit
+            # property is satisfiable on this path
+            solver.get_model(attacker_profits)
+        except UnsatError:
+            return []
 
-            potential_issue = PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                # post hook: report the offset of the CALL itself
-                address=instruction["address"] - 1,
+        return [
+            PotentialIssue(
                 swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
                 title="Unprotected Ether Withdrawal",
                 severity="High",
-                bytecode=state.environment.code.bytecode,
-                description_head="Any sender can withdraw Ether from the contract account.",
-                description_tail="Arbitrary senders other than the contract creator can profitably extract Ether "
-                "from the contract account. Verify the business logic carefully and make sure that appropriate "
-                "security controls are in place to prevent unexpected loss of funds.",
+                description_head=(
+                    "Any sender can withdraw Ether from the contract account."
+                ),
+                description_tail=REMEDIATION,
                 detector=self,
-                constraints=constraints,
+                constraints=attacker_profits,
+                # post hook: report the offset of the CALL itself
+                **found_at(
+                    state,
+                    address=state.get_current_instruction()["address"] - 1,
+                ),
             )
-            return [potential_issue]
-        except UnsatError:
-            return []
+        ]
 
 
 detector = EtherThief()

@@ -1,7 +1,7 @@
 """SWC-107 (reentrancy surface): call to a user-supplied address with
 unrestricted gas.
 
-Reference parity: mythril/analysis/module/modules/external_calls.py:46-117.
+Covers mythril/analysis/module/modules/external_calls.py.
 """
 
 from __future__ import annotations
@@ -10,39 +10,46 @@ import logging
 from copy import copy
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
+from mythril_tpu.analysis.module.dsl import (
+    ACTORS,
+    DeferredDetector,
     PotentialIssue,
-    get_potential_issues_annotation,
+    UnsatError,
+    found_at,
 )
 from mythril_tpu.analysis.swc_data import REENTRANCY
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.natives import PRECOMPILE_COUNT
 from mythril_tpu.laser.ethereum.state.constraints import Constraints
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
 from mythril_tpu.laser.smt import BitVec, Or, UGT, symbol_factory
 
 log = logging.getLogger(__name__)
 
+REMEDIATION = (
+    "An external message call to an address specified by the caller is executed. Note that "
+    "the callee account might contain arbitrary code and could re-enter any function "
+    "within this contract. Reentering the contract in an intermediate state may lead to "
+    "unexpected behaviour. Make sure that no state modifications "
+    "are executed after this call and/or reentrancy guards are in place."
+)
+
 
 def _is_precompile_call(global_state: GlobalState) -> bool:
     to: BitVec = global_state.mstate.stack[-2]
-    constraints = copy(global_state.world_state.constraints)
-    constraints += [
+    outside_precompiles = copy(global_state.world_state.constraints) + [
         Or(
             to < symbol_factory.BitVecVal(1, 256),
             to > symbol_factory.BitVecVal(PRECOMPILE_COUNT, 256),
         )
     ]
     try:
-        solver.get_model(constraints)
+        solver.get_model(outside_precompiles)
         return False
     except UnsatError:
         return True
 
 
-class ExternalCalls(DetectionModule):
+class ExternalCalls(DeferredDetector):
     """Searches for low-level calls that forward all gas to an
     attacker-controlled callee."""
 
@@ -52,54 +59,40 @@ class ExternalCalls(DetectionModule):
         "Search for external calls with unrestricted gas to a"
         " user-specified address."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
+    dedupe = False  # the reference re-analyzes every hit
 
-    def _execute(self, state: GlobalState) -> None:
-        potential_issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(potential_issues)
+    def _analyze_state(self, state: GlobalState) -> list:
+        gas, target = state.mstate.stack[-1], state.mstate.stack[-2]
 
-    def _analyze_state(self, state: GlobalState):
-        gas = state.mstate.stack[-1]
-        to = state.mstate.stack[-2]
-        address = state.get_current_instruction()["address"]
-
+        attack_property = Constraints(
+            [
+                UGT(gas, symbol_factory.BitVecVal(2300, 256)),
+                target == ACTORS.attacker,
+            ]
+        )
         try:
-            constraints = Constraints(
-                [UGT(gas, symbol_factory.BitVecVal(2300, 256)), to == ACTORS.attacker]
-            )
             solver.get_transaction_sequence(
-                state, constraints + state.world_state.constraints
-            )
-
-            description_head = "A call to a user-supplied address is executed."
-            description_tail = (
-                "An external message call to an address specified by the caller is executed. Note that "
-                "the callee account might contain arbitrary code and could re-enter any function "
-                "within this contract. Reentering the contract in an intermediate state may lead to "
-                "unexpected behaviour. Make sure that no state modifications "
-                "are executed after this call and/or reentrancy guards are in place."
-            )
-
-            issue = PotentialIssue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=address,
-                swc_id=REENTRANCY,
-                title="External Call To User-Supplied Address",
-                bytecode=state.environment.code.bytecode,
-                severity="Low",
-                description_head=description_head,
-                description_tail=description_tail,
-                constraints=constraints,
-                detector=self,
+                state, attack_property + state.world_state.constraints
             )
         except UnsatError:
             log.debug("[EXTERNAL_CALLS] No model found.")
             return []
 
-        return [issue]
+        return [
+            PotentialIssue(
+                swc_id=REENTRANCY,
+                title="External Call To User-Supplied Address",
+                severity="Low",
+                description_head=(
+                    "A call to a user-supplied address is executed."
+                ),
+                description_tail=REMEDIATION,
+                constraints=attack_property,
+                detector=self,
+                **found_at(state),
+            )
+        ]
 
 
 detector = ExternalCalls()

@@ -1,11 +1,11 @@
 """SWC-101: integer overflow / underflow.
 
-Reference parity: mythril/analysis/module/modules/integer.py:64-348 —
-arithmetic pre-hooks annotate results with the negation of the
-BV*NoOverflow predicate; use-site hooks (SSTORE/JUMPI/CALL/RETURN)
-propagate annotations into a state annotation; at transaction end each
-collected overflow condition is solved against the full path, with a
-satisfiability cache over overflowing states.
+Covers mythril/analysis/module/modules/integer.py. Arithmetic
+pre-hooks annotate the result with the negated no-overflow predicate;
+use-site hooks (SSTORE/JUMPI/CALL/RETURN) promote those taints into a
+state annotation ("the wrapped value was actually used"); at
+transaction end every collected wrap condition is solved against the
+full path, with a satisfiability cache keyed on the overflowing state.
 """
 
 from __future__ import annotations
@@ -13,13 +13,18 @@ from __future__ import annotations
 import logging
 from copy import copy
 from math import ceil, log2
-from typing import List, Set, cast
+from typing import Callable, Dict, List, Set
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.dsl import (
+    DetectionModule,
+    EntryPoint,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.swc_data import INTEGER_OVERFLOW_AND_UNDERFLOW
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.smt import (
@@ -37,6 +42,15 @@ from mythril_tpu.laser.smt import (
 
 log = logging.getLogger(__name__)
 
+REMEDIATION = (
+    "It is possible to cause an integer overflow or underflow in the"
+    " arithmetic operation. Prevent this by constraining inputs using"
+    " the require() statement or use the OpenZeppelin SafeMath"
+    " library for integer arithmetic operations. Refer to the"
+    " transaction trace generated for this issue to reproduce the"
+    " issue."
+)
+
 
 class OverUnderflowAnnotation:
     """Symbol annotation: this value may have wrapped around."""
@@ -53,17 +67,49 @@ class OverUnderflowAnnotation:
 
 
 class OverUnderflowStateAnnotation(StateAnnotation):
-    """State annotation: overflows both possible and used on this path."""
+    """State annotation: wraps both possible and used on this path."""
 
     def __init__(self) -> None:
         self.overflowing_state_annotations: Set[OverUnderflowAnnotation] = set()
 
     def __copy__(self):
-        new_annotation = OverUnderflowStateAnnotation()
-        new_annotation.overflowing_state_annotations = copy(
+        twin = OverUnderflowStateAnnotation()
+        twin.overflowing_state_annotations = copy(
             self.overflowing_state_annotations
         )
-        return new_annotation
+        return twin
+
+
+def _flow_annotation(state: GlobalState) -> OverUnderflowStateAnnotation:
+    existing = next(
+        iter(state.get_annotations(OverUnderflowStateAnnotation)), None
+    )
+    if existing is not None:
+        return existing
+    fresh = OverUnderflowStateAnnotation()
+    state.annotate(fresh)
+    return fresh
+
+
+def _word_at(stack, index) -> BitVec:
+    """stack[index] as a BitVec, converting in place if needed."""
+    value = stack[index]
+    if isinstance(value, BitVec):
+        return value
+    if isinstance(value, Bool):
+        return If(value, 1, 0)
+    stack[index] = symbol_factory.BitVecVal(value, 256)
+    return stack[index]
+
+
+def _promote_taints(state: GlobalState, value) -> None:
+    """Move wrap taints from a used value onto the state."""
+    if not isinstance(value, Expression):
+        return
+    flow = _flow_annotation(state)
+    for taint in value.annotations:
+        if isinstance(taint, OverUnderflowAnnotation):
+            flow.overflowing_state_annotations.add(taint)
 
 
 class IntegerArithmetics(DetectionModule):
@@ -89,152 +135,111 @@ class IntegerArithmetics(DetectionModule):
         "CALL",
     ]
 
+    #: wrap predicates per arithmetic opcode
+    WRAP_RULES = {
+        "ADD": ("addition", lambda a, b: Not(BVAddNoOverflow(a, b, False))),
+        "MUL": ("multiplication", lambda a, b: Not(BVMulNoOverflow(a, b, False))),
+        "SUB": ("subtraction", lambda a, b: Not(BVSubNoUnderflow(a, b, False))),
+    }
+
     def __init__(self) -> None:
         super().__init__()
-        self._ostates_satisfiable: Set[GlobalState] = set()
-        self._ostates_unsatisfiable: Set[GlobalState] = set()
+        self._known_sat: Set[GlobalState] = set()
+        self._known_unsat: Set[GlobalState] = set()
 
     def reset_module(self):
         super().reset_module()
-        self._ostates_satisfiable = set()
-        self._ostates_unsatisfiable = set()
+        self._known_sat = set()
+        self._known_unsat = set()
 
+    # -- dispatch ------------------------------------------------------
     def _execute(self, state: GlobalState) -> None:
-        address = _get_address_from_state(state)
-        if address in self.cache:
+        if state.get_current_instruction()["address"] in self.cache:
             return
-
         opcode = state.get_current_instruction()["opcode"]
-        funcs = {
-            "ADD": [self._handle_add],
-            "SUB": [self._handle_sub],
-            "MUL": [self._handle_mul],
-            "SSTORE": [self._handle_sstore],
-            "JUMPI": [self._handle_jumpi],
-            "CALL": [self._handle_call],
-            "RETURN": [self._handle_return, self._handle_transaction_end],
-            "STOP": [self._handle_transaction_end],
-            "EXP": [self._handle_exp],
+        routes: Dict[str, List[Callable]] = {
+            "ADD": [self._taint_arith],
+            "SUB": [self._taint_arith],
+            "MUL": [self._taint_arith],
+            "EXP": [self._taint_exp],
+            "SSTORE": [self._use_sstore],
+            "JUMPI": [self._use_jumpi],
+            "CALL": [self._use_call],
+            "RETURN": [self._use_return, self._finalize],
+            "STOP": [self._finalize],
         }
-        for func in funcs[opcode]:
-            func(state)
+        for step in routes[opcode]:
+            step(state)
 
-    def _get_args(self, state):
+    # -- taint producers -----------------------------------------------
+    def _taint_arith(self, state: GlobalState) -> None:
+        opcode = state.get_current_instruction()["opcode"]
+        operator, predicate = self.WRAP_RULES[opcode]
         stack = state.mstate.stack
-        op0, op1 = (
-            self._make_bitvec_if_not(stack, -1),
-            self._make_bitvec_if_not(stack, -2),
-        )
-        return op0, op1
+        a, b = _word_at(stack, -1), _word_at(stack, -2)
+        a.annotate(OverUnderflowAnnotation(state, operator, predicate(a, b)))
 
-    def _handle_add(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVAddNoOverflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "addition", c))
-
-    def _handle_mul(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVMulNoOverflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "multiplication", c))
-
-    def _handle_sub(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVSubNoUnderflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "subtraction", c))
-
-    def _handle_exp(self, state):
-        op0, op1 = self._get_args(state)
-        if op0.symbolic and op1.symbolic:
-            constraint = And(
-                op1 > symbol_factory.BitVecVal(256, 256),
-                op0 > symbol_factory.BitVecVal(1, 256),
+    def _taint_exp(self, state: GlobalState) -> None:
+        stack = state.mstate.stack
+        base, power = _word_at(stack, -1), _word_at(stack, -2)
+        if base.symbolic and power.symbolic:
+            wraps = And(
+                power > symbol_factory.BitVecVal(256, 256),
+                base > symbol_factory.BitVecVal(1, 256),
             )
-        elif op1.symbolic:
-            if op0.value < 2:
+        elif power.symbolic:
+            if base.value < 2:
                 return
-            constraint = op1 >= symbol_factory.BitVecVal(
-                ceil(256 / log2(op0.value)), 256
+            wraps = power >= symbol_factory.BitVecVal(
+                ceil(256 / log2(base.value)), 256
             )
-        elif op0.symbolic:
-            if op1.value == 0:
+        elif base.symbolic:
+            if power.value == 0:
                 return
-            constraint = op0 >= symbol_factory.BitVecVal(
-                2 ** ceil(256 / op1.value), 256
+            wraps = base >= symbol_factory.BitVecVal(
+                2 ** ceil(256 / power.value), 256
             )
         else:
-            constraint = op0.value**op1.value >= 2**256
-        annotation = OverUnderflowAnnotation(state, "exponentiation", constraint)
-        op0.annotate(annotation)
+            wraps = base.value**power.value >= 2**256
+        base.annotate(OverUnderflowAnnotation(state, "exponentiation", wraps))
+
+    # -- taint consumers -----------------------------------------------
+    @staticmethod
+    def _use_sstore(state: GlobalState) -> None:
+        _promote_taints(state, state.mstate.stack[-2])
 
     @staticmethod
-    def _make_bitvec_if_not(stack, index):
-        value = stack[index]
-        if isinstance(value, BitVec):
-            return value
-        if isinstance(value, Bool):
-            return If(value, 1, 0)
-        stack[index] = symbol_factory.BitVecVal(value, 256)
-        return stack[index]
+    def _use_jumpi(state: GlobalState) -> None:
+        _promote_taints(state, state.mstate.stack[-2])
 
     @staticmethod
-    def _handle_sstore(state: GlobalState) -> None:
-        stack = state.mstate.stack
-        value = stack[-2]
-        if not isinstance(value, Expression):
-            return
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
+    def _use_call(state: GlobalState) -> None:
+        _promote_taints(state, state.mstate.stack[-3])
 
     @staticmethod
-    def _handle_jumpi(state):
-        stack = state.mstate.stack
-        value = stack[-2]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
-
-    @staticmethod
-    def _handle_call(state):
-        stack = state.mstate.stack
-        value = stack[-3]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for annotation in value.annotations:
-            if isinstance(annotation, OverUnderflowAnnotation):
-                state_annotation.overflowing_state_annotations.add(annotation)
-
-    @staticmethod
-    def _handle_return(state: GlobalState) -> None:
-        """Propagate annotations reachable through the returned memory."""
+    def _use_return(state: GlobalState) -> None:
+        """Taints reachable through the returned memory window."""
         stack = state.mstate.stack
         offset, length = stack[-1], stack[-2]
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-        for element in state.mstate.memory[offset : offset + length]:
-            if not isinstance(element, Expression):
+        for cell in state.mstate.memory[offset : offset + length]:
+            _promote_taints(state, cell)
+
+    # -- transaction end -----------------------------------------------
+    def _finalize(self, state: GlobalState) -> None:
+        for taint in _flow_annotation(state).overflowing_state_annotations:
+            origin = taint.overflowing_state
+
+            if origin in self._known_unsat:
                 continue
-            for annotation in element.annotations:
-                if isinstance(annotation, OverUnderflowAnnotation):
-                    state_annotation.overflowing_state_annotations.add(annotation)
-
-    def _handle_transaction_end(self, state: GlobalState) -> None:
-        state_annotation = _get_overflowunderflow_state_annotation(state)
-
-        for annotation in state_annotation.overflowing_state_annotations:
-            ostate = annotation.overflowing_state
-
-            if ostate in self._ostates_unsatisfiable:
-                continue
-            if ostate not in self._ostates_satisfiable:
+            if origin not in self._known_sat:
+                # cheap pre-check against the origin state's own path
                 try:
-                    constraints = ostate.world_state.constraints + [
-                        annotation.constraint
-                    ]
-                    solver.get_model(constraints)
-                    self._ostates_satisfiable.add(ostate)
+                    solver.get_model(
+                        origin.world_state.constraints + [taint.constraint]
+                    )
+                    self._known_sat.add(origin)
                 except Exception:
-                    self._ostates_unsatisfiable.add(ostate)
+                    self._known_unsat.add(origin)
                     continue
 
             log.debug(
@@ -242,63 +247,32 @@ class IntegerArithmetics(DetectionModule):
                 "ostate address %s",
                 state.get_current_instruction()["opcode"],
                 state.get_current_instruction()["address"],
-                ostate.get_current_instruction()["address"],
+                origin.get_current_instruction()["address"],
             )
 
             try:
-                constraints = state.world_state.constraints + [annotation.constraint]
-                transaction_sequence = solver.get_transaction_sequence(
-                    state, constraints
+                witness = solver.get_transaction_sequence(
+                    state, state.world_state.constraints + [taint.constraint]
                 )
             except UnsatError:
                 continue
 
-            description_head = "The arithmetic operator can {}.".format(
-                "underflow" if annotation.operator == "subtraction" else "overflow"
-            )
-            description_tail = (
-                "It is possible to cause an integer overflow or underflow in the"
-                " arithmetic operation. Prevent this by constraining inputs using"
-                " the require() statement or use the OpenZeppelin SafeMath"
-                " library for integer arithmetic operations. Refer to the"
-                " transaction trace generated for this issue to reproduce the"
-                " issue."
-            )
-
             issue = Issue(
-                contract=ostate.environment.active_account.contract_name,
-                function_name=ostate.environment.active_function_name,
-                address=ostate.get_current_instruction()["address"],
                 swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
-                bytecode=ostate.environment.code.bytecode,
                 title="Integer Arithmetic Bugs",
                 severity="High",
-                description_head=description_head,
-                description_tail=description_tail,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                transaction_sequence=transaction_sequence,
+                description_head="The arithmetic operator can {}.".format(
+                    "underflow"
+                    if taint.operator == "subtraction"
+                    else "overflow"
+                ),
+                description_tail=REMEDIATION,
+                gas_used=gas_range(state),
+                transaction_sequence=witness,
+                **found_at(origin),
             )
-            address = _get_address_from_state(ostate)
-            self.cache.add(address)
+            self.cache.add(origin.get_current_instruction()["address"])
             self.issues.append(issue)
 
 
 detector = IntegerArithmetics()
-
-
-def _get_address_from_state(state):
-    return state.get_current_instruction()["address"]
-
-
-def _get_overflowunderflow_state_annotation(
-    state: GlobalState,
-) -> OverUnderflowStateAnnotation:
-    state_annotations = cast(
-        List[OverUnderflowStateAnnotation],
-        list(state.get_annotations(OverUnderflowStateAnnotation)),
-    )
-    if len(state_annotations) == 0:
-        state_annotation = OverUnderflowStateAnnotation()
-        state.annotate(state_annotation)
-        return state_annotation
-    return state_annotations[0]

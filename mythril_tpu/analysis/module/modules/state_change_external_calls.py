@@ -1,23 +1,26 @@
 """SWC-107: state access after an external call (reentrancy pattern).
 
-Reference parity: mythril/analysis/module/modules/
-state_change_external_calls.py:103-203.
+Covers mythril/analysis/module/modules/state_change_external_calls.py.
+A gas-forwarding external call annotates the path; any later storage
+access (or value-bearing call) on that path becomes a potential issue
+validated at transaction end.
 """
 
 from __future__ import annotations
 
 import logging
 from copy import copy
-from typing import List, Optional, cast
+from typing import List, Optional
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
+from mythril_tpu.analysis.module.dsl import (
+    DeferredDetector,
+    DetectionModule,
     PotentialIssue,
-    get_potential_issues_annotation,
+    UnsatError,
+    found_at,
 )
 from mythril_tpu.analysis.swc_data import REENTRANCY
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.constraints import Constraints
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
@@ -25,83 +28,92 @@ from mythril_tpu.laser.smt import BitVec, Or, UGT, symbol_factory
 
 log = logging.getLogger(__name__)
 
-CALL_LIST = ["CALL", "DELEGATECALL", "CALLCODE"]
-STATE_READ_WRITE_LIST = ["SSTORE", "SLOAD", "CREATE", "CREATE2"]
+CALL_OPS = ("CALL", "DELEGATECALL", "CALLCODE")
+STATE_OPS = ("SSTORE", "SLOAD", "CREATE", "CREATE2")
+
+ATTACKER_ADDRESS = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 
 
-class StateChangeCallsAnnotation(StateAnnotation):
-    def __init__(self, call_state: GlobalState, user_defined_address: bool) -> None:
-        self.call_state = call_state
-        self.state_change_states: List[GlobalState] = []
-        self.user_defined_address = user_defined_address
-
-    def __copy__(self):
-        new_annotation = StateChangeCallsAnnotation(
-            self.call_state, self.user_defined_address
-        )
-        new_annotation.state_change_states = self.state_change_states[:]
-        return new_annotation
-
-    def get_issue(
-        self, global_state: GlobalState, detector: DetectionModule
-    ) -> Optional[PotentialIssue]:
-        if not self.state_change_states:
-            return None
-        constraints = Constraints()
-        gas = self.call_state.mstate.stack[-1]
-        to = self.call_state.mstate.stack[-2]
-        constraints += [
+def _forwarding_call_constraints(call_state: GlobalState) -> Constraints:
+    """The call forwards real gas to a non-precompile callee."""
+    gas = call_state.mstate.stack[-1]
+    to = call_state.mstate.stack[-2]
+    return Constraints(
+        [
             UGT(gas, symbol_factory.BitVecVal(2300, 256)),
             Or(
                 to > symbol_factory.BitVecVal(16, 256),
                 to == symbol_factory.BitVecVal(0, 256),
             ),
         ]
+    )
+
+
+class StateChangeCallsAnnotation(StateAnnotation):
+    """Marks a path that performed a gas-forwarding external call."""
+
+    def __init__(self, call_state: GlobalState, user_defined_address: bool):
+        self.call_state = call_state
+        self.state_change_states: List[GlobalState] = []
+        self.user_defined_address = user_defined_address
+
+    def __copy__(self):
+        twin = StateChangeCallsAnnotation(
+            self.call_state, self.user_defined_address
+        )
+        twin.state_change_states = self.state_change_states[:]
+        return twin
+
+    def get_issue(
+        self, global_state: GlobalState, detector: DetectionModule
+    ) -> Optional[PotentialIssue]:
+        if not self.state_change_states:
+            return None
+
+        call_constraints = _forwarding_call_constraints(self.call_state)
         if self.user_defined_address:
-            constraints += [to == 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF]
+            call_constraints += [
+                self.call_state.mstate.stack[-2] == ATTACKER_ADDRESS
+            ]
 
         try:
             solver.get_transaction_sequence(
-                global_state, constraints + global_state.world_state.constraints
+                global_state,
+                call_constraints + global_state.world_state.constraints,
             )
         except UnsatError:
             return None
 
-        severity = "Medium" if self.user_defined_address else "Low"
-        address = global_state.get_current_instruction()["address"]
-        log.debug("[EXTERNAL_CALLS] Detected state changes at address: %s", address)
-        read_or_write = "Write to"
-        if global_state.get_current_instruction()["opcode"] == "SLOAD":
-            read_or_write = "Read of"
-        address_type = "user defined" if self.user_defined_address else "fixed"
-        description_head = "{} persistent state following external call".format(
-            read_or_write
+        here = global_state.get_current_instruction()
+        log.debug(
+            "[EXTERNAL_CALLS] Detected state changes at address: %s",
+            here["address"],
         )
-        description_tail = (
-            "The contract account state is accessed after an external call to a {} address. "
-            "To prevent reentrancy issues, consider accessing the state only before the call, especially if the "
-            "callee is untrusted. Alternatively, a reentrancy lock can be used to prevent "
-            "untrusted callees from re-entering the contract in an intermediate state.".format(
-                address_type
-            )
-        )
+        access_kind = "Read of" if here["opcode"] == "SLOAD" else "Write to"
+        address_kind = "user defined" if self.user_defined_address else "fixed"
 
         return PotentialIssue(
-            contract=global_state.environment.active_account.contract_name,
-            function_name=global_state.environment.active_function_name,
-            address=address,
             title="State access after external call",
-            severity=severity,
-            description_head=description_head,
-            description_tail=description_tail,
+            severity="Medium" if self.user_defined_address else "Low",
+            description_head=(
+                f"{access_kind} persistent state following external call"
+            ),
+            description_tail=(
+                "The contract account state is accessed after an external call to a {} address. "
+                "To prevent reentrancy issues, consider accessing the state only before the call, especially if the "
+                "callee is untrusted. Alternatively, a reentrancy lock can be used to prevent "
+                "untrusted callees from re-entering the contract in an intermediate state.".format(
+                    address_kind
+                )
+            ),
             swc_id=REENTRANCY,
-            bytecode=global_state.environment.code.bytecode,
-            constraints=constraints,
+            constraints=call_constraints,
             detector=detector,
+            **found_at(global_state),
         )
 
 
-class StateChangeAfterCall(DetectionModule):
+class StateChangeAfterCall(DeferredDetector):
     """Searches for state changes after gas-forwarding external calls."""
 
     name = "State change after an external call"
@@ -110,81 +122,57 @@ class StateChangeAfterCall(DetectionModule):
         "Check whether the account state is accessed after the execution"
         " of an external call"
     )
-    entry_point = EntryPoint.CALLBACK
-    pre_hooks = CALL_LIST + STATE_READ_WRITE_LIST
+    pre_hooks = list(CALL_OPS + STATE_OPS)
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        annotation = get_potential_issues_annotation(state)
-        annotation.potential_issues.extend(issues)
+    def _analyze_state(self, state: GlobalState) -> List[PotentialIssue]:
+        open_calls = list(state.get_annotations(StateChangeCallsAnnotation))
+        opcode = state.get_current_instruction()["opcode"]
 
-    @staticmethod
-    def _add_external_call(global_state: GlobalState) -> None:
-        gas = global_state.mstate.stack[-1]
-        to = global_state.mstate.stack[-2]
-        try:
-            constraints = copy(global_state.world_state.constraints)
-            solver.get_model(
-                constraints
-                + [
-                    UGT(gas, symbol_factory.BitVecVal(2300, 256)),
-                    Or(
-                        to > symbol_factory.BitVecVal(16, 256),
-                        to == symbol_factory.BitVecVal(0, 256),
-                    ),
-                ]
-            )
-            # can the callee address also be attacker-chosen?
-            try:
-                constraints += [to == 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF]
-                solver.get_model(constraints)
-                global_state.annotate(StateChangeCallsAnnotation(global_state, True))
-            except UnsatError:
-                global_state.annotate(StateChangeCallsAnnotation(global_state, False))
-        except UnsatError:
-            pass
-
-    def _analyze_state(self, global_state: GlobalState) -> List[PotentialIssue]:
-        annotations = cast(
-            List[StateChangeCallsAnnotation],
-            list(global_state.get_annotations(StateChangeCallsAnnotation)),
-        )
-        op_code = global_state.get_current_instruction()["opcode"]
-
-        if len(annotations) == 0 and op_code in STATE_READ_WRITE_LIST:
-            return []
-        if op_code in STATE_READ_WRITE_LIST:
-            for annotation in annotations:
-                annotation.state_change_states.append(global_state)
-
-        if op_code in CALL_LIST:
+        if opcode in STATE_OPS:
+            for call in open_calls:
+                call.state_change_states.append(state)
+        elif opcode in CALL_OPS:
             # a value-bearing call is itself a balance mutation
-            value: BitVec = global_state.mstate.stack[-3]
-            if StateChangeAfterCall._balance_change(value, global_state):
-                for annotation in annotations:
-                    annotation.state_change_states.append(global_state)
-            StateChangeAfterCall._add_external_call(global_state)
+            if self._value_may_flow(state.mstate.stack[-3], state):
+                for call in open_calls:
+                    call.state_change_states.append(state)
+            self._register_call(state)
 
-        vulnerabilities = []
-        for annotation in annotations:
-            if not annotation.state_change_states:
+        findings = []
+        for call in open_calls:
+            if not call.state_change_states:
                 continue
-            issue = annotation.get_issue(global_state, self)
+            issue = call.get_issue(state, self)
             if issue:
-                vulnerabilities.append(issue)
-        return vulnerabilities
+                findings.append(issue)
+        return findings
 
     @staticmethod
-    def _balance_change(value: BitVec, global_state: GlobalState) -> bool:
+    def _register_call(state: GlobalState) -> None:
+        """Annotate the path if this call forwards gas; classify the
+        callee address as attacker-choosable or fixed."""
+        base = copy(state.world_state.constraints)
+        try:
+            solver.get_model(base + _forwarding_call_constraints(state))
+        except UnsatError:
+            return
+        try:
+            solver.get_model(
+                base + [state.mstate.stack[-2] == ATTACKER_ADDRESS]
+            )
+            state.annotate(StateChangeCallsAnnotation(state, True))
+        except UnsatError:
+            state.annotate(StateChangeCallsAnnotation(state, False))
+
+    @staticmethod
+    def _value_may_flow(value: BitVec, state: GlobalState) -> bool:
         if not value.symbolic:
             assert value.value is not None
             return value.value > 0
-        constraints = copy(global_state.world_state.constraints)
         try:
             solver.get_model(
-                constraints + [value > symbol_factory.BitVecVal(0, 256)]
+                copy(state.world_state.constraints)
+                + [value > symbol_factory.BitVecVal(0, 256)]
             )
             return True
         except UnsatError:

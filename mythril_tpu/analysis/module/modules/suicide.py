@@ -1,8 +1,8 @@
 """SWC-106: anyone can SELFDESTRUCT the contract.
 
-Reference parity: mythril/analysis/module/modules/suicide.py:23-121 —
-first tries the stronger property (beneficiary == attacker); when that
-is unsat, reports the weaker killable-by-anyone variant.
+Covers mythril/analysis/module/modules/suicide.py — tries the
+stronger property first (balance flows to the attacker); falls back to
+the weaker killable-by-anyone variant when that is unsat.
 """
 
 from __future__ import annotations
@@ -10,21 +10,34 @@ from __future__ import annotations
 import logging
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
-from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
-from mythril_tpu.exceptions import UnsatError
-from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
-from mythril_tpu.laser.ethereum.transaction.transaction_models import (
-    ContractCreationTransaction,
+from mythril_tpu.analysis.module.dsl import (
+    ACTORS,
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    attacker_transactions,
+    found_at,
+    gas_range,
 )
-from mythril_tpu.laser.smt.bool import And
+from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 
 log = logging.getLogger(__name__)
 
+TAIL_WITH_THEFT = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+    "contract account and withdraw its balance to an arbitrary address. Review the transaction trace "
+    "generated for this issue and make sure that appropriate security controls are in place to prevent "
+    "unrestricted access."
+)
+TAIL_KILL_ONLY = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
+    "contract account. Review the transaction trace generated for this issue and make sure that "
+    "appropriate security controls are in place to prevent unrestricted access."
+)
 
-class AccidentallyKillable(DetectionModule):
+
+class AccidentallyKillable(ImmediateDetector):
     """Checks if the contract can be 'accidentally' killed by anyone."""
 
     name = "Contract can be accidentally killed by anyone"
@@ -34,78 +47,47 @@ class AccidentallyKillable(DetectionModule):
         " kill-able contracts, also check whether it is possible to direct"
         " the contract balance to the attacker."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SUICIDE"]
 
     def __init__(self):
         super().__init__()
         self._cache_address = {}
 
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
-
-    @staticmethod
-    def _analyze_state(state):
-        log.debug("SUICIDE in function %s", state.environment.active_function_name)
-        instruction = state.get_current_instruction()
-        to = state.mstate.stack[-1]
-
-        description_head = "Any sender can cause the contract to self-destruct."
-
-        constraints = []
-        for tx in state.world_state.transaction_sequence:
-            if not isinstance(tx, ContractCreationTransaction):
-                constraints.append(
-                    And(tx.caller == ACTORS.attacker, tx.caller == tx.origin)
-                )
+    def _analyze_state(self, state: GlobalState) -> list:
+        log.debug(
+            "SUICIDE in function %s", state.environment.active_function_name
+        )
+        beneficiary = state.mstate.stack[-1]
+        attacker_only = attacker_transactions(state, tie_origin=True)
+        base = state.world_state.constraints + attacker_only
 
         try:
             try:
-                # strong variant: balance flows to the attacker
-                transaction_sequence = solver.get_transaction_sequence(
-                    state,
-                    state.world_state.constraints
-                    + constraints
-                    + [to == ACTORS.attacker],
+                witness = solver.get_transaction_sequence(
+                    state, base + [beneficiary == ACTORS.attacker]
                 )
-                description_tail = (
-                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
-                    "contract account and withdraw its balance to an arbitrary address. Review the transaction trace "
-                    "generated for this issue and make sure that appropriate security controls are in place to prevent "
-                    "unrestricted access."
-                )
+                tail = TAIL_WITH_THEFT
             except UnsatError:
-                transaction_sequence = solver.get_transaction_sequence(
-                    state, state.world_state.constraints + constraints
-                )
-                description_tail = (
-                    "Any sender can trigger execution of the SELFDESTRUCT instruction to destroy this "
-                    "contract account. Review the transaction trace generated for this issue and make sure that "
-                    "appropriate security controls are in place to prevent unrestricted access."
-                )
-
-            issue = Issue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=instruction["address"],
-                swc_id=UNPROTECTED_SELFDESTRUCT,
-                bytecode=state.environment.code.bytecode,
-                title="Unprotected Selfdestruct",
-                severity="High",
-                description_head=description_head,
-                description_tail=description_tail,
-                transaction_sequence=transaction_sequence,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            )
-            return [issue]
+                witness = solver.get_transaction_sequence(state, base)
+                tail = TAIL_KILL_ONLY
         except UnsatError:
             log.debug("No model found")
-        return []
+            return []
+
+        return [
+            Issue(
+                swc_id=UNPROTECTED_SELFDESTRUCT,
+                title="Unprotected Selfdestruct",
+                severity="High",
+                description_head=(
+                    "Any sender can cause the contract to self-destruct."
+                ),
+                description_tail=tail,
+                transaction_sequence=witness,
+                gas_used=gas_range(state),
+                **found_at(state),
+            )
+        ]
 
 
 detector = AccidentallyKillable()

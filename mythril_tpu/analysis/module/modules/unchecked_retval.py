@@ -1,26 +1,40 @@
 """SWC-104: return value of an external call is never checked.
 
-Reference parity: mythril/analysis/module/modules/unchecked_retval.py
-:31-131 — CALL-family post-hooks collect retval symbols; at STOP/RETURN
-a retval that can still be both 0 and 1 was never constrained.
+Covers mythril/analysis/module/modules/unchecked_retval.py —
+CALL-family post-hooks collect retval symbols; a retval that can still
+be both 0 and 1 when the transaction ends was never constrained.
 """
 
 from __future__ import annotations
 
 import logging
 from copy import copy
-from typing import List, Mapping, Union, cast
+from typing import List, Mapping, Union
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.dsl import (
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.swc_data import UNCHECKED_RET_VAL
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.smt.bitvec import BitVec
 
 log = logging.getLogger(__name__)
+
+CALL_OPS = ("CALL", "DELEGATECALL", "STATICCALL", "CALLCODE")
+
+REMEDIATION = (
+    "External calls return a boolean value. If the callee halts with an exception, 'false' is "
+    "returned and execution continues in the caller. "
+    "The caller should check whether an exception happened and react accordingly to avoid unexpected "
+    "behavior. For example it is often desirable to wrap external calls in require() so the "
+    "transaction is reverted if the call fails."
+)
 
 
 class UncheckedRetvalAnnotation(StateAnnotation):
@@ -28,12 +42,20 @@ class UncheckedRetvalAnnotation(StateAnnotation):
         self.retvals: List[Mapping[str, Union[int, BitVec]]] = []
 
     def __copy__(self):
-        result = UncheckedRetvalAnnotation()
-        result.retvals = copy(self.retvals)
-        return result
+        twin = UncheckedRetvalAnnotation()
+        twin.retvals = copy(self.retvals)
+        return twin
 
 
-class UncheckedRetval(DetectionModule):
+def _retval_log(state: GlobalState) -> list:
+    tracker = next(iter(state.get_annotations(UncheckedRetvalAnnotation)), None)
+    if tracker is None:
+        tracker = UncheckedRetvalAnnotation()
+        state.annotate(tracker)
+    return tracker.retvals
+
+
+class UncheckedRetval(ImmediateDetector):
     """Tests whether CALL return values are checked."""
 
     name = "Return value of an external call is not checked"
@@ -43,83 +65,57 @@ class UncheckedRetval(DetectionModule):
         "For direct calls, the Solidity compiler auto-generates this check."
         " For low-level-calls the check is omitted."
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["STOP", "RETURN"]
-    post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
-
-    def _execute(self, state: GlobalState) -> None:
-        if state.get_current_instruction()["address"] in self.cache:
-            return
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    post_hooks = list(CALL_OPS)
 
     def _analyze_state(self, state: GlobalState) -> list:
         instruction = state.get_current_instruction()
+        pending = _retval_log(state)
 
-        annotations = cast(
-            List[UncheckedRetvalAnnotation],
-            [a for a in state.get_annotations(UncheckedRetvalAnnotation)],
-        )
-        if len(annotations) == 0:
-            state.annotate(UncheckedRetvalAnnotation())
-            annotations = cast(
-                List[UncheckedRetvalAnnotation],
-                [a for a in state.get_annotations(UncheckedRetvalAnnotation)],
+        if instruction["opcode"] not in ("STOP", "RETURN"):
+            # CALL-family post-hook: remember the pushed retval symbol
+            log.debug("End of call, extracting retval")
+            prev_op = state.environment.code.instruction_list[
+                state.mstate.pc - 1
+            ]["opcode"]
+            assert prev_op in CALL_OPS
+            pending.append(
+                {
+                    "address": state.instruction["address"] - 1,
+                    "retval": state.mstate.stack[-1],
+                }
             )
-        retvals = annotations[0].retvals
+            return []
 
-        if instruction["opcode"] in ("STOP", "RETURN"):
-            issues = []
-            for retval in retvals:
-                try:
-                    # unconstrained = both outcomes still satisfiable
-                    solver.get_transaction_sequence(
-                        state, state.world_state.constraints + [retval["retval"] == 1]
-                    )
-                    transaction_sequence = solver.get_transaction_sequence(
-                        state, state.world_state.constraints + [retval["retval"] == 0]
-                    )
-                except UnsatError:
-                    continue
-
-                description_tail = (
-                    "External calls return a boolean value. If the callee halts with an exception, 'false' is "
-                    "returned and execution continues in the caller. "
-                    "The caller should check whether an exception happened and react accordingly to avoid unexpected "
-                    "behavior. For example it is often desirable to wrap external calls in require() so the "
-                    "transaction is reverted if the call fails."
+        found = []
+        for entry in pending:
+            try:
+                # unconstrained = both outcomes still satisfiable
+                solver.get_transaction_sequence(
+                    state,
+                    state.world_state.constraints + [entry["retval"] == 1],
                 )
-                issues.append(
-                    Issue(
-                        contract=state.environment.active_account.contract_name,
-                        function_name=state.environment.active_function_name,
-                        address=retval["address"],
-                        bytecode=state.environment.code.bytecode,
-                        title="Unchecked return value from external call.",
-                        swc_id=UNCHECKED_RET_VAL,
-                        severity="Medium",
-                        description_head="The return value of a message call is not checked.",
-                        description_tail=description_tail,
-                        gas_used=(
-                            state.mstate.min_gas_used,
-                            state.mstate.max_gas_used,
-                        ),
-                        transaction_sequence=transaction_sequence,
-                    )
+                witness = solver.get_transaction_sequence(
+                    state,
+                    state.world_state.constraints + [entry["retval"] == 0],
                 )
-            return issues
-
-        log.debug("End of call, extracting retval")
-        assert state.environment.code.instruction_list[state.mstate.pc - 1][
-            "opcode"
-        ] in ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
-        return_value = state.mstate.stack[-1]
-        retvals.append(
-            {"address": state.instruction["address"] - 1, "retval": return_value}
-        )
-        return []
+            except UnsatError:
+                continue
+            found.append(
+                Issue(
+                    title="Unchecked return value from external call.",
+                    swc_id=UNCHECKED_RET_VAL,
+                    severity="Medium",
+                    description_head=(
+                        "The return value of a message call is not checked."
+                    ),
+                    description_tail=REMEDIATION,
+                    gas_used=gas_range(state),
+                    transaction_sequence=witness,
+                    **found_at(state, address=entry["address"]),
+                )
+            )
+        return found
 
 
 detector = UncheckedRetval()

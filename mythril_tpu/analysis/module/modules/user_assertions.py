@@ -1,9 +1,9 @@
 """SWC-110: user-supplied assertion messages.
 
-Reference parity: mythril/analysis/module/modules/user_assertions.py
-:30-122 — watches for `emit AssertionFailed(string)` LOG1 topics and
-the MythX mstore marker pattern. The ABI string decode is done inline
-(the reference pulls in eth_abi for this one call).
+Covers mythril/analysis/module/modules/user_assertions.py — watches
+for `emit AssertionFailed(string)` LOG1 topics and the MythX mstore
+marker pattern. The ABI string decode is done inline (the reference
+pulls in eth_abi for this one call).
 """
 
 from __future__ import annotations
@@ -11,32 +11,35 @@ from __future__ import annotations
 import logging
 
 from mythril_tpu.analysis import solver
-from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.module.dsl import (
+    ImmediateDetector,
+    Issue,
+    UnsatError,
+    found_at,
+    gas_range,
+)
 from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.smt import Extract
 
 log = logging.getLogger(__name__)
 
-assertion_failed_hash = (
+ASSERTION_FAILED_TOPIC = (
     0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
 )
 
-mstore_pattern = "0xcafecafecafecafecafecafecafecafecafecafecafecafecafecafecafe"
+MSTORE_MARKER = "0xcafecafecafecafecafecafecafecafecafecafecafecafecafecafecafe"
 
 
-def _decode_abi_string(data: bytes) -> str:
-    """Decode a single ABI-encoded string payload (length word followed
-    by utf-8 bytes)."""
-    if len(data) < 32:
+def _read_abi_string(blob: bytes) -> str:
+    """Decode one ABI-encoded string payload (length word + utf-8)."""
+    if len(blob) < 32:
         raise ValueError("short ABI string")
-    length = int.from_bytes(data[:32], "big")
-    return data[32 : 32 + length].decode("utf8")
+    n = int.from_bytes(blob[:32], "big")
+    return blob[32 : 32 + n].decode("utf8")
 
 
-class UserAssertions(DetectionModule):
+class UserAssertions(ImmediateDetector):
     """Searches for user-supplied exceptions:
     emit AssertionFailed("Error")."""
 
@@ -46,73 +49,67 @@ class UserAssertions(DetectionModule):
         "Search for reachable user-supplied exceptions. Report a warning if"
         " a log message is emitted: 'emit AssertionFailed(string)'"
     )
-    entry_point = EntryPoint.CALLBACK
     pre_hooks = ["LOG1", "MSTORE"]
+    dedupe = False  # the reference analyzes every hit
 
-    def _execute(self, state: GlobalState) -> None:
-        issues = self._analyze_state(state)
-        for issue in issues:
-            self.cache.add(issue.address)
-        self.issues.extend(issues)
+    def _message_from(self, state: GlobalState):
+        """The assertion message carried by this LOG1/MSTORE, or None
+        when this instruction is not an assertion marker at all
+        (signalled by raising LookupError)."""
+        if state.get_current_instruction()["opcode"] == "MSTORE":
+            word = state.mstate.stack[-2]
+            if word.symbolic or MSTORE_MARKER not in hex(word.value)[:126]:
+                raise LookupError
+            return f"Failed property id {Extract(15, 0, word).value}"
 
-    def _analyze_state(self, state: GlobalState):
-        opcode = state.get_current_instruction()["opcode"]
-        message = None
-        if opcode == "MSTORE":
-            value = state.mstate.stack[-2]
-            if value.symbolic:
-                return []
-            if mstore_pattern not in hex(value.value)[:126]:
-                return []
-            message = "Failed property id {}".format(Extract(15, 0, value).value)
-        else:
-            topic, size, mem_start = state.mstate.stack[-3:]
-            if topic.symbolic or topic.value != assertion_failed_hash:
-                return []
-            if not mem_start.symbolic and not size.symbolic:
-                try:
-                    payload = bytes(
-                        b if isinstance(b, int) else (b.value or 0)
-                        for b in state.mstate.memory[
-                            mem_start.value + 32 : mem_start.value + size.value
-                        ]
-                    )
-                    message = _decode_abi_string(payload)
-                except Exception:
-                    pass
+        topic, size, start = state.mstate.stack[-3:]
+        if topic.symbolic or topic.value != ASSERTION_FAILED_TOPIC:
+            raise LookupError
+        if start.symbolic or size.symbolic:
+            return None
+        try:
+            blob = bytes(
+                b if isinstance(b, int) else (b.value or 0)
+                for b in state.mstate.memory[
+                    start.value + 32 : start.value + size.value
+                ]
+            )
+            return _read_abi_string(blob)
+        except Exception:
+            return None
+
+    def _analyze_state(self, state: GlobalState) -> list:
+        try:
+            message = self._message_from(state)
+        except LookupError:
+            return []
 
         try:
-            transaction_sequence = solver.get_transaction_sequence(
+            witness = solver.get_transaction_sequence(
                 state, state.world_state.constraints
             )
-            if message:
-                description_tail = (
-                    "A user-provided assertion failed with the message '{}'".format(
-                        message
-                    )
-                )
-            else:
-                description_tail = "A user-provided assertion failed."
-            log.debug("User assertion emitted: %s", description_tail)
+        except UnsatError:
+            log.debug("no model found")
+            return []
 
-            address = state.get_current_instruction()["address"]
-            issue = Issue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.environment.active_function_name,
-                address=address,
+        if message:
+            tail = f"A user-provided assertion failed with the message '{message}'"
+        else:
+            tail = "A user-provided assertion failed."
+        log.debug("User assertion emitted: %s", tail)
+
+        return [
+            Issue(
                 swc_id=ASSERT_VIOLATION,
                 title="Exception State",
                 severity="Medium",
                 description_head="A user-provided assertion failed.",
-                description_tail=description_tail,
-                bytecode=state.environment.code.bytecode,
-                transaction_sequence=transaction_sequence,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                description_tail=tail,
+                transaction_sequence=witness,
+                gas_used=gas_range(state),
+                **found_at(state),
             )
-            return [issue]
-        except UnsatError:
-            log.debug("no model found")
-        return []
+        ]
 
 
 detector = UserAssertions()

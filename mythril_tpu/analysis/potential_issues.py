@@ -1,11 +1,11 @@
 """Two-phase issue flow.
 
-Reference parity: mythril/analysis/potential_issues.py:8-108 —
-detection modules pre-solve only their cheap local property and attach
-a `PotentialIssue` to the state; at transaction end
-`check_potential_issues` (called from the engine) solves the full
-path + property constraints and, on sat, builds the concrete
-transaction sequence and promotes the potential issue to a real one.
+Covers mythril/analysis/potential_issues.py. Detection modules
+pre-solve only their cheap local property and park a `PotentialIssue`
+on the state; when the engine finishes a transaction it calls
+`check_potential_issues`, which solves the full path + property
+constraints and, on sat, concretizes the exploit transactions and
+promotes the finding onto its detector.
 """
 
 from __future__ import annotations
@@ -16,36 +16,40 @@ from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 
+#: the fields a PotentialIssue shares verbatim with the Issue it becomes
+_CARRIED_FIELDS = (
+    "title",
+    "contract",
+    "function_name",
+    "address",
+    "description_head",
+    "description_tail",
+    "severity",
+    "swc_id",
+    "bytecode",
+)
+
 
 class PotentialIssue:
-    """An issue whose cheap precondition was satisfiable; final
+    """A finding whose cheap precondition was satisfiable; full
     validation is deferred to transaction end."""
 
-    def __init__(
-        self,
-        contract,
-        function_name,
-        address,
-        swc_id,
-        title,
-        bytecode,
-        detector,
-        severity=None,
-        description_head="",
-        description_tail="",
-        constraints=None,
-    ):
-        self.title = title
-        self.contract = contract
-        self.function_name = function_name
-        self.address = address
-        self.description_head = description_head
-        self.description_tail = description_tail
-        self.severity = severity
-        self.swc_id = swc_id
-        self.bytecode = bytecode
-        self.constraints = constraints or []
+    def __init__(self, detector, constraints=None, **fields):
         self.detector = detector
+        self.constraints = constraints or []
+        for name in _CARRIED_FIELDS:
+            setattr(self, name, fields.pop(name, "" if "descr" in name else None))
+        if fields:
+            raise TypeError(f"unknown PotentialIssue fields: {sorted(fields)}")
+
+    def promote(self, state: GlobalState, transaction_sequence) -> Issue:
+        """The finished Issue, with gas bounds and the concrete
+        witness filled in from the validating state."""
+        return Issue(
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+            **{name: getattr(self, name) for name in _CARRIED_FIELDS},
+        )
 
 
 class PotentialIssuesAnnotation(StateAnnotation):
@@ -55,40 +59,25 @@ class PotentialIssuesAnnotation(StateAnnotation):
 
 def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnotation:
     """The state's potential-issues annotation (created on demand)."""
-    for annotation in state.annotations:
-        if isinstance(annotation, PotentialIssuesAnnotation):
-            return annotation
-    annotation = PotentialIssuesAnnotation()
-    state.annotate(annotation)
-    return annotation
+    existing = next(iter(state.get_annotations(PotentialIssuesAnnotation)), None)
+    if existing is not None:
+        return existing
+    fresh = PotentialIssuesAnnotation()
+    state.annotate(fresh)
+    return fresh
 
 
 def check_potential_issues(state: GlobalState) -> None:
-    """Validate each pending potential issue against the full path
-    constraints; sat -> concrete tx sequence -> Issue on the detector."""
-    annotation = get_potential_issues_annotation(state)
-    for potential_issue in annotation.potential_issues[:]:
+    """Validate every pending potential issue against the full path
+    constraints; sat findings move onto their detectors as Issues."""
+    pending = get_potential_issues_annotation(state)
+    for candidate in pending.potential_issues[:]:
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints + potential_issue.constraints
+            witness = get_transaction_sequence(
+                state, state.world_state.constraints + candidate.constraints
             )
         except UnsatError:
             continue
-
-        annotation.potential_issues.remove(potential_issue)
-        potential_issue.detector.cache.add(potential_issue.address)
-        potential_issue.detector.issues.append(
-            Issue(
-                contract=potential_issue.contract,
-                function_name=potential_issue.function_name,
-                address=potential_issue.address,
-                title=potential_issue.title,
-                bytecode=potential_issue.bytecode,
-                swc_id=potential_issue.swc_id,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                severity=potential_issue.severity,
-                description_head=potential_issue.description_head,
-                description_tail=potential_issue.description_tail,
-                transaction_sequence=transaction_sequence,
-            )
-        )
+        pending.potential_issues.remove(candidate)
+        candidate.detector.cache.add(candidate.address)
+        candidate.detector.issues.append(candidate.promote(state, witness))

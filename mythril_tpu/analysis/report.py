@@ -1,10 +1,10 @@
 """Issue and Report: the user-facing analysis output.
 
-Reference parity: mythril/analysis/report.py:21-321 — `Issue` carries
-SWC id, severity, descriptions, gas bounds and the concrete
-transaction sequence (source info attached later via `add_code_info`);
-`Report` renders text/markdown (jinja2 templates), json, and the SWC
-standard jsonv2 format.
+Covers mythril/analysis/report.py. An `Issue` is one finding at one
+program location (source info attached later through the contract's
+source maps); a `Report` collects deduplicated issues and renders
+them as text/markdown (jinja2 templates under analysis/templates/),
+plain json, or the SWC-standard jsonv2 format.
 """
 
 from __future__ import annotations
@@ -12,7 +12,6 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import operator
 from time import time
 from typing import Any, Dict, List, Optional
 
@@ -26,6 +25,17 @@ from mythril_tpu.support.start_time import StartTime
 from mythril_tpu.support.support_utils import get_code_hash
 
 log = logging.getLogger(__name__)
+
+#: fixed block context attached to jsonv2 test cases so they replay
+REPLAY_BLOCK_CONTEXT = {
+    "gasLimit": "0x7d000",
+    "gasPrice": "0x773594000",
+    "blockCoinbase": "0xcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcb",
+    "blockDifficulty": "0xa7d7343662e26",
+    "blockGasLimit": "0x7d0000",
+    "blockNumber": "0x66e393",
+    "blockTime": "0x5bfa4639",
+}
 
 
 class Issue:
@@ -45,112 +55,123 @@ class Issue:
         description_tail="",
         transaction_sequence=None,
     ):
-        self.title = title
         self.contract = contract
         self.function = function_name
         self.address = address
+        self.swc_id = swc_id
+        self.title = title
+        self.severity = severity
         self.description_head = description_head
         self.description_tail = description_tail
-        self.description = "%s\n%s" % (description_head, description_tail)
-        self.severity = severity
-        self.swc_id = swc_id
+        self.description = f"{description_head}\n{description_tail}"
         self.min_gas_used, self.max_gas_used = gas_used
+        self.transaction_sequence = transaction_sequence
+        self.bytecode_hash = get_code_hash(bytecode)
+        self.discovery_time = time() - StartTime().global_start_time
+        # source info, attached later by add_code_info
         self.filename = None
         self.code = None
         self.lineno = None
         self.source_mapping = None
-        self.discovery_time = time() - StartTime().global_start_time
-        self.bytecode_hash = get_code_hash(bytecode)
-        self.transaction_sequence = transaction_sequence
 
+    # -- views ---------------------------------------------------------
     @property
     def transaction_sequence_users(self):
         return self.transaction_sequence
 
     @property
     def transaction_sequence_jsonv2(self):
-        return (
-            self.add_block_data(self.transaction_sequence)
-            if self.transaction_sequence
-            else None
-        )
+        if not self.transaction_sequence:
+            return None
+        return self.add_block_data(self.transaction_sequence)
 
     @staticmethod
     def add_block_data(transaction_sequence: Dict) -> Dict:
         """Attach plausible block context so jsonv2 test cases replay."""
         for step in transaction_sequence["steps"]:
-            step["gasLimit"] = "0x7d000"
-            step["gasPrice"] = "0x773594000"
-            step["blockCoinbase"] = "0xcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcbcb"
-            step["blockDifficulty"] = "0xa7d7343662e26"
-            step["blockGasLimit"] = "0x7d0000"
-            step["blockNumber"] = "0x66e393"
-            step["blockTime"] = "0x5bfa4639"
+            step.update(REPLAY_BLOCK_CONTEXT)
         return transaction_sequence
 
     @property
     def as_dict(self):
-        issue = {
-            "title": self.title,
-            "swc-id": self.swc_id,
+        fields = {
+            "address": self.address,
             "contract": self.contract,
             "description": self.description,
             "function": self.function,
-            "severity": self.severity,
-            "address": self.address,
-            "tx_sequence": self.transaction_sequence,
-            "min_gas_used": self.min_gas_used,
             "max_gas_used": self.max_gas_used,
+            "min_gas_used": self.min_gas_used,
+            "severity": self.severity,
             "sourceMap": self.source_mapping,
+            "swc-id": self.swc_id,
+            "title": self.title,
+            "tx_sequence": self.transaction_sequence,
         }
         if self.filename and self.lineno:
-            issue["filename"] = self.filename
-            issue["lineno"] = self.lineno
+            fields["filename"] = self.filename
+            fields["lineno"] = self.lineno
         if self.code:
-            issue["code"] = self.code
-        return issue
+            fields["code"] = self.code
+        return fields
 
-    def _set_internal_compiler_error(self):
+    # -- enrichment ----------------------------------------------------
+    def add_code_info(self, contract) -> None:
+        """Attach file/line/code via the contract's source maps."""
+        if not (self.address and hasattr(contract, "get_source_info")):
+            self.source_mapping = self.address
+            return
+        info = contract.get_source_info(
+            self.address, constructor=(self.function == "constructor")
+        )
+        if info is None:
+            self.source_mapping = self.address
+            return
+        self.filename = info.filename
+        self.code = info.code
+        self.lineno = info.lineno
+        if self.lineno is None:
+            self._mark_compiler_generated()
+        self.source_mapping = info.solc_mapping
+
+    def _mark_compiler_generated(self):
         self.severity = "Low"
         self.description_tail += (
             " This issue is reported for internal compiler generated code."
         )
-        self.description = "%s\n%s" % (self.description_head, self.description_tail)
+        self.description = f"{self.description_head}\n{self.description_tail}"
         self.code = ""
-
-    def add_code_info(self, contract) -> None:
-        """Attach file/line/code via the contract's source maps."""
-        if self.address and hasattr(contract, "get_source_info"):
-            codeinfo = contract.get_source_info(
-                self.address, constructor=(self.function == "constructor")
-            )
-            if codeinfo is None:
-                self.source_mapping = self.address
-                return
-            self.filename = codeinfo.filename
-            self.code = codeinfo.code
-            self.lineno = codeinfo.lineno
-            if self.lineno is None:
-                self._set_internal_compiler_error()
-            self.source_mapping = codeinfo.solc_mapping
-        else:
-            self.source_mapping = self.address
 
     def resolve_function_names(self) -> None:
         """Best-effort function names for each tx step via SignatureDB."""
-        if (
-            self.transaction_sequence is None
-            or "steps" not in self.transaction_sequence
-        ):
+        steps = (self.transaction_sequence or {}).get("steps")
+        if steps is None:
             return
-        signatures = SignatureDB()
-        for step in self.transaction_sequence["steps"]:
-            _hash = step["input"][:10]
+        db = SignatureDB()
+        for step in steps:
+            selector = step["input"][:10]
             try:
-                sig = signatures.get(_hash)
-                step["name"] = sig[0] if len(sig) > 0 else "unknown"
+                names = db.get(selector)
+                step["name"] = names[0] if names else "unknown"
             except ValueError:
                 step["name"] = "unknown"
+
+
+def _jsonv2_issue(issue: Issue, source_index: int) -> dict:
+    extra = {"discoveryTime": int(issue.discovery_time * 10**9)}
+    replay = issue.transaction_sequence_jsonv2
+    if replay:
+        extra["testCases"] = [replay]
+    return {
+        "swcID": "SWC-" + issue.swc_id,
+        "swcTitle": SWC_TO_TITLE.get(issue.swc_id, "Unspecified Security Issue"),
+        "description": {
+            "head": issue.description_head,
+            "tail": issue.description_tail,
+        },
+        "severity": issue.severity,
+        "locations": [{"sourceMap": "%d:1:%d" % (issue.address, source_index)}],
+        "extra": extra,
+    }
 
 
 class Report:
@@ -174,81 +195,66 @@ class Report:
         self.exceptions = exceptions or []
         self.execution_info = execution_info or []
 
-    def sorted_issues(self):
-        issue_list = [issue.as_dict for _, issue in self.issues.items()]
-        return sorted(issue_list, key=operator.itemgetter("address", "title"))
-
     def append_issue(self, issue: Issue) -> None:
-        m = hashlib.md5()
-        m.update((issue.contract + str(issue.address) + issue.title).encode("utf-8"))
+        fingerprint = hashlib.md5(
+            (issue.contract + str(issue.address) + issue.title).encode("utf-8")
+        )
         issue.resolve_function_names()
-        self.issues[m.digest()] = issue
+        self.issues[fingerprint.digest()] = issue
+
+    def sorted_issues(self):
+        rows = [issue.as_dict for issue in self.issues.values()]
+        return sorted(rows, key=lambda row: (row["address"], row["title"]))
+
+    # -- renderers -----------------------------------------------------
+    def _render_template(self, template_name: str) -> str:
+        template = Report.environment.get_template(template_name)
+        return template.render(
+            filename=self._file_name(), issues=self.sorted_issues()
+        )
 
     def as_text(self) -> str:
-        name = self._file_name()
-        template = Report.environment.get_template("report_as_text.jinja2")
-        return template.render(filename=name, issues=self.sorted_issues())
+        return self._render_template("report_as_text.jinja2")
+
+    def as_markdown(self) -> str:
+        return self._render_template("report_as_markdown.jinja2")
 
     def as_json(self) -> str:
-        result = {"success": True, "error": None, "issues": self.sorted_issues()}
-        return json.dumps(result, sort_keys=True)
-
-    def _get_exception_data(self) -> dict:
-        if not self.exceptions:
-            return {}
-        logs: List[Dict] = []
-        for exception in self.exceptions:
-            logs += [{"level": "error", "hidden": True, "msg": exception}]
-        return {"logs": logs}
+        return json.dumps(
+            {"success": True, "error": None, "issues": self.sorted_issues()},
+            sort_keys=True,
+        )
 
     def as_swc_standard_format(self) -> str:
         """The jsonv2 (SWC standard) output."""
-        _issues = []
-        for _, issue in self.issues.items():
-            idx = self.source.get_source_index(issue.bytecode_hash)
-            try:
-                title = SWC_TO_TITLE[issue.swc_id]
-            except KeyError:
-                title = "Unspecified Security Issue"
-            extra = {"discoveryTime": int(issue.discovery_time * 10**9)}
-            if issue.transaction_sequence_jsonv2:
-                extra["testCases"] = [issue.transaction_sequence_jsonv2]
-            _issues.append(
-                {
-                    "swcID": "SWC-" + issue.swc_id,
-                    "swcTitle": title,
-                    "description": {
-                        "head": issue.description_head,
-                        "tail": issue.description_tail,
-                    },
-                    "severity": issue.severity,
-                    "locations": [{"sourceMap": "%d:1:%d" % (issue.address, idx)}],
-                    "extra": extra,
-                }
-            )
+        rendered = [
+            _jsonv2_issue(issue, self.source.get_source_index(issue.bytecode_hash))
+            for issue in self.issues.values()
+        ]
 
         meta_data = self.meta
-        meta_data.update(self._get_exception_data())
+        if self.exceptions:
+            meta_data["logs"] = [
+                {"level": "error", "hidden": True, "msg": why}
+                for why in self.exceptions
+            ]
         meta_data["mythril_execution_info"] = {}
-        for execution_info in self.execution_info:
-            meta_data["mythril_execution_info"].update(execution_info.as_dict())
+        for info in self.execution_info:
+            meta_data["mythril_execution_info"].update(info.as_dict())
 
-        result = [
-            {
-                "issues": _issues,
-                "sourceType": self.source.source_type,
-                "sourceFormat": self.source.source_format,
-                "sourceList": self.source.source_list,
-                "meta": meta_data,
-            }
-        ]
-        return json.dumps(result, sort_keys=True)
-
-    def as_markdown(self) -> str:
-        filename = self._file_name()
-        template = Report.environment.get_template("report_as_markdown.jinja2")
-        return template.render(filename=filename, issues=self.sorted_issues())
+        return json.dumps(
+            [
+                {
+                    "issues": rendered,
+                    "sourceType": self.source.source_type,
+                    "sourceFormat": self.source.source_format,
+                    "sourceList": self.source.source_list,
+                    "meta": meta_data,
+                }
+            ],
+            sort_keys=True,
+        )
 
     def _file_name(self):
-        if len(self.issues.values()) > 0:
-            return list(self.issues.values())[0].filename
+        for issue in self.issues.values():
+            return issue.filename

@@ -1,15 +1,15 @@
-"""SymExecWrapper: configure and run LASER for analysis.
+"""SymExecWrapper: configure and run LASER for one analysis.
 
-Reference parity: mythril/analysis/symbolic.py:39-307 — strategy
-selection, bounded-loops extension, plugin loading, creator/attacker
-accounts, detection-module hook registration, `sym_exec`, and the
-post-run extraction of `Call` records for POST modules.
+Covers mythril/analysis/symbolic.py — strategy selection, the
+bounded-loops extension, plugin loading, actor accounts, detection-
+module hook registration, running `sym_exec`, and pre-digesting the
+statespace's CALL operations for POST modules.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import List, Optional, Type, Union
+from typing import List, Optional, Union
 
 from mythril_tpu.analysis.module import (
     EntryPoint,
@@ -22,7 +22,6 @@ from mythril_tpu.laser.ethereum.natives import PRECOMPILE_COUNT
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.world_state import WorldState
 from mythril_tpu.laser.ethereum.strategy.basic import (
-    BasicSearchStrategy,
     BreadthFirstSearchStrategy,
     DepthFirstSearchStrategy,
     ReturnRandomNaivelyStrategy,
@@ -45,6 +44,23 @@ from mythril_tpu.laser.smt import BitVec, symbol_factory
 from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
+
+STRATEGIES = {
+    "dfs": DepthFirstSearchStrategy,
+    "bfs": BreadthFirstSearchStrategy,
+    "naive-random": ReturnRandomNaivelyStrategy,
+    "weighted-random": ReturnWeightedRandomStrategy,
+}
+
+CALL_OPS = ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL")
+
+
+def _as_address_term(address: Union[int, str, BitVec]) -> BitVec:
+    if isinstance(address, str):
+        address = int(address, 16)
+    if isinstance(address, int):
+        address = symbol_factory.BitVecVal(address, 256)
+    return address
 
 
 class SymExecWrapper:
@@ -74,187 +90,155 @@ class SymExecWrapper:
 
         reset_blast_session()
 
-        if isinstance(address, str):
-            address = symbol_factory.BitVecVal(int(address, 16), 256)
-        if isinstance(address, int):
-            address = symbol_factory.BitVecVal(address, 256)
-
-        if strategy == "dfs":
-            s_strategy: Type[BasicSearchStrategy] = DepthFirstSearchStrategy
-        elif strategy == "bfs":
-            s_strategy = BreadthFirstSearchStrategy
-        elif strategy == "naive-random":
-            s_strategy = ReturnRandomNaivelyStrategy
-        elif strategy == "weighted-random":
-            s_strategy = ReturnWeightedRandomStrategy
-        else:
+        if strategy not in STRATEGIES:
             raise ValueError("Invalid strategy argument supplied")
+        address = _as_address_term(address)
 
-        creator_account = Account(
-            hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
-        )
-        attacker_account = Account(
-            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
-        )
+        self.dynloader = dynloader
+        deploys = bool(getattr(contract, "creation_code", None))
 
         requires_statespace = (
             compulsory_statespace
             or len(ModuleLoader().get_detection_modules(EntryPoint.POST, modules)) > 0
         )
-        has_creation_code = bool(getattr(contract, "creation_code", None))
-        if not has_creation_code:
-            self.accounts = {hex(ACTORS.attacker.value): attacker_account}
-        else:
-            self.accounts = {
-                hex(ACTORS.creator.value): creator_account,
-                hex(ACTORS.attacker.value): attacker_account,
-            }
 
+        self.accounts = self._actor_accounts(include_creator=deploys)
         self.laser = svm.LaserEVM(
             dynamic_loader=dynloader,
             max_depth=max_depth,
             execution_timeout=execution_timeout,
-            strategy=s_strategy,
+            strategy=STRATEGIES[strategy],
             create_timeout=create_timeout,
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
         )
-
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
 
-        plugin_loader = LaserPluginLoader()
-        plugin_loader.load(CoveragePluginBuilder())
-        plugin_loader.load(MutationPrunerBuilder())
-        plugin_loader.load(CallDepthLimitBuilder())
-        if args.iprof:
-            plugin_loader.load(InstructionProfilerBuilder())
-        plugin_loader.add_args(
-            "call-depth-limit", call_depth_limit=args.call_depth_limit
-        )
-        if not disable_dependency_pruning:
-            plugin_loader.load(DependencyPrunerBuilder())
-        plugin_loader.instrument_virtual_machine(self.laser, None)
+        self._mount_plugins(disable_dependency_pruning)
+        if run_analysis_modules:
+            self._mount_detectors(modules)
 
         world_state = WorldState()
         for account in self.accounts.values():
             world_state.put_account(account)
 
-        if run_analysis_modules:
-            analysis_modules = ModuleLoader().get_detection_modules(
-                EntryPoint.CALLBACK, modules
-            )
-            self.laser.register_hooks(
-                hook_type="pre",
-                hook_dict=get_detection_module_hooks(
-                    analysis_modules, hook_type="pre"
-                ),
-            )
-            self.laser.register_hooks(
-                hook_type="post",
-                hook_dict=get_detection_module_hooks(
-                    analysis_modules, hook_type="post"
-                ),
-            )
-
-        if has_creation_code:
+        if deploys:
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
                 contract_name=contract.name,
                 world_state=world_state,
             )
         else:
-            account = Account(
-                address,
-                contract.disassembly,
-                dynamic_loader=dynloader,
-                contract_name=contract.name,
-                balances=world_state.balances,
-                concrete_storage=True
-                if (dynloader is not None and dynloader.active)
-                else False,
+            world_state.put_account(
+                self._target_account(contract, address, world_state)
             )
-            if dynloader is not None:
+            self.laser.sym_exec(
+                world_state=world_state, target_address=address.value
+            )
+
+        if requires_statespace:
+            self.nodes = self.laser.nodes
+            self.edges = self.laser.edges
+            self.calls = list(self._digest_calls())
+
+    # -- setup pieces --------------------------------------------------
+    @staticmethod
+    def _actor_accounts(include_creator: bool) -> dict:
+        accounts = {
+            hex(ACTORS.attacker.value): Account(
+                hex(ACTORS.attacker.value),
+                "",
+                dynamic_loader=None,
+                contract_name=None,
+            )
+        }
+        if include_creator:
+            accounts[hex(ACTORS.creator.value)] = Account(
+                hex(ACTORS.creator.value),
+                "",
+                dynamic_loader=None,
+                contract_name=None,
+            )
+        return accounts
+
+    def _mount_plugins(self, disable_dependency_pruning: bool) -> None:
+        loader = LaserPluginLoader()
+        loader.load(CoveragePluginBuilder())
+        loader.load(MutationPrunerBuilder())
+        loader.load(CallDepthLimitBuilder())
+        if args.iprof:
+            loader.load(InstructionProfilerBuilder())
+        loader.add_args("call-depth-limit", call_depth_limit=args.call_depth_limit)
+        if not disable_dependency_pruning:
+            loader.load(DependencyPrunerBuilder())
+        loader.instrument_virtual_machine(self.laser, None)
+
+    def _mount_detectors(self, modules: Optional[List[str]]) -> None:
+        detectors = ModuleLoader().get_detection_modules(
+            EntryPoint.CALLBACK, modules
+        )
+        for phase in ("pre", "post"):
+            self.laser.register_hooks(
+                hook_type=phase,
+                hook_dict=get_detection_module_hooks(detectors, hook_type=phase),
+            )
+
+    def _target_account(self, contract, address: BitVec, world_state) -> Account:
+        loader = self.dynloader
+        account = Account(
+            address,
+            contract.disassembly,
+            dynamic_loader=loader,
+            contract_name=contract.name,
+            balances=world_state.balances,
+            concrete_storage=bool(loader is not None and loader.active),
+        )
+        if loader is not None:
+            try:
+                account.set_balance(
+                    loader.read_balance("{0:#0{1}x}".format(address.value, 42))
+                )
+            except Exception:
+                pass  # balance stays symbolic
+        return account
+
+    # -- statespace digestion ------------------------------------------
+    def _digest_calls(self):
+        """Yield a `Call` record for every CALL-family state in the
+        statespace (input to the POST analysis modules)."""
+        for node in self.nodes.values():
+            for state_index, state in enumerate(node.states):
                 try:
-                    _balance = dynloader.read_balance(
-                        "{0:#0{1}x}".format(address.value, 42)
-                    )
-                    account.set_balance(_balance)
-                except Exception:
-                    pass  # balance stays symbolic
-            world_state.put_account(account)
-            self.laser.sym_exec(world_state=world_state, target_address=address.value)
-
-        if not requires_statespace:
-            return
-
-        self.nodes = self.laser.nodes
-        self.edges = self.laser.edges
-
-        # pre-digest CALL-family operations for POST modules
-        self.calls: List[Call] = []
-        for key in self.nodes:
-            state_index = 0
-            for state in self.nodes[key].states:
-                try:
-                    instruction = state.get_current_instruction()
+                    op = state.get_current_instruction()["opcode"]
                 except IndexError:
-                    state_index += 1
                     continue
-                op = instruction["opcode"]
-                if op in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
-                    stack = state.mstate.stack
-                    if op in ("CALL", "CALLCODE"):
-                        gas, to, value, meminstart, meminsz = (
-                            get_variable(stack[-1]),
-                            get_variable(stack[-2]),
-                            get_variable(stack[-3]),
-                            get_variable(stack[-4]),
-                            get_variable(stack[-5]),
+                if op not in CALL_OPS:
+                    continue
+                stack = state.mstate.stack
+                gas = get_variable(stack[-1])
+                to = get_variable(stack[-2])
+
+                if op in ("CALL", "CALLCODE"):
+                    value = get_variable(stack[-3])
+                    mem_start = get_variable(stack[-4])
+                    mem_size = get_variable(stack[-5])
+                    if to.type == VarType.CONCRETE and 0 < to.val <= PRECOMPILE_COUNT:
+                        continue  # precompile call, not interesting
+                    if (
+                        mem_start.type == VarType.CONCRETE
+                        and mem_size.type == VarType.CONCRETE
+                    ):
+                        payload = state.mstate.memory[
+                            mem_start.val : mem_start.val + mem_size.val
+                        ]
+                        yield Call(
+                            node, state, state_index, op, to, gas, value, payload
                         )
-                        if (
-                            to.type == VarType.CONCRETE
-                            and 0 < to.val <= PRECOMPILE_COUNT
-                        ):
-                            # skip precompile calls
-                            state_index += 1
-                            continue
-                        if (
-                            meminstart.type == VarType.CONCRETE
-                            and meminsz.type == VarType.CONCRETE
-                        ):
-                            self.calls.append(
-                                Call(
-                                    self.nodes[key],
-                                    state,
-                                    state_index,
-                                    op,
-                                    to,
-                                    gas,
-                                    value,
-                                    state.mstate.memory[
-                                        meminstart.val : meminsz.val + meminstart.val
-                                    ],
-                                )
-                            )
-                        else:
-                            self.calls.append(
-                                Call(
-                                    self.nodes[key],
-                                    state,
-                                    state_index,
-                                    op,
-                                    to,
-                                    gas,
-                                    value,
-                                )
-                            )
                     else:
-                        gas, to = get_variable(stack[-1]), get_variable(stack[-2])
-                        self.calls.append(
-                            Call(self.nodes[key], state, state_index, op, to, gas)
-                        )
-                state_index += 1
+                        yield Call(node, state, state_index, op, to, gas, value)
+                else:
+                    yield Call(node, state, state_index, op, to, gas)
 
     @property
     def execution_info(self) -> List[ExecutionInfo]:

@@ -1,12 +1,13 @@
 #!/usr/bin/env python3
 """Command-line interface.
 
-Reference parity: mythril/interfaces/cli.py:46-856 — the same command
-tree (`analyze|disassemble|pro|read-storage|leveldb-search|
-function-to-hash|hash-to-address|list-detectors|version|truffle|help`)
-with the same analyze flags and dispatch, so `myth analyze ...`
-invocations are drop-in. coloredlogs is optional (plain logging when
-absent).
+Covers mythril/interfaces/cli.py: the same command tree
+(`analyze|disassemble|pro|read-storage|leveldb-search|function-to-hash|
+hash-to-address|list-detectors|version|truffle|help`) with the same
+flags, defaults and output behavior, so `myth analyze ...` invocations
+are drop-in. The implementation is table-driven: every flag lives in a
+declarative spec below and the parsers are assembled in loops; command
+dispatch is a name -> handler registry.
 """
 
 from __future__ import annotations
@@ -39,11 +40,11 @@ from mythril_tpu.plugin.loader import MythrilPluginLoader
 # initialise the extension system at import, as the reference does
 _ = MythrilPluginLoader()
 
+log = logging.getLogger(__name__)
+
 ANALYZE_LIST = ("analyze", "a")
 DISASSEMBLE_LIST = ("disassemble", "d")
 PRO_LIST = ("pro", "p")
-
-log = logging.getLogger(__name__)
 
 COMMAND_LIST = (
     ANALYZE_LIST
@@ -61,114 +62,340 @@ COMMAND_LIST = (
     )
 )
 
+LOG_LEVELS = (
+    logging.NOTSET,
+    logging.CRITICAL,
+    logging.ERROR,
+    logging.WARNING,
+    logging.INFO,
+    logging.DEBUG,
+)
 
+# ---------------------------------------------------------------------------
+# flag specs: (flags tuple, kwargs) rows, grouped by the shared parser
+# that carries them
+# ---------------------------------------------------------------------------
+RUNTIME_INPUT_FLAGS = [
+    (
+        ("-a", "--address"),
+        dict(help="pull contract from the blockchain", metavar="CONTRACT_ADDRESS"),
+    ),
+    (
+        ("--bin-runtime",),
+        dict(
+            action="store_true",
+            help=(
+                "Only when -c or -f is used. Consider the input bytecode as "
+                "binary runtime code, default being the contract creation "
+                "bytecode."
+            ),
+        ),
+    ),
+]
+
+CREATION_INPUT_FLAGS = [
+    (
+        ("-c", "--code"),
+        dict(
+            help='hex-encoded bytecode string ("6060604052...")',
+            metavar="BYTECODE",
+        ),
+    ),
+    (
+        ("-f", "--codefile"),
+        dict(
+            help="file containing hex-encoded bytecode string",
+            metavar="BYTECODEFILE",
+            type=argparse.FileType("r"),
+        ),
+    ),
+]
+
+OUTPUT_FLAGS = [
+    (
+        ("-o", "--outform"),
+        dict(
+            choices=["text", "markdown", "json", "jsonv2"],
+            default="text",
+            help="report output format",
+            metavar="<text/markdown/json/jsonv2>",
+        ),
+    )
+]
+
+RPC_FLAGS = [
+    (
+        ("--rpc",),
+        dict(
+            help="custom RPC settings",
+            metavar="HOST:PORT / ganache / infura-[network_name]",
+            default="infura-mainnet",
+        ),
+    ),
+    (("--rpctls",), dict(type=bool, default=False, help="RPC connection over TLS")),
+]
+
+UTILITY_FLAGS = [
+    (
+        ("--solc-json",),
+        dict(
+            help=(
+                "Json for the optional 'settings' parameter of solc's "
+                "standard-json input"
+            )
+        ),
+    ),
+    (
+        ("--solv",),
+        dict(
+            help=(
+                "specify solidity compiler version. If not present, will try "
+                "to install it (Experimental)"
+            ),
+            metavar="SOLV",
+        ),
+    ),
+]
+
+ANALYZE_COMMAND_FLAGS = [
+    (("-g", "--graph"), dict(help="generate a control flow graph")),
+    (
+        ("-j", "--statespace-json"),
+        dict(help="dumps the statespace json", metavar="OUTPUT_FILE"),
+    ),
+    (
+        ("--truffle",),
+        dict(
+            action="store_true",
+            help="analyze a truffle project (run from project dir)",
+        ),
+    ),
+    (("--infura-id",), dict(help="set infura id for onchain analysis")),
+]
+
+ANALYZE_OPTION_FLAGS = [
+    (
+        ("-m", "--modules"),
+        dict(
+            help="Comma-separated list of security analysis modules",
+            metavar="MODULES",
+        ),
+    ),
+    (
+        ("--max-depth",),
+        dict(
+            type=int,
+            default=128,
+            help="Maximum recursion depth for symbolic execution",
+        ),
+    ),
+    (
+        ("--call-depth-limit",),
+        dict(
+            type=int,
+            default=3,
+            help="Maximum call depth limit for symbolic execution",
+        ),
+    ),
+    (
+        ("--strategy",),
+        dict(
+            choices=["dfs", "bfs", "naive-random", "weighted-random"],
+            default="bfs",
+            help="Symbolic execution strategy",
+        ),
+    ),
+    (
+        ("-b", "--loop-bound"),
+        dict(type=int, default=3, help="Bound loops at n iterations", metavar="N"),
+    ),
+    (
+        ("-t", "--transaction-count"),
+        dict(
+            type=int,
+            default=2,
+            help="Maximum number of transactions issued by laser",
+        ),
+    ),
+    (
+        ("--execution-timeout",),
+        dict(
+            type=int,
+            default=86400,
+            help="The amount of seconds to spend on symbolic execution",
+        ),
+    ),
+    (
+        ("--solver-timeout",),
+        dict(
+            type=int,
+            default=10000,
+            help=(
+                "The maximum amount of time(in milli seconds) the solver "
+                "spends for queries from analysis modules"
+            ),
+        ),
+    ),
+    (
+        ("--create-timeout",),
+        dict(
+            type=int,
+            default=10,
+            help="The amount of seconds to spend on the initial contract creation",
+        ),
+    ),
+    (
+        ("--parallel-solving",),
+        dict(
+            action="store_true",
+            help="Enable solving solver queries in parallel",
+        ),
+    ),
+    (
+        ("--no-onchain-data",),
+        dict(
+            action="store_true",
+            help=(
+                "Don't attempt to retrieve contract code, variables and "
+                "balances from the blockchain"
+            ),
+        ),
+    ),
+    (
+        ("--sparse-pruning",),
+        dict(
+            action="store_true",
+            help=(
+                "Checks for reachability after the end of tx. Recommended "
+                "for short execution timeouts < 1 min"
+            ),
+        ),
+    ),
+    (
+        ("--unconstrained-storage",),
+        dict(
+            action="store_true",
+            help=(
+                "Default storage value is symbolic, turns off the on-chain "
+                "storage loading"
+            ),
+        ),
+    ),
+    (("--phrack",), dict(action="store_true", help="Phrack-style call graph")),
+    (
+        ("--enable-physics",),
+        dict(action="store_true", help="enable graph physics simulation"),
+    ),
+    (
+        ("-q", "--query-signature"),
+        dict(
+            action="store_true",
+            help="Lookup function signatures through www.4byte.directory",
+        ),
+    ),
+    (
+        ("--enable-iprof",),
+        dict(action="store_true", help="enable the instruction profiler"),
+    ),
+    (
+        ("--disable-dependency-pruning",),
+        dict(action="store_true", help="Deactivate dependency-based pruning"),
+    ),
+    (
+        ("--enable-coverage-strategy",),
+        dict(action="store_true", help="enable coverage based search strategy"),
+    ),
+    (
+        ("--custom-modules-directory",),
+        dict(
+            help=(
+                "designates a separate directory to search for custom "
+                "analysis modules"
+            ),
+            metavar="CUSTOM_MODULES_DIRECTORY",
+        ),
+    ),
+    (
+        ("--attacker-address",),
+        dict(
+            help="Designates a specific attacker address to use during analysis",
+            metavar="ATTACKER_ADDRESS",
+        ),
+    ),
+    (
+        ("--creator-address",),
+        dict(
+            help="Designates a specific creator address to use during analysis",
+            metavar="CREATOR_ADDRESS",
+        ),
+    ),
+]
+
+SOLIDITY_FILES_ARG = dict(
+    nargs="*",
+    help=(
+        "Inputs file name and contract name. \n"
+        "usage: file1.sol:OptionalContractName file2.sol "
+        "file3.sol:OptionalContractName"
+    ),
+)
+
+
+def _install_flags(parser, rows) -> None:
+    for flags, kwargs in rows:
+        parser.add_argument(*flags, **kwargs)
+
+
+def _shared_parser(rows) -> ArgumentParser:
+    parser = ArgumentParser(add_help=False)
+    _install_flags(parser, rows)
+    return parser
+
+
+# ---------------------------------------------------------------------------
+# error output
+# ---------------------------------------------------------------------------
 def exit_with_error(format_, message):
     """Print the error in the requested output format and exit."""
-    if format_ == "text" or format_ == "markdown":
+    if format_ in ("text", "markdown"):
         log.error(message)
     elif format_ == "json":
-        result = {"success": False, "error": str(message), "issues": []}
-        print(json.dumps(result))
+        print(json.dumps({"success": False, "error": str(message), "issues": []}))
     else:
-        result = [
-            {
-                "issues": [],
-                "sourceType": "",
-                "sourceFormat": "",
-                "sourceList": [],
-                "meta": {
-                    "logs": [{"level": "error", "hidden": True, "msg": str(message)}]
-                },
-            }
-        ]
-        print(json.dumps(result))
+        print(
+            json.dumps(
+                [
+                    {
+                        "issues": [],
+                        "sourceType": "",
+                        "sourceFormat": "",
+                        "sourceList": [],
+                        "meta": {
+                            "logs": [
+                                {
+                                    "level": "error",
+                                    "hidden": True,
+                                    "msg": str(message),
+                                }
+                            ]
+                        },
+                    }
+                ]
+            )
+        )
     sys.exit()
 
 
-def get_runtime_input_parser() -> ArgumentParser:
-    parser = ArgumentParser(add_help=False)
-    parser.add_argument(
-        "-a",
-        "--address",
-        help="pull contract from the blockchain",
-        metavar="CONTRACT_ADDRESS",
-    )
-    parser.add_argument(
-        "--bin-runtime",
-        action="store_true",
-        help="Only when -c or -f is used. Consider the input bytecode as binary "
-        "runtime code, default being the contract creation bytecode.",
-    )
-    return parser
+# ---------------------------------------------------------------------------
+# parser assembly
+# ---------------------------------------------------------------------------
+def build_parser() -> ArgumentParser:
+    rpc = _shared_parser(RPC_FLAGS)
+    utilities = _shared_parser(UTILITY_FLAGS)
+    runtime_input = _shared_parser(RUNTIME_INPUT_FLAGS)
+    creation_input = _shared_parser(CREATION_INPUT_FLAGS)
+    output = _shared_parser(OUTPUT_FLAGS)
 
-
-def get_creation_input_parser() -> ArgumentParser:
-    parser = ArgumentParser(add_help=False)
-    parser.add_argument(
-        "-c",
-        "--code",
-        help='hex-encoded bytecode string ("6060604052...")',
-        metavar="BYTECODE",
-    )
-    parser.add_argument(
-        "-f",
-        "--codefile",
-        help="file containing hex-encoded bytecode string",
-        metavar="BYTECODEFILE",
-        type=argparse.FileType("r"),
-    )
-    return parser
-
-
-def get_output_parser() -> ArgumentParser:
-    parser = argparse.ArgumentParser(add_help=False)
-    parser.add_argument(
-        "-o",
-        "--outform",
-        choices=["text", "markdown", "json", "jsonv2"],
-        default="text",
-        help="report output format",
-        metavar="<text/markdown/json/jsonv2>",
-    )
-    return parser
-
-
-def get_rpc_parser() -> ArgumentParser:
-    parser = argparse.ArgumentParser(add_help=False)
-    parser.add_argument(
-        "--rpc",
-        help="custom RPC settings",
-        metavar="HOST:PORT / ganache / infura-[network_name]",
-        default="infura-mainnet",
-    )
-    parser.add_argument(
-        "--rpctls", type=bool, default=False, help="RPC connection over TLS"
-    )
-    return parser
-
-
-def get_utilities_parser() -> ArgumentParser:
-    parser = argparse.ArgumentParser(add_help=False)
-    parser.add_argument(
-        "--solc-json",
-        help="Json for the optional 'settings' parameter of solc's standard-json input",
-    )
-    parser.add_argument(
-        "--solv",
-        help="specify solidity compiler version. If not present, will try to "
-        "install it (Experimental)",
-        metavar="SOLV",
-    )
-    return parser
-
-
-def main() -> None:
-    """CLI entry point."""
-    rpc_parser = get_rpc_parser()
-    utilities_parser = get_utilities_parser()
-    runtime_input_parser = get_runtime_input_parser()
-    creation_input_parser = get_creation_input_parser()
-    output_parser = get_output_parser()
     parser = argparse.ArgumentParser(
         description="Security analysis of Ethereum smart contracts"
     )
@@ -178,372 +405,212 @@ def main() -> None:
     )
 
     subparsers = parser.add_subparsers(dest="command", help="Commands")
-    analyzer_parser = subparsers.add_parser(
+
+    analyzer = subparsers.add_parser(
         ANALYZE_LIST[0],
         help="Triggers the analysis of the smart contract",
-        parents=[
-            rpc_parser,
-            utilities_parser,
-            creation_input_parser,
-            runtime_input_parser,
-            output_parser,
-        ],
+        parents=[rpc, utilities, creation_input, runtime_input, output],
         aliases=ANALYZE_LIST[1:],
         formatter_class=RawTextHelpFormatter,
     )
-    create_analyzer_parser(analyzer_parser)
+    analyzer.add_argument("solidity_files", **SOLIDITY_FILES_ARG)
+    _install_flags(analyzer.add_argument_group("commands"), ANALYZE_COMMAND_FLAGS)
+    _install_flags(analyzer.add_argument_group("options"), ANALYZE_OPTION_FLAGS)
 
-    disassemble_parser = subparsers.add_parser(
+    disassembler = subparsers.add_parser(
         DISASSEMBLE_LIST[0],
         help="Disassembles the smart contract",
         aliases=DISASSEMBLE_LIST[1:],
-        parents=[
-            rpc_parser,
-            utilities_parser,
-            creation_input_parser,
-            runtime_input_parser,
-        ],
+        parents=[rpc, utilities, creation_input, runtime_input],
         formatter_class=RawTextHelpFormatter,
     )
-    create_disassemble_parser(disassemble_parser)
+    disassembler.add_argument(
+        "solidity_files",
+        nargs="*",
+        help=(
+            "Inputs file name and contract name. Currently supports a single "
+            "contract\nusage: file1.sol:OptionalContractName"
+        ),
+    )
 
-    pro_parser = subparsers.add_parser(
+    pro = subparsers.add_parser(
         PRO_LIST[0],
         help="Analyzes input with the MythX API (https://mythx.io)",
         aliases=PRO_LIST[1:],
-        parents=[utilities_parser, creation_input_parser, output_parser],
+        parents=[utilities, creation_input, output],
         formatter_class=RawTextHelpFormatter,
     )
-    create_pro_parser(pro_parser)
-
-    subparsers.add_parser(
-        "list-detectors",
-        parents=[output_parser],
-        help="Lists available detection modules",
-    )
-    read_storage_parser = subparsers.add_parser(
-        "read-storage",
-        help="Retrieves storage slots from a given address through rpc",
-        parents=[rpc_parser],
-    )
-    leveldb_search_parser = subparsers.add_parser(
-        "leveldb-search", help="Searches the code fragment in local leveldb"
-    )
-    contract_func_to_hash = subparsers.add_parser(
-        "function-to-hash", help="Returns the hash signature of the function"
-    )
-    contract_hash_to_addr = subparsers.add_parser(
-        "hash-to-address",
-        help="converts the hashes in the blockchain to ethereum address",
-    )
-    subparsers.add_parser(
-        "version", parents=[output_parser], help="Outputs the version"
-    )
-    create_read_storage_parser(read_storage_parser)
-    create_hash_to_addr_parser(contract_hash_to_addr)
-    create_func_to_hash_parser(contract_func_to_hash)
-    create_leveldb_parser(leveldb_search_parser)
-
-    subparsers.add_parser("truffle", parents=[analyzer_parser], add_help=False)
-    subparsers.add_parser("help", add_help=False)
-
-    args = parser.parse_args()
-    parse_args_and_execute(parser=parser, args=args)
-
-
-def create_disassemble_parser(parser: ArgumentParser):
-    parser.add_argument(
-        "solidity_files",
-        nargs="*",
-        help="Inputs file name and contract name. Currently supports a single "
-        "contract\nusage: file1.sol:OptionalContractName",
-    )
-
-
-def create_pro_parser(parser: ArgumentParser):
-    parser.add_argument(
-        "solidity_files",
-        nargs="*",
-        help="Inputs file name and contract name. \n"
-        "usage: file1.sol:OptionalContractName file2.sol "
-        "file3.sol:OptionalContractName",
-    )
-    parser.add_argument(
+    pro.add_argument("solidity_files", **SOLIDITY_FILES_ARG)
+    pro.add_argument(
         "--full",
         help="Run a full analysis. Default: quick analysis",
         action="store_true",
     )
 
+    subparsers.add_parser(
+        "list-detectors",
+        parents=[output],
+        help="Lists available detection modules",
+    )
 
-def create_read_storage_parser(read_storage_parser: ArgumentParser):
-    read_storage_parser.add_argument(
+    read_storage = subparsers.add_parser(
+        "read-storage",
+        help="Retrieves storage slots from a given address through rpc",
+        parents=[rpc],
+    )
+    read_storage.add_argument(
         "storage_slots",
         help="read state variables from storage index",
         metavar="INDEX,NUM_SLOTS,[array] / mapping,INDEX,[KEY1, KEY2...]",
     )
-    read_storage_parser.add_argument(
-        "address", help="contract address", metavar="ADDRESS"
+    read_storage.add_argument("address", help="contract address", metavar="ADDRESS")
+
+    leveldb = subparsers.add_parser(
+        "leveldb-search", help="Searches the code fragment in local leveldb"
     )
-
-
-def create_leveldb_parser(parser: ArgumentParser):
-    parser.add_argument("search")
-    parser.add_argument(
+    leveldb.add_argument("search")
+    leveldb.add_argument(
         "--leveldb-dir",
         help="specify leveldb directory for search or direct access operations",
         metavar="LEVELDB_PATH",
     )
 
-
-def create_func_to_hash_parser(parser: ArgumentParser):
-    parser.add_argument(
+    func_to_hash = subparsers.add_parser(
+        "function-to-hash", help="Returns the hash signature of the function"
+    )
+    func_to_hash.add_argument(
         "func_name", help="calculate function signature hash", metavar="SIGNATURE"
     )
 
-
-def create_hash_to_addr_parser(hash_parser: ArgumentParser):
-    hash_parser.add_argument(
+    hash_to_addr = subparsers.add_parser(
+        "hash-to-address",
+        help="converts the hashes in the blockchain to ethereum address",
+    )
+    hash_to_addr.add_argument(
         "hash", help="Find the address from hash", metavar="FUNCTION_NAME"
     )
-    hash_parser.add_argument(
+    hash_to_addr.add_argument(
         "--leveldb-dir",
         help="specify leveldb directory for search or direct access operations",
         metavar="LEVELDB_PATH",
     )
 
-
-def create_analyzer_parser(analyzer_parser: ArgumentParser):
-    analyzer_parser.add_argument(
-        "solidity_files",
-        nargs="*",
-        help="Inputs file name and contract name. \n"
-        "usage: file1.sol:OptionalContractName file2.sol "
-        "file3.sol:OptionalContractName",
+    subparsers.add_parser(
+        "version", parents=[output], help="Outputs the version"
     )
-    commands = analyzer_parser.add_argument_group("commands")
-    commands.add_argument("-g", "--graph", help="generate a control flow graph")
-    commands.add_argument(
-        "-j",
-        "--statespace-json",
-        help="dumps the statespace json",
-        metavar="OUTPUT_FILE",
-    )
-    commands.add_argument(
-        "--truffle",
-        action="store_true",
-        help="analyze a truffle project (run from project dir)",
-    )
-    commands.add_argument("--infura-id", help="set infura id for onchain analysis")
-
-    options = analyzer_parser.add_argument_group("options")
-    options.add_argument(
-        "-m",
-        "--modules",
-        help="Comma-separated list of security analysis modules",
-        metavar="MODULES",
-    )
-    options.add_argument(
-        "--max-depth",
-        type=int,
-        default=128,
-        help="Maximum recursion depth for symbolic execution",
-    )
-    options.add_argument(
-        "--call-depth-limit",
-        type=int,
-        default=3,
-        help="Maximum call depth limit for symbolic execution",
-    )
-    options.add_argument(
-        "--strategy",
-        choices=["dfs", "bfs", "naive-random", "weighted-random"],
-        default="bfs",
-        help="Symbolic execution strategy",
-    )
-    options.add_argument(
-        "-b",
-        "--loop-bound",
-        type=int,
-        default=3,
-        help="Bound loops at n iterations",
-        metavar="N",
-    )
-    options.add_argument(
-        "-t",
-        "--transaction-count",
-        type=int,
-        default=2,
-        help="Maximum number of transactions issued by laser",
-    )
-    options.add_argument(
-        "--execution-timeout",
-        type=int,
-        default=86400,
-        help="The amount of seconds to spend on symbolic execution",
-    )
-    options.add_argument(
-        "--solver-timeout",
-        type=int,
-        default=10000,
-        help="The maximum amount of time(in milli seconds) the solver spends "
-        "for queries from analysis modules",
-    )
-    options.add_argument(
-        "--create-timeout",
-        type=int,
-        default=10,
-        help="The amount of seconds to spend on the initial contract creation",
-    )
-    options.add_argument(
-        "--parallel-solving",
-        action="store_true",
-        help="Enable solving solver queries in parallel",
-    )
-    options.add_argument(
-        "--no-onchain-data",
-        action="store_true",
-        help="Don't attempt to retrieve contract code, variables and balances "
-        "from the blockchain",
-    )
-    options.add_argument(
-        "--sparse-pruning",
-        action="store_true",
-        help="Checks for reachability after the end of tx. Recommended for "
-        "short execution timeouts < 1 min",
-    )
-    options.add_argument(
-        "--unconstrained-storage",
-        action="store_true",
-        help="Default storage value is symbolic, turns off the on-chain "
-        "storage loading",
-    )
-    options.add_argument(
-        "--phrack", action="store_true", help="Phrack-style call graph"
-    )
-    options.add_argument(
-        "--enable-physics",
-        action="store_true",
-        help="enable graph physics simulation",
-    )
-    options.add_argument(
-        "-q",
-        "--query-signature",
-        action="store_true",
-        help="Lookup function signatures through www.4byte.directory",
-    )
-    options.add_argument(
-        "--enable-iprof", action="store_true", help="enable the instruction profiler"
-    )
-    options.add_argument(
-        "--disable-dependency-pruning",
-        action="store_true",
-        help="Deactivate dependency-based pruning",
-    )
-    options.add_argument(
-        "--enable-coverage-strategy",
-        action="store_true",
-        help="enable coverage based search strategy",
-    )
-    options.add_argument(
-        "--custom-modules-directory",
-        help="designates a separate directory to search for custom analysis modules",
-        metavar="CUSTOM_MODULES_DIRECTORY",
-    )
-    options.add_argument(
-        "--attacker-address",
-        help="Designates a specific attacker address to use during analysis",
-        metavar="ATTACKER_ADDRESS",
-    )
-    options.add_argument(
-        "--creator-address",
-        help="Designates a specific creator address to use during analysis",
-        metavar="CREATOR_ADDRESS",
-    )
+    subparsers.add_parser("truffle", parents=[analyzer], add_help=False)
+    subparsers.add_parser("help", add_help=False)
+    return parser
 
 
+# kept under their historical names (third-party wrappers use them)
+def get_rpc_parser() -> ArgumentParser:
+    return _shared_parser(RPC_FLAGS)
+
+
+def get_utilities_parser() -> ArgumentParser:
+    return _shared_parser(UTILITY_FLAGS)
+
+
+def get_runtime_input_parser() -> ArgumentParser:
+    return _shared_parser(RUNTIME_INPUT_FLAGS)
+
+
+def get_creation_input_parser() -> ArgumentParser:
+    return _shared_parser(CREATION_INPUT_FLAGS)
+
+
+def get_output_parser() -> ArgumentParser:
+    return _shared_parser(OUTPUT_FLAGS)
+
+
+def create_analyzer_parser(parser: ArgumentParser):
+    parser.add_argument("solidity_files", **SOLIDITY_FILES_ARG)
+    _install_flags(parser.add_argument_group("commands"), ANALYZE_COMMAND_FLAGS)
+    _install_flags(parser.add_argument_group("options"), ANALYZE_OPTION_FLAGS)
+
+
+# ---------------------------------------------------------------------------
+# argument validation + environment setup
+# ---------------------------------------------------------------------------
 def validate_args(args: Namespace):
     if args.__dict__.get("v", False):
-        if 0 <= args.v < 6:
-            log_levels = [
-                logging.NOTSET,
-                logging.CRITICAL,
-                logging.ERROR,
-                logging.WARNING,
-                logging.INFO,
-                logging.DEBUG,
-            ]
-            try:
-                import coloredlogs
-
-                coloredlogs.install(
-                    fmt="%(name)s [%(levelname)s]: %(message)s",
-                    level=log_levels[args.v],
-                )
-            except ImportError:
-                logging.basicConfig(
-                    format="%(name)s [%(levelname)s]: %(message)s",
-                    level=log_levels[args.v],
-                )
-            logging.getLogger("mythril_tpu").setLevel(log_levels[args.v])
-        else:
-            exit_with_error(
-                args.outform, "Invalid -v value, you can find valid values in usage"
-            )
-    if args.command in DISASSEMBLE_LIST and len(args.solidity_files) > 1:
-        exit_with_error("text", "Only a single arg is supported for using disassemble")
-
-    if args.command in ANALYZE_LIST:
-        if args.enable_iprof and args.v < 4:
+        if not 0 <= args.v < len(LOG_LEVELS):
             exit_with_error(
                 args.outform,
-                "--enable-iprof must be used with -v LOG_LEVEL where LOG_LEVEL >= 4",
+                "Invalid -v value, you can find valid values in usage",
             )
+        chosen = LOG_LEVELS[args.v]
+        try:
+            import coloredlogs
+
+            coloredlogs.install(
+                fmt="%(name)s [%(levelname)s]: %(message)s", level=chosen
+            )
+        except ImportError:
+            logging.basicConfig(
+                format="%(name)s [%(levelname)s]: %(message)s", level=chosen
+            )
+        logging.getLogger("mythril_tpu").setLevel(chosen)
+
+    if args.command in DISASSEMBLE_LIST and len(args.solidity_files) > 1:
+        exit_with_error(
+            "text", "Only a single arg is supported for using disassemble"
+        )
+    if args.command in ANALYZE_LIST and args.enable_iprof and args.v < 4:
+        exit_with_error(
+            args.outform,
+            "--enable-iprof must be used with -v LOG_LEVEL where LOG_LEVEL >= 4",
+        )
 
 
 def set_config(args: Namespace):
     config = MythrilConfig()
-    if args.__dict__.get("infura_id", None):
+    opt = args.__dict__.get
+    if opt("infura_id"):
         config.set_api_infura_id(args.infura_id)
-    if (args.command in ANALYZE_LIST and not args.no_onchain_data) and not args.rpc:
+    if args.command in ANALYZE_LIST and not args.no_onchain_data and not args.rpc:
         config.set_api_from_config_path()
-
-    if args.__dict__.get("rpc", None) and not args.__dict__.get(
-        "no_onchain_data", False
-    ):
+    if opt("rpc") and not opt("no_onchain_data", False):
         config.set_api_rpc(rpc=args.rpc, rpctls=args.rpctls)
     if args.command in ("hash-to-address", "leveldb-search"):
-        leveldb_dir = args.__dict__.get("leveldb_dir", None) or config.leveldb_dir
-        config.set_api_leveldb(leveldb_dir)
+        config.set_api_leveldb(opt("leveldb_dir") or config.leveldb_dir)
     return config
 
 
 def leveldb_search(config: MythrilConfig, args: Namespace):
-    if args.command in ("hash-to-address", "leveldb-search"):
-        leveldb_searcher = MythrilLevelDB(config.eth_db)
-        if args.command == "leveldb-search":
-            leveldb_searcher.search_db(args.search)
-        else:
-            try:
-                leveldb_searcher.contract_hash_to_address(args.hash)
-            except AddressNotFoundError:
-                print("Address not found.")
-        sys.exit()
+    if args.command not in ("hash-to-address", "leveldb-search"):
+        return
+    searcher = MythrilLevelDB(config.eth_db)
+    if args.command == "leveldb-search":
+        searcher.search_db(args.search)
+    else:
+        try:
+            searcher.contract_hash_to_address(args.hash)
+        except AddressNotFoundError:
+            print("Address not found.")
+    sys.exit()
 
 
 def load_code(disassembler: MythrilDisassembler, args: Namespace):
-    address = None
-    if args.__dict__.get("code", False):
-        code = args.code[2:] if args.code.startswith("0x") else args.code
-        address, _ = disassembler.load_from_bytecode(code, args.bin_runtime)
-    elif args.__dict__.get("codefile", False):
-        bytecode = "".join(
-            [line.strip() for line in args.codefile if len(line.strip()) > 0]
+    """Load the analysis target from whichever input flag was given."""
+    opt = args.__dict__.get
+
+    if opt("code"):
+        blob = args.code
+        address, _ = disassembler.load_from_bytecode(
+            blob[2:] if blob.startswith("0x") else blob, args.bin_runtime
         )
-        bytecode = bytecode[2:] if bytecode.startswith("0x") else bytecode
-        address, _ = disassembler.load_from_bytecode(bytecode, args.bin_runtime)
-    elif args.__dict__.get("address", False):
+    elif opt("codefile"):
+        blob = "".join(
+            line.strip() for line in args.codefile if line.strip()
+        )
+        address, _ = disassembler.load_from_bytecode(
+            blob[2:] if blob.startswith("0x") else blob, args.bin_runtime
+        )
+    elif opt("address"):
         address, _ = disassembler.load_from_address(args.address)
-    elif args.__dict__.get("solidity_files", False):
+    elif opt("solidity_files"):
         if (
             args.command in ANALYZE_LIST
             and args.graph
@@ -557,11 +624,131 @@ def load_code(disassembler: MythrilDisassembler, args: Namespace):
         address, _ = disassembler.load_from_solidity(args.solidity_files)
     else:
         exit_with_error(
-            args.__dict__.get("outform", "text"),
+            opt("outform", "text"),
             "No input bytecode. Please provide EVM code via -c BYTECODE, "
             "-a ADDRESS, -f BYTECODE_FILE or <SOLIDITY_FILE>",
         )
     return address
+
+
+# ---------------------------------------------------------------------------
+# command handlers
+# ---------------------------------------------------------------------------
+def _print_report(report, outform: str) -> None:
+    renderers = {
+        "json": report.as_json,
+        "jsonv2": report.as_swc_standard_format,
+        "text": report.as_text,
+        "markdown": report.as_markdown,
+    }
+    print(renderers[outform]())
+
+
+def _run_read_storage(disassembler, address, args):
+    print(
+        disassembler.get_state_variable_from_storage(
+            address=address,
+            params=[p.strip() for p in args.storage_slots.strip().split(",")],
+        )
+    )
+
+
+def _run_pro(disassembler, address, args):
+    mode = "full" if args.full else "quick"
+    _print_report(mythx.analyze(disassembler.contracts, mode), args.outform)
+
+
+def _run_disassemble(disassembler, address, args):
+    target = disassembler.contracts[0]
+    if target.code:
+        print("Runtime Disassembly: \n" + target.get_easm())
+    if target.creation_code:
+        print("Disassembly: \n" + target.get_creation_easm())
+
+
+def _override_actors(args) -> None:
+    for flag, actor in (
+        ("attacker_address", "ATTACKER"),
+        ("creator_address", "CREATOR"),
+    ):
+        given = getattr(args, flag)
+        if not given:
+            continue
+        try:
+            ACTORS[actor] = given
+        except ValueError:
+            exit_with_error(
+                args.outform, f"{actor.capitalize()} address is invalid"
+            )
+
+
+def _run_analyze(disassembler, address, args):
+    analyzer = MythrilAnalyzer(
+        strategy=args.strategy,
+        disassembler=disassembler,
+        address=address,
+        max_depth=args.max_depth,
+        execution_timeout=args.execution_timeout,
+        loop_bound=args.loop_bound,
+        create_timeout=args.create_timeout,
+        enable_iprof=args.enable_iprof,
+        disable_dependency_pruning=args.disable_dependency_pruning,
+        use_onchain_data=not args.no_onchain_data,
+        solver_timeout=args.solver_timeout,
+        parallel_solving=args.parallel_solving,
+        custom_modules_directory=args.custom_modules_directory or "",
+        sparse_pruning=args.sparse_pruning,
+        unconstrained_storage=args.unconstrained_storage,
+        call_depth_limit=args.call_depth_limit,
+    )
+
+    if not disassembler.contracts:
+        exit_with_error(
+            args.outform, "input files do not contain any valid contracts"
+        )
+    _override_actors(args)
+
+    if args.graph:
+        html = analyzer.graph_html(
+            contract=analyzer.contracts[0],
+            enable_physics=args.enable_physics,
+            phrackify=args.phrack,
+            transaction_count=args.transaction_count,
+        )
+        try:
+            with open(args.graph, "w") as fp:
+                fp.write(html)
+        except Exception as e:
+            exit_with_error(args.outform, "Error saving graph: " + str(e))
+        return
+
+    if args.statespace_json:
+        if not analyzer.contracts:
+            exit_with_error(
+                args.outform, "input files do not contain any valid contracts"
+            )
+        statespace = analyzer.dump_statespace(contract=analyzer.contracts[0])
+        try:
+            with open(args.statespace_json, "w") as fp:
+                json.dump(statespace, fp)
+        except Exception as e:
+            exit_with_error(args.outform, "Error saving json: " + str(e))
+        return
+
+    try:
+        report = analyzer.fire_lasers(
+            modules=(
+                [m.strip() for m in args.modules.strip().split(",")]
+                if args.modules
+                else None
+            ),
+            transaction_count=args.transaction_count,
+        )
+        _print_report(report, args.outform)
+    except DetectorNotFoundError as e:
+        exit_with_error(args.outform, format(e))
+    except CriticalError as e:
+        exit_with_error(args.outform, "Analysis error encountered: " + format(e))
 
 
 def execute_command(
@@ -571,114 +758,13 @@ def execute_command(
     args: Namespace,
 ):
     if args.command == "read-storage":
-        storage = disassembler.get_state_variable_from_storage(
-            address=address,
-            params=[a.strip() for a in args.storage_slots.strip().split(",")],
-        )
-        print(storage)
-
+        _run_read_storage(disassembler, address, args)
     elif args.command in PRO_LIST:
-        mode = "full" if args.full else "quick"
-        report = mythx.analyze(disassembler.contracts, mode)
-        outputs = {
-            "json": report.as_json(),
-            "jsonv2": report.as_swc_standard_format(),
-            "text": report.as_text(),
-            "markdown": report.as_markdown(),
-        }
-        print(outputs[args.outform])
-
+        _run_pro(disassembler, address, args)
     elif args.command in DISASSEMBLE_LIST:
-        if disassembler.contracts[0].code:
-            print("Runtime Disassembly: \n" + disassembler.contracts[0].get_easm())
-        if disassembler.contracts[0].creation_code:
-            print("Disassembly: \n" + disassembler.contracts[0].get_creation_easm())
-
+        _run_disassemble(disassembler, address, args)
     elif args.command in ANALYZE_LIST:
-        analyzer = MythrilAnalyzer(
-            strategy=args.strategy,
-            disassembler=disassembler,
-            address=address,
-            max_depth=args.max_depth,
-            execution_timeout=args.execution_timeout,
-            loop_bound=args.loop_bound,
-            create_timeout=args.create_timeout,
-            enable_iprof=args.enable_iprof,
-            disable_dependency_pruning=args.disable_dependency_pruning,
-            use_onchain_data=not args.no_onchain_data,
-            solver_timeout=args.solver_timeout,
-            parallel_solving=args.parallel_solving,
-            custom_modules_directory=args.custom_modules_directory
-            if args.custom_modules_directory
-            else "",
-            sparse_pruning=args.sparse_pruning,
-            unconstrained_storage=args.unconstrained_storage,
-            call_depth_limit=args.call_depth_limit,
-        )
-
-        if not disassembler.contracts:
-            exit_with_error(
-                args.outform, "input files do not contain any valid contracts"
-            )
-
-        if args.attacker_address:
-            try:
-                ACTORS["ATTACKER"] = args.attacker_address
-            except ValueError:
-                exit_with_error(args.outform, "Attacker address is invalid")
-        if args.creator_address:
-            try:
-                ACTORS["CREATOR"] = args.creator_address
-            except ValueError:
-                exit_with_error(args.outform, "Creator address is invalid")
-
-        if args.graph:
-            html = analyzer.graph_html(
-                contract=analyzer.contracts[0],
-                enable_physics=args.enable_physics,
-                phrackify=args.phrack,
-                transaction_count=args.transaction_count,
-            )
-            try:
-                with open(args.graph, "w") as f:
-                    f.write(html)
-            except Exception as e:
-                exit_with_error(args.outform, "Error saving graph: " + str(e))
-
-        elif args.statespace_json:
-            if not analyzer.contracts:
-                exit_with_error(
-                    args.outform, "input files do not contain any valid contracts"
-                )
-            statespace = analyzer.dump_statespace(contract=analyzer.contracts[0])
-            try:
-                with open(args.statespace_json, "w") as f:
-                    json.dump(statespace, f)
-            except Exception as e:
-                exit_with_error(args.outform, "Error saving json: " + str(e))
-
-        else:
-            try:
-                report = analyzer.fire_lasers(
-                    modules=[m.strip() for m in args.modules.strip().split(",")]
-                    if args.modules
-                    else None,
-                    transaction_count=args.transaction_count,
-                )
-                outputs = {
-                    "json": report.as_json(),
-                    "jsonv2": report.as_swc_standard_format(),
-                    "text": report.as_text(),
-                    "markdown": report.as_markdown(),
-                }
-                print(outputs[args.outform])
-            except DetectorNotFoundError as e:
-                exit_with_error(args.outform, format(e))
-            except CriticalError as e:
-                exit_with_error(
-                    args.outform, "Analysis error encountered: " + format(e)
-                )
-
+        _run_analyze(disassembler, address, args)
     else:
         parser.print_help()
 
@@ -689,11 +775,35 @@ def contract_hash_to_address(args: Namespace):
     sys.exit()
 
 
+# ---------------------------------------------------------------------------
+# top-level dispatch
+# ---------------------------------------------------------------------------
+def _cmd_version(args: Namespace) -> None:
+    if args.outform == "json":
+        print(json.dumps({"version_str": VERSION}))
+    else:
+        print("Mythril-TPU version {}".format(VERSION))
+    sys.exit()
+
+
+def _cmd_list_detectors(args: Namespace) -> None:
+    rows = [
+        {"classname": type(module).__name__, "title": module.name}
+        for module in ModuleLoader().get_detection_modules()
+    ]
+    if args.outform == "json":
+        print(json.dumps(rows))
+    else:
+        for row in rows:
+            print("{}: {}".format(row["classname"], row["title"]))
+    sys.exit()
+
+
 def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
     if args.epic:
-        path = os.path.dirname(os.path.realpath(__file__))
+        here = os.path.dirname(os.path.realpath(__file__))
         sys.argv.remove("--epic")
-        os.system(" ".join(sys.argv) + " | python3 " + path + "/epic.py")
+        os.system(" ".join(sys.argv) + " | python3 " + here + "/epic.py")
         sys.exit()
 
     if args.command not in COMMAND_LIST or args.command is None:
@@ -701,23 +811,9 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         sys.exit()
 
     if args.command == "version":
-        if args.outform == "json":
-            print(json.dumps({"version_str": VERSION}))
-        else:
-            print("Mythril-TPU version {}".format(VERSION))
-        sys.exit()
-
+        _cmd_version(args)
     if args.command == "list-detectors":
-        modules = []
-        for module in ModuleLoader().get_detection_modules():
-            modules.append({"classname": type(module).__name__, "title": module.name})
-        if args.outform == "json":
-            print(json.dumps(modules))
-        else:
-            for module_data in modules:
-                print("{}: {}".format(module_data["classname"], module_data["title"]))
-        sys.exit()
-
+        _cmd_list_detectors(args)
     if args.command == "help":
         parser.print_help()
         sys.exit()
@@ -728,16 +824,13 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
             contract_hash_to_address(args)
         config = set_config(args)
         leveldb_search(config, args)
-        query_signature = args.__dict__.get("query_signature", None)
-        solc_json = args.__dict__.get("solc_json", None)
-        solv = args.__dict__.get("solv", None)
+
         disassembler = MythrilDisassembler(
             eth=config.eth,
-            solc_version=solv,
-            solc_settings_json=solc_json,
-            enable_online_lookup=query_signature,
+            solc_version=args.__dict__.get("solv"),
+            solc_settings_json=args.__dict__.get("solc_json"),
+            enable_online_lookup=args.__dict__.get("query_signature"),
         )
-
         address = load_code(disassembler, args)
         execute_command(
             disassembler=disassembler, address=address, parser=parser, args=args
@@ -746,6 +839,12 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         exit_with_error(args.__dict__.get("outform", "text"), str(ce))
     except Exception:
         exit_with_error(args.__dict__.get("outform", "text"), traceback.format_exc())
+
+
+def main() -> None:
+    """CLI entry point."""
+    parser = build_parser()
+    parse_args_and_execute(parser=parser, args=parser.parse_args())
 
 
 if __name__ == "__main__":

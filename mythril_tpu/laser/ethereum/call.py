@@ -1,19 +1,18 @@
-"""Call-parameter extraction for the CALL-family opcode handlers.
+"""Callee resolution for the CALL-family opcodes.
 
-Reference parity: mythril/laser/ethereum/call.py — pops the 6/7 call
-operands, resolves the callee (concrete address / `Storage[n]` pattern
-through the dynamic loader / fully symbolic), builds calldata from
-caller memory (symbolic sizes capped at SYMBOLIC_CALLDATA_SIZE), and
-dispatches precompile calls to natives.py.
+Covers mythril/laser/ethereum/call.py: popping the 6/7 call operands,
+resolving the target (concrete address, a `Storage[n]`-shaped symbolic
+expression chased through the dynamic loader, or left symbolic),
+building the callee's calldata out of caller memory, and routing
+precompile addresses to the native implementations.
 """
 
 from __future__ import annotations
 
 import logging
 import re
-from typing import List, Optional, Union, cast
+from typing import List, Optional, Union
 
-import mythril_tpu.laser.ethereum.util as util
 from mythril_tpu.laser.ethereum import natives
 from mythril_tpu.laser.ethereum.instruction_data import calculate_native_gas
 from mythril_tpu.laser.ethereum.natives import PRECOMPILE_COUNT, PRECOMPILE_FUNCTIONS
@@ -24,83 +23,79 @@ from mythril_tpu.laser.ethereum.state.calldata import (
     SymbolicCalldata,
 )
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.util import get_concrete_int
 from mythril_tpu.laser.smt import BitVec, Expression, If, simplify, symbol_factory
 
 log = logging.getLogger(__name__)
 
-SYMBOLIC_CALLDATA_SIZE = 320  # cap when copying symbolic-size calldata
-GSTIPEND = 2300  # gas stipend forwarded with value-bearing calls
+#: byte budget assumed when calldata is carved with a symbolic size
+SYMBOLIC_CALLDATA_SIZE = 320
+
+GSTIPEND = 2300  # stipend forwarded alongside value-bearing calls
+
+_STORAGE_SLOT_SHAPE = re.compile(r"Storage\[(\d+)\]")
+_ADDRESS_SHAPE = re.compile(r"^0x[0-9a-f]{40}$")
 
 
 def get_call_parameters(
     global_state: GlobalState, dynamic_loader, with_value: bool = False
 ):
-    """Pop call operands and resolve callee account/calldata/value/gas
-    (reference: call.py:34)."""
-    gas, to = global_state.mstate.pop(2)
-    value = global_state.mstate.pop() if with_value else 0
-    (
-        memory_input_offset,
-        memory_input_size,
-        memory_out_offset,
-        memory_out_size,
-    ) = global_state.mstate.pop(4)
+    """Pop the call operands off the stack and resolve them into
+    (callee_address, callee_account, call_data, value, gas,
+    out_offset, out_size)."""
+    ms = global_state.mstate
+    gas, to = ms.pop(2)
+    value = ms.pop() if with_value else 0
+    in_offset, in_size, out_offset, out_size = ms.pop(4)
 
     callee_address = get_callee_address(global_state, dynamic_loader, to)
+    call_data = get_call_data(global_state, in_offset, in_size)
 
     callee_account = None
-    call_data = get_call_data(global_state, memory_input_offset, memory_input_size)
-    if isinstance(callee_address, BitVec) or (
+    needs_account = isinstance(callee_address, BitVec) or (
         isinstance(callee_address, str)
-        and (int(callee_address, 16) > PRECOMPILE_COUNT or int(callee_address, 16) == 0)
-    ):
+        and (
+            int(callee_address, 16) > PRECOMPILE_COUNT
+            or int(callee_address, 16) == 0
+        )
+    )
+    if needs_account:
         callee_account = get_callee_account(
             global_state, callee_address, dynamic_loader
         )
 
     gas = gas + If(value > 0, symbol_factory.BitVecVal(GSTIPEND, gas.size()), 0)
-    return (
-        callee_address,
-        callee_account,
-        call_data,
-        value,
-        gas,
-        memory_out_offset,
-        memory_out_size,
-    )
-
-
-def _get_padded_hex_address(address: int) -> str:
-    return "0x{:040x}".format(address)
+    return callee_address, callee_account, call_data, value, gas, out_offset, out_size
 
 
 def get_callee_address(
     global_state: GlobalState, dynamic_loader, symbolic_to_address: Expression
 ):
-    """Resolve the callee address: concrete value, `Storage[n]`-shaped
-    symbolic expression via on-chain lookup, or leave symbolic
-    (reference: call.py:84)."""
-    environment = global_state.environment
+    """Resolve a call target: concrete value -> padded hex string;
+    `Storage[n]` shapes chase the slot on-chain; anything else stays
+    symbolic."""
     try:
-        return _get_padded_hex_address(util.get_concrete_int(symbolic_to_address))
+        return "0x{:040x}".format(get_concrete_int(symbolic_to_address))
     except TypeError:
-        log.debug("Symbolic call encountered")
+        log.debug("Symbolic call target")
 
-    match = re.search(r"Storage\[(\d+)\]", str(simplify(symbolic_to_address)))
-    if match is None or dynamic_loader is None:
+    if dynamic_loader is None:
+        return symbolic_to_address
+    slot = _STORAGE_SLOT_SHAPE.search(str(simplify(symbolic_to_address)))
+    if slot is None:
         return symbolic_to_address
 
-    index = int(match.group(1))
+    this = global_state.environment.active_account.address.value
     try:
-        callee_address = dynamic_loader.read_storage(
-            "0x{:040X}".format(environment.active_account.address.value), index
+        stored = dynamic_loader.read_storage(
+            "0x{:040X}".format(this), int(slot.group(1))
         )
     except Exception:
         return symbolic_to_address
 
-    if not re.match(r"^0x[0-9a-f]{40}$", callee_address):
-        callee_address = "0x" + callee_address[26:]
-    return callee_address
+    if not _ADDRESS_SHAPE.match(stored):
+        stored = "0x" + stored[26:]
+    return stored
 
 
 def get_callee_account(
@@ -108,13 +103,14 @@ def get_callee_account(
     callee_address: Union[str, BitVec],
     dynamic_loader,
 ) -> Account:
-    """The callee's account: fresh symbolic account for symbolic
-    addresses, else cache/chain lookup (reference: call.py:129)."""
+    """The target's account object; a genuinely symbolic address gets
+    a fresh account sharing the world's balance array."""
     if isinstance(callee_address, BitVec):
         if callee_address.symbolic:
-            return Account(callee_address, balances=global_state.world_state.balances)
+            return Account(
+                callee_address, balances=global_state.world_state.balances
+            )
         callee_address = hex(callee_address.value)[2:]
-
     return global_state.world_state.accounts_exist_or_load(
         callee_address, dynamic_loader
     )
@@ -125,44 +121,37 @@ def get_call_data(
     memory_start: Union[int, BitVec],
     memory_size: Union[int, BitVec],
 ) -> BaseCalldata:
-    """Build calldata for the callee from caller memory; symbolic
-    bounds degrade to fully symbolic calldata (reference: call.py:153)."""
-    state = global_state.mstate
-    transaction_id = "{}_internalcall".format(global_state.current_transaction.id)
+    """Carve the callee's calldata out of caller memory; symbolic
+    bounds degrade to fully symbolic calldata."""
+    tag = f"{global_state.current_transaction.id}_internalcall"
 
-    memory_start = cast(
-        BitVec,
-        symbol_factory.BitVecVal(memory_start, 256)
-        if isinstance(memory_start, int)
-        else memory_start,
-    )
-    memory_size = cast(
-        BitVec,
-        symbol_factory.BitVecVal(memory_size, 256)
-        if isinstance(memory_size, int)
-        else memory_size,
-    )
+    if isinstance(memory_start, int):
+        memory_start = symbol_factory.BitVecVal(memory_start, 256)
+    if isinstance(memory_size, int):
+        memory_size = symbol_factory.BitVecVal(memory_size, 256)
     if memory_size.symbolic:
         memory_size = SYMBOLIC_CALLDATA_SIZE
+
     try:
-        calldata_from_mem = state.memory[
-            util.get_concrete_int(memory_start) : util.get_concrete_int(
+        window = global_state.mstate.memory[
+            get_concrete_int(memory_start) : get_concrete_int(
                 memory_start + memory_size
             )
         ]
-        return ConcreteCalldata(transaction_id, calldata_from_mem)
+        return ConcreteCalldata(tag, window)
     except TypeError:
         log.debug(
-            "Unsupported symbolic memory offset %s size %s", memory_start, memory_size
+            "Carving calldata failed on symbolic offset %s size %s",
+            memory_start,
+            memory_size,
         )
-        return SymbolicCalldata(transaction_id)
+        return SymbolicCalldata(tag)
 
 
 def insert_ret_val(global_state: GlobalState) -> None:
-    """Push a success retval constrained to 1 (reference: call.py)."""
-    retval = global_state.new_bitvec(
-        "retval_" + str(global_state.get_current_instruction()["address"]), 256
-    )
+    """Push a success retval pinned to 1."""
+    here = global_state.get_current_instruction()["address"]
+    retval = global_state.new_bitvec(f"retval_{here}", 256)
     global_state.mstate.stack.append(retval)
     global_state.world_state.constraints.append(retval == 1)
 
@@ -174,48 +163,43 @@ def native_call(
     memory_out_offset: Union[int, Expression],
     memory_out_size: Union[int, Expression],
 ) -> Optional[List[GlobalState]]:
-    """Evaluate a precompile call; None when the callee is not a
-    precompile (reference: call.py:209)."""
-    if (
-        isinstance(callee_address, BitVec)
-        or not 0 < int(callee_address, 16) <= PRECOMPILE_COUNT
-    ):
+    """Run a precompile call concretely. None when the target is not a
+    precompile; symbolic inputs produce fresh symbolic output bytes."""
+    if isinstance(callee_address, BitVec):
+        return None
+    which = int(callee_address, 16)
+    if not 0 < which <= PRECOMPILE_COUNT:
         return None
 
     log.debug("Native contract called: %s", callee_address)
     try:
-        mem_out_start = util.get_concrete_int(memory_out_offset)
-        mem_out_sz = util.get_concrete_int(memory_out_size)
+        out_at = get_concrete_int(memory_out_offset)
+        out_len = get_concrete_int(memory_out_size)
     except TypeError:
-        log.debug("CALL with symbolic start or offset not supported")
+        log.debug("native call with symbolic output window")
         return [global_state]
 
-    call_address_int = int(callee_address, 16)
-    native_gas_min, native_gas_max = calculate_native_gas(
-        global_state.mstate.calculate_extension_size(mem_out_start, mem_out_sz),
-        PRECOMPILE_FUNCTIONS[call_address_int - 1].__name__,
+    ms = global_state.mstate
+    impl_name = PRECOMPILE_FUNCTIONS[which - 1].__name__
+    lo, hi = calculate_native_gas(
+        ms.calculate_extension_size(out_at, out_len), impl_name
     )
-    global_state.mstate.min_gas_used += native_gas_min
-    global_state.mstate.max_gas_used += native_gas_max
-    global_state.mstate.mem_extend(mem_out_start, mem_out_sz)
+    ms.min_gas_used += lo
+    ms.max_gas_used += hi
+    ms.mem_extend(out_at, out_len)
 
     try:
-        data = natives.native_contracts(call_address_int, call_data)
+        produced = natives.native_contracts(which, call_data)
     except natives.NativeContractException:
-        # symbolic input: fresh symbolic output bytes
-        for i in range(mem_out_sz):
-            global_state.mstate.memory[mem_out_start + i] = global_state.new_bitvec(
-                PRECOMPILE_FUNCTIONS[call_address_int - 1].__name__
-                + "("
-                + str(call_data)
-                + ")",
-                8,
+        # symbolic precompile input: unknowable output bytes
+        for i in range(out_len):
+            ms.memory[out_at + i] = global_state.new_bitvec(
+                f"{impl_name}({call_data})", 8
             )
         insert_ret_val(global_state)
         return [global_state]
 
-    for i in range(min(len(data), mem_out_sz)):  # excess output is chopped
-        global_state.mstate.memory[mem_out_start + i] = data[i]
-
+    for i in range(min(len(produced), out_len)):  # excess output is chopped
+        ms.memory[out_at + i] = produced[i]
     insert_ret_val(global_state)
     return [global_state]

@@ -1,37 +1,34 @@
-"""Search-strategy iterator protocol.
+"""Search-strategy protocol.
 
-Reference parity: mythril/laser/ethereum/strategy/__init__.py:6-29 —
-a strategy wraps the worklist and yields the next state to execute,
-dropping states beyond max_depth.
+A strategy owns the engine worklist and decides which path state runs
+next (mythril/laser/ethereum/strategy/__init__.py). Iteration ends
+when the worklist drains; states at or past max_depth are discarded
+as they surface.
 """
 
 from __future__ import annotations
 
-from abc import ABC, abstractmethod
-from typing import List
+import abc
 
-from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState  # noqa: F401
 
 
-class BasicSearchStrategy(ABC):
-    __slots__ = "work_list", "max_depth"
+class BasicSearchStrategy(abc.ABC):
+    __slots__ = ("work_list", "max_depth")
 
-    def __init__(self, work_list, max_depth):
-        self.work_list: List[GlobalState] = work_list
-        self.max_depth = max_depth
+    def __init__(self, pending_states, depth_cap):
+        self.work_list = pending_states
+        self.max_depth = depth_cap
+
+    @abc.abstractmethod
+    def get_strategic_global_state(self):
+        """Pick (and remove) the next state to execute."""
 
     def __iter__(self):
-        return self
-
-    @abstractmethod
-    def get_strategic_global_state(self):
-        raise NotImplementedError("Must be implemented by a subclass")
-
-    def __next__(self):
-        try:
-            global_state = self.get_strategic_global_state()
-            if global_state.mstate.depth >= self.max_depth:
-                return self.__next__()
-            return global_state
-        except IndexError:
-            raise StopIteration
+        while True:
+            try:
+                chosen = self.get_strategic_global_state()
+            except IndexError:
+                return  # worklist drained
+            if chosen.mstate.depth < self.max_depth:
+                yield chosen

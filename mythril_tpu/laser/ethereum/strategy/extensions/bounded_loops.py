@@ -1,19 +1,18 @@
-"""Loop-bound pruning as a decorator strategy.
+"""Loop bounding as a strategy decorator.
 
-Reference parity: mythril/laser/ethereum/strategy/extensions/
-bounded_loops.py:13-145 — a `JumpdestCountAnnotation` records the
-trace of executed jumpdest addresses per path; when the tail of the
-trace is a contiguously repeating cycle, the repeat count is measured
-(rolling-hash compare) and states past the bound are skipped. Creation
-transactions get a bound of at least 8 so constructors with loops can
-still deploy.
+Covers mythril/laser/ethereum/strategy/extensions/bounded_loops.py:
+each path carries a trace of reached instruction addresses; when the
+trace's tail is one cycle repeated back-to-back, the repetition count
+is measured and states past the configured bound are dropped before
+they execute. Creation transactions get a floor of 8 iterations so
+constructors that loop over storage can still deploy.
 """
 
 from __future__ import annotations
 
 import logging
 from copy import copy
-from typing import Dict, List, cast
+from typing import Dict, List
 
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
@@ -21,6 +20,8 @@ from mythril_tpu.laser.ethereum.strategy import BasicSearchStrategy
 from mythril_tpu.laser.ethereum.transaction import ContractCreationTransaction
 
 log = logging.getLogger(__name__)
+
+CREATION_LOOP_FLOOR = 8
 
 
 class JumpdestCountAnnotation(StateAnnotation):
@@ -31,90 +32,91 @@ class JumpdestCountAnnotation(StateAnnotation):
         self.trace: List[int] = []
 
     def __copy__(self):
-        result = JumpdestCountAnnotation()
-        result._reached_count = copy(self._reached_count)
-        result.trace = copy(self.trace)
-        return result
+        twin = JumpdestCountAnnotation()
+        twin._reached_count = copy(self._reached_count)
+        twin.trace = copy(self.trace)
+        return twin
+
+
+def _window_key(trace: List[int], lo: int, hi: int) -> int:
+    """Pack trace[lo:hi] into one integer (8 bits per entry — cheap
+    rolling compare, same aliasing behavior as the reference)."""
+    packed = 0
+    for at in range(lo, hi):
+        packed |= trace[at] << ((at - lo) * 8)
+    return packed
+
+
+def tail_cycle_count(trace: List[int]) -> int:
+    """How many times the trace's final cycle repeats contiguously.
+
+    Scans backwards for an earlier occurrence of the trace's last two
+    entries; the span between defines the candidate cycle, which is
+    then counted backwards window by window.
+    """
+    anchor = None
+    for at in range(len(trace) - 3, 0, -1):
+        if trace[at] == trace[-2] and trace[at + 1] == trace[-1]:
+            anchor = at
+            break
+    if anchor is None:
+        return 0
+
+    lo = anchor + 1
+    width = len(trace) - 1 - lo
+    key = _window_key(trace, lo, len(trace) - 1)
+
+    repeats = 1
+    at = lo
+    while at >= 0 and _window_key(trace, at, at + width) == key:
+        repeats += 1
+        at -= width
+    return repeats
 
 
 class BoundedLoopsStrategy(BasicSearchStrategy):
-    """Skips states whose jumpdest trace ends in > bound repetitions of
-    the same cycle."""
+    """Wraps another strategy; drops states stuck in a loop."""
 
     def __init__(self, super_strategy: BasicSearchStrategy, *args) -> None:
         self.super_strategy = super_strategy
         self.bound = args[0][0]
         log.info(
-            "Loaded search strategy extension: Loop bounds (limit = %d)", self.bound
+            "Loaded search strategy extension: Loop bounds (limit = %d)",
+            self.bound,
         )
         BasicSearchStrategy.__init__(
             self, super_strategy.work_list, super_strategy.max_depth
         )
 
-    @staticmethod
-    def calculate_hash(i: int, j: int, trace: List[int]) -> int:
-        """Pack trace[i:j] into one integer key."""
-        key = 0
-        for itr in range(i, j):
-            key |= trace[itr] << ((itr - i) * 8)
-        return key
-
-    @staticmethod
-    def count_key(trace: List[int], key: int, start: int, size: int) -> int:
-        """Count how many times the cycle `key` repeats contiguously,
-        walking backwards from `start`."""
-        count = 1
-        i = start
-        while i >= 0:
-            if BoundedLoopsStrategy.calculate_hash(i, i + size, trace) != key:
-                break
-            count += 1
-            i -= size
-        return count
-
-    @staticmethod
-    def get_loop_count(trace: List[int]) -> int:
-        """Length of the repeating suffix of the trace, in cycles."""
-        found = False
-        for i in range(len(trace) - 3, 0, -1):
-            if trace[i] == trace[-2] and trace[i + 1] == trace[-1]:
-                found = True
-                break
-        if found:
-            key = BoundedLoopsStrategy.calculate_hash(i + 1, len(trace) - 1, trace)
-            size = len(trace) - i - 2
-            count = BoundedLoopsStrategy.count_key(trace, key, i + 1, size)
-        else:
-            count = 0
-        return count
+    # historical names for the algorithm pieces (used by tests)
+    calculate_hash = staticmethod(
+        lambda i, j, trace: _window_key(trace, i, j)
+    )
+    get_loop_count = staticmethod(tail_cycle_count)
 
     def get_strategic_global_state(self) -> GlobalState:
         while True:
             state = self.super_strategy.get_strategic_global_state()
 
-            annotations = cast(
-                List[JumpdestCountAnnotation],
-                list(state.get_annotations(JumpdestCountAnnotation)),
+            annotation = next(
+                iter(state.get_annotations(JumpdestCountAnnotation)), None
             )
-            if len(annotations) == 0:
+            if annotation is None:
                 annotation = JumpdestCountAnnotation()
                 state.annotate(annotation)
-            else:
-                annotation = annotations[0]
 
-            cur_instr = state.get_current_instruction()
-            annotation.trace.append(cur_instr["address"])
-
-            if cur_instr["opcode"].upper() != "JUMPDEST":
+            instruction = state.get_current_instruction()
+            annotation.trace.append(instruction["address"])
+            if instruction["opcode"].upper() != "JUMPDEST":
                 return state
 
-            count = BoundedLoopsStrategy.get_loop_count(annotation.trace)
-            # give the creation tx a better chance to finish its loops
-            if isinstance(
+            repeats = tail_cycle_count(annotation.trace)
+            in_creation = isinstance(
                 state.current_transaction, ContractCreationTransaction
-            ) and count < max(8, self.bound):
+            )
+            if in_creation and repeats < max(CREATION_LOOP_FLOOR, self.bound):
                 return state
-            elif count > self.bound:
+            if repeats > self.bound:
                 log.debug("Loop bound reached, skipping state")
                 continue
             return state

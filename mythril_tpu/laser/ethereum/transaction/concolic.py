@@ -1,18 +1,21 @@
-"""Concolic transaction setup: fully concrete inputs.
+"""Concolic transaction driver: every input concrete.
 
-Reference parity: mythril/laser/ethereum/transaction/concolic.py:15-61
-— used by the Ethereum VMTests conformance harness; runs
-`laser_evm.exec(track_gas=True)` and returns the final states.
+Covers mythril/laser/ethereum/transaction/concolic.py — the VMTests
+conformance harness entry: one concrete message call per open state,
+engine run with gas tracking, final states returned.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Union
+from typing import List, Optional
 
 from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.laser.ethereum.cfg import Edge, JumpType, Node
 from mythril_tpu.laser.ethereum.state.calldata import ConcreteCalldata
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.transaction.launch import (
+    drain_open_states,
+    enqueue_transaction,
+)
 from mythril_tpu.laser.ethereum.transaction.transaction_models import (
     MessageCallTransaction,
     get_next_transaction_id,
@@ -31,51 +34,22 @@ def execute_message_call(
     value,
     track_gas: bool = False,
 ) -> Optional[List[GlobalState]]:
-    """Execute one concrete message call from every open state."""
-    open_states = laser_evm.open_states[:]
-    del laser_evm.open_states[:]
-
-    for open_world_state in open_states:
-        next_transaction_id = get_next_transaction_id()
-        transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=gas_price,
-            gas_limit=gas_limit,
-            origin=origin_address,
-            code=Disassembly(code),
-            caller=caller_address,
-            callee_account=open_world_state[callee_address],
-            call_data=ConcreteCalldata(next_transaction_id, data),
-            call_value=value,
+    """Run one concrete message call from every open world state."""
+    for world_state in drain_open_states(laser_evm):
+        ident = get_next_transaction_id()
+        enqueue_transaction(
+            laser_evm,
+            MessageCallTransaction(
+                world_state=world_state,
+                identifier=ident,
+                gas_price=gas_price,
+                gas_limit=gas_limit,
+                origin=origin_address,
+                code=Disassembly(code),
+                caller=caller_address,
+                callee_account=world_state[callee_address],
+                call_data=ConcreteCalldata(ident, data),
+                call_value=value,
+            ),
         )
-        _setup_global_state_for_execution(laser_evm, transaction)
-
     return laser_evm.exec(track_gas=track_gas)
-
-
-def _setup_global_state_for_execution(laser_evm, transaction) -> None:
-    global_state = transaction.initial_global_state()
-    global_state.transaction_stack.append((transaction, None))
-
-    new_node = Node(
-        global_state.environment.active_account.contract_name,
-        function_name=global_state.environment.active_function_name,
-    )
-    if laser_evm.requires_statespace:
-        laser_evm.nodes[new_node.uid] = new_node
-        if transaction.world_state.node:
-            laser_evm.edges.append(
-                Edge(
-                    transaction.world_state.node.uid,
-                    new_node.uid,
-                    edge_type=JumpType.Transaction,
-                    condition=None,
-                )
-            )
-            new_node.constraints = global_state.world_state.constraints
-
-    global_state.world_state.transaction_sequence.append(transaction)
-    global_state.node = new_node
-    new_node.states.append(global_state)
-    laser_evm.work_list.append(global_state)

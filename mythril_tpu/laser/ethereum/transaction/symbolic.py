@@ -1,10 +1,9 @@
-"""Symbolic transaction setup: fully attacker-controlled inputs.
+"""Symbolic transaction drivers: every input attacker-controlled.
 
-Reference parity: mythril/laser/ethereum/transaction/symbolic.py —
-the `ACTORS` registry (CREATOR / ATTACKER / SOMEGUY),
-`execute_message_call` over all open world states with symbolic
-sender/calldata/value plus the caller-in-ACTORS constraint, and
-`execute_contract_creation`.
+Covers mythril/laser/ethereum/transaction/symbolic.py — the named
+actor registry (creator / attacker / bystander), one fully-symbolic
+message call per open world state with the sender constrained into
+the actor pool, and symbolic contract creation.
 """
 
 from __future__ import annotations
@@ -13,50 +12,65 @@ import logging
 from typing import Optional
 
 from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.laser.ethereum.cfg import Edge, JumpType, Node
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.calldata import SymbolicCalldata
 from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.transaction.launch import (
+    drain_open_states,
+    enqueue_transaction,
+)
 from mythril_tpu.laser.ethereum.transaction.transaction_models import (
-    BaseTransaction,
     ContractCreationTransaction,
     MessageCallTransaction,
     get_next_transaction_id,
 )
-from mythril_tpu.laser.smt import BitVec, Or, symbol_factory
+from mythril_tpu.laser.smt import BitVec, symbol_factory
 
 log = logging.getLogger(__name__)
 
 BLOCK_GAS_LIMIT = 8_000_000
 
+_CREATOR_DEFAULT = 0xAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFE
+_ATTACKER_DEFAULT = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
+_BYSTANDER_DEFAULT = 0xAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA
+
 
 class Actors:
-    """The named transaction senders issues are phrased in terms of."""
+    """Well-known transaction senders; issue reports and detector
+    queries are phrased against these addresses."""
+
+    _PROTECTED = ("CREATOR", "ATTACKER")
 
     def __init__(
         self,
-        creator=0xAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFE,
-        attacker=0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF,
-        someguy=0xAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA,
+        creator=_CREATOR_DEFAULT,
+        attacker=_ATTACKER_DEFAULT,
+        someguy=_BYSTANDER_DEFAULT,
     ):
+        as_term = lambda v: symbol_factory.BitVecVal(v, 256)  # noqa: E731
         self.addresses = {
-            "CREATOR": symbol_factory.BitVecVal(creator, 256),
-            "ATTACKER": symbol_factory.BitVecVal(attacker, 256),
-            "SOMEGUY": symbol_factory.BitVecVal(someguy, 256),
+            "CREATOR": as_term(creator),
+            "ATTACKER": as_term(attacker),
+            "SOMEGUY": as_term(someguy),
         }
 
     def __setitem__(self, actor: str, address: Optional[str]):
         if address is None:
-            if actor in ("CREATOR", "ATTACKER"):
+            if actor in self._PROTECTED:
                 raise ValueError("Can't delete creator or attacker address")
             del self.addresses[actor]
-        else:
-            if address[0:2] != "0x":
-                raise ValueError("Actor address not in valid format")
-            self.addresses[actor] = symbol_factory.BitVecVal(int(address[2:], 16), 256)
+            return
+        if not address.startswith("0x"):
+            raise ValueError("Actor address not in valid format")
+        self.addresses[actor] = symbol_factory.BitVecVal(
+            int(address[2:], 16), 256
+        )
 
     def __getitem__(self, actor: str):
         return self.addresses[actor]
+
+    def __len__(self):
+        return len(self.addresses)
 
     @property
     def creator(self):
@@ -66,116 +80,61 @@ class Actors:
     def attacker(self):
         return self.addresses["ATTACKER"]
 
-    def __len__(self):
-        return len(self.addresses)
-
 
 ACTORS = Actors()
 
 
-def execute_message_call(laser_evm, callee_address: BitVec) -> None:
-    """Run one fully symbolic message-call transaction from every open
-    world state."""
-    open_states = laser_evm.open_states[:]
-    del laser_evm.open_states[:]
+def _sym(prefix: str, ident: str) -> BitVec:
+    return symbol_factory.BitVecSym(f"{prefix}{ident}", 256)
 
-    for open_world_state in open_states:
-        if open_world_state[callee_address].deleted:
+
+def execute_message_call(laser_evm, callee_address: BitVec) -> None:
+    """One fully symbolic transaction against `callee_address` from
+    each open world state, then run the engine."""
+    for world_state in drain_open_states(laser_evm):
+        if world_state[callee_address].deleted:
             log.debug("Can not execute dead contract, skipping.")
             continue
 
-        next_transaction_id = get_next_transaction_id()
-        external_sender = symbol_factory.BitVecSym(
-            f"sender_{next_transaction_id}", 256
-        )
-
-        transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                f"gas_price{next_transaction_id}", 256
+        ident = get_next_transaction_id()
+        sender = _sym("sender_", ident)
+        enqueue_transaction(
+            laser_evm,
+            MessageCallTransaction(
+                world_state=world_state,
+                identifier=ident,
+                gas_price=_sym("gas_price", ident),
+                gas_limit=BLOCK_GAS_LIMIT,
+                origin=sender,
+                caller=sender,
+                callee_account=world_state[callee_address],
+                call_data=SymbolicCalldata(ident),
+                call_value=_sym("call_value", ident),
             ),
-            gas_limit=BLOCK_GAS_LIMIT,
-            origin=external_sender,
-            caller=external_sender,
-            callee_account=open_world_state[callee_address],
-            call_data=SymbolicCalldata(next_transaction_id),
-            call_value=symbol_factory.BitVecSym(
-                f"call_value{next_transaction_id}", 256
-            ),
+            caller_pool=ACTORS.addresses.values(),
         )
-        _setup_global_state_for_execution(laser_evm, transaction)
-
     laser_evm.exec()
 
 
 def execute_contract_creation(
     laser_evm, contract_initialization_code, contract_name=None, world_state=None
 ) -> Account:
-    """Deploy `contract_initialization_code` symbolically and return the
-    created account."""
+    """Deploy init code symbolically; returns the created account."""
     del laser_evm.open_states[:]
 
-    world_state = world_state or WorldState()
-    open_states = [world_state]
-    new_account = None
-    for open_world_state in open_states:
-        next_transaction_id = get_next_transaction_id()
-        transaction = ContractCreationTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                f"gas_price{next_transaction_id}", 256
-            ),
-            gas_limit=BLOCK_GAS_LIMIT,
-            origin=ACTORS["CREATOR"],
-            code=Disassembly(contract_initialization_code),
-            caller=ACTORS["CREATOR"],
-            contract_name=contract_name,
-            call_data=None,
-            call_value=symbol_factory.BitVecSym(
-                f"call_value{next_transaction_id}", 256
-            ),
-        )
-        _setup_global_state_for_execution(laser_evm, transaction)
-        new_account = new_account or transaction.callee_account
-
+    ident = get_next_transaction_id()
+    transaction = ContractCreationTransaction(
+        world_state=world_state or WorldState(),
+        identifier=ident,
+        gas_price=_sym("gas_price", ident),
+        gas_limit=BLOCK_GAS_LIMIT,
+        origin=ACTORS["CREATOR"],
+        code=Disassembly(contract_initialization_code),
+        caller=ACTORS["CREATOR"],
+        contract_name=contract_name,
+        call_data=None,
+        call_value=_sym("call_value", ident),
+    )
+    enqueue_transaction(laser_evm, transaction, caller_pool=ACTORS.addresses.values())
     laser_evm.exec(True)
-    return new_account
-
-
-def _setup_global_state_for_execution(
-    laser_evm, transaction: BaseTransaction
-) -> None:
-    """Push the transaction's entry state (with the caller-in-ACTORS
-    constraint) onto the worklist and wire the CFG."""
-    global_state = transaction.initial_global_state()
-    global_state.transaction_stack.append((transaction, None))
-
-    global_state.world_state.constraints.append(
-        Or(*[transaction.caller == actor for actor in ACTORS.addresses.values()])
-    )
-
-    new_node = Node(
-        global_state.environment.active_account.contract_name,
-        function_name=global_state.environment.active_function_name,
-    )
-    if laser_evm.requires_statespace:
-        laser_evm.nodes[new_node.uid] = new_node
-
-    if transaction.world_state.node:
-        if laser_evm.requires_statespace:
-            laser_evm.edges.append(
-                Edge(
-                    transaction.world_state.node.uid,
-                    new_node.uid,
-                    edge_type=JumpType.Transaction,
-                    condition=None,
-                )
-            )
-        new_node.constraints = global_state.world_state.constraints
-
-    global_state.world_state.transaction_sequence.append(transaction)
-    global_state.node = new_node
-    new_node.states.append(global_state)
-    laser_evm.work_list.append(global_state)
+    return transaction.callee_account

@@ -1,15 +1,17 @@
 """Instruction-coverage measurement.
 
-Reference parity: mythril/laser/plugin/plugins/coverage/
-coverage_plugin.py:20-116 — per-bytecode executed-instruction masks
-recorded from the execute_state hook; per-transaction new-coverage and
-final percentages logged.
+Covers mythril/laser/plugin/plugins/coverage/coverage_plugin.py: which
+fraction of each bytecode's instructions ever executed. Rather than a
+boolean mask per bytecode, coverage is a set of executed instruction
+indices per code blob — same percentages, O(executed) memory, and the
+index set doubles as the uncovered-frontier query the coverage-guided
+strategy needs. (Unsound under sparse pruning, as in the reference.)
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Tuple
+from typing import Dict
 
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.plugin.builder import PluginBuilder
@@ -25,68 +27,75 @@ class CoveragePluginBuilder(PluginBuilder):
         return InstructionCoveragePlugin()
 
 
+class CodeCoverage:
+    """Executed-instruction indices for one bytecode."""
+
+    __slots__ = ("total", "seen")
+
+    def __init__(self, total: int):
+        self.total = total
+        self.seen = set()
+
+    @property
+    def percentage(self) -> float:
+        return len(self.seen) / float(self.total) * 100 if self.total else 0.0
+
+    def __iter__(self):  # (total, mask) view for reporting/tests
+        yield self.total
+        yield [i in self.seen for i in range(self.total)]
+
+
 class InstructionCoveragePlugin(LaserPlugin):
-    """Ratio of executed instructions to total instructions per
-    bytecode (unsound under sparse pruning, as in the reference)."""
+    """Records the pc of every executed state, keyed by bytecode."""
 
     def __init__(self):
-        self.coverage: Dict[str, Tuple[int, List[bool]]] = {}
-        self.initial_coverage = 0
-        self.tx_id = 0
+        self.coverage: Dict[str, CodeCoverage] = {}
+        self._tx_base = 0
+        self._tx_no = 0
+
+    def _touched(self) -> int:
+        return sum(len(cc.seen) for cc in self.coverage.values())
 
     def initialize(self, symbolic_vm) -> None:
         self.coverage = {}
-        self.initial_coverage = 0
-        self.tx_id = 0
-
-        @symbolic_vm.laser_hook("stop_sym_exec")
-        def stop_sym_exec_hook():
-            for code, code_cov in self.coverage.items():
-                if code_cov[0] == 0:
-                    continue
-                cov_percentage = sum(code_cov[1]) / float(code_cov[0]) * 100
-                log.info(
-                    "Achieved %.2f%% coverage for code: %s", cov_percentage, code
-                )
+        self._tx_base = 0
+        self._tx_no = 0
 
         @symbolic_vm.laser_hook("execute_state")
-        def execute_state_hook(global_state: GlobalState):
+        def mark(global_state: GlobalState):
             code = global_state.environment.code.bytecode
-            if code not in self.coverage.keys():
-                number_of_instructions = len(
-                    global_state.environment.code.instruction_list
+            cc = self.coverage.get(code)
+            if cc is None:
+                cc = CodeCoverage(
+                    len(global_state.environment.code.instruction_list)
                 )
-                self.coverage[code] = (
-                    number_of_instructions,
-                    [False] * number_of_instructions,
-                )
-            if global_state.mstate.pc < len(self.coverage[code][1]):
-                self.coverage[code][1][global_state.mstate.pc] = True
+                self.coverage[code] = cc
+            if global_state.mstate.pc < cc.total:
+                cc.seen.add(global_state.mstate.pc)
 
         @symbolic_vm.laser_hook("start_sym_trans")
-        def execute_start_sym_trans_hook():
-            self.initial_coverage = self._get_covered_instructions()
+        def tx_begin():
+            self._tx_base = self._touched()
 
         @symbolic_vm.laser_hook("stop_sym_trans")
-        def execute_stop_sym_trans_hook():
-            end_coverage = self._get_covered_instructions()
+        def tx_end():
             log.info(
                 "Number of new instructions covered in tx %d: %d",
-                self.tx_id,
-                end_coverage - self.initial_coverage,
+                self._tx_no,
+                self._touched() - self._tx_base,
             )
-            self.tx_id += 1
+            self._tx_no += 1
 
-    def _get_covered_instructions(self) -> int:
-        total_covered_instructions = 0
-        for _, cv in self.coverage.items():
-            total_covered_instructions += sum(cv[1])
-        return total_covered_instructions
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def summarize():
+            for code, cc in self.coverage.items():
+                if cc.total:
+                    log.info(
+                        "Achieved %.2f%% coverage for code: %s",
+                        cc.percentage,
+                        code,
+                    )
 
     def is_instruction_covered(self, bytecode, index) -> bool:
-        if bytecode not in self.coverage.keys():
-            return False
-        try:
-            return self.coverage[bytecode][1][index]
-        except IndexError:
-            return False
+        cc = self.coverage.get(bytecode)
+        return cc is not None and index in cc.seen

@@ -1,17 +1,19 @@
-"""Dependency pruner: skip blocks a previous tx couldn't influence.
+"""Dependency pruner: skip blocks no previous transaction can affect.
 
-Reference parity: mythril/laser/plugin/plugins/dependency_pruner.py
-:81-337. During the first transaction the plugin learns, per basic
-block, which storage locations are read along paths containing that
-block. From transaction 2 on, a previously-seen block only executes if
-some storage write of the previous transaction may alias one of those
-reads (alias check = one solver query per pair).
+Covers mythril/laser/plugin/plugins/dependency_pruner.py. Transaction
+1 is a learning pass: for every basic block the pruner records which
+storage locations are read ("dependencies") and written along paths
+through that block, plus whether a call sits on the path. From
+transaction 2 on, a block that was already seen on this path only
+re-executes when some storage write of the previous transaction may
+alias one of the block's recorded reads — each aliasing question is a
+single-equality solver query.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Set, cast
+from typing import Dict, List, Set
 
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
@@ -30,37 +32,38 @@ from mythril_tpu.support.model import get_model
 log = logging.getLogger(__name__)
 
 
+def _may_alias(a, b) -> bool:
+    """One equality query: can these two storage locations coincide?"""
+    try:
+        get_model((a == b,))
+        return True
+    except UnsatError:
+        return False
+
+
 def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
-    """The state's dependency annotation; if none, pop one carried over
-    from the previous transaction via the world-state stack."""
-    annotations = cast(
-        List[DependencyAnnotation], list(state.get_annotations(DependencyAnnotation))
-    )
-    if len(annotations) == 0:
-        # carry-over stack from the previous transaction's end states
-        # (assumes bfs-like scheduling, as in the reference)
-        try:
-            world_state_annotation = get_ws_dependency_annotation(state)
-            annotation = world_state_annotation.annotations_stack.pop()
-        except IndexError:
-            annotation = DependencyAnnotation()
-        state.annotate(annotation)
-    else:
-        annotation = annotations[0]
-    return annotation
+    """This path's dependency annotation, falling back to one handed
+    over from the previous transaction through the world-state stack
+    (assumes bfs-like scheduling, as in the reference)."""
+    existing = next(iter(state.get_annotations(DependencyAnnotation)), None)
+    if existing is not None:
+        return existing
+    try:
+        carried = get_ws_dependency_annotation(state).annotations_stack.pop()
+    except IndexError:
+        carried = DependencyAnnotation()
+    state.annotate(carried)
+    return carried
 
 
 def get_ws_dependency_annotation(state: GlobalState) -> WSDependencyAnnotation:
-    annotations = cast(
-        List[WSDependencyAnnotation],
-        list(state.world_state.get_annotations(WSDependencyAnnotation)),
-    )
-    if len(annotations) == 0:
-        annotation = WSDependencyAnnotation()
-        state.world_state.annotate(annotation)
-    else:
-        annotation = annotations[0]
-    return annotation
+    ws = state.world_state
+    existing = next(iter(ws.get_annotations(WSDependencyAnnotation)), None)
+    if existing is not None:
+        return existing
+    fresh = WSDependencyAnnotation()
+    ws.annotate(fresh)
+    return fresh
 
 
 class DependencyPrunerBuilder(PluginBuilder):
@@ -71,177 +74,142 @@ class DependencyPrunerBuilder(PluginBuilder):
 
 
 class DependencyPruner(LaserPlugin):
-    """Per-block read-set learning + cross-transaction alias pruning."""
+    """Per-block read/write learning + cross-transaction alias pruning."""
 
     def __init__(self):
-        self._reset()
-
-    def _reset(self):
         self.iteration = 0
-        self.calls_on_path: Dict[int, bool] = {}
-        self.sloads_on_path: Dict[int, List[object]] = {}
-        self.sstores_on_path: Dict[int, List[object]] = {}
-        self.storage_accessed_global: Set = set()
+        #: block address -> storage locations read on paths through it
+        self.reads_by_block: Dict[int, List[object]] = {}
+        #: block address -> storage locations written on those paths
+        self.writes_by_block: Dict[int, List[object]] = {}
+        #: blocks with an external call somewhere on their path
+        self.blocks_with_calls: Dict[int, bool] = {}
+        #: every storage location read anywhere, across all paths
+        self.all_reads: Set = set()
 
-    def update_sloads(self, path: List[int], target_location: object) -> None:
-        for address in path:
-            if address in self.sloads_on_path:
-                if target_location not in self.sloads_on_path[address]:
-                    self.sloads_on_path[address].append(target_location)
-            else:
-                self.sloads_on_path[address] = [target_location]
+    # -- learning ------------------------------------------------------
+    @staticmethod
+    def _note(table: Dict[int, List[object]], path: List[int], loc) -> None:
+        for block in path:
+            bucket = table.setdefault(block, [])
+            if loc not in bucket:
+                bucket.append(loc)
 
-    def update_sstores(self, path: List[int], target_location: object) -> None:
-        for address in path:
-            if address in self.sstores_on_path:
-                if target_location not in self.sstores_on_path[address]:
-                    self.sstores_on_path[address].append(target_location)
-            else:
-                self.sstores_on_path[address] = [target_location]
+    def _note_call(self, path: List[int]) -> None:
+        for block in path:
+            if block in self.writes_by_block:
+                self.blocks_with_calls[block] = True
 
-    def update_calls(self, path: List[int]) -> None:
-        for address in path:
-            if address in self.sstores_on_path:
-                self.calls_on_path[address] = True
-
-    def wanna_execute(self, address: int, annotation: DependencyAnnotation) -> bool:
-        """Should the block at `address` execute in this transaction?"""
-        storage_write_cache = annotation.get_storage_write_cache(self.iteration - 1)
-
-        if address in self.calls_on_path:
+    # -- the pruning decision ------------------------------------------
+    def wanna_execute(self, block: int, annotation: DependencyAnnotation) -> bool:
+        """Re-execute `block` this transaction?"""
+        if block in self.blocks_with_calls:
             return True
-        # "pure" path with no reads: nothing a prior write can influence
-        if address not in self.sloads_on_path:
+        # a read-free block can't observe any prior write
+        if block not in self.reads_by_block:
             return False
 
-        if address in self.storage_accessed_global:
-            for location in self.sstores_on_path:
-                try:
-                    get_model((location == address,))
+        prior_writes = annotation.get_storage_write_cache(self.iteration - 1)
+
+        if block in self.all_reads:
+            # the block address itself shows up as a read location;
+            # check whether any write-carrying block can hit it
+            for written in self.writes_by_block:
+                if _may_alias(written, block):
                     return True
-                except UnsatError:
-                    continue
 
-        dependencies = self.sloads_on_path[address]
-
-        for location in storage_write_cache:
-            for dependency in dependencies:
-                # known read along this path aliasing a previous-tx write?
-                try:
-                    get_model((location == dependency,))
+        for written in prior_writes:
+            for read in self.reads_by_block[block]:
+                if _may_alias(written, read):
                     return True
-                except UnsatError:
-                    continue
-
-            # current path already influenced by a previous-tx write?
-            for dependency in annotation.storage_loaded:
-                try:
-                    get_model((location == dependency,))
+            for read in annotation.storage_loaded:
+                if _may_alias(written, read):
                     return True
-                except UnsatError:
-                    continue
-
         return False
 
+    # -- wiring --------------------------------------------------------
     def initialize(self, symbolic_vm) -> None:
-        self._reset()
+        self.__init__()
 
         @symbolic_vm.laser_hook("start_sym_trans")
-        def start_sym_trans_hook():
+        def next_iteration():
             self.iteration += 1
 
-        @symbolic_vm.post_hook("JUMP")
-        def jump_hook(state: GlobalState):
+        def enter_block(state: GlobalState):
             try:
-                address = state.get_current_instruction()["address"]
+                block = state.get_current_instruction()["address"]
             except IndexError:
                 raise PluginSkipState
             annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
+            annotation.path.append(block)
+            self._decide(block, annotation)
 
-        @symbolic_vm.post_hook("JUMPI")
-        def jumpi_hook(state: GlobalState):
-            try:
-                address = state.get_current_instruction()["address"]
-            except IndexError:
-                raise PluginSkipState
-            annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
+        symbolic_vm.post_hook("JUMP")(enter_block)
+        symbolic_vm.post_hook("JUMPI")(enter_block)
 
         @symbolic_vm.pre_hook("SSTORE")
-        def sstore_hook(state: GlobalState):
+        def learn_write(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            self.update_sstores(annotation.path, location)
-            annotation.extend_storage_write_cache(self.iteration, location)
+            slot = state.mstate.stack[-1]
+            self._note(self.writes_by_block, annotation.path, slot)
+            annotation.extend_storage_write_cache(self.iteration, slot)
 
         @symbolic_vm.pre_hook("SLOAD")
-        def sload_hook(state: GlobalState):
+        def learn_read(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            if location not in annotation.storage_loaded:
-                annotation.storage_loaded.append(location)
-            # backwards-annotate: execution may never reach STOP/RETURN
-            self.update_sloads(annotation.path, location)
-            self.storage_accessed_global.add(location)
+            slot = state.mstate.stack[-1]
+            if slot not in annotation.storage_loaded:
+                annotation.storage_loaded.append(slot)
+            # annotate backwards immediately: the path may never reach
+            # a STOP/RETURN
+            self._note(self.reads_by_block, annotation.path, slot)
+            self.all_reads.add(slot)
 
-        @symbolic_vm.pre_hook("CALL")
-        def call_hook(state: GlobalState):
+        def learn_call(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
+            self._note_call(annotation.path)
             annotation.has_call = True
 
-        @symbolic_vm.pre_hook("STATICCALL")
-        def staticcall_hook(state: GlobalState):
+        symbolic_vm.pre_hook("CALL")(learn_call)
+        symbolic_vm.pre_hook("STATICCALL")(learn_call)
+
+        def flush_path(state: GlobalState):
+            """Fold the finished path's read/write sets into every
+            block it crossed."""
             annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
-            annotation.has_call = True
-
-        @symbolic_vm.pre_hook("STOP")
-        def stop_hook(state: GlobalState):
-            _transaction_end(state)
-
-        @symbolic_vm.pre_hook("RETURN")
-        def return_hook(state: GlobalState):
-            _transaction_end(state)
-
-        def _transaction_end(state: GlobalState) -> None:
-            """Propagate the path's read set into the dependency map of
-            every block on the path."""
-            annotation = get_dependency_annotation(state)
-            for index in annotation.storage_loaded:
-                self.update_sloads(annotation.path, index)
-            for index in annotation.storage_written:
-                self.update_sstores(annotation.path, index)
+            for slot in annotation.storage_loaded:
+                self._note(self.reads_by_block, annotation.path, slot)
+            for slot in annotation.storage_written:
+                self._note(self.writes_by_block, annotation.path, slot)
             if annotation.has_call:
-                self.update_calls(annotation.path)
+                self._note_call(annotation.path)
 
-        def _check_basic_block(address: int, annotation: DependencyAnnotation):
-            """The actual pruning decision point."""
-            if self.iteration < 2:
-                return
-            if address not in annotation.blocks_seen:
-                annotation.blocks_seen.add(address)
-                return
-            if self.wanna_execute(address, annotation):
-                return
-            log.debug(
-                "Skipping state: Storage slots %s not read in block at address %d",
-                annotation.get_storage_write_cache(self.iteration - 1),
-                address,
-            )
-            raise PluginSkipState
+        symbolic_vm.pre_hook("STOP")(flush_path)
+        symbolic_vm.pre_hook("RETURN")(flush_path)
 
         @symbolic_vm.laser_hook("add_world_state")
-        def world_state_filter_hook(state: GlobalState):
+        def hand_over(state: GlobalState):
             if isinstance(state.current_transaction, ContractCreationTransaction):
                 self.iteration = 0
                 return
-            world_state_annotation = get_ws_dependency_annotation(state)
+            ws_annotation = get_ws_dependency_annotation(state)
             annotation = get_dependency_annotation(state)
-            # keep only the write cache for the next transaction
+            # only the write cache survives into the next transaction
             annotation.path = [0]
             annotation.storage_loaded = []
-            world_state_annotation.annotations_stack.append(annotation)
+            ws_annotation.annotations_stack.append(annotation)
+
+    def _decide(self, block: int, annotation: DependencyAnnotation) -> None:
+        if self.iteration < 2:
+            return
+        if block not in annotation.blocks_seen:
+            annotation.blocks_seen.add(block)
+            return
+        if self.wanna_execute(block, annotation):
+            return
+        log.debug(
+            "Skipping state: Storage slots %s not read in block at address %d",
+            annotation.get_storage_write_cache(self.iteration - 1),
+            block,
+        )
+        raise PluginSkipState

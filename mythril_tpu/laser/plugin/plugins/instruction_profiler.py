@@ -1,27 +1,23 @@
 """Per-opcode wall-time profiler.
 
-Reference parity: mythril/laser/plugin/plugins/instruction_profiler.py
-:41-121, with one deliberate divergence: the reference's builder
-declares `plugin_name = "dependency-pruner"` (a name collision the
-survey flags as a bug, SURVEY.md §2.1); here it is
-"instruction-profiler" so both plugins can load together.
+Covers mythril/laser/plugin/plugins/instruction_profiler.py, with two
+deliberate divergences: the reference's builder name collides with the
+dependency pruner ("dependency-pruner", a bug flagged in SURVEY.md
+§2.1) — here it is "instruction-profiler"; and instead of storing a
+(start, end) record per executed instruction, the profiler folds each
+duration into a running (count, total, min, max) accumulator, so
+memory stays O(#opcodes) on million-instruction runs.
 """
 
 from __future__ import annotations
 
 import logging
-from collections import namedtuple
-from datetime import datetime
-from typing import Dict, Tuple
+import time
+from typing import Dict
 
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.plugin.builder import PluginBuilder
 from mythril_tpu.laser.plugin.interface import LaserPlugin
-
-_InstrExecRecord = namedtuple("_InstrExecRecord", ["start_time", "end_time"])
-_InstrExecStatistic = namedtuple(
-    "_InstrExecStatistic", ["total_time", "total_nr", "min_time", "max_time"]
-)
 
 log = logging.getLogger(__name__)
 
@@ -33,71 +29,65 @@ class InstructionProfilerBuilder(PluginBuilder):
         return InstructionProfiler()
 
 
-class InstructionProfiler(LaserPlugin):
-    """Wall-time per opcode via all-opcode pre/post instruction hooks;
-    summary logged at stop_sym_exec."""
+class _OpStats:
+    __slots__ = ("count", "total", "lo", "hi")
 
     def __init__(self):
-        self._reset()
+        self.count = 0
+        self.total = 0.0
+        self.lo = float("inf")
+        self.hi = 0.0
 
-    def _reset(self):
-        self.records = dict()
-        self.start_time = None
+    def add(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.lo = min(self.lo, seconds)
+        self.hi = max(self.hi, seconds)
+
+
+class InstructionProfiler(LaserPlugin):
+    """Times every instruction via the all-opcode instr hooks; logs a
+    per-opcode summary when symbolic execution stops."""
+
+    def __init__(self):
+        self.stats: Dict[str, _OpStats] = {}
+        self._tick = None
 
     def initialize(self, symbolic_vm) -> None:
         @symbolic_vm.instr_hook("pre", None)
-        def get_start_time(op_code: str):
-            def start_time_wrapper(global_state: GlobalState):
-                self.start_time = datetime.now()
+        def stamp(op_code: str):
+            def before(global_state: GlobalState):
+                self._tick = time.monotonic()
 
-            return start_time_wrapper
+            return before
 
         @symbolic_vm.instr_hook("post", None)
-        def record(op_code: str):
-            def record_opcode(global_state: GlobalState):
-                end_time = datetime.now()
-                self.records.setdefault(op_code, []).append(
-                    _InstrExecRecord(self.start_time, end_time)
-                )
+        def fold(op_code: str):
+            def after(global_state: GlobalState):
+                elapsed = time.monotonic() - self._tick
+                self.stats.setdefault(op_code, _OpStats()).add(elapsed)
 
-            return record_opcode
+            return after
 
         @symbolic_vm.laser_hook("stop_sym_exec")
-        def print_stats():
-            total, stats = self._make_stats()
-            if not total:
+        def report():
+            grand_total = sum(s.total for s in self.stats.values())
+            if not grand_total:
                 return
-            s = "Total: {} s\n".format(total)
-            for op in sorted(stats):
-                stat = stats[op]
-                s += (
-                    "[{:12s}] {:>8.4f} %,  nr {:>6},  total {:>8.4f} s,"
-                    "  avg {:>8.4f} s,  min {:>8.4f} s,  max {:>8.4f} s\n"
-                ).format(
-                    op,
-                    stat.total_time * 100 / total,
-                    stat.total_nr,
-                    stat.total_time,
-                    stat.total_time / stat.total_nr,
-                    stat.min_time,
-                    stat.max_time,
+            lines = [f"Total: {grand_total} s"]
+            for op in sorted(self.stats):
+                s = self.stats[op]
+                lines.append(
+                    "[%-12s] %8.4f %%,  nr %6d,  total %8.4f s,"
+                    "  avg %8.4f s,  min %8.4f s,  max %8.4f s"
+                    % (
+                        op,
+                        s.total * 100 / grand_total,
+                        s.count,
+                        s.total,
+                        s.total / s.count,
+                        s.lo,
+                        s.hi,
+                    )
                 )
-            log.info(s)
-
-    def _make_stats(self) -> Tuple[float, Dict]:
-        periods = {
-            op: [r.end_time.timestamp() - r.start_time.timestamp() for r in rs]
-            for op, rs in self.records.items()
-        }
-        stats = dict()
-        total_time = 0.0
-        for op, times in periods.items():
-            stat = _InstrExecStatistic(
-                total_time=sum(times),
-                total_nr=len(times),
-                min_time=min(times),
-                max_time=max(times),
-            )
-            total_time += stat.total_time
-            stats[op] = stat
-        return total_time, stats
+            log.info("\n".join(lines) + "\n")

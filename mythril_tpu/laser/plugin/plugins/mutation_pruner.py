@@ -1,14 +1,14 @@
-"""Mutation pruner: skip "clean" transactions.
+"""Mutation pruner: drop transactions that provably changed nothing.
 
-Reference parity: mythril/laser/plugin/plugins/mutation_pruner.py:22-89.
-If a symbolic transaction T from world state S neither mutates state
-nor can carry a positive call value, then its end state S' is
-equivalent to S for analysis purposes and is dropped.
+Covers mythril/laser/plugin/plugins/mutation_pruner.py. A symbolic
+transaction whose path neither touched a mutating opcode nor can move
+a positive call value leaves the world state equivalent to its start
+state, so keeping its end state only multiplies later transactions'
+work; the pruner vetoes it at add_world_state time.
 """
 
 from __future__ import annotations
 
-from mythril_tpu.analysis import solver
 from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.ethereum.transaction.transaction_models import (
@@ -21,6 +21,9 @@ from mythril_tpu.laser.plugin.signals import PluginSkipWorldState
 from mythril_tpu.laser.smt import UGT, symbol_factory
 from mythril_tpu.support.model import get_model
 
+#: opcodes whose mere execution means the tx was not a no-op
+MUTATING_OPS = ("SSTORE", "CALL", "STATICCALL")
+
 
 class MutationPrunerBuilder(PluginBuilder):
     plugin_name = "mutation-pruner"
@@ -29,46 +32,39 @@ class MutationPrunerBuilder(PluginBuilder):
         return MutationPruner()
 
 
+def _can_move_value(global_state: GlobalState) -> bool:
+    """Is a strictly positive callvalue satisfiable on this path?"""
+    value = global_state.environment.callvalue
+    if isinstance(value, int):
+        value = symbol_factory.BitVecVal(value, 256)
+    query = global_state.world_state.constraints + [
+        UGT(value, symbol_factory.BitVecVal(0, 256))
+    ]
+    try:
+        get_model(query)
+        return True
+    except UnsatError:
+        return False
+
+
 class MutationPruner(LaserPlugin):
-    """Annotates mutating opcodes; filters end states with no mutation
-    and a provably-zero call value."""
+    """Tags mutating opcodes on the way through; vetoes untagged,
+    value-free end states."""
 
     def initialize(self, symbolic_vm) -> None:
-        @symbolic_vm.pre_hook("SSTORE")
-        def sstore_mutator_hook(global_state: GlobalState):
+        def tag(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
 
-        @symbolic_vm.pre_hook("CALL")
-        def call_mutator_hook(global_state: GlobalState):
-            global_state.annotate(MutationAnnotation())
-
-        @symbolic_vm.pre_hook("STATICCALL")
-        def staticcall_mutator_hook(global_state: GlobalState):
-            global_state.annotate(MutationAnnotation())
+        for op in MUTATING_OPS:
+            symbolic_vm.pre_hook(op)(tag)
 
         @symbolic_vm.laser_hook("add_world_state")
-        def world_state_filter_hook(global_state: GlobalState):
-            if isinstance(
-                global_state.current_transaction, ContractCreationTransaction
-            ):
-                return
-
-            if isinstance(global_state.environment.callvalue, int):
-                callvalue = symbol_factory.BitVecVal(
-                    global_state.environment.callvalue, 256
-                )
-            else:
-                callvalue = global_state.environment.callvalue
-
-            try:
-                constraints = global_state.world_state.constraints + [
-                    UGT(callvalue, symbol_factory.BitVecVal(0, 256))
-                ]
-                get_model(constraints)
-                # a positive value transfer is possible: balances mutate
-                return
-            except UnsatError:
-                pass
-
-            if len(list(global_state.get_annotations(MutationAnnotation))) == 0:
-                raise PluginSkipWorldState
+        def drop_clean_transaction(global_state: GlobalState):
+            tx = global_state.current_transaction
+            if isinstance(tx, ContractCreationTransaction):
+                return  # deployments always matter
+            if _can_move_value(global_state):
+                return  # balances may have mutated
+            if next(global_state.get_annotations(MutationAnnotation), None):
+                return  # a mutating opcode ran
+            raise PluginSkipWorldState

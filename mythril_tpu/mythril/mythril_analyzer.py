@@ -1,9 +1,10 @@
 """Facade: per-contract analysis orchestration.
 
-Reference parity: mythril/mythril/mythril_analyzer.py:27-195 — sets
-the global `args`, runs SymExecWrapper + fire_lasers per contract with
-crash containment (exceptions are reported, already-found callback
-issues salvaged), and renders graph/statespace artifacts.
+Covers mythril/mythril/mythril_analyzer.py — publishes the run options
+to the global `args` bag, drives SymExecWrapper + fire_lasers for each
+loaded contract with crash containment (a crashing contract reports
+its traceback and salvages the callback issues already found), and
+produces the graph/statespace artifacts.
 """
 
 from __future__ import annotations
@@ -29,64 +30,85 @@ from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
 
+CRASH_NOTICE = (
+    "Exception occurred, aborting analysis. Please report this "
+    "issue to the project's issue tracker.\n"
+)
+
+
+#: analyzer-local options and their defaults
+_RUN_DEFAULTS = dict(
+    use_onchain_data=True,
+    strategy="dfs",
+    address=None,
+    max_depth=None,
+    execution_timeout=None,
+    loop_bound=None,
+    create_timeout=None,
+    disable_dependency_pruning=False,
+    custom_modules_directory="",
+)
+
+#: options published to the global `args` bag for the deep layers
+_GLOBAL_DEFAULTS = dict(
+    sparse_pruning=False,
+    parallel_solving=False,
+    unconstrained_storage=False,
+    call_depth_limit=3,
+)
+
 
 class MythrilAnalyzer:
-    """Runs the security analysis over the disassembler's contracts."""
+    """Runs the security analysis over the disassembler's contracts.
+
+    Accepts the reference CLI's full option set as keywords; anything
+    in `_RUN_DEFAULTS` configures this analyzer, anything in
+    `_GLOBAL_DEFAULTS` (plus enable_iprof / solver_timeout) is pushed
+    into the global `args` bag for the deep layers.
+    """
 
     def __init__(
         self,
         disassembler: MythrilDisassembler,
         requires_dynld: bool = False,
-        use_onchain_data: bool = True,
-        strategy: str = "dfs",
-        address: Optional[str] = None,
-        max_depth: Optional[int] = None,
-        execution_timeout: Optional[int] = None,
-        loop_bound: Optional[int] = None,
-        create_timeout: Optional[int] = None,
         enable_iprof: bool = False,
-        disable_dependency_pruning: bool = False,
         solver_timeout: Optional[int] = None,
-        custom_modules_directory: str = "",
-        sparse_pruning: bool = False,
-        unconstrained_storage: bool = False,
-        parallel_solving: bool = False,
-        call_depth_limit: int = 3,
+        **options,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
         self.enable_online_lookup = disassembler.enable_online_lookup
-        self.use_onchain_data = use_onchain_data
-        self.strategy = strategy
-        self.address = address
-        self.max_depth = max_depth
-        self.execution_timeout = execution_timeout
-        self.loop_bound = loop_bound
-        self.create_timeout = create_timeout
-        self.disable_dependency_pruning = disable_dependency_pruning
-        self.custom_modules_directory = custom_modules_directory
-        args.sparse_pruning = sparse_pruning
+
+        for field, default in _RUN_DEFAULTS.items():
+            setattr(self, field, options.pop(field, default))
+        for field, default in _GLOBAL_DEFAULTS.items():
+            setattr(args, field, options.pop(field, default))
+        if options:
+            raise TypeError(f"unknown analyzer options: {sorted(options)}")
+
+        args.iprof = enable_iprof
         if solver_timeout is not None:
             args.solver_timeout = solver_timeout
-        args.parallel_solving = parallel_solving
-        args.unconstrained_storage = unconstrained_storage
-        args.call_depth_limit = call_depth_limit
-        args.iprof = enable_iprof
 
-    def dump_statespace(self, contract: EVMContract = None) -> dict:
-        """Serializable statespace of the contract."""
-        sym = SymExecWrapper(
-            contract or self.contracts[0],
-            self.address,
-            self.strategy,
+    # -- shared engine construction ------------------------------------
+    def _symbolically_execute(self, contract, **overrides) -> SymExecWrapper:
+        options = dict(
             dynloader=DynLoader(self.eth, active=self.use_onchain_data),
             max_depth=self.max_depth,
             execution_timeout=self.execution_timeout,
             create_timeout=self.create_timeout,
             disable_dependency_pruning=self.disable_dependency_pruning,
-            run_analysis_modules=False,
             custom_modules_directory=self.custom_modules_directory,
         )
+        options.update(overrides)
+        return SymExecWrapper(
+            contract or self.contracts[0], self.address, self.strategy, **options
+        )
+
+    # -- artifacts -----------------------------------------------------
+    def dump_statespace(self, contract: EVMContract = None) -> dict:
+        """Serializable statespace of the contract."""
+        sym = self._symbolically_execute(contract, run_analysis_modules=False)
         return get_serializable_statespace(sym)
 
     def graph_html(
@@ -97,21 +119,14 @@ class MythrilAnalyzer:
         transaction_count: Optional[int] = None,
     ) -> str:
         """Interactive callgraph HTML."""
-        sym = SymExecWrapper(
-            contract or self.contracts[0],
-            self.address,
-            self.strategy,
-            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
-            max_depth=self.max_depth,
-            execution_timeout=self.execution_timeout,
+        sym = self._symbolically_execute(
+            contract,
             transaction_count=transaction_count,
-            create_timeout=self.create_timeout,
-            disable_dependency_pruning=self.disable_dependency_pruning,
             run_analysis_modules=False,
-            custom_modules_directory=self.custom_modules_directory,
         )
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
 
+    # -- the analysis run ----------------------------------------------
     def fire_lasers(
         self,
         modules: Optional[List[str]] = None,
@@ -119,27 +134,20 @@ class MythrilAnalyzer:
     ) -> Report:
         """Analyze every loaded contract; one contract crashing doesn't
         lose the others' findings."""
-        all_issues: List[Issue] = []
         SolverStatistics().enabled = True
-        exceptions = []
+        collected: List[Issue] = []
+        crashes: List[str] = []
         execution_info: Optional[List[ExecutionInfo]] = None
+
         for contract in self.contracts:
             StartTime()  # fresh discovery-time baseline per contract
             try:
-                sym = SymExecWrapper(
+                sym = self._symbolically_execute(
                     contract,
-                    self.address,
-                    self.strategy,
-                    dynloader=DynLoader(self.eth, active=self.use_onchain_data),
-                    max_depth=self.max_depth,
-                    execution_timeout=self.execution_timeout,
                     loop_bound=self.loop_bound,
-                    create_timeout=self.create_timeout,
                     transaction_count=transaction_count,
                     modules=modules,
                     compulsory_statespace=False,
-                    disable_dependency_pruning=self.disable_dependency_pruning,
-                    custom_modules_directory=self.custom_modules_directory,
                 )
                 issues = fire_lasers(sym, modules)
                 execution_info = sym.execution_info
@@ -149,27 +157,23 @@ class MythrilAnalyzer:
                 log.critical("Keyboard Interrupt")
                 issues = retrieve_callback_issues(modules)
             except Exception:
-                log.critical(
-                    "Exception occurred, aborting analysis. Please report this "
-                    "issue to the project's issue tracker.\n"
-                    + traceback.format_exc()
-                )
+                log.critical(CRASH_NOTICE + traceback.format_exc())
                 issues = retrieve_callback_issues(modules)
-                exceptions.append(traceback.format_exc())
+                crashes.append(traceback.format_exc())
+
             for issue in issues:
                 issue.add_code_info(contract)
-
-            all_issues += issues
+            collected += issues
             log.info("Solver statistics: \n%s", str(SolverStatistics()))
 
-        source_data = Source()
-        source_data.get_source_from_contracts_list(self.contracts)
+        # prime the source registry for the report
+        Source().get_source_from_contracts_list(self.contracts)
 
         report = Report(
             contracts=self.contracts,
-            exceptions=exceptions,
+            exceptions=crashes,
             execution_info=execution_info,
         )
-        for issue in all_issues:
+        for issue in collected:
             report.append_issue(issue)
         return report

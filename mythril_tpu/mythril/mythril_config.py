@@ -1,8 +1,8 @@
 """Facade: environment/config setup (~/.mythril/config.ini).
 
-Reference parity: mythril/mythril/mythril_config.py:19-252 — config
-file with leveldb path / dynamic-loading / infura-id defaults; builds
-the `EthJsonRpc` (and LevelDB reader) handles the rest of the facade
+Covers mythril/mythril/mythril_config.py — the config file with
+leveldb-path / dynamic-loading / infura-id defaults, and construction
+of the EthJsonRpc (and LevelDB reader) handles the rest of the facade
 consumes.
 """
 
@@ -21,41 +21,76 @@ from mythril_tpu.exceptions import CriticalError
 
 log = logging.getLogger(__name__)
 
+INFURA_NETWORKS = ("mainnet", "rinkeby", "kovan", "ropsten")
+
+#: commentary written into a freshly created config.ini
+_LEVELDB_NOTES = (
+    "#Default chaindata locations:",
+    "#- Mac: ~/Library/Ethereum/geth/chaindata",
+    "#- Linux: ~/.ethereum/geth/chaindata",
+    "#- Windows: %USERPROFILE%\\AppData\\Roaming\\Ethereum\\geth\\chaindata",
+)
+_DYNLOAD_NOTES = (
+    "#- To connect to Infura use dynamic_loading: infura",
+    "#- To connect to Rpc use "
+    "dynamic_loading: HOST:PORT / ganache / infura-[network_name]",
+    "#- To connect to local host use dynamic_loading: localhost",
+)
+
+NO_INFURA_KEY_NOTICE = (
+    "Infura key not provided, so onchain access is disabled. "
+    "Use --infura-id <INFURA_ID> or set it in the environment "
+    "variable INFURA_ID or in the ~/.mythril/config.ini file"
+)
+
+
+def _platform_chaindata_root() -> str:
+    home = os.path.expanduser("~")
+    system = platform.system().lower()
+    if system.startswith("darwin"):
+        root = os.path.join(home, "Library", "Ethereum")
+    elif system.startswith("windows"):
+        root = os.path.join(home, "AppData", "Roaming", "Ethereum")
+    else:
+        root = os.path.join(home, ".ethereum")
+    return os.path.join(root, "geth", "chaindata")
+
 
 class MythrilConfig:
     """Sets up the analyzer environment: data dir, config file, RPC."""
 
     def __init__(self):
         self.infura_id: Optional[str] = os.getenv("INFURA_ID")
-        self.mythril_dir = self._init_mythril_dir()
+        self.mythril_dir = self._ensure_data_dir()
         self.config_path = os.path.join(self.mythril_dir, "config.ini")
         self.leveldb_dir = None
-        self._init_config()
+        self._load_config_file()
         self.eth: Optional[EthJsonRpc] = None
         self.eth_db = None
 
     def set_api_infura_id(self, id):
         self.infura_id = id
 
+    # -- config file ---------------------------------------------------
     @staticmethod
-    def _init_mythril_dir() -> str:
-        try:
-            mythril_dir = os.environ["MYTHRIL_DIR"]
-        except KeyError:
-            mythril_dir = os.path.join(os.path.expanduser("~"), ".mythril")
-
-        if not os.path.exists(mythril_dir):
+    def _ensure_data_dir() -> str:
+        where = os.environ.get("MYTHRIL_DIR") or os.path.join(
+            os.path.expanduser("~"), ".mythril"
+        )
+        if not os.path.exists(where):
             log.info("Creating mythril data directory")
-            os.makedirs(mythril_dir, exist_ok=True)
-        return mythril_dir
+            os.makedirs(where, exist_ok=True)
+        return where
 
-    def _init_config(self):
+    def _load_config_file(self):
         """Create config.ini with defaults when missing; read the
         leveldb path and infura id."""
-        leveldb_default_path = self._get_default_leveldb_path()
+        chaindata_default = _platform_chaindata_root()
 
         if not os.path.exists(self.config_path):
-            log.info("No config file found. Creating default: %s", self.config_path)
+            log.info(
+                "No config file found. Creating default: %s", self.config_path
+            )
             open(self.config_path, "a").close()
 
         config = ConfigParser(allow_no_value=True)
@@ -63,69 +98,28 @@ class MythrilConfig:
         config.read(self.config_path, "utf-8")
         if "defaults" not in config.sections():
             config.add_section("defaults")
+
         if not config.has_option("defaults", "leveldb_dir"):
-            self._add_leveldb_option(config, leveldb_default_path)
+            for note in _LEVELDB_NOTES:
+                config.set("defaults", note, "")
+            config.set("defaults", "leveldb_dir", chaindata_default)
         if not config.has_option("defaults", "dynamic_loading"):
-            self._add_dynamic_loading_option(config)
+            for note in _DYNLOAD_NOTES:
+                config.set("defaults", note, "")
+            config.set("defaults", "dynamic_loading", "infura")
         if not config.has_option("defaults", "infura_id"):
             config.set("defaults", "infura_id", "")
 
         with codecs.open(self.config_path, "w", "utf-8") as fp:
             config.write(fp)
 
-        leveldb_dir = config.get(
-            "defaults", "leveldb_dir", fallback=leveldb_default_path
+        self.leveldb_dir = os.path.expanduser(
+            config.get("defaults", "leveldb_dir", fallback=chaindata_default)
         )
         if not self.infura_id:
             self.infura_id = config.get("defaults", "infura_id", fallback="")
-        self.leveldb_dir = os.path.expanduser(leveldb_dir)
 
-    @staticmethod
-    def _get_default_leveldb_path() -> str:
-        system = platform.system().lower()
-        leveldb_fallback_dir = os.path.expanduser("~")
-        if system.startswith("darwin"):
-            leveldb_fallback_dir = os.path.join(
-                leveldb_fallback_dir, "Library", "Ethereum"
-            )
-        elif system.startswith("windows"):
-            leveldb_fallback_dir = os.path.join(
-                leveldb_fallback_dir, "AppData", "Roaming", "Ethereum"
-            )
-        else:
-            leveldb_fallback_dir = os.path.join(leveldb_fallback_dir, ".ethereum")
-        return os.path.join(leveldb_fallback_dir, "geth", "chaindata")
-
-    @staticmethod
-    def _add_leveldb_option(config: ConfigParser, leveldb_fallback_dir: str) -> None:
-        config.set("defaults", "#Default chaindata locations:", "")
-        config.set("defaults", "#- Mac: ~/Library/Ethereum/geth/chaindata", "")
-        config.set("defaults", "#- Linux: ~/.ethereum/geth/chaindata", "")
-        config.set(
-            "defaults",
-            "#- Windows: %USERPROFILE%\\AppData\\Roaming\\Ethereum\\geth\\chaindata",
-            "",
-        )
-        config.set("defaults", "leveldb_dir", leveldb_fallback_dir)
-
-    @staticmethod
-    def _add_dynamic_loading_option(config: ConfigParser) -> None:
-        config.set(
-            "defaults", "#- To connect to Infura use dynamic_loading: infura", ""
-        )
-        config.set(
-            "defaults",
-            "#- To connect to Rpc use "
-            "dynamic_loading: HOST:PORT / ganache / infura-[network_name]",
-            "",
-        )
-        config.set(
-            "defaults",
-            "#- To connect to local host use dynamic_loading: localhost",
-            "",
-        )
-        config.set("defaults", "dynamic_loading", "infura")
-
+    # -- connection targets --------------------------------------------
     def set_api_leveldb(self, leveldb_path: str) -> None:
         from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
 
@@ -133,67 +127,60 @@ class MythrilConfig:
 
     def set_api_rpc_infura(self) -> None:
         log.info("Using INFURA Main Net for RPC queries")
-        if self.infura_id in (None, ""):
+        if not self.infura_id:
             log.info("Infura key not provided, onchain access is disabled")
             self.eth = None
             return
-        self.eth = EthJsonRpc(
-            "mainnet.infura.io/v3/{}".format(self.infura_id), None, True
-        )
-
-    def set_api_rpc(self, rpc: str = None, rpctls: bool = False) -> None:
-        if rpc == "ganache":
-            rpcconfig = ("localhost", 7545, False)
-        else:
-            m = re.match(r"infura-(.*)", rpc)
-            if m and m.group(1) in ["mainnet", "rinkeby", "kovan", "ropsten"]:
-                if self.infura_id in (None, ""):
-                    log.info(
-                        "Infura key not provided, so onchain access is disabled. "
-                        "Use --infura-id <INFURA_ID> or set it in the environment "
-                        "variable INFURA_ID or in the ~/.mythril/config.ini file"
-                    )
-                    self.eth = None
-                    return
-                rpcconfig = (
-                    "{}.infura.io/v3/{}".format(m.group(1), self.infura_id),
-                    None,
-                    True,
-                )
-            else:
-                try:
-                    host, port = rpc.split(":")
-                    rpcconfig = (host, int(port), rpctls)
-                except ValueError:
-                    raise CriticalError(
-                        "Invalid RPC argument, use 'ganache', 'infura-[network]'"
-                        " or 'HOST:PORT'"
-                    )
-
-        if rpcconfig:
-            log.info("Using RPC settings: %s", str(rpcconfig))
-            self.eth = EthJsonRpc(rpcconfig[0], rpcconfig[1], rpcconfig[2])
-        else:
-            raise CriticalError("Invalid RPC settings, check help for details.")
+        self.eth = EthJsonRpc(f"mainnet.infura.io/v3/{self.infura_id}", None, True)
 
     def set_api_rpc_localhost(self) -> None:
         log.info("Using default RPC settings: http://localhost:8545")
         self.eth = EthJsonRpc("localhost", 8545)
 
+    def set_api_rpc(self, rpc: str = None, rpctls: bool = False) -> None:
+        target = self._resolve_rpc_target(rpc, rpctls)
+        if target is None:  # infura network without a key: disabled
+            self.eth = None
+            return
+        log.info("Using RPC settings: %s", str(target))
+        self.eth = EthJsonRpc(*target)
+
+    def _resolve_rpc_target(self, rpc: str, rpctls: bool):
+        if rpc == "ganache":
+            return ("localhost", 7545, False)
+
+        infura_net = re.match(r"infura-(.*)", rpc or "")
+        if infura_net and infura_net.group(1) in INFURA_NETWORKS:
+            if not self.infura_id:
+                log.info(NO_INFURA_KEY_NOTICE)
+                return None
+            return (
+                f"{infura_net.group(1)}.infura.io/v3/{self.infura_id}",
+                None,
+                True,
+            )
+
+        try:
+            host, port = rpc.split(":")
+            return (host, int(port), rpctls)
+        except ValueError:
+            raise CriticalError(
+                "Invalid RPC argument, use 'ganache', 'infura-[network]'"
+                " or 'HOST:PORT'"
+            )
+
     def set_api_from_config_path(self) -> None:
         config = ConfigParser(allow_no_value=False)
         config.optionxform = str
         config.read(self.config_path, "utf-8")
-        if config.has_option("defaults", "dynamic_loading"):
-            dynamic_loading = config.get("defaults", "dynamic_loading")
-        else:
-            dynamic_loading = "infura"
-        self._set_rpc(dynamic_loading)
-
-    def _set_rpc(self, rpc_type: str) -> None:
-        if rpc_type == "infura":
+        chosen = (
+            config.get("defaults", "dynamic_loading")
+            if config.has_option("defaults", "dynamic_loading")
+            else "infura"
+        )
+        if chosen == "infura":
             self.set_api_rpc_infura()
-        elif rpc_type == "localhost":
+        elif chosen == "localhost":
             self.set_api_rpc_localhost()
         else:
-            self.set_api_rpc(rpc_type)
+            self.set_api_rpc(chosen)

@@ -1,8 +1,8 @@
 """Facade: contract loading and disassembly.
 
-Reference parity: mythril/mythril/mythril_disassembler.py:23-333 —
-solc version management, loading contracts from bytecode / chain
-address / Solidity source, the `read-storage` RPC helper, and
+Covers mythril/mythril/mythril_disassembler.py — solc binary
+resolution, loading contracts from raw bytecode / a chain address /
+Solidity sources, the `read-storage` slot resolver, and
 function-signature hashing.
 """
 
@@ -31,10 +31,25 @@ from mythril_tpu.support.keccak import keccak256
 
 log = logging.getLogger(__name__)
 
+RPC_DOWN = (
+    "Could not connect to RPC server. Make sure that your node is "
+    "running and that RPC parameters are set correctly."
+)
+
+
+def _rpc_guard(call, *params):
+    """Run an RPC call, converting transport failures to CriticalError."""
+    try:
+        return call(*params)
+    except FileNotFoundError as e:
+        raise CriticalError("IPC error: " + str(e))
+    except ConnectionError:
+        raise CriticalError(RPC_DOWN)
+
 
 class MythrilDisassembler:
-    """Loads and disassembles contracts from files, raw bytecode, or the
-    chain; also answers read-storage queries."""
+    """Loads and disassembles contracts from files, raw bytecode, or
+    the chain; also answers read-storage queries."""
 
     def __init__(
         self,
@@ -43,94 +58,86 @@ class MythrilDisassembler:
         solc_settings_json: str = None,
         enable_online_lookup: bool = False,
     ) -> None:
-        self.solc_binary = self._init_solc_binary(solc_version)
+        self.solc_binary = self._resolve_solc(solc_version)
         self.solc_settings_json = solc_settings_json
         self.eth = eth
         self.enable_online_lookup = enable_online_lookup
-        self.sigs = signatures.SignatureDB(enable_online_lookup=enable_online_lookup)
+        self.sigs = signatures.SignatureDB(
+            enable_online_lookup=enable_online_lookup
+        )
         self.contracts: List[EVMContract] = []
 
     @staticmethod
-    def _init_solc_binary(version: Optional[str]) -> str:
-        """Resolve the solc binary for `version` (proper releases only,
-        as in the reference)."""
+    def _resolve_solc(version: Optional[str]) -> str:
+        """The solc binary for `version` (proper releases only, as in
+        the reference)."""
         if not version:
             return os.environ.get("SOLC") or "solc"
-        solc_binary = util.solc_exists(version)
-        if solc_binary:
-            log.info("Setting the compiler to %s", solc_binary)
-            return solc_binary
-        raise CriticalError(
-            f"The requested solc version ({version}) is not installed."
-            " Install it (e.g. via solcx) or set the SOLC environment variable."
-        )
+        found = util.solc_exists(version)
+        if not found:
+            raise CriticalError(
+                f"The requested solc version ({version}) is not installed."
+                " Install it (e.g. via solcx) or set the SOLC environment"
+                " variable."
+            )
+        log.info("Setting the compiler to %s", found)
+        return found
+
+    # kept under its historical name (tests call it directly)
+    _init_solc_binary = _resolve_solc
+
+    # -- loading -------------------------------------------------------
+    def _adopt(self, contract: EVMContract) -> EVMContract:
+        self.contracts.append(contract)
+        return contract
 
     def load_from_bytecode(
         self, code: str, bin_runtime: bool = False, address: Optional[str] = None
     ) -> Tuple[str, EVMContract]:
         """Register a contract from raw hex bytecode."""
-        if address is None:
-            address = util.get_indexed_address(0)
-        if bin_runtime:
-            self.contracts.append(
-                EVMContract(
-                    code=code,
-                    name="MAIN",
-                    enable_online_lookup=self.enable_online_lookup,
-                )
+        kind = {"code": code} if bin_runtime else {"creation_code": code}
+        contract = self._adopt(
+            EVMContract(
+                name="MAIN",
+                enable_online_lookup=self.enable_online_lookup,
+                **kind,
             )
-        else:
-            self.contracts.append(
-                EVMContract(
-                    creation_code=code,
-                    name="MAIN",
-                    enable_online_lookup=self.enable_online_lookup,
-                )
-            )
-        return address, self.contracts[-1]
+        )
+        return address or util.get_indexed_address(0), contract
 
     def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
         """Fetch a deployed contract's code over RPC."""
         if not re.match(r"0x[a-fA-F0-9]{40}", address):
-            raise CriticalError("Invalid contract address. Expected format is '0x...'.")
-
-        try:
-            code = self.eth.eth_getCode(address)
-        except FileNotFoundError as e:
-            raise CriticalError("IPC error: " + str(e))
-        except ConnectionError:
             raise CriticalError(
-                "Could not connect to RPC server. Make sure that your node is "
-                "running and that RPC parameters are set correctly."
+                "Invalid contract address. Expected format is '0x...'."
             )
+        try:
+            code = _rpc_guard(self.eth.eth_getCode, address)
+        except CriticalError:
+            raise
         except Exception as e:
             raise CriticalError("IPC / RPC error: " + str(e))
 
         if code in ("0x", "0x0"):
             raise CriticalError(
-                "Received an empty response from eth_getCode. Check the contract "
-                "address and verify that you are on the correct chain."
+                "Received an empty response from eth_getCode. Check the "
+                "contract address and verify that you are on the correct chain."
             )
-        self.contracts.append(
+        contract = self._adopt(
             EVMContract(
                 code, name=address, enable_online_lookup=self.enable_online_lookup
             )
         )
-        return address, self.contracts[-1]
+        return address, contract
 
     def load_from_solidity(
         self, solidity_files: List[str]
     ) -> Tuple[str, List[SolidityContract]]:
         """Compile and register every contract in the given files;
         `file.sol:Name` selects one contract."""
-        address = util.get_indexed_address(0)
-        contracts = []
-        for file in solidity_files:
-            if ":" in file:
-                file, contract_name = file.split(":")
-            else:
-                contract_name = None
-
+        loaded = []
+        for entry in solidity_files:
+            file, _, chosen = entry.partition(":")
             file = os.path.expanduser(file)
             try:
                 self.sigs.import_solidity_file(
@@ -138,52 +145,50 @@ class MythrilDisassembler:
                     solc_binary=self.solc_binary,
                     solc_settings_json=self.solc_settings_json,
                 )
-                if contract_name is not None:
-                    contract = SolidityContract(
-                        input_file=file,
-                        name=contract_name,
-                        solc_settings_json=self.solc_settings_json,
-                        solc_binary=self.solc_binary,
+                if chosen:
+                    loaded.append(
+                        self._adopt(
+                            SolidityContract(
+                                input_file=file,
+                                name=chosen,
+                                solc_settings_json=self.solc_settings_json,
+                                solc_binary=self.solc_binary,
+                            )
+                        )
                     )
-                    self.contracts.append(contract)
-                    contracts.append(contract)
                 else:
                     for contract in get_contracts_from_file(
                         input_file=file,
                         solc_settings_json=self.solc_settings_json,
                         solc_binary=self.solc_binary,
                     ):
-                        self.contracts.append(contract)
-                        contracts.append(contract)
+                        loaded.append(self._adopt(contract))
             except FileNotFoundError:
                 raise CriticalError("Input file not found: " + file)
             except CompilerError as e:
-                error_msg = str(e)
-                # point at the pragma when the installed solc mismatches
-                if (
-                    "Error: Source file requires different compiler version"
-                    in error_msg
-                ):
-                    solv_pragma_line = error_msg.split("\n")[-3].split("//")[0]
-                    solv_match = re.findall(
-                        r"[0-9]+\.[0-9]+\.[0-9]+", solv_pragma_line
-                    )
-                    error_suggestion = (
-                        "<version_number>" if len(solv_match) != 1 else solv_match[0]
-                    )
-                    error_msg += (
-                        '\nSolidityVersionMismatch: Try adding the option "--solv '
-                        + error_suggestion
-                        + '"\n'
-                    )
-                raise CriticalError(error_msg)
+                raise CriticalError(self._describe_compiler_error(str(e)))
             except NoContractFoundError:
                 log.error(
                     "The file %s does not contain a compilable contract.", file
                 )
+        return util.get_indexed_address(0), loaded
 
-        return address, contracts
+    @staticmethod
+    def _describe_compiler_error(error_msg: str) -> str:
+        """Suggest a --solv value when the pragma mismatches solc."""
+        if "Error: Source file requires different compiler version" not in error_msg:
+            return error_msg
+        pragma_line = error_msg.split("\n")[-3].split("//")[0]
+        versions = re.findall(r"[0-9]+\.[0-9]+\.[0-9]+", pragma_line)
+        wanted = versions[0] if len(versions) == 1 else "<version_number>"
+        return (
+            error_msg
+            + '\nSolidityVersionMismatch: Try adding the option "--solv '
+            + wanted
+            + '"\n'
+        )
 
+    # -- helpers -------------------------------------------------------
     @staticmethod
     def hash_for_function_signature(func: str) -> str:
         """4-byte selector of a function signature."""
@@ -193,72 +198,47 @@ class MythrilDisassembler:
         self, address: str, params: Optional[List[str]] = None
     ) -> str:
         """Resolve storage slots (plain / array / mapping layouts) and
-        read them over RPC (reference: read-storage helper)."""
-        params = params or []
-        (position, length, mappings) = (0, 1, [])
+        read them over RPC."""
+        slots = self._resolve_slots(params or [])
+        lines = [
+            "{}: {}".format(
+                label, _rpc_guard(self.eth.eth_getStorageAt, address, slot)
+            )
+            for label, slot in slots
+        ]
+        return "\n".join(lines)
+
+    @staticmethod
+    def _resolve_slots(params: List[str]) -> list:
+        """[(label, slot)] for the requested layout."""
         try:
             if params and params[0] == "mapping":
                 if len(params) < 3:
                     raise CriticalError("Invalid number of parameters.")
-                position = int(params[1])
-                position_formatted = position.to_bytes(32, "big")
-                for i in range(2, len(params)):
-                    key = bytes(params[i], "utf8")
-                    key_formatted = key.ljust(32, b"\x00")
-                    mappings.append(
-                        int.from_bytes(
-                            keccak256(key_formatted + position_formatted),
-                            byteorder="big",
-                        )
+                base = int(params[1]).to_bytes(32, "big")
+                keyed = [
+                    int.from_bytes(
+                        keccak256(bytes(key, "utf8").ljust(32, b"\x00") + base),
+                        byteorder="big",
                     )
-                length = len(mappings)
-                if length == 1:
-                    position = mappings[0]
-            else:
-                if len(params) >= 4:
-                    raise CriticalError("Invalid number of parameters.")
-                if len(params) >= 1:
-                    position = int(params[0])
-                if len(params) >= 2:
-                    length = int(params[1])
-                if len(params) == 3 and params[2] == "array":
-                    position_formatted = position.to_bytes(32, "big")
-                    position = int.from_bytes(
-                        keccak256(position_formatted), byteorder="big"
-                    )
+                    for key in params[2:]
+                ]
+                if len(keyed) == 1:
+                    return [(keyed[0], keyed[0])]
+                return [(hex(slot), slot) for slot in keyed]
+
+            if len(params) >= 4:
+                raise CriticalError("Invalid number of parameters.")
+            position = int(params[0]) if len(params) >= 1 else 0
+            length = int(params[1]) if len(params) >= 2 else 1
+            if len(params) == 3 and params[2] == "array":
+                position = int.from_bytes(
+                    keccak256(position.to_bytes(32, "big")), byteorder="big"
+                )
+            if length == 1:
+                return [(position, position)]
+            return [(hex(i), i) for i in range(position, position + length)]
         except ValueError:
             raise CriticalError(
                 "Invalid storage index. Please provide a numeric value."
             )
-
-        outtxt = []
-        try:
-            if length == 1:
-                outtxt.append(
-                    "{}: {}".format(
-                        position, self.eth.eth_getStorageAt(address, position)
-                    )
-                )
-            elif len(mappings) > 0:
-                for mapping_position in mappings:
-                    outtxt.append(
-                        "{}: {}".format(
-                            hex(mapping_position),
-                            self.eth.eth_getStorageAt(address, mapping_position),
-                        )
-                    )
-            else:
-                for i in range(position, position + length):
-                    outtxt.append(
-                        "{}: {}".format(
-                            hex(i), self.eth.eth_getStorageAt(address, i)
-                        )
-                    )
-        except FileNotFoundError as e:
-            raise CriticalError("IPC error: " + str(e))
-        except ConnectionError:
-            raise CriticalError(
-                "Could not connect to RPC server. Make sure that your node is "
-                "running and that RPC parameters are set correctly."
-            )
-        return "\n".join(outtxt)

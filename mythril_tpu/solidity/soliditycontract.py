@@ -1,14 +1,15 @@
 """Solidity files, contracts and source mappings.
 
-Reference parity: mythril/solidity/soliditycontract.py:47-229 — solc
-standard-json compilation, srcmap parsing (offset:length:fileidx per
-instruction, constructor maps included), and `get_source_info` mapping
-a bytecode address back to (file, line, code).
+Covers mythril/solidity/soliditycontract.py: solc standard-json
+compilation, decompression of the solc source map
+(offset:length:fileidx per instruction, constructor map included),
+and `get_source_info` taking a bytecode offset back to
+(file, line, code).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 import mythril_tpu.laser.ethereum.util as helper
 from mythril_tpu.ethereum.evmcontract import EVMContract
@@ -42,22 +43,35 @@ class SourceCodeInfo:
         self.solc_mapping = mapping
 
 
+def _deployable(contract_json: dict) -> bool:
+    return bool(contract_json["evm"]["deployedBytecode"]["object"])
+
+
+def _bytecode_of(contract_json: dict) -> Tuple[str, str, list, list]:
+    """(runtime code, creation code, runtime srcmap, constructor
+    srcmap) out of one contract's standard-json blob."""
+    runtime = contract_json["evm"]["deployedBytecode"]
+    creation = contract_json["evm"]["bytecode"]
+    return (
+        runtime["object"],
+        creation["object"],
+        runtime["sourceMap"].split(";"),
+        creation["sourceMap"].split(";"),
+    )
+
+
 def get_contracts_from_file(input_file, solc_settings_json=None, solc_binary="solc"):
     """Yield a SolidityContract for every deployable contract in the
     file."""
-    data = get_solc_json(
+    compiled = get_solc_json(
         input_file, solc_settings_json=solc_settings_json, solc_binary=solc_binary
     )
     try:
-        for contract_name in data["contracts"][input_file].keys():
-            if len(
-                data["contracts"][input_file][contract_name]["evm"][
-                    "deployedBytecode"
-                ]["object"]
-            ):
+        for name, blob in compiled["contracts"][input_file].items():
+            if _deployable(blob):
                 yield SolidityContract(
                     input_file=input_file,
-                    name=contract_name,
+                    name=name,
                     solc_settings_json=solc_settings_json,
                     solc_binary=solc_binary,
                 )
@@ -71,128 +85,129 @@ class SolidityContract(EVMContract):
     def __init__(
         self, input_file, name=None, solc_settings_json=None, solc_binary="solc"
     ):
-        data = get_solc_json(
+        compiled = get_solc_json(
             input_file, solc_settings_json=solc_settings_json, solc_binary=solc_binary
         )
-
-        self.solidity_files = []
-        self.solc_json = data
+        self.solc_json = compiled
         self.input_file = input_file
+        self.solidity_files = [
+            self._load_source(filename, source_json)
+            for filename, source_json in compiled["sources"].items()
+        ]
 
-        for filename, contract in data["sources"].items():
-            with open(filename, "r", encoding="utf-8") as file:
-                code = file.read()
-                full_contract_src_maps = self.get_full_contract_src_maps(
-                    contract["ast"]
-                )
-                self.solidity_files.append(
-                    SolidityFile(filename, code, full_contract_src_maps)
-                )
-
-        has_contract = False
-        srcmap_constructor = []
-        srcmap = []
-
-        if name:
-            contract = data["contracts"][input_file][name]
-            if len(contract["evm"]["deployedBytecode"]["object"]):
-                code = contract["evm"]["deployedBytecode"]["object"]
-                creation_code = contract["evm"]["bytecode"]["object"]
-                srcmap = contract["evm"]["deployedBytecode"]["sourceMap"].split(";")
-                srcmap_constructor = contract["evm"]["bytecode"]["sourceMap"].split(";")
-                has_contract = True
-        else:
-            # no name given: last deployable contract in the file
-            for contract_name, contract in sorted(
-                data["contracts"][input_file].items()
-            ):
-                if len(contract["evm"]["deployedBytecode"]["object"]):
-                    name = contract_name
-                    code = contract["evm"]["deployedBytecode"]["object"]
-                    creation_code = contract["evm"]["bytecode"]["object"]
-                    srcmap = contract["evm"]["deployedBytecode"]["sourceMap"].split(";")
-                    srcmap_constructor = contract["evm"]["bytecode"][
-                        "sourceMap"
-                    ].split(";")
-                    has_contract = True
-
-        if not has_contract:
+        name, picked = self._pick_contract(
+            compiled["contracts"][input_file], name
+        )
+        if picked is None:
             raise NoContractFoundError
+        code, creation_code, srcmap, srcmap_constructor = _bytecode_of(picked)
 
-        self.mappings = []
-        self.constructor_mappings = []
-        self._get_solc_mappings(srcmap)
-        self._get_solc_mappings(srcmap_constructor, constructor=True)
+        self.mappings: List[SourceMapping] = []
+        self.constructor_mappings: List[SourceMapping] = []
+        self._expand_srcmap(srcmap, self.mappings)
+        self._expand_srcmap(srcmap_constructor, self.constructor_mappings)
 
         super().__init__(code, creation_code, name=name)
+
+    # -- loading helpers ----------------------------------------------
+    @staticmethod
+    def _load_source(filename: str, source_json: dict) -> SolidityFile:
+        with open(filename, "r", encoding="utf-8") as fp:
+            text = fp.read()
+        return SolidityFile(
+            filename,
+            text,
+            SolidityContract.get_full_contract_src_maps(source_json["ast"]),
+        )
+
+    @staticmethod
+    def _pick_contract(contracts: dict, name: Optional[str]):
+        """The named contract, or (without a name) the last deployable
+        contract in the file."""
+        if name:
+            blob = contracts[name]
+            return name, (blob if _deployable(blob) else None)
+        picked = None
+        for candidate, blob in sorted(contracts.items()):
+            if _deployable(blob):
+                name, picked = candidate, blob
+        return name, picked
 
     @staticmethod
     def get_full_contract_src_maps(ast: Dict) -> Set[str]:
         """The whole-contract src mappings (used to recognize compiler-
         generated code)."""
-        source_maps = set()
-        for child in ast.get("nodes", []):
-            if child.get("contractKind"):
-                source_maps.add(child["src"])
-        return source_maps
+        return {
+            child["src"]
+            for child in ast.get("nodes", [])
+            if child.get("contractKind")
+        }
 
+    # -- source mapping ------------------------------------------------
     def get_source_info(self, address, constructor=False):
         """Map a bytecode offset to (file, line, code)."""
-        disassembly = self.creation_disassembly if constructor else self.disassembly
-        mappings = self.constructor_mappings if constructor else self.mappings
-        index = helper.get_instruction_index(disassembly.instruction_list, address)
+        if constructor:
+            disassembly, mappings = self.creation_disassembly, self.constructor_mappings
+        else:
+            disassembly, mappings = self.disassembly, self.mappings
+
+        index = helper.get_instruction_index(
+            disassembly.instruction_list, address
+        )
         if index is None or index >= len(mappings):
             return None
 
-        solidity_file = self.solidity_files[mappings[index].solidity_file_idx]
-        filename = solidity_file.filename
-        offset = mappings[index].offset
-        length = mappings[index].length
-        code = solidity_file.data.encode("utf-8")[offset : offset + length].decode(
-            "utf-8", errors="ignore"
+        entry = mappings[index]
+        source = self.solidity_files[entry.solidity_file_idx]
+        snippet = (
+            source.data.encode("utf-8")[entry.offset : entry.offset + entry.length]
+            .decode("utf-8", errors="ignore")
         )
-        lineno = mappings[index].lineno
-        return SourceCodeInfo(filename, lineno, code, mappings[index].solc_mapping)
+        return SourceCodeInfo(
+            source.filename, entry.lineno, snippet, entry.solc_mapping
+        )
 
-    def _is_autogenerated_code(self, offset: int, length: int, file_index: int) -> bool:
+    def _is_autogenerated_code(
+        self, offset: int, length: int, file_index: int
+    ) -> bool:
         """Compiler-generated code has no real source line."""
-        if file_index == -1:
+        if file_index < 0 or file_index >= len(self.solidity_files):
             return True
-        if file_index >= len(self.solidity_files):
-            return True
-        if (
-            "{}:{}:{}".format(offset, length, file_index)
+        return (
+            f"{offset}:{length}:{file_index}"
             in self.solidity_files[file_index].full_contract_src_maps
-        ):
-            return True
-        return False
+        )
 
-    def _get_solc_mappings(self, srcmap, constructor=False):
-        """Expand a compressed solc source map (empty fields repeat the
-        previous entry)."""
-        mappings = self.constructor_mappings if constructor else self.mappings
-        prev_item = ""
-        offset = length = idx = 0
-        for item in srcmap:
-            if item == "":
-                item = prev_item
-            mapping = item.split(":")
+    def _expand_srcmap(self, srcmap, out: List[SourceMapping]) -> None:
+        """Decompress a solc source map: empty fields inherit from the
+        previous entry."""
+        previous = ""
+        offset = length = file_index = 0
+        for entry in srcmap:
+            entry = entry or previous
+            fields = entry.split(":")
+            if fields and fields[0]:
+                offset = int(fields[0])
+            if len(fields) > 1 and fields[1]:
+                length = int(fields[1])
+            if len(fields) > 2 and fields[2]:
+                file_index = int(fields[2])
 
-            if len(mapping) > 0 and len(mapping[0]) > 0:
-                offset = int(mapping[0])
-            if len(mapping) > 1 and len(mapping[1]) > 0:
-                length = int(mapping[1])
-            if len(mapping) > 2 and len(mapping[2]) > 0:
-                idx = int(mapping[2])
-
-            if self._is_autogenerated_code(offset, length, idx):
+            if self._is_autogenerated_code(offset, length, file_index):
                 lineno = None
             else:
                 lineno = (
-                    self.solidity_files[idx]
-                    .data.encode("utf-8")[0:offset]
-                    .count("\n".encode("utf-8"))
+                    self.solidity_files[file_index]
+                    .data.encode("utf-8")[:offset]
+                    .count(b"\n")
                     + 1
                 )
-            prev_item = item
-            mappings.append(SourceMapping(idx, offset, length, lineno, item))
+            previous = entry
+            out.append(SourceMapping(file_index, offset, length, lineno, entry))
+
+    # historical name kept for API compatibility
+    def _get_solc_mappings(self, srcmap, constructor=False):
+        self._expand_srcmap(
+            srcmap,
+            self.constructor_mappings if constructor else self.mappings,
+        )
