@@ -1,0 +1,241 @@
+"""Device-led symbolic exploration.
+
+The generational frontier loop over the symbolic batch engine
+(symbolic.py): the device executes a wave of lanes and *constructs the
+path constraints on device* (expression arena); the host decodes only
+the frontier branches it wants to flip, asks the on-chip portfolio
+searcher for a witness (CDCL as the completeness fallback), and seeds
+the next wave with the witnesses. Forking at a symbolic JUMPI is the
+flip; dead lanes are compacted away simply by not reseeding them.
+
+Compare analysis/hybrid_fuzz.py, whose flips re-execute the whole path
+prefix through the host object engine — here the arena replaces that
+host replay, so the per-flip cost is one term decode + one solver
+call, and the stepping work all happened on the TPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.laser.batch.arena import ArenaView
+from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
+from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_run
+from mythril_tpu.laser.smt.solver.portfolio import device_check
+from mythril_tpu.laser.smt.solver.solver import lower
+from mythril_tpu.support.model import get_model
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CALLER = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
+DEFAULT_ADDRESS = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE
+
+TRIGGER_KINDS = {
+    Status.INVALID: "assert-violation",
+    Status.ERR_JUMP: "invalid-jump",
+    Status.ERR_STACK: "stack-error",
+}
+
+
+class ExploreStats:
+    """Counters proving the device did the stepping."""
+
+    def __init__(self) -> None:
+        self.device_steps = 0  # lane-steps executed on device
+        self.waves = 0
+        self.arena_nodes = 0
+        self.forks_tried = 0
+        self.forks_feasible = 0
+        self.device_sat = 0  # witnesses found by the on-chip portfolio
+        self.host_sat = 0  # witnesses that needed the CDCL fallback
+        self.branches_covered = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+class DeviceSymbolicExplorer:
+    """Explore one contract's intra-transaction paths on device."""
+
+    def __init__(
+        self,
+        code_hex: str,
+        calldata_len: int = 68,
+        lanes: int = 32,
+        waves: int = 4,
+        flips_per_wave: int = 8,
+        steps_per_wave: int = 2048,
+        portfolio_candidates: int = 64,
+        portfolio_steps: int = 1024,
+        seed: int = 1,
+    ) -> None:
+        self.code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
+        self.code = bytes.fromhex(self.code_hex)
+        self.calldata_len = calldata_len
+        self.lanes = lanes
+        self.waves = waves
+        self.flips_per_wave = flips_per_wave
+        self.steps_per_wave = steps_per_wave
+        self.portfolio_candidates = portfolio_candidates
+        self.portfolio_steps = portfolio_steps
+        self.rng = random.Random(seed)
+
+        self.code_table = make_code_table([self.code])
+        self.covered: Set[Tuple[int, bool]] = set()
+        self.attempted: Set[Tuple[int, bool]] = set()
+        self.corpus: List[bytes] = []
+        self.triggers: Dict[str, List[bytes]] = {}
+        self.stats = ExploreStats()
+
+    # -- seeding -------------------------------------------------------
+    def _selector_seeds(self) -> List[bytes]:
+        from mythril_tpu.disassembler.disassembly import Disassembly
+
+        disassembly = Disassembly(self.code_hex)
+        seeds = [b"\x00" * self.calldata_len]
+        for func_hash in disassembly.func_hashes:
+            selector = bytes.fromhex(func_hash[2:])
+            seeds.append(selector.ljust(self.calldata_len, b"\x00"))
+        while len(seeds) < self.lanes:
+            seeds.append(
+                bytes(
+                    self.rng.randrange(256) for _ in range(self.calldata_len)
+                )
+            )
+        return seeds[: self.lanes]
+
+    # -- solving -------------------------------------------------------
+    def _solve_flip(self, conditions) -> Optional[Dict[str, int]]:
+        """A satisfying assignment for the flipped path, portfolio
+        first (device), CDCL second (complete)."""
+        raw = [c.raw for c in conditions]
+        try:
+            lowered, _ = lower(raw)
+        except Exception as e:
+            log.debug("lowering failed: %s", e)
+            lowered = None
+        if lowered is not None:
+            found = device_check(
+                lowered,
+                candidates=self.portfolio_candidates,
+                steps=self.portfolio_steps,
+            )
+            if found is not None:
+                self.stats.device_sat += 1
+                return found
+        try:
+            model = get_model(
+                tuple(conditions),
+                enforce_execution_time=False,
+                solver_timeout=4000,
+            )
+        except UnsatError:
+            return None
+        except Exception as e:
+            log.debug("fallback solve failed: %s", e)
+            return None
+        self.stats.host_sat += 1
+        return {
+            name: model.assignment.get(name, 0)
+            for name in model.assignment
+        }
+
+    def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
+        data = bytearray(self.calldata_len)
+        for name, value in assignment.items():
+            if name.startswith("cd"):
+                try:
+                    i = int(name[2:])
+                except ValueError:
+                    continue
+                if i < self.calldata_len:
+                    data[i] = value & 0xFF
+        return bytes(data)
+
+    # -- the wave loop -------------------------------------------------
+    def _run_wave(self, inputs: List[bytes]) -> ArenaView:
+        base = make_batch(
+            len(inputs),
+            calldata=inputs,
+            caller=DEFAULT_CALLER,
+            address=DEFAULT_ADDRESS,
+        )
+        out, steps = sym_run(
+            make_sym_batch(base), self.code_table, max_steps=self.steps_per_wave
+        )
+        self.stats.waves += 1
+        self.stats.device_steps += int(steps) * len(inputs)
+        view = ArenaView(out)
+        self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
+
+        status = np.asarray(out.base.status)
+        for i, data in enumerate(inputs):
+            kind = TRIGGER_KINDS.get(int(status[i]))
+            if kind is not None:
+                bucket = self.triggers.setdefault(kind, [])
+                if data not in bucket and len(bucket) < 16:
+                    bucket.append(data)
+            for pc, taken, _tid in view.journal(i):
+                self.covered.add((pc, taken))
+        return view
+
+    def _frontier_flips(self, view: ArenaView, n_inputs: int) -> List[bytes]:
+        """Fork the frontier: for uncovered flipped branch directions,
+        decode the arena constraints and solve."""
+        fresh: List[bytes] = []
+        for lane in range(n_inputs):
+            if len(fresh) >= self.flips_per_wave:
+                break
+            for k, (pc, taken, tid) in enumerate(view.journal(lane)):
+                target = (pc, not taken)
+                if tid <= 0:
+                    continue  # concrete or opaque condition: nothing to flip
+                if target in self.covered or target in self.attempted:
+                    continue
+                self.attempted.add(target)
+                self.stats.forks_tried += 1
+                conditions = view.path_condition(lane, k, flip_last=True)
+                if conditions is None:
+                    continue  # opaque decision upstream
+                assignment = self._solve_flip(conditions)
+                if assignment is None:
+                    continue
+                self.stats.forks_feasible += 1
+                fresh.append(self._witness_bytes(assignment))
+                break
+        return fresh
+
+    def run(self) -> Dict:
+        inputs = self._selector_seeds()
+        for wave_no in range(self.waves):
+            view = self._run_wave(inputs)
+            self.corpus.extend(inputs)
+            if wave_no == self.waves - 1:
+                break  # no next wave to seed; don't waste solver calls
+            fresh = self._frontier_flips(view, len(inputs))
+            if not fresh:
+                break
+            while len(fresh) < self.lanes:
+                parent = self.rng.choice(self.corpus)
+                mutated = bytearray(parent)
+                mutated[self.rng.randrange(len(mutated))] = self.rng.randrange(
+                    256
+                )
+                fresh.append(bytes(mutated))
+            inputs = fresh[: self.lanes]
+
+        self.stats.branches_covered = len(self.covered)
+        return {
+            "stats": self.stats.as_dict(),
+            "covered_branches": sorted(self.covered),
+            "corpus_size": len(self.corpus),
+            "triggers": {
+                kind: [data.hex() for data in bucket]
+                for kind, bucket in self.triggers.items()
+            },
+        }

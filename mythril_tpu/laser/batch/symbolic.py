@@ -1,0 +1,351 @@
+"""Device-side symbolic lanes: taint tracking + the expression arena.
+
+This is the round's centerpiece (SURVEY §7.1 step 4): symbolic values
+live ON DEVICE as node ids into an append-only expression arena. Every
+lane's stack slot, memory byte, storage journal entry and JUMPI
+decision carries a term id alongside its concrete value; ops whose
+operands are symbolic append one arena node per lane per step (dynamic
+compaction via cumsum ranks). The host never re-executes a path to
+learn its constraints — it decodes the arena (see arena.py), which IS
+the symbolic execution transcript.
+
+Term-id convention:
+    0   concrete (the value is just the value)
+    > 0 arena row + 1 (a well-formed symbolic expression)
+    < 0 opaque: symbolic but outside the device expression language
+        (keccak preimages, tainted addresses, arena overflow) — sound
+        to execute concretely, not available for branch flipping.
+
+`sym_step` wraps the concrete `step` kernel: values advance exactly as
+in the concrete engine (the concolic semantics pinned by VMTests), and
+the taint pass runs beside it on the same decoded instruction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mythril_tpu.laser.batch.state import (
+    CALLDATA_CAP,
+    MEM_CAP,
+    STACK_CAP,
+    STORAGE_CAP,
+    CodeTable,
+    StateBatch,
+    Status,
+    make_batch,
+)
+from mythril_tpu.laser.batch.step import step
+from mythril_tpu.ops import u256
+from mythril_tpu.support.opcodes import OPCODES
+
+W = u256.LIMBS
+OPAQUE = jnp.int32(-1)
+
+#: arena rows per batch (shared by all lanes of a wave)
+ARENA_CAP = 32768
+
+_B = {name: entry[0] for name, entry in OPCODES.items()}
+
+#: ops compiled to arena nodes when an operand is symbolic, with arity 2
+NODE_BINOPS = [
+    "ADD", "SUB", "MUL", "DIV", "SDIV", "MOD", "SMOD", "EXP", "SIGNEXTEND",
+    "LT", "GT", "SLT", "SGT", "EQ", "AND", "OR", "XOR", "BYTE", "SHL",
+    "SHR", "SAR",
+]
+#: unary node ops
+NODE_UNOPS = ["ISZERO", "NOT"]
+#: ternary ops degrade to opaque when tainted
+TERNARY_OPS = ["ADDMOD", "MULMOD"]
+
+_IS_BIN = np.zeros(256, bool)
+for _n in NODE_BINOPS:
+    _IS_BIN[_B[_n]] = True
+_IS_UN = np.zeros(256, bool)
+for _n in NODE_UNOPS:
+    _IS_UN[_B[_n]] = True
+_IS_TER = np.zeros(256, bool)
+for _n in TERNARY_OPS:
+    _IS_TER[_B[_n]] = True
+
+_POPS = np.zeros(256, np.int32)
+_PUSHES = np.zeros(256, np.int32)
+_VALID = np.zeros(256, bool)
+for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
+    _POPS[_byte] = _pops
+    _PUSHES[_byte] = _pushes
+    _VALID[_byte] = True
+
+CALLDATALOAD = _B["CALLDATALOAD"]
+CALLDATACOPY = _B["CALLDATACOPY"]
+SHA3 = _B["SHA3"]
+MLOAD, MSTORE, MSTORE8 = _B["MLOAD"], _B["MSTORE"], _B["MSTORE8"]
+SLOAD, SSTORE = _B["SLOAD"], _B["SSTORE"]
+JUMPI = _B["JUMPI"]
+
+
+class SymBatch(NamedTuple):
+    """A StateBatch plus the symbolic shadow state."""
+
+    base: StateBatch
+    stack_tid: jnp.ndarray  # i32[N, STACK_CAP]
+    mem_tid: jnp.ndarray  # i32[N, MEM_CAP]
+    skey_tid: jnp.ndarray  # i32[N, STORAGE_CAP]
+    sval_tid: jnp.ndarray  # i32[N, STORAGE_CAP]
+    br_tid: jnp.ndarray  # i32[N, BRANCH_CAP] condition term per decision
+    # the shared expression arena
+    ar_op: jnp.ndarray  # i32[ARENA_CAP]
+    ar_a: jnp.ndarray  # i32[ARENA_CAP] operand-a term id (0 = concrete)
+    ar_b: jnp.ndarray  # i32[ARENA_CAP]
+    ar_va: jnp.ndarray  # u32[ARENA_CAP, W] operand-a concrete value
+    ar_vb: jnp.ndarray  # u32[ARENA_CAP, W]
+    ar_count: jnp.ndarray  # i32 scalar
+
+
+def make_sym_batch(base: StateBatch) -> SymBatch:
+    n = base.pc.shape[0]
+    return SymBatch(
+        base=base,
+        stack_tid=jnp.zeros((n, STACK_CAP), jnp.int32),
+        mem_tid=jnp.zeros((n, MEM_CAP), jnp.int32),
+        skey_tid=jnp.zeros((n, STORAGE_CAP), jnp.int32),
+        sval_tid=jnp.zeros((n, STORAGE_CAP), jnp.int32),
+        br_tid=jnp.zeros((n, base.br_pc.shape[1]), jnp.int32),
+        ar_op=jnp.zeros((ARENA_CAP,), jnp.int32),
+        ar_a=jnp.zeros((ARENA_CAP,), jnp.int32),
+        ar_b=jnp.zeros((ARENA_CAP,), jnp.int32),
+        ar_va=jnp.zeros((ARENA_CAP, W), jnp.uint32),
+        ar_vb=jnp.zeros((ARENA_CAP, W), jnp.uint32),
+        ar_count=jnp.int32(0),
+    )
+
+
+def _peek2(tids, sp, k):
+    """tids[lane][sp-1-k] for 2-D shadow arrays."""
+    idx = jnp.clip(sp - 1 - k, 0, tids.shape[1] - 1)
+    return jnp.take_along_axis(tids, idx[:, None].astype(jnp.int32), axis=1)[:, 0]
+
+
+def _scatter2(tids, idx, val, mask):
+    hit = (jnp.arange(tids.shape[1])[None, :] == idx[:, None]) & mask[:, None]
+    return jnp.where(hit, val[:, None], tids)
+
+
+def _word_lo(a):
+    lo = a[:, 0] + (a[:, 1] << 16)
+    big = jnp.any(a[:, 2:] != 0, axis=-1) | (lo >= jnp.uint32(1 << 31))
+    return lo.astype(jnp.int32), big
+
+
+def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
+    """One instruction on every lane, with the symbolic shadow pass."""
+    pre = symb.base
+    n = pre.pc.shape[0]
+
+    # --- decode this step's instruction (mirrors step's fetch) --------
+    code_len = code.length[pre.code_id]
+    oob = pre.pc >= code_len
+    pc_safe = jnp.clip(pre.pc, 0, code.ops.shape[1] - 33)
+    op = code.ops[pre.code_id, pc_safe].astype(jnp.int32)
+    pops = jnp.asarray(_POPS)[op]
+    pushes = jnp.asarray(_PUSHES)[op]
+    net_sp = pushes - pops
+    live = pre.active & ~oob
+    ex = (
+        live
+        & jnp.asarray(_VALID)[op]
+        & (pre.sp >= pops)
+        & (pre.sp + net_sp <= STACK_CAP)
+    )
+
+    a_val = _take_word(pre.stack, pre.sp, 0)
+    b_val = _take_word(pre.stack, pre.sp, 1)
+    a_tid = _peek2(symb.stack_tid, pre.sp, 0)
+    b_tid = _peek2(symb.stack_tid, pre.sp, 1)
+    c_tid = _peek2(symb.stack_tid, pre.sp, 2)
+
+    # --- run the concrete kernel --------------------------------------
+    post = step(pre, code)
+
+    # --- classify the symbolic effect ---------------------------------
+    is_bin = jnp.asarray(_IS_BIN)[op]
+    is_un = jnp.asarray(_IS_UN)[op]
+    is_ter = jnp.asarray(_IS_TER)[op]
+    is_cdl = op == CALLDATALOAD
+
+    bin_sym = ex & is_bin & ((a_tid != 0) | (b_tid != 0))
+    un_sym = ex & is_un & (a_tid != 0)
+    cdl_clean = ex & is_cdl & (a_tid == 0)
+
+    # opaque results: operand already opaque, ternary taint, tainted
+    # calldata offsets, tainted keccak windows
+    bin_ok = (a_tid >= 0) & (b_tid >= 0)
+    un_ok = a_tid >= 0
+    mk_node = (bin_sym & bin_ok) | (un_sym & un_ok) | cdl_clean
+    mk_opaque = (
+        (bin_sym & ~bin_ok)
+        | (un_sym & ~un_ok)
+        | (ex & is_ter & ((a_tid != 0) | (b_tid != 0) | (c_tid != 0)))
+        | (ex & is_cdl & (a_tid != 0))
+    )
+
+    # --- memory taints -------------------------------------------------
+    off_i, off_big = _word_lo(a_val)
+    mem_tid = symb.mem_tid
+    j = jnp.arange(MEM_CAP)[None, :]
+    rel = j - off_i[:, None]
+
+    # MLOAD: uniform 32-byte window of one tid propagates; mixed is opaque
+    mload_m = ex & (op == MLOAD) & ~off_big
+    widx = jnp.clip(off_i, 0, MEM_CAP - 32)[:, None] + jnp.arange(32)[None, :]
+    wtids = jnp.take_along_axis(mem_tid, widx, axis=1)
+    w_first = wtids[:, 0]
+    w_uniform = jnp.all(wtids == w_first[:, None], axis=1)
+    w_any = jnp.any(wtids != 0, axis=1)
+    mload_prop = mload_m & w_uniform
+    mload_opq = mload_m & ~w_uniform & w_any
+    mk_opaque = mk_opaque | mload_opq | (ex & (op == MLOAD) & off_big)
+
+    # MSTORE writes the value tid over its window; MSTORE8 degrades
+    mstore_m = ex & (op == MSTORE) & ~off_big
+    inw32 = (rel >= 0) & (rel < 32) & mstore_m[:, None]
+    mem_tid = jnp.where(inw32, b_tid[:, None], mem_tid)
+    m8_m = ex & (op == MSTORE8) & ~off_big
+    m8_tid = jnp.where(b_tid != 0, OPAQUE, 0)
+    mem_tid = jnp.where((rel == 0) & m8_m[:, None], m8_tid[:, None], mem_tid)
+
+    # CALLDATACOPY makes the window opaque bytes (byte-granular calldata
+    # expressions stay host-side); CODECOPY bytes are concrete
+    ccopy_m = ex & (op == CALLDATACOPY)
+    cplen_i, _ = _word_lo(_take_word(pre.stack, pre.sp, 2))
+    inc = (rel >= 0) & (rel < cplen_i[:, None]) & (ccopy_m & ~off_big)[:, None]
+    mem_tid = jnp.where(inc, OPAQUE, mem_tid)
+
+    # SHA3 of a tainted window -> opaque digest
+    sha_m = ex & (op == SHA3) & ~off_big
+    len_i, _ = _word_lo(b_val)
+    insh = (rel >= 0) & (rel < len_i[:, None])
+    sha_tainted = sha_m & jnp.any(
+        jnp.where(insh, mem_tid != 0, False), axis=1
+    )
+    mk_opaque = mk_opaque | sha_tainted
+
+    # --- storage taints ------------------------------------------------
+    skey_tid, sval_tid = symb.skey_tid, symb.sval_tid
+    sload_m = ex & (op == SLOAD)
+    sstore_m = ex & (op == SSTORE)
+    s_cap = pre.storage_keys.shape[1]
+    hit = jnp.all(pre.storage_keys == a_val[:, None, :], axis=-1)
+    hit = hit & (jnp.arange(s_cap)[None, :] < pre.storage_cnt[:, None])
+    any_hit = jnp.any(hit, axis=-1)
+    last = jnp.argmax(jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+    stored_tid = jnp.take_along_axis(sval_tid, last[:, None], axis=1)[:, 0]
+    sload_tid = jnp.where(any_hit, stored_tid, 0)
+    sload_tid = jnp.where(a_tid != 0, OPAQUE, sload_tid)
+    # SSTORE: mirror the slot choice and record the value/key tids
+    slot = jnp.where(any_hit, last, jnp.clip(pre.storage_cnt, 0, s_cap - 1))
+    sval_tid = _scatter2(sval_tid, slot, b_tid, sstore_m)
+    skey_tid = _scatter2(skey_tid, slot, a_tid, sstore_m)
+
+    # --- arena append --------------------------------------------------
+    ranks = jnp.cumsum(mk_node.astype(jnp.int32)) - mk_node.astype(jnp.int32)
+    rows = symb.ar_count + ranks
+    ok = mk_node & (rows < ARENA_CAP)
+    dump = jnp.where(ok, rows, ARENA_CAP + 1)  # OOB rows are dropped
+
+    ar_op = symb.ar_op.at[dump].set(op, mode="drop")
+    ar_a = symb.ar_a.at[dump].set(a_tid, mode="drop")
+    ar_b = symb.ar_b.at[dump].set(b_tid, mode="drop")
+    ar_va = symb.ar_va.at[dump].set(a_val, mode="drop")
+    ar_vb = symb.ar_vb.at[dump].set(b_val, mode="drop")
+    ar_count = jnp.minimum(
+        symb.ar_count + jnp.sum(mk_node.astype(jnp.int32)), ARENA_CAP
+    )
+
+    node_tid = (rows + 1).astype(jnp.int32)
+    overflowed = mk_node & ~ok
+
+    # --- result tid ----------------------------------------------------
+    res_tid = jnp.zeros((n,), jnp.int32)
+    res_tid = jnp.where(mk_node, node_tid, res_tid)
+    res_tid = jnp.where(mk_opaque | overflowed, OPAQUE, res_tid)
+    res_tid = jnp.where(mload_prop, w_first, res_tid)
+    res_tid = jnp.where(sload_m, sload_tid, res_tid)
+
+    # DUP/SWAP move tids with their values
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    dup_n = (op - 0x80).astype(jnp.int32)
+    swap_n = (op - 0x8F).astype(jnp.int32)
+    res_tid = jnp.where(
+        ex & is_dup, _peek2(symb.stack_tid, pre.sp, dup_n), res_tid
+    )
+    deep_tid = _peek2(symb.stack_tid, pre.sp, swap_n)
+    res_tid = jnp.where(ex & is_swap, deep_tid, res_tid)
+
+    # --- stack tid write (mirrors the consolidated stack write) --------
+    res_idx = jnp.where(
+        is_dup, pre.sp, jnp.where(is_swap, pre.sp - 1, pre.sp - pops)
+    )
+    res_idx = jnp.clip(res_idx, 0, STACK_CAP - 1)
+    writes = ex & (pushes > 0)
+    stack_tid = _scatter2(symb.stack_tid, res_idx, res_tid, writes)
+    # SWAP's second slot: the old top's tid sinks to the deep position
+    stack_tid = _scatter2(
+        stack_tid,
+        jnp.clip(pre.sp - 1 - swap_n, 0, STACK_CAP - 1),
+        a_tid,
+        ex & is_swap,
+    )
+
+    # --- branch journal tids -------------------------------------------
+    br_cap = pre.br_pc.shape[1]
+    record = ex & (op == JUMPI) & (pre.br_cnt < br_cap)
+    br_slot = jnp.clip(pre.br_cnt, 0, br_cap - 1)
+    slot_hit = (jnp.arange(br_cap)[None, :] == br_slot[:, None]) & record[:, None]
+    br_tid = jnp.where(slot_hit, b_tid[:, None], symb.br_tid)
+
+    return SymBatch(
+        base=post,
+        stack_tid=stack_tid,
+        mem_tid=mem_tid,
+        skey_tid=skey_tid,
+        sval_tid=sval_tid,
+        br_tid=br_tid,
+        ar_op=ar_op,
+        ar_a=ar_a,
+        ar_b=ar_b,
+        ar_va=ar_va,
+        ar_vb=ar_vb,
+        ar_count=ar_count,
+    )
+
+
+def _take_word(stack, sp, k):
+    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
+    return jnp.take_along_axis(
+        stack, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def sym_run(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
+    """Run every lane to halt (or budget) with the symbolic shadow."""
+
+    def cond(carry):
+        s, i = carry
+        return (i < max_steps) & jnp.any(s.base.status == Status.RUNNING)
+
+    def body(carry):
+        s, i = carry
+        return sym_step(s, code), i + 1
+
+    out, steps = lax.while_loop(cond, body, (symb, jnp.int32(0)))
+    return out, steps
