@@ -144,6 +144,53 @@ def bench_corpus() -> dict:
     }
 
 
+def bench_device_default_path() -> dict:
+    """The default `myth analyze` path with the device engaged: one
+    reference contract analyzed single-process, reporting how much
+    stepping/solving the TPU did (device prepass + portfolio-first
+    feasibility, both on by default off-CPU)."""
+    from pathlib import Path
+
+    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+    target = ref / "tests" / "testdata" / "inputs" / "exceptions.sol.o"
+    if not target.exists():
+        return {}
+
+    import logging
+
+    logging.disable(logging.WARNING)
+    try:
+        from mythril_tpu.analysis.corpus import analyze_corpus
+        from mythril_tpu.laser.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        stats = SolverStatistics()
+        stats.enabled = True
+        t0 = time.perf_counter()
+        results = analyze_corpus(
+            [(target.read_text().strip(), "", target.stem)],
+            transaction_count=2,
+            execution_timeout=CORPUS_TIMEOUT_S,
+            create_timeout=10,
+            processes=1,
+        )
+        dt = time.perf_counter() - t0
+    finally:
+        logging.disable(logging.NOTSET)
+
+    out = {
+        "default_path_wall_s": round(dt, 1),
+        "default_path_issues": len(results[0]["issues"]),
+        "device_sat_verdicts": stats.device_sat_count,
+        "cdcl_sat_verdicts": stats.cdcl_sat_count,
+    }
+    prepass = results[0].get("device_prepass") or {}
+    out.update({f"prepass_{k}": v for k, v in prepass.items()})
+    print(f"bench: default path {out}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     dev = bench_transitions()
     corpus = {}
@@ -151,6 +198,11 @@ def main() -> None:
         corpus = bench_corpus()
     except Exception as e:  # corpus half must not sink the device metric
         print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
+    default_path = {}
+    try:
+        default_path = bench_device_default_path()
+    except Exception as e:
+        print(f"bench: default-path half failed: {e!r}", file=sys.stderr)
 
     record = {
         "metric": "state_transitions_per_sec",
@@ -162,6 +214,7 @@ def main() -> None:
         "n_steps": N_STEPS,
     }
     record.update(corpus)
+    record.update(default_path)
     print(json.dumps(record))
 
 

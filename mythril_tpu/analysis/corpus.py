@@ -35,6 +35,7 @@ def _analyze_one(payload: Tuple) -> Dict:
         loop_bound,
         modules,
         solver_timeout,
+        use_device,
     ) = payload
     try:
         from mythril_tpu.analysis.security import fire_lasers
@@ -44,6 +45,11 @@ def _analyze_one(payload: Tuple) -> Dict:
 
         if solver_timeout:
             args.solver_timeout = solver_timeout
+        if not use_device:
+            # pooled workers must not contend for the one accelerator;
+            # device paths run in-parent (or single-process) only
+            args.device_prepass = "never"
+            args.device_solving = "never"
 
         contract = EVMContract(
             code=code or "", creation_code=creation_code or "", name=name
@@ -61,10 +67,12 @@ def _analyze_one(payload: Tuple) -> Dict:
             compulsory_statespace=False,
         )
         issues = fire_lasers(sym, modules)
+        exploration = getattr(sym, "device_exploration", None)
         return {
             "name": name,
             "issues": [issue.as_dict for issue in issues],
             "states": sym.laser.total_states,
+            "device_prepass": exploration["stats"] if exploration else None,
             "error": None,
         }
     except Exception:
@@ -88,10 +96,14 @@ def analyze_corpus(
     modules: Optional[List[str]] = None,
     solver_timeout: Optional[int] = None,
     processes: Optional[int] = None,
+    use_device: Optional[bool] = None,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
     name), ...] across a process pool; returns one result dict per
     contract ({name, issues, error})."""
+    processes = processes or min(len(contracts), mp.cpu_count())
+    if use_device is None:
+        use_device = processes <= 1 or len(contracts) == 1
     payloads = [
         (
             code,
@@ -106,10 +118,10 @@ def analyze_corpus(
             loop_bound,
             modules,
             solver_timeout,
+            use_device,
         )
         for code, creation_code, name in contracts
     ]
-    processes = processes or min(len(payloads), mp.cpu_count())
     if processes <= 1 or len(payloads) == 1:
         return [_analyze_one(p) for p in payloads]
 

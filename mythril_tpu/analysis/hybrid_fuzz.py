@@ -41,12 +41,14 @@ from mythril_tpu.laser.ethereum.transaction.transaction_models import (
     get_next_transaction_id,
 )
 from mythril_tpu.laser.smt import symbol_factory
+from mythril_tpu.laser.batch.explore import (
+    DEFAULT_ADDRESS as ADDRESS,
+    DEFAULT_CALLER as CALLER,
+    TRIGGER_KINDS,
+)
 from mythril_tpu.support.model import get_model
 
 log = logging.getLogger(__name__)
-
-ADDRESS = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE
-CALLER = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 
 
 class _ReplayAbort(Exception):
@@ -236,13 +238,8 @@ class HybridFuzzer:
         lanes = []
         from mythril_tpu.ops import u256
 
-        _TRIGGER_KINDS = {
-            Status.INVALID: "assert-violation",
-            Status.ERR_JUMP: "invalid-jump",
-            Status.ERR_STACK: "stack-error",
-        }
         for i, data in enumerate(inputs):
-            kind = _TRIGGER_KINDS.get(int(status_arr[i]))
+            kind = TRIGGER_KINDS.get(int(status_arr[i]))
             if kind is not None:
                 bucket = self.triggers.setdefault(kind, [])
                 if data not in bucket and len(bucket) < 16:

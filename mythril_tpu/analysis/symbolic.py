@@ -55,6 +55,16 @@ STRATEGIES = {
 CALL_OPS = ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL")
 
 
+class DeviceExplorationInfo(ExecutionInfo):
+    """Device-prepass counters, surfaced in jsonv2 execution info."""
+
+    def __init__(self, stats: dict) -> None:
+        self.stats = stats
+
+    def as_dict(self):
+        return {"device_symbolic_prepass": self.stats}
+
+
 def _as_address_term(address: Union[int, str, BitVec]) -> BitVec:
     if isinstance(address, str):
         address = int(address, 16)
@@ -123,6 +133,8 @@ class SymExecWrapper:
         for account in self.accounts.values():
             world_state.put_account(account)
 
+        self.device_exploration = self._device_prepass(contract)
+
         if deploys:
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
@@ -141,6 +153,57 @@ class SymExecWrapper:
             self.nodes = self.laser.nodes
             self.edges = self.laser.edges
             self.calls = list(self._digest_calls())
+
+    # -- device symbolic prepass ----------------------------------------
+    def _device_prepass(self, contract):
+        """Explore the contract's runtime code with the device
+        symbolic engine before the host walk (arena + portfolio; see
+        laser/batch/explore.py). Default "auto": runs when an
+        accelerator backend is present. The counters it logs are the
+        proof the TPU did the path-discovery stepping."""
+        mode = getattr(args, "device_prepass", "auto")
+        if mode == "never":
+            return None
+        if mode == "auto":
+            try:
+                import jax
+
+                if jax.default_backend() == "cpu":
+                    return None
+            except Exception:
+                return None
+
+        runtime = getattr(contract, "code", "") or ""
+        if runtime.startswith("0x"):
+            runtime = runtime[2:]
+        if len(runtime) < 8:
+            return None
+        try:
+            from mythril_tpu.laser.batch.explore import DeviceSymbolicExplorer
+
+            explorer = DeviceSymbolicExplorer(
+                runtime, lanes=16, waves=2, steps_per_wave=1024
+            )
+            outcome = explorer.run()
+        except Exception as why:  # the host walk must never be blocked
+            log.debug("device prepass failed: %s", why)
+            return None
+
+        stats = outcome["stats"]
+        log.info(
+            "Device symbolic prepass: %d device steps over %d waves, "
+            "%d arena nodes, %d/%d flips feasible (%d sat on device), "
+            "%d branch directions covered",
+            stats["device_steps"],
+            stats["waves"],
+            stats["arena_nodes"],
+            stats["forks_feasible"],
+            stats["forks_tried"],
+            stats["device_sat"],
+            stats["branches_covered"],
+        )
+        self.laser.execution_info.append(DeviceExplorationInfo(stats))
+        return outcome
 
     # -- setup pieces --------------------------------------------------
     @staticmethod

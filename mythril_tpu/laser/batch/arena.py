@@ -38,7 +38,6 @@ from mythril_tpu.support.opcodes import OPCODES
 
 log = logging.getLogger(__name__)
 
-_B = {name: entry[0] for name, entry in OPCODES.items()}
 _NAME = {entry[0]: name for name, entry in OPCODES.items()}
 
 TT256M1 = 2**256 - 1

@@ -85,7 +85,10 @@ class DeviceSymbolicExplorer:
         self.portfolio_steps = portfolio_steps
         self.rng = random.Random(seed)
 
-        self.code_table = make_code_table([self.code])
+        # bucket the code capacity to powers of two so XLA compiles one
+        # kernel per size class, not one per contract
+        bucket = max(1024, 1 << max(len(self.code) - 1, 1).bit_length())
+        self.code_table = make_code_table([self.code], code_cap=bucket)
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[bytes] = []
@@ -140,10 +143,7 @@ class DeviceSymbolicExplorer:
             log.debug("fallback solve failed: %s", e)
             return None
         self.stats.host_sat += 1
-        return {
-            name: model.assignment.get(name, 0)
-            for name in model.assignment
-        }
+        return dict(model.assignment)
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)

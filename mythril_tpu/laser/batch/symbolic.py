@@ -42,7 +42,7 @@ from mythril_tpu.laser.batch.state import (
     Status,
     make_batch,
 )
-from mythril_tpu.laser.batch.step import step
+from mythril_tpu.laser.batch.step import _word_to_i32, step
 from mythril_tpu.ops import u256
 from mythril_tpu.support.opcodes import OPCODES
 
@@ -85,6 +85,7 @@ for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
 
 CALLDATALOAD = _B["CALLDATALOAD"]
 CALLDATACOPY = _B["CALLDATACOPY"]
+CODECOPY = _B["CODECOPY"]
 SHA3 = _B["SHA3"]
 MLOAD, MSTORE, MSTORE8 = _B["MLOAD"], _B["MSTORE"], _B["MSTORE8"]
 SLOAD, SSTORE = _B["SLOAD"], _B["SSTORE"]
@@ -136,12 +137,6 @@ def _peek2(tids, sp, k):
 def _scatter2(tids, idx, val, mask):
     hit = (jnp.arange(tids.shape[1])[None, :] == idx[:, None]) & mask[:, None]
     return jnp.where(hit, val[:, None], tids)
-
-
-def _word_lo(a):
-    lo = a[:, 0] + (a[:, 1] << 16)
-    big = jnp.any(a[:, 2:] != 0, axis=-1) | (lo >= jnp.uint32(1 << 31))
-    return lo.astype(jnp.int32), big
 
 
 def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
@@ -197,43 +192,57 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     )
 
     # --- memory taints -------------------------------------------------
-    off_i, off_big = _word_lo(a_val)
+    # A tainted (symbolic) offset makes the access location itself
+    # path-dependent; the concolic shadow then degrades to opaque —
+    # the concrete window is what the kernel actually touched, so
+    # poisoning it keeps later reads honest.
+    off_i, off_big = _word_to_i32(a_val)
+    off_sym = a_tid != 0
     mem_tid = symb.mem_tid
     j = jnp.arange(MEM_CAP)[None, :]
     rel = j - off_i[:, None]
 
-    # MLOAD: uniform 32-byte window of one tid propagates; mixed is opaque
+    # MLOAD: uniform 32-byte window of one tid propagates; mixed or
+    # symbolically-addressed reads are opaque
     mload_m = ex & (op == MLOAD) & ~off_big
     widx = jnp.clip(off_i, 0, MEM_CAP - 32)[:, None] + jnp.arange(32)[None, :]
     wtids = jnp.take_along_axis(mem_tid, widx, axis=1)
     w_first = wtids[:, 0]
     w_uniform = jnp.all(wtids == w_first[:, None], axis=1)
     w_any = jnp.any(wtids != 0, axis=1)
-    mload_prop = mload_m & w_uniform
-    mload_opq = mload_m & ~w_uniform & w_any
+    mload_prop = mload_m & w_uniform & ~off_sym
+    mload_opq = mload_m & ((~w_uniform & w_any) | (off_sym & w_any))
     mk_opaque = mk_opaque | mload_opq | (ex & (op == MLOAD) & off_big)
 
-    # MSTORE writes the value tid over its window; MSTORE8 degrades
+    # MSTORE writes the value tid over its window (opaque when the
+    # destination is symbolic); MSTORE8 degrades per byte
     mstore_m = ex & (op == MSTORE) & ~off_big
     inw32 = (rel >= 0) & (rel < 32) & mstore_m[:, None]
-    mem_tid = jnp.where(inw32, b_tid[:, None], mem_tid)
+    st_tid = jnp.where(off_sym & (b_tid != 0), OPAQUE, b_tid)
+    mem_tid = jnp.where(inw32, st_tid[:, None], mem_tid)
     m8_m = ex & (op == MSTORE8) & ~off_big
     m8_tid = jnp.where(b_tid != 0, OPAQUE, 0)
     mem_tid = jnp.where((rel == 0) & m8_m[:, None], m8_tid[:, None], mem_tid)
 
-    # CALLDATACOPY makes the window opaque bytes (byte-granular calldata
-    # expressions stay host-side); CODECOPY bytes are concrete
-    ccopy_m = ex & (op == CALLDATACOPY)
-    cplen_i, _ = _word_lo(_take_word(pre.stack, pre.sp, 2))
-    inc = (rel >= 0) & (rel < cplen_i[:, None]) & (ccopy_m & ~off_big)[:, None]
+    # CALLDATACOPY makes the window opaque bytes (byte-granular
+    # calldata expressions stay host-side); CODECOPY writes concrete
+    # code bytes, which must also CLEAR stale taint over the window
+    cplen_i, _ = _word_to_i32(_take_word(pre.stack, pre.sp, 2))
+    ccopy_m = ex & (op == CALLDATACOPY) & ~off_big
+    inc = (rel >= 0) & (rel < cplen_i[:, None]) & ccopy_m[:, None]
     mem_tid = jnp.where(inc, OPAQUE, mem_tid)
+    codecopy_m = ex & (op == CODECOPY) & ~off_big
+    incc = (rel >= 0) & (rel < cplen_i[:, None]) & codecopy_m[:, None]
+    mem_tid = jnp.where(incc, 0, mem_tid)
 
-    # SHA3 of a tainted window -> opaque digest
+    # SHA3 of a tainted window (or tainted bounds) -> opaque digest
     sha_m = ex & (op == SHA3) & ~off_big
-    len_i, _ = _word_lo(b_val)
+    len_i, _ = _word_to_i32(b_val)
     insh = (rel >= 0) & (rel < len_i[:, None])
-    sha_tainted = sha_m & jnp.any(
-        jnp.where(insh, mem_tid != 0, False), axis=1
+    sha_tainted = sha_m & (
+        jnp.any(jnp.where(insh, mem_tid != 0, False), axis=1)
+        | off_sym
+        | (b_tid != 0)
     )
     mk_opaque = mk_opaque | sha_tainted
 

@@ -420,11 +420,13 @@ def _get_search_fn(K: int, L: int, steps: int):
 
         limb_caps = jnp.maximum((var_widths + LIMB_BITS - 1) // LIMB_BITS, 1)
 
+        P = pool.shape[0]
+
         def body(state):
             X, best_score, key, it, _ = state
-            key, kv, kk, kp, kb = jax.random.split(key, 5)
+            key, kv, kk, kp, kb, kc = jax.random.split(key, 6)
             v = jax.random.randint(kv, (K,), 0, V)
-            kind = jax.random.randint(kk, (K,), 0, 5)
+            kind = jax.random.randint(kk, (K,), 0, 6)
             # only mutate limbs inside the var's width
             limb = jax.random.randint(kp, (K,), 0, L) % limb_caps[v]
             bits = jax.random.randint(
@@ -438,8 +440,10 @@ def _get_search_fn(K: int, L: int, steps: int):
                           0),                              # zero limb
             ).astype(jnp.uint32)
             Xp = X.at[v, cand, limb].set(flipped)
-            # kinds 3/4: whole-var increment / decrement — jumps over
-            # the carry-chain local minima single bit flips get stuck in
+            # whole-var moves: 3/4 increment / decrement jump over the
+            # carry-chain local minima single bit flips get stuck in;
+            # 5 injects a program constant (equalities against wide
+            # literals — actor addresses, selectors — solve in one move)
             rows = X[v, cand, :]                           # [K, L]
             one = jnp.zeros((K, L), dtype=jnp.uint32).at[:, 0].set(1)
             stepped = jnp.where(
@@ -447,9 +451,12 @@ def _get_search_fn(K: int, L: int, steps: int):
                 u256.add(rows, one),
                 u256.sub(rows, one),
             )
+            cidx = jax.random.randint(kc, (K,), 0, max(P, 1))
+            injected = pool[cidx]                          # [K, L]
+            whole = jnp.where((kind == 5)[:, None], injected, stepped)
             Xp = jnp.where(
                 (kind >= 3)[None, :, None],
-                X.at[v, cand, :].set(stepped),
+                X.at[v, cand, :].set(whole),
                 Xp,
             )
             Xp = Xp & vmask[:, None, :]

@@ -22,7 +22,10 @@ from mythril_tpu.laser.smt.model import Model
 from mythril_tpu.laser.smt.solver import native_sat
 from mythril_tpu.laser.smt.solver.bitblast import Blaster
 from mythril_tpu.laser.smt.solver.preprocess import Recon, lower
-from mythril_tpu.laser.smt.solver.solver_statistics import stat_smt_query
+from mythril_tpu.laser.smt.solver.solver_statistics import (
+    SolverStatistics,
+    stat_smt_query,
+)
 
 sat = "sat"
 unsat = "unsat"
@@ -220,6 +223,53 @@ def _collect_vars(lowered: List[terms.Term]):
     return bv_keys, bool_names
 
 
+class _DeviceGate:
+    """Adaptive throttle for the first-line device attempt: always
+    explores early queries, then requires a ≥20% historical hit rate
+    (with periodic re-probes so a workload shift can re-open it)."""
+
+    def __init__(self) -> None:
+        self.tries = 0
+        self.hits = 0
+        self.consults = 0
+
+    def open(self) -> bool:
+        self.consults += 1
+        if self.tries < 8:
+            return True
+        if self.consults % 16 == 0:
+            return True  # periodic re-probe
+        return self.hits >= 0.2 * self.tries
+
+    def hit(self) -> None:
+        self.tries += 1
+        self.hits += 1
+
+    def miss(self) -> None:
+        self.tries += 1
+
+
+_device_gate = _DeviceGate()
+
+
+def device_solving_enabled() -> bool:
+    """First-line on-chip SAT search: on for accelerator backends
+    ("auto"), forceable either way via args.device_solving."""
+    from mythril_tpu.support.support_args import args as _args
+
+    mode = getattr(_args, "device_solving", "auto")
+    if mode == "never":
+        return False
+    if mode == "always":
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
 def check_terms(
     raw_constraints: List[terms.Term], timeout_ms: int = 10_000
 ) -> (str, Optional[Model]):
@@ -229,6 +279,24 @@ def check_terms(
         return unsat, None
     if not lowered:
         return sat, _reconstruct({}, {}, recon, raw_constraints)
+
+    # first-line device attempt: sound on SAT, proves nothing else.
+    # Tiny queries skip it (CDCL answers those faster than a device
+    # dispatch), and a hit-rate tracker backs off when the workload's
+    # queries keep missing, so unsat-heavy phases don't pay the search
+    # cost every time. (VERDICT r1 #10: promote the portfolio from
+    # escape hatch to the default sat path.)
+    if device_solving_enabled() and len(lowered) >= 2 and _device_gate.open():
+        from mythril_tpu.laser.smt.solver import portfolio
+
+        asn = portfolio.device_check(lowered, candidates=32, steps=256)
+        if asn is not None:
+            model = _reconstruct(asn, {}, recon, raw_constraints)
+            if model is not None:
+                _device_gate.hit()
+                SolverStatistics().device_sat_count += 1
+                return sat, model
+        _device_gate.miss()
 
     blaster, native_session = _blast_session()
     import sys
@@ -296,6 +364,7 @@ def check_terms(
     model = _reconstruct(base, bools, recon, raw_constraints)
     if model is None:
         return unknown, None
+    SolverStatistics().cdcl_sat_count += 1
     return sat, model
 
 

@@ -19,12 +19,18 @@ class SolverStatistics(object, metaclass=Singleton):
         self.enabled = False
         self.query_count = 0
         self.solver_time = 0.0
+        # where sat verdicts came from: the on-chip portfolio vs the
+        # native CDCL completeness path
+        self.device_sat_count = 0
+        self.cdcl_sat_count = 0
 
     def __repr__(self):
         return (
             f"Solver statistics:\n"
             f"Query count: {self.query_count}\n"
-            f"Solver time: {self.solver_time}"
+            f"Solver time: {self.solver_time}\n"
+            f"Sat verdicts from device portfolio: {self.device_sat_count}\n"
+            f"Sat verdicts from CDCL: {self.cdcl_sat_count}"
         )
 
 

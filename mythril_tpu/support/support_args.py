@@ -19,6 +19,9 @@ class Args(object, metaclass=Singleton):
         self.call_depth_limit = 3
         self.iprof = False
         self.solver_log = None
+        # "auto" = on when an accelerator backend is present, off on CPU
+        self.device_solving = "auto"  # on-chip portfolio as first-line SAT
+        self.device_prepass = "auto"  # device symbolic exploration prepass
 
 
 args = Args()
