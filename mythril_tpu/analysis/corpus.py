@@ -129,3 +129,86 @@ def analyze_corpus(
     with ctx.Pool(processes=processes) as pool:
         results = pool.map(_analyze_one, payloads)
     return results
+
+
+def mesh_explore_corpus(
+    contracts: List[Tuple[str, str, str]],
+    n_devices: Optional[int] = None,
+    lanes_per_contract: int = 16,
+    max_steps: int = 2048,
+    calldata_len: int = 68,
+    seed: int = 7,
+) -> Dict:
+    """Corpus exploration sharded over a device mesh (SURVEY §2.4's
+    per-contract-loop axis): every contract becomes a stripe of lanes
+    with distinct calldata seeds, the whole wave is one lane-sharded
+    StateBatch, and the mesh splits it over the dp axis — the batched
+    replacement for the reference's sequential per-contract loop.
+
+    Returns {lane_steps, wall_s, lane_steps_per_sec, contracts,
+    lanes, coverage} — used by tools/corpus_bench.py --mesh.
+    """
+    import random
+    import time as _time
+
+    import numpy as np
+
+    from mythril_tpu.laser.batch.run import run
+    from mythril_tpu.laser.batch.seeds import code_cap_bucket, selector_seeds
+    from mythril_tpu.laser.batch.state import make_batch, make_code_table
+    from mythril_tpu.parallel import make_mesh, replicate_table, shard_batch
+
+    rng = random.Random(seed)
+    codes = []
+    seeds_per_code = []
+    for runtime_hex, _creation, _name in contracts:
+        runtime_hex = runtime_hex[2:] if runtime_hex.startswith("0x") else runtime_hex
+        codes.append(bytes.fromhex(runtime_hex))
+        seeds_per_code.append(
+            selector_seeds(runtime_hex, lanes_per_contract, calldata_len, rng)
+        )
+
+    cap = code_cap_bucket(max(len(c) for c in codes))
+    table = make_code_table(codes, code_cap=cap)
+
+    mesh = make_mesh(n_devices)
+    n_dev = mesh.devices.size
+    n_lanes = len(codes) * lanes_per_contract
+    pad = (-n_lanes) % n_dev
+    code_ids = np.array(
+        [i for i in range(len(codes)) for _ in range(lanes_per_contract)]
+        + [0] * pad,
+        dtype=np.int32,
+    )
+    calldata = [d for seeds in seeds_per_code for d in seeds]
+    calldata += [b"\x00" * calldata_len] * pad
+
+    batch = make_batch(len(code_ids), code_ids=code_ids, calldata=calldata)
+    batch = shard_batch(batch, mesh)
+    table = replicate_table(table, mesh)
+
+    # warm the jit cache with the SAME static args (max_steps is a
+    # static jit argument — a different value compiles a different
+    # executable) so the measurement is execution, not compile
+    warm, _ = run(batch, table, max_steps=max_steps)
+    np.asarray(warm.pc)[:1]
+
+    t0 = _time.perf_counter()
+    out, steps = run(batch, table, max_steps=max_steps)
+    seen_host = np.asarray(out.pc_seen)  # the device->host sync point
+    wall = _time.perf_counter() - t0
+    covered = int(
+        (np.unpackbits(seen_host.view(np.uint8), axis=-1) != 0).sum()
+    )
+
+    lane_steps = int(steps) * len(code_ids)
+    return {
+        "devices": int(n_dev),
+        "contracts": len(codes),
+        "lanes": len(code_ids),
+        "steps": int(steps),
+        "lane_steps": lane_steps,
+        "wall_s": round(wall, 3),
+        "lane_steps_per_sec": round(lane_steps / wall, 1),
+        "covered_pc_bits": covered,
+    }

@@ -87,8 +87,10 @@ class DeviceSymbolicExplorer:
 
         # bucket the code capacity to powers of two so XLA compiles one
         # kernel per size class, not one per contract
-        bucket = max(1024, 1 << max(len(self.code) - 1, 1).bit_length())
-        self.code_table = make_code_table([self.code], code_cap=bucket)
+        from mythril_tpu.laser.batch.seeds import code_cap_bucket
+
+        self.code_table = make_code_table(
+            [self.code], code_cap=code_cap_bucket(len(self.code)))
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[bytes] = []
@@ -97,20 +99,11 @@ class DeviceSymbolicExplorer:
 
     # -- seeding -------------------------------------------------------
     def _selector_seeds(self) -> List[bytes]:
-        from mythril_tpu.disassembler.disassembly import Disassembly
+        from mythril_tpu.laser.batch.seeds import selector_seeds
 
-        disassembly = Disassembly(self.code_hex)
-        seeds = [b"\x00" * self.calldata_len]
-        for func_hash in disassembly.func_hashes:
-            selector = bytes.fromhex(func_hash[2:])
-            seeds.append(selector.ljust(self.calldata_len, b"\x00"))
-        while len(seeds) < self.lanes:
-            seeds.append(
-                bytes(
-                    self.rng.randrange(256) for _ in range(self.calldata_len)
-                )
-            )
-        return seeds[: self.lanes]
+        return selector_seeds(
+            self.code_hex, self.lanes, self.calldata_len, self.rng
+        )
 
     # -- solving -------------------------------------------------------
     def _solve_flip(self, conditions) -> Optional[Dict[str, int]]:
@@ -164,6 +157,10 @@ class DeviceSymbolicExplorer:
             calldata=inputs,
             caller=DEFAULT_CALLER,
             address=DEFAULT_ADDRESS,
+            # real-contract shapes: Solidity's free-memory-pointer
+            # idiom and big dispatch tables stay on device
+            mem_cap=16384,
+            storage_cap=128,
         )
         out, steps = sym_run(
             make_sym_batch(base), self.code_table, max_steps=self.steps_per_wave

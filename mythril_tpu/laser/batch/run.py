@@ -18,9 +18,10 @@ from mythril_tpu.laser.batch.state import CodeTable, StateBatch, Status
 from mythril_tpu.laser.batch.step import step
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps", "unroll"))
+@functools.partial(
+    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"))
 def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
-        unroll: int = 1):
+        unroll: int = 1, track_coverage: bool = True):
     """Run all lanes to completion (or step budget). Returns
     (final_batch, steps_executed)."""
 
@@ -31,7 +32,7 @@ def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
     def body(carry):
         b, i = carry
         for _ in range(unroll):
-            b = step(b, code)
+            b = step(b, code, track_coverage=track_coverage)
         return b, i + unroll
 
     out, steps = lax.while_loop(cond, body, (batch, jnp.int32(0)))

@@ -1,0 +1,38 @@
+"""Shared wave-seeding helpers.
+
+One place for the corpus/explorer conventions: which calldata seeds
+open a contract's dispatcher (zero input + every recovered selector,
+padded), and how code capacities bucket to powers of two so XLA
+compiles one kernel per size class.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+
+def code_cap_bucket(max_len: int, floor: int = 1024) -> int:
+    """Smallest power of two >= max_len (and >= floor)."""
+    return max(floor, 1 << max(max_len - 1, 1).bit_length())
+
+
+def selector_seeds(
+    code_hex: str,
+    count: int,
+    calldata_len: int,
+    rng: random.Random,
+) -> List[bytes]:
+    """`count` calldata seeds for a contract: the zero input, one seed
+    per recovered function selector, then random fill."""
+    from mythril_tpu.disassembler.disassembly import Disassembly
+
+    if code_hex.startswith("0x"):
+        code_hex = code_hex[2:]
+    seeds = [b"\x00" * calldata_len]
+    for func_hash in Disassembly(code_hex).func_hashes:
+        selector = bytes.fromhex(func_hash[2:])
+        seeds.append(selector.ljust(calldata_len, b"\x00"))
+    while len(seeds) < count:
+        seeds.append(bytes(rng.randrange(256) for _ in range(calldata_len)))
+    return seeds[:count]

@@ -34,7 +34,9 @@ STACK_CAP = 128  # configurable; EVM max is 1024, real contracts stay shallow
 MEM_CAP = 4096  # bytes of modelled memory per lane
 STORAGE_CAP = 64  # journal entries per lane
 CALLDATA_CAP = 512  # bytes of calldata per lane
-HASH_CAP = 128  # max SHA3 input bytes handled on device (single rate block)
+SHA_RATE = 136  # keccak-256 rate in bytes
+SHA_MAX_BLOCKS = 8  # absorption blocks unrolled in the step kernel
+HASH_CAP = SHA_MAX_BLOCKS * SHA_RATE - 1  # 1087 B of SHA3 input on device
 PC_BITMAP_WORDS = 768  # coverage bitmap words (EVM max code size 24576 / 32)
 BRANCH_CAP = 64  # recorded JUMPI decisions per lane (concolic journal)
 
@@ -143,29 +145,37 @@ def make_batch(
     chainid: int = 1,
     gasprice: int = 10,
     gas_budget: int = 8_000_000,
+    mem_cap: int = MEM_CAP,
+    calldata_cap: int = CALLDATA_CAP,
+    storage_cap: int = STORAGE_CAP,
+    stack_cap: int = STACK_CAP,
 ) -> StateBatch:
-    """Fresh batch at pc=0 with empty stacks and zeroed memory/storage."""
+    """Fresh batch at pc=0 with empty stacks and zeroed memory/storage.
+
+    Capacities are per-batch: the step kernel reads them off the array
+    shapes, so mainnet-shaped workloads pass e.g. mem_cap=24576 while
+    the default stays lean for throughput runs."""
     code_ids = (
         jnp.zeros((n,), jnp.int32)
         if code_ids is None
         else jnp.asarray(code_ids, jnp.int32)
     )
-    cd = np.zeros((n, CALLDATA_CAP), dtype=np.uint8)
+    cd = np.zeros((n, calldata_cap), dtype=np.uint8)
     cds = np.zeros((n,), dtype=np.int32)
     if calldata is not None:
         for i, data in enumerate(calldata):
-            m = min(len(data), CALLDATA_CAP)
+            m = min(len(data), calldata_cap)
             cd[i, :m] = np.frombuffer(bytes(data[:m]), dtype=np.uint8)
             cds[i] = len(data)
     return StateBatch(
         code_id=code_ids,
         pc=jnp.zeros((n,), jnp.int32),
-        stack=jnp.zeros((n, STACK_CAP, u256.LIMBS), jnp.uint32),
+        stack=jnp.zeros((n, stack_cap, u256.LIMBS), jnp.uint32),
         sp=jnp.zeros((n,), jnp.int32),
-        mem=jnp.zeros((n, MEM_CAP), jnp.uint8),
+        mem=jnp.zeros((n, mem_cap), jnp.uint8),
         msize_words=jnp.zeros((n,), jnp.int32),
-        storage_keys=jnp.zeros((n, STORAGE_CAP, u256.LIMBS), jnp.uint32),
-        storage_vals=jnp.zeros((n, STORAGE_CAP, u256.LIMBS), jnp.uint32),
+        storage_keys=jnp.zeros((n, storage_cap, u256.LIMBS), jnp.uint32),
+        storage_vals=jnp.zeros((n, storage_cap, u256.LIMBS), jnp.uint32),
         storage_cnt=jnp.zeros((n,), jnp.int32),
         status=jnp.zeros((n,), jnp.int32),
         gas_min=jnp.zeros((n,), jnp.uint32),

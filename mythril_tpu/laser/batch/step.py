@@ -112,27 +112,6 @@ def _m(mask, x, y):
     return jnp.where(mask.reshape(mask.shape + (1,) * extra), x, y)
 
 
-def _peek(stack, sp, k):
-    """stack[lane][sp-1-k] -> [N, W]."""
-    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
-    return jnp.take_along_axis(
-        stack, idx[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0, :]
-
-
-def _peek_dyn(stack, sp, k):
-    """k per lane (DUP/SWAP)."""
-    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
-    return jnp.take_along_axis(
-        stack, idx[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0, :]
-
-
-def _stack_write(stack, idx, val, mask):
-    oh = (jnp.arange(STACK_CAP)[None, :] == idx[:, None]) & mask[:, None]
-    return jnp.where(oh[:, :, None], val[:, None, :], stack)
-
-
 def _word_to_i32(a):
     """u256 word -> (int32 value, overflow mask). Values >= 2**31 overflow."""
     lo = a[..., 0] + (a[..., 1] << 16)
@@ -145,8 +124,14 @@ def _mem_gas(words):
     return 3 * w + (w * w) // 512
 
 
-def step(batch: StateBatch, code: CodeTable) -> StateBatch:
+def step(batch: StateBatch, code: CodeTable,
+         track_coverage: bool = True) -> StateBatch:
     n = batch.pc.shape[0]
+    # capacities are carried by the batch's array shapes, so callers
+    # size them per workload (make_batch mem_cap=/calldata_cap=/...)
+    mem_cap = batch.mem.shape[1]
+    stack_cap = batch.stack.shape[1]
+    cd_cap = batch.calldata.shape[1]
     lanes = jnp.arange(n)
 
     # ---- fetch -----------------------------------------------------------
@@ -164,7 +149,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     pops = jnp.asarray(_POPS)[op]
     net_sp = jnp.asarray(_NET_SP)[op]
     underflow = batch.sp < pops
-    overflow = batch.sp + net_sp > STACK_CAP
+    overflow = batch.sp + net_sp > stack_cap
 
     is_invalid_op = live & (~valid | (op == INVALID_OP))
     is_unsupported = live & valid & ~supported & (op != INVALID_OP)
@@ -172,9 +157,18 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     ex = live & valid & supported & ~stack_err & (op != INVALID_OP)  # executing
 
     # ---- operands --------------------------------------------------------
-    a = _peek(batch.stack, batch.sp, 0)
-    b = _peek(batch.stack, batch.sp, 1)
-    c = _peek(batch.stack, batch.sp, 2)
+    # one gather for every slot any phase peeks (a/b/c + DUP/SWAP
+    # depths): unfused gathers dominate step latency on this platform
+    dup_n_pre = (op - 0x80).astype(jnp.int32)
+    swap_n_pre = (op - 0x8F).astype(jnp.int32)
+    peek_ks = jnp.stack(
+        [jnp.zeros_like(op), jnp.ones_like(op), 2 * jnp.ones_like(op),
+         dup_n_pre, swap_n_pre], axis=1)  # [n, 5]
+    peek_idx = jnp.clip(batch.sp[:, None] - 1 - peek_ks, 0, stack_cap - 1)
+    peeked = jnp.take_along_axis(
+        batch.stack, peek_idx[:, :, None].astype(jnp.int32), axis=1)
+    a, b, c = peeked[:, 0], peeked[:, 1], peeked[:, 2]
+    dup_val, swap_deep_val = peeked[:, 3], peeked[:, 4]
 
     status = batch.status
     status = jnp.where(halt_oob, Status.STOPPED, status)
@@ -190,7 +184,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     is_swap = (op >= 0x90) & (op <= 0x9F)
     res_idx = jnp.where(
         is_dup, batch.sp, jnp.where(is_swap, batch.sp - 1, batch.sp - pops))
-    res_idx = jnp.clip(res_idx, 0, STACK_CAP - 1)
+    res_idx = jnp.clip(res_idx, 0, stack_cap - 1)
 
     mem = batch.mem
     msize = batch.msize_words
@@ -332,10 +326,10 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     # ---- CALLDATALOAD ----------------------------------------------------
     cdl_mask = ex & (op == CALLDATALOAD)
     off_i, off_big = _word_to_i32(a)
-    cd_idx = jnp.clip(off_i[:, None], 0, CALLDATA_CAP) + jnp.arange(32)[None, :]
-    cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < CALLDATA_CAP)
+    cd_idx = jnp.clip(off_i[:, None], 0, cd_cap) + jnp.arange(32)[None, :]
+    cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < cd_cap)
     cd_bytes = jnp.take_along_axis(
-        batch.calldata, jnp.clip(cd_idx, 0, CALLDATA_CAP - 1), axis=1)
+        batch.calldata, jnp.clip(cd_idx, 0, cd_cap - 1), axis=1)
     cd_bytes = jnp.where(cd_in, cd_bytes, 0).astype(jnp.uint32)
     cd_word = u256.bytes_to_word(cd_bytes)
     res_val, res_mask = put(
@@ -353,16 +347,14 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
 
     # ---- DUP / SWAP ------------------------------------------------------
     dup_mask = ex & (op >= 0x80) & (op <= 0x8F)
-    dup_n = (op - 0x80).astype(jnp.int32)
-    res_val, res_mask = put(
-        res_val, res_mask, dup_mask, _peek_dyn(batch.stack, batch.sp, dup_n))
+    dup_n = dup_n_pre
+    res_val, res_mask = put(res_val, res_mask, dup_mask, dup_val)
 
     swap_mask = ex & (op >= 0x90) & (op <= 0x9F)
-    swap_n = (op - 0x8F).astype(jnp.int32)
-    swap_deep = _peek_dyn(batch.stack, batch.sp, swap_n)
-    # top goes to the deep slot via a dedicated scatter; deep value goes to
-    # the top through the consolidated result write
-    res_val, res_mask = put(res_val, res_mask, swap_mask, swap_deep)
+    swap_n = swap_n_pre
+    # top goes to the deep slot via the fused second write below; deep
+    # value goes to the top through the consolidated result write
+    res_val, res_mask = put(res_val, res_mask, swap_mask, swap_deep_val)
 
     BIGOFF = jnp.int32(1 << 29)  # stands in for any offset/len >= 2**31
 
@@ -371,7 +363,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
         """Memory expansion accounting + capacity check.
 
         Zero-length accesses never expand memory (EVM semantics), so
-        huge offsets with len 0 are fine. Accesses past MEM_CAP whose
+        huge offsets with len 0 are fine. Accesses past mem_cap whose
         true expansion gas provably exceeds the lane's remaining budget
         halt with ERR_OOG — the genuine EVM outcome — instead of the
         model-capacity status; the gas is estimated in float32 (w up to
@@ -385,7 +377,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
         )
         end = off_c + nb
         nz = mask & (nb > 0)
-        over = nz & (end > MEM_CAP)
+        over = nz & (end > mem_cap)
         wf = ((end + 31) // 32).astype(jnp.float32)
         # EVM charges the delta above the already-paid size, not the
         # absolute cost of the new size
@@ -420,27 +412,62 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
 
     def do_sha3(args):
         res_val, res_mask = args
-        block_idx = jnp.clip(off_i, 0, MEM_CAP)[:, None] + jnp.arange(136)[None, :]
-        inb = (jnp.arange(136)[None, :] < len_i[:, None]) & (block_idx < MEM_CAP)
-        raw = jnp.take_along_axis(mem, jnp.clip(block_idx, 0, MEM_CAP - 1), axis=1)
-        raw = jnp.where(inb, raw, 0).astype(jnp.uint32)
-        # multi-rate padding at dynamic position: 0x01 at len, 0x80 at 135
-        raw = raw | jnp.where(jnp.arange(136)[None, :] == len_i[:, None], 0x01, 0)
-        raw = raw.at[:, 135].set(raw[:, 135] | 0x80)
-        lanes8 = raw.reshape(n, 17, 8)
-        blo = (lanes8[..., 0] | (lanes8[..., 1] << 8) | (lanes8[..., 2] << 16)
-               | (lanes8[..., 3] << 24))
-        bhi = (lanes8[..., 4] | (lanes8[..., 5] << 8) | (lanes8[..., 6] << 16)
-               | (lanes8[..., 7] << 24))
-        lo = jnp.zeros((n, 25), jnp.uint32).at[:, :17].set(blo)
-        hi = jnp.zeros((n, 25), jnp.uint32).at[:, :17].set(bhi)
-        lo, hi = keccak_f(lo, hi)
+        from mythril_tpu.laser.batch.state import SHA_MAX_BLOCKS, SHA_RATE
+
+        # per-lane padded length in rate blocks (>=1); lanes absorb
+        # their own number of blocks and the digest is captured when
+        # each lane's last block has been permuted
+        n_blocks = (len_i + 1 + SHA_RATE - 1) // SHA_RATE
+        last_pad = n_blocks * SHA_RATE - 1  # absolute 0x80 position
+
+        def absorb(blk, lo, hi):
+            pos = blk * SHA_RATE + jnp.arange(SHA_RATE)[None, :]
+            block_idx = jnp.clip(off_i, 0, mem_cap)[:, None] + pos
+            inb = (pos < len_i[:, None]) & (block_idx < mem_cap)
+            raw = jnp.take_along_axis(
+                mem, jnp.clip(block_idx, 0, mem_cap - 1), axis=1)
+            raw = jnp.where(inb, raw, 0).astype(jnp.uint32)
+            # multi-rate padding: 0x01 at len, 0x80 at the final byte
+            raw = raw | jnp.where(pos == len_i[:, None], 0x01, 0)
+            raw = raw | jnp.where(pos == last_pad[:, None], 0x80, 0)
+            lanes8 = raw.reshape(n, 17, 8)
+            blo = (lanes8[..., 0] | (lanes8[..., 1] << 8)
+                   | (lanes8[..., 2] << 16) | (lanes8[..., 3] << 24))
+            bhi = (lanes8[..., 4] | (lanes8[..., 5] << 8)
+                   | (lanes8[..., 6] << 16) | (lanes8[..., 7] << 24))
+            active_blk = (blk < n_blocks)[:, None]
+            lo = jnp.where(
+                active_blk, lo.at[:, :17].set(lo[:, :17] ^ blo), lo)
+            hi = jnp.where(
+                active_blk, hi.at[:, :17].set(hi[:, :17] ^ bhi), hi)
+            plo, phi = keccak_f(lo, hi)
+            return (jnp.where(active_blk, plo, lo),
+                    jnp.where(active_blk, phi, hi))
+
+        # block 0 always runs; later blocks are whole-batch gated so
+        # the dominant single-block case (mapping slots) pays for one
+        # permutation, and the final state is captured per lane
+        lo = jnp.zeros((n, 25), jnp.uint32)
+        hi = jnp.zeros((n, 25), jnp.uint32)
+        lo, hi = absorb(0, lo, hi)
+        flo, fhi = lo, hi
+        for blk in range(1, SHA_MAX_BLOCKS):
+            lo, hi = lax.cond(
+                jnp.any(sha_ok & (n_blocks > blk)),
+                lambda args: absorb(blk, *args),
+                lambda args: args,
+                (lo, hi),
+            )
+            done_now = (n_blocks == blk + 1)[:, None]
+            flo = jnp.where(done_now, lo, flo)
+            fhi = jnp.where(done_now, hi, fhi)
+
         by = []
         for lane_i in range(4):
-            for half, arr in ((0, lo), (1, hi)):
+            for half, arr in ((0, flo), (1, fhi)):
                 for j in range(4):
                     by.append((arr[:, lane_i] >> (8 * j)) & 0xFF)
-        digest = jnp.stack(by, axis=-1)  # [n, 32] bytes, lane-ordered LE
+        digest = jnp.stack(by, axis=-1)  # [n, 32] bytes, LE lanes
         word = u256.bytes_to_word(digest)
         return put(res_val, res_mask, sha_ok, word)
 
@@ -460,7 +487,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
 
     def do_mload(args):
         res_val, res_mask = args
-        idx = jnp.clip(off_i, 0, MEM_CAP - 32)[:, None] + jnp.arange(32)[None, :]
+        idx = jnp.clip(off_i, 0, mem_cap - 32)[:, None] + jnp.arange(32)[None, :]
         byts = jnp.take_along_axis(mem, idx, axis=1).astype(jnp.uint32)
         return put(res_val, res_mask, mload_ok, u256.bytes_to_word(byts))
 
@@ -473,7 +500,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
         msize, gas_dyn_min, gas_dyn_max, status)
 
     def do_mstore(mem):
-        j = jnp.arange(MEM_CAP)[None, :]
+        j = jnp.arange(mem_cap)[None, :]
         rel = j - off_i[:, None]
         inw = (rel >= 0) & (rel < 32) & mstore_ok[:, None]
         wbytes = u256.word_to_bytes(b)  # [n, 32]
@@ -489,7 +516,7 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
         msize, gas_dyn_min, gas_dyn_max, status)
 
     def do_mstore8(mem):
-        j = jnp.arange(MEM_CAP)[None, :]
+        j = jnp.arange(mem_cap)[None, :]
         hit = (j == off_i[:, None]) & m8_ok[:, None]
         return jnp.where(hit, (b[:, 0] & 0xFF).astype(jnp.uint8)[:, None], mem)
 
@@ -512,14 +539,14 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     gas_dyn_max = gas_dyn_max + 3 * copy_words
 
     def do_copy(mem):
-        j = jnp.arange(MEM_CAP)[None, :]
+        j = jnp.arange(mem_cap)[None, :]
         rel = j - dst_i[:, None]
         inw = (rel >= 0) & (rel < cplen_i[:, None]) & copy_ok[:, None]
         sidx = src_i[:, None] + rel
         # calldata source
-        cd_ok = (sidx >= 0) & (sidx < batch.calldatasize[:, None]) & (sidx < CALLDATA_CAP)
+        cd_ok = (sidx >= 0) & (sidx < batch.calldatasize[:, None]) & (sidx < cd_cap)
         from_cd = jnp.take_along_axis(
-            batch.calldata, jnp.clip(sidx, 0, CALLDATA_CAP - 1), axis=1)
+            batch.calldata, jnp.clip(sidx, 0, cd_cap - 1), axis=1)
         from_cd = jnp.where(cd_ok, from_cd, 0)
         # code source
         co_ok = (sidx >= 0) & (sidx < code_len[:, None])
@@ -621,15 +648,30 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     pc_new = jnp.where(ex & still_running, pc_new, batch.pc)
 
     # ---- consolidated stack/sp write ------------------------------------
-    stack = _stack_write(batch.stack, res_idx, res_val, res_mask & ex)
-    # SWAP second slot: old top -> deep position
-    stack = _stack_write(
-        stack, jnp.clip(batch.sp - 1 - swap_n, 0, STACK_CAP - 1), a, swap_mask)
-    sp = jnp.where(ex, batch.sp + net_sp, batch.sp)
+    # an op that degraded mid-step (capacity -> UNSUPPORTED/ERR_MEM)
+    # must leave the lane exactly AT the instruction: no sp delta, no
+    # static gas — the host engine re-executes it on takeover
+    interrupted = ex & (
+        (status == Status.UNSUPPORTED) | (status == Status.ERR_MEM)
+    )
+    effective = ex & ~interrupted
+    # one fused pass over the stack: result slot + SWAP's deep slot
+    slot_ids = jnp.arange(stack_cap)[None, :]
+    oh_res = (slot_ids == res_idx[:, None]) & (res_mask & effective)[:, None]
+    swap_idx = jnp.clip(batch.sp - 1 - swap_n, 0, stack_cap - 1)
+    oh_swap = (slot_ids == swap_idx[:, None]) & (swap_mask & ~interrupted)[:, None]
+    stack = jnp.where(
+        oh_res[:, :, None], res_val[:, None, :],
+        jnp.where(oh_swap[:, :, None], a[:, None, :], batch.stack))
+    sp = jnp.where(effective, batch.sp + net_sp, batch.sp)
 
     # ---- gas -------------------------------------------------------------
-    gas_min = batch.gas_min + jnp.where(ex, jnp.asarray(_GAS_MIN)[op], 0) + gas_dyn_min
-    gas_max = batch.gas_max + jnp.where(ex, jnp.asarray(_GAS_MAX)[op], 0) + gas_dyn_max
+    gas_min = (batch.gas_min
+               + jnp.where(effective, jnp.asarray(_GAS_MIN)[op], 0)
+               + gas_dyn_min)
+    gas_max = (batch.gas_max
+               + jnp.where(effective, jnp.asarray(_GAS_MAX)[op], 0)
+               + gas_dyn_max)
     # out-of-gas: even the minimum-cost path exceeded this lane's budget
     # (reference: OutOfGasException via check_gas, machine_state.py:83-264)
     oog = active & (gas_min > batch.gas_budget) & (status != Status.UNSUPPORTED)
@@ -650,15 +692,21 @@ def step(batch: StateBatch, code: CodeTable) -> StateBatch:
     br_cnt = batch.br_cnt + record.astype(jnp.int32)
 
     # coverage bitmap: mark this step's pc for every executing lane
-    word_idx = jnp.clip(batch.pc // 32, 0, batch.pc_seen.shape[1] - 1)
-    bit = (jnp.uint32(1) << (batch.pc % 32).astype(jnp.uint32))
-    seen_words = jnp.take_along_axis(batch.pc_seen, word_idx[:, None], axis=1)[:, 0]
-    seen_words = jnp.where(ex, seen_words | bit, seen_words)
-    pc_seen = jnp.where(
-        jnp.arange(batch.pc_seen.shape[1])[None, :] == word_idx[:, None],
-        seen_words[:, None],
-        batch.pc_seen,
-    )
+    # (the fuzz/explore loops read it; conformance and the throughput
+    # path turn it off — it is a whole extra pass per step)
+    if track_coverage:
+        word_idx = jnp.clip(batch.pc // 32, 0, batch.pc_seen.shape[1] - 1)
+        bit = (jnp.uint32(1) << (batch.pc % 32).astype(jnp.uint32))
+        seen_words = jnp.take_along_axis(
+            batch.pc_seen, word_idx[:, None], axis=1)[:, 0]
+        seen_words = jnp.where(ex, seen_words | bit, seen_words)
+        pc_seen = jnp.where(
+            jnp.arange(batch.pc_seen.shape[1])[None, :] == word_idx[:, None],
+            seen_words[:, None],
+            batch.pc_seen,
+        )
+    else:
+        pc_seen = batch.pc_seen
 
     return batch._replace(
         pc=pc_new,

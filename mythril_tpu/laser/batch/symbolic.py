@@ -114,10 +114,10 @@ def make_sym_batch(base: StateBatch) -> SymBatch:
     n = base.pc.shape[0]
     return SymBatch(
         base=base,
-        stack_tid=jnp.zeros((n, STACK_CAP), jnp.int32),
-        mem_tid=jnp.zeros((n, MEM_CAP), jnp.int32),
-        skey_tid=jnp.zeros((n, STORAGE_CAP), jnp.int32),
-        sval_tid=jnp.zeros((n, STORAGE_CAP), jnp.int32),
+        stack_tid=jnp.zeros((n, base.stack.shape[1]), jnp.int32),
+        mem_tid=jnp.zeros((n, base.mem.shape[1]), jnp.int32),
+        skey_tid=jnp.zeros((n, base.storage_keys.shape[1]), jnp.int32),
+        sval_tid=jnp.zeros((n, base.storage_keys.shape[1]), jnp.int32),
         br_tid=jnp.zeros((n, base.br_pc.shape[1]), jnp.int32),
         ar_op=jnp.zeros((ARENA_CAP,), jnp.int32),
         ar_a=jnp.zeros((ARENA_CAP,), jnp.int32),
@@ -143,6 +143,8 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     """One instruction on every lane, with the symbolic shadow pass."""
     pre = symb.base
     n = pre.pc.shape[0]
+    mem_cap = pre.mem.shape[1]
+    stack_cap = pre.stack.shape[1]
 
     # --- decode this step's instruction (mirrors step's fetch) --------
     code_len = code.length[pre.code_id]
@@ -157,7 +159,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         live
         & jnp.asarray(_VALID)[op]
         & (pre.sp >= pops)
-        & (pre.sp + net_sp <= STACK_CAP)
+        & (pre.sp + net_sp <= stack_cap)
     )
 
     a_val = _take_word(pre.stack, pre.sp, 0)
@@ -199,13 +201,13 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     off_i, off_big = _word_to_i32(a_val)
     off_sym = a_tid != 0
     mem_tid = symb.mem_tid
-    j = jnp.arange(MEM_CAP)[None, :]
+    j = jnp.arange(mem_cap)[None, :]
     rel = j - off_i[:, None]
 
     # MLOAD: uniform 32-byte window of one tid propagates; mixed or
     # symbolically-addressed reads are opaque
     mload_m = ex & (op == MLOAD) & ~off_big
-    widx = jnp.clip(off_i, 0, MEM_CAP - 32)[:, None] + jnp.arange(32)[None, :]
+    widx = jnp.clip(off_i, 0, mem_cap - 32)[:, None] + jnp.arange(32)[None, :]
     wtids = jnp.take_along_axis(mem_tid, widx, axis=1)
     w_first = wtids[:, 0]
     w_uniform = jnp.all(wtids == w_first[:, None], axis=1)
@@ -303,13 +305,13 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     res_idx = jnp.where(
         is_dup, pre.sp, jnp.where(is_swap, pre.sp - 1, pre.sp - pops)
     )
-    res_idx = jnp.clip(res_idx, 0, STACK_CAP - 1)
+    res_idx = jnp.clip(res_idx, 0, stack_cap - 1)
     writes = ex & (pushes > 0)
     stack_tid = _scatter2(symb.stack_tid, res_idx, res_tid, writes)
     # SWAP's second slot: the old top's tid sinks to the deep position
     stack_tid = _scatter2(
         stack_tid,
-        jnp.clip(pre.sp - 1 - swap_n, 0, STACK_CAP - 1),
+        jnp.clip(pre.sp - 1 - swap_n, 0, stack_cap - 1),
         a_tid,
         ex & is_swap,
     )
@@ -338,7 +340,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
 
 
 def _take_word(stack, sp, k):
-    idx = jnp.clip(sp - 1 - k, 0, STACK_CAP - 1)
+    idx = jnp.clip(sp - 1 - k, 0, stack.shape[1] - 1)
     return jnp.take_along_axis(
         stack, idx[:, None, None].astype(jnp.int32), axis=1
     )[:, 0, :]

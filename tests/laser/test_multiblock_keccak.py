@@ -1,0 +1,61 @@
+"""Multi-block SHA3 in the step kernel vs the pure-python oracle.
+
+The device absorbs up to SHA_MAX_BLOCKS rate blocks per SHA3 (state.py),
+covering every size class the padding rules distinguish: empty input,
+intra-block, exactly rate-1 (the 0x81 shared pad byte), exact rate,
+rate+1, and multi-block.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.batch.run import run
+from mythril_tpu.laser.batch.state import (
+    Status,
+    make_batch,
+    make_code_table,
+    storage_dict,
+)
+from mythril_tpu.support.keccak import keccak256
+
+SIZES = [0, 1, 32, 64, 135, 136, 137, 272, 500, 1000]
+
+
+def _sha_program(length: int) -> bytes:
+    """CALLDATACOPY(0,0,L); SSTORE(0, SHA3(0,L)); STOP"""
+
+    def push(v):
+        return bytes([0x60, v]) if v < 256 else bytes([0x61, v >> 8, v & 0xFF])
+
+    return (
+        push(length) + push(0) + push(0) + bytes([0x37])
+        + push(length) + push(0) + bytes([0x20])
+        + push(0) + bytes([0x55, 0x00])
+    )
+
+
+@pytest.fixture(scope="module")
+def outcomes():
+    rng = np.random.default_rng(3)
+    datas = [
+        bytes(rng.integers(0, 256, max(L, 1), dtype=np.uint8).tolist())[:L]
+        for L in SIZES
+    ]
+    table = make_code_table([_sha_program(L) for L in SIZES])
+    batch = make_batch(
+        len(SIZES),
+        code_ids=np.arange(len(SIZES)),
+        calldata=datas,
+        calldata_cap=1024,
+        mem_cap=2048,
+    )
+    out, _ = run(batch, table, max_steps=64)
+    return datas, out
+
+
+@pytest.mark.parametrize("i", range(len(SIZES)))
+def test_digest_matches_oracle(i, outcomes):
+    datas, out = outcomes
+    assert int(out.status[i]) == Status.STOPPED
+    got = storage_dict(out, i).get(0, 0)
+    assert got == int.from_bytes(keccak256(datas[i]), "big")

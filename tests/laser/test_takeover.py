@@ -1,0 +1,70 @@
+"""Host takeover: device lanes resumed mid-frame by the object engine.
+
+The device engine marks CALL-family / over-capacity work UNSUPPORTED
+and stops AT the instruction; takeover.py lifts the lane (pc, stack,
+memory, storage journal, gas bounds) into a host GlobalState and the
+LASER engine finishes the transaction with full reference semantics.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.batch.run import run
+from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
+from mythril_tpu.laser.batch.takeover import resume_on_host
+
+# store sha256("") via the precompile at address 2, then return it:
+#   CALL(gas=50000, to=2, value=0, in=0/0, out=0/32); SSTORE(0, M[0])
+SHA256_CALL = bytes(
+    [0x60, 0x20,            # PUSH1 32    (out size)
+     0x60, 0x00,            # PUSH1 0     (out offset)
+     0x60, 0x00,            # PUSH1 0     (in size)
+     0x60, 0x00,            # PUSH1 0     (in offset)
+     0x60, 0x00,            # PUSH1 0     (value)
+     0x60, 0x02,            # PUSH1 2     (sha256 precompile)
+     0x61, 0xC3, 0x50,      # PUSH2 50000 (gas)
+     0xF1,                  # CALL
+     0x50,                  # POP retval
+     0x60, 0x00, 0x51,      # MLOAD(0)
+     0x60, 0x00, 0x55,      # SSTORE(0, digest)
+     0x00]                  # STOP
+)
+
+SHA256_EMPTY = int(
+    "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855", 16
+)
+
+
+def test_call_lane_resumes_on_host():
+    table = make_code_table([SHA256_CALL])
+    batch = make_batch(1, gas_budget=1_000_000)
+    out, _ = run(batch, table, max_steps=64)
+    assert int(out.status[0]) == Status.UNSUPPORTED  # stopped AT the CALL
+    # the CALL's seven operands are still on the stack, untouched
+    assert int(out.sp[0]) == 7
+
+    outcome = resume_on_host(SHA256_CALL.hex(), out, 0)
+    assert outcome is not None and outcome["open"]
+    assert outcome["storage"] == {0: SHA256_EMPTY}
+
+
+def test_journal_and_memory_survive_the_lift():
+    # SSTORE(5, 0xAB); MSTORE(0, 0xCD); then hit a CALL -> takeover;
+    # host finishes with SSTORE(6, M[0])
+    code = bytes(
+        [0x60, 0xAB, 0x60, 0x05, 0x55,        # SSTORE(5, 0xAB)
+         0x60, 0xCD, 0x60, 0x00, 0x52,        # MSTORE(0, 0xCD)
+         0x60, 0x00, 0x60, 0x00, 0x60, 0x00,  # out sz/off, in sz
+         0x60, 0x00, 0x60, 0x00, 0x60, 0x02,  # in off, value, to=2
+         0x61, 0xC3, 0x50, 0xF1, 0x50,        # gas, CALL, POP
+         0x60, 0x00, 0x51, 0x60, 0x06, 0x55,  # SSTORE(6, MLOAD(0))
+         0x00]
+    )
+    table = make_code_table([code])
+    batch = make_batch(1, gas_budget=1_000_000)
+    out, _ = run(batch, table, max_steps=64)
+    assert int(out.status[0]) == Status.UNSUPPORTED
+
+    outcome = resume_on_host(code.hex(), out, 0)
+    assert outcome is not None and outcome["open"]
+    assert outcome["storage"] == {5: 0xAB, 6: 0xCD}

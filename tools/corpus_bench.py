@@ -31,6 +31,10 @@ def main() -> None:
     parser.add_argument("--processes", type=int, default=os.cpu_count())
     parser.add_argument("--timeout", type=int, default=45)
     parser.add_argument("--tx", type=int, default=2)
+    parser.add_argument(
+        "--mesh", type=int, default=0, metavar="N",
+        help="shard corpus exploration over an N-device mesh instead of "
+             "the analyzer pipeline; reports 1-device vs N-device scaling")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.CRITICAL)
@@ -39,6 +43,21 @@ def main() -> None:
     ]
     if not contracts:
         print(json.dumps({"error": "no corpus; set MYTHRIL_REFERENCE_DIR"}))
+        return
+
+    if args.mesh:
+        from mythril_tpu.analysis.corpus import mesh_explore_corpus
+
+        single = mesh_explore_corpus(contracts, n_devices=1)
+        multi = mesh_explore_corpus(contracts, n_devices=args.mesh)
+        print(json.dumps({
+            "mode": "mesh",
+            "single_device": single,
+            "mesh": multi,
+            "scaling": round(
+                multi["lane_steps_per_sec"] / single["lane_steps_per_sec"], 2
+            ),
+        }))
         return
 
     from mythril_tpu.analysis.corpus import analyze_corpus
