@@ -1,0 +1,131 @@
+"""Device-prepass witnesses -> concrete Issues.
+
+The device symbolic explorer (laser/batch/explore.py) banks the halt
+pc + the concrete calldata of every lane that died on an ASSERT_FAIL.
+Those witnesses ARE proofs: replaying the banked calldata from a fresh
+state reaches the faulting instruction deterministically, so the
+analysis layer emits the issue directly — witness as the transaction
+sequence — instead of having the host engine re-derive the same assert
+through a solver walk.
+
+Reference anchors: the issue flow this short-circuits is
+mythril/analysis/solver.py:47-242 (`get_transaction_sequence`) feeding
+mythril/analysis/module/modules/exceptions.py (SWC-110). The issue
+text matches the host Exceptions module so the Report fingerprint
+(contract+address+title) dedups the two paths cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from mythril_tpu.analysis.module.modules.exceptions import REMEDIATION
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
+
+log = logging.getLogger(__name__)
+
+ASSERT_FAIL_BYTE = 0xFE
+
+#: the gas limit the jsonv2 replay context claims (report.py
+#: REPLAY_BLOCK_CONTEXT gasLimit); witnesses that need more gas than
+#: this would not replay, so they are not reported
+REPLAY_GAS_LIMIT = 0x7D000
+
+
+def _function_name(contract, calldata: bytes) -> str:
+    """Resolve the witness's entry function from its selector."""
+    if len(calldata) < 4:
+        return "fallback"
+    selector = "0x" + calldata[:4].hex()
+    disassembly = getattr(contract, "disassembly", None)
+    table = getattr(disassembly, "function_hash_to_name", None) or {}
+    if selector in table:
+        return table[selector]
+    if selector in getattr(disassembly, "func_hashes", []):
+        return "_function_" + selector
+    return "fallback"
+
+
+def _witness_sequence(contract_address: int, calldata: bytes, runtime_hex: str) -> Dict:
+    """A replayable single-transaction sequence in the shape
+    `get_transaction_sequence` produces (analysis/solver.py)."""
+    attacker = "0x" + ("%x" % ACTORS.attacker.value).zfill(40)
+    target = hex(contract_address)
+    data_hex = "0x" + calldata.hex()
+    return {
+        "initialState": {
+            "accounts": {
+                target: {
+                    "nonce": 0,
+                    "code": runtime_hex,
+                    "storage": "{}",
+                    "balance": "0x0",
+                },
+                attacker: {
+                    "nonce": 0,
+                    "code": "",
+                    "storage": "{}",
+                    "balance": "0x0",
+                },
+            }
+        },
+        "steps": [
+            {
+                "input": data_hex,
+                "value": "0x0",
+                "origin": attacker,
+                "address": target,
+                "calldata": data_hex,
+            }
+        ],
+    }
+
+
+def witness_issues(contract, outcome: Dict, address: int) -> List[Issue]:
+    """Concrete Issues carried by the prepass outcome's trigger bank.
+
+    Currently: assert-violation lanes whose faulting byte is the
+    designated INVALID opcode (0xfe) -> SWC-110 "Exception State".
+    Lanes that died on merely-undefined opcodes are execution errors,
+    not assertions, exactly as in the host engine's ASSERT_FAIL hook.
+    """
+    triggers = (outcome or {}).get("triggers") or {}
+    witnesses = triggers.get("assert-violation") or []
+    if not witnesses:
+        return []
+
+    runtime_hex = getattr(contract, "code", "") or ""
+    if runtime_hex.startswith("0x"):
+        runtime_hex = runtime_hex[2:]
+    code = bytes.fromhex(runtime_hex)
+
+    issues: List[Issue] = []
+    for record in witnesses:
+        pc = record["pc"]
+        if not (0 <= pc < len(code)) or code[pc] != ASSERT_FAIL_BYTE:
+            continue
+        if (record.get("gas_min") or 0) > REPLAY_GAS_LIMIT:
+            continue  # the claimed replay gas limit could not reach it
+        calldata = bytes.fromhex(record["input"])
+        issue = Issue(
+            contract=contract.name,
+            function_name=_function_name(contract, calldata),
+            address=pc,
+            swc_id=ASSERT_VIOLATION,
+            title="Exception State",
+            bytecode=runtime_hex,
+            gas_used=(record.get("gas_min"), record.get("gas_max")),
+            severity="Medium",
+            description_head="An assertion violation was triggered.",
+            description_tail=REMEDIATION,
+            transaction_sequence=_witness_sequence(address, calldata, runtime_hex),
+        )
+        issue.provenance = "device-prepass"
+        issues.append(issue)
+        log.info(
+            "Device prepass witnessed SWC-110 at pc %d (%s)", pc, issue.function
+        )
+    return issues

@@ -68,6 +68,9 @@ class Issue:
         self.transaction_sequence = transaction_sequence
         self.bytecode_hash = get_code_hash(bytecode)
         self.discovery_time = time() - StartTime().global_start_time
+        #: which engine produced the witness (e.g. "device-prepass");
+        #: None for issues found by the host walk
+        self.provenance: Optional[str] = None
         # source info, attached later by add_code_info
         self.filename = None
         self.code = None
@@ -112,6 +115,8 @@ class Issue:
             fields["lineno"] = self.lineno
         if self.code:
             fields["code"] = self.code
+        if self.provenance:
+            fields["provenance"] = self.provenance
         return fields
 
     # -- enrichment ----------------------------------------------------
@@ -161,6 +166,8 @@ def _jsonv2_issue(issue: Issue, source_index: int) -> dict:
     replay = issue.transaction_sequence_jsonv2
     if replay:
         extra["testCases"] = [replay]
+    if issue.provenance:
+        extra["detectedBy"] = issue.provenance
     return {
         "swcID": "SWC-" + issue.swc_id,
         "swcTitle": SWC_TO_TITLE.get(issue.swc_id, "Unspecified Security Issue"),

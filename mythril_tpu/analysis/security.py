@@ -31,7 +31,9 @@ def retrieve_callback_issues(white_list: Optional[List[str]] = None) -> List[Iss
 
 
 def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issue]:
-    """Run POST modules over the statespace and collect all issues."""
+    """Run POST modules over the statespace and collect all issues,
+    merging in the concrete witnesses the device prepass banked
+    (analysis/prepass.py) for locations the host walk did not reach."""
     log.info("Starting analysis")
     issues: List[Issue] = []
     for module in ModuleLoader().get_detection_modules(
@@ -40,4 +42,26 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
         log.info("Executing %s", module.name)
         issues += module.execute(statespace)
     issues += retrieve_callback_issues(white_list)
+
+    device_issues = getattr(statespace, "device_issues", None) or []
+    if white_list and "Exceptions" not in white_list:
+        # witness issues are the Exceptions module's finding class;
+        # honor the user's module selection
+        device_issues = []
+    if device_issues:
+        seen = {
+            (issue.contract, issue.address, issue.swc_id) for issue in issues
+        }
+        fresh = [
+            issue
+            for issue in device_issues
+            if (issue.contract, issue.address, issue.swc_id) not in seen
+        ]
+        if fresh:
+            log.info(
+                "Device prepass contributed %d issue(s) the host walk "
+                "did not find",
+                len(fresh),
+            )
+        issues += fresh
     return issues

@@ -133,7 +133,9 @@ class SymExecWrapper:
         for account in self.accounts.values():
             world_state.put_account(account)
 
-        self.device_exploration = self._device_prepass(contract)
+        self.device_exploration = self._device_prepass(
+            contract, address, execution_timeout
+        )
 
         if deploys:
             self.laser.sym_exec(
@@ -155,12 +157,18 @@ class SymExecWrapper:
             self.calls = list(self._digest_calls())
 
     # -- device symbolic prepass ----------------------------------------
-    def _device_prepass(self, contract):
+    def _device_prepass(self, contract, address: BitVec, execution_timeout):
         """Explore the contract's runtime code with the device
         symbolic engine before the host walk (arena + portfolio; see
         laser/batch/explore.py). Default "auto": runs when an
-        accelerator backend is present. The counters it logs are the
-        proof the TPU did the path-discovery stepping."""
+        accelerator backend is present.
+
+        The prepass is not a warmup — its results drive the analysis:
+        trigger witnesses become concrete Issues (analysis/prepass.py)
+        and the covered branch-direction set lets the host walk skip
+        per-fork feasibility queries the device already has a concrete
+        execution for (svm.py)."""
+        self.device_issues = []
         mode = getattr(args, "device_prepass", "auto")
         if mode == "never":
             return None
@@ -178,11 +186,28 @@ class SymExecWrapper:
             runtime = runtime[2:]
         if len(runtime) < 8:
             return None
+
+        # scale to the hardware, bounded by wall clock: waves stop at
+        # a coverage plateau or when the budget can't fit another wave.
+        # Tiny analysis timeouts skip the prepass outright — even a
+        # cache-warm wave would eat a meaningful slice of them.
+        budget = float(getattr(args, "device_prepass_budget", 12.0))
+        if execution_timeout:
+            if execution_timeout < 6:
+                return None
+            budget = min(budget, execution_timeout / 3.0)
+        lanes = int(getattr(args, "device_prepass_lanes", 128))
         try:
             from mythril_tpu.laser.batch.explore import DeviceSymbolicExplorer
 
             explorer = DeviceSymbolicExplorer(
-                runtime, lanes=16, waves=2, steps_per_wave=1024
+                runtime,
+                lanes=lanes,
+                waves=8,
+                flips_per_wave=max(8, lanes // 8),
+                steps_per_wave=512,
+                budget_s=budget,
+                address=address.value,
             )
             outcome = explorer.run()
         except Exception as why:  # the host walk must never be blocked
@@ -190,19 +215,35 @@ class SymExecWrapper:
             return None
 
         stats = outcome["stats"]
+        try:
+            from mythril_tpu.analysis.prepass import witness_issues
+
+            self.device_issues = witness_issues(contract, outcome, address.value)
+        except Exception as why:
+            log.debug("prepass witness conversion failed: %s", why)
+        stats["witness_issues"] = len(self.device_issues)
+
         log.info(
-            "Device symbolic prepass: %d device steps over %d waves, "
-            "%d arena nodes, %d/%d flips feasible (%d sat on device), "
-            "%d branch directions covered",
+            "Device symbolic prepass: %d device steps over %d waves in "
+            "%.1fs, %d arena nodes, %d/%d flips feasible (%d sat on "
+            "device), %d branch directions covered, %d witness issues",
             stats["device_steps"],
             stats["waves"],
+            stats["wall_s"],
             stats["arena_nodes"],
             stats["forks_feasible"],
             stats["forks_tried"],
             stats["device_sat"],
             stats["branches_covered"],
+            stats["witness_issues"],
         )
         self.laser.execution_info.append(DeviceExplorationInfo(stats))
+        # hand the host walk the concretely-executed branch directions:
+        # forks into this set skip their feasibility query (the device
+        # holds a concrete witness for the direction)
+        self.laser.seed_device_coverage(
+            {tuple(b) for b in outcome["covered_branches"]}, runtime
+        )
         return outcome
 
     # -- setup pieces --------------------------------------------------

@@ -29,6 +29,7 @@ class Disassembly:
         self.func_hashes: List[str] = []
         self.function_name_to_address: Dict[str, int] = {}
         self.address_to_function_name: Dict[int, str] = {}
+        self.function_hash_to_name: Dict[str, str] = {}
         self.enable_online_lookup = enable_online_lookup
         self._signatures = None
 
@@ -42,6 +43,7 @@ class Disassembly:
                 index, self.instruction_list, self._signature_db()
             )
             self.func_hashes.append(function_hash)
+            self.function_hash_to_name[function_hash] = function_name
             if entry_address is not None:
                 self.function_name_to_address[function_name] = entry_address
                 self.address_to_function_name[entry_address] = function_name

@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import logging
 import random
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.exceptions import SolverTimeOutException, UnsatError
 from mythril_tpu.laser.batch.arena import ArenaView
 from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
 from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_run
@@ -34,6 +35,18 @@ log = logging.getLogger(__name__)
 
 DEFAULT_CALLER = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 DEFAULT_ADDRESS = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE
+
+# the jsonv2 replay block context (analysis/report.py
+# REPLAY_BLOCK_CONTEXT): the explorer executes under the SAME concrete
+# environment the report claims for its test cases, so a banked
+# witness replays by construction — even for asserts gated on
+# ADDRESS/TIMESTAMP/NUMBER/BALANCE
+REPLAY_ENV = {
+    "timestamp": 0x5BFA4639,
+    "number": 0x66E393,
+    "gasprice": 0x773594000,
+    "balance": 0,
+}
 
 TRIGGER_KINDS = {
     Status.INVALID: "assert-violation",
@@ -51,9 +64,13 @@ class ExploreStats:
         self.arena_nodes = 0
         self.forks_tried = 0
         self.forks_feasible = 0
-        self.device_sat = 0  # witnesses found by the on-chip portfolio
-        self.host_sat = 0  # witnesses that needed the CDCL fallback
+        # flip-witness sources, in cost order: the incremental CDCL
+        # session answers first (host_sat); the on-chip portfolio is
+        # the escape hatch for queries it can't finish (device_sat)
+        self.device_sat = 0
+        self.host_sat = 0
         self.branches_covered = 0
+        self.wall_s = 0.0
 
     def as_dict(self) -> Dict[str, int]:
         return dict(self.__dict__)
@@ -73,28 +90,38 @@ class DeviceSymbolicExplorer:
         portfolio_candidates: int = 64,
         portfolio_steps: int = 1024,
         seed: int = 1,
+        budget_s: Optional[float] = None,
+        address: int = DEFAULT_ADDRESS,
     ) -> None:
         self.code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
         self.code = bytes.fromhex(self.code_hex)
         self.calldata_len = calldata_len
+        self.address = address
         self.lanes = lanes
         self.waves = waves
         self.flips_per_wave = flips_per_wave
         self.steps_per_wave = steps_per_wave
         self.portfolio_candidates = portfolio_candidates
         self.portfolio_steps = portfolio_steps
+        self.budget_s = budget_s
         self.rng = random.Random(seed)
 
         # bucket the code capacity to powers of two so XLA compiles one
         # kernel per size class, not one per contract
+        from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
+
+        ensure_compile_cache()
 
         self.code_table = make_code_table(
             [self.code], code_cap=code_cap_bucket(len(self.code)))
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[bytes] = []
-        self.triggers: Dict[str, List[bytes]] = {}
+        #: kind -> [{pc, input, gas_min, gas_max}]; the pc is the
+        #: faulting instruction (the step kernel pins a halted lane's
+        #: pc there), the gas bounds are the lane's accumulated range
+        self.triggers: Dict[str, List[Dict]] = {}
         self.stats = ExploreStats()
 
     # -- seeding -------------------------------------------------------
@@ -107,36 +134,43 @@ class DeviceSymbolicExplorer:
 
     # -- solving -------------------------------------------------------
     def _solve_flip(self, conditions) -> Optional[Dict[str, int]]:
-        """A satisfying assignment for the flipped path, portfolio
-        first (device), CDCL second (complete)."""
+        """A satisfying assignment for the flipped path.
+
+        Flip queries are small byte-level calldata constraints; the
+        incremental CDCL session answers them in microseconds, so it
+        goes first. The device portfolio is the escape hatch for the
+        queries CDCL cannot finish in its short budget — the cost
+        ordering measured on the tunneled chip (one device dispatch
+        chain ≈ seconds) dictates this, not engine pride."""
+        try:
+            model = get_model(
+                tuple(conditions),
+                enforce_execution_time=False,
+                solver_timeout=2000,
+            )
+            self.stats.host_sat += 1
+            return dict(model.assignment)
+        except SolverTimeOutException:
+            log.debug("CDCL flip solve timed out; trying the portfolio")
+        except UnsatError:
+            return None
+        except Exception as e:
+            log.debug("CDCL flip solve did not finish: %s", e)
+
         raw = [c.raw for c in conditions]
         try:
             lowered, _ = lower(raw)
         except Exception as e:
             log.debug("lowering failed: %s", e)
-            lowered = None
-        if lowered is not None:
-            found = device_check(
-                lowered,
-                candidates=self.portfolio_candidates,
-                steps=self.portfolio_steps,
-            )
-            if found is not None:
-                self.stats.device_sat += 1
-                return found
-        try:
-            model = get_model(
-                tuple(conditions),
-                enforce_execution_time=False,
-                solver_timeout=4000,
-            )
-        except UnsatError:
             return None
-        except Exception as e:
-            log.debug("fallback solve failed: %s", e)
-            return None
-        self.stats.host_sat += 1
-        return dict(model.assignment)
+        found = device_check(
+            lowered,
+            candidates=self.portfolio_candidates,
+            steps=self.portfolio_steps,
+        )
+        if found is not None:
+            self.stats.device_sat += 1
+        return found
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)
@@ -156,11 +190,12 @@ class DeviceSymbolicExplorer:
             len(inputs),
             calldata=inputs,
             caller=DEFAULT_CALLER,
-            address=DEFAULT_ADDRESS,
+            address=self.address,
             # real-contract shapes: Solidity's free-memory-pointer
             # idiom and big dispatch tables stay on device
             mem_cap=16384,
             storage_cap=128,
+            **REPLAY_ENV,
         )
         out, steps = sym_run(
             make_sym_batch(base), self.code_table, max_steps=self.steps_per_wave
@@ -171,12 +206,24 @@ class DeviceSymbolicExplorer:
         self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
 
         status = np.asarray(out.base.status)
+        halt_pc = np.asarray(out.base.pc)
+        gas_min = np.asarray(out.base.gas_min)
+        gas_max = np.asarray(out.base.gas_max)
         for i, data in enumerate(inputs):
             kind = TRIGGER_KINDS.get(int(status[i]))
             if kind is not None:
                 bucket = self.triggers.setdefault(kind, [])
-                if data not in bucket and len(bucket) < 16:
-                    bucket.append(data)
+                pc = int(halt_pc[i])
+                # one witness per faulting pc is what a report needs
+                if all(pc != t["pc"] for t in bucket) and len(bucket) < 64:
+                    bucket.append(
+                        {
+                            "pc": pc,
+                            "input": data,
+                            "gas_min": int(gas_min[i]),
+                            "gas_max": int(gas_max[i]),
+                        }
+                    )
             for pc, taken, _tid in view.journal(i):
                 self.covered.add((pc, taken))
         return view
@@ -208,15 +255,47 @@ class DeviceSymbolicExplorer:
         return fresh
 
     def run(self) -> Dict:
+        """Wave loop: seed → device wave → flip uncovered frontier
+        branches → reseed. Stops on coverage plateau, an empty flip
+        frontier, the wave cap, or the wall-clock budget."""
+        t_start = t0 = time.perf_counter()
         inputs = self._selector_seeds()
+        wave_times: List[float] = []
         for wave_no in range(self.waves):
+            covered_before = len(self.covered)
+            w0 = time.perf_counter()
             view = self._run_wave(inputs)
+            wave_times.append(time.perf_counter() - w0)
+            if wave_no == 0:
+                # the first wave carries the one-time kernel compile
+                # (amortized machine-wide by the persistent cache);
+                # the budget governs the steady-state loop after it
+                t0 = time.perf_counter()
             self.corpus.extend(inputs)
             if wave_no == self.waves - 1:
                 break  # no next wave to seed; don't waste solver calls
+            if self.budget_s is not None:
+                # hard stop: the whole prepass — compile included —
+                # may cost at most one compile allowance (45s, paid at
+                # most once per kernel shape per machine thanks to the
+                # persistent cache) on top of the steady-state budget;
+                # the compile itself cannot be interrupted from here
+                if time.perf_counter() - t_start > self.budget_s + 45:
+                    break
+                elapsed = time.perf_counter() - t0
+                # predict the next wave from steady-state waves only —
+                # wave 0 carries the compile, so until a second wave
+                # has run the prediction is optimistic by design (the
+                # overshoot is bounded by one wave)
+                predicted = min(wave_times[1:]) if len(wave_times) > 1 else 0.0
+                if elapsed + predicted > self.budget_s:
+                    break
+            plateaued = wave_no > 0 and len(self.covered) == covered_before
             fresh = self._frontier_flips(view, len(inputs))
             if not fresh:
-                break
+                break  # frontier exhausted: the plateau signal
+            if plateaued and len(fresh) < max(1, self.flips_per_wave // 4):
+                break  # coverage stalled and flips are drying up
             while len(fresh) < self.lanes:
                 parent = self.rng.choice(self.corpus)
                 mutated = bytearray(parent)
@@ -227,12 +306,13 @@ class DeviceSymbolicExplorer:
             inputs = fresh[: self.lanes]
 
         self.stats.branches_covered = len(self.covered)
+        self.stats.wall_s = round(time.perf_counter() - t_start, 3)
         return {
             "stats": self.stats.as_dict(),
             "covered_branches": sorted(self.covered),
             "corpus_size": len(self.corpus),
             "triggers": {
-                kind: [data.hex() for data in bucket]
+                kind: [dict(t, input=t["input"].hex()) for t in bucket]
                 for kind, bucket in self.triggers.items()
             },
         }

@@ -94,7 +94,24 @@ class LaserEVM:
             self.edges = self._recorder.edges
 
         self.time: Optional[datetime] = None
+
+        # device-prepass coverage guide: branch directions the device
+        # explorer concretely executed for this runtime code. Forks
+        # into this set skip their feasibility query — a concrete
+        # execution is a stronger sat certificate than a solver call.
+        # (Skipping defers pruning exactly like --sparse-pruning does;
+        # issue verification still solves full constraints.)
+        self.device_covered: set = set()
+        self.device_covered_bytecode: Optional[str] = None
+        self.device_precovered_skips = 0
+
         log.info("LASER EVM initialized with dynamic loader: %s", dynamic_loader)
+
+    def seed_device_coverage(self, covered: set, runtime_hex: str) -> None:
+        """Install the device explorer's covered (pc, taken) set for
+        `runtime_hex` (byte addresses, matching instruction addresses)."""
+        self.device_covered = covered
+        self.device_covered_bytecode = runtime_hex
 
     # ------------------------------------------------------------------
     # top-level drivers
@@ -215,7 +232,8 @@ class LaserEVM:
                 successors = [
                     s
                     for s in successors
-                    if s.world_state.constraints.is_possible
+                    if self._device_precovered(s)
+                    or s.world_state.constraints.is_possible
                 ]
 
             self._recorder.observe(opcode, successors)
@@ -225,6 +243,32 @@ class LaserEVM:
                 finals.append(state)
             self.total_states += len(successors)
         return finals if track_gas else None
+
+    def _device_precovered(self, state: GlobalState) -> bool:
+        """True when this fork's branch direction was concretely
+        executed by the device prepass on the same runtime code. The
+        `branch_obs` tag is consumed here — it describes one fork
+        decision, not the straight-line states that follow it."""
+        obs = getattr(state, "branch_obs", None)
+        if obs is None:
+            return False
+        del state.branch_obs
+        if not self.device_covered or obs not in self.device_covered:
+            return False
+        code = getattr(state.environment, "code", None)
+        if not self._device_code_matches(code):
+            return False
+        self.device_precovered_skips += 1
+        return True
+
+    def _device_code_matches(self, code) -> bool:
+        """Is this the runtime the device explored? One string compare
+        per consumed fork tag (branch_obs), which is cheap enough to
+        skip memoization and its id-reuse hazards."""
+        bytecode = getattr(code, "bytecode", None)
+        if isinstance(bytecode, str) and bytecode.startswith("0x"):
+            bytecode = bytecode[2:]
+        return bytecode == self.device_covered_bytecode
 
     def execute_state(
         self, state: GlobalState

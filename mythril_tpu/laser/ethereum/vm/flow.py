@@ -88,6 +88,9 @@ def _jumpi(frame: Frame):
         return isinstance(cond, Bool) and not is_false(cond)
 
     branches = []
+    # byte address of this JUMPI: the key the device prepass coverage
+    # guide is indexed by (svm._device_precovered)
+    src_addr = frame.here["address"]
 
     if feasible(skip_cond):
         fallthrough = frame.fork().state
@@ -95,6 +98,7 @@ def _jumpi(frame: Frame):
         fallthrough.mstate.pc += 1
         fallthrough.mstate.depth += 1
         fallthrough.world_state.constraints.append(skip_cond)
+        fallthrough.branch_obs = (src_addr, False)
         branches.append(fallthrough)
     else:
         log.debug("JUMPI fall-through branch is unsatisfiable")
@@ -110,6 +114,7 @@ def _jumpi(frame: Frame):
             taken.mstate.pc = index
             taken.mstate.depth += 1
             taken.world_state.constraints.append(taken_cond)
+            taken.branch_obs = (src_addr, True)
             branches.append(taken)
         else:
             log.debug("JUMPI taken branch is unsatisfiable")

@@ -529,6 +529,9 @@ def device_check(
     independent replica per device (pmap over seeds), any replica's
     witness wins — the multi-chip scaling axis for hard queries.
     """
+    from mythril_tpu.laser.batch import ensure_compile_cache
+
+    ensure_compile_cache()
     prog = compile_program(lowered)
     if prog is None or not prog.var_slots:
         return None

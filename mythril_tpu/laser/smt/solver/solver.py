@@ -280,24 +280,6 @@ def check_terms(
     if not lowered:
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
-    # first-line device attempt: sound on SAT, proves nothing else.
-    # Tiny queries skip it (CDCL answers those faster than a device
-    # dispatch), and a hit-rate tracker backs off when the workload's
-    # queries keep missing, so unsat-heavy phases don't pay the search
-    # cost every time. (VERDICT r1 #10: promote the portfolio from
-    # escape hatch to the default sat path.)
-    if device_solving_enabled() and len(lowered) >= 2 and _device_gate.open():
-        from mythril_tpu.laser.smt.solver import portfolio
-
-        asn = portfolio.device_check(lowered, candidates=32, steps=256)
-        if asn is not None:
-            model = _reconstruct(asn, {}, recon, raw_constraints)
-            if model is not None:
-                _device_gate.hit()
-                SolverStatistics().device_sat_count += 1
-                return sat, model
-        _device_gate.miss()
-
     blaster, native_session = _blast_session()
     import sys
 
@@ -316,18 +298,56 @@ def check_terms(
     finally:
         sys.setrecursionlimit(old_limit)
 
+    # Cost-ordered solving (measured on the tunneled chip): a short
+    # native-CDCL sprint answers the easy majority of queries in
+    # microseconds; one device dispatch chain costs seconds, so the
+    # on-chip portfolio only sees queries that survive the sprint.
+    # The hit-rate gate then decides whether the portfolio keeps
+    # getting those survivors, and the CDCL marathon is the complete
+    # backstop. (Round-3 rework of the r2 portfolio-first path, which
+    # taxed every query with a device miss.)
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
-    status, bits = native_session.solve(
-        blaster.nvars, blaster.flat, units, remaining
-    )
+    sprint = min(250, remaining)
+    status, bits = native_session.solve(blaster.nvars, blaster.flat, units, sprint)
+    if status == native_sat.UNSAT:
+        return unsat, None
+
+    device_tried = False
+    if (
+        status == native_sat.UNKNOWN
+        and device_solving_enabled()
+        and len(lowered) >= 2
+        and _device_gate.open()
+    ):
+        from mythril_tpu.laser.smt.solver import portfolio
+
+        device_tried = True
+        asn = portfolio.device_check(lowered, candidates=32, steps=256)
+        if asn is not None:
+            model = _reconstruct(asn, {}, recon, raw_constraints)
+            if model is not None:
+                _device_gate.hit()
+                SolverStatistics().device_sat_count += 1
+                return sat, model
+        _device_gate.miss()
+
+    if status == native_sat.UNKNOWN:
+        remaining = max(
+            200, timeout_ms - int((time.monotonic() - t_total) * 1000)
+        )
+        status, bits = native_session.solve(
+            blaster.nvars, blaster.flat, units, remaining
+        )
     if status == native_sat.UNSAT:
         return unsat, None
     if status == native_sat.UNKNOWN:
         # portfolio escape hatch: the on-chip local search may still
-        # find a witness where CDCL timed out (--parallel-solving)
+        # find a witness where CDCL timed out (--parallel-solving).
+        # Skipped when the gated device attempt already searched this
+        # exact query — a second multi-second dispatch buys nothing.
         from mythril_tpu.support.support_args import args as _args
 
-        if _args.parallel_solving:
+        if _args.parallel_solving and not device_tried:
             import jax
 
             from mythril_tpu.laser.smt.solver import portfolio

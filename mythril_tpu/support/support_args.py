@@ -22,6 +22,8 @@ class Args(object, metaclass=Singleton):
         # "auto" = on when an accelerator backend is present, off on CPU
         self.device_solving = "auto"  # on-chip portfolio as first-line SAT
         self.device_prepass = "auto"  # device symbolic exploration prepass
+        self.device_prepass_lanes = 128  # lanes per prepass wave
+        self.device_prepass_budget = 12.0  # prepass wall-clock cap (s)
 
 
 args = Args()

@@ -117,3 +117,72 @@ def test_prepass_runs_in_analyze_when_forced(monkeypatch):
         "device_symbolic_prepass" in info.as_dict()
         for info in sym.execution_info
     )
+
+
+# gate: ASSERT_FAIL (0xfe) only when calldata byte 0 == 0x42 — the
+# minimal SWC-110 shape the prepass must prove with a banked witness
+GATEFAIL = bytes(
+    [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
+     0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
+     0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
+     0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
+     0x00,               # STOP
+     0x5B,               # JUMPDEST (13)
+     0xFE]               # ASSERT_FAIL (14)
+)
+
+
+def test_prepass_witness_becomes_issue(monkeypatch):
+    """The explorer's trigger bank flows into the analysis as a
+    concrete SWC-110 Issue, and fire_lasers dedups it against the host
+    walk's own finding (VERDICT r2 task 1)."""
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_prepass", "always")
+    contract = EVMContract(GATEFAIL.hex(), name="GATEFAIL")
+    sym = SymExecWrapper(
+        contract,
+        0xA11CE,
+        "bfs",
+        max_depth=32,
+        execution_timeout=60,
+        create_timeout=10,
+        transaction_count=1,
+    )
+    assert [(i.address, i.swc_id) for i in sym.device_issues] == [(14, "110")]
+    issue = sym.device_issues[0]
+    assert issue.provenance == "device-prepass"
+    assert issue.title == "Exception State"
+    step = issue.transaction_sequence["steps"][0]
+    assert step["input"].startswith("0x42")
+    assert step["address"] == hex(0xA11CE)
+    assert sym.device_exploration["stats"]["witness_issues"] == 1
+
+    merged = fire_lasers(sym)
+    hits = [(i.address, i.swc_id) for i in merged]
+    assert hits.count((14, "110")) == 1  # found by both engines, reported once
+
+
+def test_device_coverage_skips_host_feasibility(monkeypatch):
+    """Branch directions the device concretely executed skip their
+    feasibility query in the host walk (guided sparse pruning)."""
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_prepass", "always")
+    contract = EVMContract(GATEFAIL.hex(), name="GATEFAIL")
+    sym = SymExecWrapper(
+        contract,
+        0xA11CE,
+        "bfs",
+        max_depth=32,
+        execution_timeout=60,
+        create_timeout=10,
+        transaction_count=1,
+    )
+    assert sym.laser.device_covered  # prepass seeded the guide
+    assert sym.laser.device_precovered_skips >= 1
