@@ -99,7 +99,14 @@ def bench_transitions() -> dict:
 
 def bench_corpus() -> dict:
     """Driver metric: contracts/sec + states/sec at -t 2 over the
-    reference's precompiled corpus, via the real analyzer pipeline."""
+    reference's precompiled corpus, via the real analyzer pipeline.
+
+    Both legs of the A/B run at EQUAL per-contract budgets: the
+    device leg is the default path (striped corpus prepass on the
+    chip + host analyses consuming its witnesses/coverage), the
+    host-only leg switches the device off. Headline numbers come from
+    the device leg; the host-only fields make the comparison honest
+    rather than implied."""
     from pathlib import Path
 
     ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
@@ -115,32 +122,57 @@ def bench_corpus() -> dict:
         from mythril_tpu.analysis.corpus import analyze_corpus
 
         contracts = [(f.read_text().strip(), "", f.stem) for f in files]
-        t0 = time.perf_counter()
-        results = analyze_corpus(
-            contracts,
-            transaction_count=2,
-            execution_timeout=CORPUS_TIMEOUT_S,
-            create_timeout=10,
-        )
-        dt = time.perf_counter() - t0
+
+        def leg(use_device):
+            t0 = time.perf_counter()
+            results = analyze_corpus(
+                contracts,
+                transaction_count=2,
+                execution_timeout=CORPUS_TIMEOUT_S,
+                create_timeout=10,
+                use_device=use_device,  # None = the default (auto) path
+            )
+            dt = time.perf_counter() - t0
+            return {
+                "wall_raw": dt,
+                "wall_s": round(dt, 1),
+                "states": sum(r.get("states", 0) for r in results),
+                "issues": sum(len(r["issues"]) for r in results),
+                "errors": [r["name"] for r in results if r["error"]],
+                # the prepass stats block is corpus-wide (one striped
+                # exploration shared by all contracts): max, not sum
+                "prepass_steps": max(
+                    (
+                        (r.get("device_prepass") or {}).get("device_steps", 0)
+                        for r in results
+                    ),
+                    default=0,
+                ),
+            }
+
+        device = leg(use_device=None)  # auto: on with an accelerator
+        host = leg(use_device=False)
     finally:
         logging.disable(logging.NOTSET)
 
-    states = sum(r.get("states", 0) for r in results)
-    issues = sum(len(r["issues"]) for r in results)
-    errors = [r["name"] for r in results if r["error"]]
     print(
-        f"bench: corpus {len(files)} contracts in {dt:.1f}s "
-        f"({states} states, {issues} issues, errors={errors})",
+        f"bench: corpus {len(files)} contracts — device leg "
+        f"{device['wall_s']}s/{device['issues']} issues, host-only leg "
+        f"{host['wall_s']}s/{host['issues']} issues",
         file=sys.stderr,
     )
     return {
-        "contracts_per_sec": round(len(files) / dt, 3),
-        "states_per_sec": round(states / dt, 1),
+        "contracts_per_sec": round(len(files) / device["wall_raw"], 3),
+        "states_per_sec": round(device["states"] / device["wall_raw"], 1),
         "corpus_contracts": len(files),
-        "corpus_wall_s": round(dt, 1),
-        "corpus_issues": issues,
-        "corpus_errors": len(errors),
+        "corpus_wall_s": device["wall_s"],
+        "corpus_issues": device["issues"],
+        "corpus_errors": len(device["errors"]),
+        "corpus_prepass_lane_steps": device["prepass_steps"],
+        "host_only_wall_s": host["wall_s"],
+        "host_only_issues": host["issues"],
+        "host_only_states_per_sec": round(host["states"] / host["wall_raw"], 1),
+        "device_extra_issues": device["issues"] - host["issues"],
     }
 
 

@@ -2,11 +2,20 @@
 
 The reference analyzes contracts strictly sequentially
 (mythril/mythril/mythril_analyzer.py:145-185 — a plain for-loop);
-SURVEY.md §2.4 maps that loop to this framework's corpus-sharding
-axis. Each worker process runs one contract through the standard
-SymExecWrapper + fire_lasers pipeline with fresh singleton state, so
-N workers deliver ~N× contracts/sec on the embarrassingly parallel
-part of the workload.
+SURVEY.md §2.4 maps that loop onto two axes here:
+
+1. **Device axis** — the parent process (which owns the accelerator)
+   runs ONE lane-striped symbolic exploration over the whole corpus
+   (laser/batch/explore.py DeviceCorpusExplorer): every contract gets
+   a stripe of lanes, each wave advances the entire corpus in one
+   jit'd dispatch, and the banked witnesses + branch coverage are
+   handed to the host analyses.
+2. **Host axis** — the per-contract SymExecWrapper + fire_lasers
+   pipeline. Single-process runs get each contract's prepass outcome
+   injected (witness issues + coverage-guided pruning); pooled runs
+   overlap the prepass with the workers and merge its witnesses into
+   the results afterward (workers never touch the device; the chip is
+   a parent-process resource).
 """
 
 from __future__ import annotations
@@ -17,6 +26,64 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+
+def corpus_device_prepass(
+    contracts: List[Tuple[str, str, str]],
+    budget_s: Optional[float] = None,
+    lanes_per_contract: int = 32,
+    address: int = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE,
+    transaction_count: int = 1,
+) -> Dict[int, Dict]:
+    """One striped device exploration over the corpus; returns
+    {contract_index: single-contract prepass outcome} for injection
+    into the per-contract analyses (indexed, not named — corpus rows
+    may share names). Empty on any failure — the host pipeline must
+    never be blocked by the device."""
+    runnable = []
+    for idx, (code, _creation, _name) in enumerate(contracts):
+        code = code[2:] if code.startswith("0x") else code
+        if len(code) >= 8:
+            runnable.append((idx, code))
+    if not runnable:
+        return {}
+    if budget_s is None:
+        budget_s = min(60.0, 3.0 * len(runnable))
+    try:
+        from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+        explorer = DeviceCorpusExplorer(
+            [code for _, code in runnable],
+            lanes_per_contract=lanes_per_contract,
+            waves=8,
+            flips_per_contract=8,
+            steps_per_wave=512,
+            budget_s=budget_s,
+            address=address,
+            transaction_count=transaction_count,
+        )
+        result = explorer.run()
+    except Exception:
+        log.warning("corpus device prepass failed", exc_info=True)
+        return {}
+    stats = result["stats"]
+    log.info(
+        "Corpus device prepass: %d contracts, %d lane-steps over %d waves "
+        "in %.1fs, %d branch directions covered",
+        len(runnable),
+        stats["device_steps"],
+        stats["waves"],
+        stats["wall_s"],
+        stats["branches_covered"],
+    )
+    outcomes = {}
+    for (idx, _code), outcome in zip(runnable, result["contracts"]):
+        # the stats block is CORPUS-WIDE (one striped exploration);
+        # it rides along on every outcome for observability, marked so
+        # consumers don't sum it per contract
+        outcome["stats"] = dict(stats, scope="corpus")
+        outcomes[idx] = outcome
+    return outcomes
 
 
 def _analyze_one(payload: Tuple) -> Dict:
@@ -36,7 +103,9 @@ def _analyze_one(payload: Tuple) -> Dict:
         modules,
         solver_timeout,
         use_device,
+        prepass_outcome,
     ) = payload
+    args = restore_device_args = None
     try:
         from mythril_tpu.analysis.security import fire_lasers
         from mythril_tpu.analysis.symbolic import SymExecWrapper
@@ -47,7 +116,12 @@ def _analyze_one(payload: Tuple) -> Dict:
             args.solver_timeout = solver_timeout
         if not use_device:
             # pooled workers must not contend for the one accelerator;
-            # device paths run in-parent (or single-process) only
+            # any prepass outcome arrives via the payload (injected) or
+            # the post-pool witness merge — device paths stay parent-only.
+            # Restored on exit: host-only corpus legs can run in-parent
+            # (single process) and must not degrade later analyses in
+            # the same process through the shared Args singleton.
+            restore_device_args = (args.device_prepass, args.device_solving)
             args.device_prepass = "never"
             args.device_solving = "never"
 
@@ -65,14 +139,19 @@ def _analyze_one(payload: Tuple) -> Dict:
             transaction_count=transaction_count,
             modules=modules,
             compulsory_statespace=False,
+            prepass_outcome=prepass_outcome,
         )
         issues = fire_lasers(sym, modules)
         exploration = getattr(sym, "device_exploration", None)
+        from mythril_tpu.support.phase_profile import PhaseProfile
+
         return {
             "name": name,
             "issues": [issue.as_dict for issue in issues],
             "states": sym.laser.total_states,
             "device_prepass": exploration["stats"] if exploration else None,
+            "phases": PhaseProfile().as_dict(),
+            "precovered_skips": sym.laser.device_precovered_skips,
             "error": None,
         }
     except Exception:
@@ -82,6 +161,9 @@ def _analyze_one(payload: Tuple) -> Dict:
             "states": 0,
             "error": traceback.format_exc(),
         }
+    finally:
+        if restore_device_args is not None and args is not None:
+            args.device_prepass, args.device_solving = restore_device_args
 
 
 def analyze_corpus(
@@ -97,15 +179,29 @@ def analyze_corpus(
     solver_timeout: Optional[int] = None,
     processes: Optional[int] = None,
     use_device: Optional[bool] = None,
+    device_budget_s: Optional[float] = None,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
-    name), ...] across a process pool; returns one result dict per
-    contract ({name, issues, error})."""
+    name), ...]: one striped device prepass in this process plus the
+    per-contract host pipeline — sequential with outcome injection when
+    single-process, overlapped with a worker pool (witnesses merged
+    afterward) otherwise. Returns one result dict per contract
+    ({name, issues, error, device_prepass, phases})."""
     processes = processes or min(len(contracts), mp.cpu_count())
     if use_device is None:
-        use_device = processes <= 1 or len(contracts) == 1
-    payloads = [
-        (
+        # the device axis is on whenever an accelerator is present —
+        # the PARENT owns the chip, so pooling does not disable it
+        try:
+            import jax
+
+            use_device = jax.default_backend() != "cpu"
+        except Exception:
+            use_device = False
+
+    single_process = processes <= 1 or len(contracts) == 1
+
+    def payload(code, creation_code, name, worker_device, outcome):
+        return (
             code,
             creation_code,
             name,
@@ -118,17 +214,95 @@ def analyze_corpus(
             loop_bound,
             modules,
             solver_timeout,
-            use_device,
+            worker_device,
+            outcome,
         )
-        for code, creation_code, name in contracts
-    ]
-    if processes <= 1 or len(payloads) == 1:
-        return [_analyze_one(p) for p in payloads]
 
-    ctx = mp.get_context("spawn")  # fresh singletons per worker
-    with ctx.Pool(processes=processes) as pool:
-        results = pool.map(_analyze_one, payloads)
+    prepass: Dict[str, Dict] = {}
+    if single_process:
+        # sequential hosts share this process's solver session, so the
+        # prepass runs up front (a thread would race the incremental
+        # CDCL session the host analyses reset per contract) and each
+        # analysis gets its contract's outcome injected: witness
+        # issues, coverage-guided pruning. At corpus scale the prepass
+        # amortizes — a wave's cost is step-dispatch-bound, not
+        # lane-bound, so 32 or 32k striped lanes cost the same wall.
+        if use_device:
+            prepass = corpus_device_prepass(
+                contracts,
+                budget_s=device_budget_s,
+                address=address,
+                transaction_count=transaction_count,
+            )
+        results = [
+            _analyze_one(
+                payload(code, creation_code, name, use_device, prepass.get(i))
+            )
+            for i, (code, creation_code, name) in enumerate(contracts)
+        ]
+    else:
+        # pooled hosts: the prepass likewise overlaps the worker pool;
+        # witnesses merge in when both finish
+        payloads = [
+            payload(code, creation_code, name, False, None)
+            for code, creation_code, name in contracts
+        ]
+        ctx = mp.get_context("spawn")  # fresh singletons per worker
+        with ctx.Pool(processes=processes) as pool:
+            async_results = pool.map_async(_analyze_one, payloads)
+            if use_device:
+                prepass = corpus_device_prepass(
+                    contracts,
+                    budget_s=device_budget_s,
+                    address=address,
+                    transaction_count=transaction_count,
+                )
+            results = async_results.get()
+    if prepass:
+        _merge_prepass_witnesses(results, contracts, prepass, address)
     return results
+
+
+def _merge_prepass_witnesses(
+    results: List[Dict],
+    contracts: List[Tuple[str, str, str]],
+    prepass: Dict[int, Dict],
+    address: int,
+) -> None:
+    """Fold the device prepass's banked witnesses into the pooled
+    results: per contract (by position — pool.map preserves order),
+    attach the prepass counters and append witness issues for
+    locations no host worker reported."""
+    from mythril_tpu.analysis.prepass import witness_issues
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    for i, (code, _creation, name) in enumerate(contracts):
+        outcome = prepass.get(i)
+        result = results[i] if i < len(results) else None
+        if outcome is None or result is None:
+            continue
+        result["device_prepass"] = outcome["stats"]
+        try:
+            contract = EVMContract(code=code or "", name=name)
+            fresh = witness_issues(contract, outcome, address)
+        except Exception:
+            log.debug("witness merge failed for %s", name, exc_info=True)
+            continue
+        seen = {(i.get("address"), i.get("swc-id")) for i in result["issues"]}
+        extra = [
+            issue.as_dict
+            for issue in fresh
+            if (issue.address, issue.swc_id) not in seen
+        ]
+        if extra:
+            log.info(
+                "Device prepass contributed %d issue(s) to %s that the "
+                "host walk did not find",
+                len(extra),
+                name,
+            )
+            result["issues"].extend(extra)
+            outcome["stats"]["witness_issues"] = len(extra)
 
 
 def mesh_explore_corpus(

@@ -22,7 +22,10 @@ from typing import Dict, List
 
 from mythril_tpu.analysis.module.modules.exceptions import REMEDIATION
 from mythril_tpu.analysis.report import Issue
-from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.analysis.swc_data import (
+    ASSERT_VIOLATION,
+    UNPROTECTED_SELFDESTRUCT,
+)
 from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
 
 log = logging.getLogger(__name__)
@@ -49,12 +52,14 @@ def _function_name(contract, calldata: bytes) -> str:
     return "fallback"
 
 
-def _witness_sequence(contract_address: int, calldata: bytes, runtime_hex: str) -> Dict:
-    """A replayable single-transaction sequence in the shape
-    `get_transaction_sequence` produces (analysis/solver.py)."""
+def _witness_sequence(
+    contract_address: int, transactions: List[bytes], runtime_hex: str
+) -> Dict:
+    """A replayable transaction sequence in the shape
+    `get_transaction_sequence` produces (analysis/solver.py): one step
+    per attacker transaction, the last one the triggering call."""
     attacker = "0x" + ("%x" % ACTORS.attacker.value).zfill(40)
     target = hex(contract_address)
-    data_hex = "0x" + calldata.hex()
     return {
         "initialState": {
             "accounts": {
@@ -74,58 +79,93 @@ def _witness_sequence(contract_address: int, calldata: bytes, runtime_hex: str) 
         },
         "steps": [
             {
-                "input": data_hex,
+                "input": "0x" + step.hex(),
                 "value": "0x0",
                 "origin": attacker,
                 "address": target,
-                "calldata": data_hex,
+                "calldata": "0x" + step.hex(),
             }
+            for step in transactions
         ],
     }
+
+
+KILL_REMEDIATION = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to "
+    "destroy this contract account. Review the transaction trace generated "
+    "for this issue and make sure that appropriate security controls are in "
+    "place to prevent unrestricted access."
+)
+
+
+def _issue_from_record(
+    contract, record: Dict, address: int, runtime_hex: str, kind: str
+) -> Issue:
+    calldata = bytes.fromhex(record["input"])
+    prefix = [bytes.fromhex(p) for p in record.get("prefix", [])]
+    if kind == "selfdestruct":
+        swc_id, title, severity = (
+            UNPROTECTED_SELFDESTRUCT,
+            "Unprotected Selfdestruct",
+            "High",
+        )
+        head = "Any sender can cause the contract to self-destruct."
+        tail = KILL_REMEDIATION
+    else:
+        swc_id, title, severity = ASSERT_VIOLATION, "Exception State", "Medium"
+        head = "An assertion violation was triggered."
+        tail = REMEDIATION
+    issue = Issue(
+        contract=contract.name,
+        function_name=_function_name(contract, calldata),
+        address=record["pc"],
+        swc_id=swc_id,
+        title=title,
+        bytecode=runtime_hex,
+        gas_used=(record.get("gas_min"), record.get("gas_max")),
+        severity=severity,
+        description_head=head,
+        description_tail=tail,
+        transaction_sequence=_witness_sequence(
+            address, prefix + [calldata], runtime_hex
+        ),
+    )
+    issue.provenance = "device-prepass"
+    return issue
 
 
 def witness_issues(contract, outcome: Dict, address: int) -> List[Issue]:
     """Concrete Issues carried by the prepass outcome's trigger bank.
 
-    Currently: assert-violation lanes whose faulting byte is the
-    designated INVALID opcode (0xfe) -> SWC-110 "Exception State".
-    Lanes that died on merely-undefined opcodes are execution errors,
-    not assertions, exactly as in the host engine's ASSERT_FAIL hook.
+    - assert-violation lanes whose faulting byte is the designated
+      INVALID opcode (0xfe) -> SWC-110 "Exception State". Lanes that
+      died on merely-undefined opcodes are execution errors, not
+      assertions, exactly as in the host engine's ASSERT_FAIL hook.
+    - selfdestruct lanes -> SWC-106 "Unprotected Selfdestruct": the
+      lane IS an attacker-sent call chain that executed SELFDESTRUCT.
     """
     triggers = (outcome or {}).get("triggers") or {}
-    witnesses = triggers.get("assert-violation") or []
-    if not witnesses:
-        return []
-
     runtime_hex = getattr(contract, "code", "") or ""
     if runtime_hex.startswith("0x"):
         runtime_hex = runtime_hex[2:]
     code = bytes.fromhex(runtime_hex)
 
     issues: List[Issue] = []
-    for record in witnesses:
-        pc = record["pc"]
-        if not (0 <= pc < len(code)) or code[pc] != ASSERT_FAIL_BYTE:
-            continue
-        if (record.get("gas_min") or 0) > REPLAY_GAS_LIMIT:
-            continue  # the claimed replay gas limit could not reach it
-        calldata = bytes.fromhex(record["input"])
-        issue = Issue(
-            contract=contract.name,
-            function_name=_function_name(contract, calldata),
-            address=pc,
-            swc_id=ASSERT_VIOLATION,
-            title="Exception State",
-            bytecode=runtime_hex,
-            gas_used=(record.get("gas_min"), record.get("gas_max")),
-            severity="Medium",
-            description_head="An assertion violation was triggered.",
-            description_tail=REMEDIATION,
-            transaction_sequence=_witness_sequence(address, calldata, runtime_hex),
-        )
-        issue.provenance = "device-prepass"
-        issues.append(issue)
-        log.info(
-            "Device prepass witnessed SWC-110 at pc %d (%s)", pc, issue.function
-        )
+    for kind in ("assert-violation", "selfdestruct"):
+        for record in triggers.get(kind) or []:
+            pc = record["pc"]
+            if kind == "assert-violation" and not (
+                0 <= pc < len(code) and code[pc] == ASSERT_FAIL_BYTE
+            ):
+                continue
+            if (record.get("gas_min") or 0) > REPLAY_GAS_LIMIT:
+                continue  # the claimed replay gas limit could not reach it
+            issue = _issue_from_record(contract, record, address, runtime_hex, kind)
+            issues.append(issue)
+            log.info(
+                "Device prepass witnessed SWC-%s at pc %d (%s)",
+                issue.swc_id,
+                pc,
+                issue.function,
+            )
     return issues

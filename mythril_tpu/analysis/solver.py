@@ -1,47 +1,51 @@
 """Witness concretization: path constraints -> exploit transactions.
 
-Reference parity: mythril/analysis/solver.py:47-242 —
-`get_transaction_sequence` poses one Optimize query (minimizing
-calldata sizes and call values, with balance sanity bounds), then
-extracts per-transaction concrete calldata/value/caller and the
-initial account state from the model, patching keccak placeholder
-values with real hashes.
+API parity with the reference's mythril/analysis/solver.py:47-242 —
+`get_transaction_sequence(global_state, constraints)` is the entry
+every detection module calls, and the returned dict shape
+(`{"initialState": ..., "steps": [...]}`) is the report contract.
+
+The mechanics are organized differently from the reference: one
+`WitnessBuilder` pass owns the whole concretization — it poses a
+single bounded minimization query, renders each transaction step from
+the model, and patches keccak placeholders through a precomputed
+substitution table instead of rescanning the calldata hex position by
+position.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Tuple, Union
+from typing import Dict, List
 
-from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.laser.ethereum.keccak_function_manager import (
     hash_matcher,
     keccak_function_manager,
 )
 from mythril_tpu.laser.ethereum.state.constraints import Constraints
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
-from mythril_tpu.laser.ethereum.transaction import BaseTransaction
 from mythril_tpu.laser.ethereum.transaction.transaction_models import (
     ContractCreationTransaction,
 )
 from mythril_tpu.laser.smt import UGE, symbol_factory
 from mythril_tpu.laser.smt.model import Model
 from mythril_tpu.support.model import get_model
+from mythril_tpu.support.phase_profile import PhaseProfile
 
 log = logging.getLogger(__name__)
 
 
 def pretty_print_model(model: Model) -> str:
     """Human-readable assignment dump."""
-    ret = ""
-    for d in model.decls():
-        value = model[d]
+    rows = []
+    for decl in model.decls():
+        value = model[decl]
         try:
-            condition = "0x%x" % int(value)
+            rendered = "0x%x" % int(value)
         except (TypeError, ValueError):
-            condition = str(value)
-        ret += "%s: %s\n" % (d.name(), condition)
-    return ret
+            rendered = str(value)
+        rows.append(f"{decl.name()}: {rendered}")
+    return "".join(row + "\n" for row in rows)
 
 
 def get_transaction_sequence(
@@ -49,157 +53,152 @@ def get_transaction_sequence(
 ) -> Dict:
     """Generate the concrete transaction sequence witnessing
     `constraints` (raises UnsatError when impossible)."""
-    transaction_sequence = global_state.world_state.transaction_sequence
-
-    concrete_transactions = []
-
-    tx_constraints, minimize = _set_minimisation_constraints(
-        transaction_sequence, constraints.copy(), [], 5000, global_state.world_state
-    )
-    model = get_model(tx_constraints, minimize=minimize)
-
-    # initial state includes the creation account (its code technically
-    # only exists after tx 1; reports follow the reference's convention)
-    initial_world_state = transaction_sequence[0].world_state
-    initial_accounts = initial_world_state.accounts
-
-    for transaction in transaction_sequence:
-        concrete_transactions.append(_get_concrete_transaction(model, transaction))
-
-    min_price_dict: Dict[str, int] = {}
-    for address in initial_accounts.keys():
-        min_price_dict[address] = model.eval_int(
-            initial_world_state.starting_balances[
-                symbol_factory.BitVecVal(address, 256)
-            ]
-        )
-
-    concrete_initial_state = _get_concrete_state(initial_accounts, min_price_dict)
-    if isinstance(transaction_sequence[0], ContractCreationTransaction):
-        code = transaction_sequence[0].code
-        _replace_with_actual_sha(concrete_transactions, model, code)
-    else:
-        _replace_with_actual_sha(concrete_transactions, model)
-    _add_calldata_placeholder(concrete_transactions, transaction_sequence)
-
-    return {"initialState": concrete_initial_state, "steps": concrete_transactions}
+    return WitnessBuilder(global_state, constraints).build()
 
 
-def _add_calldata_placeholder(
-    concrete_transactions: List[Dict[str, str]],
-    transaction_sequence: List[BaseTransaction],
-) -> None:
-    """Mirror `input` into `calldata` (for a creation tx, without the
-    deployment bytecode prefix)."""
-    for tx in concrete_transactions:
-        tx["calldata"] = tx["input"]
-    if not isinstance(transaction_sequence[0], ContractCreationTransaction):
-        return
-    code_len = len(transaction_sequence[0].code.bytecode)
-    concrete_transactions[0]["calldata"] = concrete_transactions[0]["input"][
-        code_len + 2 :
-    ]
+def _word(value: int):
+    return symbol_factory.BitVecVal(value, 256)
 
 
-def _replace_with_actual_sha(
-    concrete_transactions: List[Dict[str, str]], model: Model, code=None
-) -> None:
-    """Substitute placeholder hash values (in the reserved fffffff...
-    intervals) with real keccaks of the witness preimages."""
-    concrete_hashes = keccak_function_manager.get_concrete_hash_data(model)
-    for tx in concrete_transactions:
-        if hash_matcher not in tx["input"]:
-            continue
-        if code is not None and code.bytecode in tx["input"]:
-            s_index = len(code.bytecode) + 2
-        else:
-            s_index = 10
-        for i in range(s_index, len(tx["input"])):
-            data_slice = tx["input"][i : i + 64]
-            if hash_matcher not in data_slice or len(data_slice) != 64:
-                continue
-            find_input = symbol_factory.BitVecVal(int(data_slice, 16), 256)
-            input_ = None
-            for size in concrete_hashes:
-                _, inverse = keccak_function_manager.store_function[size]
-                if find_input.value not in concrete_hashes[size]:
-                    continue
-                input_ = symbol_factory.BitVecVal(
-                    model.eval_int(inverse(find_input)), size
+class WitnessBuilder:
+    """One concretization pass: solve once, render every step."""
+
+    #: calldata bytes per transaction the witness may use
+    CALLDATA_CAP = 5000
+    #: spendable funds cap per transaction sender
+    SENDER_FUNDS_CAP = 10**21
+    #: starting-balance cap per account: keeps witnesses readable and
+    #: avoids balance-overflow artifacts (reference: solver.py:205)
+    ACCOUNT_FUNDS_CAP = 10**20
+
+    def __init__(self, global_state: GlobalState, constraints: Constraints):
+        self.world = global_state.world_state
+        self.transactions = self.world.transaction_sequence
+        self.query = constraints.copy()
+        # the first transaction's world state is rendered as the
+        # initial state; by reference convention it already carries
+        # the created account (code technically exists only after tx 1)
+        self.genesis = self.transactions[0].world_state
+
+    # -- the solve -----------------------------------------------------
+    def _solve(self) -> Model:
+        """One bounded query minimizing calldata sizes and call
+        values, lexicographically per transaction."""
+        goals = []
+        for tx in self.transactions:
+            size = tx.call_data.calldatasize
+            self.query.append(UGE(_word(self.CALLDATA_CAP), size))
+            self.query.append(
+                UGE(
+                    _word(self.SENDER_FUNDS_CAP),
+                    self.world.starting_balances[tx.caller],
                 )
-            if input_ is None:
-                continue
-            keccak = keccak_function_manager.find_concrete_keccak(input_)
-            hex_keccak = "{:064x}".format(keccak.value)
-            tx["input"] = tx["input"][:s_index] + tx["input"][s_index:].replace(
-                tx["input"][i : 64 + i], hex_keccak
             )
+            goals.append(size)
+            goals.append(tx.call_value)
+        for account in self.world.accounts.values():
+            self.query.append(
+                UGE(
+                    _word(self.ACCOUNT_FUNDS_CAP),
+                    self.world.starting_balances[account.address],
+                )
+            )
+        with PhaseProfile().measure("concretize"):
+            return get_model(self.query, minimize=tuple(goals))
 
+    # -- rendering -----------------------------------------------------
+    @property
+    def _creation_code_hex(self) -> str:
+        first = self.transactions[0]
+        if isinstance(first, ContractCreationTransaction):
+            return first.code.bytecode
+        return ""
 
-def _get_concrete_state(
-    initial_accounts: Dict, min_price_dict: Dict[str, int]
-) -> Dict:
-    accounts = {}
-    for address, account in initial_accounts.items():
-        data: Dict[str, Union[int, str]] = {
-            "nonce": account.nonce,
-            "code": account.code.bytecode,
-            "storage": str(account.storage),
-            "balance": hex(min_price_dict.get(address, 0)),
+    def _render_step(self, model: Model, tx) -> Dict[str, str]:
+        deploying = isinstance(tx, ContractCreationTransaction)
+        body = tx.code.bytecode if deploying else ""
+        body += "".join(
+            "{:02x}".format(b if isinstance(b, int) else (b.value or 0))
+            for b in tx.call_data.concrete(model)
+        )
+        return {
+            "input": "0x" + body,
+            "value": "0x%x" % model.eval_int(tx.call_value),
+            "origin": "0x" + ("%x" % model.eval_int(tx.caller)).zfill(40),
+            "address": (
+                "" if deploying else hex(tx.callee_account.address.value)
+            ),
         }
-        accounts[hex(address)] = data
-    return {"accounts": accounts}
 
-
-def _get_concrete_transaction(model: Model, transaction: BaseTransaction) -> Dict:
-    address = hex(transaction.callee_account.address.value)
-    value = model.eval_int(transaction.call_value)
-    caller = "0x" + ("%x" % model.eval_int(transaction.caller)).zfill(40)
-
-    input_ = ""
-    if isinstance(transaction, ContractCreationTransaction):
-        address = ""
-        input_ += transaction.code.bytecode
-
-    input_ += "".join(
-        "{:02x}".format(b if isinstance(b, int) else (b.value or 0))
-        for b in transaction.call_data.concrete(model)
-    )
-
-    return {
-        "input": "0x" + input_,
-        "value": "0x%x" % value,
-        "origin": caller,
-        "address": "%s" % address,
-    }
-
-
-def _set_minimisation_constraints(
-    transaction_sequence, constraints, minimize, max_size, world_state
-) -> Tuple[Constraints, tuple]:
-    """Bound calldata sizes and starting balances; minimize calldata
-    size + call value per transaction (reference: solver.py:205)."""
-    for transaction in transaction_sequence:
-        max_calldata_size = symbol_factory.BitVecVal(max_size, 256)
-        constraints.append(UGE(max_calldata_size, transaction.call_data.calldatasize))
-
-        minimize.append(transaction.call_data.calldatasize)
-        minimize.append(transaction.call_value)
-        constraints.append(
-            UGE(
-                symbol_factory.BitVecVal(1000000000000000000000, 256),
-                world_state.starting_balances[transaction.caller],
+    def _initial_state(self, model: Model) -> Dict:
+        accounts = {}
+        for address, account in self.genesis.accounts.items():
+            balance = model.eval_int(
+                self.genesis.starting_balances[_word(address)]
             )
-        )
+            accounts[hex(address)] = {
+                "nonce": account.nonce,
+                "code": account.code.bytecode,
+                "storage": str(account.storage),
+                "balance": hex(balance),
+            }
+        return {"accounts": accounts}
 
-    for account in world_state.accounts.values():
-        # each account starts with < 100 ETH: keeps witnesses readable
-        # and avoids balance-overflow artifacts
-        constraints.append(
-            UGE(
-                symbol_factory.BitVecVal(100000000000000000000, 256),
-                world_state.starting_balances[account.address],
+    # -- keccak placeholder patching -----------------------------------
+    def _hash_substitutions(self, model: Model) -> Dict[str, str]:
+        """placeholder-hex -> real-keccak-hex for every placeholder
+        the model bound to a concrete preimage (the reserved
+        fffffff... intervals the keccak manager hands out)."""
+        table: Dict[str, str] = {}
+        by_size = keccak_function_manager.get_concrete_hash_data(model)
+        for size, placeholders in by_size.items():
+            _, inverse = keccak_function_manager.store_function[size]
+            for placeholder in placeholders:
+                if placeholder is None:
+                    continue
+                preimage = symbol_factory.BitVecVal(
+                    model.eval_int(inverse(_word(placeholder))), size
+                )
+                real = keccak_function_manager.find_concrete_keccak(preimage)
+                table["{:064x}".format(placeholder)] = "{:064x}".format(
+                    real.value
+                )
+        return table
+
+    def _patch_hashes(self, steps: List[Dict[str, str]], model: Model) -> None:
+        if not any(hash_matcher in step["input"] for step in steps):
+            return
+        table = self._hash_substitutions(model)
+        if not table:
+            return
+        code_hex = self._creation_code_hex
+        for step in steps:
+            data = step["input"]
+            # never rewrite bytes inside the deployment code prefix
+            keep = (
+                len(code_hex) + 2
+                if code_hex and code_hex in data
+                else len("0x") + 8
             )
-        )
+            tail = data[keep:]
+            for placeholder, real in table.items():
+                if hash_matcher in placeholder and placeholder in tail:
+                    tail = tail.replace(placeholder, real)
+            step["input"] = data[:keep] + tail
 
-    return constraints, tuple(minimize)
+    # -- assembly ------------------------------------------------------
+    @staticmethod
+    def _mirror_calldata(steps: List[Dict[str, str]], code_hex: str) -> None:
+        """`calldata` mirrors `input`; a creation step's calldata is
+        the constructor arguments only (deployment bytecode stripped)."""
+        for step in steps:
+            step["calldata"] = step["input"]
+        if code_hex:
+            steps[0]["calldata"] = steps[0]["input"][len(code_hex) + 2 :]
+
+    def build(self) -> Dict:
+        model = self._solve()
+        steps = [self._render_step(model, tx) for tx in self.transactions]
+        self._patch_hashes(steps, model)
+        self._mirror_calldata(steps, self._creation_code_hex)
+        return {"initialState": self._initial_state(model), "steps": steps}

@@ -93,12 +93,15 @@ class SymExecWrapper:
         disable_dependency_pruning: bool = False,
         run_analysis_modules: bool = True,
         custom_modules_directory: str = "",
+        prepass_outcome: Optional[dict] = None,
     ):
         # fresh per-contract solver session: the blast store shares
         # structure within one analysis but would tax the next contract
         from mythril_tpu.laser.smt.solver.solver import reset_blast_session
+        from mythril_tpu.support.phase_profile import PhaseProfile
 
         reset_blast_session()
+        PhaseProfile().reset()
 
         if strategy not in STRATEGIES:
             raise ValueError("Invalid strategy argument supplied")
@@ -133,6 +136,7 @@ class SymExecWrapper:
         for account in self.accounts.values():
             world_state.put_account(account)
 
+        self._injected_outcome = prepass_outcome
         self.device_exploration = self._device_prepass(
             contract, address, execution_timeout
         )
@@ -169,52 +173,65 @@ class SymExecWrapper:
         per-fork feasibility queries the device already has a concrete
         execution for (svm.py)."""
         self.device_issues = []
-        mode = getattr(args, "device_prepass", "auto")
-        if mode == "never":
-            return None
-        if mode == "auto":
-            try:
-                import jax
-
-                if jax.default_backend() == "cpu":
-                    return None
-            except Exception:
-                return None
-
         runtime = getattr(contract, "code", "") or ""
         if runtime.startswith("0x"):
             runtime = runtime[2:]
-        if len(runtime) < 8:
-            return None
 
-        # scale to the hardware, bounded by wall clock: waves stop at
-        # a coverage plateau or when the budget can't fit another wave.
-        # Tiny analysis timeouts skip the prepass outright — even a
-        # cache-warm wave would eat a meaningful slice of them.
-        budget = float(getattr(args, "device_prepass_budget", 12.0))
-        if execution_timeout:
-            if execution_timeout < 6:
+        outcome = self._injected_outcome
+        if outcome is None:
+            mode = getattr(args, "device_prepass", "auto")
+            if mode == "never":
                 return None
-            budget = min(budget, execution_timeout / 3.0)
-        lanes = int(getattr(args, "device_prepass_lanes", 128))
-        try:
-            from mythril_tpu.laser.batch.explore import DeviceSymbolicExplorer
+            if mode == "auto":
+                try:
+                    import jax
 
-            explorer = DeviceSymbolicExplorer(
-                runtime,
-                lanes=lanes,
-                waves=8,
-                flips_per_wave=max(8, lanes // 8),
-                steps_per_wave=512,
-                budget_s=budget,
-                address=address.value,
-            )
-            outcome = explorer.run()
-        except Exception as why:  # the host walk must never be blocked
-            log.debug("device prepass failed: %s", why)
-            return None
+                    if jax.default_backend() == "cpu":
+                        return None
+                except Exception:
+                    return None
+
+            if len(runtime) < 8:
+                return None
+
+            # scale to the hardware, bounded by wall clock: waves stop
+            # at a coverage plateau or when the budget can't fit
+            # another wave. Tiny analysis timeouts skip the prepass
+            # outright — even a cache-warm wave would eat a meaningful
+            # slice of them.
+            budget = float(getattr(args, "device_prepass_budget", 12.0))
+            if execution_timeout:
+                if execution_timeout < 6:
+                    return None
+                budget = min(budget, execution_timeout / 3.0)
+            lanes = int(getattr(args, "device_prepass_lanes", 128))
+            try:
+                from mythril_tpu.laser.batch.explore import (
+                    DeviceSymbolicExplorer,
+                )
+
+                explorer = DeviceSymbolicExplorer(
+                    runtime,
+                    lanes=lanes,
+                    waves=8,
+                    flips_per_wave=max(8, lanes // 8),
+                    steps_per_wave=512,
+                    budget_s=budget,
+                    address=address.value,
+                    transaction_count=self.laser.transaction_count,
+                )
+                outcome = explorer.run()
+            except Exception as why:  # the host walk must never be blocked
+                log.debug("device prepass failed: %s", why)
+                return None
+
+        from mythril_tpu.support.phase_profile import PhaseProfile
 
         stats = outcome["stats"]
+        if self._injected_outcome is None:
+            # an injected outcome's wall was paid once for the whole
+            # corpus; only an in-line exploration bills this contract
+            PhaseProfile().add("prepass", stats.get("wall_s", 0.0))
         try:
             from mythril_tpu.analysis.prepass import witness_issues
 

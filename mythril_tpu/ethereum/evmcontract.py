@@ -1,22 +1,35 @@
 """EVM contract model: an address with associated bytecode.
 
-Reference parity: mythril/ethereum/evmcontract.py:14-122 — creation +
-runtime `Disassembly`, bytecode hashes, and `matches_expression` for
-`leveldb-search`-style code queries. The reference subclasses
-`persistent.Persistent` for its ZODB-backed contract storage; plain
-objects serialize fine for this framework's needs.
+API parity with the reference's mythril/ethereum/evmcontract.py:14-122
+(creation + runtime `Disassembly`, bytecode hashes, and the
+`code#…#`/`func#…#` query DSL used by `leveldb-search`). Two deliberate
+departures: disassemblies are built lazily — a corpus pass that only
+reads the runtime hex never pays for disassembling creation code — and
+the search DSL is evaluated by a small boolean folder instead of
+handing a synthesized string to eval(). (The reference also subclasses
+persistent.Persistent for ZODB storage; plain objects serialize fine
+here.)
 """
 
 from __future__ import annotations
 
 import logging
 import re
+from typing import List, Union
 
 from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.support.keccak import keccak256
 from mythril_tpu.support.support_utils import get_code_hash
 
 log = logging.getLogger(__name__)
+
+#: solc emits __[libname]______ placeholders for compile-time linking;
+#: they are pinned to a dummy address so the hex decodes
+_LINK_PLACEHOLDER = re.compile(r"_{2}.{38}")
+
+_BOOL_OPS = ("and", "or", "not")
+_CODE_QUERY = re.compile(r"^code#([a-zA-Z0-9\s,\[\]]+)#")
+_FUNC_QUERY = re.compile(r"^func#([a-zA-Z0-9\s_,(\\)\[\]]+)#$")
 
 
 class EVMContract:
@@ -25,18 +38,37 @@ class EVMContract:
     def __init__(
         self, code="", creation_code="", name="Unknown", enable_online_lookup=False
     ):
-        # compile-time linking placeholders __[lib]__ become a dummy addr
-        creation_code = re.sub(r"(_{2}.{38})", "aa" * 20, creation_code)
-        code = re.sub(r"(_{2}.{38})", "aa" * 20, code)
-
-        self.creation_code = creation_code
+        self.code = _LINK_PLACEHOLDER.sub("aa" * 20, code or "")
+        self.creation_code = _LINK_PLACEHOLDER.sub("aa" * 20, creation_code or "")
         self.name = name
-        self.code = code
-        self.disassembly = Disassembly(code, enable_online_lookup=enable_online_lookup)
-        self.creation_disassembly = Disassembly(
-            creation_code, enable_online_lookup=enable_online_lookup
-        )
+        self._online_lookup = enable_online_lookup
+        self._runtime_disassembly = None
+        self._creation_disassembly = None
 
+    # -- disassembly (lazy) --------------------------------------------
+    @property
+    def disassembly(self) -> Disassembly:
+        if self._runtime_disassembly is None:
+            self._runtime_disassembly = Disassembly(
+                self.code, enable_online_lookup=self._online_lookup
+            )
+        return self._runtime_disassembly
+
+    @property
+    def creation_disassembly(self) -> Disassembly:
+        if self._creation_disassembly is None:
+            self._creation_disassembly = Disassembly(
+                self.creation_code, enable_online_lookup=self._online_lookup
+            )
+        return self._creation_disassembly
+
+    def get_easm(self) -> str:
+        return self.disassembly.get_easm()
+
+    def get_creation_easm(self) -> str:
+        return self.creation_disassembly.get_easm()
+
+    # -- identity ------------------------------------------------------
     @property
     def bytecode_hash(self):
         return get_code_hash(self.code)
@@ -45,7 +77,7 @@ class EVMContract:
     def creation_bytecode_hash(self):
         return get_code_hash(self.creation_code)
 
-    def as_dict(self):
+    def as_dict(self) -> dict:
         return {
             "name": self.name,
             "code": self.code,
@@ -53,36 +85,65 @@ class EVMContract:
             "disassembly": self.disassembly,
         }
 
-    def get_easm(self):
-        return self.disassembly.get_easm()
-
-    def get_creation_easm(self):
-        return self.creation_disassembly.get_easm()
-
+    # -- the code/func search DSL --------------------------------------
     def matches_expression(self, expression: str) -> bool:
         """Evaluate a `code#...# and func#...#` query against this
-        contract (reference: evmcontract.py matches_expression)."""
-        str_eval = ""
-        easm_code = None
+        contract. Terms fold left over and/or with prefix not, the
+        same precedence the reference's eval()-based version had."""
+        # (the reference passes IGNORECASE positionally into re.split's
+        # maxsplit slot, silently truncating queries with three or more
+        # operators; this version applies it as a real flag)
+        tokens: List[Union[str, bool]] = []
+        for piece in re.split(
+            r"\s+(and|or|not)\s+", expression, flags=re.IGNORECASE
+        ):
+            lowered = piece.lower()
+            if lowered in _BOOL_OPS:
+                tokens.append(lowered)
+            else:
+                tokens.append(self._term_matches(piece))
+        return _fold_bool(tokens)
 
-        tokens = re.split(r"\s+(and|or|not)\s+", expression, re.IGNORECASE)
-        for token in tokens:
-            if token in ("and", "or", "not"):
-                str_eval += " " + token + " "
-                continue
+    def _term_matches(self, token: str) -> bool:
+        by_code = _CODE_QUERY.match(token)
+        if by_code:
+            # commas separate easm lines in the query syntax
+            needle = by_code.group(1).replace(",", "\n")
+            return needle in self.get_easm()
+        by_signature = _FUNC_QUERY.match(token)
+        if by_signature:
+            selector = "0x" + keccak256(by_signature.group(1).encode())[:4].hex()
+            return selector in self.disassembly.func_hashes
+        log.debug("unrecognized search term: %r", token)
+        return False
 
-            m = re.match(r"^code#([a-zA-Z0-9\s,\[\]]+)#", token)
-            if m:
-                if easm_code is None:
-                    easm_code = self.get_easm()
-                code = m.group(1).replace(",", "\\n")
-                str_eval += '"' + code + '" in easm_code'
-                continue
 
-            m = re.match(r"^func#([a-zA-Z0-9\s_,(\\)\[\]]+)#$", token)
-            if m:
-                sign_hash = "0x" + keccak256(m.group(1).encode())[:4].hex()
-                str_eval += '"' + sign_hash + '" in self.disassembly.func_hashes'
-                continue
-
-        return bool(eval(str_eval.strip()))  # noqa: S307 - same DSL as reference
+def _fold_bool(tokens: List[Union[str, bool]]) -> bool:
+    """Evaluate [bool|'and'|'or'|'not', ...] with Python's precedence
+    (not > and > or), without eval()."""
+    # resolve prefix not-chains
+    flat: List[Union[str, bool]] = []
+    i = 0
+    while i < len(tokens):
+        if tokens[i] == "not":
+            negations = 0
+            while i < len(tokens) and tokens[i] == "not":
+                negations += 1
+                i += 1
+            operand = bool(tokens[i]) if i < len(tokens) else False
+            flat.append(operand if negations % 2 == 0 else not operand)
+            i += 1
+        else:
+            flat.append(tokens[i])
+            i += 1
+    # fold and-groups, then or across groups
+    groups: List[bool] = []
+    current = True
+    for token in flat:
+        if token == "or":
+            groups.append(current)
+            current = True
+        elif token != "and":
+            current = current and bool(token)
+    groups.append(current)
+    return any(groups)

@@ -52,9 +52,11 @@ class Status:
     ERR_MEM = 7  # memory model capacity exceeded
     UNSUPPORTED = 8  # opcode outside the device set -> host takes over
     ERR_OOG = 9  # minimum gas bound exceeded the lane's gas budget
+    KILLED = 10  # SELFDESTRUCT executed (a successful halt that the
+    #              explorer banks as SWC-106 evidence)
 
     HALTED = (STOPPED, RETURNED, REVERTED, INVALID, ERR_STACK, ERR_JUMP,
-              ERR_MEM, UNSUPPORTED, ERR_OOG)
+              ERR_MEM, UNSUPPORTED, ERR_OOG, KILLED)
 
 
 class CodeTable(NamedTuple):
@@ -149,12 +151,18 @@ def make_batch(
     calldata_cap: int = CALLDATA_CAP,
     storage_cap: int = STORAGE_CAP,
     stack_cap: int = STACK_CAP,
+    storage_seed=None,
 ) -> StateBatch:
-    """Fresh batch at pc=0 with empty stacks and zeroed memory/storage.
+    """Fresh batch at pc=0 with empty stacks and zeroed memory.
 
     Capacities are per-batch: the step kernel reads them off the array
     shapes, so mainnet-shaped workloads pass e.g. mem_cap=24576 while
-    the default stays lean for throughput runs."""
+    the default stays lean for throughput runs.
+
+    `storage_seed` pre-loads per-lane storage journals — one
+    {slot: value} dict (or None) per lane — the mechanism a
+    multi-transaction exploration uses to carry tx N's writes into
+    tx N+1's start state."""
     code_ids = (
         jnp.zeros((n,), jnp.int32)
         if code_ids is None
@@ -167,6 +175,17 @@ def make_batch(
             m = min(len(data), calldata_cap)
             cd[i, :m] = np.frombuffer(bytes(data[:m]), dtype=np.uint8)
             cds[i] = len(data)
+    skeys = np.zeros((n, storage_cap, u256.LIMBS), dtype=np.uint32)
+    svals = np.zeros((n, storage_cap, u256.LIMBS), dtype=np.uint32)
+    scnt = np.zeros((n,), dtype=np.int32)
+    if storage_seed is not None:
+        for i, journal in enumerate(storage_seed):
+            for j, (slot, value) in enumerate(
+                list((journal or {}).items())[:storage_cap]
+            ):
+                skeys[i, j] = u256.from_int(slot)
+                svals[i, j] = u256.from_int(value)
+                scnt[i] = j + 1
     return StateBatch(
         code_id=code_ids,
         pc=jnp.zeros((n,), jnp.int32),
@@ -174,9 +193,9 @@ def make_batch(
         sp=jnp.zeros((n,), jnp.int32),
         mem=jnp.zeros((n, mem_cap), jnp.uint8),
         msize_words=jnp.zeros((n,), jnp.int32),
-        storage_keys=jnp.zeros((n, storage_cap, u256.LIMBS), jnp.uint32),
-        storage_vals=jnp.zeros((n, storage_cap, u256.LIMBS), jnp.uint32),
-        storage_cnt=jnp.zeros((n,), jnp.int32),
+        storage_keys=jnp.asarray(skeys),
+        storage_vals=jnp.asarray(svals),
+        storage_cnt=jnp.asarray(scnt),
         status=jnp.zeros((n,), jnp.int32),
         gas_min=jnp.zeros((n,), jnp.uint32),
         gas_max=jnp.zeros((n,), jnp.uint32),

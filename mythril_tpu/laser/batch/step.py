@@ -612,8 +612,10 @@ def step(batch: StateBatch, code: CodeTable,
         log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
 
     # ---- halts -----------------------------------------------------------
-    stop_mask = ex & ((op == STOP) | (op == SELFDESTRUCT))
+    stop_mask = ex & (op == STOP)
     status = jnp.where(stop_mask, Status.STOPPED, status)
+    kill_mask = ex & (op == SELFDESTRUCT)
+    status = jnp.where(kill_mask, Status.KILLED, status)
 
     retrev_mask = ex & ((op == RETURN) | (op == REVERT))
     rr_len_i, rr_len_big = _word_to_i32(b)

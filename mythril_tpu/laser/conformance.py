@@ -241,7 +241,7 @@ def _verdict(case: VmTest, batch, lane: int) -> str:
         return f"fail: completed (status {st}) but exceptional halt expected"
     if st == Status.ERR_MEM:
         return "skip: memory model capacity"
-    if st not in (Status.STOPPED, Status.RETURNED):
+    if st not in (Status.STOPPED, Status.RETURNED, Status.KILLED):
         return f"fail: status {st}, success expected"
     if case.check_storage:
         got = storage_dict(batch, lane)

@@ -101,6 +101,10 @@ class LaserEVM:
         # execution is a stronger sat certificate than a solver call.
         # (Skipping defers pruning exactly like --sparse-pruning does;
         # issue verification still solves full constraints.)
+        from mythril_tpu.support.phase_profile import PhaseProfile
+
+        self._phases = PhaseProfile()
+
         self.device_covered: set = set()
         self.device_covered_bytecode: Optional[str] = None
         self.device_precovered_skips = 0
@@ -223,18 +227,20 @@ class LaserEVM:
                 return finals + [state] if track_gas else None
 
             try:
-                successors, opcode = self.execute_state(state)
+                with self._phases.measure("step"):
+                    successors, opcode = self.execute_state(state)
             except NotImplementedError:
                 log.debug("Encountered an unimplemented instruction")
                 continue
 
             if args.sparse_pruning is False:
-                successors = [
-                    s
-                    for s in successors
-                    if self._device_precovered(s)
-                    or s.world_state.constraints.is_possible
-                ]
+                with self._phases.measure("feasibility"):
+                    successors = [
+                        s
+                        for s in successors
+                        if self._device_precovered(s)
+                        or s.world_state.constraints.is_possible
+                    ]
 
             self._recorder.observe(opcode, successors)
             if successors:
@@ -259,6 +265,11 @@ class LaserEVM:
         if not self._device_code_matches(code):
             return False
         self.device_precovered_skips += 1
+        from mythril_tpu.laser.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        SolverStatistics().device_cert_count += 1
         return True
 
     def _device_code_matches(self, code) -> bool:

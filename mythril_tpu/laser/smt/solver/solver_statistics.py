@@ -23,6 +23,10 @@ class SolverStatistics(object, metaclass=Singleton):
         # native CDCL completeness path
         self.device_sat_count = 0
         self.cdcl_sat_count = 0
+        # queries never posed because the device prepass held a
+        # concrete execution of the branch direction — a sat
+        # certificate stronger than any solver answer
+        self.device_cert_count = 0
 
     def __repr__(self):
         return (
@@ -30,7 +34,9 @@ class SolverStatistics(object, metaclass=Singleton):
             f"Query count: {self.query_count}\n"
             f"Solver time: {self.solver_time}\n"
             f"Sat verdicts from device portfolio: {self.device_sat_count}\n"
-            f"Sat verdicts from CDCL: {self.cdcl_sat_count}"
+            f"Sat verdicts from CDCL: {self.cdcl_sat_count}\n"
+            f"Queries preempted by device execution certificates: "
+            f"{self.device_cert_count}"
         )
 
 

@@ -165,6 +165,9 @@ class MythrilAnalyzer:
                 issue.add_code_info(contract)
             collected += issues
             log.info("Solver statistics: \n%s", str(SolverStatistics()))
+            from mythril_tpu.support.phase_profile import PhaseProfile
+
+            log.info("Host phase profile: \n%s", str(PhaseProfile()))
 
         # prime the source registry for the report
         Source().get_source_from_contracts_list(self.contracts)

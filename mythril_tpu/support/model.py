@@ -78,7 +78,10 @@ def get_model(
         s.minimize(e)
     for e in maximize:
         s.maximize(e)
-    result = s.check()
+    from mythril_tpu.support.phase_profile import PhaseProfile
+
+    with PhaseProfile().measure("solve"):
+        result = s.check()
     if result == sat:
         model = s.model()
         _store(key, (sat, model))

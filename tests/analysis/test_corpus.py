@@ -36,3 +36,38 @@ def test_corpus_contains_worker_errors_not_raises():
         [("zz-not-hex", "", "Broken")], transaction_count=1, processes=1
     )
     assert results[0]["error"] is not None
+
+
+def test_corpus_device_prepass_feeds_workers():
+    """The parent's striped device exploration produces per-contract
+    outcomes that pooled workers consume: witnesses arrive as issues
+    (with provenance when the host walk missed them) and the prepass
+    counters ride along in each result (VERDICT r2 task 2)."""
+    # gated assert: INVALID only when calldata byte 0 == 0x42 — a
+    # host walk at a tiny budget won't prove it, the device wave will
+    gated_fail = bytes(
+        [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
+         0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
+         0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
+         0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
+         0x00, 0x5B, 0xFE]  # STOP; JUMPDEST; ASSERT_FAIL
+    ).hex()
+    contracts = [
+        ("600035600757005bfe", "", "PlainAssert"),
+        (gated_fail, "", "GatedAssert"),
+    ]
+    results = analyze_corpus(
+        contracts,
+        transaction_count=1,
+        execution_timeout=60,
+        processes=2,
+        use_device=True,  # force the device axis on the CPU mesh
+        device_budget_s=30.0,
+    )
+    by_name = {r["name"]: r for r in results}
+    for r in results:
+        assert r["error"] is None, r["error"]
+        assert r["device_prepass"] is not None
+        assert r["device_prepass"]["device_steps"] > 0
+    assert "110" in swc_ids(by_name["PlainAssert"])
+    assert "110" in swc_ids(by_name["GatedAssert"])

@@ -186,3 +186,63 @@ def test_device_coverage_skips_host_feasibility(monkeypatch):
     )
     assert sym.laser.device_covered  # prepass seeded the guide
     assert sym.laser.device_precovered_skips >= 1
+
+
+# 2-transaction pattern: tx1 (cd0==1) stores CALLER as owner; tx2
+# (cd0==2) selfdestructs only when SLOAD(0) == CALLER — the
+# suicide.sol.o shape the multi-transaction explorer must crack alone
+KILL2TX = bytes([
+    0x60, 0x00, 0x35, 0x60, 0xF8, 0x1C,              # cd0
+    0x80, 0x60, 0x01, 0x14, 0x60, 0x15, 0x57,        # ==1 -> SET
+    0x80, 0x60, 0x02, 0x14, 0x60, 0x1B, 0x57,        # ==2 -> KILL
+    0x00,
+    0x5B, 0x33, 0x60, 0x00, 0x55, 0x00,              # SET: SSTORE(0,CALLER)
+    0x5B, 0x60, 0x00, 0x54, 0x33, 0x14,              # KILL: SLOAD(0)==CALLER
+    0x60, 0x25, 0x57, 0x00,
+    0x5B, 0x33, 0xFF,                                # SELFDESTRUCT(CALLER)
+])
+
+
+def test_multi_tx_device_explorer_finds_storage_gated_selfdestruct():
+    """VERDICT r2 task 3: a 2-tx vulnerability found by the device
+    explorer alone — the storage journal persists across waves as a
+    carry, and the witness records the full transaction prefix."""
+    from mythril_tpu.laser.batch.explore import DeviceSymbolicExplorer
+
+    explorer = DeviceSymbolicExplorer(
+        KILL2TX.hex(), calldata_len=36, lanes=8, waves=4,
+        steps_per_wave=64, transaction_count=2,
+    )
+    outcome = explorer.run()
+    stats = outcome["stats"]
+    assert stats["transactions"] == 2
+    assert stats["carries_banked"] >= 1  # the device mutation pruner banked tx1
+    kills = outcome["triggers"].get("selfdestruct")
+    assert kills, "2-tx selfdestruct not found by the device explorer"
+    witness = kills[0]
+    assert witness["pc"] == 39
+    assert bytes.fromhex(witness["input"])[0] == 0x02
+    assert len(witness["prefix"]) == 1
+    assert bytes.fromhex(witness["prefix"][0])[0] == 0x01
+
+
+def test_multi_tx_witness_becomes_two_step_swc106_issue():
+    """The 2-tx trigger renders as an SWC-106 Issue whose transaction
+    sequence replays both steps in order."""
+    from mythril_tpu.analysis.prepass import witness_issues
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+    from mythril_tpu.laser.batch.explore import DeviceSymbolicExplorer
+
+    explorer = DeviceSymbolicExplorer(
+        KILL2TX.hex(), calldata_len=36, lanes=8, waves=4,
+        steps_per_wave=64, transaction_count=2,
+    )
+    outcome = explorer.run()
+    contract = EVMContract(KILL2TX.hex(), name="KILL2TX")
+    issues = witness_issues(contract, outcome, 0xA11CE)
+    kills = [i for i in issues if i.swc_id == "106"]
+    assert kills and kills[0].provenance == "device-prepass"
+    steps = kills[0].transaction_sequence["steps"]
+    assert len(steps) == 2
+    assert steps[0]["input"].startswith("0x01")
+    assert steps[1]["input"].startswith("0x02")
