@@ -1,0 +1,75 @@
+"""Canonical report shapes for golden-file comparison.
+
+The reference pins complete CLI reports against committed expected
+files (tests/cmd_line_test.py:17-47, tests/testdata/outputs_expected/);
+this module defines the equivalent canonical form here: the full issue
+list with every stable field, volatile values (timings) stripped, and
+transaction sequences reduced to their replay inputs.
+
+Producers: tools/make_goldens.py (regeneration) and
+tests/analysis/test_golden_reports.py (comparison).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+#: generous per-contract walk budget for golden runs: every fixture
+#: that can converge does; the ones that cannot are still pinned at
+#: the exact-issue-set level
+GOLDEN_EXECUTION_TIMEOUT = 120
+
+GOLDEN_FIXTURES = (
+    Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+    / "tests"
+    / "testdata"
+    / "inputs"
+)
+
+
+def golden_corpus_run() -> List[Tuple[str, Dict]]:
+    """THE golden analysis: one pinned configuration shared by the
+    generator (tools/make_goldens.py) and the comparison test, so the
+    goldens are always checked under the settings they were made
+    with. Returns [(fixture stem, result dict)] in fixture order."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    files = sorted(GOLDEN_FIXTURES.glob("*.sol.o"))
+    contracts = [(f.read_text().strip(), "", f.stem) for f in files]
+    results = analyze_corpus(
+        contracts,
+        transaction_count=2,
+        execution_timeout=GOLDEN_EXECUTION_TIMEOUT,
+        create_timeout=10,
+        processes=1,
+        use_device=False,
+    )
+    return [(f.stem, r) for f, r in zip(files, results)]
+
+
+def canonical_issues(issues: List[Dict]) -> List[Dict]:
+    """Issue dicts (Issue.as_dict shape) -> deterministic golden rows.
+
+    Transaction sequences are pinned by their model-independent
+    structure — step count, each step's selector and calldata length —
+    not the free argument bytes: those are one satisfying assignment
+    among many, and the CDCL search (unlike z3's deterministic tactics)
+    picks different ones across processes. Everything else (addresses,
+    swc ids, titles, severities, functions, full descriptions, gas
+    bounds) is compared byte for byte."""
+    rows = []
+    for issue in issues:
+        row = dict(issue)
+        steps = ((row.pop("tx_sequence", None) or {}).get("steps")) or []
+        row["tx_steps"] = [
+            {
+                "selector": (step.get("input") or "")[:10],
+                "calldata_bytes": max(0, (len(step.get("input") or "0x") - 2) // 2),
+            }
+            for step in steps
+        ]
+        rows.append(row)
+    rows.sort(key=lambda r: (r["address"], r["title"], str(r.get("function"))))
+    return rows

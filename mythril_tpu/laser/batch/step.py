@@ -274,7 +274,11 @@ def step(batch: StateBatch, code: CodeTable,
     # ---- environment / block pushes --------------------------------------
     zero_w = jnp.zeros((n, W), jnp.uint32)
     budget = batch.gas_budget
-    gas_left = budget - jnp.minimum(batch.gas_min, budget)
+    # GAS pushes the gas remaining AFTER its own charge (2): exact when
+    # the accumulated minimum is exact, which the concolic lane keeps
+    # for the static+memory costs preceding a GAS read (the gas0/gas1
+    # VMTests pin this value through an SSTORE)
+    gas_left = budget - jnp.minimum(batch.gas_min + 2, budget)
     gas_word = jnp.zeros((n, W), jnp.uint32)
     gas_word = gas_word.at[:, 0].set(gas_left & 0xFFFF)
     gas_word = gas_word.at[:, 1].set(gas_left >> 16)

@@ -59,8 +59,9 @@ SUITES = [
 # Name-based skips, mirroring the reference harness's ignore lists
 # (evm_test.py:33-60) where the reason still applies to this engine.
 SKIP_NAMES = {
-    "gas0": "exact remaining-gas value (engine tracks min/max bounds)",
-    "gas1": "exact remaining-gas value (engine tracks min/max bounds)",
+    # the reference's own skip list (tests/laser/evm_testsuite/
+    # evm_test.py:33-60 "tests_to_resolve") — inherited, not
+    # self-inflicted: the fixtures themselves are disputed upstream
     "jumpTo1InstructionafterJump": "fixture oddity (reference tests_to_resolve)",
     "sstore_load_2": "fixture oddity (reference tests_to_resolve)",
 }
@@ -286,28 +287,59 @@ def _host_verdict(case: VmTest, outcome: dict) -> str:
     return "pass"
 
 
-def run_cases(cases, max_steps: int = 4096, hybrid: bool = True):
+#: second-pass step budget for lanes still running after the main run:
+#: the forever-OOG fixtures halt by gas exhaustion, not by fixpoint, and
+#: burning their ~100k gas in ~12-gas loop bodies takes ~25k steps
+STRAGGLER_STEPS = 1 << 17
+
+
+def run_cases(
+    cases,
+    max_steps: int = 4096,
+    hybrid: bool = True,
+    straggler_steps: int = STRAGGLER_STEPS,
+):
     """Run every case in one batch; return {name: verdict}.
 
     With `hybrid`, lanes the device cannot finish (UNSUPPORTED /
     capacity) are lifted mid-frame into the host engine and judged on
-    the continued execution instead of skipping (takeover.py).
+    the continued execution instead of skipping (takeover.py). Lanes
+    still RUNNING after the main pass (gas-exhaustion loops) get one
+    long-budget re-run before being judged.
     """
     batch, code_table = build_batch(cases)
     final, _ = run(batch, code_table, max_steps=max_steps,
                    track_coverage=False)
     # one bulk device->host transfer; per-lane verdicts then index numpy
     final = jax.device_get(final)
+    lanes = {i: (final, i) for i in range(len(cases))}
+
+    stragglers = [
+        i
+        for i in range(len(cases))
+        if int(final.status[i]) == Status.RUNNING
+    ]
+    if stragglers and straggler_steps > max_steps:
+        sub_batch, sub_table = build_batch([cases[i] for i in stragglers])
+        long_run, _ = run(
+            sub_batch, sub_table, max_steps=straggler_steps,
+            track_coverage=False,
+        )
+        long_run = jax.device_get(long_run)
+        for j, i in enumerate(stragglers):
+            lanes[i] = (long_run, j)
+
     verdicts = {}
     for i, c in enumerate(cases):
-        verdict = _verdict(c, final, i)
-        if hybrid and int(final.status[i]) in (
+        view, lane = lanes[i]
+        verdict = _verdict(c, view, lane)
+        if hybrid and int(view.status[lane]) in (
             Status.UNSUPPORTED,
             Status.ERR_MEM,
         ):
             from mythril_tpu.laser.batch.takeover import resume_on_host
 
-            outcome = resume_on_host(c.code.hex(), final, i)
+            outcome = resume_on_host(c.code.hex(), view, lane)
             if outcome is not None:
                 verdict = _host_verdict(c, outcome)
         verdicts[c.name] = verdict

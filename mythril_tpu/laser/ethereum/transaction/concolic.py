@@ -33,8 +33,20 @@ def execute_message_call(
     gas_price,
     value,
     track_gas: bool = False,
+    block_number: Optional[int] = None,
 ) -> Optional[List[GlobalState]]:
-    """Run one concrete message call from every open world state."""
+    """Run one concrete message call from every open world state.
+
+    A concrete `block_number` pins the environment's otherwise-symbolic
+    block number, letting fixtures whose jump targets derive from
+    NUMBER replay exactly (the reference skips those cases)."""
+    overrides = None
+    if block_number is not None:
+        from mythril_tpu.laser.smt import symbol_factory
+
+        overrides = {
+            "block_number": symbol_factory.BitVecVal(block_number, 256)
+        }
     for world_state in drain_open_states(laser_evm):
         ident = get_next_transaction_id()
         enqueue_transaction(
@@ -51,5 +63,6 @@ def execute_message_call(
                 call_data=ConcreteCalldata(ident, data),
                 call_value=value,
             ),
+            environment_overrides=overrides,
         )
     return laser_evm.exec(track_gas=track_gas)

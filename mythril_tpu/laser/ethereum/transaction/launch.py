@@ -21,10 +21,18 @@ def enqueue_transaction(
     laser_evm,
     transaction,
     caller_pool: Optional[Iterable] = None,
+    environment_overrides: Optional[dict] = None,
 ) -> None:
-    """Stage `transaction` for execution on `laser_evm`."""
+    """Stage `transaction` for execution on `laser_evm`.
+
+    `environment_overrides` pins Environment fields that default to
+    fresh symbols (block_number, chainid, ...) — the concolic driver
+    uses it to replay fixtures whose control flow depends on concrete
+    block context (the reference must skip those: evm_test.py:33-60)."""
     entry = transaction.initial_global_state()
     entry.transaction_stack.append((transaction, None))
+    for field, value in (environment_overrides or {}).items():
+        setattr(entry.environment, field, value)
 
     if caller_pool is not None:
         entry.world_state.constraints.append(

@@ -41,21 +41,11 @@ test_types = [
     "vmIOandFlowOperations",
 ]
 
-# same skip lists as the reference harness (evm_test.py:33-60)
+# same skip lists as the reference harness (evm_test.py:33-60) —
+# minus its tests_with_block_number_support group: the concolic driver
+# pins the environment's block number from the fixture env, so the
+# NUMBER-derived dynamic jumps the reference must skip replay exactly
 tests_with_gas_support = ["gas0", "gas1"]
-tests_with_block_number_support = [
-    "BlockNumberDynamicJumpi0",
-    "BlockNumberDynamicJumpi1",
-    "BlockNumberDynamicJump0_jumpdest2",
-    "DynamicJumpPathologicalTest0",
-    "BlockNumberDynamicJumpifInsidePushWithJumpDest",
-    "BlockNumberDynamicJumpiAfterStop",
-    "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
-    "BlockNumberDynamicJump0_jumpdest0",
-    "BlockNumberDynamicJumpi1_jumpdest",
-    "BlockNumberDynamicJumpiOutsideBoundary",
-    "DynamicJumpJD_DependsOnJumps1",
-]
 tests_with_log_support = ["log1MemExp"]
 tests_not_relevant = [
     "loop_stacklimit_1020",  # max_depth stops the loop before 1020
@@ -67,7 +57,6 @@ tests_to_resolve = ["jumpTo1InstructionafterJump", "sstore_load_2"]
 ignored_test_names = (
     tests_with_gas_support
     + tests_with_log_support
-    + tests_with_block_number_support
     + tests_not_relevant
     + tests_to_resolve
 )
@@ -151,6 +140,7 @@ def test_vmtest_concolic(
         gas_price=int(action["gasPrice"], 16),
         value=int(action["value"], 16),
         track_gas=True,
+        block_number=int((environment or {}).get("currentNumber", "0x0"), 16),
     )
 
     if gas_used is not None and gas_used < int(environment["currentGasLimit"], 16):

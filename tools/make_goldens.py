@@ -1,0 +1,52 @@
+"""Regenerate the full-report goldens under tests/testdata/goldens/.
+
+Reference parity: the reference diffs complete CLI reports against
+committed expected files (tests/cmd_line_test.py:17-47 +
+tests/testdata/outputs_expected/). Here the goldens pin the HOST
+engine's complete per-contract findings over the reference's
+precompiled fixture corpus as `<name>.issues.json` — the canonical
+issue rows defined in mythril_tpu/analysis/goldens.py, produced by the
+same pinned `golden_corpus_run()` the comparison test replays.
+
+Run on the CPU backend so goldens are identical on any machine:
+    python tools/make_goldens.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+GOLDEN_DIR = Path(__file__).resolve().parent.parent / "tests" / "testdata" / "goldens"
+
+
+def main() -> None:
+    from mythril_tpu.analysis.goldens import canonical_issues, golden_corpus_run
+
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    for stale in GOLDEN_DIR.glob("*.issues.json"):
+        stale.unlink()
+    for name, result in golden_corpus_run():
+        assert result["error"] is None, f"{name}: {result['error']}"
+        (GOLDEN_DIR / f"{name}.issues.json").write_text(
+            json.dumps(
+                canonical_issues(result["issues"]), indent=1, sort_keys=True
+            )
+            + "\n"
+        )
+        print(f"{name}: {len(result['issues'])} issues")
+
+
+if __name__ == "__main__":
+    main()
