@@ -272,6 +272,36 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--device-prepass",),
+        dict(
+            choices=["auto", "always", "never"],
+            default="auto",
+            help=(
+                "Run the accelerator symbolic exploration before the host "
+                "walk (auto: on when an accelerator backend is present)"
+            ),
+        ),
+    ),
+    (
+        ("--device-solving",),
+        dict(
+            choices=["auto", "always", "never"],
+            default="auto",
+            help=(
+                "Allow the on-chip portfolio to answer solver queries the "
+                "CDCL sprint cannot (auto: on with an accelerator backend)"
+            ),
+        ),
+    ),
+    (
+        ("--device-prepass-budget",),
+        dict(
+            type=float,
+            default=12.0,
+            help="Wall-clock seconds the device prepass may spend per contract",
+        ),
+    ),
+    (
         ("--unconstrained-storage",),
         dict(
             action="store_true",
@@ -700,6 +730,9 @@ def _run_analyze(disassembler, address, args):
         sparse_pruning=args.sparse_pruning,
         unconstrained_storage=args.unconstrained_storage,
         call_depth_limit=args.call_depth_limit,
+        device_prepass=args.device_prepass,
+        device_solving=args.device_solving,
+        device_prepass_budget=args.device_prepass_budget,
     )
 
     if not disassembler.contracts:

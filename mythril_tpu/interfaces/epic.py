@@ -1,26 +1,126 @@
-"""--epic: pipe analyzer output through a falling-character renderer
-(reference: mythril/interfaces/epic.py, the easter egg)."""
+"""--epic: the matrix-rain easter egg.
+
+Reference parity: mythril/interfaces/epic.py — `myth --epic ...` re-runs
+itself piped through this renderer. The effect here is an original
+implementation: the analyzer's real output characters fall down the
+terminal in green columns and settle into the final report; non-TTY
+stdout degrades to a light glitter pass so piping stays scriptable.
+"""
 
 from __future__ import annotations
 
+import os
 import random
+import shutil
 import sys
 import time
 
+GREEN = "\033[92m"
+DIM = "\033[2;32m"
+WHITE = "\033[97m"
+RESET = "\033[0m"
+CLEAR = "\033[2J"
+HOME = "\033[H"
+HIDE_CURSOR = "\033[?25l"
+SHOW_CURSOR = "\033[?25h"
+
+GLYPHS = "0123456789abcdefABCDEF<>[]{}()#$%&*+-/=?!"
+
+
+class Rain:
+    """Green columns rain the payload onto the screen, then the real
+    text is revealed line by line beneath the falling heads."""
+
+    def __init__(self, lines, width: int, height: int) -> None:
+        self.lines = lines
+        self.width = width
+        self.height = height
+        self.heads = [random.randint(-height, 0) for _ in range(width)]
+        self.speed = [random.choice((1, 1, 2)) for _ in range(width)]
+        self.revealed = 0
+
+    def frame(self) -> str:
+        grid = [[" "] * self.width for _ in range(self.height)]
+        styles = [[""] * self.width for _ in range(self.height)]
+
+        # settled payload: the top `revealed` lines of real output
+        top = max(0, self.revealed - self.height)
+        visible = self.lines[top : self.revealed]
+        for row, line in enumerate(visible):
+            for col, ch in enumerate(line[: self.width]):
+                grid[row][col] = ch
+                styles[row][col] = GREEN
+
+        # falling heads overwrite with bright trails
+        for col in range(self.width):
+            head = self.heads[col]
+            for tail in range(4):
+                row = head - tail
+                if 0 <= row < self.height:
+                    grid[row][col] = random.choice(GLYPHS)
+                    styles[row][col] = WHITE if tail == 0 else DIM
+            self.heads[col] += self.speed[col]
+            if head - 4 > self.height:
+                self.heads[col] = random.randint(-self.height // 2, 0)
+                self.speed[col] = random.choice((1, 1, 2))
+
+        rows = []
+        for row in range(self.height):
+            out = []
+            style = ""
+            for col in range(self.width):
+                want = styles[row][col]
+                if want != style:
+                    out.append(RESET if not want else want)
+                    style = want
+                out.append(grid[row][col])
+            if style:
+                out.append(RESET)
+            rows.append("".join(out))
+        return HOME + "\n".join(rows)
+
+    def run(self, fps: float = 24.0) -> None:
+        delay = 1.0 / fps
+        total = len(self.lines)
+        sys.stdout.write(HIDE_CURSOR + CLEAR)
+        try:
+            settle_frames = self.height // 2
+            while self.revealed < total or settle_frames > 0:
+                if self.revealed < total:
+                    self.revealed += 1
+                else:
+                    settle_frames -= 1
+                sys.stdout.write(self.frame())
+                sys.stdout.flush()
+                time.sleep(delay)
+        finally:
+            sys.stdout.write(RESET + SHOW_CURSOR + "\n")
+
+
+def _glitter(stream) -> None:
+    """Non-TTY fallback: sprinkle green, keep the text greppable."""
+    for line in stream:
+        out = []
+        for ch in line.rstrip("\n"):
+            if ch.strip() and random.random() < 0.1:
+                out.append(GREEN + ch + RESET)
+            else:
+                out.append(ch)
+        print("".join(out))
+        sys.stdout.flush()
+        time.sleep(0.005)
+
 
 def main() -> None:
-    green = "\033[92m"
-    reset = "\033[0m"
-    for line in sys.stdin:
-        rendered = ""
-        for ch in line.rstrip("\n"):
-            if ch.strip() and random.random() < 0.12:
-                rendered += green + ch + reset
-            else:
-                rendered += ch
-        print(rendered)
-        sys.stdout.flush()
-        time.sleep(0.01)
+    if not sys.stdout.isatty() or os.environ.get("TERM", "dumb") == "dumb":
+        _glitter(sys.stdin)
+        return
+    size = shutil.get_terminal_size((80, 24))
+    lines = [line.rstrip("\n") for line in sys.stdin]
+    rain = Rain(lines, size.columns, size.lines - 1)
+    rain.run()
+    # leave the full plain report in the scrollback for reading
+    print("\n".join(lines))
 
 
 if __name__ == "__main__":

@@ -20,10 +20,16 @@ mythril/laser/ethereum/svm.py:189-219 drives `-t` symbolic attacker
 transactions): a successful lane whose storage journal gained writes
 becomes a *carry* — its journal is the next transaction's start state
 (make_batch storage_seed) and its calldata joins the witness prefix.
-Non-mutating end states are dropped exactly like the reference's
-mutation pruner drops "clean" zero-value transactions
-(laser/plugin/plugins/mutation_pruner.py:22-89) — on device the pruner
-is simply the carry filter.
+
+The reference's frontier pruners map onto the carry step (SURVEY §2.4
+"pruners as lane masks"):
+- mutation pruner (mutation_pruner.py:22-89): non-mutating zero-value
+  end states never become carries — identical drop rule, as a filter;
+- dependency pruner: carry dedup by canonicalized journal collapses
+  the states whose tx-N writes are indistinguishable to tx N+1;
+- call-depth limiter: structurally moot on device — CALL-family
+  opcodes hand the lane to the host (UNSUPPORTED), so device lanes
+  never nest frames.
 
 Compare analysis/hybrid_fuzz.py, whose flips re-execute the whole path
 prefix through the host object engine — here the arena replaces that

@@ -55,6 +55,10 @@ _GLOBAL_DEFAULTS = dict(
     parallel_solving=False,
     unconstrained_storage=False,
     call_depth_limit=3,
+    device_prepass="auto",
+    device_solving="auto",
+    device_prepass_budget=12.0,
+    device_prepass_lanes=128,
 )
 
 

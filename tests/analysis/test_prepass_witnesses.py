@@ -1,0 +1,67 @@
+"""Unit tests for the prepass witness -> Issue conversion
+(analysis/prepass.py) and the phase profiler."""
+
+from mythril_tpu.analysis.prepass import (
+    REPLAY_GAS_LIMIT,
+    witness_issues,
+)
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.support.phase_profile import PhaseProfile
+
+# PUSH1 0; CALLDATALOAD; PUSH1 7; JUMPI; STOP; JUMPDEST; ASSERT_FAIL
+ASSERTING = "600035600757005bfe"
+
+
+def _outcome(**record):
+    base = {"pc": 8, "input": "42" * 36, "gas_min": 100, "gas_max": 200}
+    base.update(record)
+    return {"triggers": {"assert-violation": [base]}, "stats": {}}
+
+
+def test_assert_witness_becomes_swc110_issue():
+    contract = EVMContract(ASSERTING, name="A")
+    issues = witness_issues(contract, _outcome(), 0xA11CE)
+    assert len(issues) == 1
+    issue = issues[0]
+    assert (issue.swc_id, issue.address, issue.severity) == ("110", 8, "Medium")
+    assert issue.provenance == "device-prepass"
+    assert issue.min_gas_used == 100 and issue.max_gas_used == 200
+    step = issue.transaction_sequence["steps"][0]
+    assert step["input"] == "0x" + "42" * 36
+    assert step["address"] == hex(0xA11CE)
+
+
+def test_witness_not_at_assert_byte_is_rejected():
+    contract = EVMContract(ASSERTING, name="A")
+    # pc 6 is STOP territory, not the designated INVALID byte
+    assert witness_issues(contract, _outcome(pc=6), 0xA11CE) == []
+
+
+def test_witness_beyond_replay_gas_limit_is_rejected():
+    contract = EVMContract(ASSERTING, name="A")
+    outcome = _outcome(gas_min=REPLAY_GAS_LIMIT + 1)
+    assert witness_issues(contract, outcome, 0xA11CE) == []
+
+
+def test_multi_step_prefix_renders_in_order():
+    contract = EVMContract(ASSERTING, name="A")
+    outcome = _outcome(prefix=["01" * 36])
+    issues = witness_issues(contract, outcome, 0xA11CE)
+    steps = issues[0].transaction_sequence["steps"]
+    assert [s["input"][:4] for s in steps] == ["0x01", "0x42"]
+
+
+def test_phase_profile_accumulates_and_resets():
+    profile = PhaseProfile()
+    profile.reset()
+    with profile.measure("step"):
+        pass
+    with profile.measure("step"):
+        pass
+    profile.add("prepass", 1.5)
+    snap = profile.as_dict()
+    assert snap["step"]["count"] == 2
+    assert snap["prepass"]["wall_s"] == 1.5
+    assert "step" in str(profile)
+    profile.reset()
+    assert profile.as_dict() == {}
