@@ -219,6 +219,12 @@ class SymExecWrapper:
                     budget_s=budget,
                     address=address.value,
                     transaction_count=self.laser.transaction_count,
+                    # with on-chain loading, foreign accounts may carry
+                    # code — CALLs must hand off to the host engine
+                    empty_world=not (
+                        self.dynloader is not None
+                        and getattr(self.dynloader, "active", False)
+                    ),
                 )
                 outcome = explorer.run()
             except Exception as why:  # the host walk must never be blocked

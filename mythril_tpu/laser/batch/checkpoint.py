@@ -18,7 +18,7 @@ import numpy as np
 
 from mythril_tpu.laser.batch.state import CodeTable, StateBatch
 
-FORMAT_VERSION = 2  # v2: StateBatch gained pc_seen + branch journal
+FORMAT_VERSION = 3  # v2: + pc_seen/branch journal; v3: + empty_world
 
 
 def save_checkpoint(
@@ -56,13 +56,14 @@ def load_checkpoint(
             if key in data:
                 fields[name] = data[key]
         missing = [n for n in StateBatch._fields if n not in fields]
-        # v1 checkpoints predate pc_seen + the branch journal; those
-        # fields start empty, so zero-fill exactly them at the stored
-        # lane count. Any other absence (any version) is corruption.
-        V1_MISSING_OK = {"pc_seen", "br_pc", "br_taken", "br_cnt"}
-        if missing and (
-            meta.get("version") != 1 or not set(missing) <= V1_MISSING_OK
-        ):
+        # fields newer than the checkpoint's format start at their
+        # defaults; any other absence (any version) is corruption
+        MISSING_OK = {
+            1: {"pc_seen", "br_pc", "br_taken", "br_cnt", "empty_world"},
+            2: {"empty_world"},
+        }
+        allowed = MISSING_OK.get(meta.get("version"), set())
+        if missing and not set(missing) <= allowed:
             raise ValueError(f"checkpoint missing fields: {missing}")
         if missing:
             from mythril_tpu.laser.batch.state import BRANCH_CAP, PC_BITMAP_WORDS
@@ -73,6 +74,10 @@ def load_checkpoint(
                 "br_pc": lambda: np.full((n, BRANCH_CAP), -1, np.int32),
                 "br_taken": lambda: np.zeros((n, BRANCH_CAP), np.uint8),
                 "br_cnt": lambda: np.zeros((n,), np.int32),
+                # pre-v3 checkpoints ran every call through takeover;
+                # resuming under the default analyze world is the new
+                # engine behavior, not a semantic change to the lanes
+                "empty_world": lambda: np.ones((n,), np.uint8),
             }
             for name in missing:
                 fields[name] = empties[name]()

@@ -207,6 +207,7 @@ class DeviceCorpusExplorer:
         address: int = DEFAULT_ADDRESS,
         n_devices: Optional[int] = None,
         transaction_count: int = 1,
+        empty_world: bool = True,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -226,6 +227,10 @@ class DeviceCorpusExplorer:
         self.budget_s = budget_s
         self.address = address
         self.transaction_count = max(1, transaction_count)
+        # False when foreign accounts may carry code (on-chain
+        # loading): device lanes then hand CALLs to the host instead
+        # of treating them as transfers
+        self.empty_world = empty_world
         self.rng = random.Random(seed)
         self.stats = ExploreStats()
 
@@ -336,6 +341,7 @@ class DeviceCorpusExplorer:
             mem_cap=16384,
             storage_cap=128,
             storage_seed=storage_seed,
+            empty_world=self.empty_world,
             **REPLAY_ENV,
         )
         if self.mesh is not None:
@@ -543,6 +549,7 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
         budget_s: Optional[float] = None,
         address: int = DEFAULT_ADDRESS,
         transaction_count: int = 1,
+        empty_world: bool = True,
     ) -> None:
         super().__init__(
             [code_hex],
@@ -557,6 +564,7 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
             budget_s=budget_s,
             address=address,
             transaction_count=transaction_count,
+            empty_world=empty_world,
         )
 
     # single-contract views over the corpus bookkeeping

@@ -104,6 +104,10 @@ class StateBatch(NamedTuple):
     gaslimit: jnp.ndarray
     chainid: jnp.ndarray
     basefee: jnp.ndarray
+    # world model: 1 = no foreign account carries code, so CALL-family
+    # ops to non-self, non-precompile addresses execute on device as
+    # plain transfers (the analyze world); 0 = calls hand off to host
+    empty_world: jnp.ndarray  # u8[N]
 
     @property
     def n_lanes(self) -> int:
@@ -152,6 +156,7 @@ def make_batch(
     storage_cap: int = STORAGE_CAP,
     stack_cap: int = STACK_CAP,
     storage_seed=None,
+    empty_world=True,
 ) -> StateBatch:
     """Fresh batch at pc=0 with empty stacks and zeroed memory.
 
@@ -221,6 +226,11 @@ def make_batch(
         gaslimit=_word_rows(n, 8_000_000),
         chainid=_word_rows(n, chainid),
         basefee=_word_rows(n, 7),
+        empty_world=(
+            jnp.full((n,), int(bool(empty_world)), jnp.uint8)
+            if np.isscalar(empty_world) or isinstance(empty_world, bool)
+            else jnp.asarray(empty_world, jnp.uint8)
+        ),
     )
 
 

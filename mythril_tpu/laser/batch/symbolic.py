@@ -64,6 +64,9 @@ NODE_BINOPS = [
 NODE_UNOPS = ["ISZERO", "NOT"]
 #: ternary ops degrade to opaque when tainted
 TERNARY_OPS = ["ADDMOD", "MULMOD"]
+#: empty-world calls: the concrete push is exact, but a tainted
+#: gas/callee/value makes the outcome path-dependent -> opaque
+CALL_OPS = ["CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"]
 
 _IS_BIN = np.zeros(256, bool)
 for _n in NODE_BINOPS:
@@ -74,6 +77,9 @@ for _n in NODE_UNOPS:
 _IS_TER = np.zeros(256, bool)
 for _n in TERNARY_OPS:
     _IS_TER[_B[_n]] = True
+_IS_CALL = np.zeros(256, bool)
+for _n in CALL_OPS:
+    _IS_CALL[_B[_n]] = True
 
 _POPS = np.zeros(256, np.int32)
 _PUSHES = np.zeros(256, np.int32)
@@ -90,6 +96,7 @@ SHA3 = _B["SHA3"]
 MLOAD, MSTORE, MSTORE8 = _B["MLOAD"], _B["MSTORE"], _B["MSTORE8"]
 SLOAD, SSTORE = _B["SLOAD"], _B["SSTORE"]
 JUMPI = _B["JUMPI"]
+CALL_B, SELFBALANCE_B = _B["CALL"], _B["SELFBALANCE"]
 
 
 class SymBatch(NamedTuple):
@@ -101,6 +108,7 @@ class SymBatch(NamedTuple):
     skey_tid: jnp.ndarray  # i32[N, STORAGE_CAP]
     sval_tid: jnp.ndarray  # i32[N, STORAGE_CAP]
     br_tid: jnp.ndarray  # i32[N, BRANCH_CAP] condition term per decision
+    balance_tid: jnp.ndarray  # i32[N]; 0 or OPAQUE (tainted transfers)
     # the shared expression arena
     ar_op: jnp.ndarray  # i32[ARENA_CAP]
     ar_a: jnp.ndarray  # i32[ARENA_CAP] operand-a term id (0 = concrete)
@@ -119,6 +127,7 @@ def make_sym_batch(base: StateBatch) -> SymBatch:
         skey_tid=jnp.zeros((n, base.storage_keys.shape[1]), jnp.int32),
         sval_tid=jnp.zeros((n, base.storage_keys.shape[1]), jnp.int32),
         br_tid=jnp.zeros((n, base.br_pc.shape[1]), jnp.int32),
+        balance_tid=jnp.zeros((n,), jnp.int32),
         ar_op=jnp.zeros((ARENA_CAP,), jnp.int32),
         ar_a=jnp.zeros((ARENA_CAP,), jnp.int32),
         ar_b=jnp.zeros((ARENA_CAP,), jnp.int32),
@@ -186,11 +195,22 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     bin_ok = (a_tid >= 0) & (b_tid >= 0)
     un_ok = a_tid >= 0
     mk_node = (bin_sym & bin_ok) | (un_sym & un_ok) | cdl_clean
+    tainted_top3 = (a_tid != 0) | (b_tid != 0) | (c_tid != 0)
+    is_callf = jnp.asarray(_IS_CALL)[op]
+    # a call's success push depends on its operands AND on the balance,
+    # which an earlier tainted transfer may have made path-dependent
     mk_opaque = (
         (bin_sym & ~bin_ok)
         | (un_sym & ~un_ok)
-        | (ex & is_ter & ((a_tid != 0) | (b_tid != 0) | (c_tid != 0)))
+        | (ex & is_ter & tainted_top3)
         | (ex & is_cdl & (a_tid != 0))
+        | (ex & is_callf & (tainted_top3 | (symb.balance_tid != 0)))
+    )
+    # an outgoing CALL of a tainted value taints the balance itself
+    balance_tid = jnp.where(
+        ex & (op == CALL_B) & ((c_tid != 0) | (symb.balance_tid != 0)),
+        OPAQUE,
+        symb.balance_tid,
     )
 
     # --- memory taints -------------------------------------------------
@@ -289,6 +309,10 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     res_tid = jnp.where(mk_opaque | overflowed, OPAQUE, res_tid)
     res_tid = jnp.where(mload_prop, w_first, res_tid)
     res_tid = jnp.where(sload_m, sload_tid, res_tid)
+    # SELFBALANCE reads the (possibly tainted) balance
+    res_tid = jnp.where(
+        ex & (op == SELFBALANCE_B) & (balance_tid != 0), OPAQUE, res_tid
+    )
 
     # DUP/SWAP move tids with their values
     is_dup = (op >= 0x80) & (op <= 0x8F)
@@ -330,6 +354,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         skey_tid=skey_tid,
         sval_tid=sval_tid,
         br_tid=br_tid,
+        balance_tid=balance_tid,
         ar_op=ar_op,
         ar_a=ar_a,
         ar_b=ar_b,

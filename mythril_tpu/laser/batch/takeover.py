@@ -44,11 +44,18 @@ def _word(value: int):
     return symbol_factory.BitVecVal(value, 256)
 
 
-def lift_lane(code_hex: str, batch: StateBatch, lane: int):
+def lift_lane(
+    code_hex: str, batch: StateBatch, lane: int, extra_accounts=None
+):
     """Rebuild one lane as a mid-frame host GlobalState.
 
     Returns (laser, global_state) with the state already on the
     engine's worklist; the caller runs `laser.exec(track_gas=True)`.
+
+    `extra_accounts` — [(address, code_hex, balance, storage_dict)] —
+    populates the world's foreign accounts, so a lane that degraded at
+    a CALL into coded territory resumes against the real callee
+    instead of an auto-created empty account.
     """
     address = u256.to_int(np.asarray(batch.address[lane]))
     caller = u256.to_int(np.asarray(batch.caller[lane]))
@@ -64,6 +71,16 @@ def lift_lane(code_hex: str, batch: StateBatch, lane: int):
     account.code = disassembly
     world_state.put_account(account)
     account.set_balance(balance)
+
+    for f_addr, f_code, f_balance, f_storage in extra_accounts or []:
+        if f_addr == address:
+            continue  # the exec account's live state wins
+        foreign = Account(f_addr, concrete_storage=True)
+        foreign.code = Disassembly(f_code)
+        world_state.put_account(foreign)
+        foreign.set_balance(f_balance)
+        for slot, stored in (f_storage or {}).items():
+            foreign.storage[_word(slot)] = _word(stored)
 
     # the full storage journal, zero writes included (a zeroing SSTORE
     # must override any earlier nonzero write on replay)
@@ -138,6 +155,7 @@ def resume_on_host(
     batch: StateBatch,
     lane: int,
     timeout_s: int = 20,
+    extra_accounts=None,
 ) -> Optional[Dict]:
     """Run a resumable lane to completion on the host engine.
 
@@ -148,7 +166,7 @@ def resume_on_host(
         return None
     try:
         time_handler.start_execution(timeout_s)
-        laser, _ = lift_lane(code_hex, batch, lane)
+        laser, _ = lift_lane(code_hex, batch, lane, extra_accounts)
         final_states = laser.exec(track_gas=True) or []
     except Exception as why:
         log.debug("host takeover failed for lane %d: %s", lane, why)

@@ -91,6 +91,12 @@ class VmTest(NamedTuple):
     gaslimit: int
     number: int
     timestamp: int
+    #: a pre-state account other than the exec target carries code, so
+    #: device lanes must not treat calls as empty-world transfers
+    foreign_code: bool
+    #: those accounts, for the host takeover's world:
+    #: ((address, code_hex, balance, ((slot, value), ...)), ...)
+    foreign_accounts: tuple
 
 
 def _hx(s: str) -> int:
@@ -154,6 +160,20 @@ def load_vmtests(root: Path = VMTESTS_ROOT, suites=None):
                 gas = _hx(ex["gas"])
                 gas_after = data.get("gas")
                 env = data.get("env", {})
+                foreign_accounts = tuple(
+                    (
+                        _hx(k),
+                        v.get("code", "0x")[2:],
+                        _hx(v.get("balance", "0x0")),
+                        tuple(
+                            (_hx(sk), _hx(sv))
+                            for sk, sv in v.get("storage", {}).items()
+                        ),
+                    )
+                    for k, v in pre.items()
+                    if _hx(k) != addr
+                )
+                foreign_code = any(acct[1] for acct in foreign_accounts)
                 cases.append(VmTest(
                     name=f"{suite}/{name}",
                     suite=suite,
@@ -176,6 +196,8 @@ def load_vmtests(root: Path = VMTESTS_ROOT, suites=None):
                     gaslimit=_hx(env.get("currentGasLimit", "0x0")),
                     number=_hx(env.get("currentNumber", "0x0")),
                     timestamp=_hx(env.get("currentTimestamp", "0x0")),
+                    foreign_code=foreign_code,
+                    foreign_accounts=foreign_accounts,
                 ))
     return cases, skipped
 
@@ -193,6 +215,9 @@ def build_batch(cases):
         code_ids=np.arange(n, dtype=np.int32),
         calldata=[c.calldata for c in cases],
         stack_cap=1024,  # the real EVM stack limit
+        empty_world=np.array(
+            [not c.foreign_code for c in cases], dtype=np.uint8
+        ),
     )
     skeys = np.zeros((n, STORAGE_CAP, u256.LIMBS), dtype=np.uint32)
     svals = np.zeros_like(skeys)
@@ -339,7 +364,15 @@ def run_cases(
         ):
             from mythril_tpu.laser.batch.takeover import resume_on_host
 
-            outcome = resume_on_host(c.code.hex(), view, lane)
+            outcome = resume_on_host(
+                c.code.hex(),
+                view,
+                lane,
+                extra_accounts=[
+                    (addr, code, bal, dict(slots))
+                    for addr, code, bal, slots in c.foreign_accounts
+                ],
+            )
             if outcome is not None:
                 verdict = _host_verdict(c, outcome)
         verdicts[c.name] = verdict

@@ -200,8 +200,15 @@ def test_error_paths():
     out = exec_one(bytes([0x21]))
     assert int(out.status[0]) == Status.INVALID
     # unsupported on device -> host takes over
+    out = exec_one([push(0)] * 3 + ["CREATE"])
+    assert int(out.status[0]) == Status.UNSUPPORTED
+    # a CALL to a codeless address executes on device as a transfer
+    # (empty-world semantics); STOP after it proves the lane continued
+    out = exec_one([push(0)] * 7 + ["CALL", "STOP"])
+    assert int(out.status[0]) == Status.STOPPED
+    # ... but a self-call needs real code execution -> host takeover
     out = exec_one(
-        [push(0)] * 7 + ["CALL"])
+        [push(0)] * 5 + ["ADDRESS"] + [push(0)] + ["CALL"])
     assert int(out.status[0]) == Status.UNSUPPORTED
     # running off the end of code halts like STOP
     out = exec_one([push(1), "POP"])
