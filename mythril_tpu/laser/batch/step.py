@@ -164,27 +164,20 @@ def step(batch: StateBatch, code: CodeTable,
 
     # ---- operands --------------------------------------------------------
     # one gather for every slot any phase peeks (a/b/c + DUP/SWAP
-    # depths + the CALL-family memory windows): unfused gathers
-    # dominate step latency on this platform
+    # depths): unfused gathers dominate step latency on this platform.
+    # (The CALL-family memory-window operands gather separately inside
+    # the lax.cond'd call branch — widening THIS gather taxes every
+    # step, measured at ~5% of the headline throughput.)
     dup_n_pre = (op - 0x80).astype(jnp.int32)
     swap_n_pre = (op - 0x8F).astype(jnp.int32)
-    # in/out window operands sit at depth 3..6 for CALL/CALLCODE
-    # (after gas,to,value) and 2..5 for DELEGATECALL/STATICCALL
-    call_win0 = jnp.where(
-        (op == CALL_OP) | (op == CALLCODE_OP), 3, 2
-    ).astype(jnp.int32)
     peek_ks = jnp.stack(
         [jnp.zeros_like(op), jnp.ones_like(op), 2 * jnp.ones_like(op),
-         dup_n_pre, swap_n_pre,
-         call_win0, call_win0 + 1, call_win0 + 2, call_win0 + 3],
-        axis=1)  # [n, 9]
+         dup_n_pre, swap_n_pre], axis=1)  # [n, 5]
     peek_idx = jnp.clip(batch.sp[:, None] - 1 - peek_ks, 0, stack_cap - 1)
     peeked = jnp.take_along_axis(
         batch.stack, peek_idx[:, :, None].astype(jnp.int32), axis=1)
     a, b, c = peeked[:, 0], peeked[:, 1], peeked[:, 2]
     dup_val, swap_deep_val = peeked[:, 3], peeked[:, 4]
-    call_in_off_w, call_in_len_w = peeked[:, 5], peeked[:, 6]
-    call_ret_off_w, call_ret_len_w = peeked[:, 7], peeked[:, 8]
 
     status = batch.status
     status = jnp.where(halt_oob, Status.STOPPED, status)
@@ -224,64 +217,97 @@ def step(batch: StateBatch, code: CodeTable,
         (op == CALL_OP) | (op == CALLCODE_OP)
         | (op == DELEGATECALL_OP) | (op == STATICCALL_OP)
     )
-    # the EVM truncates call targets mod 2**160 (10 of 16 limbs)
-    callee = jnp.concatenate(
-        [b[:, :10], jnp.zeros_like(b[:, 10:])], axis=1
-    )
-    callee_precompile = (
-        jnp.all(callee[:, 1:] == 0, axis=-1)
-        & (callee[:, 0] >= 1)
-        & (callee[:, 0] <= 9)
-    )
-    # in/out memory windows: the call expands memory over both even
-    # with a codeless callee. Degenerate windows (non-i32 offsets, >1MB
-    # expansion — where the quadratic gas would overflow) go to host.
-    in_off_i, in_off_big = _word_to_i32(call_in_off_w)
-    in_len_i, in_len_big = _word_to_i32(call_in_len_w)
-    ret_off_i, ret_off_big = _word_to_i32(call_ret_off_w)
-    ret_len_i, ret_len_big = _word_to_i32(call_ret_len_w)
+    call_any = ex & is_call_fam
+    balance = batch.balance
 
-    def _win_words(off_i, len_i):
-        return jnp.where(len_i > 0, (off_i + len_i + 31) // 32, 0)
+    def do_calls(args):
+        res_val, res_mask, status, balance, msize, g_min, g_max = args
+        # the EVM truncates call targets mod 2**160 (10 of 16 limbs)
+        callee = jnp.concatenate(
+            [b[:, :10], jnp.zeros_like(b[:, 10:])], axis=1
+        )
+        callee_precompile = (
+            jnp.all(callee[:, 1:] == 0, axis=-1)
+            & (callee[:, 0] >= 1)
+            & (callee[:, 0] <= 9)
+        )
+        # window operands sit at depth 3..6 for CALL/CALLCODE (after
+        # gas,to,value) and 2..5 for DELEGATECALL/STATICCALL
+        win0 = jnp.where(
+            (op == CALL_OP) | (op == CALLCODE_OP), 3, 2
+        ).astype(jnp.int32)
+        win_ks = win0[:, None] + jnp.arange(4)[None, :]
+        win_idx = jnp.clip(
+            batch.sp[:, None] - 1 - win_ks, 0, stack_cap - 1
+        )
+        windows = jnp.take_along_axis(
+            batch.stack, win_idx[:, :, None].astype(jnp.int32), axis=1
+        )
+        # in/out memory windows: the call expands memory over both even
+        # with a codeless callee. Degenerate windows (non-i32 offsets,
+        # >1MB expansion — where quadratic gas would overflow) go to host.
+        in_off_i, in_off_big = _word_to_i32(windows[:, 0])
+        in_len_i, in_len_big = _word_to_i32(windows[:, 1])
+        ret_off_i, ret_off_big = _word_to_i32(windows[:, 2])
+        ret_len_i, ret_len_big = _word_to_i32(windows[:, 3])
 
-    call_want_words = jnp.maximum(
-        _win_words(in_off_i, in_len_i), _win_words(ret_off_i, ret_len_i)
+        def _win_words(off_i, len_i):
+            return jnp.where(len_i > 0, (off_i + len_i + 31) // 32, 0)
+
+        want_words = jnp.maximum(
+            _win_words(in_off_i, in_len_i), _win_words(ret_off_i, ret_len_i)
+        )
+        win_bad = (
+            in_len_big
+            | ret_len_big
+            | ((in_len_i > 0) & in_off_big)
+            | ((ret_len_i > 0) & ret_off_big)
+            | (want_words > (1 << 15))
+        )
+        runnable = (
+            (batch.empty_world != 0)
+            & ~u256.eq(callee, batch.address)
+            & ~callee_precompile
+            & ~win_bad
+        )
+        degrade = call_any & ~runnable
+        status = jnp.where(degrade, Status.UNSUPPORTED, status)
+        call_exec = call_any & runnable
+        # the transferred value: third stack word for CALL/CALLCODE only
+        carries_value = (op == CALL_OP) | (op == CALLCODE_OP)
+        call_value = _m(call_exec & carries_value, c, jnp.zeros_like(c))
+        can_pay = ~u256.ult(balance, call_value)
+        res_val, res_mask = put(
+            res_val, res_mask, call_exec, u256.bool_to_word(can_pay)
+        )
+        # only an outgoing CALL moves ether (CALLCODE pays itself)
+        outgoing = call_exec & (op == CALL_OP) & can_pay
+        balance = _m(outgoing, u256.sub(balance, call_value), balance)
+        # memory growth + its exact quadratic gas (words capped above,
+        # so the uint32 arithmetic cannot overflow)
+        new_msize = jnp.maximum(msize, want_words.astype(jnp.int32))
+        mem_gas = jnp.where(
+            call_exec, _mem_gas(new_msize) - _mem_gas(msize), 0
+        ).astype(jnp.uint32)
+        return (
+            res_val,
+            res_mask,
+            status,
+            balance,
+            jnp.where(call_exec, new_msize, msize),
+            g_min + mem_gas,
+            g_max + mem_gas,
+        )
+
+    (res_val, res_mask, status, balance, msize, gas_dyn_min, gas_dyn_max) = (
+        lax.cond(
+            jnp.any(call_any),
+            do_calls,
+            lambda x: x,
+            (res_val, res_mask, status, balance, msize, gas_dyn_min,
+             gas_dyn_max),
+        )
     )
-    call_win_bad = (
-        in_len_big
-        | ret_len_big
-        | ((in_len_i > 0) & in_off_big)
-        | ((ret_len_i > 0) & ret_off_big)
-        | (call_want_words > (1 << 15))
-    )
-    call_runnable = (
-        (batch.empty_world != 0)
-        & ~u256.eq(callee, batch.address)
-        & ~callee_precompile
-        & ~call_win_bad
-    )
-    call_degrade = ex & is_call_fam & ~call_runnable
-    status = jnp.where(call_degrade, Status.UNSUPPORTED, status)
-    call_exec = ex & is_call_fam & call_runnable
-    # the transferred value: third stack word for CALL/CALLCODE only
-    carries_value = (op == CALL_OP) | (op == CALLCODE_OP)
-    call_value = _m(call_exec & carries_value, c, jnp.zeros_like(c))
-    can_pay = ~u256.ult(batch.balance, call_value)
-    res_val, res_mask = put(
-        res_val, res_mask, call_exec, u256.bool_to_word(can_pay)
-    )
-    # only an outgoing CALL moves ether (CALLCODE pays the self account)
-    outgoing = call_exec & (op == CALL_OP) & can_pay
-    balance = _m(outgoing, u256.sub(batch.balance, call_value), batch.balance)
-    # memory growth + its exact quadratic gas (words capped above, so
-    # the uint32 arithmetic cannot overflow)
-    call_new_msize = jnp.maximum(msize, call_want_words.astype(jnp.int32))
-    call_mem_gas = jnp.where(
-        call_exec, _mem_gas(call_new_msize) - _mem_gas(msize), 0
-    ).astype(jnp.uint32)
-    gas_dyn_min = gas_dyn_min + call_mem_gas
-    gas_dyn_max = gas_dyn_max + call_mem_gas
-    msize = jnp.where(call_exec, call_new_msize, msize)
 
     # ---- cheap binary arithmetic / compares / bitwise --------------------
     cheap_bin = {
