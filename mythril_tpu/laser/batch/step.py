@@ -84,15 +84,21 @@ RETURN, REVERT, INVALID_OP, SELFDESTRUCT = (
 CALL_OP, CALLCODE_OP, DELEGATECALL_OP, STATICCALL_OP = (
     _B["CALL"], _B["CALLCODE"], _B["DELEGATECALL"], _B["STATICCALL"],
 )
+EXTCODESIZE_OP, EXTCODECOPY_OP, RETURNDATACOPY_OP = (
+    _B["EXTCODESIZE"], _B["EXTCODECOPY"], _B["RETURNDATACOPY"],
+)
 
 _UNSUPPORTED_NAMES = [
     "CREATE", "CREATE2",
-    "EXTCODESIZE", "EXTCODECOPY", "EXTCODEHASH", "RETURNDATACOPY",
+    "EXTCODECOPY", "EXTCODEHASH",
     "BEGINSUB", "RETURNSUB", "JUMPSUB",
 ]
 # CALL/CALLCODE/DELEGATECALL/STATICCALL are conditionally supported:
 # in an empty world (no foreign code) they execute as transfers; the
-# kernel demotes the remaining cases to UNSUPPORTED per lane.
+# kernel demotes the remaining cases to UNSUPPORTED per lane. The same
+# gating covers EXTCODESIZE (self -> own code length, foreign -> 0)
+# and RETURNDATACOPY (the zero-length form Solidity emits after calls;
+# nonzero lengths are an EVM exception the host adjudicates).
 
 # ---------------------------------------------------------------------------
 # static per-opcode tables (numpy, baked into the jit as constants)
@@ -123,6 +129,14 @@ def _word_to_i32(a):
     lo = a[..., 0] + (a[..., 1] << 16)
     big = jnp.any(a[..., 2:] != 0, axis=-1) | (lo >= jnp.uint32(1 << 31))
     return lo.astype(jnp.int32), big
+
+
+def _addr160(word):
+    """Truncate a u256 word mod 2**160 (10 of 16 limbs) — the EVM's
+    rule for every address-valued operand."""
+    return jnp.concatenate(
+        [word[:, :10], jnp.zeros_like(word[:, 10:])], axis=1
+    )
 
 
 def _mem_gas(words):
@@ -213,6 +227,27 @@ def step(batch: StateBatch, code: CodeTable,
     # Lanes whose callee might carry code — self-calls, precompiles, or
     # batches built from multi-account fixtures (empty_world=0) —
     # degrade to UNSUPPORTED mid-step; the host resumes at the call.
+    # EXTCODESIZE: own address -> code length; any other address in an
+    # empty world -> 0 (precompiles carry no code either). Outside the
+    # empty world a foreign size is unknowable on device.
+    extsz = ex & (op == EXTCODESIZE_OP)
+    extsz_self = u256.eq(_addr160(a), batch.address)
+    extsz_ok = extsz & ((batch.empty_world != 0) | extsz_self)
+    status = jnp.where(extsz & ~extsz_ok, Status.UNSUPPORTED, status)
+    extsz_word = jnp.zeros((n, W), jnp.uint32)
+    extsz_word = extsz_word.at[:, 0].set(
+        jnp.where(extsz_self, code_len, 0).astype(jnp.uint32)
+    )
+    res_val, res_mask = put(res_val, res_mask, extsz_ok, extsz_word)
+
+    # RETURNDATACOPY: device lanes always have an empty return buffer
+    # (calls that would fill one hand off to the host), so the
+    # (dest, 0, 0) form Solidity emits is a no-op; any other operands
+    # are an out-of-bounds read the host adjudicates exactly.
+    rdc = ex & (op == RETURNDATACOPY_OP)
+    rdc_ok = rdc & u256.is_zero(b) & u256.is_zero(c)
+    status = jnp.where(rdc & ~rdc_ok, Status.UNSUPPORTED, status)
+
     is_call_fam = (
         (op == CALL_OP) | (op == CALLCODE_OP)
         | (op == DELEGATECALL_OP) | (op == STATICCALL_OP)
@@ -222,10 +257,7 @@ def step(batch: StateBatch, code: CodeTable,
 
     def do_calls(args):
         res_val, res_mask, status, balance, msize, g_min, g_max = args
-        # the EVM truncates call targets mod 2**160 (10 of 16 limbs)
-        callee = jnp.concatenate(
-            [b[:, :10], jnp.zeros_like(b[:, 10:])], axis=1
-        )
+        callee = _addr160(b)
         callee_precompile = (
             jnp.all(callee[:, 1:] == 0, axis=-1)
             & (callee[:, 0] >= 1)

@@ -97,6 +97,7 @@ MLOAD, MSTORE, MSTORE8 = _B["MLOAD"], _B["MSTORE"], _B["MSTORE8"]
 SLOAD, SSTORE = _B["SLOAD"], _B["SSTORE"]
 JUMPI = _B["JUMPI"]
 CALL_B, SELFBALANCE_B = _B["CALL"], _B["SELFBALANCE"]
+EXTCODESIZE_B = _B["EXTCODESIZE"]
 
 
 class SymBatch(NamedTuple):
@@ -205,7 +206,12 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         | (ex & is_ter & tainted_top3)
         | (ex & is_cdl & (a_tid != 0))
         | (ex & is_callf & (tainted_top3 | (symb.balance_tid != 0)))
+        | (ex & (op == EXTCODESIZE_B) & (a_tid != 0))
     )
+    # (RETURNDATACOPY's zero-length gate needs no shadow case: a
+    # tainted length's OTHER branch is an exceptional halt — a dead
+    # end that yields no witnesses — so not deriving inputs for it
+    # costs completeness nothing the trigger bank would keep.)
     # an outgoing CALL of a tainted value taints the balance itself
     balance_tid = jnp.where(
         ex & (op == CALL_B) & ((c_tid != 0) | (symb.balance_tid != 0)),
@@ -326,11 +332,18 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     res_tid = jnp.where(ex & is_swap, deep_tid, res_tid)
 
     # --- stack tid write (mirrors the consolidated stack write) --------
+    # A lane the kernel demoted mid-step (capacity / conditional
+    # support -> UNSUPPORTED/ERR_MEM) executed nothing: the host will
+    # re-run the instruction from the untouched concrete state, so the
+    # shadow must leave its term ids untouched too.
+    executed = (post.status != Status.UNSUPPORTED) & (
+        post.status != Status.ERR_MEM
+    )
     res_idx = jnp.where(
         is_dup, pre.sp, jnp.where(is_swap, pre.sp - 1, pre.sp - pops)
     )
     res_idx = jnp.clip(res_idx, 0, stack_cap - 1)
-    writes = ex & (pushes > 0)
+    writes = ex & executed & (pushes > 0)
     stack_tid = _scatter2(symb.stack_tid, res_idx, res_tid, writes)
     # SWAP's second slot: the old top's tid sinks to the deep position
     stack_tid = _scatter2(

@@ -322,3 +322,40 @@ def test_int32_wrap_offset_is_oog():
     )
     out, _ = run(batch, table, max_steps=16)
     assert int(out.status[0]) == Status.ERR_OOG
+
+
+def test_extcodesize_and_returndatacopy_device_semantics():
+    """EXTCODESIZE answers on device (own size / 0 in an empty world);
+    RETURNDATACOPY's zero-length Solidity form is a no-op; everything
+    else hands off to the host."""
+    import numpy as np
+
+    from mythril_tpu.laser.batch.run import run
+    from mythril_tpu.laser.batch.state import (
+        Status,
+        make_batch,
+        make_code_table,
+        storage_dict,
+    )
+
+    code = bytes([
+        0x30, 0x3B, 0x60, 0x00, 0x55,              # EXTCODESIZE(self) -> s0
+        0x61, 0xBE, 0xEF, 0x3B, 0x60, 0x01, 0x55,  # EXTCODESIZE(0xbeef) -> s1
+        0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x3E,  # RETURNDATACOPY(0,0,0)
+        0x00,
+    ])
+    table = make_code_table([code])
+    batch = make_batch(
+        2, calldata=[b"", b""], empty_world=np.array([1, 0], np.uint8)
+    )
+    out, _ = run(batch, table, max_steps=32)
+    assert int(out.status[0]) == Status.STOPPED
+    assert storage_dict(out, 0) == {0: len(code)}  # foreign size 0 filtered
+    # a world that may hold foreign code defers the foreign query
+    assert int(out.status[1]) == Status.UNSUPPORTED
+
+    # nonzero-length RETURNDATACOPY is an EVM exception -> host decides
+    code2 = bytes([0x60, 0x01, 0x60, 0x00, 0x60, 0x00, 0x3E, 0x00])
+    out2, _ = run(make_batch(1, calldata=[b""]), make_code_table([code2]),
+                  max_steps=8)
+    assert int(out2.status[0]) == Status.UNSUPPORTED
