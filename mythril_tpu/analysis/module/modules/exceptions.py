@@ -42,6 +42,12 @@ class Exceptions(ImmediateDetector):
             "ASSERT_FAIL in function %s",
             state.environment.active_function_name,
         )
+        from mythril_tpu.analysis.prepass import device_already_proved
+
+        if device_already_proved(state, ASSERT_VIOLATION):
+            # the device prepass banked a concrete witness here; its
+            # issue merges in at fire_lasers — skip the Optimize query
+            return []
         try:
             witness = get_transaction_sequence(
                 state, state.world_state.constraints

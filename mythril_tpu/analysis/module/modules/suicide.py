@@ -57,6 +57,10 @@ class AccidentallyKillable(ImmediateDetector):
         log.debug(
             "SUICIDE in function %s", state.environment.active_function_name
         )
+        # (no device-witness pre-emption here: this module's two-tier
+        # property — balance theft before kill-only — is strictly
+        # richer than the prepass's reachability witness, so the host
+        # solve runs and fire_lasers dedups the weaker device issue)
         beneficiary = state.mstate.stack[-1]
         attacker_only = attacker_transactions(state, tie_origin=True)
         base = state.world_state.constraints + attacker_only

@@ -32,6 +32,48 @@ log = logging.getLogger(__name__)
 
 ASSERT_FAIL_BYTE = 0xFE
 
+#: (runtime_hex, address, swc_id) triples the device already holds a
+#: concrete witness for: the host detection modules skip their own
+#: witness-concretization solve there and let the banked device issue
+#: carry the finding (reset per analysis by SymExecWrapper). Keyed by
+#: bytecode so creation-code pcs and dynloaded foreign contracts never
+#: collide with the analyzed runtime's pc space.
+_PROVEN: set = set()
+
+
+def _norm_code(code_hex: str) -> str:
+    code_hex = code_hex or ""
+    return code_hex[2:] if code_hex.startswith("0x") else code_hex
+
+
+def reset_proven() -> None:
+    _PROVEN.clear()
+
+
+def register_proven(issues, code_hex: str) -> None:
+    code_hex = _norm_code(code_hex)
+    for issue in issues:
+        _PROVEN.add((code_hex, issue.address, issue.swc_id))
+
+
+def device_already_proved(state, swc_id: str) -> bool:
+    """True when the prepass banked a concrete witness for the code
+    this state is executing, at its current instruction — the module's
+    Optimize query would re-derive what a concrete execution already
+    established."""
+    if not _PROVEN:
+        return False
+    code_hex = _norm_code(getattr(state.environment.code, "bytecode", ""))
+    key = (code_hex, state.get_current_instruction()["address"], swc_id)
+    if key in _PROVEN:
+        from mythril_tpu.laser.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        SolverStatistics().device_cert_count += 1
+        return True
+    return False
+
 #: the gas limit the jsonv2 replay context claims (report.py
 #: REPLAY_BLOCK_CONTEXT gasLimit); witnesses that need more gas than
 #: this would not replay, so they are not reported

@@ -44,10 +44,15 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
     issues += retrieve_callback_issues(white_list)
 
     device_issues = getattr(statespace, "device_issues", None) or []
-    if white_list and "Exceptions" not in white_list:
-        # witness issues are the Exceptions module's finding class;
-        # honor the user's module selection
-        device_issues = []
+    if white_list:
+        # honor the user's module selection per finding class: a
+        # device witness stands in for exactly one module's finding
+        allowed_swc = set()
+        if "Exceptions" in white_list:
+            allowed_swc.add("110")
+        if "AccidentallyKillable" in white_list:
+            allowed_swc.add("106")
+        device_issues = [i for i in device_issues if i.swc_id in allowed_swc]
     if device_issues:
         seen = {
             (issue.contract, issue.address, issue.swc_id) for issue in issues

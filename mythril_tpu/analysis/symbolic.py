@@ -97,11 +97,13 @@ class SymExecWrapper:
     ):
         # fresh per-contract solver session: the blast store shares
         # structure within one analysis but would tax the next contract
+        from mythril_tpu.analysis.prepass import reset_proven
         from mythril_tpu.laser.smt.solver.solver import reset_blast_session
         from mythril_tpu.support.phase_profile import PhaseProfile
 
         reset_blast_session()
         PhaseProfile().reset()
+        reset_proven()  # device witnesses never outlive their contract
 
         if strategy not in STRATEGIES:
             raise ValueError("Invalid strategy argument supplied")
@@ -239,9 +241,15 @@ class SymExecWrapper:
             # corpus; only an in-line exploration bills this contract
             PhaseProfile().add("prepass", stats.get("wall_s", 0.0))
         try:
-            from mythril_tpu.analysis.prepass import witness_issues
+            from mythril_tpu.analysis.prepass import (
+                register_proven,
+                witness_issues,
+            )
 
             self.device_issues = witness_issues(contract, outcome, address.value)
+            # the host modules skip their concretization solve at
+            # addresses the device already holds a witness for
+            register_proven(self.device_issues, runtime)
         except Exception as why:
             log.debug("prepass witness conversion failed: %s", why)
         stats["witness_issues"] = len(self.device_issues)
