@@ -46,10 +46,9 @@ def load_checkpoint(
     """Restore (batch, code_table_or_None, step) from `path`."""
     with np.load(str(path)) as data:
         meta = json.loads(bytes(data["meta"]).decode())
-        if meta.get("version") not in (1, FORMAT_VERSION):
-            raise ValueError(
-                f"unsupported checkpoint version {meta.get('version')}"
-            )
+        version = meta.get("version")
+        if not isinstance(version, int) or not 1 <= version <= FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
         fields = {}
         for name in StateBatch._fields:
             key = f"batch.{name}"
@@ -62,7 +61,7 @@ def load_checkpoint(
             1: {"pc_seen", "br_pc", "br_taken", "br_cnt", "empty_world"},
             2: {"empty_world"},
         }
-        allowed = MISSING_OK.get(meta.get("version"), set())
+        allowed = MISSING_OK.get(version, set())
         if missing and not set(missing) <= allowed:
             raise ValueError(f"checkpoint missing fields: {missing}")
         if missing:

@@ -65,3 +65,60 @@ def test_phase_profile_accumulates_and_resets():
     assert "step" in str(profile)
     profile.reset()
     assert profile.as_dict() == {}
+
+
+def test_whitelist_filters_device_issues_per_finding_class():
+    """fire_lasers keeps a device witness only when the module it
+    stands in for is whitelisted (SWC-110 <-> Exceptions,
+    SWC-106 <-> AccidentallyKillable)."""
+    from mythril_tpu.analysis.security import fire_lasers
+
+    contract = EVMContract(ASSERTING, name="A")
+    swc110 = witness_issues(contract, _outcome(), 0xA11CE)
+
+    class FakeSpace:
+        device_issues = swc110
+
+    kept = fire_lasers(FakeSpace(), white_list=["Exceptions"])
+    assert [i.swc_id for i in kept] == ["110"]
+    dropped = fire_lasers(FakeSpace(), white_list=["AccidentallyKillable"])
+    assert dropped == []
+
+
+def test_device_already_proved_is_code_scoped():
+    """The proven-set never collides across bytecodes: a witness in
+    the analyzed runtime must not suppress findings at the same pc of
+    other code (creation bytecode, dynloaded foreign contracts)."""
+    from mythril_tpu.analysis.prepass import (
+        device_already_proved,
+        register_proven,
+        reset_proven,
+    )
+
+    contract = EVMContract(ASSERTING, name="A")
+    issues = witness_issues(contract, _outcome(), 0xA11CE)
+
+    class FakeCode:
+        def __init__(self, bytecode):
+            self.bytecode = bytecode
+
+    class FakeEnv:
+        def __init__(self, bytecode):
+            self.code = FakeCode(bytecode)
+
+    class FakeState:
+        def __init__(self, bytecode, address):
+            self.environment = FakeEnv(bytecode)
+            self._address = address
+
+        def get_current_instruction(self):
+            return {"address": self._address}
+
+    reset_proven()
+    try:
+        register_proven(issues, ASSERTING)
+        assert device_already_proved(FakeState(ASSERTING, 8), "110")
+        assert not device_already_proved(FakeState("6001600101", 8), "110")
+        assert not device_already_proved(FakeState(ASSERTING, 7), "110")
+    finally:
+        reset_proven()  # never leak proven entries into later tests

@@ -93,3 +93,27 @@ def test_v1_checkpoint_zero_fills_new_fields(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(done_a.status), np.asarray(done_b.status)
     )
+
+
+def test_v2_checkpoint_defaults_empty_world(tmp_path):
+    # v2 checkpoints predate the empty_world lane flag; loading one
+    # must default it to the analyze world (all ones), not reject
+    import json
+
+    batch, code = demo()
+    path = tmp_path / "v2.npz"
+    save_checkpoint(path, batch, code)
+    data = dict(np.load(str(path)))
+    del data["batch.empty_world"]
+    data["meta"] = np.frombuffer(
+        json.dumps({"version": 2, "step": 0}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(str(path), **data)
+
+    restored, code2, _ = load_checkpoint(path)
+    assert np.asarray(restored.empty_world).tolist() == [1] * batch.n_lanes
+    done_a, _ = run(batch, code, max_steps=64)
+    done_b, _ = run(restored, code2, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(done_a.status), np.asarray(done_b.status)
+    )
