@@ -124,6 +124,13 @@ def bench_corpus() -> dict:
         contracts = [(f.read_text().strip(), "", f.stem) for f in files]
 
         def leg(use_device):
+            # equal-budget AND equal-cache: the legs share one process,
+            # and get_model's memo is keyed on hash-consed term ids that
+            # are identical across legs — without this reset the second
+            # leg would ride the first leg's solves
+            from mythril_tpu.support.model import clear_cache
+
+            clear_cache()
             t0 = time.perf_counter()
             results = analyze_corpus(
                 contracts,

@@ -34,6 +34,9 @@ def corpus_device_prepass(
     lanes_per_contract: int = 32,
     address: int = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE,
     transaction_count: int = 1,
+    host_lock=None,
+    stop_event=None,
+    publish=None,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
@@ -52,6 +55,11 @@ def corpus_device_prepass(
     try:
         from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
 
+        translate = (
+            None
+            if publish is None
+            else (lambda ti, outcome: publish(runnable[ti][0], outcome))
+        )
         explorer = DeviceCorpusExplorer(
             [code for _, code in runnable],
             lanes_per_contract=lanes_per_contract,
@@ -61,6 +69,9 @@ def corpus_device_prepass(
             budget_s=budget_s,
             address=address,
             transaction_count=transaction_count,
+            host_lock=host_lock,
+            stop_event=stop_event,
+            publish=translate,
         )
         result = explorer.run()
     except Exception:
@@ -220,26 +231,116 @@ def analyze_corpus(
 
     prepass: Dict[str, Dict] = {}
     if single_process:
-        # sequential hosts share this process's solver session, so the
-        # prepass runs up front (a thread would race the incremental
-        # CDCL session the host analyses reset per contract) and each
-        # analysis gets its contract's outcome injected: witness
-        # issues, coverage-guided pruning. At corpus scale the prepass
-        # amortizes — a wave's cost is step-dispatch-bound, not
-        # lane-bound, so 32 or 32k striped lanes cost the same wall.
-        if use_device:
-            prepass = corpus_device_prepass(
-                contracts,
-                budget_s=device_budget_s,
-                address=address,
-                transaction_count=transaction_count,
+        # Sequential hosts: the striped device prepass OVERLAPS the
+        # per-contract analyses — a prepass thread runs the waves (pure
+        # device work) while the main thread analyzes, and both sides
+        # take HOST_SYMBOLIC_LOCK around host symbolic state (the term
+        # arena and the incremental CDCL session are process-global —
+        # support/host_lock.py), so the chip steps while the host
+        # solves and the prepass costs ~zero wall. Contracts reached
+        # after the prepass lands get its outcome injected (witness
+        # issues, coverage-guided pruning); earlier ones pick up their
+        # witnesses in the post-merge, same as the pooled path. A lone
+        # contract can't overlap with anything, so it keeps the
+        # prepass-first ordering and full injection.
+        if use_device and len(contracts) > 1:
+            import threading
+
+            from mythril_tpu.support.host_lock import HOST_SYMBOLIC_LOCK
+
+            stop_event = threading.Event()
+            published: Dict[int, Dict] = {}
+
+            def _prepass_worker():
+                prepass.update(
+                    corpus_device_prepass(
+                        contracts,
+                        budget_s=device_budget_s,
+                        address=address,
+                        transaction_count=transaction_count,
+                        host_lock=HOST_SYMBOLIC_LOCK,
+                        stop_event=stop_event,
+                        publish=published.__setitem__,
+                    )
+                )
+
+            prepass_thread = threading.Thread(
+                target=_prepass_worker, daemon=True
             )
-        results = [
-            _analyze_one(
-                payload(code, creation_code, name, use_device, prepass.get(i))
-            )
-            for i, (code, creation_code, name) in enumerate(contracts)
-        ]
+            prepass_thread.start()
+            prepass_failure_noted = False
+            results = []
+            for i, (code, creation_code, name) in enumerate(contracts):
+                if prepass_thread is not None and not prepass_thread.is_alive():
+                    prepass_thread.join()
+                    prepass_thread = None
+                prepass_done = prepass_thread is None
+                # While the prepass is still running, contracts consume
+                # its latest PUBLISHED partial outcome (wave-1 triggers
+                # and coverage already pre-empt most host solves) with
+                # the device args off — the chip belongs to the prepass
+                # thread, and an injected outcome bypasses the
+                # device_prepass mode check anyway. Once it's done, the
+                # device comes back for everyone: covered contracts get
+                # the final outcome injected (which skips their own
+                # prepass), missed ones (failure, sub-8-char runtime)
+                # fall back to the normal per-contract device path.
+                outcome = prepass.get(i) if prepass_done else published.get(i)
+                worker_device = use_device and prepass_done
+                if (
+                    prepass_done
+                    and not prepass
+                    and i > 0
+                    and not prepass_failure_noted
+                ):
+                    # the prepass died without outcomes: contracts
+                    # already analyzed ran host-only on at most a
+                    # partial outcome — say so rather than degrade
+                    # silently (later contracts fall back to the
+                    # per-contract device path)
+                    prepass_failure_noted = True
+                    log.warning(
+                        "corpus device prepass produced no outcomes; "
+                        "the first %d contract(s) were analyzed without "
+                        "the device",
+                        i,
+                    )
+                with HOST_SYMBOLIC_LOCK:
+                    results.append(
+                        _analyze_one(
+                            payload(
+                                code, creation_code, name, worker_device,
+                                outcome,
+                            )
+                        )
+                    )
+            if prepass_thread is not None:
+                # analyses outran the prepass: stop it at the next wave
+                # boundary and fold in whatever it banked
+                stop_event.set()
+                prepass_thread.join(timeout=300)
+                if prepass_thread.is_alive():
+                    log.warning(
+                        "corpus device prepass did not stop within its "
+                        "grace period; its banked witnesses are lost and "
+                        "the daemon thread may briefly keep the device busy"
+                    )
+        else:
+            if use_device:
+                prepass = corpus_device_prepass(
+                    contracts,
+                    budget_s=device_budget_s,
+                    address=address,
+                    transaction_count=transaction_count,
+                )
+            results = [
+                _analyze_one(
+                    payload(
+                        code, creation_code, name, use_device, prepass.get(i)
+                    )
+                )
+                for i, (code, creation_code, name) in enumerate(contracts)
+            ]
     else:
         # pooled hosts: the prepass likewise overlaps the worker pool;
         # witnesses merge in when both finish

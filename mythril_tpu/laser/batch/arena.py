@@ -47,17 +47,39 @@ class ArenaView:
     """Read-only host copy of one wave's arena + per-lane journals."""
 
     def __init__(self, symb) -> None:
-        self.op = np.asarray(symb.ar_op)
-        self.a = np.asarray(symb.ar_a)
-        self.b = np.asarray(symb.ar_b)
-        self.va = np.asarray(symb.ar_va)
-        self.vb = np.asarray(symb.ar_vb)
-        self.count = int(symb.ar_count)
-        self.br_pc = np.asarray(symb.base.br_pc)
-        self.br_taken = np.asarray(symb.base.br_taken)
-        self.br_tid = np.asarray(symb.br_tid)
-        self.br_cnt = np.asarray(symb.base.br_cnt)
-        self.calldatasize = np.asarray(symb.base.calldatasize)
+        import jax
+
+        # one bundled transfer: sequential per-array np.asarray pays a
+        # separate device round-trip each (measured 2.8s vs 1.3s for a
+        # striped wave's arena on the tunneled link)
+        (
+            self.op,
+            self.a,
+            self.b,
+            self.va,
+            self.vb,
+            self.br_pc,
+            self.br_taken,
+            self.br_tid,
+            self.br_cnt,
+            self.calldatasize,
+            count,
+        ) = jax.device_get(
+            (
+                symb.ar_op,
+                symb.ar_a,
+                symb.ar_b,
+                symb.ar_va,
+                symb.ar_vb,
+                symb.base.br_pc,
+                symb.base.br_taken,
+                symb.br_tid,
+                symb.base.br_cnt,
+                symb.base.calldatasize,
+                symb.ar_count,
+            )
+        )
+        self.count = int(count)
         self._terms: Dict[int, BitVec] = {}
         self._cd_bytes: Dict[int, BitVec] = {}
         self._fresh = 0

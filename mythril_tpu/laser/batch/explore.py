@@ -52,10 +52,10 @@ from mythril_tpu.laser.batch.state import (
     Status,
     make_batch,
     make_code_table,
-    storage_dict,
+    storage_dict_from,
 )
 from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_run
-from mythril_tpu.laser.smt.solver.portfolio import device_check
+from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
 from mythril_tpu.laser.smt.solver.solver import lower
 from mythril_tpu.support.model import get_model
 
@@ -105,6 +105,10 @@ class ExploreStats:
         self.branches_covered = 0
         self.carries_banked = 0  # mutating end states promoted to tx N+1
         self.wall_s = 0.0
+        # where the prepass wall goes: device wave execution vs host
+        # flip solving (the two phases that can dominate)
+        self.wave_exec_s = 0.0
+        self.flip_solve_s = 0.0
 
     def as_dict(self) -> Dict[str, int]:
         return dict(self.__dict__)
@@ -208,6 +212,9 @@ class DeviceCorpusExplorer:
         n_devices: Optional[int] = None,
         transaction_count: int = 1,
         empty_world: bool = True,
+        host_lock=None,
+        stop_event=None,
+        publish=None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -231,6 +238,22 @@ class DeviceCorpusExplorer:
         # loading): device lanes then hand CALLs to the host instead
         # of treating them as transfers
         self.empty_world = empty_world
+        # Overlapped mode (analysis/corpus.py): waves run in a prepass
+        # thread while the main thread analyzes; `host_lock` guards the
+        # process-global symbolic state (support/host_lock.py) around
+        # flip decode+solve bursts, and the budget switches to ACTIVE
+        # time (waves + flip solving) so wall spent blocked on the lock
+        # doesn't count against the prepass. `stop_event` lets the
+        # owner end the exploration when its own work is done.
+        self.host_lock = host_lock
+        self.stop_event = stop_event
+        # `publish(track_index, outcome_so_far)` after every wave: in
+        # overlapped mode the owner consumes partial outcomes for
+        # contracts it analyzes before the exploration completes —
+        # wave-1 triggers/coverage already pre-empt most of what the
+        # final outcome would (dict writes are GIL-atomic; the value is
+        # freshly built, never mutated after publication)
+        self.publish = publish
         self.rng = random.Random(seed)
         self.stats = ExploreStats()
 
@@ -271,44 +294,87 @@ class DeviceCorpusExplorer:
         return stripes
 
     # -- solving -------------------------------------------------------
-    def _solve_flip(self, conditions) -> Optional[Dict[str, int]]:
-        """A satisfying assignment for the flipped path.
+    def _solve_flips(self, batch):
+        """(assignments, retriable): satisfying assignments for a
+        wave's flip batch, aligned with `batch` (condition tuples),
+        plus the index set of queries that never got a real attempt
+        (sprint cap tripped before their CDCL turn) — the caller
+        un-blacklists those so later waves retry them.
 
         Flip queries are small byte-level calldata constraints; the
-        incremental CDCL session answers them in microseconds, so it
-        goes first. The device portfolio is the escape hatch for the
-        queries CDCL cannot finish in its short budget — the cost
-        ordering measured on the tunneled chip (one device dispatch
-        chain ≈ seconds) dictates this, not engine pride."""
-        try:
-            model = get_model(
-                tuple(conditions),
-                enforce_execution_time=False,
-                solver_timeout=2000,
-            )
-            self.stats.host_sat += 1
-            return dict(model.assignment)
-        except SolverTimeOutException:
-            log.debug("CDCL flip solve timed out; trying the portfolio")
-        except UnsatError:
-            return None
-        except Exception as e:
-            log.debug("CDCL flip solve did not finish: %s", e)
+        incremental CDCL session answers them in microseconds, so every
+        query gets a CDCL sprint first. The queries CDCL cannot finish
+        in its budget then share ONE batched device dispatch
+        (device_check_batch) — on a link where a dispatch chain costs
+        seconds, the portfolio is only affordable at batch granularity,
+        and a wave is exactly a batch (docs/roadmap.md: the device's
+        solving shape)."""
+        t0 = time.perf_counter()
+        out: List[Optional[Dict[str, int]]] = [None] * len(batch)
+        survivors: List[int] = []
+        capped: set = set()
+        # the sprint pass is time-capped as a whole: once hard queries
+        # have eaten this much wall, the rest skip straight to the
+        # batched device dispatch (whose cost does not grow with count)
+        sprint_cap_s = 5.0
+        stopped = False
+        for i, conditions in enumerate(batch):
+            # a stop request bounds post-stop lock-held work to the
+            # query in flight — the owner may be waiting on a join
+            # deadline past which it stops honoring the lock protocol
+            if stopped or (
+                self.stop_event is not None and self.stop_event.is_set()
+            ):
+                stopped = True
+                capped.add(i)
+                continue
+            if time.perf_counter() - t0 > sprint_cap_s:
+                survivors.append(i)
+                capped.add(i)
+                continue
+            try:
+                model = get_model(
+                    tuple(conditions),
+                    enforce_execution_time=False,
+                    solver_timeout=2000,
+                )
+                self.stats.host_sat += 1
+                out[i] = dict(model.assignment)
+            except UnsatError:
+                pass
+            except SolverTimeOutException:
+                survivors.append(i)
+            except Exception as e:
+                log.debug("CDCL flip solve did not finish: %s", e)
+                survivors.append(i)
 
-        raw = [c.raw for c in conditions]
-        try:
-            lowered, _ = lower(raw)
-        except Exception as e:
-            log.debug("lowering failed: %s", e)
-            return None
-        found = device_check(
-            lowered,
-            candidates=self.portfolio_candidates,
-            steps=self.portfolio_steps,
-        )
-        if found is not None:
-            self.stats.device_sat += 1
-        return found
+        if survivors and not stopped:
+            lowered_batch = []
+            kept = []
+            for i in survivors:
+                try:
+                    lowered, _ = lower([c.raw for c in batch[i]])
+                except Exception as e:
+                    log.debug("lowering failed: %s", e)
+                    continue
+                lowered_batch.append(lowered)
+                kept.append(i)
+            if lowered_batch:
+                found = device_check_batch(
+                    lowered_batch,
+                    candidates=self.portfolio_candidates,
+                    steps=self.portfolio_steps,
+                )
+                for i, assignment in zip(kept, found):
+                    if assignment is not None:
+                        self.stats.device_sat += 1
+                        out[i] = assignment
+        self.stats.flip_solve_s += time.perf_counter() - t0
+        # a capped query that the device also failed to answer (or that
+        # never compiled) had no genuine attempt; sprint-attempted and
+        # device-answered ones are spoken for
+        retriable = {i for i in capped if out[i] is None}
+        return out, retriable
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)
@@ -356,10 +422,22 @@ class DeviceCorpusExplorer:
         view = ArenaView(out)
         self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
 
-        status = np.asarray(out.base.status)
-        halt_pc = np.asarray(out.base.pc)
-        gas_min = np.asarray(out.base.gas_min)
-        gas_max = np.asarray(out.base.gas_max)
+        # bulk reads: per-lane jax indexing (or per-array np.asarray)
+        # pays one device round-trip each — measured ~15s/wave for the
+        # lane-indexed storage journals alone on the tunnel
+        import jax
+
+        status, halt_pc, gas_min, gas_max, *tables = jax.device_get(
+            (
+                out.base.status,
+                out.base.pc,
+                out.base.gas_min,
+                out.base.gas_max,
+                out.base.storage_keys,
+                out.base.storage_vals,
+                out.base.storage_cnt,
+            )
+        )
         for lane, (ci, data) in enumerate(flat):
             track = self.tracks[lane // L]
             if track.idle:
@@ -384,7 +462,7 @@ class DeviceCorpusExplorer:
             if st in (Status.STOPPED, Status.RETURNED):
                 # the device mutation pruner: only end states whose
                 # journal gained writes become next-tx start states
-                journal = storage_dict(out.base, lane)
+                journal = storage_dict_from(tables, lane)
                 if journal != carry["journal"]:
                     if track.bank_carry(
                         journal, list(carry["prefix"]) + [data]
@@ -394,22 +472,27 @@ class DeviceCorpusExplorer:
                 track.covered.add((pc, taken))
         return view
 
-    def _contract_flips(
+    def _collect_flip_candidates(
         self, view: ArenaView, ci: int
-    ) -> List[Tuple[int, bytes]]:
-        """Fork contract ci's frontier: for uncovered flipped branch
-        directions, decode the arena constraints and solve. A flip
-        witness stays bound to its source lane's carry — the path
-        condition only holds under that start state."""
+    ) -> List[Tuple[int, List, Tuple[int, bool]]]:
+        """Contract ci's un-attempted frontier branches this wave: one
+        candidate per lane (the lane's first flippable uncovered
+        target), each a (carry index, decoded path condition, target)
+        triple. A flip witness stays bound to its source lane's carry —
+        the path condition only holds under that start state."""
         track = self.tracks[ci]
         if track.idle:
             track.exhausted = True
             return []
         L = self.lanes_per_contract
-        fresh: List[Tuple[int, bytes]] = []
+        candidates: List[Tuple[int, List, Tuple[int, bool]]] = []
+        # every lane may contribute one candidate (bounded by the lane
+        # count): unsat candidates cost one short CDCL sprint each
+        # (time-capped in _solve_flips) and surplus feasible witnesses
+        # still seed lanes, so oversampling loses nothing — while
+        # under-sampling would blacklist targets via `attempted`
+        # without ever solving them
         for lane in range(ci * L, (ci + 1) * L):
-            if len(fresh) >= self.flips_per_contract:
-                break
             for k, (pc, taken, tid) in enumerate(view.journal(lane)):
                 target = (pc, not taken)
                 if tid <= 0:
@@ -421,26 +504,56 @@ class DeviceCorpusExplorer:
                 conditions = view.path_condition(lane, k, flip_last=True)
                 if conditions is None:
                     continue  # opaque decision upstream
-                assignment = self._solve_flip(conditions)
-                if assignment is None:
-                    continue
-                self.stats.forks_feasible += 1
-                carry_idx = self._lane_carry[lane]
-                fresh.append((carry_idx, self._witness_bytes(assignment)))
+                candidates.append((self._lane_carry[lane], conditions, target))
                 break
-        track.exhausted = not fresh
-        return fresh
+        return candidates
 
     def _reseed(
         self, view: ArenaView
     ) -> Tuple[Optional[List[List[Tuple[int, bytes]]]], int]:
-        """(next-wave inputs, number of flip witnesses): per contract,
-        flip witnesses topped up with mutations of its corpus. Inputs
-        are None when every contract's frontier is exhausted."""
+        """(next-wave inputs, pending flip work): per contract, flip
+        witnesses topped up with mutations of its corpus. Inputs are
+        None when every contract's frontier is exhausted; the count is
+        flip witnesses plus sprint-capped candidates still awaiting a
+        genuine solve (so the phase loop never concludes exhaustion
+        over queries nobody attempted).
+
+        Candidates are collected across the WHOLE corpus first and
+        solved as one batch (_solve_flips), so hard queries share a
+        single device dispatch instead of paying per-query latency."""
+        per_contract = [
+            self._collect_flip_candidates(view, ci)
+            for ci in range(len(self.tracks))
+        ]
+        flat = [c for cands in per_contract for c in cands]
+        solved, retriable = self._solve_flips([cond for _, cond, _ in flat])
+
         stripes: List[List[Tuple[int, bytes]]] = []
         n_flips = 0
+        n_retriable = 0
+        cursor = 0
         for ci, track in enumerate(self.tracks):
-            fresh = self._contract_flips(view, ci)
+            fresh: List[Tuple[int, bytes]] = []
+            had_retriable = False
+            for carry_idx, _cond, target in per_contract[ci]:
+                assignment = solved[cursor]
+                if cursor in retriable:
+                    # never actually attempted (sprint cap): lift the
+                    # blacklist so a later wave gets a real try
+                    track.attempted.discard(target)
+                    had_retriable = True
+                    n_retriable += 1
+                cursor += 1
+                # every feasible witness seeds a lane (up to the stripe
+                # width) — a solved flip discarded here would leave its
+                # target blacklisted in `attempted` yet never explored
+                if assignment is None or len(fresh) >= self.lanes_per_contract:
+                    continue
+                self.stats.forks_feasible += 1
+                fresh.append((carry_idx, self._witness_bytes(assignment)))
+            # a frontier with un-attempted (capped) candidates is not
+            # exhausted — it just hasn't had its turn with the solver
+            track.exhausted = not fresh and not had_retriable
             n_flips += len(fresh)
             while len(fresh) < self.lanes_per_contract:
                 carry_idx, parent = self.rng.choice(track.corpus)
@@ -450,7 +563,8 @@ class DeviceCorpusExplorer:
                 )
                 fresh.append((carry_idx, bytes(mutated)))
             stripes.append(fresh[: self.lanes_per_contract])
-        return (stripes if n_flips else None), n_flips
+        pending = n_flips + n_retriable
+        return (stripes if pending else None), pending
 
     # -- the phase loop ------------------------------------------------
     def _phase(self, txn: int) -> bool:
@@ -458,11 +572,17 @@ class DeviceCorpusExplorer:
         False when the wall-clock budget is exhausted."""
         inputs = self._seed_phase_inputs()
         for wave_no in range(self.waves):
+            if self.stop_event is not None and self.stop_event.is_set():
+                # honored before DISPATCHING a wave, not only at the
+                # budget check — the last-wave break and the phase
+                # advance both skip _budget_spent
+                return False
             covered_before = sum(len(t.covered) for t in self.tracks)
             self._lane_carry = [ci for stripe in inputs for ci, _ in stripe]
             w0 = time.perf_counter()
             view = self._run_wave(inputs)
             self._wave_times.append(time.perf_counter() - w0)
+            self.stats.wave_exec_s += self._wave_times[-1]
             if txn == 0 and wave_no == 0:
                 # the first wave carries the one-time kernel compile
                 # (amortized machine-wide by the persistent cache);
@@ -470,13 +590,18 @@ class DeviceCorpusExplorer:
                 self._t0 = time.perf_counter()
             for ci, track in enumerate(self.tracks):
                 track.corpus.extend(inputs[ci])
+            self._publish_partial()
             if wave_no == self.waves - 1:
                 break  # no next wave to seed; don't waste solver calls
             if self._budget_spent():
                 return False
             covered_now = sum(len(t.covered) for t in self.tracks)
             plateaued = wave_no > 0 and covered_now == covered_before
-            fresh, n_flips = self._reseed(view)
+            if self.host_lock is not None:
+                with self.host_lock:
+                    fresh, n_flips = self._reseed(view)
+            else:
+                fresh, n_flips = self._reseed(view)
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
             quota = len(self.tracks) * self.flips_per_contract
@@ -485,9 +610,39 @@ class DeviceCorpusExplorer:
             inputs = fresh
         return True
 
+    def _publish_partial(self) -> None:
+        if self.publish is None:
+            return
+        for ci, track in enumerate(self.tracks):
+            outcome = track.outcome()
+            # per-track copy: consumers annotate their stats dict
+            # (witness_issues), so sharing one object across contracts
+            # would let them clobber each other
+            outcome["stats"] = dict(self.stats.as_dict(), partial=True)
+            self.publish(ci, outcome)
+
     def _budget_spent(self) -> bool:
+        if self.stop_event is not None and self.stop_event.is_set():
+            return True
         if self.budget_s is None:
             return False
+        # predict the next wave from steady-state waves only — wave 0
+        # carries the compile, so until a second wave has run the
+        # prediction is optimistic by design (the overshoot is bounded
+        # by one wave)
+        predicted = (
+            min(self._wave_times[1:]) if len(self._wave_times) > 1 else 0.0
+        )
+        if self.host_lock is not None:
+            # overlapped: bill only ACTIVE time — wall spent waiting on
+            # the lock is the main thread's analysis time, not ours
+            active = self.stats.wave_exec_s + self.stats.flip_solve_s
+            if active > self.budget_s + 45:
+                return True
+            steady = active - (
+                self._wave_times[0] if self._wave_times else 0.0
+            )
+            return steady + predicted > self.budget_s
         # hard stop: the whole prepass — compile included — may cost
         # at most one compile allowance (45s, paid at most once per
         # kernel shape per machine thanks to the persistent cache) on
@@ -496,13 +651,6 @@ class DeviceCorpusExplorer:
         if time.perf_counter() - self._t_start > self.budget_s + 45:
             return True
         elapsed = time.perf_counter() - self._t0
-        # predict the next wave from steady-state waves only — wave 0
-        # carries the compile, so until a second wave has run the
-        # prediction is optimistic by design (the overshoot is bounded
-        # by one wave)
-        predicted = (
-            min(self._wave_times[1:]) if len(self._wave_times) > 1 else 0.0
-        )
         return elapsed + predicted > self.budget_s
 
     def run(self) -> Dict:
@@ -525,6 +673,8 @@ class DeviceCorpusExplorer:
 
         self.stats.branches_covered = sum(len(t.covered) for t in self.tracks)
         self.stats.wall_s = round(time.perf_counter() - self._t_start, 3)
+        self.stats.wave_exec_s = round(self.stats.wave_exec_s, 3)
+        self.stats.flip_solve_s = round(self.stats.flip_solve_s, 3)
         return {
             "stats": self.stats.as_dict(),
             "contracts": [t.outcome() for t in self.tracks],

@@ -23,6 +23,8 @@ host symbolic engine can take the lane over.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -118,6 +120,25 @@ for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
     _SUPPORTED[_byte] = _name not in _UNSUPPORTED_NAMES
 
 
+# Stack-peek implementation: "gather" (take_along_axis) or "einsum"
+# (one-hot contraction). The limbs-major probe measured the contraction
+# at 2/3 the kernel-segment count of the gather, and the full step
+# kernel at +18% throughput on the v5e link (439k -> 520k
+# transitions/s); segment count is the latency unit on
+# dispatch-floor-bound links (docs/roadmap.md). On CPU the one-hot
+# multiply is pure overhead, so the default is per-backend; the
+# MYTHRIL_TPU_PEEK env var pins either implementation.
+_PEEK_CHOICE = os.environ.get("MYTHRIL_TPU_PEEK", "auto")
+
+
+def _peek_einsum() -> bool:
+    if _PEEK_CHOICE != "auto":
+        return _PEEK_CHOICE == "einsum"
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def _m(mask, x, y):
     """Masked select with trailing-dim broadcast."""
     extra = x.ndim - mask.ndim
@@ -188,8 +209,17 @@ def step(batch: StateBatch, code: CodeTable,
         [jnp.zeros_like(op), jnp.ones_like(op), 2 * jnp.ones_like(op),
          dup_n_pre, swap_n_pre], axis=1)  # [n, 5]
     peek_idx = jnp.clip(batch.sp[:, None] - 1 - peek_ks, 0, stack_cap - 1)
-    peeked = jnp.take_along_axis(
-        batch.stack, peek_idx[:, :, None].astype(jnp.int32), axis=1)
+    if _peek_einsum():
+        # one-hot contraction instead of a gather: a per-lane [5,S]x[S,W]
+        # reduction the vector/matrix units take directly, measured to
+        # compile to fewer kernel segments (tools/limbs_major_probe.py)
+        onehot = (
+            peek_idx[:, :, None] == jnp.arange(stack_cap)[None, None, :]
+        ).astype(batch.stack.dtype)
+        peeked = jnp.einsum("nks,nsw->nkw", onehot, batch.stack)
+    else:
+        peeked = jnp.take_along_axis(
+            batch.stack, peek_idx[:, :, None].astype(jnp.int32), axis=1)
     a, b, c = peeked[:, 0], peeked[:, 1], peeked[:, 2]
     dup_val, swap_deep_val = peeked[:, 3], peeked[:, 4]
 

@@ -400,7 +400,10 @@ def _get_search_fn(K: int, L: int, steps: int):
         return hard.all(axis=0), soft.sum(axis=0)  # [K] solved, [K] score
 
     def search(opcodes, args, imms, widths, pool, roots, roots_mask,
-               var_widths, seed):
+               var_widths, n_vars, seed):
+        # n_vars = the query's REAL var count: batched dispatch pads
+        # var_widths to a shared bucket, and mutating width-1 dummy
+        # slots would waste most of the step budget on a small query
         V = var_widths.shape[0]
         key = jax.random.PRNGKey(seed)
         k1, k2 = jax.random.split(key)
@@ -425,7 +428,7 @@ def _get_search_fn(K: int, L: int, steps: int):
         def body(state):
             X, best_score, key, it, _ = state
             key, kv, kk, kp, kb, kc = jax.random.split(key, 6)
-            v = jax.random.randint(kv, (K,), 0, V)
+            v = jax.random.randint(kv, (K,), 0, jnp.maximum(n_vars, 1))
             kind = jax.random.randint(kk, (K,), 0, 6)
             # only mutate limbs inside the var's width
             limb = jax.random.randint(kp, (K,), 0, L) % limb_caps[v]
@@ -485,6 +488,7 @@ def _get_search_fn(K: int, L: int, steps: int):
 
     fn = _jax.jit(search)
     fn.score = _jax.jit(score)
+    fn.raw = search  # unjitted form, for vmapping into batched dispatch
     _eval_cache[key] = fn
     return fn
 
@@ -515,33 +519,13 @@ def debug_eval(prog: Program, assignment: Dict[str, int], candidates: int = 2):
     return bool(solved[0]), int(score[0])
 
 
-def device_check(
-    lowered: List[Term],
-    candidates: int = 64,
-    steps: int = 512,
-    seed: int = 7,
-    n_devices: int = 1,
-) -> Optional[Dict[str, int]]:
-    """Try to find a witness for `lowered` on device. Returns a
-    {var_name: value} assignment, or None (which proves nothing).
-
-    With n_devices > 1 the search runs as a true portfolio: one
-    independent replica per device (pmap over seeds), any replica's
-    witness wins — the multi-chip scaling axis for hard queries.
-    """
-    from mythril_tpu.laser.batch import ensure_compile_cache
-
-    ensure_compile_cache()
-    prog = compile_program(lowered)
-    if prog is None or not prog.var_slots:
-        return None
-
-    import jax
+def _program_args(prog: Program):
     import jax.numpy as jnp
 
-    var_widths = np.array([w for _, w in prog.var_slots], dtype=np.int32)
-    fn = _get_search_fn(candidates, prog.limbs, steps)
-    prog_args = (
+    var_widths = np.array(
+        [w for _, w in prog.var_slots], dtype=np.int32
+    )
+    return (
         jnp.asarray(prog.opcodes),
         jnp.asarray(prog.args),
         jnp.asarray(prog.imms),
@@ -552,6 +536,166 @@ def device_check(
         jnp.asarray(var_widths),
     )
 
+
+def _decode_assignment(
+    prog: Program, winner, limbs: Optional[int] = None
+) -> Dict[str, int]:
+    assignment: Dict[str, int] = {}
+    for slot, (name, _w) in enumerate(prog.var_slots):
+        value = 0
+        for j in range(limbs or prog.limbs):
+            value |= int(winner[slot, j]) << (LIMB_BITS * j)
+        assignment[name] = value
+    return assignment
+
+
+def device_check_batch(
+    queries: List[List[Term]],
+    candidates: int = 64,
+    steps: int = 512,
+    seed: int = 7,
+) -> List[Optional[Dict[str, int]]]:
+    """Solve MANY independent queries in ONE device dispatch.
+
+    The per-query `device_check` pays the link's full dispatch-chain
+    latency (~seconds on a tunneled chip) for every call, which is why
+    the cost-ordered pipeline runs native CDCL first and the device
+    only on survivors. Batching inverts the economics: every query
+    compiles to the same bucketed tensor-program shape, the programs
+    stack on a leading axis, and ONE vmapped search runs K candidates
+    for all of them concurrently — the whole batch costs one dispatch
+    chain. This is the device's natural solving shape (frontier flip
+    batches, independence-solver buckets), per docs/roadmap.md.
+
+    Returns one Optional assignment per query, position-aligned.
+    Queries that fall outside the device language come back None
+    (which, as always, proves nothing).
+    """
+    from mythril_tpu.laser.batch import ensure_compile_cache
+
+    if not queries:
+        return []
+
+    ensure_compile_cache()
+    progs: List[Optional[Program]] = [compile_program(q) for q in queries]
+    live = [
+        (i, p) for i, p in enumerate(progs) if p is not None and p.var_slots
+    ]
+    out: List[Optional[Dict[str, int]]] = [None] * len(queries)
+    if not live:
+        return out
+    if len(live) == 1:
+        i, prog = live[0]
+        out[i] = device_check(queries[i], candidates, steps, seed, prog=prog)
+        return out
+
+    import jax
+    import jax.numpy as jnp
+
+    # One shared shape bucket: every stacked axis padded to the max
+    # bucket over the batch, so the vmapped kernel compiles once per
+    # (Q, N, C, R, V, L, K, steps) class rather than once per query.
+    N = max(p.opcodes.shape[0] for _, p in live)
+    C = max(p.const_pool.shape[0] for _, p in live)
+    R = max(p.roots.shape[0] for _, p in live)
+    V = _bucket(max(len(p.var_slots) for _, p in live), 4)
+    L = max(p.limbs for _, p in live)
+    Q = _bucket(len(live), 4)
+
+    def stack(getter, shape, dtype, fill=0):
+        arr = np.full((Q,) + shape, fill, dtype=dtype)
+        for qi, (_, p) in enumerate(live):
+            src = getter(p)
+            arr[qi][tuple(slice(0, s) for s in src.shape)] = src
+        # Q-padding rows repeat the first program (their results are
+        # ignored) so the kernel never sees degenerate zero programs.
+        for qi in range(len(live), Q):
+            src = getter(live[0][1])
+            arr[qi][tuple(slice(0, s) for s in src.shape)] = src
+        return jnp.asarray(arr)
+
+    def widen_pool(p: Program):
+        # const pools narrower than the bucket's limb count re-expand
+        # from the original values' limbs: higher limbs are zero by
+        # construction (values fit the program's own width cap)
+        if p.const_pool.shape[1] == L:
+            return p.const_pool
+        wide = np.zeros((p.const_pool.shape[0], L), dtype=np.uint32)
+        wide[:, : p.const_pool.shape[1]] = p.const_pool
+        return wide
+
+    args = (
+        stack(lambda p: p.opcodes, (N,), np.int32),
+        stack(lambda p: p.args, (N, 3), np.int32),
+        stack(lambda p: p.imms, (N, 2), np.int32),
+        stack(lambda p: p.widths, (N,), np.int32, fill=1),
+        stack(widen_pool, (C, L), np.uint32),
+        stack(lambda p: p.roots, (R,), np.int32),
+        stack(lambda p: p.roots_mask, (R,), bool),
+        stack(
+            lambda p: np.array([w for _, w in p.var_slots], dtype=np.int32),
+            (V,),
+            np.int32,
+            fill=1,
+        ),
+        # each query's REAL var count, so the search never mutates its
+        # padding slots
+        jnp.asarray(
+            [len(p.var_slots) for _, p in live]
+            + [len(live[0][1].var_slots)] * (Q - len(live)),
+            dtype=jnp.int32,
+        ),
+    )
+
+    fn = _get_search_fn(candidates, L, steps)
+    vkey = ("vmap", candidates, L, steps)
+    vfn = _eval_cache.get(vkey)
+    if vfn is None:
+        vfn = jax.jit(jax.vmap(fn.raw))
+        _eval_cache[vkey] = vfn
+    seeds = jnp.arange(seed, seed + Q, dtype=jnp.int32)
+    solved, winners = vfn(*args, seeds)
+    solved = np.asarray(solved)
+    winners = np.asarray(winners)
+
+    for qi, (i, p) in enumerate(live):
+        if bool(solved[qi]):
+            out[i] = _decode_assignment(p, winners[qi], limbs=L)
+    return out
+
+
+def device_check(
+    lowered: List[Term],
+    candidates: int = 64,
+    steps: int = 512,
+    seed: int = 7,
+    n_devices: int = 1,
+    prog: Optional[Program] = None,
+) -> Optional[Dict[str, int]]:
+    """Try to find a witness for `lowered` on device. Returns a
+    {var_name: value} assignment, or None (which proves nothing).
+
+    With n_devices > 1 the search runs as a true portfolio: one
+    independent replica per device (pmap over seeds), any replica's
+    witness wins — the multi-chip scaling axis for hard queries.
+    Callers that already compiled `lowered` pass `prog` to skip the
+    recompile (device_check_batch's single-survivor fallback).
+    """
+    from mythril_tpu.laser.batch import ensure_compile_cache
+
+    ensure_compile_cache()
+    if prog is None:
+        prog = compile_program(lowered)
+    if prog is None or not prog.var_slots:
+        return None
+
+    import jax
+    import jax.numpy as jnp
+
+    fn = _get_search_fn(candidates, prog.limbs, steps)
+    prog_args = _program_args(prog)
+
+    n_vars = len(prog.var_slots)
     if n_devices > 1:
         pkey = ("pmap", candidates, prog.limbs, steps, n_devices)
         replicated = _eval_cache.get(pkey)
@@ -560,25 +704,19 @@ def device_check(
             replicated = jax.pmap(
                 fn,
                 devices=jax.devices()[:n_devices],
-                in_axes=(None,) * 8 + (0,),
+                in_axes=(None,) * 9 + (0,),
             )
             _eval_cache[pkey] = replicated
         seeds = jnp.arange(seed, seed + n_devices, dtype=jnp.int32)
-        solved_all, winners = replicated(*prog_args, seeds)
+        solved_all, winners = replicated(*prog_args, n_vars, seeds)
         solved_all = np.asarray(solved_all)
         if not solved_all.any():
             return None
         winner = np.asarray(winners)[int(np.argmax(solved_all))]
     else:
-        solved, winner = fn(*prog_args, seed)
+        solved, winner = fn(*prog_args, n_vars, seed)
         if not bool(solved):
             return None
         winner = np.asarray(winner)  # [V, L]
 
-    assignment: Dict[str, int] = {}
-    for slot, (name, _w) in enumerate(prog.var_slots):
-        value = 0
-        for j in range(prog.limbs):
-            value |= int(winner[slot, j]) << (LIMB_BITS * j)
-        assignment[name] = value
-    return assignment
+    return _decode_assignment(prog, winner)

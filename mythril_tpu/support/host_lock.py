@@ -1,0 +1,24 @@
+"""The host symbolic-state lock.
+
+Every piece of host-side symbolic machinery is process-global by
+design (reference parity: mythril/support/support_utils.py documents
+its singletons as explicitly not thread-safe): the hash-consed term
+arena, the incremental CDCL blast session, the model cache. A device
+wave, by contrast, touches none of it — `sym_run` plus its numpy
+readbacks are pure jax/numpy (laser/batch/arena.py defers term
+construction until a flip is actually decoded).
+
+That split is what makes the overlapped corpus mode sound: a prepass
+thread may run device waves freely while the main thread analyzes
+contracts, provided BOTH take this lock around any host symbolic work
+(flip decode + solve bursts on one side, whole per-contract analyses
+on the other). Coarse on purpose — the win is device-vs-host overlap,
+not host-vs-host concurrency (this box has one core; SURVEY §5 maps
+the reference's single-thread design note).
+"""
+
+from __future__ import annotations
+
+import threading
+
+HOST_SYMBOLIC_LOCK = threading.Lock()

@@ -71,3 +71,38 @@ def test_corpus_device_prepass_feeds_workers():
         assert r["device_prepass"]["device_steps"] > 0
     assert "110" in swc_ids(by_name["PlainAssert"])
     assert "110" in swc_ids(by_name["GatedAssert"])
+
+
+def test_corpus_overlapped_single_process_device():
+    """Single-process + device: the prepass runs in a thread overlapped
+    with the host analyses (both sides serialized on
+    HOST_SYMBOLIC_LOCK), witnesses still reach the results, and
+    per-contract errors stay contained."""
+    gated_fail = bytes(
+        [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
+         0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
+         0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
+         0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
+         0x00, 0x5B, 0xFE]  # STOP; JUMPDEST; ASSERT_FAIL
+    ).hex()
+    contracts = [
+        ("600035600757005bfe", "", "PlainAssert"),
+        (gated_fail, "", "GatedAssert"),
+        ("33ff", "", "Killable"),
+    ]
+    results = analyze_corpus(
+        contracts,
+        transaction_count=1,
+        execution_timeout=60,
+        processes=1,
+        use_device=True,  # force the overlapped branch on the CPU mesh
+        device_budget_s=30.0,
+    )
+    by_name = {r["name"]: r for r in results}
+    for r in results:
+        assert r["error"] is None, r["error"]
+    assert "110" in swc_ids(by_name["PlainAssert"])
+    assert "110" in swc_ids(by_name["GatedAssert"])
+    assert "106" in swc_ids(by_name["Killable"])
+    # the prepass outcome must have been folded into the results
+    assert any(r.get("device_prepass") for r in results)
