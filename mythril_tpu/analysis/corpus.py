@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import multiprocessing as mp
+import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
@@ -268,7 +269,7 @@ def analyze_corpus(
                 target=_prepass_worker, daemon=True
             )
             prepass_thread.start()
-            prepass_failure_noted = False
+            deviceless_contracts = 0
             results = []
             for i, (code, creation_code, name) in enumerate(contracts):
                 if prepass_thread is not None and not prepass_thread.is_alive():
@@ -287,24 +288,8 @@ def analyze_corpus(
                 # fall back to the normal per-contract device path.
                 outcome = prepass.get(i) if prepass_done else published.get(i)
                 worker_device = use_device and prepass_done
-                if (
-                    prepass_done
-                    and not prepass
-                    and i > 0
-                    and not prepass_failure_noted
-                ):
-                    # the prepass died without outcomes: contracts
-                    # already analyzed ran host-only on at most a
-                    # partial outcome — say so rather than degrade
-                    # silently (later contracts fall back to the
-                    # per-contract device path)
-                    prepass_failure_noted = True
-                    log.warning(
-                        "corpus device prepass produced no outcomes; "
-                        "the first %d contract(s) were analyzed without "
-                        "the device",
-                        i,
-                    )
+                if not worker_device:
+                    deviceless_contracts += 1
                 with HOST_SYMBOLIC_LOCK:
                     results.append(
                         _analyze_one(
@@ -314,6 +299,12 @@ def analyze_corpus(
                             )
                         )
                     )
+                if prepass_thread is not None and prepass_thread.is_alive():
+                    # hand the lock to the prepass thread: CPython locks
+                    # are unfair and this loop would otherwise reacquire
+                    # within microseconds, rationing the prepass to one
+                    # reseed per contract (lock convoy)
+                    time.sleep(0.05)
             if prepass_thread is not None:
                 # analyses outran the prepass: stop it at the next wave
                 # boundary and fold in whatever it banked
@@ -325,6 +316,15 @@ def analyze_corpus(
                         "grace period; its banked witnesses are lost and "
                         "the daemon thread may briefly keep the device busy"
                     )
+            if not prepass and deviceless_contracts:
+                # the prepass died without outcomes: these analyses ran
+                # host-only on at most a partial outcome — say so
+                # rather than degrade silently
+                log.warning(
+                    "corpus device prepass produced no outcomes; %d "
+                    "contract(s) were analyzed without the device",
+                    deviceless_contracts,
+                )
         else:
             if use_device:
                 prepass = corpus_device_prepass(

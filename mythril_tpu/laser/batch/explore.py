@@ -294,21 +294,19 @@ class DeviceCorpusExplorer:
         return stripes
 
     # -- solving -------------------------------------------------------
-    def _solve_flips(self, batch):
-        """(assignments, retriable): satisfying assignments for a
-        wave's flip batch, aligned with `batch` (condition tuples),
-        plus the index set of queries that never got a real attempt
-        (sprint cap tripped before their CDCL turn) — the caller
-        un-blacklists those so later waves retry them.
+    def _sprint_flips(self, batch):
+        """CDCL-sprint pass over a wave's flip batch (condition
+        tuples). MUST run under the host lock in overlapped mode: the
+        incremental CDCL session, the term arena, and `lower` are all
+        process-global. Returns (assignments, capped, lowered, kept):
+        position-aligned assignments, the index set that never got a
+        real attempt (time cap / stop), and the lowered survivor
+        queries + their indices for the lock-free device stage.
 
         Flip queries are small byte-level calldata constraints; the
         incremental CDCL session answers them in microseconds, so every
-        query gets a CDCL sprint first. The queries CDCL cannot finish
-        in its budget then share ONE batched device dispatch
-        (device_check_batch) — on a link where a dispatch chain costs
-        seconds, the portfolio is only affordable at batch granularity,
-        and a wave is exactly a batch (docs/roadmap.md: the device's
-        solving shape)."""
+        query gets a CDCL sprint first; the ones it cannot finish get
+        lowered here and solved on device afterwards."""
         t0 = time.perf_counter()
         out: List[Optional[Dict[str, int]]] = [None] * len(batch)
         survivors: List[int] = []
@@ -348,9 +346,9 @@ class DeviceCorpusExplorer:
                 log.debug("CDCL flip solve did not finish: %s", e)
                 survivors.append(i)
 
+        lowered_batch: List = []
+        kept: List[int] = []
         if survivors and not stopped:
-            lowered_batch = []
-            kept = []
             for i in survivors:
                 try:
                     lowered, _ = lower([c.raw for c in batch[i]])
@@ -359,22 +357,29 @@ class DeviceCorpusExplorer:
                     continue
                 lowered_batch.append(lowered)
                 kept.append(i)
-            if lowered_batch:
-                found = device_check_batch(
-                    lowered_batch,
-                    candidates=self.portfolio_candidates,
-                    steps=self.portfolio_steps,
-                )
-                for i, assignment in zip(kept, found):
-                    if assignment is not None:
-                        self.stats.device_sat += 1
-                        out[i] = assignment
         self.stats.flip_solve_s += time.perf_counter() - t0
-        # a capped query that the device also failed to answer (or that
-        # never compiled) had no genuine attempt; sprint-attempted and
-        # device-answered ones are spoken for
-        retriable = {i for i in capped if out[i] is None}
-        return out, retriable
+        return out, capped, lowered_batch, kept
+
+    def _device_flips(self, out, lowered_batch, kept):
+        """The lock-free stage: ONE batched device dispatch for every
+        sprint survivor — on a link where a dispatch chain costs
+        seconds, the portfolio is only affordable at batch granularity,
+        and a wave is exactly a batch (docs/roadmap.md: the device's
+        solving shape). Holding the host lock here would block the
+        owner's analyses on pure device work."""
+        if not lowered_batch:
+            return
+        t0 = time.perf_counter()
+        found = device_check_batch(
+            lowered_batch,
+            candidates=self.portfolio_candidates,
+            steps=self.portfolio_steps,
+        )
+        for i, assignment in zip(kept, found):
+            if assignment is not None:
+                self.stats.device_sat += 1
+                out[i] = assignment
+        self.stats.flip_solve_s += time.perf_counter() - t0
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)
@@ -519,14 +524,28 @@ class DeviceCorpusExplorer:
         over queries nobody attempted).
 
         Candidates are collected across the WHOLE corpus first and
-        solved as one batch (_solve_flips), so hard queries share a
-        single device dispatch instead of paying per-query latency."""
-        per_contract = [
-            self._collect_flip_candidates(view, ci)
-            for ci in range(len(self.tracks))
-        ]
-        flat = [c for cands in per_contract for c in cands]
-        solved, retriable = self._solve_flips([cond for _, cond, _ in flat])
+        solved as one batch, so hard queries share a single device
+        dispatch instead of paying per-query latency. Only the
+        host-symbolic stages (term decode + CDCL sprint + lowering)
+        hold the host lock; the device dispatch and the track
+        bookkeeping are lock-free."""
+        from contextlib import nullcontext
+
+        guard = self.host_lock if self.host_lock is not None else nullcontext()
+        with guard:
+            per_contract = [
+                self._collect_flip_candidates(view, ci)
+                for ci in range(len(self.tracks))
+            ]
+            flat = [c for cands in per_contract for c in cands]
+            solved, capped, lowered_batch, kept = self._sprint_flips(
+                [cond for _, cond, _ in flat]
+            )
+        self._device_flips(solved, lowered_batch, kept)
+        # a capped query that the device also failed to answer (or that
+        # never compiled) had no genuine attempt; sprint-attempted and
+        # device-answered ones are spoken for
+        retriable = {i for i in capped if solved[i] is None}
 
         stripes: List[List[Tuple[int, bytes]]] = []
         n_flips = 0
@@ -597,11 +616,7 @@ class DeviceCorpusExplorer:
                 return False
             covered_now = sum(len(t.covered) for t in self.tracks)
             plateaued = wave_no > 0 and covered_now == covered_before
-            if self.host_lock is not None:
-                with self.host_lock:
-                    fresh, n_flips = self._reseed(view)
-            else:
-                fresh, n_flips = self._reseed(view)
+            fresh, n_flips = self._reseed(view)
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
             quota = len(self.tracks) * self.flips_per_contract

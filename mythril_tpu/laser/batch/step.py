@@ -119,6 +119,22 @@ for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
     _GAS_MAX[_byte] = _gmax
     _SUPPORTED[_byte] = _name not in _UNSUPPORTED_NAMES
 
+# merged per-opcode metadata, one row gather per step:
+# [valid, supported, pops, net_sp, gas_min, gas_max]
+# (static gas bounds fit int32: the largest table entry is CREATE's
+# 32000)
+_META = np.stack(
+    [
+        _VALID.astype(np.int32),
+        _SUPPORTED.astype(np.int32),
+        _POPS,
+        _NET_SP,
+        _GAS_MIN.astype(np.int32),
+        _GAS_MAX.astype(np.int32),
+    ],
+    axis=1,
+)
+
 
 # Stack-peek implementation: "gather" (take_along_axis) or "einsum"
 # (one-hot contraction). The limbs-major probe measured the contraction
@@ -179,16 +195,26 @@ def step(batch: StateBatch, code: CodeTable,
     code_len = code.length[batch.code_id]
     oob = batch.pc >= code_len  # running off the code ends the tx
     pc_safe = jnp.clip(batch.pc, 0, code.ops.shape[1] - 33)
-    op = code.ops[batch.code_id, pc_safe].astype(jnp.int32)
+    # one 33-byte window gather serves BOTH the opcode fetch (byte 0)
+    # and the PUSH payload (bytes 1..32) — two separate code-table
+    # gathers are two kernel segments
+    code_win = code.ops[
+        batch.code_id[:, None], pc_safe[:, None] + jnp.arange(33)[None, :]
+    ]
+    op = code_win[:, 0].astype(jnp.int32)
 
     active = batch.active
     halt_oob = active & oob
     live = active & ~oob
 
-    valid = jnp.asarray(_VALID)[op]
-    supported = jnp.asarray(_SUPPORTED)[op]
-    pops = jnp.asarray(_POPS)[op]
-    net_sp = jnp.asarray(_NET_SP)[op]
+    # one gather against the merged [256, 6] metadata table instead of
+    # six separate [256] lookups — each unfused gather is a kernel
+    # segment on this platform
+    meta = jnp.asarray(_META)[op]
+    valid = meta[:, 0] != 0
+    supported = meta[:, 1] != 0
+    pops = meta[:, 2]
+    net_sp = meta[:, 3]
     underflow = batch.sp < pops
     overflow = batch.sp + net_sp > stack_cap
 
@@ -427,24 +453,27 @@ def step(batch: StateBatch, code: CodeTable,
     exp_mask = ex & (op == EXP)
 
     def do_exp(args):
-        res_val, res_mask = args
-        return put(res_val, res_mask, exp_mask, u256.exp(a, b))
+        res_val, res_mask, g_min, g_max = args
+        res_val, res_mask = put(res_val, res_mask, exp_mask, u256.exp(a, b))
+        # dynamic gas: priced per byte of exponent (b)
+        high_limb = jnp.max(
+            jnp.where(
+                b != 0, jnp.arange(1, W + 1, dtype=jnp.int32)[None, :], 0
+            ),
+            axis=-1)  # 1-based index of highest nonzero limb, 0 if b == 0
+        top_limb = jnp.take_along_axis(
+            b, jnp.clip(high_limb - 1, 0, W - 1)[:, None], axis=-1)[:, 0]
+        exp_bytes = jnp.where(
+            high_limb > 0, 2 * high_limb - (top_limb < 256), 0
+        ).astype(jnp.uint32)
+        exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
+        # 10/byte is the Frontier/Homestead price (the true minimum
+        # across forks); 50/byte (EIP-160) bounds the maximum
+        return res_val, res_mask, g_min + 10 * exp_bytes, g_max + 50 * exp_bytes
 
-    res_val, res_mask = lax.cond(
-        jnp.any(exp_mask), do_exp, lambda x: x, (res_val, res_mask))
-    # dynamic gas: 50 per byte of exponent (b)
-    high_limb = jnp.max(
-        jnp.where(b != 0, jnp.arange(1, W + 1, dtype=jnp.int32)[None, :], 0),
-        axis=-1)  # 1-based index of highest nonzero limb, 0 if b == 0
-    top_limb = jnp.take_along_axis(
-        b, jnp.clip(high_limb - 1, 0, W - 1)[:, None], axis=-1)[:, 0]
-    exp_bytes = jnp.where(
-        high_limb > 0, 2 * high_limb - (top_limb < 256), 0).astype(jnp.uint32)
-    exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
-    # 10/byte is the Frontier/Homestead price (the true minimum across
-    # forks); 50/byte (EIP-160) bounds the maximum
-    gas_dyn_min = gas_dyn_min + 10 * exp_bytes
-    gas_dyn_max = gas_dyn_max + 50 * exp_bytes
+    res_val, res_mask, gas_dyn_min, gas_dyn_max = lax.cond(
+        jnp.any(exp_mask), do_exp, lambda x: x,
+        (res_val, res_mask, gas_dyn_min, gas_dyn_max))
 
     # ---- environment / block pushes --------------------------------------
     zero_w = jnp.zeros((n, W), jnp.uint32)
@@ -507,8 +536,17 @@ def step(batch: StateBatch, code: CodeTable,
     off_i, off_big = _word_to_i32(a)
     cd_idx = jnp.clip(off_i[:, None], 0, cd_cap) + jnp.arange(32)[None, :]
     cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < cd_cap)
-    cd_bytes = jnp.take_along_axis(
-        batch.calldata, jnp.clip(cd_idx, 0, cd_cap - 1), axis=1)
+    if _peek_einsum():
+        # same contraction trick as the stack peek: the 32-byte window
+        # read becomes a one-hot [n,32,C]x[n,C] reduction
+        cd_onehot = (
+            jnp.clip(cd_idx, 0, cd_cap - 1)[:, :, None]
+            == jnp.arange(cd_cap)[None, None, :]
+        ).astype(batch.calldata.dtype)
+        cd_bytes = jnp.einsum("nkc,nc->nk", cd_onehot, batch.calldata)
+    else:
+        cd_bytes = jnp.take_along_axis(
+            batch.calldata, jnp.clip(cd_idx, 0, cd_cap - 1), axis=1)
     cd_bytes = jnp.where(cd_in, cd_bytes, 0).astype(jnp.uint32)
     cd_word = u256.bytes_to_word(cd_bytes)
     res_val, res_mask = put(
@@ -517,8 +555,7 @@ def step(batch: StateBatch, code: CodeTable,
     # ---- PUSHn -----------------------------------------------------------
     push_mask = ex & (op >= 0x60) & (op <= 0x7F)
     push_n = (op - 0x5F).astype(jnp.int32)
-    pidx = pc_safe[:, None] + 1 + jnp.arange(32)[None, :]
-    pbytes = code.ops[batch.code_id[:, None], pidx].astype(jnp.uint32)
+    pbytes = code_win[:, 1:].astype(jnp.uint32)  # rides the fetch window
     pword = u256.bytes_to_word(pbytes)
     shift = (8 * (32 - push_n)).astype(jnp.uint32)
     pword = u256.lshr(pword, shift)
@@ -743,12 +780,22 @@ def step(batch: StateBatch, code: CodeTable,
 
     def do_sload(args):
         res_val, res_mask = args
+        s_cap = skeys.shape[1]
         hit = jnp.all(skeys == a[:, None, :], axis=-1)  # [n, S]
-        hit = hit & (jnp.arange(skeys.shape[1])[None, :] < scnt[:, None])
+        hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
         any_hit = jnp.any(hit, axis=-1)
         last = jnp.argmax(
-            jnp.where(hit, jnp.arange(skeys.shape[1])[None, :] + 1, 0), axis=-1)
-        val = jnp.take_along_axis(svals, last[:, None, None], axis=1)[:, 0, :]
+            jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+        if _peek_einsum():
+            # one-hot contraction instead of a gather (same trick as
+            # the stack peek)
+            oh = (
+                jnp.arange(s_cap)[None, :] == last[:, None]
+            ).astype(svals.dtype)
+            val = jnp.einsum("ns,nsw->nw", oh, svals)
+        else:
+            val = jnp.take_along_axis(
+                svals, last[:, None, None], axis=1)[:, 0, :]
         val = _m(any_hit, val, jnp.zeros_like(val))
         return put(res_val, res_mask, sload_mask, val)
 
@@ -846,12 +893,12 @@ def step(batch: StateBatch, code: CodeTable,
         jnp.where(oh_swap[:, :, None], a[:, None, :], batch.stack))
     sp = jnp.where(effective, batch.sp + net_sp, batch.sp)
 
-    # ---- gas -------------------------------------------------------------
+    # ---- gas (static bounds ride the merged metadata gather) -------------
     gas_min = (batch.gas_min
-               + jnp.where(effective, jnp.asarray(_GAS_MIN)[op], 0)
+               + jnp.where(effective, meta[:, 4].astype(jnp.uint32), 0)
                + gas_dyn_min)
     gas_max = (batch.gas_max
-               + jnp.where(effective, jnp.asarray(_GAS_MAX)[op], 0)
+               + jnp.where(effective, meta[:, 5].astype(jnp.uint32), 0)
                + gas_dyn_max)
     # out-of-gas: even the minimum-cost path exceeded this lane's budget
     # (reference: OutOfGasException via check_gas, machine_state.py:83-264)
