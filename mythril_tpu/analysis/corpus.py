@@ -65,7 +65,6 @@ def corpus_device_prepass(
             [code for _, code in runnable],
             lanes_per_contract=lanes_per_contract,
             waves=8,
-            flips_per_contract=8,
             steps_per_wave=512,
             budget_s=budget_s,
             address=address,

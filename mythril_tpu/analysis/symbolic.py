@@ -216,7 +216,6 @@ class SymExecWrapper:
                     runtime,
                     lanes=lanes,
                     waves=8,
-                    flips_per_wave=max(8, lanes // 8),
                     steps_per_wave=512,
                     budget_s=budget,
                     address=address.value,

@@ -202,7 +202,6 @@ class DeviceCorpusExplorer:
         calldata_len: int = 68,
         lanes_per_contract: int = 32,
         waves: int = 4,
-        flips_per_contract: int = 8,
         steps_per_wave: int = 512,
         portfolio_candidates: int = 64,
         portfolio_steps: int = 1024,
@@ -227,7 +226,6 @@ class DeviceCorpusExplorer:
         self.lanes_per_contract = lanes_per_contract
         self.calldata_len = calldata_len
         self.waves = waves
-        self.flips_per_contract = flips_per_contract
         self.steps_per_wave = steps_per_wave
         self.portfolio_candidates = portfolio_candidates
         self.portfolio_steps = portfolio_steps
@@ -493,7 +491,7 @@ class DeviceCorpusExplorer:
         candidates: List[Tuple[int, List, Tuple[int, bool]]] = []
         # every lane may contribute one candidate (bounded by the lane
         # count): unsat candidates cost one short CDCL sprint each
-        # (time-capped in _solve_flips) and surplus feasible witnesses
+        # (time-capped in _sprint_flips) and surplus feasible witnesses
         # still seed lanes, so oversampling loses nothing — while
         # under-sampling would blacklist targets via `attempted`
         # without ever solving them
@@ -619,7 +617,7 @@ class DeviceCorpusExplorer:
             fresh, n_flips = self._reseed(view)
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
-            quota = len(self.tracks) * self.flips_per_contract
+            quota = len(self.tracks) * self.lanes_per_contract
             if plateaued and n_flips < max(1, quota // 4):
                 break  # coverage stalled and flips are drying up
             inputs = fresh
@@ -706,7 +704,6 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
         calldata_len: int = 68,
         lanes: int = 32,
         waves: int = 4,
-        flips_per_wave: int = 8,
         steps_per_wave: int = 2048,
         portfolio_candidates: int = 64,
         portfolio_steps: int = 1024,
@@ -721,7 +718,6 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
             calldata_len=calldata_len,
             lanes_per_contract=lanes,
             waves=waves,
-            flips_per_contract=flips_per_wave,
             steps_per_wave=steps_per_wave,
             portfolio_candidates=portfolio_candidates,
             portfolio_steps=portfolio_steps,

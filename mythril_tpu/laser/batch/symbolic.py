@@ -89,6 +89,22 @@ for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
     _PUSHES[_byte] = _pushes
     _VALID[_byte] = True
 
+# merged per-opcode shadow metadata, one gather per step (each unfused
+# gather is a kernel segment — see step.py _META):
+# [pops, pushes, valid, is_bin, is_un, is_ter, is_call]
+_SYM_META = np.stack(
+    [
+        _POPS,
+        _PUSHES,
+        _VALID.astype(np.int32),
+        _IS_BIN.astype(np.int32),
+        _IS_UN.astype(np.int32),
+        _IS_TER.astype(np.int32),
+        _IS_CALL.astype(np.int32),
+    ],
+    axis=1,
+)
+
 CALLDATALOAD = _B["CALLDATALOAD"]
 CALLDATACOPY = _B["CALLDATACOPY"]
 CODECOPY = _B["CODECOPY"]
@@ -138,12 +154,6 @@ def make_sym_batch(base: StateBatch) -> SymBatch:
     )
 
 
-def _peek2(tids, sp, k):
-    """tids[lane][sp-1-k] for 2-D shadow arrays."""
-    idx = jnp.clip(sp - 1 - k, 0, tids.shape[1] - 1)
-    return jnp.take_along_axis(tids, idx[:, None].astype(jnp.int32), axis=1)[:, 0]
-
-
 def _scatter2(tids, idx, val, mask):
     hit = (jnp.arange(tids.shape[1])[None, :] == idx[:, None]) & mask[:, None]
     return jnp.where(hit, val[:, None], tids)
@@ -161,30 +171,43 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     oob = pre.pc >= code_len
     pc_safe = jnp.clip(pre.pc, 0, code.ops.shape[1] - 33)
     op = code.ops[pre.code_id, pc_safe].astype(jnp.int32)
-    pops = jnp.asarray(_POPS)[op]
-    pushes = jnp.asarray(_PUSHES)[op]
+    meta = jnp.asarray(_SYM_META)[op]
+    pops = meta[:, 0]
+    pushes = meta[:, 1]
     net_sp = pushes - pops
     live = pre.active & ~oob
     ex = (
         live
-        & jnp.asarray(_VALID)[op]
+        & (meta[:, 2] != 0)
         & (pre.sp >= pops)
         & (pre.sp + net_sp <= stack_cap)
     )
 
-    a_val = _take_word(pre.stack, pre.sp, 0)
-    b_val = _take_word(pre.stack, pre.sp, 1)
-    a_tid = _peek2(symb.stack_tid, pre.sp, 0)
-    b_tid = _peek2(symb.stack_tid, pre.sp, 1)
-    c_tid = _peek2(symb.stack_tid, pre.sp, 2)
+    # one consolidated peek each for the value stack (3 slots) and the
+    # shadow stack (those plus the DUP/SWAP depths) — separate per-slot
+    # gathers are separate kernel segments
+    dup_n = (op - 0x80).astype(jnp.int32)
+    swap_n = (op - 0x8F).astype(jnp.int32)
+    peek_ks = jnp.stack(
+        [jnp.zeros_like(op), jnp.ones_like(op), 2 * jnp.ones_like(op),
+         dup_n, swap_n], axis=1)  # [n, 5]
+    peek_idx = jnp.clip(
+        pre.sp[:, None] - 1 - peek_ks, 0, stack_cap - 1
+    ).astype(jnp.int32)
+    vals = jnp.take_along_axis(
+        pre.stack, peek_idx[:, :3, None], axis=1)
+    a_val, b_val, c_val = vals[:, 0], vals[:, 1], vals[:, 2]
+    tids = jnp.take_along_axis(symb.stack_tid, peek_idx, axis=1)
+    a_tid, b_tid, c_tid = tids[:, 0], tids[:, 1], tids[:, 2]
+    dup_tid, swap_deep_tid = tids[:, 3], tids[:, 4]
 
     # --- run the concrete kernel --------------------------------------
     post = step(pre, code)
 
     # --- classify the symbolic effect ---------------------------------
-    is_bin = jnp.asarray(_IS_BIN)[op]
-    is_un = jnp.asarray(_IS_UN)[op]
-    is_ter = jnp.asarray(_IS_TER)[op]
+    is_bin = meta[:, 3] != 0
+    is_un = meta[:, 4] != 0
+    is_ter = meta[:, 5] != 0
     is_cdl = op == CALLDATALOAD
 
     bin_sym = ex & is_bin & ((a_tid != 0) | (b_tid != 0))
@@ -197,7 +220,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     un_ok = a_tid >= 0
     mk_node = (bin_sym & bin_ok) | (un_sym & un_ok) | cdl_clean
     tainted_top3 = (a_tid != 0) | (b_tid != 0) | (c_tid != 0)
-    is_callf = jnp.asarray(_IS_CALL)[op]
+    is_callf = meta[:, 6] != 0
     # a call's success push depends on its operands AND on the balance,
     # which an earlier tainted transfer may have made path-dependent
     mk_opaque = (
@@ -255,7 +278,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     # CALLDATACOPY makes the window opaque bytes (byte-granular
     # calldata expressions stay host-side); CODECOPY writes concrete
     # code bytes, which must also CLEAR stale taint over the window
-    cplen_i, _ = _word_to_i32(_take_word(pre.stack, pre.sp, 2))
+    cplen_i, _ = _word_to_i32(c_val)
     ccopy_m = ex & (op == CALLDATACOPY) & ~off_big
     inc = (rel >= 0) & (rel < cplen_i[:, None]) & ccopy_m[:, None]
     mem_tid = jnp.where(inc, OPAQUE, mem_tid)
@@ -320,16 +343,12 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         ex & (op == SELFBALANCE_B) & (balance_tid != 0), OPAQUE, res_tid
     )
 
-    # DUP/SWAP move tids with their values
+    # DUP/SWAP move tids with their values (depths pre-gathered in the
+    # consolidated peek)
     is_dup = (op >= 0x80) & (op <= 0x8F)
     is_swap = (op >= 0x90) & (op <= 0x9F)
-    dup_n = (op - 0x80).astype(jnp.int32)
-    swap_n = (op - 0x8F).astype(jnp.int32)
-    res_tid = jnp.where(
-        ex & is_dup, _peek2(symb.stack_tid, pre.sp, dup_n), res_tid
-    )
-    deep_tid = _peek2(symb.stack_tid, pre.sp, swap_n)
-    res_tid = jnp.where(ex & is_swap, deep_tid, res_tid)
+    res_tid = jnp.where(ex & is_dup, dup_tid, res_tid)
+    res_tid = jnp.where(ex & is_swap, swap_deep_tid, res_tid)
 
     # --- stack tid write (mirrors the consolidated stack write) --------
     # A lane the kernel demoted mid-step (capacity / conditional
@@ -375,13 +394,6 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         ar_vb=ar_vb,
         ar_count=ar_count,
     )
-
-
-def _take_word(stack, sp, k):
-    idx = jnp.clip(sp - 1 - k, 0, stack.shape[1] - 1)
-    return jnp.take_along_axis(
-        stack, idx[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0, :]
 
 
 @functools.partial(jax.jit, static_argnames=("max_steps",))
