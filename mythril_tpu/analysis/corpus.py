@@ -97,6 +97,122 @@ def corpus_device_prepass(
     return outcomes
 
 
+class OverlappedPrepass:
+    """Own the striped device prepass thread beside a sequence of host
+    analyses in THIS process.
+
+    The prepass explores the whole corpus on device while the caller
+    analyzes contracts one by one; both sides serialize host symbolic
+    state on HOST_SYMBOLIC_LOCK (support/host_lock.py). Per-contract
+    outcomes are published incrementally after every wave, so analyses
+    that start mid-prepass still get witness/coverage injection, and
+    `finish()` returns the final outcomes for a post-merge.
+
+    Usage:
+        pre = OverlappedPrepass(contracts, address, transaction_count)
+        for i, c in enumerate(contracts):
+            outcome, device_ok = pre.outcome_for(i)
+            with pre.lock:
+                ...analyze c with prepass_outcome=outcome, device off
+                   unless device_ok...
+            pre.yield_lock()
+        final = pre.finish()
+    """
+
+    def __init__(
+        self,
+        contracts: List[Tuple[str, str, str]],
+        address: int,
+        transaction_count: int,
+        budget_s: Optional[float] = None,
+    ) -> None:
+        import threading
+
+        from mythril_tpu.support.host_lock import HOST_SYMBOLIC_LOCK
+
+        self.lock = HOST_SYMBOLIC_LOCK
+        self._final: Dict[int, Dict] = {}
+        self._published: Dict[int, Dict] = {}
+        self._stop = threading.Event()
+        self._deviceless = 0
+        self._finished = False
+
+        def _work():
+            self._final.update(
+                corpus_device_prepass(
+                    contracts,
+                    budget_s=budget_s,
+                    address=address,
+                    transaction_count=transaction_count,
+                    host_lock=self.lock,
+                    stop_event=self._stop,
+                    publish=self._published.__setitem__,
+                )
+            )
+
+        self._thread = threading.Thread(target=_work, daemon=True)
+        self._thread.start()
+
+    def _done(self) -> bool:
+        if self._thread is not None and not self._thread.is_alive():
+            self._thread.join()
+            self._thread = None
+        return self._thread is None
+
+    def outcome_for(self, i: int):
+        """(outcome to inject for contract i, device allowed).
+
+        While the prepass runs, analyses get the latest PUBLISHED
+        partial outcome with the device off — the chip belongs to the
+        prepass thread, and an injected outcome bypasses the
+        device_prepass mode check anyway. Once it's done, the device
+        comes back for everyone: covered contracts get the final
+        outcome (which skips their own per-contract prepass), missed
+        ones fall back to the normal per-contract device path."""
+        if self._done():
+            return self._final.get(i), True
+        self._deviceless += 1
+        return self._published.get(i), False
+
+    def yield_lock(self) -> None:
+        """Hand the lock to the prepass thread between analyses:
+        CPython locks are unfair and a tight loop would reacquire
+        within microseconds, rationing the prepass to one reseed per
+        contract (lock convoy)."""
+        if self._thread is not None and self._thread.is_alive():
+            time.sleep(0.05)
+
+    def finish(self) -> Dict[int, Dict]:
+        """Stop the exploration at its next wave boundary and return
+        the final per-contract outcomes (empty on prepass failure).
+        Idempotent — callers invoke it from finally blocks so an
+        exception escaping the analysis loop cannot orphan the
+        thread."""
+        if self._finished:
+            return self._final
+        self._finished = True
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=300)
+            if self._thread.is_alive():
+                log.warning(
+                    "corpus device prepass did not stop within its "
+                    "grace period; its banked witnesses are lost and "
+                    "the daemon thread may briefly keep the device busy"
+                )
+            self._thread = None
+        if not self._final and self._deviceless:
+            # the prepass died without outcomes: these analyses ran
+            # host-only on at most a partial outcome — say so rather
+            # than degrade silently
+            log.warning(
+                "corpus device prepass produced no outcomes; %d "
+                "contract(s) were analyzed without the device",
+                self._deviceless,
+            )
+        return self._final
+
+
 def _analyze_one(payload: Tuple) -> Dict:
     """Worker: analyze one contract, return issue dicts (run in a
     spawned process; heavyweight imports stay inside)."""
@@ -202,12 +318,9 @@ def analyze_corpus(
     if use_device is None:
         # the device axis is on whenever an accelerator is present —
         # the PARENT owns the chip, so pooling does not disable it
-        try:
-            import jax
+        from mythril_tpu.support.accel import accelerator_present
 
-            use_device = jax.default_backend() != "cpu"
-        except Exception:
-            use_device = False
+        use_device = accelerator_present()
 
     single_process = processes <= 1 or len(contracts) == 1
 
@@ -244,86 +357,26 @@ def analyze_corpus(
         # contract can't overlap with anything, so it keeps the
         # prepass-first ordering and full injection.
         if use_device and len(contracts) > 1:
-            import threading
-
-            from mythril_tpu.support.host_lock import HOST_SYMBOLIC_LOCK
-
-            stop_event = threading.Event()
-            published: Dict[int, Dict] = {}
-
-            def _prepass_worker():
-                prepass.update(
-                    corpus_device_prepass(
-                        contracts,
-                        budget_s=device_budget_s,
-                        address=address,
-                        transaction_count=transaction_count,
-                        host_lock=HOST_SYMBOLIC_LOCK,
-                        stop_event=stop_event,
-                        publish=published.__setitem__,
-                    )
-                )
-
-            prepass_thread = threading.Thread(
-                target=_prepass_worker, daemon=True
+            pre = OverlappedPrepass(
+                contracts, address, transaction_count, device_budget_s
             )
-            prepass_thread.start()
-            deviceless_contracts = 0
             results = []
             for i, (code, creation_code, name) in enumerate(contracts):
-                if prepass_thread is not None and not prepass_thread.is_alive():
-                    prepass_thread.join()
-                    prepass_thread = None
-                prepass_done = prepass_thread is None
-                # While the prepass is still running, contracts consume
-                # its latest PUBLISHED partial outcome (wave-1 triggers
-                # and coverage already pre-empt most host solves) with
-                # the device args off — the chip belongs to the prepass
-                # thread, and an injected outcome bypasses the
-                # device_prepass mode check anyway. Once it's done, the
-                # device comes back for everyone: covered contracts get
-                # the final outcome injected (which skips their own
-                # prepass), missed ones (failure, sub-8-char runtime)
-                # fall back to the normal per-contract device path.
-                outcome = prepass.get(i) if prepass_done else published.get(i)
-                worker_device = use_device and prepass_done
-                if not worker_device:
-                    deviceless_contracts += 1
-                with HOST_SYMBOLIC_LOCK:
+                outcome, device_ok = pre.outcome_for(i)
+                with pre.lock:
                     results.append(
                         _analyze_one(
                             payload(
-                                code, creation_code, name, worker_device,
+                                code,
+                                creation_code,
+                                name,
+                                use_device and device_ok,
                                 outcome,
                             )
                         )
                     )
-                if prepass_thread is not None and prepass_thread.is_alive():
-                    # hand the lock to the prepass thread: CPython locks
-                    # are unfair and this loop would otherwise reacquire
-                    # within microseconds, rationing the prepass to one
-                    # reseed per contract (lock convoy)
-                    time.sleep(0.05)
-            if prepass_thread is not None:
-                # analyses outran the prepass: stop it at the next wave
-                # boundary and fold in whatever it banked
-                stop_event.set()
-                prepass_thread.join(timeout=300)
-                if prepass_thread.is_alive():
-                    log.warning(
-                        "corpus device prepass did not stop within its "
-                        "grace period; its banked witnesses are lost and "
-                        "the daemon thread may briefly keep the device busy"
-                    )
-            if not prepass and deviceless_contracts:
-                # the prepass died without outcomes: these analyses ran
-                # host-only on at most a partial outcome — say so
-                # rather than degrade silently
-                log.warning(
-                    "corpus device prepass produced no outcomes; %d "
-                    "contract(s) were analyzed without the device",
-                    deviceless_contracts,
-                )
+                pre.yield_lock()
+            prepass = pre.finish()
         else:
             if use_device:
                 prepass = corpus_device_prepass(

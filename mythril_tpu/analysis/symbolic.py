@@ -185,12 +185,9 @@ class SymExecWrapper:
             if mode == "never":
                 return None
             if mode == "auto":
-                try:
-                    import jax
+                from mythril_tpu.support.accel import accelerator_present
 
-                    if jax.default_backend() == "cpu":
-                        return None
-                except Exception:
+                if not accelerator_present():
                     return None
 
             if len(runtime) < 8:

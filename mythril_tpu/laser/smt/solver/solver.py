@@ -262,12 +262,9 @@ def device_solving_enabled() -> bool:
         return False
     if mode == "always":
         return True
-    try:
-        import jax
+    from mythril_tpu.support.accel import accelerator_present
 
-        return jax.default_backend() != "cpu"
-    except Exception:
-        return False
+    return accelerator_present()
 
 
 def check_terms(

@@ -131,29 +131,112 @@ class MythrilAnalyzer:
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
 
     # -- the analysis run ----------------------------------------------
+    def _corpus_prepass(self, transaction_count: Optional[int]):
+        """The overlapped striped device prepass for multi-contract
+        runs (analysis/corpus.py OverlappedPrepass): the chip explores
+        the whole corpus while this process analyzes contracts one by
+        one. None when there is nothing to overlap (single contract,
+        no accelerator, or --device-prepass never)."""
+        if len(self.contracts) < 2:
+            return None
+        mode = getattr(args, "device_prepass", "auto")
+        if mode == "never":
+            return None
+        if mode == "auto":
+            from mythril_tpu.support.accel import accelerator_present
+
+            if not accelerator_present():
+                return None
+        try:
+            from mythril_tpu.analysis.corpus import OverlappedPrepass
+
+            return OverlappedPrepass(
+                [
+                    (c.code or "", getattr(c, "creation_code", "") or "", c.name)
+                    for c in self.contracts
+                ],
+                self._prepass_address(),
+                transaction_count or 2,
+            )
+        except Exception:
+            log.debug("overlapped corpus prepass unavailable", exc_info=True)
+            return None
+
+    def _prepass_address(self) -> int:
+        address = self.address
+        if isinstance(address, str):
+            return int(address, 16)
+        if isinstance(address, int):
+            return address
+        from mythril_tpu.laser.batch.explore import DEFAULT_ADDRESS
+
+        return DEFAULT_ADDRESS
+
     def fire_lasers(
         self,
         modules: Optional[List[str]] = None,
         transaction_count: Optional[int] = None,
     ) -> Report:
         """Analyze every loaded contract; one contract crashing doesn't
-        lose the others' findings."""
+        lose the others' findings. With several contracts and an
+        accelerator, the striped device prepass overlaps the loop —
+        the reference's sequential per-contract for-loop
+        (mythril/mythril/mythril_analyzer.py:145-185) becomes the host
+        half of a host+device pipeline."""
         SolverStatistics().enabled = True
+        pre = self._corpus_prepass(transaction_count)
+
+        try:
+            collected, crashes, execution_info = self._analyze_contracts(
+                pre, modules, transaction_count
+            )
+        finally:
+            # an exception escaping the loop (DetectorNotFoundError)
+            # must not orphan the prepass thread on the device
+            final = pre.finish() if pre is not None else {}
+        collected += self._merge_prepass_issues(final, collected)
+
+        # prime the source registry for the report
+        Source().get_source_from_contracts_list(self.contracts)
+
+        return self._build_report(collected, crashes, execution_info)
+
+    def _analyze_contracts(
+        self,
+        pre,
+        modules: Optional[List[str]],
+        transaction_count: Optional[int],
+    ):
+        """The per-contract host loop (crash-contained per contract)."""
+        from contextlib import nullcontext
+
         collected: List[Issue] = []
         crashes: List[str] = []
         execution_info: Optional[List[ExecutionInfo]] = None
-
-        for contract in self.contracts:
+        for index, contract in enumerate(self.contracts):
             StartTime()  # fresh discovery-time baseline per contract
+            outcome, device_ok = (
+                pre.outcome_for(index) if pre is not None else (None, True)
+            )
+            restore = None
+            if not device_ok:
+                # the chip belongs to the prepass thread; the injected
+                # (possibly partial) outcome stands in for this
+                # contract's own device prepass
+                restore = (args.device_prepass, args.device_solving)
+                args.device_prepass = "never"
+                args.device_solving = "never"
             try:
-                sym = self._symbolically_execute(
-                    contract,
-                    loop_bound=self.loop_bound,
-                    transaction_count=transaction_count,
-                    modules=modules,
-                    compulsory_statespace=False,
-                )
-                issues = fire_lasers(sym, modules)
+                with pre.lock if pre is not None else nullcontext():
+                    sym = self._symbolically_execute(
+                        contract,
+                        loop_bound=self.loop_bound,
+                        transaction_count=transaction_count,
+                        modules=modules,
+                        compulsory_statespace=False,
+                        prepass_outcome=outcome,
+                    )
+                    issues = fire_lasers(sym, modules)
                 execution_info = sym.execution_info
             except DetectorNotFoundError:
                 raise
@@ -164,6 +247,11 @@ class MythrilAnalyzer:
                 log.critical(CRASH_NOTICE + traceback.format_exc())
                 issues = retrieve_callback_issues(modules)
                 crashes.append(traceback.format_exc())
+            finally:
+                if restore is not None:
+                    args.device_prepass, args.device_solving = restore
+            if pre is not None:
+                pre.yield_lock()
 
             for issue in issues:
                 issue.add_code_info(contract)
@@ -172,9 +260,47 @@ class MythrilAnalyzer:
             from mythril_tpu.support.phase_profile import PhaseProfile
 
             log.info("Host phase profile: \n%s", str(PhaseProfile()))
+        return collected, crashes, execution_info
 
-        # prime the source registry for the report
-        Source().get_source_from_contracts_list(self.contracts)
+    def _merge_prepass_issues(
+        self, final: dict, collected: List[Issue]
+    ) -> List[Issue]:
+        """Witness issues the device banked for contracts the host walk
+        missed (same dedup rule as the pooled corpus merge: one issue
+        per (address, swc-id) PER CONTRACT — two contracts may hold the
+        same vulnerability at the same byte offset)."""
+        from mythril_tpu.analysis.prepass import witness_issues
+
+        seen = {
+            (issue.contract, issue.address, issue.swc_id)
+            for issue in collected
+        }
+        extra: List[Issue] = []
+        address = self._prepass_address()
+        for i, contract in enumerate(self.contracts):
+            outcome = final.get(i)
+            if not outcome:
+                continue
+            try:
+                fresh = witness_issues(contract, outcome, address)
+            except Exception:
+                log.debug("witness merge failed for %s", contract.name,
+                          exc_info=True)
+                continue
+            for issue in fresh:
+                if (issue.contract, issue.address, issue.swc_id) in seen:
+                    continue
+                issue.add_code_info(contract)
+                extra.append(issue)
+        if extra:
+            log.info(
+                "Device prepass contributed %d issue(s) the host walk "
+                "did not find",
+                len(extra),
+            )
+        return extra
+
+    def _build_report(self, collected, crashes, execution_info) -> Report:
 
         report = Report(
             contracts=self.contracts,

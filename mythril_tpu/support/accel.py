@@ -1,0 +1,21 @@
+"""One shared answer to "is an accelerator present?".
+
+The device prepass, the overlapped corpus pipeline, and the solver's
+first-line device attempt must agree on whether a chip exists —
+independent copies of the backend probe drifting apart would let one
+half of the pipeline dispatch to a device the other half refuses.
+"""
+
+from __future__ import annotations
+
+
+def accelerator_present() -> bool:
+    """True when jax's default backend is a real accelerator (anything
+    but cpu). False when jax is unavailable or fails to initialize —
+    callers treat that exactly like a CPU-only host."""
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False

@@ -114,3 +114,38 @@ def test_analyzer_end_to_end_with_mocked_chain():
     data = json.loads(report.as_json())
     assert data["success"] is True
     assert any(i["swc-id"] == "106" for i in data["issues"])
+
+
+def test_analyzer_multi_contract_overlapped_prepass():
+    """With several contracts and --device-prepass always, fire_lasers
+    runs the overlapped striped prepass beside the per-contract loop
+    (the reference's sequential for-loop becomes the host half of a
+    host+device pipeline) and still reports every contract's issues."""
+    from mythril_tpu.support.support_args import args
+
+    disassembler = MythrilDisassembler(eth=None)
+    disassembler.load_from_bytecode("33ff", bin_runtime=True)  # SWC-106
+    disassembler.load_from_bytecode(
+        "600035600757005bfe", bin_runtime=True  # SWC-110
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler,
+        strategy="bfs",
+        use_onchain_data=False,
+        address="0x" + "11" * 20,
+        execution_timeout=60,
+        create_timeout=10,
+        max_depth=64,
+        loop_bound=3,
+    )
+    saved = (args.device_prepass, args.device_solving)
+    args.device_prepass = "always"  # engage the overlap on the CPU mesh
+    try:
+        report = analyzer.fire_lasers(transaction_count=1)
+    finally:
+        args.device_prepass, args.device_solving = saved
+    data = json.loads(report.as_json())
+    assert data["success"] is True
+    swcs = {i["swc-id"] for i in data["issues"]}
+    assert "106" in swcs
+    assert "110" in swcs
