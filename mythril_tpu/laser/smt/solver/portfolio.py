@@ -554,6 +554,7 @@ def device_check_batch(
     candidates: int = 64,
     steps: int = 512,
     seed: int = 7,
+    n_devices: int = 1,
 ) -> List[Optional[Dict[str, int]]]:
     """Solve MANY independent queries in ONE device dispatch.
 
@@ -570,6 +571,10 @@ def device_check_batch(
     Returns one Optional assignment per query, position-aligned.
     Queries that fall outside the device language come back None
     (which, as always, proves nothing).
+
+    With n_devices > 1 the query axis shards over the devices
+    (pmap over Q-chunks of the vmapped search) — corpus-scale batches
+    spread across a chip mesh, each device solving its slice.
     """
     from mythril_tpu.laser.batch import ensure_compile_cache
 
@@ -586,7 +591,10 @@ def device_check_batch(
         return out
     if len(live) == 1:
         i, prog = live[0]
-        out[i] = device_check(queries[i], candidates, steps, seed, prog=prog)
+        out[i] = device_check(
+            queries[i], candidates, steps, seed,
+            n_devices=n_devices, prog=prog,
+        )
         return out
 
     import jax
@@ -648,15 +656,35 @@ def device_check_batch(
     )
 
     fn = _get_search_fn(candidates, L, steps)
-    vkey = ("vmap", candidates, L, steps)
-    vfn = _eval_cache.get(vkey)
-    if vfn is None:
-        vfn = jax.jit(jax.vmap(fn.raw))
-        _eval_cache[vkey] = vfn
     seeds = jnp.arange(seed, seed + Q, dtype=jnp.int32)
-    solved, winners = vfn(*args, seeds)
-    solved = np.asarray(solved)
-    winners = np.asarray(winners)
+    # largest power-of-two device count that divides Q (Q is bucketed
+    # to a power of two, so any pow2 <= min(n_devices, Q) divides it),
+    # clamped to the devices that actually exist
+    D = 1
+    avail = min(n_devices, len(jax.devices()), Q)
+    while D * 2 <= avail:
+        D *= 2
+    if D > 1:
+        pkey = ("pmap-vmap", candidates, L, steps, D)
+        pfn = _eval_cache.get(pkey)
+        if pfn is None:
+            pfn = jax.pmap(
+                jax.vmap(fn.raw), devices=jax.devices()[:D]
+            )
+            _eval_cache[pkey] = pfn
+        chunk = lambda a: a.reshape((D, Q // D) + a.shape[1:])
+        solved, winners = pfn(*(chunk(a) for a in args), chunk(seeds))
+        solved = np.asarray(solved).reshape(Q)
+        winners = np.asarray(winners).reshape((Q,) + winners.shape[2:])
+    else:
+        vkey = ("vmap", candidates, L, steps)
+        vfn = _eval_cache.get(vkey)
+        if vfn is None:
+            vfn = jax.jit(jax.vmap(fn.raw))
+            _eval_cache[vkey] = vfn
+        solved, winners = vfn(*args, seeds)
+        solved = np.asarray(solved)
+        winners = np.asarray(winners)
 
     for qi, (i, p) in enumerate(live):
         if bool(solved[qi]):
@@ -696,6 +724,7 @@ def device_check(
     prog_args = _program_args(prog)
 
     n_vars = len(prog.var_slots)
+    n_devices = min(n_devices, len(jax.devices()))
     if n_devices > 1:
         pkey = ("pmap", candidates, prog.limbs, steps, n_devices)
         replicated = _eval_cache.get(pkey)

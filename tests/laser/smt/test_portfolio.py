@@ -131,3 +131,26 @@ def test_batched_matches_single():
     for asn in [single] + list(batched):
         if asn is not None:
             assert all(eval_term(c, asn) for c in cons)
+
+
+def test_batched_dispatch_sharded_over_devices():
+    """The query axis shards over a device mesh (pmap of the vmapped
+    search): same aligned answers, each device solving its chunk."""
+    import jax
+
+    from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    qs = [bv(f"sh{i}", 32) for i in range(4)]
+    queries = [lowered(q * 3 == 21 + 3 * i) for i, q in enumerate(qs)]
+    out = device_check_batch(
+        queries, candidates=32, steps=2048, n_devices=jax.device_count()
+    )
+    assert len(out) == len(queries)
+    solved = 0
+    for q, asn in zip(queries, out):
+        if asn is not None:
+            assert all(eval_term(c, asn) for c in q)
+            solved += 1
+    assert solved >= 1
