@@ -155,6 +155,14 @@ def _peek_einsum() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def _gate(pred, fn, operands):
+    """Whole-batch phase gate: `lax.cond` skips the phase when no lane
+    triggers it. (Probed once: inlining every phase unconditionally —
+    the handlers are mask-correct either way — crashed the TPU worker
+    outright on the v5e link, so the conditionals stay.)"""
+    return lax.cond(pred, fn, lambda x: x, operands)
+
+
 def _m(mask, x, y):
     """Masked select with trailing-dim broadcast."""
     extra = x.ndim - mask.ndim
@@ -388,10 +396,9 @@ def step(batch: StateBatch, code: CodeTable,
         )
 
     (res_val, res_mask, status, balance, msize, gas_dyn_min, gas_dyn_max) = (
-        lax.cond(
+        _gate(
             jnp.any(call_any),
             do_calls,
-            lambda x: x,
             (res_val, res_mask, status, balance, msize, gas_dyn_min,
              gas_dyn_max),
         )
@@ -436,8 +443,7 @@ def step(batch: StateBatch, code: CodeTable,
         val = _m(op == DIV, q, _m(op == SDIV, qs, _m(op == MOD, r, rs)))
         return put(res_val, res_mask, div_mask, val)
 
-    res_val, res_mask = lax.cond(
-        jnp.any(div_mask), do_div, lambda x: x, (res_val, res_mask))
+    res_val, res_mask = _gate(jnp.any(div_mask), do_div, (res_val, res_mask))
 
     modmask = ex & ((op == ADDMOD) | (op == MULMOD))
 
@@ -447,8 +453,7 @@ def step(batch: StateBatch, code: CodeTable,
         mm = u256.mulmod(a, b, c)
         return put(res_val, res_mask, modmask, _m(op == ADDMOD, am, mm))
 
-    res_val, res_mask = lax.cond(
-        jnp.any(modmask), do_modops, lambda x: x, (res_val, res_mask))
+    res_val, res_mask = _gate(jnp.any(modmask), do_modops, (res_val, res_mask))
 
     exp_mask = ex & (op == EXP)
 
@@ -471,8 +476,8 @@ def step(batch: StateBatch, code: CodeTable,
         # across forks); 50/byte (EIP-160) bounds the maximum
         return res_val, res_mask, g_min + 10 * exp_bytes, g_max + 50 * exp_bytes
 
-    res_val, res_mask, gas_dyn_min, gas_dyn_max = lax.cond(
-        jnp.any(exp_mask), do_exp, lambda x: x,
+    res_val, res_mask, gas_dyn_min, gas_dyn_max = _gate(
+        jnp.any(exp_mask), do_exp,
         (res_val, res_mask, gas_dyn_min, gas_dyn_max))
 
     # ---- environment / block pushes --------------------------------------
@@ -668,10 +673,9 @@ def step(batch: StateBatch, code: CodeTable,
         lo, hi = absorb(0, lo, hi)
         flo, fhi = lo, hi
         for blk in range(1, SHA_MAX_BLOCKS):
-            lo, hi = lax.cond(
+            lo, hi = _gate(
                 jnp.any(sha_ok & (n_blocks > blk)),
-                lambda args: absorb(blk, *args),
-                lambda args: args,
+                lambda args, blk=blk: absorb(blk, *args),
                 (lo, hi),
             )
             done_now = (n_blocks == blk + 1)[:, None]
@@ -687,8 +691,7 @@ def step(batch: StateBatch, code: CodeTable,
         word = u256.bytes_to_word(digest)
         return put(res_val, res_mask, sha_ok, word)
 
-    res_val, res_mask = lax.cond(
-        jnp.any(sha_mask), do_sha3, lambda x: x, (res_val, res_mask))
+    res_val, res_mask = _gate(jnp.any(sha_mask), do_sha3, (res_val, res_mask))
     # affordable inputs beyond the device hash cap go back to the host
     status = jnp.where(sha_toobig, Status.UNSUPPORTED, status)
     sha_words = jnp.where(sha_ok, (len_i + 31) // 32, 0).astype(jnp.uint32)
@@ -707,8 +710,7 @@ def step(batch: StateBatch, code: CodeTable,
         byts = jnp.take_along_axis(mem, idx, axis=1).astype(jnp.uint32)
         return put(res_val, res_mask, mload_ok, u256.bytes_to_word(byts))
 
-    res_val, res_mask = lax.cond(
-        jnp.any(mload_ok), do_mload, lambda x: x, (res_val, res_mask))
+    res_val, res_mask = _gate(jnp.any(mload_ok), do_mload, (res_val, res_mask))
 
     mstore_mask = ex & (op == MSTORE)
     msize, gas_dyn_min, gas_dyn_max, status, mstore_ok = expand(
@@ -724,7 +726,7 @@ def step(batch: StateBatch, code: CodeTable,
             wbytes, jnp.clip(rel, 0, 31).astype(jnp.int32), axis=1)
         return jnp.where(inw, src, mem)
 
-    mem = lax.cond(jnp.any(mstore_ok), do_mstore, lambda m: m, mem)
+    mem = _gate(jnp.any(mstore_ok), do_mstore, mem)
 
     m8_mask = ex & (op == MSTORE8)
     msize, gas_dyn_min, gas_dyn_max, status, m8_ok = expand(
@@ -736,7 +738,7 @@ def step(batch: StateBatch, code: CodeTable,
         hit = (j == off_i[:, None]) & m8_ok[:, None]
         return jnp.where(hit, (b[:, 0] & 0xFF).astype(jnp.uint8)[:, None], mem)
 
-    mem = lax.cond(jnp.any(m8_ok), do_mstore8, lambda m: m, mem)
+    mem = _gate(jnp.any(m8_ok), do_mstore8, mem)
 
     # ---- CALLDATACOPY / CODECOPY (gated) ---------------------------------
     copy_mask = ex & ((op == CALLDATACOPY) | (op == CODECOPY))
@@ -773,7 +775,7 @@ def step(batch: StateBatch, code: CodeTable,
         src = jnp.where((op == CALLDATACOPY)[:, None], from_cd, from_co)
         return jnp.where(inw, src, mem)
 
-    mem = lax.cond(jnp.any(copy_ok), do_copy, lambda m: m, mem)
+    mem = _gate(jnp.any(copy_ok), do_copy, mem)
 
     # ---- storage (gated) -------------------------------------------------
     sload_mask = ex & (op == SLOAD)
@@ -799,8 +801,7 @@ def step(batch: StateBatch, code: CodeTable,
         val = _m(any_hit, val, jnp.zeros_like(val))
         return put(res_val, res_mask, sload_mask, val)
 
-    res_val, res_mask = lax.cond(
-        jnp.any(sload_mask), do_sload, lambda x: x, (res_val, res_mask))
+    res_val, res_mask = _gate(jnp.any(sload_mask), do_sload, (res_val, res_mask))
 
     sstore_mask = ex & (op == SSTORE)
 
@@ -821,8 +822,8 @@ def step(batch: StateBatch, code: CodeTable,
         status = jnp.where(full, Status.ERR_MEM, status)
         return skeys, svals, scnt, status
 
-    skeys, svals, scnt, status = lax.cond(
-        jnp.any(sstore_mask), do_sstore, lambda x: x, (skeys, svals, scnt, status))
+    skeys, svals, scnt, status = _gate(
+        jnp.any(sstore_mask), do_sstore, (skeys, svals, scnt, status))
 
     # ---- LOGn: pure pops (topics + data range) ---------------------------
     log_mask = ex & (op >= 0xA0) & (op <= 0xA4)
