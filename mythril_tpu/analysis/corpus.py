@@ -22,11 +22,39 @@ from __future__ import annotations
 
 import logging
 import multiprocessing as mp
+import os
 import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+
+def _effective_cpus() -> int:
+    """CPUs this process may actually run on — the affinity mask, not
+    the host count (a container pinned to one core of a 64-core host
+    must take the single-core paths)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return mp.cpu_count()
+
+
+def resolve_prepass_budget_s(
+    n_contracts: int, override: Optional[float] = None
+) -> float:
+    """Default ACTIVE-time budget (waves + flip solving; lock waits
+    don't bill) for the striped corpus prepass. Sized to the measured
+    coverage curve: the selector seeds cover most of what wave 1 can
+    reach and the curve plateaus within a few waves, while every
+    second of prepass activity is a second of GIL/core contention
+    stolen from overlapped host analyses on a small box — with the
+    conflict-budgeted CDCL answering most host queries in
+    microseconds, a long prepass tail costs more states than its
+    witnesses save. 1s/contract keeps 2-4 steady-state waves."""
+    if override is not None:
+        return override
+    return min(30.0, 1.0 * max(1, n_contracts))
 
 
 def corpus_device_prepass(
@@ -52,7 +80,7 @@ def corpus_device_prepass(
     if not runnable:
         return {}
     if budget_s is None:
-        budget_s = min(60.0, 3.0 * len(runnable))
+        budget_s = resolve_prepass_budget_s(len(runnable))
     try:
         from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
 
@@ -158,6 +186,20 @@ class OverlappedPrepass:
             self._thread.join()
             self._thread = None
         return self._thread is None
+
+    def drain(self) -> None:
+        """Block until the prepass finishes its remaining active
+        budget, without stopping it early. While the caller waits here
+        the lock stays free, so the drain runs at full speed — this is
+        how the analysis loop bounds its overlap window: cheap
+        contracts share the core with the prepass, then one drain, and
+        the budget-bound heavyweights run uncontended with the FINAL
+        outcome. (An active-time budget alone cannot bound the
+        prepass's wall span: lock waits don't bill, so a 13s budget
+        can stretch across a whole corpus of analyses.)"""
+        if self._thread is not None:
+            self._thread.join(timeout=300)
+            self._done()
 
     def outcome_for(self, i: int):
         """(outcome to inject for contract i, device allowed).
@@ -314,7 +356,7 @@ def analyze_corpus(
     single-process, overlapped with a worker pool (witnesses merged
     afterward) otherwise. Returns one result dict per contract
     ({name, issues, error, device_prepass, phases})."""
-    processes = processes or min(len(contracts), mp.cpu_count())
+    processes = processes or min(len(contracts), _effective_cpus())
     if use_device is None:
         # the device axis is on whenever an accelerator is present —
         # the PARENT owns the chip, so pooling does not disable it
@@ -349,33 +391,60 @@ def analyze_corpus(
         # device work) while the main thread analyzes, and both sides
         # take HOST_SYMBOLIC_LOCK around host symbolic state (the term
         # arena and the incremental CDCL session are process-global —
-        # support/host_lock.py), so the chip steps while the host
-        # solves and the prepass costs ~zero wall. Contracts reached
-        # after the prepass lands get its outcome injected (witness
-        # issues, coverage-guided pruning); earlier ones pick up their
-        # witnesses in the post-merge, same as the pooled path. A lone
-        # contract can't overlap with anything, so it keeps the
-        # prepass-first ordering and full injection.
-        if use_device and len(contracts) > 1:
+        # support/host_lock.py). Contracts reached after the prepass
+        # lands get its outcome injected (witness issues,
+        # coverage-guided pruning); earlier ones pick up their
+        # witnesses in the post-merge, same as the pooled path.
+        # Overlap needs a second core to pay: a wave's host-side
+        # dispatch/sync work contends with the analyses on a 1-core
+        # box (measured: a budget-bound contract analyzed beside a
+        # live prepass thread loses ~30% of its explored states), so
+        # single-core hosts — and lone contracts, which have nothing
+        # to overlap with — run the prepass FIRST, uncontended, then
+        # analyze with the final outcome injected.
+        if use_device and len(contracts) > 1 and _effective_cpus() > 1:
             pre = OverlappedPrepass(
                 contracts, address, transaction_count, device_budget_s
             )
-            results = []
-            for i, (code, creation_code, name) in enumerate(contracts):
+            # Smallest code first: cheap analyses (which converge well
+            # inside their budgets regardless of contention) soak up
+            # the prepass's busy window, so the budget-bound
+            # heavyweights run after it finishes — on an uncontended
+            # core and with the FINAL prepass outcome instead of a
+            # partial. Measured on the 13-fixture corpus (1-core box):
+            # scheduling the largest contract first instead cost it
+            # ~30% of its explored states to prepass-thread contention.
+            order = sorted(
+                range(len(contracts)), key=lambda i: len(contracts[i][0])
+            )
+            # Overlap window: cheap analyses share the (single) core
+            # with the prepass for about its active budget, then one
+            # drain lets it finish uncontended. Past the window every
+            # remaining contract runs on a quiet core — measured: a
+            # budget-bound contract analyzed beside a live prepass
+            # thread loses ~30% of its explored states to contention.
+            overlap_window_s = 1.25 * resolve_prepass_budget_s(
+                len(contracts), device_budget_s
+            )
+            t_overlap = time.perf_counter()
+            slots: List[Optional[Dict]] = [None] * len(contracts)
+            for i in order:
+                if time.perf_counter() - t_overlap > overlap_window_s:
+                    pre.drain()
+                code, creation_code, name = contracts[i]
                 outcome, device_ok = pre.outcome_for(i)
                 with pre.lock:
-                    results.append(
-                        _analyze_one(
-                            payload(
-                                code,
-                                creation_code,
-                                name,
-                                use_device and device_ok,
-                                outcome,
-                            )
+                    slots[i] = _analyze_one(
+                        payload(
+                            code,
+                            creation_code,
+                            name,
+                            use_device and device_ok,
+                            outcome,
                         )
                     )
                 pre.yield_lock()
+            results = slots
             prepass = pre.finish()
         else:
             if use_device:

@@ -35,7 +35,19 @@ def golden_corpus_run() -> List[Tuple[str, Dict]]:
     goldens are always checked under the settings they were made
     with. Returns [(fixture stem, result dict)] in fixture order."""
     from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.laser.smt.solver.solver import reset_blast_session
+    from mythril_tpu.support.model import clear_cache
 
+    # hermetic: get_model's memo is process-global and keyed on
+    # hash-consed term ids, so analyses run earlier in the same
+    # process (e.g. other test files with different budgets) would
+    # otherwise answer this run's queries with verdicts cached under
+    # THEIR budgets — the goldens must not depend on test order.
+    # (SymExecWrapper resets the blast session per contract already;
+    # the explicit reset here makes the hermetic intent self-contained
+    # rather than an inherited side effect.)
+    clear_cache()
+    reset_blast_session()
     files = sorted(GOLDEN_FIXTURES.glob("*.sol.o"))
     contracts = [(f.read_text().strip(), "", f.stem) for f in files]
     results = analyze_corpus(

@@ -69,6 +69,7 @@ def _load():
 SAT, UNSAT, UNKNOWN = 1, -1, 0
 
 _CHUNK = 20_000  # conflicts between wall-clock checks
+_SPRINT_CHUNK = 2_500  # finer valve granularity for conflict-budget mode
 
 
 def solve_flat(
@@ -147,9 +148,21 @@ class SolverSession:
             pass
 
     def solve(self, nvars: int, flat_clauses, units: List[int],
-              timeout_ms: Optional[int] = None):
+              timeout_ms: Optional[int] = None,
+              conflict_budget: Optional[int] = None):
         """Load the store delta and solve under `units` as assumptions.
-        Returns (status, bits) like solve_flat."""
+        Returns (status, bits) like solve_flat.
+
+        With `conflict_budget` the query gets at most that many CDCL
+        conflicts and then returns UNKNOWN — a machine-independent
+        bound (the same CNF + session state always produces the same
+        verdict), unlike the wall-clock deadline whose outcome shifts
+        with load. The sprint pass uses this so that run-to-run report
+        byte-stability does not depend on scheduler timing. A
+        `timeout_ms` passed alongside still acts as a safety valve
+        (checked between conflict chunks): determinism then holds for
+        every query the wall budget can cover at all — a query that
+        trips the valve would have ended as a marathon timeout anyway."""
         if self.poisoned:
             # a failed definitional load signals an internal blaster bug,
             # never real unsatisfiability: degrade to unknown so paths
@@ -174,8 +187,16 @@ class SolverSession:
         deadline = (
             None if timeout_ms is None else time.monotonic() + timeout_ms / 1000.0
         )
+        end_conflicts = (
+            None
+            if conflict_budget is None
+            else lib.cdcl_conflicts(s) + conflict_budget
+        )
+        chunk = _SPRINT_CHUNK if conflict_budget is not None else _CHUNK
         while True:
-            budget = lib.cdcl_conflicts(s) + _CHUNK
+            budget = lib.cdcl_conflicts(s) + chunk
+            if end_conflicts is not None:
+                budget = min(budget, end_conflicts)
             r = lib.cdcl_solve_assuming(s, budget, arr, len(units))
             if r == SAT:
                 out = (ctypes.c_ubyte * nvars)()
@@ -183,5 +204,7 @@ class SolverSession:
                 return SAT, bytearray(out)
             if r == UNSAT:
                 return UNSAT, None
+            if end_conflicts is not None and lib.cdcl_conflicts(s) >= end_conflicts:
+                return UNKNOWN, None
             if deadline is not None and time.monotonic() >= deadline:
                 return UNKNOWN, None

@@ -21,6 +21,7 @@ from the CNF assignment.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 from mythril_tpu.laser.smt import terms
@@ -252,76 +253,241 @@ def _push_chain(arr: Term, idx: Term) -> Term:
     return out
 
 
+# The elimination rewrite is context-free once fresh names are
+# CONTENT-keyed (a stable structural digest of the select index / UF
+# argument tuple) instead of query-positional: the rewrite of a
+# constraint no longer depends on which query it appears in, so it is
+# memoized process-wide. Path-prefix constraints — re-submitted by
+# every feasibility query along a walk — are eliminated exactly once
+# per run instead of once per query (measured ~30% of a budget-bound
+# contract's host wall before the cache). Per query, only the pairwise
+# read-/functional-consistency axioms and the Recon tables are
+# assembled, restricted to the apps that query actually references.
+#
+# Determinism: digests are stable across runs and machines, and every
+# per-query assembly below iterates apps in sorted-by-fresh-name
+# order, so CNF variable order — and therefore models and report
+# bytes — cannot drift with hash seeds or thread interleaving. (The
+# sprint being conflict-budgeted, solver.py, is the other half of
+# run-stability.)
+
+_ELIM_MEMO_MAX = 1 << 18
+
+_elim_memo: Dict[int, Term] = {}       # original node id -> rewritten
+_fresh_of_memo: Dict[int, frozenset] = {}  # rewritten id -> fresh names
+_digest_memo: Dict[int, str] = {}
+_sel_by_id: Dict[Tuple[str, int], str] = {}  # (array, idx id) -> fresh
+_uf_by_id: Dict[tuple, str] = {}
+_sel_info: Dict[str, Tuple[str, int, Term]] = {}  # fresh -> (arr, rw, idx)
+_uf_info: Dict[str, Tuple[str, int, Tuple[Term, ...]]] = {}
+_pair_axioms: Dict[Tuple[str, str], Term] = {}
+
+
+def _elim_bound() -> None:
+    """Bound cache growth. Content-keyed names make a full clear safe:
+    re-derived names are bit-identical, so dropping every cache at once
+    (registries included) only costs recomputation, never stability."""
+    if len(_elim_memo) > _ELIM_MEMO_MAX or len(_sel_info) + len(_uf_info) > _ELIM_MEMO_MAX:
+        _elim_memo.clear()
+        _fresh_of_memo.clear()
+        _digest_memo.clear()
+        _pair_axioms.clear()
+        _sel_by_id.clear()
+        _uf_by_id.clear()
+        _sel_info.clear()
+        _uf_info.clear()
+
+
+def _digest(t: Term) -> str:
+    """Stable structural digest (iterative post-order, memoized).
+
+    128 bits: fresh names derived from colliding digests would merge
+    two different select indices into one variable — a silent
+    soundness break on the unsat side — so the width is chosen to put
+    the birthday bound far below any realistic app count."""
+    got = _digest_memo.get(t._id)
+    if got is not None:
+        return got
+    stack = [(t, False)]
+    while stack:
+        cur, ready = stack.pop()
+        if cur._id in _digest_memo:
+            continue
+        if not ready:
+            stack.append((cur, True))
+            for a in cur.args:
+                if isinstance(a, Term) and a._id not in _digest_memo:
+                    stack.append((a, False))
+            continue
+        h = hashlib.blake2b(digest_size=16)
+        h.update(cur.op.encode())
+        h.update(repr((cur.sort.kind, cur.sort.width, cur.sort.range_width)).encode())
+        for a in cur.args:
+            if isinstance(a, Term):
+                h.update(_digest_memo[a._id].encode())
+            else:
+                h.update(repr(a).encode())
+            h.update(b"|")
+        _digest_memo[cur._id] = h.hexdigest()
+    return _digest_memo[t._id]
+
+
+def _fresh_select(arr: Term, idx: Term) -> Term:
+    name = arr.args[0]
+    key = (name, idx._id)
+    fresh = _sel_by_id.get(key)
+    if fresh is None:
+        fresh = f"sel!{name}!{_digest(idx)}"
+        _sel_by_id[key] = fresh
+        _sel_info.setdefault(fresh, (name, arr.sort.range_width, idx))
+    return terms.bv_var(fresh, arr.sort.range_width)
+
+
+def _fresh_uf(t: Term) -> Term:
+    name = t.args[0]
+    args = tuple(t.args[1:])
+    key = (name, t.width, tuple(a._id for a in args))
+    fresh = _uf_by_id.get(key)
+    if fresh is None:
+        # the uf term's own digest covers name, width and arg digests
+        fresh = f"uf!{name}!{_digest(t)}"
+        _uf_by_id[key] = fresh
+        _uf_info.setdefault(fresh, (name, t.width, args))
+    return terms.bv_var(fresh, t.width)
+
+
+def _rewrite(t: Term) -> Term:
+    got = _elim_memo.get(t._id)
+    if got is not None:
+        return got
+    new_args = tuple(
+        _rewrite(a) if isinstance(a, Term) else a for a in t.args
+    )
+    out = rebuild(t.op, new_args, t) if new_args != t.args else t
+    if out.op == "select":
+        arr, idx = out.args
+        if arr.op == "avar":
+            out = _fresh_select(arr, idx)
+        else:
+            out = _rewrite(_push_chain(arr, idx))
+    elif out.op == "uf":
+        out = _fresh_uf(out)
+    _elim_memo[t._id] = out
+    return out
+
+
+def _fresh_of(t: Term) -> frozenset:
+    """Fresh (sel!/uf!) var names appearing in a rewritten term."""
+    got = _fresh_of_memo.get(t._id)
+    if got is not None:
+        return got
+    stack = [(t, False)]
+    while stack:
+        cur, ready = stack.pop()
+        if cur._id in _fresh_of_memo:
+            continue
+        if not ready:
+            stack.append((cur, True))
+            for a in cur.args:
+                if isinstance(a, Term) and a._id not in _fresh_of_memo:
+                    stack.append((a, False))
+            continue
+        if cur.op == "var" and cur.args[0].startswith(("sel!", "uf!")):
+            _fresh_of_memo[cur._id] = frozenset((cur.args[0],))
+            continue
+        acc: frozenset = frozenset()
+        for a in cur.args:
+            if isinstance(a, Term):
+                child = _fresh_of_memo[a._id]
+                if child:
+                    acc = acc | child
+        _fresh_of_memo[cur._id] = acc
+    return _fresh_of_memo[t._id]
+
+
 def eliminate_uf_and_arrays(constraints: List[Term], recon: Recon) -> List[Term]:
     """Replace uf apps and base-array selects by fresh vars + axioms."""
-    side: List[Term] = []
-    memo: Dict[int, Term] = {}
-
-    def push_select(arr: Term, idx: Term) -> Term:
-        """Base-array select -> per-query fresh var + read-consistency
-        axioms (non-avar chains were already pushed by _push_chain)."""
-        if arr.op != "avar":
-            return walk(_push_chain(arr, idx))
-        name = arr.args[0]
-        apps = recon.sel_apps.setdefault(name, [])
-        for prev_idx, fresh in apps:
-            if prev_idx is idx:
-                return terms.bv_var(fresh, arr.sort.range_width)
-        fresh = f"sel!{name}!{len(apps)}"
-        out = terms.bv_var(fresh, arr.sort.range_width)
-        # read consistency vs every earlier select on this array
-        for prev_idx, prev_fresh in apps:
-            prev_out = terms.bv_var(prev_fresh, arr.sort.range_width)
-            side.append(
-                terms.implies(terms.eq(prev_idx, idx), terms.eq(prev_out, out))
-            )
-        apps.append((idx, fresh))
-        return out
-
-    def walk(t: Term) -> Term:
-        got = memo.get(t._id)
-        if got is not None:
-            return got
-        new_args = tuple(walk(a) if isinstance(a, Term) else a for a in t.args)
-        out = rebuild(t.op, new_args, t) if new_args != t.args else t
-        if out.op == "select":
-            out = walk(push_select(out.args[0], out.args[1]))
-        elif out.op == "uf":
-            name = out.args[0]
-            args = tuple(out.args[1:])
-            apps = recon.uf_apps.setdefault(name, [])
-            found = None
-            for prev_args, fresh in apps:
-                if prev_args == args:
-                    found = fresh
-                    break
-            if found is None:
-                found = f"uf!{name}!{len(apps)}"
-                new = terms.bv_var(found, out.width)
-                for prev_args, prev_fresh in apps:
-                    if len(prev_args) != len(args):
-                        continue
-                    same = terms.band(
-                        *[terms.eq(x, y) for x, y in zip(prev_args, args)]
-                    )
-                    prev_out = terms.bv_var(prev_fresh, out.width)
-                    side.append(terms.implies(same, terms.eq(prev_out, new)))
-                apps.append((args, found))
-            out = terms.bv_var(found, out.width)
-        memo[t._id] = out
-        return out
-
+    _elim_bound()
     import sys
 
     old = sys.getrecursionlimit()
     sys.setrecursionlimit(200000)
     try:
-        lowered = [walk(c) for c in constraints]
+        lowered = [_rewrite(c) for c in constraints]
     finally:
         sys.setrecursionlimit(old)
 
-    # side conditions may themselves contain selects/ufs (idx terms were
-    # already walked, so they are clean) — but eq() of walked terms is fine
+    # the apps THIS query references: fresh vars of the rewritten
+    # constraints, closed under "appears in a used app's index/args"
+    # (a nested select's fresh var lives only inside the outer app's
+    # index term, which re-enters the CNF through the axioms below)
+    used: set = set()
+    frontier: set = set()
+    for c in lowered:
+        frontier |= _fresh_of(c)
+    while frontier:
+        used |= frontier
+        nxt: set = set()
+        for f in frontier:
+            info = _sel_info.get(f)
+            if info is not None:
+                nxt |= _fresh_of(info[2])
+            else:
+                uinfo = _uf_info.get(f)
+                if uinfo is not None:
+                    for a in uinfo[2]:
+                        nxt |= _fresh_of(a)
+        frontier = nxt - used
+    if not used:
+        return lowered
+
+    side: List[Term] = []
+    for f in sorted(used):
+        info = _sel_info.get(f)
+        if info is not None:
+            recon.sel_apps.setdefault(info[0], []).append((info[2], f))
+        else:
+            name, _w, args = _uf_info[f]
+            recon.uf_apps.setdefault(name, []).append((args, f))
+    # pairwise read consistency per array (sorted app order: run-stable)
+    for arr_name in sorted(recon.sel_apps):
+        apps = recon.sel_apps[arr_name]
+        rw = _sel_info[apps[0][1]][1]
+        for i in range(1, len(apps)):
+            idx_i, f_i = apps[i]
+            for j in range(i):
+                idx_j, f_j = apps[j]
+                akey = (f_j, f_i)
+                ax = _pair_axioms.get(akey)
+                if ax is None:
+                    ax = terms.implies(
+                        terms.eq(idx_j, idx_i),
+                        terms.eq(terms.bv_var(f_j, rw), terms.bv_var(f_i, rw)),
+                    )
+                    _pair_axioms[akey] = ax
+                side.append(ax)
+    # pairwise functional consistency per UF
+    for uf_name in sorted(recon.uf_apps):
+        apps = recon.uf_apps[uf_name]
+        for i in range(1, len(apps)):
+            args_i, f_i = apps[i]
+            w = _uf_info[f_i][1]
+            for j in range(i):
+                args_j, f_j = apps[j]
+                if len(args_j) != len(args_i):
+                    continue
+                akey = (f_j, f_i)
+                ax = _pair_axioms.get(akey)
+                if ax is None:
+                    same = terms.band(
+                        *[terms.eq(x, y) for x, y in zip(args_j, args_i)]
+                    )
+                    ax = terms.implies(
+                        same,
+                        terms.eq(terms.bv_var(f_j, w), terms.bv_var(f_i, w)),
+                    )
+                    _pair_axioms[akey] = ax
+                side.append(ax)
     return lowered + side
 
 

@@ -33,11 +33,20 @@ inline int lit_var(int l) { return l >> 1; }
 inline bool lit_neg(int l) { return l & 1; }
 inline int lit_not(int l) { return l ^ 1; }
 
+// A watch entry carries a "blocker" literal (the other watched literal
+// at attach time): if the blocker is already true the clause is
+// satisfied and propagate skips the clause memory entirely — most
+// watch-list traffic resolves on this one cached int.
+struct Watcher {
+  Clause* c;
+  int blocker;
+};
+
 struct Solver {
   int nvars = 0;
   std::vector<Clause*> clauses;          // problem clauses
   std::vector<Clause*> learnts;          // learnt clauses
-  std::vector<std::vector<Clause*>> watches;  // watch lists per literal
+  std::vector<std::vector<Watcher>> watches;  // watch lists per literal
   std::vector<int8_t> assigns;           // -1 unset, 0 false, 1 true
   std::vector<int8_t> phase;             // saved phase
   std::vector<Clause*> reason;
@@ -165,16 +174,21 @@ struct Solver {
     while (qhead < trail.size()) {
       int p = trail[qhead++];
       propagations++;
-      std::vector<Clause*>& ws = watches[lit_not(p)];
+      std::vector<Watcher>& ws = watches[lit_not(p)];
       size_t i = 0, j = 0;
       while (i < ws.size()) {
-        Clause* c = ws[i++];
+        Watcher w = ws[i++];
+        if (value_lit(w.blocker) == 1) {  // satisfied via cached literal
+          ws[j++] = w;
+          continue;
+        }
+        Clause* c = w.c;
         if (c->deleted) continue;
         auto& lits = c->lits;
         // make sure lits[1] is the false literal (not-p)
         if (lits[0] == lit_not(p)) std::swap(lits[0], lits[1]);
         if (value_lit(lits[0]) == 1) {  // satisfied
-          ws[j++] = c;
+          ws[j++] = {c, lits[0]};
           continue;
         }
         // find new watch
@@ -182,14 +196,14 @@ struct Solver {
         for (size_t k = 2; k < lits.size(); k++) {
           if (value_lit(lits[k]) != 0) {
             std::swap(lits[1], lits[k]);
-            watches[lits[1]].push_back(c);
+            watches[lits[1]].push_back({c, lits[0]});
             found = true;
             break;
           }
         }
         if (found) continue;
         // unit or conflict
-        ws[j++] = c;
+        ws[j++] = {c, lits[0]};
         if (!enqueue(lits[0], c)) {
           // conflict: restore remaining watches
           while (i < ws.size()) ws[j++] = ws[i++];
@@ -211,12 +225,16 @@ struct Solver {
     }
   }
 
-  // 1UIP conflict analysis
+  // 1UIP conflict analysis. `seen` is persistent and cleared via
+  // `to_clear` — a full O(nvars) reset per conflict dominates analysis
+  // cost at bit-blasted sizes (hundreds of thousands of vars).
   std::vector<char> seen;
+  std::vector<int> to_clear;
   void analyze(Clause* confl, std::vector<int>& out_learnt, int& out_btlevel) {
     out_learnt.clear();
     out_learnt.push_back(0);  // slot for asserting literal
-    seen.assign(nvars, 0);
+    if ((int)seen.size() < nvars) seen.resize(nvars, 0);
+    to_clear.clear();
     int counter = 0;
     int p = -1;
     size_t idx = trail.size();
@@ -226,6 +244,7 @@ struct Solver {
         int v = lit_var(q);
         if (!seen[v] && level[v] > 0) {
           seen[v] = 1;
+          to_clear.push_back(v);
           bump_var(v);
           if (level[v] >= decision_level())
             counter++;
@@ -242,6 +261,31 @@ struct Solver {
       counter--;
     } while (counter > 0);
     out_learnt[0] = lit_not(p);
+
+    // conflict-clause minimization (basic self-subsumption): a learnt
+    // literal whose reason clause is entirely inside the learnt clause
+    // (tracked by the still-set `seen` flags) is implied by the rest
+    // and can be dropped — shorter learnts propagate more and earlier.
+    size_t jj = 1;
+    for (size_t k = 1; k < out_learnt.size(); k++) {
+      int v = lit_var(out_learnt[k]);
+      Clause* r = reason[v];
+      bool redundant = false;
+      if (r != nullptr) {
+        redundant = true;
+        for (size_t m = 0; m < r->lits.size(); m++) {
+          int lv = lit_var(r->lits[m]);
+          if (lv == v) continue;
+          if (!seen[lv] && level[lv] > 0) {
+            redundant = false;
+            break;
+          }
+        }
+      }
+      if (!redundant) out_learnt[jj++] = out_learnt[k];
+    }
+    out_learnt.resize(jj);
+    for (int v : to_clear) seen[v] = 0;
 
     // minimal backtrack level
     out_btlevel = 0;
@@ -298,8 +342,8 @@ struct Solver {
     c->lits = lits;
     c->learnt = learnt;
     (learnt ? learnts : clauses).push_back(c);
-    watches[lits[0]].push_back(c);
-    watches[lits[1]].push_back(c);
+    watches[lits[0]].push_back({c, lits[1]});
+    watches[lits[1]].push_back({c, lits[0]});
     return true;
   }
 
@@ -328,7 +372,7 @@ struct Solver {
         for (int widx = 0; widx < 2; widx++) {
           auto& ws = watches[c->lits[widx]];
           for (size_t k = 0; k < ws.size(); k++) {
-            if (ws[k] == c) {
+            if (ws[k].c == c) {
               ws[k] = ws.back();
               ws.pop_back();
               break;
