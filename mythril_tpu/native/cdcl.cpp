@@ -22,6 +22,7 @@ typedef int Lit;  // +-(var+1), DIMACS style externally; internal 2*v+sign
 
 struct Clause {
   float activity = 0.0f;
+  int lbd = 0;  // literal block distance at learn time (glue metric)
   bool learnt = false;
   bool deleted = false;
   bool keep_mark = false;
@@ -40,6 +41,7 @@ inline int lit_not(int l) { return l ^ 1; }
 struct Watcher {
   Clause* c;
   int blocker;
+  int is_bin;  // binary clause: blocker IS the other literal
 };
 
 struct Solver {
@@ -182,13 +184,32 @@ struct Solver {
           ws[j++] = w;
           continue;
         }
+        if (w.is_bin) {
+          // binary fast path: the blocker is the whole rest of the
+          // clause — unit-propagate it without touching the watch
+          // structure (Tseitin stores are ~2/3 binary clauses).
+          // Analyze expects reason->lits[0] to be the propagated
+          // literal; normalize before the clause becomes a reason.
+          ws[j++] = w;
+          if (w.c->lits[0] != w.blocker)
+            std::swap(w.c->lits[0], w.c->lits[1]);
+          if (!enqueue(w.blocker, w.c)) {
+            while (i < ws.size()) ws[j++] = ws[i++];
+            ws.resize(j);
+            qhead = trail.size();
+            return w.c;
+          }
+          continue;
+        }
+        // no deleted-clause check needed: reduce_db eagerly detaches a
+        // clause from both watch lists before freeing it, so a watcher
+        // can never reference a deleted clause
         Clause* c = w.c;
-        if (c->deleted) continue;
         auto& lits = c->lits;
         // make sure lits[1] is the false literal (not-p)
         if (lits[0] == lit_not(p)) std::swap(lits[0], lits[1]);
         if (value_lit(lits[0]) == 1) {  // satisfied
-          ws[j++] = {c, lits[0]};
+          ws[j++] = {c, lits[0], 0};
           continue;
         }
         // find new watch
@@ -196,14 +217,14 @@ struct Solver {
         for (size_t k = 2; k < lits.size(); k++) {
           if (value_lit(lits[k]) != 0) {
             std::swap(lits[1], lits[k]);
-            watches[lits[1]].push_back({c, lits[0]});
+            watches[lits[1]].push_back({c, lits[0], 0});
             found = true;
             break;
           }
         }
         if (found) continue;
         // unit or conflict
-        ws[j++] = {c, lits[0]};
+        ws[j++] = {c, lits[0], 0};
         if (!enqueue(lits[0], c)) {
           // conflict: restore remaining watches
           while (i < ws.size()) ws[j++] = ws[i++];
@@ -230,6 +251,8 @@ struct Solver {
   // cost at bit-blasted sizes (hundreds of thousands of vars).
   std::vector<char> seen;
   std::vector<int> to_clear;
+  std::vector<int64_t> lbd_stamp;  // level -> conflict counter stamp
+  int last_lbd = 0;  // LBD of the most recently analyzed clause
   void analyze(Clause* confl, std::vector<int>& out_learnt, int& out_btlevel) {
     out_learnt.clear();
     out_learnt.push_back(0);  // slot for asserting literal
@@ -287,6 +310,21 @@ struct Solver {
     out_learnt.resize(jj);
     for (int v : to_clear) seen[v] = 0;
 
+    // literal block distance: distinct decision levels in the learnt
+    // clause — glucose's predictor of clause usefulness. One linear
+    // pass over a conflict-stamped level array (no sort, consistent
+    // with the to_clear discipline above).
+    if (lbd_stamp.size() < (size_t)decision_level() + 1)
+      lbd_stamp.resize(decision_level() + 1, -1);
+    last_lbd = 0;
+    for (size_t k = 0; k < out_learnt.size(); k++) {
+      int lv = level[lit_var(out_learnt[k])];
+      if (lbd_stamp[lv] != conflicts) {
+        lbd_stamp[lv] = conflicts;
+        last_lbd++;
+      }
+    }
+
     // minimal backtrack level
     out_btlevel = 0;
     for (size_t k = 1; k < out_learnt.size(); k++)
@@ -342,23 +380,31 @@ struct Solver {
     c->lits = lits;
     c->learnt = learnt;
     (learnt ? learnts : clauses).push_back(c);
-    watches[lits[0]].push_back({c, lits[1]});
-    watches[lits[1]].push_back({c, lits[0]});
+    int bin = lits.size() == 2 ? 1 : 0;
+    watches[lits[0]].push_back({c, lits[1], bin});
+    watches[lits[1]].push_back({c, lits[0], bin});
     return true;
   }
 
   void reduce_db() {
-    // drop the least active half of learnt clauses (keep reasons/binary)
+    // glucose-style: drop the half of learnt clauses with the worst
+    // (highest) LBD, activity as tie-break; keep glue clauses
+    // (lbd <= 2), binaries, and reason clauses
     std::vector<Clause*> sorted = learnts;
-    std::sort(sorted.begin(), sorted.end(),
-              [](Clause* a, Clause* b) { return a->activity < b->activity; });
+    std::sort(sorted.begin(), sorted.end(), [](Clause* a, Clause* b) {
+      if (a->lbd != b->lbd) return a->lbd > b->lbd;
+      return a->activity < b->activity;
+    });
     size_t target = sorted.size() / 2;
     for (int v = 0; v < nvars; v++)
       if (assigns[v] >= 0 && reason[v] && reason[v]->learnt) reason[v]->keep_mark = 1;
     size_t removed = 0;
     for (auto* c : sorted) {
       if (removed >= target) break;
-      if (c->lits.size() <= 2 || c->keep_mark) { c->keep_mark = 0; continue; }
+      if (c->lits.size() <= 2 || c->lbd <= 2 || c->keep_mark) {
+        c->keep_mark = 0;
+        continue;
+      }
       c->deleted = true;
       removed++;
     }
@@ -426,6 +472,7 @@ struct Solver {
           if (!ok) return -1;  // unit learnt conflicted at level 0: UNSAT
           if (learnt_clause.size() > 1) {
             // clause watched; assert first literal
+            learnts.back()->lbd = last_lbd;
             enqueue(learnt_clause[0], learnts.back());
           }
           var_inc *= 1.0 / 0.95;
