@@ -67,10 +67,19 @@ class OverUnderflowAnnotation:
 
 
 class OverUnderflowStateAnnotation(StateAnnotation):
-    """State annotation: wraps both possible and used on this path."""
+    """State annotation: wraps both possible and used on this path.
+
+    The taint collection is an insertion-ordered identity set (a dict
+    used for its keys): annotation objects hash by identity, so a
+    plain `set` iterates in memory-address order — which varies run to
+    run with allocator layout, letting a different taint win the
+    per-address issue dedupe and drift the reported witness. Dict key
+    order is insertion order: deterministic."""
 
     def __init__(self) -> None:
-        self.overflowing_state_annotations: Set[OverUnderflowAnnotation] = set()
+        self.overflowing_state_annotations: Dict[
+            OverUnderflowAnnotation, None
+        ] = {}
 
     def __copy__(self):
         twin = OverUnderflowStateAnnotation()
@@ -109,7 +118,7 @@ def _promote_taints(state: GlobalState, value) -> None:
     flow = _flow_annotation(state)
     for taint in value.annotations:
         if isinstance(taint, OverUnderflowAnnotation):
-            flow.overflowing_state_annotations.add(taint)
+            flow.overflowing_state_annotations[taint] = None
 
 
 class IntegerArithmetics(DetectionModule):

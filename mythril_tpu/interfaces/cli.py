@@ -262,6 +262,17 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--deterministic-solving",),
+        dict(
+            action="store_true",
+            help=(
+                "Conflict-budget solver marathons so reports are "
+                "reproducible across machines and load (slightly less "
+                "complete on hard queries than pure wall budgets)"
+            ),
+        ),
+    ),
+    (
         ("--sparse-pruning",),
         dict(
             action="store_true",
@@ -733,6 +744,7 @@ def _run_analyze(disassembler, address, args):
         device_prepass=args.device_prepass,
         device_solving=args.device_solving,
         device_prepass_budget=args.device_prepass_budget,
+        deterministic_solving=args.deterministic_solving,
     )
 
     if not disassembler.contracts:

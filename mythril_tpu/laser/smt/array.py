@@ -18,7 +18,7 @@ class BaseArray:
     raw: terms.Term
 
     def __getitem__(self, item: BitVec) -> BitVec:
-        return BitVec(terms.select(self.raw, item.raw), set(item.annotations))
+        return BitVec(terms.select(self.raw, item.raw), item.annotations)
 
     def __setitem__(self, key: BitVec, value: BitVec) -> None:
         self.raw = terms.store(self.raw, key.raw, value.raw)

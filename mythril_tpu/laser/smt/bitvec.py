@@ -12,7 +12,7 @@ from typing import Optional, Set, Union
 
 from mythril_tpu.laser.smt import terms
 from mythril_tpu.laser.smt.bool import Bool
-from mythril_tpu.laser.smt.expression import Expression
+from mythril_tpu.laser.smt.expression import Expression, OrderedSet
 
 
 def _coerce(other, width: int) -> terms.Term:
@@ -23,8 +23,8 @@ def _coerce(other, width: int) -> terms.Term:
     raise TypeError(f"cannot coerce {type(other)} to BitVec")
 
 
-def _anns(a, b) -> Set:
-    out = set(a.annotations)
+def _anns(a, b) -> "OrderedSet":
+    out = a.annotations.copy()
     if isinstance(b, Expression):
         out |= b.annotations
     return out
@@ -86,7 +86,7 @@ class BitVec(Expression):
     __rxor__ = __xor__
 
     def __invert__(self) -> "BitVec":
-        return BitVec(terms.bvnot(self.raw), set(self.annotations))
+        return BitVec(terms.bvnot(self.raw), self.annotations)
 
     def __lshift__(self, other) -> "BitVec":
         return BitVec(terms.shl(self.raw, _coerce(other, self.size())), _anns(self, other))
@@ -97,7 +97,7 @@ class BitVec(Expression):
 
     def __neg__(self) -> "BitVec":
         return BitVec(
-            terms.sub(terms.bv_const(0, self.size()), self.raw), set(self.annotations)
+            terms.sub(terms.bv_const(0, self.size()), self.raw), self.annotations
         )
 
     # -- comparisons (signed, matching z3 defaults) -----------------------

@@ -20,7 +20,7 @@ def _both(a: BitVec, b) -> tuple:
 def If(cond: Union[Bool, bool], a: Union[BitVec, int], b: Union[BitVec, int]):
     if isinstance(cond, bool):
         cond = Bool(terms.bool_const(cond))
-    anns = set(cond.annotations)
+    anns = cond.annotations.copy()
     if isinstance(a, BitVec):
         width = a.size()
     elif isinstance(b, BitVec):
@@ -69,7 +69,7 @@ def Concat(*args) -> BitVec:
     if len(args) == 1 and isinstance(args[0], list):
         args = tuple(args[0])
     raw = args[0].raw
-    anns = set(args[0].annotations)
+    anns = args[0].annotations.copy()
     for a in args[1:]:
         raw = terms.concat(raw, a.raw)
         anns |= a.annotations
@@ -77,15 +77,15 @@ def Concat(*args) -> BitVec:
 
 
 def Extract(high: int, low: int, bv: BitVec) -> BitVec:
-    return BitVec(terms.extract(high, low, bv.raw), set(bv.annotations))
+    return BitVec(terms.extract(high, low, bv.raw), bv.annotations)
 
 
 def ZeroExt(extra: int, bv: BitVec) -> BitVec:
-    return BitVec(terms.zext(bv.raw, extra), set(bv.annotations))
+    return BitVec(terms.zext(bv.raw, extra), bv.annotations)
 
 
 def SignExt(extra: int, bv: BitVec) -> BitVec:
-    return BitVec(terms.sext(bv.raw, extra), set(bv.annotations))
+    return BitVec(terms.sext(bv.raw, extra), bv.annotations)
 
 
 def UDiv(a: BitVec, b) -> BitVec:
@@ -110,7 +110,7 @@ def LShR(a: BitVec, b) -> BitVec:
 
 def Sum(*args: BitVec) -> BitVec:
     raw = args[0].raw
-    anns = set(args[0].annotations)
+    anns = args[0].annotations.copy()
     for a in args[1:]:
         raw = terms.add(raw, a.raw)
         anns |= a.annotations

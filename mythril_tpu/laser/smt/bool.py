@@ -91,7 +91,7 @@ def Or(*args: Union[Bool, bool]) -> Bool:
 
 
 def Not(a: Bool) -> Bool:
-    return Bool(terms.bnot(a.raw), set(a.annotations))
+    return Bool(terms.bnot(a.raw), a.annotations)
 
 
 def Xor(a: Bool, b: Bool) -> Bool:

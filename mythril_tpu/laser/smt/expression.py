@@ -7,20 +7,90 @@ tracking by detection modules). Here the payload is our own `Term`.
 
 from __future__ import annotations
 
-from typing import Optional, Set
+from typing import Iterable, Optional
 
 from mythril_tpu.laser.smt import terms
+
+
+class OrderedSet:
+    """Identity set with deterministic (insertion) iteration order.
+
+    Annotations hash by object identity, so a plain `set` iterates in
+    memory-address order — which varies run to run with allocator
+    layout. Detection modules iterate annotation sets to pick issue
+    witnesses, so that disorder leaks into which taint wins a dedupe
+    race and drifts report bytes. A dict's keys give set semantics
+    with insertion order."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, items: Iterable = ()):
+        self._d = dict.fromkeys(items)
+
+    def add(self, item) -> None:
+        self._d[item] = None
+
+    def update(self, items) -> None:
+        for x in items:
+            self._d[x] = None
+
+    def copy(self) -> "OrderedSet":
+        return OrderedSet(self._d)
+
+    def union(self, *others) -> "OrderedSet":
+        out = OrderedSet(self._d)
+        for o in others:
+            out.update(o)
+        return out
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __contains__(self, item) -> bool:
+        return item in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __bool__(self) -> bool:
+        return bool(self._d)
+
+    def __or__(self, other) -> "OrderedSet":
+        out = OrderedSet(self._d)
+        out.update(other)
+        return out
+
+    def __ror__(self, other) -> "OrderedSet":
+        out = OrderedSet(other)
+        out.update(self._d)
+        return out
+
+    def __ior__(self, other) -> "OrderedSet":
+        self.update(other)
+        return self
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, OrderedSet):
+            return set(self._d) == set(other._d)
+        if isinstance(other, (set, frozenset)):
+            return set(self._d) == other
+        return NotImplemented
+
+    def __repr__(self):
+        return f"OrderedSet({list(self._d)!r})"
 
 
 class Expression:
     """A symbolic expression: immutable term + mutable annotation set."""
 
-    def __init__(self, raw: terms.Term, annotations: Optional[Set] = None):
+    def __init__(self, raw: terms.Term, annotations: Optional[Iterable] = None):
         self.raw = raw
-        self._annotations = set(annotations) if annotations else set()
+        self._annotations = (
+            OrderedSet(annotations) if annotations is not None else OrderedSet()
+        )
 
     @property
-    def annotations(self) -> Set:
+    def annotations(self) -> OrderedSet:
         return self._annotations
 
     def annotate(self, annotation) -> None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import List, Union
 
 from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.expression import OrderedSet
 from mythril_tpu.laser.smt.bitvec import BitVec
 
 
@@ -22,7 +23,7 @@ class Function:
         self.range = value_range
 
     def __call__(self, *items: BitVec) -> BitVec:
-        anns = set()
+        anns = OrderedSet()
         for i in items:
             anns |= i.annotations
         return BitVec(

@@ -103,46 +103,91 @@ class Optimize(BaseSolver):
     def maximize(self, element: BitVec) -> None:
         self.objectives.append((element.raw, False))
 
+    #: fixed per-step budgets and emergency aggregate stop for
+    #: objective refinement. These are deliberately NOT derived from
+    #: the analysis' remaining execution time: a load-dependent
+    #: refinement deadline made the minimized witness (e.g. the
+    #: reported calldata length) vary run to run — the refinement
+    #: schedule must be a pure function of the query. Steps are
+    #: conflict-budgeted (deterministic); the ms value is only the
+    #: wall valve, sized so a typical step's conflicts finish far
+    #: inside it.
+    REFINE_STEP_CONFLICTS = 250_000
+    REFINE_STEP_MS = 10_000
+    REFINE_EMERGENCY_S = 10.0
+
     @stat_smt_query
     def check(self, *extra) -> str:
         base = self.constraints + self._norm(extra)
         self._model = None
-        deadline = time.monotonic() + self.timeout / 1000.0
+        caller_deadline = time.monotonic() + self.timeout / 1000.0
         status, model = check_terms(base, timeout_ms=self.timeout)
         if status != sat:
             return status
         # refine objectives one at a time (lexicographic, like z3's default)
         constraints = list(base)
         for obj, is_min in self.objectives:
-            budget_ms = max(200, int((deadline - time.monotonic()) * 1000))
-            model = self._refine(constraints, obj, is_min, model, budget_ms)
+            model = self._refine(constraints, obj, is_min, model, caller_deadline)
             constraints.append(
                 terms.eq(obj, terms.bv_const(eval_term(obj, model.assignment), obj.width))
             )
         self._model = model
         return sat
 
-    @staticmethod
+    @classmethod
     def _refine(
+        cls,
         constraints: List[terms.Term],
         obj: terms.Term,
         is_min: bool,
         model: Model,
-        budget_ms: int,
+        caller_deadline: float,
     ) -> Model:
-        """Binary search the objective value downward (or upward)."""
-        deadline = time.monotonic() + budget_ms / 1000.0
+        """Binary search the objective value downward (or upward).
+
+        Default mode respects the caller's wall deadline (the query
+        timeout, itself clamped to the analysis' remaining execution
+        budget). Under --deterministic-solving the schedule is instead
+        a pure function of the query — convergence under an iteration
+        cap with fixed conflict-budgeted steps — so the minimized
+        witness cannot vary with machine load; the fixed emergency
+        stop then only exists for pathological objectives, and the
+        trade (an Optimize may run up to REFINE_EMERGENCY_S per
+        objective past its wall share) is what the flag opts into."""
+        from mythril_tpu.support.support_args import args as _args
+
+        deterministic = _args.deterministic_solving
+        deadline = (
+            time.monotonic() + cls.REFINE_EMERGENCY_S
+            if deterministic
+            else caller_deadline
+        )
         best = eval_term(obj, model.assignment)
         lo, hi = (0, best) if is_min else (best, (1 << obj.width) - 1)
-        while lo < hi and time.monotonic() < deadline:
+        iters = 0
+        while lo < hi and iters <= obj.width + 2:
+            if time.monotonic() >= deadline:
+                break
+            iters += 1
             mid = (lo + hi) // 2 if is_min else (lo + hi + 1) // 2
             bound = (
                 terms.ule(obj, terms.bv_const(mid, obj.width))
                 if is_min
                 else terms.ule(terms.bv_const(mid, obj.width), obj)
             )
-            ms = max(100, int((deadline - time.monotonic()) * 1000))
-            status, candidate = check_terms(constraints + [bound], timeout_ms=ms)
+            if deterministic:
+                step_ms = cls.REFINE_STEP_MS
+                step_conflicts = cls.REFINE_STEP_CONFLICTS
+            else:
+                step_ms = max(
+                    100, int((deadline - time.monotonic()) * 1000)
+                )
+                step_conflicts = None
+            status, candidate = check_terms(
+                constraints + [bound],
+                timeout_ms=step_ms,
+                conflict_budget=step_conflicts,
+            )
             if status == sat:
                 model = candidate
                 best = eval_term(obj, candidate.assignment)
@@ -290,8 +335,15 @@ def device_solving_enabled() -> bool:
 
 
 def check_terms(
-    raw_constraints: List[terms.Term], timeout_ms: int = 10_000
+    raw_constraints: List[terms.Term],
+    timeout_ms: int = 10_000,
+    conflict_budget: Optional[int] = None,
 ) -> (str, Optional[Model]):
+    """Decide a constraint set. With `conflict_budget` the MARATHON is
+    also conflict-capped (the sprint always is), so the verdict is a
+    pure function of the query whenever the wall valve doesn't fire —
+    callers that must be reproducible (objective refinement) pass a
+    budget sized to finish well inside their wall allowance."""
     t_total = time.monotonic()
     lowered, recon = lower(raw_constraints)
     if any(c is terms.FALSE for c in lowered):
@@ -342,9 +394,13 @@ def check_terms(
     if status == native_sat.UNSAT:
         return unsat, None
 
+    from mythril_tpu.support.support_args import args as _glob_args
+
+    deterministic = _glob_args.deterministic_solving
     device_tried = False
     if (
         status == native_sat.UNKNOWN
+        and not deterministic  # device search timing is load-variable
         and device_solving_enabled()
         and len(lowered) >= 2
         and _device_gate.open()
@@ -363,11 +419,25 @@ def check_terms(
         _device_gate.miss(time.monotonic() - t_dev)
 
     if status == native_sat.UNKNOWN:
-        remaining = max(
-            200, timeout_ms - int((time.monotonic() - t_total) * 1000)
-        )
+        if conflict_budget is None and deterministic:
+            # budget sized to bind BEFORE the wall even at the slowest
+            # observed conflict rate on bit-blasted CNFs (~10k/s), so
+            # the verdict is load-independent; only queries slower
+            # than ~8k conflicts/s still fall to the wall valve
+            conflict_budget = timeout_ms * 8
+        if deterministic:
+            # the valve must not inherit the sprint's (load-variable)
+            # wall consumption, or a hard query flips verdicts under
+            # load — the budget above is the binding constraint, the
+            # full caller budget the emergency stop (worst ≤2× wall)
+            remaining = timeout_ms
+        else:
+            remaining = max(
+                200, timeout_ms - int((time.monotonic() - t_total) * 1000)
+            )
         status, bits = native_session.solve(
-            blaster.nvars, blaster.flat, units, remaining
+            blaster.nvars, blaster.flat, units, remaining,
+            conflict_budget=conflict_budget,
         )
     if status == native_sat.UNSAT:
         return unsat, None

@@ -253,6 +253,57 @@ struct Solver {
   std::vector<int> to_clear;
   std::vector<int64_t> lbd_stamp;  // level -> conflict counter stamp
   int last_lbd = 0;  // LBD of the most recently analyzed clause
+  std::vector<int> analyze_stack;  // DFS stack for lit_redundant
+
+  uint32_t abstract_level(int v) { return 1u << (level[v] & 31); }
+
+  // distinct decision levels among lits, via a stamped level array
+  // (one linear pass, no sort). Callers pass distinct stamps so the
+  // pre- and post-minimization counts within one conflict don't
+  // collide: pre-pass stamps are negative, final stamps positive.
+  int count_levels(const std::vector<int>& lits, int64_t stamp) {
+    if (lbd_stamp.size() < (size_t)decision_level() + 1)
+      lbd_stamp.resize(decision_level() + 1, -1);
+    int n = 0;
+    for (size_t k = 0; k < lits.size(); k++) {
+      int lv = level[lit_var(lits[k])];
+      if (lbd_stamp[lv] != stamp) {
+        lbd_stamp[lv] = stamp;
+        n++;
+      }
+    }
+    return n;
+  }
+
+  // Is p implied by the still-seen learnt literals (+ level 0)? DFS
+  // over reasons; marks proven-redundant vars seen (kept on success,
+  // rolled back past `top` on failure). Terminates because each var
+  // is pushed at most once (marked seen when pushed).
+  bool lit_redundant(int p0, uint32_t abstract_levels) {
+    analyze_stack.clear();
+    analyze_stack.push_back(p0);
+    size_t top = to_clear.size();
+    while (!analyze_stack.empty()) {
+      int p = analyze_stack.back();
+      analyze_stack.pop_back();
+      Clause* r = reason[lit_var(p)];
+      for (size_t k = 1; k < r->lits.size(); k++) {
+        int q = r->lits[k];
+        int v = lit_var(q);
+        if (seen[v] || level[v] == 0) continue;
+        if (reason[v] == nullptr || !(abstract_level(v) & abstract_levels)) {
+          for (size_t j = top; j < to_clear.size(); j++)
+            seen[to_clear[j]] = 0;
+          to_clear.resize(top);
+          return false;
+        }
+        seen[v] = 1;
+        to_clear.push_back(v);
+        analyze_stack.push_back(q);
+      }
+    }
+    return true;
+  }
   void analyze(Clause* confl, std::vector<int>& out_learnt, int& out_btlevel) {
     out_learnt.clear();
     out_learnt.push_back(0);  // slot for asserting literal
@@ -285,45 +336,55 @@ struct Solver {
     } while (counter > 0);
     out_learnt[0] = lit_not(p);
 
-    // conflict-clause minimization (basic self-subsumption): a learnt
-    // literal whose reason clause is entirely inside the learnt clause
-    // (tracked by the still-set `seen` flags) is implied by the rest
-    // and can be dropped — shorter learnts propagate more and earlier.
+    // deep conflict-clause minimization (MiniSat ccmin): a learnt
+    // literal is dropped when every reason-DFS path from it bottoms
+    // out in other learnt literals (seen) or level 0 — the abstract
+    // level mask prunes branches that reach a decision level the
+    // learnt clause does not contain. Shorter learnts propagate more
+    // and earlier.
     size_t jj = 1;
-    for (size_t k = 1; k < out_learnt.size(); k++) {
-      int v = lit_var(out_learnt[k]);
-      Clause* r = reason[v];
-      bool redundant = false;
-      if (r != nullptr) {
-        redundant = true;
-        for (size_t m = 0; m < r->lits.size(); m++) {
-          int lv = lit_var(r->lits[m]);
-          if (lv == v) continue;
-          if (!seen[lv] && level[lv] > 0) {
-            redundant = false;
-            break;
+    if (count_levels(out_learnt, -conflicts - 2) <= 6) {
+      // deep mode pays on low-LBD clauses (glucose's gate: high
+      // redundancy, bounded DFS); on scattered ones the reason-DFS
+      // cost per conflict outruns the propagation it saves —
+      // measured: ungated deep mode took a mul-heavy fixture from
+      // 23.8s to 16.2s but pushed a branch-heavy one from
+      // convergence back over its budget
+      uint32_t abstract_levels = 0;
+      for (size_t k = 1; k < out_learnt.size(); k++)
+        abstract_levels |= abstract_level(lit_var(out_learnt[k]));
+      for (size_t k = 1; k < out_learnt.size(); k++) {
+        int v = lit_var(out_learnt[k]);
+        if (reason[v] == nullptr ||
+            !lit_redundant(out_learnt[k], abstract_levels))
+          out_learnt[jj++] = out_learnt[k];
+      }
+    } else {
+      // basic self-subsumption: drop a literal whose whole reason
+      // clause is already inside the learnt set
+      for (size_t k = 1; k < out_learnt.size(); k++) {
+        int v = lit_var(out_learnt[k]);
+        Clause* r = reason[v];
+        bool redundant = false;
+        if (r != nullptr) {
+          redundant = true;
+          for (size_t m = 1; m < r->lits.size(); m++) {
+            int lv = lit_var(r->lits[m]);
+            if (!seen[lv] && level[lv] > 0) {
+              redundant = false;
+              break;
+            }
           }
         }
+        if (!redundant) out_learnt[jj++] = out_learnt[k];
       }
-      if (!redundant) out_learnt[jj++] = out_learnt[k];
     }
     out_learnt.resize(jj);
     for (int v : to_clear) seen[v] = 0;
 
     // literal block distance: distinct decision levels in the learnt
-    // clause — glucose's predictor of clause usefulness. One linear
-    // pass over a conflict-stamped level array (no sort, consistent
-    // with the to_clear discipline above).
-    if (lbd_stamp.size() < (size_t)decision_level() + 1)
-      lbd_stamp.resize(decision_level() + 1, -1);
-    last_lbd = 0;
-    for (size_t k = 0; k < out_learnt.size(); k++) {
-      int lv = level[lit_var(out_learnt[k])];
-      if (lbd_stamp[lv] != conflicts) {
-        lbd_stamp[lv] = conflicts;
-        last_lbd++;
-      }
-    }
+    // clause — glucose's predictor of clause usefulness
+    last_lbd = count_levels(out_learnt, conflicts);
 
     // minimal backtrack level
     out_btlevel = 0;

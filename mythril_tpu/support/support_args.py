@@ -24,6 +24,14 @@ class Args(object, metaclass=Singleton):
         self.device_prepass = "auto"  # device symbolic exploration prepass
         self.device_prepass_lanes = 128  # lanes per prepass wave
         self.device_prepass_budget = 12.0  # prepass wall-clock cap (s)
+        # Reproducible-report mode (CLI --deterministic-solving; the
+        # golden harness pins it): marathon solves get a conflict
+        # budget derived from the query timeout instead of running to
+        # the wall, so verdicts — and therefore reports — are a pure
+        # function of the input whenever the wall valve doesn't fire.
+        # Off by default: the wall-budget marathon squeezes more sat
+        # answers out of fast queries (completeness-first).
+        self.deterministic_solving = False
 
 
 args = Args()
