@@ -1,0 +1,143 @@
+"""Determinism regressions: report bytes must be a pure function of
+the input.
+
+Two properties are pinned here:
+
+1. **Annotation iteration order is insertion order.** Taint
+   annotations hash by object identity; iterating a plain `set` of
+   them follows allocator addresses, which vary run to run. The
+   integer module's issue dedupe picks whichever taint it sees first,
+   so allocator order leaked into report bytes (observed: a witness
+   calldata length oscillating 37/48 across identical runs).
+   `OrderedSet` (laser/smt/expression.py) replaces the plain set.
+
+2. **Conflict-budgeted solving.** The sprint always, and under
+   `--deterministic-solving` the marathon and objective refinement
+   too, are budgeted in CDCL conflicts — the same query stream gives
+   the same verdicts on any machine at any load.
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.laser.smt import symbol_factory
+from mythril_tpu.laser.smt.expression import OrderedSet
+
+
+class _Tag:
+    """Identity-hashed annotation stand-in."""
+
+
+def test_ordered_set_is_insertion_ordered():
+    tags = [_Tag() for _ in range(64)]
+    s = OrderedSet()
+    for t in tags:
+        s.add(t)
+        s.add(t)  # re-add must not move it
+    assert list(s) == tags
+    assert len(s) == 64
+
+
+def test_ordered_set_union_preserves_order():
+    a, b, c, d = _Tag(), _Tag(), _Tag(), _Tag()
+    left = OrderedSet([a, b])
+    right = OrderedSet([c, b, d])
+    merged = left | right
+    assert list(merged) == [a, b, c, d]
+    left |= right
+    assert list(left) == [a, b, c, d]
+    assert OrderedSet([a]).union([b], [c]) == {a, b, c}
+    assert list(OrderedSet([a]).union([b], [c])) == [a, b, c]
+
+
+def test_ordered_set_equals_plain_set():
+    a, b = _Tag(), _Tag()
+    assert OrderedSet([a, b]) == {b, a}
+    assert OrderedSet([a]) != {a, b}
+
+
+def test_annotations_propagate_in_insertion_order():
+    """Binary ops union annotations left-to-right, deterministically."""
+    x = symbol_factory.BitVecSym("detx", 256)
+    y = symbol_factory.BitVecSym("dety", 256)
+    tx, ty = _Tag(), _Tag()
+    x.annotate(tx)
+    y.annotate(ty)
+    assert list((x + y).annotations) == [tx, ty]
+    assert list((y + x).annotations) == [ty, tx]
+    from mythril_tpu.laser.smt import Concat, Extract
+
+    assert list(Concat(x, y).annotations) == [tx, ty]
+    assert list(Extract(7, 0, x + y).annotations) == [tx, ty]
+
+
+def test_integer_module_taint_collection_is_ordered():
+    from mythril_tpu.analysis.module.modules.integer import (
+        OverUnderflowStateAnnotation,
+    )
+
+    flow = OverUnderflowStateAnnotation()
+    tags = [_Tag() for _ in range(16)]
+    for t in tags:
+        flow.overflowing_state_annotations[t] = None
+    assert list(flow.overflowing_state_annotations) == tags
+    from copy import copy
+
+    twin = copy(flow)
+    assert list(twin.overflowing_state_annotations) == tags
+    twin.overflowing_state_annotations[_Tag()] = None
+    assert len(flow.overflowing_state_annotations) == 16  # copy detached
+
+
+def test_sprint_and_deterministic_marathon_budgets(monkeypatch):
+    """Behavioral pin on the conflict-budget discipline: the sprint
+    always passes a conflict budget to the native session, and under
+    --deterministic-solving the MARATHON does too (timeout_ms * 8),
+    with the full caller budget as its wall valve rather than the
+    sprint-depleted remainder. The sprint's verdict is forced to
+    UNKNOWN so the query genuinely falls through to the marathon
+    branch."""
+    from mythril_tpu.laser.smt import terms
+    from mythril_tpu.laser.smt.solver import native_sat
+    from mythril_tpu.laser.smt.solver import solver as S
+    from mythril_tpu.support.support_args import args
+
+    calls = []
+    real_solve = native_sat.SolverSession.solve
+
+    def recording(self, nvars, flat, units, timeout_ms=None, conflict_budget=None):
+        calls.append((timeout_ms, conflict_budget))
+        if len(calls) % 2 == 1:
+            # force the sprint to "not finished" so the query genuinely
+            # falls through to the marathon branch under test
+            return native_sat.UNKNOWN, None
+        return real_solve(
+            self, nvars, flat, units,
+            timeout_ms=timeout_ms, conflict_budget=conflict_budget,
+        )
+
+    monkeypatch.setattr(native_sat.SolverSession, "solve", recording)
+    monkeypatch.setattr(args, "deterministic_solving", True)
+    S.reset_blast_session()
+
+    x = terms.bv_var("detmode_x", 64)
+    query = [
+        terms.ult(terms.bv_const(10, 64), x),
+        terms.ult(x, terms.bv_const(100, 64)),
+    ]
+    status, model = S.check_terms(query, timeout_ms=10_000)
+    assert status == "sat"
+    xv = model.assignment.get("detmode_x")
+    assert xv is not None and 10 < xv < 100
+
+    # call 1: the sprint, conflict-budgeted with the module constant;
+    # call 2: the deterministic marathon with budget timeout_ms*8 and
+    # the FULL caller wall valve (not the sprint-depleted remainder)
+    assert len(calls) == 2, calls
+    assert calls[0][1] == S.SPRINT_CONFLICTS
+    assert calls[1][1] == 10_000 * 8
+    assert calls[1][0] == 10_000
+
+    # and the verdict repeats bit-identically
+    status2, model2 = S.check_terms(query, timeout_ms=10_000)
+    assert status2 == "sat"
+    assert model2.assignment.get("detmode_x") == xv
