@@ -106,7 +106,7 @@ def test_sprint_and_deterministic_marathon_budgets(monkeypatch):
 
     def recording(self, nvars, flat, units, timeout_ms=None, conflict_budget=None):
         calls.append((timeout_ms, conflict_budget))
-        if len(calls) % 2 == 1:
+        if conflict_budget == S.SPRINT_CONFLICTS:
             # force the sprint to "not finished" so the query genuinely
             # falls through to the marathon branch under test
             return native_sat.UNKNOWN, None
