@@ -66,6 +66,27 @@ def test_analyze_detects_selfdestruct_text():
     assert "[ATTACKER]" in out.stdout
 
 
+def test_analyze_deterministic_solving_flag():
+    """--deterministic-solving must produce the same report as the
+    default on a converging contract, byte-for-byte across two runs."""
+    args = (
+        "analyze",
+        "-c",
+        "33ff",
+        "--bin-runtime",
+        "--no-onchain-data",
+        "--deterministic-solving",
+        "-t",
+        "1",
+        "--execution-timeout",
+        "60",
+    )
+    first = run_myth(*args)
+    second = run_myth(*args)
+    assert "SWC ID: 106" in first.stdout
+    assert first.stdout == second.stdout
+
+
 def test_analyze_json_output():
     out = run_myth(
         "analyze",
