@@ -36,8 +36,12 @@ class DependenceMap:
     def add_condition(self, condition: terms.Term) -> None:
         # dependence_symbols includes UF names: constraints sharing an
         # uninterpreted function (e.g. keccak) must land in one bucket
-        # or functional consistency is lost across sub-queries
-        names = terms.dependence_symbols(condition)
+        # or functional consistency is lost across sub-queries.
+        # Sorted: set iteration order follows string hash seeds, and
+        # bucket-merge order must not vary across runs (bucket CONTENTS
+        # are order-independent, but the bucket list order — and with
+        # it solve order and session state — is not).
+        names = sorted(terms.dependence_symbols(condition))
         touched: List[_Bucket] = []
         for name in names:
             b = self.variable_map.get(name)
@@ -50,7 +54,7 @@ class DependenceMap:
         else:
             bucket = self._merge_buckets(touched)
         bucket.conditions.append(condition)
-        bucket.variables |= names
+        bucket.variables.update(names)
         if bucket not in self.buckets:
             self.buckets.append(bucket)
         for name in names:
