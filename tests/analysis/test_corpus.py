@@ -38,23 +38,31 @@ def test_corpus_contains_worker_errors_not_raises():
     assert results[0]["error"] is not None
 
 
+#: gated assert: INVALID only when calldata byte 0 == 0x42 — a host
+#: walk at a tiny budget won't prove it, the device wave will
+_GATED_FAIL = bytes(
+    [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
+     0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
+     0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
+     0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
+     0x00, 0x5B, 0xFE]  # STOP; JUMPDEST; ASSERT_FAIL
+).hex()
+
+_DEVICE_CONTRACTS = [
+    ("600035600757005bfe", "", "PlainAssert"),
+    (_GATED_FAIL, "", "GatedAssert"),
+    ("33ff", "", "Killable"),
+]
+
+
 def test_corpus_device_prepass_feeds_workers():
     """The parent's striped device exploration produces per-contract
     outcomes that pooled workers consume: witnesses arrive as issues
     (with provenance when the host walk missed them) and the prepass
     counters ride along in each result (VERDICT r2 task 2)."""
-    # gated assert: INVALID only when calldata byte 0 == 0x42 — a
-    # host walk at a tiny budget won't prove it, the device wave will
-    gated_fail = bytes(
-        [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
-         0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
-         0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
-         0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
-         0x00, 0x5B, 0xFE]  # STOP; JUMPDEST; ASSERT_FAIL
-    ).hex()
     contracts = [
         ("600035600757005bfe", "", "PlainAssert"),
-        (gated_fail, "", "GatedAssert"),
+        (_GATED_FAIL, "", "GatedAssert"),
     ]
     results = analyze_corpus(
         contracts,
@@ -73,31 +81,7 @@ def test_corpus_device_prepass_feeds_workers():
     assert "110" in swc_ids(by_name["GatedAssert"])
 
 
-def test_corpus_overlapped_single_process_device():
-    """Single-process + device: the prepass runs in a thread overlapped
-    with the host analyses (both sides serialized on
-    HOST_SYMBOLIC_LOCK), witnesses still reach the results, and
-    per-contract errors stay contained."""
-    gated_fail = bytes(
-        [0x60, 0x00, 0x35,  # PUSH1 0; CALLDATALOAD
-         0x60, 0xF8, 0x1C,  # PUSH1 248; SHR
-         0x60, 0x42, 0x14,  # PUSH1 0x42; EQ
-         0x60, 0x0D, 0x57,  # PUSH1 13; JUMPI
-         0x00, 0x5B, 0xFE]  # STOP; JUMPDEST; ASSERT_FAIL
-    ).hex()
-    contracts = [
-        ("600035600757005bfe", "", "PlainAssert"),
-        (gated_fail, "", "GatedAssert"),
-        ("33ff", "", "Killable"),
-    ]
-    results = analyze_corpus(
-        contracts,
-        transaction_count=1,
-        execution_timeout=60,
-        processes=1,
-        use_device=True,  # force the overlapped branch on the CPU mesh
-        device_budget_s=30.0,
-    )
+def _assert_device_corpus_results(results):
     by_name = {r["name"]: r for r in results}
     for r in results:
         assert r["error"] is None, r["error"]
@@ -106,3 +90,41 @@ def test_corpus_overlapped_single_process_device():
     assert "106" in swc_ids(by_name["Killable"])
     # the prepass outcome must have been folded into the results
     assert any(r.get("device_prepass") for r in results)
+
+
+def test_corpus_single_core_device_prepass_first(monkeypatch):
+    """Single-process on a 1-core host: the prepass runs FIRST,
+    uncontended, and its final outcome is injected into every
+    analysis (the overlap needs a second core to pay)."""
+    import mythril_tpu.analysis.corpus as C
+
+    monkeypatch.setattr(C, "_effective_cpus", lambda: 1)
+    results = analyze_corpus(
+        _DEVICE_CONTRACTS,
+        transaction_count=1,
+        execution_timeout=60,
+        processes=1,
+        use_device=True,  # force the device axis on the CPU mesh
+        device_budget_s=30.0,
+    )
+    _assert_device_corpus_results(results)
+
+
+def test_corpus_overlapped_single_process_device(monkeypatch):
+    """Single-process on a multi-core host: the prepass runs in a
+    thread overlapped with the host analyses (both sides serialized
+    on HOST_SYMBOLIC_LOCK), cheap contracts are scheduled into the
+    overlap window, witnesses still reach the results, and
+    per-contract errors stay contained."""
+    import mythril_tpu.analysis.corpus as C
+
+    monkeypatch.setattr(C, "_effective_cpus", lambda: 2)
+    results = analyze_corpus(
+        _DEVICE_CONTRACTS,
+        transaction_count=1,
+        execution_timeout=60,
+        processes=1,
+        use_device=True,  # force the overlapped branch on the CPU mesh
+        device_budget_s=30.0,
+    )
+    _assert_device_corpus_results(results)
