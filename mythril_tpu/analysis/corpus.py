@@ -57,6 +57,20 @@ def resolve_prepass_budget_s(
     return min(30.0, 1.0 * max(1, n_contracts))
 
 
+def _runnable_rows(
+    contracts: List[Tuple[str, str, str]],
+) -> List[Tuple[int, str]]:
+    """(index, normalized runtime hex) for every contract the device
+    prepass can execute — THE filter both the prepass and its budget/
+    window sizing must share, or the two silently desync."""
+    rows = []
+    for idx, (code, _creation, _name) in enumerate(contracts):
+        code = code[2:] if code.startswith("0x") else code
+        if len(code) >= 8:
+            rows.append((idx, code))
+    return rows
+
+
 def corpus_device_prepass(
     contracts: List[Tuple[str, str, str]],
     budget_s: Optional[float] = None,
@@ -72,11 +86,7 @@ def corpus_device_prepass(
     into the per-contract analyses (indexed, not named — corpus rows
     may share names). Empty on any failure — the host pipeline must
     never be blocked by the device."""
-    runnable = []
-    for idx, (code, _creation, _name) in enumerate(contracts):
-        code = code[2:] if code.startswith("0x") else code
-        if len(code) >= 8:
-            runnable.append((idx, code))
+    runnable = _runnable_rows(contracts)
     if not runnable:
         return {}
     if budget_s is None:
@@ -273,8 +283,9 @@ def _analyze_one(payload: Tuple) -> Dict:
         solver_timeout,
         use_device,
         prepass_outcome,
+        deterministic_solving,
     ) = payload
-    args = restore_device_args = None
+    args = restore_device_args = restore_deterministic = None
     try:
         from mythril_tpu.analysis.security import fire_lasers
         from mythril_tpu.analysis.symbolic import SymExecWrapper
@@ -283,6 +294,12 @@ def _analyze_one(payload: Tuple) -> Dict:
 
         if solver_timeout:
             args.solver_timeout = solver_timeout
+        if deterministic_solving is not None:
+            # threaded through the payload (not toggled by the caller
+            # around the whole run) so the flag flip is scoped to this
+            # one analysis and restored on every exit path
+            restore_deterministic = args.deterministic_solving
+            args.deterministic_solving = deterministic_solving
         if not use_device:
             # pooled workers must not contend for the one accelerator;
             # any prepass outcome arrives via the payload (injected) or
@@ -333,6 +350,8 @@ def _analyze_one(payload: Tuple) -> Dict:
     finally:
         if restore_device_args is not None and args is not None:
             args.device_prepass, args.device_solving = restore_device_args
+        if restore_deterministic is not None and args is not None:
+            args.deterministic_solving = restore_deterministic
 
 
 def analyze_corpus(
@@ -349,6 +368,8 @@ def analyze_corpus(
     processes: Optional[int] = None,
     use_device: Optional[bool] = None,
     device_budget_s: Optional[float] = None,
+    deterministic_solving: Optional[bool] = None,
+    _flag_scoped: bool = False,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
     name), ...]: one striped device prepass in this process plus the
@@ -357,6 +378,37 @@ def analyze_corpus(
     afterward) otherwise. Returns one result dict per contract
     ({name, issues, error, device_prepass, phases})."""
     processes = processes or min(len(contracts), _effective_cpus())
+    if deterministic_solving is not None and not _flag_scoped:
+        # The flag must also govern the PARENT-side device prepass
+        # (flip solving + witness banking run in this process, not in
+        # _analyze_one), so it is scoped to this call with a restore on
+        # every exit path. Spawned workers (fresh processes, default
+        # Args) still get it via the payload, hence the parameter is
+        # threaded through the recursion too.
+        from mythril_tpu.support.support_args import args as _args
+
+        _restore_det = _args.deterministic_solving
+        _args.deterministic_solving = deterministic_solving
+        try:
+            return analyze_corpus(
+                contracts,
+                address=address,
+                strategy=strategy,
+                transaction_count=transaction_count,
+                execution_timeout=execution_timeout,
+                create_timeout=create_timeout,
+                max_depth=max_depth,
+                loop_bound=loop_bound,
+                modules=modules,
+                solver_timeout=solver_timeout,
+                processes=processes,
+                use_device=use_device,
+                device_budget_s=device_budget_s,
+                deterministic_solving=deterministic_solving,
+                _flag_scoped=True,
+            )
+        finally:
+            _args.deterministic_solving = _restore_det
     if use_device is None:
         # the device axis is on whenever an accelerator is present —
         # the PARENT owns the chip, so pooling does not disable it
@@ -382,6 +434,7 @@ def analyze_corpus(
             solver_timeout,
             worker_device,
             outcome,
+            deterministic_solving,
         )
 
     prepass: Dict[str, Dict] = {}
@@ -423,8 +476,11 @@ def analyze_corpus(
             # remaining contract runs on a quiet core — measured: a
             # budget-bound contract analyzed beside a live prepass
             # thread loses ~30% of its explored states to contention.
+            # Sized from the RUNNABLE count (the same filter
+            # corpus_device_prepass applies) so rows with no runtime
+            # code don't inflate the contended period.
             overlap_window_s = 1.25 * resolve_prepass_budget_s(
-                len(contracts), device_budget_s
+                max(1, len(_runnable_rows(contracts))), device_budget_s
             )
             t_overlap = time.perf_counter()
             slots: List[Optional[Dict]] = [None] * len(contracts)

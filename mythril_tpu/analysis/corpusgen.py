@@ -1,0 +1,150 @@
+"""Deterministic benchmark-corpus synthesis.
+
+BASELINE.json config 3 calls for a 1k-contract SWC-style corpus; the
+image ships only the reference's 13 precompiled fixtures
+(tests/testdata/inputs/*.sol.o — the inputs the reference's own CLI
+tests analyze). This module synthesizes an arbitrarily large corpus
+from them by *constant mutation*: each replica keeps the original's
+control-flow graph byte-for-byte but carries distinct function
+selectors, addresses, and data constants, so no two replicas share
+hash-consed terms, solver queries, or calldata witnesses — every
+contract costs the analyzer real work, exactly like a family of
+forked/redeployed contracts on mainnet (the regime the reference's
+per-contract loop, mythril/mythril/mythril_analyzer.py:145-185, was
+built for).
+
+What is mutated (and why it is structure-preserving):
+
+- the 4-byte immediate of a ``PUSH4`` directly followed by ``EQ`` —
+  the Solidity dispatcher's selector-compare idiom (the same pattern
+  the disassembler's function-recovery matches,
+  mythril/disassembler/disassembly.py:63). New selectors re-route
+  which calldata reaches which function but leave every jump target
+  untouched.
+- ``PUSH20`` immediates — hardcoded addresses.
+- the low half of a ``PUSH32`` immediate when the value is not a
+  mask/sentinel (not mostly 0x00/0xff bytes) — data constants.
+
+Jump destinations are never touched: PUSH1..PUSH3 immediates (memory
+offsets, jumpdests, small constants) and mask-like words are left
+alone, so every replica disassembles to the same instruction skeleton
+and exercises the same paths under symbolic calldata.
+
+Determinism: the byte stream is a pure function of (family name,
+replica index, corpus seed); two processes synthesize identical
+corpora.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+PUSH1, PUSH32 = 0x60, 0x7F
+
+
+def _instruction_starts(code: bytes) -> List[int]:
+    """Offsets of instruction starts (linear sweep, PUSH data skipped)
+    — mutation must never rewrite a byte that another sweep would read
+    as an opcode."""
+    starts = []
+    pc = 0
+    while pc < len(code):
+        starts.append(pc)
+        op = code[pc]
+        pc += 1 + (op - PUSH1 + 1 if PUSH1 <= op <= PUSH32 else 0)
+    return starts
+
+
+def _masklike(word: bytes) -> bool:
+    """True for sentinel/mask words (mostly 0x00/0xff or few distinct
+    bytes) whose value is semantic — address masks, type(uint).max,
+    -1 — rather than data."""
+    extreme = sum(1 for b in word if b in (0x00, 0xFF))
+    return extreme >= len(word) - 2 or len(set(word)) <= 2
+
+
+def mutate_constants(code: bytes, rng: random.Random) -> bytes:
+    """One structure-preserving replica of `code` (see module doc)."""
+    out = bytearray(code)
+    starts = _instruction_starts(code)
+    for i, pc in enumerate(starts):
+        op = code[pc]
+        if not PUSH1 <= op <= PUSH32:
+            continue
+        width = op - PUSH1 + 1
+        arg = bytes(code[pc + 1 : pc + 1 + width])
+        if len(arg) < width:
+            continue  # truncated trailing push (swarm hash tail)
+        nxt = code[starts[i + 1]] if i + 1 < len(starts) else None
+        if width == 4 and nxt == 0x14:  # PUSH4 <sel>; EQ — dispatcher
+            out[pc + 1 : pc + 5] = rng.randbytes(4)
+        elif width == 20:
+            out[pc + 1 : pc + 21] = rng.randbytes(20)
+        elif width == 32 and not _masklike(arg):
+            out[pc + 17 : pc + 33] = rng.randbytes(16)
+    return bytes(out)
+
+
+def fixture_dir() -> Path:
+    import os
+
+    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
+    return ref / "tests" / "testdata" / "inputs"
+
+
+def load_fixtures(
+    inputs: Optional[Path] = None,
+) -> List[Tuple[str, str]]:
+    """[(family name, runtime hex)] for every precompiled fixture."""
+    inputs = inputs or fixture_dir()
+    out = []
+    for f in sorted(inputs.glob("*.sol.o")):
+        code = f.read_text().strip()
+        if code.startswith("0x"):
+            code = code[2:]
+        if len(code) >= 8:
+            out.append((f.stem, code))
+    return out
+
+
+def synth_corpus(
+    n_contracts: int,
+    seed: int = 2024,
+    inputs: Optional[Path] = None,
+) -> List[Tuple[str, str, str]]:
+    """`n_contracts` (runtime_hex, creation_hex="", name) rows, the
+    analyze_corpus input shape. Families round-robin; replica 0 of
+    each family is the unmutated original so the corpus contains the
+    real fixtures, and replica k > 0 is the k-th constant mutation."""
+    families = load_fixtures(inputs)
+    if not families:
+        return []
+    corpus: List[Tuple[str, str, str]] = []
+    replica = 0
+    while len(corpus) < n_contracts:
+        for name, code_hex in families:
+            if len(corpus) >= n_contracts:
+                break
+            if replica == 0:
+                mutant_hex = code_hex
+            else:
+                rng = random.Random(f"{seed}:{name}:{replica}")
+                mutant_hex = mutate_constants(
+                    bytes.fromhex(code_hex), rng
+                ).hex()
+            corpus.append((mutant_hex, "", f"{name}#{replica}"))
+        replica += 1
+    return corpus
+
+
+def _check_skeleton(original: bytes, mutant: bytes) -> bool:
+    """Same instruction skeleton: identical opcode bytes at identical
+    offsets (only PUSH immediates may differ)."""
+    if len(original) != len(mutant):
+        return False
+    starts = _instruction_starts(original)
+    return starts == _instruction_starts(mutant) and all(
+        original[pc] == mutant[pc] for pc in starts
+    )

@@ -54,22 +54,18 @@ def golden_corpus_run() -> List[Tuple[str, Dict]]:
     # marathon verdict must be a pure function of the query — wall
     # budgets alone let machine load flip a borderline solve and
     # drift a minimized witness (observed: a tx calldata length
-    # oscillating 37/48 run-to-run on one fixture)
-    from mythril_tpu.support.support_args import args as _args
-
-    prior = _args.deterministic_solving
-    _args.deterministic_solving = True
-    try:
-        results = analyze_corpus(
-            contracts,
-            transaction_count=2,
-            execution_timeout=GOLDEN_EXECUTION_TIMEOUT,
-            create_timeout=10,
-            processes=1,
-            use_device=False,
-        )
-    finally:
-        _args.deterministic_solving = prior
+    # oscillating 37/48 run-to-run on one fixture). Threaded as a
+    # parameter (scoped + restored per analysis inside the runner)
+    # rather than toggled on the process-global Args around the run.
+    results = analyze_corpus(
+        contracts,
+        transaction_count=2,
+        execution_timeout=GOLDEN_EXECUTION_TIMEOUT,
+        create_timeout=10,
+        processes=1,
+        use_device=False,
+        deterministic_solving=True,
+    )
     return [(f.stem, r) for f, r in zip(files, results)]
 
 

@@ -1,67 +1,100 @@
-"""State annotations shared by the pruner plugins.
+"""Path metadata carried for the pruner plugins.
 
-Reference parity: mythril/laser/plugin/plugins/plugin_annotations.py:1-69.
+Behavioral contract (consumed by dependency_pruner.py and
+mutation_pruner.py; the reference equivalent lives at
+mythril/laser/plugin/plugins/plugin_annotations.py):
+
+- ``MutationAnnotation`` — a bare marker meaning "this path executed a
+  state-mutating instruction". It must survive into nested call frames
+  so an SSTORE inside a callee still marks the outer transaction.
+- ``DependencyAnnotation`` — one transaction's dependency trace: which
+  storage slots the path read, which it wrote per transaction number,
+  whether it made an external call, and the basic-block trail walked.
+- ``WSDependencyAnnotation`` — the world-state-level carrier that
+  stacks one ``DependencyAnnotation`` per open state so the next
+  transaction can resume its predecessor's trace.
+
+Copies must be *one level deep*: forked states share slot values (terms
+are immutable) but must not share the containers, or one branch's
+appends would leak into its sibling's trace.
 """
 
 from __future__ import annotations
 
-from copy import copy
-from typing import Dict, List, Set
+from typing import Any, Dict, List, Set
 
 from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
 
 
 class MutationAnnotation(StateAnnotation):
-    """Marks a state that executed a mutating instruction (SSTORE /
-    CALL / STATICCALL); survives across call frames."""
+    """Marker: the annotated path performed a world-state mutation
+    (SSTORE, or a value-bearing CALL family instruction)."""
 
-    def __init__(self):
-        pass
+    __slots__ = ()
 
     @property
     def persist_over_calls(self) -> bool:
+        # a mutation inside a callee frame is still a mutation of the
+        # transaction — the mutation pruner checks the outermost state
         return True
 
 
 class DependencyAnnotation(StateAnnotation):
-    """Tracks storage reads/writes and the block path per transaction."""
+    """One transaction's storage-dependency trace along a path."""
 
-    def __init__(self):
-        self.storage_loaded: List = []
-        self.storage_written: Dict[int, List] = {}
+    def __init__(self) -> None:
+        #: slots (concrete or symbolic terms) this path has SLOADed
+        self.storage_loaded: List[Any] = []
+        #: transaction number -> slots SSTOREd during that transaction
+        self.storage_written: Dict[int, List[Any]] = {}
+        #: the path issued CALL/STATICCALL/DELEGATECALL/CALLCODE
         self.has_call: bool = False
-        self.path: List = [0]
+        #: basic-block trail, rooted at the synthetic entry block 0
+        self.path: List[int] = [0]
+        #: blocks already counted by the loop-aware block tracker
         self.blocks_seen: Set[int] = set()
 
-    def __copy__(self):
-        result = DependencyAnnotation()
-        result.storage_loaded = copy(self.storage_loaded)
-        result.storage_written = copy(self.storage_written)
-        result.has_call = self.has_call
-        result.path = copy(self.path)
-        result.blocks_seen = copy(self.blocks_seen)
-        return result
+    def __copy__(self) -> "DependencyAnnotation":
+        twin = DependencyAnnotation()
+        twin.storage_loaded = list(self.storage_loaded)
+        twin.storage_written = {
+            tx: list(slots) for tx, slots in self.storage_written.items()
+        }
+        twin.has_call = self.has_call
+        twin.path = list(self.path)
+        twin.blocks_seen = set(self.blocks_seen)
+        return twin
 
-    def get_storage_write_cache(self, iteration: int):
-        if iteration not in self.storage_written:
-            self.storage_written[iteration] = []
-        return self.storage_written[iteration]
+    def get_storage_write_cache(self, iteration: int) -> List[Any]:
+        """The (created-on-demand) write list for transaction number
+        `iteration`."""
+        return self.storage_written.setdefault(iteration, [])
 
-    def extend_storage_write_cache(self, iteration: int, value: object):
-        if iteration not in self.storage_written:
-            self.storage_written[iteration] = [value]
-        elif value not in self.storage_written[iteration]:
-            self.storage_written[iteration].append(value)
+    def extend_storage_write_cache(self, iteration: int, value: Any) -> None:
+        """Record a written slot, keeping insertion order and dropping
+        duplicates (term equality — symbolic slots dedup structurally)."""
+        cache = self.get_storage_write_cache(iteration)
+        if value not in cache:
+            cache.append(value)
 
 
 class WSDependencyAnnotation(StateAnnotation):
-    """World-state-level stack of DependencyAnnotations, carrying them
-    from one transaction to the next."""
+    """Per-world-state stack of dependency traces: the end of
+    transaction N pushes its trace; transaction N+1 pops it to seed
+    its own annotation."""
 
-    def __init__(self):
-        self.annotations_stack: List = []
+    def __init__(self) -> None:
+        self.annotations_stack: List[DependencyAnnotation] = []
 
-    def __copy__(self):
-        result = WSDependencyAnnotation()
-        result.annotations_stack = copy(self.annotations_stack)
-        return result
+    def __copy__(self) -> "WSDependencyAnnotation":
+        twin = WSDependencyAnnotation()
+        # Shallow by design, matching reference behavior: the copied
+        # stacks are separate lists but share the carried trace
+        # objects, and the adopter (dependency_pruner
+        # get_dependency_annotation) pops WITHOUT copying — so two
+        # world-state forks that each start a next transaction adopt
+        # the same trace object. That sharing only ever widens the
+        # recorded read/write sets (the pruner treats them as
+        # may-sets), so it costs pruning precision, never soundness.
+        twin.annotations_stack = list(self.annotations_stack)
+        return twin

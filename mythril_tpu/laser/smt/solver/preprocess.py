@@ -298,6 +298,16 @@ def _elim_bound() -> None:
         _uf_info.clear()
 
 
+def _feed(h, data: bytes) -> None:
+    """Length-prefix every hashed field so the digest input is
+    injectively framed: separator-joined reprs could (however
+    improbably) collide across different arg tuples, and a digest
+    collision silently merges two select/UF apps into one fresh
+    variable — an unsat-side soundness break."""
+    h.update(len(data).to_bytes(4, "little"))
+    h.update(data)
+
+
 def _digest(t: Term) -> str:
     """Stable structural digest (iterative post-order, memoized).
 
@@ -320,14 +330,18 @@ def _digest(t: Term) -> str:
                     stack.append((a, False))
             continue
         h = hashlib.blake2b(digest_size=16)
-        h.update(cur.op.encode())
-        h.update(repr((cur.sort.kind, cur.sort.width, cur.sort.range_width)).encode())
+        _feed(h, cur.op.encode())
+        _feed(
+            h,
+            repr(
+                (cur.sort.kind, cur.sort.width, cur.sort.range_width)
+            ).encode(),
+        )
         for a in cur.args:
             if isinstance(a, Term):
-                h.update(_digest_memo[a._id].encode())
+                _feed(h, _digest_memo[a._id].encode())
             else:
-                h.update(repr(a).encode())
-            h.update(b"|")
+                _feed(h, repr(a).encode())
         _digest_memo[cur._id] = h.hexdigest()
     return _digest_memo[t._id]
 

@@ -151,9 +151,13 @@ class Optimize(BaseSolver):
         a pure function of the query — convergence under an iteration
         cap with fixed conflict-budgeted steps — so the minimized
         witness cannot vary with machine load; the fixed emergency
-        stop then only exists for pathological objectives, and the
-        trade (an Optimize may run up to REFINE_EMERGENCY_S per
-        objective past its wall share) is what the flag opts into."""
+        stop then only exists for pathological objectives, and each
+        step's wall valve is clamped to the time left before that
+        stop, so an objective overruns its wall share by at most
+        REFINE_EMERGENCY_S plus one step's scheduling slop. (The clamp
+        is load-dependent, but only within the emergency regime, which
+        is load-dependent by definition; the conflict budget remains
+        the binding determinism constraint on every healthy step.)"""
         from mythril_tpu.support.support_args import args as _args
 
         deterministic = _args.deterministic_solving
@@ -176,7 +180,10 @@ class Optimize(BaseSolver):
                 else terms.ule(terms.bv_const(mid, obj.width), obj)
             )
             if deterministic:
-                step_ms = cls.REFINE_STEP_MS
+                step_ms = min(
+                    cls.REFINE_STEP_MS,
+                    max(100, int((deadline - time.monotonic()) * 1000)),
+                )
                 step_conflicts = cls.REFINE_STEP_CONFLICTS
             else:
                 step_ms = max(
@@ -385,18 +392,24 @@ def check_terms(
     # only — a query that trips it would have ended as a marathon
     # timeout regardless of machine. The marathon below stays
     # wall-budgeted as the completeness backstop.
+    from mythril_tpu.support.support_args import args as _glob_args
+
+    deterministic = _glob_args.deterministic_solving
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
+    # In deterministic mode the conflict budget binds and the wall
+    # valve must not (a load-variable valve could flip a verdict), so
+    # the sprint gets the full remaining wall. Default mode keeps a
+    # modest wall cap: a CNF whose conflict rate is far below the
+    # calibrated ~10k/s must not burn most of the per-query wall
+    # inside the sprint and starve the device attempt + marathon.
+    sprint_ms = remaining if deterministic else min(2000, remaining)
     status, bits = native_session.solve(
         blaster.nvars, blaster.flat, units,
-        timeout_ms=remaining,
+        timeout_ms=sprint_ms,
         conflict_budget=SPRINT_CONFLICTS,
     )
     if status == native_sat.UNSAT:
         return unsat, None
-
-    from mythril_tpu.support.support_args import args as _glob_args
-
-    deterministic = _glob_args.deterministic_solving
     device_tried = False
     if (
         status == native_sat.UNKNOWN

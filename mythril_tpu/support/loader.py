@@ -1,71 +1,89 @@
-"""DynLoader: on-demand chain data for lazy storage/code hydration.
+"""DynLoader: lazy on-chain data for the symbolic engine.
 
-Reference parity: mythril/support/loader.py:15-95 — `read_storage`,
-`read_balance`, `dynld(address) -> Disassembly`, all lru-cached.
+Behavioral contract (the reference equivalent is
+mythril/support/loader.py): the state layer calls `read_storage` /
+`read_balance` when a symbolic account touches a slot it has no local
+value for, and `dynld` when a CALL resolves to a foreign address whose
+code must be pulled in. All three memoize — the engine re-reads the
+same slot on every path that forks after the first read — and all
+three refuse loudly when dynamic loading is off or no RPC client is
+configured, so a misconfigured run fails instead of silently analyzing
+against empty chain state.
 """
 
 from __future__ import annotations
 
-import functools
 import logging
-import re
+from functools import lru_cache
 from typing import Optional
 
 from mythril_tpu.disassembler.disassembly import Disassembly
 
-LRU_CACHE_SIZE = 4096
-
 log = logging.getLogger(__name__)
+
+#: distinct (address, slot) pairs a single analysis plausibly touches
+MEMO_SLOTS = 4096
+
+
+def _canonical_address(address) -> Optional[str]:
+    """0x-prefixed, 40-hex-digit, left-zero-padded form of `address`
+    (int or hex string); None when it cannot be one."""
+    if isinstance(address, int):
+        address = f"{address:#042x}"
+    elif isinstance(address, str):
+        digits = address[2:] if address.startswith("0x") else address
+        address = "0x" + digits.rjust(40, "0")
+    else:
+        return None
+    body = address[2:]
+    if len(body) != 40:
+        return None
+    try:
+        int(body, 16)
+    except ValueError:
+        return None
+    return address
 
 
 class DynLoader:
-    """Loads storage slots, balances and dependency bytecode over RPC."""
+    """On-demand chain reads (storage slots, balances, dependency
+    code) through an `EthJsonRpc`-shaped client."""
 
-    def __init__(self, eth, active: bool = True):
+    def __init__(self, eth, active: bool = True) -> None:
         self.eth = eth
         self.active = active
 
-    @functools.lru_cache(LRU_CACHE_SIZE)
-    def read_storage(self, contract_address: str, index: int) -> str:
+    def _client(self):
+        """The RPC client, or a loud failure when loading is off."""
         if not self.active:
-            raise ValueError("Loader is disabled")
-        if not self.eth:
-            raise ValueError("Cannot load from the storage when eth is None")
-        return self.eth.eth_getStorageAt(
+            raise ValueError("Dynamic data loading is disabled")
+        if self.eth is None:
+            raise ValueError(
+                "Dynamic data loading requires an RPC client and none "
+                "is configured"
+            )
+        return self.eth
+
+    @lru_cache(maxsize=MEMO_SLOTS)
+    def read_storage(self, contract_address: str, index: int) -> str:
+        return self._client().eth_getStorageAt(
             contract_address, position=index, block="latest"
         )
 
-    @functools.lru_cache(LRU_CACHE_SIZE)
+    @lru_cache(maxsize=MEMO_SLOTS)
     def read_balance(self, address: str) -> str:
-        if not self.active:
-            raise ValueError("Cannot load from storage when the loader is disabled")
-        if not self.eth:
-            raise ValueError("Cannot load from the chain when eth is None")
-        return self.eth.eth_getBalance(address)
+        return self._client().eth_getBalance(address)
 
-    @functools.lru_cache(LRU_CACHE_SIZE)
-    def dynld(self, dependency_address: str) -> Optional[Disassembly]:
-        """Fetch and disassemble a dependency contract's code."""
-        if not self.active:
-            raise ValueError("Loader is disabled")
-        if not self.eth:
-            raise ValueError("Cannot load from the chain when eth is None")
-
-        log.debug("Dynld at contract %s", dependency_address)
-        if isinstance(dependency_address, int):
-            dependency_address = "0x{:040X}".format(dependency_address)
-        else:
-            dependency_address = (
-                "0x" + "0" * (42 - len(dependency_address)) + dependency_address[2:]
-            )
-
-        m = re.match(r"^(0x[0-9a-fA-F]{40})$", dependency_address)
-        if not m:
+    @lru_cache(maxsize=MEMO_SLOTS)
+    def dynld(self, dependency_address) -> Optional[Disassembly]:
+        """Code of the contract at `dependency_address`, disassembled;
+        None for malformed addresses and codeless accounts."""
+        client = self._client()
+        address = _canonical_address(dependency_address)
+        log.debug("dynld %s -> %s", dependency_address, address)
+        if address is None:
             return None
-        dependency_address = m.group(1)
-
-        log.debug("Dependency address: %s", dependency_address)
-        code = self.eth.eth_getCode(dependency_address)
-        if code == "0x":
+        code = client.eth_getCode(address)
+        if not code or code == "0x":
             return None
         return Disassembly(code)

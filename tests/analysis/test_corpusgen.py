@@ -1,0 +1,79 @@
+"""Benchmark-corpus synthesis (analysis/corpusgen.py).
+
+The synthesized corpus is the stand-in for BASELINE config 3's
+1k-contract SWC corpus; these tests pin the properties the benchmark's
+honesty rests on: replicas are deterministic, structure-preserving
+(same instruction skeleton, so they exercise the same code paths), and
+genuinely distinct (different selectors/constants, so no work dedups
+across replicas).
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import (
+    _check_skeleton,
+    load_fixtures,
+    mutate_constants,
+    synth_corpus,
+)
+from mythril_tpu.disassembler.disassembly import Disassembly
+
+FAMILIES = load_fixtures()
+pytestmark = pytest.mark.skipif(
+    not FAMILIES, reason="reference fixture corpus not mounted"
+)
+
+
+def test_deterministic():
+    assert synth_corpus(40) == synth_corpus(40)
+    # a different seed changes the mutants but not the originals
+    other = synth_corpus(40, seed=7)
+    assert other != synth_corpus(40)
+    assert [row for row in other if row[2].endswith("#0")] == [
+        row for row in synth_corpus(40) if row[2].endswith("#0")
+    ]
+
+
+def test_replica_zero_is_the_original():
+    corpus = {name: code for code, _, name in synth_corpus(26)}
+    for name, code_hex in FAMILIES:
+        assert corpus[f"{name}#0"] == code_hex
+
+
+@pytest.mark.parametrize("name,code_hex", FAMILIES)
+def test_skeleton_preserved(name, code_hex):
+    orig = bytes.fromhex(code_hex)
+    mutant = mutate_constants(orig, random.Random(f"t:{name}"))
+    assert _check_skeleton(orig, mutant)
+    d0, d1 = Disassembly(code_hex), Disassembly(mutant.hex())
+    assert [i["opcode"] for i in d0.instruction_list] == [
+        i["opcode"] for i in d1.instruction_list
+    ]
+
+
+def test_replicas_are_distinct_work():
+    """No two replicas of a family share selectors or full bytecode —
+    the property that makes N replicas N units of analyzer work."""
+    corpus = synth_corpus(13 * 4)
+    by_family = {}
+    for code, _, name in corpus:
+        by_family.setdefault(name.split("#")[0], []).append(code)
+    mutated_selector_families = 0
+    for family, codes in by_family.items():
+        assert len(set(codes)) == len(codes), family
+        selectors = [frozenset(Disassembly(c).func_hashes) for c in codes]
+        if len(set(selectors)) == len(selectors):
+            mutated_selector_families += 1
+    # every family with a dispatcher must yield distinct selector sets
+    assert mutated_selector_families >= 10
+
+
+def test_corpus_size_and_shape():
+    corpus = synth_corpus(208)
+    assert len(corpus) == 208
+    codes, creations, names = zip(*corpus)
+    assert len(set(names)) == 208
+    assert all(c == "" for c in creations)
+    assert all(len(c) >= 8 and "0x" not in c for c in codes)
