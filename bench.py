@@ -1,42 +1,56 @@
-"""Benchmark: honest batched-interpreter throughput + the driver metric.
+"""Benchmark: honest batched-interpreter throughput + the corpus A/B.
 
-Two measurements, one JSON line:
+One JSON line with three measurement groups:
 
-1. `state_transitions_per_sec` (headline `value`): one state-transition
-   = one EVM instruction applied to one path state — the unit of work of
-   the reference's `execute_state` hot loop
+1. `state_transitions_per_sec` (the `value` field): one state
+   transition = one EVM instruction applied to one path state — the
+   unit of work of the reference's `execute_state` hot loop
    (mythril/laser/ethereum/svm.py:303). A single jit'd step advances
    every lane of a StateBatch at once on the TPU.
 
-   Honesty rules (round-2 fix): on this platform `block_until_ready`
-   returns before execution finishes, so timing stops only after a
-   forced device->host readback (`np.asarray`) of the result, and the
-   measurement is accepted only if wall time scales ~linearly with
-   `max_steps` (a dispatch-only "measurement" would not).
+   Honesty rules (round-2): timing stops only after a forced
+   device->host readback, and the measurement must scale ~linearly
+   with step count (a dispatch-only "measurement" would not).
 
-2. `contracts_per_sec` / `states_per_sec` (extra fields): the
-   BASELINE.json driver metric — the full `myth analyze`-equivalent
-   pipeline at -t 2 over the reference's precompiled contract corpus
-   (tests/testdata/inputs/*.sol.o).
+2. The **corpus A/B** (round-4 headline, BASELINE config-3 stand-in):
+   `CORPUS_CONTRACTS` synthesized contracts (analysis/corpusgen.py —
+   structure-preserving constant mutants of the reference's 13
+   precompiled fixtures) analyzed at `-t 2` with equal per-contract
+   budgets by two legs: the default device path (overlapped striped
+   prepass + witness/coverage injection + solver races) and the same
+   engine with the chip off. Legs are INTERLEAVED device/host x
+   `CORPUS_PAIRS` and the headline uses medians; the run is rejected
+   (and retried once) when either side's wall spread exceeds
+   `SPREAD_GATE` — a single loaded-regime sample must not become the
+   round's permanent record (round-3 lesson).
 
-Baseline: the reference engine executes ~2,000 state-transitions/sec
-single-threaded (order-of-magnitude from its own instruction-profiler
-machinery; it publishes no numbers — see BASELINE.md — and cannot run
-in this image since z3 is not installed). vs_baseline uses that
-documented nominal figure against the honest transitions/sec.
+3. The default single-contract path with its prepass/solver counters.
+
+Baseline: the reference cannot run in this image (z3 is absent — its
+entire solving surface is z3, mythril/laser/smt/solver/solver.py), and
+it publishes no numbers (BASELINE.md). The normative proxy, recorded
+in BASELINE.md, is therefore this repo's own host-only leg — the same
+analyzer with the accelerator disabled. `vs_baseline` is the measured
+median host-only wall over the median device wall on the corpus A/B:
+the speedup the chip delivers over the proxy, not a nominal constant.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import statistics
 import sys
 import time
 
-BASELINE_STATES_PER_SEC = 2_000.0
 N_LANES = 16384
 N_STEPS = 256
-CORPUS_TIMEOUT_S = 45
+CORPUS_CONTRACTS = 208
+CORPUS_PAIRS = 3
+CORPUS_EXEC_TIMEOUT_S = 2
+SPREAD_GATE = 0.25
+LEG_DEADLINE_S = 480
 
 
 def _timed_run(batch, code, max_steps: int) -> float:
@@ -106,102 +120,170 @@ def bench_transitions() -> dict:
     return {"rate": rate, "wall_s": dt_full, "scaling_ratio": ratio}
 
 
-def bench_corpus() -> dict:
-    """Driver metric: contracts/sec + states/sec at -t 2 over the
-    reference's precompiled corpus, via the real analyzer pipeline.
+class _Deadline(Exception):
+    pass
 
-    Both legs of the A/B run at EQUAL per-contract budgets: the
-    device leg is the default path (striped corpus prepass on the
-    chip + host analyses consuming its witnesses/coverage), the
-    host-only leg switches the device off. Headline numbers come from
-    the device leg; the host-only fields make the comparison honest
-    rather than implied."""
-    from pathlib import Path
 
-    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-    inputs = ref / "tests" / "testdata" / "inputs"
-    files = sorted(inputs.glob("*.sol.o"))
-    if not files:
-        return {}
+def _with_deadline(fn, seconds: int):
+    """Run fn() under a SIGALRM deadline; raises _Deadline."""
 
+    def _alarm(signum, frame):
+        raise _Deadline()
+
+    previous = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(seconds)
+    try:
+        return fn()
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)
+
+
+def _corpus_leg(contracts, use_device):
+    """One A/B leg at equal budgets. Legs share one process, so the
+    query memo is cleared each time — without the reset the second leg
+    would ride the first leg's solves."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.support.model import clear_cache
+    from mythril_tpu.laser.smt.solver.solver_statistics import (
+        SolverStatistics,
+    )
+
+    stats = SolverStatistics()
+    stats.enabled = True
+    clear_cache()
+    d0 = stats.device_sat_count
+    t0 = time.perf_counter()
+    results = analyze_corpus(
+        contracts,
+        transaction_count=2,
+        execution_timeout=CORPUS_EXEC_TIMEOUT_S,
+        create_timeout=10,
+        use_device=use_device,
+        processes=1,
+    )
+    wall = time.perf_counter() - t0
+    prepass = max(
+        ((r.get("device_prepass") or {}) for r in results),
+        key=lambda s: s.get("device_steps", 0),
+    )
+    return {
+        "wall_s": round(wall, 1),
+        "issues": sum(len(r["issues"]) for r in results),
+        "states": sum(r.get("states", 0) for r in results),
+        "errors": sum(1 for r in results if r["error"]),
+        "device_sat": stats.device_sat_count - d0,
+        "prepass": prepass or None,
+    }
+
+
+def _spread(values) -> float:
+    med = statistics.median(values)
+    return (max(values) - min(values)) / med if med else 0.0
+
+
+def bench_corpus_ab(strict: bool = True) -> dict:
+    """Interleaved device/host A/B over the synthesized corpus;
+    medians + spreads. With `strict`, raises on a spread-gate
+    violation so the __main__ retry reruns the whole measurement; the
+    retry records the result with `spread_rejected: true` instead of
+    leaving the round without an artifact."""
     import logging
 
+    from mythril_tpu.analysis.corpusgen import synth_corpus
+
+    contracts = synth_corpus(CORPUS_CONTRACTS)
+    if not contracts:
+        return {}
+
     logging.disable(logging.WARNING)
+    device_legs, host_legs = [], []
     try:
-        from mythril_tpu.analysis.corpus import analyze_corpus
-
-        contracts = [(f.read_text().strip(), "", f.stem) for f in files]
-
-        def leg(use_device):
-            # equal-budget AND equal-cache: the legs share one process,
-            # and get_model's memo is keyed on hash-consed term ids that
-            # are identical across legs — without this reset the second
-            # leg would ride the first leg's solves
-            from mythril_tpu.support.model import clear_cache
-
-            clear_cache()
-            t0 = time.perf_counter()
-            results = analyze_corpus(
-                contracts,
-                transaction_count=2,
-                execution_timeout=CORPUS_TIMEOUT_S,
-                create_timeout=10,
-                use_device=use_device,  # None = the default (auto) path
+        for pair in range(CORPUS_PAIRS):
+            device_legs.append(
+                _with_deadline(
+                    lambda: _corpus_leg(contracts, None), LEG_DEADLINE_S
+                )
             )
-            dt = time.perf_counter() - t0
-            return {
-                "wall_raw": dt,
-                "wall_s": round(dt, 1),
-                "states": sum(r.get("states", 0) for r in results),
-                "issues": sum(len(r["issues"]) for r in results),
-                "errors": [r["name"] for r in results if r["error"]],
-                # the prepass stats block is corpus-wide (one striped
-                # exploration shared by all contracts): max, not sum
-                "prepass_steps": max(
-                    (
-                        (r.get("device_prepass") or {}).get("device_steps", 0)
-                        for r in results
-                    ),
-                    default=0,
-                ),
-            }
-
-        device = leg(use_device=None)  # auto: on with an accelerator
-        host = leg(use_device=False)
+            host_legs.append(
+                _with_deadline(
+                    lambda: _corpus_leg(contracts, False), LEG_DEADLINE_S
+                )
+            )
+            print(
+                f"bench: corpus pair {pair + 1}/{CORPUS_PAIRS}: device "
+                f"{device_legs[-1]['wall_s']}s/{device_legs[-1]['issues']} "
+                f"issues vs host {host_legs[-1]['wall_s']}s/"
+                f"{host_legs[-1]['issues']} issues",
+                file=sys.stderr,
+            )
     finally:
         logging.disable(logging.NOTSET)
 
-    print(
-        f"bench: corpus {len(files)} contracts — device leg "
-        f"{device['wall_s']}s/{device['issues']} issues, host-only leg "
-        f"{host['wall_s']}s/{host['issues']} issues",
-        file=sys.stderr,
-    )
-    return {
-        "contracts_per_sec": round(len(files) / device["wall_raw"], 3),
-        "states_per_sec": round(device["states"] / device["wall_raw"], 1),
-        "corpus_contracts": len(files),
-        "corpus_wall_s": device["wall_s"],
-        "corpus_issues": device["issues"],
-        "corpus_errors": len(device["errors"]),
-        "corpus_prepass_lane_steps": device["prepass_steps"],
-        "host_only_wall_s": host["wall_s"],
-        "host_only_issues": host["issues"],
-        "host_only_states_per_sec": round(host["states"] / host["wall_raw"], 1),
-        "device_extra_issues": device["issues"] - host["issues"],
+    d_walls = [leg["wall_s"] for leg in device_legs]
+    h_walls = [leg["wall_s"] for leg in host_legs]
+    d_spread, h_spread = _spread(d_walls), _spread(h_walls)
+    spread_rejected = max(d_spread, h_spread) > SPREAD_GATE
+    if spread_rejected and strict:
+        raise RuntimeError(
+            f"corpus A/B spread gate: device {d_spread:.2f} / host "
+            f"{h_spread:.2f} exceeds {SPREAD_GATE} — the regime is too "
+            "noisy to record"
+        )
+
+    # the prepass counters of the median device leg (the recorded one)
+    median_leg = device_legs[
+        d_walls.index(sorted(d_walls)[len(d_walls) // 2])
+    ]
+    out = {
+        "corpus_contracts": len(contracts),
+        "spread_rejected": spread_rejected,
+        "corpus_pairs": CORPUS_PAIRS,
+        "corpus_exec_timeout_s": CORPUS_EXEC_TIMEOUT_S,
+        "corpus_wall_s": statistics.median(d_walls),
+        "corpus_wall_spread": round(d_spread, 3),
+        "corpus_issues": int(
+            statistics.median([leg["issues"] for leg in device_legs])
+        ),
+        "corpus_errors": max(leg["errors"] for leg in device_legs),
+        "host_only_wall_s": statistics.median(h_walls),
+        "host_only_wall_spread": round(h_spread, 3),
+        "host_only_issues": int(
+            statistics.median([leg["issues"] for leg in host_legs])
+        ),
+        "corpus_states_per_sec": round(
+            statistics.median(
+                [leg["states"] / leg["wall_s"] for leg in device_legs]
+            ),
+            1,
+        ),
+        "host_only_states_per_sec": round(
+            statistics.median(
+                [leg["states"] / leg["wall_s"] for leg in host_legs]
+            ),
+            1,
+        ),
+        "contracts_per_sec": round(
+            len(contracts) / statistics.median(d_walls), 3
+        ),
+        "device_sat_verdicts_corpus": sum(
+            leg["device_sat"] for leg in device_legs
+        ),
+        "corpus_walls_device": d_walls,
+        "corpus_walls_host": h_walls,
     }
+    for k, v in (median_leg.get("prepass") or {}).items():
+        if k not in ("scope", "partial"):
+            out[f"prepass_{k}"] = v
+    return out
 
 
 def bench_device_default_path(budget_s: int = 210) -> dict:
     """The default `myth analyze` path with the device engaged: one
     reference contract analyzed single-process, reporting how much
-    stepping/solving the TPU did (device prepass + portfolio-first
-    feasibility, both on by default off-CPU).
-
-    Runs last, under a SIGALRM deadline: the device kernels'
-    first-compile cost must never sink the earlier metrics (this
-    process owns the chip, so a subprocess cannot do the work)."""
-    import signal
+    stepping/solving the TPU did. Runs last, under a deadline: the
+    device kernels' first-compile cost must never sink the earlier
+    metrics."""
     from pathlib import Path
 
     ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
@@ -209,16 +291,8 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
     if not target.exists():
         return {}
 
-    class _Deadline(Exception):
-        pass
-
-    def _alarm(signum, frame):
-        raise _Deadline()
-
     import logging
 
-    previous = signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(budget_s)
     logging.disable(logging.WARNING)
     try:
         from mythril_tpu.analysis.corpus import analyze_corpus
@@ -228,22 +302,27 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
 
         stats = SolverStatistics()
         stats.enabled = True
+        d0, c0 = stats.device_sat_count, stats.cdcl_sat_count
         t0 = time.perf_counter()
-        results = analyze_corpus(
-            [(target.read_text().strip(), "", target.stem)],
-            transaction_count=2,
-            execution_timeout=30,
-            create_timeout=10,
-            processes=1,
-        )
+
+        def run():
+            return analyze_corpus(
+                [(target.read_text().strip(), "", target.stem)],
+                transaction_count=2,
+                execution_timeout=30,
+                create_timeout=10,
+                processes=1,
+            )
+
+        results = _with_deadline(run, budget_s)
         out = {
             "default_path_wall_s": round(time.perf_counter() - t0, 1),
             "default_path_issues": len(results[0]["issues"]),
-            "device_sat_verdicts": stats.device_sat_count,
-            "cdcl_sat_verdicts": stats.cdcl_sat_count,
+            "device_sat_verdicts": stats.device_sat_count - d0,
+            "cdcl_sat_verdicts": stats.cdcl_sat_count - c0,
         }
         for k, v in (results[0].get("device_prepass") or {}).items():
-            out[f"prepass_{k}"] = v
+            out[f"default_prepass_{k}"] = v
     except _Deadline:
         print("bench: default-path half hit its deadline", file=sys.stderr)
         return {"default_path": "deadline"}
@@ -251,31 +330,45 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
         print(f"bench: default-path half skipped: {e!r}", file=sys.stderr)
         return {"default_path": "skipped"}
     finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, previous)
         logging.disable(logging.NOTSET)
     print(f"bench: default path {out}", file=sys.stderr)
     return out
 
 
-def main() -> None:
+def main(final_attempt: bool = False) -> None:
     dev = bench_transitions()
     corpus = {}
     try:
-        corpus = bench_corpus()
-    except Exception as e:  # corpus half must not sink the device metric
+        corpus = bench_corpus_ab(strict=not final_attempt)
+    except _Deadline:
+        print("bench: a corpus leg hit its deadline", file=sys.stderr)
+        corpus = {"corpus": "deadline"}
+    except RuntimeError:
+        raise  # spread-gate rejection: let the __main__ retry rerun it
+    except Exception as e:
+        # the corpus half must not sink the device metric: any other
+        # bug is recorded as a skip, and the JSON line still prints
         print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
+        corpus = {"corpus": "failed"}
     default_path = {}
     try:
         default_path = bench_device_default_path()
     except Exception as e:
         print(f"bench: default-path half failed: {e!r}", file=sys.stderr)
 
+    vs_baseline = None
+    if corpus.get("corpus_wall_s") and corpus.get("host_only_wall_s"):
+        vs_baseline = round(
+            corpus["host_only_wall_s"] / corpus["corpus_wall_s"], 3
+        )
     record = {
         "metric": "state_transitions_per_sec",
         "value": round(dev["rate"], 1),
         "unit": "states/sec",
-        "vs_baseline": round(dev["rate"] / BASELINE_STATES_PER_SEC, 2),
+        # measured: median host-only(proxy baseline, see BASELINE.md)
+        # wall over median device wall on the corpus A/B
+        "vs_baseline": vs_baseline,
+        "vs_baseline_def": "host_only_wall_s / corpus_wall_s (measured)",
         "scaling_ratio_4x_steps": round(dev["scaling_ratio"], 2),
         "n_lanes": N_LANES,
         "n_steps": N_STEPS,
@@ -287,11 +380,11 @@ def main() -> None:
 
 if __name__ == "__main__":
     # One retry shields the round's metric from transient device/tunnel
-    # hiccups (observed once right after a heavy test run released the
-    # chip). Only runtime/IO errors retry; deterministic bugs propagate.
+    # hiccups and from a spread-gate rejection. Only runtime/IO errors
+    # retry; deterministic bugs propagate.
     try:
         main()
     except (RuntimeError, OSError) as e:
         print(f"bench: first attempt failed ({e!r}); retrying", file=sys.stderr)
         time.sleep(5)
-        main()
+        main(final_attempt=True)

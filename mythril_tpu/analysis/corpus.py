@@ -40,20 +40,38 @@ def _effective_cpus() -> int:
         return mp.cpu_count()
 
 
+#: corpus size from which the overlapped prepass pays even on a
+#: single-core box: the waves are device-bound (GIL released during
+#: dispatch + readback — measured ~2.7s of host-side work per ~33s
+#: wave at 3328 lanes), so the per-wave contention tax amortizes over
+#: many host analyses, where on a small corpus it dominates
+OVERLAP_MIN_CORPUS = 32
+
+
 def resolve_prepass_budget_s(
     n_contracts: int, override: Optional[float] = None
 ) -> float:
     """Default ACTIVE-time budget (waves + flip solving; lock waits
-    don't bill) for the striped corpus prepass. Sized to the measured
-    coverage curve: the selector seeds cover most of what wave 1 can
-    reach and the curve plateaus within a few waves, while every
-    second of prepass activity is a second of GIL/core contention
-    stolen from overlapped host analyses on a small box — with the
-    conflict-budgeted CDCL answering most host queries in
-    microseconds, a long prepass tail costs more states than its
-    witnesses save. 1s/contract keeps 2-4 steady-state waves."""
+    don't bill) for the striped corpus prepass.
+
+    Small corpora: sized to the measured coverage curve — the selector
+    seeds cover most of what wave 1 can reach and the curve plateaus
+    within a few waves, while every second of prepass activity is a
+    second of GIL/core contention stolen from overlapped host analyses
+    on a small box. 1s/contract keeps 2-4 steady-state waves.
+
+    Large corpora (>= OVERLAP_MIN_CORPUS): the prepass overlaps a long
+    train of host analyses and its waves are device-bound, so the
+    budget scales with the corpus — 0.5s/contract, capped at 120s —
+    which at corpus wave sizes fits several waves per transaction
+    phase (the explorer reserves later transactions their share)."""
     if override is not None:
         return override
+    if n_contracts >= OVERLAP_MIN_CORPUS:
+        # floored at the small-corpus cap so crossing the threshold
+        # never SHRINKS the budget (32 contracts must not explore less
+        # than 31)
+        return min(120.0, max(30.0, 0.5 * n_contracts))
     return min(30.0, 1.0 * max(1, n_contracts))
 
 
@@ -74,12 +92,13 @@ def _runnable_rows(
 def corpus_device_prepass(
     contracts: List[Tuple[str, str, str]],
     budget_s: Optional[float] = None,
-    lanes_per_contract: int = 32,
+    lanes_per_contract: Optional[int] = None,
     address: int = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE,
     transaction_count: int = 1,
     host_lock=None,
     stop_event=None,
     publish=None,
+    lock_wanted=None,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
@@ -91,6 +110,25 @@ def corpus_device_prepass(
         return {}
     if budget_s is None:
         budget_s = resolve_prepass_budget_s(len(runnable))
+    if lanes_per_contract is None:
+        # corpus-sized waves: the symbolic kernel is lane-bound on a
+        # tunneled link (~33s/wave at 3328 lanes), so wide stripes at
+        # hundreds of contracts would starve the wave count; narrower
+        # stripes keep several waves per transaction phase
+        lanes_per_contract = 16 if len(runnable) >= 64 else 32
+    # multi-chip: when the backend exposes more than one device, the
+    # striped wave shards lane-major over the dp mesh (SURVEY §2.4's
+    # per-contract-loop axis) — the single-chip path is the mesh path
+    # with one device, so `myth analyze`/analyze_corpus pick the mesh
+    # up with no extra configuration
+    n_devices = None
+    try:
+        import jax
+
+        if len(jax.devices()) > 1:
+            n_devices = len(jax.devices())
+    except Exception:
+        pass
     try:
         from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
 
@@ -99,18 +137,30 @@ def corpus_device_prepass(
             if publish is None
             else (lambda ti, outcome: publish(runnable[ti][0], outcome))
         )
+        at_scale = len(runnable) >= OVERLAP_MIN_CORPUS
         explorer = DeviceCorpusExplorer(
             [code for _, code in runnable],
+            # corpus scale runs LEAN-CAP symbolic waves: the
+            # [N, mem_cap] memory array dominates per-step wave cost
+            # on the tunneled link (explore.py cap notes), and the
+            # degraded-lane counters report what the lean trade
+            # excludes. Small corpora keep the roomy caps — depth per
+            # contract matters more than wave cost there.
+            mem_cap=4096 if at_scale else 16384,
+            storage_cap=64 if at_scale else 128,
             lanes_per_contract=lanes_per_contract,
             waves=8,
             steps_per_wave=512,
             budget_s=budget_s,
             address=address,
             transaction_count=transaction_count,
+            n_devices=n_devices,
             host_lock=host_lock,
             stop_event=stop_event,
             publish=translate,
         )
+        if lock_wanted is not None:
+            explorer.lock_wanted = lock_wanted
         result = explorer.run()
     except Exception:
         log.warning("corpus device prepass failed", exc_info=True)
@@ -172,6 +222,7 @@ class OverlappedPrepass:
         self._final: Dict[int, Dict] = {}
         self._published: Dict[int, Dict] = {}
         self._stop = threading.Event()
+        self._lock_wanted = threading.Event()
         self._deviceless = 0
         self._finished = False
 
@@ -185,6 +236,7 @@ class OverlappedPrepass:
                     host_lock=self.lock,
                     stop_event=self._stop,
                     publish=self._published.__setitem__,
+                    lock_wanted=self._lock_wanted,
                 )
             )
 
@@ -206,9 +258,12 @@ class OverlappedPrepass:
         the budget-bound heavyweights run uncontended with the FINAL
         outcome. (An active-time budget alone cannot bound the
         prepass's wall span: lock waits don't bill, so a 13s budget
-        can stretch across a whole corpus of analyses.)"""
+        can stretch across a whole corpus of analyses.) The join is
+        bounded: a device call hung on a crashed tunnel must cost the
+        corpus two minutes, not a five-minute stall — past the bound
+        the analyses continue on partial outcomes."""
         if self._thread is not None:
-            self._thread.join(timeout=300)
+            self._thread.join(timeout=120)
             self._done()
 
     def outcome_for(self, i: int):
@@ -230,8 +285,15 @@ class OverlappedPrepass:
         """Hand the lock to the prepass thread between analyses:
         CPython locks are unfair and a tight loop would reacquire
         within microseconds, rationing the prepass to one reseed per
-        contract (lock convoy)."""
-        if self._thread is not None and self._thread.is_alive():
+        contract (lock convoy). Only yields when a flip burst is
+        actually waiting — an unconditional sleep would tax every
+        analysis of a large corpus for a lock the prepass wants at
+        most once per wave."""
+        if (
+            self._thread is not None
+            and self._thread.is_alive()
+            and self._lock_wanted.is_set()
+        ):
             time.sleep(0.05)
 
     def finish(self) -> Dict[int, Dict]:
@@ -245,7 +307,10 @@ class OverlappedPrepass:
         self._finished = True
         if self._thread is not None:
             self._stop.set()
-            self._thread.join(timeout=300)
+            # stop is honored between waves; one corpus wave runs
+            # ~30-60s, so 90s means "a wave and slack", while a hung
+            # tunnel call is abandoned instead of stalling the corpus
+            self._thread.join(timeout=90)
             if self._thread.is_alive():
                 log.warning(
                     "corpus device prepass did not stop within its "
@@ -448,14 +513,22 @@ def analyze_corpus(
         # lands get its outcome injected (witness issues,
         # coverage-guided pruning); earlier ones pick up their
         # witnesses in the post-merge, same as the pooled path.
-        # Overlap needs a second core to pay: a wave's host-side
-        # dispatch/sync work contends with the analyses on a 1-core
-        # box (measured: a budget-bound contract analyzed beside a
-        # live prepass thread loses ~30% of its explored states), so
-        # single-core hosts — and lone contracts, which have nothing
-        # to overlap with — run the prepass FIRST, uncontended, then
-        # analyze with the final outcome injected.
-        if use_device and len(contracts) > 1 and _effective_cpus() > 1:
+        # Overlap needs either a second core or a corpus long enough
+        # to amortize the tax: a wave's host-side dispatch/sync work
+        # contends with the analyses on a 1-core box (measured: a
+        # budget-bound contract analyzed beside a live prepass thread
+        # loses ~30% of its explored states on a 13-fixture corpus),
+        # but the waves are device-bound (~2.7s of GIL-held work per
+        # ~33s wave at corpus sizes), so from OVERLAP_MIN_CORPUS
+        # contracts the chip rides along ~free while the CPU
+        # analyzes. Below that, single-core hosts — and lone
+        # contracts, which have nothing to overlap with — run the
+        # prepass FIRST, uncontended, then analyze with the final
+        # outcome injected.
+        if use_device and len(contracts) > 1 and (
+            _effective_cpus() > 1
+            or len(_runnable_rows(contracts)) >= OVERLAP_MIN_CORPUS
+        ):
             pre = OverlappedPrepass(
                 contracts, address, transaction_count, device_budget_s
             )
@@ -478,30 +551,42 @@ def analyze_corpus(
             # thread loses ~30% of its explored states to contention.
             # Sized from the RUNNABLE count (the same filter
             # corpus_device_prepass applies) so rows with no runtime
-            # code don't inflate the contended period.
-            overlap_window_s = 1.25 * resolve_prepass_budget_s(
-                max(1, len(_runnable_rows(contracts))), device_budget_s
-            )
+            # code don't inflate the contended period. Large corpora
+            # get a 2x window: their waves bill active time at nearly
+            # wall rate (flip bursts wait for the lock at most once
+            # per wave), so by 2x the budget the prepass has finished
+            # on its own and the drain is a no-op instead of a
+            # main-thread stall on pure device work.
+            n_run = max(1, len(_runnable_rows(contracts)))
+            overlap_window_s = (
+                2.0 if n_run >= OVERLAP_MIN_CORPUS else 1.25
+            ) * resolve_prepass_budget_s(n_run, device_budget_s)
             t_overlap = time.perf_counter()
             slots: List[Optional[Dict]] = [None] * len(contracts)
-            for i in order:
-                if time.perf_counter() - t_overlap > overlap_window_s:
-                    pre.drain()
-                code, creation_code, name = contracts[i]
-                outcome, device_ok = pre.outcome_for(i)
-                with pre.lock:
-                    slots[i] = _analyze_one(
-                        payload(
-                            code,
-                            creation_code,
-                            name,
-                            use_device and device_ok,
-                            outcome,
+            try:
+                for i in order:
+                    if time.perf_counter() - t_overlap > overlap_window_s:
+                        pre.drain()
+                    code, creation_code, name = contracts[i]
+                    outcome, device_ok = pre.outcome_for(i)
+                    with pre.lock:
+                        slots[i] = _analyze_one(
+                            payload(
+                                code,
+                                creation_code,
+                                name,
+                                use_device and device_ok,
+                                outcome,
+                            )
                         )
-                    )
-                pre.yield_lock()
-            results = slots
-            prepass = pre.finish()
+                    pre.yield_lock()
+                results = slots
+            finally:
+                # an exception (including a caller's alarm/deadline)
+                # must not orphan the prepass thread mid-wave: it would
+                # keep the chip and the host lock busy under whatever
+                # the caller measures next
+                prepass = pre.finish()
         else:
             if use_device:
                 prepass = corpus_device_prepass(

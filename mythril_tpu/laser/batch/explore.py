@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import logging
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -104,6 +105,14 @@ class ExploreStats:
         self.host_sat = 0
         self.branches_covered = 0
         self.carries_banked = 0  # mutating end states promoted to tx N+1
+        # device-cap observability: lanes that halted by *degrading* —
+        # capacity overflow (ERR_MEM) or an off-device opcode
+        # (UNSUPPORTED) — rather than by finishing. These lanes' work
+        # falls back to the host engine, so the counters measure how
+        # much of the modeled space the lean device caps actually
+        # cover on this workload (laser/batch/state.py caps).
+        self.lanes_degraded_mem = 0
+        self.lanes_degraded_unsupported = 0
         self.wall_s = 0.0
         # where the prepass wall goes: device wave execution vs host
         # flip solving (the two phases that can dominate)
@@ -214,6 +223,8 @@ class DeviceCorpusExplorer:
         host_lock=None,
         stop_event=None,
         publish=None,
+        mem_cap: int = 16384,
+        storage_cap: int = 128,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -245,6 +256,10 @@ class DeviceCorpusExplorer:
         # owner end the exploration when its own work is done.
         self.host_lock = host_lock
         self.stop_event = stop_event
+        #: set while this explorer wants/holds the host lock — the
+        #: overlapped owner only needs to yield between analyses when
+        #: a flip burst is actually waiting, not once per contract
+        self.lock_wanted = threading.Event()
         # `publish(track_index, outcome_so_far)` after every wave: in
         # overlapped mode the owner consumes partial outcomes for
         # contracts it analyzes before the exploration completes —
@@ -252,8 +267,16 @@ class DeviceCorpusExplorer:
         # final outcome would (dict writes are GIL-atomic; the value is
         # freshly built, never mutated after publication)
         self.publish = publish
+        #: device model capacities per lane. The [N, mem_cap] memory
+        #: array dominates per-step cost on a tunneled link (measured:
+        #: 152 ms/step at 16384/128 vs 39 ms/step at 4096/64, 3328
+        #: lanes) — corpus callers pass lean caps and the degraded-lane
+        #: counters report what the trade costs
+        self.mem_cap = mem_cap
+        self.storage_cap = storage_cap
         self.rng = random.Random(seed)
         self.stats = ExploreStats()
+        self._phase_allowance: Optional[float] = None
 
         # bucket the code capacity to powers of two so XLA compiles one
         # kernel per size class, not one per corpus composition
@@ -405,10 +428,8 @@ class DeviceCorpusExplorer:
             calldata=[data for _, data in flat],
             caller=DEFAULT_CALLER,
             address=self.address,
-            # real-contract shapes: Solidity's free-memory-pointer
-            # idiom and big dispatch tables stay on device
-            mem_cap=16384,
-            storage_cap=128,
+            mem_cap=self.mem_cap,
+            storage_cap=self.storage_cap,
             storage_seed=storage_seed,
             empty_world=self.empty_world,
             **REPLAY_ENV,
@@ -418,28 +439,39 @@ class DeviceCorpusExplorer:
 
             base = shard_batch(base, self.mesh)
         out, steps = sym_run(
-            make_sym_batch(base), self.code_table, max_steps=self.steps_per_wave
+            make_sym_batch(base),
+            self.code_table,
+            max_steps=self.steps_per_wave,
         )
-        self.stats.waves += 1
-        self.stats.device_steps += int(steps) * len(flat)
+        base_out = out.base
         view = ArenaView(out)
         self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
+        self.stats.waves += 1
+        self.stats.device_steps += int(steps) * len(flat)
 
         # bulk reads: per-lane jax indexing (or per-array np.asarray)
         # pays one device round-trip each — measured ~15s/wave for the
-        # lane-indexed storage journals alone on the tunnel
+        # lane-indexed storage journals alone on the tunnel. The
+        # branch journal is NOT fetched here: ArenaView's bundled
+        # transfer already carries it.
         import jax
 
         status, halt_pc, gas_min, gas_max, *tables = jax.device_get(
             (
-                out.base.status,
-                out.base.pc,
-                out.base.gas_min,
-                out.base.gas_max,
-                out.base.storage_keys,
-                out.base.storage_vals,
-                out.base.storage_cnt,
+                base_out.status,
+                base_out.pc,
+                base_out.gas_min,
+                base_out.gas_max,
+                base_out.storage_keys,
+                base_out.storage_vals,
+                base_out.storage_cnt,
             )
+        )
+        self.stats.lanes_degraded_mem += int(
+            (status == Status.ERR_MEM).sum()
+        )
+        self.stats.lanes_degraded_unsupported += int(
+            (status == Status.UNSUPPORTED).sum()
         )
         for lane, (ci, data) in enumerate(flat):
             track = self.tracks[lane // L]
@@ -530,15 +562,19 @@ class DeviceCorpusExplorer:
         from contextlib import nullcontext
 
         guard = self.host_lock if self.host_lock is not None else nullcontext()
-        with guard:
-            per_contract = [
-                self._collect_flip_candidates(view, ci)
-                for ci in range(len(self.tracks))
-            ]
-            flat = [c for cands in per_contract for c in cands]
-            solved, capped, lowered_batch, kept = self._sprint_flips(
-                [cond for _, cond, _ in flat]
-            )
+        self.lock_wanted.set()
+        try:
+            with guard:
+                per_contract = [
+                    self._collect_flip_candidates(view, ci)
+                    for ci in range(len(self.tracks))
+                ]
+                flat = [c for cands in per_contract for c in cands]
+                solved, capped, lowered_batch, kept = self._sprint_flips(
+                    [cond for _, cond, _ in flat]
+                )
+        finally:
+            self.lock_wanted.clear()
         self._device_flips(solved, lowered_batch, kept)
         # a capped query that the device also failed to answer (or that
         # never compiled) had no genuine attempt; sprint-attempted and
@@ -635,9 +671,13 @@ class DeviceCorpusExplorer:
             self.publish(ci, outcome)
 
     def _budget_spent(self) -> bool:
+        return self._allowance_spent(self._phase_allowance)
+
+    def _allowance_spent(self, allowance: Optional[float]) -> bool:
         if self.stop_event is not None and self.stop_event.is_set():
             return True
-        if self.budget_s is None:
+        budget_s = allowance if allowance is not None else self.budget_s
+        if budget_s is None:
             return False
         # predict the next wave from steady-state waves only — wave 0
         # carries the compile, so until a second wave has run the
@@ -650,27 +690,36 @@ class DeviceCorpusExplorer:
             # overlapped: bill only ACTIVE time — wall spent waiting on
             # the lock is the main thread's analysis time, not ours
             active = self.stats.wave_exec_s + self.stats.flip_solve_s
-            if active > self.budget_s + 45:
+            if active > budget_s + 45:
                 return True
             steady = active - (
                 self._wave_times[0] if self._wave_times else 0.0
             )
-            return steady + predicted > self.budget_s
+            return steady + predicted > budget_s
         # hard stop: the whole prepass — compile included — may cost
         # at most one compile allowance (45s, paid at most once per
         # kernel shape per machine thanks to the persistent cache) on
         # top of the steady-state budget; the compile itself cannot be
         # interrupted from here
-        if time.perf_counter() - self._t_start > self.budget_s + 45:
+        if time.perf_counter() - self._t_start > budget_s + 45:
             return True
         elapsed = time.perf_counter() - self._t0
-        return elapsed + predicted > self.budget_s
+        return elapsed + predicted > budget_s
 
     def run(self) -> Dict:
         """Phase loop: one wave loop per attacker transaction, carries
         (mutated storage journals + their calldata prefixes) advancing
         between phases. Stops at `transaction_count`, on a corpus-wide
         dead end, or on the wall-clock budget."""
+        from mythril_tpu.laser.smt.solver.device_race import DEVICE_BUSY
+
+        DEVICE_BUSY.acquire()
+        try:
+            return self._run_phases()
+        finally:
+            DEVICE_BUSY.release()
+
+    def _run_phases(self) -> Dict:
         self._t_start = self._t0 = time.perf_counter()
         self._wave_times: List[float] = []
         for txn in range(self.transaction_count):
@@ -680,8 +729,29 @@ class DeviceCorpusExplorer:
                     break  # no contract mutated state: tx N+1 is moot
                 for track in self.tracks:
                     track.corpus = []
+            # Cumulative allowance per transaction phase: phase k may
+            # spend at most (k+1)/T of the budget, so phase 1 cannot
+            # eat the whole budget before the later transactions — the
+            # `-t 2` threat model — ever execute (the last phase's
+            # share is the full budget). Without this, a corpus-sized
+            # wave bill starves phase 2 exactly when the multi-tx
+            # exploration matters most.
+            self._phase_allowance = (
+                None
+                if self.budget_s is None
+                else self.budget_s * (txn + 1) / self.transaction_count
+            )
             self.stats.transactions = txn + 1
-            if not self._phase(txn):
+            self._phase(txn)
+            # A spent budget ends the CURRENT phase's wave loop but
+            # does not cancel the remaining transactions: each later
+            # phase still executes its first wave (a phase's opening
+            # wave is unconditional), because `-t N` is the product's
+            # threat model, not an optimization. Worst-case overshoot
+            # is one wave per remaining phase, inside the +45s slack
+            # the hard stop already grants. A stop REQUEST (the
+            # overlapped owner shutting us down) ends everything now.
+            if self.stop_event is not None and self.stop_event.is_set():
                 break
 
         self.stats.branches_covered = sum(len(t.covered) for t in self.tracks)

@@ -1,0 +1,140 @@
+"""CPU-vs-TPU solver race for sprint-unknown queries.
+
+The reference exposes `--parallel-solving` by turning on z3's internal
+thread parallelism (reference: mythril/laser/smt/solver/__init__.py:8-9
+— one process, extra CPU threads per query). The TPU-native equivalent
+races two genuinely different engines on two different processors:
+
+- the incremental CDCL session keeps solving on the CPU (complete:
+  can prove unsat), in short wall slices;
+- the on-chip portfolio local search (laser/smt/solver/portfolio.py)
+  runs the SAME query on the accelerator in a daemon thread
+  (incomplete: a witness proves sat, a miss proves nothing).
+
+The race costs the CPU almost nothing: the thread spends its life
+inside jax dispatch/sync and the ctypes CDCL call releases the GIL, so
+the only host work added is one `compile_program` (off the critical
+path, amortized by the portfolio's compile caches). First finisher
+wins; a device witness is validated against the original constraints
+before it is believed (the same soundness gate every model passes).
+
+At most one race is in flight per process — a queue of stale races
+behind a busy chip would make every later dispatch slower, and a race
+that cannot start simply doesn't happen (the CDCL marathon is the
+complete backstop either way).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class _BusyCounter:
+    """Reentrant chip-occupancy count. A plain Event breaks under
+    nesting: a per-contract explorer finishing inside an overlapped
+    corpus prepass would clear the flag the prepass still relies on,
+    re-enabling races that queue behind multi-second waves."""
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._mu = threading.Lock()
+
+    def acquire(self) -> None:
+        with self._mu:
+            self._count += 1
+
+    def release(self) -> None:
+        with self._mu:
+            if self._count > 0:
+                self._count -= 1
+
+    def is_set(self) -> bool:
+        with self._mu:
+            return self._count > 0
+
+
+#: chip-occupancy flag: held (counted) while a device exploration —
+#: corpus prepass or per-contract explorer, possibly nested — owns the
+#: accelerator; races started then would queue behind multi-second
+#: waves and answer long after the marathon, so they are not started
+DEVICE_BUSY = _BusyCounter()
+
+_INFLIGHT = threading.Semaphore(1)
+
+PENDING = "pending"
+FAILED = "failed"
+
+
+class DeviceRace:
+    """One async portfolio attempt on the accelerator.
+
+    poll() is non-blocking and returns PENDING (still searching),
+    FAILED (finished without a witness / errored / never started), or
+    the raw {var: value} assignment — which the caller must validate
+    via its reconstruction + soundness gate before trusting.
+
+    Construction never raises: a race that cannot start (slot taken,
+    thread exhaustion) reports started=False and the caller's CDCL
+    marathon proceeds alone — a race must never sink the query.
+    """
+
+    def __init__(
+        self,
+        lowered: List,
+        candidates: int = 32,
+        steps: int = 256,
+    ) -> None:
+        self._done = threading.Event()
+        self._assignment: Optional[Dict[str, int]] = None
+        self._started = _INFLIGHT.acquire(blocking=False)
+        if not self._started:
+            self._done.set()
+            return
+        try:
+            self._thread = threading.Thread(
+                target=self._work,
+                args=(list(lowered), candidates, steps),
+                daemon=True,
+                name="device-race",
+            )
+            self._thread.start()
+        except Exception as why:  # e.g. "can't start new thread"
+            log.debug("device race could not start: %s", why)
+            self._started = False
+            self._done.set()
+            _INFLIGHT.release()
+
+    def _work(self, lowered: List, candidates: int, steps: int) -> None:
+        try:
+            from mythril_tpu.laser.smt.solver import portfolio
+
+            self._assignment = portfolio.device_check(
+                lowered, candidates=candidates, steps=steps
+            )
+        except Exception as why:  # a race must never sink the query
+            log.debug("device race attempt failed: %s", why)
+            self._assignment = None
+        finally:
+            self._done.set()
+            _INFLIGHT.release()
+
+    def poll(self):
+        if not self._done.is_set():
+            return PENDING
+        if self._assignment is None:
+            return FAILED
+        return self._assignment
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+
+def race_available() -> bool:
+    """A race may start: the chip is not owned by an exploration and
+    no other race is in flight (checked again, atomically, at start)."""
+    return not DEVICE_BUSY.is_set()

@@ -282,50 +282,6 @@ def _collect_vars(lowered: List[terms.Term]):
     return bv_keys, bool_names
 
 
-class _DeviceGate:
-    """Adaptive throttle for the first-line device attempt: always
-    explores early queries, then requires a ≥20% historical hit rate.
-    Re-probes (so a workload shift can re-open a closed gate) back off
-    exponentially: a fixed every-16th-query probe at seconds per
-    dispatch chain was measured stealing ~15s from a 45s budget-bound
-    contract whose workload the portfolio never hits."""
-
-    def __init__(self) -> None:
-        self.tries = 0
-        self.hits = 0
-        self.consults = 0
-        self.next_probe = 16
-        self.spent_s = 0.0  # wall burned in device attempts
-
-    def open(self) -> bool:
-        self.consults += 1
-        # cost-aware exploration: on a dispatch-floor link (~seconds
-        # per chain) two misses establish the cost and the gate closes;
-        # on clean hardware (ms dispatches) it keeps exploring longer
-        avg_cost = self.spent_s / max(1, self.tries)
-        free_tries = 2 if avg_cost > 1.0 else 8
-        if self.tries < free_tries:
-            return True
-        if self.hits >= 0.2 * self.tries:
-            return True
-        if self.consults >= self.next_probe:
-            self.next_probe = self.consults * 4
-            return True
-        return False
-
-    def hit(self, cost_s: float = 0.0) -> None:
-        self.tries += 1
-        self.hits += 1
-        self.spent_s += cost_s
-
-    def miss(self, cost_s: float = 0.0) -> None:
-        self.tries += 1
-        self.spent_s += cost_s
-
-
-_device_gate = _DeviceGate()
-
-
 def device_solving_enabled() -> bool:
     """First-line on-chip SAT search: on for accelerator backends
     ("auto"), forceable either way via args.device_solving."""
@@ -411,47 +367,85 @@ def check_terms(
     if status == native_sat.UNSAT:
         return unsat, None
     device_tried = False
-    if (
-        status == native_sat.UNKNOWN
-        and not deterministic  # device search timing is load-variable
-        and device_solving_enabled()
-        and len(lowered) >= 2
-        and _device_gate.open()
-    ):
-        from mythril_tpu.laser.smt.solver import portfolio
-
-        device_tried = True
-        t_dev = time.monotonic()
-        asn = portfolio.device_check(lowered, candidates=32, steps=256)
-        if asn is not None:
-            model = _reconstruct(asn, {}, recon, raw_constraints)
-            if model is not None:
-                _device_gate.hit(time.monotonic() - t_dev)
-                SolverStatistics().device_sat_count += 1
-                return sat, model
-        _device_gate.miss(time.monotonic() - t_dev)
-
     if status == native_sat.UNKNOWN:
+        # The marathon. Deterministic mode (and explicit caller
+        # conflict budgets) run it as ONE conflict/wall-bounded call —
+        # the verdict must stay a pure function of the query. Default
+        # mode races the accelerator: a daemon thread runs the on-chip
+        # portfolio search on the same query (~zero CPU cost — jax
+        # dispatch and the ctypes CDCL call both release the GIL)
+        # while the marathon proceeds in short wall slices, polling
+        # the race between slices; the first engine with an answer
+        # wins. This is the TPU-native `--parallel-solving`
+        # (reference: z3 parallel.enable,
+        # mythril/laser/smt/solver/__init__.py:8-9): two engines on
+        # two processors — replacing the round-3 blocking device
+        # attempt that taxed every miss with a full dispatch wait.
         if conflict_budget is None and deterministic:
             # budget sized to bind BEFORE the wall even at the slowest
             # observed conflict rate on bit-blasted CNFs (~10k/s), so
             # the verdict is load-independent; only queries slower
             # than ~8k conflicts/s still fall to the wall valve
             conflict_budget = timeout_ms * 8
-        if deterministic:
+        if deterministic or conflict_budget is not None:
             # the valve must not inherit the sprint's (load-variable)
             # wall consumption, or a hard query flips verdicts under
             # load — the budget above is the binding constraint, the
             # full caller budget the emergency stop (worst ≤2× wall)
-            remaining = timeout_ms
-        else:
-            remaining = max(
-                200, timeout_ms - int((time.monotonic() - t_total) * 1000)
+            remaining = (
+                timeout_ms
+                if deterministic
+                else max(
+                    200,
+                    timeout_ms - int((time.monotonic() - t_total) * 1000),
+                )
             )
-        status, bits = native_session.solve(
-            blaster.nvars, blaster.flat, units, remaining,
-            conflict_budget=conflict_budget,
-        )
+            status, bits = native_session.solve(
+                blaster.nvars, blaster.flat, units, remaining,
+                conflict_budget=conflict_budget,
+            )
+        else:
+            from mythril_tpu.laser.smt.solver import device_race
+
+            race = None
+            if (
+                device_solving_enabled()
+                and len(lowered) >= 2
+                and device_race.race_available()
+            ):
+                race = device_race.DeviceRace(lowered)
+                if not race.started:
+                    race = None
+            device_tried = race is not None
+            while True:
+                if race is not None:
+                    found = race.poll()
+                    if found is device_race.FAILED:
+                        race = None
+                    elif found is not device_race.PENDING:
+                        model = _reconstruct(
+                            found, {}, recon, raw_constraints
+                        )
+                        if model is not None:
+                            SolverStatistics().device_sat_count += 1
+                            return sat, model
+                        race = None  # invalid witness: back to CDCL
+                rem = timeout_ms - int((time.monotonic() - t_total) * 1000)
+                if rem <= 0:
+                    status = native_sat.UNKNOWN
+                    break
+                # short slices only while a race could preempt the
+                # marathon; alone, the session gets the full remainder
+                # (the incremental session keeps learned clauses, so
+                # slicing costs only empty delta loads)
+                slice_ms = min(1000, rem) if race is not None else rem
+                status, bits = native_session.solve(
+                    blaster.nvars, blaster.flat, units, max(200, slice_ms)
+                )
+                if status != native_sat.UNKNOWN:
+                    break
+                if race is None:
+                    break  # full remaining budget spent in one call
     if status == native_sat.UNSAT:
         return unsat, None
     if status == native_sat.UNKNOWN:

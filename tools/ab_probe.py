@@ -1,0 +1,83 @@
+"""Round-4 working probe: corpus A/B legs with knobs, JSON out.
+
+Usage: python tools/ab_probe.py out.json legspec [legspec ...]
+  legspec = name:use_device:race  e.g. devR:auto:on  host:off:off
+Environment: N (corpus size, default 208), ET (exec timeout, default 2).
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+logging.disable(logging.WARNING)
+
+from mythril_tpu.analysis.corpus import analyze_corpus
+from mythril_tpu.analysis.corpusgen import synth_corpus
+from mythril_tpu.support.model import clear_cache
+from mythril_tpu.support.support_args import args
+from mythril_tpu.laser.smt.solver.solver_statistics import SolverStatistics
+
+
+def main():
+    out_path, specs = sys.argv[1], sys.argv[2:]
+    n = int(os.environ.get("N", "208"))
+    et = int(os.environ.get("ET", "2"))
+    corpus = synth_corpus(n)
+    stats = SolverStatistics()
+    stats.enabled = True
+    rows = []
+    for spec in specs:
+        name, dev, race = spec.split(":")
+        use_device = None if dev == "auto" else False
+        args.device_solving = "auto" if race == "on" else "never"
+        clear_cache()
+        d0 = stats.device_sat_count
+        t0 = time.time()
+        res = analyze_corpus(
+            corpus,
+            transaction_count=2,
+            execution_timeout=et,
+            create_timeout=10,
+            use_device=use_device,
+            processes=1,
+        )
+        wall = time.time() - t0
+        pre = max(
+            ((r.get("device_prepass") or {}) for r in res),
+            key=lambda s: s.get("device_steps", 0),
+        )
+        row = {
+            "name": name,
+            "wall_s": round(wall, 1),
+            "issues": sum(len(r["issues"]) for r in res),
+            "errors": sum(1 for r in res if r["error"]),
+            "states": sum(r.get("states", 0) for r in res),
+            "device_sat": stats.device_sat_count - d0,
+            "skips": sum(r.get("precovered_skips") or 0 for r in res),
+            "prepass": {
+                k: pre.get(k)
+                for k in (
+                    "device_steps",
+                    "waves",
+                    "transactions",
+                    "carries_banked",
+                    "wall_s",
+                    "wave_exec_s",
+                    "flip_solve_s",
+                    "witness_issues",
+                )
+            }
+            if pre
+            else None,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        json.dump(rows, open(out_path, "w"), indent=1)
+    args.device_solving = "auto"
+
+
+if __name__ == "__main__":
+    main()
