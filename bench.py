@@ -199,6 +199,26 @@ def bench_corpus_ab(strict: bool = True) -> dict:
     logging.disable(logging.WARNING)
     device_legs, host_legs = [], []
     try:
+        # Warm the wave kernels at the legs' exact shapes (one
+        # untimed wave) — the same rule the transitions metric
+        # applies: jit tracing + compile are once-per-machine costs
+        # (persistent compile cache), not per-corpus costs, and the
+        # first device leg must not carry them into the median.
+        try:
+            from mythril_tpu.analysis.corpus import corpus_device_prepass
+
+            # budget 0: each phase still opens its one unconditional
+            # wave, through the SAME sizing rules (lanes/caps/mesh)
+            # the timed legs resolve — no duplicated shape constants
+            # to rot
+            _with_deadline(
+                lambda: corpus_device_prepass(contracts, budget_s=0.0),
+                180,
+            )
+            print("bench: corpus wave kernels warmed", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: corpus warmup skipped: {e!r}", file=sys.stderr)
+
         for pair in range(CORPUS_PAIRS):
             device_legs.append(
                 _with_deadline(

@@ -89,6 +89,40 @@ def _runnable_rows(
     return rows
 
 
+def corpus_shard(items, shard_index: int, shard_count: int, identity=None):
+    """Deterministic multi-host partition of a corpus — the DCN axis of
+    SURVEY §2.4's per-contract-loop mapping: contracts are
+    embarrassingly parallel across hosts, so scale-out is a stable
+    partition + a report merge, with no cross-host traffic during
+    analysis (the reference's analog is running its sequential loop on
+    a slice of the input list).
+
+    Assignment hashes each item's CONTENT (name + runtime code), not
+    its position, so every host computes the same partition no matter
+    how its filesystem enumerates the inputs. `identity` maps an item
+    to its identity string; the default fits the analyze_corpus row
+    shape (code, creation, name).
+    """
+    import hashlib
+
+    if shard_count < 1:
+        raise ValueError(f"shard count must be >= 1, got {shard_count}")
+    if not 0 <= shard_index < shard_count:
+        raise ValueError(
+            f"shard index {shard_index} outside 0..{shard_count - 1}"
+        )
+    if shard_count == 1:
+        return list(items)
+    if identity is None:
+        identity = lambda row: f"{row[2]}:{row[0]}"  # noqa: E731
+    out = []
+    for item in items:
+        digest = hashlib.sha256(identity(item).encode()).digest()
+        if int.from_bytes(digest[:8], "big") % shard_count == shard_index:
+            out.append(item)
+    return out
+
+
 def corpus_device_prepass(
     contracts: List[Tuple[str, str, str]],
     budget_s: Optional[float] = None,

@@ -273,6 +273,18 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--corpus-shard",),
+        dict(
+            default=None,
+            metavar="I/N",
+            help=(
+                "Analyze only this host's shard of the input contracts "
+                "(deterministic content-hash partition; run one myth per "
+                "host with I=0..N-1 and merge the reports)"
+            ),
+        ),
+    ),
+    (
         ("--sparse-pruning",),
         dict(
             action="store_true",
@@ -723,7 +735,42 @@ def _override_actors(args) -> None:
             )
 
 
+def _apply_corpus_shard(disassembler, args) -> None:
+    """--corpus-shard I/N: keep only this host's content-hash shard of
+    the loaded contracts (analysis/corpus.py corpus_shard)."""
+    spec = getattr(args, "corpus_shard", None)
+    if not spec:
+        return
+    try:
+        index_s, count_s = spec.split("/", 1)
+        index, count = int(index_s), int(count_s)
+    except ValueError:
+        exit_with_error(
+            args.outform, f"--corpus-shard wants I/N, got {spec!r}"
+        )
+    from mythril_tpu.analysis.corpus import corpus_shard
+
+    try:
+        disassembler.contracts[:] = corpus_shard(
+            disassembler.contracts,
+            index,
+            count,
+            identity=lambda c: f"{c.name}:{c.code or ''}",
+        )
+    except ValueError as why:
+        exit_with_error(args.outform, str(why))
+
+
 def _run_analyze(disassembler, address, args):
+    _apply_corpus_shard(disassembler, args)
+    if getattr(args, "corpus_shard", None) and not disassembler.contracts:
+        # a legitimately empty shard (more hosts than contracts) is a
+        # clean no-findings run, not an input error — and it must honor
+        # --outform so multi-host merge scripts can parse every host
+        from mythril_tpu.analysis.report import Report
+
+        _print_report(Report(), args.outform)
+        return
     analyzer = MythrilAnalyzer(
         strategy=args.strategy,
         disassembler=disassembler,

@@ -128,6 +128,11 @@ class _ContractTrack:
 
     def __init__(self, code_hex: str) -> None:
         self.code_hex = code_hex
+        #: dispatcher seeds, computed once — selector recovery
+        #: disassembles the contract, and doing that per PHASE for a
+        #: whole corpus is seconds of GIL time stolen from overlapped
+        #: host analyses
+        self.selector_seeds: Optional[List[bytes]] = None
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[Tuple[int, bytes]] = []  # (carry index, calldata)
@@ -296,15 +301,26 @@ class DeviceCorpusExplorer:
     def _seed_phase_inputs(self) -> List[List[Tuple[int, bytes]]]:
         """Per contract: (carry index, calldata) pairs — every carry
         crossed with the dispatcher seeds, round-robin to the stripe."""
-        from mythril_tpu.laser.batch.seeds import selector_seeds
+        from mythril_tpu.laser.batch.seeds import dispatcher_seeds
 
         stripes = []
         for track in self.tracks:
-            seeds = list(track.parent_inputs)
-            seeds += selector_seeds(
-                track.code_hex, self.lanes_per_contract, self.calldata_len,
-                self.rng,
-            )
+            if track.selector_seeds is None:
+                # cache only the deterministic part (zero + dispatcher
+                # selectors); the random filler below is re-drawn each
+                # phase so later transactions don't replay identical
+                # calldata
+                track.selector_seeds = dispatcher_seeds(
+                    track.code_hex, self.calldata_len
+                )
+            seeds = list(track.parent_inputs) + track.selector_seeds
+            while len(seeds) < self.lanes_per_contract:
+                seeds.append(
+                    bytes(
+                        self.rng.randrange(256)
+                        for _ in range(self.calldata_len)
+                    )
+                )
             n_carries = len(track.carries)
             stripes.append(
                 [

@@ -17,22 +17,55 @@ def code_cap_bucket(max_len: int, floor: int = 1024) -> int:
     return max(floor, 1 << max(max_len - 1, 1).bit_length())
 
 
+PUSH1, PUSH4, PUSH32, EQ, GT = 0x60, 0x63, 0x7F, 0x14, 0x11
+
+
+def scan_selectors(code: bytes) -> List[bytes]:
+    """Dispatcher selectors by a linear opcode sweep: the 4-byte
+    immediate of every PUSH4 directly followed by EQ (Solidity's
+    selector-compare idiom — the same pattern the disassembler's
+    function recovery matches, but without building instruction
+    dicts: a corpus prepass scans hundreds of contracts on the thread
+    that contends with host analyses, so this path is kept at raw
+    byte-sweep cost)."""
+    out: List[bytes] = []
+    pc = 0
+    n = len(code)
+    while pc < n:
+        op = code[pc]
+        width = op - PUSH1 + 1 if PUSH1 <= op <= PUSH32 else 0
+        nxt = pc + 1 + width
+        if (
+            op == PUSH4
+            and nxt < n
+            and code[nxt] in (EQ, GT)
+            and pc + 5 <= n
+        ):
+            out.append(bytes(code[pc + 1 : pc + 5]))
+        pc = nxt
+    return out
+
+
+def dispatcher_seeds(code_hex: str, calldata_len: int) -> List[bytes]:
+    """The deterministic seeds that open a contract's dispatcher: the
+    zero input plus one padded seed per recovered selector."""
+    if code_hex.startswith("0x"):
+        code_hex = code_hex[2:]
+    seeds = [b"\x00" * calldata_len]
+    for selector in scan_selectors(bytes.fromhex(code_hex)):
+        seeds.append(selector.ljust(calldata_len, b"\x00"))
+    return seeds
+
+
 def selector_seeds(
     code_hex: str,
     count: int,
     calldata_len: int,
     rng: random.Random,
 ) -> List[bytes]:
-    """`count` calldata seeds for a contract: the zero input, one seed
-    per recovered function selector, then random fill."""
-    from mythril_tpu.disassembler.disassembly import Disassembly
-
-    if code_hex.startswith("0x"):
-        code_hex = code_hex[2:]
-    seeds = [b"\x00" * calldata_len]
-    for func_hash in Disassembly(code_hex).func_hashes:
-        selector = bytes.fromhex(func_hash[2:])
-        seeds.append(selector.ljust(calldata_len, b"\x00"))
+    """`count` calldata seeds for a contract: the dispatcher seeds,
+    then random fill."""
+    seeds = dispatcher_seeds(code_hex, calldata_len)
     while len(seeds) < count:
         seeds.append(bytes(rng.randrange(256) for _ in range(calldata_len)))
     return seeds[:count]

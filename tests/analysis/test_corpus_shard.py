@@ -1,0 +1,70 @@
+"""Multi-host corpus sharding (analysis/corpus.py corpus_shard — the
+DCN axis of SURVEY §2.4's per-contract-loop mapping)."""
+
+import pytest
+
+from mythril_tpu.analysis.corpus import corpus_shard
+
+
+def rows(n):
+    return [(f"60{i:02x}00", "", f"c{i}") for i in range(n)]
+
+
+def test_partition_is_complete_and_disjoint():
+    corpus = rows(40)
+    shards = [corpus_shard(corpus, i, 4) for i in range(4)]
+    merged = [row for shard in shards for row in shard]
+    assert sorted(merged) == sorted(corpus)
+    names = [set(r[2] for r in s) for s in shards]
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not (names[i] & names[j])
+
+
+def test_partition_is_content_stable():
+    """Hosts must agree on the partition regardless of how each one
+    enumerates the inputs."""
+    corpus = rows(24)
+    shuffled = list(reversed(corpus))
+    for i in range(3):
+        assert sorted(corpus_shard(corpus, i, 3)) == sorted(
+            corpus_shard(shuffled, i, 3)
+        )
+
+
+def test_single_shard_is_identity():
+    corpus = rows(5)
+    assert corpus_shard(corpus, 0, 1) == corpus
+
+
+def test_bad_index_rejected():
+    with pytest.raises(ValueError):
+        corpus_shard(rows(3), 3, 3)
+
+
+def test_cli_flag_parses_and_filters(tmp_path, capsys):
+    """`--corpus-shard 0/2` + `1/2` over the same inputs split the
+    contracts; an empty shard exits cleanly as a no-findings run."""
+    from mythril_tpu.interfaces.cli import _apply_corpus_shard
+
+    class Contract:
+        def __init__(self, name, code):
+            self.name, self.code = name, code
+
+    class Dis:
+        def __init__(self):
+            self.contracts = [Contract(f"c{i}", f"60{i:02x}00") for i in range(8)]
+
+    class Args:
+        outform = "text"
+        corpus_shard = None
+
+    sizes = []
+    for spec in ("0/2", "1/2"):
+        dis = Dis()
+        args = Args()
+        args.corpus_shard = spec
+        _apply_corpus_shard(dis, args)
+        sizes.append(len(dis.contracts))
+    assert sum(sizes) == 8
+    assert all(s < 8 for s in sizes)

@@ -49,9 +49,18 @@ def main():
             ((r.get("device_prepass") or {}) for r in res),
             key=lambda s: s.get("device_steps", 0),
         )
+        phases = {}
+        for r in res:
+            for k, v in (r.get("phases") or {}).items():
+                agg = phases.setdefault(k, {"wall_s": 0.0, "count": 0})
+                agg["wall_s"] += v["wall_s"]
+                agg["count"] += v["count"]
+        for agg in phases.values():
+            agg["wall_s"] = round(agg["wall_s"], 1)
         row = {
             "name": name,
             "wall_s": round(wall, 1),
+            "phases": phases,
             "issues": sum(len(r["issues"]) for r in res),
             "errors": sum(1 for r in res if r["error"]),
             "states": sum(r.get("states", 0) for r in res),
