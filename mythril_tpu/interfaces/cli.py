@@ -735,12 +735,15 @@ def _override_actors(args) -> None:
             )
 
 
-def _apply_corpus_shard(disassembler, args) -> None:
+def _apply_corpus_shard(disassembler, args) -> bool:
     """--corpus-shard I/N: keep only this host's content-hash shard of
-    the loaded contracts (analysis/corpus.py corpus_shard)."""
+    the loaded contracts (analysis/corpus.py corpus_shard). True when
+    sharding emptied a previously NON-empty contract list — the only
+    case the caller may treat as a clean empty-shard run (an input
+    that loaded no contracts at all must still error)."""
     spec = getattr(args, "corpus_shard", None)
-    if not spec:
-        return
+    if not spec or not disassembler.contracts:
+        return False
     try:
         index_s, count_s = spec.split("/", 1)
         index, count = int(index_s), int(count_s)
@@ -759,11 +762,11 @@ def _apply_corpus_shard(disassembler, args) -> None:
         )
     except ValueError as why:
         exit_with_error(args.outform, str(why))
+    return not disassembler.contracts
 
 
 def _run_analyze(disassembler, address, args):
-    _apply_corpus_shard(disassembler, args)
-    if getattr(args, "corpus_shard", None) and not disassembler.contracts:
+    if _apply_corpus_shard(disassembler, args):
         # a legitimately empty shard (more hosts than contracts) is a
         # clean no-findings run, not an input error — and it must honor
         # --outform so multi-host merge scripts can parse every host

@@ -35,12 +35,7 @@ def scan_selectors(code: bytes) -> List[bytes]:
         op = code[pc]
         width = op - PUSH1 + 1 if PUSH1 <= op <= PUSH32 else 0
         nxt = pc + 1 + width
-        if (
-            op == PUSH4
-            and nxt < n
-            and code[nxt] in (EQ, GT)
-            and pc + 5 <= n
-        ):
+        if op == PUSH4 and nxt < n and code[nxt] in (EQ, GT):
             out.append(bytes(code[pc + 1 : pc + 5]))
         pc = nxt
     return out

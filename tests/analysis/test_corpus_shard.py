@@ -42,29 +42,69 @@ def test_bad_index_rejected():
         corpus_shard(rows(3), 3, 3)
 
 
-def test_cli_flag_parses_and_filters(tmp_path, capsys):
+class _Contract:
+    def __init__(self, name, code):
+        self.name, self.code = name, code
+
+
+class _Dis:
+    def __init__(self, n=8):
+        self.contracts = [_Contract(f"c{i}", f"60{i:02x}00") for i in range(n)]
+
+
+class _Args:
+    outform = "json"
+    corpus_shard = None
+
+
+def test_cli_flag_parses_and_filters():
     """`--corpus-shard 0/2` + `1/2` over the same inputs split the
-    contracts; an empty shard exits cleanly as a no-findings run."""
+    contracts between the two hosts."""
     from mythril_tpu.interfaces.cli import _apply_corpus_shard
-
-    class Contract:
-        def __init__(self, name, code):
-            self.name, self.code = name, code
-
-    class Dis:
-        def __init__(self):
-            self.contracts = [Contract(f"c{i}", f"60{i:02x}00") for i in range(8)]
-
-    class Args:
-        outform = "text"
-        corpus_shard = None
 
     sizes = []
     for spec in ("0/2", "1/2"):
-        dis = Dis()
-        args = Args()
+        dis = _Dis()
+        args = _Args()
         args.corpus_shard = spec
-        _apply_corpus_shard(dis, args)
+        emptied = _apply_corpus_shard(dis, args)
+        assert emptied == (not dis.contracts)
         sizes.append(len(dis.contracts))
     assert sum(sizes) == 8
     assert all(s < 8 for s in sizes)
+
+
+def test_cli_empty_shard_is_clean_but_empty_input_is_not():
+    """Sharding a 1-contract corpus across many hosts empties most
+    shards — those are clean no-findings runs (True). A contract list
+    that was ALREADY empty is an input error and must not be masked
+    by the shard flag (False, list untouched)."""
+    from mythril_tpu.interfaces.cli import _apply_corpus_shard
+
+    lonely = _Dis(n=1)
+    probe_args = _Args()
+    probe_args.corpus_shard = "0/2"
+    _apply_corpus_shard(lonely, probe_args)
+    home_shard = 0 if lonely.contracts else 1
+
+    dis = _Dis(n=1)
+    args = _Args()
+    args.corpus_shard = f"{1 - home_shard}/2"
+    assert _apply_corpus_shard(dis, args) is True
+    assert dis.contracts == []
+
+    empty = _Dis(n=0)
+    args = _Args()
+    args.corpus_shard = "0/2"
+    assert _apply_corpus_shard(empty, args) is False
+
+
+def test_cli_empty_shard_report_honors_outform():
+    """The empty-shard early exit must emit a parseable report in the
+    requested outform so multi-host merge scripts never choke."""
+    import json
+
+    from mythril_tpu.analysis.report import Report
+
+    report = json.loads(Report().as_json())
+    assert report["success"] is True and report["issues"] == []

@@ -30,7 +30,9 @@ def main():
     stats.enabled = True
     rows = []
     for spec in specs:
-        name, dev, race = spec.split(":")
+        parts = spec.split(":")
+        name, dev, race = parts[0], parts[1], parts[2]
+        det = len(parts) > 3 and parts[3] == "d"
         use_device = None if dev == "auto" else False
         args.device_solving = "auto" if race == "on" else "never"
         clear_cache()
@@ -43,6 +45,7 @@ def main():
             create_timeout=10,
             use_device=use_device,
             processes=1,
+            deterministic_solving=det or None,
         )
         wall = time.time() - t0
         pre = max(
@@ -90,3 +93,6 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+# legspec extension: name:use_device:race:det — det "d" turns on
+# deterministic solving for the leg

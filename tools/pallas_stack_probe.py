@@ -14,6 +14,21 @@ Prints per-iteration wall for both implementations over a 64-step
 chained scan (forced readback — block_until_ready lies on this link)
 plus a correctness check, and is the measured basis for the roadmap's
 verdict on the Pallas stack path.
+
+MEASURED VERDICT (2026-08-01, v5e over the tunnel — kept for the
+record; see docs/roadmap.md "Pallas stack scatter"): the BlockSpec
+route is a dead end on TPU. Mosaic requires the last two block dims
+divisible by (8, 128) (doubled sublanes for 16-bit dtypes) unless
+equal to the array dims, so a [1, 1, W] per-lane block — the whole
+point of the in-place design — cannot be expressed; the smallest
+legal block already spans 8 stack slots, and slot indices differ per
+lane within any multi-lane block. A hand-rolled HBM DMA kernel
+remains possible but unmotivated: the one-hot merge measured here
+(44-81 ms/iter at [16384,128,16]) is dominated by the scan carrying a
+fresh stack copy per iteration — inside the real jit'd while loop the
+carried state is donated/aliased and the merge fuses with adjacent
+passes (the ENTIRE 75-fusion step runs at ~26 ms/step), so there is
+no 40+ ms standalone write to reclaim.
 """
 
 import os
@@ -49,7 +64,7 @@ def make_pallas_write():
 
         @pl.when(mask_ref[lane] != 0)
         def _():
-            out_ref[...] = val_ref[...]
+            out_ref[0, 0, :] = val_ref[0, :]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # res_idx, mask
