@@ -128,3 +128,54 @@ def test_corpus_overlapped_single_process_device(monkeypatch):
         device_budget_s=30.0,
     )
     _assert_device_corpus_results(results)
+
+
+def test_prepass_budget_is_monotone_at_the_overlap_threshold():
+    """Crossing OVERLAP_MIN_CORPUS must never SHRINK the prepass
+    budget (review regression: 31 contracts got 30s while 32 got
+    16s before the large-corpus floor landed)."""
+    from mythril_tpu.analysis.corpus import (
+        OVERLAP_MIN_CORPUS,
+        resolve_prepass_budget_s,
+    )
+
+    budgets = [
+        resolve_prepass_budget_s(n)
+        for n in range(1, OVERLAP_MIN_CORPUS + 32)
+    ]
+    assert all(b2 >= b1 for b1, b2 in zip(budgets, budgets[1:]))
+
+
+def test_yield_lock_only_when_wanted(monkeypatch):
+    """OverlappedPrepass.yield_lock hands the lock over only while a
+    flip burst is actually waiting — an unconditional sleep taxed
+    every analysis of a large corpus (round-4 lock-wanted handshake).
+    time.sleep is stubbed so the contract (sleep called iff the lock
+    is wanted) is pinned without wall-clock sensitivity."""
+    import mythril_tpu.analysis.corpus as corpus_mod
+    from mythril_tpu.analysis.corpus import OverlappedPrepass
+
+    slept = []
+    monkeypatch.setattr(corpus_mod.time, "sleep", slept.append)
+
+    pre = OverlappedPrepass.__new__(OverlappedPrepass)
+
+    class AliveThread:
+        def is_alive(self):
+            return True
+
+    class Wanted:
+        def __init__(self, value):
+            self.value = value
+
+        def is_set(self):
+            return self.value
+
+    pre._thread = AliveThread()
+    pre._lock_wanted = Wanted(False)
+    pre.yield_lock()
+    assert slept == []  # no yield when nobody is waiting
+
+    pre._lock_wanted = Wanted(True)
+    pre.yield_lock()
+    assert len(slept) == 1

@@ -177,3 +177,24 @@ def test_analyze_graph_html(tmp_path):
         "60",
     )
     assert "vis-network" in out_file.read_text()
+
+
+def test_corpus_shard_cli_both_hosts():
+    """The multi-host workflow end-to-end: the same input analyzed
+    with --corpus-shard 0/2 and 1/2 yields exactly one host with the
+    finding and one clean empty-shard JSON report; a malformed spec
+    errors."""
+    base = (
+        "analyze", "-c", "33ff", "--bin-runtime", "--no-onchain-data",
+        "-t", "1", "-o", "json", "--execution-timeout", "60",
+    )
+    issues = []
+    for shard in ("0/2", "1/2"):
+        out = run_myth(*base, "--corpus-shard", shard)
+        report = json.loads(out.stdout)
+        assert report["success"] is True
+        issues.append([i["swc-id"] for i in report["issues"]])
+    assert sorted(issues) == [[], ["106"]]
+
+    bad = run_myth(*base, "--corpus-shard", "two/4")
+    assert json.loads(bad.stdout)["success"] is False
