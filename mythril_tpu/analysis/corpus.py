@@ -160,7 +160,16 @@ def corpus_device_prepass(
         import jax
 
         if len(jax.devices()) > 1:
+            # shard_batch requires the mesh size to divide the lane
+            # count; shrink to the largest divisor rather than letting
+            # a non-dividing device count sink the whole prepass into
+            # the broad except below (silent host-only degradation)
+            n_lanes = len(runnable) * lanes_per_contract
             n_devices = len(jax.devices())
+            while n_devices > 1 and n_lanes % n_devices:
+                n_devices -= 1
+            if n_devices <= 1:
+                n_devices = None
     except Exception:
         pass
     try:
@@ -259,6 +268,7 @@ class OverlappedPrepass:
         self._lock_wanted = threading.Event()
         self._deviceless = 0
         self._finished = False
+        self._drain_abandoned = False
 
         def _work():
             self._final.update(
@@ -293,12 +303,19 @@ class OverlappedPrepass:
         outcome. (An active-time budget alone cannot bound the
         prepass's wall span: lock waits don't bill, so a 13s budget
         can stretch across a whole corpus of analyses.) The join is
-        bounded: a device call hung on a crashed tunnel must cost the
-        corpus two minutes, not a five-minute stall — past the bound
-        the analyses continue on partial outcomes."""
-        if self._thread is not None:
-            self._thread.join(timeout=120)
-            self._done()
+        bounded AND paid once: a device call hung on a crashed tunnel
+        must cost the corpus two minutes total, not two minutes per
+        remaining contract — after a timed-out drain every later call
+        is a no-op and the analyses continue on partial outcomes."""
+        if self._drain_abandoned or self._thread is None:
+            return
+        self._thread.join(timeout=120)
+        if not self._done():
+            self._drain_abandoned = True
+            log.warning(
+                "corpus device prepass drain timed out; continuing on "
+                "partial outcomes (later drains skipped)"
+            )
 
     def outcome_for(self, i: int):
         """(outcome to inject for contract i, device allowed).
@@ -343,8 +360,11 @@ class OverlappedPrepass:
             self._stop.set()
             # stop is honored between waves; one corpus wave runs
             # ~30-60s, so 90s means "a wave and slack", while a hung
-            # tunnel call is abandoned instead of stalling the corpus
-            self._thread.join(timeout=90)
+            # tunnel call is abandoned instead of stalling the corpus.
+            # A thread a drain already waited 120s on is known hung —
+            # its device call cannot observe the stop event, so another
+            # 90s here would break drain()'s "two minutes total" bound.
+            self._thread.join(timeout=0.1 if self._drain_abandoned else 90)
             if self._thread.is_alive():
                 log.warning(
                     "corpus device prepass did not stop within its "

@@ -689,6 +689,17 @@ class DeviceCorpusExplorer:
     def _budget_spent(self) -> bool:
         return self._allowance_spent(self._phase_allowance)
 
+    def _hard_stop(self) -> bool:
+        """The +45s slack line past which even a phase's guaranteed
+        opening wave is forfeit (billed in the mode's own currency:
+        active time when overlapped, wall otherwise)."""
+        if self.budget_s is None:
+            return False
+        if self.host_lock is not None:
+            active = self.stats.wave_exec_s + self.stats.flip_solve_s
+            return active > self.budget_s + 45
+        return time.perf_counter() - self._t_start > self.budget_s + 45
+
     def _allowance_spent(self, allowance: Optional[float]) -> bool:
         if self.stop_event is not None and self.stop_event.is_set():
             return True
@@ -757,16 +768,19 @@ class DeviceCorpusExplorer:
                 if self.budget_s is None
                 else self.budget_s * (txn + 1) / self.transaction_count
             )
+            if txn >= 2 and self._hard_stop():
+                # A spent budget ends the CURRENT phase's wave loop
+                # but phase 2 (the `-t 2` threat model) still gets its
+                # unconditional opening wave. DEEPER phases only open
+                # while inside the hard stop's +45s slack — without
+                # this gate a `-t 4` corpus run overshoots by one
+                # ~30-60s wave per remaining phase, far past the slack
+                # the budget contract grants.
+                break
             self.stats.transactions = txn + 1
             self._phase(txn)
-            # A spent budget ends the CURRENT phase's wave loop but
-            # does not cancel the remaining transactions: each later
-            # phase still executes its first wave (a phase's opening
-            # wave is unconditional), because `-t N` is the product's
-            # threat model, not an optimization. Worst-case overshoot
-            # is one wave per remaining phase, inside the +45s slack
-            # the hard stop already grants. A stop REQUEST (the
-            # overlapped owner shutting us down) ends everything now.
+            # A stop REQUEST (the overlapped owner shutting us down)
+            # ends everything now.
             if self.stop_event is not None and self.stop_event.is_set():
                 break
 

@@ -14,9 +14,11 @@ mythril/laser/plugin/plugins/plugin_annotations.py):
   stacks one ``DependencyAnnotation`` per open state so the next
   transaction can resume its predecessor's trace.
 
-Copies must be *one level deep*: forked states share slot values (terms
-are immutable) but must not share the containers, or one branch's
-appends would leak into its sibling's trace.
+Copies are *one level deep* for the read trace and block trail (a
+branch's appends must not leak into its sibling), but the
+per-transaction WRITE lists are shared across forks on purpose: the
+pruner reads them as may-write sets, and cross-fork widening only ever
+causes extra re-execution — see ``DependencyAnnotation.__copy__``.
 """
 
 from __future__ import annotations
@@ -57,9 +59,15 @@ class DependencyAnnotation(StateAnnotation):
     def __copy__(self) -> "DependencyAnnotation":
         twin = DependencyAnnotation()
         twin.storage_loaded = list(self.storage_loaded)
-        twin.storage_written = {
-            tx: list(slots) for tx, slots in self.storage_written.items()
-        }
+        # Shallow dict copy ON PURPOSE: the per-transaction write lists
+        # stay SHARED across forks, so one branch's SSTOREs widen its
+        # siblings' recorded write sets. The pruner treats these as
+        # may-write sets — wider sets mean re-executing more
+        # transactions, never fewer — so sharing costs pruning
+        # precision but can never skip a transaction a sibling's write
+        # made relevant (per-fork narrowed sets could, which risks
+        # missed findings, not just precision).
+        twin.storage_written = dict(self.storage_written)
         twin.has_call = self.has_call
         twin.path = list(self.path)
         twin.blocks_seen = set(self.blocks_seen)

@@ -151,13 +151,14 @@ class Optimize(BaseSolver):
         a pure function of the query — convergence under an iteration
         cap with fixed conflict-budgeted steps — so the minimized
         witness cannot vary with machine load; the fixed emergency
-        stop then only exists for pathological objectives, and each
-        step's wall valve is clamped to the time left before that
-        stop, so an objective overruns its wall share by at most
-        REFINE_EMERGENCY_S plus one step's scheduling slop. (The clamp
-        is load-dependent, but only within the emergency regime, which
-        is load-dependent by definition; the conflict budget remains
-        the binding determinism constraint on every healthy step.)"""
+        stop then only exists for pathological objectives and is
+        enforced BETWEEN steps (the loop-head deadline check), never
+        inside one — a step's wall valve stays the fixed
+        REFINE_STEP_MS, because a load-clamped valve would let a slow
+        conflict rate cut a step short and reintroduce exactly the
+        run-to-run witness drift this mode exists to prevent. An
+        objective overruns the emergency stop by at most one full
+        step."""
         from mythril_tpu.support.support_args import args as _args
 
         deterministic = _args.deterministic_solving
@@ -180,10 +181,7 @@ class Optimize(BaseSolver):
                 else terms.ule(terms.bv_const(mid, obj.width), obj)
             )
             if deterministic:
-                step_ms = min(
-                    cls.REFINE_STEP_MS,
-                    max(100, int((deadline - time.monotonic()) * 1000)),
-                )
+                step_ms = cls.REFINE_STEP_MS
                 step_conflicts = cls.REFINE_STEP_CONFLICTS
             else:
                 step_ms = max(

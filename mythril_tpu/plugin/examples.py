@@ -1,0 +1,79 @@
+"""First-party example extension registered under the
+``mythril.plugins`` entry-point group (pyproject.toml).
+
+Two jobs:
+
+1. make L10 reachable in practice — once this package is installed,
+   ``PluginDiscovery`` finds a real entry point instead of an empty
+   group (the reference ships its extension group the same way,
+   /root/reference/setup.py entry_points);
+2. serve as the template third-party plugin authors copy: a
+   ``MythrilLaserPlugin`` is simultaneously package metadata (author,
+   version, default-enabled flag) and a laser ``PluginBuilder`` whose
+   built plugin instruments the symbolic VM through hooks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from mythril_tpu.laser.plugin.interface import LaserPlugin
+from mythril_tpu.plugin.interface import MythrilLaserPlugin
+
+log = logging.getLogger(__name__)
+
+
+class _CoverageMetrics(LaserPlugin):
+    """Counts executed instructions and distinct jump destinations per
+    symbolic VM run and logs the totals when execution stops."""
+
+    def __init__(self) -> None:
+        self.instructions = 0
+        self.jumpdests = set()
+
+    def initialize(self, symbolic_vm) -> None:
+        self.instructions = 0
+        self.jumpdests = set()
+
+        @symbolic_vm.laser_hook("execute_state")
+        def on_state(global_state):
+            self.instructions += 1
+            try:
+                if global_state.get_current_instruction()["opcode"] == "JUMPDEST":
+                    self.jumpdests.add(global_state.mstate.pc)
+            except IndexError:
+                pass
+
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def on_stop():
+            log.info(
+                "coverage-metrics: %d instructions executed, %d distinct "
+                "JUMPDESTs reached",
+                self.instructions,
+                len(self.jumpdests),
+            )
+
+
+class CoverageMetricsPlugin(MythrilLaserPlugin):
+    """The installable wrapper (entry point: ``coverage-metrics``)."""
+
+    def __init__(self, **kwargs):
+        # MythrilPlugin.__init__ does not chain to PluginBuilder's, so
+        # without this the builder lacks `enabled` and
+        # LaserPluginLoader.instrument_virtual_machine crashes
+        super().__init__(**kwargs)
+        self.enabled = True
+
+    author = "mythril_tpu"
+    name = "coverage-metrics"
+    plugin_name = "coverage-metrics"
+    plugin_license = "MIT"
+    plugin_type = "Laser Plugin"
+    plugin_version = "1.0.0"
+    plugin_description = (
+        "Example laser plugin: per-run instruction and JUMPDEST counters"
+    )
+    plugin_default_enabled = False
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return _CoverageMetrics()

@@ -1,0 +1,127 @@
+"""Installed-package plugin discovery smoke test (L10 reachability).
+
+The reference's extension system only works through setuptools entry
+points in installed package metadata (/root/reference/setup.py
+entry_points `mythril.plugins`; mythril/plugin/discovery.py loads the
+group). This harness proves the same path end-to-end WITHOUT a pip
+install: it fabricates a real `.dist-info` on sys.path carrying the
+exact entry point pyproject.toml declares, then drives
+PluginDiscovery -> build_plugin -> MythrilPluginLoader.load and checks
+the example plugin lands in the laser plugin registry.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from mythril_tpu.plugin.discovery import ENTRY_POINT_GROUP, PluginDiscovery
+
+
+@pytest.fixture()
+def installed_example_plugin(tmp_path, monkeypatch):
+    dist = tmp_path / "mythril_tpu_example-1.0.0.dist-info"
+    dist.mkdir()
+    (dist / "METADATA").write_text(
+        "Metadata-Version: 2.1\n"
+        "Name: mythril-tpu-example\n"
+        "Version: 1.0.0\n"
+    )
+    (dist / "entry_points.txt").write_text(
+        textwrap.dedent(
+            f"""\
+            [{ENTRY_POINT_GROUP}]
+            coverage-metrics = mythril_tpu.plugin.examples:CoverageMetricsPlugin
+            """
+        )
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    # Reset on the singleton INSTANCE: the CLI import path populates
+    # the cache as an instance attribute, which would shadow a reset of
+    # the class attribute and skip the re-scan entirely. The teardown
+    # reset keeps the fabricated entry point from leaking into later
+    # tests.
+    PluginDiscovery()._installed_plugins = None
+    yield
+    PluginDiscovery()._installed_plugins = None
+
+
+def test_discovery_finds_entry_point(installed_example_plugin):
+    discovery = PluginDiscovery()
+    assert discovery.is_installed("coverage-metrics")
+    assert "coverage-metrics" in discovery.get_plugins()
+    # not default-enabled: must not appear in the auto-load set
+    assert "coverage-metrics" not in discovery.get_plugins(default_enabled=True)
+
+
+def test_discovered_plugin_builds_and_loads(installed_example_plugin):
+    from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+    from mythril_tpu.plugin.interface import MythrilLaserPlugin
+    from mythril_tpu.plugin.loader import MythrilPluginLoader
+
+    plugin = PluginDiscovery().build_plugin("coverage-metrics", {})
+    assert isinstance(plugin, MythrilLaserPlugin)
+
+    loader = MythrilPluginLoader()
+    before = list(loader.loaded_plugins)
+    try:
+        loader.load(plugin)
+        assert plugin in loader.loaded_plugins
+        assert (
+            LaserPluginLoader().laser_plugin_builders["coverage-metrics"]
+            is plugin
+        )
+        # the builder must be instrumentable: is_enabled reads
+        # builder.enabled, which MythrilPlugin.__init__ does not set
+        assert LaserPluginLoader().is_enabled("coverage-metrics")
+    finally:
+        loader.loaded_plugins[:] = before
+        LaserPluginLoader().laser_plugin_builders.pop("coverage-metrics", None)
+
+
+def test_pyproject_declares_the_same_entry_point():
+    """The fabricated metadata above must stay in lockstep with what a
+    real `pip install` would register."""
+    from pathlib import Path
+
+    text = (Path(__file__).parents[2] / "pyproject.toml").read_text()
+    assert '[project.entry-points."mythril.plugins"]' in text
+    assert (
+        'coverage-metrics = "mythril_tpu.plugin.examples:CoverageMetricsPlugin"'
+        in text
+    )
+    assert 'myth = "mythril_tpu.interfaces.cli:main"' in text
+
+
+def test_example_plugin_instruments_a_vm():
+    """The built plugin's hooks actually fire on a real (tiny) run."""
+    from mythril_tpu.plugin.examples import CoverageMetricsPlugin
+
+    builder = CoverageMetricsPlugin()
+    inner = builder()
+
+    class _Bus:
+        def __init__(self):
+            self.hooks = {}
+
+        def laser_hook(self, name):
+            def deco(fn):
+                self.hooks[name] = fn
+
+            return deco
+
+    bus = _Bus()
+    inner.initialize(bus)
+    assert set(bus.hooks) == {"execute_state", "stop_sym_exec"}
+
+    class _State:
+        mstate = type("M", (), {"pc": 3})()
+
+        def get_current_instruction(self):
+            return {"opcode": "JUMPDEST", "address": 3}
+
+    bus.hooks["execute_state"](_State())
+    bus.hooks["execute_state"](_State())
+    bus.hooks["stop_sym_exec"]()
+    assert inner.instructions == 2
+    assert inner.jumpdests == {3}
