@@ -38,7 +38,6 @@ the speedup the chip delivers over the proxy, not a nominal constant.
 from __future__ import annotations
 
 import json
-import os
 import signal
 import statistics
 import sys
@@ -304,10 +303,9 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
     stepping/solving the TPU did. Runs last, under a deadline: the
     device kernels' first-compile cost must never sink the earlier
     metrics."""
-    from pathlib import Path
+    from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES
 
-    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-    target = ref / "tests" / "testdata" / "inputs" / "exceptions.sol.o"
+    target = GOLDEN_FIXTURES / "exceptions.sol.o"
     if not target.exists():
         return {}
 

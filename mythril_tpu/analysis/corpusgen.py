@@ -88,10 +88,11 @@ def mutate_constants(code: bytes, rng: random.Random) -> bytes:
 
 
 def fixture_dir() -> Path:
-    import os
+    # one resolution rule for all fixture consumers: override ->
+    # vendored in-repo copy -> reference checkout (goldens.py)
+    from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES
 
-    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-    return ref / "tests" / "testdata" / "inputs"
+    return GOLDEN_FIXTURES
 
 
 def load_fixtures(

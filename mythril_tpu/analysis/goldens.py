@@ -21,12 +21,22 @@ from typing import Dict, List, Tuple
 #: the exact-issue-set level
 GOLDEN_EXECUTION_TIMEOUT = 120
 
-GOLDEN_FIXTURES = (
-    Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-    / "tests"
-    / "testdata"
-    / "inputs"
-)
+def _fixture_dir() -> Path:
+    """Explicit override -> the vendored in-repo copy (self-contained
+    suite) -> the reference checkout."""
+    override = os.environ.get("MYTHRIL_REFERENCE_DIR")
+    if override:
+        return Path(override) / "tests" / "testdata" / "inputs"
+    vendored = (
+        Path(__file__).resolve().parents[2]
+        / "tests" / "testdata" / "vendored" / "inputs"
+    )
+    if vendored.is_dir():
+        return vendored
+    return Path("/root/reference") / "tests" / "testdata" / "inputs"
+
+
+GOLDEN_FIXTURES = _fixture_dir()
 
 
 def golden_corpus_run() -> List[Tuple[str, Dict]]:

@@ -1,0 +1,5 @@
+"""Report templates (jinja2 + html), shipped as package data.
+
+This __init__ exists so setuptools' package discovery includes the
+directory in wheels; the templates are loaded by analysis/report.py.
+"""

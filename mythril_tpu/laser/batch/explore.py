@@ -750,6 +750,18 @@ class DeviceCorpusExplorer:
         self._t_start = self._t0 = time.perf_counter()
         self._wave_times: List[float] = []
         for txn in range(self.transaction_count):
+            if txn >= 2 and self._hard_stop():
+                # A spent budget ends the CURRENT phase's wave loop but
+                # phase 2 (the `-t 2` threat model) still gets its
+                # unconditional opening wave; DEEPER phases only open
+                # while inside the hard stop's +45s slack — without
+                # this gate a `-t 4` corpus run overshoots by one
+                # ~30-60s wave per remaining phase. Checked BEFORE
+                # advance_phase(): the break must not first consume the
+                # banked carries and wipe the last phase's corpus stats
+                # (outcomes would publish corpus_size 0 after a full
+                # phase of exploration).
+                break
             if txn > 0:
                 advanced = [t.advance_phase() for t in self.tracks]
                 if not any(advanced):
@@ -768,15 +780,6 @@ class DeviceCorpusExplorer:
                 if self.budget_s is None
                 else self.budget_s * (txn + 1) / self.transaction_count
             )
-            if txn >= 2 and self._hard_stop():
-                # A spent budget ends the CURRENT phase's wave loop
-                # but phase 2 (the `-t 2` threat model) still gets its
-                # unconditional opening wave. DEEPER phases only open
-                # while inside the hard stop's +45s slack — without
-                # this gate a `-t 4` corpus run overshoots by one
-                # ~30-60s wave per remaining phase, far past the slack
-                # the budget contract grants.
-                break
             self.stats.transactions = txn + 1
             self._phase(txn)
             # A stop REQUEST (the overlapped owner shutting us down)

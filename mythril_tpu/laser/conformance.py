@@ -37,12 +37,22 @@ from mythril_tpu.laser.batch.state import (
 )
 from mythril_tpu.ops import u256
 
-VMTESTS_ROOT = Path(
-    os.environ.get(
-        "MYTHRIL_TPU_VMTESTS",
-        "/root/reference/tests/laser/evm_testsuite/VMTests",
+def _vmtests_root() -> Path:
+    """Explicit override -> the vendored in-repo copy (the suite must
+    test itself with nothing mounted) -> the reference checkout."""
+    override = os.environ.get("MYTHRIL_TPU_VMTESTS")
+    if override:
+        return Path(override)
+    vendored = (
+        Path(__file__).resolve().parents[2]
+        / "tests" / "testdata" / "vendored" / "VMTests"
     )
-)
+    if vendored.is_dir():
+        return vendored
+    return Path("/root/reference/tests/laser/evm_testsuite/VMTests")
+
+
+VMTESTS_ROOT = _vmtests_root()
 
 SUITES = [
     "vmArithmeticTest",

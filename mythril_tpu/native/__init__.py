@@ -1,0 +1,7 @@
+"""Native runtime components (C++ sources + the built shared library).
+
+This __init__ exists so setuptools' package discovery ships the
+directory — the .so and sources ride along as package data
+(pyproject.toml [tool.setuptools.package-data]); nothing here is
+importable Python.
+"""

@@ -6,7 +6,7 @@ import pytest
 
 from mythril_tpu.analysis.dispatcher_probe import probe_dispatcher
 
-REFERENCE = Path("/root/reference/tests/testdata/inputs")
+from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES as REFERENCE
 
 
 def test_probe_simple_contract():

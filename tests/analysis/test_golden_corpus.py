@@ -11,13 +11,31 @@ from mythril_tpu.analysis.security import fire_lasers
 from mythril_tpu.analysis.symbolic import SymExecWrapper
 from mythril_tpu.ethereum.evmcontract import EVMContract
 
-REFERENCE_DIR = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-INPUTS = REFERENCE_DIR / "tests" / "testdata" / "inputs"
-EXPECTED = REFERENCE_DIR / "tests" / "testdata" / "outputs_expected"
+from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES as INPUTS
+
+# EXPECTED must follow the same override-first rule as INPUTS
+# (goldens._fixture_dir): a MYTHRIL_REFERENCE_DIR override redirects
+# BOTH, or the easm comparison would diff the override's bytecode
+# against the vendored snapshot's goldens.
+_VENDORED_EASM = (
+    Path(__file__).parents[1] / "testdata" / "vendored" / "outputs_expected_easm"
+)
+if os.environ.get("MYTHRIL_REFERENCE_DIR"):
+    EXPECTED = (
+        Path(os.environ["MYTHRIL_REFERENCE_DIR"])
+        / "tests"
+        / "testdata"
+        / "outputs_expected"
+    )
+elif _VENDORED_EASM.is_dir():
+    EXPECTED = _VENDORED_EASM
+else:
+    EXPECTED = Path("/root/reference/tests/testdata/outputs_expected")
 
 if not INPUTS.is_dir():  # pragma: no cover
     pytest.skip(
-        "reference testdata not found; set MYTHRIL_REFERENCE_DIR",
+        "fixture bytecode not found (vendored copy missing and no "
+        "reference checkout); set MYTHRIL_REFERENCE_DIR",
         allow_module_level=True,
     )
 

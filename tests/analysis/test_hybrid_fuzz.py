@@ -92,13 +92,11 @@ def test_finds_concrete_assert_violation_behind_gate():
 def test_real_contract_assert_triggers():
     """On the reference's compiled exceptions contract the loop must
     produce concrete calldata triggering real assert violations."""
-    import os
-    from pathlib import Path
+    from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES
 
-    ref = Path(os.environ.get("MYTHRIL_REFERENCE_DIR", "/root/reference"))
-    src = ref / "tests" / "testdata" / "inputs" / "exceptions.sol.o"
+    src = GOLDEN_FIXTURES / "exceptions.sol.o"
     if not src.is_file():
-        pytest.skip("reference testdata absent")
+        pytest.skip("fixture bytecode absent")
 
     fuzzer = HybridFuzzer(
         src.read_text().strip(),

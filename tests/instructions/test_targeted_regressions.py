@@ -1,0 +1,280 @@
+"""Targeted per-instruction regression tests.
+
+Mirrors the reference's focused suite layout
+(/root/reference/tests/instructions/: create2_test, create_test,
+extcodehash_test, extcodecopy_test, codecopy_test, sar/shl/shr_test,
+static_call_test) for the post-Constantinople opcodes the vendored
+VMTests generation predates — these semantics otherwise ride on fewer
+direct assertions than the reference keeps.
+
+Shift vectors are the canonical EIP-145 spec examples; the CREATE2
+address check recomputes EIP-1014 independently of the handler.
+"""
+
+import pytest
+
+from mythril_tpu.laser.ethereum.evm_exceptions import WriteProtection
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    ContractCreationTransaction,
+    TransactionStartSignal,
+)
+from mythril_tpu.support.support_utils import get_code_hash, keccak256
+from mythril_tpu.laser.smt import symbol_factory
+
+from tests.instructions.test_instruction_semantics import (
+    bv,
+    make_state,
+    run_op,
+)
+
+MAX = 2**256 - 1
+NEG1 = MAX  # two's-complement -1
+
+
+def run_signal(state, op):
+    """Evaluate an op that must open a nested frame; return the
+    signal."""
+    from mythril_tpu.laser.ethereum.instructions import Instruction
+
+    with pytest.raises(TransactionStartSignal) as excinfo:
+        Instruction(op, None).evaluate(state)
+    return excinfo.value
+
+
+def _write_memory(state, at, data: bytes):
+    state.mstate.mem_extend(at, len(data))
+    for i, b in enumerate(data):
+        state.mstate.memory[at + i] = b
+
+
+# ---------------------------------------------------------------------------
+# EIP-145 shift vectors (spec examples, verbatim)
+# ---------------------------------------------------------------------------
+SHL_VECTORS = [
+    (0x01, 0x00, 0x01),
+    (0x01, 0x01, 0x02),
+    (0x01, 0xFF, 1 << 255),
+    (0x01, 0x100, 0x00),
+    (0x01, 0x101, 0x00),
+    (MAX, 0x00, MAX),
+    (MAX, 0x01, MAX - 1),
+    (MAX, 0xFF, 1 << 255),
+    (MAX, 0x100, 0x00),
+    (0x00, 0x01, 0x00),
+    (1 << 255, 0x01, 0x00),
+]
+
+SHR_VECTORS = [
+    (0x01, 0x00, 0x01),
+    (0x01, 0x01, 0x00),
+    (1 << 255, 0x01, 1 << 254),
+    (1 << 255, 0xFF, 0x01),
+    (1 << 255, 0x100, 0x00),
+    (1 << 255, 0x101, 0x00),
+    (MAX, 0x00, MAX),
+    (MAX, 0x01, MAX >> 1),
+    (MAX, 0xFF, 0x01),
+    (MAX, 0x100, 0x00),
+    (0x00, 0x01, 0x00),
+]
+
+SAR_VECTORS = [
+    (0x01, 0x00, 0x01),
+    (0x01, 0x01, 0x00),
+    (1 << 255, 0x01, 0b11 << 254),
+    (1 << 255, 0xFF, NEG1),
+    (1 << 255, 0x100, NEG1),
+    (1 << 255, 0x101, NEG1),
+    (NEG1, 0x00, NEG1),
+    (NEG1, 0x01, NEG1),
+    (NEG1, 0xFF, NEG1),
+    (NEG1, 0x100, NEG1),
+    (0x00, 0x01, 0x00),
+    (0x4000000000000000000000000000000000000000000000000000000000000000, 0xFE, 0x01),
+    (MAX >> 1, 0xF8, 0x7F),
+    (MAX >> 1, 0xFE, 0x01),
+    (MAX >> 1, 0xFF, 0x00),
+    (MAX >> 1, 0x100, 0x00),
+]
+
+
+def _shift(op, value, shift):
+    state = make_state()
+    state.mstate.stack.append(bv(value))
+    state.mstate.stack.append(bv(shift))
+    return run_op(state, op).mstate.stack[-1].value
+
+
+@pytest.mark.parametrize("value,shift,expected", SHL_VECTORS)
+def test_shl_eip145(value, shift, expected):
+    assert _shift("SHL", value, shift) == expected
+
+
+@pytest.mark.parametrize("value,shift,expected", SHR_VECTORS)
+def test_shr_eip145(value, shift, expected):
+    assert _shift("SHR", value, shift) == expected
+
+
+@pytest.mark.parametrize("value,shift,expected", SAR_VECTORS)
+def test_sar_eip145(value, shift, expected):
+    assert _shift("SAR", value, shift) == expected
+
+
+# ---------------------------------------------------------------------------
+# EXTCODEHASH (EIP-1052)
+# ---------------------------------------------------------------------------
+def test_extcodehash_missing_account_is_zero():
+    state = make_state()
+    state.mstate.stack.append(bv(0x1234567890))  # no such account
+    assert run_op(state, "EXTCODEHASH").mstate.stack[-1].value == 0
+
+
+def test_extcodehash_existing_account_hashes_code():
+    state = make_state()
+    # make_state creates account 101 with code 60006000
+    state.mstate.stack.append(bv(101))
+    out = run_op(state, "EXTCODEHASH").mstate.stack[-1].value
+    assert out == int(get_code_hash("60006000"), 16)
+
+
+def test_extcodehash_truncates_address_to_160_bits():
+    state = make_state()
+    # dirty upper bits must be ignored (address is the low 160 bits)
+    state.mstate.stack.append(bv((0xDEAD << 160) | 101))
+    out = run_op(state, "EXTCODEHASH").mstate.stack[-1].value
+    assert out == int(get_code_hash("60006000"), 16)
+
+
+# ---------------------------------------------------------------------------
+# CODECOPY / EXTCODECOPY
+# ---------------------------------------------------------------------------
+def test_codecopy_copies_own_code_and_zero_pads():
+    state = make_state(code_hex="60026000")
+    # dest=0, code offset=2, length=4 (code is 4 bytes: pads 2 zeros)
+    state.mstate.stack.append(bv(4))
+    state.mstate.stack.append(bv(2))
+    state.mstate.stack.append(bv(0))
+    out = run_op(state, "CODECOPY")
+    got = [out.mstate.memory[i] for i in range(4)]
+    got = [g.value if hasattr(g, "value") else g for g in got]
+    assert got == [0x60, 0x00, 0x00, 0x00]
+
+
+def test_extcodecopy_reads_foreign_code():
+    state = make_state()
+    # copy account 101's 4-byte code to memory at 8
+    state.mstate.stack.append(bv(4))  # length
+    state.mstate.stack.append(bv(0))  # code offset
+    state.mstate.stack.append(bv(8))  # dest
+    state.mstate.stack.append(bv(101))  # address
+    out = run_op(state, "EXTCODECOPY")
+    got = [out.mstate.memory[8 + i] for i in range(4)]
+    got = [g.value if hasattr(g, "value") else g for g in got]
+    assert got == [0x60, 0x00, 0x60, 0x00]
+
+
+# ---------------------------------------------------------------------------
+# CREATE / CREATE2 (EIP-1014)
+# ---------------------------------------------------------------------------
+INIT_CODE = bytes.fromhex("600a600c600039600a6000f3")  # returns 10 bytes
+
+
+def _push_create_args(state, value=0, at=0, length=len(INIT_CODE)):
+    state.mstate.stack.append(bv(length))
+    state.mstate.stack.append(bv(at))
+    state.mstate.stack.append(bv(value))
+
+
+def test_create_opens_creation_transaction():
+    state = make_state()
+    _write_memory(state, 0, INIT_CODE)
+    _push_create_args(state, value=7)
+    signal = run_signal(state, "CREATE")
+    txn = signal.transaction
+    assert isinstance(txn, ContractCreationTransaction)
+    assert txn.code.bytecode == INIT_CODE.hex()
+    assert txn.call_value.value == 7
+    # plain CREATE: address assigned by the engine, not pinned here
+    assert signal.op_code == "CREATE"
+
+
+def test_create2_concrete_salt_pins_eip1014_address():
+    state = make_state()
+    _write_memory(state, 0, INIT_CODE)
+    salt = 0x2A
+    state.mstate.stack.append(bv(salt))
+    _push_create_args(state)
+    signal = run_signal(state, "CREATE2")
+    txn = signal.transaction
+    creator = 101  # make_state's account address
+    preimage = (
+        b"\xff"
+        + creator.to_bytes(20, "big")
+        + salt.to_bytes(32, "big")
+        + keccak256(INIT_CODE)
+    )
+    expected = int.from_bytes(keccak256(preimage)[12:], "big")
+    got = txn.callee_account.address
+    got = got.value if hasattr(got, "value") else got
+    assert got == expected
+
+
+def test_create2_resume_pushes_created_address():
+    from mythril_tpu.laser.ethereum.instructions import Instruction
+
+    state = make_state()
+    for v in (4, 3, 2, 1):  # the 4 original operands, re-popped on resume
+        state.mstate.stack.append(bv(v))
+    state.last_return_data = "0xbebebebebebebebebebebebebebebebebebebebe"
+    out = Instruction("CREATE2", None).evaluate(state, post=True)[0]
+    assert out.mstate.stack[-1].value == 0xBEBEBEBEBEBEBEBEBEBEBEBEBEBEBEBEBEBEBEBE
+
+
+def test_create_resume_failed_creation_pushes_zero():
+    from mythril_tpu.laser.ethereum.instructions import Instruction
+
+    state = make_state()
+    for v in (3, 2, 1):
+        state.mstate.stack.append(bv(v))
+    state.last_return_data = None
+    out = Instruction("CREATE", None).evaluate(state, post=True)[0]
+    assert out.mstate.stack[-1].value == 0
+
+
+# ---------------------------------------------------------------------------
+# WriteProtection inside STATICCALL context (reference:
+# tests/instructions/static_call_test.py)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "op,operands",
+    [
+        ("SSTORE", 2),
+        ("LOG0", 2),
+        ("LOG1", 3),
+        ("LOG2", 4),
+        ("LOG3", 5),
+        ("LOG4", 6),
+        ("CREATE", 3),
+        ("CREATE2", 4),
+        ("SUICIDE", 1),  # 0xff's table mnemonic (SELFDESTRUCT alias)
+    ],
+)
+def test_state_mutators_raise_write_protection_in_static_context(op, operands):
+    from mythril_tpu.laser.ethereum.instructions import Instruction
+
+    state = make_state(static=True)
+    for i in range(operands):
+        state.mstate.stack.append(bv(i))
+    with pytest.raises(WriteProtection):
+        Instruction(op, None).evaluate(state)
+
+
+def test_call_with_value_raises_write_protection_in_static_context():
+    from mythril_tpu.laser.ethereum.instructions import Instruction
+
+    state = make_state(static=True)
+    # gas, to, VALUE=1, in_at, in_len, out_at, out_len
+    for v in (0, 0, 0, 0, 1, 101, 100):
+        state.mstate.stack.append(bv(v))
+    with pytest.raises(WriteProtection):
+        Instruction("CALL", None).evaluate(state)
