@@ -192,7 +192,11 @@ def corpus_device_prepass(
             mem_cap=4096 if at_scale else 16384,
             storage_cap=64 if at_scale else 128,
             lanes_per_contract=lanes_per_contract,
-            waves=8,
+            # the budget (active time) is the real limiter; the wave
+            # cap only backstops a runaway phase. 8 waves starved the
+            # ownership gate: frontier closure + poison seeding need
+            # however many waves the budget affords.
+            waves=48,
             steps_per_wave=512,
             budget_s=budget_s,
             address=address,
@@ -382,6 +386,62 @@ class OverlappedPrepass:
                 self._deviceless,
             )
         return self._final
+
+
+def _ownership_enabled(use_device: bool) -> bool:
+    """Resolve --device-ownership (auto = follow the device axis)."""
+    from mythril_tpu.support.support_args import args
+
+    mode = getattr(args, "device_ownership", "auto")
+    if mode == "never":
+        return False
+    if mode == "always":
+        return True
+    return bool(use_device)
+
+
+def _outcome_owns(outcome: Optional[Dict]) -> bool:
+    """True when a FINAL prepass outcome covered the contract
+    end-to-end (explore.py `device_complete`): frontier closed, no
+    degraded lanes, no dropped carries. Partial (mid-exploration)
+    outcomes never own — completeness is only known at the end."""
+    return bool(
+        outcome
+        and outcome.get("device_complete")
+        and not (outcome.get("stats") or {}).get("partial")
+    )
+
+
+def _owned_result(code, creation_code, name, outcome, address) -> Dict:
+    """The analysis result for a device-owned contract: issues are
+    synthesized from the banked concrete evidence (witness issues +
+    evidence issues, analysis/prepass.py / analysis/evidence.py); the
+    host walk is SKIPPED — this is the round-5 inversion of the
+    reference's per-contract loop (mythril_analyzer.py:145-185)."""
+    from mythril_tpu.analysis.prepass import witness_issues
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    try:
+        contract = EVMContract(
+            code=code or "", creation_code=creation_code or "", name=name
+        )
+        issues = witness_issues(contract, outcome, address)
+    except Exception:
+        # synthesis failed AFTER the walk was skipped on its promise:
+        # None tells the caller to fall back to the host walk
+        log.warning("owned-result synthesis failed for %s", name, exc_info=True)
+        return None
+    stats = dict(outcome.get("stats") or {}, scope="corpus", owned=True)
+    return {
+        "name": name,
+        "issues": [issue.as_dict for issue in issues],
+        "states": 0,
+        "device_prepass": stats,
+        "phases": {},
+        "precovered_skips": 0,
+        "owned": True,
+        "error": None,
+    }
 
 
 def _analyze_one(payload: Tuple) -> Dict:
@@ -616,6 +676,7 @@ def analyze_corpus(
                 2.0 if n_run >= OVERLAP_MIN_CORPUS else 1.25
             ) * resolve_prepass_budget_s(n_run, device_budget_s)
             t_overlap = time.perf_counter()
+            own = _ownership_enabled(use_device)
             slots: List[Optional[Dict]] = [None] * len(contracts)
             try:
                 for i in order:
@@ -623,6 +684,15 @@ def analyze_corpus(
                         pre.drain()
                     code, creation_code, name = contracts[i]
                     outcome, device_ok = pre.outcome_for(i)
+                    if own and device_ok and _outcome_owns(outcome):
+                        # device-complete contract: evidence IS the
+                        # analysis; no walk, no lock, no solver
+                        owned_res = _owned_result(
+                            code, creation_code, name, outcome, address
+                        )
+                        if owned_res is not None:
+                            slots[i] = owned_res
+                            continue
                     with pre.lock:
                         slots[i] = _analyze_one(
                             payload(
@@ -649,14 +719,27 @@ def analyze_corpus(
                     address=address,
                     transaction_count=transaction_count,
                 )
-            results = [
-                _analyze_one(
-                    payload(
-                        code, creation_code, name, use_device, prepass.get(i)
+            own = _ownership_enabled(use_device)
+            results = []
+            for i, (code, creation_code, name) in enumerate(contracts):
+                owned_res = (
+                    _owned_result(
+                        code, creation_code, name, prepass[i], address
                     )
+                    if own and _outcome_owns(prepass.get(i))
+                    else None
                 )
-                for i, (code, creation_code, name) in enumerate(contracts)
-            ]
+                if owned_res is None:
+                    owned_res = _analyze_one(
+                        payload(
+                            code,
+                            creation_code,
+                            name,
+                            use_device,
+                            prepass.get(i),
+                        )
+                    )
+                results.append(owned_res)
     else:
         # pooled hosts: the prepass likewise overlaps the worker pool;
         # witnesses merge in when both finish
@@ -698,6 +781,8 @@ def _merge_prepass_witnesses(
         result = results[i] if i < len(results) else None
         if outcome is None or result is None:
             continue
+        if result.get("owned"):
+            continue  # issues ARE the witnesses; nothing to merge
         result["device_prepass"] = outcome["stats"]
         try:
             contract = EVMContract(code=code or "", name=name)

@@ -140,6 +140,104 @@ def synth_corpus(
     return corpus
 
 
+def loop_contract(iterations_cap: int = 0x7F) -> str:
+    """A hand-assembled deep-loop runtime (BASELINE config-4 shape):
+    `n = calldata[0..31] & cap; while (n) { acc += n; n -= 1 };
+    storage[0] = acc; if (calldata[32] == 0xaa) assert(false)` — the
+    loop count is attacker-chosen, so bounded-loop strategies and the
+    device wave budget both get exercised, and the tail assert keeps a
+    detectable SWC-110 behind real loop work."""
+    loop = 0x0A  # JUMPDEST lands right after the 10-byte prologue
+    # prologue: n = CALLDATALOAD(0) & cap; acc = 0 (stack [acc, n])
+    code = bytes(
+        [0x60, 0x00, 0x35, 0x60, iterations_cap & 0xFF, 0x16, 0x60, 0x00]
+    )
+    code += bytes([0x90, 0x90])  # two SWAP1s (net no-op padding)
+    body = bytes(
+        [
+            0x5B,  # loop: JUMPDEST           [acc, n]
+            0x81, 0x15,  # DUP2; ISZERO       [n==0, acc, n]
+            0x60, 0x00,  # PUSH1 exit (patched below)
+            0x57,  # JUMPI                    [acc, n]
+            0x81, 0x01,  # DUP2; ADD          [acc+n, n]
+            0x90,  # SWAP1                    [n, acc']
+            0x60, 0x01, 0x90, 0x03,  # PUSH1 1; SWAP1; SUB -> [n-1, acc']
+            0x90,  # SWAP1                    [acc', n-1]
+            0x60, loop, 0x56,  # PUSH1 loop; JUMP
+        ]
+    )
+    exit_at = loop + len(body)
+    body = body.replace(bytes([0x60, 0x00, 0x57]), bytes([0x60, exit_at, 0x57]))
+    # exit: storage[0] = acc; if (calldata[32..63] == 0xaa) INVALID
+    tail = bytes([0x5B, 0x60, 0x00, 0x55])  # JUMPDEST; SSTORE
+    guard_at = exit_at + len(tail)
+    fail_at = guard_at + 10
+    tail += bytes(
+        [
+            0x60, 0x20, 0x35,  # PUSH1 32; CALLDATALOAD
+            0x60, 0xAA, 0x14,  # == 0xaa ?
+            0x60, fail_at, 0x57,  # JUMPI fail
+            0x00,  # STOP
+            0x5B, 0xFE,  # fail: JUMPDEST; INVALID (SWC-110)
+        ]
+    )
+    return (code + body + tail).hex()
+
+
+def degrader_contract(copy_at: int = 0x2000) -> str:
+    """A runtime whose first action writes calldata FAR past the lean
+    device memory cap (CALLDATACOPY to `copy_at`): device lanes demote
+    to ERR_MEM and the host takeover carries the contract — the shape
+    that makes the degradation counters a measured number instead of a
+    structural claim. A guarded INVALID behind the copy keeps a real
+    SWC-110 for the host to find."""
+    code = bytes(
+        [
+            0x60, 0x20,  # PUSH1 32 (length)
+            0x60, 0x00,  # PUSH1 0 (calldata offset)
+            0x61, (copy_at >> 8) & 0xFF, copy_at & 0xFF,  # PUSH2 dest
+            0x39,  # CALLDATACOPY
+        ]
+    )
+    guard_at = len(code)
+    fail_at = guard_at + 10
+    code += bytes(
+        [
+            0x60, 0x00, 0x35,  # CALLDATALOAD(0)
+            0x60, 0xAA, 0x14,  # == 0xaa ? (whole-word compare)
+            0x60, fail_at, 0x57,  # JUMPI fail
+            0x00,  # STOP
+            0x5B, 0xFE,  # fail: JUMPDEST; INVALID
+        ]
+    )
+    return code.hex()
+
+
+def synth_bench_corpus(
+    n_contracts: int,
+    seed: int = 2024,
+    loops: int = 4,
+    degraders: int = 4,
+    inputs: Optional[Path] = None,
+) -> List[Tuple[str, str, str]]:
+    """The round-5 benchmark corpus: fixture constant-mutants plus
+    hand-assembled deep-loop and cap-degrading shapes, so the A/B
+    exercises bounded loops, device degradation/takeover, and the
+    ownership gate in one measured run."""
+    rng = random.Random(seed)
+    corpus = synth_corpus(
+        max(0, n_contracts - loops - degraders), seed=seed, inputs=inputs
+    )
+    for k in range(loops):
+        cap = (0x1F, 0x3F, 0x7F, 0xFF)[k % 4]
+        corpus.append((loop_contract(cap), "", f"loop#{k}"))
+    for k in range(degraders):
+        at = 0x2000 + 0x400 * (k % 4)
+        corpus.append((degrader_contract(at), "", f"degrader#{k}"))
+    rng.shuffle(corpus)
+    return corpus[:n_contracts]
+
+
 def _check_skeleton(original: bytes, mutant: bytes) -> bool:
     """Same instruction skeleton: identical opcode bytes at identical
     offsets (only PUSH immediates may differ)."""

@@ -59,6 +59,12 @@ class TxOrigin(ImmediateDetector):
         )
         if not tainted:
             return []
+        from mythril_tpu.analysis.prepass import device_already_proved
+
+        if device_already_proved(state, TX_ORIGIN_USAGE):
+            # a device lane concretely reached this origin-guarded
+            # branch; the banked witness carries the issue
+            return []
         try:
             witness = solver.get_transaction_sequence(
                 state, copy(state.world_state.constraints)

@@ -107,8 +107,19 @@ class PredictableVariables(ImmediateDetector):
     @staticmethod
     def _report_tainted_branch(state: GlobalState) -> list:
         findings = []
+        from mythril_tpu.analysis.prepass import device_already_proved
+
         for taint in state.mstate.stack[-2].annotations:
             if not isinstance(taint, PredictableValueAnnotation):
+                continue
+            swc = (
+                TIMESTAMP_DEPENDENCE
+                if "timestamp" in taint.operation
+                else WEAK_RANDOMNESS
+            )
+            if device_already_proved(state, swc):
+                # a device lane concretely reached this branch; the
+                # banked witness carries the issue
                 continue
             try:
                 witness = solver.get_transaction_sequence(
@@ -118,11 +129,7 @@ class PredictableVariables(ImmediateDetector):
                 continue
             findings.append(
                 Issue(
-                    swc_id=(
-                        TIMESTAMP_DEPENDENCE
-                        if "timestamp" in taint.operation
-                        else WEAK_RANDOMNESS
-                    ),
+                    swc_id=swc,
                     title="Dependence on predictable environment variable",
                     severity="Low",
                     description_head=(

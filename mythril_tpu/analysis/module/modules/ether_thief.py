@@ -45,6 +45,16 @@ class EtherThief(DeferredDetector):
     post_hooks = ["CALL", "STATICCALL"]
 
     def _analyze_state(self, state: GlobalState) -> list:
+        from mythril_tpu.analysis.prepass import device_already_proved
+
+        if device_already_proved(
+            state,
+            UNPROTECTED_ETHER_WITHDRAWAL,
+            address=state.get_current_instruction()["address"] - 1,
+        ):
+            # a device lane concretely sent value to the attacker from
+            # this call site; the banked witness carries the issue
+            return []
         state = copy(state)
         world = state.world_state
 

@@ -63,6 +63,12 @@ class ExternalCalls(DeferredDetector):
     dedupe = False  # the reference re-analyzes every hit
 
     def _analyze_state(self, state: GlobalState) -> list:
+        from mythril_tpu.analysis.prepass import device_already_proved
+
+        if device_already_proved(state, REENTRANCY):
+            # a device lane concretely called the attacker from this
+            # site with forwarded gas; the banked witness carries it
+            return []
         gas, target = state.mstate.stack[-1], state.mstate.stack[-2]
 
         attack_property = Constraints(

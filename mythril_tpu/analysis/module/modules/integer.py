@@ -235,10 +235,16 @@ class IntegerArithmetics(DetectionModule):
 
     # -- transaction end -----------------------------------------------
     def _finalize(self, state: GlobalState) -> None:
+        from mythril_tpu.analysis.prepass import device_already_proved
+
         for taint in _flow_annotation(state).overflowing_state_annotations:
             origin = taint.overflowing_state
 
             if origin in self._known_unsat:
+                continue
+            if device_already_proved(origin, INTEGER_OVERFLOW_AND_UNDERFLOW):
+                # a device lane concretely wrapped at this site and
+                # used the result; its banked witness carries the issue
                 continue
             if origin not in self._known_sat:
                 # cheap pre-check against the origin state's own path

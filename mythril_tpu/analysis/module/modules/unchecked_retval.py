@@ -87,8 +87,16 @@ class UncheckedRetval(ImmediateDetector):
             )
             return []
 
+        from mythril_tpu.analysis.prepass import device_already_proved
+
         found = []
         for entry in pending:
+            if device_already_proved(
+                state, UNCHECKED_RET_VAL, address=entry["address"]
+            ):
+                # a device lane ran this call and halted with no branch
+                # after it — the banked witness carries the issue
+                continue
             try:
                 # unconstrained = both outcomes still satisfiable
                 solver.get_transaction_sequence(

@@ -69,9 +69,22 @@ def get_potential_issues_annotation(state: GlobalState) -> PotentialIssuesAnnota
 
 def check_potential_issues(state: GlobalState) -> None:
     """Validate every pending potential issue against the full path
-    constraints; sat findings move onto their detectors as Issues."""
+    constraints; sat findings move onto their detectors as Issues.
+
+    Candidates the device prepass already holds a concrete witness for
+    (same code, address, and SWC class) skip the expensive validation
+    solve — the banked device issue carries the finding with an
+    identical fingerprint (analysis/evidence.py)."""
+    from mythril_tpu.analysis.prepass import device_already_proved
+
     pending = get_potential_issues_annotation(state)
     for candidate in pending.potential_issues[:]:
+        if device_already_proved(
+            state, candidate.swc_id, address=candidate.address
+        ):
+            pending.potential_issues.remove(candidate)
+            candidate.detector.cache.add(candidate.address)
+            continue
         try:
             witness = get_transaction_sequence(
                 state, state.world_state.constraints + candidate.constraints

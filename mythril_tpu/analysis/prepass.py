@@ -56,15 +56,17 @@ def register_proven(issues, code_hex: str) -> None:
         _PROVEN.add((code_hex, issue.address, issue.swc_id))
 
 
-def device_already_proved(state, swc_id: str) -> bool:
+def device_already_proved(state, swc_id: str, address: int = None) -> bool:
     """True when the prepass banked a concrete witness for the code
-    this state is executing, at its current instruction — the module's
-    Optimize query would re-derive what a concrete execution already
-    established."""
+    this state is executing, at `address` (default: the current
+    instruction) — the module's Optimize query would re-derive what a
+    concrete execution already established."""
     if not _PROVEN:
         return False
     code_hex = _norm_code(getattr(state.environment.code, "bytecode", ""))
-    key = (code_hex, state.get_current_instruction()["address"], swc_id)
+    if address is None:
+        address = state.get_current_instruction()["address"]
+    key = (code_hex, address, swc_id)
     if key in _PROVEN:
         from mythril_tpu.laser.smt.solver.solver_statistics import (
             SolverStatistics,
@@ -95,11 +97,21 @@ def _function_name(contract, calldata: bytes) -> str:
 
 
 def _witness_sequence(
-    contract_address: int, transactions: List[bytes], runtime_hex: str
+    contract_address: int,
+    transactions: List[bytes],
+    runtime_hex: str,
+    initial_storage: Dict = None,
+    values: List[int] = None,
+    initial_balance: int = 0,
 ) -> Dict:
     """A replayable transaction sequence in the shape
     `get_transaction_sequence` produces (analysis/solver.py): one step
-    per attacker transaction, the last one the triggering call."""
+    per attacker transaction, the last one the triggering call.
+    `initial_storage` declares a poisoned-carry witness's synthetic
+    start state (the concolic form of the reference's symbolic initial
+    storage) so the claim is honest about what it assumes."""
+    import json
+
     attacker = "0x" + ("%x" % ACTORS.attacker.value).zfill(40)
     target = hex(contract_address)
     return {
@@ -108,8 +120,12 @@ def _witness_sequence(
                 target: {
                     "nonce": 0,
                     "code": runtime_hex,
-                    "storage": "{}",
-                    "balance": "0x0",
+                    "storage": (
+                        json.dumps(initial_storage, sort_keys=True)
+                        if initial_storage
+                        else "{}"
+                    ),
+                    "balance": hex(initial_balance or 0),
                 },
                 attacker: {
                     "nonce": 0,
@@ -122,12 +138,14 @@ def _witness_sequence(
         "steps": [
             {
                 "input": "0x" + step.hex(),
-                "value": "0x0",
+                "value": (
+                    hex(values[i]) if values and i < len(values) else "0x0"
+                ),
                 "origin": attacker,
                 "address": target,
                 "calldata": "0x" + step.hex(),
             }
-            for step in transactions
+            for i, step in enumerate(transactions)
         ],
     }
 
@@ -169,7 +187,15 @@ def _issue_from_record(
         description_head=head,
         description_tail=tail,
         transaction_sequence=_witness_sequence(
-            address, prefix + [calldata], runtime_hex
+            address,
+            prefix + [calldata],
+            runtime_hex,
+            initial_storage=record.get("initial_storage"),
+            values=(
+                list(record.get("prefix_values") or [])
+                + [record.get("call_value", 0)]
+            ),
+            initial_balance=record.get("initial_balance", 0),
         ),
     )
     issue.provenance = "device-prepass"
@@ -210,4 +236,12 @@ def witness_issues(contract, outcome: Dict, address: int) -> List[Issue]:
                 pc,
                 issue.function,
             )
+    # the round-5 evidence classes (wraps, calls, env branches) ride
+    # the same outcome; synthesis lives in analysis/evidence.py
+    try:
+        from mythril_tpu.analysis.evidence import evidence_issues
+
+        issues.extend(evidence_issues(contract, outcome, address))
+    except Exception:
+        log.debug("evidence synthesis failed", exc_info=True)
     return issues

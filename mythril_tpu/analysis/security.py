@@ -47,12 +47,33 @@ def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issu
     if white_list:
         # honor the user's module selection per finding class: a
         # device witness stands in for exactly one module's finding
-        allowed_swc = set()
-        if "Exceptions" in white_list:
-            allowed_swc.add("110")
-        if "AccidentallyKillable" in white_list:
-            allowed_swc.add("106")
-        device_issues = [i for i in device_issues if i.swc_id in allowed_swc]
+        # SWC-107 is claimed by two modules with distinct titles, so
+        # the filter keys on (swc, title); None matches any title
+        module_claims = {
+            "Exceptions": (("110", None),),
+            "AccidentallyKillable": (("106", None),),
+            "IntegerArithmetics": (("101", None),),
+            "UncheckedRetval": (("104", None),),
+            "EtherThief": (("105", None),),
+            "ExternalCalls": (
+                ("107", "External Call To User-Supplied Address"),
+            ),
+            "StateChangeAfterCall": (
+                ("107", "State access after external call"),
+            ),
+            "ArbitraryDelegateCall": (("112", None),),
+            "TxOrigin": (("115", None),),
+            "PredictableVariables": (("116", None), ("120", None)),
+        }
+        allowed = set()
+        for module_name, claims in module_claims.items():
+            if module_name in white_list:
+                allowed.update(claims)
+        device_issues = [
+            i
+            for i in device_issues
+            if (i.swc_id, None) in allowed or (i.swc_id, i.title) in allowed
+        ]
     if device_issues:
         seen = {
             (issue.contract, issue.address, issue.swc_id) for issue in issues

@@ -325,6 +325,19 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--device-ownership",),
+        dict(
+            choices=["auto", "always", "never"],
+            default="auto",
+            help=(
+                "Let the device OWN contracts its exploration covered "
+                "end-to-end: issues come from the banked concrete "
+                "evidence and the host walk is skipped (auto: on when "
+                "an accelerator backend is present)"
+            ),
+        ),
+    ),
+    (
         ("--unconstrained-storage",),
         dict(
             action="store_true",
@@ -794,6 +807,7 @@ def _run_analyze(disassembler, address, args):
         device_prepass=args.device_prepass,
         device_solving=args.device_solving,
         device_prepass_budget=args.device_prepass_budget,
+        device_ownership=args.device_ownership,
         deterministic_solving=args.deterministic_solving,
     )
 

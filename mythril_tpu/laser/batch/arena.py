@@ -33,6 +33,7 @@ from mythril_tpu.laser.smt import (
     URem,
     symbol_factory,
 )
+from mythril_tpu.laser.batch.symbolic import ENV_LEAF_OPS
 from mythril_tpu.ops import u256
 from mythril_tpu.support.opcodes import OPCODES
 
@@ -63,6 +64,20 @@ class ArenaView:
             self.br_tid,
             self.br_cnt,
             self.calldatasize,
+            self.ev_pc,
+            self.ev_kind,
+            self.ev_tid,
+            self.ev_vtid,
+            self.ev_a,
+            self.ev_b,
+            self.ev_aux,
+            self.ev_gas,
+            self.ev_cnt,
+            self.ev_overflow,
+            self.ret_off,
+            self.ret_len,
+            self.sval_tid,
+            self.mem_tid_head,
             count,
         ) = jax.device_get(
             (
@@ -76,10 +91,29 @@ class ArenaView:
                 symb.br_tid,
                 symb.base.br_cnt,
                 symb.base.calldatasize,
+                symb.ev_pc,
+                symb.ev_kind,
+                symb.ev_tid,
+                symb.ev_vtid,
+                symb.ev_a,
+                symb.ev_b,
+                symb.ev_aux,
+                symb.ev_gas,
+                symb.ev_cnt,
+                symb.ev_overflow,
+                symb.ret_off,
+                symb.ret_len,
+                symb.sval_tid,
+                # RETURN windows live in low memory in compiler output;
+                # the 512-byte head keeps the bundled transfer small
+                # while covering them (beyond-head windows degrade to
+                # "unused", which only costs pre-emption)
+                symb.mem_tid[:, :512],
                 symb.ar_count,
             )
         )
         self.count = int(count)
+        self._closure: Dict[int, frozenset] = {}
         self._terms: Dict[int, BitVec] = {}
         self._cd_bytes: Dict[int, BitVec] = {}
         self._fresh = 0
@@ -120,6 +154,12 @@ class ArenaView:
         opcode = _NAME.get(int(self.op[row]))
         if opcode is None:
             return None
+
+        if opcode in ENV_LEAF_OPS:
+            # environment leaf: decodes to the wave's pinned concrete
+            # value, so env-guarded flips solve to REPLAYABLE calldata
+            # (symbolic.py ENV_LEAF_OPS); provenance via dag_source_ops
+            return symbol_factory.BitVecVal(u256.to_int(self.va[row]), 256)
 
         if opcode == "CALLDATALOAD":
             offset = u256.to_int(self.va[row])
@@ -215,6 +255,118 @@ class ArenaView:
             return self._fresh_word("exp")
         log.debug("arena decode: unsupported node op %s", opcode)
         return None
+
+    # -- evidence banks -------------------------------------------------
+    def events(self, lane: int) -> List[Dict]:
+        """The lane's banked detection events (symbolic.py EV_* kinds),
+        decoded: concrete operand values as ints, term ids raw."""
+        n = min(int(self.ev_cnt[lane]), self.ev_pc.shape[1])
+        return [
+            {
+                "pc": int(self.ev_pc[lane, k]),
+                "kind": int(self.ev_kind[lane, k]),
+                "tid": int(self.ev_tid[lane, k]),
+                "vtid": int(self.ev_vtid[lane, k]),
+                "a": u256.to_int(self.ev_a[lane, k]),
+                "b": u256.to_int(self.ev_b[lane, k]),
+                "aux": int(self.ev_aux[lane, k]),
+                "gas": int(self.ev_gas[lane, k]),
+            }
+            for k in range(n)
+        ]
+
+    def subterms(self, tid: int) -> frozenset:
+        """All node ids reachable from `tid` (itself included) — the
+        dataflow closure, memoized per arena. Usage checks reduce to
+        'is the wrap node's id in some used root's closure'."""
+        if tid <= 0:
+            return frozenset()
+        cached = self._closure.get(tid)
+        if cached is not None:
+            return cached
+        out = set()
+        stack = [tid]
+        while stack:
+            t = stack.pop()
+            if t <= 0 or t in out:
+                continue
+            out.add(t)
+            row = t - 1
+            if row < self.count:
+                stack.append(int(self.a[row]))
+                stack.append(int(self.b[row]))
+        result = frozenset(out)
+        self._closure[tid] = result
+        return result
+
+    def used_roots(self, lane: int) -> List[int]:
+        """Term ids the lane USED in the reference module's sense
+        (mythril integer.py promotes wrap taints at SSTORE/JUMPI/CALL/
+        RETURN): every journal decision plus the end-state storage
+        journal values plus banked call values. RETURN-window memory
+        taints ride through the final mem tids the caller holds."""
+        roots = [tid for _, _, tid in self.journal(lane) if tid > 0]
+        roots += [int(t) for t in self.sval_tid[lane] if t > 0]
+        for ev in self.events(lane):
+            if ev["vtid"] > 0:
+                roots.append(ev["vtid"])
+            if 4 <= ev["kind"] <= 7 and ev["tid"] > 0:  # call target
+                roots.append(ev["tid"])
+        # the RETURN window's memory taints (integer.py's _use_return)
+        off, length = int(self.ret_off[lane]), int(self.ret_len[lane])
+        if off >= 0 and length > 0:
+            window = self.mem_tid_head[lane, off : off + length]
+            roots += [int(t) for t in window if t > 0]
+        return roots
+
+    def wrap_used(self, lane: int, wrap_tid: int) -> bool:
+        """True when the wrapped result's term flows into a used root."""
+        if wrap_tid <= 0:
+            return False
+        return any(
+            wrap_tid in self.subterms(root) for root in self.used_roots(lane)
+        )
+
+    def row_operand_terms(self, tid: int, lane: int):
+        """(a, b) operand terms of a node (constants folded in) — the
+        raw material for steering conditions like 'make this SUB
+        underflow'. None when the node or an operand is opaque."""
+        if tid <= 0 or tid - 1 >= self.count:
+            return None
+        row = tid - 1
+        a = self._operand(int(self.a[row]), self.va[row], lane)
+        b = self._operand(int(self.b[row]), self.vb[row], lane)
+        if a is None or b is None:
+            return None
+        return a, b
+
+    @staticmethod
+    def _neg_sources(t: int) -> set:
+        bits = min(-t - 1, 3)
+        out = set()
+        if bits & 1:
+            out.add("ORIGIN")
+        if bits & 2:
+            out.add("BLOCKHASH")
+        return out
+
+    def dag_source_ops(self, tid: int) -> set:
+        """Opcode names of the leaf/interior rows in `tid`'s closure —
+        'what did this decision depend on'. Negative ids (standalone
+        or as row operands: rows exist over opaque operands too)
+        contribute their provenance pseudo-sources."""
+        if tid < 0:
+            return self._neg_sources(tid)
+        out = set()
+        for t in self.subterms(tid):
+            row = t - 1
+            if row >= self.count:
+                continue
+            out.add(_NAME.get(int(self.op[row]), "?"))
+            for operand in (int(self.a[row]), int(self.b[row])):
+                if operand < 0:
+                    out |= self._neg_sources(operand)
+        return out
 
     # -- path constraints ----------------------------------------------
     def journal(self, lane: int) -> List[Tuple[int, bool, int]]:

@@ -84,8 +84,34 @@ TRIGGER_KINDS = {
     Status.KILLED: "selfdestruct",
 }
 
+#: event-kind byte -> call mnemonic (symbolic.py EV_*)
+CALL_EVENT_KINDS = {4: "CALL", 5: "CALLCODE", 6: "DELEGATECALL", 7: "STATICCALL"}
+WRAP_EVENT_OPS = {1: "addition", 2: "subtraction", 3: "multiplication"}
+#: env sources the predictable-vars module hooks (DIFFICULTY is a leaf
+#: for flippability but the reference module does not report it)
+PREDICTABLE_SRCS = ("TIMESTAMP", "NUMBER", "COINBASE", "GASLIMIT", "BLOCKHASH")
+GAS_STIPEND = 2300
+
 #: carried next-transaction start states kept per contract per phase
-CARRY_CAP = 4
+CARRY_CAP = 16
+
+#: the adversarial values poisoned-storage carries seed into observed
+#: slots — the concolic stand-in for the host engine's symbolic
+#: initial storage ("the contract may be in any prior state"). Two
+#: carries per contract: MAX makes guarded reads pass and
+#: receiving-side adds wrap (SWC-101); the attacker's address makes
+#: storage-held callees resolve to the attacker (SWC-105/107/112 —
+#: the reference solves `storage_slot == attacker` the same way).
+POISON_VALUE = 2**256 - 1
+POISON_ADDR = DEFAULT_CALLER
+#: observed-slot cap per contract (per poison carry, many slots)
+POISON_SLOTS = 8
+
+#: msg.value seeded on the callvalue-axis carries of contracts whose
+#: code reads CALLVALUE — the concolic stand-in for the host's
+#: symbolic call value (1 ETH: passes `msg.value > 0` guards, small
+#: enough that profit gates stay meaningful)
+CALLVALUE_SEED = 10**18
 
 
 class ExploreStats:
@@ -136,6 +162,10 @@ class _ContractTrack:
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[Tuple[int, bytes]] = []  # (carry index, calldata)
+        #: solver-derived inputs (flip/steer witnesses) — the seeds
+        #: worth carrying into the next transaction phase ahead of
+        #: mutation filler
+        self.flip_corpus: List[bytes] = []
         #: kind -> [{pc, input, prefix, gas_min, gas_max}]; pc is the
         #: faulting instruction (the step kernel pins a halted lane's
         #: pc there), prefix the calldata of the transactions before
@@ -143,19 +173,246 @@ class _ContractTrack:
         self.triggers: Dict[str, List[Dict]] = {}
         self.exhausted = False  # no flips left last time we looked
         self.parent_inputs: List[bytes] = []  # last phase's distinct inputs
+        #: concrete detection evidence, keyed (class, pc[, detail]) —
+        #: every record carries the exhibiting lane's replayable input
+        #: (analysis/evidence.py turns these into Issues)
+        self.evidence: Dict[Tuple, Dict] = {}
+        #: property-steering queries already dispatched (pc, kind)
+        self.prop_attempted: Set[Tuple[int, int]] = set()
+        # -- device-completeness accounting (ownership gate) ----------
+        #: lanes of this contract that degraded (ERR_MEM/UNSUPPORTED):
+        #: their work fell back to the host, so the device's view of
+        #: the contract is partial
+        self.degraded = 0
+        #: a carry was dropped at CARRY_CAP: some tx-N+1 start state
+        #: was never explored
+        self.carry_overflow = False
+        #: every finished phase ended with the frontier genuinely
+        #: closed (exhausted, no retriable candidates) — False the
+        #: moment a phase ends on budget/wave-cap instead
+        self.frontier_closed = True
+        #: never-written slots the device observed SLOADs of
+        self.storage_reads: Set[int] = set()
+        #: arith sites over opaque operands that never wrapped — each
+        #: must be resolved (a wrap witness, or an ANSWERED node-site
+        #: steering query at the same pc) or the contract stays
+        #: host-owned
+        self.opaque_sites: Set[int] = set()
+        #: steering queries that got a genuine answer (unsat, or sat
+        #: with the wrap then confirmed concretely) — attempts alone
+        #: resolve nothing
+        self.prop_resolved: Set[Tuple[int, int]] = set()
+        #: branch targets whose path condition could not be decoded
+        #: (opaque prefix): unflippable — complete only if some
+        #: concrete lane covered them anyway
+        self.opaque_branches: Set[Tuple[int, bool]] = set()
+        #: the per-lane evidence bank overflowed: completeness inputs
+        #: (opaque sites, storage reads) may be truncated
+        self.event_overflow = False
+        #: the synthetic adversarial-storage start states (MAX and
+        #: attacker-address variants; grown in place as reads surface)
+        self.poison_carries: List[Dict] = []
+        #: does the bytecode read msg.value? (byte scan over-approxes
+        #: into PUSH data — a harmless extra carry)
+        self.uses_callvalue = 0x34 in bytes.fromhex(self.code_hex)
         #: this phase's transaction start states
         self.carries: List[Dict] = [{"journal": {}, "prefix": []}]
+        if self.uses_callvalue:
+            # the msg.value axis: one value-bearing start state
+            self.carries.append(
+                {"journal": {}, "prefix": [], "callvalue": CALLVALUE_SEED}
+            )
         #: mutating end states collected for the NEXT transaction,
         #: keyed by canonicalized journal (the device mutation pruner)
         self.next_carries: Dict[Tuple, Dict] = {}
         self.idle = False  # no start states left for this phase
 
-    def bank_carry(self, journal: Dict[int, int], prefix: List[bytes]) -> bool:
+    def device_complete(self) -> bool:
+        """True when the striped exploration covered this contract's
+        bounded model end-to-end: every phase's frontier closed, no
+        lane degraded off-device, no carry dropped, and every opaque
+        arith site resolved (wrapped concretely, or steering-checked
+        through its node form at the same pc). The ownership gate
+        (analysis/corpus.py): a complete contract's issues come from
+        the evidence bank alone and the host walk is skipped."""
+        steered = {p for (p, k) in self.prop_resolved if k in (10, 11, 12)}
+        unresolved = {
+            pc
+            for pc in self.opaque_sites
+            if ("wrap", pc) not in self.evidence and pc not in steered
+        }
+        return (
+            not self._unresolved_steering()
+            and self.frontier_closed
+            and self.degraded == 0
+            and not self.carry_overflow
+            and not self.event_overflow
+            and not unresolved
+            # every unflippable (opaque-prefix) branch target must have
+            # been covered concretely by some lane
+            and self.opaque_branches <= self.covered
+            # an unseeded poisoned state means the storage dimension
+            # was never sampled: whatever it would have exhibited is
+            # unknown, so the host walk keeps the contract
+            and not self.unseeded_poison()
+        )
+
+    def bank_carry(
+        self,
+        journal: Dict[int, int],
+        prefix: List[bytes],
+        parent: Optional[Dict] = None,
+    ) -> bool:
         key = tuple(sorted(journal.items()))
-        if key in self.next_carries or len(self.next_carries) >= CARRY_CAP:
+        if key in self.next_carries:
             return False
-        self.next_carries[key] = {"journal": journal, "prefix": prefix}
+        if len(self.next_carries) >= CARRY_CAP:
+            # a DISTINCT mutated end state was dropped: the next
+            # transaction's exploration is knowingly partial
+            self.carry_overflow = True
+            return False
+        carry = {"journal": journal, "prefix": prefix}
+        if parent:
+            if parent.get("base"):
+                # descendants of a poisoned start state keep its
+                # synthetic initial storage: any witness they produce
+                # must declare it
+                carry["base"] = parent["base"]
+            if parent.get("balance"):
+                carry["balance"] = parent["balance"]
+            # per-transaction msg.value trail (witness steps + the
+            # attacker-profit gate)
+            carry["prefix_values"] = parent.get("prefix_values", []) + [
+                parent.get("callvalue", 0)
+            ]
+        self.next_carries[key] = carry
         return True
+
+    def ensure_poison_carries(self) -> None:
+        """Create/refresh the adversarial-storage start states from
+        the observed never-written reads. Mutated in place: carries
+        are referenced by index, and the next wave's make_batch reads
+        the journals fresh."""
+        if not self.storage_reads:
+            return
+        if not self.poison_carries:
+            # MAX and attacker-address variants run VALUE-FREE (a
+            # value-bearing start reverts at every non-payable guard);
+            # payable contracts get one extra MAX+msg.value combo for
+            # the `balances[x] += msg.value` wrap family
+            variants = 3 if self.uses_callvalue else 2
+            for k in range(variants):
+                # every poisoned state also holds a funded contract
+                # balance (`send(this.balance)` shapes — the host
+                # models balances symbolically; witnesses declare it)
+                carry = {
+                    "journal": {},
+                    "prefix": [],
+                    "base": {},
+                    "balance": CALLVALUE_SEED,
+                }
+                if k == 2:
+                    carry["callvalue"] = CALLVALUE_SEED
+                self.poison_carries.append(carry)
+                self.carries.append(carry)
+        values = (POISON_VALUE, POISON_ADDR, POISON_VALUE)
+        for value, carry in zip(values, self.poison_carries):
+            for slot in sorted(self.storage_reads)[:POISON_SLOTS]:
+                if slot not in carry["journal"]:
+                    # a new slot means the poisoned state changed: it
+                    # deserves a fresh seeding pass
+                    carry["seeded"] = False
+                carry["journal"][slot] = value
+                carry["base"][slot] = value
+        # Per-slot SINGLES: uniform poison blocks guarded paths (a
+        # MAX-poisoned `minInvestment` reverts the same function whose
+        # poisoned balance would wrap), so each observed slot also gets
+        # lone-slot MAX and attacker-address states — the closest
+        # concolic analogue of the solver picking per-slot values.
+        keys = getattr(self, "_poison_keys", None)
+        if keys is None:
+            keys = self._poison_keys = set()
+        # MAX singles only: the attacker-address dimension rides the
+        # all-ADDR variant (callee/owner slots resolve together there),
+        # while wrap-guard interplay needs each slot isolated at MAX
+        for slot in sorted(self.storage_reads)[:POISON_SLOTS - 2]:
+            k = (slot, POISON_VALUE)
+            if k in keys or len(self.poison_carries) >= 9:
+                continue
+            keys.add(k)
+            carry = {
+                "journal": {slot: POISON_VALUE},
+                "prefix": [],
+                "base": {slot: POISON_VALUE},
+                "balance": CALLVALUE_SEED,
+            }
+            if self.uses_callvalue:
+                carry["callvalue"] = CALLVALUE_SEED
+            self.poison_carries.append(carry)
+            self.carries.append(carry)
+
+    def unseeded_poison(self) -> List[int]:
+        return [
+            i
+            for i in self.poison_indices()
+            if not self.carries[i].get("seeded")
+        ]
+
+    def _unresolved_steering(self) -> bool:
+        """A steering query that was dispatched but never got a real
+        answer — sprint-capped, lowering-failed, or sat-but-never-
+        confirmed-concretely — leaves its property OPEN: the host walk
+        must keep the contract."""
+        for key in self.prop_attempted:
+            pc, k = key
+            if key in self.prop_resolved:
+                continue
+            if k in (10, 11, 12) and ("wrap", pc) in self.evidence:
+                continue
+            if k in (4, 6):
+                mnemonic = {4: "CALL", 6: "DELEGATECALL"}[k]
+                rec = self.evidence.get(("call", pc, mnemonic))
+                if rec is not None and rec.get("to_attacker"):
+                    continue
+            return True
+        return False
+
+    def result_stored_in_block(self, pc: int) -> bool:
+        """Static stand-in for the wrap-usage check when the result is
+        term-opaque: does the basic block continuing at `pc` reach one
+        of integer.py's promotion sites (SSTORE, RETURN, CALL — or a
+        JUMPI, whose in-block condition chain the result feeds) before
+        a plain control transfer? Linear byte sweep, PUSH data skipped
+        — the `SLOAD ADD ... SSTORE` / `MUL ... GT ... JUMPI` compiler
+        shapes this covers have no interior branches."""
+        cached = getattr(self, "_stored_memo", None)
+        if cached is None:
+            cached = self._stored_memo = {}
+        hit = cached.get(pc)
+        if hit is not None:
+            return hit
+        code = bytes.fromhex(self.code_hex)
+        at = pc
+        out = False
+        for _ in range(48):
+            if at >= len(code):
+                break
+            op = code[at]
+            if op in (0x55, 0xF3, 0xF1, 0x57):
+                out = True  # SSTORE / RETURN / CALL / JUMPI use sites
+                break
+            if op in (0x00, 0x56, 0xFD, 0xFE, 0xFF):
+                break  # STOP/JUMP/REVERT/INVALID/SELFDESTRUCT
+            at += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+        cached[pc] = out
+        return out
+
+    def poison_indices(self) -> List[int]:
+        return [
+            i
+            for i, c in enumerate(self.carries)
+            if any(c is p for p in self.poison_carries)
+        ]
 
     def advance_phase(self) -> bool:
         """Promote the banked carries to the next transaction's start
@@ -164,14 +421,27 @@ class _ContractTrack:
         # seeds for the next one: a branch direction that was a dead
         # end under empty storage may open under the carried journal,
         # and the global covered-set keeps it off the flip frontier.
-        # Latest first — the flip witnesses arrive in later waves and
-        # must land inside the next phase's seed window
+        # SOLVER-DERIVED witnesses first (they opened branches nothing
+        # else reaches), then the rest latest-first — plain
+        # reversed(corpus) buries a wave's flip witnesses behind its
+        # own mutation filler and they fall out of the seed window.
         seen = set()
         self.parent_inputs = [
+            data
+            for data in reversed(self.flip_corpus)
+            if not (data in seen or seen.add(data))
+        ] + [
             data
             for _, data in reversed(self.corpus)
             if not (data in seen or seen.add(data))
         ]
+        self.flip_corpus = []
+        # fresh phase, fresh poison: the carried states already hold
+        # last phase's written slots; new never-written reads surface
+        # their own synthetic start state
+        self.poison_carries = []
+        self.storage_reads = set()
+        self._poison_keys = set()
         if not self.next_carries:
             self.idle = True
             # keep a placeholder so the lane stripe stays shape-stable
@@ -198,6 +468,16 @@ class _ContractTrack:
                 ]
                 for kind, bucket in self.triggers.items()
             },
+            "evidence": [
+                dict(
+                    rec,
+                    input=rec["input"].hex(),
+                    prefix=[p.hex() for p in rec["prefix"]],
+                )
+                for rec in self.evidence.values()
+            ],
+            "device_complete": self.device_complete(),
+            "degraded_lanes": self.degraded,
         }
 
 
@@ -438,24 +718,61 @@ class DeviceCorpusExplorer:
             self.tracks[lane // L].carries[ci]["journal"]
             for lane, (ci, _) in enumerate(flat)
         ]
+        callvalues = [
+            self.tracks[lane // L].carries[ci].get("callvalue", 0)
+            for lane, (ci, _) in enumerate(flat)
+        ]
+        env = dict(REPLAY_ENV)
+        env["balance"] = [
+            self.tracks[lane // L].carries[ci].get(
+                "balance", REPLAY_ENV["balance"]
+            )
+            for lane, (ci, _) in enumerate(flat)
+        ]
         base = make_batch(
             len(flat),
             code_ids=self.code_ids,
             calldata=[data for _, data in flat],
+            callvalue=callvalues,
             caller=DEFAULT_CALLER,
             address=self.address,
             mem_cap=self.mem_cap,
             storage_cap=self.storage_cap,
             storage_seed=storage_seed,
             empty_world=self.empty_world,
-            **REPLAY_ENV,
+            **env,
         )
         if self.mesh is not None:
             from mythril_tpu.parallel import shard_batch
 
             base = shard_batch(base, self.mesh)
+        sym = make_sym_batch(base)
+        synthetic = np.array(
+            [
+                bool(self.tracks[lane // L].carries[ci].get("base"))
+                for lane, (ci, _) in enumerate(flat)
+            ]
+        )
+        if synthetic.any():
+            # poisoned start states are SAMPLES of the host's symbolic
+            # initial storage: reads of them must count as opaque so
+            # arithmetic over them banks (wrap or opaque-site) events
+            # instead of masquerading as path constants
+            import jax.numpy as jnp
+
+            seeded = (
+                jnp.arange(sym.sval_tid.shape[1])[None, :]
+                < base.storage_cnt[:, None]
+            )
+            sym = sym._replace(
+                sval_tid=jnp.where(
+                    jnp.asarray(synthetic)[:, None] & seeded,
+                    jnp.int32(-1),
+                    sym.sval_tid,
+                )
+            )
         out, steps = sym_run(
-            make_sym_batch(base),
+            sym,
             self.code_table,
             max_steps=self.steps_per_wave,
         )
@@ -489,39 +806,304 @@ class DeviceCorpusExplorer:
         self.stats.lanes_degraded_unsupported += int(
             (status == Status.UNSUPPORTED).sum()
         )
+        self._pending_props: List[Tuple[int, int, List]] = []
+        srcs_memo: Dict[int, set] = {}
         for lane, (ci, data) in enumerate(flat):
             track = self.tracks[lane // L]
             if track.idle:
                 continue
             carry = track.carries[ci]
             st = int(status[lane])
+            if st in (Status.ERR_MEM, Status.UNSUPPORTED):
+                track.degraded += 1
             kind = TRIGGER_KINDS.get(st)
             if kind is not None:
                 bucket = track.triggers.setdefault(kind, [])
                 pc = int(halt_pc[lane])
                 # one witness per faulting pc is what a report needs
                 if all(pc != t["pc"] for t in bucket) and len(bucket) < 64:
-                    bucket.append(
-                        {
-                            "pc": pc,
-                            "input": data,
-                            "prefix": list(carry["prefix"]),
-                            "gas_min": int(gas_min[lane]),
-                            "gas_max": int(gas_max[lane]),
+                    trig = {
+                        "pc": pc,
+                        "input": data,
+                        "prefix": list(carry["prefix"]),
+                        "gas_min": int(gas_min[lane]),
+                        "gas_max": int(gas_max[lane]),
+                        "call_value": carry.get("callvalue", 0),
+                        "prefix_values": list(
+                            carry.get("prefix_values", [])
+                        ),
+                    }
+                    if carry.get("base"):
+                        trig["initial_storage"] = {
+                            hex(k): hex(v)
+                            for k, v in carry["base"].items()
                         }
-                    )
+                    if carry.get("balance"):
+                        trig["initial_balance"] = carry["balance"]
+                    bucket.append(trig)
             if st in (Status.STOPPED, Status.RETURNED):
                 # the device mutation pruner: only end states whose
                 # journal gained writes become next-tx start states
                 journal = storage_dict_from(tables, lane)
                 if journal != carry["journal"]:
                     if track.bank_carry(
-                        journal, list(carry["prefix"]) + [data]
+                        journal,
+                        list(carry["prefix"]) + [data],
+                        parent=carry,
                     ):
                         self.stats.carries_banked += 1
-            for pc, taken, _tid in view.journal(lane):
+            rows = view.journal(lane)
+            for pc, taken, _tid in rows:
                 track.covered.add((pc, taken))
+            self._consume_evidence(
+                track,
+                view,
+                lane,
+                data,
+                carry,
+                st,
+                int(gas_min[lane]),
+                int(gas_max[lane]),
+                rows,
+                srcs_memo,
+            )
+        for track in self.tracks:
+            if not track.idle:
+                # the concolic symbolic-initial-storage axis: observed
+                # never-written reads become adversarial start states
+                track.ensure_poison_carries()
         return view
+
+    #: env-source opcode -> the predictable-vars module's operation text
+    _ENV_OPERATION = {
+        "TIMESTAMP": "The block.timestamp environment variable",
+        "NUMBER": "The block.number environment variable",
+        "COINBASE": "The block.coinbase environment variable",
+        "GASLIMIT": "The block.gaslimit environment variable",
+        "BLOCKHASH": "The block hash of a previous block",
+    }
+
+    def _consume_evidence(
+        self, track, view, lane, data, carry, st, gmin, gmax, rows, srcs_memo
+    ) -> None:
+        """Fold one lane's banked events + journal provenance into the
+        track's evidence map. Everything recorded here was CONCRETELY
+        exhibited by the lane — the record's input/prefix replays it —
+        so issue synthesis (analysis/evidence.py) needs no solver.
+
+        Calls with a calldata-derived target additionally enqueue a
+        STEERING query (path + target == attacker): its witness seeds a
+        lane next wave, whose concrete execution then confirms the
+        SWC-105/107/112 property the reference modules solve for."""
+
+        def base(extra: Dict) -> Dict:
+            rec = {
+                "input": data,
+                "prefix": list(carry["prefix"]),
+                "gas_min": gmin,
+                "gas_max": gmax,
+                "call_value": carry.get("callvalue", 0),
+                "prefix_values": list(carry.get("prefix_values", [])),
+            }
+            if carry.get("base"):
+                # poisoned start state: the witness must declare the
+                # synthetic initial storage it assumed
+                rec["initial_storage"] = {
+                    hex(k): hex(v) for k, v in carry["base"].items()
+                }
+            if carry.get("balance"):
+                rec["initial_balance"] = carry["balance"]
+            rec.update(extra)
+            return rec
+
+        halted_clean = st in (Status.STOPPED, Status.RETURNED)
+        n_branches = int(view.br_cnt[lane])
+        if int(view.ev_overflow[lane]):
+            track.event_overflow = True
+        if int(view.ev_cnt[lane]):
+            for ev in view.events(lane):
+                pc, k = ev["pc"], ev["kind"]
+                if k in WRAP_EVENT_OPS:
+                    exact = {
+                        1: ev["a"] + ev["b"] >= 2**256,
+                        2: ev["a"] < ev["b"],
+                        3: ev["a"] * ev["b"] >= 2**256,
+                    }[k]
+                    key = ("wrap", pc)
+                    if exact and key not in track.evidence:
+                        # "the wrapped value was USED" (integer.py's
+                        # promotion rule): DAG reachability when the
+                        # result is a term; for opaque results (taint-
+                        # hashed mapping reads) the static in-block
+                        # store/return check stands in
+                        used = (
+                            view.wrap_used(lane, ev["tid"])
+                            if ev["tid"] > 0
+                            else track.result_stored_in_block(pc)
+                        )
+                        if used:
+                            track.evidence[key] = base(
+                                {
+                                    "class": "wrap",
+                                    "pc": pc,
+                                    "op": WRAP_EVENT_OPS[k],
+                                }
+                            )
+                elif k in CALL_EVENT_KINDS:
+                    mnemonic = CALL_EVENT_KINDS[k]
+                    key = ("call", pc, mnemonic)
+                    to_attacker = ev["a"] == DEFAULT_CALLER
+                    rec = track.evidence.get(key)
+                    if rec is None:
+                        rec = track.evidence[key] = base(
+                            {
+                                "class": "call",
+                                "pc": pc,
+                                "kind": mnemonic,
+                                "gas": ev["gas"],
+                                "to_attacker": False,
+                                "value_to_attacker": False,
+                                "target_tainted": ev["tid"] != 0,
+                                "unchecked": False,
+                            }
+                        )
+                    rec["gas"] = max(rec["gas"], ev["gas"])
+                    rec["target_tainted"] = rec["target_tainted"] or ev["tid"] != 0
+                    if to_attacker and not rec["to_attacker"]:
+                        # THIS lane exhibits the attacker-target
+                        # property: its input is the witness worth
+                        # reporting
+                        rec.update(
+                            to_attacker=True,
+                            input=data,
+                            prefix=list(carry["prefix"]),
+                            gas_min=gmin,
+                            gas_max=gmax,
+                        )
+                    sent = sum(
+                        carry.get("prefix_values", [])
+                    ) + carry.get("callvalue", 0)
+                    if to_attacker and ev["b"] > sent:
+                        # the attacker PROFITS: receives more than the
+                        # whole sequence sent in (ether_thief.py's
+                        # balance-increase property)
+                        rec["value_to_attacker"] = True
+                    if halted_clean and n_branches == ev["aux"]:
+                        # the lane ended with NO branch after the call:
+                        # nothing ever constrained the return value
+                        rec["unchecked"] = True
+                    # steering: make a lane send the call to the
+                    # attacker (confirms next wave, concretely)
+                    if (
+                        ev["tid"] > 0
+                        and ev["gas"] > GAS_STIPEND
+                        and k in (4, 6)
+                        and not rec["to_attacker"]
+                        and (pc, k) not in track.prop_attempted
+                    ):
+                        conds = self._steer_conditions(view, lane, ev)
+                        if conds is not None:
+                            track.prop_attempted.add((pc, k))
+                            self._pending_props.append(
+                                (lane // self.lanes_per_contract,
+                                 self._lane_carry[lane],
+                                 conds,
+                                 (pc, k))
+                            )
+                elif k in (10, 11, 12):
+                    # tainted arithmetic that has not wrapped on any
+                    # lane yet: steer a lane into the wrap (the witness
+                    # seeds next wave; the concrete wrap then banks as
+                    # kind 1-3 and becomes evidence)
+                    if (
+                        ("wrap", pc) not in track.evidence
+                        and (pc, k) not in track.prop_attempted
+                    ):
+                        conds = self._steer_wrap_conditions(view, lane, ev)
+                        if conds is not None:
+                            track.prop_attempted.add((pc, k))
+                            self._pending_props.append(
+                                (lane // self.lanes_per_contract,
+                                 self._lane_carry[lane],
+                                 conds,
+                                 (pc, k))
+                            )
+                elif k in (8, 9):
+                    access = "SSTORE" if k == 8 else "SLOAD"
+                    key = ("state_acc", pc, access)
+                    if key not in track.evidence:
+                        track.evidence[key] = base(
+                            {"class": "state_acc", "pc": pc, "access": access}
+                        )
+                elif k == 13:
+                    track.storage_reads.add(ev["a"])
+                elif k == 15:
+                    track.opaque_sites.add(pc)
+        for pc, taken, tid in rows:
+            if tid == 0:
+                continue
+            srcs = srcs_memo.get(tid)
+            if srcs is None:
+                srcs = srcs_memo[tid] = view.dag_source_ops(tid)
+            if "ORIGIN" in srcs:
+                key = ("env", pc, "115")
+                if key not in track.evidence:
+                    track.evidence[key] = base(
+                        {"class": "env", "pc": pc, "swc": "115", "operation": ""}
+                    )
+            hits = [s for s in PREDICTABLE_SRCS if s in srcs]
+            if hits:
+                swc = "116" if "TIMESTAMP" in hits else "120"
+                key = ("env", pc, swc)
+                if key not in track.evidence:
+                    track.evidence[key] = base(
+                        {
+                            "class": "env",
+                            "pc": pc,
+                            "swc": swc,
+                            "operation": self._ENV_OPERATION[hits[0]],
+                        }
+                    )
+
+    def _steer_conditions(self, view, lane, ev):
+        """Path-prefix + (target == attacker) [+ value > 0] for a call
+        event — the property the reference's 105/107/112 modules query,
+        phrased as a seed-derivation problem."""
+        from mythril_tpu.laser.smt import UGT, symbol_factory
+
+        target = view.term(ev["tid"], lane)
+        if target is None:
+            return None
+        path = view.path_condition(lane, ev["aux"] - 1, flip_last=False) or []
+        attacker = symbol_factory.BitVecVal(DEFAULT_CALLER, 256)
+        conds = path + [target == attacker]
+        if ev["kind"] == 4 and ev["vtid"] > 0:
+            value = view.term(ev["vtid"], lane)
+            if value is not None:
+                conds.append(UGT(value, symbol_factory.BitVecVal(0, 256)))
+        return conds
+
+    def _steer_wrap_conditions(self, view, lane, ev):
+        """Path-prefix + the exact wrap predicate for a tainted arith
+        site — the property integer.py solves at transaction end,
+        phrased as a seed-derivation problem."""
+        from mythril_tpu.laser.smt import UDiv, UGT, ULT, symbol_factory
+
+        operands = view.row_operand_terms(ev["tid"], lane)
+        if operands is None:
+            return None
+        a, b = operands
+        path = view.path_condition(lane, ev["aux"] - 1, flip_last=False) or []
+        zero = symbol_factory.BitVecVal(0, 256)
+        if ev["kind"] == 10:  # ADD wraps iff a + b < a
+            wrap = ULT(a + b, a)
+        elif ev["kind"] == 11:  # SUB wraps iff a < b
+            wrap = ULT(a, b)
+        else:  # MUL wraps iff b != 0 and a > MAX // b
+            maxw = symbol_factory.BitVecVal(2**256 - 1, 256)
+            wrap = UGT(a, UDiv(maxw, b))
+            path = path + [b != zero]
+        return path + [wrap]
 
     def _collect_flip_candidates(
         self, view: ArenaView, ci: int
@@ -554,7 +1136,12 @@ class DeviceCorpusExplorer:
                 self.stats.forks_tried += 1
                 conditions = view.path_condition(lane, k, flip_last=True)
                 if conditions is None:
-                    continue  # opaque decision upstream
+                    # opaque decision upstream: unflippable. Recorded —
+                    # the ownership gate demands some concrete lane
+                    # cover the target anyway (poison samples usually
+                    # do) before the contract can be device-owned.
+                    track.opaque_branches.add(target)
+                    continue
                 candidates.append((self._lane_carry[lane], conditions, target))
                 break
         return candidates
@@ -577,6 +1164,8 @@ class DeviceCorpusExplorer:
         bookkeeping are lock-free."""
         from contextlib import nullcontext
 
+        props = getattr(self, "_pending_props", [])
+        self._pending_props = []
         guard = self.host_lock if self.host_lock is not None else nullcontext()
         self.lock_wanted.set()
         try:
@@ -586,8 +1175,10 @@ class DeviceCorpusExplorer:
                     for ci in range(len(self.tracks))
                 ]
                 flat = [c for cands in per_contract for c in cands]
+                # property-steering queries ride the same sprint batch
+                # as the flips (same cost model, same device escape)
                 solved, capped, lowered_batch, kept = self._sprint_flips(
-                    [cond for _, cond, _ in flat]
+                    [cond for _, cond, _ in flat] + [p[2] for p in props]
                 )
         finally:
             self.lock_wanted.clear()
@@ -595,14 +1186,38 @@ class DeviceCorpusExplorer:
         # a capped query that the device also failed to answer (or that
         # never compiled) had no genuine attempt; sprint-attempted and
         # device-answered ones are spoken for
-        retriable = {i for i in capped if solved[i] is None}
+        retriable = {i for i in capped if solved[i] is None and i < len(flat)}
+        # steering witnesses: calldata that makes a banked call site
+        # target the attacker — seeded below, confirmed concretely by
+        # the next wave's event bank
+        steer: Dict[int, List[Tuple[int, bytes]]] = {}
+        for j, (tidx, carry_idx, _conds, key) in enumerate(props):
+            assignment = solved[len(flat) + j]
+            trk = self.tracks[tidx]
+            if assignment is not None:
+                witness = self._witness_bytes(assignment)
+                steer.setdefault(tidx, []).append((carry_idx, witness))
+                trk.flip_corpus.append(witness)
+                # sat resolves the property only once a seeded lane
+                # CONFIRMS it concretely (wrap/to_attacker evidence —
+                # _unresolved_steering checks that side)
+            elif len(flat) + j not in capped:
+                # a genuine unsat answer closes the property
+                trk.prop_resolved.add(key)
+            else:
+                # sprint-capped: never attempted — lift the mark so a
+                # later wave retries instead of leaving it open forever
+                trk.prop_attempted.discard(key)
 
         stripes: List[List[Tuple[int, bytes]]] = []
+        track_has_payload: List[bool] = []
         n_flips = 0
         n_retriable = 0
         cursor = 0
         for ci, track in enumerate(self.tracks):
-            fresh: List[Tuple[int, bytes]] = []
+            fresh: List[Tuple[int, bytes]] = list(
+                steer.get(ci, [])[: self.lanes_per_contract]
+            )
             had_retriable = False
             for carry_idx, _cond, target in per_contract[ci]:
                 assignment = solved[cursor]
@@ -619,13 +1234,27 @@ class DeviceCorpusExplorer:
                 if assignment is None or len(fresh) >= self.lanes_per_contract:
                     continue
                 self.stats.forks_feasible += 1
-                fresh.append((carry_idx, self._witness_bytes(assignment)))
+                witness = self._witness_bytes(assignment)
+                fresh.append((carry_idx, witness))
+                track.flip_corpus.append(witness)
             # a frontier with un-attempted (capped) candidates is not
             # exhausted — it just hasn't had its turn with the solver
             track.exhausted = not fresh and not had_retriable
+            track_has_payload.append(bool(fresh))
             n_flips += len(fresh)
+            # mutation fill — and the poison carries' ONLY seed source:
+            # synthetic start states are appended mid-phase, so no flip
+            # or phase seed ever points at them; without this rotation
+            # a poisoned state would exist but never execute
+            poison_idx = track.poison_indices()
+            fill_no = 0
             while len(fresh) < self.lanes_per_contract:
                 carry_idx, parent = self.rng.choice(track.corpus)
+                if poison_idx:
+                    rotation = fill_no % (len(poison_idx) + 1)
+                    if rotation < len(poison_idx):
+                        carry_idx = poison_idx[rotation]
+                fill_no += 1
                 mutated = bytearray(parent)
                 mutated[self.rng.randrange(len(mutated))] = self.rng.randrange(
                     256
@@ -633,6 +1262,43 @@ class DeviceCorpusExplorer:
                 fresh.append((carry_idx, bytes(mutated)))
             stripes.append(fresh[: self.lanes_per_contract])
         pending = n_flips + n_retriable
+        # Poison continuation: adversarial-storage carries are created
+        # AFTER the wave that observed the reads, so when the flip
+        # frontier dries up in that same wave they have never run.
+        # Give every unseeded poisoned state one dedicated stripe of
+        # dispatcher seeds — the wave that concretely exhibits the
+        # storage-dependent wraps/thefts the host finds with symbolic
+        # storage.
+        n_poison = 0
+        for ci, track in enumerate(self.tracks):
+            if track.idle or track_has_payload[ci]:
+                # flip/steer witnesses keep their stripe; the poison
+                # pass waits for a drier wave
+                continue
+            # at most two poisoned states per wave: a full stripe per
+            # state beats a sliver of every state
+            pend = track.unseeded_poison()[:2]
+            if not pend:
+                continue
+            seeds = list(track.selector_seeds or []) + list(
+                track.parent_inputs or []
+            )
+            if not seeds:
+                seeds = [b"\x00" * self.calldata_len]
+            stripes[ci] = [
+                (
+                    pend[j % len(pend)],
+                    seeds[(j // len(pend)) % len(seeds)],
+                )
+                for j in range(self.lanes_per_contract)
+            ]
+            for i in pend:
+                track.carries[i]["seeded"] = True
+            n_poison += 1
+        pending += n_poison
+        #: the phase loop must not plateau-break away a wave that
+        #: carries freshly-seeded poison stripes
+        self._poison_stripes_pending = n_poison
         return (stripes if pending else None), pending
 
     # -- the phase loop ------------------------------------------------
@@ -661,6 +1327,12 @@ class DeviceCorpusExplorer:
                 track.corpus.extend(inputs[ci])
             self._publish_partial()
             if wave_no == self.waves - 1:
+                # the wave cap ends the phase with the final wave's
+                # results never reseeded: `exhausted` is stale, so no
+                # live frontier may claim closure
+                for track in self.tracks:
+                    if not track.idle:
+                        track.frontier_closed = False
                 break  # no next wave to seed; don't waste solver calls
             if self._budget_spent():
                 return False
@@ -670,8 +1342,20 @@ class DeviceCorpusExplorer:
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
             quota = len(self.tracks) * self.lanes_per_contract
-            if plateaued and n_flips < max(1, quota // 4):
-                break  # coverage stalled and flips are drying up
+            if (
+                plateaued
+                and n_flips < max(1, quota // 4)
+                and not getattr(self, "_poison_stripes_pending", 0)
+                and not any(
+                    t.unseeded_poison() for t in self.tracks if not t.idle
+                )
+            ):
+                # coverage stalled and flips are drying up — but only
+                # once every poisoned state has had its seeding wave
+                # (those open value dimensions coverage cannot see);
+                # a wave whose stripes WERE just poison-seeded must
+                # run before the plateau verdict counts
+                break
             inputs = fresh
         return True
 
@@ -761,6 +1445,10 @@ class DeviceCorpusExplorer:
                 # banked carries and wipe the last phase's corpus stats
                 # (outcomes would publish corpus_size 0 after a full
                 # phase of exploration).
+                for track in self.tracks:
+                    if track.next_carries:
+                        # a banked tx-N+1 start state will never run
+                        track.frontier_closed = False
                 break
             if txn > 0:
                 advanced = [t.advance_phase() for t in self.tracks]
@@ -780,13 +1468,34 @@ class DeviceCorpusExplorer:
                 if self.budget_s is None
                 else self.budget_s * (txn + 1) / self.transaction_count
             )
+            if txn == self.transaction_count - 1:
+                # carries dropped during the LAST phase feed no further
+                # phase: overflow there must not block completeness
+                for track in self.tracks:
+                    track._final_phase_overflow_base = track.carry_overflow
             self.stats.transactions = txn + 1
-            self._phase(txn)
+            finished = self._phase(txn)
+            # completeness accounting: a phase that ended on budget or
+            # wave cap (or a stop request) leaves live frontiers open —
+            # those contracts are NOT device-complete and the ownership
+            # gate must send them to the host walk
+            stopped = (
+                self.stop_event is not None and self.stop_event.is_set()
+            )
+            for track in self.tracks:
+                if not track.idle and not track.exhausted:
+                    track.frontier_closed = False
+                if (not finished or stopped) and not track.idle:
+                    track.frontier_closed = False
             # A stop REQUEST (the overlapped owner shutting us down)
             # ends everything now.
-            if self.stop_event is not None and self.stop_event.is_set():
+            if stopped:
                 break
 
+        for track in self.tracks:
+            base = getattr(track, "_final_phase_overflow_base", None)
+            if base is not None:
+                track.carry_overflow = base
         self.stats.branches_covered = sum(len(t.covered) for t in self.tracks)
         self.stats.wall_s = round(time.perf_counter() - self._t_start, 3)
         self.stats.wave_exec_s = round(self.stats.wave_exec_s, 3)

@@ -43,12 +43,19 @@ def scan_selectors(code: bytes) -> List[bytes]:
 
 def dispatcher_seeds(code_hex: str, calldata_len: int) -> List[bytes]:
     """The deterministic seeds that open a contract's dispatcher: the
-    zero input plus one padded seed per recovered selector."""
+    zero input plus, per recovered selector, a zero-args seed and a
+    max-args seed. The 0xff fill drives every argument to the integer
+    boundary, so arithmetic on calldata wraps CONCRETELY in wave 1 —
+    the wrap-event bank (symbolic.py) needs an exhibiting lane, and
+    `selector + zeros` never wraps anything."""
     if code_hex.startswith("0x"):
         code_hex = code_hex[2:]
-    seeds = [b"\x00" * calldata_len]
+    # the all-ff seed also covers SELECTORLESS contracts (raw runtime
+    # bodies), whose only boundary input would otherwise be zero
+    seeds = [b"\x00" * calldata_len, b"\xff" * calldata_len]
     for selector in scan_selectors(bytes.fromhex(code_hex)):
         seeds.append(selector.ljust(calldata_len, b"\x00"))
+        seeds.append(selector + b"\xff" * (calldata_len - len(selector)))
     return seeds
 
 

@@ -142,7 +142,7 @@ def make_batch(
     n: int,
     code_ids=None,
     calldata=None,
-    callvalue: int = 0,
+    callvalue=0,
     caller: int = 0xDEADBEEFDEADBEEF,
     address: int = 0xAFFEAFFE,
     balance: int = 10**18,
@@ -167,7 +167,8 @@ def make_batch(
     `storage_seed` pre-loads per-lane storage journals — one
     {slot: value} dict (or None) per lane — the mechanism a
     multi-transaction exploration uses to carry tx N's writes into
-    tx N+1's start state."""
+    tx N+1's start state. `callvalue` accepts a scalar or one int per
+    lane (the explorer's msg.value axis)."""
     code_ids = (
         jnp.zeros((n,), jnp.int32)
         if code_ids is None
@@ -214,9 +215,21 @@ def make_batch(
         address=_word_rows(n, address),
         caller=_word_rows(n, caller),
         origin=_word_rows(n, caller),
-        callvalue=_word_rows(n, callvalue),
+        callvalue=(
+            _word_rows(n, callvalue)
+            if np.isscalar(callvalue)
+            else jnp.asarray(
+                np.stack([u256.from_int(int(v)) for v in callvalue])
+            )
+        ),
+        balance=(
+            _word_rows(n, balance)
+            if np.isscalar(balance)
+            else jnp.asarray(
+                np.stack([u256.from_int(int(v)) for v in balance])
+            )
+        ),
         gasprice=_word_rows(n, gasprice),
-        balance=_word_rows(n, balance),
         calldata=jnp.asarray(cd),
         calldatasize=jnp.asarray(cds),
         timestamp=_word_rows(n, timestamp),

@@ -15,6 +15,27 @@ Term-id convention:
     < 0 opaque: symbolic but outside the device expression language
         (keccak preimages, tainted addresses, arena overflow) — sound
         to execute concretely, not available for branch flipping.
+        Opaque ids carry PROVENANCE bits so detection evidence
+        survives opacity: -(1 + bits) with bit 1 = derived from
+        tx.origin (SWC-115 source) and bit 2 = derived from a
+        predictable block attribute (TIMESTAMP/NUMBER/COINBASE/
+        DIFFICULTY/GASLIMIT/BLOCKHASH — SWC-116 sources). -1 is the
+        generic opaque; a JUMPI whose journal tid is -2/-4 decided on
+        tx.origin, -3/-4 on a predictable var.
+
+Evidence banks (round 5 — the device owns detection, the host
+verifies): beside the arena, every lane banks the concrete EVENTS the
+detection layer needs, so issues can be synthesized from device
+evidence instead of host solver walks (analysis/evidence.py):
+
+- wrap events: ADD/SUB/MUL whose concrete execution wrapped (with both
+  operand values banked for exact host-side confirmation and the
+  result's term id for DAG usage tracking) — SWC-101 witnesses;
+- call events: CALL-family sites with target/value term ids + concrete
+  values and the branch-journal depth at call time — SWC-104/105/107/
+  112 witnesses;
+- the RETURN window, so "wrapped value escapes via RETURN" usage
+  checks can read the final memory taints.
 
 `sym_step` wraps the concrete `step` kernel: values advance exactly as
 in the concrete engine (the concolic semantics pinned by VMTests), and
@@ -51,6 +72,36 @@ OPAQUE = jnp.int32(-1)
 
 #: arena rows per batch (shared by all lanes of a wave)
 ARENA_CAP = 32768
+
+#: banked detection events per lane (one wrap/call/arith site each; a
+#: report needs one witness per faulting pc, and surplus lanes cover
+#: the overflow)
+EVENT_CAP = 12
+
+#: event kinds (ev_kind values)
+EV_WRAP_ADD = 1
+EV_WRAP_SUB = 2
+EV_WRAP_MUL = 3
+EV_CALL = 4
+EV_CALLCODE = 5
+EV_DELEGATECALL = 6
+EV_STATICCALL = 7
+EV_SSTORE_AFTER_CALL = 8
+EV_SLOAD_AFTER_CALL = 9
+#: tainted arithmetic that did NOT wrap on this lane — a steering
+#: target: the explorer solves path + wrap-condition and seeds a lane
+#: that wraps concretely (explore.py `_steer_wrap_conditions`)
+EV_SITE_ADD = 10
+EV_SITE_SUB = 11
+EV_SITE_MUL = 12
+#: SLOAD of a never-written slot (concrete key in ev_a): the observed
+#: key feeds the poisoned-storage carry — the concolic equivalent of
+#: the host engine's symbolic initial storage (explore.py)
+EV_SLOAD_MISS = 13
+#: arithmetic over OPAQUE operands that did not wrap: unverifiable by
+#: steering (no decodable terms), so an unresolved site of this kind
+#: blocks device-completeness — the host walk keeps those contracts
+EV_SITE_OPAQUE = 15
 
 _B = {name: entry[0] for name, entry in OPCODES.items()}
 
@@ -91,19 +142,8 @@ for _name, (_byte, _pops, _pushes, _gmin, _gmax) in OPCODES.items():
 
 # merged per-opcode shadow metadata, one gather per step (each unfused
 # gather is a kernel segment — see step.py _META):
-# [pops, pushes, valid, is_bin, is_un, is_ter, is_call]
-_SYM_META = np.stack(
-    [
-        _POPS,
-        _PUSHES,
-        _VALID.astype(np.int32),
-        _IS_BIN.astype(np.int32),
-        _IS_UN.astype(np.int32),
-        _IS_TER.astype(np.int32),
-        _IS_CALL.astype(np.int32),
-    ],
-    axis=1,
-)
+# [pops, pushes, valid, is_bin, is_un, is_ter, is_call,
+#  call_kind, is_env_leaf, call_has_value]
 
 CALLDATALOAD = _B["CALLDATALOAD"]
 CALLDATACOPY = _B["CALLDATACOPY"]
@@ -114,6 +154,48 @@ SLOAD, SSTORE = _B["SLOAD"], _B["SSTORE"]
 JUMPI = _B["JUMPI"]
 CALL_B, SELFBALANCE_B = _B["CALL"], _B["SELFBALANCE"]
 EXTCODESIZE_B = _B["EXTCODESIZE"]
+ADD_B, SUB_B, MUL_B = _B["ADD"], _B["SUB"], _B["MUL"]
+RETURN_B = _B["RETURN"]
+ORIGIN_B = _B["ORIGIN"]
+BLOCKHASH_B = _B["BLOCKHASH"]
+#: push-only environment sources that become ARENA LEAF NODES: the
+#: leaf decodes to the wave's pinned concrete value (REPLAY_ENV), so
+#: env-guarded branches stay flippable with REPLAYABLE witnesses
+#: (the solver sees cd == <pinned value>), while detection provenance
+#: (SWC-115 origin / SWC-116 predictable vars) reads the leaf ops out
+#: of the DAG closure. BLOCKHASH (pops 1) stays provenance-opaque.
+ENV_LEAF_OPS = [
+    "ORIGIN", "TIMESTAMP", "NUMBER", "COINBASE", "DIFFICULTY", "GASLIMIT",
+]
+_IS_ENV_LEAF = np.zeros(256, bool)
+for _n in ENV_LEAF_OPS:
+    _IS_ENV_LEAF[_B[_n]] = True
+#: CALL-family byte -> event kind (0 = not a call)
+_CALL_KIND = np.zeros(256, np.int32)
+_CALL_KIND[_B["CALL"]] = EV_CALL
+_CALL_KIND[_B["CALLCODE"]] = EV_CALLCODE
+_CALL_KIND[_B["DELEGATECALL"]] = EV_DELEGATECALL
+_CALL_KIND[_B["STATICCALL"]] = EV_STATICCALL
+#: calls that carry a value operand (stack slot 3)
+_CALL_HAS_VALUE = np.zeros(256, bool)
+_CALL_HAS_VALUE[_B["CALL"]] = True
+_CALL_HAS_VALUE[_B["CALLCODE"]] = True
+
+_SYM_META = np.stack(
+    [
+        _POPS,
+        _PUSHES,
+        _VALID.astype(np.int32),
+        _IS_BIN.astype(np.int32),
+        _IS_UN.astype(np.int32),
+        _IS_TER.astype(np.int32),
+        _IS_CALL.astype(np.int32),
+        _CALL_KIND,
+        _IS_ENV_LEAF.astype(np.int32),
+        _CALL_HAS_VALUE.astype(np.int32),
+    ],
+    axis=1,
+)
 
 
 class SymBatch(NamedTuple):
@@ -126,6 +208,20 @@ class SymBatch(NamedTuple):
     sval_tid: jnp.ndarray  # i32[N, STORAGE_CAP]
     br_tid: jnp.ndarray  # i32[N, BRANCH_CAP] condition term per decision
     balance_tid: jnp.ndarray  # i32[N]; 0 or OPAQUE (tainted transfers)
+    # per-lane detection-evidence banks (see module docstring)
+    ev_pc: jnp.ndarray  # i32[N, EVENT_CAP]
+    ev_kind: jnp.ndarray  # i32[N, EVENT_CAP] EV_* kind
+    ev_tid: jnp.ndarray  # i32[N, EVENT_CAP] wrap result / call target tid
+    ev_vtid: jnp.ndarray  # i32[N, EVENT_CAP] call value tid (wraps: 0)
+    ev_a: jnp.ndarray  # u32[N, EVENT_CAP, W] operand a / call target value
+    ev_b: jnp.ndarray  # u32[N, EVENT_CAP, W] operand b / call value
+    ev_aux: jnp.ndarray  # i32[N, EVENT_CAP] br_cnt at a call site
+    ev_gas: jnp.ndarray  # u32[N, EVENT_CAP] call gas operand, saturated
+    ev_cnt: jnp.ndarray  # i32[N]
+    ev_overflow: jnp.ndarray  # i32[N] a distinct event was DROPPED
+    call_seen: jnp.ndarray  # i32[N] lane executed a gas-forwarding call
+    ret_off: jnp.ndarray  # i32[N] RETURN window offset (-1: none)
+    ret_len: jnp.ndarray  # i32[N]
     # the shared expression arena
     ar_op: jnp.ndarray  # i32[ARENA_CAP]
     ar_a: jnp.ndarray  # i32[ARENA_CAP] operand-a term id (0 = concrete)
@@ -145,6 +241,19 @@ def make_sym_batch(base: StateBatch) -> SymBatch:
         sval_tid=jnp.zeros((n, base.storage_keys.shape[1]), jnp.int32),
         br_tid=jnp.zeros((n, base.br_pc.shape[1]), jnp.int32),
         balance_tid=jnp.zeros((n,), jnp.int32),
+        ev_pc=jnp.zeros((n, EVENT_CAP), jnp.int32),
+        ev_kind=jnp.zeros((n, EVENT_CAP), jnp.int32),
+        ev_tid=jnp.zeros((n, EVENT_CAP), jnp.int32),
+        ev_vtid=jnp.zeros((n, EVENT_CAP), jnp.int32),
+        ev_a=jnp.zeros((n, EVENT_CAP, W), jnp.uint32),
+        ev_b=jnp.zeros((n, EVENT_CAP, W), jnp.uint32),
+        ev_aux=jnp.zeros((n, EVENT_CAP), jnp.int32),
+        ev_gas=jnp.zeros((n, EVENT_CAP), jnp.uint32),
+        ev_cnt=jnp.zeros((n,), jnp.int32),
+        ev_overflow=jnp.zeros((n,), jnp.int32),
+        call_seen=jnp.zeros((n,), jnp.int32),
+        ret_off=jnp.full((n,), -1, jnp.int32),
+        ret_len=jnp.full((n,), -1, jnp.int32),
         ar_op=jnp.zeros((ARENA_CAP,), jnp.int32),
         ar_a=jnp.zeros((ARENA_CAP,), jnp.int32),
         ar_b=jnp.zeros((ARENA_CAP,), jnp.int32),
@@ -203,6 +312,13 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
 
     # --- run the concrete kernel --------------------------------------
     post = step(pre, code)
+    # A lane the kernel demoted mid-step (capacity / conditional
+    # support -> UNSUPPORTED/ERR_MEM) executed nothing: the host will
+    # re-run the instruction from the untouched concrete state, so
+    # neither the shadow nor the evidence banks may record it.
+    executed = (post.status != Status.UNSUPPORTED) & (
+        post.status != Status.ERR_MEM
+    )
 
     # --- classify the symbolic effect ---------------------------------
     is_bin = meta[:, 3] != 0
@@ -218,14 +334,29 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     # calldata offsets, tainted keccak windows
     bin_ok = (a_tid >= 0) & (b_tid >= 0)
     un_ok = a_tid >= 0
-    mk_node = (bin_sym & bin_ok) | (un_sym & un_ok) | cdl_clean
+    # taint-involved binops ALWAYS get a row, opaque operand or not:
+    # the row is undecodable as a term (flip/steer decode returns
+    # None), but it preserves the dataflow DAG — provenance scans
+    # (origin/predictable sources) and usage tracking keep working
+    # through mixed opaque/symbolic expressions
+    mk_node = bin_sym | (un_sym & un_ok) | cdl_clean
+    # environment leaves (see ENV_LEAF_OPS): a row whose decode is the
+    # pinned concrete value; operands forced to 0 below
+    mk_env = ex & (meta[:, 8] != 0)
+    env_val = jnp.zeros_like(a_val)
+    for _env_name in ENV_LEAF_OPS:
+        env_val = jnp.where(
+            (op == _B[_env_name])[:, None],
+            getattr(pre, _env_name.lower()),
+            env_val,
+        )
     tainted_top3 = (a_tid != 0) | (b_tid != 0) | (c_tid != 0)
     is_callf = meta[:, 6] != 0
     # a call's success push depends on its operands AND on the balance,
     # which an earlier tainted transfer may have made path-dependent
     mk_opaque = (
-        (bin_sym & ~bin_ok)
-        | (un_sym & ~un_ok)
+        # (binops over opaque operands now make rows — see mk_node)
+        (un_sym & ~un_ok)
         | (ex & is_ter & tainted_top3)
         | (ex & is_cdl & (a_tid != 0))
         | (ex & is_callf & (tainted_top3 | (symb.balance_tid != 0)))
@@ -307,7 +438,11 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     any_hit = jnp.any(hit, axis=-1)
     last = jnp.argmax(jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
     stored_tid = jnp.take_along_axis(sval_tid, last[:, None], axis=1)[:, 0]
-    sload_tid = jnp.where(any_hit, stored_tid, 0)
+    # a MISS reads initial storage, which the host models as symbolic:
+    # the concrete 0 is just this lane's SAMPLE of it, so the result
+    # is opaque — arithmetic over it must bank (wrap or opaque-site)
+    # events instead of posing as a path constant
+    sload_tid = jnp.where(any_hit, stored_tid, OPAQUE)
     sload_tid = jnp.where(a_tid != 0, OPAQUE, sload_tid)
     # SSTORE: mirror the slot choice and record the value/key tids
     slot = jnp.where(any_hit, last, jnp.clip(pre.storage_cnt, 0, s_cap - 1))
@@ -315,27 +450,41 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     skey_tid = _scatter2(skey_tid, slot, a_tid, sstore_m)
 
     # --- arena append --------------------------------------------------
-    ranks = jnp.cumsum(mk_node.astype(jnp.int32)) - mk_node.astype(jnp.int32)
+    mk_row = mk_node | mk_env
+    ranks = jnp.cumsum(mk_row.astype(jnp.int32)) - mk_row.astype(jnp.int32)
     rows = symb.ar_count + ranks
-    ok = mk_node & (rows < ARENA_CAP)
+    ok = mk_row & (rows < ARENA_CAP)
     dump = jnp.where(ok, rows, ARENA_CAP + 1)  # OOB rows are dropped
 
     ar_op = symb.ar_op.at[dump].set(op, mode="drop")
-    ar_a = symb.ar_a.at[dump].set(a_tid, mode="drop")
-    ar_b = symb.ar_b.at[dump].set(b_tid, mode="drop")
-    ar_va = symb.ar_va.at[dump].set(a_val, mode="drop")
-    ar_vb = symb.ar_vb.at[dump].set(b_val, mode="drop")
+    ar_a = symb.ar_a.at[dump].set(jnp.where(mk_env, 0, a_tid), mode="drop")
+    ar_b = symb.ar_b.at[dump].set(jnp.where(mk_env, 0, b_tid), mode="drop")
+    ar_va = symb.ar_va.at[dump].set(
+        jnp.where(mk_env[:, None], env_val, a_val), mode="drop"
+    )
+    ar_vb = symb.ar_vb.at[dump].set(
+        jnp.where(mk_env[:, None], jnp.zeros_like(b_val), b_val), mode="drop"
+    )
     ar_count = jnp.minimum(
-        symb.ar_count + jnp.sum(mk_node.astype(jnp.int32)), ARENA_CAP
+        symb.ar_count + jnp.sum(mk_row.astype(jnp.int32)), ARENA_CAP
     )
 
     node_tid = (rows + 1).astype(jnp.int32)
-    overflowed = mk_node & ~ok
+    overflowed = mk_row & ~ok
 
     # --- result tid ----------------------------------------------------
     res_tid = jnp.zeros((n,), jnp.int32)
-    res_tid = jnp.where(mk_node, node_tid, res_tid)
+    res_tid = jnp.where(mk_row, node_tid, res_tid)
     res_tid = jnp.where(mk_opaque | overflowed, OPAQUE, res_tid)
+    # binop results are nodes even over opaque operands (see mk_node);
+    # unary results of opaque operands PRESERVE the operand's
+    # provenance bits (-(1 + bits), term-id convention) so BLOCKHASH-
+    # derived dependence survives ISZERO/NOT chains
+    neg_bits_a = jnp.where(a_tid < 0, jnp.clip(-a_tid - 1, 0, 3), 0)
+    res_tid = jnp.where(un_sym & ~un_ok, -(1 + neg_bits_a), res_tid)
+    # BLOCKHASH: predictable-var provenance without a leaf (its result
+    # value is block-state we do not model as a constant)
+    res_tid = jnp.where(ex & (op == BLOCKHASH_B), jnp.int32(-3), res_tid)
     res_tid = jnp.where(mload_prop, w_first, res_tid)
     res_tid = jnp.where(sload_m, sload_tid, res_tid)
     # SELFBALANCE reads the (possibly tainted) balance
@@ -351,13 +500,6 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     res_tid = jnp.where(ex & is_swap, swap_deep_tid, res_tid)
 
     # --- stack tid write (mirrors the consolidated stack write) --------
-    # A lane the kernel demoted mid-step (capacity / conditional
-    # support -> UNSUPPORTED/ERR_MEM) executed nothing: the host will
-    # re-run the instruction from the untouched concrete state, so the
-    # shadow must leave its term ids untouched too.
-    executed = (post.status != Status.UNSUPPORTED) & (
-        post.status != Status.ERR_MEM
-    )
     res_idx = jnp.where(
         is_dup, pre.sp, jnp.where(is_swap, pre.sp - 1, pre.sp - pops)
     )
@@ -379,6 +521,135 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     slot_hit = (jnp.arange(br_cap)[None, :] == br_slot[:, None]) & record[:, None]
     br_tid = jnp.where(slot_hit, b_tid[:, None], symb.br_tid)
 
+    # --- evidence banks ------------------------------------------------
+    # Wrap events: the concrete execution actually wrapped, which IS a
+    # sat proof of the module's overflow predicate on this lane's path.
+    # ADD/SUB checks are exact; MUL banks a cheap over-approximation
+    # (overflow is impossible when both operands fit 128 bits) and the
+    # host confirms exactly from the banked operand values — an extra
+    # banked event costs a slot, never a false issue. Only node-backed
+    # results bank (ev_tid must support DAG usage tracking).
+    wrap_add = (op == ADD_B) & u256.ult(u256.bit_not(a_val), b_val)
+    wrap_sub = (op == SUB_B) & u256.ult(a_val, b_val)
+    hi_a = jnp.any(a_val[:, W // 2 :] != 0, axis=-1)
+    hi_b = jnp.any(b_val[:, W // 2 :] != 0, axis=-1)
+    nz_a = jnp.any(a_val != 0, axis=-1)
+    nz_b = jnp.any(b_val != 0, axis=-1)
+    wrap_mul = (op == MUL_B) & (hi_a | hi_b) & nz_a & nz_b
+    arith_exec = (
+        ((op == ADD_B) | (op == SUB_B) | (op == MUL_B)) & ex & executed
+    )
+    # A concrete wrap banks REGARDLESS of term-ness: arithmetic over
+    # taint-hashed mapping reads is opaque in the expression language
+    # (the `balances[to] += x` shape), but the wrap still concretely
+    # happened and the lane's input replays it. ev_tid is the result
+    # node when one exists (DAG usage tracking) and 0 otherwise (the
+    # consumer falls back to a static used-check).
+    wrap_evt = (wrap_add | wrap_sub | wrap_mul) & arith_exec
+    # sites WITHOUT a concrete wrap bank as steering targets — those
+    # need decodable operand terms, so they stay node-gated; opaque-
+    # operand sites bank as EV_SITE_OPAQUE (completeness gate)
+    no_wrap = ~(wrap_add | wrap_sub | wrap_mul)
+    # steering sites need DECODABLE operand terms (both non-opaque)
+    site_evt = arith_exec & bin_sym & bin_ok & ok & no_wrap
+    opaque_site = arith_exec & no_wrap & ((a_tid < 0) | (b_tid < 0))
+    wrap_kind = jnp.where(
+        op == ADD_B,
+        EV_WRAP_ADD,
+        jnp.where(op == SUB_B, EV_WRAP_SUB, EV_WRAP_MUL),
+    ).astype(jnp.int32)
+    wrap_kind = jnp.where(site_evt, wrap_kind + 9, wrap_kind)
+    wrap_kind = jnp.where(opaque_site, EV_SITE_OPAQUE, wrap_kind)
+
+    # Call events: every executed CALL-family site, with target/value
+    # term ids + concrete values, the gas operand (saturated to 32
+    # bits — detection only compares against the 2300 stipend), and
+    # the branch-journal depth at call time (analysis/evidence.py
+    # classifies SWC-104/105/107/112).
+    call_kind = meta[:, 7]
+    has_value = meta[:, 9] != 0
+    call_evt = ex & executed & (call_kind != 0)
+    gas32 = (
+        a_val[:, 0].astype(jnp.uint32)
+        | (a_val[:, 1].astype(jnp.uint32) << 16)
+    )
+    gas_sat = jnp.where(
+        jnp.any(a_val[:, 2:] != 0, axis=-1), jnp.uint32(0xFFFFFFFF), gas32
+    )
+    # state access AFTER a gas-forwarding call (reentrancy surface,
+    # state_change_external_calls.py): the flag arms on the call, the
+    # SSTORE/SLOAD event banks the access site
+    forwarding = call_evt & (gas_sat > 2300)
+    state_acc = ex & executed & (symb.call_seen != 0) & (
+        (op == SSTORE) | (op == SLOAD)
+    )
+    call_seen = jnp.where(
+        forwarding, jnp.int32(1), symb.call_seen
+    )
+    # SLOAD of a never-written slot: the observed CONCRETE key value
+    # is what the poisoned-storage carry will seed. The key may be
+    # taint-derived (mapping slots hash calldata) — the value is still
+    # the one this lane's replayable input reaches, which is all the
+    # poison mechanism needs.
+    sload_miss = ex & executed & sload_m & ~any_hit
+
+    evt = wrap_evt | site_evt | opaque_site | call_evt | state_acc | sload_miss
+    kind = jnp.where(call_evt, call_kind, wrap_kind)
+    kind = jnp.where(
+        state_acc & (op == SSTORE), EV_SSTORE_AFTER_CALL, kind
+    )
+    kind = jnp.where(state_acc & (op == SLOAD), EV_SLOAD_AFTER_CALL, kind)
+    # an after-call SLOAD outranks the miss hint (one event per step)
+    kind = jnp.where(sload_miss & ~state_acc, EV_SLOAD_MISS, kind)
+    ev_tid_new = jnp.where(mk_node & ok, node_tid, 0)
+    ev_tid_new = jnp.where(call_evt, b_tid, ev_tid_new)
+    ev_tid_new = jnp.where(state_acc | sload_miss, 0, ev_tid_new)
+    ev_vtid_new = jnp.where(call_evt & has_value, c_tid, 0)
+    a_field = jnp.where(call_evt[:, None], b_val, a_val)
+    b_field = jnp.where(
+        call_evt[:, None],
+        jnp.where(has_value[:, None], c_val, jnp.zeros_like(c_val)),
+        b_val,
+    )
+    # one witness per (pc, kind) per lane: loops would otherwise fill
+    # the bank with duplicates of the first wrapping site
+    seen = jnp.any(
+        (symb.ev_pc == pre.pc[:, None])
+        & (symb.ev_kind == kind[:, None])
+        & (jnp.arange(EVENT_CAP)[None, :] < symb.ev_cnt[:, None]),
+        axis=1,
+    )
+    bank = evt & ~seen & (symb.ev_cnt < EVENT_CAP)
+    # a DISTINCT event hitting a full bank is LOST evidence: the
+    # consumer's completeness inputs are truncated, so the lane flags
+    # it and the ownership gate sends the contract to the host walk
+    ev_overflow = jnp.where(
+        evt & ~seen & (symb.ev_cnt >= EVENT_CAP),
+        jnp.int32(1),
+        symb.ev_overflow,
+    )
+    ev_hit = (
+        jnp.arange(EVENT_CAP)[None, :]
+        == jnp.clip(symb.ev_cnt, 0, EVENT_CAP - 1)[:, None]
+    ) & bank[:, None]
+    ev_pc = jnp.where(ev_hit, pre.pc[:, None], symb.ev_pc)
+    ev_kind = jnp.where(ev_hit, kind[:, None], symb.ev_kind)
+    ev_tid = jnp.where(ev_hit, ev_tid_new[:, None], symb.ev_tid)
+    ev_vtid = jnp.where(ev_hit, ev_vtid_new[:, None], symb.ev_vtid)
+    ev_a = jnp.where(ev_hit[:, :, None], a_field[:, None, :], symb.ev_a)
+    ev_b = jnp.where(ev_hit[:, :, None], b_field[:, None, :], symb.ev_b)
+    ev_aux = jnp.where(ev_hit, pre.br_cnt[:, None], symb.ev_aux)
+    ev_gas = jnp.where(ev_hit, gas_sat[:, None], symb.ev_gas)
+    ev_cnt = symb.ev_cnt + bank.astype(jnp.int32)
+
+    # RETURN window (final memory taints + this window = "the wrapped
+    # value escapes via RETURN" usage evidence)
+    ret_m = ex & executed & (op == RETURN_B)
+    len_ret, len_big = _word_to_i32(b_val)
+    ret_known = ret_m & ~off_big & ~len_big
+    ret_off = jnp.where(ret_known, off_i, jnp.where(ret_m, -1, symb.ret_off))
+    ret_len = jnp.where(ret_known, len_ret, jnp.where(ret_m, -1, symb.ret_len))
+
     return SymBatch(
         base=post,
         stack_tid=stack_tid,
@@ -387,6 +658,19 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
         sval_tid=sval_tid,
         br_tid=br_tid,
         balance_tid=balance_tid,
+        ev_pc=ev_pc,
+        ev_kind=ev_kind,
+        ev_tid=ev_tid,
+        ev_vtid=ev_vtid,
+        ev_a=ev_a,
+        ev_b=ev_b,
+        ev_aux=ev_aux,
+        ev_gas=ev_gas,
+        ev_cnt=ev_cnt,
+        ev_overflow=ev_overflow,
+        call_seen=call_seen,
+        ret_off=ret_off,
+        ret_len=ret_len,
         ar_op=ar_op,
         ar_a=ar_a,
         ar_b=ar_b,

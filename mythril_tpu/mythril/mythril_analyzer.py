@@ -59,6 +59,7 @@ _GLOBAL_DEFAULTS = dict(
     device_solving="auto",
     device_prepass_budget=12.0,
     device_prepass_lanes=128,
+    device_ownership="auto",
     deterministic_solving=False,
 )
 

@@ -24,6 +24,12 @@ class Args(object, metaclass=Singleton):
         self.device_prepass = "auto"  # device symbolic exploration prepass
         self.device_prepass_lanes = 128  # lanes per prepass wave
         self.device_prepass_budget = 12.0  # prepass wall-clock cap (s)
+        # round-5 inversion: contracts the device exploration covered
+        # END-TO-END (frontier closed, no degraded lanes, no dropped
+        # carries) are OWNED by the device — issues come from its
+        # concrete evidence bank and the host walk is skipped.
+        # "auto" = on when an accelerator backend is present.
+        self.device_ownership = "auto"
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to
