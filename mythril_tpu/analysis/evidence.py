@@ -43,6 +43,7 @@ from mythril_tpu.analysis.module.modules import (
     unchecked_retval as _retval_mod,
     dependence_on_origin as _origin_mod,
     dependence_on_predictable_vars as _predictable_mod,
+    state_change_external_calls as _state_change_mod,
 )
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.analysis.swc_data import (
@@ -95,12 +96,16 @@ def _mk_issue(
 def _call_issues(contract, runtime_hex, address, rec) -> List[Issue]:
     out = []
     if rec.get("unchecked"):
+        # per-property witness: the lane that PROVED the property
+        # (explore.py banks w_unchecked/w_profit beside the shared
+        # record), so the reported transaction_sequence replays the
+        # claim even when another lane owns the record's main witness
         out.append(
             _mk_issue(
                 contract,
                 runtime_hex,
                 address,
-                rec,
+                {**rec, **rec.get("w_unchecked", {})},
                 swc_id=UNCHECKED_RET_VAL,
                 title="Unchecked return value from external call.",
                 severity="Medium",
@@ -116,7 +121,7 @@ def _call_issues(contract, runtime_hex, address, rec) -> List[Issue]:
                 contract,
                 runtime_hex,
                 address,
-                rec,
+                {**rec, **rec.get("w_profit", {})},
                 swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
                 title="Unprotected Ether Withdrawal",
                 severity="High",
@@ -126,7 +131,7 @@ def _call_issues(contract, runtime_hex, address, rec) -> List[Issue]:
                 description_tail=_ether_mod.REMEDIATION,
             )
         )
-    if rec.get("to_attacker") and rec.get("gas", 0) > GAS_STIPEND:
+    if rec.get("to_attacker") and rec.get("attacker_gas", rec.get("gas", 0)) > GAS_STIPEND:
         if rec["kind"] == "CALL":
             out.append(
                 _mk_issue(
@@ -223,14 +228,9 @@ def evidence_issues(contract, outcome: Dict, address: int) -> List[Issue]:
                         "external call"
                     ),
                     description_tail=(
-                        "The contract account state is accessed after an "
-                        "external call to a {} address. "
-                        "To prevent reentrancy issues, consider accessing "
-                        "the state only before the call, especially if the "
-                        "callee is untrusted. Alternatively, a reentrancy "
-                        "lock can be used to prevent "
-                        "untrusted callees from re-entering the contract in "
-                        "an intermediate state.".format(address_kind)
+                        _state_change_mod.DESCRIPTION_TAIL_TEMPLATE.format(
+                            address_kind
+                        )
                     ),
                 )
             )

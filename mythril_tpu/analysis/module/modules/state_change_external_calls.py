@@ -31,6 +31,15 @@ log = logging.getLogger(__name__)
 CALL_OPS = ("CALL", "DELEGATECALL", "CALLCODE")
 STATE_OPS = ("SSTORE", "SLOAD", "CREATE", "CREATE2")
 
+# shared with analysis/evidence.py — device-evidence SWC-107 issues must
+# carry byte-identical text so report dedupe collapses the two paths
+DESCRIPTION_TAIL_TEMPLATE = (
+    "The contract account state is accessed after an external call to a {} address. "
+    "To prevent reentrancy issues, consider accessing the state only before the call, especially if the "
+    "callee is untrusted. Alternatively, a reentrancy lock can be used to prevent "
+    "untrusted callees from re-entering the contract in an intermediate state."
+)
+
 ATTACKER_ADDRESS = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
 
 
@@ -98,14 +107,7 @@ class StateChangeCallsAnnotation(StateAnnotation):
             description_head=(
                 f"{access_kind} persistent state following external call"
             ),
-            description_tail=(
-                "The contract account state is accessed after an external call to a {} address. "
-                "To prevent reentrancy issues, consider accessing the state only before the call, especially if the "
-                "callee is untrusted. Alternatively, a reentrancy lock can be used to prevent "
-                "untrusted callees from re-entering the contract in an intermediate state.".format(
-                    address_kind
-                )
-            ),
+            description_tail=DESCRIPTION_TAIL_TEMPLATE.format(address_kind),
             swc_id=REENTRANCY,
             constraints=call_constraints,
             detector=detector,

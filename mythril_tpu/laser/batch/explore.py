@@ -315,8 +315,15 @@ class _ContractTrack:
                     carry["callvalue"] = CALLVALUE_SEED
                 self.poison_carries.append(carry)
                 self.carries.append(carry)
+            self._n_uniform_poison = variants
         values = (POISON_VALUE, POISON_ADDR, POISON_VALUE)
-        for value, carry in zip(values, self.poison_carries):
+        # only the uniform variant carries take the all-slot refresh:
+        # per-slot singles (appended below) must keep their lone-slot
+        # isolation across waves
+        uniform = self.poison_carries[
+            : getattr(self, "_n_uniform_poison", len(self.poison_carries))
+        ]
+        for value, carry in zip(values, uniform):
             for slot in sorted(self.storage_reads)[:POISON_SLOTS]:
                 if slot not in carry["journal"]:
                     # a new slot means the poisoned state changed: it
@@ -453,6 +460,26 @@ class _ContractTrack:
         self.exhausted = False
         return True
 
+    @staticmethod
+    def _hexify_rec(rec: Dict) -> Dict:
+        """Internal records hold raw bytes; the outcome dict carries hex
+        strings — including the per-property witnesses (w_unchecked /
+        w_profit) banked beside call records."""
+        out = dict(
+            rec,
+            input=rec["input"].hex(),
+            prefix=[p.hex() for p in rec["prefix"]],
+        )
+        for k in ("w_unchecked", "w_profit"):
+            w = out.get(k)
+            if w is not None:
+                out[k] = dict(
+                    w,
+                    input=w["input"].hex(),
+                    prefix=[p.hex() for p in w["prefix"]],
+                )
+        return out
+
     def outcome(self) -> Dict:
         return {
             "covered_branches": sorted(self.covered),
@@ -468,14 +495,7 @@ class _ContractTrack:
                 ]
                 for kind, bucket in self.triggers.items()
             },
-            "evidence": [
-                dict(
-                    rec,
-                    input=rec["input"].hex(),
-                    prefix=[p.hex() for p in rec["prefix"]],
-                )
-                for rec in self.evidence.values()
-            ],
+            "evidence": [self._hexify_rec(rec) for rec in self.evidence.values()],
             "device_complete": self.device_complete(),
             "degraded_lanes": self.degraded,
         }
@@ -930,6 +950,14 @@ class DeviceCorpusExplorer:
                         3: ev["a"] * ev["b"] >= 2**256,
                     }[k]
                     key = ("wrap", pc)
+                    if not exact:
+                        # the device's wrap flag over-approximated (MUL
+                        # uses a 128-bit hi check): whether any input
+                        # wraps HERE is undecided on device. Mark the
+                        # site opaque — ownership is withheld unless a
+                        # steering query (kinds 10-12) or a later exact
+                        # wrap resolves the same pc
+                        track.opaque_sites.add(pc)
                     if exact and key not in track.evidence:
                         # "the wrapped value was USED" (integer.py's
                         # promotion rule): DAG reachability when the
@@ -980,18 +1008,35 @@ class DeviceCorpusExplorer:
                             gas_min=gmin,
                             gas_max=gmax,
                         )
+                    if to_attacker:
+                        # the stipend gate for attacker-targeted issues
+                        # must see gas from a lane that ALSO proved the
+                        # target — not the max over unrelated lanes
+                        rec["attacker_gas"] = max(
+                            rec.get("attacker_gas", 0), ev["gas"]
+                        )
                     sent = sum(
                         carry.get("prefix_values", [])
                     ) + carry.get("callvalue", 0)
-                    if to_attacker and ev["b"] > sent:
+                    if to_attacker and ev["b"] > sent and not rec["value_to_attacker"]:
                         # the attacker PROFITS: receives more than the
                         # whole sequence sent in (ether_thief.py's
-                        # balance-increase property)
+                        # balance-increase property). THIS lane's input
+                        # replays the profit — bank it beside the shared
+                        # record so the synthesized issue's witness
+                        # exhibits the property it claims
                         rec["value_to_attacker"] = True
-                    if halted_clean and n_branches == ev["aux"]:
+                        rec["w_profit"] = base({})
+                    if (
+                        halted_clean
+                        and n_branches == ev["aux"]
+                        and not rec["unchecked"]
+                    ):
                         # the lane ended with NO branch after the call:
-                        # nothing ever constrained the return value
+                        # nothing ever constrained the return value.
+                        # Same rule: the witness is this lane's input
                         rec["unchecked"] = True
+                        rec["w_unchecked"] = base({})
                     # steering: make a lane send the call to the
                     # attacker (confirms next wave, concretely)
                     if (

@@ -11,12 +11,32 @@ every gate can short-circuit on them without special cases downstream.
 
 Expects *lowered* terms: no arrays, no UFs, no sdiv/srem (see
 preprocess.py which rewrites those to udiv/urem + ite).
+
+Two implementations share this contract:
+
+- `PyBlaster` — the original pure-Python encoder (kept as the
+  reference semantics and the no-native fallback);
+- `NativeBlaster` — the term DAG walk stays here, but every word-level
+  circuit (adder/multiplier/divider/comparator/shifter) is ONE FFI
+  call into native/blast.cpp, which owns the variable counter, the
+  gate cache, and the flat clause store (docs/roadmap.md item 0: the
+  Python gate loop was the dominant host-solve cost).
+
+The native encoder is REQUIRED to produce a bit-for-bit identical
+clause stream (same var numbering, same clause order) — identical CNF
+means identical CDCL behavior, models, witnesses, and byte-identical
+golden reports. tests/laser/smt/test_native_blast.py holds the two to
+stream equality over randomized DAGs; `Blaster()` picks the native one
+when the library is loadable (MYTHRIL_TPU_NATIVE_BLAST=0 forces
+Python).
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
 from array import array
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from mythril_tpu.laser.smt import terms
 from mythril_tpu.laser.smt.terms import Term
@@ -25,7 +45,7 @@ TRUE_LIT = 1
 FALSE_LIT = -1
 
 
-class Blaster:
+class PyBlaster:
     def __init__(self):
         self.nvars = 1  # var 1 = constant TRUE
         # definitional clause store, flat 0-separated DIMACS stream —
@@ -375,3 +395,330 @@ class Blaster:
                 return self.ult_bits(af, bf)
             return -self.ult_bits(bf, af)
         raise NotImplementedError(f"blast bool: {op}")
+
+
+# ---------------------------------------------------------------------------
+# native-backed implementation
+# ---------------------------------------------------------------------------
+
+_BLAST_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))),
+    "native",
+    "libmythril_native.so",
+)
+
+_blib = None
+_blib_failed = False
+
+
+def _load_blast_lib():
+    global _blib, _blib_failed
+    if _blib is not None or _blib_failed:
+        return _blib
+    try:
+        lib = ctypes.CDLL(_BLAST_LIB_PATH)
+        P = ctypes.POINTER(ctypes.c_int32)
+        lib.bl_new.restype = ctypes.c_void_p
+        lib.bl_free.argtypes = [ctypes.c_void_p]
+        lib.bl_nvars.argtypes = [ctypes.c_void_p]
+        lib.bl_nvars.restype = ctypes.c_int32
+        lib.bl_flat_len.argtypes = [ctypes.c_void_p]
+        lib.bl_flat_len.restype = ctypes.c_longlong
+        lib.bl_flat_ptr.argtypes = [ctypes.c_void_p]
+        lib.bl_flat_ptr.restype = P
+        lib.bl_new_vars.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.bl_new_vars.restype = ctypes.c_int32
+        lib.bl_add_clause.argtypes = [ctypes.c_void_p, P, ctypes.c_int32]
+        for name in ("bl_and", "bl_or"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p, P, ctypes.c_int32]
+            fn.restype = ctypes.c_int32
+        lib.bl_xor.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.bl_xor.restype = ctypes.c_int32
+        for name in ("bl_ite", "bl_maj"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+            fn.restype = ctypes.c_int32
+        lib.bl_adder.argtypes = [
+            ctypes.c_void_p, P, P, ctypes.c_int32, ctypes.c_int32, P,
+        ]
+        lib.bl_adder.restype = ctypes.c_int32
+        lib.bl_mul.argtypes = [
+            ctypes.c_void_p, P, ctypes.c_int32, P, ctypes.c_int32,
+            ctypes.c_int32, P,
+        ]
+        for name in ("bl_eq", "bl_ult"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p, P, P, ctypes.c_int32]
+            fn.restype = ctypes.c_int32
+        lib.bl_shift.argtypes = [
+            ctypes.c_void_p, P, ctypes.c_int32, P, ctypes.c_int32,
+            ctypes.c_int32, P,
+        ]
+        lib.bl_divmod.argtypes = [ctypes.c_void_p, P, P, ctypes.c_int32, P, P]
+        lib.bl_ite_bits.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, P, P, ctypes.c_int32, P,
+        ]
+        for name in ("bl_and_bits", "bl_or_bits", "bl_xor_bits"):
+            getattr(lib, name).argtypes = [ctypes.c_void_p, P, P,
+                                           ctypes.c_int32, P]
+        _blib = lib
+    except (OSError, AttributeError):
+        # OSError: no .so; AttributeError: a stale library built before
+        # blast.cpp existed — either way fall back to PyBlaster
+        _blib_failed = True
+    return _blib
+
+
+def _ia(bits: List[int]):
+    return (ctypes.c_int32 * len(bits))(*bits)
+
+
+class NativeFlat:
+    """View over the native blaster's clause store. Quacks enough like
+    `array('i')` for the solver sessions: `len()` in literals, and a
+    zero-copy (pointer, count) window for the CDCL bulk-load FFI."""
+
+    def __init__(self, blaster: "NativeBlaster"):
+        self._bl = blaster
+
+    def __len__(self) -> int:
+        return int(self._bl._lib.bl_flat_len(self._bl._h))
+
+    def window(self, offset: int = 0):
+        """(POINTER(c_int), count) over flat[offset:]. The pointer is
+        fetched per call — the store reallocates as it grows."""
+        total = len(self)
+        base = self._bl._lib.bl_flat_ptr(self._bl._h)
+        ptr = ctypes.cast(
+            ctypes.addressof(base.contents) + 4 * offset,
+            ctypes.POINTER(ctypes.c_int),
+        )
+        return ptr, total - offset
+
+
+class NativeBlaster:
+    """Term walk in Python, circuits in C++ (one FFI call per term)."""
+
+    def __init__(self):
+        lib = _load_blast_lib()
+        if lib is None:
+            raise OSError(f"native blast library not loadable: {_BLAST_LIB_PATH}")
+        self._lib = lib
+        self._h = lib.bl_new()
+        self.flat = NativeFlat(self)
+        self.bv_cache: Dict[int, List[int]] = {}
+        self.bool_cache: Dict[int, int] = {}
+        self.gate_cache: Dict[Tuple, Tuple] = {}  # divmod (q, r) by term ids
+        self.var_bits: Dict[Tuple[str, int], List[int]] = {}
+        self.bool_vars: Dict[str, int] = {}
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._lib.bl_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def nvars(self) -> int:
+        return int(self._lib.bl_nvars(self._h))
+
+    def new_var(self) -> int:
+        return int(self._lib.bl_new_vars(self._h, 1))
+
+    def add(self, *lits: int) -> None:
+        self._lib.bl_add_clause(self._h, _ia(list(lits)), len(lits))
+
+    # ---- scalar gates (term-level bool ops) --------------------------
+    def g_and(self, *ins: int) -> int:
+        return int(self._lib.bl_and(self._h, _ia(list(ins)), len(ins)))
+
+    def g_or(self, *ins: int) -> int:
+        return int(self._lib.bl_or(self._h, _ia(list(ins)), len(ins)))
+
+    def g_xor(self, a: int, b: int) -> int:
+        return int(self._lib.bl_xor(self._h, a, b))
+
+    def g_ite(self, c: int, a: int, b: int) -> int:
+        return int(self._lib.bl_ite(self._h, c, a, b))
+
+    def g_maj(self, a: int, b: int, c: int) -> int:
+        return int(self._lib.bl_maj(self._h, a, b, c))
+
+    # ---- word helpers ------------------------------------------------
+    def const_bits(self, value: int, width: int) -> List[int]:
+        return [TRUE_LIT if (value >> i) & 1 else FALSE_LIT for i in range(width)]
+
+    def adder(self, a: List[int], b: List[int], cin: int = FALSE_LIT) -> Tuple[List[int], int]:
+        w = len(a)
+        out = (ctypes.c_int32 * w)()
+        carry = self._lib.bl_adder(self._h, _ia(a), _ia(b), w, cin, out)
+        return list(out), int(carry)
+
+    def negate(self, a: List[int]) -> List[int]:
+        out, _ = self.adder([-l for l in a], self.const_bits(1, len(a)))
+        return out
+
+    def mul_bits(self, a: List[int], b: List[int], out_width: int) -> List[int]:
+        out = (ctypes.c_int32 * out_width)()
+        self._lib.bl_mul(self._h, _ia(a), len(a), _ia(b), len(b), out_width, out)
+        return list(out)
+
+    def eq_bits(self, a: List[int], b: List[int]) -> int:
+        w = min(len(a), len(b))
+        return int(self._lib.bl_eq(self._h, _ia(a), _ia(b), w))
+
+    def ult_bits(self, a: List[int], b: List[int]) -> int:
+        w = min(len(a), len(b))
+        return int(self._lib.bl_ult(self._h, _ia(a), _ia(b), w))
+
+    def shift_bits(self, a: List[int], sh: List[int], kind: str) -> List[int]:
+        w = len(a)
+        out = (ctypes.c_int32 * w)()
+        self._lib.bl_shift(
+            self._h, _ia(a), w, _ia(sh), len(sh),
+            {"shl": 0, "lshr": 1, "ashr": 2}[kind], out)
+        return list(out)
+
+    # ---- term walk (mirrors PyBlaster exactly) -----------------------
+    def blast_bv(self, t: Term) -> List[int]:
+        cached = self.bv_cache.get(t._id)
+        if cached is not None:
+            return cached
+        bits = self._blast_bv(t)
+        assert len(bits) == t.width, f"{t.op}: {len(bits)} != {t.width}"
+        self.bv_cache[t._id] = bits
+        return bits
+
+    def _blast_bv(self, t: Term) -> List[int]:
+        op = t.op
+        w = t.width
+        lib, h = self._lib, self._h
+        if op == "const":
+            return self.const_bits(t.args[0], w)
+        if op == "var":
+            key = (t.args[0], w)
+            bits = self.var_bits.get(key)
+            if bits is None:
+                first = int(lib.bl_new_vars(h, w))
+                bits = list(range(first, first + w))
+                self.var_bits[key] = bits
+            return bits
+        if op in ("add", "sub", "mul", "udiv", "urem", "and", "or", "xor",
+                  "shl", "lshr", "ashr"):
+            a = self.blast_bv(t.args[0])
+            b = self.blast_bv(t.args[1])
+            if op == "add":
+                return self.adder(a, b)[0]
+            if op == "sub":
+                return self.adder(a, [-l for l in b], TRUE_LIT)[0]
+            if op == "mul":
+                return self.mul_bits(a, b, w)
+            if op in ("udiv", "urem"):
+                key = ("divmod", t.args[0]._id, t.args[1]._id)
+                qr = self.gate_cache.get(key)
+                if qr is None:
+                    q = (ctypes.c_int32 * w)()
+                    r = (ctypes.c_int32 * w)()
+                    lib.bl_divmod(h, _ia(a), _ia(b), w, q, r)
+                    qr = (list(q), list(r))
+                    self.gate_cache[key] = qr
+                return qr[0] if op == "udiv" else qr[1]
+            if op in ("and", "or", "xor"):
+                out = (ctypes.c_int32 * w)()
+                fn = {"and": lib.bl_and_bits, "or": lib.bl_or_bits,
+                      "xor": lib.bl_xor_bits}[op]
+                fn(h, _ia(a), _ia(b), w, out)
+                return list(out)
+            return self.shift_bits(a, b, op)
+        if op == "not":
+            return [-l for l in self.blast_bv(t.args[0])]
+        if op == "concat":
+            hi, lo = t.args
+            return self.blast_bv(lo) + self.blast_bv(hi)
+        if op == "extract":
+            hi, lo, src = t.args
+            return self.blast_bv(src)[lo : hi + 1]
+        if op == "zext":
+            return self.blast_bv(t.args[0]) + self.const_bits(0, t.args[1])
+        if op == "sext":
+            bits = self.blast_bv(t.args[0])
+            return bits + [bits[-1]] * t.args[1]
+        if op == "ite":
+            c = self.blast_bool(t.args[0])
+            a = self.blast_bv(t.args[1])
+            b = self.blast_bv(t.args[2])
+            out = (ctypes.c_int32 * w)()
+            lib.bl_ite_bits(h, c, _ia(a), _ia(b), w, out)
+            return list(out)
+        raise NotImplementedError(f"blast bv: {op}")
+
+    def blast_bool(self, t: Term) -> int:
+        cached = self.bool_cache.get(t._id)
+        if cached is not None:
+            return cached
+        lit = self._blast_bool(t)
+        self.bool_cache[t._id] = lit
+        return lit
+
+    def _blast_bool(self, t: Term) -> int:
+        op = t.op
+        if op == "true":
+            return TRUE_LIT
+        if op == "false":
+            return FALSE_LIT
+        if op == "bvar":
+            name = t.args[0]
+            v = self.bool_vars.get(name)
+            if v is None:
+                v = self.bool_vars[name] = self.new_var()
+            return v
+        if op == "band":
+            return self.g_and(*[self.blast_bool(a) for a in t.args])
+        if op == "bor":
+            return self.g_or(*[self.blast_bool(a) for a in t.args])
+        if op == "bnot":
+            return -self.blast_bool(t.args[0])
+        if op == "bxor":
+            return self.g_xor(self.blast_bool(t.args[0]), self.blast_bool(t.args[1]))
+        if op == "ite":
+            return self.g_ite(
+                self.blast_bool(t.args[0]),
+                self.blast_bool(t.args[1]),
+                self.blast_bool(t.args[2]),
+            )
+        if op in ("eq", "ult", "ule", "slt", "sle"):
+            a = self.blast_bv(t.args[0])
+            b = self.blast_bv(t.args[1])
+            if op == "eq":
+                return self.eq_bits(a, b)
+            if op == "ult":
+                return self.ult_bits(a, b)
+            if op == "ule":
+                return -self.ult_bits(b, a)
+            af = a[:-1] + [-a[-1]]
+            bf = b[:-1] + [-b[-1]]
+            if op == "slt":
+                return self.ult_bits(af, bf)
+            return -self.ult_bits(bf, af)
+        raise NotImplementedError(f"blast bool: {op}")
+
+
+def native_blast_available() -> bool:
+    if os.environ.get("MYTHRIL_TPU_NATIVE_BLAST", "auto") == "0":
+        return False
+    return _load_blast_lib() is not None
+
+
+def Blaster():
+    """Factory kept under the historical class name: native circuits
+    when the library is present, pure Python otherwise."""
+    if native_blast_available():
+        return NativeBlaster()
+    return PyBlaster()

@@ -88,9 +88,14 @@ def solve_flat(
         lib.cdcl_ensure_vars(s, nvars)
         n = len(flat_clauses)
         if n:
-            buf = (ctypes.c_int * n).from_buffer(flat_clauses)
-            ok = lib.cdcl_add_clauses_flat(s, buf, n)
-            del buf  # release the buffer export so the store can grow
+            if hasattr(flat_clauses, "window"):
+                # native blast store: a (pointer, count) view, no copy
+                ptr, cnt = flat_clauses.window(0)
+                ok = lib.cdcl_add_clauses_flat(s, ptr, cnt)
+            else:
+                buf = (ctypes.c_int * n).from_buffer(flat_clauses)
+                ok = lib.cdcl_add_clauses_flat(s, buf, n)
+                del buf  # release the buffer export so the store can grow
             if not ok:
                 return UNSAT, None
         if units:
@@ -174,10 +179,16 @@ class SolverSession:
             self.loaded_vars = nvars
         n = len(flat_clauses)
         if n > self.loaded_lits:
-            delta = flat_clauses[self.loaded_lits:]
-            buf = (ctypes.c_int * len(delta)).from_buffer(delta)
-            ok = lib.cdcl_add_clauses_flat(s, buf, len(delta))
-            del buf
+            if hasattr(flat_clauses, "window"):
+                # native blast store: load the delta straight out of the
+                # C++ vector (pointer fetched per call — it reallocates)
+                ptr, cnt = flat_clauses.window(self.loaded_lits)
+                ok = lib.cdcl_add_clauses_flat(s, ptr, cnt)
+            else:
+                delta = flat_clauses[self.loaded_lits:]
+                buf = (ctypes.c_int * len(delta)).from_buffer(delta)
+                ok = lib.cdcl_add_clauses_flat(s, buf, len(delta))
+                del buf
             self.loaded_lits = n
             if not ok:
                 self.poisoned = True  # definitional store unsat: broken
