@@ -94,6 +94,43 @@ def _timed_run(batch, code, max_steps: int) -> float:
     return dt
 
 
+#: v5e(lite) headline numbers for the roofline denominators
+HBM_BYTES_PER_S = 819e9
+PEAK_BF16_FLOPS = 197e12
+
+
+def _roofline(batch, code, rate: float) -> dict:
+    """Bytes-per-step / roofline accounting for the step kernel.
+
+    The batched interpreter is integer vector work — the MXU (the
+    FLOPs headline) is idle by design, so MFU is ~0 and the honest
+    utilization axis is HBM: a functional step reads and writes the
+    whole StateBatch (XLA fuses/elides some of it, so this is an upper
+    bound on demanded traffic) plus the two code-table gathers. The
+    interesting diagnosis is which side of the roofline the measured
+    rate lands on: demanded-bytes x steps/s far under the HBM ceiling
+    means the kernel is DISPATCH/latency-bound, not bandwidth-bound —
+    macro-stepping (unroll) is then the lever, not layout."""
+    state_bytes = sum(
+        getattr(a, "nbytes", 0) for a in batch
+    )
+    gather_bytes = N_LANES * (33 + 6 * 4)  # code window + opcode metadata
+    bytes_per_step = 2 * state_bytes + gather_bytes
+    steps_per_sec = rate / N_LANES
+    demanded = bytes_per_step * steps_per_sec
+    return {
+        "state_bytes_per_lane": int(state_bytes // N_LANES),
+        "bytes_per_step": int(bytes_per_step),
+        "batch_steps_per_sec": round(steps_per_sec, 2),
+        "hbm_demand_gbps": round(demanded / 1e9, 2),
+        "hbm_utilization_pct": round(100 * demanded / HBM_BYTES_PER_S, 2),
+        "mfu_pct": 0.0,  # integer kernel: no MXU FLOPs by design
+        "roofline_bound": (
+            "bandwidth" if demanded > 0.5 * HBM_BYTES_PER_S else "dispatch"
+        ),
+    }
+
+
 def bench_transitions() -> dict:
     import jax
 
@@ -135,7 +172,9 @@ def bench_transitions() -> dict:
         f"{jax.devices()[0]}",
         file=sys.stderr,
     )
-    return {"rate": rate, "wall_s": dt_full, "scaling_ratio": ratio}
+    out = {"rate": rate, "wall_s": dt_full, "scaling_ratio": ratio}
+    out.update(_roofline(batch, code, rate))
+    return out
 
 
 class _Deadline(Exception):
@@ -442,6 +481,13 @@ def main(final_attempt: bool = False) -> None:
         "n_lanes": N_LANES,
         "n_steps": N_STEPS,
     }
+    for k in (
+        "state_bytes_per_lane", "bytes_per_step", "batch_steps_per_sec",
+        "hbm_demand_gbps", "hbm_utilization_pct", "mfu_pct",
+        "roofline_bound",
+    ):
+        if k in dev:
+            record[k] = dev[k]
     record.update(corpus)
     record.update(default_path)
     print(json.dumps(record))

@@ -49,30 +49,39 @@ OVERLAP_MIN_CORPUS = 32
 
 
 def resolve_prepass_budget_s(
-    n_contracts: int, override: Optional[float] = None
+    n_contracts: int,
+    override: Optional[float] = None,
+    execution_timeout: Optional[float] = None,
+    ownership: bool = False,
 ) -> float:
     """Default ACTIVE-time budget (waves + flip solving; lock waits
     don't bill) for the striped corpus prepass.
 
-    Small corpora: sized to the measured coverage curve — the selector
-    seeds cover most of what wave 1 can reach and the curve plateaus
-    within a few waves, while every second of prepass activity is a
-    second of GIL/core contention stolen from overlapped host analyses
-    on a small box. 1s/contract keeps 2-4 steady-state waves.
+    With `ownership` (the round-5 inversion), the economics change:
+    every contract the exploration completes refunds its WHOLE host
+    walk (up to execution_timeout each), so the budget scales with the
+    walk ceiling — up to half the refundable wall, bounded per corpus
+    size. Early exits (per-contract parking, frontier exhaustion,
+    coverage plateau) stop the spend well short of the budget on
+    corpora that converge, so the bound mostly prices the hopeless
+    tail.
 
-    Large corpora (>= OVERLAP_MIN_CORPUS): the prepass overlaps a long
-    train of host analyses and its waves are device-bound, so the
-    budget scales with the corpus — 0.5s/contract, capped at 120s —
-    which at corpus wave sizes fits several waves per transaction
-    phase (the explorer reserves later transactions their share)."""
+    Witness-injection-only mode (ownership off) keeps the old curve:
+    small corpora 1s/contract (the selector seeds cover most of what
+    wave 1 reaches; every active second contends with overlapped host
+    analyses on a small box), large corpora 0.5s/contract capped at
+    120s."""
     if override is not None:
         return override
-    if n_contracts >= OVERLAP_MIN_CORPUS:
+    n = max(1, n_contracts)
+    if ownership and execution_timeout:
+        return min(0.5 * execution_timeout * n, 30.0 + 5.0 * n, 300.0)
+    if n >= OVERLAP_MIN_CORPUS:
         # floored at the small-corpus cap so crossing the threshold
         # never SHRINKS the budget (32 contracts must not explore less
         # than 31)
-        return min(120.0, max(30.0, 0.5 * n_contracts))
-    return min(30.0, 1.0 * max(1, n_contracts))
+        return min(120.0, max(30.0, 0.5 * n))
+    return min(30.0, 1.0 * n)
 
 
 def _runnable_rows(
@@ -133,6 +142,8 @@ def corpus_device_prepass(
     stop_event=None,
     publish=None,
     lock_wanted=None,
+    execution_timeout: Optional[float] = None,
+    ownership: bool = False,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
@@ -143,7 +154,11 @@ def corpus_device_prepass(
     if not runnable:
         return {}
     if budget_s is None:
-        budget_s = resolve_prepass_budget_s(len(runnable))
+        budget_s = resolve_prepass_budget_s(
+            len(runnable),
+            execution_timeout=execution_timeout,
+            ownership=ownership,
+        )
     if lanes_per_contract is None:
         # corpus-sized waves: the symbolic kernel is lane-bound on a
         # tunneled link (~33s/wave at 3328 lanes), so wide stripes at
@@ -180,9 +195,14 @@ def corpus_device_prepass(
             if publish is None
             else (lambda ti, outcome: publish(runnable[ti][0], outcome))
         )
+        from mythril_tpu.laser.batch.explore import required_calldata_len
+
         at_scale = len(runnable) >= OVERLAP_MIN_CORPUS
         explorer = DeviceCorpusExplorer(
             [code for _, code in runnable],
+            calldata_len=max(
+                required_calldata_len(code) for _, code in runnable
+            ),
             # corpus scale runs LEAN-CAP symbolic waves: the
             # [N, mem_cap] memory array dominates per-step wave cost
             # on the tunneled link (explore.py cap notes), and the
@@ -260,6 +280,8 @@ class OverlappedPrepass:
         address: int,
         transaction_count: int,
         budget_s: Optional[float] = None,
+        execution_timeout: Optional[float] = None,
+        ownership: bool = False,
     ) -> None:
         import threading
 
@@ -285,11 +307,19 @@ class OverlappedPrepass:
                     stop_event=self._stop,
                     publish=self._published.__setitem__,
                     lock_wanted=self._lock_wanted,
+                    execution_timeout=execution_timeout,
+                    ownership=ownership,
                 )
             )
 
         self._thread = threading.Thread(target=_work, daemon=True)
         self._thread.start()
+
+    @property
+    def drain_abandoned(self) -> bool:
+        """True once a drain timed out on a hung device call — no
+        further outcomes will ever be published."""
+        return self._drain_abandoned
 
     def _done(self) -> bool:
         if self._thread is not None and not self._thread.is_alive():
@@ -404,11 +434,33 @@ def _outcome_owns(outcome: Optional[Dict]) -> bool:
     """True when a FINAL prepass outcome covered the contract
     end-to-end (explore.py `device_complete`): frontier closed, no
     degraded lanes, no dropped carries. Partial (mid-exploration)
-    outcomes never own — completeness is only known at the end."""
+    outcomes never own — UNLESS the explorer froze this contract early
+    (`final_for_contract`: all gates green in the last phase, track
+    parked, evidence immutable), which is per-contract finality inside
+    a still-running corpus exploration."""
     return bool(
         outcome
         and outcome.get("device_complete")
-        and not (outcome.get("stats") or {}).get("partial")
+        and (
+            outcome.get("final_for_contract")
+            or not (outcome.get("stats") or {}).get("partial")
+        )
+    )
+
+
+def _maybe_ownable(outcome: Optional[Dict]) -> bool:
+    """Could a still-running prepass still hand this contract over?
+    False the moment a published outcome shows a hard ownership
+    failure (degraded lanes, dropped carries, saturated event bank) —
+    those gates only ever get worse, so the host walk should start
+    immediately instead of waiting out the prepass."""
+    if outcome is None:
+        return True  # no information yet
+    gates = outcome.get("completeness_gates") or {}
+    return (
+        gates.get("no_degraded", True)
+        and gates.get("no_carry_overflow", True)
+        and gates.get("no_event_overflow", True)
     )
 
 
@@ -644,7 +696,12 @@ def analyze_corpus(
             or len(_runnable_rows(contracts)) >= OVERLAP_MIN_CORPUS
         ):
             pre = OverlappedPrepass(
-                contracts, address, transaction_count, device_budget_s
+                contracts,
+                address,
+                transaction_count,
+                device_budget_s,
+                execution_timeout=execution_timeout,
+                ownership=_ownership_enabled(use_device),
             )
             # Smallest code first: cheap analyses (which converge well
             # inside their budgets regardless of contention) soak up
@@ -674,36 +731,78 @@ def analyze_corpus(
             n_run = max(1, len(_runnable_rows(contracts)))
             overlap_window_s = (
                 2.0 if n_run >= OVERLAP_MIN_CORPUS else 1.25
-            ) * resolve_prepass_budget_s(n_run, device_budget_s)
+            ) * resolve_prepass_budget_s(
+                n_run,
+                device_budget_s,
+                execution_timeout=execution_timeout,
+                ownership=_ownership_enabled(use_device),
+            )
             t_overlap = time.perf_counter()
             own = _ownership_enabled(use_device)
             slots: List[Optional[Dict]] = [None] * len(contracts)
             try:
-                for i in order:
-                    if time.perf_counter() - t_overlap > overlap_window_s:
-                        pre.drain()
-                    code, creation_code, name = contracts[i]
-                    outcome, device_ok = pre.outcome_for(i)
-                    if own and device_ok and _outcome_owns(outcome):
-                        # device-complete contract: evidence IS the
-                        # analysis; no walk, no lock, no solver
-                        owned_res = _owned_result(
-                            code, creation_code, name, outcome, address
-                        )
-                        if owned_res is not None:
-                            slots[i] = owned_res
-                            continue
-                    with pre.lock:
-                        slots[i] = _analyze_one(
-                            payload(
-                                code,
-                                creation_code,
-                                name,
-                                use_device and device_ok,
-                                outcome,
+                # Ownership-aware scheduling: a contract the running
+                # prepass may still freeze as final (no hard gate
+                # failure published yet) is DEFERRED rather than
+                # walked — walking it now would burn its full budget
+                # on work the chip is about to hand over. Clearly
+                # unownable contracts (degraded, overflowed) walk
+                # immediately and soak the overlap window; once the
+                # prepass ends (or the window drains it), everything
+                # left resolves against final outcomes.
+                pending = list(order)
+                while pending:
+                    progressed = False
+                    deferred: List[int] = []
+                    for i in pending:
+                        # per-contract, as before the deferral rework:
+                        # a long pass over `pending` must still hand
+                        # the prepass its uncontended tail past the
+                        # overlap window
+                        if time.perf_counter() - t_overlap > overlap_window_s:
+                            pre.drain()
+                        code, creation_code, name = contracts[i]
+                        outcome, device_ok = pre.outcome_for(i)
+                        if own and _outcome_owns(outcome):
+                            # device-complete contract: evidence IS
+                            # the analysis; no walk, no lock, no
+                            # solver
+                            owned_res = _owned_result(
+                                code, creation_code, name, outcome,
+                                address,
                             )
-                        )
-                    pre.yield_lock()
+                            if owned_res is not None:
+                                slots[i] = owned_res
+                                progressed = True
+                                continue
+                        if (
+                            not device_ok
+                            and own
+                            and _maybe_ownable(outcome)
+                            and not pre.drain_abandoned
+                        ):
+                            # a hung prepass (abandoned drain) will
+                            # never publish finality: deferring past it
+                            # would spin this loop forever
+                            deferred.append(i)
+                            continue
+                        with pre.lock:
+                            slots[i] = _analyze_one(
+                                payload(
+                                    code,
+                                    creation_code,
+                                    name,
+                                    use_device and device_ok,
+                                    outcome,
+                                )
+                            )
+                        pre.yield_lock()
+                        progressed = True
+                    pending = deferred
+                    if pending and not progressed:
+                        # only deferred work left: let the prepass run
+                        # uncontended and poll its published finality
+                        time.sleep(1.0)
                 results = slots
             finally:
                 # an exception (including a caller's alarm/deadline)
@@ -718,6 +817,8 @@ def analyze_corpus(
                     budget_s=device_budget_s,
                     address=address,
                     transaction_count=transaction_count,
+                    execution_timeout=execution_timeout,
+                    ownership=_ownership_enabled(use_device),
                 )
             own = _ownership_enabled(use_device)
             results = []

@@ -213,20 +213,102 @@ def degrader_contract(copy_at: int = 0x2000) -> str:
     return code.hex()
 
 
+def wide_contract(n_guards: int = 6, seed: int = 0) -> str:
+    """A hand-assembled wide-branching runtime — the shape where the
+    device engine's breadth is a STRUCTURAL advantage, not a constant
+    factor. `n_guards` independent calldata guards (each its own
+    32-byte word vs a distinct magic constant) plus an
+    overflow-to-branch segment, an ORIGIN guard, a TIMESTAMP guard,
+    and a guarded SELFDESTRUCT:
+
+        if (cd[4+32j] == magic_j)  { mem[j] = 1 }        // j guards
+        if (cd[o_w] + C == 0)      { mem[7] = 1 }        // ADD wraps (SWC-101)
+        if (tx.origin == A)        { mem[8] = 1 }        // SWC-115
+        if (block.timestamp == T)  { mem[9] = 1 }        // SWC-116
+        if (cd[o_k] == magic_k)    { selfdestruct(caller) }  // SWC-106
+
+    A sequential symbolic walk forks at every guard: ~2^(n_guards+4)
+    path-leaves, two feasibility solves per fork (the reference's
+    worklist shape, mythril/laser/ethereum/svm.py:235-271) — the
+    per-contract wall grows exponentially. Branch-coverage closure on
+    the device needs ONE flip per guard direction: a couple of waves
+    regardless of 2^K. Storage is never written, so tx-2 starts from
+    unchanged states on both engines (no carry variance)."""
+    rng = random.Random(0xBEEF + seed)
+    code = bytearray()
+
+    def _guard_cd(offset: int, magic: int, body: bytes) -> None:
+        # PUSH2 off CALLDATALOAD PUSH4 magic EQ ISZERO PUSH2 skip JUMPI
+        code.extend([0x61, (offset >> 8) & 0xFF, offset & 0xFF, 0x35])
+        code.extend([0x63]) ; code.extend(magic.to_bytes(4, "big"))
+        code.extend([0x14, 0x15])
+        skip = len(code) + 3 + 1 + len(body)
+        code.extend([0x61, (skip >> 8) & 0xFF, skip & 0xFF, 0x57])
+        code.extend(body)
+        code.extend([0x5B])  # skip: JUMPDEST
+
+    def _mark(j: int) -> bytes:
+        return bytes([0x60, 0x01, 0x60, j & 0xFF, 0x53])  # mem[j] = 1
+
+    for j in range(n_guards):
+        _guard_cd(4 + 32 * j, 0xFEED0000 + rng.getrandbits(16), _mark(j))
+
+    # overflow-to-branch: s = cd[o_w] + C; if (s == 0) { mem[7] = 1 }
+    # the s == 0 witness is exactly the wrapping input, and the JUMPI
+    # is integer.py's promotion site on both engines
+    o_w = 4 + 32 * n_guards
+    big = (2**256 - (0x10000 + rng.getrandbits(12))) | 1
+    code.extend([0x61, (o_w >> 8) & 0xFF, o_w & 0xFF, 0x35])
+    code.extend([0x7F]) ; code.extend(big.to_bytes(32, "big"))
+    code.extend([0x01, 0x60, 0x00, 0x14, 0x15])
+    skip = len(code) + 3 + 1 + 5
+    code.extend([0x61, (skip >> 8) & 0xFF, skip & 0xFF, 0x57])
+    code.extend(_mark(7))
+    code.extend([0x5B])
+
+    # ORIGIN guard: equality with an address the pinned replay origin
+    # does not match — the taken direction is host-only (symbolic
+    # origin), the branch itself banks SWC-115 from the DAG either way
+    code.extend([0x32, 0x73]) ; code.extend((0xAAAA000000000000000000000000000000000000 + seed).to_bytes(20, "big"))
+    code.extend([0x14, 0x15])
+    skip = len(code) + 3 + 1 + 5
+    code.extend([0x61, (skip >> 8) & 0xFF, skip & 0xFF, 0x57])
+    code.extend(_mark(8))
+    code.extend([0x5B])
+
+    # TIMESTAMP guard (SWC-116): same shape
+    code.extend([0x42, 0x63]) ; code.extend((0x5C000000 + seed).to_bytes(4, "big"))
+    code.extend([0x14, 0x15])
+    skip = len(code) + 3 + 1 + 5
+    code.extend([0x61, (skip >> 8) & 0xFF, skip & 0xFF, 0x57])
+    code.extend(_mark(9))
+    code.extend([0x5B])
+
+    # guarded SELFDESTRUCT(caller) — last: it ends the transaction
+    o_k = o_w + 32
+    _guard_cd(o_k, 0xDEAD0000 + rng.getrandbits(16), bytes([0x33, 0xFF]))
+    code.extend([0x00])  # STOP
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,
     loops: int = 4,
     degraders: int = 4,
+    wides: int = 6,
     inputs: Optional[Path] = None,
 ) -> List[Tuple[str, str, str]]:
     """The round-5 benchmark corpus: fixture constant-mutants plus
-    hand-assembled deep-loop and cap-degrading shapes, so the A/B
-    exercises bounded loops, device degradation/takeover, and the
-    ownership gate in one measured run."""
+    hand-assembled deep-loop, cap-degrading, and wide-branching
+    shapes, so the A/B exercises bounded loops, device
+    degradation/takeover, the ownership gate, and the breadth regime
+    (sequential walk exponential vs device branch-coverage closure) in
+    one measured run."""
     rng = random.Random(seed)
     corpus = synth_corpus(
-        max(0, n_contracts - loops - degraders), seed=seed, inputs=inputs
+        max(0, n_contracts - loops - degraders - wides), seed=seed,
+        inputs=inputs,
     )
     for k in range(loops):
         cap = (0x1F, 0x3F, 0x7F, 0xFF)[k % 4]
@@ -234,6 +316,8 @@ def synth_bench_corpus(
     for k in range(degraders):
         at = 0x2000 + 0x400 * (k % 4)
         corpus.append((degrader_contract(at), "", f"degrader#{k}"))
+    for k in range(wides):
+        corpus.append((wide_contract(6 + (k % 3), seed=k), "", f"wide#{k}"))
     rng.shuffle(corpus)
     return corpus[:n_contracts]
 

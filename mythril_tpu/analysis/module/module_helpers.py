@@ -7,9 +7,15 @@ import traceback
 def is_prehook() -> bool:
     """True when called from inside the engine's pre-hook dispatch.
 
-    Same stack-inspection trick as the reference, made robust to call
-    depth by scanning the recent frames instead of one fixed offset
-    (the post-hook dispatcher's name contains "post_hook", never
-    "pre_hook", so the scan cannot misfire).
-    """
+    The reference inspects the Python stack for its dispatcher's
+    function name; this engine's hook bus records the phase explicitly
+    (hooks.py `_PHASE`), which cannot misfire with frame depth or
+    renamed dispatchers. The stack scan survives only as a fallback
+    for direct calls outside any dispatch (unit tests driving
+    _analyze_state by hand)."""
+    from mythril_tpu.laser.ethereum.hooks import current_hook_phase
+
+    phase = current_hook_phase()
+    if phase is not None:
+        return phase == "pre"
     return any("pre_hook" in frame for frame in traceback.format_stack()[-6:])

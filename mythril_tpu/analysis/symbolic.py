@@ -207,10 +207,12 @@ class SymExecWrapper:
             try:
                 from mythril_tpu.laser.batch.explore import (
                     DeviceSymbolicExplorer,
+                    required_calldata_len,
                 )
 
                 explorer = DeviceSymbolicExplorer(
                     runtime,
+                    calldata_len=required_calldata_len(runtime),
                     lanes=lanes,
                     waves=8,
                     steps_per_wave=512,

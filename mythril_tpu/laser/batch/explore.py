@@ -149,6 +149,32 @@ class ExploreStats:
         return dict(self.__dict__)
 
 
+def required_calldata_len(
+    code_hex: str, default: int = 68, cap: int = 480
+) -> int:
+    """Static scan for the largest PUSH1..PUSH4 immediate that
+    directly feeds a CALLDATALOAD, plus a word of margin: contracts
+    reading high fixed offsets (hand-rolled dispatchers, packed
+    multi-word args) are unreachable past the default 68-byte seed
+    window otherwise — their guards could never be covered or flipped
+    on device. Bounded by the device calldata envelope."""
+    code = bytes.fromhex(code_hex[2:] if code_hex.startswith("0x") else code_hex)
+    need = default
+    i = 0
+    while i < len(code):
+        op = code[i]
+        if 0x60 <= op <= 0x7F:
+            n = op - 0x5F
+            if n <= 4 and i + n + 1 < len(code) and code[i + n + 1] == 0x35:
+                off = int.from_bytes(code[i + 1 : i + 1 + n], "big")
+                if off < cap:
+                    need = max(need, off + 36)
+            i += 1 + n
+        else:
+            i += 1
+    return min(need, cap)
+
+
 class _ContractTrack:
     """Per-contract exploration bookkeeping inside the striped batch."""
 
@@ -226,6 +252,13 @@ class _ContractTrack:
         #: keyed by canonicalized journal (the device mutation pruner)
         self.next_carries: Dict[Tuple, Dict] = {}
         self.idle = False  # no start states left for this phase
+        #: contract finished EARLY (all ownership gates green in the
+        #: final phase): its evidence is frozen, its lanes stop being
+        #: seeded, and the published outcome is final mid-run — the
+        #: ownership consumer (analysis/corpus.py) may skip the host
+        #: walk without waiting for the whole corpus run to end
+        self.parked = False
+        self._final_outcome: Optional[Dict] = None
 
     def device_complete(self) -> bool:
         """True when the striped exploration covered this contract's
@@ -235,27 +268,33 @@ class _ContractTrack:
         through its node form at the same pc). The ownership gate
         (analysis/corpus.py): a complete contract's issues come from
         the evidence bank alone and the host walk is skipped."""
+        return all(self.completeness_gates().values())
+
+    def completeness_gates(self) -> Dict[str, bool]:
+        """The ownership conditions, individually — every value must be
+        True for device_complete. Exported through outcome() so an
+        incomplete contract SAYS which gate kept the host walk."""
         steered = {p for (p, k) in self.prop_resolved if k in (10, 11, 12)}
         unresolved = {
             pc
             for pc in self.opaque_sites
             if ("wrap", pc) not in self.evidence and pc not in steered
         }
-        return (
-            not self._unresolved_steering()
-            and self.frontier_closed
-            and self.degraded == 0
-            and not self.carry_overflow
-            and not self.event_overflow
-            and not unresolved
+        return {
+            "steering_resolved": not self._unresolved_steering(),
+            "frontier_closed": bool(self.frontier_closed),
+            "no_degraded": self.degraded == 0,
+            "no_carry_overflow": not self.carry_overflow,
+            "no_event_overflow": not self.event_overflow,
+            "arith_sites_resolved": not unresolved,
             # every unflippable (opaque-prefix) branch target must have
             # been covered concretely by some lane
-            and self.opaque_branches <= self.covered
+            "opaque_branches_covered": self.opaque_branches <= self.covered,
             # an unseeded poisoned state means the storage dimension
             # was never sampled: whatever it would have exhibited is
             # unknown, so the host walk keeps the contract
-            and not self.unseeded_poison()
-        )
+            "poison_seeded": not self.unseeded_poison(),
+        }
 
     def bank_carry(
         self,
@@ -293,7 +332,7 @@ class _ContractTrack:
         the observed never-written reads. Mutated in place: carries
         are referenced by index, and the next wave's make_batch reads
         the journals fresh."""
-        if not self.storage_reads:
+        if self.parked or not self.storage_reads:
             return
         if not self.poison_carries:
             # MAX and attacker-address variants run VALUE-FREE (a
@@ -365,6 +404,46 @@ class _ContractTrack:
             if not self.carries[i].get("seeded")
         ]
 
+    def still_exhausted(self) -> bool:
+        """True when the last reseed found this frontier exhausted AND
+        no lane has covered anything new since that verdict — the
+        condition under which an early phase end (budget, wave cap,
+        stop) cannot have left live work here."""
+        return (
+            self.exhausted
+            and len(self.covered) == getattr(self, "_exhausted_cov", -1)
+        )
+
+    def finalize_if_complete(self) -> bool:
+        """Early per-contract finality, checked after every reseed of
+        the LAST transaction phase: once this contract's frontier is
+        provably closed (idle this phase, or exhausted with stable
+        coverage) and every other ownership gate is green, freeze it —
+        snapshot the outcome as final, stop seeding its lanes, and
+        stop consuming its events. The frozen claim stays sound
+        because nothing can mutate the track afterwards; the consumer
+        gets ownership ~as soon as the contract converges instead of
+        at the end of the whole corpus run."""
+        if self.parked:
+            return True
+        if getattr(self, "_poison_wave_pending", False):
+            # a freshly-seeded poison stripe runs NEXT wave — its
+            # results must be harvested before completeness can claim
+            # the storage dimension was sampled
+            return False
+        gates = self.completeness_gates()
+        gates["frontier_closed"] = self.idle or self.still_exhausted()
+        if not all(gates.values()):
+            return False
+        self.frontier_closed = True
+        self.exhausted = True
+        self._exhausted_cov = len(self.covered)
+        self.parked = True
+        out = self.outcome()
+        out["final_for_contract"] = True
+        self._final_outcome = out
+        return True
+
     def _unresolved_steering(self) -> bool:
         """A steering query that was dispatched but never got a real
         answer — sprint-capped, lowering-failed, or sat-but-never-
@@ -424,6 +503,8 @@ class _ContractTrack:
     def advance_phase(self) -> bool:
         """Promote the banked carries to the next transaction's start
         states; False when exploration of this contract is over."""
+        if self.parked:
+            return False  # frozen — state must not be touched
         # inputs that exercised branches last transaction are the best
         # seeds for the next one: a branch direction that was a dead
         # end under empty storage may open under the carried journal,
@@ -497,6 +578,7 @@ class _ContractTrack:
             },
             "evidence": [self._hexify_rec(rec) for rec in self.evidence.values()],
             "device_complete": self.device_complete(),
+            "completeness_gates": self.completeness_gates(),
             "degraded_lanes": self.degraded,
         }
 
@@ -828,9 +910,16 @@ class DeviceCorpusExplorer:
         )
         self._pending_props: List[Tuple[int, int, List]] = []
         srcs_memo: Dict[int, set] = {}
+        for t in self.tracks:
+            # any poison stripe scheduled by the last reseed has now
+            # executed and is being harvested: finality may proceed
+            t._poison_wave_pending = False
         for lane, (ci, data) in enumerate(flat):
             track = self.tracks[lane // L]
-            if track.idle:
+            if track.idle or track.parked:
+                # parked: the published-final claim stays sound only
+                # because nothing (evidence, degradation, carries)
+                # mutates a frozen track
                 continue
             carry = track.carries[ci]
             st = int(status[lane])
@@ -1026,7 +1115,15 @@ class DeviceCorpusExplorer:
                         # record so the synthesized issue's witness
                         # exhibits the property it claims
                         rec["value_to_attacker"] = True
-                        rec["w_profit"] = base({})
+                        # explicit None/0 defaults: the merged issue
+                        # dict must not inherit the shared record's
+                        # initial_storage/balance when THIS lane ran
+                        # without them (the witness would declare a
+                        # synthetic start state it never assumed)
+                        rec["w_profit"] = dict(
+                            {"initial_storage": None, "initial_balance": 0},
+                            **base({}),
+                        )
                     if (
                         halted_clean
                         and n_branches == ev["aux"]
@@ -1036,7 +1133,10 @@ class DeviceCorpusExplorer:
                         # nothing ever constrained the return value.
                         # Same rule: the witness is this lane's input
                         rec["unchecked"] = True
-                        rec["w_unchecked"] = base({})
+                        rec["w_unchecked"] = dict(
+                            {"initial_storage": None, "initial_balance": 0},
+                            **base({}),
+                        )
                     # steering: make a lane send the call to the
                     # attacker (confirms next wave, concretely)
                     if (
@@ -1159,6 +1259,8 @@ class DeviceCorpusExplorer:
         triple. A flip witness stays bound to its source lane's carry —
         the path condition only holds under that start state."""
         track = self.tracks[ci]
+        if track.parked:
+            return []  # frozen: flags untouched
         if track.idle:
             track.exhausted = True
             return []
@@ -1260,6 +1362,12 @@ class DeviceCorpusExplorer:
         n_retriable = 0
         cursor = 0
         for ci, track in enumerate(self.tracks):
+            if track.parked:
+                # frozen stripe: shape-stable placeholder lanes (empty
+                # calldata halts immediately); harvest ignores them
+                stripes.append([(0, b"")] * self.lanes_per_contract)
+                track_has_payload.append(False)
+                continue
             fresh: List[Tuple[int, bytes]] = list(
                 steer.get(ci, [])[: self.lanes_per_contract]
             )
@@ -1285,6 +1393,12 @@ class DeviceCorpusExplorer:
             # a frontier with un-attempted (capped) candidates is not
             # exhausted — it just hasn't had its turn with the solver
             track.exhausted = not fresh and not had_retriable
+            if track.exhausted:
+                # snapshot: if later waves (mutation-filled lanes of a
+                # corpus that is still running for OTHER contracts)
+                # uncover nothing new here, this frontier may claim
+                # closure even when the PHASE ends on budget/wave-cap
+                track._exhausted_cov = len(track.covered)
             track_has_payload.append(bool(fresh))
             n_flips += len(fresh)
             # mutation fill — and the poison carries' ONLY seed source:
@@ -1316,7 +1430,7 @@ class DeviceCorpusExplorer:
         # storage.
         n_poison = 0
         for ci, track in enumerate(self.tracks):
-            if track.idle or track_has_payload[ci]:
+            if track.idle or track.parked or track_has_payload[ci]:
                 # flip/steer witnesses keep their stripe; the poison
                 # pass waits for a drier wave
                 continue
@@ -1339,6 +1453,10 @@ class DeviceCorpusExplorer:
             ]
             for i in pend:
                 track.carries[i]["seeded"] = True
+            # the stripe is SCHEDULED but runs next wave: finality must
+            # wait for its harvest (parking now would freeze the track
+            # with the poison results discarded — unsound ownership)
+            track._poison_wave_pending = True
             n_poison += 1
         pending += n_poison
         #: the phase loop must not plateau-break away a wave that
@@ -1373,10 +1491,11 @@ class DeviceCorpusExplorer:
             self._publish_partial()
             if wave_no == self.waves - 1:
                 # the wave cap ends the phase with the final wave's
-                # results never reseeded: `exhausted` is stale, so no
-                # live frontier may claim closure
+                # results never reseeded: `exhausted` is stale for any
+                # track whose coverage moved since its snapshot, so
+                # only provably-still-exhausted frontiers stay closed
                 for track in self.tracks:
-                    if not track.idle:
+                    if not track.idle and not track.still_exhausted():
                         track.frontier_closed = False
                 break  # no next wave to seed; don't waste solver calls
             if self._budget_spent():
@@ -1384,6 +1503,20 @@ class DeviceCorpusExplorer:
             covered_now = sum(len(t.covered) for t in self.tracks)
             plateaued = wave_no > 0 and covered_now == covered_before
             fresh, n_flips = self._reseed(view)
+            if txn == self.transaction_count - 1:
+                # early per-contract finality: a contract that just
+                # closed all its ownership gates freezes NOW, and the
+                # publisher announces it so the analysis loop can skip
+                # its host walk without waiting for the corpus run
+                newly_parked = [
+                    t
+                    for t in self.tracks
+                    if not t.parked and t.finalize_if_complete()
+                ]
+                if newly_parked:
+                    self._publish_partial()
+                if all(t.parked or t.idle for t in self.tracks):
+                    return True  # everything owned or inert: run over
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
             quota = len(self.tracks) * self.lanes_per_contract
@@ -1408,7 +1541,12 @@ class DeviceCorpusExplorer:
         if self.publish is None:
             return
         for ci, track in enumerate(self.tracks):
-            outcome = track.outcome()
+            if track.parked:
+                # the frozen FINAL outcome — final_for_contract lets
+                # the ownership consumer act on it mid-run
+                outcome = dict(track._final_outcome)
+            else:
+                outcome = track.outcome()
             # per-track copy: consumers annotate their stats dict
             # (witness_issues), so sharing one object across contracts
             # would let them clobber each other
@@ -1530,7 +1668,17 @@ class DeviceCorpusExplorer:
             for track in self.tracks:
                 if not track.idle and not track.exhausted:
                     track.frontier_closed = False
-                if (not finished or stopped) and not track.idle:
+                if (
+                    (not finished or stopped)
+                    and not track.idle
+                    and not track.still_exhausted()
+                ):
+                    # the PHASE ended early (budget/wave-cap/stop), but
+                    # a track whose own frontier exhausted — and whose
+                    # coverage hasn't moved since — is done regardless
+                    # of why the corpus loop stopped; marking every
+                    # track open here was the corpus-scale ownership
+                    # killer (32-contract bench: 0 owned)
                     track.frontier_closed = False
             # A stop REQUEST (the overlapped owner shutting us down)
             # ends everything now.
@@ -1547,7 +1695,10 @@ class DeviceCorpusExplorer:
         self.stats.flip_solve_s = round(self.stats.flip_solve_s, 3)
         return {
             "stats": self.stats.as_dict(),
-            "contracts": [t.outcome() for t in self.tracks],
+            "contracts": [
+                dict(t._final_outcome) if t.parked else t.outcome()
+                for t in self.tracks
+            ],
         }
 
 

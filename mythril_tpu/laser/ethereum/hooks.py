@@ -22,8 +22,9 @@ both engines.
 from __future__ import annotations
 
 import logging
+import threading
 from collections import defaultdict
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from mythril_tpu.laser.plugin.signals import PluginSkipState
 
@@ -37,6 +38,20 @@ LIFECYCLE_CHANNELS = (
     "execute_state",
     "add_world_state",
 )
+
+
+#: the hook phase ("pre"/"post") currently being dispatched, per
+#: thread — detection modules branch on it (module_helpers.is_prehook).
+#: An explicit context instead of the reference's stack-name sniffing:
+#: this engine's dispatch frames (bus.emit / emit_opcode) don't carry
+#: the reference's function names, so the sniff silently mis-phased
+#: every phase-dependent module (SWC-116 was undetectable until the
+#: wide-corpus shapes flushed it out).
+_PHASE = threading.local()
+
+
+def current_hook_phase() -> Optional[str]:
+    return getattr(_PHASE, "value", None)
 
 
 class HookBus:
@@ -66,10 +81,18 @@ class HookBus:
         """Fire every per-event subscriber; exceptions propagate (they
         are control flow: PluginSkip*, stop signals). Batch consumers
         only exist on opcode channels — see emit_opcode."""
-        for fn in self._subs.get(channel, ()):
-            fn(*payload)
-        for fn in self._batch_subs.get(channel, ()):
-            fn([payload[0]] if payload else [])
+        phased = isinstance(channel, tuple) and channel[0] in ("pre", "post")
+        if phased:
+            prev = current_hook_phase()
+            _PHASE.value = channel[0]
+        try:
+            for fn in self._subs.get(channel, ()):
+                fn(*payload)
+            for fn in self._batch_subs.get(channel, ()):
+                fn([payload[0]] if payload else [])
+        finally:
+            if phased:
+                _PHASE.value = prev
 
     def emit_opcode(self, phase: str, opcode: str, states: List) -> List:
         """Fire an opcode channel over a state vector. Returns the
@@ -77,17 +100,22 @@ class HookBus:
         subscriber removes that state from the batch (the reference's
         post-hook drop semantics, svm.py:572-582)."""
         key = (phase, opcode)
-        survivors = []
-        for state in states:
-            dropped = False
-            for fn in self._subs.get(key, ()):
-                try:
-                    fn(state)
-                except PluginSkipState:
-                    dropped = True
-                    break
-            if not dropped:
-                survivors.append(state)
-        for fn in self._batch_subs.get(key, ()):
-            fn(survivors)
-        return survivors
+        prev = current_hook_phase()
+        _PHASE.value = phase
+        try:
+            survivors = []
+            for state in states:
+                dropped = False
+                for fn in self._subs.get(key, ()):
+                    try:
+                        fn(state)
+                    except PluginSkipState:
+                        dropped = True
+                        break
+                if not dropped:
+                    survivors.append(state)
+            for fn in self._batch_subs.get(key, ()):
+                fn(survivors)
+            return survivors
+        finally:
+            _PHASE.value = prev

@@ -159,6 +159,8 @@ class MythrilAnalyzer:
                 ],
                 self._prepass_address(),
                 transaction_count or 2,
+                execution_timeout=self.execution_timeout,
+                ownership=getattr(args, "device_ownership", "auto") != "never",
             )
         except Exception:
             log.debug("overlapped corpus prepass unavailable", exc_info=True)
