@@ -291,6 +291,45 @@ def wide_contract(n_guards: int = 6, seed: int = 0) -> str:
     return bytes(code).hex()
 
 
+def bec_contract(seed: int = 0) -> str:
+    """The BECToken shape (SWC-101 CVE-2018-10299): an unchecked
+    `amount = cnt * value` whose product then steers control flow
+    through a DIVISION — `if (m / y == x) { sstore }`. The flip of
+    that branch (`m / y != x` with the mul in scope) is exactly the
+    multiplication+division circuit CDCL grinds on (measured: 33.6s
+    for the native CDCL; the on-chip portfolio's concrete evaluation
+    finds a witness in seconds — the workload class where the solver
+    race pays). An assert guard rides behind it for a detectable
+    SWC-110."""
+    # offsets are fixed by construction (PUSH2 jump forms throughout):
+    #  0: x = cd(4); 3: y = cd(36); 6: if (y == 0) goto end
+    # 12: m = x*y; 15: q = m/y; 18..24: if (q != x) goto skip
+    # 25: sstore(0,1); 30 skip: guard cd(68) == magic -> fail
+    # 41 end: STOP; 43 fail: INVALID
+    skip, end, fail = 30, 41, 43
+    code = bytearray(
+        [
+            0x60, 0x04, 0x35,        # x = CALLDATALOAD(4)
+            0x60, 0x24, 0x35,        # y = CALLDATALOAD(36)  [x, y]
+            0x80, 0x15,              # DUP1 ISZERO           [x, y, y==0]
+            0x61, (end >> 8) & 0xFF, end & 0xFF, 0x57,  # JUMPI end
+            0x81, 0x81, 0x02,        # DUP2 DUP2 MUL -> m    [x, y, m]
+            0x81, 0x90, 0x04,        # DUP2 SWAP1 DIV -> m/y [x, y, q]
+            0x82, 0x14,              # DUP3 EQ -> q == x     [x, y, e]
+            0x15,                    # ISZERO                [x, y, !e]
+            0x61, (skip >> 8) & 0xFF, skip & 0xFF, 0x57,  # JUMPI skip
+            0x60, 0x01, 0x60, 0x00, 0x55,  # sstore(0, 1)
+            0x5B,                    # skip: JUMPDEST
+            0x60, 0x44, 0x35,              # CALLDATALOAD(68)
+            0x60, 0xAA + (seed % 16), 0x14,  # == 0xaa+k ?
+            0x61, (fail >> 8) & 0xFF, fail & 0xFF, 0x57,
+            0x5B, 0x00,                    # end: JUMPDEST; STOP
+            0x5B, 0xFE,                    # fail: JUMPDEST; INVALID
+        ]
+    )
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,

@@ -280,6 +280,70 @@ def _collect_vars(lowered: List[terms.Term]):
     return bv_keys, bool_names
 
 
+def _race_cone(
+    lowered: List[terms.Term], max_constraints: int = 384
+) -> List[terms.Term]:
+    """The cone of influence of the query's TAIL constraints — what
+    the on-chip portfolio race actually searches.
+
+    Analysis queries lower into thousands of conjuncts (per-query
+    select-elimination axioms over calldata), which the portfolio
+    compiler chokes on (measured: 30s compile+search miss over 4573
+    conjuncts, while the 2-conjunct core of the same query wins in
+    seconds). The race doesn't need the whole set: any witness it
+    finds is validated against the FULL raw constraints by the
+    reconstruction gate before it is believed, so racing a relevant
+    subset is sound — an under-constrained witness just fails
+    validation and the CDCL proceeds. Seeded from the last conjuncts
+    (the freshly-appended branch/property condition), grown by shared
+    variables breadth-first, capped."""
+    if len(lowered) <= max_constraints:
+        return lowered
+
+    var_memo: Dict[int, frozenset] = {}
+
+    def vars_of(t: terms.Term) -> frozenset:
+        hit = var_memo.get(t._id)
+        if hit is not None:
+            return hit
+        names = set()
+        seen = set()
+        stack = [t]
+        while stack:
+            s = stack.pop()
+            if s._id in seen:
+                continue
+            seen.add(s._id)
+            if s.op in ("var", "bvar"):
+                names.add(s.args[0])
+            else:
+                for a in s.args:
+                    if isinstance(a, terms.Term):
+                        stack.append(a)
+        out = frozenset(names)
+        var_memo[t._id] = out
+        return out
+
+    per = [vars_of(c) for c in lowered]
+    active = set().union(*per[-2:]) if len(per) >= 2 else set(per[-1])
+    chosen = set(range(len(lowered) - 2, len(lowered)))
+    # breadth-first rounds: constraints sharing a live var join the
+    # cone and contribute their vars; stop at the cap — proximity to
+    # the seed is the relevance order
+    for _ in range(4):
+        added = False
+        for i in range(len(lowered) - 1, -1, -1):
+            if i in chosen or len(chosen) >= max_constraints:
+                continue
+            if per[i] & active:
+                chosen.add(i)
+                active |= per[i]
+                added = True
+        if not added or len(chosen) >= max_constraints:
+            break
+    return [lowered[i] for i in sorted(chosen)]
+
+
 def device_solving_enabled() -> bool:
     """First-line on-chip SAT search: on for accelerator backends
     ("auto"), forceable either way via args.device_solving."""
@@ -411,7 +475,7 @@ def check_terms(
                 and len(lowered) >= 2
                 and device_race.race_available()
             ):
-                race = device_race.DeviceRace(lowered)
+                race = device_race.DeviceRace(_race_cone(lowered))
                 if not race.started:
                     race = None
             device_tried = race is not None
@@ -419,17 +483,34 @@ def check_terms(
                 if race is not None:
                     found = race.poll()
                     if found is device_race.FAILED:
+                        SolverStatistics().race_losses += 1
                         race = None
                     elif found is not device_race.PENDING:
                         model = _reconstruct(
                             found, {}, recon, raw_constraints
                         )
+                        if model is None:
+                            # the cone witness alone doesn't cover the
+                            # full vocabulary: pin it and let the CDCL
+                            # extend it (the chip did the hard search)
+                            model = _extend_race_witness(
+                                found, blaster, native_session, units,
+                                lowered, recon, raw_constraints,
+                                remaining_ms=timeout_ms
+                                - int((time.monotonic() - t_total) * 1000),
+                            )
                         if model is not None:
                             SolverStatistics().device_sat_count += 1
+                            SolverStatistics().race_wins += 1
                             return sat, model
+                        SolverStatistics().race_losses += 1
                         race = None  # invalid witness: back to CDCL
                 rem = timeout_ms - int((time.monotonic() - t_total) * 1000)
                 if rem <= 0:
+                    if race is not None:
+                        # the query's budget ran out with the race
+                        # still searching: that IS a loss
+                        SolverStatistics().race_losses += 1
                     status = native_sat.UNKNOWN
                     break
                 # short slices only while a race could preempt the
@@ -441,6 +522,9 @@ def check_terms(
                     blaster.nvars, blaster.flat, units, max(200, slice_ms)
                 )
                 if status != native_sat.UNKNOWN:
+                    if race is not None:
+                        # the CDCL answered while a race was in flight
+                        SolverStatistics().race_losses += 1
                     break
                 if race is None:
                     break  # full remaining budget spent in one call
@@ -471,6 +555,15 @@ def check_terms(
     # this query references: the session store holds vars from every
     # query this run, and a same-named var of another width would
     # otherwise clobber the live one
+    model = _decode_bits(blaster, bits, lowered, recon, raw_constraints)
+    if model is None:
+        return unknown, None
+    SolverStatistics().cdcl_sat_count += 1
+    return sat, model
+
+
+def _decode_bits(blaster, bits, lowered, recon, raw_constraints):
+    """SAT bit vector -> validated word-level model (or None)."""
     bv_keys, bool_names = _collect_vars(lowered)
     base: Dict[str, int] = {}
     for key in bv_keys:
@@ -487,11 +580,82 @@ def check_terms(
         for name in bool_names
         if name in blaster.bool_vars
     }
-    model = _reconstruct(base, bools, recon, raw_constraints)
-    if model is None:
-        return unknown, None
-    SolverStatistics().cdcl_sat_count += 1
-    return sat, model
+    return _reconstruct(base, bools, recon, raw_constraints)
+
+
+def _extend_race_witness(
+    found: Dict[str, int],
+    blaster,
+    native_session,
+    units: List[int],
+    lowered,
+    recon,
+    raw_constraints,
+    remaining_ms: int = 8_000,
+):
+    """Two-stage device-led sat: the portfolio cracked the race cone's
+    core (found = {var: value}); pin those values as assumptions and
+    let the incremental CDCL extend them to a FULL model of the query
+    in one short propagation-heavy call. The hard search happened on
+    the chip; the CDCL only fills in the easy remainder (eliminated
+    select names, size bounds). Returns a validated model or None —
+    an inconsistent core (cone under-approximation) comes back unsat
+    here and the caller treats the race as lost."""
+    # keyed lookup: THIS query's (name, width) vocabulary — a linear
+    # scan of the persistent store would also pin stale same-named
+    # vars of other widths from earlier queries
+    bv_keys, _bool_names = _collect_vars(lowered)
+    width_of = {name: width for (name, width) in bv_keys}
+
+    def pins_for(names) -> List[int]:
+        pins: List[int] = []
+        for name in names:
+            value = found[name]
+            if name in blaster.bool_vars:
+                lit = blaster.bool_vars[name]
+                pins.append(lit if value else -lit)
+                continue
+            width = width_of.get(name)
+            var_bits = (
+                blaster.var_bits.get((name, width))
+                if width is not None
+                else None
+            )
+            if var_bits is None:
+                continue
+            for i, lit in enumerate(var_bits):
+                pins.append(lit if (value >> i) & 1 else -lit)
+        return pins
+
+    # full pin first; if the cone witness is inconsistent with the
+    # constraints outside the cone, relax to single-var pins — even
+    # one concretized 256-bit operand collapses the mul/div circuit
+    # the CDCL was grinding on. Every attempt respects the caller's
+    # remaining wall: the extension must not overrun the query budget.
+    deadline = time.monotonic() + max(0, remaining_ms) / 1000.0
+    attempts = [list(found.keys())]
+    attempts += [[n] for n in list(found.keys())[:3]]
+    for names in attempts:
+        left_ms = int((deadline - time.monotonic()) * 1000)
+        if left_ms <= 100:
+            return None
+        pins = pins_for(names)
+        if not pins:
+            continue
+        status, bits = native_session.solve(
+            blaster.nvars,
+            blaster.flat,
+            units + pins,
+            timeout_ms=min(2_000, left_ms),
+            conflict_budget=50_000,
+        )
+        if status == native_sat.SAT:
+            model = _decode_bits(
+                blaster, bits, lowered, recon, raw_constraints
+            )
+            if model is not None:
+                return model
+    return None
 
 
 def _reconstruct(

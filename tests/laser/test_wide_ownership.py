@@ -70,6 +70,27 @@ def test_device_completes_and_matches_host(wide_code, host_result):
 
 
 @pytest.mark.slow
+def test_bec_contract_shape():
+    """The BEC-guard fixture (corpusgen.bec_contract): the host walk
+    must find the unchecked-multiplication SWC-101 and the guarded
+    SWC-110 — pinning the hand-assembled jump offsets and the
+    `m/y != x` branch shape the hard-solve bench races on."""
+    from mythril_tpu.analysis.corpusgen import bec_contract
+
+    res = analyze_corpus(
+        [(bec_contract(), "", "bec")],
+        transaction_count=1,
+        execution_timeout=90,
+        create_timeout=5,
+        use_device=False,
+        processes=1,
+    )[0]
+    assert res["error"] is None
+    swcs = {i["swc-id"] for i in res["issues"]}
+    assert {"101", "110"} <= swcs
+
+
+@pytest.mark.slow
 def test_corpus_run_parks_wide_contract_early(wide_code):
     """Striped beside a never-converging contract, the wide contract
     must reach per-contract finality (parked, final_for_contract) even
