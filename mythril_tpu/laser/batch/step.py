@@ -224,12 +224,31 @@ def step(batch: StateBatch, code: CodeTable,
     pops = meta[:, 2]
     net_sp = meta[:, 3]
     underflow = batch.sp < pops
-    overflow = batch.sp + net_sp > stack_cap
+    over_cap = batch.sp + net_sp > stack_cap
+    if stack_cap >= 1024:
+        # the model holds the full EVM stack: the genuine stack-limit
+        # exception fires at the EVM's 1024, not at a roomier model
+        # cap (reference: StackOverflowException)
+        overflow = batch.sp + net_sp > 1024
+        cap_degrade = jnp.zeros_like(over_cap)
+    else:
+        # the model cap is BELOW the EVM's 1024: a lane that outgrows
+        # it proves nothing about real EVM behavior — degrade to the
+        # host engine (UNSUPPORTED -> takeover) instead of reporting a
+        # stack error the contract may never have
+        overflow = jnp.zeros_like(over_cap)
+        cap_degrade = over_cap
 
     is_invalid_op = live & (~valid | (op == INVALID_OP))
     is_unsupported = live & valid & ~supported & (op != INVALID_OP)
+    is_unsupported = is_unsupported | (
+        live & valid & supported & ~underflow & cap_degrade
+    )
     stack_err = live & valid & supported & (underflow | overflow)
-    ex = live & valid & supported & ~stack_err & (op != INVALID_OP)  # executing
+    ex = (
+        live & valid & supported & ~stack_err & ~cap_degrade
+        & (op != INVALID_OP)
+    )  # executing
 
     # ---- operands --------------------------------------------------------
     # one gather for every slot any phase peeks (a/b/c + DUP/SWAP

@@ -1,0 +1,47 @@
+"""Stack-capacity semantics: the EVM allows depth 1024; the device
+model often runs a smaller cap for bandwidth. Outgrowing a sub-1024
+MODEL cap must degrade the lane to the host (UNSUPPORTED — capacity,
+not behavior), while crossing the true EVM limit with a full-size
+stack is the genuine stack error. Reference anchor: the
+StackOverflowException at mythril/laser/ethereum/machine_state.py."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.batch.run import run
+from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
+
+
+def _pusher(n_pushes: int) -> bytes:
+    # PUSH1 1, n times, then STOP
+    return bytes([0x60, 0x01] * n_pushes + [0x00])
+
+
+def _run(code: bytes, stack_cap: int):
+    table = make_code_table([code])
+    batch = make_batch(
+        4, calldata=[b""] * 4, stack_cap=stack_cap
+    )
+    out, _ = run(batch, table, max_steps=4096)
+    return np.asarray(out.status)
+
+
+def test_small_cap_overflow_degrades_not_errors():
+    status = _run(_pusher(200), stack_cap=128)
+    assert (status == Status.UNSUPPORTED).all(), status
+
+
+def test_full_cap_runs_deep_contract():
+    status = _run(_pusher(200), stack_cap=1024)
+    assert (status == Status.STOPPED).all(), status
+
+
+@pytest.mark.slow
+def test_true_evm_limit_is_a_stack_error():
+    status = _run(_pusher(1100), stack_cap=1024)
+    assert (status == Status.ERR_STACK).all(), status
+
+
+def test_shallow_contract_unaffected_by_cap():
+    status = _run(_pusher(10), stack_cap=128)
+    assert (status == Status.STOPPED).all(), status
