@@ -47,10 +47,25 @@ A/B: the speedup the chip delivers over the proxy, not a constant.
 from __future__ import annotations
 
 import json
+import os
 import signal
 import statistics
 import sys
 import time
+
+#: Internal wall-clock budget for the WHOLE bench process (both
+#: attempts share it), sized below the harness's 870 s capture window:
+#: the bench must ALWAYS emit its one parseable JSON line with whatever
+#: phases completed, instead of being killed by the outer `timeout`
+#: (BENCH_r05.json's rc:124/parsed:null failure mode). Phases that
+#: don't fit the remaining budget are skipped and say so in the record.
+BENCH_BUDGET_S = float(os.environ.get("MYTHRIL_BENCH_BUDGET_S", "780"))
+_BENCH_T0 = time.monotonic()
+
+
+def _budget_left() -> float:
+    return BENCH_BUDGET_S - (time.monotonic() - _BENCH_T0)
+
 
 N_LANES = 16384
 N_STEPS = 256
@@ -269,6 +284,17 @@ def bench_corpus_convergence(strict: bool = True) -> dict:
 
     logging.disable(logging.WARNING)
     device_legs, host_legs = [], []
+
+    def _leg_deadline() -> int:
+        # each leg promises only the wall the bench budget still holds
+        # (minus slack for the later bench halves); a leg that cannot
+        # fit raises _Deadline NOW so the record says "deadline"
+        # instead of the outer timeout killing the process mid-leg
+        room = int(min(LEG_DEADLINE_S, _budget_left() - 90))
+        if room < 30:
+            raise _Deadline()
+        return room
+
     try:
         # Warm the wave kernels at the legs' exact shapes (one
         # untimed wave) — the same rule the transitions metric
@@ -284,21 +310,23 @@ def bench_corpus_convergence(strict: bool = True) -> dict:
             # to rot
             _with_deadline(
                 lambda: corpus_device_prepass(contracts, budget_s=0.0),
-                240,
+                min(240, _leg_deadline()),
             )
             print("bench: corpus wave kernels warmed", file=sys.stderr)
+        except _Deadline:
+            raise
         except Exception as e:
             print(f"bench: corpus warmup skipped: {e!r}", file=sys.stderr)
 
         for pair in range(CONV_PAIRS):
             device_legs.append(
                 _with_deadline(
-                    lambda: _corpus_leg(contracts, None), LEG_DEADLINE_S
+                    lambda: _corpus_leg(contracts, None), _leg_deadline()
                 )
             )
             host_legs.append(
                 _with_deadline(
-                    lambda: _corpus_leg(contracts, False), LEG_DEADLINE_S
+                    lambda: _corpus_leg(contracts, False), _leg_deadline()
                 )
             )
             print(
@@ -549,30 +577,63 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
 
 
 def main(final_attempt: bool = False) -> None:
-    dev = bench_transitions()
-    corpus = {}
+    dev = {}
     try:
-        corpus = bench_corpus_convergence(strict=not final_attempt)
+        dev = _with_deadline(
+            bench_transitions, max(30, min(240, int(_budget_left() - 60)))
+        )
     except _Deadline:
-        print("bench: a corpus leg hit its deadline", file=sys.stderr)
-        corpus = {"corpus": "deadline"}
-    except RuntimeError:
-        raise  # spread-gate rejection: let the __main__ retry rerun it
-    except Exception as e:
-        # the corpus half must not sink the device metric: any other
-        # bug is recorded as a skip, and the JSON line still prints
-        print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
-        corpus = {"corpus": "failed"}
+        print("bench: transitions half hit the budget", file=sys.stderr)
+        dev = {"transitions": "deadline"}
+    except Exception:
+        if not final_attempt:
+            raise  # linearity-gate rejection: let __main__ retry
+        import traceback as _tb
+
+        print(f"bench: transitions half failed: {_tb.format_exc()}", file=sys.stderr)
+        dev = {"transitions": "failed"}
+    corpus = {}
+    if _budget_left() < 120:
+        corpus = {"corpus": "budget-skipped"}
+        print("bench: corpus half skipped (budget spent)", file=sys.stderr)
+    else:
+        try:
+            corpus = bench_corpus_convergence(strict=not final_attempt)
+        except _Deadline:
+            print("bench: a corpus leg hit its deadline", file=sys.stderr)
+            corpus = {"corpus": "deadline"}
+        except RuntimeError:
+            if final_attempt:
+                corpus = {"corpus": "failed"}
+            else:
+                raise  # spread-gate rejection: let __main__ retry rerun it
+        except Exception as e:
+            # the corpus half must not sink the device metric: any other
+            # bug is recorded as a skip, and the JSON line still prints
+            print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
+            corpus = {"corpus": "failed"}
     default_path = {}
-    try:
-        default_path = bench_device_default_path()
-    except Exception as e:
-        print(f"bench: default-path half failed: {e!r}", file=sys.stderr)
+    if _budget_left() < 60:
+        default_path = {"default_path": "budget-skipped"}
+        print("bench: default-path half skipped (budget spent)", file=sys.stderr)
+    else:
+        try:
+            default_path = bench_device_default_path(
+                budget_s=max(30, min(210, int(_budget_left() - 45)))
+            )
+        except Exception as e:
+            print(f"bench: default-path half failed: {e!r}", file=sys.stderr)
     hard = {}
-    try:
-        hard = bench_hard_solve()
-    except Exception as e:
-        print(f"bench: hard-solve half failed: {e!r}", file=sys.stderr)
+    if _budget_left() < 45:
+        hard = {"hard_solve": "budget-skipped"}
+        print("bench: hard-solve half skipped (budget spent)", file=sys.stderr)
+    else:
+        try:
+            hard = bench_hard_solve(
+                budget_s=max(20, min(300, int(_budget_left() - 15)))
+            )
+        except Exception as e:
+            print(f"bench: hard-solve half failed: {e!r}", file=sys.stderr)
 
     vs_baseline = None
     if corpus.get("corpus_wall_s") and corpus.get("host_only_wall_s"):
@@ -581,16 +642,22 @@ def main(final_attempt: bool = False) -> None:
         )
     record = {
         "metric": "state_transitions_per_sec",
-        "value": round(dev["rate"], 1),
+        "value": round(dev["rate"], 1) if "rate" in dev else None,
         "unit": "states/sec",
         # measured: median host-only(proxy baseline, see BASELINE.md)
         # wall over median device wall on the corpus A/B
         "vs_baseline": vs_baseline,
         "vs_baseline_def": "host_only_wall_s / corpus_wall_s (measured)",
-        "scaling_ratio_4x_steps": round(dev["scaling_ratio"], 2),
+        "scaling_ratio_4x_steps": (
+            round(dev["scaling_ratio"], 2) if "scaling_ratio" in dev else None
+        ),
         "n_lanes": N_LANES,
         "n_steps": N_STEPS,
+        "bench_budget_s": BENCH_BUDGET_S,
+        "bench_wall_s": round(time.monotonic() - _BENCH_T0, 1),
     }
+    if "transitions" in dev:
+        record["transitions"] = dev["transitions"]
     for k in (
         "state_bytes_per_lane", "bytes_per_step", "batch_steps_per_sec",
         "hbm_demand_gbps", "hbm_utilization_pct", "mfu_pct",

@@ -144,6 +144,8 @@ def corpus_device_prepass(
     lock_wanted=None,
     execution_timeout: Optional[float] = None,
     ownership: bool = False,
+    deadline=None,
+    checkpoint_path=None,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
@@ -225,11 +227,21 @@ def corpus_device_prepass(
             host_lock=host_lock,
             stop_event=stop_event,
             publish=translate,
+            deadline=deadline,
+            checkpoint_path=checkpoint_path,
         )
         if lock_wanted is not None:
             explorer.lock_wanted = lock_wanted
         result = explorer.run()
     except Exception:
+        from mythril_tpu.support.resilience import (
+            DegradationLog,
+            DegradationReason,
+        )
+
+        DegradationLog().record(
+            DegradationReason.PREPASS_FAILED, site="corpus-prepass"
+        )
         log.warning("corpus device prepass failed", exc_info=True)
         return {}
     stats = result["stats"]
@@ -282,6 +294,7 @@ class OverlappedPrepass:
         budget_s: Optional[float] = None,
         execution_timeout: Optional[float] = None,
         ownership: bool = False,
+        deadline=None,
     ) -> None:
         import threading
 
@@ -309,6 +322,7 @@ class OverlappedPrepass:
                     lock_wanted=self._lock_wanted,
                     execution_timeout=execution_timeout,
                     ownership=ownership,
+                    deadline=deadline,
                 )
             )
 
@@ -496,6 +510,37 @@ def _owned_result(code, creation_code, name, outcome, address) -> Dict:
     }
 
 
+def _skipped_result(name: str, reason: str) -> Dict:
+    """The result slot for a contract the supervisor never analyzed
+    (deadline expiry / SIGTERM): same shape as an analyzed result so
+    report builders need no special case, explicitly marked so the
+    partial report can say WHICH contracts are missing and why. The
+    post-merge still folds in any witnesses the device prepass banked
+    for it — a run killed at minute 10 keeps every finding harvested
+    so far."""
+    from mythril_tpu.support.resilience import (
+        DegradationLog,
+        DegradationReason,
+    )
+
+    DegradationLog().record(
+        DegradationReason.CONTRACT_SKIPPED,
+        site="corpus",
+        detail=reason,
+        contract=name,
+    )
+    return {
+        "name": name,
+        "issues": [],
+        "states": 0,
+        "device_prepass": None,
+        "phases": {},
+        "precovered_skips": 0,
+        "error": None,
+        "skipped": reason,
+    }
+
+
 def _analyze_one(payload: Tuple) -> Dict:
     """Worker: analyze one contract, return issue dicts (run in a
     spawned process; heavyweight imports stay inside)."""
@@ -600,6 +645,8 @@ def analyze_corpus(
     use_device: Optional[bool] = None,
     device_budget_s: Optional[float] = None,
     deterministic_solving: Optional[bool] = None,
+    deadline_s: Optional[float] = None,
+    on_timeout: str = "partial",
     _flag_scoped: bool = False,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
@@ -607,8 +654,27 @@ def analyze_corpus(
     per-contract host pipeline — sequential with outcome injection when
     single-process, overlapped with a worker pool (witnesses merged
     afterward) otherwise. Returns one result dict per contract
-    ({name, issues, error, device_prepass, phases})."""
+    ({name, issues, error, device_prepass, phases, complete}).
+
+    Resource exhaustion is an OUTCOME here, not a crash: the supervisor
+    (support/resilience.py) is consulted at every contract boundary.
+    With `deadline_s` (falling back to the process-global run deadline)
+    an expired budget — or a delivered SIGINT/SIGTERM — stops launching
+    new work; already-harvested device witnesses still merge into the
+    skipped contracts' slots, each result says whether it is
+    `complete`, and `on_timeout` picks between the partial result list
+    (default) and a DeadlineExpiredError. Signal handling is the
+    CALLER's choice: enter `resilience.graceful_shutdown()` around this
+    call (the CLI and the fault harness do) to convert SIGINT/SIGTERM
+    into the graceful partial-run stop instead of process death."""
+    from mythril_tpu.support import resilience
+
     processes = processes or min(len(contracts), _effective_cpus())
+    deadline = (
+        resilience.run_deadline()
+        if deadline_s is None
+        else resilience.Deadline(deadline_s, label="corpus")
+    )
     if deterministic_solving is not None and not _flag_scoped:
         # The flag must also govern the PARENT-side device prepass
         # (flip solving + witness banking run in this process, not in
@@ -636,6 +702,8 @@ def analyze_corpus(
                 use_device=use_device,
                 device_budget_s=device_budget_s,
                 deterministic_solving=deterministic_solving,
+                deadline_s=deadline_s,
+                on_timeout=on_timeout,
                 _flag_scoped=True,
             )
         finally:
@@ -702,6 +770,7 @@ def analyze_corpus(
                 device_budget_s,
                 execution_timeout=execution_timeout,
                 ownership=_ownership_enabled(use_device),
+                deadline=deadline,
             )
             # Smallest code first: cheap analyses (which converge well
             # inside their budgets regardless of contention) soak up
@@ -740,6 +809,7 @@ def analyze_corpus(
             t_overlap = time.perf_counter()
             own = _ownership_enabled(use_device)
             slots: List[Optional[Dict]] = [None] * len(contracts)
+            halt_reason: Optional[str] = None
             try:
                 # Ownership-aware scheduling: a contract the running
                 # prepass may still freeze as final (no hard gate
@@ -755,13 +825,26 @@ def analyze_corpus(
                     progressed = False
                     deferred: List[int] = []
                     for i in pending:
+                        # the supervisor boundary: an expired deadline
+                        # or a delivered signal stops LAUNCHING work;
+                        # everything already harvested keeps flowing
+                        # into the partial report below
+                        resilience.inject("corpus.contract")
+                        if halt_reason is None:
+                            halt_reason = resilience.interrupted_reason(
+                                deadline
+                            )
+                        code, creation_code, name = contracts[i]
+                        if halt_reason is not None:
+                            slots[i] = _skipped_result(name, halt_reason)
+                            progressed = True
+                            continue
                         # per-contract, as before the deferral rework:
                         # a long pass over `pending` must still hand
                         # the prepass its uncontended tail past the
                         # overlap window
                         if time.perf_counter() - t_overlap > overlap_window_s:
                             pre.drain()
-                        code, creation_code, name = contracts[i]
                         outcome, device_ok = pre.outcome_for(i)
                         if own and _outcome_owns(outcome):
                             # device-complete contract: evidence IS
@@ -819,10 +902,33 @@ def analyze_corpus(
                     transaction_count=transaction_count,
                     execution_timeout=execution_timeout,
                     ownership=_ownership_enabled(use_device),
+                    deadline=deadline,
+                    stop_event=resilience.shutdown_event(),
                 )
             own = _ownership_enabled(use_device)
             results = []
+            halt_reason = None
             for i, (code, creation_code, name) in enumerate(contracts):
+                resilience.inject("corpus.contract")
+                if halt_reason is None:
+                    halt_reason = resilience.interrupted_reason(deadline)
+                if halt_reason is not None:
+                    # device-owned evidence survives the halt: synthesis
+                    # is cheap (no walk, no solver), so an owned
+                    # contract still reports in full
+                    owned_res = (
+                        _owned_result(
+                            code, creation_code, name, prepass[i], address
+                        )
+                        if own and _outcome_owns(prepass.get(i))
+                        else None
+                    )
+                    results.append(
+                        owned_res
+                        if owned_res is not None
+                        else _skipped_result(name, halt_reason)
+                    )
+                    continue
                 owned_res = (
                     _owned_result(
                         code, creation_code, name, prepass[i], address
@@ -843,24 +949,70 @@ def analyze_corpus(
                 results.append(owned_res)
     else:
         # pooled hosts: the prepass likewise overlaps the worker pool;
-        # witnesses merge in when both finish
+        # witnesses merge in when both finish. Results are collected
+        # INCREMENTALLY (imap preserves order) so a deadline or a
+        # signal keeps everything finished so far and marks only the
+        # tail skipped — map_async's all-or-nothing get() would lose
+        # the whole pool on a timeout.
         payloads = [
             payload(code, creation_code, name, False, None)
             for code, creation_code, name in contracts
         ]
         ctx = mp.get_context("spawn")  # fresh singletons per worker
         with ctx.Pool(processes=processes) as pool:
-            async_results = pool.map_async(_analyze_one, payloads)
+            walked = pool.imap(_analyze_one, payloads)
             if use_device:
                 prepass = corpus_device_prepass(
                     contracts,
                     budget_s=device_budget_s,
                     address=address,
                     transaction_count=transaction_count,
+                    deadline=deadline,
+                    stop_event=resilience.shutdown_event(),
                 )
-            results = async_results.get()
+            results = []
+            halt_reason = None
+            for code, _creation, name in contracts:
+                if halt_reason is None:
+                    halt_reason = resilience.interrupted_reason(deadline)
+                if halt_reason is None:
+                    try:
+                        if deadline is None:
+                            results.append(walked.next())
+                        else:
+                            results.append(
+                                walked.next(max(0.1, deadline.remaining))
+                            )
+                        continue
+                    except mp.TimeoutError:
+                        halt_reason = (
+                            resilience.interrupted_reason(deadline)
+                            or "deadline-expired"
+                        )
+                results.append(_skipped_result(name, halt_reason))
+            if halt_reason is not None:
+                # in-flight workers past the deadline: stop them now
+                pool.terminate()
     if prepass:
         _merge_prepass_witnesses(results, contracts, prepass, address)
+    skipped = 0
+    for result in results:
+        if result is None:
+            continue
+        # per-contract completion status, first-class in the result
+        # (and from there in the json/jsonv2 report meta): a partial
+        # run SAYS which contracts it covered
+        result["complete"] = (
+            not result.get("skipped") and result.get("error") is None
+        )
+        skipped += bool(result.get("skipped"))
+    if skipped and on_timeout == "fail":
+        from mythril_tpu.exceptions import DeadlineExpiredError
+
+        raise DeadlineExpiredError(
+            f"{skipped}/{len(contracts)} contract(s) unanalyzed at the "
+            "deadline (--on-timeout=fail)"
+        )
     return results
 
 

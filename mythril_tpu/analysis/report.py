@@ -201,6 +201,16 @@ class Report:
         self.source.get_source_from_contracts_list(contracts)
         self.exceptions = exceptions or []
         self.execution_info = execution_info or []
+        #: resilience outcome (support/resilience.py): `partial` is True
+        #: when the run was cut short (deadline / signal) and the issue
+        #: list is knowingly incomplete; `degradation` carries the
+        #: structured reason counts and per-contract completion status
+        #: ({"reasons": {reason: n}, "contracts": [{"contract", ...,
+        #: "complete", "device_complete"?, "skipped"?}]}). Both render
+        #: into json and jsonv2 ONLY when set, so clean runs' output is
+        #: byte-identical to before the supervisor existed.
+        self.partial: bool = False
+        self.degradation: Dict[str, Any] = {}
 
     def append_issue(self, issue: Issue) -> None:
         fingerprint = hashlib.md5(
@@ -227,10 +237,16 @@ class Report:
         return self._render_template("report_as_markdown.jinja2")
 
     def as_json(self) -> str:
-        return json.dumps(
-            {"success": True, "error": None, "issues": self.sorted_issues()},
-            sort_keys=True,
-        )
+        payload = {
+            "success": True,
+            "error": None,
+            "issues": self.sorted_issues(),
+        }
+        if self.partial:
+            payload["partial"] = True
+        if self.degradation:
+            payload["degradation"] = self.degradation
+        return json.dumps(payload, sort_keys=True)
 
     def as_swc_standard_format(self) -> str:
         """The jsonv2 (SWC standard) output."""
@@ -240,6 +256,10 @@ class Report:
         ]
 
         meta_data = self.meta
+        if self.partial:
+            meta_data["partial"] = True
+        if self.degradation:
+            meta_data["degradation"] = self.degradation
         if self.exceptions:
             meta_data["logs"] = [
                 {"level": "error", "hidden": True, "msg": why}

@@ -38,3 +38,35 @@ class DetectorNotFoundError(MythrilBaseException):
 
 class IllegalArgumentError(ValueError):
     """An argument combination is invalid."""
+
+
+# -- resilience taxonomy (support/resilience.py) ---------------------------
+# Resource exhaustion and infrastructure faults are first-class
+# OUTCOMES of an analysis, not crashes: these types carry the fault to
+# the supervisor layer, which degrades the affected lane/contract and
+# keeps the corpus running.
+
+
+class DeadlineExpiredError(MythrilBaseException):
+    """The run's wall-clock deadline expired (--deadline with
+    --on-timeout=fail; partial mode reports instead of raising)."""
+
+
+class WatchdogTimeout(MythrilBaseException):
+    """A guarded native call wedged past its watchdog budget and was
+    abandoned — the callee's state (e.g. a CDCL clause session) must be
+    treated as lost and rebuilt."""
+
+
+class DeviceDispatchError(MythrilBaseException):
+    """A device dispatch kept failing after retries and the reduced-
+    capacity fallback — the caller degrades the work to the host."""
+
+
+class InjectedFault(MythrilBaseException):
+    """A deterministic fault fired by the injection harness
+    (support/resilience.py arm_fault). Never raised in production runs."""
+
+    def __init__(self, site: str, detail: str = ""):
+        super().__init__(f"injected fault at {site}" + (f": {detail}" if detail else ""))
+        self.site = site

@@ -26,6 +26,7 @@ from mythril_tpu.analysis.module import ModuleLoader
 from mythril_tpu.exceptions import (
     AddressNotFoundError,
     CriticalError,
+    DeadlineExpiredError,
     DetectorNotFoundError,
 )
 from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
@@ -273,6 +274,33 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--deadline",),
+        dict(
+            type=float,
+            default=None,
+            metavar="SECONDS",
+            help=(
+                "Wall-clock budget for the WHOLE run: solver queries "
+                "clamp to it, device waves stop at it, and on expiry "
+                "the analysis degrades per --on-timeout instead of "
+                "running past the budget"
+            ),
+        ),
+    ),
+    (
+        ("--on-timeout",),
+        dict(
+            choices=["partial", "fail"],
+            default="partial",
+            help=(
+                "What an expired --deadline produces: 'partial' emits "
+                "the report built so far, marked partial with "
+                "per-contract completion status and degradation-reason "
+                "counts; 'fail' exits with an error"
+            ),
+        ),
+    ),
+    (
         ("--corpus-shard",),
         dict(
             default=None,
@@ -421,8 +449,10 @@ def _shared_parser(rows) -> ArgumentParser:
 # ---------------------------------------------------------------------------
 # error output
 # ---------------------------------------------------------------------------
-def exit_with_error(format_, message):
-    """Print the error in the requested output format and exit."""
+def exit_with_error(format_, message, exit_code=None):
+    """Print the error in the requested output format and exit.
+    `exit_code` defaults to the reference CLI's bare sys.exit() (code
+    0); callers for whom the failure is a hard contract pass nonzero."""
     if format_ in ("text", "markdown"):
         log.error(message)
     elif format_ == "json":
@@ -449,7 +479,7 @@ def exit_with_error(format_, message):
                 ]
             )
         )
-    sys.exit()
+    sys.exit(exit_code)
 
 
 # ---------------------------------------------------------------------------
@@ -809,6 +839,8 @@ def _run_analyze(disassembler, address, args):
         device_prepass_budget=args.device_prepass_budget,
         device_ownership=args.device_ownership,
         deterministic_solving=args.deterministic_solving,
+        deadline=args.deadline,
+        on_timeout=args.on_timeout,
     )
 
     if not disassembler.contracts:
@@ -856,6 +888,12 @@ def _run_analyze(disassembler, address, args):
         _print_report(report, args.outform)
     except DetectorNotFoundError as e:
         exit_with_error(args.outform, format(e))
+    except DeadlineExpiredError as e:
+        # --on-timeout=fail: the budget is a hard contract, and the
+        # exit code says so (scripts gate on it)
+        exit_with_error(
+            args.outform, "Analysis deadline expired: " + format(e), exit_code=1
+        )
     except CriticalError as e:
         exit_with_error(args.outform, "Analysis error encountered: " + format(e))
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Optional, Tuple, Union
+from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
@@ -26,18 +26,36 @@ def save_checkpoint(
     batch: StateBatch,
     code: Optional[CodeTable] = None,
     step: int = 0,
+    extra: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
-    """Write the frontier (and optionally the code table) to `path`."""
+    """Write the frontier (and optionally the code table) to `path`.
+
+    `extra` arrays ride along under their own namespace — the wave
+    flush (explore.py) stores per-lane context the StateBatch itself
+    doesn't carry (e.g. the synthetic-storage mask), so a resumed wave
+    replays exactly. Readers that don't know the extras ignore them."""
     arrays = {f"batch.{name}": np.asarray(value) for name, value in batch._asdict().items()}
     if code is not None:
         arrays.update(
             {f"code.{name}": np.asarray(value) for name, value in code._asdict().items()}
         )
+    for name, value in (extra or {}).items():
+        arrays[f"extra.{name}"] = np.asarray(value)
     arrays["meta"] = np.frombuffer(
         json.dumps({"version": FORMAT_VERSION, "step": int(step)}).encode(),
         dtype=np.uint8,
     )
     np.savez_compressed(str(path), **arrays)
+
+
+def load_checkpoint_extra(path: Union[str, Path]) -> Dict[str, np.ndarray]:
+    """The sidecar arrays a checkpoint carries beyond the frontier."""
+    out: Dict[str, np.ndarray] = {}
+    with np.load(str(path)) as data:
+        for key in data.files:
+            if key.startswith("extra."):
+                out[key[len("extra."):]] = data[key]
+    return out
 
 
 def load_checkpoint(

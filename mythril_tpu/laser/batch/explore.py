@@ -47,7 +47,11 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from mythril_tpu.exceptions import SolverTimeOutException, UnsatError
+from mythril_tpu.exceptions import (
+    DeviceDispatchError,
+    SolverTimeOutException,
+    UnsatError,
+)
 from mythril_tpu.laser.batch.arena import ArenaView
 from mythril_tpu.laser.batch.state import (
     Status,
@@ -139,6 +143,11 @@ class ExploreStats:
         # cover on this workload (laser/batch/state.py caps).
         self.lanes_degraded_mem = 0
         self.lanes_degraded_unsupported = 0
+        # resilience observability: waves whose dispatch died past the
+        # retry ladder (the exploration degraded instead of crashing),
+        # and wave checkpoints flushed for resume
+        self.device_faults = 0
+        self.wave_checkpoints = 0
         self.wall_s = 0.0
         # where the prepass wall goes: device wave execution vs host
         # flip solving (the two phases that can dominate)
@@ -612,6 +621,8 @@ class DeviceCorpusExplorer:
         publish=None,
         mem_cap: int = 16384,
         storage_cap: int = 128,
+        deadline=None,
+        checkpoint_path=None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -643,6 +654,14 @@ class DeviceCorpusExplorer:
         # owner end the exploration when its own work is done.
         self.host_lock = host_lock
         self.stop_event = stop_event
+        #: resilience supervision (support/resilience.py): an expired
+        #: `deadline` — or a delivered SIGINT/SIGTERM — reads as a stop
+        #: request at every wave/budget boundary, and `checkpoint_path`
+        #: flushes each wave's seeded frontier to npz BEFORE dispatch,
+        #: so a wave killed mid-flight replays exactly (replay_wave)
+        self.deadline = deadline
+        self.checkpoint_path = checkpoint_path
+        self._halt_reason = None
         #: set while this explorer wants/holds the host lock — the
         #: overlapped owner only needs to yield between analyses when
         #: a flip burst is actually waiting, not once per contract
@@ -678,6 +697,29 @@ class DeviceCorpusExplorer:
 
             self.mesh = make_mesh(n_devices)
             self.code_table = replicate_table(self.code_table, self.mesh)
+
+    # -- supervision ---------------------------------------------------
+    def _stop_requested(self) -> bool:
+        """One answer for every wave/budget/solve boundary: the owner's
+        stop event, a delivered SIGINT/SIGTERM, or an expired deadline
+        all read as "finish the current unit of work and wind down with
+        partial outcomes". The first trigger is remembered so the final
+        stats can say WHY the run ended early."""
+        from mythril_tpu.support import resilience
+
+        if self.stop_event is not None and self.stop_event.is_set():
+            self._halt_reason = self._halt_reason or "stop-event"
+            return True
+        reason = resilience.interrupted_reason(self.deadline)
+        if reason is not None:
+            if self._halt_reason is None:
+                self._halt_reason = reason
+                resilience.DegradationLog().record(
+                    reason, site="explorer",
+                    detail="exploration wound down at a wave boundary",
+                )
+            return True
+        return False
 
     # -- seeding -------------------------------------------------------
     def _seed_phase_inputs(self) -> List[List[Tuple[int, bytes]]]:
@@ -739,9 +781,7 @@ class DeviceCorpusExplorer:
             # a stop request bounds post-stop lock-held work to the
             # query in flight — the owner may be waiting on a join
             # deadline past which it stops honoring the lock protocol
-            if stopped or (
-                self.stop_event is not None and self.stop_event.is_set()
-            ):
+            if stopped or self._stop_requested():
                 stopped = True
                 capped.add(i)
                 continue
@@ -873,10 +913,42 @@ class DeviceCorpusExplorer:
                     sym.sval_tid,
                 )
             )
-        out, steps = sym_run(
-            sym,
-            self.code_table,
-            max_steps=self.steps_per_wave,
+        if self.checkpoint_path:
+            # flush the SEEDED frontier before the dispatch: a wave
+            # killed mid-flight (fault, OOM, SIGKILL) leaves its exact
+            # inputs on disk, and the engine is deterministic, so
+            # replay_wave reproduces the lost wave bit-for-bit
+            try:
+                from mythril_tpu.laser.batch.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    self.checkpoint_path,
+                    base,
+                    self.code_table,
+                    step=self.steps_per_wave,
+                    extra={"synthetic": synthetic.astype(np.uint8)},
+                )
+                self.stats.wave_checkpoints += 1
+            except Exception:
+                log.warning("wave checkpoint flush failed", exc_info=True)
+
+        from mythril_tpu.support import resilience
+
+        resilience.inject("explore.wave")
+
+        def _dispatch():
+            import jax as _jax
+
+            o, s = sym_run(sym, self.code_table, max_steps=self.steps_per_wave)
+            # surface asynchronous XLA faults inside the containment,
+            # not at some later readback outside it
+            _jax.block_until_ready(s)
+            return o, s
+
+        out, steps = resilience.retry_device_dispatch(
+            _dispatch,
+            label="wave",
+            policy=resilience.RetryPolicy(attempts=2, base_delay_s=0.2),
         )
         base_out = out.base
         view = ArenaView(out)
@@ -1470,7 +1542,7 @@ class DeviceCorpusExplorer:
         False when the wall-clock budget is exhausted."""
         inputs = self._seed_phase_inputs()
         for wave_no in range(self.waves):
-            if self.stop_event is not None and self.stop_event.is_set():
+            if self._stop_requested():
                 # honored before DISPATCHING a wave, not only at the
                 # budget check — the last-wave break and the phase
                 # advance both skip _budget_spent
@@ -1568,7 +1640,7 @@ class DeviceCorpusExplorer:
         return time.perf_counter() - self._t_start > self.budget_s + 45
 
     def _allowance_spent(self, allowance: Optional[float]) -> bool:
-        if self.stop_event is not None and self.stop_event.is_set():
+        if self._stop_requested():
             return True
         budget_s = allowance if allowance is not None else self.budget_s
         if budget_s is None:
@@ -1657,14 +1729,34 @@ class DeviceCorpusExplorer:
                 for track in self.tracks:
                     track._final_phase_overflow_base = track.carry_overflow
             self.stats.transactions = txn + 1
-            finished = self._phase(txn)
+            try:
+                finished = self._phase(txn)
+            except DeviceDispatchError as why:
+                # a wave died past the retry ladder: the exploration
+                # DEGRADES — every live frontier reopens (those
+                # contracts go to the host walk), the banked evidence
+                # and coverage so far stay valid, and the corpus run
+                # continues instead of crashing
+                from mythril_tpu.support.resilience import (
+                    DegradationLog,
+                    DegradationReason,
+                )
+
+                DegradationLog().record(
+                    DegradationReason.WAVE_ABANDONED,
+                    site="explorer",
+                    detail=str(why),
+                )
+                self.stats.device_faults += 1
+                for track in self.tracks:
+                    if not track.idle and not track.still_exhausted():
+                        track.frontier_closed = False
+                break
             # completeness accounting: a phase that ended on budget or
             # wave cap (or a stop request) leaves live frontiers open —
             # those contracts are NOT device-complete and the ownership
             # gate must send them to the host walk
-            stopped = (
-                self.stop_event is not None and self.stop_event.is_set()
-            )
+            stopped = self._stop_requested()
             for track in self.tracks:
                 if not track.idle and not track.exhausted:
                     track.frontier_closed = False
@@ -1693,13 +1785,60 @@ class DeviceCorpusExplorer:
         self.stats.wall_s = round(time.perf_counter() - self._t_start, 3)
         self.stats.wave_exec_s = round(self.stats.wave_exec_s, 3)
         self.stats.flip_solve_s = round(self.stats.flip_solve_s, 3)
+        stats = self.stats.as_dict()
+        if self._halt_reason:
+            # WHY the run ended early (deadline-expired / interrupted /
+            # stop-event) — consumers mark the outcome partial with a
+            # structured reason instead of guessing from counters
+            stats["halt_reason"] = self._halt_reason
         return {
-            "stats": self.stats.as_dict(),
+            "stats": stats,
             "contracts": [
                 dict(t._final_outcome) if t.parked else t.outcome()
                 for t in self.tracks
             ],
         }
+
+
+def replay_wave(path):
+    """Re-execute a flushed wave checkpoint exactly.
+
+    The explorer writes each wave's SEEDED frontier (StateBatch + code
+    table + synthetic-storage mask) to `checkpoint_path` before the
+    dispatch, so a run killed mid-wave loses nothing: this function
+    reloads the npz, rebuilds the symbolic batch — reapplying the
+    synthetic mask the same way `_run_wave` did — and runs the wave to
+    the same step budget. The engine is deterministic, so the replayed
+    coverage/status/evidence equal the uninterrupted wave's
+    (tests/laser/test_resilience.py asserts this bit-for-bit).
+
+    Returns (ArenaView, sym_out, steps)."""
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.batch.checkpoint import (
+        load_checkpoint,
+        load_checkpoint_extra,
+    )
+
+    batch, code, wave_steps = load_checkpoint(path)
+    if code is None:
+        raise ValueError("wave checkpoint carries no code table")
+    sym = make_sym_batch(batch)
+    synthetic = load_checkpoint_extra(path).get("synthetic")
+    if synthetic is not None and synthetic.any():
+        seeded = (
+            jnp.arange(sym.sval_tid.shape[1])[None, :]
+            < jnp.asarray(batch.storage_cnt)[:, None]
+        )
+        sym = sym._replace(
+            sval_tid=jnp.where(
+                jnp.asarray(synthetic.astype(bool))[:, None] & seeded,
+                jnp.int32(-1),
+                sym.sval_tid,
+            )
+        )
+    out, steps = sym_run(sym, code, max_steps=int(wave_steps))
+    return ArenaView(out), out, int(steps)
 
 
 class DeviceSymbolicExplorer(DeviceCorpusExplorer):

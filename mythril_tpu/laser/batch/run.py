@@ -37,3 +37,68 @@ def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
 
     out, steps = lax.while_loop(cond, body, (batch, jnp.int32(0)))
     return out, steps
+
+
+def run_resilient(
+    batch: StateBatch,
+    code: CodeTable,
+    max_steps: int = 4096,
+    unroll: int = 1,
+    track_coverage: bool = True,
+    retries: int = 2,
+    allow_split: bool = True,
+):
+    """`run` under the device-dispatch fault ladder
+    (support/resilience.py): XLA compile / OOM / device-lost errors are
+    retried with exponential backoff, then — still failing — the batch
+    is split in half and each half dispatched separately (an OOM'd or
+    flaky device often carries the reduced capacity), and only when
+    even the halves fail does DeviceDispatchError reach the caller,
+    which degrades the work to the host instead of crashing the run.
+
+    The dispatch blocks until the result is ready so asynchronous XLA
+    errors surface HERE, inside the containment, not at some later
+    readback outside it. Logic errors (shape bugs, tracer leaks)
+    propagate untouched — only classified infrastructure faults enter
+    the ladder."""
+    from mythril_tpu.exceptions import DeviceDispatchError
+    from mythril_tpu.support.resilience import (
+        DegradationLog,
+        DegradationReason,
+        RetryPolicy,
+        retry_device_dispatch,
+    )
+
+    policy = RetryPolicy(attempts=retries + 1)
+
+    def dispatch(b):
+        def _go():
+            out, steps = run(
+                b, code, max_steps=max_steps, unroll=unroll,
+                track_coverage=track_coverage,
+            )
+            jax.block_until_ready(steps)
+            return out, steps
+
+        return retry_device_dispatch(_go, label="batch-run", policy=policy)
+
+    try:
+        return dispatch(batch)
+    except DeviceDispatchError:
+        n = int(batch.pc.shape[0])
+        if not allow_split or n < 2:
+            raise
+        DegradationLog().record(
+            DegradationReason.DEVICE_SPLIT_DISPATCH,
+            site="batch-run",
+            detail=f"retrying as 2x{n // 2}-lane dispatches",
+        )
+        half = n // 2
+        first = jax.tree_util.tree_map(lambda a: a[:half], batch)
+        second = jax.tree_util.tree_map(lambda a: a[half:], batch)
+        out_a, steps_a = dispatch(first)
+        out_b, steps_b = dispatch(second)
+        merged = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), out_a, out_b
+        )
+        return merged, max(int(steps_a), int(steps_b))

@@ -164,6 +164,19 @@ def resume_on_host(
     """
     if int(batch.status[lane]) not in RESUMABLE:
         return None
+    from mythril_tpu.support.resilience import (
+        DegradationLog,
+        DegradationReason,
+    )
+
+    # first-class outcome, not a silent log line: every takeover is a
+    # lane the device model could not carry, and reports surface the
+    # count beside the other degradation reasons
+    DegradationLog().record(
+        DegradationReason.HOST_TAKEOVER,
+        site="takeover",
+        detail=f"lane {lane} status {int(batch.status[lane])}",
+    )
     try:
         time_handler.start_execution(timeout_s)
         laser, _ = lift_lane(code_hex, batch, lane, extra_accounts)

@@ -16,7 +16,13 @@ from typing import Dict, List, Optional, Set
 
 from mythril_tpu.laser.smt import terms
 from mythril_tpu.laser.smt.model import Model
-from mythril_tpu.laser.smt.solver.solver import BaseSolver, check_terms, sat, unsat
+from mythril_tpu.laser.smt.solver.solver import (
+    BaseSolver,
+    check_terms,
+    sat,
+    unknown,
+    unsat,
+)
 from mythril_tpu.laser.smt.solver.solver_statistics import stat_smt_query
 
 
@@ -77,6 +83,8 @@ class IndependenceSolver(BaseSolver):
 
     @stat_smt_query
     def check(self, *extra) -> str:
+        from mythril_tpu.support import resilience
+
         self._model = None
         dep_map = DependenceMap()
         for c in self.constraints + self._norm(extra):
@@ -85,8 +93,20 @@ class IndependenceSolver(BaseSolver):
         per_bucket_ms = max(
             500, self.timeout // max(1, len(dep_map.buckets))
         )
+        deadline = resilience.run_deadline()
         worst = sat
-        for bucket in dep_map.buckets:
+        for i, bucket in enumerate(dep_map.buckets):
+            if deadline is not None and deadline.expired:
+                # remaining buckets degrade to unknown-with-reason: an
+                # unsat verdict needs EVERY bucket's answer, and the
+                # run has no wall left to earn them
+                resilience.DegradationLog().record(
+                    resilience.DegradationReason.SOLVER_TIMEOUT,
+                    site="independence-solver",
+                    detail=f"{len(dep_map.buckets) - i} bucket(s) unsolved "
+                    "at run deadline",
+                )
+                return unknown
             status, model = check_terms(bucket.conditions, timeout_ms=per_bucket_ms)
             if status == unsat:
                 return unsat

@@ -140,11 +140,21 @@ class SolverSession:
         self.loaded_lits = 0
         self.loaded_vars = 0
         self.poisoned = False
+        #: a watchdog abandoned this session mid-call: the native
+        #: object may still be in use by the zombie thread, so close()
+        #: must LEAK it rather than free memory out from under C++
+        self.abandoned = False
 
     def close(self):
-        if self._s is not None:
+        if self._s is not None and not self.abandoned:
             self._lib.cdcl_delete(self._s)
-            self._s = None
+        self._s = None
+
+    def abandon(self):
+        """Mark the session wedged: unusable, and never freed (the
+        hung native call may still hold the pointer)."""
+        self.poisoned = True
+        self.abandoned = True
 
     def __del__(self):
         try:
@@ -155,6 +165,40 @@ class SolverSession:
     def solve(self, nvars: int, flat_clauses, units: List[int],
               timeout_ms: Optional[int] = None,
               conflict_budget: Optional[int] = None):
+        """Watchdog-guarded entry: `_solve_inner` runs in a worker
+        thread bounded by the call's own wall budget plus a grace
+        (support/resilience.py). A chunk that wedges inside the native
+        solver — past every between-chunk deadline check — raises
+        WatchdogTimeout with the session abandoned; solver.py rebuilds
+        the clause session and retries the query once before degrading
+        to UNKNOWN. The `solver.cdcl` injection site fires inside the
+        guarded region so the fault suite can simulate the wedge."""
+        from mythril_tpu.support import resilience
+
+        budget_s = resilience.solver_watchdog_budget_s(timeout_ms)
+
+        def _work():
+            resilience.inject("solver.cdcl")
+            return self._solve_inner(
+                nvars, flat_clauses, units, timeout_ms, conflict_budget
+            )
+
+        if budget_s is None:
+            return _work()
+        try:
+            return resilience.call_with_watchdog(
+                _work, budget_s, label="native-cdcl"
+            )
+        except Exception as why:
+            from mythril_tpu.exceptions import WatchdogTimeout
+
+            if isinstance(why, WatchdogTimeout):
+                self.abandon()
+            raise
+
+    def _solve_inner(self, nvars: int, flat_clauses, units: List[int],
+                     timeout_ms: Optional[int] = None,
+                     conflict_budget: Optional[int] = None):
         """Load the store delta and solve under `units` as assumptions.
         Returns (status, bits) like solve_flat.
 

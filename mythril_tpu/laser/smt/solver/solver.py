@@ -257,6 +257,29 @@ def reset_blast_session() -> None:
     _session = None
 
 
+def _rebuild_native_session() -> native_sat.SolverSession:
+    """Replace a wedged native session with a fresh one paired to the
+    SAME blaster: the new session's first solve reloads the whole flat
+    store (loaded_lits starts at 0), so no blasted clause is lost —
+    only the learned clauses, the price of abandoning a hung solver."""
+    global _session
+    from mythril_tpu.support.resilience import (
+        DegradationLog,
+        DegradationReason,
+    )
+
+    DegradationLog().record(
+        DegradationReason.SOLVER_SESSION_REBUILT, site="cdcl"
+    )
+    if _session is None:
+        return _blast_session()[1]
+    blaster, old = _session
+    old.close()  # a no-op leak when the watchdog abandoned it
+    fresh = native_sat.SolverSession()
+    _session = (blaster, fresh)
+    return fresh
+
+
 def _collect_vars(lowered: List[terms.Term]):
     """Free (name, width) bit-vector vars and bool var names of a
     lowered constraint set (iterative walk over the interned DAG)."""
@@ -369,6 +392,21 @@ def check_terms(
     pure function of the query whenever the wall valve doesn't fire —
     callers that must be reproducible (objective refinement) pass a
     budget sized to finish well inside their wall allowance."""
+    from mythril_tpu.support import resilience
+
+    run_dl = resilience.run_deadline()
+    if run_dl is not None:
+        if run_dl.expired:
+            # the run is out of wall: every further query degrades to
+            # UNKNOWN-with-reason instead of spending time the caller
+            # no longer has (the supervisor reports the count)
+            resilience.DegradationLog().record(
+                resilience.DegradationReason.SOLVER_TIMEOUT,
+                site="check_terms",
+                detail="run deadline expired before solve",
+            )
+            return unknown, None
+        timeout_ms = run_dl.clamp_ms(timeout_ms)
     t_total = time.monotonic()
     lowered, recon = lower(raw_constraints)
     if any(c is terms.FALSE for c in lowered):
@@ -377,6 +415,35 @@ def check_terms(
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
     blaster, native_session = _blast_session()
+
+    def _native_solve(units_, timeout_ms_, conflict_budget_=None):
+        """One rung of the escalation ladder's hang recovery: a native
+        solve whose watchdog fired is abandoned, the clause session
+        rebuilt (same blaster — the store reloads), and the query
+        retried ONCE; a second hang degrades to UNKNOWN-with-reason.
+        Reassigns the enclosing session so later rungs of this query
+        use the rebuilt one."""
+        nonlocal native_session
+        from mythril_tpu.exceptions import WatchdogTimeout
+
+        for attempt in (1, 2):
+            try:
+                return native_session.solve(
+                    blaster.nvars,
+                    blaster.flat,
+                    units_,
+                    timeout_ms=timeout_ms_,
+                    conflict_budget=conflict_budget_,
+                )
+            except WatchdogTimeout as why:
+                resilience.DegradationLog().record(
+                    resilience.DegradationReason.SOLVER_HANG,
+                    site="cdcl",
+                    detail=f"attempt {attempt}: {why}",
+                )
+                native_session = _rebuild_native_session()
+        return native_sat.UNKNOWN, None
+
     import sys
 
     old_limit = sys.getrecursionlimit()
@@ -421,10 +488,8 @@ def check_terms(
     # calibrated ~10k/s must not burn most of the per-query wall
     # inside the sprint and starve the device attempt + marathon.
     sprint_ms = remaining if deterministic else min(2000, remaining)
-    status, bits = native_session.solve(
-        blaster.nvars, blaster.flat, units,
-        timeout_ms=sprint_ms,
-        conflict_budget=SPRINT_CONFLICTS,
+    status, bits = _native_solve(
+        units, sprint_ms, conflict_budget_=SPRINT_CONFLICTS
     )
     if status == native_sat.UNSAT:
         return unsat, None
@@ -462,9 +527,8 @@ def check_terms(
                     timeout_ms - int((time.monotonic() - t_total) * 1000),
                 )
             )
-            status, bits = native_session.solve(
-                blaster.nvars, blaster.flat, units, remaining,
-                conflict_budget=conflict_budget,
+            status, bits = _native_solve(
+                units, remaining, conflict_budget_=conflict_budget
             )
         else:
             from mythril_tpu.laser.smt.solver import device_race
@@ -505,6 +569,10 @@ def check_terms(
                             return sat, model
                         SolverStatistics().race_losses += 1
                         race = None  # invalid witness: back to CDCL
+                        # the witness extension may have abandoned a
+                        # wedged session; resync so the CDCL continues
+                        # on the rebuilt one instead of a poisoned stub
+                        blaster, native_session = _blast_session()
                 rem = timeout_ms - int((time.monotonic() - t_total) * 1000)
                 if rem <= 0:
                     if race is not None:
@@ -518,9 +586,7 @@ def check_terms(
                 # (the incremental session keeps learned clauses, so
                 # slicing costs only empty delta loads)
                 slice_ms = min(1000, rem) if race is not None else rem
-                status, bits = native_session.solve(
-                    blaster.nvars, blaster.flat, units, max(200, slice_ms)
-                )
+                status, bits = _native_solve(units, max(200, slice_ms))
                 if status != native_sat.UNKNOWN:
                     if race is not None:
                         # the CDCL answered while a race was in flight
@@ -642,13 +708,33 @@ def _extend_race_witness(
         pins = pins_for(names)
         if not pins:
             continue
-        status, bits = native_session.solve(
-            blaster.nvars,
-            blaster.flat,
-            units + pins,
-            timeout_ms=min(2_000, left_ms),
-            conflict_budget=50_000,
-        )
+        try:
+            status, bits = native_session.solve(
+                blaster.nvars,
+                blaster.flat,
+                units + pins,
+                timeout_ms=min(2_000, left_ms),
+                conflict_budget=50_000,
+            )
+        except Exception as why:
+            from mythril_tpu.exceptions import WatchdogTimeout
+
+            if not isinstance(why, WatchdogTimeout):
+                raise
+            # the extension is best-effort on top of a race win: a
+            # wedged session loses the witness, never the query — the
+            # caller treats the race as lost and the CDCL (on a
+            # rebuilt session) proceeds
+            from mythril_tpu.support.resilience import (
+                DegradationLog,
+                DegradationReason,
+            )
+
+            DegradationLog().record(
+                DegradationReason.SOLVER_HANG, site="cdcl-extend"
+            )
+            _rebuild_native_session()
+            return None
         if status == native_sat.SAT:
             model = _decode_bits(
                 blaster, bits, lowered, recon, raw_constraints

@@ -47,6 +47,12 @@ _RUN_DEFAULTS = dict(
     create_timeout=None,
     disable_dependency_pruning=False,
     custom_modules_directory="",
+    # deadline-aware supervision (support/resilience.py): a wall-clock
+    # budget for the WHOLE run; on expiry the analyzer stops launching
+    # contracts and either reports partial (with per-contract
+    # completion status) or fails hard, per on_timeout
+    deadline=None,
+    on_timeout="partial",
 )
 
 #: options published to the global `args` bag for the deep layers
@@ -95,6 +101,10 @@ class MythrilAnalyzer:
         args.iprof = enable_iprof
         if solver_timeout is not None:
             args.solver_timeout = solver_timeout
+        # mirrored into the flag bag for observability (deep layers
+        # consult resilience.run_deadline(), set in fire_lasers)
+        args.run_deadline_s = self.deadline
+        args.on_timeout = self.on_timeout
 
     # -- shared engine construction ------------------------------------
     def _symbolically_execute(self, contract, **overrides) -> SymExecWrapper:
@@ -152,6 +162,8 @@ class MythrilAnalyzer:
         try:
             from mythril_tpu.analysis.corpus import OverlappedPrepass
 
+            from mythril_tpu.support import resilience
+
             return OverlappedPrepass(
                 [
                     (c.code or "", getattr(c, "creation_code", "") or "", c.name)
@@ -161,6 +173,7 @@ class MythrilAnalyzer:
                 transaction_count or 2,
                 execution_timeout=self.execution_timeout,
                 ownership=getattr(args, "device_ownership", "auto") != "never",
+                deadline=resilience.run_deadline(),
             )
         except Exception:
             log.debug("overlapped corpus prepass unavailable", exc_info=True)
@@ -186,24 +199,57 @@ class MythrilAnalyzer:
         accelerator, the striped device prepass overlaps the loop —
         the reference's sequential per-contract for-loop
         (mythril/mythril/mythril_analyzer.py:145-185) becomes the host
-        half of a host+device pipeline."""
+        half of a host+device pipeline.
+
+        The run is supervised (support/resilience.py): --deadline
+        installs the process-global run deadline every solver query and
+        wave loop clamps to, SIGINT/SIGTERM degrade to a graceful stop,
+        and an expired budget yields a PARTIAL report — per-contract
+        completion status plus degradation-reason counts in the meta —
+        or a hard DeadlineExpiredError under --on-timeout=fail."""
+        from mythril_tpu.support import resilience
+
         SolverStatistics().enabled = True
+        degradation_marker = resilience.DegradationLog().marker()
+        if self.deadline is not None:
+            resilience.set_run_deadline(self.deadline)
         pre = self._corpus_prepass(transaction_count)
 
         try:
-            collected, crashes, execution_info = self._analyze_contracts(
-                pre, modules, transaction_count
-            )
+            with resilience.graceful_shutdown():
+                (
+                    collected,
+                    crashes,
+                    execution_info,
+                    completion,
+                ) = self._analyze_contracts(pre, modules, transaction_count)
         finally:
             # an exception escaping the loop (DetectorNotFoundError)
             # must not orphan the prepass thread on the device
             final = pre.finish() if pre is not None else {}
+            if self.deadline is not None:
+                resilience.clear_run_deadline()
         collected += self._merge_prepass_issues(final, collected)
+        for i, status in enumerate(completion):
+            outcome = final.get(i)
+            if outcome is not None:
+                status["device_complete"] = bool(
+                    outcome.get("device_complete")
+                )
 
         # prime the source registry for the report
         Source().get_source_from_contracts_list(self.contracts)
 
-        return self._build_report(collected, crashes, execution_info)
+        report = self._build_report(collected, crashes, execution_info)
+        reasons = resilience.DegradationLog().counts_since(degradation_marker)
+        partial = any(not status["complete"] for status in completion)
+        if reasons or partial:
+            report.partial = partial
+            report.degradation = {
+                "reasons": reasons,
+                "contracts": completion,
+            }
+        return report
 
     def _analyze_contracts(
         self,
@@ -211,18 +257,51 @@ class MythrilAnalyzer:
         modules: Optional[List[str]],
         transaction_count: Optional[int],
     ):
-        """The per-contract host loop (crash-contained per contract)."""
+        """The per-contract host loop (crash-contained per contract),
+        consulting the resilience supervisor at every contract
+        boundary: an expired deadline or a delivered signal marks the
+        remaining contracts skipped (partial report) or raises
+        (on_timeout=fail) instead of running past the budget."""
         from contextlib import nullcontext
+
+        from mythril_tpu.support import resilience
 
         collected: List[Issue] = []
         crashes: List[str] = []
         execution_info: Optional[List[ExecutionInfo]] = None
+        completion: List[dict] = []
+        halt_reason: Optional[str] = None
         for index, contract in enumerate(self.contracts):
+            if halt_reason is None:
+                halt_reason = resilience.interrupted_reason()
+            if halt_reason is not None:
+                if self.on_timeout == "fail":
+                    from mythril_tpu.exceptions import DeadlineExpiredError
+
+                    raise DeadlineExpiredError(
+                        f"{len(self.contracts) - index} contract(s) "
+                        f"unanalyzed at the deadline ({halt_reason})"
+                    )
+                resilience.DegradationLog().record(
+                    resilience.DegradationReason.CONTRACT_SKIPPED,
+                    site="analyzer",
+                    detail=halt_reason,
+                    contract=contract.name,
+                )
+                completion.append(
+                    {
+                        "contract": contract.name,
+                        "complete": False,
+                        "skipped": halt_reason,
+                    }
+                )
+                continue
             StartTime()  # fresh discovery-time baseline per contract
             outcome, device_ok = (
                 pre.outcome_for(index) if pre is not None else (None, True)
             )
             restore = None
+            crashed = False
             if not device_ok:
                 # the chip belongs to the prepass thread; the injected
                 # (possibly partial) outcome stands in for this
@@ -251,6 +330,7 @@ class MythrilAnalyzer:
                 log.critical(CRASH_NOTICE + traceback.format_exc())
                 issues = retrieve_callback_issues(modules)
                 crashes.append(traceback.format_exc())
+                crashed = True
             finally:
                 if restore is not None:
                     args.device_prepass, args.device_solving = restore
@@ -260,11 +340,14 @@ class MythrilAnalyzer:
             for issue in issues:
                 issue.add_code_info(contract)
             collected += issues
+            completion.append(
+                {"contract": contract.name, "complete": not crashed}
+            )
             log.info("Solver statistics: \n%s", str(SolverStatistics()))
             from mythril_tpu.support.phase_profile import PhaseProfile
 
             log.info("Host phase profile: \n%s", str(PhaseProfile()))
-        return collected, crashes, execution_info
+        return collected, crashes, execution_info, completion
 
     def _merge_prepass_issues(
         self, final: dict, collected: List[Issue]

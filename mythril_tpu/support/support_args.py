@@ -38,6 +38,13 @@ class Args(object, metaclass=Singleton):
         # Off by default: the wall-budget marathon squeezes more sat
         # answers out of fast queries (completeness-first).
         self.deterministic_solving = False
+        # Deadline-aware supervision (CLI --deadline / --on-timeout,
+        # support/resilience.py): the run's wall budget and what its
+        # expiry produces ("partial" report vs hard "fail"). The live
+        # clock lives in resilience.run_deadline(); these mirror the
+        # configured values for observability.
+        self.run_deadline_s = None
+        self.on_timeout = "partial"
 
 
 args = Args()

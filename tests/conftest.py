@@ -33,6 +33,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running golden analyses (run explicitly with -m slow)"
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection suite (resilience harness; "
+        "fast — runs in tier-1, selectable with -m faults)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,90 @@
+"""Deterministic fault-injection harness for the resilience suite.
+
+Thin, test-facing wrappers over the production injection hooks in
+`mythril_tpu/support/resilience.py`: production code calls
+`resilience.inject(site)` at the boundaries this harness arms, so the
+fault suite exercises the EXACT code paths a real hang / device fault /
+signal would take — no monkeypatching of internals, no timing races.
+
+Sites wired into the pipeline:
+
+- ``solver.cdcl``     — inside the watchdog-guarded native CDCL call
+                        (native_sat.SolverSession.solve); a "hang"
+                        action simulates a wedged native solver.
+- ``device.dispatch`` — inside every attempt of the device-dispatch
+                        retry ladder (resilience.retry_device_dispatch,
+                        used by run.run_resilient and the explorer's
+                        wave dispatch).
+- ``explore.wave``    — in DeviceCorpusExplorer._run_wave, after the
+                        checkpoint flush and before the dispatch: the
+                        "killed mid-wave" point.
+- ``corpus.contract`` — at analyze_corpus's per-contract supervisor
+                        boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from contextlib import contextmanager
+
+from mythril_tpu.support import resilience
+
+
+@contextmanager
+def injected(site: str, **kwargs):
+    """Arm one fault for the duration of the block (always disarmed,
+    even when the fault escapes as an exception)."""
+    resilience.arm_fault(site, **kwargs)
+    try:
+        yield
+    finally:
+        resilience.disarm_faults()
+
+
+@contextmanager
+def solver_hang(delay_s: float = 2.0, grace_s: float = 0.2, times: int = 1):
+    """Simulate a wedged native CDCL call: the guarded region sleeps
+    past a shrunken watchdog grace, so the watchdog fires in test time
+    instead of the production 30s."""
+    previous = resilience.SOLVER_WATCHDOG_GRACE_S
+    resilience.SOLVER_WATCHDOG_GRACE_S = grace_s
+    resilience.arm_fault(
+        "solver.cdcl", times=times, action="hang", delay_s=delay_s
+    )
+    try:
+        yield
+    finally:
+        resilience.SOLVER_WATCHDOG_GRACE_S = previous
+        resilience.disarm_faults()
+
+
+@contextmanager
+def device_faults(times: int = 1, skip: int = 0):
+    """Fail device dispatches with a classified infrastructure fault
+    (the injection raises InjectedFault at a ``device.*`` site, which
+    resilience.is_device_fault classifies as retriable)."""
+    resilience.arm_fault("device.dispatch", times=times, skip=skip)
+    try:
+        yield
+    finally:
+        resilience.disarm_faults()
+
+
+@contextmanager
+def sigterm_at(site: str, skip: int = 0):
+    """Deliver a real SIGTERM to this process when `site` is next
+    reached (after `skip` pass-throughs). Pair with
+    resilience.graceful_shutdown() so the signal degrades the run
+    instead of killing pytest."""
+    resilience.arm_fault(
+        site,
+        times=1,
+        action="call",
+        skip=skip,
+        fn=lambda: os.kill(os.getpid(), signal.SIGTERM),
+    )
+    try:
+        yield
+    finally:
+        resilience.disarm_faults()

@@ -1,0 +1,445 @@
+"""The fault-injection suite: deadline supervision, solver/device
+escalation ladders, and checkpointed graceful degradation.
+
+Every fault here is DETERMINISTIC — armed at a named injection site the
+production code reaches (tests/laser/faultinject.py), never a timing
+race. The acceptance bar (ISSUE 1): an injected solver hang, an
+injected device dispatch failure, and a mid-run SIGTERM each produce a
+completed run with a partial-but-well-formed result (no traceback,
+findings preserved, degradation reasons recorded), and a killed wave
+resumes from its npz checkpoint to the uninterrupted run's results.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mythril_tpu.exceptions import (
+    DeadlineExpiredError,
+    DeviceDispatchError,
+    WatchdogTimeout,
+)
+from mythril_tpu.laser.batch.checkpoint import load_checkpoint, save_checkpoint
+from mythril_tpu.laser.batch.run import run, run_resilient
+from mythril_tpu.laser.batch.state import make_batch, make_code_table
+from mythril_tpu.support import resilience
+
+# tests/laser is not a package: pytest's rootdir import mode puts this
+# directory on sys.path, so the harness imports flat
+from faultinject import device_faults, sigterm_at, solver_hang  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+#: PUSH1 1; PUSH1 0; SSTORE; PUSH1 0; PUSH1 1; SSTORE; STOP
+WRITER = "6001600055600060015500"
+#: CALLDATALOAD(0) branches to a storage write — one symbolic JUMPI,
+#: so waves have a branch journal to checkpoint/replay
+BRANCHER = "600035600757005b600160005500"
+#: SELFDESTRUCT — banks trigger evidence in one wave
+KILLABLE = "33ff"
+
+
+@pytest.fixture(autouse=True)
+def _clean_supervisor():
+    """Every test starts from a quiet supervisor: no armed faults, no
+    run deadline, no pending shutdown, empty degradation log."""
+    resilience.disarm_faults()
+    resilience.clear_run_deadline()
+    resilience.clear_shutdown()
+    resilience.DegradationLog().reset()
+    yield
+    resilience.disarm_faults()
+    resilience.clear_run_deadline()
+    resilience.clear_shutdown()
+
+
+# -- primitives -------------------------------------------------------------
+def test_deadline_clamp_and_expiry():
+    dl = resilience.Deadline(30.0)
+    assert not dl.expired
+    assert dl.clamp_ms(10_000) <= 10_000
+    spent = resilience.Deadline(0.0)
+    assert spent.expired
+    # a nearly-expired run still gives queries the floor, never zero
+    assert spent.clamp_ms(10_000) == 200
+    with pytest.raises(DeadlineExpiredError):
+        spent.check("test")
+    assert resilience.Deadline(None).clamp_ms(7_000) == 7_000
+
+
+def test_retry_policy_backoff_schedule():
+    policy = resilience.RetryPolicy(
+        attempts=5, base_delay_s=0.1, multiplier=2.0, max_delay_s=0.3
+    )
+    assert policy.delays() == [0.1, 0.2, 0.3, 0.3]
+
+
+def test_degradation_log_counts_and_marker():
+    log = resilience.DegradationLog()
+    marker = log.marker()
+    log.record(resilience.DegradationReason.SOLVER_HANG, site="t")
+    log.record(resilience.DegradationReason.SOLVER_HANG, site="t")
+    delta = log.counts_since(marker)
+    assert delta == {"solver-hang": 2}
+    assert log.events[-1]["site"] == "t"
+
+
+def test_graceful_shutdown_nesting_preserves_signal():
+    """An inner scope's exit must not erase a signal the outer loop
+    still needs to honor."""
+    with resilience.graceful_shutdown():
+        with resilience.graceful_shutdown():
+            resilience.shutdown_event().set()
+        assert resilience.shutdown_requested()
+    assert not resilience.shutdown_requested()  # outermost exit clears
+
+
+# -- device-dispatch escalation ladder --------------------------------------
+def _demo():
+    code = make_code_table([bytes.fromhex(WRITER)])
+    return make_batch(8, calldata=[b"\x00" * 4] * 8), code
+
+
+def test_injected_device_fault_is_retried():
+    batch, code = _demo()
+    reference, _ = run(batch, code, max_steps=64)
+    with device_faults(times=1):
+        out, _ = run_resilient(batch, code, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(out.status), np.asarray(reference.status)
+    )
+    counts = resilience.DegradationLog().counts
+    assert counts.get("device-dispatch-failed") == 1
+
+
+def test_persistent_fault_falls_back_to_split_dispatch():
+    """Full-batch dispatches keep dying; the ladder degrades to two
+    half-sized dispatches and the merged result is bit-identical."""
+    batch, code = _demo()
+    reference, _ = run(batch, code, max_steps=64)
+    with device_faults(times=3):  # all 3 full-batch attempts die
+        out, _ = run_resilient(batch, code, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(out.status), np.asarray(reference.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.storage_vals), np.asarray(reference.storage_vals)
+    )
+    counts = resilience.DegradationLog().counts
+    assert counts.get("device-split-dispatch") == 1
+
+
+def test_dispatch_exhaustion_raises_for_the_caller_to_degrade():
+    batch, code = _demo()
+    with device_faults(times=99):
+        with pytest.raises(DeviceDispatchError):
+            run_resilient(batch, code, max_steps=64, retries=1)
+
+
+def test_genuine_bugs_do_not_enter_the_ladder():
+    """Only classified infrastructure faults retry; a logic error
+    propagates with its traceback intact."""
+    with pytest.raises(TypeError):
+        resilience.retry_device_dispatch(
+            lambda: (_ for _ in ()).throw(TypeError("shape bug")),
+            label="test",
+        )
+    assert not resilience.DegradationLog().counts
+
+
+# -- checkpointed graceful degradation --------------------------------------
+def test_checkpoint_resume_after_killed_wave(tmp_path):
+    """A wave killed mid-run resumes from the flushed npz to results
+    identical to an uninterrupted run (the determinism DTVM's argument
+    needs from interrupted runs)."""
+    batch, code = _demo()
+    mid, steps = run(batch, code, max_steps=2)
+    flush = tmp_path / "flush.npz"
+    save_checkpoint(flush, mid, code, step=int(steps))
+    # the next wave dies past the whole ladder (split disabled to model
+    # a dead device rather than an OOM)
+    with device_faults(times=99):
+        with pytest.raises(DeviceDispatchError):
+            run_resilient(mid, code, max_steps=64, retries=1, allow_split=False)
+    # "new process": resume from disk, run to completion
+    restored, code2, _ = load_checkpoint(flush)
+    resumed, _ = run_resilient(restored, code2, max_steps=64)
+    direct, _ = run(mid, code, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.status), np.asarray(direct.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.storage_vals), np.asarray(direct.storage_vals)
+    )
+
+
+def test_wave_checkpoint_replay_matches_explorer_coverage(tmp_path):
+    """The explorer flushes every wave's seeded frontier before
+    dispatch; replaying the flushed wave reproduces the exact branch
+    coverage the live wave harvested."""
+    from mythril_tpu.laser.batch.explore import (
+        DeviceCorpusExplorer,
+        replay_wave,
+    )
+
+    path = str(tmp_path / "wave.npz")
+    ex = DeviceCorpusExplorer(
+        [BRANCHER],
+        lanes_per_contract=8,
+        waves=1,
+        steps_per_wave=64,
+        transaction_count=1,
+        checkpoint_path=path,
+    )
+    out = ex.run()
+    assert out["stats"]["wave_checkpoints"] == 1
+    covered = {tuple(b) for b in out["contracts"][0]["covered_branches"]}
+    assert covered, "the branching fixture must cover at least one direction"
+
+    view, _sym_out, _steps = replay_wave(path)
+    replayed = set()
+    for lane in range(8):
+        for pc, taken, _tid in view.journal(lane):
+            replayed.add((pc, taken))
+    assert replayed == covered
+
+
+def test_wave_fault_degrades_exploration_not_the_run():
+    """A wave dispatch that dies past the retry ladder ends the
+    exploration with partial outcomes — ownership gates open, evidence
+    intact — instead of raising out of run()."""
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    with device_faults(times=10):
+        ex = DeviceCorpusExplorer(
+            [WRITER],
+            lanes_per_contract=8,
+            waves=2,
+            steps_per_wave=64,
+            transaction_count=1,
+        )
+        out = ex.run()
+    assert out["stats"]["device_faults"] == 1
+    assert not out["contracts"][0]["device_complete"]
+    counts = resilience.DegradationLog().counts
+    assert counts.get("wave-abandoned") == 1
+
+
+def test_explorer_deadline_stops_at_wave_boundary():
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    ex = DeviceCorpusExplorer(
+        [WRITER],
+        lanes_per_contract=8,
+        waves=4,
+        steps_per_wave=64,
+        transaction_count=2,
+        deadline=resilience.Deadline(0.0),
+    )
+    out = ex.run()
+    assert out["stats"]["waves"] == 0
+    assert out["stats"]["halt_reason"] == "deadline-expired"
+    assert not out["contracts"][0]["device_complete"]
+
+
+# -- solver escalation ladder -----------------------------------------------
+def test_solver_hang_watchdog_rebuilds_and_retries():
+    """A wedged native CDCL call is abandoned by the watchdog, the
+    clause session rebuilt, and the query retried — the answer still
+    comes back sat, with the hang recorded as a degradation reason."""
+    from mythril_tpu.laser.smt import terms
+    from mythril_tpu.laser.smt.solver.solver import check_terms
+
+    x = terms.bv_var("fault_x", 8)
+    y = terms.bv_var("fault_y", 8)
+    query = [terms.ult(x, y), terms.ult(terms.bv_const(3, 8), x)]
+    with solver_hang(delay_s=2.0, grace_s=0.2, times=1):
+        verdict, model = check_terms(query, timeout_ms=300)
+    assert verdict == "sat"
+    xv = model.assignment["fault_x"]
+    yv = model.assignment["fault_y"]
+    assert 3 < xv < yv
+    counts = resilience.DegradationLog().counts
+    assert counts.get("solver-hang") == 1
+    assert counts.get("solver-session-rebuilt") == 1
+
+
+def test_solver_double_hang_degrades_to_unknown():
+    """Both the original attempt and the post-rebuild retry wedge: the
+    query degrades to UNKNOWN-with-reason instead of hanging the run."""
+    from mythril_tpu.laser.smt import terms
+    from mythril_tpu.laser.smt.solver.solver import check_terms
+
+    x = terms.bv_var("fault2_x", 8)
+    y = terms.bv_var("fault2_y", 8)
+    query = [terms.ult(x, y), terms.ult(terms.bv_const(5, 8), x)]
+    with solver_hang(delay_s=2.0, grace_s=0.15, times=99):
+        verdict, model = check_terms(query, timeout_ms=200)
+    assert verdict == "unknown"
+    assert model is None
+    counts = resilience.DegradationLog().counts
+    assert counts.get("solver-hang", 0) >= 2
+    # and the rebuilt session still answers once the fault clears
+    from mythril_tpu.laser.smt.solver.solver import check_terms as ct
+
+    verdict, _ = ct(query, timeout_ms=2000)
+    assert verdict == "sat"
+
+
+def test_watchdog_abandon_leaks_never_frees():
+    """close() on an abandoned session must not free the native object
+    out from under a zombie thread."""
+    from mythril_tpu.laser.smt.solver import native_sat
+
+    session = native_sat.SolverSession()
+    session.abandon()
+    session.close()  # must be a no-op, not a use-after-free
+    assert session.poisoned and session.abandoned
+
+
+def test_expired_run_deadline_degrades_queries():
+    from mythril_tpu.laser.smt import terms
+    from mythril_tpu.laser.smt.solver.solver import check_terms
+
+    resilience.set_run_deadline(0.0)
+    x = terms.bv_var("fault3_x", 8)
+    verdict, model = check_terms(
+        [terms.ult(x, terms.bv_const(9, 8))], timeout_ms=5_000
+    )
+    assert verdict == "unknown" and model is None
+    assert resilience.DegradationLog().counts.get("solver-timeout", 0) >= 1
+
+
+def test_independence_solver_respects_run_deadline():
+    from mythril_tpu.laser.smt import symbol_factory
+    from mythril_tpu.laser.smt.solver.independence_solver import (
+        IndependenceSolver,
+    )
+
+    a = symbol_factory.BitVecSym("fault4_a", 8)
+    solver = IndependenceSolver(timeout=5_000)
+    solver.add(a > symbol_factory.BitVecVal(3, 8))
+    resilience.set_run_deadline(0.0)
+    assert solver.check() == "unknown"
+
+
+# -- corpus supervision -----------------------------------------------------
+CORPUS = [(KILLABLE, "", f"K{i}") for i in range(4)]
+
+
+def test_expired_deadline_yields_partial_shaped_results():
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    results = analyze_corpus(
+        CORPUS,
+        transaction_count=1,
+        execution_timeout=5,
+        processes=1,
+        use_device=False,
+        deadline_s=0.0,
+    )
+    assert len(results) == len(CORPUS)
+    for result in results:
+        assert result["skipped"] == "deadline-expired"
+        assert result["complete"] is False
+        assert result["error"] is None
+        json.dumps(result)  # well-formed: serializes clean
+
+
+def test_on_timeout_fail_raises():
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    with pytest.raises(DeadlineExpiredError):
+        analyze_corpus(
+            CORPUS,
+            transaction_count=1,
+            execution_timeout=5,
+            processes=1,
+            use_device=False,
+            deadline_s=0.0,
+            on_timeout="fail",
+        )
+
+
+def test_midrun_sigterm_keeps_findings_and_marks_the_tail():
+    """SIGTERM lands at the third contract boundary: the first two
+    keep their findings, the rest are marked skipped with the
+    structured reason — a completed run, not a traceback."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    with resilience.graceful_shutdown():
+        with sigterm_at("corpus.contract", skip=2):
+            results = analyze_corpus(
+                CORPUS,
+                transaction_count=1,
+                execution_timeout=10,
+                processes=1,
+                use_device=False,
+            )
+    assert len(results) == len(CORPUS)
+    assert results[0]["complete"] and results[0]["issues"]
+    assert results[1]["complete"]
+    for result in results[2:]:
+        assert result["skipped"] == "interrupted"
+        assert result["error"] is None
+    counts = resilience.DegradationLog().counts
+    assert counts.get("interrupted") == 1
+    assert counts.get("contract-skipped") == 2
+
+
+def test_device_fault_degrades_one_lane_not_the_corpus():
+    """The acceptance scenario: every device dispatch dies, and the
+    corpus still completes on the host with findings and recorded
+    degradation — the chip failing degrades the device AXIS, never the
+    service. One contract forces the SYNCHRONOUS prepass branch, so
+    the injected fault deterministically hits the wave dispatch before
+    any host analysis can finish first."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    # PUSH1 0; CALLDATALOAD; POP; CALLER; SELFDESTRUCT — long enough
+    # for the device prepass to stripe, and the host walk reports the
+    # unprotected selfdestruct
+    contracts = [("6000355033ff", "", "DevKill")]
+    with device_faults(times=99):
+        results = analyze_corpus(
+            contracts,
+            transaction_count=1,
+            execution_timeout=10,
+            processes=1,
+            use_device=True,
+        )
+    assert len(results) == 1
+    result = results[0]
+    assert result["complete"], result
+    assert result["error"] is None
+    assert not result.get("owned")
+    assert result["issues"], "host walk findings preserved"
+    counts = resilience.DegradationLog().counts
+    assert counts.get("device-dispatch-failed", 0) >= 1
+    assert counts.get("wave-abandoned", 0) >= 1
+
+
+# -- report surfacing -------------------------------------------------------
+def test_report_renders_degradation_only_when_present():
+    from mythril_tpu.analysis.report import Report
+
+    clean = Report()
+    assert "degradation" not in json.loads(clean.as_json())
+
+    report = Report()
+    report.partial = True
+    report.degradation = {
+        "reasons": {"deadline-expired": 1, "contract-skipped": 2},
+        "contracts": [
+            {"contract": "A", "complete": True, "device_complete": True},
+            {"contract": "B", "complete": False, "skipped": "deadline-expired"},
+        ],
+    }
+    as_json = json.loads(report.as_json())
+    assert as_json["partial"] is True
+    assert as_json["degradation"]["reasons"]["contract-skipped"] == 2
+    jsonv2 = json.loads(report.as_swc_standard_format())
+    meta = jsonv2[0]["meta"]
+    assert meta["partial"] is True
+    assert meta["degradation"]["contracts"][1]["complete"] is False

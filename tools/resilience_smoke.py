@@ -1,0 +1,129 @@
+"""End-to-end smoke for the resilient analysis supervisor.
+
+Runs a 2-contract corpus under an aggressive wall-clock deadline and
+asserts the run produces a well-formed PARTIAL report instead of a
+traceback: the contract that fit inside the budget keeps its findings,
+the one that didn't is marked skipped with the structured reason, and
+the degradation-reason counts the json report surfaces are present.
+
+The corpus is built so the outcome is deterministic, not a timing
+race: the first contract is a branch-heavy walk (2^STAGES symbolic
+paths) whose execution timeout deliberately outlives the deadline, so
+the deadline is guaranteed to be expired by the time the supervisor
+reaches the second (cheap) contract's boundary.
+
+Usage:
+    python tools/resilience_smoke.py                # 10 s deadline
+    python tools/resilience_smoke.py --deadline 5
+
+Exits 0 on success; prints the failing assertion and exits 1 otherwise.
+Wall cost is roughly the execution timeout (default 12 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+STAGES = 12
+
+
+def heavy_contract() -> str:
+    """2^STAGES symbolic paths: a chain of calldata-dependent JUMPIs,
+    each fallthrough writing one storage slot. The host walk cannot
+    exhaust this inside the smoke's budget, which is the point."""
+    code = bytearray()
+    for i in range(STAGES):
+        o = len(code)
+        dest = o + 11
+        # PUSH1 i*32; CALLDATALOAD; PUSH1 dest; JUMPI;
+        # PUSH1 1; PUSH1 i; SSTORE; JUMPDEST
+        code += bytes([0x60, (i * 32) & 0xFF, 0x35, 0x60, dest, 0x57,
+                       0x60, 0x01, 0x60, i, 0x55, 0x5B])
+    code.append(0x00)  # STOP
+    return code.hex()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--deadline", type=float, default=10.0,
+                        help="run deadline in seconds (default 10)")
+    parser.add_argument("--execution-timeout", type=int, default=12,
+                        help="per-contract walk timeout; must outlive "
+                             "the deadline for a deterministic cut")
+    args = parser.parse_args()
+    if args.execution_timeout <= args.deadline:
+        print("smoke: execution timeout must exceed the deadline "
+              "(the first walk has to carry the run past expiry)",
+              file=sys.stderr)
+        return 2
+
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.support import resilience
+
+    marker = resilience.DegradationLog().marker()
+    contracts = [
+        (heavy_contract(), "", "Heavy"),
+        ("33ff", "", "Killable"),  # never reached inside the deadline
+    ]
+    t0 = time.monotonic()
+    results = analyze_corpus(
+        contracts,
+        transaction_count=2,
+        execution_timeout=args.execution_timeout,
+        processes=1,
+        use_device=False,
+        deadline_s=args.deadline,
+    )
+    wall = time.monotonic() - t0
+    reasons = resilience.DegradationLog().counts_since(marker)
+
+    # the partial report, in the shape the json report meta carries
+    report = {
+        "partial": any(not r["complete"] for r in results),
+        "degradation": {
+            "reasons": reasons,
+            "contracts": [
+                {
+                    "contract": r["name"],
+                    "complete": r["complete"],
+                    **({"skipped": r["skipped"]} if r.get("skipped") else {}),
+                }
+                for r in results
+            ],
+        },
+    }
+
+    try:
+        parsed = json.loads(json.dumps(report))  # well-formed: round-trips
+        assert len(results) == 2, f"expected 2 results, got {len(results)}"
+        heavy, cheap = results
+        assert heavy["error"] is None, f"heavy errored: {heavy['error']}"
+        assert heavy["complete"], "the in-budget contract must complete"
+        assert cheap["skipped"] == "deadline-expired", (
+            f"expected the tail skipped at the deadline, got {cheap!r}"
+        )
+        assert not cheap["complete"] and cheap["error"] is None
+        assert parsed["partial"] is True
+        assert parsed["degradation"]["reasons"].get("contract-skipped"), (
+            f"no contract-skipped reason recorded: {reasons}"
+        )
+    except AssertionError as why:
+        print(f"smoke FAILED after {wall:.1f}s: {why}", file=sys.stderr)
+        print(json.dumps(report, indent=2), file=sys.stderr)
+        return 1
+
+    print(
+        f"smoke OK in {wall:.1f}s: deadline {args.deadline}s cut the run, "
+        f"partial report well-formed, reasons={reasons}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
