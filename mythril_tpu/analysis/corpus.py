@@ -630,6 +630,13 @@ def _analyze_one(payload: Tuple) -> Dict:
             args.deterministic_solving = restore_deterministic
 
 
+#: public name for the pooled-mode worker: the analysis service
+#: (mythril_tpu/service/engine.py) feeds finished device stripes
+#: through the exact per-contract pipeline the corpus pool runs, so
+#: the payload contract is shared, not duplicated
+analyze_one_payload = _analyze_one
+
+
 def analyze_corpus(
     contracts: List[Tuple[str, str, str]],
     address: int = 0x901D573B8CE8C997DE5F19173C32D966B4Fa55FE,

@@ -57,6 +57,8 @@ COMMAND_LIST = (
         "function-to-hash",
         "hash-to-address",
         "list-detectors",
+        "serve",
+        "submit",
         "version",
         "truffle",
         "help",
@@ -591,6 +593,110 @@ def build_parser() -> ArgumentParser:
         metavar="LEVELDB_PATH",
     )
 
+    serve = subparsers.add_parser(
+        "serve",
+        help=(
+            "Run the persistent analysis service: a long-lived daemon "
+            "that owns the device, serves analysis jobs over HTTP/JSON, "
+            "and amortizes XLA compile across requests"
+        ),
+    )
+    serve.add_argument("--host", default="127.0.0.1", help="bind address")
+    serve.add_argument(
+        "--port", type=int, default=7341, help="listen port (0 = ephemeral)"
+    )
+    serve.add_argument(
+        "--stripes",
+        type=int,
+        default=4,
+        help="arena stripes (max concurrently-resident contracts)",
+    )
+    serve.add_argument(
+        "--lanes-per-stripe",
+        type=int,
+        default=8,
+        help="device lanes per stripe",
+    )
+    serve.add_argument(
+        "--steps-per-wave", type=int, default=256, help="EVM steps per wave"
+    )
+    serve.add_argument(
+        "--max-waves",
+        type=int,
+        default=2,
+        help="device waves per job before the host walk",
+    )
+    serve.add_argument(
+        "--queue-capacity",
+        type=int,
+        default=64,
+        help="admission queue bound (full queue answers 429)",
+    )
+    serve.add_argument(
+        "--host-workers",
+        type=int,
+        default=1,
+        help="host-analysis worker threads consuming finished stripes",
+    )
+    serve.add_argument(
+        "--no-host-walk",
+        action="store_true",
+        help="device-only reports (skip the per-job host walk)",
+    )
+    serve.add_argument(
+        "--execution-timeout",
+        type=int,
+        default=8,
+        help="seconds of host walk per job",
+    )
+    serve.add_argument(
+        "--transaction-count",
+        type=int,
+        default=2,
+        help="attacker transactions the host walk models",
+    )
+    serve.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="where drain checkpoints land (default: a temp dir)",
+    )
+
+    submit = subparsers.add_parser(
+        "submit",
+        parents=[creation_input],
+        help="Submit bytecode to a running `myth serve` instance",
+    )
+    submit.add_argument(
+        "--url",
+        default="http://127.0.0.1:7341",
+        help="service base URL",
+    )
+    submit.add_argument(
+        "--max-waves", type=int, default=None, help="device waves override"
+    )
+    submit.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="per-job wall budget the service supervisor enforces",
+    )
+    submit.add_argument(
+        "--no-host-walk",
+        action="store_true",
+        help="ask for a device-only report",
+    )
+    submit.add_argument(
+        "--no-wait",
+        action="store_true",
+        help="print the job id and return without waiting for the report",
+    )
+    submit.add_argument(
+        "--wait-s",
+        type=float,
+        default=120.0,
+        help="how long to wait for the report",
+    )
+
     subparsers.add_parser(
         "version", parents=[output], help="Outputs the version"
     )
@@ -946,6 +1052,66 @@ def _cmd_list_detectors(args: Namespace) -> None:
     sys.exit()
 
 
+def _cmd_serve(args: Namespace) -> None:
+    """`myth serve`: run the persistent analysis service until a
+    graceful drain (SIGTERM/SIGINT or POST /v1/drain) completes."""
+    from mythril_tpu.service.engine import ServiceConfig
+    from mythril_tpu.service.server import serve_forever
+
+    config = ServiceConfig(
+        stripes=args.stripes,
+        lanes_per_stripe=args.lanes_per_stripe,
+        steps_per_wave=args.steps_per_wave,
+        max_waves=args.max_waves,
+        queue_capacity=args.queue_capacity,
+        host_workers=args.host_workers,
+        host_walk=not args.no_host_walk,
+        execution_timeout=args.execution_timeout,
+        transaction_count=args.transaction_count,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    serve_forever(config, host=args.host, port=args.port)
+    sys.exit()
+
+
+def _cmd_submit(args: Namespace) -> None:
+    """`myth submit`: send bytecode to a running service, print the
+    report (or the job id with --no-wait) as JSON."""
+    from mythril_tpu.service.client import ServiceClient, ServiceError
+
+    if args.code:
+        blob = args.code
+    elif args.codefile:
+        blob = "".join(line.strip() for line in args.codefile if line.strip())
+    else:
+        log.error(
+            "No input bytecode. Provide EVM code via -c BYTECODE or "
+            "-f BYTECODE_FILE"
+        )
+        sys.exit(1)
+    client = ServiceClient(args.url)
+    try:
+        job_id = client.submit(
+            blob,
+            max_waves=args.max_waves,
+            deadline_s=args.deadline,
+            host_walk=False if args.no_host_walk else None,
+        )
+        if args.no_wait:
+            print(json.dumps({"job_id": job_id}))
+            sys.exit()
+        print(json.dumps(client.report(job_id, wait_s=args.wait_s), indent=2))
+    except ServiceError as why:
+        # backpressure (429 full / 503 draining) and mistakes (400)
+        # both land here; the exit code flags the failure for scripts
+        print(
+            json.dumps({"error": str(why), "status": why.status}),
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    sys.exit()
+
+
 def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
     if args.epic:
         here = os.path.dirname(os.path.realpath(__file__))
@@ -961,6 +1127,10 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_version(args)
     if args.command == "list-detectors":
         _cmd_list_detectors(args)
+    if args.command == "serve":
+        _cmd_serve(args)
+    if args.command == "submit":
+        _cmd_submit(args)
     if args.command == "help":
         parser.print_help()
         sys.exit()

@@ -18,7 +18,53 @@ import numpy as np
 
 from mythril_tpu.laser.batch.state import CodeTable, StateBatch
 
-FORMAT_VERSION = 3  # v2: + pc_seen/branch journal; v3: + empty_world
+FORMAT_VERSION = 4  # v2: + pc_seen/branch journal; v3: + empty_world;
+#                     v4: + arena-shape metadata (the mismatch gate)
+
+
+def arena_shape(
+    batch: StateBatch, code: Optional[CodeTable] = None
+) -> Dict[str, int]:
+    """The capacity signature a checkpoint was written under. Loading
+    one into a DIFFERENT arena shape (the persistent service owns one
+    fixed-shape warm arena) must refuse with a clear error instead of
+    resharding garbage into mismatched lanes — this dict is what the
+    refusal compares."""
+    shape = {
+        "lanes": int(np.asarray(batch.pc).shape[0]),
+        "stack_cap": int(np.asarray(batch.stack).shape[1]),
+        "mem_cap": int(np.asarray(batch.mem).shape[1]),
+        "storage_cap": int(np.asarray(batch.storage_keys).shape[1]),
+        "calldata_cap": int(np.asarray(batch.calldata).shape[1]),
+    }
+    if code is not None:
+        shape["code_rows"] = int(np.asarray(code.ops).shape[0])
+        shape["code_cap"] = int(np.asarray(code.jumpdest).shape[1])
+    return shape
+
+
+def _check_shape(
+    stored: Dict[str, int], expected: Optional[Dict[str, int]], path
+) -> None:
+    """Refuse a checkpoint whose arena shape contradicts the caller's.
+    Only the keys the caller cares about are compared, so a service
+    that doesn't pin e.g. `lanes` can leave it out of `expected`."""
+    if not expected:
+        return
+    mismatched = {
+        key: (stored.get(key), value)
+        for key, value in expected.items()
+        if stored.get(key) is not None and stored.get(key) != value
+    }
+    if mismatched:
+        detail = ", ".join(
+            f"{key}: checkpoint has {got}, arena wants {want}"
+            for key, (got, want) in sorted(mismatched.items())
+        )
+        raise ValueError(
+            f"checkpoint {path} was written under a different arena "
+            f"shape ({detail}); refusing to load it into this arena"
+        )
 
 
 def save_checkpoint(
@@ -41,11 +87,37 @@ def save_checkpoint(
         )
     for name, value in (extra or {}).items():
         arrays[f"extra.{name}"] = np.asarray(value)
+    meta = {
+        "version": FORMAT_VERSION,
+        "step": int(step),
+        "shape": arena_shape(batch, code),
+    }
     arrays["meta"] = np.frombuffer(
-        json.dumps({"version": FORMAT_VERSION, "step": int(step)}).encode(),
-        dtype=np.uint8,
+        json.dumps(meta).encode(), dtype=np.uint8
     )
     np.savez_compressed(str(path), **arrays)
+
+
+def checkpoint_shape(path: Union[str, Path]) -> Dict[str, int]:
+    """The arena shape a checkpoint was written under, without loading
+    the frontier. Pre-v4 checkpoints carry no shape metadata, so it is
+    derived from the stored arrays (same truth, slower read)."""
+    with np.load(str(path)) as data:
+        meta = json.loads(bytes(data["meta"]).decode())
+        shape = meta.get("shape")
+        if shape is not None:
+            return dict(shape)
+        out = {
+            "lanes": int(data["batch.pc"].shape[0]),
+            "stack_cap": int(data["batch.stack"].shape[1]),
+            "mem_cap": int(data["batch.mem"].shape[1]),
+            "storage_cap": int(data["batch.storage_keys"].shape[1]),
+            "calldata_cap": int(data["batch.calldata"].shape[1]),
+        }
+        if f"code.{CodeTable._fields[0]}" in data:
+            out["code_rows"] = int(data["code.ops"].shape[0])
+            out["code_cap"] = int(data["code.jumpdest"].shape[1])
+        return out
 
 
 def load_checkpoint_extra(path: Union[str, Path]) -> Dict[str, np.ndarray]:
@@ -59,14 +131,35 @@ def load_checkpoint_extra(path: Union[str, Path]) -> Dict[str, np.ndarray]:
 
 
 def load_checkpoint(
-    path: Union[str, Path]
+    path: Union[str, Path],
+    expect_shape: Optional[Dict[str, int]] = None,
 ) -> Tuple[StateBatch, Optional[CodeTable], int]:
-    """Restore (batch, code_table_or_None, step) from `path`."""
+    """Restore (batch, code_table_or_None, step) from `path`.
+
+    `expect_shape` (an `arena_shape`-style dict; partial is fine) makes
+    the load refuse — clear ValueError, not garbage lanes — when the
+    checkpoint was written under a different arena shape than the one
+    it is being restored into."""
     with np.load(str(path)) as data:
         meta = json.loads(bytes(data["meta"]).decode())
         version = meta.get("version")
         if not isinstance(version, int) or not 1 <= version <= FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {version}")
+        stored_shape = meta.get("shape")
+        if stored_shape is None:  # pre-v4: derive from the arrays
+            stored_shape = {
+                "lanes": int(data["batch.pc"].shape[0]),
+                "stack_cap": int(data["batch.stack"].shape[1]),
+                "mem_cap": int(data["batch.mem"].shape[1]),
+                "storage_cap": int(data["batch.storage_keys"].shape[1]),
+                "calldata_cap": int(data["batch.calldata"].shape[1]),
+            }
+            if f"code.{CodeTable._fields[0]}" in data:
+                stored_shape["code_rows"] = int(data["code.ops"].shape[0])
+                stored_shape["code_cap"] = int(
+                    data["code.jumpdest"].shape[1]
+                )
+        _check_shape(stored_shape, expect_shape, path)
         fields = {}
         for name in StateBatch._fields:
             key = f"batch.{name}"

@@ -1800,7 +1800,7 @@ class DeviceCorpusExplorer:
         }
 
 
-def replay_wave(path):
+def replay_wave(path, expect_shape=None):
     """Re-execute a flushed wave checkpoint exactly.
 
     The explorer writes each wave's SEEDED frontier (StateBatch + code
@@ -1812,6 +1812,11 @@ def replay_wave(path):
     coverage/status/evidence equal the uninterrupted wave's
     (tests/laser/test_resilience.py asserts this bit-for-bit).
 
+    `expect_shape` (checkpoint.arena_shape dict, partial fine) makes a
+    checkpoint written under a different arena shape refuse with a
+    clear error instead of replaying garbage lanes — the persistent
+    service pins its warm arena shape through this.
+
     Returns (ArenaView, sym_out, steps)."""
     import jax.numpy as jnp
 
@@ -1820,7 +1825,7 @@ def replay_wave(path):
         load_checkpoint_extra,
     )
 
-    batch, code, wave_steps = load_checkpoint(path)
+    batch, code, wave_steps = load_checkpoint(path, expect_shape=expect_shape)
     if code is None:
         raise ValueError("wave checkpoint carries no code table")
     sym = make_sym_batch(batch)

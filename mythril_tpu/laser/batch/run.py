@@ -51,10 +51,16 @@ def run_resilient(
     """`run` under the device-dispatch fault ladder
     (support/resilience.py): XLA compile / OOM / device-lost errors are
     retried with exponential backoff, then — still failing — the batch
-    is split in half and each half dispatched separately (an OOM'd or
-    flaky device often carries the reduced capacity), and only when
-    even the halves fail does DeviceDispatchError reach the caller,
-    which degrades the work to the host instead of crashing the run.
+    is split in half and each half re-enters THIS function (an OOM'd or
+    flaky device often carries the reduced capacity), recursing down to
+    single lanes before DeviceDispatchError reaches the caller, which
+    degrades the work to the host instead of crashing the run.
+
+    Every rung of the ladder carries the caller's exact kwargs: a split
+    retry that silently fell back to default `unroll`/`track_coverage`
+    would change coverage accounting and step bookkeeping mid-escalation
+    (the regression tests/laser/test_resilience.py pins), so the
+    recursion threads them all explicitly.
 
     The dispatch blocks until the result is ready so asynchronous XLA
     errors surface HERE, inside the containment, not at some later
@@ -71,19 +77,16 @@ def run_resilient(
 
     policy = RetryPolicy(attempts=retries + 1)
 
-    def dispatch(b):
-        def _go():
-            out, steps = run(
-                b, code, max_steps=max_steps, unroll=unroll,
-                track_coverage=track_coverage,
-            )
-            jax.block_until_ready(steps)
-            return out, steps
-
-        return retry_device_dispatch(_go, label="batch-run", policy=policy)
+    def _go():
+        out, steps = run(
+            batch, code, max_steps=max_steps, unroll=unroll,
+            track_coverage=track_coverage,
+        )
+        jax.block_until_ready(steps)
+        return out, steps
 
     try:
-        return dispatch(batch)
+        return retry_device_dispatch(_go, label="batch-run", policy=policy)
     except DeviceDispatchError:
         n = int(batch.pc.shape[0])
         if not allow_split or n < 2:
@@ -94,11 +97,20 @@ def run_resilient(
             detail=f"retrying as 2x{n // 2}-lane dispatches",
         )
         half = n // 2
-        first = jax.tree_util.tree_map(lambda a: a[:half], batch)
-        second = jax.tree_util.tree_map(lambda a: a[half:], batch)
-        out_a, steps_a = dispatch(first)
-        out_b, steps_b = dispatch(second)
-        merged = jax.tree_util.tree_map(
-            lambda a, b: jnp.concatenate([a, b], axis=0), out_a, out_b
+        halves = (
+            jax.tree_util.tree_map(lambda a: a[:half], batch),
+            jax.tree_util.tree_map(lambda a: a[half:], batch),
         )
-        return merged, max(int(steps_a), int(steps_b))
+        outs, steps = [], 0
+        for part in halves:
+            out_p, steps_p = run_resilient(
+                part, code, max_steps=max_steps, unroll=unroll,
+                track_coverage=track_coverage, retries=retries,
+                allow_split=allow_split,
+            )
+            outs.append(out_p)
+            steps = max(steps, int(steps_p))
+        merged = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), *outs
+        )
+        return merged, steps

@@ -1,0 +1,27 @@
+"""Persistent analysis service: `myth serve`.
+
+A long-lived daemon that owns the device for its lifetime and serves
+analysis requests over a local HTTP/JSON API, amortizing process
+startup, XLA compile, and arena allocation across requests — the
+serving counterpart of the one-shot `myth analyze` pipeline
+(docs/architecture.md, "The analysis service").
+
+    jobs.py            job model, bounded queue, admission control
+    lane_allocator.py  stripe packing over the fixed device arena
+    engine.py          warm arena + continuous-batching wave loop +
+                       overlapped host-analysis pool
+    server.py          HTTP front, drain-on-SIGTERM wiring
+    client.py          stdlib client (`myth submit`)
+"""
+
+from mythril_tpu.service.engine import (  # noqa: F401
+    AnalysisEngine,
+    ServiceConfig,
+)
+from mythril_tpu.service.jobs import (  # noqa: F401
+    Job,
+    JobQueue,
+    JobState,
+    QueueRefusal,
+)
+from mythril_tpu.service.lane_allocator import LaneAllocator  # noqa: F401

@@ -1,0 +1,86 @@
+"""Thin stdlib client for the analysis service (`myth submit`)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+
+class ServiceError(Exception):
+    """A non-2xx answer from the service; carries the HTTP status so
+    callers can tell backpressure (429/503) from mistakes (400/404)."""
+
+    def __init__(self, status: int, payload: Dict) -> None:
+        super().__init__(payload.get("error") or f"HTTP {status}")
+        self.status = status
+        self.payload = payload
+
+
+class ServiceClient:
+    def __init__(self, url: str, timeout_s: float = 10.0) -> None:
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(
+        self, path: str, body: Optional[Dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Dict:
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.url + path,
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method="POST" if data is not None else "GET",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s or self.timeout_s
+            ) as response:
+                return json.loads(response.read() or b"{}")
+        except urllib.error.HTTPError as why:
+            try:
+                payload = json.loads(why.read() or b"{}")
+            except Exception:
+                payload = {}
+            raise ServiceError(why.code, payload) from why
+
+    def submit(
+        self,
+        code_hex: str,
+        max_waves: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        host_walk: Optional[bool] = None,
+        lanes: Optional[int] = None,
+    ) -> str:
+        body = {"code": code_hex}
+        for key, value in (
+            ("max_waves", max_waves),
+            ("deadline_s", deadline_s),
+            ("host_walk", host_walk),
+            ("lanes", lanes),
+        ):
+            if value is not None:
+                body[key] = value
+        return self._request("/v1/jobs", body)["job_id"]
+
+    def job(self, job_id: str) -> Dict:
+        return self._request(f"/v1/jobs/{job_id}")
+
+    def report(self, job_id: str, wait_s: float = 30.0) -> Dict:
+        """Long-poll until the job is terminal (or `wait_s` elapses);
+        returns the job dict either way."""
+        return self._request(
+            f"/v1/jobs/{job_id}/report?wait_s={wait_s}",
+            timeout_s=wait_s + 10.0,
+        )
+
+    def stats(self) -> Dict:
+        return self._request("/stats")
+
+    def healthz(self) -> Dict:
+        return self._request("/healthz")
+
+    def drain(self) -> Dict:
+        return self._request("/v1/drain", body={})

@@ -1,0 +1,824 @@
+"""The persistent analysis engine: warm device arena + continuous
+lane-level batching + overlapped host analysis.
+
+One-shot `myth analyze` pays process startup, XLA compile, and arena
+allocation on every invocation; compile alone dwarfs steady-state wave
+cost (measured on CPU JAX: ~18s cold vs ~2ms warm for the same wave).
+This engine owns the device for its lifetime and amortizes all three:
+
+- **Warm arena** — ONE fixed batch shape (`stripes x lanes_per_stripe`
+  lanes, one code-table row per stripe plus a halt row). The jit'd
+  `run` kernel keys on that shape, so after the first wave every
+  request rides the compiled kernel. Contracts longer than the current
+  code capacity re-bucket it (power of two, seeds.code_cap_bucket) —
+  the one event that recompiles, counted in /stats.
+- **Continuous batching** — the wave loop admits queued jobs into free
+  stripes *between waves* and finished jobs release their stripes the
+  wave they complete, so concurrent requests coalesce into shared
+  dispatches instead of queuing behind a whole corpus drain
+  (service/lane_allocator.py holds the packing logic).
+- **Code LRU** — disassembled dense code rows cached by code hash:
+  resubmitted or popular contracts skip `to_dense`.
+- **Host pool** — finished device phases hand off to a host worker
+  (analysis/corpus.py pooled mode, outcome injected) so device waves
+  and host `fire_lasers` overlap continuously. Host symbolic state is
+  process-global, so in-process workers serialize on
+  HOST_SYMBOLIC_LOCK.
+- **Drain** — `drain()` (wired to SIGTERM by the server) finishes the
+  in-flight wave, then checkpoints every unfinished job's seeded
+  frontier to a replayable npz (laser/batch/checkpoint.py, shape
+  metadata included): accepted work is completed or checkpointed,
+  never dropped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import CancelledError, ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mythril_tpu.service.jobs import Job, JobQueue, JobState
+from mythril_tpu.service.lane_allocator import LaneAllocator
+
+log = logging.getLogger(__name__)
+
+#: trigger statuses -> report kinds (mirrors explore.TRIGGER_KINDS; a
+#: local copy so importing the engine never drags the explorer in)
+_TRIGGER_KINDS = {
+    4: "assert-violation",  # Status.INVALID
+    5: "stack-error",  # Status.ERR_STACK
+    6: "invalid-jump",  # Status.ERR_JUMP
+    10: "selfdestruct",  # Status.KILLED
+}
+_DEGRADED_STATUSES = (7, 8)  # ERR_MEM, UNSUPPORTED
+
+DEFAULT_CALLER = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
+DEFAULT_ADDRESS = 0x901D573B8CE8C997DE5F19173C32D966B4FA55FE
+
+
+class ServiceConfig:
+    """Arena + policy knobs. Everything has a serving-shaped default;
+    tests shrink the arena, `myth serve` exposes the lot as flags."""
+
+    def __init__(
+        self,
+        stripes: int = 4,
+        lanes_per_stripe: int = 8,
+        steps_per_wave: int = 256,
+        max_waves: int = 2,
+        queue_capacity: int = 64,
+        calldata_len: int = 68,
+        code_cap: int = 2048,
+        code_cache_cap: int = 64,
+        host_workers: int = 1,
+        host_walk: bool = True,
+        execution_timeout: int = 8,
+        create_timeout: int = 10,
+        transaction_count: int = 2,
+        checkpoint_dir: Optional[str] = None,
+        coalesce_wait_s: float = 0.05,
+        idle_wait_s: float = 0.2,
+    ) -> None:
+        self.stripes = stripes
+        self.lanes_per_stripe = lanes_per_stripe
+        self.steps_per_wave = steps_per_wave
+        self.max_waves = max_waves
+        self.queue_capacity = queue_capacity
+        self.calldata_len = calldata_len
+        self.code_cap = code_cap
+        self.code_cache_cap = code_cache_cap
+        self.host_workers = host_workers
+        self.host_walk = host_walk
+        self.execution_timeout = execution_timeout
+        self.create_timeout = create_timeout
+        self.transaction_count = transaction_count
+        self.checkpoint_dir = checkpoint_dir
+        #: brief admission window before an empty arena's first wave so
+        #: near-simultaneous submissions coalesce into one dispatch —
+        #: the continuous-batching analogue of a scheduler tick
+        self.coalesce_wait_s = coalesce_wait_s
+        self.idle_wait_s = idle_wait_s
+
+
+class CodeCache:
+    """LRU of disassembled dense code rows keyed by code hash — the
+    warm path for resubmitted contracts (to_dense is a host-side
+    linear sweep, cheap once but not free at service request rates)."""
+
+    def __init__(self, code_cap: int, capacity: int = 64) -> None:
+        self.code_cap = code_cap
+        self.capacity = max(1, capacity)
+        self._rows: "OrderedDict[str, Tuple[np.ndarray, np.ndarray, int]]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def code_hash(code: bytes) -> str:
+        return hashlib.sha256(code).hexdigest()
+
+    def rows(self, code: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(ops[code_cap+33] u8, jumpdest[code_cap] bool, length)."""
+        from mythril_tpu.disassembler.asm import to_dense
+
+        key = self.code_hash(code)
+        hit = self._rows.get(key)
+        if hit is not None:
+            self.hits += 1
+            self._rows.move_to_end(key)
+            return hit
+        self.misses += 1
+        ops_row = np.zeros((self.code_cap + 33,), dtype=np.uint8)
+        ops, jumpdest = to_dense(code, max_len=self.code_cap)
+        ops_row[: self.code_cap] = ops
+        entry = (ops_row, jumpdest, min(len(code), self.code_cap))
+        self._rows[key] = entry
+        while len(self._rows) > self.capacity:
+            self._rows.popitem(last=False)
+            self.evictions += 1
+        return entry
+
+    def rebucket(self, code_cap: int) -> None:
+        """Grow the capacity (new kernel shape): cached rows are the
+        old width, so the cache flushes and rebuilds lazily."""
+        self.code_cap = code_cap
+        self._rows.clear()
+
+    def stats(self) -> Dict:
+        return {
+            "size": len(self._rows),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
+
+
+class _JobTrack:
+    """Per-resident-job device bookkeeping: lanes, seeds, coverage,
+    trigger bank. Touched only by the wave thread."""
+
+    def __init__(
+        self, job: Job, stripes: List[int], lanes: List[int],
+        calldata_len: int,
+    ) -> None:
+        import random
+
+        from mythril_tpu.laser.batch.seeds import dispatcher_seeds
+
+        self.job = job
+        self.stripes = stripes
+        self.lanes = lanes
+        self.code_row = stripes[0]
+        self.calldata_len = calldata_len
+        self.seeds = dispatcher_seeds(job.code.hex(), calldata_len)
+        self.corpus: List[bytes] = list(self.seeds)
+        self.covered: set = set()
+        self.pc_seen: Optional[np.ndarray] = None
+        self.triggers: Dict[str, List[Dict]] = {}
+        self.waves_done = 0
+        self.stale_waves = 0
+        self.degraded_lanes = 0
+        self.lane_steps = 0
+        self.rng = random.Random(int(job.id, 16))
+
+    def next_inputs(self) -> List[bytes]:
+        """One calldata per owned lane: dispatcher seeds first, then
+        single-byte mutations of the banked corpus (the explorer's
+        mutation-fill idiom, scaled down to a stripe)."""
+        out: List[bytes] = []
+        if self.waves_done == 0:
+            for i in range(len(self.lanes)):
+                out.append(self.seeds[i % len(self.seeds)])
+            return out
+        for _ in self.lanes:
+            parent = self.rng.choice(self.corpus)
+            mutated = bytearray(parent.ljust(self.calldata_len, b"\x00"))
+            mutated[self.rng.randrange(len(mutated))] = self.rng.randrange(256)
+            out.append(bytes(mutated))
+        return out
+
+    def harvest(
+        self, inputs: List[bytes], status, halt_pc, gas_min, gas_max,
+        br_pc, br_taken, br_cnt, pc_seen, steps: int,
+    ) -> None:
+        fresh = 0
+        self.waves_done += 1
+        self.lane_steps += steps * len(self.lanes)
+        for data, lane in zip(inputs, self.lanes):
+            st = int(status[lane])
+            if st in _DEGRADED_STATUSES:
+                self.degraded_lanes += 1
+            kind = _TRIGGER_KINDS.get(st)
+            if kind is not None:
+                bucket = self.triggers.setdefault(kind, [])
+                pc = int(halt_pc[lane])
+                if all(pc != t["pc"] for t in bucket) and len(bucket) < 64:
+                    bucket.append(
+                        {
+                            "pc": pc,
+                            "input": data.hex(),
+                            "prefix": [],
+                            "gas_min": int(gas_min[lane]),
+                            "gas_max": int(gas_max[lane]),
+                            "call_value": 0,
+                            "prefix_values": [],
+                        }
+                    )
+            for k in range(int(br_cnt[lane])):
+                edge = (int(br_pc[lane, k]), bool(br_taken[lane, k]))
+                if edge not in self.covered:
+                    self.covered.add(edge)
+                    fresh += 1
+            self.corpus.append(data)
+        rows = pc_seen[self.lanes].astype(np.uint32)
+        merged = np.bitwise_or.reduce(rows, axis=0)
+        if self.pc_seen is None or np.any(merged & ~self.pc_seen):
+            fresh += 1
+        self.pc_seen = (
+            merged if self.pc_seen is None else (self.pc_seen | merged)
+        )
+        del self.corpus[256:]  # bounded seed bank
+        self.stale_waves = 0 if fresh else self.stale_waves + 1
+
+    def outcome(self) -> Dict:
+        """The device phase's result in the prepass-outcome shape
+        SymExecWrapper injects (explore.py outcome contract): trigger
+        witnesses become Issues, covered branch directions pre-empt
+        host feasibility queries."""
+        from mythril_tpu.laser.batch.explore import ExploreStats
+
+        stats = ExploreStats()
+        stats.device_steps = self.lane_steps
+        stats.waves = self.waves_done
+        stats.branches_covered = len(self.covered)
+        stats.lanes_degraded_mem = 0
+        return {
+            "covered_branches": sorted(self.covered),
+            "corpus_size": len(self.corpus),
+            "triggers": {k: list(v) for k, v in self.triggers.items()},
+            "evidence": [],
+            "device_complete": False,
+            "completeness_gates": {},
+            "degraded_lanes": self.degraded_lanes,
+            "stats": stats.as_dict(),
+        }
+
+    def covered_pc_bits(self) -> int:
+        if self.pc_seen is None:
+            return 0
+        return int(
+            (np.unpackbits(self.pc_seen.view(np.uint8)) != 0).sum()
+        )
+
+
+class AnalysisEngine:
+    """Wave loop + admission + host pool behind the HTTP server.
+
+    `start()` spins the wave thread; `submit()` is thread-safe (the
+    HTTP layer calls it from handler threads); `drain()` implements the
+    SIGTERM contract. The engine also works un-started: submissions
+    queue, and a drain checkpoints them — the degenerate case the drain
+    tests pin without paying a kernel compile."""
+
+    def __init__(self, config: Optional[ServiceConfig] = None) -> None:
+        from mythril_tpu.laser.batch import ensure_compile_cache
+        from mythril_tpu.laser.batch.seeds import code_cap_bucket
+        from mythril_tpu.support.resilience import DegradationLog
+
+        ensure_compile_cache()
+        self.cfg = config or ServiceConfig()
+        self.queue = JobQueue(self.cfg.queue_capacity)
+        self.alloc = LaneAllocator(
+            self.cfg.stripes, self.cfg.lanes_per_stripe
+        )
+        self.code_cap = code_cap_bucket(1, floor=self.cfg.code_cap)
+        self.code_cache = CodeCache(self.code_cap, self.cfg.code_cache_cap)
+        self._tracks: "OrderedDict[str, _JobTrack]" = OrderedDict()
+        self._arena_ops: Optional[np.ndarray] = None
+        self._arena_jd: Optional[np.ndarray] = None
+        self._arena_len: Optional[np.ndarray] = None
+        self._code_table = None
+        self._table_dirty = True
+        self._rebuild_arena_rows()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.cfg.host_workers),
+            thread_name_prefix="myth-serve-host",
+        )
+        self._host_inflight: Dict[str, Tuple] = {}
+        self._deg_marker = DegradationLog().marker()
+        # observability
+        self.started_t = time.monotonic()
+        self.waves_total = 0
+        self.device_steps = 0
+        self.host_completed = 0
+        self.kernel_rebuckets = 0
+        self._first_wave_t: Optional[float] = None
+        self._last_wave_t: Optional[float] = None
+        self._wave_cold_s: Optional[float] = None
+        self._wave_warm_ema_s: Optional[float] = None
+        self._checkpoint_dir: Optional[str] = self.cfg.checkpoint_dir
+        self._drained = threading.Event()
+        self._draining = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "AnalysisEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="myth-serve-waves", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def submit(self, job: Job) -> Job:
+        self.queue.submit(job)  # raises QueueRefusal on backpressure
+        self._wake.set()
+        return job
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        """The SIGTERM contract: refuse new work, finish the in-flight
+        wave and the in-flight host analyses, checkpoint everything
+        else to replayable npz. Idempotent."""
+        with self._lock:
+            if self._draining:
+                self._drained.wait(timeout_s)
+                return
+            self._draining = True
+        queued = self.queue.drain_remaining()
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            if self._thread.is_alive():
+                # a wedged device call: checkpoint from the host-side
+                # track state anyway (it is no longer being mutated in
+                # any way that matters — the wave will be re-run from
+                # the checkpoint) and say so
+                log.warning(
+                    "drain: wave thread still busy after %.0fs; "
+                    "checkpointing resident jobs from the last "
+                    "harvested state", timeout_s,
+                )
+        # resident jobs: their next-wave frontier, seeded exactly as
+        # the wave loop would have
+        for track in list(self._tracks.values()):
+            self._checkpoint_job(track.job, track)
+            self.alloc.release(track.stripes)
+        self._tracks.clear()
+        # never-admitted jobs: their first-wave frontier
+        for job in queued:
+            self._checkpoint_job(job, None)
+        # host pool: running analyses finish, queued ones cancel and
+        # fall back to device-only reports (the device phase already
+        # completed — its findings are not lost, the walk is skipped)
+        self._pool.shutdown(wait=True, cancel_futures=True)
+        for job_id, (future, track, outcome) in list(
+            self._host_inflight.items()
+        ):
+            if future.cancelled():
+                job = self.queue.get(job_id)
+                if job is not None and not job.terminal:
+                    job.degraded.append("interrupted")
+                    self._finalize(job, track, outcome, host_result=None)
+        self._host_inflight.clear()
+        self._drained.set()
+
+    def close(self) -> None:
+        self.drain()
+
+    # -- admission + arena ---------------------------------------------
+    def _rebuild_arena_rows(self) -> None:
+        rows = self.cfg.stripes + 1  # + the halt row idle lanes run
+        self._arena_ops = np.zeros((rows, self.code_cap + 33), np.uint8)
+        self._arena_jd = np.zeros((rows, self.code_cap), bool)
+        self._arena_len = np.zeros((rows,), np.int32)
+        self._table_dirty = True
+
+    def _install_code(self, track: _JobTrack) -> None:
+        ops_row, jd_row, length = self.code_cache.rows(track.job.code)
+        self._arena_ops[track.code_row] = ops_row
+        self._arena_jd[track.code_row] = jd_row
+        self._arena_len[track.code_row] = length
+        self._table_dirty = True
+
+    def _ensure_code_cap(self, code: bytes) -> None:
+        from mythril_tpu.laser.batch.seeds import code_cap_bucket
+
+        if len(code) <= self.code_cap:
+            return
+        self.code_cap = code_cap_bucket(len(code), floor=self.code_cap)
+        self.kernel_rebuckets += 1
+        self.code_cache.rebucket(self.code_cap)
+        self._rebuild_arena_rows()
+        for resident in self._tracks.values():
+            self._install_code(resident)
+        log.info(
+            "service arena re-bucketed code capacity to %d (recompile)",
+            self.code_cap,
+        )
+
+    def _admit(self) -> None:
+        """Between waves: pull queued jobs into free stripes."""
+        free = self.alloc.stripes - self.alloc.occupancy()["stripes_busy"]
+        if free <= 0:
+            return
+        for job in self.queue.claim(free):
+            n_stripes = self.alloc.stripes_needed(
+                job.lanes or self.cfg.lanes_per_stripe
+            )
+            if n_stripes > self.alloc.stripes:
+                n_stripes = self.alloc.stripes
+            granted = self.alloc.allocate(job.id, n_stripes)
+            if granted is None:
+                self.queue.unclaim(job)
+                break
+            self._ensure_code_cap(job.code)
+            lanes = [
+                lane for s in granted for lane in self.alloc.lanes_of(s)
+            ]
+            track = _JobTrack(job, granted, lanes, self.cfg.calldata_len)
+            self._install_code(track)
+            self._tracks[job.id] = track
+
+    def _table(self):
+        import jax.numpy as jnp
+
+        from mythril_tpu.laser.batch.state import CodeTable
+
+        if self._table_dirty or self._code_table is None:
+            self._code_table = CodeTable(
+                jnp.asarray(self._arena_ops),
+                jnp.asarray(self._arena_jd),
+                jnp.asarray(self._arena_len),
+            )
+            self._table_dirty = False
+        return self._code_table
+
+    # -- the wave loop -------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self._wave_once()
+            except Exception:
+                log.exception("service wave loop fault; jobs failed")
+                worked = True  # don't spin hot on a persistent fault
+            if not worked:
+                self._wake.wait(self.cfg.idle_wait_s)
+                self._wake.clear()
+
+    def _wave_once(self) -> bool:
+        import jax
+
+        from mythril_tpu.laser.batch.run import run_resilient
+        from mythril_tpu.laser.batch.state import make_batch
+
+        if not self._tracks and self.queue.depth():
+            # the coalesce window: near-simultaneous submissions share
+            # the first wave instead of serializing behind it
+            time.sleep(self.cfg.coalesce_wait_s)
+        self._admit()
+        if not self._tracks:
+            return False
+        halt_row = self.cfg.stripes
+        n = self.alloc.n_lanes
+        code_ids = np.full((n,), halt_row, np.int32)
+        calldata: List[bytes] = [b""] * n
+        wave_inputs: Dict[str, List[bytes]] = {}
+        for track in self._tracks.values():
+            inputs = track.next_inputs()
+            wave_inputs[track.job.id] = inputs
+            for lane, data in zip(track.lanes, inputs):
+                code_ids[lane] = track.code_row
+                calldata[lane] = data
+        batch = make_batch(
+            n,
+            code_ids=code_ids,
+            calldata=calldata,
+            caller=DEFAULT_CALLER,
+            address=DEFAULT_ADDRESS,
+            timestamp=0x5BFA4639,
+            number=0x66E393,
+            gasprice=0x773594000,
+        )
+        t0 = time.perf_counter()
+        try:
+            out, steps = run_resilient(
+                batch,
+                self._table(),
+                max_steps=self.cfg.steps_per_wave,
+                track_coverage=True,
+            )
+        except Exception as why:
+            self._fail_wave(why)
+            return True
+        wall = time.perf_counter() - t0
+        now = time.monotonic()
+        self.waves_total += 1
+        if self._first_wave_t is None:
+            self._first_wave_t = now
+            self._wave_cold_s = wall
+        else:
+            ema = self._wave_warm_ema_s
+            self._wave_warm_ema_s = (
+                wall if ema is None else 0.8 * ema + 0.2 * wall
+            )
+        self._last_wave_t = now
+        status, halt_pc, gas_min, gas_max, br_pc, br_taken, br_cnt, seen = (
+            jax.device_get(
+                (
+                    out.status, out.pc, out.gas_min, out.gas_max,
+                    out.br_pc, out.br_taken, out.br_cnt, out.pc_seen,
+                )
+            )
+        )
+        steps = int(steps)
+        self.device_steps += steps * n
+        finished: List[_JobTrack] = []
+        for track in list(self._tracks.values()):
+            track.harvest(
+                wave_inputs[track.job.id], status, halt_pc, gas_min,
+                gas_max, br_pc, br_taken, br_cnt, seen, steps,
+            )
+            track.job.waves = track.waves_done
+            max_waves = track.job.max_waves or self.cfg.max_waves
+            expired = (
+                track.job.deadline is not None
+                and track.job.deadline.expired
+            )
+            if expired:
+                from mythril_tpu.support.resilience import (
+                    DegradationLog,
+                    DegradationReason,
+                )
+
+                track.job.degraded.append(DegradationReason.DEADLINE_EXPIRED)
+                DegradationLog().record(
+                    DegradationReason.DEADLINE_EXPIRED,
+                    site="service-wave",
+                    contract=track.job.id,
+                )
+            if expired or track.waves_done >= max_waves or (
+                track.stale_waves >= 2
+            ):
+                finished.append(track)
+        for track in finished:
+            del self._tracks[track.job.id]
+            self.alloc.release(track.stripes)
+            track.job.device_done_t = time.monotonic()
+            self._dispatch_host(track)
+        return True
+
+    def _fail_wave(self, why: Exception) -> None:
+        """A wave died past run_resilient's whole escalation ladder:
+        fail the resident jobs with the fault recorded — the service
+        itself stays up for the next request."""
+        from mythril_tpu.support.resilience import (
+            DegradationLog,
+            DegradationReason,
+        )
+
+        DegradationLog().record(
+            DegradationReason.WAVE_ABANDONED,
+            site="service-wave",
+            detail=str(why),
+        )
+        for track in list(self._tracks.values()):
+            del self._tracks[track.job.id]
+            self.alloc.release(track.stripes)
+            track.job.error = f"device wave failed: {why}"
+            self.queue.settle(track.job, JobState.FAILED)
+
+    # -- host phase ----------------------------------------------------
+    def _dispatch_host(self, track: _JobTrack) -> None:
+        job = track.job
+        outcome = track.outcome()
+        host_walk = (
+            self.cfg.host_walk if job.host_walk is None else job.host_walk
+        )
+        if not host_walk:
+            self._finalize(job, track, outcome, host_result=None)
+            return
+        self.queue.mark(job, JobState.ANALYZING)
+        future = self._pool.submit(self._host_task, job, track, outcome)
+        self._host_inflight[job.id] = (future, track, outcome)
+
+    def _host_task(self, job: Job, track: _JobTrack, outcome: Dict) -> None:
+        from mythril_tpu.analysis.corpus import analyze_one_payload
+        from mythril_tpu.support.host_lock import HOST_SYMBOLIC_LOCK
+
+        timeout = self.cfg.execution_timeout
+        if job.deadline is not None:
+            timeout = max(1, min(timeout, int(job.deadline.remaining)))
+        payload = (
+            job.code.hex(),
+            "",
+            f"job-{job.id}",
+            DEFAULT_ADDRESS,
+            "bfs",
+            self.cfg.transaction_count,
+            timeout,
+            self.cfg.create_timeout,
+            128,  # max_depth
+            3,  # loop_bound
+            None,  # modules
+            None,  # solver_timeout
+            False,  # use_device: the arena is the wave thread's
+            outcome,
+            None,  # deterministic_solving
+        )
+        try:
+            # host symbolic state (term arena, CDCL session) is
+            # process-global: in-process workers serialize here
+            with HOST_SYMBOLIC_LOCK:
+                result = analyze_one_payload(payload)
+        except CancelledError:
+            raise
+        except Exception as why:  # analyze_one_payload already catches;
+            result = {"issues": [], "states": 0, "error": str(why)}
+        self._host_inflight.pop(job.id, None)
+        self.host_completed += 1
+        self._finalize(job, track, outcome, host_result=result)
+
+    def _finalize(
+        self, job: Job, track: Optional[_JobTrack], outcome: Dict,
+        host_result: Optional[Dict],
+    ) -> None:
+        now = time.monotonic()
+        device_s = (
+            (job.device_done_t or now) - (job.started_t or job.created_t)
+        )
+        report = {
+            "job_id": job.id,
+            "code_hash": CodeCache.code_hash(job.code),
+            "device": {
+                "waves": outcome["stats"]["waves"],
+                "lane_steps": outcome["stats"]["device_steps"],
+                "covered_branches": len(outcome["covered_branches"]),
+                "covered_pc_bits": (
+                    track.covered_pc_bits() if track is not None else 0
+                ),
+                "triggers": {
+                    kind: len(bucket)
+                    for kind, bucket in outcome["triggers"].items()
+                },
+                "degraded_lanes": outcome["degraded_lanes"],
+            },
+            "issues": [],
+            "timings": {
+                "queued_s": round(
+                    (job.started_t or now) - job.created_t, 3
+                ),
+                "device_s": round(device_s, 3),
+            },
+        }
+        state = JobState.DONE
+        if host_result is not None:
+            report["issues"] = host_result.get("issues", [])
+            report["host"] = {
+                "states": host_result.get("states", 0),
+                "error": host_result.get("error"),
+            }
+            report["timings"]["host_s"] = round(
+                now - (job.device_done_t or now), 3
+            )
+            if host_result.get("error"):
+                job.error = host_result["error"]
+                state = JobState.FAILED
+        if job.degraded:
+            report["degraded"] = list(job.degraded)
+        report["timings"]["total_s"] = round(now - job.created_t, 3)
+        job.report = report
+        self.queue.settle(job, state)
+
+    # -- drain checkpoints ----------------------------------------------
+    def checkpoint_dir(self) -> str:
+        if self._checkpoint_dir is None:
+            self._checkpoint_dir = tempfile.mkdtemp(prefix="myth-serve-")
+        os.makedirs(self._checkpoint_dir, exist_ok=True)
+        return self._checkpoint_dir
+
+    def _checkpoint_job(self, job: Job, track: Optional[_JobTrack]) -> None:
+        """Flush one unfinished job's seeded frontier to a replayable
+        npz: its lanes' next-wave inputs (or first-wave dispatcher
+        seeds when it never entered the arena) against its own
+        single-contract code table. replay_wave / load_checkpoint
+        reconstruct the exact wave the drain cut off."""
+        from mythril_tpu.laser.batch.checkpoint import save_checkpoint
+        from mythril_tpu.laser.batch.seeds import (
+            code_cap_bucket,
+            dispatcher_seeds,
+        )
+        from mythril_tpu.laser.batch.state import make_batch, make_code_table
+
+        try:
+            if track is not None:
+                n = len(track.lanes)
+                inputs = track.next_inputs()
+            else:
+                n = (
+                    self.alloc.stripes_needed(
+                        job.lanes or self.cfg.lanes_per_stripe
+                    )
+                    * self.cfg.lanes_per_stripe
+                )
+                seeds = dispatcher_seeds(
+                    job.code.hex(), self.cfg.calldata_len
+                )
+                inputs = [seeds[i % len(seeds)] for i in range(n)]
+            table = make_code_table(
+                [job.code], code_cap=code_cap_bucket(len(job.code))
+            )
+            batch = make_batch(
+                n,
+                calldata=inputs,
+                caller=DEFAULT_CALLER,
+                address=DEFAULT_ADDRESS,
+                timestamp=0x5BFA4639,
+                number=0x66E393,
+                gasprice=0x773594000,
+            )
+            path = os.path.join(
+                self.checkpoint_dir(), f"job-{job.id}.npz"
+            )
+            save_checkpoint(
+                path, batch, table, step=self.cfg.steps_per_wave
+            )
+            job.checkpoint_path = path
+            self.queue.settle(job, JobState.CHECKPOINTED)
+        except Exception as why:
+            log.exception("drain checkpoint failed for job %s", job.id)
+            job.error = f"drain checkpoint failed: {why}"
+            self.queue.settle(job, JobState.FAILED)
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> Dict:
+        from mythril_tpu.support.resilience import DegradationLog
+
+        now = time.monotonic()
+        span = (
+            (self._last_wave_t - self._first_wave_t)
+            if self.waves_total > 1
+            else None
+        )
+        return {
+            "uptime_s": round(now - self.started_t, 3),
+            "draining": self._draining,
+            "queue": {
+                "depth": self.queue.depth(),
+                "capacity": self.queue.capacity,
+                "accepted": self.queue.accepted,
+                "rejected_full": self.queue.rejected_full,
+                "rejected_draining": self.queue.rejected_draining,
+                "jobs": self.queue.jobs_by_state(),
+            },
+            "arena": self.alloc.occupancy(),
+            "waves": {
+                "count": self.waves_total,
+                "steps_per_wave": self.cfg.steps_per_wave,
+                "device_steps": self.device_steps,
+                "rate_per_s": (
+                    round((self.waves_total - 1) / span, 3)
+                    if span
+                    else 0.0
+                ),
+                "cold_wave_s": (
+                    round(self._wave_cold_s, 4)
+                    if self._wave_cold_s is not None
+                    else None
+                ),
+                "warm_wave_s": (
+                    round(self._wave_warm_ema_s, 4)
+                    if self._wave_warm_ema_s is not None
+                    else None
+                ),
+            },
+            "warm": {
+                "code_cap": self.code_cap,
+                "kernel_rebuckets": self.kernel_rebuckets,
+                "code_cache": self.code_cache.stats(),
+            },
+            "host_pool": {
+                "workers": max(1, self.cfg.host_workers),
+                "inflight": len(self._host_inflight),
+                "completed": self.host_completed,
+            },
+            "degradation": DegradationLog().counts_since(self._deg_marker),
+        }

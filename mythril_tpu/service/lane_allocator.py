@@ -1,0 +1,104 @@
+"""Lane-stripe allocation for the persistent analysis arena.
+
+The service's device arena is ONE fixed-shape StateBatch (the shape is
+what keeps the jit'd run kernel warm), carved into `stripes` equal
+stripes of `lanes_per_stripe` lanes. A job owns one or more stripes
+for its device phase and releases them the moment its exploration
+finishes — between two waves, not between two corpus runs — which is
+what lets the next queued contract join the very next wave
+(continuous lane-level batching, the service counterpart of
+continuous batching in LLM serving).
+
+Stripes need not be contiguous: every lane carries its own code-table
+row id, so the allocator is a plain free-list + occupancy ledger with
+no compaction. Pure host-side bookkeeping, no JAX."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class LaneAllocator:
+    """Free-list allocator over `stripes` stripes of
+    `lanes_per_stripe` lanes each."""
+
+    def __init__(self, stripes: int, lanes_per_stripe: int) -> None:
+        if stripes < 1 or lanes_per_stripe < 1:
+            raise ValueError(
+                f"arena wants >=1 stripe of >=1 lane, got "
+                f"{stripes}x{lanes_per_stripe}"
+            )
+        self.stripes = stripes
+        self.lanes_per_stripe = lanes_per_stripe
+        self._free: List[int] = list(range(stripes))
+        self._owner: Dict[int, str] = {}  # stripe -> job id
+        self._lock = threading.Lock()
+        # high-water marks for /stats: how coalesced the waves actually
+        # ran (the acceptance signal that concurrent jobs share waves)
+        self.max_jobs_resident = 0
+        self.max_lanes_busy = 0
+
+    @property
+    def n_lanes(self) -> int:
+        return self.stripes * self.lanes_per_stripe
+
+    def lanes_of(self, stripe: int) -> List[int]:
+        base = stripe * self.lanes_per_stripe
+        return list(range(base, base + self.lanes_per_stripe))
+
+    def stripes_needed(self, lanes: int) -> int:
+        """Smallest stripe count covering a lane request (ceil)."""
+        return max(1, -(-int(lanes) // self.lanes_per_stripe))
+
+    def allocate(self, job_id: str, n_stripes: int = 1) -> Optional[List[int]]:
+        """Claim `n_stripes` stripes for `job_id`, or None when the
+        arena can't fit the request right now (the job stays queued and
+        retries at the next wave boundary). All-or-nothing: a partial
+        grant would strand a job half-resident across waves."""
+        if n_stripes > self.stripes:
+            raise ValueError(
+                f"job {job_id} wants {n_stripes} stripes; the arena has "
+                f"{self.stripes} — resize the arena, not the request"
+            )
+        with self._lock:
+            if len(self._free) < n_stripes:
+                return None
+            granted = [self._free.pop(0) for _ in range(n_stripes)]
+            for stripe in granted:
+                self._owner[stripe] = job_id
+            jobs = len(set(self._owner.values()))
+            self.max_jobs_resident = max(self.max_jobs_resident, jobs)
+            self.max_lanes_busy = max(
+                self.max_lanes_busy, len(self._owner) * self.lanes_per_stripe
+            )
+            return granted
+
+    def release(self, stripes: List[int]) -> None:
+        with self._lock:
+            for stripe in stripes:
+                if stripe in self._owner:
+                    del self._owner[stripe]
+                    self._free.append(stripe)
+            self._free.sort()
+
+    def owner_of(self, stripe: int) -> Optional[str]:
+        with self._lock:
+            return self._owner.get(stripe)
+
+    def occupancy(self) -> Dict:
+        """The /stats view: stripe/lane busy counts plus high-water
+        marks (max_jobs_resident > 1 is the proof that concurrent
+        requests coalesced into shared waves)."""
+        with self._lock:
+            busy = len(self._owner)
+            return {
+                "stripes": self.stripes,
+                "lanes_per_stripe": self.lanes_per_stripe,
+                "lanes": self.n_lanes,
+                "stripes_busy": busy,
+                "lanes_busy": busy * self.lanes_per_stripe,
+                "jobs_resident": len(set(self._owner.values())),
+                "max_jobs_resident": self.max_jobs_resident,
+                "max_lanes_busy": self.max_lanes_busy,
+            }

@@ -1,0 +1,251 @@
+"""HTTP/JSON front of the persistent analysis service (`myth serve`).
+
+Stdlib-only (http.server): the service targets the same no-egress
+container the rest of the toolchain runs in, so no web framework.
+
+Endpoints:
+  POST /v1/jobs                submit {"code": "0x..."} -> 202 {job_id}
+                               (429 queue full, 503 draining, 400 junk)
+  GET  /v1/jobs/<id>           job status (+ report when terminal)
+  GET  /v1/jobs/<id>/report    long-poll until terminal (?wait_s=30)
+  GET  /healthz                liveness + draining flag
+  GET  /stats                  queue depth, lane occupancy, wave rate,
+                               warm-cache counters, degradation counts
+  POST /v1/drain               begin the graceful drain (also SIGTERM)
+
+Drain semantics (SIGTERM or /v1/drain): new submissions get 503, the
+in-flight wave and in-flight host analyses finish, every other
+accepted job is checkpointed to a replayable npz
+(laser/batch/checkpoint.py) and reported as `checkpointed` — accepted
+work is never dropped. The signal handler chains to whatever handler
+was installed before it (support/resilience.py keeps its own handlers
+restore-and-chain-safe for exactly this embedding)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+from mythril_tpu.service.jobs import Job, QueueRefusal
+
+log = logging.getLogger(__name__)
+
+_JOB_PATH = re.compile(r"^/v1/jobs/([0-9a-f]{12})(/report)?$")
+
+#: QueueRefusal.reason -> HTTP status
+_REFUSAL_STATUS = {"full": 429, "draining": 503}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # the engine rides on the server object (ThreadingHTTPServer
+    # instantiates a handler per request)
+    @property
+    def engine(self) -> AnalysisEngine:
+        return self.server.engine  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # route through logging, quietly
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, status: int, payload: Dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _query(self) -> Tuple[str, Dict[str, str]]:
+        path, _, query = self.path.partition("?")
+        params = {}
+        for pair in query.split("&"):
+            if "=" in pair:
+                key, _, value = pair.partition("=")
+                params[key] = value
+        return path, params
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        path, params = self._query()
+        if path == "/healthz":
+            self._reply(
+                200,
+                {
+                    "ok": True,
+                    "draining": self.engine.draining,
+                    "uptime_s": self.engine.stats()["uptime_s"],
+                },
+            )
+            return
+        if path == "/stats":
+            self._reply(200, self.engine.stats())
+            return
+        match = _JOB_PATH.match(path)
+        if match:
+            job_id, want_report = match.group(1), bool(match.group(2))
+            if want_report:
+                wait_s = min(float(params.get("wait_s", 30.0)), 300.0)
+                job = self.engine.queue.wait_terminal(job_id, wait_s)
+            else:
+                job = self.engine.queue.get(job_id)
+            if job is None:
+                self._reply(404, {"error": f"unknown job {job_id}"})
+                return
+            self._reply(200, job.as_dict())
+            return
+        self._reply(404, {"error": f"no route {path}"})
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        path, _ = self._query()
+        if path == "/v1/drain":
+            # ack first: the drain blocks until checkpoints are flushed
+            self._reply(202, {"draining": True})
+            threading.Thread(
+                target=self.engine.drain, name="myth-serve-drain",
+                daemon=True,
+            ).start()
+            return
+        if path != "/v1/jobs":
+            self._reply(404, {"error": f"no route {path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            job = Job(
+                code_hex=body["code"],
+                max_waves=body.get("max_waves"),
+                deadline_s=body.get("deadline_s"),
+                host_walk=body.get("host_walk"),
+                lanes=body.get("lanes"),
+            )
+        except (KeyError, ValueError, TypeError) as why:
+            self._reply(400, {"error": f"bad request: {why}"})
+            return
+        try:
+            self.engine.submit(job)
+        except QueueRefusal as refusal:
+            self._reply(
+                _REFUSAL_STATUS.get(refusal.reason, 503),
+                {"error": str(refusal), "reason": refusal.reason},
+            )
+            return
+        self._reply(202, {"job_id": job.id, "state": job.state})
+
+
+class AnalysisServer:
+    """The embeddable server: engine + HTTP listener + drain wiring.
+
+    `myth serve` runs it until drained; tools/serve_smoke.py and the
+    service tests run it in-process (port 0 picks a free port)."""
+
+    def __init__(
+        self,
+        config: Optional[ServiceConfig] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        start_engine: bool = True,
+    ) -> None:
+        self.engine = AnalysisEngine(config)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.engine = self.engine  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._http_thread: Optional[threading.Thread] = None
+        self._start_engine = start_engine
+        self._closed = False
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "AnalysisServer":
+        if self._start_engine:
+            self.engine.start()
+        if self._http_thread is None:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="myth-serve-http",
+                daemon=True,
+            )
+            self._http_thread.start()
+        return self
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain. Chains to the previously
+        installed handler, mirroring the courtesy resilience's
+        supervisor extends to us."""
+        def _drain_handler(signum, frame, _previous={}):
+            log.info("signal %s: draining the analysis service", signum)
+            threading.Thread(
+                target=self.close, name="myth-serve-drain", daemon=True
+            ).start()
+            previous = _previous.get(signum)
+            if callable(previous) and previous not in (
+                signal.default_int_handler,
+            ):
+                previous(signum, frame)
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev = signal.signal(sig, _drain_handler)
+            except (ValueError, OSError):
+                continue  # not the main thread / exotic embedding
+            if prev is not _drain_handler:
+                _drain_handler.__defaults__[0][sig] = prev
+
+    def drained(self, timeout_s: Optional[float] = 300.0) -> bool:
+        """Block until the drain completes (None = forever)."""
+        return self.engine._drained.wait(timeout_s)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.engine.drain()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "AnalysisServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_forever(
+    config: Optional[ServiceConfig] = None,
+    host: str = "127.0.0.1",
+    port: int = 7341,
+) -> None:
+    """The `myth serve` entry: run until a drain (SIGTERM/SIGINT or
+    POST /v1/drain) completes."""
+    server = AnalysisServer(config, host=host, port=port).start()
+    server.install_signal_handlers()
+    print(
+        f"myth serve: listening on {server.url} "
+        f"(arena {server.engine.cfg.stripes}x"
+        f"{server.engine.cfg.lanes_per_stripe} lanes, "
+        f"queue {server.engine.cfg.queue_capacity})",
+        flush=True,
+    )
+    try:
+        server.drained(timeout_s=None)
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    print("myth serve: drained, bye", flush=True)

@@ -455,6 +455,30 @@ def clear_shutdown() -> None:
 
 
 _SHUTDOWN_DEPTH = 0
+#: handlers that were installed before the supervisor's, keyed by
+#: signal number — module-global (not per-scope) so re-entry can never
+#: save the supervisor's OWN handler as "previous" and leak it
+_PREVIOUS_HANDLERS: Dict[int, object] = {}
+
+
+def _supervisor_handler(signum, frame) -> None:
+    """The one supervisor signal handler: record the interruption, set
+    the shutdown event the wave/contract boundaries poll, then CHAIN to
+    whatever handler was installed before us — an embedding server
+    (e.g. `myth serve`'s drain handler) keeps receiving its signals
+    even while an analysis runs under the supervisor. The default
+    KeyboardInterrupt handler and SIG_DFL/SIG_IGN are not chained:
+    re-raising would kill exactly the run this handler exists to wind
+    down gracefully."""
+    DegradationLog().record(
+        DegradationReason.INTERRUPTED,
+        site="signal",
+        detail=signal.Signals(signum).name,
+    )
+    _SHUTDOWN.set()
+    previous = _PREVIOUS_HANDLERS.get(signum)
+    if callable(previous) and previous is not signal.default_int_handler:
+        previous(signum, frame)
 
 
 class graceful_shutdown:
@@ -465,12 +489,18 @@ class graceful_shutdown:
     analyzer and the corpus driver both guard their loops, handlers
     install once at the outermost entry and the event clears only when
     the outermost scope exits (an inner exit must not erase a signal
-    the outer loop still needs to honor)."""
+    the outer loop still needs to honor).
+
+    Embedding-safe: installation is idempotent (finding our own handler
+    already installed saves nothing, so repeated runs can't make the
+    supervisor its own "previous" handler), the handler chains to the
+    embedder's (see _supervisor_handler), and exit restores the
+    previous handler ONLY while ours is still the installed one — an
+    embedder that re-registered its own handler mid-run keeps it."""
 
     SIGNALS = (signal.SIGINT, signal.SIGTERM)
 
     def __init__(self) -> None:
-        self._previous: Dict[int, object] = {}
         self._armed = False
 
     def __enter__(self) -> "graceful_shutdown":
@@ -481,18 +511,13 @@ class graceful_shutdown:
         self._armed = True
         if _SHUTDOWN_DEPTH > 1:
             return self
-
-        def _handler(signum, frame):
-            DegradationLog().record(
-                DegradationReason.INTERRUPTED,
-                site="signal",
-                detail=signal.Signals(signum).name,
-            )
-            _SHUTDOWN.set()
-
         for sig in self.SIGNALS:
             try:
-                self._previous[sig] = signal.signal(sig, _handler)
+                current = signal.getsignal(sig)
+                if current is _supervisor_handler:
+                    continue  # already installed: nothing to save
+                _PREVIOUS_HANDLERS[sig] = current
+                signal.signal(sig, _supervisor_handler)
             except (ValueError, OSError):  # exotic embedding: keep going
                 pass
         return self
@@ -504,11 +529,14 @@ class graceful_shutdown:
         _SHUTDOWN_DEPTH -= 1
         if _SHUTDOWN_DEPTH > 0:
             return None
-        for sig, previous in self._previous.items():
+        for sig in self.SIGNALS:
+            previous = _PREVIOUS_HANDLERS.pop(sig, None)
+            if previous is None:
+                continue
             try:
-                signal.signal(sig, previous)
+                if signal.getsignal(sig) is _supervisor_handler:
+                    signal.signal(sig, previous)
             except (ValueError, OSError):
                 pass
-        self._previous = {}
         _SHUTDOWN.clear()
         return None

@@ -38,6 +38,11 @@ def pytest_configure(config):
         "faults: deterministic fault-injection suite (resilience harness; "
         "fast — runs in tier-1, selectable with -m faults)",
     )
+    config.addinivalue_line(
+        "markers",
+        "service: persistent analysis service suite (myth serve; CPU-only, "
+        "fast — runs in tier-1, selectable with -m service)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

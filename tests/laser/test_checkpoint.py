@@ -117,3 +117,70 @@ def test_v2_checkpoint_defaults_empty_world(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(done_a.status), np.asarray(done_b.status)
     )
+
+
+# -- arena-shape metadata (ISSUE 2 satellite) -------------------------------
+def test_shape_metadata_written_and_readable(tmp_path):
+    from mythril_tpu.laser.batch.checkpoint import arena_shape, checkpoint_shape
+
+    batch, code = demo()
+    path = tmp_path / "shaped.npz"
+    save_checkpoint(path, batch, code, step=3)
+    shape = checkpoint_shape(path)
+    assert shape == arena_shape(batch, code)
+    assert shape["lanes"] == 8
+    assert shape["code_rows"] == 1
+
+
+def test_mismatched_arena_shape_refuses_clearly(tmp_path):
+    """An npz written by one arena shape must refuse to load into a
+    mismatched one — a clear error naming the mismatch, not garbage
+    lanes."""
+    batch, code = demo()
+    path = tmp_path / "narrow.npz"
+    save_checkpoint(path, batch, code)
+    with pytest.raises(ValueError, match="lanes: checkpoint has 8"):
+        load_checkpoint(path, expect_shape={"lanes": 16})
+    with pytest.raises(ValueError, match="mem_cap"):
+        load_checkpoint(path, expect_shape={"lanes": 8, "mem_cap": 99})
+    # the matching shape (and a partial expectation) load fine
+    from mythril_tpu.laser.batch.checkpoint import arena_shape
+
+    restored, _, _ = load_checkpoint(path, expect_shape=arena_shape(batch, code))
+    np.testing.assert_array_equal(
+        np.asarray(batch.pc), np.asarray(restored.pc)
+    )
+    load_checkpoint(path, expect_shape={"lanes": 8})
+
+
+def test_replay_wave_refuses_mismatched_shape(tmp_path):
+    from mythril_tpu.laser.batch.explore import replay_wave
+
+    batch, code = demo()
+    path = tmp_path / "wave.npz"
+    save_checkpoint(path, batch, code, step=4)
+    with pytest.raises(ValueError, match="arena shape"):
+        replay_wave(str(path), expect_shape={"lanes": 512})
+
+
+def test_pre_v4_checkpoint_shape_is_derived(tmp_path):
+    """Checkpoints written before the shape metadata still refuse a
+    mismatched load: the shape is derived from the stored arrays."""
+    import json
+
+    from mythril_tpu.laser.batch.checkpoint import checkpoint_shape
+
+    batch, code = demo()
+    path = tmp_path / "v3.npz"
+    save_checkpoint(path, batch, code)
+    data = dict(np.load(str(path)))
+    data["meta"] = np.frombuffer(
+        json.dumps({"version": 3, "step": 0}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(str(path), **data)
+    shape = checkpoint_shape(path)
+    assert shape["lanes"] == 8 and shape["code_rows"] == 1
+    with pytest.raises(ValueError, match="arena shape"):
+        load_checkpoint(path, expect_shape={"lanes": 4})
+    restored, _, _ = load_checkpoint(path, expect_shape={"lanes": 8})
+    assert restored.n_lanes == 8

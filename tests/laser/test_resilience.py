@@ -443,3 +443,128 @@ def test_report_renders_degradation_only_when_present():
     meta = jsonv2[0]["meta"]
     assert meta["partial"] is True
     assert meta["degradation"]["contracts"][1]["complete"] is False
+
+
+# -- split-ladder kwarg propagation (ISSUE 2 satellite) ---------------------
+def test_split_retry_preserves_unroll_and_coverage_kwargs():
+    """The retry->split ladder must thread the caller's exact kwargs:
+    a split that silently reset `unroll`/`track_coverage` to defaults
+    would change coverage accounting (pc_seen suddenly populated) and
+    step bookkeeping (odd step counts) mid-escalation."""
+    batch, code = _demo()
+    # all 3 full-batch attempts die; the 4-lane halves succeed
+    with device_faults(times=3):
+        out, steps = run_resilient(
+            batch, code, max_steps=64, unroll=2, track_coverage=False
+        )
+    counts = resilience.DegradationLog().counts
+    assert counts.get("device-split-dispatch") == 1
+    # the WRITER fixture halts cleanly on every lane
+    assert set(np.asarray(out.status).tolist()) == {1}  # Status.STOPPED
+    # track_coverage=False survived the split: no lane banked coverage
+    assert int(np.asarray(out.pc_seen).sum()) == 0
+    # unroll=2 survived the split: WRITER is 7 instructions, so the
+    # unrolled loop lands on 8 (7 with the default unroll=1)
+    assert int(steps) == 8
+
+
+def test_recursive_split_descends_with_kwargs_until_single_lane():
+    """Persistent faults keep splitting (8 -> 4 -> 2 -> 1) with the
+    kwargs intact at every rung, and only a single lane's failure
+    raises for the caller to degrade."""
+    batch, code = _demo()
+    with device_faults(times=999):
+        with pytest.raises(DeviceDispatchError):
+            run_resilient(
+                batch, code, max_steps=64, unroll=2,
+                track_coverage=False, retries=0,
+            )
+    counts = resilience.DegradationLog().counts
+    # one split per level of the 8-lane descent
+    assert counts.get("device-split-dispatch", 0) >= 3
+
+
+# -- embeddable signal handlers (ISSUE 2 satellite) -------------------------
+def test_supervisor_handler_chains_to_embedding_server():
+    """A server that installed its own drain handler BEFORE the
+    supervisor keeps receiving the signal: the supervisor's handler
+    sets the shutdown event and then chains."""
+    import os
+    import signal
+
+    import time
+
+    delivered = []
+
+    def embedder_handler(signum, frame):
+        delivered.append(signum)
+
+    previous = signal.signal(signal.SIGTERM, embedder_handler)
+    try:
+        with resilience.graceful_shutdown():
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(200):  # delivery is next-bytecode, not instant
+                if resilience.shutdown_requested():
+                    break
+                time.sleep(0.005)
+            assert resilience.shutdown_requested()
+            assert delivered == [signal.SIGTERM]
+        # exit restored the embedder's handler, not SIG_DFL
+        assert signal.getsignal(signal.SIGTERM) is embedder_handler
+        assert delivered == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_supervisor_install_is_idempotent_across_repeated_runs():
+    """Repeated supervised runs under an embedding server's handler:
+    every exit restores the embedder's handler, and the supervisor can
+    never save ITSELF as the previous handler (the clobbering leak the
+    satellite fixes)."""
+    import signal
+
+    def embedder(signum, frame):
+        pass
+
+    previous = signal.signal(signal.SIGTERM, embedder)
+    try:
+        for _ in range(3):
+            with resilience.graceful_shutdown():
+                assert (
+                    signal.getsignal(signal.SIGTERM)
+                    is resilience._supervisor_handler
+                )
+            assert signal.getsignal(signal.SIGTERM) is embedder
+        # even if the supervisor's handler is already installed when a
+        # scope enters, it must not become its own "previous"
+        signal.signal(signal.SIGTERM, resilience._supervisor_handler)
+        with resilience.graceful_shutdown():
+            pass
+        assert (
+            signal.getsignal(signal.SIGTERM)
+            is resilience._supervisor_handler
+        )
+        assert (
+            resilience._PREVIOUS_HANDLERS.get(signal.SIGTERM)
+            is not resilience._supervisor_handler
+        )
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_supervisor_exit_respects_midrun_reregistration():
+    """An embedder that re-registers its own handler DURING a
+    supervised run keeps it: exit only restores when the installed
+    handler is still the supervisor's."""
+    import signal
+
+    def late_embedder(signum, frame):
+        pass
+
+    original = signal.getsignal(signal.SIGTERM)
+    try:
+        with resilience.graceful_shutdown():
+            signal.signal(signal.SIGTERM, late_embedder)
+        assert signal.getsignal(signal.SIGTERM) is late_embedder
+    finally:
+        signal.signal(signal.SIGTERM, original)

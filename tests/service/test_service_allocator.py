@@ -1,0 +1,68 @@
+"""Lane-stripe allocator: the packing logic under the service's
+continuous batching (pure host-side bookkeeping, no device)."""
+
+import pytest
+
+from mythril_tpu.service.lane_allocator import LaneAllocator
+
+pytestmark = pytest.mark.service
+
+
+def test_allocate_release_roundtrip():
+    alloc = LaneAllocator(stripes=4, lanes_per_stripe=8)
+    a = alloc.allocate("job-a")
+    b = alloc.allocate("job-b", n_stripes=2)
+    assert len(a) == 1 and len(b) == 2
+    assert set(a).isdisjoint(b)
+    assert alloc.occupancy()["stripes_busy"] == 3
+    assert alloc.owner_of(a[0]) == "job-a"
+    alloc.release(a)
+    assert alloc.occupancy()["stripes_busy"] == 2
+    assert alloc.owner_of(a[0]) is None
+    # the freed stripe is reusable immediately — mid-run, not at drain
+    c = alloc.allocate("job-c", n_stripes=2)
+    assert c is not None and set(c).isdisjoint(b)
+
+
+def test_allocation_is_all_or_nothing():
+    alloc = LaneAllocator(stripes=2, lanes_per_stripe=4)
+    assert alloc.allocate("a") is not None
+    # two stripes wanted, one free: refuse outright (a partial grant
+    # would strand the job half-resident) and leave the free list alone
+    assert alloc.allocate("b", n_stripes=2) is None
+    assert alloc.occupancy()["stripes_busy"] == 1
+    assert alloc.allocate("c") is not None
+
+
+def test_oversized_request_is_an_error_not_a_wait():
+    alloc = LaneAllocator(stripes=2, lanes_per_stripe=4)
+    with pytest.raises(ValueError):
+        alloc.allocate("huge", n_stripes=3)
+
+
+def test_lane_math_and_stripes_needed():
+    alloc = LaneAllocator(stripes=3, lanes_per_stripe=8)
+    assert alloc.n_lanes == 24
+    assert alloc.lanes_of(1) == list(range(8, 16))
+    assert alloc.stripes_needed(1) == 1
+    assert alloc.stripes_needed(8) == 1
+    assert alloc.stripes_needed(9) == 2
+    assert alloc.stripes_needed(16) == 2
+
+
+def test_high_water_marks_track_coalescing():
+    alloc = LaneAllocator(stripes=4, lanes_per_stripe=8)
+    a = alloc.allocate("a")
+    b = alloc.allocate("b")
+    alloc.release(a)
+    alloc.release(b)
+    occ = alloc.occupancy()
+    # the /stats proof that two jobs shared the arena at once
+    assert occ["max_jobs_resident"] == 2
+    assert occ["max_lanes_busy"] == 16
+    assert occ["jobs_resident"] == 0
+
+
+def test_invalid_arena_shape_rejected():
+    with pytest.raises(ValueError):
+        LaneAllocator(stripes=0, lanes_per_stripe=8)

@@ -1,0 +1,76 @@
+"""Job model + bounded admission queue: the service's backpressure
+contract (429 on full, 503 on draining) without any device work."""
+
+import pytest
+
+from mythril_tpu.service.jobs import Job, JobQueue, JobState, QueueRefusal
+
+pytestmark = pytest.mark.service
+
+
+def test_job_normalizes_and_validates_code():
+    job = Job("0x33ff")
+    assert job.code == bytes.fromhex("33ff")
+    assert job.state == JobState.QUEUED
+    with pytest.raises(ValueError):
+        Job("0xzz")
+    with pytest.raises(ValueError):
+        Job("")
+
+
+def test_fifo_claim_and_unclaim():
+    queue = JobQueue(capacity=4)
+    first, second = Job("33ff"), Job("6001")
+    queue.submit(first)
+    queue.submit(second)
+    claimed = queue.claim(1)
+    assert claimed == [first] and first.state == JobState.RUNNING
+    # the arena couldn't fit it: back to the queue HEAD, still FIFO
+    queue.unclaim(first)
+    assert first.state == JobState.QUEUED
+    assert queue.claim(2) == [first, second]
+
+
+def test_full_queue_refuses_with_backpressure_reason():
+    queue = JobQueue(capacity=1)
+    queue.submit(Job("33ff"))
+    with pytest.raises(QueueRefusal) as refusal:
+        queue.submit(Job("6001"))
+    assert refusal.value.reason == "full"  # -> HTTP 429
+    assert queue.rejected_full == 1
+
+
+def test_draining_queue_refuses_and_hands_back_pending():
+    queue = JobQueue(capacity=4)
+    job = Job("33ff")
+    queue.submit(job)
+    remaining = queue.drain_remaining()
+    assert remaining == [job]
+    assert queue.depth() == 0
+    with pytest.raises(QueueRefusal) as refusal:
+        queue.submit(Job("6001"))
+    assert refusal.value.reason == "draining"  # -> HTTP 503
+
+
+def test_wait_terminal_long_poll():
+    queue = JobQueue()
+    job = Job("33ff")
+    queue.submit(job)
+    # not terminal yet: the wait times out and returns the live job
+    assert queue.wait_terminal(job.id, 0.05) is job
+    assert not job.terminal
+    queue.settle(job, JobState.DONE)
+    settled = queue.wait_terminal(job.id, 0.05)
+    assert settled.terminal and settled.state == JobState.DONE
+    assert queue.wait_terminal("0" * 12, 0.01) is None
+
+
+def test_job_dict_shape():
+    job = Job("33ff", deadline_s=30.0)
+    out = job.as_dict()
+    assert out["state"] == "queued"
+    assert out["code_len"] == 2
+    assert "report" not in out
+    job.report = {"issues": []}
+    assert Job("33ff").deadline is None
+    assert job.as_dict()["report"] == {"issues": []}

@@ -1,0 +1,195 @@
+"""End-to-end service tests: a real AnalysisServer on 127.0.0.1, a
+real HTTP client, CPU JAX.
+
+One module-scoped server (one fixed arena shape) so the whole suite
+pays at most one kernel compile; the drain/backpressure tests use
+engine-less servers (start_engine=False) that never dispatch a wave.
+CPU-only and sized to stay well under a minute warm."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.batch.checkpoint import (
+    checkpoint_shape,
+    load_checkpoint,
+)
+from mythril_tpu.service.client import ServiceClient, ServiceError
+from mythril_tpu.service.engine import ServiceConfig
+from mythril_tpu.service.server import AnalysisServer
+
+pytestmark = pytest.mark.service
+
+#: PUSH1 1 PUSH1 0 SSTORE PUSH1 0 PUSH1 1 SSTORE STOP
+WRITER = "6001600055600060015500"
+#: CALLER SELFDESTRUCT — banks a selfdestruct trigger in one wave
+KILLABLE = "33ff"
+#: CALLDATALOAD(0) branches to a storage write — one coverable JUMPI
+BRANCHER = "600035600757005b600160005500"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    steps_per_wave=64,
+    max_waves=2,
+    queue_capacity=8,
+    host_walk=False,  # device-only by default; one test opts in
+    execution_timeout=5,
+    coalesce_wait_s=0.15,
+    idle_wait_s=0.02,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = AnalysisServer(ServiceConfig(**CFG)).start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return ServiceClient(server.url)
+
+
+def test_healthz_and_stats_shape(server, client):
+    health = client.healthz()
+    assert health["ok"] is True and health["draining"] is False
+    stats = client.stats()
+    assert stats["queue"]["capacity"] == 8
+    assert stats["arena"]["lanes"] == 8
+    assert {"count", "rate_per_s", "steps_per_wave"} <= set(stats["waves"])
+    assert "degradation" in stats
+
+
+def test_concurrent_jobs_coalesce_into_shared_waves(server, client):
+    """Two concurrent submissions must share waves (lane occupancy > 1
+    contract) and both reports must arrive — the continuous-batching
+    acceptance signal."""
+    ids = []
+    submit = lambda code: ids.append(client.submit(code))  # noqa: E731
+    threads = [
+        threading.Thread(target=submit, args=(code,))
+        for code in (WRITER, BRANCHER)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 2
+    reports = [client.report(job_id, wait_s=90.0) for job_id in ids]
+    for job in reports:
+        assert job["state"] == "done", job
+        assert job["report"]["device"]["waves"] == 2
+        assert job["report"]["device"]["lane_steps"] > 0
+    stats = client.stats()
+    assert stats["arena"]["max_jobs_resident"] >= 2
+    assert stats["waves"]["count"] >= 2
+    # the branching contract's waves covered at least one direction
+    brancher = reports[1]["report"]
+    assert brancher["device"]["covered_branches"] >= 1
+
+
+def test_trigger_witness_reaches_the_report(server, client):
+    job_id = client.submit(KILLABLE)
+    job = client.report(job_id, wait_s=90.0)
+    assert job["state"] == "done"
+    assert job["report"]["device"]["triggers"].get("selfdestruct", 0) >= 1
+
+
+def test_code_cache_warms_on_resubmission(server, client):
+    before = client.stats()["warm"]["code_cache"]["hits"]
+    job_id = client.submit(KILLABLE)  # same hash as the previous test
+    assert client.report(job_id, wait_s=90.0)["state"] == "done"
+    assert client.stats()["warm"]["code_cache"]["hits"] > before
+
+
+def test_per_job_deadline_degrades_not_crashes(server, client):
+    """An already-expired per-request deadline: the job still completes
+    (device phase bounded at the wave boundary) with the degradation
+    recorded — resource exhaustion is an outcome, not a crash."""
+    job_id = client.submit(WRITER, deadline_s=0.0)
+    job = client.report(job_id, wait_s=90.0)
+    assert job["state"] == "done"
+    assert "deadline-expired" in job["report"].get("degraded", [])
+    assert job["report"]["device"]["waves"] == 1  # cut at the boundary
+
+
+def test_host_walk_overlaps_and_reports_issues(server, client):
+    """One job opts into the host walk: the device outcome is injected
+    into the pooled-mode worker and the report carries host results."""
+    job_id = client.submit(KILLABLE, host_walk=True)
+    job = client.report(job_id, wait_s=120.0)
+    assert job["state"] == "done", job
+    assert "host" in job["report"]
+    assert job["report"]["host"]["error"] is None
+    assert isinstance(job["report"]["issues"], list)
+
+
+def test_bad_requests_are_400_not_500(server, client):
+    with pytest.raises(ServiceError) as bad:
+        client.submit("0xzz")
+    assert bad.value.status == 400
+    with pytest.raises(ServiceError) as missing:
+        client.job("f" * 12)
+    assert missing.value.status == 404
+
+
+def test_queue_full_answers_429():
+    srv = AnalysisServer(
+        ServiceConfig(**dict(CFG, queue_capacity=1)), start_engine=False
+    ).start()
+    try:
+        client = ServiceClient(srv.url)
+        client.submit(WRITER)
+        with pytest.raises(ServiceError) as refusal:
+            client.submit(KILLABLE)
+        assert refusal.value.status == 429
+        assert client.stats()["queue"]["rejected_full"] == 1
+    finally:
+        srv.close()
+
+
+def test_drain_checkpoints_every_accepted_job(tmp_path):
+    """The SIGTERM contract: accepted-but-unfinished jobs end up
+    CHECKPOINTED with a replayable npz (correct shape metadata), and a
+    draining server answers 503."""
+    srv = AnalysisServer(
+        ServiceConfig(**dict(CFG, checkpoint_dir=str(tmp_path))),
+        start_engine=False,  # jobs stay queued: the pure drain path
+    ).start()
+    client = ServiceClient(srv.url)
+    ids = [client.submit(WRITER), client.submit(BRANCHER)]
+    srv.engine.drain()
+    try:
+        for job_id in ids:
+            job = client.job(job_id)
+            assert job["state"] == "checkpointed", job
+            path = job["checkpoint"]
+            # the npz is a real, replayable frontier: it loads, carries
+            # its code table, and its shape metadata says what arena
+            # wrote it
+            batch, code, step = load_checkpoint(path)
+            assert code is not None and step == CFG["steps_per_wave"]
+            shape = checkpoint_shape(path)
+            assert shape["lanes"] == CFG["lanes_per_stripe"]
+            assert shape["code_rows"] == 1
+            assert int(np.asarray(batch.calldatasize).max()) > 0  # seeded
+            # a mismatched arena refuses it instead of resharding junk
+            with pytest.raises(ValueError, match="arena shape"):
+                load_checkpoint(path, expect_shape={"lanes": 512})
+        with pytest.raises(ServiceError) as refusal:
+            client.submit(KILLABLE)
+        assert refusal.value.status == 503
+        assert client.healthz()["draining"] is True
+    finally:
+        srv.close()
+
+
+def test_drain_is_idempotent_and_close_safe():
+    srv = AnalysisServer(ServiceConfig(**CFG), start_engine=False).start()
+    srv.engine.drain()
+    srv.engine.drain()  # second drain returns immediately
+    srv.close()
+    srv.close()  # close after drain is a no-op

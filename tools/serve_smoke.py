@@ -1,0 +1,173 @@
+"""End-to-end smoke for the persistent analysis service (`myth serve`).
+
+Spins the server up in-process on CPU JAX, then checks the three
+service contracts the ISSUE pins:
+
+1. **Amortization** — the first (cold) request pays the XLA kernel
+   compile; concurrent warm requests ride the compiled kernel, so the
+   warm p50 submit->report latency must beat the cold first request.
+2. **Continuous batching** — four concurrent submissions coalesce into
+   shared waves: /stats must show more than one contract resident in
+   the arena at once.
+3. **Drain** — SIGTERM loses zero accepted jobs: every job is either
+   completed or checkpointed with a replayable npz (shape metadata
+   verified via load_checkpoint).
+
+Usage:
+    python tools/serve_smoke.py            # 4 testdata contracts
+    python tools/serve_smoke.py --waves 3
+
+Exits 0 on success; prints the failing assertion and exits 1 otherwise.
+Wall cost is dominated by the one cold kernel compile (seconds to tens
+of seconds on a cold XLA cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+FIXTURES = (
+    "suicide.sol.o",
+    "returnvalue.sol.o",
+    "origin.sol.o",
+    "nonascii.sol.o",
+)
+
+
+def load_fixtures() -> list:
+    root = Path(__file__).resolve().parent.parent
+    inputs = root / "tests" / "testdata" / "vendored" / "inputs"
+    codes = []
+    for name in FIXTURES:
+        text = (inputs / name).read_text().strip()
+        codes.append(text[2:] if text.startswith("0x") else text)
+    return codes
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--waves", type=int, default=2,
+                        help="device waves per job (default 2)")
+    parser.add_argument("--steps-per-wave", type=int, default=256)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from mythril_tpu.laser.batch.checkpoint import (
+        checkpoint_shape,
+        load_checkpoint,
+    )
+    from mythril_tpu.service.client import ServiceClient
+    from mythril_tpu.service.engine import ServiceConfig
+    from mythril_tpu.service.server import AnalysisServer
+
+    codes = load_fixtures()
+    config = ServiceConfig(
+        stripes=4,
+        lanes_per_stripe=8,
+        steps_per_wave=args.steps_per_wave,
+        max_waves=args.waves,
+        host_walk=False,  # the smoke measures the service path itself
+        coalesce_wait_s=0.1,
+    )
+    server = AnalysisServer(config).start()
+    server.install_signal_handlers()  # the SIGTERM drain under test
+    client = ServiceClient(server.url)
+    t_start = time.monotonic()
+
+    # -- 1. cold request: pays the kernel compile ----------------------
+    t0 = time.monotonic()
+    cold_id = client.submit(codes[0])
+    cold_job = client.report(cold_id, wait_s=300.0)
+    cold_s = time.monotonic() - t0
+
+    # -- 2. four concurrent warm requests ------------------------------
+    warm: dict = {}
+
+    def one(code: str) -> None:
+        t = time.monotonic()
+        job_id = client.submit(code)
+        report = client.report(job_id, wait_s=120.0)
+        warm[job_id] = (time.monotonic() - t, report)
+
+    threads = [threading.Thread(target=one, args=(c,)) for c in codes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = client.stats()
+    warm_latencies = sorted(lat for lat, _ in warm.values())
+    warm_p50 = statistics.median(warm_latencies)
+
+    # -- 3. SIGTERM drain with work still in the pipe -------------------
+    drain_ids = [client.submit(code) for code in codes[:2]]
+    os.kill(os.getpid(), signal.SIGTERM)
+    drained = server.drained(timeout_s=180.0)
+
+    summary = {
+        "cold_s": round(cold_s, 3),
+        "warm_p50_s": round(warm_p50, 3),
+        "warm_latencies_s": [round(x, 3) for x in warm_latencies],
+        "max_jobs_resident": stats["arena"]["max_jobs_resident"],
+        "waves": stats["waves"],
+        "drain": {},
+    }
+    try:
+        assert cold_job["state"] == "done", f"cold job: {cold_job}"
+        assert len(warm) == 4, f"expected 4 warm reports, got {len(warm)}"
+        for job_id, (_, report) in warm.items():
+            assert report["state"] == "done", f"{job_id}: {report}"
+            assert report["report"]["device"]["waves"] >= 1
+        assert stats["arena"]["max_jobs_resident"] > 1, (
+            "concurrent jobs never shared a wave: "
+            f"max_jobs_resident={stats['arena']['max_jobs_resident']}"
+        )
+        assert warm_p50 < cold_s, (
+            f"warm p50 {warm_p50:.3f}s did not beat the cold request "
+            f"{cold_s:.3f}s — the warm arena isn't amortizing"
+        )
+        assert drained, "drain did not complete"
+        for job_id in drain_ids:
+            job = server.engine.queue.get(job_id)
+            assert job is not None, f"accepted job {job_id} vanished"
+            state = job.state
+            summary["drain"][job_id] = state
+            assert state in ("done", "checkpointed"), (
+                f"job {job_id} lost by the drain: state={state}"
+            )
+            if state == "checkpointed":
+                path = job.checkpoint_path
+                assert path and os.path.exists(path), path
+                batch, code_table, step = load_checkpoint(path)
+                assert code_table is not None and step > 0
+                shape = checkpoint_shape(path)
+                assert shape["lanes"] == batch.n_lanes
+    except AssertionError as why:
+        print(f"smoke FAILED after {time.monotonic() - t_start:.1f}s: {why}",
+              file=sys.stderr)
+        print(json.dumps(summary, indent=2), file=sys.stderr)
+        return 1
+
+    print(
+        f"smoke OK in {time.monotonic() - t_start:.1f}s: cold "
+        f"{cold_s:.2f}s, warm p50 {warm_p50:.3f}s, "
+        f"{summary['max_jobs_resident']} contracts shared the arena, "
+        f"drain kept all accepted jobs ({summary['drain']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
