@@ -192,6 +192,33 @@ def bench_transitions() -> dict:
     return out
 
 
+def bench_static_prune() -> dict:
+    """The static layer (analysis/static) over the benchmark corpus:
+    pure host work, no device — measures what fraction of the corpus's
+    statically-decidable units (branch directions, dispatcher
+    selectors, basic blocks) the pre-dispatch pass proves dead, i.e.
+    lanes/flips/modules the arena never wastes. Runs first: it is
+    milliseconds and must not be skippable by budget exhaustion."""
+    from mythril_tpu.analysis.corpusgen import synth_bench_corpus
+    from mythril_tpu.analysis.static import summary_for
+
+    contracts = synth_bench_corpus(CONV_CONTRACTS)
+    t0 = time.perf_counter()
+    pruned = total = dead_selectors = dead_directions = 0
+    for code, _creation, _name in contracts:
+        summary = summary_for(code)
+        pruned += summary.prune_units
+        total += summary.total_units
+        dead_selectors += len(summary.dead_selectors)
+        dead_directions += len(summary.prune_directions())
+    return {
+        "static_prune_rate": round(pruned / total, 4) if total else 0.0,
+        "static_dead_selectors": dead_selectors,
+        "static_dead_directions": dead_directions,
+        "static_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 class _Deadline(Exception):
     pass
 
@@ -577,6 +604,13 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
 
 
 def main(final_attempt: bool = False) -> None:
+    static = {}
+    try:
+        static = bench_static_prune()
+        print(f"bench: static prune {static}", file=sys.stderr)
+    except Exception as e:
+        print(f"bench: static-prune half failed: {e!r}", file=sys.stderr)
+        static = {"static_prune_rate": None}
     dev = {}
     try:
         dev = _with_deadline(
@@ -665,6 +699,7 @@ def main(final_attempt: bool = False) -> None:
     ):
         if k in dev:
             record[k] = dev[k]
+    record.update(static)
     record.update(corpus)
     record.update(default_path)
     record.update(hard)

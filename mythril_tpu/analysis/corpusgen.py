@@ -330,23 +330,70 @@ def bec_contract(seed: int = 0) -> str:
     return bytes(code).hex()
 
 
+def deadweight_contract(seed: int = 0) -> str:
+    """A runtime full of statically-resolvable waste — the shape the
+    static layer (analysis/static) exists to keep off the arena:
+
+    - a constant-true guard (`PUSH1 1; PUSH1 t; JUMPI`) whose
+      fall-through is a dead island (const-foldable dead direction +
+      unreachable code);
+    - a dispatcher with a LIVE function (SSTORE + a guarded INVALID,
+      so the contract keeps a detectable SWC-110) and a DEAD function
+      (`JUMPDEST PUSH1 0 DUP1 REVERT` — the classic inert revert
+      body) whose seeds/flips static pruning drops.
+
+    With pruning on and off, the ISSUE set is identical by
+    construction — only the wasted lanes differ."""
+    dead_fn, live_fn = 35, 40
+    fail_at = 56
+    live_sel = (0xFEEDC0DE + seed) & 0xFFFFFFFF
+    dead_sel = (0xDEADD00D + seed * 7) & 0xFFFFFFFF
+    code = bytearray(
+        [
+            0x60, 0x01, 0x60, 0x07, 0x57,  # PUSH1 1; PUSH1 7; JUMPI
+            0x00, 0xFE,                    # dead island
+            0x5B,                          # 7: JUMPDEST
+            0x60, 0x00, 0x35,              # CALLDATALOAD(0)
+            0x60, 0xE0, 0x1C,              # >> 224 -> selector
+            0x80, 0x63,                    # DUP1; PUSH4
+        ]
+    )
+    code += live_sel.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, live_fn, 0x57])  # EQ; PUSH1 live; JUMPI
+    code += bytes([0x80, 0x63]) + dead_sel.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, dead_fn, 0x57])  # EQ; PUSH1 dead; JUMPI
+    code += bytes([0x00])  # STOP (no match)
+    assert len(code) == dead_fn
+    code += bytes([0x5B, 0x60, 0x00, 0x80, 0xFD])  # dead: revert(0,0)
+    assert len(code) == live_fn
+    code += bytes([0x5B, 0x60, 0x01, 0x60, 0x00, 0x55])  # sstore(0,1)
+    code += bytes([0x60, 0x04, 0x35])  # CALLDATALOAD(4)
+    code += bytes([0x60, 0xAA + (seed % 16), 0x14])  # == magic?
+    code += bytes([0x60, fail_at, 0x57, 0x00])  # JUMPI fail; STOP
+    assert len(code) == fail_at
+    code += bytes([0x5B, 0xFE])  # fail: JUMPDEST; INVALID (SWC-110)
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,
     loops: int = 4,
     degraders: int = 4,
     wides: int = 6,
+    deadweights: int = 2,
     inputs: Optional[Path] = None,
 ) -> List[Tuple[str, str, str]]:
     """The round-5 benchmark corpus: fixture constant-mutants plus
-    hand-assembled deep-loop, cap-degrading, and wide-branching
-    shapes, so the A/B exercises bounded loops, device
-    degradation/takeover, the ownership gate, and the breadth regime
-    (sequential walk exponential vs device branch-coverage closure) in
-    one measured run."""
+    hand-assembled deep-loop, cap-degrading, wide-branching, and
+    static-deadweight shapes, so the A/B exercises bounded loops,
+    device degradation/takeover, the ownership gate, the breadth
+    regime (sequential walk exponential vs device branch-coverage
+    closure), and the static prune layer in one measured run."""
     rng = random.Random(seed)
     corpus = synth_corpus(
-        max(0, n_contracts - loops - degraders - wides), seed=seed,
+        max(0, n_contracts - loops - degraders - wides - deadweights),
+        seed=seed,
         inputs=inputs,
     )
     for k in range(loops):
@@ -357,6 +404,8 @@ def synth_bench_corpus(
         corpus.append((degrader_contract(at), "", f"degrader#{k}"))
     for k in range(wides):
         corpus.append((wide_contract(6 + (k % 3), seed=k), "", f"wide#{k}"))
+    for k in range(deadweights):
+        corpus.append((deadweight_contract(seed=k), "", f"deadweight#{k}"))
     rng.shuffle(corpus)
     return corpus[:n_contracts]
 

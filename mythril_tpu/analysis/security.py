@@ -33,12 +33,22 @@ def retrieve_callback_issues(white_list: Optional[List[str]] = None) -> List[Iss
 def fire_lasers(statespace, white_list: Optional[List[str]] = None) -> List[Issue]:
     """Run POST modules over the statespace and collect all issues,
     merging in the concrete witnesses the device prepass banked
-    (analysis/prepass.py) for locations the host walk did not reach."""
+    (analysis/prepass.py) for locations the host walk did not reach.
+
+    The static pre-screen (analysis/static, computed by
+    SymExecWrapper) filters modules whose opcode signature cannot fire
+    on the analyzed code — they neither mounted hooks nor run their
+    POST pass. White-list validation still happens first, so an
+    invalid -m name errors regardless of the screen."""
     log.info("Starting analysis")
+    screen = getattr(statespace, "static_screen", None)
     issues: List[Issue] = []
     for module in ModuleLoader().get_detection_modules(
         entry_point=EntryPoint.POST, white_list=white_list
     ):
+        if screen is not None and type(module).__name__ not in screen:
+            log.debug("Static pre-screen skipped %s", module.name)
+            continue
         log.info("Executing %s", module.name)
         issues += module.execute(statespace)
     issues += retrieve_callback_issues(white_list)

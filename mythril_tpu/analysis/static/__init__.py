@@ -1,0 +1,71 @@
+"""Host-side static bytecode analysis (the pre-dispatch layer).
+
+Four cooperating analyses over one shared IR (the disassembler's
+instruction list), run once per code hash BEFORE any arena lane is
+seeded or any detection module is mounted:
+
+1. **CFG recovery** (`cfg.py`) — basic blocks + peephole PUSH-const
+   jump-target resolution over `disassembler/asm.py` instructions.
+   Distinct from the symbolic `laser/ethereum/cfg.py`: that graph is
+   built DURING host execution; this one exists before anything runs.
+2. **Dataflow** (`dataflow.py`) — abstract stack-height + constant
+   lattice worklist over the blocks: resolves computed jumps whose
+   targets are stack constants, flags definite stack-underflow and
+   const-invalid-jumpdest blocks, and constant-folds JUMPI conditions
+   into statically-dead branch directions.
+3. **Detector pre-screen** (`screen.py`) — per-module opcode/feature
+   signatures over the reachable instruction set, so
+   `analysis/security.py` loads only modules that can possibly fire
+   on this contract.
+4. **Prune feed** (`summary.py` StaticSummary) — consumed by
+   `laser/batch/seeds.py` (dispatcher seeds for statically-inert
+   functions are dropped) and `laser/batch/explore.py` (dead branch
+   directions never enter the flip frontier).
+
+The whole pass is pure host work (no jax, no device): `myth lint`
+runs it standalone, `myth analyze`/`myth serve` run it as an always-on
+prepass, and the service engine caches summaries by code hash in its
+existing LRU beside the dense disassembly rows.
+
+Manticore (arxiv 1907.03890) fronts symbolic exploration with exactly
+this kind of CFG recovery; the Blockchain Superoptimizer (arxiv
+2005.05912) shows how far pure constant propagation over EVM stack
+code reaches without a solver — this layer is the batched-arena
+adaptation of both.
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.analysis.static.cfg import BasicBlock, recover_cfg
+from mythril_tpu.analysis.static.screen import (
+    MODULE_SIGNATURES,
+    screen_modules,
+)
+from mythril_tpu.analysis.static.summary import (
+    StaticSummary,
+    analyze_bytecode,
+    clear_static_cache,
+    static_cache_stats,
+    summary_for,
+)
+
+
+def static_prune_enabled() -> bool:
+    """One switch for every consumer (CLI --no-static-prune)."""
+    from mythril_tpu.support.support_args import args
+
+    return bool(getattr(args, "static_prune", True))
+
+
+__all__ = [
+    "BasicBlock",
+    "MODULE_SIGNATURES",
+    "StaticSummary",
+    "analyze_bytecode",
+    "clear_static_cache",
+    "recover_cfg",
+    "screen_modules",
+    "static_cache_stats",
+    "static_prune_enabled",
+    "summary_for",
+]

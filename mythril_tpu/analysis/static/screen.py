@@ -1,0 +1,85 @@
+"""Detector pre-screen: per-module opcode/feature signatures.
+
+Each detection module can only ever fire if certain opcodes exist in
+the analyzed code (a module that reports unchecked CALL return values
+is inert on a contract with no CALL-family opcode). The signature is a
+conjunction of disjunctions over opcode names: the module applies iff
+EVERY group has at least one member present in the feature set.
+
+The feature set is the opcode names of the (conservatively) reachable
+instructions — an unresolved computed jump makes every JUMPDEST block
+reachable, and on any dataflow bail the whole instruction stream
+counts — so screening a module out is sound: no execution of this
+code can reach an opcode the screen says is absent.
+
+Skipping a module buys two things per contract: its opcode hooks are
+never mounted (the svm's hook dispatch runs per executed instruction)
+and its POST pass never scans the statespace.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+CALL_FAMILY = ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL")
+
+#: module class name -> conjunction of opcode-name disjunctions.
+#: A module absent from this table is never screened (always loaded).
+MODULE_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], ...]] = {
+    # jump-target hijack needs a jump
+    "ArbitraryJump": (("JUMP", "JUMPI"),),
+    # arbitrary storage write needs a store
+    "ArbitraryStorage": (("SSTORE",),),
+    "ArbitraryDelegateCall": (("DELEGATECALL",),),
+    "TxOrigin": (("ORIGIN",),),
+    "PredictableVariables": (
+        ("BLOCKHASH", "COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER"),
+    ),
+    # its post hooks (ether_thief.py)
+    "EtherThief": (("CALL", "STATICCALL"),),
+    "Exceptions": (("ASSERT_FAIL",),),
+    "ExternalCalls": (("CALL",),),
+    "IntegerArithmetics": (("ADD", "SUB", "MUL", "EXP"),),
+    "MultipleSends": (CALL_FAMILY,),
+    # needs an external call AND a state access after it
+    "StateChangeAfterCall": (
+        ("CALL", "DELEGATECALL", "CALLCODE"),
+        ("SSTORE", "SLOAD", "CREATE", "CREATE2"),
+    ),
+    "AccidentallyKillable": (("SUICIDE",),),
+    "UncheckedRetval": (CALL_FAMILY,),
+    # solc assertion markers ride LOG1 (event) or MSTORE (panic word);
+    # MSTORE is near-ubiquitous, so this screen rarely fires — kept
+    # for raw runtime bodies that touch no memory at all
+    "UserAssertions": (("LOG1", "MSTORE"),),
+}
+
+
+def module_applicable(module_name: str, features: Set[str]) -> bool:
+    signature = MODULE_SIGNATURES.get(module_name)
+    if signature is None:
+        return True
+    return all(any(op in features for op in group) for group in signature)
+
+
+def screen_modules(
+    features: Iterable[str],
+    module_names: Iterable[str] = None,
+) -> Tuple[List[str], List[str]]:
+    """(applicable, skipped) module class names for a feature set.
+
+    `module_names` defaults to every registered detection module."""
+    feature_set = set(features)
+    if module_names is None:
+        from mythril_tpu.analysis.module import ModuleLoader
+
+        module_names = [
+            type(module).__name__
+            for module in ModuleLoader().get_detection_modules()
+        ]
+    applicable, skipped = [], []
+    for name in module_names:
+        (applicable if module_applicable(name, feature_set) else skipped).append(
+            name
+        )
+    return applicable, skipped

@@ -1,0 +1,436 @@
+"""StaticSummary: the per-code-hash product of the static layer.
+
+Built once per code hash (module-level LRU; the service engine
+additionally caches summaries in its own code LRU) and consumed by:
+
+- `laser/batch/seeds.py` — `dead_selectors` drops dispatcher seeds
+  for statically-inert functions (logged at DEBUG, counted);
+- `laser/batch/explore.py` — `prune_directions()` keeps dead branch
+  directions out of the flip frontier;
+- `analysis/symbolic.py` / `analysis/security.py` — `features` feeds
+  the detector pre-screen;
+- `myth lint` / `tools/lint_smoke.py` — `lint_dict()` renders the
+  pure static findings + CFG/prune stats.
+
+Soundness contract (the differential acceptance): nothing pruned here
+may change the ISSUE set. Dead directions come from constant branch
+conditions (the pruned flip would be UNSAT — no witness exists). Dead
+selectors are functions whose whole resolved subgraph is *inert*: no
+opcode any detector, trigger bank, or evidence bank observes, no
+possible stack underflow, no unresolved jump, and only
+bounded-operand REVERT/RETURN or STOP terminals — seeding or flipping
+into them can only ever produce a clean, write-free halt.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+from mythril_tpu.analysis.static.cfg import CFG, recover_cfg
+from mythril_tpu.analysis.static.dataflow import DataflowResult, run_dataflow
+from mythril_tpu.analysis.static.screen import screen_modules
+
+log = logging.getLogger(__name__)
+
+#: opcodes an inert (prunable) subgraph may contain: pure stack/data
+#: shuffling plus control flow. Anything a detection module hooks, the
+#: device evidence bank records (arith wraps, storage access, calls,
+#: env reads), or that can degrade a lane (unbounded memory growth)
+#: disqualifies the subgraph.
+INERT_OPS = frozenset(
+    ["POP", "JUMPDEST", "JUMP", "JUMPI", "STOP", "REVERT", "RETURN",
+     "CALLDATALOAD", "CALLDATASIZE", "CALLVALUE", "CODESIZE", "PC", "GAS",
+     "ISZERO", "EQ", "LT", "GT", "SLT", "SGT", "AND", "OR", "XOR", "NOT",
+     "BYTE", "SHL", "SHR", "SAR"]
+    + [f"PUSH{n}" for n in range(1, 33)]
+    + [f"DUP{n}" for n in range(1, 17)]
+    + [f"SWAP{n}" for n in range(1, 17)]
+)
+#: inert-subgraph size bound: bigger bodies are kept explorable
+INERT_MAX_BLOCKS = 24
+
+
+class DispatcherEntry:
+    """One recovered dispatcher row: PUSH4 sel; EQ; [ISZERO...]
+    PUSH target; JUMPI."""
+
+    __slots__ = ("selector", "jumpi_pc", "entry_pc", "entry_taken")
+
+    def __init__(
+        self, selector: bytes, jumpi_pc: int, entry_pc: int, entry_taken: bool
+    ) -> None:
+        self.selector = selector
+        self.jumpi_pc = jumpi_pc
+        self.entry_pc = entry_pc
+        #: the JUMPI direction that ENTERS the function body (False
+        #: when an ISZERO inverted the compare and the body is the
+        #: fall-through)
+        self.entry_taken = entry_taken
+
+
+class StaticSummary:
+    """Everything the static pass established about one bytecode."""
+
+    def __init__(self, code: bytes) -> None:
+        t0 = time.perf_counter()
+        self.code_hash = "0x" + hashlib.sha256(code).hexdigest()
+        self.code_len = len(code)
+        self.cfg: CFG = recover_cfg(code)
+        self.flow: DataflowResult = run_dataflow(self.cfg)
+        self.incomplete = self.flow.incomplete
+
+        self.n_instructions = len(self.cfg.instructions)
+        self.n_blocks = len(self.cfg.blocks)
+        self.n_jumpis = sum(
+            1 for b in self.cfg.blocks.values() if b.terminator == "JUMPI"
+        )
+        self.reachable_blocks: Set[int] = set(self.flow.reachable)
+        self.dead_blocks: Set[int] = (
+            set(self.cfg.blocks) - self.reachable_blocks
+        )
+        self.dead_instructions = sum(
+            len(self.cfg.blocks[s]) for s in self.dead_blocks
+        )
+        #: branch directions proven infeasible by constant folding
+        self.dead_directions: Set[Tuple[int, bool]] = set(
+            self.flow.dead_directions
+        )
+
+        self.features: Set[str] = self._feature_set()
+        self.dispatcher: List[DispatcherEntry] = self._recover_dispatcher()
+        self.dead_selectors: Set[bytes] = set()
+        #: dispatcher directions entering inert functions — pruned
+        #: from the flip frontier alongside the infeasible directions
+        self.inert_directions: Set[Tuple[int, bool]] = set()
+        self._classify_dead_selectors()
+
+        #: mutable prune observability (seeds.py increments)
+        self.seeds_dropped = 0
+        self.wall_ms = round((time.perf_counter() - t0) * 1e3, 3)
+
+    # -- derived feeds --------------------------------------------------
+    def prune_directions(self) -> Set[Tuple[int, bool]]:
+        """(jumpi_pc, taken) directions the explorer must never spend
+        a flip on: infeasible (constant condition) plus inert
+        (dispatcher entry of a statically-dead function)."""
+        return self.dead_directions | self.inert_directions
+
+    def applicable_modules(self) -> Tuple[List[str], List[str]]:
+        """(applicable, skipped) detection-module class names."""
+        return screen_modules(self.features)
+
+    @property
+    def prune_units(self) -> int:
+        return (
+            len(self.dead_directions)
+            + len(self.inert_directions)
+            + len(self.dead_selectors)
+            + len(self.dead_blocks)
+        )
+
+    @property
+    def total_units(self) -> int:
+        return 2 * self.n_jumpis + len(self.dispatcher) + self.n_blocks
+
+    @property
+    def prune_rate(self) -> float:
+        total = self.total_units
+        return round(self.prune_units / total, 4) if total else 0.0
+
+    # -- construction helpers -------------------------------------------
+    def _feature_set(self) -> Set[str]:
+        if self.incomplete:
+            # conservative: the whole instruction stream counts
+            return {ins.opcode for ins in self.cfg.instructions}
+        return {
+            ins.opcode
+            for start in self.reachable_blocks
+            for ins in self.cfg.blocks[start].instructions
+        }
+
+    def _recover_dispatcher(self) -> List[DispatcherEntry]:
+        """The Solidity selector-compare idiom, inversion-aware."""
+        out: List[DispatcherEntry] = []
+        instructions = self.cfg.instructions
+        for i, ins in enumerate(instructions):
+            if ins.opcode != "PUSH4" or not ins.argument:
+                continue
+            if i + 1 >= len(instructions) or instructions[i + 1].opcode != "EQ":
+                continue
+            inverted = False
+            target_pc = None
+            jumpi_pc = None
+            for j in range(i + 2, min(i + 6, len(instructions))):
+                op = instructions[j].opcode
+                if op == "ISZERO":
+                    inverted = not inverted
+                elif op.startswith("PUSH"):
+                    if (
+                        j + 1 < len(instructions)
+                        and instructions[j + 1].opcode == "JUMPI"
+                    ):
+                        target_pc = int(instructions[j].argument, 16)
+                        jumpi_pc = instructions[j + 1].address
+                    break
+                else:
+                    break
+            if jumpi_pc is None or target_pc is None:
+                continue
+            selector = bytes.fromhex(ins.argument[2:].rjust(8, "0"))
+            if inverted:
+                # JUMPI skips PAST the body on mismatch: the function
+                # entry is the fall-through
+                nxt = self.cfg.block_after(
+                    self.cfg.blocks[
+                        max(
+                            s
+                            for s in self.cfg.starts
+                            if s <= jumpi_pc
+                        )
+                    ].start
+                )
+                if nxt is None:
+                    continue
+                out.append(DispatcherEntry(selector, jumpi_pc, nxt.start, False))
+            else:
+                out.append(DispatcherEntry(selector, jumpi_pc, target_pc, True))
+        return out
+
+    def _classify_dead_selectors(self) -> None:
+        if self.incomplete:
+            return
+        for entry in self.dispatcher:
+            if self._subgraph_inert(entry.entry_pc):
+                self.dead_selectors.add(entry.selector)
+                self.inert_directions.add((entry.jumpi_pc, entry.entry_taken))
+
+    def _subgraph_inert(self, entry_pc: int) -> bool:
+        """True when every path from `entry_pc` over resolved edges is
+        observable-effect-free (see module docstring)."""
+        if entry_pc not in self.cfg.blocks:
+            return False
+        seen: Set[int] = set()
+        work = [entry_pc]
+        while work:
+            start = work.pop()
+            if start in seen:
+                continue
+            seen.add(start)
+            if len(seen) > INERT_MAX_BLOCKS:
+                return False
+            block = self.cfg.blocks[start]
+            if (
+                start in self.flow.underflow_blocks
+                or start in self.flow.possible_underflow_blocks
+            ):
+                return False
+            for ins in block.instructions:
+                if ins.opcode not in INERT_OPS:
+                    return False
+            terminator = block.terminator
+            if terminator in ("REVERT", "RETURN"):
+                if not self._halt_args_bounded(block):
+                    return False
+                continue
+            if terminator == "STOP":
+                continue
+            if terminator in ("JUMP", "JUMPI"):
+                pc = block.end
+                if pc in self.flow.unresolved_jumps or pc in self.flow.invalid_jumps:
+                    return False
+                target = self.flow.resolved_jumps.get(pc)
+                if target is None:
+                    # block unreachable at fixpoint (no recorded jump
+                    # facts): treat as not provably inert
+                    return False
+                dead = {
+                    d for p, d in self.dead_directions if p == pc
+                }
+                if not (terminator == "JUMPI" and True in dead):
+                    work.append(target)
+                if terminator == "JUMPI" and False not in dead:
+                    nxt = self.cfg.block_after(start)
+                    if nxt is None:
+                        return False
+                    work.append(nxt.start)
+                continue
+            if terminator == "FALL":
+                nxt = self.cfg.block_after(start)
+                if nxt is None:
+                    return False
+                work.append(nxt.start)
+                continue
+            return False  # ASSERT_FAIL / SUICIDE / INVALID / unknown
+        return True
+
+    def _halt_args_bounded(self, block) -> bool:
+        """REVERT/RETURN operands must be small constants (or DUPed
+        zeros) so the halt cannot expand memory into a degraded lane —
+        the `PUSH1 0 DUP1 REVERT` compiler shape and friends."""
+        body = block.instructions[:-1]
+        tail = body[-2:]
+        if len(tail) < 2:
+            return False
+        for ins in tail:
+            if ins.opcode.startswith("PUSH"):
+                if int(ins.argument or "0", 16) > 4096:
+                    return False
+            elif not ins.opcode.startswith("DUP"):
+                return False
+        return True
+
+    # -- rendering ------------------------------------------------------
+    def stats(self) -> Dict:
+        applicable, skipped = self.applicable_modules()
+        return {
+            "code_hash": self.code_hash,
+            "code_len": self.code_len,
+            "instructions": self.n_instructions,
+            "blocks": self.n_blocks,
+            "reachable_blocks": len(self.reachable_blocks),
+            "dead_blocks": len(self.dead_blocks),
+            "dead_instructions": self.dead_instructions,
+            "jumpis": self.n_jumpis,
+            "resolved_jumps": len(self.flow.resolved_jumps),
+            "unresolved_jumps": len(self.flow.unresolved_jumps),
+            "invalid_jumps": len(self.flow.invalid_jumps),
+            "dead_directions": len(self.dead_directions),
+            "selectors": len(self.dispatcher),
+            "dead_selectors": len(self.dead_selectors),
+            "underflow_blocks": len(self.flow.underflow_blocks),
+            "modules_applicable": len(applicable),
+            "modules_skipped": sorted(skipped),
+            "prune_rate": self.prune_rate,
+            "seeds_dropped": self.seeds_dropped,
+            "incomplete": self.incomplete,
+            "wall_ms": self.wall_ms,
+        }
+
+    def findings(self) -> List[Dict]:
+        """Pure static findings for `myth lint` (informational — the
+        lint surface, not security issues)."""
+        out: List[Dict] = []
+        if self.dead_blocks:
+            out.append(
+                {
+                    "check": "unreachable-code",
+                    "detail": (
+                        f"{self.dead_instructions} instruction(s) across "
+                        f"{len(self.dead_blocks)} block(s) are unreachable "
+                        "from the entry point"
+                    ),
+                    "addresses": sorted(self.dead_blocks)[:16],
+                }
+            )
+        for pc, target in sorted(self.flow.invalid_jumps.items()):
+            out.append(
+                {
+                    "check": "invalid-jump-target",
+                    "detail": (
+                        f"jump at {pc} targets {target}, which is not a "
+                        "valid JUMPDEST (execution there always fails)"
+                    ),
+                    "addresses": [pc],
+                }
+            )
+        for start in sorted(self.flow.underflow_blocks):
+            out.append(
+                {
+                    "check": "stack-underflow",
+                    "detail": (
+                        f"block at {start} underflows the stack on every "
+                        "path (always-reverting)"
+                    ),
+                    "addresses": [start],
+                }
+            )
+        for pc, dead_taken in sorted(self.dead_directions):
+            direction = "taken" if dead_taken else "fall-through"
+            out.append(
+                {
+                    "check": "dead-branch",
+                    "detail": (
+                        f"JUMPI at {pc}: the {direction} direction is "
+                        "statically infeasible (constant condition)"
+                    ),
+                    "addresses": [pc],
+                }
+            )
+        for entry in self.dispatcher:
+            if entry.selector in self.dead_selectors:
+                out.append(
+                    {
+                        "check": "inert-function",
+                        "detail": (
+                            f"function 0x{entry.selector.hex()} (entry "
+                            f"{entry.entry_pc}) has no observable effect "
+                            "(pruned from seeding)"
+                        ),
+                        "addresses": [entry.entry_pc],
+                    }
+                )
+        return out
+
+    def lint_dict(self, name: str = "") -> Dict:
+        out = {"contract": name} if name else {}
+        out.update(self.stats())
+        out["findings"] = self.findings()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-code-hash cache
+# ---------------------------------------------------------------------------
+_CACHE: "OrderedDict[str, StaticSummary]" = OrderedDict()
+_CACHE_CAP = 256
+_HITS = 0
+_MISSES = 0
+
+
+def _as_bytes(code) -> bytes:
+    if isinstance(code, bytes):
+        return code
+    code = code or ""
+    if code.startswith("0x"):
+        code = code[2:]
+    from mythril_tpu.disassembler.asm import safe_decode
+
+    return safe_decode(code)
+
+
+def analyze_bytecode(code) -> StaticSummary:
+    """Uncached static analysis of bytecode (bytes or hex str)."""
+    return StaticSummary(_as_bytes(code))
+
+
+def summary_for(code) -> StaticSummary:
+    """Cached-by-code-hash static analysis."""
+    global _HITS, _MISSES
+    raw = _as_bytes(code)
+    key = hashlib.sha256(raw).hexdigest()
+    hit = _CACHE.get(key)
+    if hit is not None:
+        _HITS += 1
+        _CACHE.move_to_end(key)
+        return hit
+    _MISSES += 1
+    summary = StaticSummary(raw)
+    _CACHE[key] = summary
+    while len(_CACHE) > _CACHE_CAP:
+        _CACHE.popitem(last=False)
+    return summary
+
+
+def clear_static_cache() -> None:
+    global _HITS, _MISSES
+    _CACHE.clear()
+    _HITS = 0
+    _MISSES = 0
+
+
+def static_cache_stats() -> Dict:
+    return {"size": len(_CACHE), "hits": _HITS, "misses": _MISSES}

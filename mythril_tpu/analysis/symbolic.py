@@ -9,7 +9,7 @@ statespace's CALL operations for POST modules.
 from __future__ import annotations
 
 import logging
-from typing import List, Optional, Union
+from typing import List, Optional, Set, Union
 
 from mythril_tpu.analysis.module import (
     EntryPoint,
@@ -63,6 +63,17 @@ class DeviceExplorationInfo(ExecutionInfo):
 
     def as_dict(self):
         return {"device_symbolic_prepass": self.stats}
+
+
+class StaticAnalysisInfo(ExecutionInfo):
+    """Static-prepass counters (analysis/static), surfaced in the
+    jsonv2 report meta: CFG/prune stats plus the detector screen."""
+
+    def __init__(self, stats: dict) -> None:
+        self.stats = stats
+
+    def as_dict(self):
+        return {"static_analysis": self.stats}
 
 
 def _as_address_term(address: Union[int, str, BitVec]) -> BitVec:
@@ -130,6 +141,12 @@ class SymExecWrapper:
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
 
+        # the static prepass (analysis/static): CFG + dataflow once
+        # per code hash, detector pre-screen BEFORE any hook mounts
+        self.static_summary = None
+        self.static_screen: Optional[Set[str]] = None
+        self._static_prescreen(contract, deploys)
+
         self._mount_plugins(disable_dependency_pruning)
         if run_analysis_modules:
             self._mount_detectors(modules)
@@ -161,6 +178,61 @@ class SymExecWrapper:
             self.nodes = self.laser.nodes
             self.edges = self.laser.edges
             self.calls = list(self._digest_calls())
+
+    # -- static prepass -------------------------------------------------
+    def _static_prescreen(self, contract, deploys: bool) -> None:
+        """Run the host-side static pass (cached by code hash) and
+        derive the detector screen: modules whose opcode signature
+        cannot fire on this code are never mounted and never run their
+        POST pass (analysis/static/screen.py).
+
+        Screening is skipped when on-chain loading is active — a
+        DELEGATECALL into foreign code executes opcodes this
+        contract's bytecode does not contain — and when the user
+        passed --no-static-prune."""
+        if not getattr(args, "static_prune", True):
+            return
+        if self.dynloader is not None and getattr(
+            self.dynloader, "active", False
+        ):
+            return
+        runtime = getattr(contract, "code", "") or ""
+        if len(runtime) < 4:
+            return
+        try:
+            from mythril_tpu.analysis.static import (
+                screen_modules,
+                summary_for,
+            )
+
+            self.static_summary = summary_for(runtime)
+            features = set(self.static_summary.features)
+            if deploys:
+                # creation code executes under the same hooks; its
+                # linear sweep over-approximates (embedded runtime
+                # decodes as instructions), which only ADDS features —
+                # conservative in the right direction
+                features |= summary_for(
+                    getattr(contract, "creation_code", "") or ""
+                ).features
+            applicable, skipped = screen_modules(features)
+            self.static_screen = set(applicable)
+            stats = self.static_summary.stats()
+            stats["modules_skipped"] = sorted(skipped)
+            self.laser.execution_info.append(StaticAnalysisInfo(stats))
+            if skipped:
+                log.info(
+                    "Static pre-screen: %d/%d detection modules "
+                    "applicable (skipped: %s)",
+                    len(applicable),
+                    len(applicable) + len(skipped),
+                    ", ".join(sorted(skipped)),
+                )
+        except Exception:
+            self.static_summary = None
+            self.static_screen = None
+            log.debug("static prescreen failed; all modules load",
+                      exc_info=True)
 
     # -- device symbolic prepass ----------------------------------------
     def _device_prepass(self, contract, address: BitVec, execution_timeout):
@@ -311,6 +383,15 @@ class SymExecWrapper:
         detectors = ModuleLoader().get_detection_modules(
             EntryPoint.CALLBACK, modules
         )
+        if self.static_screen is not None:
+            # the pre-screen: a module whose opcode signature cannot
+            # fire on this code never mounts its hooks (the svm pays
+            # hook dispatch per executed instruction)
+            detectors = [
+                d
+                for d in detectors
+                if type(d).__name__ in self.static_screen
+            ]
         for phase in ("pre", "post"):
             self.laser.register_hooks(
                 hook_type=phase,

@@ -90,7 +90,13 @@ def disassemble(bytecode: bytes) -> List[EvmInstruction]:
         match = regex_push.match(name)
         if match:
             n = int(match.group(1))
-            argument = bytecode[address + 1 : address + 1 + n]
+            # the operand is bounded by the CODE region: a trailing
+            # PUSH whose operand runs past end-of-code must NOT absorb
+            # the solc metadata bytes that follow — the EVM pads reads
+            # past the code end with zeros, and every other consumer
+            # (to_dense, the jumpdest sweep, CFG recovery) treats the
+            # metadata as non-code
+            argument = bytecode[address + 1 : min(address + 1 + n, length)]
             # zero-pad truncated push at end of code, as the EVM does
             argument = argument + b"\x00" * (n - len(argument))
             instructions.append(

@@ -57,6 +57,7 @@ COMMAND_LIST = (
         "function-to-hash",
         "hash-to-address",
         "list-detectors",
+        "lint",
         "serve",
         "submit",
         "version",
@@ -321,6 +322,19 @@ ANALYZE_OPTION_FLAGS = [
             help=(
                 "Checks for reachability after the end of tx. Recommended "
                 "for short execution timeouts < 1 min"
+            ),
+        ),
+    ),
+    (
+        ("--no-static-prune",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable the static bytecode prepass (CFG recovery + "
+                "constant dataflow): detection-module pre-screening, "
+                "dispatcher-seed masking, and flip-frontier pruning "
+                "all switch off — the differential baseline for a "
+                "suspected wrong prune"
             ),
         ),
     ),
@@ -593,6 +607,19 @@ def build_parser() -> ArgumentParser:
         metavar="LEVELDB_PATH",
     )
 
+    lint = subparsers.add_parser(
+        "lint",
+        help=(
+            "Static bytecode analysis only: CFG recovery, constant "
+            "dataflow, dead-code/dead-branch findings, and the "
+            "detector pre-screen — pure host work, sub-second, no "
+            "device initialization"
+        ),
+        parents=[rpc, utilities, creation_input, runtime_input, output],
+        formatter_class=RawTextHelpFormatter,
+    )
+    lint.add_argument("solidity_files", **SOLIDITY_FILES_ARG)
+
     serve = subparsers.add_parser(
         "serve",
         help=(
@@ -860,6 +887,60 @@ def _run_pro(disassembler, address, args):
     _print_report(mythx.analyze(disassembler.contracts, mode), args.outform)
 
 
+def _run_lint(disassembler, address, args):
+    """`myth lint`: the static layer alone — per contract, CFG/prune
+    stats plus the pure static findings. Never touches the device."""
+    from mythril_tpu.analysis.static import summary_for
+
+    rows = []
+    for contract in disassembler.contracts:
+        code = contract.code or getattr(contract, "creation_code", "") or ""
+        try:
+            summary = summary_for(code)
+        except Exception as why:
+            exit_with_error(
+                args.outform,
+                f"static analysis failed for {contract.name}: {why}",
+                exit_code=1,
+            )
+        rows.append(summary.lint_dict(name=contract.name))
+
+    if args.outform in ("json", "jsonv2"):
+        print(json.dumps(rows, sort_keys=True))
+        return
+    for row in rows:
+        print(f"Static analysis: {row['contract']} ({row['code_hash']})")
+        print(
+            "  blocks: {blocks} ({reachable_blocks} reachable, "
+            "{dead_blocks} dead), instructions: {instructions} "
+            "({dead_instructions} dead)".format(**row)
+        )
+        print(
+            "  jumps: {resolved_jumps} resolved / {unresolved_jumps} "
+            "unresolved / {invalid_jumps} invalid; dead branch "
+            "directions: {dead_directions}".format(**row)
+        )
+        print(
+            "  selectors: {selectors} ({dead_selectors} statically "
+            "prunable); prune rate: {prune_rate}".format(**row)
+        )
+        skipped = row["modules_skipped"]
+        print(
+            "  detector screen: {} applicable, {} skipped{}".format(
+                row["modules_applicable"],
+                len(skipped),
+                " ({})".format(", ".join(skipped)) if skipped else "",
+            )
+        )
+        if row["findings"]:
+            print("  findings:")
+            for finding in row["findings"]:
+                print(
+                    "    - [{check}] {detail}".format(**finding)
+                )
+        print("  wall: {wall_ms} ms".format(**row))
+
+
 def _run_disassemble(disassembler, address, args):
     target = disassembler.contracts[0]
     if target.code:
@@ -945,6 +1026,7 @@ def _run_analyze(disassembler, address, args):
         device_prepass_budget=args.device_prepass_budget,
         device_ownership=args.device_ownership,
         deterministic_solving=args.deterministic_solving,
+        static_prune=not args.no_static_prune,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
     )
@@ -1014,6 +1096,8 @@ def execute_command(
         _run_read_storage(disassembler, address, args)
     elif args.command in PRO_LIST:
         _run_pro(disassembler, address, args)
+    elif args.command == "lint":
+        _run_lint(disassembler, address, args)
     elif args.command in DISASSEMBLE_LIST:
         _run_disassemble(disassembler, address, args)
     elif args.command in ANALYZE_LIST:

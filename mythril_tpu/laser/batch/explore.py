@@ -148,6 +148,13 @@ class ExploreStats:
         # and wave checkpoints flushed for resume
         self.device_faults = 0
         self.wave_checkpoints = 0
+        # static-prune observability (analysis/static): flip targets
+        # the pre-dispatch pass proved dead (never solved), dispatcher
+        # seeds dropped for statically-inert functions, and how many
+        # contracts carried a static summary at all
+        self.static_pruned_flips = 0
+        self.static_seeds_dropped = 0
+        self.static_summaries = 0
         self.wall_s = 0.0
         # where the prepass wall goes: device wave execution vs host
         # flip solving (the two phases that can dominate)
@@ -194,6 +201,13 @@ class _ContractTrack:
         #: whole corpus is seconds of GIL time stolen from overlapped
         #: host analyses
         self.selector_seeds: Optional[List[bytes]] = None
+        #: static pre-analysis (analysis/static StaticSummary), set by
+        #: the explorer when the static prepass is enabled; None means
+        #: no pruning and no seed masking for this contract
+        self.static = None
+        #: the statically-dead branch directions — (jumpi_pc, taken)
+        #: pairs the flip loop must never spend a solver attempt on
+        self.static_dead: frozenset = frozenset()
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[Tuple[int, bytes]] = []  # (carry index, calldata)
@@ -632,6 +646,7 @@ class DeviceCorpusExplorer:
             _ContractTrack(c[2:] if c.startswith("0x") else c) for c in codes_hex
         ]
         self.codes = [bytes.fromhex(t.code_hex) for t in self.tracks]
+        self._attach_static_feeds()
         self.lanes_per_contract = lanes_per_contract
         self.calldata_len = calldata_len
         self.waves = waves
@@ -682,6 +697,9 @@ class DeviceCorpusExplorer:
         self.storage_cap = storage_cap
         self.rng = random.Random(seed)
         self.stats = ExploreStats()
+        self.stats.static_summaries = sum(
+            1 for t in self.tracks if t.static is not None
+        )
         self._phase_allowance: Optional[float] = None
 
         # bucket the code capacity to powers of two so XLA compiles one
@@ -697,6 +715,34 @@ class DeviceCorpusExplorer:
 
             self.mesh = make_mesh(n_devices)
             self.code_table = replicate_table(self.code_table, self.mesh)
+
+    # -- static pre-analysis -------------------------------------------
+    def _attach_static_feeds(self) -> None:
+        """Run the host-side static pass once per contract (cached by
+        code hash) BEFORE any lane is seeded: statically-dead branch
+        directions never enter the flip frontier and inert functions
+        never get dispatcher seeds. Failure is never fatal — a
+        contract without a feed simply explores unpruned."""
+        from mythril_tpu.analysis.static import static_prune_enabled
+
+        if not static_prune_enabled():
+            return
+        from mythril_tpu.analysis.static import summary_for
+
+        for track in self.tracks:
+            try:
+                track.static = summary_for(track.code_hex)
+                track.static_dead = frozenset(
+                    track.static.prune_directions()
+                )
+            except Exception:
+                log.debug(
+                    "static pre-analysis failed; contract explores "
+                    "unpruned",
+                    exc_info=True,
+                )
+                track.static = None
+                track.static_dead = frozenset()
 
     # -- supervision ---------------------------------------------------
     def _stop_requested(self) -> bool:
@@ -733,10 +779,16 @@ class DeviceCorpusExplorer:
                 # cache only the deterministic part (zero + dispatcher
                 # selectors); the random filler below is re-drawn each
                 # phase so later transactions don't replay identical
-                # calldata
+                # calldata. The static feed masks inert selectors out
+                # of the wave seeding (drops logged at DEBUG there).
+                before = track.static.seeds_dropped if track.static else 0
                 track.selector_seeds = dispatcher_seeds(
-                    track.code_hex, self.calldata_len
+                    track.code_hex, self.calldata_len, prune=track.static
                 )
+                if track.static is not None:
+                    self.stats.static_seeds_dropped += (
+                        track.static.seeds_dropped - before
+                    )
             seeds = list(track.parent_inputs) + track.selector_seeds
             while len(seeds) < self.lanes_per_contract:
                 seeds.append(
@@ -1349,6 +1401,16 @@ class DeviceCorpusExplorer:
                 target = (pc, not taken)
                 if tid <= 0:
                     continue  # concrete or opaque condition: nothing to flip
+                if target in track.static_dead:
+                    # the static pass proved this direction infeasible
+                    # (constant condition) or inert (dispatcher entry
+                    # of an effect-free function): a solve would be
+                    # UNSAT or pure waste — blacklist without spending
+                    # the sprint
+                    if target not in track.attempted:
+                        track.attempted.add(target)
+                        self.stats.static_pruned_flips += 1
+                    continue
                 if target in track.covered or target in track.attempted:
                     continue
                 track.attempted.add(target)

@@ -8,8 +8,11 @@ compiles one kernel per size class.
 
 from __future__ import annotations
 
+import logging
 import random
 from typing import List
+
+log = logging.getLogger(__name__)
 
 
 def code_cap_bucket(max_len: int, floor: int = 1024) -> int:
@@ -41,19 +44,36 @@ def scan_selectors(code: bytes) -> List[bytes]:
     return out
 
 
-def dispatcher_seeds(code_hex: str, calldata_len: int) -> List[bytes]:
+def dispatcher_seeds(
+    code_hex: str, calldata_len: int, prune=None
+) -> List[bytes]:
     """The deterministic seeds that open a contract's dispatcher: the
     zero input plus, per recovered selector, a zero-args seed and a
     max-args seed. The 0xff fill drives every argument to the integer
     boundary, so arithmetic on calldata wraps CONCRETELY in wave 1 —
     the wrap-event bank (symbolic.py) needs an exhibiting lane, and
-    `selector + zeros` never wraps anything."""
+    `selector + zeros` never wraps anything.
+
+    `prune` (a StaticSummary, analysis/static) masks statically-dead
+    selectors out of the seeding: functions whose whole resolved
+    subgraph is inert never get a lane. Every drop is logged at DEBUG
+    and counted on the feed (`prune.seeds_dropped`), so a wrong prune
+    is diagnosable from the wave log rather than silent."""
     if code_hex.startswith("0x"):
         code_hex = code_hex[2:]
+    dead = getattr(prune, "dead_selectors", None) or frozenset()
     # the all-ff seed also covers SELECTORLESS contracts (raw runtime
     # bodies), whose only boundary input would otherwise be zero
     seeds = [b"\x00" * calldata_len, b"\xff" * calldata_len]
     for selector in scan_selectors(bytes.fromhex(code_hex)):
+        if selector in dead:
+            prune.seeds_dropped += 2
+            log.debug(
+                "static prune dropped dispatcher seeds for selector "
+                "0x%s (statically-inert function body)",
+                selector.hex(),
+            )
+            continue
         seeds.append(selector.ljust(calldata_len, b"\x00"))
         seeds.append(selector + b"\xff" * (calldata_len - len(selector)))
     return seeds
@@ -64,10 +84,11 @@ def selector_seeds(
     count: int,
     calldata_len: int,
     rng: random.Random,
+    prune=None,
 ) -> List[bytes]:
     """`count` calldata seeds for a contract: the dispatcher seeds,
     then random fill."""
-    seeds = dispatcher_seeds(code_hex, calldata_len)
+    seeds = dispatcher_seeds(code_hex, calldata_len, prune=prune)
     while len(seeds) < count:
         seeds.append(bytes(rng.randrange(256) for _ in range(calldata_len)))
     return seeds[:count]

@@ -67,6 +67,7 @@ _GLOBAL_DEFAULTS = dict(
     device_prepass_lanes=128,
     device_ownership="auto",
     deterministic_solving=False,
+    static_prune=True,
 )
 
 

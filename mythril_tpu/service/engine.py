@@ -111,24 +111,25 @@ class ServiceConfig:
 class CodeCache:
     """LRU of disassembled dense code rows keyed by code hash — the
     warm path for resubmitted contracts (to_dense is a host-side
-    linear sweep, cheap once but not free at service request rates)."""
+    linear sweep, cheap once but not free at service request rates).
+    The static summary (analysis/static: CFG + dataflow + prune feed)
+    rides in the same LRU entry beside the disassembly, so a
+    resubmitted contract skips both sweeps."""
 
     def __init__(self, code_cap: int, capacity: int = 64) -> None:
         self.code_cap = code_cap
         self.capacity = max(1, capacity)
-        self._rows: "OrderedDict[str, Tuple[np.ndarray, np.ndarray, int]]" = (
-            OrderedDict()
-        )
+        self._rows: "OrderedDict[str, list]" = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.static_summaries = 0
 
     @staticmethod
     def code_hash(code: bytes) -> str:
         return hashlib.sha256(code).hexdigest()
 
-    def rows(self, code: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
-        """(ops[code_cap+33] u8, jumpdest[code_cap] bool, length)."""
+    def _entry(self, code: bytes) -> list:
         from mythril_tpu.disassembler.asm import to_dense
 
         key = self.code_hash(code)
@@ -141,12 +142,39 @@ class CodeCache:
         ops_row = np.zeros((self.code_cap + 33,), dtype=np.uint8)
         ops, jumpdest = to_dense(code, max_len=self.code_cap)
         ops_row[: self.code_cap] = ops
-        entry = (ops_row, jumpdest, min(len(code), self.code_cap))
+        # slot 3 holds the lazily-built static summary (None until
+        # some consumer asks for it)
+        entry = [ops_row, jumpdest, min(len(code), self.code_cap), None]
         self._rows[key] = entry
         while len(self._rows) > self.capacity:
             self._rows.popitem(last=False)
             self.evictions += 1
         return entry
+
+    def rows(self, code: bytes) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(ops[code_cap+33] u8, jumpdest[code_cap] bool, length)."""
+        entry = self._entry(code)
+        return entry[0], entry[1], entry[2]
+
+    def static_summary(self, code: bytes):
+        """The code's StaticSummary from the same LRU entry, built on
+        first request; None when the static layer is off or failed."""
+        entry = self._entry(code)
+        if entry[3] is None:
+            try:
+                from mythril_tpu.analysis.static import (
+                    static_prune_enabled,
+                    summary_for,
+                )
+
+                if not static_prune_enabled():
+                    return None
+                entry[3] = summary_for(code)
+                self.static_summaries += 1
+            except Exception:
+                log.debug("static summary failed", exc_info=True)
+                return None
+        return entry[3]
 
     def rebucket(self, code_cap: int) -> None:
         """Grow the capacity (new kernel shape): cached rows are the
@@ -161,6 +189,7 @@ class CodeCache:
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "static_summaries": self.static_summaries,
         }
 
 
@@ -170,7 +199,7 @@ class _JobTrack:
 
     def __init__(
         self, job: Job, stripes: List[int], lanes: List[int],
-        calldata_len: int,
+        calldata_len: int, static_feed=None,
     ) -> None:
         import random
 
@@ -181,7 +210,16 @@ class _JobTrack:
         self.lanes = lanes
         self.code_row = stripes[0]
         self.calldata_len = calldata_len
-        self.seeds = dispatcher_seeds(job.code.hex(), calldata_len)
+        # the static prune feed masks inert selectors out of this
+        # job's seeding; per-job drop delta kept for the report
+        self.static = static_feed
+        before = static_feed.seeds_dropped if static_feed else 0
+        self.seeds = dispatcher_seeds(
+            job.code.hex(), calldata_len, prune=static_feed
+        )
+        self.static_seeds_dropped = (
+            (static_feed.seeds_dropped - before) if static_feed else 0
+        )
         self.corpus: List[bytes] = list(self.seeds)
         self.covered: set = set()
         self.pc_seen: Optional[np.ndarray] = None
@@ -327,6 +365,7 @@ class AnalysisEngine:
         self.device_steps = 0
         self.host_completed = 0
         self.kernel_rebuckets = 0
+        self.static_seeds_dropped = 0
         self._first_wave_t: Optional[float] = None
         self._last_wave_t: Optional[float] = None
         self._wave_cold_s: Optional[float] = None
@@ -454,7 +493,11 @@ class AnalysisEngine:
             lanes = [
                 lane for s in granted for lane in self.alloc.lanes_of(s)
             ]
-            track = _JobTrack(job, granted, lanes, self.cfg.calldata_len)
+            track = _JobTrack(
+                job, granted, lanes, self.cfg.calldata_len,
+                static_feed=self.code_cache.static_summary(job.code),
+            )
+            self.static_seeds_dropped += track.static_seeds_dropped
             self._install_code(track)
             self._tracks[job.id] = track
 
@@ -680,6 +723,9 @@ class AnalysisEngine:
                     for kind, bucket in outcome["triggers"].items()
                 },
                 "degraded_lanes": outcome["degraded_lanes"],
+                "static_pruned_seeds": (
+                    track.static_seeds_dropped if track is not None else 0
+                ),
             },
             "issues": [],
             "timings": {
@@ -739,8 +785,12 @@ class AnalysisEngine:
                     )
                     * self.cfg.lanes_per_stripe
                 )
+                # same prune feed a wave admission would have used, so
+                # the checkpointed frontier replays what the engine
+                # would actually have seeded
                 seeds = dispatcher_seeds(
-                    job.code.hex(), self.cfg.calldata_len
+                    job.code.hex(), self.cfg.calldata_len,
+                    prune=self.code_cache.static_summary(job.code),
                 )
                 inputs = [seeds[i % len(seeds)] for i in range(n)]
             table = make_code_table(
@@ -814,6 +864,10 @@ class AnalysisEngine:
                 "code_cap": self.code_cap,
                 "kernel_rebuckets": self.kernel_rebuckets,
                 "code_cache": self.code_cache.stats(),
+            },
+            "static": {
+                "summaries_cached": self.code_cache.static_summaries,
+                "seeds_dropped": self.static_seeds_dropped,
             },
             "host_pool": {
                 "workers": max(1, self.cfg.host_workers),

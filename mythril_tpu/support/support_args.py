@@ -30,6 +30,12 @@ class Args(object, metaclass=Singleton):
         # concrete evidence bank and the host walk is skipped.
         # "auto" = on when an accelerator backend is present.
         self.device_ownership = "auto"
+        # Static pre-analysis (analysis/static, CLI --no-static-prune):
+        # CFG recovery + constant dataflow once per code hash, feeding
+        # the detector pre-screen, the dispatcher-seed mask, and the
+        # flip-frontier prune. On by default; the flag exists so a
+        # suspected wrong prune is one switch away from a differential.
+        self.static_prune = True
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to

@@ -1,0 +1,296 @@
+"""Static analysis layer: golden CFGs, prune decisions, screen, and
+the pruned-vs-unpruned differential (analysis/static).
+
+Tier-1 via the `static` marker (tox -e static runs it alone).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import deadweight_contract
+from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES
+from mythril_tpu.analysis.static import (
+    analyze_bytecode,
+    screen_modules,
+    summary_for,
+)
+from mythril_tpu.disassembler import asm
+from mythril_tpu.laser.batch.seeds import dispatcher_seeds
+
+pytestmark = pytest.mark.static
+
+
+def _fixture(name: str) -> str:
+    return (GOLDEN_FIXTURES / f"{name}.sol.o").read_text().strip()
+
+
+# -- golden CFG + prune decisions -------------------------------------------
+def test_golden_deadweight_contract():
+    """The dead-revert-block shape: every static decision pinned."""
+    summary = analyze_bytecode(deadweight_contract(0))
+    stats = summary.stats()
+    assert stats["blocks"] == 10
+    assert stats["dead_blocks"] == 2  # the island after the const guard
+    assert stats["selectors"] == 2
+    assert stats["dead_selectors"] == 1
+    assert {s.hex() for s in summary.dead_selectors} == {"deadd00d"}
+    # the const-true guard kills its fall-through; the dead function's
+    # dispatcher entry is pruned alongside it
+    assert summary.dead_directions == {(4, False)}
+    assert summary.inert_directions == {(33, True)}
+    assert summary.prune_directions() == {(4, False), (33, True)}
+    assert not stats["incomplete"]
+    checks = {f["check"] for f in summary.findings()}
+    assert {"unreachable-code", "dead-branch", "inert-function"} <= checks
+
+
+def test_golden_computed_jump_dispatcher():
+    """A computed jump the peephole cannot see: the target reaches the
+    JUMP through a SWAP/POP shuffle and constant arithmetic — only the
+    dataflow pass resolves it."""
+    code = asm.assemble(
+        """
+        PUSH1 0x55      ; junk
+        PUSH1 0x03      ; half the target
+        DUP1
+        ADD             ; 6
+        PUSH1 0x06
+        ADD             ; target = 12
+        SWAP1
+        POP             ; drop the junk, target on top
+        JUMP            ; at pc 11
+        JUMPDEST        ; 12
+        STOP
+        """
+    )
+    summary = analyze_bytecode(code)
+    jump_pc = summary.cfg.blocks[0].end
+    assert summary.flow.resolved_jumps == {jump_pc: 12}
+    assert summary.flow.unresolved_jumps == set()
+    assert summary.reachable_blocks == {0, 12}
+    # the peephole alone must NOT have seen it (PUSH is not adjacent)
+    assert jump_pc not in summary.cfg.peephole_targets
+
+
+def test_golden_const_fold_and_dead_island():
+    code = asm.assemble(
+        """
+        PUSH1 0x01
+        PUSH1 0x08
+        JUMPI           ; always taken
+        PUSH1 0x00      ; dead island, not JUMPDEST-led
+        STOP
+        JUMPDEST        ; 0x08
+        CALLER
+        SUICIDE
+        """
+    )
+    summary = analyze_bytecode(code)
+    assert summary.dead_directions == {(4, False)}
+    assert summary.dead_blocks == {5}
+    assert summary.dead_instructions == 2
+    assert "SUICIDE" in summary.features
+    assert "PUSH1" in summary.features
+
+
+def test_golden_underflow_and_invalid_jump():
+    # ADD on an empty stack: definite underflow, flagged not pruned
+    summary = analyze_bytecode(asm.assemble("ADD\nSTOP"))
+    assert summary.flow.underflow_blocks == {0}
+    assert {f["check"] for f in summary.findings()} == {"stack-underflow"}
+
+    # const jump to a non-JUMPDEST: invalid, flagged not pruned (the
+    # taken lane halts ERR_JUMP — a real finding, not dead code)
+    summary = analyze_bytecode(
+        asm.assemble("PUSH1 0x04\nJUMP\nSTOP\nSTOP")
+    )
+    assert summary.flow.invalid_jumps == {2: 4}
+    assert not summary.dead_directions
+
+
+def test_golden_fixture_suicide():
+    """Real solc output: dispatcher recovered, jumps fully resolved,
+    trailing dead region counted, screen keeps the killable module."""
+    summary = summary_for(_fixture("suicide"))
+    stats = summary.stats()
+    assert stats["blocks"] == 9
+    assert stats["reachable_blocks"] == 7
+    assert stats["dead_blocks"] == 2
+    assert stats["selectors"] == 1
+    assert stats["dead_selectors"] == 0
+    assert stats["unresolved_jumps"] == 0
+    assert stats["resolved_jumps"] == 4
+    applicable, skipped = summary.applicable_modules()
+    assert "AccidentallyKillable" in applicable
+    assert "EtherThief" in skipped  # no CALL anywhere in the code
+    assert "IntegerArithmetics" in applicable
+
+
+def test_golden_fixture_overflow():
+    summary = summary_for(_fixture("overflow"))
+    stats = summary.stats()
+    assert stats["blocks"] == 29
+    assert stats["selectors"] == 4
+    assert stats["dead_selectors"] == 0
+    assert stats["unresolved_jumps"] == 0
+    applicable, skipped = summary.applicable_modules()
+    assert "IntegerArithmetics" in applicable
+    assert "AccidentallyKillable" in skipped
+
+
+# -- the screen -------------------------------------------------------------
+def test_screen_minimal_killable():
+    applicable, skipped = screen_modules(
+        analyze_bytecode("33ff").features
+    )
+    assert applicable == ["AccidentallyKillable"]
+    assert len(skipped) == 13
+
+
+def test_screen_conjunction():
+    # CALL present but no state op: StateChangeAfterCall screens off
+    # while the other call modules stay
+    features = {"CALL", "STOP", "PUSH1"}
+    applicable, skipped = screen_modules(features)
+    assert "StateChangeAfterCall" in skipped
+    assert "ExternalCalls" in applicable
+    assert "UncheckedRetval" in applicable
+    features.add("SSTORE")
+    applicable, _ = screen_modules(features)
+    assert "StateChangeAfterCall" in applicable
+
+
+def test_unknown_module_is_never_screened():
+    applicable, skipped = screen_modules(set(), ["SomeCustomDetector"])
+    assert applicable == ["SomeCustomDetector"] and not skipped
+
+
+# -- the prune feed ---------------------------------------------------------
+def test_dispatcher_seeds_drop_dead_selector_and_count():
+    code = deadweight_contract(0)
+    summary = analyze_bytecode(code)
+    unpruned = dispatcher_seeds(code, 68)
+    pruned = dispatcher_seeds(code, 68, prune=summary)
+    assert len(unpruned) - len(pruned) == 2  # zero-args + max-args seed
+    assert summary.seeds_dropped == 2
+    dead = bytes.fromhex("deadd00d")
+    assert all(not s.startswith(dead) for s in pruned)
+    live = next(s for s in summary.dispatcher if s.selector != dead)
+    assert any(seed.startswith(live.selector) for seed in pruned)
+
+
+def test_prune_log_is_debug_visible(caplog):
+    import logging
+
+    code = deadweight_contract(0)
+    summary = analyze_bytecode(code)
+    with caplog.at_level(logging.DEBUG, logger="mythril_tpu.laser.batch.seeds"):
+        dispatcher_seeds(code, 68, prune=summary)
+    assert any("static prune dropped" in r.message for r in caplog.records)
+    assert any("deadd00d" in r.message for r in caplog.records)
+
+
+def test_explorer_attaches_feed_and_masks_flips():
+    """The explorer wires the feed at construction: dead directions
+    populate the per-track mask, the seed plan drops the inert
+    selector, and the counters say so."""
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    explorer = DeviceCorpusExplorer([deadweight_contract(0)], waves=1)
+    track = explorer.tracks[0]
+    assert track.static is not None
+    assert track.static_dead == {(4, False), (33, True)}
+    assert explorer.stats.static_summaries == 1
+    inputs = explorer._seed_phase_inputs()
+    assert explorer.stats.static_seeds_dropped == 2
+    dead = bytes.fromhex("deadd00d")
+    assert all(
+        not data.startswith(dead) for _, data in inputs[0]
+    )
+
+
+def test_explorer_feed_disabled_by_flag():
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+    from mythril_tpu.support.support_args import args
+
+    args.static_prune = False
+    try:
+        explorer = DeviceCorpusExplorer([deadweight_contract(0)], waves=1)
+        assert explorer.tracks[0].static is None
+        assert explorer.tracks[0].static_dead == frozenset()
+    finally:
+        args.static_prune = True
+
+
+# -- the cache --------------------------------------------------------------
+def test_summary_cache_by_code_hash():
+    from mythril_tpu.analysis.static import static_cache_stats
+
+    code = deadweight_contract(1)
+    first = summary_for(code)
+    again = summary_for("0x" + code)  # prefix-insensitive key
+    assert first is again
+    stats = static_cache_stats()
+    assert stats["hits"] >= 1
+
+
+# -- the differential (acceptance criterion) --------------------------------
+def _fingerprints(results):
+    return {
+        (r["name"], i["swc-id"], i["address"])
+        for r in results
+        for i in r["issues"]
+    }
+
+
+@pytest.mark.parametrize("static_prune", [True, False])
+def test_differential_prepares(static_prune):
+    """Smoke both legs build summaries/skip them without error."""
+    from mythril_tpu.support.support_args import args
+
+    previous = args.static_prune
+    args.static_prune = static_prune
+    try:
+        from mythril_tpu.analysis.static import static_prune_enabled
+
+        assert static_prune_enabled() == static_prune
+    finally:
+        args.static_prune = previous
+
+
+def test_differential_issue_sets_match():
+    """Pruned and unpruned analysis must report the SAME issue set on
+    the fault-suite contracts (KILLABLE/WRITER) plus the deadweight
+    shape whose whole point is to be heavily pruned."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.support.support_args import args
+
+    contracts = [
+        ("33ff", "", "Killable"),  # the fault suite's KILLABLE
+        ("6001600055600060015500", "", "Writer"),  # the WRITER fixture
+        (deadweight_contract(0), "", "Deadweight"),
+    ]
+
+    def leg(static_prune: bool):
+        previous = args.static_prune
+        args.static_prune = static_prune
+        try:
+            return analyze_corpus(
+                contracts,
+                transaction_count=1,
+                execution_timeout=8,
+                processes=1,
+                use_device=False,
+            )
+        finally:
+            args.static_prune = previous
+
+    pruned = leg(True)
+    unpruned = leg(False)
+    assert all(r["error"] is None for r in pruned + unpruned)
+    assert _fingerprints(pruned) == _fingerprints(unpruned)
+    # and the runs actually found things (the differential is not
+    # trivially empty): the killable + the deadweight's SWC-110
+    assert any(swc == "106" for _, swc, _ in _fingerprints(pruned))
+    assert any(swc == "110" for _, swc, _ in _fingerprints(pruned))

@@ -43,6 +43,12 @@ def pytest_configure(config):
         "service: persistent analysis service suite (myth serve; CPU-only, "
         "fast — runs in tier-1, selectable with -m service)",
     )
+    config.addinivalue_line(
+        "markers",
+        "static: static bytecode analysis suite (analysis/static: CFG "
+        "recovery, dataflow, prune feed, detector screen; host-only, "
+        "fast — runs in tier-1, selectable with -m static)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -127,6 +127,54 @@ def test_analyze_jsonv2_output():
     assert data[0]["issues"][0]["swcID"] == "SWC-106"
 
 
+def test_lint_text_output():
+    out = run_myth("lint", "-c", "33ff", "--bin-runtime")
+    assert "Static analysis:" in out.stdout
+    assert "detector screen:" in out.stdout
+    assert out.returncode == 0
+
+
+def test_lint_json_output():
+    from mythril_tpu.analysis.corpusgen import deadweight_contract
+
+    out = run_myth(
+        "lint", "-c", deadweight_contract(0), "--bin-runtime", "-o", "json"
+    )
+    rows = json.loads(out.stdout)
+    assert rows[0]["dead_selectors"] == 1
+    assert rows[0]["dead_directions"] == 1
+    checks = {f["check"] for f in rows[0]["findings"]}
+    assert "inert-function" in checks
+    assert "dead-branch" in checks
+
+
+def test_analyze_no_static_prune_flag_parity():
+    """--no-static-prune must change nothing but the wasted work: the
+    jsonv2 issue list is identical with the prepass on and off."""
+    base = (
+        "analyze", "-c", "33ff", "--bin-runtime", "--no-onchain-data",
+        "-t", "1", "-o", "jsonv2", "--execution-timeout", "60",
+    )
+    pruned = run_myth(*base)
+    unpruned = run_myth(*base, "--no-static-prune")
+
+    def stable(run):
+        issues = json.loads(run.stdout)[0]["issues"]
+        for issue in issues:
+            # wall-clock, differs between any two runs
+            issue.get("extra", {}).pop("discoveryTime", None)
+        return issues
+
+    assert stable(pruned) == stable(unpruned)
+    # and the pruned run's meta carries the static counters
+    meta = json.loads(pruned.stdout)[0]["meta"]["mythril_execution_info"]
+    assert "static_analysis" in meta
+    assert meta["static_analysis"]["modules_skipped"]
+    assert "static_analysis" not in json.loads(unpruned.stdout)[0][
+        "meta"
+    ].get("mythril_execution_info", {})
+
+
 def test_analyze_clean_contract_no_issues():
     out = run_myth(
         "analyze",

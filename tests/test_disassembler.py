@@ -39,6 +39,48 @@ def test_truncated_push_padded():
     assert instrs[0].argument == "0xaa00"
 
 
+def _metadata_blob() -> bytes:
+    """A valid solc-style CBOR tail: content with a bzzr key plus the
+    2-byte big-endian length find_metadata_length validates."""
+    inner = b"\xa1\x65bzzr0X " + bytes(range(32))
+    # the trailing 2-byte big-endian length counts the CBOR content
+    # only (find_metadata_length adds the 2 length bytes itself)
+    return inner + len(inner).to_bytes(2, "big")
+
+
+def test_truncated_push_does_not_absorb_metadata():
+    """A trailing PUSH whose operand runs past end-of-CODE must be
+    zero-padded per EVM semantics, not mis-sized by absorbing the solc
+    metadata bytes that follow — CFG recovery depends on the
+    instruction boundary (regression: the operand slice was bounded by
+    the raw blob, not the code region)."""
+    meta = _metadata_blob()
+    # code region = PUSH1; JUMPDEST-looking byte lives in metadata
+    blob = bytes([0x00, 0x60]) + meta
+    assert find_metadata_length(blob) == len(meta)
+    instrs = disassemble(blob)
+    assert [i.opcode for i in instrs] == ["STOP", "PUSH1"]
+    # EVM pads the out-of-code operand with zeros; the old behavior
+    # leaked meta[0] (0xa1) into the argument
+    assert instrs[1].argument == "0x00"
+
+    # a PUSH4 cut two bytes short: in-code bytes kept, tail padded
+    blob = bytes([0x63, 0xDE, 0xAD]) + meta
+    instrs = disassemble(blob)
+    assert instrs[0].opcode == "PUSH4"
+    assert instrs[0].argument == "0xdead0000"
+
+    # instruction boundaries must agree with the dense sweep: both
+    # views see the same 3-byte code region, metadata excluded — even
+    # when the first metadata byte (0xa1) would decode as an opcode
+    blob = bytes([0x63, 0xDE, 0xAD]) + meta
+    ops, jd = to_dense(blob)
+    assert len(ops) == 3
+    assert sum(len(i.argument[2:]) // 2 + 1 for i in disassemble(blob)) == 5
+    # (PUSH4 reports its full padded width; the CODE region is 3 bytes
+    # and to_dense stops exactly there)
+
+
 def test_dense_arrays_jumpdest_mask():
     code = assemble(["PUSH1 0x5b", "JUMPDEST", "PUSH2 0x5b5b", "JUMPDEST", "STOP"])
     ops, jd = to_dense(code)

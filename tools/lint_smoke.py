@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Static-layer smoke: `myth lint` semantics over the bundled corpus.
+
+Runs the static analysis (analysis/static) over every bundled fixture
+plus the synthetic benchmark shapes and FAILS (exit 1) on any
+static-summary exception — the CI tripwire for a CFG/dataflow
+regression. No device, no jax ops; the whole sweep is milliseconds.
+
+Prints one JSON line: per-corpus aggregates (prune rate, dead code,
+screen narrowing) plus any failures.
+
+Usage: python tools/lint_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import traceback
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from mythril_tpu.analysis.corpusgen import (
+        load_fixtures,
+        synth_bench_corpus,
+    )
+    from mythril_tpu.analysis.static import analyze_bytecode
+
+    rows = [(name, code) for name, code in load_fixtures()]
+    rows += [
+        (name, code) for code, _creation, name in synth_bench_corpus(32)
+    ]
+    if not rows:
+        print(json.dumps({"error": "no corpus found"}))
+        return 1
+
+    failures = []
+    pruned = total = dead_instructions = instructions = 0
+    modules_skipped = 0
+    t0 = time.perf_counter()
+    for name, code in rows:
+        try:
+            summary = analyze_bytecode(code)
+            # exercise every surface myth lint renders
+            summary.lint_dict(name=name)
+            applicable, skipped = summary.applicable_modules()
+            assert applicable, f"{name}: screen emptied the module list"
+            pruned += summary.prune_units
+            total += summary.total_units
+            dead_instructions += summary.dead_instructions
+            instructions += summary.n_instructions
+            modules_skipped += len(skipped)
+        except Exception:
+            failures.append(
+                {"contract": name, "error": traceback.format_exc(limit=3)}
+            )
+    record = {
+        "contracts": len(rows),
+        "failures": len(failures),
+        "static_prune_rate": round(pruned / total, 4) if total else 0.0,
+        "dead_instructions": dead_instructions,
+        "instructions": instructions,
+        "modules_skipped_total": modules_skipped,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    if failures:
+        record["failed"] = failures[:5]
+    print(json.dumps(record))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
