@@ -238,10 +238,17 @@ def _with_deadline(fn, seconds: int):
         signal.signal(signal.SIGALRM, previous)
 
 
-def _corpus_leg(contracts, use_device):
+def _corpus_leg(contracts, use_device, deadline_s=None):
     """One A/B leg. Legs share one process, so the query memo is
     cleared each time — without the reset the second leg would ride
-    the first leg's solves."""
+    the first leg's solves.
+
+    `deadline_s` bounds the leg INSIDE the analyzer (the supervisor is
+    consulted at every contract boundary, emitting a partial result
+    list) — the BENCH_r05 fix: the SIGALRM wrapper alone can be
+    swallowed by per-contract error containment, which let a host leg
+    run 691s past its alarm and the outer timeout kill the process
+    with no JSON emitted (rc:124/parsed:null)."""
     from mythril_tpu.analysis.corpus import analyze_corpus
     from mythril_tpu.support.model import clear_cache
     from mythril_tpu.laser.smt.solver.solver_statistics import (
@@ -261,6 +268,8 @@ def _corpus_leg(contracts, use_device):
         use_device=use_device,
         device_budget_s=CONV_DEVICE_BUDGET_S if use_device is None else None,
         processes=1,
+        deadline_s=deadline_s,
+        on_timeout="partial",
     )
     wall = time.perf_counter() - t0
     prepass = max(
@@ -346,14 +355,26 @@ def bench_corpus_convergence(strict: bool = True) -> dict:
             print(f"bench: corpus warmup skipped: {e!r}", file=sys.stderr)
 
         for pair in range(CONV_PAIRS):
+            # each leg's internal deadline: whatever the bench budget
+            # still holds (minus slack for the later halves), so a
+            # pathological corpus lands a PARTIAL leg result instead
+            # of eating the process's remaining wall
+            room = _leg_deadline()
             device_legs.append(
                 _with_deadline(
-                    lambda: _corpus_leg(contracts, None), _leg_deadline()
+                    lambda room=room: _corpus_leg(
+                        contracts, None, deadline_s=max(30, room - 30)
+                    ),
+                    room,
                 )
             )
+            room = _leg_deadline()
             host_legs.append(
                 _with_deadline(
-                    lambda: _corpus_leg(contracts, False), _leg_deadline()
+                    lambda room=room: _corpus_leg(
+                        contracts, False, deadline_s=max(30, room - 30)
+                    ),
+                    room,
                 )
             )
             print(
@@ -439,6 +460,28 @@ def bench_corpus_convergence(strict: bool = True) -> dict:
     for k, v in (median_leg.get("prepass") or {}).items():
         if k not in ("scope", "partial"):
             out[f"prepass_{k}"] = v
+    # the pipelined-wave-engine headline metrics, promoted out of the
+    # prepass_* namespace (ISSUE 4 acceptance: bench reports them):
+    # how much device execution the host covered with concurrent work,
+    # how often the device sat with no wave in flight, and what the
+    # compacted per-wave readback transferred vs the full tables
+    for alias in (
+        "wave_overlap_ratio",
+        "device_idle_frac",
+        "evidence_bytes_per_wave",
+        "waves_overlapped",
+        "pipelined",
+    ):
+        if f"prepass_{alias}" in out:
+            out[alias] = out[f"prepass_{alias}"]
+    if out.get("prepass_evidence_bytes_full") and out.get(
+        "prepass_evidence_bytes"
+    ):
+        out["evidence_compaction_ratio"] = round(
+            out["prepass_evidence_bytes_full"]
+            / max(1, out["prepass_evidence_bytes"]),
+            2,
+        )
     return out
 
 

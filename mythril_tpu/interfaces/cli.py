@@ -339,6 +339,19 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--no-pipeline",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable the pipelined wave engine (double-buffered "
+                "async dispatch + donated arena buffers): the device "
+                "exploration falls back to the lock-step "
+                "dispatch/harvest/solve schedule — the differential "
+                "baseline for a suspected pipelining bug"
+            ),
+        ),
+    ),
+    (
         ("--device-prepass",),
         dict(
             choices=["auto", "always", "never"],
@@ -687,6 +700,14 @@ def build_parser() -> ArgumentParser:
         default=None,
         help="where drain checkpoints land (default: a temp dir)",
     )
+    serve.add_argument(
+        "--no-pipeline",
+        action="store_true",
+        help=(
+            "disable double-buffered wave pipelining (dispatch wave "
+            "N+1 while harvesting wave N); lock-step waves instead"
+        ),
+    )
 
     submit = subparsers.add_parser(
         "submit",
@@ -1027,6 +1048,7 @@ def _run_analyze(disassembler, address, args):
         device_ownership=args.device_ownership,
         deterministic_solving=args.deterministic_solving,
         static_prune=not args.no_static_prune,
+        pipeline=not args.no_pipeline,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
     )
@@ -1153,6 +1175,7 @@ def _cmd_serve(args: Namespace) -> None:
         execution_timeout=args.execution_timeout,
         transaction_count=args.transaction_count,
         checkpoint_dir=args.checkpoint_dir,
+        pipeline=not args.no_pipeline,
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()

@@ -44,11 +44,39 @@ _NAME = {entry[0]: name for name, entry in OPCODES.items()}
 TT256M1 = 2**256 - 1
 
 
+def _pow2(n: int, cap: int) -> int:
+    """Smallest power of two >= n, clamped to [1, cap]."""
+    return min(cap, 1 << max(int(n) - 1, 0).bit_length()) if n > 1 else 1
+
+
 class ArenaView:
-    """Read-only host copy of one wave's arena + per-lane journals."""
+    """Read-only host copy of one wave's arena + per-lane journals —
+    and, since the pipelined wave engine, of everything else the
+    explorer's harvest reads (halt status/pc, gas bounds, storage
+    journals), so one compacted transfer replaces the full-table
+    `device_get` the wave loop used to pay.
+
+    Compaction: the arena tables are ARENA_CAP rows and the storage
+    journals storage_cap columns on device, but a wave typically
+    fills a small fraction of both. Two scalar counters (`ar_count`,
+    max `storage_cnt`) are fetched first and the bulk transfer is
+    sliced on device to their power-of-two buckets — the slice is a
+    device-side op, so only the bucketed rows ever cross the link.
+    `bytes_fetched` / `bytes_full` record what the compaction saved
+    (ExploreStats.evidence_bytes feeds bench's
+    `evidence_bytes_per_wave`)."""
 
     def __init__(self, symb) -> None:
         import jax
+
+        # the two dynamic row counts that size the bundled transfer —
+        # a tiny sync fetch ahead of the bulk one
+        count, max_cnt = jax.device_get(
+            (symb.ar_count, symb.base.storage_cnt.max())
+        )
+        self.count = int(count)
+        ar_rows = _pow2(self.count, int(symb.ar_op.shape[0]))
+        sj_w = _pow2(int(max_cnt), int(symb.base.storage_keys.shape[1]))
 
         # one bundled transfer: sequential per-array np.asarray pays a
         # separate device round-trip each (measured 2.8s vs 1.3s for a
@@ -78,14 +106,20 @@ class ArenaView:
             self.ret_len,
             self.sval_tid,
             self.mem_tid_head,
-            count,
+            self.status,
+            self.halt_pc,
+            self.gas_min,
+            self.gas_max,
+            self.storage_keys,
+            self.storage_vals,
+            self.storage_cnt,
         ) = jax.device_get(
             (
-                symb.ar_op,
-                symb.ar_a,
-                symb.ar_b,
-                symb.ar_va,
-                symb.ar_vb,
+                symb.ar_op[:ar_rows],
+                symb.ar_a[:ar_rows],
+                symb.ar_b[:ar_rows],
+                symb.ar_va[:ar_rows],
+                symb.ar_vb[:ar_rows],
                 symb.base.br_pc,
                 symb.base.br_taken,
                 symb.br_tid,
@@ -109,14 +143,37 @@ class ArenaView:
                 # while covering them (beyond-head windows degrade to
                 # "unused", which only costs pre-emption)
                 symb.mem_tid[:, :512],
-                symb.ar_count,
+                symb.base.status,
+                symb.base.pc,
+                symb.base.gas_min,
+                symb.base.gas_max,
+                symb.base.storage_keys[:, :sj_w],
+                symb.base.storage_vals[:, :sj_w],
+                symb.base.storage_cnt,
             )
         )
-        self.count = int(count)
+        self.bytes_fetched = sum(
+            getattr(a, "nbytes", 0) for a in vars(self).values()
+        )
+        # what the uncompacted harvest transferred: full arena tables
+        # plus full-width storage journals
+        self.bytes_full = self.bytes_fetched + (
+            (symb.ar_op.shape[0] - ar_rows)
+            * (self.op.itemsize * 3 + self.va.itemsize * self.va.shape[-1] * 2)
+            + 2
+            * (symb.base.storage_keys.shape[1] - sj_w)
+            * self.storage_keys.shape[0]
+            * self.storage_keys.shape[-1]
+            * self.storage_keys.itemsize
+        )
         self._closure: Dict[int, frozenset] = {}
         self._terms: Dict[int, BitVec] = {}
         self._cd_bytes: Dict[int, BitVec] = {}
         self._fresh = 0
+
+    def storage_tables(self):
+        """(keys, vals, cnt) in the state.storage_dict_from shape."""
+        return self.storage_keys, self.storage_vals, self.storage_cnt
 
     # -- variables ------------------------------------------------------
     def calldata_byte(self, i: int) -> BitVec:

@@ -11,12 +11,18 @@ device topology — the lane axis reshards on load.
 from __future__ import annotations
 
 import json
+import logging
+import os
+import queue
+import threading
 from pathlib import Path
-from typing import Dict, Optional, Tuple, Union
+from typing import Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 
 from mythril_tpu.laser.batch.state import CodeTable, StateBatch
+
+log = logging.getLogger(__name__)
 
 FORMAT_VERSION = 4  # v2: + pc_seen/branch journal; v3: + empty_world;
 #                     v4: + arena-shape metadata (the mismatch gate)
@@ -73,13 +79,19 @@ def save_checkpoint(
     code: Optional[CodeTable] = None,
     step: int = 0,
     extra: Optional[Dict[str, np.ndarray]] = None,
+    atomic: bool = False,
 ) -> None:
     """Write the frontier (and optionally the code table) to `path`.
 
     `extra` arrays ride along under their own namespace — the wave
     flush (explore.py) stores per-lane context the StateBatch itself
     doesn't carry (e.g. the synthetic-storage mask), so a resumed wave
-    replays exactly. Readers that don't know the extras ignore them."""
+    replays exactly. Readers that don't know the extras ignore them.
+
+    `atomic` writes to a sibling temp file and renames it into place:
+    the background wave-checkpoint writer uses this so a crash mid-
+    write leaves the PREVIOUS complete checkpoint on disk, never a
+    truncated npz."""
     arrays = {f"batch.{name}": np.asarray(value) for name, value in batch._asdict().items()}
     if code is not None:
         arrays.update(
@@ -95,7 +107,91 @@ def save_checkpoint(
     arrays["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     )
-    np.savez_compressed(str(path), **arrays)
+    if not atomic:
+        np.savez_compressed(str(path), **arrays)
+        return
+    # mirror np.savez's extension rule so `path` names the final file
+    target = str(path) if str(path).endswith(".npz") else str(path) + ".npz"
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as fh:  # a file handle defeats suffix munging
+        np.savez_compressed(fh, **arrays)
+    os.replace(tmp, target)
+
+
+class WaveCheckpointWriter:
+    """Background npz flusher for the pipelined wave engine.
+
+    The explorer used to serialize each wave's seeded frontier inline
+    before the dispatch — seconds of npz compression on the critical
+    path. This writer takes the flush off that path: `submit` enqueues
+    a build-and-write closure onto one daemon worker; writes are FIFO
+    (last wave wins at a fixed path) and atomic (temp + rename), so an
+    interrupted run holds the last COMPLETE frontier instead of a torn
+    one. The durability trade: a process killed between dispatch and
+    the worker's rename replays the previous wave, not the in-flight
+    one — documented in docs/device_engine.md.
+
+    `flush` blocks until everything submitted so far is on disk (the
+    explorer calls it before its run() returns, so outcomes never race
+    their own checkpoints)."""
+
+    def __init__(self, name: str = "wave-ckpt-writer") -> None:
+        self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._pending = 0
+        self.written = 0
+        self.failed = 0
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name=self._name, daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+                self.written += 1
+            except Exception:
+                self.failed += 1
+                log.warning("wave checkpoint flush failed", exc_info=True)
+            finally:
+                with self._idle:
+                    self._pending -= 1
+                    self._idle.notify_all()
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        with self._idle:
+            self._pending += 1
+        self._ensure_thread()
+        self._q.put(fn)
+
+    def flush(self, timeout_s: float = 60.0) -> bool:
+        """Wait for every submitted write to land; False on timeout
+        (the run proceeds — checkpoints are an optimization, never a
+        requirement)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self._pending > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(left)
+        return True
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
 
 
 def checkpoint_shape(path: Union[str, Path]) -> Dict[str, int]:

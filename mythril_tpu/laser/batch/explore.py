@@ -53,13 +53,23 @@ from mythril_tpu.exceptions import (
     UnsatError,
 )
 from mythril_tpu.laser.batch.arena import ArenaView
+from mythril_tpu.laser.batch.checkpoint import (
+    WaveCheckpointWriter,
+    save_checkpoint,
+)
 from mythril_tpu.laser.batch.state import (
     Status,
     make_batch,
     make_code_table,
     storage_dict_from,
 )
-from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_run
+from mythril_tpu.laser.batch.symbolic import (
+    make_sym_batch,
+    reseed_wave,
+    reseed_wave_donated,
+    sym_run,
+    sym_run_donated,
+)
 from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
 from mythril_tpu.laser.smt.solver.solver import lower
 from mythril_tpu.support.model import get_model
@@ -122,7 +132,12 @@ class ExploreStats:
     """Counters proving the device did the stepping."""
 
     def __init__(self) -> None:
-        self.device_steps = 0  # lane-steps executed on device
+        # lane-steps executed on device, counting only lanes that were
+        # still RUNNING at each step (the while_loop's own knowledge);
+        # the raw product steps x lanes — which overcounts the halted
+        # tail — is kept beside it for the utilization comparison
+        self.device_steps = 0
+        self.device_steps_raw = 0
         self.waves = 0
         self.transactions = 0  # deepest transaction index reached (1-based)
         self.arena_nodes = 0
@@ -160,6 +175,32 @@ class ExploreStats:
         # flip solving (the two phases that can dominate)
         self.wave_exec_s = 0.0
         self.flip_solve_s = 0.0
+        # -- pipelined wave engine observability ----------------------
+        #: 1 when the double-buffered schedule ran this exploration
+        self.pipelined = 0
+        #: most waves simultaneously in flight (2 = the pipeline)
+        self.waves_inflight_max = 0
+        #: harvests that ran with another wave executing on device —
+        #: the integer the ratio below normalizes (robust to rounding
+        #: on tiny workloads)
+        self.waves_overlapped = 0
+        #: host-side work (evidence consume + flip solving + next-wave
+        #: seeding) done WHILE a wave was executing on device
+        self.wave_overlap_s = 0.0
+        #: host blocked waiting on a wave's readiness (device working,
+        #: host idle) / device span from dispatch to readiness
+        self.device_wait_s = 0.0
+        self.device_busy_s = 0.0
+        #: overlap_s / busy_s — the fraction of device execution the
+        #: host covered with concurrent work (0 in --no-pipeline runs)
+        self.wave_overlap_ratio = 0.0
+        #: fraction of the exploration wall with NO wave in flight
+        self.device_idle_frac = 0.0
+        #: bytes the compacted per-wave readback actually transferred,
+        #: and what the full-table transfer would have cost
+        self.evidence_bytes = 0
+        self.evidence_bytes_full = 0
+        self.evidence_bytes_per_wave = 0
 
     def as_dict(self) -> Dict[str, int]:
         return dict(self.__dict__)
@@ -449,10 +490,12 @@ class _ContractTrack:
         at the end of the whole corpus run."""
         if self.parked:
             return True
-        if getattr(self, "_poison_wave_pending", False):
-            # a freshly-seeded poison stripe runs NEXT wave — its
-            # results must be harvested before completeness can claim
-            # the storage dimension was sampled
+        if getattr(self, "_poison_pending_serial", None) is not None:
+            # a freshly-seeded poison stripe is scheduled for a wave
+            # that has not been HARVESTED yet (under the pipelined
+            # schedule that wave may still be two dispatches out) —
+            # its results must land before completeness can claim the
+            # storage dimension was sampled
             return False
         gates = self.completeness_gates()
         gates["frontier_closed"] = self.idle or self.still_exhausted()
@@ -553,6 +596,7 @@ class _ContractTrack:
         self.poison_carries = []
         self.storage_reads = set()
         self._poison_keys = set()
+        self._poison_pending_serial = None
         if not self.next_carries:
             self.idle = True
             # keep a placeholder so the lane stripe stays shape-stable
@@ -606,6 +650,47 @@ class _ContractTrack:
         }
 
 
+class _WavePayload:
+    """One wave's host-side seed snapshot: everything needed to (a)
+    dispatch it, (b) re-dispatch it cold after a fault, (c) flush its
+    checkpoint from a background thread, and (d) consume its results
+    — all WITHOUT touching live track state, which later harvests
+    mutate in place while this wave is still in flight."""
+
+    __slots__ = (
+        "inputs", "flat", "lane_carry", "carries", "storage_seed",
+        "callvalues", "balances", "synthetic", "serial",
+    )
+
+    def __init__(
+        self, inputs, flat, lane_carry, carries, storage_seed,
+        callvalues, balances, synthetic, serial,
+    ) -> None:
+        self.inputs = inputs
+        self.flat = flat
+        self.lane_carry = lane_carry
+        self.carries = carries
+        self.storage_seed = storage_seed
+        self.callvalues = callvalues
+        self.balances = balances
+        self.synthetic = synthetic
+        self.serial = serial
+
+
+class _Inflight:
+    """A dispatched, not-yet-harvested wave."""
+
+    __slots__ = ("payload", "out", "steps", "active", "dispatch_t", "failed")
+
+    def __init__(self, payload: _WavePayload) -> None:
+        self.payload = payload
+        self.out = None
+        self.steps = None
+        self.active = None
+        self.dispatch_t = None
+        self.failed = None
+
+
 class DeviceCorpusExplorer:
     """Explore a corpus of contracts in one lane-striped StateBatch.
 
@@ -637,9 +722,11 @@ class DeviceCorpusExplorer:
         storage_cap: int = 128,
         deadline=None,
         checkpoint_path=None,
+        pipeline: Optional[bool] = None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
+        from mythril_tpu.support.support_args import args as _flags
 
         ensure_compile_cache()
         self.tracks = [
@@ -676,6 +763,27 @@ class DeviceCorpusExplorer:
         #: so a wave killed mid-flight replays exactly (replay_wave)
         self.deadline = deadline
         self.checkpoint_path = checkpoint_path
+        #: double-buffered wave pipelining (--no-pipeline turns it
+        #: off): up to two waves in flight, wave N+1 seeded from the
+        #: frontier known BEFORE wave N's results, so the host's
+        #: evidence consume + flip solving for wave N overlap the
+        #: device's execution of wave N+1
+        self.pipeline = (
+            bool(getattr(_flags, "pipeline", True))
+            if pipeline is None
+            else bool(pipeline)
+        )
+        #: background npz flusher (checkpoint.py): the per-wave
+        #: seeded-frontier flush serializes off the critical path
+        self._ckpt_writer = (
+            WaveCheckpointWriter() if checkpoint_path else None
+        )
+        #: the most recently harvested wave's device buffers — the
+        #: next dispatch's donation fodder (arena reuse): None forces
+        #: the cold make_batch upload path
+        self._carcass = None
+        self._donate: Optional[bool] = None
+        self._wave_serial = 0
         self._halt_reason = None
         #: set while this explorer wants/holds the host lock — the
         #: overlapped owner only needs to yield between analyses when
@@ -697,6 +805,7 @@ class DeviceCorpusExplorer:
         self.storage_cap = storage_cap
         self.rng = random.Random(seed)
         self.stats = ExploreStats()
+        self.stats.pipelined = int(self.pipeline)
         self.stats.static_summaries = sum(
             1 for t in self.tracks if t.static is not None
         )
@@ -706,6 +815,12 @@ class DeviceCorpusExplorer:
         # kernel per size class, not one per corpus composition
         cap = code_cap_bucket(max((len(c) for c in self.codes), default=1))
         self.code_table = make_code_table(self.codes, code_cap=cap)
+        # host copy for the background checkpoint writer: the table
+        # never changes, so snapshotting it once keeps the writer from
+        # pulling it back over the link every wave
+        self._code_table_host = type(self.code_table)(
+            *(np.asarray(a) for a in self.code_table)
+        )
         self.code_ids = np.repeat(
             np.arange(len(self.codes), dtype=np.int32), lanes_per_contract
         )
@@ -768,9 +883,16 @@ class DeviceCorpusExplorer:
         return False
 
     # -- seeding -------------------------------------------------------
-    def _seed_phase_inputs(self) -> List[List[Tuple[int, bytes]]]:
+    def _seed_phase_inputs(
+        self, offset: int = 0
+    ) -> List[List[Tuple[int, bytes]]]:
         """Per contract: (carry index, calldata) pairs — every carry
-        crossed with the dispatcher seeds, round-robin to the stripe."""
+        crossed with the dispatcher seeds, round-robin to the stripe.
+
+        `offset` continues the same deterministic seed stream `offset`
+        stripes further along — the pipelined schedule fills its
+        second in-flight slot with the stream's next window (the only
+        inputs derivable before any wave has been harvested)."""
         from mythril_tpu.laser.batch.seeds import dispatcher_seeds
 
         stripes = []
@@ -798,9 +920,13 @@ class DeviceCorpusExplorer:
                     )
                 )
             n_carries = len(track.carries)
+            shift = offset * self.lanes_per_contract
             stripes.append(
                 [
-                    (j % n_carries, seeds[(j // n_carries) % len(seeds)])
+                    (
+                        j % n_carries,
+                        seeds[((j + shift) // n_carries) % len(seeds)],
+                    )
                     for j in range(self.lanes_per_contract)
                 ]
             )
@@ -905,34 +1031,109 @@ class DeviceCorpusExplorer:
         return bytes(data)
 
     # -- the wave ------------------------------------------------------
-    def _run_wave(self, inputs: List[List[Tuple[int, bytes]]]) -> ArenaView:
+    def _donation_ok(self) -> bool:
+        """Buffer donation only where the backend honors it (the CPU
+        client warns and ignores donations — noise, no win)."""
+        if self._donate is None:
+            import jax
+
+            self._donate = jax.default_backend() != "cpu"
+        return self._donate
+
+    def _prepare_wave(self, inputs: List[List[Tuple[int, bytes]]]):
+        """Snapshot one wave's host-side seed data (a _WavePayload) and
+        hand its checkpoint flush to the background writer.
+
+        The snapshot matters: carry journals are mutated in place by
+        later harvests (ensure_poison_carries), so both the dispatch
+        and the asynchronously-written checkpoint must read copies
+        taken at seeding time — the flushed frontier is the one that
+        DISPATCHED, whatever the host learned afterwards."""
         flat = [pair for stripe in inputs for pair in stripe]
         L = self.lanes_per_contract
-        storage_seed = [
-            self.tracks[lane // L].carries[ci]["journal"]
-            for lane, (ci, _) in enumerate(flat)
-        ]
-        callvalues = [
-            self.tracks[lane // L].carries[ci].get("callvalue", 0)
-            for lane, (ci, _) in enumerate(flat)
-        ]
+        carries = []
+        for lane, (ci, _) in enumerate(flat):
+            live = self.tracks[lane // L].carries[ci]
+            snap = dict(live)
+            snap["journal"] = dict(live["journal"])
+            snap["prefix"] = list(live["prefix"])
+            if live.get("base"):
+                snap["base"] = dict(live["base"])
+            if live.get("prefix_values"):
+                snap["prefix_values"] = list(live["prefix_values"])
+            carries.append(snap)
+        payload = _WavePayload(
+            inputs=inputs,
+            flat=flat,
+            lane_carry=[ci for ci, _ in flat],
+            carries=carries,
+            storage_seed=[c["journal"] for c in carries],
+            callvalues=[c.get("callvalue", 0) for c in carries],
+            balances=[
+                c.get("balance", REPLAY_ENV["balance"]) for c in carries
+            ],
+            synthetic=np.array([bool(c.get("base")) for c in carries]),
+            serial=self._wave_serial,
+        )
+        self._wave_serial += 1
+        if self._ckpt_writer is not None:
+            # flush the SEEDED frontier: a wave killed mid-flight
+            # (fault, OOM, SIGKILL) leaves its exact inputs on disk,
+            # and the engine is deterministic, so replay_wave
+            # reproduces the lost wave bit-for-bit. The serialization
+            # runs on the writer thread (atomic rename), overlapping
+            # the dispatch instead of preceding it.
+            path = self.checkpoint_path
+            table = self._code_table_host
+            steps = self.steps_per_wave
+
+            def _flush(payload=payload):
+                env = dict(REPLAY_ENV)
+                env["balance"] = payload.balances
+                frontier = make_batch(
+                    len(payload.flat),
+                    code_ids=self.code_ids,
+                    calldata=[data for _, data in payload.flat],
+                    callvalue=payload.callvalues,
+                    caller=DEFAULT_CALLER,
+                    address=self.address,
+                    mem_cap=self.mem_cap,
+                    storage_cap=self.storage_cap,
+                    storage_seed=payload.storage_seed,
+                    empty_world=self.empty_world,
+                    as_numpy=True,
+                    **env,
+                )
+                save_checkpoint(
+                    path,
+                    frontier,
+                    table,
+                    step=steps,
+                    extra={
+                        "synthetic": payload.synthetic.astype(np.uint8)
+                    },
+                    atomic=True,
+                )
+
+            self._ckpt_writer.submit(_flush)
+            self.stats.wave_checkpoints += 1
+        return payload
+
+    def _cold_sym(self, payload):
+        """Full host-side batch build + upload (the first wave, every
+        mesh-sharded wave, and the fault-retry path)."""
         env = dict(REPLAY_ENV)
-        env["balance"] = [
-            self.tracks[lane // L].carries[ci].get(
-                "balance", REPLAY_ENV["balance"]
-            )
-            for lane, (ci, _) in enumerate(flat)
-        ]
+        env["balance"] = payload.balances
         base = make_batch(
-            len(flat),
+            len(payload.flat),
             code_ids=self.code_ids,
-            calldata=[data for _, data in flat],
-            callvalue=callvalues,
+            calldata=[data for _, data in payload.flat],
+            callvalue=payload.callvalues,
             caller=DEFAULT_CALLER,
             address=self.address,
             mem_cap=self.mem_cap,
             storage_cap=self.storage_cap,
-            storage_seed=storage_seed,
+            storage_seed=payload.storage_seed,
             empty_world=self.empty_world,
             **env,
         )
@@ -941,13 +1142,7 @@ class DeviceCorpusExplorer:
 
             base = shard_batch(base, self.mesh)
         sym = make_sym_batch(base)
-        synthetic = np.array(
-            [
-                bool(self.tracks[lane // L].carries[ci].get("base"))
-                for lane, (ci, _) in enumerate(flat)
-            ]
-        )
-        if synthetic.any():
+        if payload.synthetic.any():
             # poisoned start states are SAMPLES of the host's symbolic
             # initial storage: reads of them must count as opaque so
             # arithmetic over them banks (wrap or opaque-site) events
@@ -960,72 +1155,178 @@ class DeviceCorpusExplorer:
             )
             sym = sym._replace(
                 sval_tid=jnp.where(
-                    jnp.asarray(synthetic)[:, None] & seeded,
+                    jnp.asarray(payload.synthetic)[:, None] & seeded,
                     jnp.int32(-1),
                     sym.sval_tid,
                 )
             )
-        if self.checkpoint_path:
-            # flush the SEEDED frontier before the dispatch: a wave
-            # killed mid-flight (fault, OOM, SIGKILL) leaves its exact
-            # inputs on disk, and the engine is deterministic, so
-            # replay_wave reproduces the lost wave bit-for-bit
-            try:
-                from mythril_tpu.laser.batch.checkpoint import save_checkpoint
+        return sym
 
-                save_checkpoint(
-                    self.checkpoint_path,
-                    base,
-                    self.code_table,
-                    step=self.steps_per_wave,
-                    extra={"synthetic": synthetic.astype(np.uint8)},
-                )
-                self.stats.wave_checkpoints += 1
-            except Exception:
-                log.warning("wave checkpoint flush failed", exc_info=True)
+    def _warm_sym(self, payload):
+        """Device-side reseed out of the previous wave's buffers: the
+        host uploads only the per-wave seed delta (calldata, values,
+        a width-bucketed storage slab) — symbolic.reseed_wave."""
+        from mythril_tpu.ops import u256
 
+        n = len(payload.flat)
+        limbs = u256.LIMBS
+        widest = max((len(j) for j in payload.storage_seed), default=0)
+        w = 1
+        while w < min(widest, self.storage_cap):
+            w <<= 1
+        skeys = np.zeros((n, w, limbs), np.uint32)
+        svals = np.zeros((n, w, limbs), np.uint32)
+        scnt = np.zeros((n,), np.int32)
+        for i, journal in enumerate(payload.storage_seed):
+            for j, (slot, value) in enumerate(
+                list(journal.items())[: self.storage_cap]
+            ):
+                skeys[i, j] = u256.from_int(slot)
+                svals[i, j] = u256.from_int(value)
+                scnt[i] = j + 1
+        cd_w = 1
+        while cd_w < self.calldata_len:
+            cd_w <<= 1
+        cd = np.zeros((n, cd_w), np.uint8)
+        cds = np.zeros((n,), np.int32)
+        for i, (_ci, data) in enumerate(payload.flat):
+            m = min(len(data), cd_w)
+            if m:
+                cd[i, :m] = np.frombuffer(bytes(data[:m]), np.uint8)
+            cds[i] = len(data)
+        cv = np.stack(
+            [u256.from_int(int(v)) for v in payload.callvalues]
+        ).astype(np.uint32)
+        bal = np.stack(
+            [u256.from_int(int(v)) for v in payload.balances]
+        ).astype(np.uint32)
+        reseed = (
+            reseed_wave_donated if self._donation_ok() else reseed_wave
+        )
+        carcass, self._carcass = self._carcass, None
+        return reseed(
+            carcass,
+            self.code_ids,
+            cd,
+            cds,
+            cv,
+            bal,
+            skeys,
+            svals,
+            scnt,
+            payload.synthetic,
+        )
+
+    def _dispatch_wave(self, payload) -> "_Inflight":
+        """Seed + dispatch one wave ASYNCHRONOUSLY: the call returns as
+        soon as XLA has enqueued the computation, so the caller can
+        keep consuming the previous wave while the device runs this
+        one. Classified dispatch-time faults are captured on the
+        inflight record — harvest retries them through the ladder with
+        correct wave attribution."""
         from mythril_tpu.support import resilience
 
         resilience.inject("explore.wave")
+        fl = _Inflight(payload)
+        fl.dispatch_t = time.perf_counter()
+        try:
+            if self._carcass is not None and self.mesh is None:
+                sym = self._warm_sym(payload)
+            else:
+                sym = self._cold_sym(payload)
+            runner = (
+                sym_run_donated if self._donation_ok() else sym_run
+            )
+            fl.out, fl.steps, fl.active = runner(
+                sym, self.code_table, max_steps=self.steps_per_wave
+            )
+        except Exception as why:
+            if not resilience.is_device_fault(why):
+                raise
+            # the wave never launched: drop the (possibly half-donated)
+            # carcass and let harvest re-dispatch cold under the ladder
+            self._carcass = None
+            fl.failed = why
+        return fl
 
-        def _dispatch():
-            import jax as _jax
+    def _retry_wave(self, fl):
+        """The resilience ladder for a wave whose dispatch or readback
+        faulted: cold re-dispatch from the retained host payload (the
+        donated warm path cannot replay — its input buffers are spent),
+        synchronous, attributed to the faulted wave's serial."""
+        import jax
 
-            o, s = sym_run(sym, self.code_table, max_steps=self.steps_per_wave)
-            # surface asynchronous XLA faults inside the containment,
-            # not at some later readback outside it
-            _jax.block_until_ready(s)
-            return o, s
+        from mythril_tpu.support import resilience
 
-        out, steps = resilience.retry_device_dispatch(
-            _dispatch,
+        def _cold():
+            sym = self._cold_sym(fl.payload)
+            out, steps, active = sym_run(
+                sym, self.code_table, max_steps=self.steps_per_wave
+            )
+            jax.block_until_ready(steps)
+            return out, steps, active
+
+        return resilience.retry_device_dispatch(
+            _cold,
             label="wave",
             policy=resilience.RetryPolicy(attempts=2, base_delay_s=0.2),
         )
-        base_out = out.base
-        view = ArenaView(out)
-        self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
-        self.stats.waves += 1
-        self.stats.device_steps += int(steps) * len(flat)
 
-        # bulk reads: per-lane jax indexing (or per-array np.asarray)
-        # pays one device round-trip each — measured ~15s/wave for the
-        # lane-indexed storage journals alone on the tunnel. The
-        # branch journal is NOT fetched here: ArenaView's bundled
-        # transfer already carries it.
+    def _harvest_wave(self, fl) -> ArenaView:
+        """Block until the wave's results are ready — the single point
+        where asynchronous XLA faults surface, so the fault containment
+        lives HERE, attributed to the wave that actually faulted even
+        when a newer wave is already in flight — then pull the
+        compacted evidence readback (ArenaView)."""
         import jax
 
-        status, halt_pc, gas_min, gas_max, *tables = jax.device_get(
-            (
-                base_out.status,
-                base_out.pc,
-                base_out.gas_min,
-                base_out.gas_max,
-                base_out.storage_keys,
-                base_out.storage_vals,
-                base_out.storage_cnt,
-            )
-        )
+        from mythril_tpu.support import resilience
+
+        wait0 = time.perf_counter()
+        if fl.failed is None:
+            try:
+                resilience.inject("device.dispatch")
+                jax.block_until_ready(fl.steps)
+                out, steps, active = fl.out, fl.steps, fl.active
+            except Exception as why:
+                if not resilience.is_device_fault(why):
+                    raise
+                resilience.DegradationLog().record(
+                    resilience.DegradationReason.ASYNC_DEVICE_FAULT,
+                    site=f"wave#{fl.payload.serial}",
+                    detail=str(why),
+                )
+                self._carcass = None
+                out, steps, active = self._retry_wave(fl)
+        else:
+            out, steps, active = self._retry_wave(fl)
+        now = time.perf_counter()
+        self.stats.device_wait_s += now - wait0
+        if fl.dispatch_t is not None:
+            self.stats.device_busy_s += max(0.0, now - fl.dispatch_t)
+        view = ArenaView(out)
+        # the spent output buffers become the next dispatch's donation
+        # fodder (everything the host needs is in the view's numpy)
+        self._carcass = out if self.mesh is None else None
+        self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
+        self.stats.waves += 1
+        self.stats.device_steps += int(active)
+        self.stats.device_steps_raw += int(steps) * len(fl.payload.flat)
+        self.stats.evidence_bytes += view.bytes_fetched
+        self.stats.evidence_bytes_full += view.bytes_full
+        return view
+
+    def _consume_wave(self, view: ArenaView, payload) -> None:
+        """Fold one harvested wave into the tracks: triggers, carries,
+        coverage, evidence, poison bookkeeping. Pure host work — under
+        the pipelined schedule this (plus the reseed's flip solving)
+        is exactly what overlaps the next wave's device execution."""
+        flat = payload.flat
+        L = self.lanes_per_contract
+        status, halt_pc = view.status, view.halt_pc
+        gas_min, gas_max = view.gas_min, view.gas_max
+        tables = view.storage_tables()
+        self._lane_carry = payload.lane_carry
         self.stats.lanes_degraded_mem += int(
             (status == Status.ERR_MEM).sum()
         )
@@ -1035,9 +1336,12 @@ class DeviceCorpusExplorer:
         self._pending_props: List[Tuple[int, int, List]] = []
         srcs_memo: Dict[int, set] = {}
         for t in self.tracks:
-            # any poison stripe scheduled by the last reseed has now
-            # executed and is being harvested: finality may proceed
-            t._poison_wave_pending = False
+            # a poison stripe is accounted for once the wave CARRYING
+            # it has been harvested (under pipelining that wave may be
+            # a later serial than the next one harvested)
+            pending = getattr(t, "_poison_pending_serial", None)
+            if pending is not None and payload.serial >= pending:
+                t._poison_pending_serial = None
         for lane, (ci, data) in enumerate(flat):
             track = self.tracks[lane // L]
             if track.idle or track.parked:
@@ -1045,7 +1349,11 @@ class DeviceCorpusExplorer:
                 # because nothing (evidence, degradation, carries)
                 # mutates a frozen track
                 continue
-            carry = track.carries[ci]
+            # the SNAPSHOT carry, not the live one: poison journals
+            # are refreshed in place by harvests that may run between
+            # this wave's dispatch and its consume (pipelining), and
+            # the lane executed against the snapshot
+            carry = payload.carries[lane]
             st = int(status[lane])
             if st in (Status.ERR_MEM, Status.UNSUPPORTED):
                 track.degraded += 1
@@ -1105,7 +1413,8 @@ class DeviceCorpusExplorer:
                 # the concolic symbolic-initial-storage axis: observed
                 # never-written reads become adversarial start states
                 track.ensure_poison_carries()
-        return view
+        for ci, track in enumerate(self.tracks):
+            track.corpus.extend(payload.inputs[ci])
 
     #: env-source opcode -> the predictable-vars module's operation text
     _ENV_OPERATION = {
@@ -1587,10 +1896,15 @@ class DeviceCorpusExplorer:
             ]
             for i in pend:
                 track.carries[i]["seeded"] = True
-            # the stripe is SCHEDULED but runs next wave: finality must
-            # wait for its harvest (parking now would freeze the track
-            # with the poison results discarded — unsound ownership)
-            track._poison_wave_pending = True
+            # the stripe is SCHEDULED but runs in the wave the NEXT
+            # dispatch launches (serial self._wave_serial): finality
+            # must wait for that wave's HARVEST (parking now would
+            # freeze the track with the poison results discarded —
+            # unsound ownership). Tagging the serial — rather than a
+            # boolean the next harvest clears — keeps this sound under
+            # pipelining, where an older wave is harvested after the
+            # poison stripe was scheduled but before it runs.
+            track._poison_pending_serial = self._wave_serial
             n_poison += 1
         pending += n_poison
         #: the phase loop must not plateau-break away a wave that
@@ -1601,7 +1915,44 @@ class DeviceCorpusExplorer:
     # -- the phase loop ------------------------------------------------
     def _phase(self, txn: int) -> bool:
         """One attacker transaction's wave loop over the whole corpus;
-        False when the wall-clock budget is exhausted."""
+        False when the wall-clock budget is exhausted. The schedule is
+        either lock-step (--no-pipeline: dispatch, harvest, solve,
+        repeat) or double-buffered (default: up to two waves in
+        flight, host work overlapping device execution)."""
+        if self.pipeline:
+            return self._phase_pipelined(txn)
+        return self._phase_sync(txn)
+
+    def _plateau_break(self, plateaued: bool, n_flips: int) -> bool:
+        """Coverage stalled and flips are drying up — but only once
+        every poisoned state has had its seeding wave (those open
+        value dimensions coverage cannot see); a wave whose stripes
+        WERE just poison-seeded must run before the verdict counts."""
+        quota = len(self.tracks) * self.lanes_per_contract
+        return (
+            plateaued
+            and n_flips < max(1, quota // 4)
+            and not getattr(self, "_poison_stripes_pending", 0)
+            and not any(
+                t.unseeded_poison() for t in self.tracks if not t.idle
+            )
+        )
+
+    def _finalize_tracks(self) -> Tuple[List, bool]:
+        """Early per-contract finality (last transaction phase only):
+        contracts that just closed every ownership gate freeze NOW."""
+        newly_parked = [
+            t
+            for t in self.tracks
+            if not t.parked and t.finalize_if_complete()
+        ]
+        if newly_parked:
+            self._publish_partial()
+        return newly_parked, all(
+            t.parked or t.idle for t in self.tracks
+        )
+
+    def _phase_sync(self, txn: int) -> bool:
         inputs = self._seed_phase_inputs()
         for wave_no in range(self.waves):
             if self._stop_requested():
@@ -1610,9 +1961,11 @@ class DeviceCorpusExplorer:
                 # advance both skip _budget_spent
                 return False
             covered_before = sum(len(t.covered) for t in self.tracks)
-            self._lane_carry = [ci for stripe in inputs for ci, _ in stripe]
             w0 = time.perf_counter()
-            view = self._run_wave(inputs)
+            payload = self._prepare_wave(inputs)
+            fl = self._dispatch_wave(payload)
+            view = self._harvest_wave(fl)
+            self._consume_wave(view, payload)
             self._wave_times.append(time.perf_counter() - w0)
             self.stats.wave_exec_s += self._wave_times[-1]
             if txn == 0 and wave_no == 0:
@@ -1620,8 +1973,6 @@ class DeviceCorpusExplorer:
                 # (amortized machine-wide by the persistent cache);
                 # the budget governs the steady-state loop after it
                 self._t0 = time.perf_counter()
-            for ci, track in enumerate(self.tracks):
-                track.corpus.extend(inputs[ci])
             self._publish_partial()
             if wave_no == self.waves - 1:
                 # the wave cap ends the phase with the final wave's
@@ -1642,34 +1993,110 @@ class DeviceCorpusExplorer:
                 # closed all its ownership gates freezes NOW, and the
                 # publisher announces it so the analysis loop can skip
                 # its host walk without waiting for the corpus run
-                newly_parked = [
-                    t
-                    for t in self.tracks
-                    if not t.parked and t.finalize_if_complete()
-                ]
-                if newly_parked:
-                    self._publish_partial()
-                if all(t.parked or t.idle for t in self.tracks):
+                _, all_done = self._finalize_tracks()
+                if all_done:
                     return True  # everything owned or inert: run over
             if fresh is None:
                 break  # every frontier exhausted: the plateau signal
-            quota = len(self.tracks) * self.lanes_per_contract
-            if (
-                plateaued
-                and n_flips < max(1, quota // 4)
-                and not getattr(self, "_poison_stripes_pending", 0)
-                and not any(
-                    t.unseeded_poison() for t in self.tracks if not t.idle
-                )
-            ):
-                # coverage stalled and flips are drying up — but only
-                # once every poisoned state has had its seeding wave
-                # (those open value dimensions coverage cannot see);
-                # a wave whose stripes WERE just poison-seeded must
-                # run before the plateau verdict counts
+            if self._plateau_break(plateaued, n_flips):
                 break
             inputs = fresh
         return True
+
+    def _phase_pipelined(self, txn: int) -> bool:
+        """The double-buffered schedule: wave N+1 is seeded from the
+        frontier known BEFORE wave N's results and dispatched ahead of
+        wave N's consume, so the host's evidence consumption and flip
+        solving for wave N overlap the device's execution of wave N+1
+        (the flip witnesses land in wave N+2 — one wave later than the
+        lock-step schedule, bought back by the extra dispatch slot).
+
+        The in-flight queue holds at most two waves. Harvest order is
+        dispatch order; the fault containment in _harvest_wave keeps
+        per-wave attribution even when the fault is asynchronous."""
+        from collections import deque
+
+        inflight: "deque[_Inflight]" = deque()
+        # the warm-up second slot rides free: the lock-step schedule
+        # gets `waves` reseed generations, and so does this one
+        dispatch_budget = self.waves + 1 if self.waves > 1 else self.waves
+        dispatched = 0
+        stop_dispatch = False
+        finished = True
+        harvested = 0
+
+        def _launch(stripes) -> None:
+            nonlocal dispatched
+            payload = self._prepare_wave(stripes)
+            inflight.append(self._dispatch_wave(payload))
+            dispatched += 1
+            self.stats.waves_inflight_max = max(
+                self.stats.waves_inflight_max, len(inflight)
+            )
+
+        if self._stop_requested():
+            return False
+        _launch(self._seed_phase_inputs())
+        if dispatch_budget > 1 and not self._stop_requested():
+            # the second pipeline slot: the seed stream's next window —
+            # the only inputs derivable before any harvest
+            _launch(self._seed_phase_inputs(offset=1))
+
+        while inflight:
+            fl = inflight.popleft()
+            w0 = time.perf_counter()
+            view = self._harvest_wave(fl)
+            h0 = time.perf_counter()
+            overlapping = bool(inflight)  # device busy with wave N+1
+            covered_before = sum(len(t.covered) for t in self.tracks)
+            self._consume_wave(view, fl.payload)
+            self._wave_times.append(time.perf_counter() - w0)
+            self.stats.wave_exec_s += self._wave_times[-1]
+            harvested += 1
+            if txn == 0 and harvested == 1:
+                self._t0 = time.perf_counter()
+            self._publish_partial()
+            covered_now = sum(len(t.covered) for t in self.tracks)
+
+            if not stop_dispatch and self._stop_requested():
+                stop_dispatch = True
+                finished = False
+            if not stop_dispatch and dispatched >= dispatch_budget:
+                stop_dispatch = True  # the wave cap: drain what's left
+            if not stop_dispatch and self._budget_spent():
+                stop_dispatch = True
+                finished = False
+            if not stop_dispatch:
+                plateaued = harvested > 1 and covered_now == covered_before
+                fresh, n_flips = self._reseed(view)
+                if fresh is not None and not self._plateau_break(
+                    plateaued, n_flips
+                ):
+                    _launch(fresh)
+                # an exhausted/plateaued frontier on THIS wave only
+                # skips launching from it — a still-in-flight wave may
+                # carry flip witnesses that reopen it, and ITS harvest
+                # gets its own reseed verdict (the lock-step loop's
+                # break maps to the drain running out of launches).
+                # Per-contract finality is NOT checked here: every
+                # in-flight wave carries live stripes for every
+                # unparked track (mutation fill), so parking one now
+                # would discard results already executing — the
+                # lock-step schedule's mid-phase parking moves to the
+                # drain end below, where nothing is in flight.
+            if overlapping:
+                self.stats.waves_overlapped += 1
+                self.stats.wave_overlap_s += time.perf_counter() - h0
+
+        # the phase's last harvested wave(s) were never reseeded: the
+        # lock-step final-wave rule applies — only provably-still-
+        # exhausted frontiers stay closed
+        for track in self.tracks:
+            if not track.idle and not track.still_exhausted():
+                track.frontier_closed = False
+        if txn == self.transaction_count - 1 and finished:
+            self._finalize_tracks()
+        return finished
 
     def _publish_partial(self) -> None:
         if self.publish is None:
@@ -1745,6 +2172,11 @@ class DeviceCorpusExplorer:
         try:
             return self._run_phases()
         finally:
+            if self._ckpt_writer is not None:
+                # outcomes must never race their own checkpoints; close
+                # also retires the worker thread (a later run() would
+                # lazily restart it)
+                self._ckpt_writer.close()
             DEVICE_BUSY.release()
 
     def _run_phases(self) -> Dict:
@@ -1847,6 +2279,30 @@ class DeviceCorpusExplorer:
         self.stats.wall_s = round(time.perf_counter() - self._t_start, 3)
         self.stats.wave_exec_s = round(self.stats.wave_exec_s, 3)
         self.stats.flip_solve_s = round(self.stats.flip_solve_s, 3)
+        # pipeline observability: how much of the device's execution
+        # span the host covered with concurrent work, how much of the
+        # run the device sat idle, and what the compacted readback
+        # transferred per wave (bench.py reports all three)
+        busy = self.stats.device_busy_s
+        wall = self.stats.wall_s
+        self.stats.wave_overlap_ratio = (
+            round(min(1.0, self.stats.wave_overlap_s / busy), 3)
+            if busy > 0
+            else 0.0
+        )
+        self.stats.device_idle_frac = (
+            round(max(0.0, min(1.0, 1.0 - busy / wall)), 3)
+            if wall > 0
+            else 0.0
+        )
+        self.stats.evidence_bytes_per_wave = (
+            int(self.stats.evidence_bytes / self.stats.waves)
+            if self.stats.waves
+            else 0
+        )
+        self.stats.device_wait_s = round(self.stats.device_wait_s, 3)
+        self.stats.device_busy_s = round(self.stats.device_busy_s, 3)
+        self.stats.wave_overlap_s = round(self.stats.wave_overlap_s, 3)
         stats = self.stats.as_dict()
         if self._halt_reason:
             # WHY the run ended early (deadline-expired / interrupted /
@@ -1869,9 +2325,9 @@ def replay_wave(path, expect_shape=None):
     table + synthetic-storage mask) to `checkpoint_path` before the
     dispatch, so a run killed mid-wave loses nothing: this function
     reloads the npz, rebuilds the symbolic batch — reapplying the
-    synthetic mask the same way `_run_wave` did — and runs the wave to
-    the same step budget. The engine is deterministic, so the replayed
-    coverage/status/evidence equal the uninterrupted wave's
+    synthetic mask the same way the wave dispatch did — and runs the
+    wave to the same step budget. The engine is deterministic, so the
+    replayed coverage/status/evidence equal the uninterrupted wave's
     (tests/laser/test_resilience.py asserts this bit-for-bit).
 
     `expect_shape` (checkpoint.arena_shape dict, partial fine) makes a
@@ -1904,7 +2360,7 @@ def replay_wave(path, expect_shape=None):
                 sym.sval_tid,
             )
         )
-    out, steps = sym_run(sym, code, max_steps=int(wave_steps))
+    out, steps, _active = sym_run(sym, code, max_steps=int(wave_steps))
     return ArenaView(out), out, int(steps)
 
 
@@ -1926,6 +2382,7 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
         address: int = DEFAULT_ADDRESS,
         transaction_count: int = 1,
         empty_world: bool = True,
+        pipeline: Optional[bool] = None,
     ) -> None:
         super().__init__(
             [code_hex],
@@ -1940,6 +2397,7 @@ class DeviceSymbolicExplorer(DeviceCorpusExplorer):
             address=address,
             transaction_count=transaction_count,
             empty_world=empty_world,
+            pipeline=pipeline,
         )
 
     # single-contract views over the corpus bookkeeping

@@ -18,10 +18,8 @@ from mythril_tpu.laser.batch.state import CodeTable, StateBatch, Status
 from mythril_tpu.laser.batch.step import step
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"))
-def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
-        unroll: int = 1, track_coverage: bool = True):
+def _run_impl(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
+              unroll: int = 1, track_coverage: bool = True):
     """Run all lanes to completion (or step budget). Returns
     (final_batch, steps_executed)."""
 
@@ -37,6 +35,19 @@ def run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
 
     out, steps = lax.while_loop(cond, body, (batch, jnp.int32(0)))
     return out, steps
+
+
+run = functools.partial(
+    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"))(
+    _run_impl)
+#: donated variant for the pipelined service wave loop: the seeded
+#: input batch is consumed by the dispatch so XLA reuses its buffers
+#: for the output. Callers must never read the input batch afterwards
+#: and must rebuild it from host data to retry a faulted dispatch —
+#: run_resilient therefore keeps the undonated kernel.
+run_donated = functools.partial(
+    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"),
+    donate_argnums=(0,))(_run_impl)
 
 
 def run_resilient(

@@ -135,7 +135,7 @@ def make_code_table(codes, code_cap: int = None) -> CodeTable:
 
 
 def _word_rows(n, value: int = 0):
-    return jnp.broadcast_to(jnp.asarray(u256.from_int(value)), (n, u256.LIMBS))
+    return np.broadcast_to(np.asarray(u256.from_int(value)), (n, u256.LIMBS))
 
 
 def make_batch(
@@ -157,6 +157,7 @@ def make_batch(
     stack_cap: int = STACK_CAP,
     storage_seed=None,
     empty_world=True,
+    as_numpy=False,
 ) -> StateBatch:
     """Fresh batch at pc=0 with empty stacks and zeroed memory.
 
@@ -168,11 +169,15 @@ def make_batch(
     {slot: value} dict (or None) per lane — the mechanism a
     multi-transaction exploration uses to carry tx N's writes into
     tx N+1's start state. `callvalue` accepts a scalar or one int per
-    lane (the explorer's msg.value axis)."""
+    lane (the explorer's msg.value axis).
+
+    `as_numpy` skips the device upload and returns a StateBatch of
+    host numpy arrays — the background wave-checkpoint writer builds
+    its npz frontier this way without ever touching the device."""
     code_ids = (
-        jnp.zeros((n,), jnp.int32)
+        np.zeros((n,), np.int32)
         if code_ids is None
-        else jnp.asarray(code_ids, jnp.int32)
+        else np.asarray(code_ids, np.int32)
     )
     cd = np.zeros((n, calldata_cap), dtype=np.uint8)
     cds = np.zeros((n,), dtype=np.int32)
@@ -192,46 +197,42 @@ def make_batch(
                 skeys[i, j] = u256.from_int(slot)
                 svals[i, j] = u256.from_int(value)
                 scnt[i] = j + 1
-    return StateBatch(
+    batch = StateBatch(
         code_id=code_ids,
-        pc=jnp.zeros((n,), jnp.int32),
-        stack=jnp.zeros((n, stack_cap, u256.LIMBS), jnp.uint32),
-        sp=jnp.zeros((n,), jnp.int32),
-        mem=jnp.zeros((n, mem_cap), jnp.uint8),
-        msize_words=jnp.zeros((n,), jnp.int32),
-        storage_keys=jnp.asarray(skeys),
-        storage_vals=jnp.asarray(svals),
-        storage_cnt=jnp.asarray(scnt),
-        status=jnp.zeros((n,), jnp.int32),
-        gas_min=jnp.zeros((n,), jnp.uint32),
-        gas_max=jnp.zeros((n,), jnp.uint32),
-        gas_budget=jnp.full((n,), gas_budget, jnp.uint32),
-        ret_offset=jnp.zeros((n,), jnp.int32),
-        ret_len=jnp.zeros((n,), jnp.int32),
-        pc_seen=jnp.zeros((n, PC_BITMAP_WORDS), jnp.uint32),
-        br_pc=jnp.full((n, BRANCH_CAP), -1, jnp.int32),
-        br_taken=jnp.zeros((n, BRANCH_CAP), jnp.uint8),
-        br_cnt=jnp.zeros((n,), jnp.int32),
+        pc=np.zeros((n,), np.int32),
+        stack=np.zeros((n, stack_cap, u256.LIMBS), np.uint32),
+        sp=np.zeros((n,), np.int32),
+        mem=np.zeros((n, mem_cap), np.uint8),
+        msize_words=np.zeros((n,), np.int32),
+        storage_keys=skeys,
+        storage_vals=svals,
+        storage_cnt=scnt,
+        status=np.zeros((n,), np.int32),
+        gas_min=np.zeros((n,), np.uint32),
+        gas_max=np.zeros((n,), np.uint32),
+        gas_budget=np.full((n,), gas_budget, np.uint32),
+        ret_offset=np.zeros((n,), np.int32),
+        ret_len=np.zeros((n,), np.int32),
+        pc_seen=np.zeros((n, PC_BITMAP_WORDS), np.uint32),
+        br_pc=np.full((n, BRANCH_CAP), -1, np.int32),
+        br_taken=np.zeros((n, BRANCH_CAP), np.uint8),
+        br_cnt=np.zeros((n,), np.int32),
         address=_word_rows(n, address),
         caller=_word_rows(n, caller),
         origin=_word_rows(n, caller),
         callvalue=(
             _word_rows(n, callvalue)
             if np.isscalar(callvalue)
-            else jnp.asarray(
-                np.stack([u256.from_int(int(v)) for v in callvalue])
-            )
+            else np.stack([u256.from_int(int(v)) for v in callvalue])
         ),
         balance=(
             _word_rows(n, balance)
             if np.isscalar(balance)
-            else jnp.asarray(
-                np.stack([u256.from_int(int(v)) for v in balance])
-            )
+            else np.stack([u256.from_int(int(v)) for v in balance])
         ),
         gasprice=_word_rows(n, gasprice),
-        calldata=jnp.asarray(cd),
-        calldatasize=jnp.asarray(cds),
+        calldata=cd,
+        calldatasize=cds,
         timestamp=_word_rows(n, timestamp),
         number=_word_rows(n, number),
         coinbase=_word_rows(n, 0),
@@ -240,11 +241,15 @@ def make_batch(
         chainid=_word_rows(n, chainid),
         basefee=_word_rows(n, 7),
         empty_world=(
-            jnp.full((n,), int(bool(empty_world)), jnp.uint8)
+            np.full((n,), int(bool(empty_world)), np.uint8)
             if np.isscalar(empty_world) or isinstance(empty_world, bool)
-            else jnp.asarray(empty_world, jnp.uint8)
+            else np.asarray(empty_world, np.uint8)
         ),
     )
+    if as_numpy:
+        return batch
+    # one upload per field; broadcast views are materialized by jax
+    return StateBatch(*(jnp.asarray(a) for a in batch))
 
 
 def storage_dict_from(tables, lane: int) -> dict:

@@ -680,17 +680,147 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
-def sym_run(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
-    """Run every lane to halt (or budget) with the symbolic shadow."""
+def _sym_run_impl(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
+    """Run every lane to halt (or budget) with the symbolic shadow.
+
+    Returns (out, steps, active_lane_steps): `steps` is the raw loop
+    trip count, `active_lane_steps` counts only lanes that were still
+    RUNNING when each step executed — the honest per-wave work metric
+    (most lanes halt long before the wave's step budget, so
+    steps * n_lanes overcounts by the halted tail)."""
 
     def cond(carry):
-        s, i = carry
+        s, i, _active = carry
         return (i < max_steps) & jnp.any(s.base.status == Status.RUNNING)
 
     def body(carry):
-        s, i = carry
-        return sym_step(s, code), i + 1
+        s, i, active = carry
+        active = active + jnp.sum(
+            (s.base.status == Status.RUNNING).astype(jnp.int32)
+        )
+        return sym_step(s, code), i + 1, active
 
-    out, steps = lax.while_loop(cond, body, (symb, jnp.int32(0)))
-    return out, steps
+    out, steps, active = lax.while_loop(
+        cond, body, (symb, jnp.int32(0), jnp.int32(0))
+    )
+    return out, steps, active
+
+
+sym_run = functools.partial(jax.jit, static_argnames=("max_steps",))(
+    _sym_run_impl
+)
+#: donated variant for the pipelined wave engine (explore.py): the
+#: seeded input SymBatch is consumed by the dispatch, so XLA reuses its
+#: buffers for the output instead of allocating a second arena-sized
+#: footprint per in-flight wave. Only safe when the caller never reads
+#: the input again (the explorer's dispatch path guarantees this);
+#: gated off on backends without donation support (CPU).
+sym_run_donated = functools.partial(
+    jax.jit, static_argnames=("max_steps",), donate_argnums=(0,)
+)(_sym_run_impl)
+
+
+def _reseed_wave_impl(
+    symb: SymBatch,
+    code_ids,
+    calldata,
+    calldatasize,
+    callvalue,
+    balance,
+    skeys,
+    svals,
+    scnt,
+    synthetic,
+):
+    """Build the NEXT wave's seeded SymBatch on device out of the
+    PREVIOUS wave's (donated) buffers.
+
+    This is the arena-reuse half of the pipelined wave engine: the
+    big constant-shaped state (stack, memory, coverage bitmap, shadow
+    tids, the expression arena) is re-zeroed in place on device, the
+    environment words (block context, caller, address, gas budget,
+    empty_world) are carried over untouched — they are identical every
+    wave of an exploration — and the host uploads only the per-wave
+    seed delta: calldata, call values, balances, and a compact
+    storage-journal slab (`skeys`/`svals` are [N, w, LIMBS] with w the
+    power-of-two bucket of the widest journal, not the full
+    storage_cap table `make_batch` would rebuild).
+
+    `synthetic` marks lanes whose seeded journal is an adversarial
+    SAMPLE of symbolic initial storage: their seeded value tids become
+    opaque, exactly as the explorer's make_batch path masks them."""
+    base = symb.base
+    n = base.pc.shape[0]
+    s_cap = base.storage_keys.shape[1]
+
+    storage_keys = jnp.zeros_like(base.storage_keys)
+    storage_vals = jnp.zeros_like(base.storage_vals)
+    storage_keys = storage_keys.at[:, : skeys.shape[1]].set(skeys)
+    storage_vals = storage_vals.at[:, : svals.shape[1]].set(svals)
+    cd = jnp.zeros_like(base.calldata).at[:, : calldata.shape[1]].set(calldata)
+
+    new_base = base._replace(
+        code_id=code_ids,
+        pc=jnp.zeros_like(base.pc),
+        stack=jnp.zeros_like(base.stack),
+        sp=jnp.zeros_like(base.sp),
+        mem=jnp.zeros_like(base.mem),
+        msize_words=jnp.zeros_like(base.msize_words),
+        storage_keys=storage_keys,
+        storage_vals=storage_vals,
+        storage_cnt=scnt,
+        status=jnp.zeros_like(base.status),
+        gas_min=jnp.zeros_like(base.gas_min),
+        gas_max=jnp.zeros_like(base.gas_max),
+        ret_offset=jnp.zeros_like(base.ret_offset),
+        ret_len=jnp.zeros_like(base.ret_len),
+        pc_seen=jnp.zeros_like(base.pc_seen),
+        br_pc=jnp.full_like(base.br_pc, -1),
+        br_taken=jnp.zeros_like(base.br_taken),
+        br_cnt=jnp.zeros_like(base.br_cnt),
+        callvalue=callvalue,
+        balance=balance,
+        calldata=cd,
+        calldatasize=calldatasize,
+    )
+    seeded = jnp.arange(s_cap)[None, :] < scnt[:, None]
+    sval_tid = jnp.where(
+        synthetic[:, None] & seeded,
+        jnp.int32(-1),
+        jnp.zeros_like(symb.sval_tid),
+    )
+    return SymBatch(
+        base=new_base,
+        stack_tid=jnp.zeros_like(symb.stack_tid),
+        mem_tid=jnp.zeros_like(symb.mem_tid),
+        skey_tid=jnp.zeros_like(symb.skey_tid),
+        sval_tid=sval_tid,
+        br_tid=jnp.zeros_like(symb.br_tid),
+        balance_tid=jnp.zeros_like(symb.balance_tid),
+        ev_pc=jnp.zeros_like(symb.ev_pc),
+        ev_kind=jnp.zeros_like(symb.ev_kind),
+        ev_tid=jnp.zeros_like(symb.ev_tid),
+        ev_vtid=jnp.zeros_like(symb.ev_vtid),
+        ev_a=jnp.zeros_like(symb.ev_a),
+        ev_b=jnp.zeros_like(symb.ev_b),
+        ev_aux=jnp.zeros_like(symb.ev_aux),
+        ev_gas=jnp.zeros_like(symb.ev_gas),
+        ev_cnt=jnp.zeros_like(symb.ev_cnt),
+        ev_overflow=jnp.zeros_like(symb.ev_overflow),
+        call_seen=jnp.zeros_like(symb.call_seen),
+        ret_off=jnp.full_like(symb.ret_off, -1),
+        ret_len=jnp.full_like(symb.ret_len, -1),
+        ar_op=jnp.zeros_like(symb.ar_op),
+        ar_a=jnp.zeros_like(symb.ar_a),
+        ar_b=jnp.zeros_like(symb.ar_b),
+        ar_va=jnp.zeros_like(symb.ar_va),
+        ar_vb=jnp.zeros_like(symb.ar_vb),
+        ar_count=jnp.int32(0),
+    )
+
+
+reseed_wave = jax.jit(_reseed_wave_impl)
+#: donated variant: the spent wave's output buffers become the next
+#: wave's input buffers — device memory for the exploration stays flat
+#: at ~pipeline-depth arenas regardless of wave count.
+reseed_wave_donated = jax.jit(_reseed_wave_impl, donate_argnums=(0,))

@@ -68,6 +68,7 @@ _GLOBAL_DEFAULTS = dict(
     device_ownership="auto",
     deterministic_solving=False,
     static_prune=True,
+    pipeline=True,
 )
 
 

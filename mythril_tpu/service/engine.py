@@ -86,6 +86,7 @@ class ServiceConfig:
         checkpoint_dir: Optional[str] = None,
         coalesce_wait_s: float = 0.05,
         idle_wait_s: float = 0.2,
+        pipeline: bool = True,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -106,6 +107,12 @@ class ServiceConfig:
         #: the continuous-batching analogue of a scheduler tick
         self.coalesce_wait_s = coalesce_wait_s
         self.idle_wait_s = idle_wait_s
+        #: double-buffered wave pipelining: dispatch wave N+1 (seeded
+        #: from the corpora known before wave N's results) before
+        #: harvesting wave N, so the host-side harvest/admission work
+        #: overlaps device execution — waves from DISTINCT jobs share
+        #: the two pipeline slots. `myth serve --no-pipeline` disables.
+        self.pipeline = pipeline
 
 
 class CodeCache:
@@ -366,6 +373,10 @@ class AnalysisEngine:
         self.host_completed = 0
         self.kernel_rebuckets = 0
         self.static_seeds_dropped = 0
+        # pipeline occupancy/overlap counters (/stats pipeline.*)
+        self.pipeline_overlapped = 0
+        self.pipeline_multi_job = 0
+        self._pipeline_inflight = 0
         self._first_wave_t: Optional[float] = None
         self._last_wave_t: Optional[float] = None
         self._wave_cold_s: Optional[float] = None
@@ -517,21 +528,70 @@ class AnalysisEngine:
 
     # -- the wave loop -------------------------------------------------
     def _loop(self) -> None:
+        """Pipelined: dispatch wave N+1 (seeded from corpora known
+        before wave N's results — the service's mutation seeding never
+        needed the in-flight wave's outcome) BEFORE harvesting wave N,
+        so the device executes N+1 while the host reads back and
+        consumes N and admits new jobs into freed stripes. With
+        `pipeline` off, each wave is dispatched and harvested
+        lock-step (the old schedule)."""
+        inflight: Optional[Dict] = None
         while not self._stop.is_set():
             try:
-                worked = self._wave_once()
+                nxt = self._dispatch_wave()
             except Exception:
-                log.exception("service wave loop fault; jobs failed")
-                worked = True  # don't spin hot on a persistent fault
-            if not worked:
+                log.exception("service wave dispatch fault")
+                nxt = None
+            if inflight is not None:
+                if nxt is not None:
+                    self.pipeline_overlapped += 1
+                    jobs = set(inflight["wave_inputs"]) | set(
+                        nxt["wave_inputs"]
+                    )
+                    if len(jobs) > 1:
+                        # the two pipeline slots hold waves spanning
+                        # more than one job
+                        self.pipeline_multi_job += 1
+                try:
+                    self._harvest_wave(inflight)
+                except Exception:
+                    log.exception("service wave loop fault; jobs failed")
+                inflight = None
+                self._pipeline_inflight = 0
+            if nxt is not None:
+                if self.pipeline_enabled:
+                    inflight = nxt
+                    self._pipeline_inflight = 1
+                else:
+                    try:
+                        self._harvest_wave(nxt)
+                    except Exception:
+                        log.exception("service wave loop fault; jobs failed")
+            elif inflight is None:
                 self._wake.wait(self.cfg.idle_wait_s)
                 self._wake.clear()
+        if inflight is not None:
+            # the drain contract: the in-flight wave is finished, its
+            # jobs harvested, before checkpoints are cut
+            try:
+                self._harvest_wave(inflight)
+            except Exception:
+                log.exception("drain harvest of the in-flight wave failed")
+            self._pipeline_inflight = 0
 
-    def _wave_once(self) -> bool:
-        import jax
+    @property
+    def pipeline_enabled(self) -> bool:
+        return bool(getattr(self.cfg, "pipeline", True))
 
-        from mythril_tpu.laser.batch.run import run_resilient
+    def _dispatch_wave(self) -> Optional[Dict]:
+        """Admit queued jobs, seed every resident job's lanes, and
+        dispatch the wave ASYNCHRONOUSLY (no block): returns the
+        in-flight record the harvest half consumes. The host-side
+        inputs ride the record so a faulted dispatch can be rebuilt
+        and retried through the synchronous resilience ladder."""
+        from mythril_tpu.laser.batch.run import run, run_donated
         from mythril_tpu.laser.batch.state import make_batch
+        from mythril_tpu.support import resilience
 
         if not self._tracks and self.queue.depth():
             # the coalesce window: near-simultaneous submissions share
@@ -539,7 +599,7 @@ class AnalysisEngine:
             time.sleep(self.cfg.coalesce_wait_s)
         self._admit()
         if not self._tracks:
-            return False
+            return None
         halt_row = self.cfg.stripes
         n = self.alloc.n_lanes
         code_ids = np.full((n,), halt_row, np.int32)
@@ -561,18 +621,82 @@ class AnalysisEngine:
             number=0x66E393,
             gasprice=0x773594000,
         )
-        t0 = time.perf_counter()
+        record: Dict = {
+            "wave_inputs": wave_inputs,
+            "code_ids": code_ids,
+            "calldata": calldata,
+            "out": None,
+            "steps": None,
+            "failed": None,
+            "t0": time.perf_counter(),
+        }
         try:
-            out, steps = run_resilient(
+            import jax
+
+            # buffer donation: the seeded batch is never read again on
+            # the host (retries rebuild it from `calldata`), so the
+            # device reuses its buffers for the output. CPU ignores
+            # donation with a warning, so gate it.
+            runner = run_donated if jax.default_backend() != "cpu" else run
+            record["out"], record["steps"] = runner(
                 batch,
                 self._table(),
                 max_steps=self.cfg.steps_per_wave,
                 track_coverage=True,
             )
         except Exception as why:
-            self._fail_wave(why)
-            return True
-        wall = time.perf_counter() - t0
+            if not resilience.is_device_fault(why):
+                raise
+            record["failed"] = why
+        return record
+
+    def _rebuild_batch(self, record: Dict):
+        from mythril_tpu.laser.batch.state import make_batch
+
+        return make_batch(
+            self.alloc.n_lanes,
+            code_ids=record["code_ids"],
+            calldata=record["calldata"],
+            caller=DEFAULT_CALLER,
+            address=DEFAULT_ADDRESS,
+            timestamp=0x5BFA4639,
+            number=0x66E393,
+            gasprice=0x773594000,
+        )
+
+    def _harvest_wave(self, record: Dict) -> None:
+        import jax
+
+        from mythril_tpu.laser.batch.run import run_resilient
+        from mythril_tpu.support import resilience
+
+        try:
+            if record["failed"] is not None:
+                raise record["failed"]
+            # asynchronous XLA faults surface HERE, attributed to the
+            # wave in this record, not to whatever the host was doing
+            jax.block_until_ready(record["steps"])
+            out, steps = record["out"], record["steps"]
+        except Exception as why:
+            if not resilience.is_device_fault(why):
+                raise
+            resilience.DegradationLog().record(
+                resilience.DegradationReason.ASYNC_DEVICE_FAULT,
+                site="service-wave",
+                detail=str(why),
+            )
+            try:
+                out, steps = run_resilient(
+                    self._rebuild_batch(record),
+                    self._table(),
+                    max_steps=self.cfg.steps_per_wave,
+                    track_coverage=True,
+                )
+            except Exception as ladder_why:
+                self._fail_wave(ladder_why)
+                return
+        wave_inputs = record["wave_inputs"]
+        wall = time.perf_counter() - record["t0"]
         now = time.monotonic()
         self.waves_total += 1
         if self._first_wave_t is None:
@@ -593,9 +717,13 @@ class AnalysisEngine:
             )
         )
         steps = int(steps)
-        self.device_steps += steps * n
+        self.device_steps += steps * self.alloc.n_lanes
         finished: List[_JobTrack] = []
         for track in list(self._tracks.values()):
+            if track.job.id not in wave_inputs:
+                # admitted AFTER this wave dispatched (pipelined): its
+                # first wave is the one still in flight
+                continue
             track.harvest(
                 wave_inputs[track.job.id], status, halt_pc, gas_min,
                 gas_max, br_pc, br_taken, br_cnt, seen, steps,
@@ -627,7 +755,6 @@ class AnalysisEngine:
             self.alloc.release(track.stripes)
             track.job.device_done_t = time.monotonic()
             self._dispatch_host(track)
-        return True
 
     def _fail_wave(self, why: Exception) -> None:
         """A wave died past run_resilient's whole escalation ladder:
@@ -864,6 +991,17 @@ class AnalysisEngine:
                 "code_cap": self.code_cap,
                 "kernel_rebuckets": self.kernel_rebuckets,
                 "code_cache": self.code_cache.stats(),
+            },
+            "pipeline": {
+                "enabled": self.pipeline_enabled,
+                "inflight": self._pipeline_inflight,
+                "overlapped_waves": self.pipeline_overlapped,
+                "multi_job_overlaps": self.pipeline_multi_job,
+                "wave_overlap_ratio": (
+                    round(self.pipeline_overlapped / self.waves_total, 3)
+                    if self.waves_total
+                    else 0.0
+                ),
             },
             "static": {
                 "summaries_cached": self.code_cache.static_summaries,

@@ -64,6 +64,12 @@ class DegradationReason:
     SOLVER_SESSION_REBUILT = "solver-session-rebuilt"
     DEVICE_DISPATCH_FAILED = "device-dispatch-failed"
     DEVICE_SPLIT_DISPATCH = "device-split-dispatch"
+    #: an XLA fault surfaced at a wave's READBACK rather than its
+    #: dispatch (async dispatch in the pipelined wave engine): the
+    #: record carries the faulted wave's serial so a fault on the
+    #: in-flight wave N+1 is attributed to N+1, not to whichever wave
+    #: the host happened to be consuming
+    ASYNC_DEVICE_FAULT = "async-device-fault"
     WAVE_ABANDONED = "wave-abandoned"
     HOST_TAKEOVER = "host-takeover"
     DEADLINE_EXPIRED = "deadline-expired"

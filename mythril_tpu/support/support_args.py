@@ -36,6 +36,12 @@ class Args(object, metaclass=Singleton):
         # flip-frontier prune. On by default; the flag exists so a
         # suspected wrong prune is one switch away from a differential.
         self.static_prune = True
+        # Pipelined wave engine (CLI --no-pipeline): double-buffered
+        # async wave dispatch — up to two waves in flight, host
+        # evidence-consume/flip-solving overlapping device execution,
+        # donated arena buffers. Off = the lock-step schedule, the
+        # differential baseline for a suspected pipelining bug.
+        self.pipeline = True
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to

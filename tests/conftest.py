@@ -49,6 +49,13 @@ def pytest_configure(config):
         "recovery, dataflow, prune feed, detector screen; host-only, "
         "fast — runs in tier-1, selectable with -m static)",
     )
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipelined wave engine suite (double-buffered async "
+        "dispatch, device-side evidence compaction, donated arena "
+        "reseed; CPU-only, fast — runs in tier-1, selectable with "
+        "-m pipeline)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -15,9 +15,10 @@ Sites wired into the pipeline:
                         retry ladder (resilience.retry_device_dispatch,
                         used by run.run_resilient and the explorer's
                         wave dispatch).
-- ``explore.wave``    — in DeviceCorpusExplorer._run_wave, after the
-                        checkpoint flush and before the dispatch: the
-                        "killed mid-wave" point.
+- ``explore.wave``    — in DeviceCorpusExplorer._dispatch_wave, before
+                        the async dispatch: the "killed mid-wave"
+                        point (the checkpoint flush is already on the
+                        background writer).
 - ``corpus.contract`` — at analyze_corpus's per-contract supervisor
                         boundary.
 """

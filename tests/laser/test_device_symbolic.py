@@ -31,7 +31,7 @@ GATED = bytes(
 def _run_gated(data: bytes):
     table = make_code_table([GATED])
     base = make_batch(1, calldata=[data], caller=0xD00D, address=0xA11CE)
-    out, steps = sym_run(make_sym_batch(base), table, max_steps=64)
+    out, steps, _active = sym_run(make_sym_batch(base), table, max_steps=64)
     return out, int(steps)
 
 
@@ -74,7 +74,7 @@ def test_taint_flows_through_memory_roundtrip():
     )
     table = make_code_table([code])
     base = make_batch(1, calldata=[b"\x00" * 4])
-    out, _ = sym_run(make_sym_batch(base), table, max_steps=32)
+    out, _, _ = sym_run(make_sym_batch(base), table, max_steps=32)
     view = ArenaView(out)
     journal = view.journal(0)
     assert len(journal) == 1

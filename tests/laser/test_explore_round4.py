@@ -82,13 +82,13 @@ def test_device_busy_is_set_during_run(monkeypatch):
     ex = DeviceCorpusExplorer(
         [MUTATOR], lanes_per_contract=8, waves=1, steps_per_wave=32
     )
-    original = ex._run_wave
+    original = ex._dispatch_wave
 
-    def spy(inputs):
+    def spy(payload):
         seen.append(DEVICE_BUSY.is_set())
-        return original(inputs)
+        return original(payload)
 
-    monkeypatch.setattr(ex, "_run_wave", spy)
+    monkeypatch.setattr(ex, "_dispatch_wave", spy)
     ex.run()
     assert seen and all(seen)
     assert not DEVICE_BUSY.is_set()

@@ -122,6 +122,7 @@ def main() -> int:
         "warm_latencies_s": [round(x, 3) for x in warm_latencies],
         "max_jobs_resident": stats["arena"]["max_jobs_resident"],
         "waves": stats["waves"],
+        "pipeline": stats.get("pipeline", {}),
         "drain": {},
     }
     try:
@@ -138,6 +139,15 @@ def main() -> int:
             f"warm p50 {warm_p50:.3f}s did not beat the cold request "
             f"{cold_s:.3f}s — the warm arena isn't amortizing"
         )
+        # the pipeline contract: with >= 2 jobs queued, the warm path
+        # must actually double-buffer — wave N+1 dispatched while wave
+        # N is harvested, slots spanning more than one job
+        pipe = stats.get("pipeline", {})
+        if pipe.get("enabled"):
+            assert pipe.get("overlapped_waves", 0) >= 1, (
+                f"no wave overlap with 4 concurrent jobs: {pipe}"
+            )
+            assert pipe.get("wave_overlap_ratio", 0) > 0, pipe
         assert drained, "drain did not complete"
         for job_id in drain_ids:
             job = server.engine.queue.get(job_id)
