@@ -1,7 +1,16 @@
 """Benchmark: honest batched-interpreter throughput + the
 time-to-convergence corpus A/B.
 
-One JSON line with three measurement groups:
+Emission is HEADLINE-FIRST and incremental: the complete one-line JSON
+record prints as soon as the headline phases (static prune,
+transitions, ONE convergence pair) finish — inside
+`MYTHRIL_BENCH_HEADLINE_S` (default 8 min) — and prints again after
+every refinement (second pair, default path, hard solve). The LAST
+parseable line is the record; a capture window that closes mid-refine
+still holds a complete artifact (the round-5 rc:124/parsed:null fix,
+hardened).
+
+The record carries three measurement groups:
 
 1. `state_transitions_per_sec` (the `value` field): one state
    transition = one EVM instruction applied to one path state — the
@@ -62,15 +71,31 @@ import time
 BENCH_BUDGET_S = float(os.environ.get("MYTHRIL_BENCH_BUDGET_S", "780"))
 _BENCH_T0 = time.monotonic()
 
+#: The HEADLINE deadline: the record must be printed (complete, with
+#: transitions + one convergence pair) by this wall mark even when the
+#: full budget would allow more — the capture window must never close
+#: on a bench that has measured everything but printed nothing
+#: (BENCH_r05's rc:124/parsed:null failure mode). Later phases REFINE
+#: the record and print it again; the last line supersedes.
+HEADLINE_DEADLINE_S = float(
+    os.environ.get("MYTHRIL_BENCH_HEADLINE_S", "480")
+)
+
 
 def _budget_left() -> float:
     return BENCH_BUDGET_S - (time.monotonic() - _BENCH_T0)
 
 
-N_LANES = 16384
-N_STEPS = 256
-CONV_CONTRACTS = 32
-CONV_PAIRS = 2
+def _headline_left() -> float:
+    return HEADLINE_DEADLINE_S - (time.monotonic() - _BENCH_T0)
+
+
+# sizes are env-tunable so the tier-1 smoke (tests/test_bench_smoke.py)
+# can drive the REAL emission path at toy scale
+N_LANES = int(os.environ.get("MYTHRIL_BENCH_LANES", "16384"))
+N_STEPS = int(os.environ.get("MYTHRIL_BENCH_STEPS", "256"))
+CONV_CONTRACTS = int(os.environ.get("MYTHRIL_BENCH_CONTRACTS", "32"))
+CONV_PAIRS = int(os.environ.get("MYTHRIL_BENCH_PAIRS", "2"))
 #: per-contract ceiling, NOT the expected wall: contracts converge
 #: (walk reaches fixpoint) well under it; the ceiling only bounds
 #: pathological mutants
@@ -270,6 +295,10 @@ def _corpus_leg(contracts, use_device, deadline_s=None):
         processes=1,
         deadline_s=deadline_s,
         on_timeout="partial",
+        # multi-chip: with >1 visible device the device leg runs the
+        # mesh corpus scheduler (one wave engine per device group,
+        # work stealing) — the single-chip leg is unchanged
+        devices=_bench_devices() if use_device is None else None,
     )
     wall = time.perf_counter() - t0
     prepass = max(
@@ -303,50 +332,63 @@ def _spread(values) -> float:
     return (max(values) - min(values)) / med if med else 0.0
 
 
-def bench_corpus_convergence(strict: bool = True) -> dict:
-    """Interleaved device/host time-to-convergence A/B over the
-    benchmark corpus; medians + spreads + explicit criteria. With
-    `strict`, raises on a spread-gate violation so the __main__ retry
-    reruns the whole measurement; the retry records the result with
-    `spread_rejected: true` instead of leaving the round without an
-    artifact."""
-    import logging
+def _bench_devices():
+    """Device-group count for the mesh scheduler: the visible device
+    count when there is more than one chip, else None (single
+    engine)."""
+    try:
+        import jax
 
-    from mythril_tpu.analysis.corpusgen import synth_bench_corpus
+        n = len(jax.devices())
+        return n if n > 1 else None
+    except Exception:
+        return None
 
-    contracts = synth_bench_corpus(CONV_CONTRACTS)
-    if not contracts:
-        return {}
 
-    logging.disable(logging.WARNING)
-    device_legs, host_legs = [], []
+class _ConvAB:
+    """Incremental device/host time-to-convergence A/B: pairs
+    accumulate one at a time and summarize() re-aggregates after every
+    pair, so main() can print a COMPLETE record after the first pair
+    (headline-first) and refine it while budget remains."""
 
-    def _leg_deadline() -> int:
+    def __init__(self):
+        from mythril_tpu.analysis.corpusgen import synth_bench_corpus
+
+        self.contracts = synth_bench_corpus(CONV_CONTRACTS)
+        self.device_legs = []
+        self.host_legs = []
+
+    def _leg_deadline(self, cap=None) -> int:
         # each leg promises only the wall the bench budget still holds
         # (minus slack for the later bench halves); a leg that cannot
         # fit raises _Deadline NOW so the record says "deadline"
         # instead of the outer timeout killing the process mid-leg
-        room = int(min(LEG_DEADLINE_S, _budget_left() - 90))
+        room = _budget_left() - 90
+        if cap is not None:
+            room = min(room, cap)
+        room = int(min(LEG_DEADLINE_S, room))
         if room < 30:
             raise _Deadline()
         return room
 
-    try:
-        # Warm the wave kernels at the legs' exact shapes (one
-        # untimed wave) — the same rule the transitions metric
-        # applies: jit tracing + compile are once-per-machine costs
-        # (persistent compile cache), not per-corpus costs, and the
-        # first device leg must not carry them into the median.
+    def warmup(self) -> None:
+        """Warm the wave kernels at the legs' exact shapes (one
+        untimed wave): jit tracing + compile are once-per-machine
+        costs (persistent compile cache), not per-corpus costs, and
+        the first device leg must not carry them into the median."""
         try:
             from mythril_tpu.analysis.corpus import corpus_device_prepass
 
             # budget 0: each phase still opens its one unconditional
             # wave, through the SAME sizing rules (lanes/caps/mesh)
-            # the timed legs resolve — no duplicated shape constants
-            # to rot
+            # the timed legs resolve
             _with_deadline(
-                lambda: corpus_device_prepass(contracts, budget_s=0.0),
-                min(240, _leg_deadline()),
+                lambda: corpus_device_prepass(
+                    self.contracts,
+                    budget_s=0.0,
+                    mesh_groups=_bench_devices(),
+                ),
+                min(240, self._leg_deadline()),
             )
             print("bench: corpus wave kernels warmed", file=sys.stderr)
         except _Deadline:
@@ -354,136 +396,168 @@ def bench_corpus_convergence(strict: bool = True) -> dict:
         except Exception as e:
             print(f"bench: corpus warmup skipped: {e!r}", file=sys.stderr)
 
-        for pair in range(CONV_PAIRS):
-            # each leg's internal deadline: whatever the bench budget
-            # still holds (minus slack for the later halves), so a
-            # pathological corpus lands a PARTIAL leg result instead
-            # of eating the process's remaining wall
-            room = _leg_deadline()
-            device_legs.append(
-                _with_deadline(
-                    lambda room=room: _corpus_leg(
-                        contracts, None, deadline_s=max(30, room - 30)
-                    ),
-                    room,
-                )
-            )
-            room = _leg_deadline()
-            host_legs.append(
-                _with_deadline(
-                    lambda room=room: _corpus_leg(
-                        contracts, False, deadline_s=max(30, room - 30)
-                    ),
-                    room,
-                )
-            )
-            print(
-                f"bench: conv pair {pair + 1}/{CONV_PAIRS}: device "
-                f"{device_legs[-1]['wall_s']}s/"
-                f"{device_legs[-1]['distinct_issues']} findings "
-                f"({device_legs[-1]['owned']} owned) vs host "
-                f"{host_legs[-1]['wall_s']}s/"
-                f"{host_legs[-1]['distinct_issues']} findings",
-                file=sys.stderr,
-            )
-    finally:
-        logging.disable(logging.NOTSET)
+    def run_pair(self, headline: bool = False) -> None:
+        """One interleaved device+host pair. A headline pair is
+        additionally bounded by the headline window so the FIRST
+        record prints inside the capture window no matter what the
+        corpus does."""
+        import logging
 
-    d_walls = [leg["wall_s"] for leg in device_legs]
-    h_walls = [leg["wall_s"] for leg in host_legs]
-    d_spread, h_spread = _spread(d_walls), _spread(h_walls)
-    spread_rejected = max(d_spread, h_spread) > SPREAD_GATE
-    if spread_rejected and strict:
-        raise RuntimeError(
-            f"convergence A/B spread gate: device {d_spread:.2f} / host "
-            f"{h_spread:.2f} exceeds {SPREAD_GATE} — the regime is too "
-            "noisy to record"
+        logging.disable(logging.WARNING)
+        try:
+            for use_device, bucket in (
+                (None, self.device_legs),
+                (False, self.host_legs),
+            ):
+                cap = None
+                if headline:
+                    legs_left = 2 if use_device is None else 1
+                    cap = max(30, int((_headline_left() - 30) / legs_left))
+                room = self._leg_deadline(cap)
+                bucket.append(
+                    _with_deadline(
+                        lambda room=room, ud=use_device: _corpus_leg(
+                            self.contracts, ud,
+                            deadline_s=max(30, room - 30),
+                        ),
+                        room,
+                    )
+                )
+        finally:
+            logging.disable(logging.NOTSET)
+        pair = len(self.host_legs)
+        print(
+            f"bench: conv pair {pair}/{CONV_PAIRS}: device "
+            f"{self.device_legs[-1]['wall_s']}s/"
+            f"{self.device_legs[-1]['distinct_issues']} findings "
+            f"({self.device_legs[-1]['owned']} owned) vs host "
+            f"{self.host_legs[-1]['wall_s']}s/"
+            f"{self.host_legs[-1]['distinct_issues']} findings",
+            file=sys.stderr,
         )
 
-    # the prepass counters of the median device leg (the recorded one)
-    median_leg = device_legs[
-        d_walls.index(sorted(d_walls)[len(d_walls) // 2])
-    ]
-    d_wall = statistics.median(d_walls)
-    h_wall = statistics.median(h_walls)
-    d_found = int(
-        statistics.median([leg["distinct_issues"] for leg in device_legs])
-    )
-    h_found = int(
-        statistics.median([leg["distinct_issues"] for leg in host_legs])
-    )
-    speedup = round(h_wall / d_wall, 3) if d_wall else None
-    out = {
-        "corpus_contracts": len(contracts),
-        "spread_rejected": spread_rejected,
-        "corpus_pairs": CONV_PAIRS,
-        "corpus_exec_timeout_s": CONV_EXEC_TIMEOUT_S,
-        "corpus_wall_s": d_wall,
-        "corpus_wall_spread": round(d_spread, 3),
-        "corpus_issues": d_found,
-        "corpus_issues_raw": int(
-            statistics.median([leg["issues"] for leg in device_legs])
-        ),
-        "corpus_owned_contracts": int(
-            statistics.median([leg["owned"] for leg in device_legs])
-        ),
-        "corpus_errors": max(leg["errors"] for leg in device_legs),
-        "host_only_wall_s": h_wall,
-        "host_only_wall_spread": round(h_spread, 3),
-        "host_only_issues": h_found,
-        "host_only_issues_raw": int(
-            statistics.median([leg["issues"] for leg in host_legs])
-        ),
-        "contracts_per_sec": round(len(contracts) / d_wall, 3)
-        if d_wall
-        else None,
-        "device_sat_verdicts_corpus": sum(
-            leg["device_sat"] for leg in device_legs
-        ),
-        "corpus_walls_device": d_walls,
-        "corpus_walls_host": h_walls,
-        # the round's pass/fail thresholds, stated in the artifact so
-        # narrative and record cannot diverge (round-4 lesson)
-        "criteria": {
-            "speedup_def": "median host_only_wall_s / corpus_wall_s",
-            "speedup_target": SPEEDUP_TARGET,
-            "speedup_measured": speedup,
-            "speedup_pass": bool(
-                speedup is not None and speedup >= SPEEDUP_TARGET
+    def summarize(self, strict: bool = True) -> dict:
+        """Aggregate whatever pairs have run: medians + spreads +
+        explicit criteria (the same record shape at every refinement).
+        With `strict` and >1 pair, a spread-gate violation raises so
+        __main__'s retry reruns the measurement."""
+        device_legs, host_legs = self.device_legs, self.host_legs
+        if not device_legs or not host_legs:
+            return {}
+        d_walls = [leg["wall_s"] for leg in device_legs]
+        h_walls = [leg["wall_s"] for leg in host_legs]
+        d_spread, h_spread = _spread(d_walls), _spread(h_walls)
+        spread_rejected = (
+            len(d_walls) > 1 and max(d_spread, h_spread) > SPREAD_GATE
+        )
+        if spread_rejected and strict:
+            raise RuntimeError(
+                f"convergence A/B spread gate: device {d_spread:.2f} / "
+                f"host {h_spread:.2f} exceeds {SPREAD_GATE} — the regime "
+                "is too noisy to record"
+            )
+
+        # the prepass counters of the median device leg (the recorded one)
+        median_leg = device_legs[
+            d_walls.index(sorted(d_walls)[len(d_walls) // 2])
+        ]
+        d_wall = statistics.median(d_walls)
+        h_wall = statistics.median(h_walls)
+        d_found = int(
+            statistics.median([leg["distinct_issues"] for leg in device_legs])
+        )
+        h_found = int(
+            statistics.median([leg["distinct_issues"] for leg in host_legs])
+        )
+        speedup = round(h_wall / d_wall, 3) if d_wall else None
+        out = {
+            "corpus_contracts": len(self.contracts),
+            "spread_rejected": spread_rejected,
+            "corpus_pairs": len(host_legs),
+            "corpus_exec_timeout_s": CONV_EXEC_TIMEOUT_S,
+            "corpus_wall_s": d_wall,
+            "corpus_wall_spread": round(d_spread, 3),
+            "corpus_issues": d_found,
+            "corpus_issues_raw": int(
+                statistics.median([leg["issues"] for leg in device_legs])
             ),
-            "findings_def": "median distinct (contract, swc, address)",
-            "findings_device": d_found,
-            "findings_host": h_found,
-            "findings_parity_pass": d_found >= h_found,
-        },
-    }
-    for k, v in (median_leg.get("prepass") or {}).items():
-        if k not in ("scope", "partial"):
-            out[f"prepass_{k}"] = v
-    # the pipelined-wave-engine headline metrics, promoted out of the
-    # prepass_* namespace (ISSUE 4 acceptance: bench reports them):
-    # how much device execution the host covered with concurrent work,
-    # how often the device sat with no wave in flight, and what the
-    # compacted per-wave readback transferred vs the full tables
-    for alias in (
-        "wave_overlap_ratio",
-        "device_idle_frac",
-        "evidence_bytes_per_wave",
-        "waves_overlapped",
-        "pipelined",
-    ):
-        if f"prepass_{alias}" in out:
-            out[alias] = out[f"prepass_{alias}"]
-    if out.get("prepass_evidence_bytes_full") and out.get(
-        "prepass_evidence_bytes"
-    ):
-        out["evidence_compaction_ratio"] = round(
-            out["prepass_evidence_bytes_full"]
-            / max(1, out["prepass_evidence_bytes"]),
-            2,
+            "corpus_owned_contracts": int(
+                statistics.median([leg["owned"] for leg in device_legs])
+            ),
+            "corpus_errors": max(leg["errors"] for leg in device_legs),
+            "host_only_wall_s": h_wall,
+            "host_only_wall_spread": round(h_spread, 3),
+            "host_only_issues": h_found,
+            "host_only_issues_raw": int(
+                statistics.median([leg["issues"] for leg in host_legs])
+            ),
+            "contracts_per_sec": round(len(self.contracts) / d_wall, 3)
+            if d_wall
+            else None,
+            "device_sat_verdicts_corpus": sum(
+                leg["device_sat"] for leg in device_legs
+            ),
+            "corpus_walls_device": d_walls,
+            "corpus_walls_host": h_walls,
+            # the round's pass/fail thresholds, stated in the artifact so
+            # narrative and record cannot diverge (round-4 lesson)
+            "criteria": {
+                "speedup_def": "median host_only_wall_s / corpus_wall_s",
+                "speedup_target": SPEEDUP_TARGET,
+                "speedup_measured": speedup,
+                "speedup_pass": bool(
+                    speedup is not None and speedup >= SPEEDUP_TARGET
+                ),
+                "findings_def": "median distinct (contract, swc, address)",
+                "findings_device": d_found,
+                "findings_host": h_found,
+                "findings_parity_pass": d_found >= h_found,
+            },
+        }
+        prepass = median_leg.get("prepass") or {}
+        for k, v in prepass.items():
+            if k not in ("scope", "partial", "mesh"):
+                out[f"prepass_{k}"] = v
+        # the pipelined-wave-engine headline metrics, promoted out of the
+        # prepass_* namespace (ISSUE 4 acceptance: bench reports them)
+        for alias in (
+            "wave_overlap_ratio",
+            "device_idle_frac",
+            "evidence_bytes_per_wave",
+            "waves_overlapped",
+            "pipelined",
+        ):
+            if f"prepass_{alias}" in out:
+                out[alias] = out[f"prepass_{alias}"]
+        if out.get("prepass_evidence_bytes_full") and out.get(
+            "prepass_evidence_bytes"
+        ):
+            out["evidence_compaction_ratio"] = round(
+                out["prepass_evidence_bytes_full"]
+                / max(1, out["prepass_evidence_bytes"]),
+                2,
+            )
+        # mesh scheduler observability (ISSUE 5 acceptance: the bench
+        # reports mesh_devices / steal_count / per-device occupancy)
+        mesh = prepass.get("mesh") or {}
+        out["mesh_devices"] = prepass.get(
+            "mesh_devices", mesh.get("devices", 1)
         )
-    return out
-
+        out["mesh_groups"] = prepass.get("mesh_groups", mesh.get("groups", 1))
+        out["steal_count"] = prepass.get("steal_count", mesh.get("steals", 0))
+        out["rebalance_bytes"] = prepass.get(
+            "rebalance_bytes", mesh.get("rebalance_bytes", 0)
+        )
+        out["mesh_occupancy"] = [
+            {
+                "group": g.get("group"),
+                "occupancy": g.get("occupancy"),
+                "waves": g.get("waves"),
+                "steals": g.get("steals", 0),
+            }
+            for g in mesh.get("per_device", [])
+        ]
+        return out
 
 def bench_hard_solve(budget_s: int = 300) -> dict:
     """The solver-race half (VERDICT r4 item 3): BEC-guard-shaped
@@ -646,14 +720,55 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
     return out
 
 
+def _emit(record: dict, stage: str) -> None:
+    """Print the one-line JSON record NOW. Called after the headline
+    phases (transitions + one convergence pair) and again after every
+    refinement: a capture that closes at any point past the headline
+    emit still holds a complete, parseable record — the last printed
+    line supersedes earlier ones."""
+    record["bench_emit"] = stage
+    record["bench_wall_s"] = round(time.monotonic() - _BENCH_T0, 1)
+    print(json.dumps(record), flush=True)
+
+
+def _refresh_headline(record: dict, dev: dict) -> None:
+    """(Re)derive the cross-phase headline fields from the phase data
+    currently in the record."""
+    record["value"] = round(dev["rate"], 1) if "rate" in dev else None
+    vs_baseline = None
+    if record.get("corpus_wall_s") and record.get("host_only_wall_s"):
+        vs_baseline = round(
+            record["host_only_wall_s"] / record["corpus_wall_s"], 3
+        )
+    record["vs_baseline"] = vs_baseline
+
+
 def main(final_attempt: bool = False) -> None:
-    static = {}
+    record = {
+        "metric": "state_transitions_per_sec",
+        "value": None,
+        "unit": "states/sec",
+        # measured: median host-only(proxy baseline, see BASELINE.md)
+        # wall over median device wall on the corpus A/B
+        "vs_baseline": None,
+        "vs_baseline_def": "host_only_wall_s / corpus_wall_s (measured)",
+        "n_lanes": N_LANES,
+        "n_steps": N_STEPS,
+        "bench_budget_s": BENCH_BUDGET_S,
+        "headline_deadline_s": HEADLINE_DEADLINE_S,
+        # mesh defaults so the fields exist even when the corpus half
+        # never runs (budget-skipped records stay schema-complete)
+        "mesh_devices": 1,
+        "steal_count": 0,
+    }
+
     try:
-        static = bench_static_prune()
-        print(f"bench: static prune {static}", file=sys.stderr)
+        record.update(bench_static_prune())
+        print("bench: static prune done", file=sys.stderr)
     except Exception as e:
         print(f"bench: static-prune half failed: {e!r}", file=sys.stderr)
-        static = {"static_prune_rate": None}
+        record["static_prune_rate"] = None
+
     dev = {}
     try:
         dev = _with_deadline(
@@ -667,74 +782,16 @@ def main(final_attempt: bool = False) -> None:
             raise  # linearity-gate rejection: let __main__ retry
         import traceback as _tb
 
-        print(f"bench: transitions half failed: {_tb.format_exc()}", file=sys.stderr)
-        dev = {"transitions": "failed"}
-    corpus = {}
-    if _budget_left() < 120:
-        corpus = {"corpus": "budget-skipped"}
-        print("bench: corpus half skipped (budget spent)", file=sys.stderr)
-    else:
-        try:
-            corpus = bench_corpus_convergence(strict=not final_attempt)
-        except _Deadline:
-            print("bench: a corpus leg hit its deadline", file=sys.stderr)
-            corpus = {"corpus": "deadline"}
-        except RuntimeError:
-            if final_attempt:
-                corpus = {"corpus": "failed"}
-            else:
-                raise  # spread-gate rejection: let __main__ retry rerun it
-        except Exception as e:
-            # the corpus half must not sink the device metric: any other
-            # bug is recorded as a skip, and the JSON line still prints
-            print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
-            corpus = {"corpus": "failed"}
-    default_path = {}
-    if _budget_left() < 60:
-        default_path = {"default_path": "budget-skipped"}
-        print("bench: default-path half skipped (budget spent)", file=sys.stderr)
-    else:
-        try:
-            default_path = bench_device_default_path(
-                budget_s=max(30, min(210, int(_budget_left() - 45)))
-            )
-        except Exception as e:
-            print(f"bench: default-path half failed: {e!r}", file=sys.stderr)
-    hard = {}
-    if _budget_left() < 45:
-        hard = {"hard_solve": "budget-skipped"}
-        print("bench: hard-solve half skipped (budget spent)", file=sys.stderr)
-    else:
-        try:
-            hard = bench_hard_solve(
-                budget_s=max(20, min(300, int(_budget_left() - 15)))
-            )
-        except Exception as e:
-            print(f"bench: hard-solve half failed: {e!r}", file=sys.stderr)
-
-    vs_baseline = None
-    if corpus.get("corpus_wall_s") and corpus.get("host_only_wall_s"):
-        vs_baseline = round(
-            corpus["host_only_wall_s"] / corpus["corpus_wall_s"], 3
+        print(
+            f"bench: transitions half failed: {_tb.format_exc()}",
+            file=sys.stderr,
         )
-    record = {
-        "metric": "state_transitions_per_sec",
-        "value": round(dev["rate"], 1) if "rate" in dev else None,
-        "unit": "states/sec",
-        # measured: median host-only(proxy baseline, see BASELINE.md)
-        # wall over median device wall on the corpus A/B
-        "vs_baseline": vs_baseline,
-        "vs_baseline_def": "host_only_wall_s / corpus_wall_s (measured)",
-        "scaling_ratio_4x_steps": (
-            round(dev["scaling_ratio"], 2) if "scaling_ratio" in dev else None
-        ),
-        "n_lanes": N_LANES,
-        "n_steps": N_STEPS,
-        "bench_budget_s": BENCH_BUDGET_S,
-        "bench_wall_s": round(time.monotonic() - _BENCH_T0, 1),
-    }
+        dev = {"transitions": "failed"}
     if "transitions" in dev:
         record["transitions"] = dev["transitions"]
+    record["scaling_ratio_4x_steps"] = (
+        round(dev["scaling_ratio"], 2) if "scaling_ratio" in dev else None
+    )
     for k in (
         "state_bytes_per_lane", "bytes_per_step", "batch_steps_per_sec",
         "hbm_demand_gbps", "hbm_utilization_pct", "mfu_pct",
@@ -742,11 +799,94 @@ def main(final_attempt: bool = False) -> None:
     ):
         if k in dev:
             record[k] = dev[k]
-    record.update(static)
-    record.update(corpus)
-    record.update(default_path)
-    record.update(hard)
-    print(json.dumps(record))
+
+    # -- headline convergence pair (bounded by the headline window) ---
+    conv = None
+    if CONV_PAIRS < 1:
+        record["corpus"] = "disabled"
+    elif _budget_left() < 120 or _headline_left() < 60:
+        record["corpus"] = "budget-skipped"
+        print("bench: corpus half skipped (budget spent)", file=sys.stderr)
+    else:
+        try:
+            conv = _ConvAB()
+            if not conv.contracts:
+                record["corpus"] = "empty"
+                conv = None
+            else:
+                conv.warmup()
+                conv.run_pair(headline=True)
+                record.update(conv.summarize(strict=False))
+        except _Deadline:
+            print("bench: a corpus leg hit its deadline", file=sys.stderr)
+            record["corpus"] = "deadline"
+        except Exception as e:
+            # the corpus half must not sink the device metric: any
+            # other bug is recorded as a skip, the JSON still prints
+            print(f"bench: corpus half failed: {e!r}", file=sys.stderr)
+            record["corpus"] = "failed"
+            conv = None
+
+    _refresh_headline(record, dev)
+    _emit(record, "headline")  # <-- the capture-window guarantee
+
+    # -- refinement: the remaining pairs, then the cheap halves -------
+    spread_error = None
+    while (
+        conv is not None
+        and len(conv.host_legs) < CONV_PAIRS
+        and _budget_left() >= 120
+    ):
+        try:
+            conv.run_pair()
+            record.update(conv.summarize(strict=not final_attempt))
+        except _Deadline:
+            print("bench: a corpus leg hit its deadline", file=sys.stderr)
+            break
+        except RuntimeError as why:
+            # spread-gate rejection: finish the record (the headline
+            # line already stands), then let __main__ retry the whole
+            # measurement unless this IS the retry
+            record.update(conv.summarize(strict=False))
+            spread_error = why
+            break
+
+    if _budget_left() < 60:
+        record.setdefault("default_path", "budget-skipped")
+        print(
+            "bench: default-path half skipped (budget spent)",
+            file=sys.stderr,
+        )
+    else:
+        try:
+            record.update(
+                bench_device_default_path(
+                    budget_s=max(30, min(210, int(_budget_left() - 45)))
+                )
+            )
+        except Exception as e:
+            print(
+                f"bench: default-path half failed: {e!r}", file=sys.stderr
+            )
+    if _budget_left() < 45:
+        record.setdefault("hard_solve", "budget-skipped")
+        print(
+            "bench: hard-solve half skipped (budget spent)", file=sys.stderr
+        )
+    else:
+        try:
+            record.update(
+                bench_hard_solve(
+                    budget_s=max(20, min(300, int(_budget_left() - 15)))
+                )
+            )
+        except Exception as e:
+            print(f"bench: hard-solve half failed: {e!r}", file=sys.stderr)
+
+    _refresh_headline(record, dev)
+    _emit(record, "final")
+    if spread_error is not None and not final_attempt:
+        raise spread_error  # __main__ reruns; this record already printed
 
 
 if __name__ == "__main__":

@@ -1,0 +1,8 @@
+"""`python -m mythril_tpu` — the same CLI as the `myth` console
+script (reference parity: `python -m mythril` runs mythril.__main__).
+"""
+
+from mythril_tpu.interfaces.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -146,15 +146,27 @@ def corpus_device_prepass(
     ownership: bool = False,
     deadline=None,
     checkpoint_path=None,
+    mesh_groups: Optional[int] = None,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
     into the per-contract analyses (indexed, not named — corpus rows
     may share names). Empty on any failure — the host pipeline must
-    never be blocked by the device."""
+    never be blocked by the device.
+
+    `mesh_groups > 1` (or `--devices N` via the global flag bag) runs
+    the multi-chip corpus scheduler instead of one lane-sharded
+    engine: the corpus shards over N device groups at admission, each
+    group runs its own wave engine in its own failure domain, and a
+    drained group steals pending contracts/frontiers from the most
+    loaded one (parallel/scheduler.py)."""
     runnable = _runnable_rows(contracts)
     if not runnable:
         return {}
+    if mesh_groups is None:
+        from mythril_tpu.support.support_args import args as _flags
+
+        mesh_groups = getattr(_flags, "mesh_devices", None)
     if budget_s is None:
         budget_s = resolve_prepass_budget_s(
             len(runnable),
@@ -167,6 +179,25 @@ def corpus_device_prepass(
         # hundreds of contracts would starve the wave count; narrower
         # stripes keep several waves per transaction phase
         lanes_per_contract = 16 if len(runnable) >= 64 else 32
+    if mesh_groups is not None and mesh_groups > 1 and len(runnable) > 1:
+        # the multi-chip corpus scheduler: one wave engine per device
+        # group, admission-time sharding, live work stealing, per-group
+        # failure domains — the same outcome contract as the single
+        # engine below, plus stats["mesh"] observability
+        return _mesh_prepass(
+            runnable,
+            mesh_groups=mesh_groups,
+            budget_s=budget_s,
+            lanes_per_contract=lanes_per_contract,
+            address=address,
+            transaction_count=transaction_count,
+            host_lock=host_lock,
+            stop_event=stop_event,
+            publish=publish,
+            lock_wanted=lock_wanted,
+            deadline=deadline,
+            checkpoint_path=checkpoint_path,
+        )
     # multi-chip: when the backend exposes more than one device, the
     # striped wave shards lane-major over the dp mesh (SURVEY §2.4's
     # per-contract-loop axis) — the single-chip path is the mesh path
@@ -245,6 +276,40 @@ def corpus_device_prepass(
         log.warning("corpus device prepass failed", exc_info=True)
         return {}
     stats = result["stats"]
+    # mesh observability parity with the scheduler path: the single
+    # lane-sharded engine is one group with zero steals, and its
+    # occupancy is the fraction of the run a wave was in flight —
+    # bench.py reads these fields regardless of which path ran
+    wall = stats.get("wall_s") or 0.0
+    busy = stats.get("device_busy_s") or 0.0
+    stats.setdefault("mesh_devices", n_devices or 1)
+    stats.setdefault("mesh_groups", 1)
+    stats.setdefault("steal_count", 0)
+    stats.setdefault("rebalance_bytes", 0)
+    stats.setdefault(
+        "mesh",
+        {
+            "devices": n_devices or 1,
+            "groups": 1,
+            "steals": 0,
+            "stolen_items": 0,
+            "rebalance_bytes": 0,
+            "per_device": [
+                {
+                    "group": 0,
+                    "devices": n_devices or 1,
+                    "waves": stats.get("waves", 0),
+                    "device_steps": stats.get("device_steps", 0),
+                    "busy_s": round(busy, 3),
+                    "occupancy": (
+                        round(min(1.0, busy / wall), 3) if wall > 0 else 0.0
+                    ),
+                    "steals": 0,
+                    "faults": stats.get("device_faults", 0),
+                }
+            ],
+        },
+    )
     log.info(
         "Corpus device prepass: %d contracts, %d lane-steps over %d waves "
         "in %.1fs, %d branch directions covered",
@@ -259,6 +324,86 @@ def corpus_device_prepass(
         # the stats block is CORPUS-WIDE (one striped exploration);
         # it rides along on every outcome for observability, marked so
         # consumers don't sum it per contract
+        outcome["stats"] = dict(stats, scope="corpus")
+        outcomes[idx] = outcome
+    return outcomes
+
+
+def _mesh_prepass(
+    runnable,
+    mesh_groups: int,
+    budget_s: Optional[float],
+    lanes_per_contract: int,
+    address: int,
+    transaction_count: int,
+    host_lock,
+    stop_event,
+    publish,
+    lock_wanted,
+    deadline,
+    checkpoint_path,
+) -> Dict[int, Dict]:
+    """The multi-chip corpus prepass: shard the runnable rows over
+    `mesh_groups` device groups and run one wave engine per group with
+    live work stealing (parallel/scheduler.py). Outcome contract
+    matches corpus_device_prepass's single-engine path."""
+    try:
+        from mythril_tpu.parallel.scheduler import CorpusScheduler
+
+        at_scale = len(runnable) >= OVERLAP_MIN_CORPUS
+        translate = (
+            None
+            if publish is None
+            else (lambda ti, outcome: publish(runnable[ti][0], outcome))
+        )
+        scheduler = CorpusScheduler(
+            [code for _, code in runnable],
+            n_groups=mesh_groups,
+            budget_s=budget_s,
+            host_lock=host_lock,
+            stop_event=stop_event,
+            publish=translate,
+            lock_wanted=lock_wanted,
+            deadline=deadline,
+            checkpoint_path=checkpoint_path,
+            explorer_kwargs=dict(
+                lanes_per_contract=lanes_per_contract,
+                mem_cap=4096 if at_scale else 16384,
+                storage_cap=64 if at_scale else 128,
+                waves=48,
+                steps_per_wave=512,
+                address=address,
+                transaction_count=transaction_count,
+            ),
+        )
+        result = scheduler.run()
+    except Exception:
+        from mythril_tpu.support.resilience import (
+            DegradationLog,
+            DegradationReason,
+        )
+
+        DegradationLog().record(
+            DegradationReason.PREPASS_FAILED, site="corpus-mesh-prepass"
+        )
+        log.warning("multi-chip corpus prepass failed", exc_info=True)
+        return {}
+    stats = result["stats"]
+    mesh = stats.get("mesh", {})
+    log.info(
+        "Mesh corpus prepass: %d contracts over %d device group(s), "
+        "%d lane-steps / %d waves in %.1fs, %d steal event(s), "
+        "%d rebalance byte(s)",
+        len(runnable),
+        mesh.get("groups", 1),
+        stats.get("device_steps", 0),
+        stats.get("waves", 0),
+        stats.get("wall_s", 0.0),
+        mesh.get("steals", 0),
+        mesh.get("rebalance_bytes", 0),
+    )
+    outcomes = {}
+    for (idx, _code), outcome in zip(runnable, result["contracts"]):
         outcome["stats"] = dict(stats, scope="corpus")
         outcomes[idx] = outcome
     return outcomes
@@ -295,6 +440,7 @@ class OverlappedPrepass:
         execution_timeout: Optional[float] = None,
         ownership: bool = False,
         deadline=None,
+        mesh_groups: Optional[int] = None,
     ) -> None:
         import threading
 
@@ -323,6 +469,7 @@ class OverlappedPrepass:
                     execution_timeout=execution_timeout,
                     ownership=ownership,
                     deadline=deadline,
+                    mesh_groups=mesh_groups,
                 )
             )
 
@@ -654,6 +801,7 @@ def analyze_corpus(
     deterministic_solving: Optional[bool] = None,
     deadline_s: Optional[float] = None,
     on_timeout: str = "partial",
+    devices: Optional[int] = None,
     _flag_scoped: bool = False,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
@@ -711,6 +859,7 @@ def analyze_corpus(
                 deterministic_solving=deterministic_solving,
                 deadline_s=deadline_s,
                 on_timeout=on_timeout,
+                devices=devices,
                 _flag_scoped=True,
             )
         finally:
@@ -778,6 +927,7 @@ def analyze_corpus(
                 execution_timeout=execution_timeout,
                 ownership=_ownership_enabled(use_device),
                 deadline=deadline,
+                mesh_groups=devices,
             )
             # Smallest code first: cheap analyses (which converge well
             # inside their budgets regardless of contention) soak up
@@ -911,6 +1061,7 @@ def analyze_corpus(
                     ownership=_ownership_enabled(use_device),
                     deadline=deadline,
                     stop_event=resilience.shutdown_event(),
+                    mesh_groups=devices,
                 )
             own = _ownership_enabled(use_device)
             results = []
@@ -976,6 +1127,7 @@ def analyze_corpus(
                     transaction_count=transaction_count,
                     deadline=deadline,
                     stop_event=resilience.shutdown_event(),
+                    mesh_groups=devices,
                 )
             results = []
             halt_reason = None

@@ -382,6 +382,21 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--devices",),
+        dict(
+            type=int,
+            default=None,
+            metavar="N",
+            help=(
+                "Shard the corpus over N device groups (multi-chip "
+                "corpus scheduler): one wave engine per group, "
+                "cross-group work stealing, per-group failure "
+                "domains. Default: one lane-sharded engine over all "
+                "visible devices"
+            ),
+        ),
+    ),
+    (
         ("--device-ownership",),
         dict(
             choices=["auto", "always", "never"],
@@ -706,6 +721,18 @@ def build_parser() -> ArgumentParser:
         help=(
             "disable double-buffered wave pipelining (dispatch wave "
             "N+1 while harvesting wave N); lock-step waves instead"
+        ),
+    )
+    serve.add_argument(
+        "--devices",
+        type=int,
+        default=1,
+        metavar="N",
+        help=(
+            "split the arena over N device groups: one dispatch/"
+            "harvest pair per group, jobs striped over groups at "
+            "admission, idle groups steal resident jobs "
+            "(/stats mesh.*). Stripes must divide evenly by N"
         ),
     )
 
@@ -1049,6 +1076,7 @@ def _run_analyze(disassembler, address, args):
         deterministic_solving=args.deterministic_solving,
         static_prune=not args.no_static_prune,
         pipeline=not args.no_pipeline,
+        mesh_devices=args.devices,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
     )
@@ -1176,6 +1204,7 @@ def _cmd_serve(args: Namespace) -> None:
         transaction_count=args.transaction_count,
         checkpoint_dir=args.checkpoint_dir,
         pipeline=not args.no_pipeline,
+        devices=args.devices,
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()

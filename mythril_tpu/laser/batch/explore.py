@@ -723,6 +723,8 @@ class DeviceCorpusExplorer:
         deadline=None,
         checkpoint_path=None,
         pipeline: Optional[bool] = None,
+        devices=None,
+        fault_domain: Optional[str] = None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -824,8 +826,22 @@ class DeviceCorpusExplorer:
         self.code_ids = np.repeat(
             np.arange(len(self.codes), dtype=np.int32), lanes_per_contract
         )
+        #: multi-chip scheduler attribution (parallel/topology.py):
+        #: the failure-domain label qualifies this explorer's fault
+        #: injection sites and degradation records, so a fault in one
+        #: device group's engine is pinned to THAT group
+        self.fault_domain = fault_domain
         self.mesh = None
-        if n_devices is not None and n_devices > 1:
+        if devices is not None:
+            # an explicit device set (one scheduler group): the wave
+            # pins to these devices via the mesh path — a single-device
+            # group is a 1-device mesh, which is how a group's arena
+            # replica stays resident on its own chip
+            from mythril_tpu.parallel import make_mesh, replicate_table
+
+            self.mesh = make_mesh(devices=devices)
+            self.code_table = replicate_table(self.code_table, self.mesh)
+        elif n_devices is not None and n_devices > 1:
             from mythril_tpu.parallel import make_mesh, replicate_table
 
             self.mesh = make_mesh(n_devices)
@@ -859,6 +875,26 @@ class DeviceCorpusExplorer:
                 track.static = None
                 track.static_dead = frozenset()
 
+    # -- failure-domain attribution ------------------------------------
+    def _inject(self, site: str) -> None:
+        """Fire the fault-injection hook at `site` — and, when this
+        explorer runs inside a scheduler device group, at the
+        domain-qualified site too, so a harness can fault ONE group's
+        dispatches (`device.dispatch.mesh-g0`) while the other groups'
+        engines run clean."""
+        from mythril_tpu.support import resilience
+
+        resilience.inject(site)
+        if self.fault_domain is not None:
+            resilience.inject(f"{site}.{self.fault_domain}")
+
+    def _site(self, site: str) -> str:
+        """Degradation-record site, qualified with the failure domain
+        so the DegradationLog attributes the group."""
+        if self.fault_domain is not None:
+            return f"{site}/{self.fault_domain}"
+        return site
+
     # -- supervision ---------------------------------------------------
     def _stop_requested(self) -> bool:
         """One answer for every wave/budget/solve boundary: the owner's
@@ -876,11 +912,92 @@ class DeviceCorpusExplorer:
             if self._halt_reason is None:
                 self._halt_reason = reason
                 resilience.DegradationLog().record(
-                    reason, site="explorer",
+                    reason, site=self._site("explorer"),
                     detail="exploration wound down at a wave boundary",
                 )
             return True
         return False
+
+    # -- frontier handoff (multi-chip work stealing) --------------------
+    def export_frontier(self, ci: int) -> Dict:
+        """Pack contract ci's live exploration frontier for a host
+        handoff to another device group's engine
+        (parallel/scheduler.py work stealing): the seeds worth
+        re-dispatching (flip witnesses first — solver-derived inputs
+        are the expensive part), the covered/attempted sets (so the
+        stealing engine never re-solves a flip this one already
+        answered), and the banked transaction-start carries with their
+        journals. Everything is host-resident after a harvest; the
+        stealing side re-uploads it through its own wave seeding path
+        (the same width-bucketed slab `reseed_wave` ships), which is
+        the device-side unpack."""
+        track = self.tracks[ci]
+        seen: Set[bytes] = set()
+        inputs: List[bytes] = []
+        for data in list(reversed(track.flip_corpus)) + [
+            d for _, d in reversed(track.corpus)
+        ]:
+            if data not in seen:
+                seen.add(data)
+                inputs.append(data)
+        carries = []
+        for carry in track.carries:
+            if any(carry is p for p in track.poison_carries):
+                continue  # poison is re-derived from observed reads
+            packed = {
+                "journal": dict(carry["journal"]),
+                "prefix": list(carry["prefix"]),
+            }
+            for key in ("callvalue", "balance", "prefix_values"):
+                if carry.get(key):
+                    packed[key] = carry[key]
+            if carry.get("base"):
+                packed["base"] = dict(carry["base"])
+            carries.append(packed)
+        return {
+            "code_hex": track.code_hex,
+            "covered": sorted(track.covered),
+            "attempted": sorted(track.attempted),
+            "parent_inputs": inputs[:64],
+            "carries": carries[:CARRY_CAP],
+        }
+
+    def seed_frontier(self, ci: int, frontier: Dict) -> None:
+        """Install a stolen frontier (export_frontier's shape) into
+        contract ci's track BEFORE run(): the engine continues the
+        donor's exploration instead of restarting it — solved flips
+        stay blacklisted, covered directions stay off the flip
+        frontier, and the donor's carries become this engine's
+        transaction-start states."""
+        track = self.tracks[ci]
+        if track.code_hex != frontier.get("code_hex", track.code_hex):
+            raise ValueError(
+                "frontier handoff code mismatch for contract "
+                f"{ci}: refusing to seed another contract's state"
+            )
+        track.covered |= {tuple(b) for b in frontier.get("covered", [])}
+        track.attempted |= {
+            tuple(b) for b in frontier.get("attempted", [])
+        }
+        track.parent_inputs = [
+            bytes(d) for d in frontier.get("parent_inputs", [])
+        ]
+        carries = frontier.get("carries")
+        if carries:
+            track.carries = [
+                {
+                    "journal": dict(c.get("journal", {})),
+                    "prefix": [bytes(p) for p in c.get("prefix", [])],
+                    **{
+                        k: c[k]
+                        for k in (
+                            "callvalue", "balance", "prefix_values", "base",
+                        )
+                        if c.get(k)
+                    },
+                }
+                for c in carries[:CARRY_CAP]
+            ]
 
     # -- seeding -------------------------------------------------------
     def _seed_phase_inputs(
@@ -1226,7 +1343,7 @@ class DeviceCorpusExplorer:
         correct wave attribution."""
         from mythril_tpu.support import resilience
 
-        resilience.inject("explore.wave")
+        self._inject("explore.wave")
         fl = _Inflight(payload)
         fl.dispatch_t = time.perf_counter()
         try:
@@ -1259,6 +1376,12 @@ class DeviceCorpusExplorer:
         from mythril_tpu.support import resilience
 
         def _cold():
+            # the ladder's own per-attempt injection point, qualified
+            # so a chaos harness keeps faulting ONLY this group's
+            # retries (the global `device.dispatch` site fires inside
+            # retry_device_dispatch for every group alike)
+            if self.fault_domain is not None:
+                resilience.inject(f"device.dispatch.{self.fault_domain}")
             sym = self._cold_sym(fl.payload)
             out, steps, active = sym_run(
                 sym, self.code_table, max_steps=self.steps_per_wave
@@ -1285,7 +1408,7 @@ class DeviceCorpusExplorer:
         wait0 = time.perf_counter()
         if fl.failed is None:
             try:
-                resilience.inject("device.dispatch")
+                self._inject("device.dispatch")
                 jax.block_until_ready(fl.steps)
                 out, steps, active = fl.out, fl.steps, fl.active
             except Exception as why:
@@ -1293,7 +1416,7 @@ class DeviceCorpusExplorer:
                     raise
                 resilience.DegradationLog().record(
                     resilience.DegradationReason.ASYNC_DEVICE_FAULT,
-                    site=f"wave#{fl.payload.serial}",
+                    site=self._site(f"wave#{fl.payload.serial}"),
                     detail=str(why),
                 )
                 self._carcass = None
@@ -2238,7 +2361,7 @@ class DeviceCorpusExplorer:
 
                 DegradationLog().record(
                     DegradationReason.WAVE_ABANDONED,
-                    site="explorer",
+                    site=self._site("explorer"),
                     detail=str(why),
                 )
                 self.stats.device_faults += 1

@@ -14,3 +14,13 @@ from mythril_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from mythril_tpu.parallel.topology import (  # noqa: F401
+    DeviceGroup,
+    FailureDomain,
+    MeshTopology,
+    discover_topology,
+)
+
+# CorpusScheduler is imported lazily by consumers
+# (mythril_tpu.parallel.scheduler) — it drags the wave engine in, and
+# topology/mesh users (CLI flag validation, lint) must stay light.

@@ -1,0 +1,586 @@
+"""Multi-chip corpus scheduler: sharded wave dispatch + cross-device
+frontier work stealing.
+
+One wave engine (laser/batch/explore.py DeviceCorpusExplorer) per
+device group (topology.py): contracts shard across the groups at
+admission time (greedy longest-processing-time by code size — the
+static balance), and rebalance LIVE: a group whose queue drains while
+another group is dispatch-bound steals pending work — queued contracts
+and *flip-frontier continuations* (a partially-explored contract's
+exported frontier: solver-derived seeds, covered/attempted sets,
+banked carries) — from the most-loaded group. The handoff is
+host-mediated (the frontier is host-resident after every harvest) and
+re-enters the device through the stealing engine's normal wave-seed
+upload, the same width-bucketed slab `symbolic.reseed_wave` ships —
+that upload is the device-side unpack. No chip idles while another
+still has a queue.
+
+Failure domains: each group's engine carries the group's fault-domain
+label, so a wave that dies past the retry→split ladder degrades ONLY
+that group's shard (its contracts fall back to the host walk, the
+DegradationLog attributes the group), while every other group keeps
+dispatching. This is Manticore's (arXiv:1907.03890) load-balancing
+lesson applied at the chip level, and EVMx's (arXiv:2507.23518)
+keep-every-lane-fed rule applied across chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from mythril_tpu.parallel.topology import (
+    DeviceGroup,
+    MeshTopology,
+    discover_topology,
+)
+
+log = logging.getLogger(__name__)
+
+#: contracts per explorer run: small enough that a group reaches a
+#: steal point every few waves, large enough that waves stay batched
+DEFAULT_CHUNK = 8
+
+#: a continuation item is only re-admitted while this much budget
+#: remains — below it the re-run could not finish a single wave
+MIN_CONTINUATION_BUDGET_S = 8.0
+
+
+class WorkItem:
+    """One schedulable unit: a contract, optionally carrying a stolen
+    frontier (a previous partial exploration to continue)."""
+
+    __slots__ = ("index", "code_hex", "frontier", "passes", "home_group")
+
+    def __init__(
+        self,
+        index: int,
+        code_hex: str,
+        frontier: Optional[Dict] = None,
+        passes: int = 0,
+        home_group: int = 0,
+    ) -> None:
+        self.index = index
+        self.code_hex = code_hex
+        self.frontier = frontier
+        self.passes = passes
+        self.home_group = home_group
+
+    def handoff_nbytes(self) -> int:
+        """The host-handoff cost of moving this item between groups:
+        the code row plus — for a continuation — the seed slab and
+        journal limbs the stealing device re-uploads (u8 calldata
+        bytes + 8 u32 limbs per journal key and value), the same
+        accounting `reseed_wave`'s upload pays."""
+        n = len(self.code_hex) // 2
+        if self.frontier:
+            n += sum(len(d) for d in self.frontier.get("parent_inputs", []))
+            for carry in self.frontier.get("carries", []):
+                n += len(carry.get("journal", {})) * 2 * 32
+            n += 8 * (
+                len(self.frontier.get("covered", []))
+                + len(self.frontier.get("attempted", []))
+            )
+        return n
+
+
+class GroupLedger:
+    """Per-group scheduling/observability state."""
+
+    def __init__(self, group: DeviceGroup) -> None:
+        self.group = group
+        self.queue: "deque[WorkItem]" = deque()
+        self.admitted = 0
+        self.contracts_done = 0
+        self.chunks = 0
+        self.waves = 0
+        self.device_steps = 0
+        self.busy_s = 0.0
+        self.steals = 0  # steal events this group INITIATED
+        self.stolen_items = 0  # items this group took from others
+        self.victim_items = 0  # items other groups took from this one
+
+    def as_dict(self, wall_s: float) -> Dict:
+        occupancy = (
+            round(min(1.0, self.busy_s / wall_s), 3) if wall_s > 0 else 0.0
+        )
+        return {
+            "group": self.group.gid,
+            "devices": [str(d) for d in self.group.devices],
+            "contracts": self.contracts_done,
+            "chunks": self.chunks,
+            "waves": self.waves,
+            "device_steps": self.device_steps,
+            "busy_s": round(self.busy_s, 3),
+            "occupancy": occupancy,
+            "steals": self.steals,
+            "stolen_items": self.stolen_items,
+            "victim_items": self.victim_items,
+            "faults": self.group.failure_domain.faults,
+            "degraded_contracts": (
+                self.group.failure_domain.degraded_contracts
+            ),
+        }
+
+
+def merge_outcomes(old: Optional[Dict], new: Dict) -> Dict:
+    """Fold a continuation run's outcome over the donor's: coverage
+    and evidence union (the continuation imported the donor's covered
+    set, but its trigger/evidence banks start empty), completeness
+    taken from the LAST run — it owns the live frontier."""
+    if not old:
+        return new
+    out = dict(new)
+    covered = {tuple(b) for b in old.get("covered_branches", [])}
+    covered |= {tuple(b) for b in new.get("covered_branches", [])}
+    out["covered_branches"] = sorted(covered)
+    triggers: Dict[str, List[Dict]] = {}
+    for src in (old, new):
+        for kind, bucket in (src.get("triggers") or {}).items():
+            dst = triggers.setdefault(kind, [])
+            for trig in bucket:
+                if all(trig["pc"] != t["pc"] for t in dst):
+                    dst.append(trig)
+    out["triggers"] = triggers
+    seen = set()
+    evidence: List[Dict] = []
+    for src in (old, new):
+        for rec in src.get("evidence") or []:
+            key = (rec.get("class"), rec.get("pc"), rec.get("detail"))
+            if key not in seen:
+                seen.add(key)
+                evidence.append(rec)
+    out["evidence"] = evidence
+    out["corpus_size"] = old.get("corpus_size", 0) + new.get(
+        "corpus_size", 0
+    )
+    out["degraded_lanes"] = old.get("degraded_lanes", 0) + new.get(
+        "degraded_lanes", 0
+    )
+    return out
+
+
+#: ExploreStats counters that merge by max, not sum
+_STATS_MAX = {
+    "arena_nodes",
+    "transactions",
+    "waves_inflight_max",
+    "pipelined",
+}
+#: derived ratios recomputed after the merge
+_STATS_DERIVED = {
+    "wave_overlap_ratio",
+    "device_idle_frac",
+    "evidence_bytes_per_wave",
+    "wall_s",
+}
+
+
+class CorpusScheduler:
+    """Shard a corpus across device groups and run one wave engine per
+    group, work-stealing between them.
+
+    `run()` returns the same contract as DeviceCorpusExplorer.run():
+    {"stats": merged explorer counters + a "mesh" block, "contracts":
+    [outcome per input contract, in input order]} — so the corpus
+    prepass (analysis/corpus.py) can swap the single engine for the
+    scheduler without its consumers noticing anything but the mesh
+    counters."""
+
+    def __init__(
+        self,
+        codes_hex: List[str],
+        n_groups: Optional[int] = None,
+        devices=None,
+        topology: Optional[MeshTopology] = None,
+        chunk: int = DEFAULT_CHUNK,
+        budget_s: Optional[float] = None,
+        seed: int = 1,
+        calldata_len: Optional[int] = None,
+        host_lock=None,
+        stop_event=None,
+        publish: Optional[Callable[[int, Dict], None]] = None,
+        lock_wanted=None,
+        deadline=None,
+        parallel: bool = True,
+        continuation: bool = True,
+        shard: str = "lpt",
+        checkpoint_path=None,
+        explorer_kwargs: Optional[Dict] = None,
+    ) -> None:
+        from mythril_tpu.laser.batch.explore import required_calldata_len
+
+        self.codes_hex = [
+            c[2:] if c.startswith("0x") else c for c in codes_hex
+        ]
+        self.topology = topology or discover_topology(n_groups, devices)
+        self.chunk = max(1, chunk)
+        self.budget_s = budget_s
+        self.seed = seed
+        # ONE corpus-wide calldata envelope (the rule the single-engine
+        # prepass applies): per-group envelopes would make a stolen
+        # contract's witnesses change width mid-handoff
+        self.calldata_len = calldata_len or max(
+            (required_calldata_len(c) for c in self.codes_hex), default=68
+        )
+        self.host_lock = host_lock
+        self.stop_event = stop_event
+        self.publish = publish
+        self.lock_wanted = lock_wanted
+        self.deadline = deadline
+        self.parallel = parallel
+        self.continuation = continuation
+        #: wave-checkpoint template: each group flushes its own latest
+        #: seeded frontier to `<path>.<group-label>` (one file per
+        #: failure domain — a faulted group replays ITS wave)
+        self.checkpoint_path = checkpoint_path
+        self.explorer_kwargs = dict(explorer_kwargs or {})
+        self._mu = threading.Lock()
+        self.ledgers = [GroupLedger(g) for g in self.topology.groups]
+        self.outcomes: Dict[int, Dict] = {}
+        self._merged_stats: Dict[str, float] = {}
+        self._steal_events = 0
+        self._rebalance_bytes = 0
+        self._admit(shard)
+
+    # -- admission ------------------------------------------------------
+    def _admit(self, shard) -> None:
+        """Admission-time sharding. "lpt" = greedy longest-processing-
+        time by code size (largest contract to the least-loaded group —
+        the classic 4/3-approximate static balance); "round-robin" =
+        positional striping (deterministic layouts for tests and
+        differentials); an explicit list of group ids pins contract i
+        to group shard[i] (imbalance harnesses — the steal tests build
+        a loaded and a drained shard this way)."""
+        items = [
+            WorkItem(i, code) for i, code in enumerate(self.codes_hex)
+        ]
+        if isinstance(shard, (list, tuple)):
+            if len(shard) != len(items):
+                raise ValueError(
+                    f"explicit shard map covers {len(shard)} contracts; "
+                    f"the corpus has {len(items)}"
+                )
+            for item, gid in zip(items, shard):
+                if not 0 <= gid < len(self.ledgers):
+                    raise ValueError(
+                        f"shard map group {gid} outside "
+                        f"0..{len(self.ledgers) - 1}"
+                    )
+                item.home_group = gid
+                self.ledgers[gid].queue.append(item)
+                self.ledgers[gid].admitted += 1
+        elif shard == "lpt":
+            loads = [0] * len(self.ledgers)
+            for item in sorted(
+                items, key=lambda it: len(it.code_hex), reverse=True
+            ):
+                gid = loads.index(min(loads))
+                item.home_group = gid
+                self.ledgers[gid].queue.append(item)
+                self.ledgers[gid].admitted += 1
+                loads[gid] += max(1, len(item.code_hex) // 2)
+        elif shard == "round-robin":
+            for pos, item in enumerate(items):
+                gid = pos % len(self.ledgers)
+                item.home_group = gid
+                self.ledgers[gid].queue.append(item)
+                self.ledgers[gid].admitted += 1
+        else:
+            raise ValueError(f"unknown shard policy {shard!r}")
+
+    # -- the queues -----------------------------------------------------
+    def _take(self, gid: int) -> List[WorkItem]:
+        with self._mu:
+            queue = self.ledgers[gid].queue
+            return [queue.popleft() for _ in range(min(self.chunk, len(queue)))]
+
+    def _steal(self, gid: int) -> List[WorkItem]:
+        """Take up to half of the most-loaded group's pending queue
+        (from the tail — the victim keeps the work it is about to
+        start). The move is counted in handoff bytes: code rows plus,
+        for continuations, the frontier slab the stealing device
+        re-uploads."""
+        with self._mu:
+            victim = max(
+                (led for led in self.ledgers if led.group.gid != gid),
+                key=lambda led: len(led.queue),
+                default=None,
+            )
+            if victim is None or not victim.queue:
+                return []
+            take = min(self.chunk, (len(victim.queue) + 1) // 2)
+            items = [victim.queue.pop() for _ in range(take)]
+            items.reverse()
+            led = self.ledgers[gid]
+            led.steals += 1
+            led.stolen_items += len(items)
+            victim.victim_items += len(items)
+            self._steal_events += 1
+            moved = sum(item.handoff_nbytes() for item in items)
+            self._rebalance_bytes += moved
+            log.debug(
+                "mesh steal: group %d took %d item(s) (%d handoff bytes) "
+                "from group %d",
+                gid,
+                len(items),
+                moved,
+                victim.group.gid,
+            )
+            return items
+
+    def _budget_left(self) -> Optional[float]:
+        if self.budget_s is None:
+            return None
+        return self.budget_s - (time.perf_counter() - self._t0)
+
+    def _stopping(self) -> bool:
+        from mythril_tpu.support import resilience
+
+        if self.stop_event is not None and self.stop_event.is_set():
+            return True
+        return resilience.interrupted_reason(self.deadline) is not None
+
+    # -- per-group execution --------------------------------------------
+    def _run_chunk(self, group: DeviceGroup, items: List[WorkItem]) -> None:
+        from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+        led = self.ledgers[group.gid]
+        kwargs = dict(self.explorer_kwargs)
+        if self.checkpoint_path:
+            kwargs["checkpoint_path"] = (
+                f"{self.checkpoint_path}.{group.label}"
+            )
+        n_lanes = len(items) * kwargs.get("lanes_per_contract", 32)
+        devices = group.devices_for_lanes(n_lanes)
+        budget = self._budget_left()
+        translate = None
+        if self.publish is not None:
+            publish = self.publish
+
+            def translate(ti, outcome, _items=items, _publish=publish):
+                _publish(_items[ti].index, outcome)
+
+        t0 = time.perf_counter()
+        explorer = DeviceCorpusExplorer(
+            [item.code_hex for item in items],
+            calldata_len=self.calldata_len,
+            seed=self.seed,
+            budget_s=max(1.0, budget) if budget is not None else None,
+            host_lock=self.host_lock,
+            stop_event=self.stop_event,
+            publish=translate,
+            deadline=self.deadline,
+            devices=devices,
+            fault_domain=group.label,
+            **kwargs,
+        )
+        if self.lock_wanted is not None:
+            explorer.lock_wanted = self.lock_wanted
+        for pos, item in enumerate(items):
+            if item.frontier:
+                explorer.seed_frontier(pos, item.frontier)
+        result = explorer.run()
+        wall = time.perf_counter() - t0
+        stats = result["stats"]
+        if stats.get("device_faults"):
+            group.failure_domain.record_degraded(
+                len(items),
+                detail=(
+                    f"{stats['device_faults']} wave(s) abandoned in "
+                    f"chunk of {len(items)}"
+                ),
+            )
+        requeue: List[WorkItem] = []
+        with self._mu:
+            led.chunks += 1
+            led.waves += stats.get("waves", 0)
+            led.device_steps += stats.get("device_steps", 0)
+            led.busy_s += wall
+            self._merge_stats(stats)
+            budget_now = self._budget_left()
+            for pos, (item, outcome) in enumerate(
+                zip(items, result["contracts"])
+            ):
+                outcome["mesh_group"] = group.gid
+                self.outcomes[item.index] = merge_outcomes(
+                    self.outcomes.get(item.index), outcome
+                )
+                gates = outcome.get("completeness_gates") or {}
+                if (
+                    self.continuation
+                    and item.passes == 0
+                    and not outcome.get("device_complete")
+                    and gates.get("frontier_closed") is False
+                    and not stats.get("device_faults")
+                    and budget_now is not None
+                    and budget_now > MIN_CONTINUATION_BUDGET_S
+                    and not self._stopping()
+                ):
+                    # the open flip frontier becomes a stealable
+                    # continuation: whichever group drains first picks
+                    # it up and resumes from the exported state
+                    try:
+                        frontier = explorer.export_frontier(pos)
+                    except Exception:
+                        log.debug(
+                            "frontier export failed; contract not "
+                            "re-admitted",
+                            exc_info=True,
+                        )
+                        continue
+                    requeue.append(
+                        WorkItem(
+                            item.index,
+                            item.code_hex,
+                            frontier=frontier,
+                            passes=item.passes + 1,
+                            home_group=group.gid,
+                        )
+                    )
+            led.contracts_done += len(items) - len(requeue)
+            for item in requeue:
+                led.queue.append(item)
+
+    def _merge_stats(self, stats: Dict) -> None:
+        """Fold one chunk's ExploreStats dict into the corpus-wide
+        merge (sum counters, max high-water marks; ratios recomputed
+        at the end). Caller holds the lock."""
+        for key, value in stats.items():
+            if not isinstance(value, (int, float)) or key in _STATS_DERIVED:
+                continue
+            if key in _STATS_MAX:
+                self._merged_stats[key] = max(
+                    self._merged_stats.get(key, 0), value
+                )
+            else:
+                self._merged_stats[key] = (
+                    self._merged_stats.get(key, 0) + value
+                )
+
+    def _worker(self, group: DeviceGroup) -> None:
+        while not self._stopping():
+            budget = self._budget_left()
+            # budget-0 parity with the single engine: every group still
+            # opens its FIRST chunk (whose explorer opens its one
+            # unconditional wave) — bench warmup relies on it
+            if (
+                budget is not None
+                and budget <= 0
+                and self.ledgers[group.gid].chunks > 0
+            ):
+                return
+            items = self._take(group.gid)
+            if not items:
+                items = self._steal(group.gid)
+            if not items:
+                return
+            try:
+                self._run_chunk(group, items)
+            except Exception:
+                # the explorer already contains classified faults; an
+                # escape here is a logic bug in THIS chunk — fail its
+                # contracts' outcomes, keep the other groups running
+                log.exception(
+                    "mesh group %d chunk failed", group.gid
+                )
+                with self._mu:
+                    for item in items:
+                        self.outcomes.setdefault(
+                            item.index, {"mesh_group": group.gid}
+                        )
+
+    # -- the run --------------------------------------------------------
+    def run(self) -> Dict:
+        self._t0 = time.perf_counter()
+        if self.parallel and self.topology.n_groups > 1:
+            threads = [
+                threading.Thread(
+                    target=self._worker,
+                    args=(group,),
+                    name=f"mesh-{group.label}",
+                    daemon=True,
+                )
+                for group in self.topology.groups
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            # deterministic cooperative schedule (tests, 1-group runs):
+            # round-robin one chunk per group; a drained group steals
+            # exactly as the threaded schedule would
+            progressed = True
+            while progressed and not self._stopping():
+                progressed = False
+                for group in self.topology.groups:
+                    budget = self._budget_left()
+                    if (
+                        budget is not None
+                        and budget <= 0
+                        and self.ledgers[group.gid].chunks > 0
+                    ):
+                        break
+                    items = self._take(group.gid)
+                    if not items:
+                        items = self._steal(group.gid)
+                    if not items:
+                        continue
+                    self._run_chunk(group, items)
+                    progressed = True
+        wall = time.perf_counter() - self._t0
+        return self._result(wall)
+
+    def _result(self, wall_s: float) -> Dict:
+        stats = dict(self._merged_stats)
+        stats["wall_s"] = round(wall_s, 3)
+        busy = stats.get("device_busy_s", 0.0)
+        overlap = stats.get("wave_overlap_s", 0.0)
+        stats["wave_overlap_ratio"] = (
+            round(min(1.0, overlap / busy), 3) if busy > 0 else 0.0
+        )
+        # idle means NO group had a wave in flight — under the mesh the
+        # per-group busy spans overlap, so clamp into [0, 1]
+        stats["device_idle_frac"] = (
+            round(
+                max(
+                    0.0,
+                    min(
+                        1.0,
+                        1.0 - busy / (wall_s * self.topology.n_groups),
+                    ),
+                ),
+                3,
+            )
+            if wall_s > 0
+            else 0.0
+        )
+        waves = stats.get("waves", 0)
+        stats["evidence_bytes_per_wave"] = (
+            int(stats.get("evidence_bytes", 0) / waves) if waves else 0
+        )
+        stats["mesh_devices"] = self.topology.n_devices
+        stats["mesh_groups"] = self.topology.n_groups
+        stats["steal_count"] = self._steal_events
+        stats["stolen_items"] = sum(
+            led.stolen_items for led in self.ledgers
+        )
+        stats["rebalance_bytes"] = self._rebalance_bytes
+        stats["mesh"] = {
+            "devices": self.topology.n_devices,
+            "groups": self.topology.n_groups,
+            "steals": self._steal_events,
+            "stolen_items": stats["stolen_items"],
+            "rebalance_bytes": self._rebalance_bytes,
+            "per_device": [
+                led.as_dict(wall_s) for led in self.ledgers
+            ],
+        }
+        contracts = [
+            self.outcomes.get(i, {}) for i in range(len(self.codes_hex))
+        ]
+        return {"stats": stats, "contracts": contracts}

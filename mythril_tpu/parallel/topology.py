@@ -1,0 +1,177 @@
+"""Device-group topology for the multi-chip corpus scheduler.
+
+The mesh helpers (mesh.py) answer "how does ONE wave shard over N
+chips" — lane-major data parallelism inside a single dispatch. This
+module answers the layer above: "how do the visible chips split into
+independent *device groups*", where each group runs its own wave
+engine (laser/batch/explore.py), owns its own arena replica, and forms
+its own **failure domain** — a faulted chip demotes only its group's
+shard of the corpus through the existing retry→split ladder
+(support/resilience.py), while every other group keeps dispatching.
+
+Manticore (arXiv:1907.03890) showed state-level parallel symbolic
+execution pays only with real load balancing; the group split is what
+makes balancing possible: groups are independent dispatch streams, so
+an idle group can steal work (parallel/scheduler.py) without fencing
+another group's in-flight wave.
+
+Topology is host-side bookkeeping only — no jax import at module
+import time, so the static/lint paths never initialize a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class FailureDomain:
+    """One group's fault-containment ledger.
+
+    The explorer's dispatch/harvest injection sites are qualified with
+    the domain label (``device.dispatch.mesh-g<k>``) so a test — or a
+    chaos harness — can fault ONE group's dispatches and pin that only
+    that group's shard degrades. The qualified site keeps the
+    ``device.`` prefix because `resilience.is_device_fault` classifies
+    injected faults by site prefix: a domain fault must enter the same
+    retry→split ladder a real XLA fault would."""
+
+    def __init__(self, gid: int) -> None:
+        self.gid = gid
+        self.label = f"mesh-g{gid}"
+        #: explorer runs in this domain that lost a wave past the
+        #: whole retry ladder (the shard degraded, the run continued)
+        self.faults = 0
+        #: contracts whose exploration the degradation touched — they
+        #: fall back to the host walk, same as single-chip degradation
+        self.degraded_contracts = 0
+
+    @property
+    def fault_site(self) -> str:
+        """The domain-qualified injection site (``device.`` prefix =
+        classified as an infrastructure fault)."""
+        return f"device.dispatch.{self.label}"
+
+    def record_degraded(self, n_contracts: int, detail: str = "") -> None:
+        """A wave in this domain died past the retry ladder: attribute
+        the degradation to THIS group in the DegradationLog, so the
+        report says which chip group — not just that "a device" —
+        carried the fault."""
+        from mythril_tpu.support.resilience import (
+            DegradationLog,
+            DegradationReason,
+        )
+
+        self.faults += 1
+        self.degraded_contracts += n_contracts
+        DegradationLog().record(
+            DegradationReason.MESH_GROUP_DEGRADED,
+            site=self.label,
+            detail=detail
+            or f"{n_contracts} contract(s) demoted to the host walk",
+        )
+
+    def as_dict(self) -> Dict:
+        return {
+            "group": self.gid,
+            "faults": self.faults,
+            "degraded_contracts": self.degraded_contracts,
+        }
+
+
+class DeviceGroup:
+    """A set of devices dispatched as one unit: one wave engine, one
+    arena replica, one failure domain. Groups with several devices
+    lane-shard their waves over an intra-group mesh (mesh.py); the
+    group boundary is the failure/scheduling boundary either way."""
+
+    def __init__(self, gid: int, devices: List) -> None:
+        if not devices:
+            raise ValueError(f"device group {gid} has no devices")
+        self.gid = gid
+        self.devices = list(devices)
+        self.failure_domain = FailureDomain(gid)
+
+    @property
+    def label(self) -> str:
+        return self.failure_domain.label
+
+    def devices_for_lanes(self, n_lanes: int) -> List:
+        """The largest prefix of this group's devices that divides the
+        lane count — shard_batch needs an even split, and a group must
+        never refuse work over a remainder lane (same shrink rule as
+        analysis/corpus.py's mesh sizing)."""
+        devs = list(self.devices)
+        while len(devs) > 1 and n_lanes % len(devs):
+            devs.pop()
+        return devs
+
+    def as_dict(self) -> Dict:
+        return {
+            "group": self.gid,
+            "devices": [str(d) for d in self.devices],
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"DeviceGroup({self.gid}, {len(self.devices)} device(s))"
+
+
+class MeshTopology:
+    """The discovered group layout: an ordered list of DeviceGroups
+    covering the visible devices."""
+
+    def __init__(self, groups: List[DeviceGroup]) -> None:
+        if not groups:
+            raise ValueError("a mesh topology needs at least one group")
+        self.groups = groups
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def n_devices(self) -> int:
+        return sum(len(g.devices) for g in self.groups)
+
+    def group(self, gid: int) -> DeviceGroup:
+        return self.groups[gid]
+
+    def as_dict(self) -> Dict:
+        return {
+            "groups": [g.as_dict() for g in self.groups],
+            "n_groups": self.n_groups,
+            "n_devices": self.n_devices,
+        }
+
+
+def discover_topology(
+    n_groups: Optional[int] = None, devices=None
+) -> MeshTopology:
+    """Split the visible devices into `n_groups` contiguous groups.
+
+    `n_groups=None` means one group per device (the finest failure
+    domains and the most steal targets). A request for more groups
+    than devices clamps — a group without a chip could never dispatch.
+    Contiguous assignment keeps intra-group meshes on neighboring
+    devices (ICI-adjacent on real slices; irrelevant but harmless on
+    the virtual CPU mesh). Remainder devices go to the leading groups,
+    one each, so group sizes differ by at most one."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    if not devices:
+        raise RuntimeError("no jax devices visible; cannot build a mesh")
+    if n_groups is None:
+        n_groups = len(devices)
+    if n_groups < 1:
+        raise ValueError(f"n_groups must be >= 1, got {n_groups}")
+    n_groups = min(n_groups, len(devices))
+    base, extra = divmod(len(devices), n_groups)
+    groups: List[DeviceGroup] = []
+    at = 0
+    for gid in range(n_groups):
+        take = base + (1 if gid < extra else 0)
+        groups.append(DeviceGroup(gid, devices[at : at + take]))
+        at += take
+    return MeshTopology(groups)

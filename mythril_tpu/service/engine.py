@@ -87,6 +87,7 @@ class ServiceConfig:
         coalesce_wait_s: float = 0.05,
         idle_wait_s: float = 0.2,
         pipeline: bool = True,
+        devices: int = 1,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -113,6 +114,11 @@ class ServiceConfig:
         #: overlaps device execution — waves from DISTINCT jobs share
         #: the two pipeline slots. `myth serve --no-pipeline` disables.
         self.pipeline = pipeline
+        #: `myth serve --devices N`: split the arena into N device
+        #: groups, one dispatch/harvest pair per group, jobs striped
+        #: over groups at admission and migrated to idle groups live
+        #: (/stats mesh.* counters). 1 = the single-arena engine.
+        self.devices = max(1, int(devices or 1))
 
 
 class CodeCache:
@@ -255,12 +261,16 @@ class _JobTrack:
 
     def harvest(
         self, inputs: List[bytes], status, halt_pc, gas_min, gas_max,
-        br_pc, br_taken, br_cnt, pc_seen, steps: int,
+        br_pc, br_taken, br_cnt, pc_seen, steps: int, lanes=None,
     ) -> None:
+        # `lanes` is the dispatch-time snapshot: under the mesh a job
+        # may migrate to another group while its wave is in flight, so
+        # the harvest must read the lanes the wave actually ran on
+        lanes = self.lanes if lanes is None else lanes
         fresh = 0
         self.waves_done += 1
-        self.lane_steps += steps * len(self.lanes)
-        for data, lane in zip(inputs, self.lanes):
+        self.lane_steps += steps * len(lanes)
+        for data, lane in zip(inputs, lanes):
             st = int(status[lane])
             if st in _DEGRADED_STATUSES:
                 self.degraded_lanes += 1
@@ -286,7 +296,7 @@ class _JobTrack:
                     self.covered.add(edge)
                     fresh += 1
             self.corpus.append(data)
-        rows = pc_seen[self.lanes].astype(np.uint32)
+        rows = pc_seen[lanes].astype(np.uint32)
         merged = np.bitwise_or.reduce(rows, axis=0)
         if self.pc_seen is None or np.any(merged & ~self.pc_seen):
             fresh += 1
@@ -344,9 +354,24 @@ class AnalysisEngine:
         ensure_compile_cache()
         self.cfg = config or ServiceConfig()
         self.queue = JobQueue(self.cfg.queue_capacity)
+        #: device-group mesh (myth serve --devices N): the arena
+        #: splits into per-group stripe blocks, each group runs its
+        #: own dispatch/harvest pair, and jobs stripe over the groups
+        self.mesh = None
+        if self.cfg.devices > 1:
+            from mythril_tpu.parallel.topology import discover_topology
+
+            self.mesh = discover_topology(self.cfg.devices)
         self.alloc = LaneAllocator(
-            self.cfg.stripes, self.cfg.lanes_per_stripe
+            self.cfg.stripes,
+            self.cfg.lanes_per_stripe,
+            groups=self.mesh.n_groups if self.mesh else 1,
         )
+        #: per-device (group) tables + mesh counters (/stats mesh.*)
+        self._group_tables: Dict = {}
+        self._group_waves = [0] * (self.mesh.n_groups if self.mesh else 1)
+        self.mesh_steals = 0
+        self.mesh_rebalance_bytes = 0
         self.code_cap = code_cap_bucket(1, floor=self.cfg.code_cap)
         self.code_cache = CodeCache(self.code_cap, self.cfg.code_cache_cap)
         self._tracks: "OrderedDict[str, _JobTrack]" = OrderedDict()
@@ -486,7 +511,10 @@ class AnalysisEngine:
         )
 
     def _admit(self) -> None:
-        """Between waves: pull queued jobs into free stripes."""
+        """Between waves: pull queued jobs into free stripes (striped
+        over the device groups least-loaded-first when --devices > 1),
+        then rebalance residents onto any group the admissions left
+        idle."""
         free = self.alloc.stripes - self.alloc.occupancy()["stripes_busy"]
         if free <= 0:
             return
@@ -494,8 +522,9 @@ class AnalysisEngine:
             n_stripes = self.alloc.stripes_needed(
                 job.lanes or self.cfg.lanes_per_stripe
             )
-            if n_stripes > self.alloc.stripes:
-                n_stripes = self.alloc.stripes
+            if n_stripes > self.alloc.stripes_per_group:
+                # a job must fit ONE group: its wave is one dispatch
+                n_stripes = self.alloc.stripes_per_group
             granted = self.alloc.allocate(job.id, n_stripes)
             if granted is None:
                 self.queue.unclaim(job)
@@ -511,8 +540,57 @@ class AnalysisEngine:
             self.static_seeds_dropped += track.static_seeds_dropped
             self._install_code(track)
             self._tracks[job.id] = track
+        if self.mesh is not None:
+            self._rebalance()
 
-    def _table(self):
+    def _rebalance(self) -> None:
+        """Live mesh balancing: a device group left with NO resident
+        job — while another group carries two or more — steals the
+        loaded group's newest job at the wave boundary. The move is a
+        host handoff (release stripes, re-grant in the idle group,
+        reinstall the code row); the job's corpus and coverage ride
+        its track untouched, and in-flight waves are safe because
+        dispatch records snapshot each job's lanes."""
+        occ = self.alloc.occupancy()["groups"]
+        idle = [g["group"] for g in occ if g["jobs_resident"] == 0]
+        if not idle:
+            return
+        for target in idle:
+            victim_group = max(occ, key=lambda g: g["jobs_resident"])
+            if victim_group["jobs_resident"] < 2:
+                return
+            jobs = self.alloc.jobs_in_group(victim_group["group"])
+            track = self._tracks.get(jobs[-1]) if jobs else None
+            if track is None:
+                return
+            old = track.stripes
+            granted = self.alloc.allocate(
+                track.job.id, len(old), group=target
+            )
+            if granted is None:
+                return
+            self.alloc.release(old)
+            track.stripes = granted
+            track.code_row = granted[0]
+            track.lanes = [
+                lane
+                for s in granted
+                for lane in self.alloc.lanes_of(s)
+            ]
+            self._install_code(track)
+            self.mesh_steals += 1
+            self.mesh_rebalance_bytes += len(track.job.code) + sum(
+                len(c) for c in track.corpus
+            )
+            log.info(
+                "mesh rebalance: job %s moved group %d -> %d",
+                track.job.id,
+                victim_group["group"],
+                target,
+            )
+            occ = self.alloc.occupancy()["groups"]
+
+    def _table(self, device=None):
         import jax.numpy as jnp
 
         from mythril_tpu.laser.batch.state import CodeTable
@@ -524,7 +602,19 @@ class AnalysisEngine:
                 jnp.asarray(self._arena_len),
             )
             self._table_dirty = False
-        return self._code_table
+            self._group_tables.clear()
+        if device is None:
+            return self._code_table
+        # per-group replica: a group's wave must find its table on its
+        # OWN device — mixed-device jit inputs are an error, and the
+        # replica is what makes the group's arena self-contained
+        cached = self._group_tables.get(device)
+        if cached is None:
+            import jax
+
+            cached = jax.device_put(self._code_table, device)
+            self._group_tables[device] = cached
+        return cached
 
     # -- the wave loop -------------------------------------------------
     def _loop(self) -> None:
@@ -611,6 +701,8 @@ class AnalysisEngine:
             for lane, data in zip(track.lanes, inputs):
                 code_ids[lane] = track.code_row
                 calldata[lane] = data
+        if self.mesh is not None:
+            return self._dispatch_wave_mesh(code_ids, calldata, wave_inputs)
         batch = make_batch(
             n,
             code_ids=code_ids,
@@ -650,18 +742,135 @@ class AnalysisEngine:
             record["failed"] = why
         return record
 
-    def _rebuild_batch(self, record: Dict):
+    def _dispatch_wave_mesh(
+        self, code_ids, calldata, wave_inputs: Dict
+    ) -> Dict:
+        """The --devices N dispatch: one wave PER DEVICE GROUP, each
+        over its own contiguous lane block with its own table replica,
+        launched asynchronously back-to-back so the groups execute
+        concurrently. Groups with no resident job skip their dispatch
+        entirely (an idle group burns nothing — and is exactly the
+        group _rebalance feeds next)."""
+        import jax
+
+        from mythril_tpu.laser.batch.run import run, run_donated
+        from mythril_tpu.laser.batch.state import make_batch
+        from mythril_tpu.support import resilience
+
+        donate = jax.default_backend() != "cpu"
+        record: Dict = {
+            "wave_inputs": wave_inputs,
+            "code_ids": code_ids,
+            "calldata": calldata,
+            "lanes_by_job": {
+                jid: list(self._tracks[jid].lanes)
+                for jid in wave_inputs
+                if jid in self._tracks
+            },
+            "group_by_job": {
+                jid: self.alloc.group_of(self._tracks[jid].stripes[0])
+                for jid in wave_inputs
+                if jid in self._tracks
+            },
+            "groups": [],
+            "t0": time.perf_counter(),
+        }
+        live_groups = set(record["group_by_job"].values())
+        span = self.alloc.lanes_per_group
+        for group in self.mesh.groups:
+            if group.gid not in live_groups:
+                continue
+            lo = group.gid * span
+            hi = lo + span
+            batch = make_batch(
+                span,
+                code_ids=code_ids[lo:hi],
+                calldata=calldata[lo:hi],
+                caller=DEFAULT_CALLER,
+                address=DEFAULT_ADDRESS,
+                timestamp=0x5BFA4639,
+                number=0x66E393,
+                gasprice=0x773594000,
+            )
+            device = group.devices[0]
+            batch = jax.device_put(batch, device)
+            grec = {
+                "gid": group.gid,
+                "device": device,
+                "lo": lo,
+                "hi": hi,
+                "out": None,
+                "steps": None,
+                "failed": None,
+            }
+            try:
+                runner = run_donated if donate else run
+                grec["out"], grec["steps"] = runner(
+                    batch,
+                    self._table(device),
+                    max_steps=self.cfg.steps_per_wave,
+                    track_coverage=True,
+                )
+            except Exception as why:
+                if not resilience.is_device_fault(why):
+                    raise
+                grec["failed"] = why
+            record["groups"].append(grec)
+            self._group_waves[group.gid] += 1
+        return record
+
+    def _rebuild_batch(self, record: Dict, lo: int = 0, hi=None):
         from mythril_tpu.laser.batch.state import make_batch
 
+        hi = self.alloc.n_lanes if hi is None else hi
         return make_batch(
-            self.alloc.n_lanes,
-            code_ids=record["code_ids"],
-            calldata=record["calldata"],
+            hi - lo,
+            code_ids=record["code_ids"][lo:hi],
+            calldata=record["calldata"][lo:hi],
             caller=DEFAULT_CALLER,
             address=DEFAULT_ADDRESS,
             timestamp=0x5BFA4639,
             number=0x66E393,
             gasprice=0x773594000,
+        )
+
+    def _note_wave_timing(self, wall: float) -> None:
+        now = time.monotonic()
+        self.waves_total += 1
+        if self._first_wave_t is None:
+            self._first_wave_t = now
+            self._wave_cold_s = wall
+        else:
+            ema = self._wave_warm_ema_s
+            self._wave_warm_ema_s = (
+                wall if ema is None else 0.8 * ema + 0.2 * wall
+            )
+        self._last_wave_t = now
+
+    def _job_wave_done(self, track: _JobTrack) -> bool:
+        """Post-harvest settlement shared by the single-arena and mesh
+        paths: deadline expiry, wave cap, staleness."""
+        track.job.waves = track.waves_done
+        max_waves = track.job.max_waves or self.cfg.max_waves
+        expired = (
+            track.job.deadline is not None and track.job.deadline.expired
+        )
+        if expired:
+            from mythril_tpu.support.resilience import (
+                DegradationLog,
+                DegradationReason,
+            )
+
+            track.job.degraded.append(DegradationReason.DEADLINE_EXPIRED)
+            DegradationLog().record(
+                DegradationReason.DEADLINE_EXPIRED,
+                site="service-wave",
+                contract=track.job.id,
+            )
+        return bool(
+            expired
+            or track.waves_done >= max_waves
+            or track.stale_waves >= 2
         )
 
     def _harvest_wave(self, record: Dict) -> None:
@@ -670,6 +879,8 @@ class AnalysisEngine:
         from mythril_tpu.laser.batch.run import run_resilient
         from mythril_tpu.support import resilience
 
+        if record.get("groups") is not None:
+            return self._harvest_wave_mesh(record)
         try:
             if record["failed"] is not None:
                 raise record["failed"]
@@ -696,18 +907,7 @@ class AnalysisEngine:
                 self._fail_wave(ladder_why)
                 return
         wave_inputs = record["wave_inputs"]
-        wall = time.perf_counter() - record["t0"]
-        now = time.monotonic()
-        self.waves_total += 1
-        if self._first_wave_t is None:
-            self._first_wave_t = now
-            self._wave_cold_s = wall
-        else:
-            ema = self._wave_warm_ema_s
-            self._wave_warm_ema_s = (
-                wall if ema is None else 0.8 * ema + 0.2 * wall
-            )
-        self._last_wave_t = now
+        self._note_wave_timing(time.perf_counter() - record["t0"])
         status, halt_pc, gas_min, gas_max, br_pc, br_taken, br_cnt, seen = (
             jax.device_get(
                 (
@@ -728,33 +928,121 @@ class AnalysisEngine:
                 wave_inputs[track.job.id], status, halt_pc, gas_min,
                 gas_max, br_pc, br_taken, br_cnt, seen, steps,
             )
-            track.job.waves = track.waves_done
-            max_waves = track.job.max_waves or self.cfg.max_waves
-            expired = (
-                track.job.deadline is not None
-                and track.job.deadline.expired
-            )
-            if expired:
-                from mythril_tpu.support.resilience import (
-                    DegradationLog,
-                    DegradationReason,
-                )
-
-                track.job.degraded.append(DegradationReason.DEADLINE_EXPIRED)
-                DegradationLog().record(
-                    DegradationReason.DEADLINE_EXPIRED,
-                    site="service-wave",
-                    contract=track.job.id,
-                )
-            if expired or track.waves_done >= max_waves or (
-                track.stale_waves >= 2
-            ):
+            if self._job_wave_done(track):
                 finished.append(track)
         for track in finished:
             del self._tracks[track.job.id]
             self.alloc.release(track.stripes)
             track.job.device_done_t = time.monotonic()
             self._dispatch_host(track)
+
+    def _harvest_wave_mesh(self, record: Dict) -> None:
+        """Harvest every group's wave of one mesh dispatch. Each group
+        is its own failure domain: a group whose readback faults past
+        the resilience ladder fails ONLY the jobs resident in it (the
+        DegradationLog attributes the group), while the other groups'
+        results harvest normally."""
+        import jax
+
+        from mythril_tpu.laser.batch.run import run_resilient
+        from mythril_tpu.support import resilience
+
+        n = self.alloc.n_lanes
+        fields = None
+        steps_by_group: Dict[int, int] = {}
+        failed_groups = set()
+        for grec in record["groups"]:
+            gid = grec["gid"]
+            try:
+                if grec["failed"] is not None:
+                    raise grec["failed"]
+                jax.block_until_ready(grec["steps"])
+                out, steps = grec["out"], grec["steps"]
+            except Exception as why:
+                if not resilience.is_device_fault(why):
+                    raise
+                resilience.DegradationLog().record(
+                    resilience.DegradationReason.ASYNC_DEVICE_FAULT,
+                    site=f"service-wave/mesh-g{gid}",
+                    detail=str(why),
+                )
+                try:
+                    out, steps = run_resilient(
+                        jax.device_put(
+                            self._rebuild_batch(
+                                record, grec["lo"], grec["hi"]
+                            ),
+                            grec["device"],
+                        ),
+                        self._table(grec["device"]),
+                        max_steps=self.cfg.steps_per_wave,
+                        track_coverage=True,
+                    )
+                except Exception as ladder_why:
+                    self._fail_group_jobs(gid, ladder_why, record)
+                    failed_groups.add(gid)
+                    continue
+            arrays = jax.device_get(
+                (
+                    out.status, out.pc, out.gas_min, out.gas_max,
+                    out.br_pc, out.br_taken, out.br_cnt, out.pc_seen,
+                )
+            )
+            if fields is None:
+                fields = [
+                    np.zeros((n,) + a.shape[1:], a.dtype) for a in arrays
+                ]
+            for full, part in zip(fields, arrays):
+                full[grec["lo"] : grec["hi"]] = part
+            steps_by_group[gid] = int(steps)
+            self.device_steps += int(steps) * (grec["hi"] - grec["lo"])
+        self._note_wave_timing(time.perf_counter() - record["t0"])
+        if fields is None:
+            return  # every live group failed; jobs already settled
+        status, halt_pc, gas_min, gas_max, br_pc, br_taken, br_cnt, seen = (
+            fields
+        )
+        finished: List[_JobTrack] = []
+        for track in list(self._tracks.values()):
+            jid = track.job.id
+            if jid not in record["wave_inputs"]:
+                continue
+            gid = record["group_by_job"].get(jid)
+            if gid is None or gid in failed_groups:
+                continue
+            track.harvest(
+                record["wave_inputs"][jid], status, halt_pc, gas_min,
+                gas_max, br_pc, br_taken, br_cnt, seen,
+                steps_by_group.get(gid, 0),
+                lanes=record["lanes_by_job"][jid],
+            )
+            if self._job_wave_done(track):
+                finished.append(track)
+        for track in finished:
+            del self._tracks[track.job.id]
+            self.alloc.release(track.stripes)
+            track.job.device_done_t = time.monotonic()
+            self._dispatch_host(track)
+
+    def _fail_group_jobs(
+        self, gid: int, why: Exception, record: Dict
+    ) -> None:
+        """One device group's wave died past run_resilient's whole
+        ladder: fail THAT group's resident jobs, attribute the group,
+        and leave every other group — and the service — running."""
+        jobs = [
+            jid
+            for jid, job_gid in record["group_by_job"].items()
+            if job_gid == gid and jid in self._tracks
+        ]
+        self.mesh.group(gid).failure_domain.record_degraded(
+            len(jobs), detail=f"service wave failed: {why}"
+        )
+        for jid in jobs:
+            track = self._tracks.pop(jid)
+            self.alloc.release(track.stripes)
+            track.job.error = f"device wave failed in mesh-g{gid}: {why}"
+            self.queue.settle(track.job, JobState.FAILED)
 
     def _fail_wave(self, why: Exception) -> None:
         """A wave died past run_resilient's whole escalation ladder:
@@ -1002,6 +1290,38 @@ class AnalysisEngine:
                     if self.waves_total
                     else 0.0
                 ),
+            },
+            "mesh": {
+                # the ACTUAL topology, not the requested --devices N (a
+                # request past the visible device count clamps)
+                "devices": self.mesh.n_devices if self.mesh else 1,
+                "groups": self.alloc.groups,
+                "steals": self.mesh_steals,
+                "rebalance_bytes": self.mesh_rebalance_bytes,
+                "per_device": [
+                    dict(
+                        g,
+                        waves=self._group_waves[g["group"]],
+                        devices=(
+                            [
+                                str(d)
+                                for d in self.mesh.group(
+                                    g["group"]
+                                ).devices
+                            ]
+                            if self.mesh
+                            else None
+                        ),
+                        faults=(
+                            self.mesh.group(
+                                g["group"]
+                            ).failure_domain.faults
+                            if self.mesh
+                            else 0
+                        ),
+                    )
+                    for g in self.alloc.occupancy()["groups"]
+                ],
             },
             "static": {
                 "summaries_cached": self.code_cache.static_summaries,

@@ -9,9 +9,18 @@ what lets the next queued contract join the very next wave
 (continuous lane-level batching, the service counterpart of
 continuous batching in LLM serving).
 
-Stripes need not be contiguous: every lane carries its own code-table
-row id, so the allocator is a plain free-list + occupancy ledger with
-no compaction. Pure host-side bookkeeping, no JAX."""
+With `groups > 1` (myth serve --devices N) the stripes split into
+contiguous per-device-group blocks: each group dispatches its own
+wave over its own block (service/engine.py runs one dispatch/harvest
+pair per group), so a job's stripes must all live in ONE group, and
+admission stripes jobs over the groups least-loaded-first — the
+static half of the mesh balance; the engine's job migration
+(_rebalance) is the live half.
+
+Stripes need not be contiguous within a group: every lane carries its
+own code-table row id, so the allocator is a plain free-list +
+occupancy ledger with no compaction. Pure host-side bookkeeping, no
+JAX."""
 
 from __future__ import annotations
 
@@ -21,16 +30,26 @@ from typing import Dict, List, Optional
 
 class LaneAllocator:
     """Free-list allocator over `stripes` stripes of
-    `lanes_per_stripe` lanes each."""
+    `lanes_per_stripe` lanes each, optionally split into `groups`
+    contiguous device-group blocks."""
 
-    def __init__(self, stripes: int, lanes_per_stripe: int) -> None:
+    def __init__(
+        self, stripes: int, lanes_per_stripe: int, groups: int = 1
+    ) -> None:
         if stripes < 1 or lanes_per_stripe < 1:
             raise ValueError(
                 f"arena wants >=1 stripe of >=1 lane, got "
                 f"{stripes}x{lanes_per_stripe}"
             )
+        if groups < 1 or stripes % groups:
+            raise ValueError(
+                f"{stripes} stripes do not split evenly into "
+                f"{groups} device group(s) — size the arena to the mesh"
+            )
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
+        self.groups = groups
+        self.stripes_per_group = stripes // groups
         self._free: List[int] = list(range(stripes))
         self._owner: Dict[int, str] = {}  # stripe -> job id
         self._lock = threading.Lock()
@@ -43,29 +62,75 @@ class LaneAllocator:
     def n_lanes(self) -> int:
         return self.stripes * self.lanes_per_stripe
 
+    @property
+    def lanes_per_group(self) -> int:
+        return self.stripes_per_group * self.lanes_per_stripe
+
+    def group_of(self, stripe: int) -> int:
+        return stripe // self.stripes_per_group
+
     def lanes_of(self, stripe: int) -> List[int]:
         base = stripe * self.lanes_per_stripe
         return list(range(base, base + self.lanes_per_stripe))
+
+    def group_lanes(self, group: int) -> List[int]:
+        base = group * self.lanes_per_group
+        return list(range(base, base + self.lanes_per_group))
 
     def stripes_needed(self, lanes: int) -> int:
         """Smallest stripe count covering a lane request (ceil)."""
         return max(1, -(-int(lanes) // self.lanes_per_stripe))
 
-    def allocate(self, job_id: str, n_stripes: int = 1) -> Optional[List[int]]:
-        """Claim `n_stripes` stripes for `job_id`, or None when the
-        arena can't fit the request right now (the job stays queued and
-        retries at the next wave boundary). All-or-nothing: a partial
-        grant would strand a job half-resident across waves."""
-        if n_stripes > self.stripes:
+    def _free_by_group(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {g: [] for g in range(self.groups)}
+        for stripe in self._free:
+            out[self.group_of(stripe)].append(stripe)
+        return out
+
+    def allocate(
+        self, job_id: str, n_stripes: int = 1, group: Optional[int] = None
+    ) -> Optional[List[int]]:
+        """Claim `n_stripes` stripes for `job_id`, or None when no
+        group can fit the request right now (the job stays queued and
+        retries at the next wave boundary). All-or-nothing AND
+        single-group: a job striped across groups would need its wave
+        split across two dispatch streams. With `group`, the grant is
+        pinned (the engine's job migration targets an idle group);
+        otherwise the least-loaded group with room wins — admission
+        stripes jobs over the device groups."""
+        if n_stripes > self.stripes_per_group:
             raise ValueError(
-                f"job {job_id} wants {n_stripes} stripes; the arena has "
-                f"{self.stripes} — resize the arena, not the request"
+                f"job {job_id} wants {n_stripes} stripes; a device "
+                f"group holds {self.stripes_per_group} — resize the "
+                f"arena (or drop --devices), not the request"
             )
         with self._lock:
-            if len(self._free) < n_stripes:
+            by_group = self._free_by_group()
+            if group is not None:
+                candidates = [group]
+            else:
+                # least busy first (fewest owned stripes), gid breaks
+                # ties so the layout is deterministic
+                candidates = sorted(
+                    range(self.groups),
+                    key=lambda g: (
+                        self.stripes_per_group - len(by_group[g]),
+                        g,
+                    ),
+                )
+            chosen = next(
+                (
+                    g
+                    for g in candidates
+                    if len(by_group.get(g, [])) >= n_stripes
+                ),
+                None,
+            )
+            if chosen is None:
                 return None
-            granted = [self._free.pop(0) for _ in range(n_stripes)]
+            granted = by_group[chosen][:n_stripes]
             for stripe in granted:
+                self._free.remove(stripe)
                 self._owner[stripe] = job_id
             jobs = len(set(self._owner.values()))
             self.max_jobs_resident = max(self.max_jobs_resident, jobs)
@@ -86,12 +151,39 @@ class LaneAllocator:
         with self._lock:
             return self._owner.get(stripe)
 
+    def jobs_in_group(self, group: int) -> List[str]:
+        """Distinct job ids resident in `group`, in stripe order."""
+        with self._lock:
+            seen = []
+            for stripe in sorted(self._owner):
+                if self.group_of(stripe) == group:
+                    job = self._owner[stripe]
+                    if job not in seen:
+                        seen.append(job)
+            return seen
+
     def occupancy(self) -> Dict:
         """The /stats view: stripe/lane busy counts plus high-water
         marks (max_jobs_resident > 1 is the proof that concurrent
-        requests coalesced into shared waves)."""
+        requests coalesced into shared waves) and the per-group
+        occupancy the mesh counters surface."""
         with self._lock:
             busy = len(self._owner)
+            per_group = []
+            for g in range(self.groups):
+                owned = [
+                    s for s in self._owner if self.group_of(s) == g
+                ]
+                per_group.append(
+                    {
+                        "group": g,
+                        "stripes_busy": len(owned),
+                        "stripes": self.stripes_per_group,
+                        "jobs_resident": len(
+                            {self._owner[s] for s in owned}
+                        ),
+                    }
+                )
             return {
                 "stripes": self.stripes,
                 "lanes_per_stripe": self.lanes_per_stripe,
@@ -101,4 +193,5 @@ class LaneAllocator:
                 "jobs_resident": len(set(self._owner.values())),
                 "max_jobs_resident": self.max_jobs_resident,
                 "max_lanes_busy": self.max_lanes_busy,
+                "groups": per_group,
             }

@@ -236,11 +236,18 @@ def serve_forever(
     POST /v1/drain) completes."""
     server = AnalysisServer(config, host=host, port=port).start()
     server.install_signal_handlers()
+    mesh = server.engine.mesh
+    mesh_note = (
+        f", {mesh.n_groups} device group(s) over {mesh.n_devices} "
+        f"device(s)"
+        if mesh is not None
+        else ""
+    )
     print(
         f"myth serve: listening on {server.url} "
         f"(arena {server.engine.cfg.stripes}x"
         f"{server.engine.cfg.lanes_per_stripe} lanes, "
-        f"queue {server.engine.cfg.queue_capacity})",
+        f"queue {server.engine.cfg.queue_capacity}{mesh_note})",
         flush=True,
     )
     try:

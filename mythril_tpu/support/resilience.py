@@ -71,6 +71,11 @@ class DegradationReason:
     #: the host happened to be consuming
     ASYNC_DEVICE_FAULT = "async-device-fault"
     WAVE_ABANDONED = "wave-abandoned"
+    #: a device GROUP's shard degraded under the multi-chip scheduler
+    #: (parallel/topology.py FailureDomain): the site names the group,
+    #: so a faulted chip is attributed — and contained — per group
+    #: while the other groups' shards keep dispatching
+    MESH_GROUP_DEGRADED = "mesh-group-degraded"
     HOST_TAKEOVER = "host-takeover"
     DEADLINE_EXPIRED = "deadline-expired"
     INTERRUPTED = "interrupted"

@@ -30,6 +30,12 @@ class Args(object, metaclass=Singleton):
         # concrete evidence bank and the host walk is skipped.
         # "auto" = on when an accelerator backend is present.
         self.device_ownership = "auto"
+        # Multi-chip corpus scheduler (CLI --devices N,
+        # parallel/scheduler.py): shard the corpus over N device
+        # groups, one wave engine per group, with cross-group work
+        # stealing and per-group failure domains. None = single
+        # engine (lane-sharded over whatever devices are visible).
+        self.mesh_devices = None
         # Static pre-analysis (analysis/static, CLI --no-static-prune):
         # CFG recovery + constant dataflow once per code hash, feeding
         # the detector pre-screen, the dispatcher-seed mask, and the

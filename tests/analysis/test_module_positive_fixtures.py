@@ -1,0 +1,117 @@
+"""Per-module positive detection fixtures: EVERY detection module in
+analysis/module/modules/ has one minimal hand-assembled contract that
+makes it report at least one issue end-to-end.
+
+The structural guarantee this buys: "module silently never fires" —
+the failure mode where a detector exists, loads, hooks, and then never
+produces an issue on anything (the 4-round SWC-116 hole) — breaks a
+test the moment it regresses, instead of surviving until someone
+happens to read a golden report diff. The registry sweep at the bottom
+pins that every module in the package HAS a fixture here, so adding a
+module without a positive fixture fails too."""
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.disassembler.disassembly import Disassembly
+
+
+class FakeContract:
+    def __init__(self, code, name="Test"):
+        self.name = name
+        self.disassembly = Disassembly(code)
+        self.creation_code = None
+        self.code = code
+
+
+def analyze(code, tx_count=1, modules=None):
+    contract = FakeContract(code)
+    sym = SymExecWrapper(
+        contract,
+        address=0x1234,
+        strategy="bfs",
+        execution_timeout=90,
+        create_timeout=30,
+        transaction_count=tx_count,
+        modules=modules,
+    )
+    return fire_lasers(sym, white_list=modules)
+
+
+#: one forwarded-gas CALL to the caller:
+#: PUSH1 0 (outsz, outoff, insz, inoff, value) CALLER PUSH2 0xffff CALL POP
+_CALL_CALLER = "600060006000600060003361ffff" + "f1" + "50"
+#: same call shape with a calldata-supplied target
+_CALL_USER = "6000600060006000" + "6000" + "600035" + "61ffff" + "f1" + "50"
+
+#: the AssertionFailed(string) event topic user_assertions keys on
+_ASSERT_TOPIC = (
+    "b42604cb105a16c8f6db8a41e6b00c0c1b4826465e8bc504b3eb3e88b3e6a4a0"
+)
+
+#: module class name -> (bytecode, expected swc ids — None skips the
+#: swc check where the module reports composite/variable ids)
+FIXTURES = {
+    # CALLER; SELFDESTRUCT
+    "AccidentallyKillable": ("33ff", {"106"}),
+    # DELEGATECALL to a calldata-loaded address
+    "ArbitraryDelegateCall": (
+        "6000600060006000" + "600035" + "61ffff" + "f45000",
+        {"112"},
+    ),
+    # JUMP to a calldata-loaded destination (JUMPDEST at 4 keeps one
+    # branch alive; the symbolic destination is the finding)
+    "ArbitraryJump": ("600035565b00", None),
+    # SSTORE(key=CALLDATALOAD(0), value=1)
+    "ArbitraryStorage": ("60016000355500", {"124"}),
+    # send the whole balance to the caller
+    "EtherThief": ("6000600060006000473361fffff15000", {"105"}),
+    # calldata-gated INVALID
+    "Exceptions": ("600035600757005bfe", {"110"}),
+    # forwarded-gas CALL to a user-supplied address
+    "ExternalCalls": (_CALL_USER + "00", {"107"}),
+    # CALLDATALOAD(0) * 2 stored: the overflow witness
+    "IntegerArithmetics": ("600035600202" + "60005500", {"101"}),
+    # two sends in one transaction
+    "MultipleSends": (_CALL_CALLER * 2 + "00", {"113"}),
+    # TIMESTAMP decides a branch
+    "PredictableVariables": ("42600557005b00", {"116"}),
+    # SSTORE after a forwarded-gas call
+    "StateChangeAfterCall": (_CALL_USER + "6001600055" + "00", {"107"}),
+    # branch on ORIGIN == CALLER
+    "TxOrigin": ("3233146007" + "57005b00", {"115"}),
+    # CALL retval popped, never checked
+    "UncheckedRetval": (_CALL_CALLER + "00", {"104"}),
+    # LOG1 with the AssertionFailed(string) topic
+    "UserAssertions": (
+        "7f" + _ASSERT_TOPIC + "60006000" + "a1" + "00",
+        {"110"},
+    ),
+}
+
+
+@pytest.mark.parametrize("module", sorted(FIXTURES))
+def test_module_fires_on_its_fixture(module):
+    code, expected_swc = FIXTURES[module]
+    issues = analyze(code, modules=[module])
+    assert issues, f"{module} produced no issues on its positive fixture"
+    if expected_swc is not None:
+        found = {i.swc_id for i in issues}
+        assert found & expected_swc, (
+            f"{module} reported {found}, fixture expects {expected_swc}"
+        )
+
+
+def test_every_registered_module_has_a_fixture():
+    """The sweep that keeps this file honest: a new detection module
+    must land with a positive fixture."""
+    from mythril_tpu.analysis.module import ModuleLoader
+
+    registered = {
+        type(m).__name__ for m in ModuleLoader().get_detection_modules()
+    }
+    missing = registered - set(FIXTURES)
+    assert not missing, (
+        f"detection modules without a positive fixture: {sorted(missing)}"
+    )

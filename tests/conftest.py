@@ -56,6 +56,13 @@ def pytest_configure(config):
         "reseed; CPU-only, fast — runs in tier-1, selectable with "
         "-m pipeline)",
     )
+    config.addinivalue_line(
+        "markers",
+        "multichip: multi-chip mesh suite (sharded step, corpus "
+        "scheduler with work stealing, per-group failure domains, "
+        "mesh service) on the 8 simulated host devices this conftest "
+        "forces — runs in tier-1, selectable with -m multichip",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

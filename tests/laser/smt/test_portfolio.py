@@ -84,6 +84,9 @@ def test_unsupported_ops_return_none():
     cons = [terms.eq(sel, terms.bv_const(5, 256))]
     assert compile_program(cons) is None
 
+# slow tier: ~30 s of full-budget portfolio grinding per test on a
+# 1-core host; the multichip suite keeps a fast batched-solve pin
+@pytest.mark.slow
 def test_batched_dispatch_alignment():
     """device_check_batch answers each query independently in one
     dispatch: results are position-aligned, every returned witness
@@ -133,6 +136,7 @@ def test_batched_matches_single():
             assert all(eval_term(c, asn) for c in cons)
 
 
+@pytest.mark.slow
 def test_batched_dispatch_sharded_over_devices():
     """The query axis shards over a device mesh (pmap of the vmapped
     search): same aligned answers, each device solving its chunk."""

@@ -161,6 +161,9 @@ def test_optimize_maximize():
     assert s.model().eval(x.raw).value == 1233
 
 
+# slow tier: ~100 s of brute-force differential on a 1-core host —
+# the 8-bit sweep belongs to the conformance tier (tox -e slow)
+@pytest.mark.slow
 def test_random_differential():
     """Random constraint systems: solver verdicts vs brute force (8-bit)."""
     rng = random.Random(1337)

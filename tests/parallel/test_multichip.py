@@ -1,0 +1,405 @@
+"""The multichip suite (tier-1 port of the driver's dryrun_multichip):
+every multi-device behavior pinned as pytest on the 8 simulated host
+devices tests/conftest.py forces (--xla_force_host_platform_device_count=8).
+
+Covers the dryrun sections — sharded step, symbolic shadow step,
+solver portfolio and batched solve over the mesh — plus the multi-chip
+corpus scheduler (parallel/scheduler.py): the N-device-vs-1-device
+corpus-to-issues differential on the fault-suite contracts, the
+work-steal path (a drained shard demonstrably takes load from a loaded
+one), the frontier handoff, and the per-group failure domain (a
+faulted group degrades only its own shard)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mythril_tpu.parallel import discover_topology
+from mythril_tpu.parallel.scheduler import CorpusScheduler
+from mythril_tpu.support import resilience
+
+pytestmark = pytest.mark.multichip
+
+#: the fault-suite contracts (tests/laser/test_pipeline.py)
+KILLABLE = "33ff"
+WRITER = "6001600055600060015500"
+BRANCHER = "600035600757005b600160005500"
+GATED = "60003560f81c604214600d57005b600160005500"
+FAULT_SUITE = [KILLABLE, WRITER, BRANCHER, GATED]
+
+#: lean explorer shape shared by the scheduler tests (fast on CPU)
+EXPLORE_KW = dict(
+    lanes_per_contract=8, waves=3, steps_per_wave=64, transaction_count=1
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_supervisor():
+    resilience.disarm_faults()
+    resilience.DegradationLog().reset()
+    yield
+    resilience.disarm_faults()
+
+
+def test_eight_simulated_devices_present():
+    assert len(jax.devices()) >= 8
+
+
+# -- the dryrun sections, as pytest -----------------------------------------
+def test_step_shards_over_the_mesh():
+    """dryrun section 1: the batched concrete step jit'd over an
+    8-device dp mesh."""
+    from __graft_entry__ import _demo_workload
+    from mythril_tpu.laser.batch.step import step
+    from mythril_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        replicate_table,
+        replicated,
+        shard_batch,
+    )
+
+    mesh = make_mesh(8)
+    batch, code = _demo_workload(n_lanes=64)
+    batch = shard_batch(batch, mesh)
+    code = replicate_table(code, mesh)
+    sharded_step = jax.jit(
+        step,
+        in_shardings=(
+            jax.tree.map(lambda _: batch_sharding(mesh), batch),
+            jax.tree.map(lambda _: replicated(mesh), code),
+        ),
+        out_shardings=jax.tree.map(lambda _: batch_sharding(mesh), batch),
+    )
+    out = sharded_step(batch, code)
+    jax.block_until_ready(out)
+    assert out.pc.shape == batch.pc.shape
+
+
+def test_symbolic_shadow_step_shards_over_the_mesh():
+    """dryrun section 2: lane-major shadow state shards with the
+    lanes; the shared expression arena replicates."""
+    from __graft_entry__ import _demo_workload
+    from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_step
+    from mythril_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        replicate_table,
+        replicated,
+        shard_batch,
+    )
+
+    mesh = make_mesh(8)
+    batch, code = _demo_workload(n_lanes=64)
+    batch = shard_batch(batch, mesh)
+    code = replicate_table(code, mesh)
+    symb = make_sym_batch(batch)
+    lane_sharded = {"stack_tid", "mem_tid", "skey_tid", "sval_tid", "br_tid"}
+    symb = symb._replace(
+        base=batch,
+        **{
+            name: jax.device_put(
+                getattr(symb, name),
+                batch_sharding(mesh)
+                if name in lane_sharded
+                else replicated(mesh),
+            )
+            for name in (
+                "stack_tid", "mem_tid", "skey_tid", "sval_tid", "br_tid",
+                "ar_op", "ar_a", "ar_b", "ar_va", "ar_vb", "ar_count",
+            )
+        },
+    )
+    out = jax.jit(sym_step)(symb, code)
+    jax.block_until_ready(out)
+    assert out.stack_tid.shape == symb.stack_tid.shape
+
+
+def test_solver_portfolio_replicates_over_devices():
+    """dryrun sections 3+4: per-device solver replicas and the batched
+    query solve sharded over the mesh."""
+    from mythril_tpu.laser.smt import symbol_factory
+    from mythril_tpu.laser.smt.evalterm import eval_term
+    from mythril_tpu.laser.smt.solver.portfolio import (
+        device_check,
+        device_check_batch,
+    )
+    from mythril_tpu.laser.smt.solver.solver import lower
+
+    x = symbol_factory.BitVecSym("mc_x", 64)
+    cons, _ = lower([(x + 5 == 12).raw])
+    asn = device_check(cons, candidates=32, steps=2048, n_devices=8)
+    assert asn is not None and all(eval_term(c, asn) for c in cons)
+
+    ys = [symbol_factory.BitVecSym(f"mc_y{i}", 32) for i in range(4)]
+    queries = [
+        lower([(y * 3 == 21 + 3 * i).raw])[0] for i, y in enumerate(ys)
+    ]
+    found = device_check_batch(
+        queries, candidates=32, steps=1024, n_devices=8
+    )
+    solved = 0
+    for q, a in zip(queries, found):
+        if a is not None:
+            assert all(eval_term(c, a) for c in q)
+            solved += 1
+    assert solved >= 1, "batched mesh solve found nothing"
+
+
+# -- topology ----------------------------------------------------------------
+def test_topology_splits_devices_into_groups():
+    topo = discover_topology(4)
+    assert topo.n_groups == 4
+    assert topo.n_devices == len(jax.devices())
+    sizes = [len(g.devices) for g in topo.groups]
+    assert max(sizes) - min(sizes) <= 1
+    flat = [d for g in topo.groups for d in g.devices]
+    assert len(set(map(str, flat))) == len(flat)  # no device in two groups
+
+
+def test_topology_clamps_to_device_count():
+    topo = discover_topology(100)
+    assert topo.n_groups == len(jax.devices())
+    assert all(len(g.devices) == 1 for g in topo.groups)
+
+
+def test_group_shrinks_device_set_to_divide_lanes():
+    group = discover_topology(2).group(0)
+    assert len(group.devices_for_lanes(len(group.devices) * 8)) == len(
+        group.devices
+    )
+    assert len(group.devices_for_lanes(7)) == 1
+
+
+# -- the corpus-to-issues differential (acceptance criterion) ----------------
+def _issue_set(contracts_outcomes):
+    """The issue-bearing fingerprint of a scheduler run: synthesized
+    Issues from the evidence bank plus the trigger classes/pcs — the
+    exact inputs issue synthesis (analysis/evidence.py + prepass
+    witnesses) consumes."""
+    from mythril_tpu.analysis.evidence import evidence_issues
+
+    class _C:
+        def __init__(self, code):
+            self.code = code
+            self.name = "t"
+            self.creation_code = None
+
+    out = []
+    for code, outcome in zip(FAULT_SUITE, contracts_outcomes):
+        issues = {
+            (i.swc_id, i.address)
+            for i in evidence_issues(_C(code), outcome, 0x1234)
+        }
+        triggers = {
+            kind: tuple(sorted(t["pc"] for t in bucket))
+            for kind, bucket in (outcome.get("triggers") or {}).items()
+        }
+        out.append((issues, triggers))
+    return out
+
+
+def test_n_device_issue_set_matches_single_device():
+    """The differential: the corpus explored over 2 device groups must
+    produce the same issue set as the 1-group run on the fault-suite
+    contracts (and the same gated-branch coverage)."""
+    one = CorpusScheduler(
+        FAULT_SUITE, n_groups=1, chunk=len(FAULT_SUITE), parallel=False,
+        shard="round-robin", explorer_kwargs=dict(EXPLORE_KW),
+    ).run()
+    two = CorpusScheduler(
+        FAULT_SUITE, n_groups=2, chunk=1, parallel=False,
+        shard="round-robin", explorer_kwargs=dict(EXPLORE_KW),
+    ).run()
+    assert _issue_set(one["contracts"]) == _issue_set(two["contracts"])
+    # the differential is not trivially empty: the selfdestruct fires
+    # and the gated branch needed a solver flip on BOTH runs
+    for result in (one, two):
+        assert "selfdestruct" in result["contracts"][0]["triggers"]
+        covered = {
+            tuple(b) for b in result["contracts"][3]["covered_branches"]
+        }
+        assert (11, True) in covered and (11, False) in covered
+    assert two["stats"]["mesh_groups"] == 2
+    assert two["stats"]["mesh_devices"] == len(jax.devices())
+
+
+def test_outcomes_annotated_with_their_group():
+    out = CorpusScheduler(
+        FAULT_SUITE, n_groups=2, chunk=1, parallel=False,
+        shard="round-robin", explorer_kwargs=dict(EXPLORE_KW),
+    ).run()
+    groups = [c["mesh_group"] for c in out["contracts"]]
+    assert set(groups) == {0, 1}  # both shards carried contracts
+
+
+# -- work stealing (acceptance criterion) ------------------------------------
+def test_drained_shard_steals_from_loaded_shard():
+    """Group 1 is admitted one contract while group 0 holds three:
+    after its own queue drains, group 1 must take load from group 0
+    (steal counter > 0), and the stolen contract's outcome must come
+    from the thief."""
+    sched = CorpusScheduler(
+        [BRANCHER, WRITER, GATED, KILLABLE],
+        n_groups=2,
+        chunk=1,
+        parallel=False,
+        shard=[0, 0, 0, 1],  # the imbalance: 3 vs 1
+        explorer_kwargs=dict(EXPLORE_KW),
+    )
+    out = sched.run()
+    stats = out["stats"]
+    assert stats["steal_count"] > 0
+    assert stats["stolen_items"] > 0
+    assert stats["rebalance_bytes"] > 0
+    per = {g["group"]: g for g in stats["mesh"]["per_device"]}
+    assert per[1]["steals"] > 0  # the drained shard initiated it
+    assert per[0]["victim_items"] > 0  # ...from the loaded one
+    # the stolen contract (GATED, admitted to group 0) ran on group 1
+    assert out["contracts"][2]["mesh_group"] == 1
+    # and its exploration is not degraded by the move: the gated
+    # branch still flips on the thief's device
+    covered = {tuple(b) for b in out["contracts"][2]["covered_branches"]}
+    assert (11, True) in covered and (11, False) in covered
+
+
+# slow tier: ~40 s of threaded 8-contract exploration; tier-1 keeps
+# the deterministic sequential schedule + steal + fault pins
+@pytest.mark.slow
+def test_threaded_schedule_completes_all_contracts():
+    """The production (threaded) schedule: every contract gets an
+    outcome, and both groups did work."""
+    out = CorpusScheduler(
+        FAULT_SUITE * 2,
+        n_groups=2,
+        chunk=2,
+        parallel=True,
+        explorer_kwargs=dict(EXPLORE_KW),
+    ).run()
+    assert len(out["contracts"]) == 8
+    assert all(
+        "covered_branches" in c for c in out["contracts"]
+    ), "a contract lost its outcome"
+    per = {g["group"]: g for g in out["stats"]["mesh"]["per_device"]}
+    assert per[0]["waves"] > 0 and per[1]["waves"] > 0
+
+
+# -- frontier handoff --------------------------------------------------------
+def test_frontier_handoff_roundtrip():
+    """export_frontier -> seed_frontier continues the donor's
+    exploration: the continuation starts with the donor's coverage and
+    blacklists, and its outcome keeps every donor-covered branch."""
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    donor = DeviceCorpusExplorer([GATED], **EXPLORE_KW)
+    donor_out = donor.run()
+    frontier = donor.export_frontier(0)
+    assert frontier["parent_inputs"], "donor exported no seeds"
+    donor_covered = {
+        tuple(b) for b in donor_out["contracts"][0]["covered_branches"]
+    }
+
+    thief = DeviceCorpusExplorer([GATED], **EXPLORE_KW)
+    thief.seed_frontier(0, frontier)
+    # the donor's solved flips stay blacklisted on the thief
+    assert thief.tracks[0].attempted
+    cont = thief.run()["contracts"][0]
+    assert donor_covered <= {tuple(b) for b in cont["covered_branches"]}
+
+
+def test_frontier_handoff_refuses_wrong_contract():
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    donor = DeviceCorpusExplorer([GATED], **EXPLORE_KW)
+    donor.run()
+    frontier = donor.export_frontier(0)
+    thief = DeviceCorpusExplorer([WRITER], **EXPLORE_KW)
+    with pytest.raises(ValueError):
+        thief.seed_frontier(0, frontier)
+
+
+# -- failure domains (acceptance criterion) ----------------------------------
+def test_faulted_group_degrades_only_its_own_shard():
+    """A device fault injected into group 0's dispatches (the
+    domain-qualified site device.dispatch.mesh-g0, times=99 so the
+    whole retry ladder is exhausted) demotes ONLY group 0's shard:
+    its contracts lose device-completeness, group 1's results are
+    identical to a fault-free run, and the DegradationLog attributes
+    the group."""
+    clean = CorpusScheduler(
+        FAULT_SUITE, n_groups=2, chunk=2, parallel=False,
+        shard="round-robin", explorer_kwargs=dict(EXPLORE_KW),
+    ).run()
+
+    resilience.DegradationLog().reset()
+    resilience.arm_fault("device.dispatch.mesh-g0", times=99)
+    try:
+        faulted = CorpusScheduler(
+            FAULT_SUITE, n_groups=2, chunk=2, parallel=False,
+            shard="round-robin", explorer_kwargs=dict(EXPLORE_KW),
+        ).run()
+    finally:
+        resilience.disarm_faults()
+
+    # group 0's shard (round-robin: contracts 0 and 2) degraded
+    for i in (0, 2):
+        assert faulted["contracts"][i]["mesh_group"] == 0
+        assert not faulted["contracts"][i]["device_complete"]
+    # group 1's shard is untouched: same fingerprint as the clean run
+    for i in (1, 3):
+        assert faulted["contracts"][i]["mesh_group"] == 1
+        assert faulted["contracts"][i]["device_complete"] == (
+            clean["contracts"][i]["device_complete"]
+        )
+        assert (
+            faulted["contracts"][i]["covered_branches"]
+            == clean["contracts"][i]["covered_branches"]
+        )
+        assert (
+            faulted["contracts"][i]["triggers"].keys()
+            == clean["contracts"][i]["triggers"].keys()
+        )
+    # the DegradationLog attributes the group
+    log = resilience.DegradationLog()
+    assert log.counts.get("mesh-group-degraded", 0) >= 1
+    sites = {
+        e["site"]
+        for e in log.events
+        if e["reason"] == "mesh-group-degraded"
+    }
+    assert sites == {"mesh-g0"}
+    per = {
+        g["group"]: g
+        for g in faulted["stats"]["mesh"]["per_device"]
+    }
+    assert per[0]["faults"] >= 1 and per[0]["degraded_contracts"] >= 1
+    assert per[1]["faults"] == 0
+
+
+# -- the prepass integration -------------------------------------------------
+def test_corpus_prepass_routes_through_the_scheduler():
+    """corpus_device_prepass(mesh_groups=2) must run the scheduler
+    (mesh counters present) and keep the outcome contract the
+    per-contract consumers read."""
+    from mythril_tpu.analysis.corpus import corpus_device_prepass
+
+    # the dryrun's gated-selfdestruct contract replaces bare KILLABLE:
+    # _runnable_rows drops codes under 4 bytes from any prepass
+    gated_kill = "604260003560f81c14600d57005b33ff"
+    rows = [
+        (code, "", f"c{i}")
+        for i, code in enumerate([gated_kill, WRITER, BRANCHER, GATED])
+    ]
+    out = corpus_device_prepass(
+        rows, budget_s=60.0, transaction_count=1, mesh_groups=2
+    )
+    assert set(out) == {0, 1, 2, 3}
+    stats = out[0]["stats"]
+    assert stats["mesh_groups"] == 2
+    assert stats["scope"] == "corpus"
+    assert "steal_count" in stats and "rebalance_bytes" in stats
+    assert len(stats["mesh"]["per_device"]) == 2
+    # the gated SELFDESTRUCT needs a solver flip — the mesh run banks
+    # its trigger end-to-end, the same bar the dryrun asserted
+    assert "selfdestruct" in out[0]["triggers"]

@@ -1,0 +1,141 @@
+"""The service's device-group mesh (`myth serve --devices N`): the
+arena splits into per-group stripe blocks, admission stripes jobs over
+the groups, each group gets its own dispatch/harvest pair, idle groups
+steal resident jobs, and /stats surfaces the mesh counters."""
+
+import pytest
+
+from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+from mythril_tpu.service.jobs import Job
+from mythril_tpu.service.lane_allocator import LaneAllocator
+
+pytestmark = [pytest.mark.service, pytest.mark.multichip]
+
+WRITER = "6001600055600060015500"
+BRANCHER = "600035600757005b600160005500"
+KILLABLE = "33ff"
+
+
+# -- allocator group semantics ----------------------------------------------
+def test_allocator_stripes_jobs_over_groups():
+    alloc = LaneAllocator(stripes=4, lanes_per_stripe=4, groups=2)
+    a = alloc.allocate("a")
+    b = alloc.allocate("b")
+    # least-loaded striping: the two jobs land in different groups
+    assert alloc.group_of(a[0]) != alloc.group_of(b[0])
+    occ = alloc.occupancy()
+    assert [g["jobs_resident"] for g in occ["groups"]] == [1, 1]
+
+
+def test_allocator_keeps_a_job_inside_one_group():
+    alloc = LaneAllocator(stripes=4, lanes_per_stripe=4, groups=2)
+    granted = alloc.allocate("wide", n_stripes=2)
+    assert len({alloc.group_of(s) for s in granted}) == 1
+    # a request bigger than one group's block is a config error
+    with pytest.raises(ValueError):
+        alloc.allocate("huge", n_stripes=3)
+
+
+def test_allocator_pinned_group_grant():
+    alloc = LaneAllocator(stripes=4, lanes_per_stripe=4, groups=2)
+    granted = alloc.allocate("pinned", group=1)
+    assert alloc.group_of(granted[0]) == 1
+    assert alloc.jobs_in_group(1) == ["pinned"]
+    assert alloc.jobs_in_group(0) == []
+
+
+def test_allocator_rejects_indivisible_mesh():
+    with pytest.raises(ValueError):
+        LaneAllocator(stripes=3, lanes_per_stripe=4, groups=2)
+
+
+# -- engine mesh dispatch ----------------------------------------------------
+def test_mesh_engine_runs_one_dispatch_pair_per_group():
+    """Two jobs on a 2-group engine: they stripe into distinct groups,
+    each group's dispatch/harvest pair runs its own wave, and both
+    reports carry harvested device results."""
+    engine = AnalysisEngine(
+        ServiceConfig(
+            stripes=2, lanes_per_stripe=4, steps_per_wave=64, max_waves=2,
+            host_walk=False, coalesce_wait_s=0.05, idle_wait_s=0.02,
+            pipeline=True, devices=2,
+        )
+    ).start()
+    try:
+        jobs = [engine.submit(Job(WRITER)), engine.submit(Job(BRANCHER))]
+        for job in jobs:
+            settled = engine.queue.wait_terminal(job.id, timeout_s=180.0)
+            assert settled is not None and settled.state == "done", (
+                settled.state if settled else "lost"
+            )
+        stats = engine.stats()
+        mesh = stats["mesh"]
+        # groups = the requested split; devices = the ACTUAL device
+        # count behind it (8 on the simulated test mesh)
+        assert mesh["groups"] == 2
+        assert mesh["devices"] == len(__import__("jax").devices())
+        # one dispatch/harvest pair per group actually ran
+        waves_per_group = [g["waves"] for g in mesh["per_device"]]
+        assert all(w >= 1 for w in waves_per_group)
+        # per-device occupancy is reported (stripes per group, busy)
+        assert all(
+            g["stripes"] == 1 and "stripes_busy" in g
+            for g in mesh["per_device"]
+        )
+        # the branchy job's wave coverage harvested correctly through
+        # the per-group readback assembly
+        by_code = {j.code_hex if hasattr(j, "code_hex") else None for j in jobs}
+        reports = [j.report["device"] for j in jobs]
+        assert any(r["covered_branches"] >= 2 for r in reports)
+        assert all(r["waves"] >= 1 for r in reports)
+    finally:
+        engine.close()
+
+
+def test_mesh_engine_rebalances_job_to_idle_group():
+    """The live balance: with both resident jobs in group 0 and group
+    1 idle, the wave-boundary rebalance migrates one job across (steal
+    + rebalance bytes counted), preserving its corpus/coverage."""
+    engine = AnalysisEngine(
+        ServiceConfig(
+            stripes=4, lanes_per_stripe=4, steps_per_wave=64,
+            host_walk=False, devices=2,
+        )
+    )
+    # engine NOT started: drive admission by hand for determinism
+    from mythril_tpu.service.engine import _JobTrack
+
+    jobs = [Job(WRITER), Job(BRANCHER)]
+    for job in jobs:
+        engine.queue.submit(job)
+    for job in engine.queue.claim(2):
+        granted = engine.alloc.allocate(job.id, 1, group=0)  # crowd g0
+        lanes = [l for s in granted for l in engine.alloc.lanes_of(s)]
+        track = _JobTrack(job, granted, lanes, engine.cfg.calldata_len)
+        engine._install_code(track)
+        engine._tracks[job.id] = track
+    assert engine.alloc.occupancy()["groups"][0]["jobs_resident"] == 2
+    engine._rebalance()
+    occ = engine.alloc.occupancy()["groups"]
+    assert [g["jobs_resident"] for g in occ] == [1, 1]
+    assert engine.mesh_steals == 1
+    assert engine.mesh_rebalance_bytes > 0
+    moved = next(
+        t for t in engine._tracks.values()
+        if engine.alloc.group_of(t.stripes[0]) == 1
+    )
+    # the migrated track's lanes and code row moved with it
+    assert set(moved.lanes) <= set(engine.alloc.group_lanes(1))
+    assert moved.code_row == moved.stripes[0]
+
+
+def test_mesh_stats_present_on_single_device_engine():
+    """Schema stability: the mesh block exists (trivially) without
+    --devices, so /stats consumers never branch on its absence."""
+    engine = AnalysisEngine(
+        ServiceConfig(stripes=2, lanes_per_stripe=4, host_walk=False)
+    )
+    mesh = engine.stats()["mesh"]
+    assert mesh["devices"] == 1 and mesh["groups"] == 1
+    assert mesh["steals"] == 0
+    assert len(mesh["per_device"]) == 1

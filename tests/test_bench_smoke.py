@@ -1,0 +1,97 @@
+"""bench.py emission contract: the parsed one-line JSON record prints
+HEADLINE-FIRST (inside the budget, rc 0) and carries the mesh fields —
+the capture-window guarantee BENCH_r05 lacked (rc:124/parsed:null),
+pinned at toy scale via the MYTHRIL_BENCH_* env knobs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parsed_lines(stdout: str):
+    out = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def test_bench_emits_headline_record_inside_budget():
+    """A tiny-budget bench run must exit 0 within the window and print
+    at least one complete parseable record (corpus phases report
+    budget-skipped rather than eating the wall), with the mesh fields
+    present."""
+    env = dict(
+        os.environ,
+        MYTHRIL_BENCH_BUDGET_S="70",
+        MYTHRIL_BENCH_HEADLINE_S="50",
+        MYTHRIL_BENCH_LANES="256",
+        MYTHRIL_BENCH_STEPS="64",
+        MYTHRIL_BENCH_CONTRACTS="2",
+        MYTHRIL_BENCH_PAIRS="0",  # toy run: headline phases only
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = _parsed_lines(proc.stdout)
+    assert records, f"no parseable JSON line in: {proc.stdout!r}"
+    # incremental emission: the headline line printed BEFORE the final
+    stages = [r.get("bench_emit") for r in records]
+    assert stages[0] == "headline"
+    assert stages[-1] == "final"
+    final = records[-1]
+    # schema-complete even with the corpus half disabled
+    for field in (
+        "metric", "value", "unit", "vs_baseline", "bench_wall_s",
+        "mesh_devices", "steal_count", "static_prune_rate",
+    ):
+        assert field in final, f"missing {field}"
+    assert final["corpus"] == "disabled"
+    assert final["bench_wall_s"] <= 70 + 45  # the budget held
+
+
+@pytest.mark.slow
+def test_bench_headline_pair_reports_mesh_occupancy():
+    """With one real (toy) convergence pair, the record reports the
+    per-device occupancy + steal counters from the mesh prepass —
+    slow tier: two real analyze_corpus legs."""
+    env = dict(
+        os.environ,
+        MYTHRIL_BENCH_BUDGET_S="600",
+        MYTHRIL_BENCH_HEADLINE_S="540",
+        MYTHRIL_BENCH_LANES="256",
+        MYTHRIL_BENCH_STEPS="64",
+        MYTHRIL_BENCH_CONTRACTS="4",
+        MYTHRIL_BENCH_PAIRS="1",
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=700,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    final = _parsed_lines(proc.stdout)[-1]
+    assert final.get("corpus_pairs") == 1
+    assert "mesh_occupancy" in final
+    assert isinstance(final["steal_count"], int)
+    assert final["mesh_devices"] >= 1
