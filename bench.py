@@ -217,6 +217,63 @@ def bench_transitions() -> dict:
     return out
 
 
+def bench_specialize_ab(dev: dict) -> dict:
+    """Generic-vs-specialized step-throughput A/B (ISSUE 6): the SAME
+    demo workload timed on the generic interpreter (the transitions
+    half above, `dev["rate"]`) and on its contract-specialized kernel
+    (laser/batch/specialize.py: phase pruning + superblock fusion).
+    The specialized leg's transition count includes the instructions
+    the fused substeps advanced — both legs count executed EVM
+    instructions per second."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _demo_workload
+    from mythril_tpu.laser.batch import specialize as spec_mod
+
+    batch, code = _demo_workload(N_LANES)
+    length = int(np.asarray(code.length)[0])
+    raw = bytes(np.asarray(code.ops)[0, :length].tolist())
+    # the production kernel-selection path: pruning from the signature,
+    # fusion only where the superblock profile profits
+    phases = spec_mod.phases_for(
+        spec_mod.signature_for(raw), fuse=spec_mod.fuse_profitable(raw)
+    )
+    fuse = jnp.asarray(
+        spec_mod.build_fuse_table([raw], code.ops.shape[1] - 33)
+    )
+    kern = spec_mod.kernel_cache().get(phases)
+
+    def timed(max_steps: int):
+        t0 = time.perf_counter()
+        out, steps, fused = kern.run(batch, code, fuse, max_steps=max_steps)
+        sync = int(np.asarray(out.pc).sum())  # forced readback
+        n_fused = int(fused)
+        dt = time.perf_counter() - t0
+        assert sync >= 0
+        return dt, int(steps), n_fused
+
+    timed(N_STEPS)  # warmup: the one specialized-kernel compile
+    dt, steps, n_fused = timed(N_STEPS)
+    assert steps == N_STEPS, f"early halt at {steps}/{N_STEPS}"
+    # the demo contract loops forever, so every lane executes every
+    # full step; the fused substeps add on top
+    transitions = N_LANES * steps + n_fused
+    spec_rate = transitions / dt
+    out = {
+        "specialized_step_rate": round(spec_rate, 1),
+        "specialized_wall_s": round(dt, 3),
+        "specialized_fused_steps": n_fused,
+        "spec_pruned_phases": len(phases.pruned),
+    }
+    if dev.get("rate"):
+        out["generic_step_rate"] = round(dev["rate"], 1)
+        out["specialize_speedup"] = round(spec_rate / dev["rate"], 3)
+    print(f"bench: specialize A/B {out}", file=sys.stderr)
+    return out
+
+
 def bench_static_prune() -> dict:
     """The static layer (analysis/static) over the benchmark corpus:
     pure host work, no device — measures what fraction of the corpus's
@@ -741,6 +798,19 @@ def _refresh_headline(record: dict, dev: dict) -> None:
             record["host_only_wall_s"] / record["corpus_wall_s"], 3
         )
     record["vs_baseline"] = vs_baseline
+    # kernel-specialization scorecard: the process-wide compile-cache
+    # counters at emit time (covers the A/B leg AND the corpus legs'
+    # in-process explorers)
+    try:
+        from mythril_tpu.laser.batch.specialize import kernel_cache_stats
+
+        ks = kernel_cache_stats()
+        record["kernel_cache_hits"] = ks["hits"]
+        record["kernel_cache_misses"] = ks["misses"]
+        record["kernel_buckets"] = ks["size"]
+        record["kernel_compile_s"] = ks["compile_s"]
+    except Exception:
+        pass
 
 
 def main(final_attempt: bool = False) -> None:
@@ -799,6 +869,27 @@ def main(final_attempt: bool = False) -> None:
     ):
         if k in dev:
             record[k] = dev[k]
+
+    # -- generic-vs-specialized step-throughput A/B -------------------
+    if "rate" not in dev or _budget_left() < 120:
+        record["specialize_ab"] = (
+            "budget-skipped" if "rate" in dev else "no-generic-leg"
+        )
+        print("bench: specialize A/B skipped", file=sys.stderr)
+    else:
+        try:
+            record.update(
+                _with_deadline(
+                    lambda: bench_specialize_ab(dev),
+                    max(30, min(180, int(_budget_left() - 60))),
+                )
+            )
+        except _Deadline:
+            record["specialize_ab"] = "deadline"
+            print("bench: specialize A/B hit its deadline", file=sys.stderr)
+        except Exception as e:
+            record["specialize_ab"] = "failed"
+            print(f"bench: specialize A/B failed: {e!r}", file=sys.stderr)
 
     # -- headline convergence pair (bounded by the headline window) ---
     conv = None

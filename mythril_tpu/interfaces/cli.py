@@ -352,6 +352,19 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--no-specialize",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable per-contract specialized step kernels "
+                "(opcode-set phase pruning + superblock fusion from "
+                "the static summary): device waves run the generic "
+                "opcode-switch interpreter — the differential "
+                "baseline for a suspected specialization bug"
+            ),
+        ),
+    ),
+    (
         ("--device-prepass",),
         dict(
             choices=["auto", "always", "never"],
@@ -724,6 +737,15 @@ def build_parser() -> ArgumentParser:
         ),
     )
     serve.add_argument(
+        "--no-specialize",
+        action="store_true",
+        help=(
+            "disable contract-specialized step kernels (phase "
+            "pruning + superblock fusion); every wave runs the "
+            "generic interpreter"
+        ),
+    )
+    serve.add_argument(
         "--devices",
         type=int,
         default=1,
@@ -1076,6 +1098,7 @@ def _run_analyze(disassembler, address, args):
         deterministic_solving=args.deterministic_solving,
         static_prune=not args.no_static_prune,
         pipeline=not args.no_pipeline,
+        specialize=not args.no_specialize,
         mesh_devices=args.devices,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
@@ -1204,6 +1227,7 @@ def _cmd_serve(args: Namespace) -> None:
         transaction_count=args.transaction_count,
         checkpoint_dir=args.checkpoint_dir,
         pipeline=not args.no_pipeline,
+        specialize=not args.no_specialize,
         devices=args.devices,
     )
     serve_forever(config, host=args.host, port=args.port)

@@ -170,6 +170,23 @@ class ExploreStats:
         self.static_pruned_flips = 0
         self.static_seeds_dropped = 0
         self.static_summaries = 0
+        # -- kernel specialization observability (specialize.py) ------
+        #: 1 when the waves ran a contract-specialized kernel
+        self.specialized = 0
+        #: handler phases the wave kernel elided (union bucket)
+        self.spec_pruned_phases = 0
+        #: instructions advanced by fused substeps (superblock fusion)
+        #: ON TOP of the full-step active count — total instructions
+        #: executed is device_steps + spec_fused_steps
+        self.spec_fused_steps = 0
+        #: wave retries that fell back to the generic kernel (the
+        #: resilience ladder never re-dispatches specialized)
+        self.spec_fallbacks = 0
+        #: this explorer's kernel-cache lookups (process-wide LRU)
+        self.kernel_cache_hits = 0
+        self.kernel_cache_misses = 0
+        #: first-call trace+compile wall of this run's kernel bucket
+        self.kernel_compile_s = 0.0
         self.wall_s = 0.0
         # where the prepass wall goes: device wave execution vs host
         # flip solving (the two phases that can dominate)
@@ -249,6 +266,10 @@ class _ContractTrack:
         #: the statically-dead branch directions — (jumpi_pc, taken)
         #: pairs the flip loop must never spend a solver attempt on
         self.static_dead: frozenset = frozenset()
+        #: this contract's kernel-specialization bucket (step.PhaseSet,
+        #: set by the explorer when specialization is on; the wave
+        #: kernel is the union over the striped tracks)
+        self.phases = None
         self.covered: Set[Tuple[int, bool]] = set()
         self.attempted: Set[Tuple[int, bool]] = set()
         self.corpus: List[Tuple[int, bytes]] = []  # (carry index, calldata)
@@ -680,13 +701,16 @@ class _WavePayload:
 class _Inflight:
     """A dispatched, not-yet-harvested wave."""
 
-    __slots__ = ("payload", "out", "steps", "active", "dispatch_t", "failed")
+    __slots__ = (
+        "payload", "out", "steps", "active", "fused", "dispatch_t", "failed",
+    )
 
     def __init__(self, payload: _WavePayload) -> None:
         self.payload = payload
         self.out = None
         self.steps = None
         self.active = None
+        self.fused = None  # fused-substep lane-steps (specialized waves)
         self.dispatch_t = None
         self.failed = None
 
@@ -725,6 +749,7 @@ class DeviceCorpusExplorer:
         pipeline: Optional[bool] = None,
         devices=None,
         fault_domain: Optional[str] = None,
+        specialize: Optional[bool] = None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -846,6 +871,62 @@ class DeviceCorpusExplorer:
 
             self.mesh = make_mesh(n_devices)
             self.code_table = replicate_table(self.code_table, self.mesh)
+
+        # -- kernel specialization (specialize.py) ---------------------
+        # Per-track opcode signatures (from the static summary when one
+        # attached, a linear sweep otherwise) union into ONE wave-kernel
+        # bucket: the wave is a single striped dispatch, so the kernel
+        # must lower every phase ANY striped contract reaches. The
+        # per-pc fuse table rides beside the code table (replicated
+        # under a mesh the same way). --no-specialize, or any failure
+        # here, falls back to the generic kernel.
+        self._kernel = None
+        self._fuse_tbl = None
+        self.kernel_phases = None
+        if specialize is None:
+            from mythril_tpu.laser.batch.specialize import specialize_enabled
+
+            specialize = specialize_enabled()
+        if specialize:
+            try:
+                from mythril_tpu.laser.batch import specialize as _spec
+
+                for track, code in zip(self.tracks, self.codes):
+                    track.phases = _spec.phases_for(
+                        _spec.signature_for(code, track.static),
+                        fuse=_spec.fuse_profitable(code),
+                    )
+                self.kernel_phases = _spec.union_phases(
+                    [t.phases for t in self.tracks]
+                )
+                fuse_np = _spec.build_fuse_table(self.codes, cap)
+                import jax.numpy as jnp
+
+                self._fuse_tbl = jnp.asarray(fuse_np)
+                if self.mesh is not None:
+                    from mythril_tpu.parallel import replicate_table
+
+                    self._fuse_tbl = replicate_table(
+                        self._fuse_tbl, self.mesh
+                    )
+                cache = _spec.kernel_cache()
+                h0, m0 = cache.hits, cache.misses
+                self._kernel = cache.acquire(self.kernel_phases)
+                self.stats.kernel_cache_hits += cache.hits - h0
+                self.stats.kernel_cache_misses += cache.misses - m0
+                self.stats.specialized = 1
+                self.stats.spec_pruned_phases = len(
+                    self.kernel_phases.pruned
+                )
+            except Exception:
+                log.debug(
+                    "kernel specialization failed; exploring on the "
+                    "generic kernel",
+                    exc_info=True,
+                )
+                self._kernel = None
+                self._fuse_tbl = None
+                self.kernel_phases = None
 
     # -- static pre-analysis -------------------------------------------
     def _attach_static_feeds(self) -> None:
@@ -1351,12 +1432,23 @@ class DeviceCorpusExplorer:
                 sym = self._warm_sym(payload)
             else:
                 sym = self._cold_sym(payload)
-            runner = (
-                sym_run_donated if self._donation_ok() else sym_run
-            )
-            fl.out, fl.steps, fl.active = runner(
-                sym, self.code_table, max_steps=self.steps_per_wave
-            )
+            if self._kernel is not None:
+                # the contract-specialized kernel: pruned phases +
+                # fused superblock substeps (specialize.py)
+                fl.out, fl.steps, fl.active, fl.fused = self._kernel.sym_run(
+                    sym,
+                    self.code_table,
+                    self._fuse_tbl,
+                    max_steps=self.steps_per_wave,
+                    donate=self._donation_ok(),
+                )
+            else:
+                runner = (
+                    sym_run_donated if self._donation_ok() else sym_run
+                )
+                fl.out, fl.steps, fl.active = runner(
+                    sym, self.code_table, max_steps=self.steps_per_wave
+                )
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -1370,10 +1462,16 @@ class DeviceCorpusExplorer:
         """The resilience ladder for a wave whose dispatch or readback
         faulted: cold re-dispatch from the retained host payload (the
         donated warm path cannot replay — its input buffers are spent),
-        synchronous, attributed to the faulted wave's serial."""
+        synchronous, attributed to the faulted wave's serial. Retries
+        always run the GENERIC kernel — a fault on a specialized
+        dispatch must not be retried into the same specialized
+        lowering (fallback-to-generic, specialize.py docstring)."""
         import jax
 
         from mythril_tpu.support import resilience
+
+        if self._kernel is not None:
+            self.stats.spec_fallbacks += 1
 
         def _cold():
             # the ladder's own per-attempt injection point, qualified
@@ -1406,11 +1504,13 @@ class DeviceCorpusExplorer:
         from mythril_tpu.support import resilience
 
         wait0 = time.perf_counter()
+        fused = None
         if fl.failed is None:
             try:
                 self._inject("device.dispatch")
                 jax.block_until_ready(fl.steps)
                 out, steps, active = fl.out, fl.steps, fl.active
+                fused = fl.fused
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
@@ -1434,6 +1534,14 @@ class DeviceCorpusExplorer:
         self.stats.arena_nodes = max(self.stats.arena_nodes, view.count)
         self.stats.waves += 1
         self.stats.device_steps += int(active)
+        if fused is not None:
+            # instructions the fused substeps advanced beyond the
+            # full-step active count (specialized waves only) — kept
+            # BESIDE device_steps, whose active-lanes-per-full-step
+            # semantics the utilization comparison against
+            # device_steps_raw pins; total instructions executed is
+            # device_steps + spec_fused_steps
+            self.stats.spec_fused_steps += int(fused)
         self.stats.device_steps_raw += int(steps) * len(fl.payload.flat)
         self.stats.evidence_bytes += view.bytes_fetched
         self.stats.evidence_bytes_full += view.bytes_full
@@ -2300,6 +2408,13 @@ class DeviceCorpusExplorer:
                 # also retires the worker thread (a later run() would
                 # lazily restart it)
                 self._ckpt_writer.close()
+            if self._kernel is not None:
+                # unpin this run's specialization bucket (the kernel
+                # cache LRU may now evict it; the jit cache keeps it
+                # warm for the next explorer until then)
+                from mythril_tpu.laser.batch.specialize import kernel_cache
+
+                kernel_cache().release(self._kernel)
             DEVICE_BUSY.release()
 
     def _run_phases(self) -> Dict:
@@ -2426,6 +2541,11 @@ class DeviceCorpusExplorer:
         self.stats.device_wait_s = round(self.stats.device_wait_s, 3)
         self.stats.device_busy_s = round(self.stats.device_busy_s, 3)
         self.stats.wave_overlap_s = round(self.stats.wave_overlap_s, 3)
+        if self._kernel is not None:
+            # the bucket's first-call trace+compile wall (0 once warm)
+            self.stats.kernel_compile_s = round(
+                self._kernel.compile_s, 3
+            )
         stats = self.stats.as_dict()
         if self._halt_reason:
             # WHY the run ended early (deadline-expired / interrupted /

@@ -19,9 +19,14 @@ from mythril_tpu.laser.batch.step import step
 
 
 def _run_impl(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
-              unroll: int = 1, track_coverage: bool = True):
+              unroll: int = 1, track_coverage: bool = True, phases=None):
     """Run all lanes to completion (or step budget). Returns
-    (final_batch, steps_executed)."""
+    (final_batch, steps_executed).
+
+    `phases` (a static step.PhaseSet) prunes handler phases from the
+    lowered kernel at trace time — the specialization layer
+    (laser/batch/specialize.py) derives it from the static summary;
+    None is the generic interpreter."""
 
     def cond(carry):
         b, i = carry
@@ -30,7 +35,7 @@ def _run_impl(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
     def body(carry):
         b, i = carry
         for _ in range(unroll):
-            b = step(b, code, track_coverage=track_coverage)
+            b = step(b, code, track_coverage=track_coverage, phases=phases)
         return b, i + unroll
 
     out, steps = lax.while_loop(cond, body, (batch, jnp.int32(0)))
@@ -38,7 +43,8 @@ def _run_impl(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
 
 
 run = functools.partial(
-    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"))(
+    jax.jit,
+    static_argnames=("max_steps", "unroll", "track_coverage", "phases"))(
     _run_impl)
 #: donated variant for the pipelined service wave loop: the seeded
 #: input batch is consumed by the dispatch so XLA reuses its buffers
@@ -46,7 +52,8 @@ run = functools.partial(
 #: and must rebuild it from host data to retry a faulted dispatch —
 #: run_resilient therefore keeps the undonated kernel.
 run_donated = functools.partial(
-    jax.jit, static_argnames=("max_steps", "unroll", "track_coverage"),
+    jax.jit,
+    static_argnames=("max_steps", "unroll", "track_coverage", "phases"),
     donate_argnums=(0,))(_run_impl)
 
 

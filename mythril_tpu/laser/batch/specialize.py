@@ -1,0 +1,674 @@
+"""Per-contract specialized step kernels: trace-JIT the interpreter.
+
+The generic step kernel (step.py) is one execute-all-and-mask
+opcode-switch interpreter shared by every contract: each step lowers
+every handler phase whether or not the contract can ever reach that
+opcode. This layer uses the static summary (analysis/static: CFG,
+reachable blocks, opcode histogram) to *compile* a contract-shaped
+kernel instead — the DTVM determinism/JIT and Blockchain
+Superoptimizer block-lowering direction (PAPERS.md) applied to the
+analyzer itself:
+
+- **Opcode-set pruning** — a `step.PhaseSet` derived from the
+  contract's reachable-opcode signature elides whole handler phases
+  (keccak, EXP, the storage journal, memory copies, the call family)
+  from the lowered HLO at TRACE time, shrinking the per-step
+  mask-merge and dropping the cond-gated phases entirely. A lane that
+  somehow reaches a pruned opcode degrades to UNSUPPORTED (host
+  takeover) — silent mis-execution is impossible (step.py's
+  `_unhandled_table` safety net).
+
+- **Superblock fusion** — straight-line runs of pure stack-machine ops
+  (PUSH/DUP/SWAP/POP/JUMPDEST — the dominant Solidity filler) are
+  advanced by cheap *fused substeps*: each `while_loop` iteration runs
+  one full (pruned) step plus `fuse_depth - 1` micro-steps that only
+  execute lanes sitting inside a fusible run (a per-pc table computed
+  from the linear disassembly), so the loop advances a superblock per
+  iteration instead of an instruction. A substep never adjudicates
+  errors: a lane whose op would underflow/overflow/OOG simply skips
+  the substep and the next full step reproduces the generic verdict —
+  fused execution is bit-identical to generic execution by
+  construction.
+
+- **Specialization keys + compile cache** — kernels are keyed by the
+  (coarse, phase-granular) opcode-signature BUCKET, not the exact
+  codehash, so similar contracts share one compile; the per-arena-
+  shape XLA executables live inside each kernel's own jit cache. The
+  service's code-hash LRU (service/engine.py CodeCache) pins each
+  resident contract's bucket in the module-level `KernelCache`, so
+  warm `myth serve` traffic hits a contract-specialized kernel with
+  zero compile latency — and releases the pin on LRU eviction so
+  executables never leak.
+
+Fallback-to-generic conditions (documented in docs/device_engine.md
+§10): specialization disabled (`--no-specialize`), signature
+extraction failure, a wave-dispatch fault (the resilience retry ladder
+always re-dispatches on the generic kernel), and any opcode outside
+the signature (per-lane UNSUPPORTED degrade, as above).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from mythril_tpu.laser.batch.state import CodeTable, StateBatch, Status
+from mythril_tpu.laser.batch.step import (
+    GENERIC_PHASES,
+    PHASE_FLAGS,
+    PHASE_OPS,
+    PhaseSet,
+    _META,
+    step,
+)
+from mythril_tpu.ops import u256
+from mythril_tpu.support.opcodes import OPCODES
+
+log = logging.getLogger(__name__)
+
+W = u256.LIMBS
+
+#: full-step + (FUSE_DEPTH - 1) fused substeps per while_loop
+#: iteration: a superblock of up to FUSE_DEPTH straight-line
+#: stack-machine ops advances in one iteration
+FUSE_DEPTH = 4
+
+#: byte -> opcode name (linear-sweep signature extraction)
+_BYTE_TO_NAME = {entry[0]: name for name, entry in OPCODES.items()}
+
+#: the fusible op set: pure stack shuffling with static gas, no
+#: control transfer, no memory/storage/env effects, no arena nodes
+#: beyond tid moves — the substep semantics equal the full step's for
+#: exactly these ops
+_FUSE_BYTES = frozenset(
+    list(range(0x60, 0x80))  # PUSH1..PUSH32
+    + list(range(0x80, 0x90))  # DUP1..DUP16
+    + list(range(0x90, 0xA0))  # SWAP1..SWAP16
+    + [0x50, 0x5B]  # POP, JUMPDEST
+)
+
+_OPNAME_TO_FLAG = {
+    opname: flag for flag, names in PHASE_OPS.items() for opname in names
+}
+
+
+# ---------------------------------------------------------------------------
+# signatures + phase decisions
+# ---------------------------------------------------------------------------
+def signature_for(code: bytes, summary=None) -> frozenset:
+    """The contract's opcode-name signature.
+
+    With a StaticSummary: its reachable-block feature set (already a
+    conservative over-approximation — an incomplete dataflow widens it
+    to every instruction). Without one: a linear byte sweep following
+    PUSH immediates — the EVM's canonical instruction alignment, so
+    bytes inside PUSH data never count as executable opcodes."""
+    if summary is not None:
+        features = getattr(summary, "features", None)
+        if features:
+            return frozenset(features)
+    names = set()
+    pc, n = 0, len(code)
+    while pc < n:
+        op = code[pc]
+        name = _BYTE_TO_NAME.get(op)
+        if name is not None:
+            names.add(name)
+        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    return frozenset(names)
+
+
+def phases_for(signature: Iterable[str], fuse: bool = True) -> PhaseSet:
+    """The opcode-set pruning decision: a phase stays lowered iff the
+    signature reaches at least one of its opcodes. This IS the
+    specialization bucket — phase-granular on purpose, so contracts
+    differing only inside a phase share one compiled kernel."""
+    signature = set(signature)
+    flags = {
+        flag: any(opname in signature for opname in ops)
+        for flag, ops in PHASE_OPS.items()
+    }
+    return PhaseSet(**flags, fuse_depth=FUSE_DEPTH if fuse else 0)
+
+
+def union_phases(phase_sets: Iterable[PhaseSet]) -> PhaseSet:
+    """The bucket of a multi-contract wave: a phase is lowered iff ANY
+    striped contract needs it (sound for every lane)."""
+    phase_sets = list(phase_sets)
+    if not phase_sets:
+        return GENERIC_PHASES
+    merged = {
+        name: any(getattr(ph, name) for ph in phase_sets)
+        for name in PHASE_FLAGS
+    }
+    return PhaseSet(
+        **merged, fuse_depth=max(ph.fuse_depth for ph in phase_sets)
+    )
+
+
+def build_fuse_row(code: bytes, code_cap: int) -> np.ndarray:
+    """u8[code_cap]: 1 at every pc whose instruction is fusible — the
+    superblock membership table. Runs of consecutive 1s (in execution
+    order, PUSH immediates skipped) are the superblocks the fused
+    substeps advance; boundaries fall at the first non-fusible op."""
+    row = np.zeros((code_cap,), np.uint8)
+    pc, n = 0, len(code)
+    while pc < n and pc < code_cap:
+        op = code[pc]
+        if op in _FUSE_BYTES:
+            row[pc] = 1
+        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    return row
+
+
+def build_fuse_table(codes: List[bytes], code_cap: int) -> np.ndarray:
+    """One fuse row per CodeTable row, same row order."""
+    return np.stack([build_fuse_row(code, code_cap) for code in codes])
+
+
+def fuse_run_lengths(code: bytes) -> List[tuple]:
+    """(start_pc, n_ops) of every maximal fusible run — the superblock
+    boundaries, exposed for the golden tests and `myth lint`-style
+    introspection (not used on the hot path)."""
+    out = []
+    pc, n = 0, len(code)
+    start, count = None, 0
+    while pc < n:
+        op = code[pc]
+        if op in _FUSE_BYTES:
+            if start is None:
+                start, count = pc, 0
+            count += 1
+        else:
+            if start is not None:
+                out.append((start, count))
+                start = None
+        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    if start is not None:
+        out.append((start, count))
+    return out
+
+
+#: fusion profitability floor: fraction of instructions sitting inside
+#: multi-op fusible runs. Every iteration pays `fuse_depth - 1` substep
+#: passes whether lanes advance or not, so sparse-run contracts (short
+#: straight lines between branches/memory ops) lose to pruning-only —
+#: measured on the bench demo loop. Solidity-compiled code sits well
+#: above this floor (dispatchers and argument plumbing are PUSH/DUP/
+#: SWAP-dense).
+FUSE_DENSITY_MIN = 0.25
+
+
+def fuse_profitable(code: bytes) -> bool:
+    """The per-contract fusion decision: enable superblock substeps
+    only when enough of the instruction stream sits in runs of >= 2
+    fusible ops (singleton runs advance nothing a full step wouldn't).
+    A multi-contract wave fuses iff ANY striped contract profits
+    (union_phases takes the max fuse_depth) — non-profiting lanes just
+    skip the substeps."""
+    pc, n, total = 0, len(code), 0
+    while pc < n:
+        op = code[pc]
+        total += 1
+        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    if not total:
+        return False
+    fused = sum(
+        length for _start, length in fuse_run_lengths(code) if length >= 2
+    )
+    return fused / total >= FUSE_DENSITY_MIN
+
+
+# ---------------------------------------------------------------------------
+# fused substeps (superblock fusion)
+# ---------------------------------------------------------------------------
+def fused_substep(batch: StateBatch, code: CodeTable, fuse_tbl,
+                  track_coverage: bool = True):
+    """One micro-step over the fusible op set only.
+
+    Executes every RUNNING lane whose current op the fuse table marks
+    AND whose stack/gas state cannot fault on it; every other lane
+    waits for the next full step (which reproduces the generic error
+    verdict exactly). Returns (batch', lanes_executed)."""
+    import jax.numpy as jnp
+
+    n = batch.pc.shape[0]
+    stack_cap = batch.stack.shape[1]
+    code_len = code.length[batch.code_id]
+    pc_safe = jnp.clip(batch.pc, 0, code.ops.shape[1] - 33)
+    code_win = code.ops[
+        batch.code_id[:, None], pc_safe[:, None] + jnp.arange(33)[None, :]
+    ]
+    op = code_win[:, 0].astype(jnp.int32)
+    fuse_ok = (
+        fuse_tbl[
+            batch.code_id,
+            jnp.clip(batch.pc, 0, fuse_tbl.shape[1] - 1),
+        ]
+        != 0
+    )
+    live = (
+        (batch.status == Status.RUNNING)
+        & (batch.pc < code_len)
+        & fuse_ok
+    )
+
+    meta = jnp.asarray(_META)[op]
+    pops = meta[:, 2]
+    net_sp = meta[:, 3]
+    gmin_add = meta[:, 4].astype(jnp.uint32)
+    gmax_add = meta[:, 5].astype(jnp.uint32)
+    # skip (don't fault) lanes the full step must adjudicate: stack
+    # underflow/overflow, the model-capacity degrade, and OOG
+    ok = (
+        live
+        & (batch.sp >= pops)
+        & (batch.sp + net_sp <= min(stack_cap, 1024))
+        & (batch.gas_min + gmin_add <= batch.gas_budget)
+    )
+
+    is_push = (op >= 0x60) & (op <= 0x7F)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    dup_n = (op - 0x80).astype(jnp.int32)
+    swap_n = (op - 0x8F).astype(jnp.int32)
+
+    # one consolidated 3-slot peek: top (SWAP's sinking value), the
+    # DUP depth, the SWAP deep slot
+    peek_ks = jnp.stack(
+        [jnp.zeros_like(op), dup_n, swap_n], axis=1)
+    peek_idx = jnp.clip(
+        batch.sp[:, None] - 1 - peek_ks, 0, stack_cap - 1
+    ).astype(jnp.int32)
+    peeked = jnp.take_along_axis(batch.stack, peek_idx[:, :, None], axis=1)
+    top, dup_val, swap_deep = peeked[:, 0], peeked[:, 1], peeked[:, 2]
+
+    # PUSH immediate rides the fetch window (same as the full step)
+    push_n = (op - 0x5F).astype(jnp.int32)
+    pword = u256.bytes_to_word(code_win[:, 1:].astype(jnp.uint32))
+    pword = u256.lshr(pword, (8 * (32 - push_n)).astype(jnp.uint32))
+
+    res_val = jnp.where(
+        is_push[:, None], pword,
+        jnp.where(is_dup[:, None], dup_val, swap_deep),
+    )
+    res_idx = jnp.clip(
+        jnp.where(is_swap, batch.sp - 1, batch.sp), 0, stack_cap - 1
+    )
+    writes = ok & (is_push | is_dup | is_swap)
+    slot_ids = jnp.arange(stack_cap)[None, :]
+    oh_res = (slot_ids == res_idx[:, None]) & writes[:, None]
+    swap_idx = jnp.clip(batch.sp - 1 - swap_n, 0, stack_cap - 1)
+    oh_swap = (slot_ids == swap_idx[:, None]) & (ok & is_swap)[:, None]
+    stack = jnp.where(
+        oh_res[:, :, None], res_val[:, None, :],
+        jnp.where(oh_swap[:, :, None], top[:, None, :], batch.stack),
+    )
+
+    sp = jnp.where(ok, batch.sp + net_sp, batch.sp)
+    pc = jnp.where(ok, batch.pc + 1 + jnp.where(is_push, push_n, 0),
+                   batch.pc)
+    gas_min = batch.gas_min + jnp.where(ok, gmin_add, 0)
+    gas_max = batch.gas_max + jnp.where(ok, gmax_add, 0)
+
+    if track_coverage:
+        word_idx = jnp.clip(batch.pc // 32, 0, batch.pc_seen.shape[1] - 1)
+        bit = jnp.uint32(1) << (batch.pc % 32).astype(jnp.uint32)
+        seen_words = jnp.take_along_axis(
+            batch.pc_seen, word_idx[:, None], axis=1)[:, 0]
+        seen_words = jnp.where(ok, seen_words | bit, seen_words)
+        pc_seen = jnp.where(
+            jnp.arange(batch.pc_seen.shape[1])[None, :] == word_idx[:, None],
+            seen_words[:, None],
+            batch.pc_seen,
+        )
+    else:
+        pc_seen = batch.pc_seen
+
+    out = batch._replace(
+        pc=pc, stack=stack, sp=sp, gas_min=gas_min, gas_max=gas_max,
+        pc_seen=pc_seen,
+    )
+    return out, jnp.sum(ok.astype(jnp.int32)), ok, peek_idx, res_idx, writes
+
+
+def sym_fused_substep(symb, code: CodeTable, fuse_tbl,
+                      track_coverage: bool = True):
+    """The fused substep with the symbolic-shadow mirror: PUSH writes
+    a concrete (0) tid, DUP/SWAP move tids exactly as they move
+    values. No arena rows, no events — the fusible set is chosen so
+    the shadow is pure tid plumbing. Returns (symb', executed)."""
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.batch.symbolic import SymBatch, _scatter2
+
+    pre = symb.base
+    new_base, n_exec, ok, peek_idx, res_idx, writes = fused_substep(
+        pre, code, fuse_tbl, track_coverage=track_coverage
+    )
+    stack_cap = pre.stack.shape[1]
+    pc_safe = jnp.clip(pre.pc, 0, code.ops.shape[1] - 33)
+    op = code.ops[pre.code_id, pc_safe].astype(jnp.int32)
+    is_push = (op >= 0x60) & (op <= 0x7F)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    swap_n = (op - 0x8F).astype(jnp.int32)
+
+    tids = jnp.take_along_axis(symb.stack_tid, peek_idx, axis=1)
+    top_tid, dup_tid, deep_tid = tids[:, 0], tids[:, 1], tids[:, 2]
+    res_tid = jnp.where(
+        is_push, 0, jnp.where(is_dup, dup_tid, deep_tid)
+    ).astype(jnp.int32)
+    stack_tid = _scatter2(symb.stack_tid, res_idx, res_tid, writes)
+    stack_tid = _scatter2(
+        stack_tid,
+        jnp.clip(pre.sp - 1 - swap_n, 0, stack_cap - 1),
+        top_tid,
+        ok & is_swap,
+    )
+    return symb._replace(base=new_base, stack_tid=stack_tid), n_exec
+
+
+# ---------------------------------------------------------------------------
+# specialized run loops
+# ---------------------------------------------------------------------------
+def _spec_run_impl(batch: StateBatch, code: CodeTable, fuse,
+                   max_steps: int = 4096, track_coverage: bool = True,
+                   phases: Optional[PhaseSet] = None):
+    """The concrete specialized loop: one pruned full step plus
+    `fuse_depth - 1` fused substeps per iteration. Returns
+    (out, full_steps, fused_lane_steps)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    fuse_depth = phases.fuse_depth if phases is not None else 0
+
+    def cond(carry):
+        b, i, _fused = carry
+        return (i < max_steps) & jnp.any(b.status == Status.RUNNING)
+
+    def body(carry):
+        b, i, fused = carry
+        b = step(b, code, track_coverage=track_coverage, phases=phases)
+        for _ in range(max(0, fuse_depth - 1)):
+            b, n_exec, *_ = fused_substep(
+                b, code, fuse, track_coverage=track_coverage
+            )
+            fused = fused + n_exec
+        return b, i + 1, fused
+
+    out, steps, fused = lax.while_loop(
+        cond, body, (batch, jnp.int32(0), jnp.int32(0))
+    )
+    return out, steps, fused
+
+
+def _spec_sym_run_impl(symb, code: CodeTable, fuse,
+                       max_steps: int = 2048,
+                       phases: Optional[PhaseSet] = None):
+    """The symbolic specialized loop (the explorer's wave kernel).
+    Returns (out, full_steps, active_lane_steps, fused_lane_steps) —
+    `active` keeps the generic loop's semantics (RUNNING lanes per
+    full step); `fused` counts the extra instructions the substeps
+    advanced on top."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mythril_tpu.laser.batch.symbolic import sym_step
+
+    fuse_depth = phases.fuse_depth if phases is not None else 0
+
+    def cond(carry):
+        s, i, _active, _fused = carry
+        return (i < max_steps) & jnp.any(s.base.status == Status.RUNNING)
+
+    def body(carry):
+        s, i, active, fused = carry
+        active = active + jnp.sum(
+            (s.base.status == Status.RUNNING).astype(jnp.int32)
+        )
+        s = sym_step(s, code, phases=phases)
+        for _ in range(max(0, fuse_depth - 1)):
+            s, n_exec = sym_fused_substep(s, code, fuse)
+            fused = fused + n_exec
+        return s, i + 1, active, fused
+
+    out, steps, active, fused = lax.while_loop(
+        cond, body, (symb, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    )
+    return out, steps, active, fused
+
+
+# ---------------------------------------------------------------------------
+# compiled-kernel handles + the compile cache
+# ---------------------------------------------------------------------------
+class SpecializedKernel:
+    """One specialization bucket's compiled kernels: fresh jit objects
+    per bucket (so dropping the handle releases its XLA executables),
+    donated variants included, with first-call compile timing.
+
+    The per-arena-shape executables live inside these jit objects'
+    caches; `compiles` counts distinct (entry point, shape) traces."""
+
+    def __init__(self, phases: PhaseSet) -> None:
+        import jax
+
+        self.phases = phases
+        self.refs = 0
+        self.calls = 0
+        self.compile_s = 0.0
+        self._warm = set()
+        self._run = jax.jit(
+            _spec_run_impl,
+            static_argnames=("max_steps", "track_coverage", "phases"),
+        )
+        self._run_donated = jax.jit(
+            _spec_run_impl,
+            static_argnames=("max_steps", "track_coverage", "phases"),
+            donate_argnums=(0,),
+        )
+        self._sym = jax.jit(
+            _spec_sym_run_impl, static_argnames=("max_steps", "phases")
+        )
+        self._sym_donated = jax.jit(
+            _spec_sym_run_impl,
+            static_argnames=("max_steps", "phases"),
+            donate_argnums=(0,),
+        )
+
+    @property
+    def pruned_phases(self) -> tuple:
+        return self.phases.pruned
+
+    @property
+    def compiles(self) -> int:
+        return len(self._warm)
+
+    def _timed(self, key, fn, *args, **kwargs):
+        """First call per (entry, shape) is trace+compile-dominated
+        (jit compiles synchronously, dispatch is async): its wall is
+        the honest compile-latency figure the bench/stats report."""
+        self.calls += 1
+        if key in self._warm:
+            return fn(*args, **kwargs)
+        global _COMPILING
+        with _CACHE_MU:
+            _COMPILING += 1
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self.compile_s += time.perf_counter() - t0
+            self._warm.add(key)
+            with _CACHE_MU:
+                _COMPILING -= 1
+
+    @staticmethod
+    def run_key(batch, code, donate: bool) -> tuple:
+        """The warm-cache key of a concrete-run dispatch shape (the
+        service's warm-gating probes it before putting a compile on
+        the serving path)."""
+        return ("run", donate, batch.pc.shape[0], batch.mem.shape[1],
+                batch.stack.shape[1], code.ops.shape)
+
+    def is_warm(self, key) -> bool:
+        return key in self._warm
+
+    def run(self, batch, code, fuse, max_steps, track_coverage=True,
+            donate=False):
+        """(out, full_steps, fused_lane_steps) — the service's wave
+        entry point."""
+        if self._run is None:
+            raise RuntimeError("specialized kernel was dropped")
+        fn = self._run_donated if donate else self._run
+        key = self.run_key(batch, code, donate)
+        return self._timed(
+            key, fn, batch, code, fuse, max_steps=max_steps,
+            track_coverage=track_coverage, phases=self.phases,
+        )
+
+    def sym_run(self, symb, code, fuse, max_steps, donate=False):
+        """(out, full_steps, active, fused) — the explorer's wave
+        entry point."""
+        if self._sym is None:
+            raise RuntimeError("specialized kernel was dropped")
+        fn = self._sym_donated if donate else self._sym
+        base = symb.base
+        key = ("sym", donate, base.pc.shape[0], base.mem.shape[1],
+               base.stack.shape[1], code.ops.shape)
+        return self._timed(
+            key, fn, symb, code, fuse, max_steps=max_steps,
+            phases=self.phases,
+        )
+
+    def drop(self) -> None:
+        """Release the jit objects (and with them any live XLA
+        executables) — called when the cache evicts an unpinned
+        entry."""
+        self._run = self._run_donated = None
+        self._sym = self._sym_donated = None
+
+
+_CACHE_MU = threading.Lock()
+_COMPILING = 0
+
+
+class KernelCache:
+    """LRU of SpecializedKernel handles keyed by specialization bucket
+    (the PhaseSet). Entries pinned via acquire() (the service's code
+    LRU pins each resident contract's bucket) survive capacity
+    eviction until released; releasing the last pin of an
+    already-evicted entry drops its executables."""
+
+    def __init__(self, capacity: int = 32) -> None:
+        self.capacity = max(1, capacity)
+        self._entries: "OrderedDict[PhaseSet, SpecializedKernel]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, phases: PhaseSet) -> SpecializedKernel:
+        with _CACHE_MU:
+            kernel = self._entries.get(phases)
+            if kernel is not None:
+                self.hits += 1
+                self._entries.move_to_end(phases)
+                return kernel
+            self.misses += 1
+        # build outside the lock (jit object construction is cheap but
+        # not free); a racing build of the same bucket keeps the first
+        kernel = SpecializedKernel(phases)
+        with _CACHE_MU:
+            racing = self._entries.get(phases)
+            if racing is not None:
+                return racing
+            self._entries[phases] = kernel
+            self._evict_over_capacity()
+        return kernel
+
+    def acquire(self, phases: PhaseSet) -> SpecializedKernel:
+        kernel = self.get(phases)
+        with _CACHE_MU:
+            kernel.refs += 1
+        return kernel
+
+    def release(self, kernel: Optional[SpecializedKernel]) -> None:
+        if kernel is None:
+            return
+        with _CACHE_MU:
+            kernel.refs = max(0, kernel.refs - 1)
+            if kernel.refs == 0 and kernel.phases not in self._entries:
+                # last pin of an evicted entry: executables go now
+                kernel.drop()
+            else:
+                self._evict_over_capacity()
+
+    def _evict_over_capacity(self) -> None:
+        # under _CACHE_MU; pinned entries are skipped, not dropped
+        over = len(self._entries) - self.capacity
+        if over <= 0:
+            return
+        for phases in list(self._entries):
+            if over <= 0:
+                break
+            kernel = self._entries[phases]
+            if kernel.refs > 0:
+                continue
+            del self._entries[phases]
+            kernel.drop()
+            self.evictions += 1
+            over -= 1
+
+    def stats(self) -> Dict:
+        with _CACHE_MU:
+            entries = list(self._entries.values())
+            return {
+                "size": len(entries),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pinned": sum(1 for k in entries if k.refs > 0),
+                "compiles": sum(k.compiles for k in entries),
+                "compiles_in_flight": _COMPILING,
+                "compile_s": round(sum(k.compile_s for k in entries), 3),
+            }
+
+    def clear(self) -> None:
+        with _CACHE_MU:
+            for kernel in self._entries.values():
+                kernel.drop()
+            self._entries.clear()
+            self.hits = self.misses = self.evictions = 0
+
+
+_KERNELS = KernelCache()
+
+
+def kernel_cache() -> KernelCache:
+    """The process-wide kernel cache (one compile per bucket per
+    process; the persistent XLA cache amortizes across processes)."""
+    return _KERNELS
+
+
+def kernel_cache_stats() -> Dict:
+    return _KERNELS.stats()
+
+
+def clear_kernel_cache() -> None:
+    _KERNELS.clear()
+
+
+def specialize_enabled() -> bool:
+    """One switch for every consumer (CLI --no-specialize)."""
+    from mythril_tpu.support.support_args import args
+
+    return bool(getattr(args, "specialize", True))

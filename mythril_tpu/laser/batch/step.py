@@ -23,7 +23,9 @@ host symbolic engine can take the lane over.
 
 from __future__ import annotations
 
+import functools
 import os
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -136,6 +138,130 @@ _META = np.stack(
 )
 
 
+# ---------------------------------------------------------------------------
+# kernel specialization: trace-time phase switches
+# ---------------------------------------------------------------------------
+# The generic step kernel lowers EVERY handler phase into the HLO —
+# cond-gated phases still pay their branch evaluation each step and
+# their compiled footprint always. A PhaseSet prunes whole phases at
+# TRACE time from the static layer's reachable-opcode signature
+# (laser/batch/specialize.py builds them), so a contract that never
+# hashes, never journals storage, never EXPs gets a kernel without
+# those phases at all. Phases are grouped coarsely (one flag covers an
+# opcode family) so similar contracts land in the same specialization
+# bucket and share one compile.
+
+
+class PhaseSet(NamedTuple):
+    """Hashable trace-time phase switches (a static jit argument).
+
+    All-True == the generic kernel. `fuse_depth` > 1 additionally runs
+    that many fused-substep micro-iterations per full step (superblock
+    fusion, specialize.py)."""
+
+    calls: bool = True
+    extcodesize: bool = True
+    returndatacopy: bool = True
+    arith: bool = True
+    cmp: bool = True
+    bits: bool = True
+    shifts: bool = True
+    div: bool = True
+    modops: bool = True
+    exp: bool = True
+    env_block: bool = True
+    env_tx: bool = True
+    env_info: bool = True
+    calldataload: bool = True
+    sha3: bool = True
+    mload: bool = True
+    mstore: bool = True
+    mstore8: bool = True
+    copy: bool = True
+    sload: bool = True
+    sstore: bool = True
+    logs: bool = True
+    selfdestruct: bool = True
+    fuse_depth: int = 0
+
+    @property
+    def pruned(self):
+        """Names of the phases this kernel elides."""
+        return tuple(
+            name for name in PHASE_FLAGS if not getattr(self, name)
+        )
+
+
+#: the boolean phase fields, in declaration order
+PHASE_FLAGS = tuple(
+    name for name in PhaseSet._fields if name != "fuse_depth"
+)
+
+#: phase flag -> the opcode names that phase (and only that phase)
+#: handles. Ops in NO group (STOP/RETURN/REVERT/JUMP/JUMPI/JUMPDEST/
+#: POP/PC-relative PUSH/DUP/SWAP, ASSERT_FAIL) are structural and
+#: always lowered.
+PHASE_OPS = {
+    "calls": ["CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"],
+    "extcodesize": ["EXTCODESIZE"],
+    "returndatacopy": ["RETURNDATACOPY"],
+    "arith": ["ADD", "SUB", "MUL"],
+    "cmp": ["LT", "GT", "SLT", "SGT", "EQ", "ISZERO"],
+    "bits": ["AND", "OR", "XOR", "NOT"],
+    "shifts": ["BYTE", "SHL", "SHR", "SAR", "SIGNEXTEND"],
+    "div": ["DIV", "SDIV", "MOD", "SMOD"],
+    "modops": ["ADDMOD", "MULMOD"],
+    "exp": ["EXP"],
+    "env_block": [
+        "TIMESTAMP", "NUMBER", "COINBASE", "DIFFICULTY", "GASLIMIT",
+        "CHAINID", "BASEFEE", "BLOCKHASH",
+    ],
+    "env_tx": [
+        "ADDRESS", "CALLER", "ORIGIN", "CALLVALUE", "GASPRICE",
+        "SELFBALANCE", "BALANCE",
+    ],
+    "env_info": [
+        "CALLDATASIZE", "CODESIZE", "RETURNDATASIZE", "MSIZE", "PC", "GAS",
+    ],
+    "calldataload": ["CALLDATALOAD"],
+    "sha3": ["SHA3"],
+    "mload": ["MLOAD"],
+    "mstore": ["MSTORE"],
+    "mstore8": ["MSTORE8"],
+    "copy": ["CALLDATACOPY", "CODECOPY"],
+    "sload": ["SLOAD"],
+    "sstore": ["SSTORE"],
+    "logs": ["LOG0", "LOG1", "LOG2", "LOG3", "LOG4"],
+    "selfdestruct": ["SUICIDE"],
+}
+
+#: the generic (nothing pruned, no fusion) kernel
+GENERIC_PHASES = PhaseSet()
+
+
+def _on(phases: Optional[PhaseSet], name: str) -> bool:
+    """Trace-time phase switch: None means the generic kernel."""
+    return phases is None or getattr(phases, name)
+
+
+@functools.lru_cache(maxsize=None)
+def _unhandled_table(phases: PhaseSet) -> np.ndarray:
+    """bool[256]: opcodes whose handler phase this PhaseSet prunes.
+
+    The specialized kernel's safety net: a lane reaching a pruned
+    opcode (a wrong or stale signature — reachable sets are
+    over-approximations, so this should never fire) degrades to
+    UNSUPPORTED and the host re-executes it, exactly like any other
+    off-device opcode. Silent mis-execution is impossible by
+    construction."""
+    table = np.zeros(256, dtype=bool)
+    for flag, names in PHASE_OPS.items():
+        if not getattr(phases, flag):
+            for opname in names:
+                table[_B[opname]] = True
+    return table
+
+
 # Stack-peek implementation: "gather" (take_along_axis) or "einsum"
 # (one-hot contraction). The limbs-major probe measured the contraction
 # at 2/3 the kernel-segment count of the gather, and the full step
@@ -190,7 +316,8 @@ def _mem_gas(words):
 
 
 def step(batch: StateBatch, code: CodeTable,
-         track_coverage: bool = True) -> StateBatch:
+         track_coverage: bool = True,
+         phases: Optional[PhaseSet] = None) -> StateBatch:
     n = batch.pc.shape[0]
     # capacities are carried by the batch's array shapes, so callers
     # size them per workload (make_batch mem_cap=/calldata_cap=/...)
@@ -244,11 +371,23 @@ def step(batch: StateBatch, code: CodeTable,
     is_unsupported = is_unsupported | (
         live & valid & supported & ~underflow & cap_degrade
     )
+    if phases is not None and phases.pruned:
+        # the specialization safety net: an opcode whose handler phase
+        # this kernel pruned degrades to UNSUPPORTED (host takeover),
+        # leaving the lane AT the instruction — never silently
+        # mis-executed. A sound signature makes this dead code.
+        unhandled = jnp.asarray(_unhandled_table(phases))[op]
+        is_unsupported = is_unsupported | (
+            live & valid & supported & ~underflow & ~cap_degrade
+            & unhandled
+        )
     stack_err = live & valid & supported & (underflow | overflow)
     ex = (
         live & valid & supported & ~stack_err & ~cap_degrade
         & (op != INVALID_OP)
     )  # executing
+    if phases is not None and phases.pruned:
+        ex = ex & ~unhandled
 
     # ---- operands --------------------------------------------------------
     # one gather for every slot any phase peeks (a/b/c + DUP/SWAP
@@ -313,23 +452,25 @@ def step(batch: StateBatch, code: CodeTable,
     # EXTCODESIZE: own address -> code length; any other address in an
     # empty world -> 0 (precompiles carry no code either). Outside the
     # empty world a foreign size is unknowable on device.
-    extsz = ex & (op == EXTCODESIZE_OP)
-    extsz_self = u256.eq(_addr160(a), batch.address)
-    extsz_ok = extsz & ((batch.empty_world != 0) | extsz_self)
-    status = jnp.where(extsz & ~extsz_ok, Status.UNSUPPORTED, status)
-    extsz_word = jnp.zeros((n, W), jnp.uint32)
-    extsz_word = extsz_word.at[:, 0].set(
-        jnp.where(extsz_self, code_len, 0).astype(jnp.uint32)
-    )
-    res_val, res_mask = put(res_val, res_mask, extsz_ok, extsz_word)
+    if _on(phases, "extcodesize"):
+        extsz = ex & (op == EXTCODESIZE_OP)
+        extsz_self = u256.eq(_addr160(a), batch.address)
+        extsz_ok = extsz & ((batch.empty_world != 0) | extsz_self)
+        status = jnp.where(extsz & ~extsz_ok, Status.UNSUPPORTED, status)
+        extsz_word = jnp.zeros((n, W), jnp.uint32)
+        extsz_word = extsz_word.at[:, 0].set(
+            jnp.where(extsz_self, code_len, 0).astype(jnp.uint32)
+        )
+        res_val, res_mask = put(res_val, res_mask, extsz_ok, extsz_word)
 
     # RETURNDATACOPY: device lanes always have an empty return buffer
     # (calls that would fill one hand off to the host), so the
     # (dest, 0, 0) form Solidity emits is a no-op; any other operands
     # are an out-of-bounds read the host adjudicates exactly.
-    rdc = ex & (op == RETURNDATACOPY_OP)
-    rdc_ok = rdc & u256.is_zero(b) & u256.is_zero(c)
-    status = jnp.where(rdc & ~rdc_ok, Status.UNSUPPORTED, status)
+    if _on(phases, "returndatacopy"):
+        rdc = ex & (op == RETURNDATACOPY_OP)
+        rdc_ok = rdc & u256.is_zero(b) & u256.is_zero(c)
+        status = jnp.where(rdc & ~rdc_ok, Status.UNSUPPORTED, status)
 
     is_call_fam = (
         (op == CALL_OP) | (op == CALLCODE_OP)
@@ -414,90 +555,114 @@ def step(batch: StateBatch, code: CodeTable,
             g_max + mem_gas,
         )
 
-    (res_val, res_mask, status, balance, msize, gas_dyn_min, gas_dyn_max) = (
-        _gate(
-            jnp.any(call_any),
-            do_calls,
-            (res_val, res_mask, status, balance, msize, gas_dyn_min,
-             gas_dyn_max),
+    if _on(phases, "calls"):
+        (res_val, res_mask, status, balance, msize, gas_dyn_min,
+         gas_dyn_max) = (
+            _gate(
+                jnp.any(call_any),
+                do_calls,
+                (res_val, res_mask, status, balance, msize, gas_dyn_min,
+                 gas_dyn_max),
+            )
         )
-    )
 
     # ---- cheap binary arithmetic / compares / bitwise --------------------
-    cheap_bin = {
-        ADD: u256.add(a, b),
-        SUB: u256.sub(a, b),
-        MUL: u256.mul(a, b),
-        AND: a & b,
-        OR: a | b,
-        XOR: a ^ b,
-        LT: u256.bool_to_word(u256.ult(a, b)),
-        GT: u256.bool_to_word(u256.ult(b, a)),
-        SLT: u256.bool_to_word(u256.slt(a, b)),
-        SGT: u256.bool_to_word(u256.slt(b, a)),
-        EQ: u256.bool_to_word(u256.eq(a, b)),
-        BYTE: u256.byte_op(a, b),
-        SHL: u256.shl(b, u256.shift_amount(a)),
-        SHR: u256.lshr(b, u256.shift_amount(a)),
-        SAR: u256.ashr(b, u256.shift_amount(a)),
-        SIGNEXTEND: u256.signextend(a, b),
-    }
+    # entries are included per phase group: pruning a group the
+    # contract never reaches drops its compute AND its share of the
+    # per-step mask-merge from the lowered HLO
+    cheap_bin = {}
+    if _on(phases, "arith"):
+        cheap_bin.update({
+            ADD: u256.add(a, b),
+            SUB: u256.sub(a, b),
+            MUL: u256.mul(a, b),
+        })
+    if _on(phases, "bits"):
+        cheap_bin.update({AND: a & b, OR: a | b, XOR: a ^ b})
+    if _on(phases, "cmp"):
+        cheap_bin.update({
+            LT: u256.bool_to_word(u256.ult(a, b)),
+            GT: u256.bool_to_word(u256.ult(b, a)),
+            SLT: u256.bool_to_word(u256.slt(a, b)),
+            SGT: u256.bool_to_word(u256.slt(b, a)),
+            EQ: u256.bool_to_word(u256.eq(a, b)),
+        })
+    if _on(phases, "shifts"):
+        cheap_bin.update({
+            BYTE: u256.byte_op(a, b),
+            SHL: u256.shl(b, u256.shift_amount(a)),
+            SHR: u256.lshr(b, u256.shift_amount(a)),
+            SAR: u256.ashr(b, u256.shift_amount(a)),
+            SIGNEXTEND: u256.signextend(a, b),
+        })
     for byte_, val in cheap_bin.items():
         res_val, res_mask = put(res_val, res_mask, ex & (op == byte_), val)
 
     # unary
-    res_val, res_mask = put(
-        res_val, res_mask, ex & (op == ISZERO),
-        u256.bool_to_word(u256.is_zero(a)))
-    res_val, res_mask = put(res_val, res_mask, ex & (op == NOT), u256.bit_not(a))
+    if _on(phases, "cmp"):
+        res_val, res_mask = put(
+            res_val, res_mask, ex & (op == ISZERO),
+            u256.bool_to_word(u256.is_zero(a)))
+    if _on(phases, "bits"):
+        res_val, res_mask = put(
+            res_val, res_mask, ex & (op == NOT), u256.bit_not(a))
 
     # ---- expensive arithmetic (gated) ------------------------------------
-    div_mask = ex & ((op == DIV) | (op == SDIV) | (op == MOD) | (op == SMOD))
+    if _on(phases, "div"):
+        div_mask = ex & (
+            (op == DIV) | (op == SDIV) | (op == MOD) | (op == SMOD)
+        )
 
-    def do_div(args):
-        res_val, res_mask = args
-        q, r = u256.udivmod(a, b)
-        qs = u256.sdiv(a, b)
-        rs = u256.srem(a, b)
-        val = _m(op == DIV, q, _m(op == SDIV, qs, _m(op == MOD, r, rs)))
-        return put(res_val, res_mask, div_mask, val)
+        def do_div(args):
+            res_val, res_mask = args
+            q, r = u256.udivmod(a, b)
+            qs = u256.sdiv(a, b)
+            rs = u256.srem(a, b)
+            val = _m(op == DIV, q, _m(op == SDIV, qs, _m(op == MOD, r, rs)))
+            return put(res_val, res_mask, div_mask, val)
 
-    res_val, res_mask = _gate(jnp.any(div_mask), do_div, (res_val, res_mask))
+        res_val, res_mask = _gate(
+            jnp.any(div_mask), do_div, (res_val, res_mask))
 
-    modmask = ex & ((op == ADDMOD) | (op == MULMOD))
+    if _on(phases, "modops"):
+        modmask = ex & ((op == ADDMOD) | (op == MULMOD))
 
-    def do_modops(args):
-        res_val, res_mask = args
-        am = u256.addmod(a, b, c)
-        mm = u256.mulmod(a, b, c)
-        return put(res_val, res_mask, modmask, _m(op == ADDMOD, am, mm))
+        def do_modops(args):
+            res_val, res_mask = args
+            am = u256.addmod(a, b, c)
+            mm = u256.mulmod(a, b, c)
+            return put(res_val, res_mask, modmask, _m(op == ADDMOD, am, mm))
 
-    res_val, res_mask = _gate(jnp.any(modmask), do_modops, (res_val, res_mask))
+        res_val, res_mask = _gate(
+            jnp.any(modmask), do_modops, (res_val, res_mask))
 
-    exp_mask = ex & (op == EXP)
+    if _on(phases, "exp"):
+        exp_mask = ex & (op == EXP)
 
-    def do_exp(args):
-        res_val, res_mask, g_min, g_max = args
-        res_val, res_mask = put(res_val, res_mask, exp_mask, u256.exp(a, b))
-        # dynamic gas: priced per byte of exponent (b)
-        high_limb = jnp.max(
-            jnp.where(
-                b != 0, jnp.arange(1, W + 1, dtype=jnp.int32)[None, :], 0
-            ),
-            axis=-1)  # 1-based index of highest nonzero limb, 0 if b == 0
-        top_limb = jnp.take_along_axis(
-            b, jnp.clip(high_limb - 1, 0, W - 1)[:, None], axis=-1)[:, 0]
-        exp_bytes = jnp.where(
-            high_limb > 0, 2 * high_limb - (top_limb < 256), 0
-        ).astype(jnp.uint32)
-        exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
-        # 10/byte is the Frontier/Homestead price (the true minimum
-        # across forks); 50/byte (EIP-160) bounds the maximum
-        return res_val, res_mask, g_min + 10 * exp_bytes, g_max + 50 * exp_bytes
+        def do_exp(args):
+            res_val, res_mask, g_min, g_max = args
+            res_val, res_mask = put(
+                res_val, res_mask, exp_mask, u256.exp(a, b))
+            # dynamic gas: priced per byte of exponent (b)
+            high_limb = jnp.max(
+                jnp.where(
+                    b != 0, jnp.arange(1, W + 1, dtype=jnp.int32)[None, :], 0
+                ),
+                axis=-1)  # 1-based index of highest nonzero limb, 0 if b == 0
+            top_limb = jnp.take_along_axis(
+                b, jnp.clip(high_limb - 1, 0, W - 1)[:, None], axis=-1)[:, 0]
+            exp_bytes = jnp.where(
+                high_limb > 0, 2 * high_limb - (top_limb < 256), 0
+            ).astype(jnp.uint32)
+            exp_bytes = jnp.where(exp_mask, exp_bytes, 0)
+            # 10/byte is the Frontier/Homestead price (the true minimum
+            # across forks); 50/byte (EIP-160) bounds the maximum
+            return (res_val, res_mask, g_min + 10 * exp_bytes,
+                    g_max + 50 * exp_bytes)
 
-    res_val, res_mask, gas_dyn_min, gas_dyn_max = _gate(
-        jnp.any(exp_mask), do_exp,
-        (res_val, res_mask, gas_dyn_min, gas_dyn_max))
+        res_val, res_mask, gas_dyn_min, gas_dyn_max = _gate(
+            jnp.any(exp_mask), do_exp,
+            (res_val, res_mask, gas_dyn_min, gas_dyn_max))
 
     # ---- environment / block pushes --------------------------------------
     zero_w = jnp.zeros((n, W), jnp.uint32)
@@ -505,76 +670,94 @@ def step(batch: StateBatch, code: CodeTable,
     # GAS pushes the gas remaining AFTER its own charge (2): exact when
     # the accumulated minimum is exact, which the concolic lane keeps
     # for the static+memory costs preceding a GAS read (the gas0/gas1
-    # VMTests pin this value through an SSTORE)
+    # VMTests pin this value through an SSTORE). gas_left also feeds
+    # the memory-expansion OOG check, so it is computed unconditionally.
     gas_left = budget - jnp.minimum(batch.gas_min + 2, budget)
-    gas_word = jnp.zeros((n, W), jnp.uint32)
-    gas_word = gas_word.at[:, 0].set(gas_left & 0xFFFF)
-    gas_word = gas_word.at[:, 1].set(gas_left >> 16)
-    msize_word = jnp.zeros((n, W), jnp.uint32)
-    msize_bytes = (msize * 32).astype(jnp.uint32)
-    msize_word = msize_word.at[:, 0].set(msize_bytes & 0xFFFF)
-    msize_word = msize_word.at[:, 1].set(msize_bytes >> 16)
-    pc_word = jnp.zeros((n, W), jnp.uint32)
-    pc_word = pc_word.at[:, 0].set(batch.pc.astype(jnp.uint32) & 0xFFFF)
-    pc_word = pc_word.at[:, 1].set(batch.pc.astype(jnp.uint32) >> 16)
-    cds_word = jnp.zeros((n, W), jnp.uint32)
-    cds_word = cds_word.at[:, 0].set(batch.calldatasize.astype(jnp.uint32))
-    csize_word = jnp.zeros((n, W), jnp.uint32)
-    csize_word = csize_word.at[:, 0].set(code_len.astype(jnp.uint32))
 
-    env_pushes = {
-        ADDRESS: batch.address,
-        CALLER: batch.caller,
-        ORIGIN: batch.origin,
-        CALLVALUE: batch.callvalue,
-        GASPRICE: batch.gasprice,
-        TIMESTAMP: batch.timestamp,
-        NUMBER: batch.number,
-        COINBASE: batch.coinbase,
-        DIFFICULTY: batch.difficulty,
-        GASLIMIT: batch.gaslimit,
-        CHAINID: batch.chainid,
-        BASEFEE: batch.basefee,
-        SELFBALANCE: batch.balance,
-        CALLDATASIZE: cds_word,
-        CODESIZE: csize_word,
-        RETURNDATASIZE: zero_w,
-        MSIZE: msize_word,
-        PC: pc_word,
-        GAS: gas_word,
-    }
+    env_pushes = {}
+    if _on(phases, "env_tx"):
+        env_pushes.update({
+            ADDRESS: batch.address,
+            CALLER: batch.caller,
+            ORIGIN: batch.origin,
+            CALLVALUE: batch.callvalue,
+            GASPRICE: batch.gasprice,
+            SELFBALANCE: batch.balance,
+        })
+    if _on(phases, "env_block"):
+        env_pushes.update({
+            TIMESTAMP: batch.timestamp,
+            NUMBER: batch.number,
+            COINBASE: batch.coinbase,
+            DIFFICULTY: batch.difficulty,
+            GASLIMIT: batch.gaslimit,
+            CHAINID: batch.chainid,
+            BASEFEE: batch.basefee,
+        })
+    if _on(phases, "env_info"):
+        gas_word = jnp.zeros((n, W), jnp.uint32)
+        gas_word = gas_word.at[:, 0].set(gas_left & 0xFFFF)
+        gas_word = gas_word.at[:, 1].set(gas_left >> 16)
+        msize_word = jnp.zeros((n, W), jnp.uint32)
+        msize_bytes = (msize * 32).astype(jnp.uint32)
+        msize_word = msize_word.at[:, 0].set(msize_bytes & 0xFFFF)
+        msize_word = msize_word.at[:, 1].set(msize_bytes >> 16)
+        pc_word = jnp.zeros((n, W), jnp.uint32)
+        pc_word = pc_word.at[:, 0].set(batch.pc.astype(jnp.uint32) & 0xFFFF)
+        pc_word = pc_word.at[:, 1].set(batch.pc.astype(jnp.uint32) >> 16)
+        cds_word = jnp.zeros((n, W), jnp.uint32)
+        cds_word = cds_word.at[:, 0].set(
+            batch.calldatasize.astype(jnp.uint32))
+        csize_word = jnp.zeros((n, W), jnp.uint32)
+        csize_word = csize_word.at[:, 0].set(code_len.astype(jnp.uint32))
+        env_pushes.update({
+            CALLDATASIZE: cds_word,
+            CODESIZE: csize_word,
+            RETURNDATASIZE: zero_w,
+            MSIZE: msize_word,
+            PC: pc_word,
+            GAS: gas_word,
+        })
     for byte_, val in env_pushes.items():
         res_val, res_mask = put(res_val, res_mask, ex & (op == byte_), val)
 
-    # BALANCE: own account -> balance, anything else -> 0 (no world state
-    # on device; the symbolic engine handles foreign accounts)
-    bal_mask = ex & (op == BALANCE)
-    res_val, res_mask = put(
-        res_val, res_mask, bal_mask,
-        _m(u256.eq(a, batch.address), batch.balance, zero_w))
-    # BLOCKHASH: zero (reference returns a symbol; concolic tests skip it)
-    res_val, res_mask = put(res_val, res_mask, ex & (op == BLOCKHASH), zero_w)
+    if _on(phases, "env_tx"):
+        # BALANCE: own account -> balance, anything else -> 0 (no world
+        # state on device; the symbolic engine handles foreign accounts)
+        bal_mask = ex & (op == BALANCE)
+        res_val, res_mask = put(
+            res_val, res_mask, bal_mask,
+            _m(u256.eq(a, batch.address), batch.balance, zero_w))
+    if _on(phases, "env_block"):
+        # BLOCKHASH: zero (reference returns a symbol; concolic tests
+        # skip it)
+        res_val, res_mask = put(
+            res_val, res_mask, ex & (op == BLOCKHASH), zero_w)
+
+    # top-of-stack as an i32 offset: CALLDATALOAD's operand, and the
+    # memory/hash/log/halt phases' window base — computed once for all
+    off_i, off_big = _word_to_i32(a)
 
     # ---- CALLDATALOAD ----------------------------------------------------
-    cdl_mask = ex & (op == CALLDATALOAD)
-    off_i, off_big = _word_to_i32(a)
-    cd_idx = jnp.clip(off_i[:, None], 0, cd_cap) + jnp.arange(32)[None, :]
-    cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < cd_cap)
-    if _peek_einsum():
-        # same contraction trick as the stack peek: the 32-byte window
-        # read becomes a one-hot [n,32,C]x[n,C] reduction
-        cd_onehot = (
-            jnp.clip(cd_idx, 0, cd_cap - 1)[:, :, None]
-            == jnp.arange(cd_cap)[None, None, :]
-        ).astype(batch.calldata.dtype)
-        cd_bytes = jnp.einsum("nkc,nc->nk", cd_onehot, batch.calldata)
-    else:
-        cd_bytes = jnp.take_along_axis(
-            batch.calldata, jnp.clip(cd_idx, 0, cd_cap - 1), axis=1)
-    cd_bytes = jnp.where(cd_in, cd_bytes, 0).astype(jnp.uint32)
-    cd_word = u256.bytes_to_word(cd_bytes)
-    res_val, res_mask = put(
-        res_val, res_mask, cdl_mask, _m(off_big, zero_w, cd_word))
+    if _on(phases, "calldataload"):
+        cdl_mask = ex & (op == CALLDATALOAD)
+        cd_idx = jnp.clip(off_i[:, None], 0, cd_cap) + jnp.arange(32)[None, :]
+        cd_in = (cd_idx < batch.calldatasize[:, None]) & (cd_idx < cd_cap)
+        if _peek_einsum():
+            # same contraction trick as the stack peek: the 32-byte
+            # window read becomes a one-hot [n,32,C]x[n,C] reduction
+            cd_onehot = (
+                jnp.clip(cd_idx, 0, cd_cap - 1)[:, :, None]
+                == jnp.arange(cd_cap)[None, None, :]
+            ).astype(batch.calldata.dtype)
+            cd_bytes = jnp.einsum("nkc,nc->nk", cd_onehot, batch.calldata)
+        else:
+            cd_bytes = jnp.take_along_axis(
+                batch.calldata, jnp.clip(cd_idx, 0, cd_cap - 1), axis=1)
+        cd_bytes = jnp.where(cd_in, cd_bytes, 0).astype(jnp.uint32)
+        cd_word = u256.bytes_to_word(cd_bytes)
+        res_val, res_mask = put(
+            res_val, res_mask, cdl_mask, _m(off_big, zero_w, cd_word))
 
     # ---- PUSHn -----------------------------------------------------------
     push_mask = ex & (op >= 0x60) & (op <= 0x7F)
@@ -637,18 +820,19 @@ def step(batch: StateBatch, code: CodeTable,
         return msize, gmin, gmax, status, mask & ~over
 
     # ---- SHA3 (gated) ----------------------------------------------------
-    sha_mask = ex & (op == SHA3)
-    len_i, len_big = _word_to_i32(b)
-    sha_off = jnp.where(off_big, BIGOFF, off_i)
-    sha_len = jnp.where(len_big, BIGOFF, len_i)
-    # charge memory expansion over the hashed range first (reference:
-    # sha3_ extends via mem_extend before hashing) — unaffordable huge
-    # ranges OOG; affordable-but-over-cap goes back to the host engine
-    msize, gas_dyn_min, gas_dyn_max, status, sha_exp_ok = expand(
-        sha_mask, sha_off, sha_len, msize, gas_dyn_min, gas_dyn_max,
-        status, over_status=Status.UNSUPPORTED)
-    sha_toobig = sha_exp_ok & (sha_len > HASH_CAP)
-    sha_ok = sha_exp_ok & ~sha_toobig
+    sha_mask = ex & (op == SHA3) if _on(phases, "sha3") else None
+    if sha_mask is not None:
+        len_i, len_big = _word_to_i32(b)
+        sha_off = jnp.where(off_big, BIGOFF, off_i)
+        sha_len = jnp.where(len_big, BIGOFF, len_i)
+        # charge memory expansion over the hashed range first (reference:
+        # sha3_ extends via mem_extend before hashing) — unaffordable huge
+        # ranges OOG; affordable-but-over-cap goes back to the host engine
+        msize, gas_dyn_min, gas_dyn_max, status, sha_exp_ok = expand(
+            sha_mask, sha_off, sha_len, msize, gas_dyn_min, gas_dyn_max,
+            status, over_status=Status.UNSUPPORTED)
+        sha_toobig = sha_exp_ok & (sha_len > HASH_CAP)
+        sha_ok = sha_exp_ok & ~sha_toobig
 
     def do_sha3(args):
         res_val, res_mask = args
@@ -710,158 +894,180 @@ def step(batch: StateBatch, code: CodeTable,
         word = u256.bytes_to_word(digest)
         return put(res_val, res_mask, sha_ok, word)
 
-    res_val, res_mask = _gate(jnp.any(sha_mask), do_sha3, (res_val, res_mask))
-    # affordable inputs beyond the device hash cap go back to the host
-    status = jnp.where(sha_toobig, Status.UNSUPPORTED, status)
-    sha_words = jnp.where(sha_ok, (len_i + 31) // 32, 0).astype(jnp.uint32)
-    gas_dyn_min = gas_dyn_min + 6 * sha_words
-    gas_dyn_max = gas_dyn_max + 6 * sha_words
+    if sha_mask is not None:
+        res_val, res_mask = _gate(
+            jnp.any(sha_mask), do_sha3, (res_val, res_mask))
+        # affordable inputs beyond the device hash cap go to the host
+        status = jnp.where(sha_toobig, Status.UNSUPPORTED, status)
+        sha_words = jnp.where(
+            sha_ok, (len_i + 31) // 32, 0).astype(jnp.uint32)
+        gas_dyn_min = gas_dyn_min + 6 * sha_words
+        gas_dyn_max = gas_dyn_max + 6 * sha_words
 
     # ---- memory ----------------------------------------------------------
-    mload_mask = ex & (op == MLOAD)
-    msize, gas_dyn_min, gas_dyn_max, status, mload_ok = expand(
-        mload_mask, jnp.where(off_big, BIGOFF, off_i), 32,
-        msize, gas_dyn_min, gas_dyn_max, status)
+    if _on(phases, "mload"):
+        mload_mask = ex & (op == MLOAD)
+        msize, gas_dyn_min, gas_dyn_max, status, mload_ok = expand(
+            mload_mask, jnp.where(off_big, BIGOFF, off_i), 32,
+            msize, gas_dyn_min, gas_dyn_max, status)
 
-    def do_mload(args):
-        res_val, res_mask = args
-        idx = jnp.clip(off_i, 0, mem_cap - 32)[:, None] + jnp.arange(32)[None, :]
-        byts = jnp.take_along_axis(mem, idx, axis=1).astype(jnp.uint32)
-        return put(res_val, res_mask, mload_ok, u256.bytes_to_word(byts))
+        def do_mload(args):
+            res_val, res_mask = args
+            idx = (
+                jnp.clip(off_i, 0, mem_cap - 32)[:, None]
+                + jnp.arange(32)[None, :]
+            )
+            byts = jnp.take_along_axis(mem, idx, axis=1).astype(jnp.uint32)
+            return put(res_val, res_mask, mload_ok, u256.bytes_to_word(byts))
 
-    res_val, res_mask = _gate(jnp.any(mload_ok), do_mload, (res_val, res_mask))
+        res_val, res_mask = _gate(
+            jnp.any(mload_ok), do_mload, (res_val, res_mask))
 
-    mstore_mask = ex & (op == MSTORE)
-    msize, gas_dyn_min, gas_dyn_max, status, mstore_ok = expand(
-        mstore_mask, jnp.where(off_big, BIGOFF, off_i), 32,
-        msize, gas_dyn_min, gas_dyn_max, status)
+    if _on(phases, "mstore"):
+        mstore_mask = ex & (op == MSTORE)
+        msize, gas_dyn_min, gas_dyn_max, status, mstore_ok = expand(
+            mstore_mask, jnp.where(off_big, BIGOFF, off_i), 32,
+            msize, gas_dyn_min, gas_dyn_max, status)
 
-    def do_mstore(mem):
-        j = jnp.arange(mem_cap)[None, :]
-        rel = j - off_i[:, None]
-        inw = (rel >= 0) & (rel < 32) & mstore_ok[:, None]
-        wbytes = u256.word_to_bytes(b)  # [n, 32]
-        src = jnp.take_along_axis(
-            wbytes, jnp.clip(rel, 0, 31).astype(jnp.int32), axis=1)
-        return jnp.where(inw, src, mem)
+        def do_mstore(mem):
+            j = jnp.arange(mem_cap)[None, :]
+            rel = j - off_i[:, None]
+            inw = (rel >= 0) & (rel < 32) & mstore_ok[:, None]
+            wbytes = u256.word_to_bytes(b)  # [n, 32]
+            src = jnp.take_along_axis(
+                wbytes, jnp.clip(rel, 0, 31).astype(jnp.int32), axis=1)
+            return jnp.where(inw, src, mem)
 
-    mem = _gate(jnp.any(mstore_ok), do_mstore, mem)
+        mem = _gate(jnp.any(mstore_ok), do_mstore, mem)
 
-    m8_mask = ex & (op == MSTORE8)
-    msize, gas_dyn_min, gas_dyn_max, status, m8_ok = expand(
-        m8_mask, jnp.where(off_big, BIGOFF, off_i), 1,
-        msize, gas_dyn_min, gas_dyn_max, status)
+    if _on(phases, "mstore8"):
+        m8_mask = ex & (op == MSTORE8)
+        msize, gas_dyn_min, gas_dyn_max, status, m8_ok = expand(
+            m8_mask, jnp.where(off_big, BIGOFF, off_i), 1,
+            msize, gas_dyn_min, gas_dyn_max, status)
 
-    def do_mstore8(mem):
-        j = jnp.arange(mem_cap)[None, :]
-        hit = (j == off_i[:, None]) & m8_ok[:, None]
-        return jnp.where(hit, (b[:, 0] & 0xFF).astype(jnp.uint8)[:, None], mem)
+        def do_mstore8(mem):
+            j = jnp.arange(mem_cap)[None, :]
+            hit = (j == off_i[:, None]) & m8_ok[:, None]
+            return jnp.where(
+                hit, (b[:, 0] & 0xFF).astype(jnp.uint8)[:, None], mem)
 
-    mem = _gate(jnp.any(m8_ok), do_mstore8, mem)
+        mem = _gate(jnp.any(m8_ok), do_mstore8, mem)
 
     # ---- CALLDATACOPY / CODECOPY (gated) ---------------------------------
-    copy_mask = ex & ((op == CALLDATACOPY) | (op == CODECOPY))
-    dst_i, dst_big = _word_to_i32(a)
-    src_i, src_big = _word_to_i32(b)
-    cplen_i, cplen_big = _word_to_i32(c)
-    # a huge source offset is legal: reads past the data are zeros
-    src_i = jnp.where(src_big, BIGOFF, src_i)
-    msize, gas_dyn_min, gas_dyn_max, status, copy_ok = expand(
-        copy_mask,
-        jnp.where(dst_big, BIGOFF, dst_i),
-        jnp.where(cplen_big, BIGOFF, cplen_i),
-        msize, gas_dyn_min, gas_dyn_max, status)
-    copy_words = jnp.where(copy_ok, (cplen_i + 31) // 32, 0).astype(jnp.uint32)
-    gas_dyn_min = gas_dyn_min + 3 * copy_words
-    gas_dyn_max = gas_dyn_max + 3 * copy_words
+    if _on(phases, "copy"):
+        copy_mask = ex & ((op == CALLDATACOPY) | (op == CODECOPY))
+        dst_i, dst_big = _word_to_i32(a)
+        src_i, src_big = _word_to_i32(b)
+        cplen_i, cplen_big = _word_to_i32(c)
+        # a huge source offset is legal: reads past the data are zeros
+        src_i = jnp.where(src_big, BIGOFF, src_i)
+        msize, gas_dyn_min, gas_dyn_max, status, copy_ok = expand(
+            copy_mask,
+            jnp.where(dst_big, BIGOFF, dst_i),
+            jnp.where(cplen_big, BIGOFF, cplen_i),
+            msize, gas_dyn_min, gas_dyn_max, status)
+        copy_words = jnp.where(
+            copy_ok, (cplen_i + 31) // 32, 0).astype(jnp.uint32)
+        gas_dyn_min = gas_dyn_min + 3 * copy_words
+        gas_dyn_max = gas_dyn_max + 3 * copy_words
 
-    def do_copy(mem):
-        j = jnp.arange(mem_cap)[None, :]
-        rel = j - dst_i[:, None]
-        inw = (rel >= 0) & (rel < cplen_i[:, None]) & copy_ok[:, None]
-        sidx = src_i[:, None] + rel
-        # calldata source
-        cd_ok = (sidx >= 0) & (sidx < batch.calldatasize[:, None]) & (sidx < cd_cap)
-        from_cd = jnp.take_along_axis(
-            batch.calldata, jnp.clip(sidx, 0, cd_cap - 1), axis=1)
-        from_cd = jnp.where(cd_ok, from_cd, 0)
-        # code source
-        co_ok = (sidx >= 0) & (sidx < code_len[:, None])
-        from_co = code.ops[
-            batch.code_id[:, None],
-            jnp.clip(sidx, 0, code.ops.shape[1] - 1)]
-        from_co = jnp.where(co_ok, from_co, 0)
-        src = jnp.where((op == CALLDATACOPY)[:, None], from_cd, from_co)
-        return jnp.where(inw, src, mem)
+        def do_copy(mem):
+            j = jnp.arange(mem_cap)[None, :]
+            rel = j - dst_i[:, None]
+            inw = (rel >= 0) & (rel < cplen_i[:, None]) & copy_ok[:, None]
+            sidx = src_i[:, None] + rel
+            # calldata source
+            cd_ok = (
+                (sidx >= 0) & (sidx < batch.calldatasize[:, None])
+                & (sidx < cd_cap)
+            )
+            from_cd = jnp.take_along_axis(
+                batch.calldata, jnp.clip(sidx, 0, cd_cap - 1), axis=1)
+            from_cd = jnp.where(cd_ok, from_cd, 0)
+            # code source
+            co_ok = (sidx >= 0) & (sidx < code_len[:, None])
+            from_co = code.ops[
+                batch.code_id[:, None],
+                jnp.clip(sidx, 0, code.ops.shape[1] - 1)]
+            from_co = jnp.where(co_ok, from_co, 0)
+            src = jnp.where((op == CALLDATACOPY)[:, None], from_cd, from_co)
+            return jnp.where(inw, src, mem)
 
-    mem = _gate(jnp.any(copy_ok), do_copy, mem)
+        mem = _gate(jnp.any(copy_ok), do_copy, mem)
 
     # ---- storage (gated) -------------------------------------------------
-    sload_mask = ex & (op == SLOAD)
+    if _on(phases, "sload"):
+        sload_mask = ex & (op == SLOAD)
 
-    def do_sload(args):
-        res_val, res_mask = args
-        s_cap = skeys.shape[1]
-        hit = jnp.all(skeys == a[:, None, :], axis=-1)  # [n, S]
-        hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
-        any_hit = jnp.any(hit, axis=-1)
-        last = jnp.argmax(
-            jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
-        if _peek_einsum():
-            # one-hot contraction instead of a gather (same trick as
-            # the stack peek)
-            oh = (
-                jnp.arange(s_cap)[None, :] == last[:, None]
-            ).astype(svals.dtype)
-            val = jnp.einsum("ns,nsw->nw", oh, svals)
-        else:
-            val = jnp.take_along_axis(
-                svals, last[:, None, None], axis=1)[:, 0, :]
-        val = _m(any_hit, val, jnp.zeros_like(val))
-        return put(res_val, res_mask, sload_mask, val)
+        def do_sload(args):
+            res_val, res_mask = args
+            s_cap = skeys.shape[1]
+            hit = jnp.all(skeys == a[:, None, :], axis=-1)  # [n, S]
+            hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
+            any_hit = jnp.any(hit, axis=-1)
+            last = jnp.argmax(
+                jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+            if _peek_einsum():
+                # one-hot contraction instead of a gather (same trick
+                # as the stack peek)
+                oh = (
+                    jnp.arange(s_cap)[None, :] == last[:, None]
+                ).astype(svals.dtype)
+                val = jnp.einsum("ns,nsw->nw", oh, svals)
+            else:
+                val = jnp.take_along_axis(
+                    svals, last[:, None, None], axis=1)[:, 0, :]
+            val = _m(any_hit, val, jnp.zeros_like(val))
+            return put(res_val, res_mask, sload_mask, val)
 
-    res_val, res_mask = _gate(jnp.any(sload_mask), do_sload, (res_val, res_mask))
+        res_val, res_mask = _gate(
+            jnp.any(sload_mask), do_sload, (res_val, res_mask))
 
-    sstore_mask = ex & (op == SSTORE)
+    if _on(phases, "sstore"):
+        sstore_mask = ex & (op == SSTORE)
 
-    def do_sstore(args):
-        skeys, svals, scnt, status = args
-        s_cap = skeys.shape[1]
-        hit = jnp.all(skeys == a[:, None, :], axis=-1)
-        hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
-        any_hit = jnp.any(hit, axis=-1)
-        last = jnp.argmax(jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
-        slot = jnp.where(any_hit, last, scnt)
-        full = sstore_mask & ~any_hit & (scnt >= s_cap)
-        write = sstore_mask & ~full
-        oh = (jnp.arange(s_cap)[None, :] == slot[:, None]) & write[:, None]
-        skeys = jnp.where(oh[:, :, None], a[:, None, :], skeys)
-        svals = jnp.where(oh[:, :, None], b[:, None, :], svals)
-        scnt = jnp.where(write & ~any_hit, scnt + 1, scnt)
-        status = jnp.where(full, Status.ERR_MEM, status)
-        return skeys, svals, scnt, status
+        def do_sstore(args):
+            skeys, svals, scnt, status = args
+            s_cap = skeys.shape[1]
+            hit = jnp.all(skeys == a[:, None, :], axis=-1)
+            hit = hit & (jnp.arange(s_cap)[None, :] < scnt[:, None])
+            any_hit = jnp.any(hit, axis=-1)
+            last = jnp.argmax(
+                jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+            slot = jnp.where(any_hit, last, scnt)
+            full = sstore_mask & ~any_hit & (scnt >= s_cap)
+            write = sstore_mask & ~full
+            oh = (jnp.arange(s_cap)[None, :] == slot[:, None]) & write[:, None]
+            skeys = jnp.where(oh[:, :, None], a[:, None, :], skeys)
+            svals = jnp.where(oh[:, :, None], b[:, None, :], svals)
+            scnt = jnp.where(write & ~any_hit, scnt + 1, scnt)
+            status = jnp.where(full, Status.ERR_MEM, status)
+            return skeys, svals, scnt, status
 
-    skeys, svals, scnt, status = _gate(
-        jnp.any(sstore_mask), do_sstore, (skeys, svals, scnt, status))
+        skeys, svals, scnt, status = _gate(
+            jnp.any(sstore_mask), do_sstore, (skeys, svals, scnt, status))
 
     # ---- LOGn: pure pops (topics + data range) ---------------------------
-    log_mask = ex & (op >= 0xA0) & (op <= 0xA4)
-    log_len_i, log_len_big = _word_to_i32(b)
-    msize, gas_dyn_min, gas_dyn_max, status, log_ok = expand(
-        log_mask,
-        jnp.where(off_big, BIGOFF, off_i),
-        jnp.where(log_len_big, BIGOFF, log_len_i),
-        msize, gas_dyn_min, gas_dyn_max, status)
-    gas_dyn_min = gas_dyn_min + jnp.where(
-        log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
-    gas_dyn_max = gas_dyn_max + jnp.where(
-        log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
+    if _on(phases, "logs"):
+        log_mask = ex & (op >= 0xA0) & (op <= 0xA4)
+        log_len_i, log_len_big = _word_to_i32(b)
+        msize, gas_dyn_min, gas_dyn_max, status, log_ok = expand(
+            log_mask,
+            jnp.where(off_big, BIGOFF, off_i),
+            jnp.where(log_len_big, BIGOFF, log_len_i),
+            msize, gas_dyn_min, gas_dyn_max, status)
+        gas_dyn_min = gas_dyn_min + jnp.where(
+            log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
+        gas_dyn_max = gas_dyn_max + jnp.where(
+            log_ok, 8 * log_len_i.astype(jnp.uint32), 0)
 
     # ---- halts -----------------------------------------------------------
     stop_mask = ex & (op == STOP)
     status = jnp.where(stop_mask, Status.STOPPED, status)
-    kill_mask = ex & (op == SELFDESTRUCT)
-    status = jnp.where(kill_mask, Status.KILLED, status)
+    if _on(phases, "selfdestruct"):
+        kill_mask = ex & (op == SELFDESTRUCT)
+        status = jnp.where(kill_mask, Status.KILLED, status)
 
     retrev_mask = ex & ((op == RETURN) | (op == REVERT))
     rr_len_i, rr_len_big = _word_to_i32(b)

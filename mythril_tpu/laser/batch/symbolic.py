@@ -63,7 +63,7 @@ from mythril_tpu.laser.batch.state import (
     Status,
     make_batch,
 )
-from mythril_tpu.laser.batch.step import _word_to_i32, step
+from mythril_tpu.laser.batch.step import PhaseSet, _on, _word_to_i32, step
 from mythril_tpu.ops import u256
 from mythril_tpu.support.opcodes import OPCODES
 
@@ -268,8 +268,32 @@ def _scatter2(tids, idx, val, mask):
     return jnp.where(hit, val[:, None], tids)
 
 
-def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
-    """One instruction on every lane, with the symbolic shadow pass."""
+@functools.lru_cache(maxsize=None)
+def _env_leaf_table(names) -> np.ndarray:
+    """bool[256] of the env-leaf ops a specialized kernel keeps."""
+    table = np.zeros(256, dtype=bool)
+    for name in names:
+        table[_B[name]] = True
+    return table
+
+
+def _kept_env_leaves(phases):
+    """ENV_LEAF_OPS restricted to the phases this kernel lowers
+    (ORIGIN rides env_tx, the block attributes ride env_block)."""
+    return tuple(
+        name
+        for name in ENV_LEAF_OPS
+        if _on(phases, "env_tx" if name == "ORIGIN" else "env_block")
+    )
+
+
+def sym_step(symb: SymBatch, code: CodeTable, phases=None) -> SymBatch:
+    """One instruction on every lane, with the symbolic shadow pass.
+
+    `phases` (step.PhaseSet, a static jit argument) prunes handler
+    phases from BOTH the concrete kernel and this shadow pass at trace
+    time — the specialization layer (specialize.py) derives it from
+    the static summary's reachable-opcode signature. None = generic."""
     pre = symb.base
     n = pre.pc.shape[0]
     mem_cap = pre.mem.shape[1]
@@ -311,7 +335,7 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     dup_tid, swap_deep_tid = tids[:, 3], tids[:, 4]
 
     # --- run the concrete kernel --------------------------------------
-    post = step(pre, code)
+    post = step(pre, code, phases=phases)
     # A lane the kernel demoted mid-step (capacity / conditional
     # support -> UNSUPPORTED/ERR_MEM) executed nothing: the host will
     # re-run the instruction from the untouched concrete state, so
@@ -341,10 +365,17 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     # through mixed opaque/symbolic expressions
     mk_node = bin_sym | (un_sym & un_ok) | cdl_clean
     # environment leaves (see ENV_LEAF_OPS): a row whose decode is the
-    # pinned concrete value; operands forced to 0 below
-    mk_env = ex & (meta[:, 8] != 0)
+    # pinned concrete value; operands forced to 0 below. A specialized
+    # kernel keeps only the leaves whose env phase it lowers.
+    kept_leaves = _kept_env_leaves(phases)
+    if len(kept_leaves) == len(ENV_LEAF_OPS):
+        mk_env = ex & (meta[:, 8] != 0)
+    elif kept_leaves:
+        mk_env = ex & jnp.asarray(_env_leaf_table(kept_leaves))[op]
+    else:
+        mk_env = jnp.zeros_like(ex)
     env_val = jnp.zeros_like(a_val)
-    for _env_name in ENV_LEAF_OPS:
+    for _env_name in kept_leaves:
         env_val = jnp.where(
             (op == _B[_env_name])[:, None],
             getattr(pre, _env_name.lower()),
@@ -353,25 +384,36 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     tainted_top3 = (a_tid != 0) | (b_tid != 0) | (c_tid != 0)
     is_callf = meta[:, 6] != 0
     # a call's success push depends on its operands AND on the balance,
-    # which an earlier tainted transfer may have made path-dependent
-    mk_opaque = (
+    # which an earlier tainted transfer may have made path-dependent.
+    # Phase-pruned terms drop out of the merge at trace time (their
+    # ops degrade to UNSUPPORTED in the concrete kernel and never
+    # execute).
+    opaque_terms = [un_sym & ~un_ok]
+    if _on(phases, "modops"):
         # (binops over opaque operands now make rows — see mk_node)
-        (un_sym & ~un_ok)
-        | (ex & is_ter & tainted_top3)
-        | (ex & is_cdl & (a_tid != 0))
-        | (ex & is_callf & (tainted_top3 | (symb.balance_tid != 0)))
-        | (ex & (op == EXTCODESIZE_B) & (a_tid != 0))
-    )
+        opaque_terms.append(ex & is_ter & tainted_top3)
+    if _on(phases, "calldataload"):
+        opaque_terms.append(ex & is_cdl & (a_tid != 0))
+    if _on(phases, "calls"):
+        opaque_terms.append(
+            ex & is_callf & (tainted_top3 | (symb.balance_tid != 0))
+        )
+    if _on(phases, "extcodesize"):
+        opaque_terms.append(ex & (op == EXTCODESIZE_B) & (a_tid != 0))
+    mk_opaque = functools.reduce(jnp.logical_or, opaque_terms)
     # (RETURNDATACOPY's zero-length gate needs no shadow case: a
     # tainted length's OTHER branch is an exceptional halt — a dead
     # end that yields no witnesses — so not deriving inputs for it
     # costs completeness nothing the trigger bank would keep.)
     # an outgoing CALL of a tainted value taints the balance itself
-    balance_tid = jnp.where(
-        ex & (op == CALL_B) & ((c_tid != 0) | (symb.balance_tid != 0)),
-        OPAQUE,
-        symb.balance_tid,
-    )
+    if _on(phases, "calls"):
+        balance_tid = jnp.where(
+            ex & (op == CALL_B) & ((c_tid != 0) | (symb.balance_tid != 0)),
+            OPAQUE,
+            symb.balance_tid,
+        )
+    else:
+        balance_tid = symb.balance_tid
 
     # --- memory taints -------------------------------------------------
     # A tainted (symbolic) offset makes the access location itself
@@ -386,68 +428,83 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
 
     # MLOAD: uniform 32-byte window of one tid propagates; mixed or
     # symbolically-addressed reads are opaque
-    mload_m = ex & (op == MLOAD) & ~off_big
-    widx = jnp.clip(off_i, 0, mem_cap - 32)[:, None] + jnp.arange(32)[None, :]
-    wtids = jnp.take_along_axis(mem_tid, widx, axis=1)
-    w_first = wtids[:, 0]
-    w_uniform = jnp.all(wtids == w_first[:, None], axis=1)
-    w_any = jnp.any(wtids != 0, axis=1)
-    mload_prop = mload_m & w_uniform & ~off_sym
-    mload_opq = mload_m & ((~w_uniform & w_any) | (off_sym & w_any))
-    mk_opaque = mk_opaque | mload_opq | (ex & (op == MLOAD) & off_big)
+    mload_prop = None
+    if _on(phases, "mload"):
+        mload_m = ex & (op == MLOAD) & ~off_big
+        widx = (
+            jnp.clip(off_i, 0, mem_cap - 32)[:, None]
+            + jnp.arange(32)[None, :]
+        )
+        wtids = jnp.take_along_axis(mem_tid, widx, axis=1)
+        w_first = wtids[:, 0]
+        w_uniform = jnp.all(wtids == w_first[:, None], axis=1)
+        w_any = jnp.any(wtids != 0, axis=1)
+        mload_prop = mload_m & w_uniform & ~off_sym
+        mload_opq = mload_m & ((~w_uniform & w_any) | (off_sym & w_any))
+        mk_opaque = mk_opaque | mload_opq | (ex & (op == MLOAD) & off_big)
 
     # MSTORE writes the value tid over its window (opaque when the
     # destination is symbolic); MSTORE8 degrades per byte
-    mstore_m = ex & (op == MSTORE) & ~off_big
-    inw32 = (rel >= 0) & (rel < 32) & mstore_m[:, None]
-    st_tid = jnp.where(off_sym & (b_tid != 0), OPAQUE, b_tid)
-    mem_tid = jnp.where(inw32, st_tid[:, None], mem_tid)
-    m8_m = ex & (op == MSTORE8) & ~off_big
-    m8_tid = jnp.where(b_tid != 0, OPAQUE, 0)
-    mem_tid = jnp.where((rel == 0) & m8_m[:, None], m8_tid[:, None], mem_tid)
+    if _on(phases, "mstore"):
+        mstore_m = ex & (op == MSTORE) & ~off_big
+        inw32 = (rel >= 0) & (rel < 32) & mstore_m[:, None]
+        st_tid = jnp.where(off_sym & (b_tid != 0), OPAQUE, b_tid)
+        mem_tid = jnp.where(inw32, st_tid[:, None], mem_tid)
+    if _on(phases, "mstore8"):
+        m8_m = ex & (op == MSTORE8) & ~off_big
+        m8_tid = jnp.where(b_tid != 0, OPAQUE, 0)
+        mem_tid = jnp.where(
+            (rel == 0) & m8_m[:, None], m8_tid[:, None], mem_tid)
 
     # CALLDATACOPY makes the window opaque bytes (byte-granular
     # calldata expressions stay host-side); CODECOPY writes concrete
     # code bytes, which must also CLEAR stale taint over the window
-    cplen_i, _ = _word_to_i32(c_val)
-    ccopy_m = ex & (op == CALLDATACOPY) & ~off_big
-    inc = (rel >= 0) & (rel < cplen_i[:, None]) & ccopy_m[:, None]
-    mem_tid = jnp.where(inc, OPAQUE, mem_tid)
-    codecopy_m = ex & (op == CODECOPY) & ~off_big
-    incc = (rel >= 0) & (rel < cplen_i[:, None]) & codecopy_m[:, None]
-    mem_tid = jnp.where(incc, 0, mem_tid)
+    if _on(phases, "copy"):
+        cplen_i, _ = _word_to_i32(c_val)
+        ccopy_m = ex & (op == CALLDATACOPY) & ~off_big
+        inc = (rel >= 0) & (rel < cplen_i[:, None]) & ccopy_m[:, None]
+        mem_tid = jnp.where(inc, OPAQUE, mem_tid)
+        codecopy_m = ex & (op == CODECOPY) & ~off_big
+        incc = (rel >= 0) & (rel < cplen_i[:, None]) & codecopy_m[:, None]
+        mem_tid = jnp.where(incc, 0, mem_tid)
 
     # SHA3 of a tainted window (or tainted bounds) -> opaque digest
-    sha_m = ex & (op == SHA3) & ~off_big
-    len_i, _ = _word_to_i32(b_val)
-    insh = (rel >= 0) & (rel < len_i[:, None])
-    sha_tainted = sha_m & (
-        jnp.any(jnp.where(insh, mem_tid != 0, False), axis=1)
-        | off_sym
-        | (b_tid != 0)
-    )
-    mk_opaque = mk_opaque | sha_tainted
+    if _on(phases, "sha3"):
+        sha_m = ex & (op == SHA3) & ~off_big
+        len_i, _ = _word_to_i32(b_val)
+        insh = (rel >= 0) & (rel < len_i[:, None])
+        sha_tainted = sha_m & (
+            jnp.any(jnp.where(insh, mem_tid != 0, False), axis=1)
+            | off_sym
+            | (b_tid != 0)
+        )
+        mk_opaque = mk_opaque | sha_tainted
 
     # --- storage taints ------------------------------------------------
     skey_tid, sval_tid = symb.skey_tid, symb.sval_tid
     sload_m = ex & (op == SLOAD)
     sstore_m = ex & (op == SSTORE)
-    s_cap = pre.storage_keys.shape[1]
-    hit = jnp.all(pre.storage_keys == a_val[:, None, :], axis=-1)
-    hit = hit & (jnp.arange(s_cap)[None, :] < pre.storage_cnt[:, None])
-    any_hit = jnp.any(hit, axis=-1)
-    last = jnp.argmax(jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
-    stored_tid = jnp.take_along_axis(sval_tid, last[:, None], axis=1)[:, 0]
-    # a MISS reads initial storage, which the host models as symbolic:
-    # the concrete 0 is just this lane's SAMPLE of it, so the result
-    # is opaque — arithmetic over it must bank (wrap or opaque-site)
-    # events instead of posing as a path constant
-    sload_tid = jnp.where(any_hit, stored_tid, OPAQUE)
-    sload_tid = jnp.where(a_tid != 0, OPAQUE, sload_tid)
-    # SSTORE: mirror the slot choice and record the value/key tids
-    slot = jnp.where(any_hit, last, jnp.clip(pre.storage_cnt, 0, s_cap - 1))
-    sval_tid = _scatter2(sval_tid, slot, b_tid, sstore_m)
-    skey_tid = _scatter2(skey_tid, slot, a_tid, sstore_m)
+    any_hit = None
+    if _on(phases, "sload") or _on(phases, "sstore"):
+        s_cap = pre.storage_keys.shape[1]
+        hit = jnp.all(pre.storage_keys == a_val[:, None, :], axis=-1)
+        hit = hit & (jnp.arange(s_cap)[None, :] < pre.storage_cnt[:, None])
+        any_hit = jnp.any(hit, axis=-1)
+        last = jnp.argmax(
+            jnp.where(hit, jnp.arange(s_cap)[None, :] + 1, 0), axis=-1)
+        stored_tid = jnp.take_along_axis(sval_tid, last[:, None], axis=1)[:, 0]
+        # a MISS reads initial storage, which the host models as
+        # symbolic: the concrete 0 is just this lane's SAMPLE of it, so
+        # the result is opaque — arithmetic over it must bank (wrap or
+        # opaque-site) events instead of posing as a path constant
+        sload_tid = jnp.where(any_hit, stored_tid, OPAQUE)
+        sload_tid = jnp.where(a_tid != 0, OPAQUE, sload_tid)
+    if _on(phases, "sstore"):
+        # SSTORE: mirror the slot choice and record the value/key tids
+        slot = jnp.where(
+            any_hit, last, jnp.clip(pre.storage_cnt, 0, s_cap - 1))
+        sval_tid = _scatter2(sval_tid, slot, b_tid, sstore_m)
+        skey_tid = _scatter2(skey_tid, slot, a_tid, sstore_m)
 
     # --- arena append --------------------------------------------------
     mk_row = mk_node | mk_env
@@ -482,15 +539,21 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     # derived dependence survives ISZERO/NOT chains
     neg_bits_a = jnp.where(a_tid < 0, jnp.clip(-a_tid - 1, 0, 3), 0)
     res_tid = jnp.where(un_sym & ~un_ok, -(1 + neg_bits_a), res_tid)
-    # BLOCKHASH: predictable-var provenance without a leaf (its result
-    # value is block-state we do not model as a constant)
-    res_tid = jnp.where(ex & (op == BLOCKHASH_B), jnp.int32(-3), res_tid)
-    res_tid = jnp.where(mload_prop, w_first, res_tid)
-    res_tid = jnp.where(sload_m, sload_tid, res_tid)
-    # SELFBALANCE reads the (possibly tainted) balance
-    res_tid = jnp.where(
-        ex & (op == SELFBALANCE_B) & (balance_tid != 0), OPAQUE, res_tid
-    )
+    if _on(phases, "env_block"):
+        # BLOCKHASH: predictable-var provenance without a leaf (its
+        # result value is block-state we do not model as a constant)
+        res_tid = jnp.where(
+            ex & (op == BLOCKHASH_B), jnp.int32(-3), res_tid)
+    if mload_prop is not None:
+        res_tid = jnp.where(mload_prop, w_first, res_tid)
+    if _on(phases, "sload"):
+        res_tid = jnp.where(sload_m, sload_tid, res_tid)
+    if _on(phases, "env_tx") and _on(phases, "calls"):
+        # SELFBALANCE reads the (possibly tainted) balance; with calls
+        # pruned the balance can never become tainted at all
+        res_tid = jnp.where(
+            ex & (op == SELFBALANCE_B) & (balance_tid != 0), OPAQUE, res_tid
+        )
 
     # DUP/SWAP move tids with their values (depths pre-gathered in the
     # consolidated peek)
@@ -529,69 +592,85 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     # host confirms exactly from the banked operand values — an extra
     # banked event costs a slot, never a false issue. Only node-backed
     # results bank (ev_tid must support DAG usage tracking).
-    wrap_add = (op == ADD_B) & u256.ult(u256.bit_not(a_val), b_val)
-    wrap_sub = (op == SUB_B) & u256.ult(a_val, b_val)
-    hi_a = jnp.any(a_val[:, W // 2 :] != 0, axis=-1)
-    hi_b = jnp.any(b_val[:, W // 2 :] != 0, axis=-1)
-    nz_a = jnp.any(a_val != 0, axis=-1)
-    nz_b = jnp.any(b_val != 0, axis=-1)
-    wrap_mul = (op == MUL_B) & (hi_a | hi_b) & nz_a & nz_b
-    arith_exec = (
-        ((op == ADD_B) | (op == SUB_B) | (op == MUL_B)) & ex & executed
-    )
-    # A concrete wrap banks REGARDLESS of term-ness: arithmetic over
-    # taint-hashed mapping reads is opaque in the expression language
-    # (the `balances[to] += x` shape), but the wrap still concretely
-    # happened and the lane's input replays it. ev_tid is the result
-    # node when one exists (DAG usage tracking) and 0 otherwise (the
-    # consumer falls back to a static used-check).
-    wrap_evt = (wrap_add | wrap_sub | wrap_mul) & arith_exec
-    # sites WITHOUT a concrete wrap bank as steering targets — those
-    # need decodable operand terms, so they stay node-gated; opaque-
-    # operand sites bank as EV_SITE_OPAQUE (completeness gate)
-    no_wrap = ~(wrap_add | wrap_sub | wrap_mul)
-    # steering sites need DECODABLE operand terms (both non-opaque)
-    site_evt = arith_exec & bin_sym & bin_ok & ok & no_wrap
-    opaque_site = arith_exec & no_wrap & ((a_tid < 0) | (b_tid < 0))
-    wrap_kind = jnp.where(
-        op == ADD_B,
-        EV_WRAP_ADD,
-        jnp.where(op == SUB_B, EV_WRAP_SUB, EV_WRAP_MUL),
-    ).astype(jnp.int32)
-    wrap_kind = jnp.where(site_evt, wrap_kind + 9, wrap_kind)
-    wrap_kind = jnp.where(opaque_site, EV_SITE_OPAQUE, wrap_kind)
+    _false = jnp.zeros((n,), bool)
+    if _on(phases, "arith"):
+        wrap_add = (op == ADD_B) & u256.ult(u256.bit_not(a_val), b_val)
+        wrap_sub = (op == SUB_B) & u256.ult(a_val, b_val)
+        hi_a = jnp.any(a_val[:, W // 2 :] != 0, axis=-1)
+        hi_b = jnp.any(b_val[:, W // 2 :] != 0, axis=-1)
+        nz_a = jnp.any(a_val != 0, axis=-1)
+        nz_b = jnp.any(b_val != 0, axis=-1)
+        wrap_mul = (op == MUL_B) & (hi_a | hi_b) & nz_a & nz_b
+        arith_exec = (
+            ((op == ADD_B) | (op == SUB_B) | (op == MUL_B)) & ex & executed
+        )
+        # A concrete wrap banks REGARDLESS of term-ness: arithmetic over
+        # taint-hashed mapping reads is opaque in the expression language
+        # (the `balances[to] += x` shape), but the wrap still concretely
+        # happened and the lane's input replays it. ev_tid is the result
+        # node when one exists (DAG usage tracking) and 0 otherwise (the
+        # consumer falls back to a static used-check).
+        wrap_evt = (wrap_add | wrap_sub | wrap_mul) & arith_exec
+        # sites WITHOUT a concrete wrap bank as steering targets — those
+        # need decodable operand terms, so they stay node-gated; opaque-
+        # operand sites bank as EV_SITE_OPAQUE (completeness gate)
+        no_wrap = ~(wrap_add | wrap_sub | wrap_mul)
+        # steering sites need DECODABLE operand terms (both non-opaque)
+        site_evt = arith_exec & bin_sym & bin_ok & ok & no_wrap
+        opaque_site = arith_exec & no_wrap & ((a_tid < 0) | (b_tid < 0))
+        wrap_kind = jnp.where(
+            op == ADD_B,
+            EV_WRAP_ADD,
+            jnp.where(op == SUB_B, EV_WRAP_SUB, EV_WRAP_MUL),
+        ).astype(jnp.int32)
+        wrap_kind = jnp.where(site_evt, wrap_kind + 9, wrap_kind)
+        wrap_kind = jnp.where(opaque_site, EV_SITE_OPAQUE, wrap_kind)
+    else:
+        wrap_evt = site_evt = opaque_site = _false
+        wrap_kind = jnp.zeros((n,), jnp.int32)
 
     # Call events: every executed CALL-family site, with target/value
     # term ids + concrete values, the gas operand (saturated to 32
     # bits — detection only compares against the 2300 stipend), and
     # the branch-journal depth at call time (analysis/evidence.py
     # classifies SWC-104/105/107/112).
-    call_kind = meta[:, 7]
-    has_value = meta[:, 9] != 0
-    call_evt = ex & executed & (call_kind != 0)
-    gas32 = (
-        a_val[:, 0].astype(jnp.uint32)
-        | (a_val[:, 1].astype(jnp.uint32) << 16)
-    )
-    gas_sat = jnp.where(
-        jnp.any(a_val[:, 2:] != 0, axis=-1), jnp.uint32(0xFFFFFFFF), gas32
-    )
-    # state access AFTER a gas-forwarding call (reentrancy surface,
-    # state_change_external_calls.py): the flag arms on the call, the
-    # SSTORE/SLOAD event banks the access site
-    forwarding = call_evt & (gas_sat > 2300)
-    state_acc = ex & executed & (symb.call_seen != 0) & (
-        (op == SSTORE) | (op == SLOAD)
-    )
-    call_seen = jnp.where(
-        forwarding, jnp.int32(1), symb.call_seen
-    )
+    if _on(phases, "calls"):
+        call_kind = meta[:, 7]
+        has_value = meta[:, 9] != 0
+        call_evt = ex & executed & (call_kind != 0)
+        gas32 = (
+            a_val[:, 0].astype(jnp.uint32)
+            | (a_val[:, 1].astype(jnp.uint32) << 16)
+        )
+        gas_sat = jnp.where(
+            jnp.any(a_val[:, 2:] != 0, axis=-1), jnp.uint32(0xFFFFFFFF),
+            gas32,
+        )
+        # state access AFTER a gas-forwarding call (reentrancy surface,
+        # state_change_external_calls.py): the flag arms on the call,
+        # the SSTORE/SLOAD event banks the access site
+        forwarding = call_evt & (gas_sat > 2300)
+        state_acc = ex & executed & (symb.call_seen != 0) & (
+            (op == SSTORE) | (op == SLOAD)
+        )
+        call_seen = jnp.where(
+            forwarding, jnp.int32(1), symb.call_seen
+        )
+    else:
+        call_kind = jnp.zeros((n,), jnp.int32)
+        has_value = _false
+        call_evt = state_acc = _false
+        gas_sat = jnp.zeros((n,), jnp.uint32)
+        call_seen = symb.call_seen
     # SLOAD of a never-written slot: the observed CONCRETE key value
     # is what the poisoned-storage carry will seed. The key may be
     # taint-derived (mapping slots hash calldata) — the value is still
     # the one this lane's replayable input reaches, which is all the
     # poison mechanism needs.
-    sload_miss = ex & executed & sload_m & ~any_hit
+    if _on(phases, "sload"):
+        sload_miss = ex & executed & sload_m & ~any_hit
+    else:
+        sload_miss = _false
 
     evt = wrap_evt | site_evt | opaque_site | call_evt | state_acc | sload_miss
     kind = jnp.where(call_evt, call_kind, wrap_kind)
@@ -680,14 +759,20 @@ def sym_step(symb: SymBatch, code: CodeTable) -> SymBatch:
     )
 
 
-def _sym_run_impl(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
+def _sym_run_impl(symb: SymBatch, code: CodeTable, max_steps: int = 2048,
+                  phases=None):
     """Run every lane to halt (or budget) with the symbolic shadow.
 
     Returns (out, steps, active_lane_steps): `steps` is the raw loop
     trip count, `active_lane_steps` counts only lanes that were still
     RUNNING when each step executed — the honest per-wave work metric
     (most lanes halt long before the wave's step budget, so
-    steps * n_lanes overcounts by the halted tail)."""
+    steps * n_lanes overcounts by the halted tail).
+
+    `phases` (static) prunes handler phases at trace time — the
+    specialization layer's loop (specialize.py) additionally
+    interleaves fused substeps; THIS loop is the generic/pruned-only
+    schedule."""
 
     def cond(carry):
         s, i, _active = carry
@@ -698,7 +783,7 @@ def _sym_run_impl(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
         active = active + jnp.sum(
             (s.base.status == Status.RUNNING).astype(jnp.int32)
         )
-        return sym_step(s, code), i + 1, active
+        return sym_step(s, code, phases=phases), i + 1, active
 
     out, steps, active = lax.while_loop(
         cond, body, (symb, jnp.int32(0), jnp.int32(0))
@@ -706,7 +791,8 @@ def _sym_run_impl(symb: SymBatch, code: CodeTable, max_steps: int = 2048):
     return out, steps, active
 
 
-sym_run = functools.partial(jax.jit, static_argnames=("max_steps",))(
+sym_run = functools.partial(
+    jax.jit, static_argnames=("max_steps", "phases"))(
     _sym_run_impl
 )
 #: donated variant for the pipelined wave engine (explore.py): the
@@ -716,7 +802,7 @@ sym_run = functools.partial(jax.jit, static_argnames=("max_steps",))(
 #: the input again (the explorer's dispatch path guarantees this);
 #: gated off on backends without donation support (CPU).
 sym_run_donated = functools.partial(
-    jax.jit, static_argnames=("max_steps",), donate_argnums=(0,)
+    jax.jit, static_argnames=("max_steps", "phases"), donate_argnums=(0,)
 )(_sym_run_impl)
 
 

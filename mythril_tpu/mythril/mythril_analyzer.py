@@ -69,6 +69,7 @@ _GLOBAL_DEFAULTS = dict(
     deterministic_solving=False,
     static_prune=True,
     pipeline=True,
+    specialize=True,
     mesh_devices=None,
 )
 

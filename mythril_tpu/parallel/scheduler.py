@@ -102,6 +102,13 @@ class GroupLedger:
         self.steals = 0  # steal events this group INITIATED
         self.stolen_items = 0  # items this group took from others
         self.victim_items = 0  # items other groups took from this one
+        # per-group kernel-specialization cache accounting: each
+        # chunk's explorer selects its OWN union bucket (the group's
+        # contract subset), so hits/misses attribute to the group
+        # while the compiled kernels live in the process-wide cache
+        self.kernel_hits = 0
+        self.kernel_misses = 0
+        self.spec_fused_steps = 0
 
     def as_dict(self, wall_s: float) -> Dict:
         occupancy = (
@@ -119,6 +126,9 @@ class GroupLedger:
             "steals": self.steals,
             "stolen_items": self.stolen_items,
             "victim_items": self.victim_items,
+            "kernel_hits": self.kernel_hits,
+            "kernel_misses": self.kernel_misses,
+            "spec_fused_steps": self.spec_fused_steps,
             "faults": self.group.failure_domain.faults,
             "degraded_contracts": (
                 self.group.failure_domain.degraded_contracts
@@ -169,6 +179,8 @@ _STATS_MAX = {
     "transactions",
     "waves_inflight_max",
     "pipelined",
+    "specialized",
+    "spec_pruned_phases",
 }
 #: derived ratios recomputed after the merge
 _STATS_DERIVED = {
@@ -399,6 +411,9 @@ class CorpusScheduler:
             led.chunks += 1
             led.waves += stats.get("waves", 0)
             led.device_steps += stats.get("device_steps", 0)
+            led.kernel_hits += stats.get("kernel_cache_hits", 0)
+            led.kernel_misses += stats.get("kernel_cache_misses", 0)
+            led.spec_fused_steps += stats.get("spec_fused_steps", 0)
             led.busy_s += wall
             self._merge_stats(stats)
             budget_now = self._budget_left()

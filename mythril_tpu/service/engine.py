@@ -88,6 +88,8 @@ class ServiceConfig:
         idle_wait_s: float = 0.2,
         pipeline: bool = True,
         devices: int = 1,
+        specialize: bool = True,
+        specialize_warmup: str = "background",
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -119,6 +121,19 @@ class ServiceConfig:
         #: over groups at admission and migrated to idle groups live
         #: (/stats mesh.* counters). 1 = the single-arena engine.
         self.devices = max(1, int(devices or 1))
+        #: contract-specialized step kernels (specialize.py): waves
+        #: dispatch on the engine's monotone union bucket (it widens
+        #: as new phase groups arrive, never narrows — residency churn
+        #: must not churn compiles), cached per bucket and pinned in
+        #: the code LRU. `myth serve --no-specialize` restores the
+        #: generic interpreter.
+        self.specialize = specialize
+        #: how a not-yet-compiled bucket is handled: "background"
+        #: (default — the wave runs GENERIC while a warmup thread
+        #: compiles the bucket off the serving path; no request ever
+        #: pays specialized-compile latency) or "sync" (compile on the
+        #: dispatching wave — deterministic, used by the tests)
+        self.specialize_warmup = specialize_warmup
 
 
 class CodeCache:
@@ -126,8 +141,16 @@ class CodeCache:
     warm path for resubmitted contracts (to_dense is a host-side
     linear sweep, cheap once but not free at service request rates).
     The static summary (analysis/static: CFG + dataflow + prune feed)
-    rides in the same LRU entry beside the disassembly, so a
-    resubmitted contract skips both sweeps."""
+    and the kernel-specialization feed (laser/batch/specialize.py:
+    PhaseSet bucket + per-pc fuse row + a PINNED handle on the
+    bucket's compiled kernel) ride in the same LRU entry beside the
+    disassembly, so a resubmitted contract skips every sweep AND hits
+    an already-compiled contract-specialized kernel.
+
+    Eviction releases the entry's kernel pin: the kernel cache may
+    then drop the bucket's XLA executables (unless another resident
+    contract still pins the same bucket) — a compiled-kernel slot
+    never leaks past its LRU entry."""
 
     def __init__(self, code_cap: int, capacity: int = 64) -> None:
         self.code_cap = code_cap
@@ -137,10 +160,25 @@ class CodeCache:
         self.misses = 0
         self.evictions = 0
         self.static_summaries = 0
+        self.kernels_pinned = 0
+        self.kernels_released = 0
 
     @staticmethod
     def code_hash(code: bytes) -> str:
         return hashlib.sha256(code).hexdigest()
+
+    def _release_kernel(self, entry: list) -> None:
+        """Drop the entry's pin on its specialization bucket (the
+        eviction contract: dense rows and the static summary die with
+        the entry by GC; the compiled kernel must be RELEASED so the
+        kernel cache can drop its live XLA executables too)."""
+        spec = entry[3].get("spec")
+        if spec is not None and spec.get("kernel") is not None:
+            from mythril_tpu.laser.batch.specialize import kernel_cache
+
+            kernel_cache().release(spec["kernel"])
+            spec["kernel"] = None
+            self.kernels_released += 1
 
     def _entry(self, code: bytes) -> list:
         from mythril_tpu.disassembler.asm import to_dense
@@ -155,12 +193,17 @@ class CodeCache:
         ops_row = np.zeros((self.code_cap + 33,), dtype=np.uint8)
         ops, jumpdest = to_dense(code, max_len=self.code_cap)
         ops_row[: self.code_cap] = ops
-        # slot 3 holds the lazily-built static summary (None until
-        # some consumer asks for it)
-        entry = [ops_row, jumpdest, min(len(code), self.code_cap), None]
+        # slot 3 holds the lazily-built derived feeds: the static
+        # summary and the specialization feed (None until a consumer
+        # asks for them)
+        entry = [
+            ops_row, jumpdest, min(len(code), self.code_cap),
+            {"summary": None, "summary_tried": False, "spec": None},
+        ]
         self._rows[key] = entry
         while len(self._rows) > self.capacity:
-            self._rows.popitem(last=False)
+            _k, evicted = self._rows.popitem(last=False)
+            self._release_kernel(evicted)
             self.evictions += 1
         return entry
 
@@ -173,7 +216,9 @@ class CodeCache:
         """The code's StaticSummary from the same LRU entry, built on
         first request; None when the static layer is off or failed."""
         entry = self._entry(code)
-        if entry[3] is None:
+        feeds = entry[3]
+        if feeds["summary"] is None and not feeds["summary_tried"]:
+            feeds["summary_tried"] = True
             try:
                 from mythril_tpu.analysis.static import (
                     static_prune_enabled,
@@ -182,17 +227,51 @@ class CodeCache:
 
                 if not static_prune_enabled():
                     return None
-                entry[3] = summary_for(code)
+                feeds["summary"] = summary_for(code)
                 self.static_summaries += 1
             except Exception:
                 log.debug("static summary failed", exc_info=True)
                 return None
-        return entry[3]
+        return feeds["summary"]
+
+    def spec_for(self, code: bytes) -> Optional[Dict]:
+        """The code's specialization feed from the same LRU entry:
+        {"phases": PhaseSet, "fuse_row": u8[code_cap], "kernel":
+        pinned SpecializedKernel} — built (and the kernel compiled
+        lazily on its first wave) once per resident code hash, so warm
+        resubmissions dispatch with zero compile latency. None when
+        specialization is off or the feed build failed."""
+        entry = self._entry(code)
+        feeds = entry[3]
+        if feeds["spec"] is None:
+            try:
+                from mythril_tpu.laser.batch import specialize as _spec
+
+                if not _spec.specialize_enabled():
+                    return None
+                summary = self.static_summary(code)
+                phases = _spec.phases_for(
+                    _spec.signature_for(code, summary),
+                    fuse=_spec.fuse_profitable(code),
+                )
+                feeds["spec"] = {
+                    "phases": phases,
+                    "fuse_row": _spec.build_fuse_row(code, self.code_cap),
+                    "kernel": _spec.kernel_cache().acquire(phases),
+                }
+                self.kernels_pinned += 1
+            except Exception:
+                log.debug("specialization feed failed", exc_info=True)
+                return None
+        return feeds["spec"]
 
     def rebucket(self, code_cap: int) -> None:
         """Grow the capacity (new kernel shape): cached rows are the
-        old width, so the cache flushes and rebuilds lazily."""
+        old width, so the cache flushes and rebuilds lazily — kernel
+        pins released with their entries."""
         self.code_cap = code_cap
+        for entry in self._rows.values():
+            self._release_kernel(entry)
         self._rows.clear()
 
     def stats(self) -> Dict:
@@ -203,6 +282,8 @@ class CodeCache:
             "misses": self.misses,
             "evictions": self.evictions,
             "static_summaries": self.static_summaries,
+            "kernels_pinned": self.kernels_pinned,
+            "kernels_released": self.kernels_released,
         }
 
 
@@ -212,7 +293,7 @@ class _JobTrack:
 
     def __init__(
         self, job: Job, stripes: List[int], lanes: List[int],
-        calldata_len: int, static_feed=None,
+        calldata_len: int, static_feed=None, spec_feed=None,
     ) -> None:
         import random
 
@@ -226,6 +307,9 @@ class _JobTrack:
         # the static prune feed masks inert selectors out of this
         # job's seeding; per-job drop delta kept for the report
         self.static = static_feed
+        #: the code's specialization feed (CodeCache.spec_for): the
+        #: wave kernel is the union bucket over resident jobs' phases
+        self.spec = spec_feed
         before = static_feed.seeds_dropped if static_feed else 0
         self.seeds = dispatcher_seeds(
             job.code.hex(), calldata_len, prune=static_feed
@@ -378,7 +462,10 @@ class AnalysisEngine:
         self._arena_ops: Optional[np.ndarray] = None
         self._arena_jd: Optional[np.ndarray] = None
         self._arena_len: Optional[np.ndarray] = None
+        self._arena_fuse: Optional[np.ndarray] = None
         self._code_table = None
+        self._fuse_table = None
+        self._group_fuse: Dict = {}
         self._table_dirty = True
         self._rebuild_arena_rows()
         self._lock = threading.Lock()
@@ -398,6 +485,17 @@ class AnalysisEngine:
         self.host_completed = 0
         self.kernel_rebuckets = 0
         self.static_seeds_dropped = 0
+        # kernel-specialization observability (/stats kernel.*)
+        self.spec_waves = 0
+        self.generic_waves = 0
+        self.kernel_fused_steps = 0
+        self.kernel_fallbacks = 0
+        #: the engine's monotone specialization bucket (widens as jobs
+        #: with new phase groups arrive; a wider kernel stays sound
+        #: for every lane) and the warmups already launched for it
+        self._union_phases = None
+        self._kernel_warming: set = set()
+        self._warmup_threads: List[threading.Thread] = []
         # pipeline occupancy/overlap counters (/stats pipeline.*)
         self.pipeline_overlapped = 0
         self.pipeline_multi_job = 0
@@ -474,6 +572,12 @@ class AnalysisEngine:
                     job.degraded.append("interrupted")
                     self._finalize(job, track, outcome, host_result=None)
         self._host_inflight.clear()
+        # in-flight kernel warmups: an XLA compile racing interpreter
+        # teardown aborts the process (std::terminate), so the drain
+        # waits them out (bounded — a compile is seconds, and no new
+        # warmup launches once draining)
+        for thread in self._warmup_threads:
+            thread.join(timeout=60.0)
         self._drained.set()
 
     def close(self) -> None:
@@ -485,6 +589,9 @@ class AnalysisEngine:
         self._arena_ops = np.zeros((rows, self.code_cap + 33), np.uint8)
         self._arena_jd = np.zeros((rows, self.code_cap), bool)
         self._arena_len = np.zeros((rows,), np.int32)
+        # per-row superblock fuse tables (specialize.py): the halt row
+        # stays all-zero (idle lanes never fuse)
+        self._arena_fuse = np.zeros((rows, self.code_cap), np.uint8)
         self._table_dirty = True
 
     def _install_code(self, track: _JobTrack) -> None:
@@ -492,6 +599,11 @@ class AnalysisEngine:
         self._arena_ops[track.code_row] = ops_row
         self._arena_jd[track.code_row] = jd_row
         self._arena_len[track.code_row] = length
+        self._arena_fuse[track.code_row] = (
+            track.spec["fuse_row"]
+            if track.spec is not None
+            else 0
+        )
         self._table_dirty = True
 
     def _ensure_code_cap(self, code: bytes) -> None:
@@ -536,6 +648,11 @@ class AnalysisEngine:
             track = _JobTrack(
                 job, granted, lanes, self.cfg.calldata_len,
                 static_feed=self.code_cache.static_summary(job.code),
+                spec_feed=(
+                    self.code_cache.spec_for(job.code)
+                    if self.cfg.specialize
+                    else None
+                ),
             )
             self.static_seeds_dropped += track.static_seeds_dropped
             self._install_code(track)
@@ -601,8 +718,10 @@ class AnalysisEngine:
                 jnp.asarray(self._arena_jd),
                 jnp.asarray(self._arena_len),
             )
+            self._fuse_table = jnp.asarray(self._arena_fuse)
             self._table_dirty = False
             self._group_tables.clear()
+            self._group_fuse.clear()
         if device is None:
             return self._code_table
         # per-group replica: a group's wave must find its table on its
@@ -615,6 +734,98 @@ class AnalysisEngine:
             cached = jax.device_put(self._code_table, device)
             self._group_tables[device] = cached
         return cached
+
+    def _fuse(self, device=None):
+        """The fuse table matching `_table()` (same dirty lifecycle;
+        `_table()` must have been called first this wave)."""
+        if device is None:
+            return self._fuse_table
+        cached = self._group_fuse.get(device)
+        if cached is None:
+            import jax
+
+            cached = jax.device_put(self._fuse_table, device)
+            self._group_fuse[device] = cached
+        return cached
+
+    def _wave_kernel(self, job_ids, batch, table, donate) -> Optional[Tuple]:
+        """(kernel, phases) for this wave, or None for a generic wave.
+
+        The bucket is the engine's MONOTONE union over every admitted
+        job's phases: residency churn (jobs finishing, new mixes)
+        never narrows it, so the compile count is bounded by the phase
+        flags, not by residency patterns. A bucket whose executable is
+        not yet warm for this dispatch shape is handled per
+        `specialize_warmup`: "background" runs THIS wave generic and
+        compiles off the serving path; "sync" compiles on the wave.
+        Any resident job without a specialization feed makes the wave
+        generic (the striped dispatch is one kernel)."""
+        if not self.cfg.specialize:
+            return None
+        from mythril_tpu.laser.batch import specialize as _spec
+
+        if not _spec.specialize_enabled():
+            return None
+        feeds = []
+        for jid in job_ids:
+            track = self._tracks.get(jid)
+            if track is None or track.spec is None:
+                return None
+            feeds.append(track.spec["phases"])
+        if not feeds:
+            return None
+        if self._union_phases is not None:
+            feeds.append(self._union_phases)
+        self._union_phases = _spec.union_phases(feeds)
+        kernel = _spec.kernel_cache().get(self._union_phases)
+        key = kernel.run_key(batch, table, donate)
+        if kernel.is_warm(key):
+            return kernel, self._union_phases
+        if self.cfg.specialize_warmup == "sync":
+            return kernel, self._union_phases
+        self._warm_kernel_async(kernel, key, batch, table, donate)
+        return None
+
+    def _warm_kernel_async(self, kernel, key, batch, table, donate) -> None:
+        """Compile the bucket for this dispatch shape OFF the serving
+        path: a daemon thread runs the kernel once over a dummy batch
+        of the same shape (all lanes halt on the empty halt row after
+        one step, so the warmup's execution cost is one step — its
+        wall is the compile). At most one warmup per (bucket, shape)."""
+        import jax.numpy as jnp
+
+        from mythril_tpu.laser.batch.state import make_batch
+
+        warm_id = (kernel.phases, key)
+        with self._lock:
+            if self._draining or warm_id in self._kernel_warming:
+                return
+            self._kernel_warming.add(warm_id)
+        n = batch.pc.shape[0]
+        fuse = self._fuse_table
+        steps = self.cfg.steps_per_wave
+
+        def _warm():
+            try:
+                dummy = make_batch(
+                    n,
+                    code_ids=np.full((n,), self.cfg.stripes, np.int32),
+                    mem_cap=batch.mem.shape[1],
+                    stack_cap=batch.stack.shape[1],
+                )
+                out = kernel.run(
+                    dummy, table, fuse, max_steps=steps,
+                    track_coverage=True, donate=donate,
+                )
+                jnp.asarray(out[1]).block_until_ready()
+            except Exception:
+                log.debug("kernel warmup failed", exc_info=True)
+
+        thread = threading.Thread(
+            target=_warm, name="myth-kernel-warmup", daemon=True
+        )
+        self._warmup_threads.append(thread)
+        thread.start()
 
     # -- the wave loop -------------------------------------------------
     def _loop(self) -> None:
@@ -719,6 +930,8 @@ class AnalysisEngine:
             "calldata": calldata,
             "out": None,
             "steps": None,
+            "fused": None,
+            "spec": False,
             "failed": None,
             "t0": time.perf_counter(),
         }
@@ -729,13 +942,30 @@ class AnalysisEngine:
             # the host (retries rebuild it from `calldata`), so the
             # device reuses its buffers for the output. CPU ignores
             # donation with a warning, so gate it.
-            runner = run_donated if jax.default_backend() != "cpu" else run
-            record["out"], record["steps"] = runner(
-                batch,
-                self._table(),
-                max_steps=self.cfg.steps_per_wave,
-                track_coverage=True,
-            )
+            donate = jax.default_backend() != "cpu"
+            table = self._table()
+            spec = self._wave_kernel(wave_inputs, batch, table, donate)
+            if spec is not None:
+                kernel, _phases = spec
+                record["spec"] = True
+                self.spec_waves += 1
+                record["out"], record["steps"], record["fused"] = kernel.run(
+                    batch,
+                    table,
+                    self._fuse(),
+                    max_steps=self.cfg.steps_per_wave,
+                    track_coverage=True,
+                    donate=donate,
+                )
+            else:
+                self.generic_waves += 1
+                runner = run_donated if donate else run
+                record["out"], record["steps"] = runner(
+                    batch,
+                    table,
+                    max_steps=self.cfg.steps_per_wave,
+                    track_coverage=True,
+                )
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -801,16 +1031,42 @@ class AnalysisEngine:
                 "hi": hi,
                 "out": None,
                 "steps": None,
+                "fused": None,
+                "spec": False,
                 "failed": None,
             }
+            # per-group kernel selection: the union bucket over THIS
+            # group's resident jobs only (another group's keccak does
+            # not widen this group's kernel)
+            group_jobs = [
+                jid
+                for jid, gid in record["group_by_job"].items()
+                if gid == group.gid
+            ]
             try:
-                runner = run_donated if donate else run
-                grec["out"], grec["steps"] = runner(
-                    batch,
-                    self._table(device),
-                    max_steps=self.cfg.steps_per_wave,
-                    track_coverage=True,
-                )
+                table = self._table(device)
+                spec = self._wave_kernel(group_jobs, batch, table, donate)
+                if spec is not None:
+                    kernel, _phases = spec
+                    self.spec_waves += 1
+                    grec["spec"] = True
+                    grec["out"], grec["steps"], grec["fused"] = kernel.run(
+                        batch,
+                        table,
+                        self._fuse(device),
+                        max_steps=self.cfg.steps_per_wave,
+                        track_coverage=True,
+                        donate=donate,
+                    )
+                else:
+                    self.generic_waves += 1
+                    runner = run_donated if donate else run
+                    grec["out"], grec["steps"] = runner(
+                        batch,
+                        table,
+                        max_steps=self.cfg.steps_per_wave,
+                        track_coverage=True,
+                    )
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
@@ -888,6 +1144,8 @@ class AnalysisEngine:
             # wave in this record, not to whatever the host was doing
             jax.block_until_ready(record["steps"])
             out, steps = record["out"], record["steps"]
+            if record.get("fused") is not None:
+                self.kernel_fused_steps += int(record["fused"])
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -896,6 +1154,10 @@ class AnalysisEngine:
                 site="service-wave",
                 detail=str(why),
             )
+            if record.get("spec"):
+                # the retry ladder always re-dispatches GENERIC: a
+                # specialized lowering must not be retried into itself
+                self.kernel_fallbacks += 1
             try:
                 out, steps = run_resilient(
                     self._rebuild_batch(record),
@@ -958,6 +1220,8 @@ class AnalysisEngine:
                     raise grec["failed"]
                 jax.block_until_ready(grec["steps"])
                 out, steps = grec["out"], grec["steps"]
+                if grec.get("fused") is not None:
+                    self.kernel_fused_steps += int(grec["fused"])
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
@@ -966,6 +1230,8 @@ class AnalysisEngine:
                     site=f"service-wave/mesh-g{gid}",
                     detail=str(why),
                 )
+                if grec.get("spec"):
+                    self.kernel_fallbacks += 1
                 try:
                     out, steps = run_resilient(
                         jax.device_put(
@@ -1234,6 +1500,33 @@ class AnalysisEngine:
             self.queue.settle(job, JobState.FAILED)
 
     # -- introspection --------------------------------------------------
+    def _kernel_stats(self) -> Dict:
+        """The specialization scorecard (/stats kernel.*): the
+        process-wide compile cache (size, hits, misses, compiles in
+        flight, compile wall) plus this engine's wave split and fused
+        throughput."""
+        from mythril_tpu.laser.batch.specialize import (
+            kernel_cache_stats,
+            specialize_enabled,
+        )
+
+        out = {
+            "enabled": bool(self.cfg.specialize) and specialize_enabled(),
+            "warmup": self.cfg.specialize_warmup,
+            "warmups_launched": len(self._kernel_warming),
+            "spec_waves": self.spec_waves,
+            "generic_waves": self.generic_waves,
+            "fused_steps": self.kernel_fused_steps,
+            "fallbacks": self.kernel_fallbacks,
+            "pinned_codes": self.code_cache.kernels_pinned
+            - self.code_cache.kernels_released,
+        }
+        out.update(kernel_cache_stats())
+        # the cache's own counters under their /stats names
+        out["cache_hits"] = out.pop("hits")
+        out["cache_misses"] = out.pop("misses")
+        return out
+
     def stats(self) -> Dict:
         from mythril_tpu.support.resilience import DegradationLog
 
@@ -1327,6 +1620,7 @@ class AnalysisEngine:
                 "summaries_cached": self.code_cache.static_summaries,
                 "seeds_dropped": self.static_seeds_dropped,
             },
+            "kernel": self._kernel_stats(),
             "host_pool": {
                 "workers": max(1, self.cfg.host_workers),
                 "inflight": len(self._host_inflight),

@@ -42,6 +42,14 @@ class Args(object, metaclass=Singleton):
         # flip-frontier prune. On by default; the flag exists so a
         # suspected wrong prune is one switch away from a differential.
         self.static_prune = True
+        # Kernel specialization (CLI --no-specialize,
+        # laser/batch/specialize.py): per-contract step kernels
+        # compiled from the static layer's reachable-opcode signature
+        # (phase pruning + superblock fusion), cached per
+        # specialization bucket. On by default; the flag restores the
+        # generic interpreter — the differential baseline for a
+        # suspected specialization bug.
+        self.specialize = True
         # Pipelined wave engine (CLI --no-pipeline): double-buffered
         # async wave dispatch — up to two waves in flight, host
         # evidence-consume/flip-solving overlapping device execution,

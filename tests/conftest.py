@@ -28,6 +28,17 @@ os.makedirs("/tmp/mtpu_xla_cache", exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", "/tmp/mtpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# Kernel specialization is OFF by default under the test harness: the
+# product default is on, but every distinct (specialization bucket x
+# arena shape) pays a fresh XLA compile, and the many small contract
+# combinations across the suite would not fit tier-1's 10-minute
+# window on a 1-core host. The dedicated suite
+# (tests/laser/test_specialize.py, `-m specialize`) re-enables it and
+# pins the specialized-vs-generic differentials.
+from mythril_tpu.support.support_args import args as _support_args  # noqa: E402
+
+_support_args.specialize = False
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -62,6 +73,13 @@ def pytest_configure(config):
         "scheduler with work stealing, per-group failure domains, "
         "mesh service) on the 8 simulated host devices this conftest "
         "forces — runs in tier-1, selectable with -m multichip",
+    )
+    config.addinivalue_line(
+        "markers",
+        "specialize: kernel-specialization suite (per-contract step "
+        "kernels: phase pruning, superblock fusion, compile cache, "
+        "CodeCache kernel eviction; CPU-only — runs in tier-1, "
+        "selectable with -m specialize)",
     )
 
 

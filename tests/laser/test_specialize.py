@@ -1,0 +1,389 @@
+"""Per-contract specialized step kernels (ISSUE 6): opcode-set phase
+pruning, superblock fusion, specialization buckets + the compile
+cache, and the service CodeCache's kernel-slot eviction contract.
+
+The acceptance bar: specialized and generic (--no-specialize) kernels
+produce IDENTICAL issue sets on the fault-suite and the per-module
+positive-fixture contracts, the pruning decisions and superblock
+boundaries match goldens, a pruned opcode degrades to UNSUPPORTED
+(never silent mis-execution), and evicting a service CodeCache entry
+releases its compiled kernel. Everything runs on CPU JAX.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.laser.batch import specialize as sp
+from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+from mythril_tpu.laser.batch.run import run
+from mythril_tpu.laser.batch.state import Status, make_batch, make_code_table
+from mythril_tpu.laser.batch.step import PhaseSet
+from mythril_tpu.laser.batch.symbolic import make_sym_batch, sym_run
+from mythril_tpu.support.support_args import args as support_args
+
+pytestmark = pytest.mark.specialize
+
+
+@pytest.fixture(autouse=True)
+def _specialization_on():
+    """The suite tests the feature itself: re-enable the flag the test
+    conftest turns off for tier-1 wall-time (see tests/conftest.py)."""
+    before = support_args.specialize
+    support_args.specialize = True
+    yield
+    support_args.specialize = before
+
+#: the pipeline suite's fault-suite fixtures (same shapes, same seeds)
+WRITER = "6001600055600060015500"
+BRANCHER = "600035600757005b600160005500"
+KILLABLE = "33ff"
+GATED = "60003560f81c604214600d57005b600160005500"
+#: a PUSH/DUP/SWAP-heavy straight line ending in a storage write — the
+#: superblock-fusion showcase
+PUSHY = "600160026003600450809101600055"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _module_fixture_codes():
+    """The per-module positive-fixture bytecodes (every detection
+    module's minimal firing contract), loaded from the fixture suite
+    so the two lists can never drift apart."""
+    path = os.path.join(
+        _REPO, "tests", "analysis", "test_module_positive_fixtures.py"
+    )
+    spec = importlib.util.spec_from_file_location("_module_fixtures", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return [code for code, _swc in mod.FIXTURES.values()]
+
+
+def _fingerprint(contract):
+    return (
+        tuple(map(tuple, contract["covered_branches"])),
+        {
+            kind: tuple(sorted(t["pc"] for t in bucket))
+            for kind, bucket in contract["triggers"].items()
+        },
+        tuple(sorted((e["class"], e["pc"]) for e in contract["evidence"])),
+    )
+
+
+def _explore(codes, specialize, **kw):
+    kw.setdefault("lanes_per_contract", 8)
+    kw.setdefault("waves", 3)
+    kw.setdefault("steps_per_wave", 64)
+    kw.setdefault("transaction_count", 1)
+    ex = DeviceCorpusExplorer(codes, specialize=specialize, **kw)
+    return ex, ex.run()
+
+
+# -- pruning decisions (goldens) ---------------------------------------------
+def test_phase_decision_goldens():
+    """The opcode-set pruning decisions for known bytecodes."""
+    ph = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    # PUSH/SSTORE/STOP only: everything else prunes
+    assert ph.sstore and not ph.sload
+    for flag in ("calls", "sha3", "mload", "mstore", "exp", "div",
+                 "copy", "logs", "selfdestruct", "calldataload"):
+        assert not getattr(ph, flag), flag
+
+    ph = sp.phases_for(sp.signature_for(bytes.fromhex(GATED)))
+    # CALLDATALOAD; SHR; EQ-compare; JUMPI; SSTORE
+    assert ph.calldataload and ph.shifts and ph.cmp and ph.sstore
+    assert not ph.calls and not ph.sha3 and not ph.arith
+
+    ph = sp.phases_for(sp.signature_for(bytes.fromhex(KILLABLE)))
+    assert ph.selfdestruct and ph.env_tx
+    assert not ph.sstore
+
+    # fusion is on by default and off on request
+    assert ph.fuse_depth == sp.FUSE_DEPTH
+    assert sp.phases_for(sp.signature_for(b"\x00"), fuse=False).fuse_depth == 0
+
+
+def test_signature_prefers_static_summary_reachable_set():
+    from mythril_tpu.analysis.static import analyze_bytecode
+
+    # dead code after STOP carries a SHA3 the dispatcher never reaches
+    code = bytes.fromhex("600160005500" + "6020600020")
+    summary = analyze_bytecode(code)
+    sig_static = sp.signature_for(code, summary)
+    sig_sweep = sp.signature_for(code)
+    assert "SHA3" in sig_sweep  # the linear sweep sees the dead tail
+    if not summary.incomplete:
+        assert "SHA3" not in sig_static  # the CFG proves it dead
+
+
+def test_union_phases_covers_every_track():
+    a = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    b = sp.phases_for(sp.signature_for(bytes.fromhex(KILLABLE)))
+    u = sp.union_phases([a, b])
+    assert u.sstore and u.selfdestruct
+    assert not u.sha3
+
+
+# -- superblock boundaries (goldens) -----------------------------------------
+def test_fuse_table_golden_marks_only_fusible_pcs():
+    code = bytes.fromhex(WRITER)
+    row = sp.build_fuse_row(code, 32)
+    # PUSH1s at 0,2,5,7 are fusible; SSTOREs at 4,9 and STOP at 10 not
+    expected = {0, 2, 5, 7}
+    assert {int(i) for i in np.flatnonzero(row)} == expected
+    # immediates are never marked (pc 1,3,6,8 are PUSH data)
+    assert row[1] == 0 and row[3] == 0
+
+
+def test_fuse_profitability_gate():
+    """Fusion switches on only for run-dense code: the substep passes
+    cost every iteration, so sparse-run contracts get pruning-only
+    kernels (the production selection path passes this decision into
+    phases_for)."""
+    assert sp.fuse_profitable(bytes.fromhex(PUSHY))  # 8/10 ops in runs
+    assert sp.fuse_profitable(bytes.fromhex(WRITER))  # paired PUSHes
+    assert not sp.fuse_profitable(bytes.fromhex(KILLABLE))  # no runs
+    assert not sp.fuse_profitable(b"")
+
+
+def test_superblock_boundaries_golden():
+    # PUSHY: PUSH1 x4, DUP1, SWAP2, SWAP1? -> one long run, then
+    # PUSH1 0; SSTORE splits it
+    runs = sp.fuse_run_lengths(bytes.fromhex(PUSHY))
+    # run 1: four PUSH1s + DUP1 + SWAP2 + SWAP1 + ADD? — ADD (0x01) is
+    # NOT fusible, so the first run ends before it
+    assert runs[0][0] == 0 and runs[0][1] == 7
+    # run 2: the PUSH1 0 before SSTORE
+    assert runs[1] == (12, 1)
+
+
+# -- kernel equivalence -------------------------------------------------------
+#: ONE code set + ONE batch shape for both equivalence tests: the
+#: concrete and sym legs then share a single specialization bucket
+#: (the XLA compiles are the suite's wall cost)
+_EQ_CODES = (WRITER, BRANCHER, KILLABLE, GATED, PUSHY)
+
+
+def _eq_setup():
+    codes = [bytes.fromhex(c) for c in _EQ_CODES]
+    table = make_code_table(codes)
+    fuse = jnp.asarray(
+        sp.build_fuse_table(codes, table.ops.shape[1] - 33)
+    )
+    phases = sp.union_phases(
+        [sp.phases_for(sp.signature_for(c)) for c in codes]
+    )
+    batch = make_batch(
+        10, code_ids=[0, 1, 2, 3, 4] * 2, calldata=[b"\x42" * 8] * 10
+    )
+    return table, fuse, phases, batch
+
+
+def test_specialized_concrete_kernel_matches_generic():
+    table, fuse, phases, batch = _eq_setup()
+    g_out, _ = run(batch, table, max_steps=64)
+    kern = sp.kernel_cache().get(phases)
+    s_out, _steps, fused = kern.run(batch, table, fuse, max_steps=64)
+    assert int(fused) > 0  # the fused substeps actually advanced work
+    for i, (x, y) in enumerate(
+        zip(jax.tree.flatten(g_out)[0], jax.tree.flatten(s_out)[0])
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), str(i))
+
+
+def test_specialized_sym_kernel_matches_generic():
+    table, fuse, phases, batch = _eq_setup()
+    g_out, _s, _a = sym_run(make_sym_batch(batch), table, max_steps=64)
+    kern = sp.kernel_cache().get(phases)
+    s_out, _s2, _a2, fused = kern.sym_run(
+        make_sym_batch(batch), table, fuse, max_steps=64
+    )
+    assert int(fused) > 0
+    for i, (x, y) in enumerate(
+        zip(jax.tree.flatten(g_out)[0], jax.tree.flatten(s_out)[0])
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), str(i))
+
+
+def test_pruned_opcode_degrades_to_unsupported_not_silent():
+    """The safety net: a lane reaching an opcode whose phase the
+    kernel pruned parks AT the instruction with UNSUPPORTED (host
+    takeover) — it must never advance past it. (The kernel is WRITER's
+    own tiny bucket with sstore flipped off — a near-generic bucket
+    would pay a full-size compile for the same assertion.)"""
+    code = bytes.fromhex(WRITER)
+    table = make_code_table([code])
+    batch = make_batch(2, calldata=[b""] * 2)
+    wrong = sp.phases_for(sp.signature_for(code))._replace(sstore=False)
+    out, _ = run(batch, table, max_steps=32, phases=wrong)
+    assert (np.asarray(out.status) == Status.UNSUPPORTED).all()
+    assert (np.asarray(out.pc) == 4).all()  # parked AT the SSTORE
+
+
+# -- the explorer differential (acceptance criterion) ------------------------
+def test_differential_issue_sets_fault_suite():
+    codes = [KILLABLE, WRITER, BRANCHER, GATED]
+    _, spec = _explore(codes, True, seed=7)
+    _, generic = _explore(codes, False, seed=7)
+    for s, g in zip(spec["contracts"], generic["contracts"]):
+        assert _fingerprint(s) == _fingerprint(g)
+    assert spec["stats"]["specialized"] == 1
+    assert spec["stats"]["spec_pruned_phases"] > 0
+    assert generic["stats"]["specialized"] == 0
+    # and the differential is not trivially empty
+    assert "selfdestruct" in spec["contracts"][0]["triggers"]
+
+
+def test_differential_issue_sets_module_fixtures():
+    """Every detection module's positive-fixture contract explores to
+    the same coverage/trigger/evidence fingerprint under the
+    specialized and the generic kernel."""
+    codes = _module_fixture_codes()
+    _, spec = _explore(codes, True, seed=11, waves=2)
+    _, generic = _explore(codes, False, seed=11, waves=2)
+    for s, g in zip(spec["contracts"], generic["contracts"]):
+        assert _fingerprint(s) == _fingerprint(g)
+    assert spec["stats"]["spec_fused_steps"] > 0
+
+
+def test_no_specialize_flag_restores_generic_path():
+    before = support_args.specialize
+    try:
+        support_args.specialize = False
+        ex, out = _explore([WRITER], None)  # None -> read the flag bag
+        assert ex._kernel is None
+        assert out["stats"]["specialized"] == 0
+    finally:
+        support_args.specialize = before
+
+
+# -- the compile cache --------------------------------------------------------
+def test_kernel_cache_buckets_share_compiles():
+    cache = sp.KernelCache(capacity=4)
+    a = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    b = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    k1 = cache.get(a)
+    k2 = cache.get(b)  # same bucket -> same kernel object
+    assert k1 is k2
+    assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 1
+
+
+def test_kernel_cache_evicts_lru_and_keeps_pins():
+    cache = sp.KernelCache(capacity=2)
+    buckets = [
+        PhaseSet(sha3=False),
+        PhaseSet(exp=False),
+        PhaseSet(div=False),
+    ]
+    pinned = cache.acquire(buckets[0])
+    cache.get(buckets[1])
+    cache.get(buckets[2])  # over capacity: evicts buckets[1], not the pin
+    stats = cache.stats()
+    assert stats["evictions"] == 1
+    assert stats["pinned"] == 1
+    assert cache.get(buckets[0]) is pinned  # survived as a hit
+    # releasing the pin makes it evictable; an evicted pin drops NOW
+    cache.release(pinned)
+    cache.get(PhaseSet(modops=False))
+    assert cache.stats()["size"] <= 2
+
+
+def test_code_cache_eviction_releases_kernel():
+    """The satellite fix: evicting a service CodeCache entry releases
+    its pinned compiled kernel (previously only dense rows and static
+    summaries were dropped — the kernel slot leaked)."""
+    from mythril_tpu.service.engine import CodeCache
+
+    cache = CodeCache(code_cap=64, capacity=1)
+    spec1 = cache.spec_for(bytes.fromhex(WRITER))
+    assert spec1 is not None and spec1["kernel"] is not None
+    k1 = spec1["kernel"]
+    refs_before = k1.refs
+    # inserting a second code evicts the first entry -> pin released
+    cache.spec_for(bytes.fromhex(KILLABLE))
+    assert cache.evictions == 1
+    assert cache.kernels_released == 1
+    assert k1.refs == refs_before - 1
+
+
+def test_code_cache_rebucket_releases_kernels():
+    from mythril_tpu.service.engine import CodeCache
+
+    cache = CodeCache(code_cap=64, capacity=4)
+    assert cache.spec_for(bytes.fromhex(WRITER)) is not None
+    assert cache.spec_for(bytes.fromhex(KILLABLE)) is not None
+    pinned = cache.kernels_pinned
+    cache.rebucket(128)
+    assert cache.kernels_released == pinned
+
+
+# -- the service warm path ----------------------------------------------------
+def test_service_warm_waves_hit_kernel_cache():
+    from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+    from mythril_tpu.service.jobs import Job
+
+    engine = AnalysisEngine(
+        ServiceConfig(
+            stripes=2,
+            lanes_per_stripe=4,
+            steps_per_wave=64,
+            max_waves=2,
+            host_walk=False,
+            coalesce_wait_s=0.05,
+            idle_wait_s=0.02,
+            # deterministic for the assertion: compile on the wave
+            # instead of the production background warmup
+            specialize_warmup="sync",
+        )
+    ).start()
+    try:
+        # two jobs of the SAME code: every wave's resident-set union is
+        # one bucket, so the warm path is deterministic (and the suite
+        # compiles one service kernel, not one per residency pattern)
+        jobs = [engine.submit(Job(BRANCHER)), engine.submit(Job(BRANCHER))]
+        for job in jobs:
+            settled = engine.queue.wait_terminal(job.id, timeout_s=120.0)
+            assert settled is not None and settled.state == "done", (
+                settled.state if settled else "lost"
+            )
+        kernel = engine.stats()["kernel"]
+        assert kernel["enabled"] is True
+        assert kernel["spec_waves"] >= 1
+        assert kernel["cache_hits"] >= 1  # warm waves rode the bucket
+        assert kernel["fallbacks"] == 0
+        assert kernel["pinned_codes"] >= 1
+    finally:
+        engine.close()
+
+
+def test_service_no_specialize_runs_generic_waves():
+    from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+    from mythril_tpu.service.jobs import Job
+
+    engine = AnalysisEngine(
+        ServiceConfig(
+            stripes=1,
+            lanes_per_stripe=4,
+            steps_per_wave=64,
+            max_waves=1,
+            host_walk=False,
+            coalesce_wait_s=0.05,
+            idle_wait_s=0.02,
+            specialize=False,
+        )
+    ).start()
+    try:
+        job = engine.submit(Job(WRITER))
+        settled = engine.queue.wait_terminal(job.id, timeout_s=120.0)
+        assert settled is not None and settled.state == "done"
+        kernel = engine.stats()["kernel"]
+        assert kernel["enabled"] is False
+        assert kernel["spec_waves"] == 0
+        assert kernel["generic_waves"] >= 1
+    finally:
+        engine.close()

@@ -123,6 +123,7 @@ def main() -> int:
         "max_jobs_resident": stats["arena"]["max_jobs_resident"],
         "waves": stats["waves"],
         "pipeline": stats.get("pipeline", {}),
+        "kernel": stats.get("kernel", {}),
         "drain": {},
     }
     try:
@@ -148,6 +149,20 @@ def main() -> int:
                 f"no wave overlap with 4 concurrent jobs: {pipe}"
             )
             assert pipe.get("wave_overlap_ratio", 0) > 0, pipe
+        # the specialization contract: the engine's monotone bucket is
+        # consulted every wave (later lookups are kernel-cache HITS),
+        # compiles stay OFF the serving path (background warmup: a
+        # not-yet-warm bucket makes the wave generic, never slower),
+        # and nothing fell back through the fault ladder
+        kernel = stats.get("kernel", {})
+        if kernel.get("enabled"):
+            assert kernel.get("cache_hits", 0) >= 1, (
+                f"warm waves never hit the kernel cache: {kernel}"
+            )
+            assert kernel.get("warmups_launched", 0) >= 1, (
+                f"no kernel warmup launched: {kernel}"
+            )
+            assert kernel.get("fallbacks", 0) == 0, kernel
         assert drained, "drain did not complete"
         for job_id in drain_ids:
             job = server.engine.queue.get(job_id)
