@@ -331,6 +331,7 @@ def _corpus_leg(contracts, use_device, deadline_s=None):
     swallowed by per-contract error containment, which let a host leg
     run 691s past its alarm and the outer timeout kill the process
     with no JSON emitted (rc:124/parsed:null)."""
+    from mythril_tpu import observe
     from mythril_tpu.analysis.corpus import analyze_corpus
     from mythril_tpu.support.model import clear_cache
     from mythril_tpu.laser.smt.solver.solver_statistics import (
@@ -341,6 +342,7 @@ def _corpus_leg(contracts, use_device, deadline_s=None):
     stats.enabled = True
     clear_cache()
     d0 = stats.device_sat_count
+    solver_marker = observe.solver_marker()
     t0 = time.perf_counter()
     results = analyze_corpus(
         contracts,
@@ -372,6 +374,13 @@ def _corpus_leg(contracts, use_device, deadline_s=None):
             for i in r["issues"]
         }
     )
+    # span-derived device overlap for THIS leg: only wave.device spans
+    # that closed after the leg started count
+    leg_spans = [
+        s
+        for s in observe.flight_recorder().tail(8192)
+        if s.t1 >= t0
+    ]
     return {
         "wall_s": round(wall, 1),
         "issues": sum(len(r["issues"]) for r in results),
@@ -380,6 +389,10 @@ def _corpus_leg(contracts, use_device, deadline_s=None):
         "errors": sum(1 for r in results if r["error"]),
         "owned": sum(1 for r in results if r.get("owned")),
         "device_sat": stats.device_sat_count - d0,
+        "solver_attribution": observe.solver_attribution(solver_marker),
+        "trace_overlap_frac": observe.overlap_fraction(
+            leg_spans, name="wave.device"
+        ),
         "prepass": prepass or None,
     }
 
@@ -571,6 +584,16 @@ class _ConvAB:
                 "findings_parity_pass": d_found >= h_found,
             },
         }
+        # per-origin solver attribution + span-derived wave overlap of
+        # the recorded (median) device leg — the ISSUE-7 observability
+        # fields (ROADMAP item 1 reads solver_attribution to see which
+        # engine owns the verdicts)
+        out["solver_attribution"] = median_leg.get(
+            "solver_attribution"
+        ) or {}
+        out["trace_overlap_frac"] = median_leg.get(
+            "trace_overlap_frac", 0.0
+        )
         prepass = median_leg.get("prepass") or {}
         for k, v in prepass.items():
             if k not in ("scope", "partial", "mesh"):
@@ -830,7 +853,16 @@ def main(final_attempt: bool = False) -> None:
         # never runs (budget-skipped records stay schema-complete)
         "mesh_devices": 1,
         "steal_count": 0,
+        # telemetry defaults (ISSUE 7): populated by the corpus legs
+        "solver_attribution": {},
+        "trace_overlap_frac": 0.0,
     }
+    if os.environ.get("MYTHRIL_BENCH_NO_OBSERVE"):
+        # the telemetry-overhead differential leg: spans/attribution/
+        # routing recording off, record fields stay at their defaults
+        from mythril_tpu import observe
+
+        observe.set_enabled(False)
 
     try:
         record.update(bench_static_prune())
@@ -973,6 +1005,20 @@ def main(final_attempt: bool = False) -> None:
             )
         except Exception as e:
             print(f"bench: hard-solve half failed: {e!r}", file=sys.stderr)
+
+    trace_out = os.environ.get("MYTHRIL_BENCH_TRACE_OUT")
+    if trace_out:
+        # the run's Perfetto timeline beside the record: a pipelined
+        # multi-device corpus leg renders its overlapped waves
+        try:
+            from mythril_tpu import observe
+
+            observe.export_trace(trace_out)
+            record["trace_out"] = trace_out
+            print(f"bench: span trace written to {trace_out}",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"bench: trace export failed: {e!r}", file=sys.stderr)
 
     _refresh_headline(record, dev)
     _emit(record, "final")

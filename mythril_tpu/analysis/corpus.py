@@ -263,7 +263,10 @@ def corpus_device_prepass(
         )
         if lock_wanted is not None:
             explorer.lock_wanted = lock_wanted
-        result = explorer.run()
+        from mythril_tpu.observe.spans import trace
+
+        with trace("corpus.prepass", contracts=len(runnable)):
+            result = explorer.run()
     except Exception:
         from mythril_tpu.support.resilience import (
             DegradationLog,
@@ -376,7 +379,12 @@ def _mesh_prepass(
                 transaction_count=transaction_count,
             ),
         )
-        result = scheduler.run()
+        from mythril_tpu.observe.spans import trace
+
+        with trace(
+            "corpus.prepass", contracts=len(runnable), mesh=mesh_groups
+        ):
+            result = scheduler.run()
     except Exception:
         from mythril_tpu.support.resilience import (
             DegradationLog,
@@ -690,7 +698,10 @@ def _skipped_result(name: str, reason: str) -> Dict:
 
 def _analyze_one(payload: Tuple) -> Dict:
     """Worker: analyze one contract, return issue dicts (run in a
-    spawned process; heavyweight imports stay inside)."""
+    spawned process; heavyweight imports stay inside). The result
+    carries its own wall (`wall_s`) — the per-contract outcome field
+    the routing feature log (observe/routing.py) trains on."""
+    t_start = time.perf_counter()
     (
         code,
         creation_code,
@@ -734,23 +745,26 @@ def _analyze_one(payload: Tuple) -> Dict:
             args.device_prepass = "never"
             args.device_solving = "never"
 
+        from mythril_tpu.observe.spans import trace
+
         contract = EVMContract(
             code=code or "", creation_code=creation_code or "", name=name
         )
-        sym = SymExecWrapper(
-            contract,
-            address,
-            strategy,
-            max_depth=max_depth,
-            execution_timeout=execution_timeout,
-            loop_bound=loop_bound,
-            create_timeout=create_timeout,
-            transaction_count=transaction_count,
-            modules=modules,
-            compulsory_statespace=False,
-            prepass_outcome=prepass_outcome,
-        )
-        issues = fire_lasers(sym, modules)
+        with trace("contract.analyze", contract=name):
+            sym = SymExecWrapper(
+                contract,
+                address,
+                strategy,
+                max_depth=max_depth,
+                execution_timeout=execution_timeout,
+                loop_bound=loop_bound,
+                create_timeout=create_timeout,
+                transaction_count=transaction_count,
+                modules=modules,
+                compulsory_statespace=False,
+                prepass_outcome=prepass_outcome,
+            )
+            issues = fire_lasers(sym, modules)
         exploration = getattr(sym, "device_exploration", None)
         from mythril_tpu.support.phase_profile import PhaseProfile
 
@@ -761,6 +775,7 @@ def _analyze_one(payload: Tuple) -> Dict:
             "device_prepass": exploration["stats"] if exploration else None,
             "phases": PhaseProfile().as_dict(),
             "precovered_skips": sym.laser.device_precovered_skips,
+            "wall_s": round(time.perf_counter() - t_start, 3),
             "error": None,
         }
     except Exception:
@@ -768,6 +783,7 @@ def _analyze_one(payload: Tuple) -> Dict:
             "name": name,
             "issues": [],
             "states": 0,
+            "wall_s": round(time.perf_counter() - t_start, 3),
             "error": traceback.format_exc(),
         }
     finally:
@@ -1165,6 +1181,7 @@ def analyze_corpus(
             not result.get("skipped") and result.get("error") is None
         )
         skipped += bool(result.get("skipped"))
+    _emit_routing_records(results, contracts)
     if skipped and on_timeout == "fail":
         from mythril_tpu.exceptions import DeadlineExpiredError
 
@@ -1173,6 +1190,41 @@ def analyze_corpus(
             "deadline (--on-timeout=fail)"
         )
     return results
+
+
+def _emit_routing_records(
+    results: List[Dict], contracts: List[Tuple[str, str, str]]
+) -> None:
+    """One routing-feature record per analyzed contract
+    (observe/routing.py): static features joined with the route taken
+    and the outcome — the JSONL training set ROADMAP item 5's cost
+    model needs. Never fatal; a record failure loses one row, not the
+    run."""
+    from mythril_tpu import observe
+
+    if not observe.enabled():
+        return
+    import hashlib
+
+    for (code, _creation, name), result in zip(contracts, results):
+        if result is None:
+            continue
+        try:
+            code_norm = code[2:] if code.startswith("0x") else code
+            try:
+                digest = hashlib.sha256(
+                    bytes.fromhex(code_norm or "")
+                ).hexdigest()
+            except ValueError:
+                digest = ""
+            observe.routing_log().record(
+                contract=name,
+                code_hash=digest,
+                features=observe.routing_features_for(code_norm),
+                outcome=observe.routing_outcome_for(result),
+            )
+        except Exception:
+            log.debug("routing record failed for %s", name, exc_info=True)
 
 
 def _merge_prepass_witnesses(

@@ -365,6 +365,45 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--trace-out",),
+        dict(
+            default=None,
+            metavar="FILE",
+            help=(
+                "Write the run's structured-span timeline as "
+                "Chrome/Perfetto trace JSON (open at "
+                "https://ui.perfetto.dev): device waves, host "
+                "harvest/solve, kernel compiles, mesh steals — the "
+                "flight recorder's full view of where the wall went"
+            ),
+        ),
+    ),
+    (
+        ("--observe-out",),
+        dict(
+            default=None,
+            metavar="DIR",
+            help=(
+                "Telemetry output directory: per-contract routing-"
+                "feature records (routing_features.jsonl — the "
+                "host/device cost-model training set) plus automatic "
+                "flight-recorder dumps on mesh/deadline degradations"
+            ),
+        ),
+    ),
+    (
+        ("--no-observe",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable telemetry recording (spans, solver "
+                "attribution, routing records): the zero-overhead "
+                "differential baseline — issue sets are identical "
+                "with and without"
+            ),
+        ),
+    ),
+    (
         ("--device-prepass",),
         dict(
             choices=["auto", "always", "never"],
@@ -757,6 +796,21 @@ def build_parser() -> ArgumentParser:
             "(/stats mesh.*). Stripes must divide evenly by N"
         ),
     )
+    serve.add_argument(
+        "--observe-out",
+        default=None,
+        metavar="DIR",
+        help=(
+            "telemetry output directory: degradation flight-recorder "
+            "dumps land here and the drain's final flush prefers it "
+            "over the checkpoint dir (live views: /metrics, /trace)"
+        ),
+    )
+    serve.add_argument(
+        "--no-observe",
+        action="store_true",
+        help="disable span/attribution/routing telemetry recording",
+    )
 
     submit = subparsers.add_parser(
         "submit",
@@ -1066,6 +1120,12 @@ def _apply_corpus_shard(disassembler, args) -> bool:
 
 
 def _run_analyze(disassembler, address, args):
+    from mythril_tpu import observe
+
+    if getattr(args, "no_observe", False):
+        observe.set_enabled(False)
+    if getattr(args, "observe_out", None):
+        observe.configure(out_dir=args.observe_out)
     if _apply_corpus_shard(disassembler, args):
         # a legitimately empty shard (more hosts than contracts) is a
         # clean no-findings run, not an input error — and it must honor
@@ -1157,6 +1217,15 @@ def _run_analyze(disassembler, address, args):
         )
     except CriticalError as e:
         exit_with_error(args.outform, "Analysis error encountered: " + format(e))
+    finally:
+        # the span timeline flushes even on a deadline/error exit —
+        # a failed run's trace is the one you want to open
+        if getattr(args, "trace_out", None):
+            try:
+                observe.export_trace(args.trace_out)
+                log.info("span trace written to %s", args.trace_out)
+            except Exception:
+                log.warning("trace export failed", exc_info=True)
 
 
 def execute_command(
@@ -1212,9 +1281,14 @@ def _cmd_list_detectors(args: Namespace) -> None:
 def _cmd_serve(args: Namespace) -> None:
     """`myth serve`: run the persistent analysis service until a
     graceful drain (SIGTERM/SIGINT or POST /v1/drain) completes."""
+    from mythril_tpu import observe
     from mythril_tpu.service.engine import ServiceConfig
     from mythril_tpu.service.server import serve_forever
 
+    if args.no_observe:
+        observe.set_enabled(False)
+    if args.observe_out:
+        observe.configure(out_dir=args.observe_out)
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,

@@ -72,6 +72,8 @@ from mythril_tpu.laser.batch.symbolic import (
 )
 from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
 from mythril_tpu.laser.smt.solver.solver import lower
+from mythril_tpu.observe.solverstats import ORIGIN_DEVICE, record_query
+from mythril_tpu.observe.spans import flight_recorder, trace
 from mythril_tpu.support.model import get_model
 
 log = logging.getLogger(__name__)
@@ -221,6 +223,107 @@ class ExploreStats:
 
     def as_dict(self) -> Dict[str, int]:
         return dict(self.__dict__)
+
+
+#: Explicit cross-engine merge semantics for EVERY ExploreStats field
+#: (plus the optional "halt_reason" the stats dict may carry). The
+#: multi-chip scheduler folds per-chunk stats dicts with these rules;
+#: before PR 7 it guessed (sum unless listed), and a new counter
+#: could silently merge wrong. tests/observe pins that every field has
+#: an explicit policy, so adding a stat without deciding its merge is
+#: a test failure, not a latent drift.
+#:
+#:   sum      additive work/byte/fault counters
+#:   max      high-water marks and per-run mode flags (1 if ANY chunk
+#:            ran pipelined/specialized; the deepest transaction)
+#:   derived  ratios recomputed AFTER the merge from merged inputs
+#:   last     non-numeric run verdicts (the newest chunk owns them)
+MERGE_POLICY: Dict[str, str] = {
+    "device_steps": "sum",
+    "device_steps_raw": "sum",
+    "waves": "sum",
+    "transactions": "max",
+    "arena_nodes": "max",
+    "forks_tried": "sum",
+    "forks_feasible": "sum",
+    "device_sat": "sum",
+    "host_sat": "sum",
+    "branches_covered": "sum",
+    "carries_banked": "sum",
+    "lanes_degraded_mem": "sum",
+    "lanes_degraded_unsupported": "sum",
+    "device_faults": "sum",
+    "wave_checkpoints": "sum",
+    "static_pruned_flips": "sum",
+    "static_seeds_dropped": "sum",
+    "static_summaries": "sum",
+    "specialized": "max",
+    "spec_pruned_phases": "max",
+    "spec_fused_steps": "sum",
+    "spec_fallbacks": "sum",
+    "kernel_cache_hits": "sum",
+    "kernel_cache_misses": "sum",
+    "kernel_compile_s": "sum",
+    "wall_s": "derived",
+    "wave_exec_s": "sum",
+    "flip_solve_s": "sum",
+    "pipelined": "max",
+    "waves_inflight_max": "max",
+    "waves_overlapped": "sum",
+    "wave_overlap_s": "sum",
+    "device_wait_s": "sum",
+    "device_busy_s": "sum",
+    "wave_overlap_ratio": "derived",
+    "device_idle_frac": "derived",
+    "evidence_bytes": "sum",
+    "evidence_bytes_full": "sum",
+    "evidence_bytes_per_wave": "derived",
+    "halt_reason": "last",
+}
+
+
+def merge_stats(dst: Dict, src: Dict) -> None:
+    """Fold one engine's stats dict into `dst` under MERGE_POLICY.
+    Unknown numeric keys sum (the policy-pin test keeps the set
+    complete for ExploreStats fields); unknown non-numeric keys are
+    ignored."""
+    for key, value in src.items():
+        policy = MERGE_POLICY.get(key)
+        if policy == "derived":
+            continue
+        if policy == "last":
+            dst[key] = value
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        if policy == "max":
+            dst[key] = max(dst.get(key, 0), value)
+        else:  # "sum" and unregistered numeric keys
+            dst[key] = dst.get(key, 0) + value
+
+
+def publish_explore_stats(stats: Dict) -> None:
+    """Register one finished exploration's counters into the
+    process-wide metrics registry (mtpu_explore_*): summing fields
+    accumulate as counters, high-water fields as set-max gauges —
+    the /metrics view of what ExploreStats reports per run."""
+    from mythril_tpu.observe.registry import registry
+
+    reg = registry()
+    for key, value in stats.items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        policy = MERGE_POLICY.get(key)
+        if policy == "sum":
+            reg.counter(
+                f"mtpu_explore_{key}_total",
+                f"ExploreStats.{key}, accumulated over explorations",
+            ).inc(value)
+        elif policy == "max":
+            reg.gauge(
+                f"mtpu_explore_{key}_max",
+                f"ExploreStats.{key}, process high-water mark",
+            ).set_max(value)
 
 
 def required_calldata_len(
@@ -1145,6 +1248,10 @@ class DeviceCorpusExplorer:
         query gets a CDCL sprint first; the ones it cannot finish get
         lowered here and solved on device afterwards."""
         t0 = time.perf_counter()
+        sprint_span = trace(
+            "flip.solve.host", track=self.fault_domain, queries=len(batch)
+        )
+        sprint_span.__enter__()
         out: List[Optional[Dict[str, int]]] = [None] * len(batch)
         survivors: List[int] = []
         capped: set = set()
@@ -1192,6 +1299,7 @@ class DeviceCorpusExplorer:
                     continue
                 lowered_batch.append(lowered)
                 kept.append(i)
+        sprint_span.__exit__(None, None, None)
         self.stats.flip_solve_s += time.perf_counter() - t0
         return out, capped, lowered_batch, kept
 
@@ -1205,16 +1313,32 @@ class DeviceCorpusExplorer:
         if not lowered_batch:
             return
         t0 = time.perf_counter()
-        found = device_check_batch(
-            lowered_batch,
-            candidates=self.portfolio_candidates,
-            steps=self.portfolio_steps,
-        )
+        with trace(
+            "flip.solve.device",
+            track=self.fault_domain,
+            queries=len(lowered_batch),
+        ):
+            found = device_check_batch(
+                lowered_batch,
+                candidates=self.portfolio_candidates,
+                steps=self.portfolio_steps,
+            )
+        dt = time.perf_counter() - t0
+        per_query = dt / max(1, len(kept))
         for i, assignment in zip(kept, found):
             if assignment is not None:
                 self.stats.device_sat += 1
                 out[i] = assignment
-        self.stats.flip_solve_s += time.perf_counter() - t0
+            # solver attribution: these queries escalated past the CDCL
+            # sprint onto the on-chip portfolio (hop 1); a miss is an
+            # "unknown" — the portfolio is a sat-finder, not a decider
+            record_query(
+                ORIGIN_DEVICE,
+                "sat" if assignment is not None else "unknown",
+                per_query,
+                hop=1,
+            )
+        self.stats.flip_solve_s += dt
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)
@@ -1428,27 +1552,34 @@ class DeviceCorpusExplorer:
         fl = _Inflight(payload)
         fl.dispatch_t = time.perf_counter()
         try:
-            if self._carcass is not None and self.mesh is None:
-                sym = self._warm_sym(payload)
-            else:
-                sym = self._cold_sym(payload)
-            if self._kernel is not None:
-                # the contract-specialized kernel: pruned phases +
-                # fused superblock substeps (specialize.py)
-                fl.out, fl.steps, fl.active, fl.fused = self._kernel.sym_run(
-                    sym,
-                    self.code_table,
-                    self._fuse_tbl,
-                    max_steps=self.steps_per_wave,
-                    donate=self._donation_ok(),
-                )
-            else:
-                runner = (
-                    sym_run_donated if self._donation_ok() else sym_run
-                )
-                fl.out, fl.steps, fl.active = runner(
-                    sym, self.code_table, max_steps=self.steps_per_wave
-                )
+            with trace(
+                "wave.dispatch",
+                track=self.fault_domain,
+                serial=payload.serial,
+            ):
+                if self._carcass is not None and self.mesh is None:
+                    sym = self._warm_sym(payload)
+                else:
+                    sym = self._cold_sym(payload)
+                if self._kernel is not None:
+                    # the contract-specialized kernel: pruned phases +
+                    # fused superblock substeps (specialize.py)
+                    fl.out, fl.steps, fl.active, fl.fused = (
+                        self._kernel.sym_run(
+                            sym,
+                            self.code_table,
+                            self._fuse_tbl,
+                            max_steps=self.steps_per_wave,
+                            donate=self._donation_ok(),
+                        )
+                    )
+                else:
+                    runner = (
+                        sym_run_donated if self._donation_ok() else sym_run
+                    )
+                    fl.out, fl.steps, fl.active = runner(
+                        sym, self.code_table, max_steps=self.steps_per_wave
+                    )
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -1505,28 +1636,43 @@ class DeviceCorpusExplorer:
 
         wait0 = time.perf_counter()
         fused = None
-        if fl.failed is None:
-            try:
-                self._inject("device.dispatch")
-                jax.block_until_ready(fl.steps)
-                out, steps, active = fl.out, fl.steps, fl.active
-                fused = fl.fused
-            except Exception as why:
-                if not resilience.is_device_fault(why):
-                    raise
-                resilience.DegradationLog().record(
-                    resilience.DegradationReason.ASYNC_DEVICE_FAULT,
-                    site=self._site(f"wave#{fl.payload.serial}"),
-                    detail=str(why),
-                )
-                self._carcass = None
+        with trace(
+            "wave.harvest",
+            track=self.fault_domain,
+            serial=fl.payload.serial,
+        ):
+            if fl.failed is None:
+                try:
+                    self._inject("device.dispatch")
+                    jax.block_until_ready(fl.steps)
+                    out, steps, active = fl.out, fl.steps, fl.active
+                    fused = fl.fused
+                except Exception as why:
+                    if not resilience.is_device_fault(why):
+                        raise
+                    resilience.DegradationLog().record(
+                        resilience.DegradationReason.ASYNC_DEVICE_FAULT,
+                        site=self._site(f"wave#{fl.payload.serial}"),
+                        detail=str(why),
+                    )
+                    self._carcass = None
+                    out, steps, active = self._retry_wave(fl)
+            else:
                 out, steps, active = self._retry_wave(fl)
-        else:
-            out, steps, active = self._retry_wave(fl)
         now = time.perf_counter()
         self.stats.device_wait_s += now - wait0
         if fl.dispatch_t is not None:
             self.stats.device_busy_s += max(0.0, now - fl.dispatch_t)
+            # the retrospective device-execution span: dispatch to
+            # readback-ready — the Perfetto track a pipelined run's
+            # overlap (and bench's trace_overlap_frac) reads from
+            flight_recorder().add(
+                "wave.device",
+                fl.dispatch_t,
+                now,
+                track=self.fault_domain or "device",
+                serial=fl.payload.serial,
+            )
         view = ArenaView(out)
         # the spent output buffers become the next dispatch's donation
         # fodder (everything the host needs is in the view's numpy)
@@ -1552,6 +1698,12 @@ class DeviceCorpusExplorer:
         coverage, evidence, poison bookkeeping. Pure host work — under
         the pipelined schedule this (plus the reseed's flip solving)
         is exactly what overlaps the next wave's device execution."""
+        with trace(
+            "wave.consume", track=self.fault_domain, serial=payload.serial
+        ):
+            return self._consume_wave_inner(view, payload)
+
+    def _consume_wave_inner(self, view: ArenaView, payload) -> None:
         flat = payload.flat
         L = self.lanes_per_contract
         status, halt_pc = view.status, view.halt_pc
@@ -2401,7 +2553,12 @@ class DeviceCorpusExplorer:
 
         DEVICE_BUSY.acquire()
         try:
-            return self._run_phases()
+            with trace(
+                "explore.run",
+                track=self.fault_domain,
+                contracts=len(self.tracks),
+            ):
+                return self._run_phases()
         finally:
             if self._ckpt_writer is not None:
                 # outcomes must never race their own checkpoints; close
@@ -2462,7 +2619,11 @@ class DeviceCorpusExplorer:
                     track._final_phase_overflow_base = track.carry_overflow
             self.stats.transactions = txn + 1
             try:
-                finished = self._phase(txn)
+                with trace(
+                    "phase", track=self.fault_domain, txn=txn,
+                    contracts=len(self.tracks),
+                ):
+                    finished = self._phase(txn)
             except DeviceDispatchError as why:
                 # a wave died past the retry ladder: the exploration
                 # DEGRADES — every live frontier reopens (those
@@ -2552,6 +2713,10 @@ class DeviceCorpusExplorer:
             # stop-event) — consumers mark the outcome partial with a
             # structured reason instead of guessing from counters
             stats["halt_reason"] = self._halt_reason
+        # the registry view of this run: the process-wide mtpu_explore_*
+        # series /metrics scrapes (the legacy dict above is the per-run
+        # view — tests pin the two equal over a run's delta)
+        publish_explore_stats(stats)
         return {
             "stats": stats,
             "contracts": [

@@ -504,10 +504,29 @@ class SpecializedKernel:
         try:
             return fn(*args, **kwargs)
         finally:
-            self.compile_s += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.compile_s += t1 - t0
             self._warm.add(key)
             with _CACHE_MU:
                 _COMPILING -= 1
+            # the compile stall as a flight-recorder span: on a
+            # Perfetto timeline this is the gap that explains a slow
+            # first wave (observe/spans.py)
+            try:
+                from mythril_tpu.observe.registry import registry
+                from mythril_tpu.observe.spans import flight_recorder
+
+                flight_recorder().add(
+                    "kernel.compile", t0, t1,
+                    entry=key[0], pruned=len(self.phases.pruned),
+                )
+                registry().histogram(
+                    "mtpu_kernel_compile_seconds",
+                    "specialized-kernel trace+compile wall per "
+                    "(entry, shape)",
+                ).observe(t1 - t0)
+            except Exception:
+                pass
 
     @staticmethod
     def run_key(batch, code, donate: bool) -> tuple:
@@ -576,13 +595,21 @@ class KernelCache:
         self.evictions = 0
 
     def get(self, phases: PhaseSet) -> SpecializedKernel:
+        from mythril_tpu.observe.registry import registry
+
+        lookups = registry().counter(
+            "mtpu_kernel_cache_lookups_total",
+            "specialization-bucket cache lookups by result",
+        )
         with _CACHE_MU:
             kernel = self._entries.get(phases)
             if kernel is not None:
                 self.hits += 1
                 self._entries.move_to_end(phases)
+                lookups.labels(result="hit").inc()
                 return kernel
             self.misses += 1
+        lookups.labels(result="miss").inc()
         # build outside the lock (jit object construction is cheap but
         # not free); a racing build of the same bucket keeps the first
         kernel = SpecializedKernel(phases)

@@ -382,7 +382,47 @@ def device_solving_enabled() -> bool:
     return accelerator_present()
 
 
+#: thread-local channel the device-win sites mark so the telemetry
+#: wrapper below attributes the verdict to the right engine (the
+#: origin is decided deep inside the race/escape paths, the wall is
+#: measured at the entry)
+import threading as _threading
+
+_QUERY_ORIGIN = _threading.local()
+
+
 def check_terms(
+    raw_constraints: List[terms.Term],
+    timeout_ms: int = 10_000,
+    conflict_budget: Optional[int] = None,
+) -> (str, Optional[Model]):
+    """Decide a constraint set — `_check_terms_impl` under solver
+    query telemetry: every verdict is tagged with its answering origin
+    (host CDCL vs device portfolio), wall time, and escalation hop
+    (observe/solverstats.py; the per-run attribution table lands in
+    bench records and report meta)."""
+    from mythril_tpu.observe.solverstats import (
+        ORIGIN_DEVICE,
+        ORIGIN_HOST_CDCL,
+        record_query,
+    )
+
+    _QUERY_ORIGIN.origin = None
+    t0 = time.perf_counter()
+    verdict, model = _check_terms_impl(
+        raw_constraints, timeout_ms, conflict_budget
+    )
+    origin = getattr(_QUERY_ORIGIN, "origin", None) or ORIGIN_HOST_CDCL
+    record_query(
+        origin,
+        verdict,
+        time.perf_counter() - t0,
+        hop=1 if origin == ORIGIN_DEVICE else 0,
+    )
+    return verdict, model
+
+
+def _check_terms_impl(
     raw_constraints: List[terms.Term],
     timeout_ms: int = 10_000,
     conflict_budget: Optional[int] = None,
@@ -566,6 +606,7 @@ def check_terms(
                         if model is not None:
                             SolverStatistics().device_sat_count += 1
                             SolverStatistics().race_wins += 1
+                            _QUERY_ORIGIN.origin = "device-portfolio"
                             return sat, model
                         SolverStatistics().race_losses += 1
                         race = None  # invalid witness: back to CDCL
@@ -614,6 +655,8 @@ def check_terms(
             if asn is not None:
                 model = _reconstruct(asn, {}, recon, raw_constraints)
                 if model is not None:
+                    SolverStatistics().device_sat_count += 1
+                    _QUERY_ORIGIN.origin = "device-portfolio"
                     return sat, model
         return unknown, None
 

@@ -215,6 +215,9 @@ class MythrilAnalyzer:
 
         SolverStatistics().enabled = True
         degradation_marker = resilience.DegradationLog().marker()
+        from mythril_tpu import observe
+
+        solver_marker = observe.solver_marker()
         if self.deadline is not None:
             resilience.set_run_deadline(self.deadline)
         pre = self._corpus_prepass(transaction_count)
@@ -245,6 +248,12 @@ class MythrilAnalyzer:
         Source().get_source_from_contracts_list(self.contracts)
 
         report = self._build_report(collected, crashes, execution_info)
+        # per-run solver attribution (observe/solverstats.py): which
+        # engine answered how many queries at what cost — the jsonv2
+        # meta view of ROADMAP item 1's device-vs-host question
+        attribution = observe.solver_attribution(solver_marker)
+        if attribution:
+            report.meta["solver_attribution"] = attribution
         reasons = resilience.DegradationLog().counts_since(degradation_marker)
         partial = any(not status["complete"] for status in completion)
         if reasons or partial:
@@ -313,17 +322,23 @@ class MythrilAnalyzer:
                 restore = (args.device_prepass, args.device_solving)
                 args.device_prepass = "never"
                 args.device_solving = "never"
+            import time as _time
+
+            from mythril_tpu.observe.spans import trace as _trace
+
+            t_contract = _time.perf_counter()
             try:
-                with pre.lock if pre is not None else nullcontext():
-                    sym = self._symbolically_execute(
-                        contract,
-                        loop_bound=self.loop_bound,
-                        transaction_count=transaction_count,
-                        modules=modules,
-                        compulsory_statespace=False,
-                        prepass_outcome=outcome,
-                    )
-                    issues = fire_lasers(sym, modules)
+                with _trace("contract.analyze", contract=contract.name):
+                    with pre.lock if pre is not None else nullcontext():
+                        sym = self._symbolically_execute(
+                            contract,
+                            loop_bound=self.loop_bound,
+                            transaction_count=transaction_count,
+                            modules=modules,
+                            compulsory_statespace=False,
+                            prepass_outcome=outcome,
+                        )
+                        issues = fire_lasers(sym, modules)
                 execution_info = sym.execution_info
             except DetectorNotFoundError:
                 raise
@@ -347,11 +362,54 @@ class MythrilAnalyzer:
             completion.append(
                 {"contract": contract.name, "complete": not crashed}
             )
+            self._routing_record(
+                contract, issues, crashed,
+                _time.perf_counter() - t_contract,
+            )
             log.info("Solver statistics: \n%s", str(SolverStatistics()))
             from mythril_tpu.support.phase_profile import PhaseProfile
 
             log.info("Host phase profile: \n%s", str(PhaseProfile()))
         return collected, crashes, execution_info, completion
+
+    @staticmethod
+    def _routing_record(
+        contract, issues: List[Issue], crashed: bool, wall_s: float
+    ) -> None:
+        """One routing-feature record per analyzed contract on the CLI
+        path (the corpus driver emits its own): static features joined
+        with the walk's wall/issue outcome (observe/routing.py)."""
+        from mythril_tpu import observe
+
+        if not observe.enabled():
+            return
+        try:
+            import hashlib
+
+            code = contract.code or getattr(
+                contract, "creation_code", ""
+            ) or ""
+            code = code[2:] if code.startswith("0x") else code
+            try:
+                digest = hashlib.sha256(bytes.fromhex(code)).hexdigest()
+            except ValueError:
+                digest = ""
+            observe.routing_log().record(
+                contract=contract.name,
+                code_hash=digest,
+                features=observe.routing_features_for(code),
+                outcome=observe.routing_outcome_for(
+                    {
+                        "name": contract.name,
+                        "issues": [None] * len(issues),
+                        "wall_s": round(wall_s, 3),
+                        "error": "crash" if crashed else None,
+                        "complete": not crashed,
+                    }
+                ),
+            )
+        except Exception:
+            log.debug("routing record failed", exc_info=True)
 
     def _merge_prepass_issues(
         self, final: dict, collected: List[Issue]

@@ -1,0 +1,151 @@
+"""Unified telemetry for the whole stack.
+
+One subsystem, four surfaces (see docs/observability.md):
+
+- **Metrics registry** (registry.py) — typed counters/gauges/
+  histograms with labels; the single backing store the explorer, the
+  service, the scheduler, the kernel cache and the phase profiler all
+  register into. Exposed as Prometheus text at the service's
+  ``/metrics``.
+- **Structured spans** (spans.py) — ``trace(name, **attrs)`` nested
+  spans in a bounded flight recorder, exportable as Chrome/Perfetto
+  trace JSON (``--trace-out``, ``/trace``), auto-dumped on mesh/
+  deadline degradations.
+- **Solver query telemetry** (solverstats.py) — every SAT/SMT verdict
+  tagged with its answering origin (host CDCL / device portfolio /
+  memo), aggregated into the per-run attribution table the bench
+  record and report meta carry.
+- **Routing feature log** (routing.py) — one JSONL record per analyzed
+  contract joining static features with route/outcome
+  (``--observe-out DIR``): ROADMAP item 5's training set.
+
+Global switches: `set_enabled(False)` (CLI ``--no-observe``) turns the
+span/solver/routing recording into near-zero-cost no-ops — registry
+arithmetic that backs *legacy* views (ExploreStats publication, /stats,
+phase profile) stays on so product behavior never changes with
+telemetry off. `configure(out_dir=...)` points file outputs (routing
+JSONL, degradation flight dumps) at a directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from mythril_tpu.observe.registry import (  # noqa: F401 (public API)
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    registry,
+    reset_registry,
+)
+from mythril_tpu.observe.routing import (  # noqa: F401
+    features_for as routing_features_for,
+)
+from mythril_tpu.observe.routing import outcome_for as routing_outcome_for  # noqa: F401,E501
+from mythril_tpu.observe.routing import routing_log  # noqa: F401
+from mythril_tpu.observe.solverstats import (  # noqa: F401
+    ORIGIN_DEVICE,
+    ORIGIN_HOST_CDCL,
+    ORIGIN_MEMO,
+    attribution as solver_attribution,
+    marker as solver_marker,
+    record_query,
+)
+from mythril_tpu.observe.spans import (  # noqa: F401
+    FlightRecorder,
+    export_trace,
+    flight_recorder,
+    overlap_fraction,
+    to_perfetto,
+    trace,
+)
+
+log = logging.getLogger(__name__)
+
+_ENABLED = True
+_OUT_DIR: Optional[str] = None
+_DUMP_MU = threading.Lock()
+_DUMPS = 0
+#: bound on automatic degradation dumps per process: a degrading corpus
+#: can log hundreds of events, and each dump serializes the whole ring
+MAX_AUTO_DUMPS = 8
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """The --no-observe switch: gates span recording, solver query
+    telemetry, routing records, and automatic flight dumps."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def out_dir() -> Optional[str]:
+    return _OUT_DIR
+
+
+def configure(out_dir: Optional[str] = None) -> None:
+    """Point file outputs at `out_dir` (created if missing); None
+    clears. Also arms the degradation auto-dump hook."""
+    global _OUT_DIR
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    _OUT_DIR = out_dir or None
+    _install_degradation_hook()
+
+
+#: degradation reasons that dump the flight recorder: the two failure
+#: classes where "what was in flight" is the question (a faulted mesh
+#: group, a run that ran out of wall)
+_DUMP_REASONS = ("mesh-group-degraded", "deadline-expired", "wave-abandoned")
+
+_HOOKED = False
+
+
+def _degradation_dump(reason: str, site: str) -> None:
+    """resilience.DegradationLog hook: flush the flight recorder to
+    the observe directory so the timeline that LED to the degradation
+    survives the run."""
+    global _DUMPS
+    if not _ENABLED or _OUT_DIR is None or reason not in _DUMP_REASONS:
+        return
+    with _DUMP_MU:
+        if _DUMPS >= MAX_AUTO_DUMPS:
+            return
+        _DUMPS += 1
+        n = _DUMPS
+    try:
+        path = os.path.join(
+            _OUT_DIR, f"flight-{reason}-{n}.trace.json"
+        )
+        export_trace(path)
+        log.info("flight recorder dumped to %s (%s at %s)", path, reason, site)
+    except Exception:
+        log.debug("flight-recorder dump failed", exc_info=True)
+
+
+def _install_degradation_hook() -> None:
+    global _HOOKED
+    if _HOOKED:
+        return
+    try:
+        from mythril_tpu.support import resilience
+
+        resilience.add_degradation_hook(_degradation_dump)
+        _HOOKED = True
+    except Exception:
+        log.debug("degradation hook install failed", exc_info=True)
+
+
+def auto_dump_count() -> int:
+    return _DUMPS
+
+
+def reset_auto_dumps() -> None:
+    global _DUMPS
+    with _DUMP_MU:
+        _DUMPS = 0

@@ -1,0 +1,184 @@
+"""The routing feature log: one record per analyzed contract, joining
+static-summary features with the analysis outcome.
+
+ROADMAP item 5 wants a host/device routing cost model; a cost model
+needs a training set. This module emits it: for every contract a
+corpus run analyzes, one JSONL record holding
+
+- **features** available BEFORE any routing decision — code size, CFG
+  block/instruction counts, selector counts, storage-op density,
+  screened-detector count, the kernel-specialization phase bucket;
+- **outcome** observed AFTER — the route actually taken (device-owned
+  / host walk / skipped), per-contract wall, waves, issues, verdicts.
+
+`myth analyze --observe-out DIR` lands the records in
+``DIR/routing_features.jsonl``; an in-memory tail is always kept (the
+bench and the tests read it without touching disk). Schema is
+versioned (`schema_version`) so the future trainer can pin it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from mythril_tpu.observe.registry import SCHEMA_VERSION
+
+#: every record carries exactly these top-level keys (the JSONL golden
+#: test pins them)
+RECORD_KEYS = (
+    "schema_version", "contract", "code_hash", "features", "outcome",
+)
+
+
+class RoutingLog:
+    """Thread-safe JSONL writer + bounded in-memory tail."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._mu = threading.Lock()
+        self._tail: "deque[Dict]" = deque(maxlen=capacity)
+        self.written = 0
+
+    def record(
+        self,
+        contract: str,
+        code_hash: str,
+        features: Dict,
+        outcome: Dict,
+    ) -> Dict:
+        from mythril_tpu import observe
+
+        rec = {
+            "schema_version": SCHEMA_VERSION,
+            "contract": contract,
+            "code_hash": code_hash,
+            "features": features,
+            "outcome": outcome,
+        }
+        if not observe.enabled():
+            return rec
+        line = json.dumps(rec, sort_keys=True)
+        out_dir = observe.out_dir()
+        with self._mu:
+            self._tail.append(rec)
+            self.written += 1
+            if out_dir:
+                try:
+                    with open(
+                        os.path.join(out_dir, "routing_features.jsonl"), "a"
+                    ) as fp:
+                        fp.write(line + "\n")
+                except OSError:
+                    pass  # a full/readonly disk must not sink analysis
+        return rec
+
+    def tail(self, n: int = 256) -> List[Dict]:
+        with self._mu:
+            return list(self._tail)[-n:]
+
+    def clear(self) -> None:
+        with self._mu:
+            self._tail.clear()
+
+
+_LOG = RoutingLog()
+
+
+def routing_log() -> RoutingLog:
+    return _LOG
+
+
+#: storage / call / env opcode sets for the density features (byte
+#: scan over-approximates into PUSH data, uniformly for every
+#: contract — fine for a ranking feature)
+_STORAGE_OPS = (0x54, 0x55)  # SLOAD, SSTORE
+_CALL_OPS = (0xF1, 0xF2, 0xF4, 0xFA)  # CALL family
+
+
+def features_for(code_hex: str, summary=None) -> Dict:
+    """The static feature vector for one contract. Uses the cached
+    StaticSummary when available (CFG sizes, dead selectors, screened
+    modules); degrades to byte-scan features when the static layer is
+    off or failed — the record always exists."""
+    code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
+    try:
+        code = bytes.fromhex(code_hex)
+    except ValueError:
+        code = b""
+    n = max(1, len(code))
+    feats: Dict = {
+        "code_bytes": len(code),
+        "storage_op_density": round(
+            sum(code.count(bytes([op])) for op in _STORAGE_OPS) / n, 5
+        ),
+        "call_op_density": round(
+            sum(code.count(bytes([op])) for op in _CALL_OPS) / n, 5
+        ),
+    }
+    if summary is None:
+        try:
+            from mythril_tpu.analysis.static import (
+                static_prune_enabled,
+                summary_for,
+            )
+
+            if static_prune_enabled():
+                summary = summary_for(code_hex)
+        except Exception:
+            summary = None
+    if summary is not None:
+        try:
+            row = summary.lint_dict()
+            feats.update(
+                cfg_blocks=row.get("blocks"),
+                cfg_reachable_blocks=row.get("reachable_blocks"),
+                instructions=row.get("instructions"),
+                selectors=row.get("selectors"),
+                dead_selectors=row.get("dead_selectors"),
+                dead_directions=row.get("dead_directions"),
+                modules_screened=row.get("modules_applicable"),
+            )
+        except Exception:
+            pass
+    try:
+        from mythril_tpu.laser.batch import specialize as _spec
+
+        phases = _spec.phases_for(
+            _spec.signature_for(code, summary),
+            fuse=_spec.fuse_profitable(code),
+        )
+        feats["phase_bucket_pruned"] = len(phases.pruned)
+        feats["fuse_profitable"] = bool(phases.fuse_depth)
+    except Exception:
+        pass
+    return feats
+
+
+def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
+    """The outcome half of a routing record, from an analyze_corpus
+    per-contract result dict (+ the corpus prepass stats when the
+    device ran)."""
+    if result.get("skipped"):
+        route = "skipped"
+    elif result.get("owned"):
+        route = "device-owned"
+    else:
+        route = "host-walk"
+    out: Dict = {
+        "route": route,
+        "wall_s": result.get("wall_s"),
+        "issues": len(result.get("issues") or []),
+        "states": result.get("states", 0),
+        "complete": bool(result.get("complete", result.get("error") is None)),
+        "error": bool(result.get("error")),
+    }
+    stats = prepass_stats or result.get("device_prepass") or {}
+    if stats:
+        out["waves"] = stats.get("waves", 0)
+        out["device_sat"] = stats.get("device_sat", 0)
+        out["host_sat"] = stats.get("host_sat", 0)
+        out["device_steps"] = stats.get("device_steps", 0)
+    return out

@@ -173,24 +173,6 @@ def merge_outcomes(old: Optional[Dict], new: Dict) -> Dict:
     return out
 
 
-#: ExploreStats counters that merge by max, not sum
-_STATS_MAX = {
-    "arena_nodes",
-    "transactions",
-    "waves_inflight_max",
-    "pipelined",
-    "specialized",
-    "spec_pruned_phases",
-}
-#: derived ratios recomputed after the merge
-_STATS_DERIVED = {
-    "wave_overlap_ratio",
-    "device_idle_frac",
-    "evidence_bytes_per_wave",
-    "wall_s",
-}
-
-
 class CorpusScheduler:
     """Shard a corpus across device groups and run one wave engine per
     group, work-stealing between them.
@@ -334,6 +316,25 @@ class CorpusScheduler:
             self._steal_events += 1
             moved = sum(item.handoff_nbytes() for item in items)
             self._rebalance_bytes += moved
+            from mythril_tpu.observe.registry import registry
+            from mythril_tpu.observe.spans import flight_recorder
+
+            reg = registry()
+            reg.counter(
+                "mtpu_mesh_steals_total",
+                "cross-device work-steal events",
+            ).labels(group=self.ledgers[gid].group.label).inc()
+            reg.counter(
+                "mtpu_mesh_rebalance_bytes_total",
+                "host-handoff bytes moved by work stealing",
+            ).inc(moved)
+            now = time.perf_counter()
+            flight_recorder().add(
+                "mesh.steal", now, now,
+                track=self.ledgers[gid].group.label,
+                items=len(items), bytes=moved,
+                victim=victim.group.label,
+            )
             log.debug(
                 "mesh steal: group %d took %d item(s) (%d handoff bytes) "
                 "from group %d",
@@ -395,7 +396,15 @@ class CorpusScheduler:
         for pos, item in enumerate(items):
             if item.frontier:
                 explorer.seed_frontier(pos, item.frontier)
-        result = explorer.run()
+        from mythril_tpu.observe.spans import trace
+
+        with trace(
+            "mesh.chunk",
+            track=group.label,
+            contracts=len(items),
+            continuations=sum(1 for it in items if it.frontier),
+        ):
+            result = explorer.run()
         wall = time.perf_counter() - t0
         stats = result["stats"]
         if stats.get("device_faults"):
@@ -462,19 +471,12 @@ class CorpusScheduler:
 
     def _merge_stats(self, stats: Dict) -> None:
         """Fold one chunk's ExploreStats dict into the corpus-wide
-        merge (sum counters, max high-water marks; ratios recomputed
-        at the end). Caller holds the lock."""
-        for key, value in stats.items():
-            if not isinstance(value, (int, float)) or key in _STATS_DERIVED:
-                continue
-            if key in _STATS_MAX:
-                self._merged_stats[key] = max(
-                    self._merged_stats.get(key, 0), value
-                )
-            else:
-                self._merged_stats[key] = (
-                    self._merged_stats.get(key, 0) + value
-                )
+        merge under the EXPLICIT per-field policy beside ExploreStats
+        (explore.MERGE_POLICY: sum / max / last / derived-recomputed-
+        after). Caller holds the lock."""
+        from mythril_tpu.laser.batch.explore import merge_stats
+
+        merge_stats(self._merged_stats, stats)
 
     def _worker(self, group: DeviceGroup) -> None:
         while not self._stopping():

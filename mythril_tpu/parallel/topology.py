@@ -63,6 +63,19 @@ class FailureDomain:
 
         self.faults += 1
         self.degraded_contracts += n_contracts
+        from mythril_tpu.observe.registry import registry
+
+        reg = registry()
+        reg.counter(
+            "mtpu_mesh_group_faults_total",
+            "device-group waves lost past the retry ladder",
+        ).labels(group=self.label).inc()
+        reg.counter(
+            "mtpu_mesh_degraded_contracts_total",
+            "contracts demoted to the host walk by a group fault",
+        ).labels(group=self.label).inc(n_contracts)
+        # recorded LAST: the DegradationLog's observer hooks (the
+        # flight-recorder auto-dump) must see the counters already moved
         DegradationLog().record(
             DegradationReason.MESH_GROUP_DEGRADED,
             site=self.label,

@@ -45,10 +45,21 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mythril_tpu import observe
+from mythril_tpu.observe.registry import _label_key
+from mythril_tpu.observe.spans import flight_recorder, trace
 from mythril_tpu.service.jobs import Job, JobQueue, JobState
 from mythril_tpu.service.lane_allocator import LaneAllocator
 
 log = logging.getLogger(__name__)
+
+#: /stats payload schema version: smoke tools pin it and the key set
+#: it covers. Bump on any shape change.
+STATS_SCHEMA_VERSION = 2
+
+#: engine-instance serial for the registry label (tests run many
+#: engines per process; each gets its own series)
+_ENGINE_SERIAL = __import__("itertools").count(1)
 
 #: trigger statuses -> report kinds (mirrors explore.TRIGGER_KINDS; a
 #: local copy so importing the engine never drags the explorer in)
@@ -451,11 +462,9 @@ class AnalysisEngine:
             self.cfg.lanes_per_stripe,
             groups=self.mesh.n_groups if self.mesh else 1,
         )
-        #: per-device (group) tables + mesh counters (/stats mesh.*)
+        #: per-device (group) tables (mesh counters live in the
+        #: registry — /stats mesh.* reads the snapshot)
         self._group_tables: Dict = {}
-        self._group_waves = [0] * (self.mesh.n_groups if self.mesh else 1)
-        self.mesh_steals = 0
-        self.mesh_rebalance_bytes = 0
         self.code_cap = code_cap_bucket(1, floor=self.cfg.code_cap)
         self.code_cache = CodeCache(self.code_cap, self.cfg.code_cache_cap)
         self._tracks: "OrderedDict[str, _JobTrack]" = OrderedDict()
@@ -478,28 +487,95 @@ class AnalysisEngine:
         )
         self._host_inflight: Dict[str, Tuple] = {}
         self._deg_marker = DegradationLog().marker()
-        # observability
+        # -- observability: the wave-loop counters are REGISTRY-backed
+        # (mtpu_service_* series labeled by engine instance): every
+        # mutation goes through the registry's one lock, and stats()
+        # reads them all from ONE snapshot — a point-in-time-consistent
+        # /stats instead of field-by-field reads racing the wave loop.
+        # The legacy attribute names stay as properties below.
         self.started_t = time.monotonic()
-        self.waves_total = 0
-        self.device_steps = 0
-        self.host_completed = 0
-        self.kernel_rebuckets = 0
-        self.static_seeds_dropped = 0
-        # kernel-specialization observability (/stats kernel.*)
-        self.spec_waves = 0
-        self.generic_waves = 0
-        self.kernel_fused_steps = 0
-        self.kernel_fallbacks = 0
+        self._eid = f"e{next(_ENGINE_SERIAL)}"
+        reg = observe.registry()
+        lab = {"engine": self._eid}
+        self._c_waves = reg.counter(
+            "mtpu_service_waves_total", "device waves dispatched"
+        ).labels(**lab)
+        self._c_device_steps = reg.counter(
+            "mtpu_service_device_steps_total", "lane-steps executed"
+        ).labels(**lab)
+        self._c_host_completed = reg.counter(
+            "mtpu_service_host_completed_total", "host walks finished"
+        ).labels(**lab)
+        self._c_rebuckets = reg.counter(
+            "mtpu_service_kernel_rebuckets_total",
+            "code-capacity re-buckets (arena recompiles)",
+        ).labels(**lab)
+        self._c_static_seeds = reg.counter(
+            "mtpu_service_static_seeds_dropped_total",
+            "dispatcher seeds masked by the static prune",
+        ).labels(**lab)
+        self._c_wave_kind = reg.counter(
+            "mtpu_service_wave_kind_total",
+            "waves by kernel kind (specialized vs generic)",
+        )
+        self._c_spec_waves = self._c_wave_kind.labels(kind="spec", **lab)
+        self._c_generic_waves = self._c_wave_kind.labels(
+            kind="generic", **lab
+        )
+        self._c_fused = reg.counter(
+            "mtpu_service_fused_steps_total",
+            "instructions advanced by fused substeps",
+        ).labels(**lab)
+        self._c_fallbacks = reg.counter(
+            "mtpu_service_kernel_fallbacks_total",
+            "specialized waves retried on the generic kernel",
+        ).labels(**lab)
+        self._c_overlapped = reg.counter(
+            "mtpu_service_pipeline_overlapped_total",
+            "harvests that ran with another wave in flight",
+        ).labels(**lab)
+        self._c_multi_job = reg.counter(
+            "mtpu_service_pipeline_multi_job_total",
+            "overlaps whose two slots spanned distinct jobs",
+        ).labels(**lab)
+        self._g_inflight = reg.gauge(
+            "mtpu_service_pipeline_inflight",
+            "waves currently in flight past the dispatch slot",
+        ).labels(**lab)
+        self._c_mesh_steals = reg.counter(
+            "mtpu_service_mesh_steals_total",
+            "resident-job migrations to idle device groups",
+        ).labels(**lab)
+        self._c_mesh_rebalance = reg.counter(
+            "mtpu_service_mesh_rebalance_bytes_total",
+            "bytes re-uploaded by job migrations",
+        ).labels(**lab)
+        self._c_group_waves = reg.counter(
+            "mtpu_service_group_waves_total",
+            "waves dispatched per device group",
+        )
+        # materialize every series at 0 so /metrics exposes the full
+        # schema from the first scrape (a dashboard must not have to
+        # wait for the first wave to learn the series names)
+        for child in (
+            self._c_waves, self._c_device_steps, self._c_host_completed,
+            self._c_rebuckets, self._c_static_seeds, self._c_spec_waves,
+            self._c_generic_waves, self._c_fused, self._c_fallbacks,
+            self._c_overlapped, self._c_multi_job, self._c_mesh_steals,
+            self._c_mesh_rebalance,
+        ):
+            child.inc(0)
+        self._g_inflight.set(0)
+        for gid in range(self.mesh.n_groups if self.mesh else 1):
+            self._c_group_waves.labels(
+                engine=self._eid, group=str(gid)
+            ).inc(0)
         #: the engine's monotone specialization bucket (widens as jobs
         #: with new phase groups arrive; a wider kernel stays sound
         #: for every lane) and the warmups already launched for it
         self._union_phases = None
         self._kernel_warming: set = set()
         self._warmup_threads: List[threading.Thread] = []
-        # pipeline occupancy/overlap counters (/stats pipeline.*)
-        self.pipeline_overlapped = 0
-        self.pipeline_multi_job = 0
-        self._pipeline_inflight = 0
         self._first_wave_t: Optional[float] = None
         self._last_wave_t: Optional[float] = None
         self._wave_cold_s: Optional[float] = None
@@ -507,6 +583,66 @@ class AnalysisEngine:
         self._checkpoint_dir: Optional[str] = self.cfg.checkpoint_dir
         self._drained = threading.Event()
         self._draining = False
+        #: where the drain's final flight-recorder flush landed (None
+        #: until drained; /stats observe.flight_dump mirrors it)
+        self.flight_dump_path: Optional[str] = None
+
+    # -- legacy counter names (views over the registry series) ---------
+    @property
+    def waves_total(self) -> int:
+        return int(self._c_waves.value)
+
+    @property
+    def device_steps(self) -> int:
+        return int(self._c_device_steps.value)
+
+    @property
+    def host_completed(self) -> int:
+        return int(self._c_host_completed.value)
+
+    @property
+    def kernel_rebuckets(self) -> int:
+        return int(self._c_rebuckets.value)
+
+    @property
+    def static_seeds_dropped(self) -> int:
+        return int(self._c_static_seeds.value)
+
+    @property
+    def spec_waves(self) -> int:
+        return int(self._c_spec_waves.value)
+
+    @property
+    def generic_waves(self) -> int:
+        return int(self._c_generic_waves.value)
+
+    @property
+    def kernel_fused_steps(self) -> int:
+        return int(self._c_fused.value)
+
+    @property
+    def kernel_fallbacks(self) -> int:
+        return int(self._c_fallbacks.value)
+
+    @property
+    def pipeline_overlapped(self) -> int:
+        return int(self._c_overlapped.value)
+
+    @property
+    def pipeline_multi_job(self) -> int:
+        return int(self._c_multi_job.value)
+
+    @property
+    def _pipeline_inflight(self) -> int:
+        return int(self._g_inflight.value)
+
+    @property
+    def mesh_steals(self) -> int:
+        return int(self._c_mesh_steals.value)
+
+    @property
+    def mesh_rebalance_bytes(self) -> int:
+        return int(self._c_mesh_rebalance.value)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "AnalysisEngine":
@@ -578,6 +714,18 @@ class AnalysisEngine:
         # warmup launches once draining)
         for thread in self._warmup_threads:
             thread.join(timeout=60.0)
+        # the final flight-recorder flush: the drained service leaves
+        # its span timeline beside its checkpoints (Perfetto JSON), so
+        # a post-mortem sees what the waves were doing at shutdown
+        if observe.enabled():
+            try:
+                dump_dir = observe.out_dir() or self.checkpoint_dir()
+                self.flight_dump_path = observe.export_trace(
+                    os.path.join(dump_dir, "flight_recorder.trace.json")
+                )
+            except Exception:
+                log.debug("drain flight-recorder flush failed",
+                          exc_info=True)
         self._drained.set()
 
     def close(self) -> None:
@@ -612,7 +760,7 @@ class AnalysisEngine:
         if len(code) <= self.code_cap:
             return
         self.code_cap = code_cap_bucket(len(code), floor=self.code_cap)
-        self.kernel_rebuckets += 1
+        self._c_rebuckets.inc()
         self.code_cache.rebucket(self.code_cap)
         self._rebuild_arena_rows()
         for resident in self._tracks.values():
@@ -654,7 +802,7 @@ class AnalysisEngine:
                     else None
                 ),
             )
-            self.static_seeds_dropped += track.static_seeds_dropped
+            self._c_static_seeds.inc(track.static_seeds_dropped)
             self._install_code(track)
             self._tracks[job.id] = track
         if self.mesh is not None:
@@ -695,9 +843,10 @@ class AnalysisEngine:
                 for lane in self.alloc.lanes_of(s)
             ]
             self._install_code(track)
-            self.mesh_steals += 1
-            self.mesh_rebalance_bytes += len(track.job.code) + sum(
-                len(c) for c in track.corpus
+            self._c_mesh_steals.inc()
+            self._c_mesh_rebalance.inc(
+                len(track.job.code)
+                + sum(len(c) for c in track.corpus)
             )
             log.info(
                 "mesh rebalance: job %s moved group %d -> %d",
@@ -845,24 +994,24 @@ class AnalysisEngine:
                 nxt = None
             if inflight is not None:
                 if nxt is not None:
-                    self.pipeline_overlapped += 1
+                    self._c_overlapped.inc()
                     jobs = set(inflight["wave_inputs"]) | set(
                         nxt["wave_inputs"]
                     )
                     if len(jobs) > 1:
                         # the two pipeline slots hold waves spanning
                         # more than one job
-                        self.pipeline_multi_job += 1
+                        self._c_multi_job.inc()
                 try:
                     self._harvest_wave(inflight)
                 except Exception:
                     log.exception("service wave loop fault; jobs failed")
                 inflight = None
-                self._pipeline_inflight = 0
+                self._g_inflight.set(0)
             if nxt is not None:
                 if self.pipeline_enabled:
                     inflight = nxt
-                    self._pipeline_inflight = 1
+                    self._g_inflight.set(1)
                 else:
                     try:
                         self._harvest_wave(nxt)
@@ -878,7 +1027,7 @@ class AnalysisEngine:
                 self._harvest_wave(inflight)
             except Exception:
                 log.exception("drain harvest of the in-flight wave failed")
-            self._pipeline_inflight = 0
+            self._g_inflight.set(0)
 
     @property
     def pipeline_enabled(self) -> bool:
@@ -938,34 +1087,40 @@ class AnalysisEngine:
         try:
             import jax
 
-            # buffer donation: the seeded batch is never read again on
-            # the host (retries rebuild it from `calldata`), so the
-            # device reuses its buffers for the output. CPU ignores
-            # donation with a warning, so gate it.
-            donate = jax.default_backend() != "cpu"
-            table = self._table()
-            spec = self._wave_kernel(wave_inputs, batch, table, donate)
-            if spec is not None:
-                kernel, _phases = spec
-                record["spec"] = True
-                self.spec_waves += 1
-                record["out"], record["steps"], record["fused"] = kernel.run(
-                    batch,
-                    table,
-                    self._fuse(),
-                    max_steps=self.cfg.steps_per_wave,
-                    track_coverage=True,
-                    donate=donate,
-                )
-            else:
-                self.generic_waves += 1
-                runner = run_donated if donate else run
-                record["out"], record["steps"] = runner(
-                    batch,
-                    table,
-                    max_steps=self.cfg.steps_per_wave,
-                    track_coverage=True,
-                )
+            with trace(
+                "service.wave.dispatch", track="service",
+                jobs=len(wave_inputs),
+            ):
+                # buffer donation: the seeded batch is never read again
+                # on the host (retries rebuild it from `calldata`), so
+                # the device reuses its buffers for the output. CPU
+                # ignores donation with a warning, so gate it.
+                donate = jax.default_backend() != "cpu"
+                table = self._table()
+                spec = self._wave_kernel(wave_inputs, batch, table, donate)
+                if spec is not None:
+                    kernel, _phases = spec
+                    record["spec"] = True
+                    self._c_spec_waves.inc()
+                    record["out"], record["steps"], record["fused"] = (
+                        kernel.run(
+                            batch,
+                            table,
+                            self._fuse(),
+                            max_steps=self.cfg.steps_per_wave,
+                            track_coverage=True,
+                            donate=donate,
+                        )
+                    )
+                else:
+                    self._c_generic_waves.inc()
+                    runner = run_donated if donate else run
+                    record["out"], record["steps"] = runner(
+                        batch,
+                        table,
+                        max_steps=self.cfg.steps_per_wave,
+                        track_coverage=True,
+                    )
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -1048,7 +1203,7 @@ class AnalysisEngine:
                 spec = self._wave_kernel(group_jobs, batch, table, donate)
                 if spec is not None:
                     kernel, _phases = spec
-                    self.spec_waves += 1
+                    self._c_spec_waves.inc()
                     grec["spec"] = True
                     grec["out"], grec["steps"], grec["fused"] = kernel.run(
                         batch,
@@ -1059,7 +1214,7 @@ class AnalysisEngine:
                         donate=donate,
                     )
                 else:
-                    self.generic_waves += 1
+                    self._c_generic_waves.inc()
                     runner = run_donated if donate else run
                     grec["out"], grec["steps"] = runner(
                         batch,
@@ -1072,7 +1227,7 @@ class AnalysisEngine:
                     raise
                 grec["failed"] = why
             record["groups"].append(grec)
-            self._group_waves[group.gid] += 1
+            self._c_group_waves.labels(engine=self._eid, group=str(group.gid)).inc()
         return record
 
     def _rebuild_batch(self, record: Dict, lo: int = 0, hi=None):
@@ -1092,7 +1247,7 @@ class AnalysisEngine:
 
     def _note_wave_timing(self, wall: float) -> None:
         now = time.monotonic()
-        self.waves_total += 1
+        self._c_waves.inc()
         if self._first_wave_t is None:
             self._first_wave_t = now
             self._wave_cold_s = wall
@@ -1142,10 +1297,20 @@ class AnalysisEngine:
                 raise record["failed"]
             # asynchronous XLA faults surface HERE, attributed to the
             # wave in this record, not to whatever the host was doing
-            jax.block_until_ready(record["steps"])
+            with trace("service.wave.harvest", track="service"):
+                jax.block_until_ready(record["steps"])
+            # the retrospective device-execution span (dispatch ->
+            # readback-ready): the service's Perfetto track
+            flight_recorder().add(
+                "wave.device",
+                record["t0"],
+                time.perf_counter(),
+                track="service",
+                jobs=len(record["wave_inputs"]),
+            )
             out, steps = record["out"], record["steps"]
             if record.get("fused") is not None:
-                self.kernel_fused_steps += int(record["fused"])
+                self._c_fused.inc(int(record["fused"]))
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -1157,7 +1322,7 @@ class AnalysisEngine:
             if record.get("spec"):
                 # the retry ladder always re-dispatches GENERIC: a
                 # specialized lowering must not be retried into itself
-                self.kernel_fallbacks += 1
+                self._c_fallbacks.inc()
             try:
                 out, steps = run_resilient(
                     self._rebuild_batch(record),
@@ -1179,7 +1344,7 @@ class AnalysisEngine:
             )
         )
         steps = int(steps)
-        self.device_steps += steps * self.alloc.n_lanes
+        self._c_device_steps.inc(steps * self.alloc.n_lanes)
         finished: List[_JobTrack] = []
         for track in list(self._tracks.values()):
             if track.job.id not in wave_inputs:
@@ -1221,7 +1386,7 @@ class AnalysisEngine:
                 jax.block_until_ready(grec["steps"])
                 out, steps = grec["out"], grec["steps"]
                 if grec.get("fused") is not None:
-                    self.kernel_fused_steps += int(grec["fused"])
+                    self._c_fused.inc(int(grec["fused"]))
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
@@ -1231,7 +1396,7 @@ class AnalysisEngine:
                     detail=str(why),
                 )
                 if grec.get("spec"):
-                    self.kernel_fallbacks += 1
+                    self._c_fallbacks.inc()
                 try:
                     out, steps = run_resilient(
                         jax.device_put(
@@ -1261,7 +1426,7 @@ class AnalysisEngine:
             for full, part in zip(fields, arrays):
                 full[grec["lo"] : grec["hi"]] = part
             steps_by_group[gid] = int(steps)
-            self.device_steps += int(steps) * (grec["hi"] - grec["lo"])
+            self._c_device_steps.inc(int(steps) * (grec["hi"] - grec["lo"]))
         self._note_wave_timing(time.perf_counter() - record["t0"])
         if fields is None:
             return  # every live group failed; jobs already settled
@@ -1378,7 +1543,7 @@ class AnalysisEngine:
         except Exception as why:  # analyze_one_payload already catches;
             result = {"issues": [], "states": 0, "error": str(why)}
         self._host_inflight.pop(job.id, None)
-        self.host_completed += 1
+        self._c_host_completed.inc()
         self._finalize(job, track, outcome, host_result=result)
 
     def _finalize(
@@ -1528,15 +1693,31 @@ class AnalysisEngine:
         return out
 
     def stats(self) -> Dict:
+        """The /stats tree. The wave-loop counters all come out of ONE
+        registry snapshot (a single lock acquisition), so the numbers
+        are point-in-time consistent with each other even while the
+        wave thread is mutating them; the queue/arena/cache blocks are
+        internally consistent behind their own locks. Pinned by
+        `schema_version`."""
         from mythril_tpu.support.resilience import DegradationLog
 
         now = time.monotonic()
+        snap = observe.registry().snapshot()
+
+        def sv(name: str, **labels) -> float:
+            return snap.get(name, {}).get(
+                _label_key(dict(labels, engine=self._eid)), 0
+            )
+
+        waves_total = int(sv("mtpu_service_waves_total"))
+        overlapped = int(sv("mtpu_service_pipeline_overlapped_total"))
         span = (
             (self._last_wave_t - self._first_wave_t)
-            if self.waves_total > 1
+            if waves_total > 1
             else None
         )
         return {
+            "schema_version": STATS_SCHEMA_VERSION,
             "uptime_s": round(now - self.started_t, 3),
             "draining": self._draining,
             "queue": {
@@ -1549,13 +1730,11 @@ class AnalysisEngine:
             },
             "arena": self.alloc.occupancy(),
             "waves": {
-                "count": self.waves_total,
+                "count": waves_total,
                 "steps_per_wave": self.cfg.steps_per_wave,
-                "device_steps": self.device_steps,
+                "device_steps": int(sv("mtpu_service_device_steps_total")),
                 "rate_per_s": (
-                    round((self.waves_total - 1) / span, 3)
-                    if span
-                    else 0.0
+                    round((waves_total - 1) / span, 3) if span else 0.0
                 ),
                 "cold_wave_s": (
                     round(self._wave_cold_s, 4)
@@ -1570,17 +1749,21 @@ class AnalysisEngine:
             },
             "warm": {
                 "code_cap": self.code_cap,
-                "kernel_rebuckets": self.kernel_rebuckets,
+                "kernel_rebuckets": int(
+                    sv("mtpu_service_kernel_rebuckets_total")
+                ),
                 "code_cache": self.code_cache.stats(),
             },
             "pipeline": {
                 "enabled": self.pipeline_enabled,
-                "inflight": self._pipeline_inflight,
-                "overlapped_waves": self.pipeline_overlapped,
-                "multi_job_overlaps": self.pipeline_multi_job,
+                "inflight": int(sv("mtpu_service_pipeline_inflight")),
+                "overlapped_waves": overlapped,
+                "multi_job_overlaps": int(
+                    sv("mtpu_service_pipeline_multi_job_total")
+                ),
                 "wave_overlap_ratio": (
-                    round(self.pipeline_overlapped / self.waves_total, 3)
-                    if self.waves_total
+                    round(overlapped / waves_total, 3)
+                    if waves_total
                     else 0.0
                 ),
             },
@@ -1589,12 +1772,19 @@ class AnalysisEngine:
                 # request past the visible device count clamps)
                 "devices": self.mesh.n_devices if self.mesh else 1,
                 "groups": self.alloc.groups,
-                "steals": self.mesh_steals,
-                "rebalance_bytes": self.mesh_rebalance_bytes,
+                "steals": int(sv("mtpu_service_mesh_steals_total")),
+                "rebalance_bytes": int(
+                    sv("mtpu_service_mesh_rebalance_bytes_total")
+                ),
                 "per_device": [
                     dict(
                         g,
-                        waves=self._group_waves[g["group"]],
+                        waves=int(
+                            sv(
+                                "mtpu_service_group_waves_total",
+                                group=str(g["group"]),
+                            )
+                        ),
                         devices=(
                             [
                                 str(d)
@@ -1618,13 +1808,20 @@ class AnalysisEngine:
             },
             "static": {
                 "summaries_cached": self.code_cache.static_summaries,
-                "seeds_dropped": self.static_seeds_dropped,
+                "seeds_dropped": int(
+                    sv("mtpu_service_static_seeds_dropped_total")
+                ),
             },
             "kernel": self._kernel_stats(),
             "host_pool": {
                 "workers": max(1, self.cfg.host_workers),
                 "inflight": len(self._host_inflight),
-                "completed": self.host_completed,
+                "completed": int(sv("mtpu_service_host_completed_total")),
+            },
+            "observe": {
+                "enabled": observe.enabled(),
+                "spans_recorded": flight_recorder().recorded,
+                "flight_dump": getattr(self, "flight_dump_path", None),
             },
             "degradation": DegradationLog().counts_since(self._deg_marker),
         }

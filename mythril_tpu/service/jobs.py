@@ -124,16 +124,26 @@ class JobQueue:
     def submit(self, job: Job) -> None:
         """Admit `job` or raise QueueRefusal with the backpressure
         reason."""
+        from mythril_tpu.observe.registry import registry
+
+        admissions = registry().counter(
+            "mtpu_service_admissions_total",
+            "service job admissions by outcome "
+            "(accepted / rejected-full / rejected-draining)",
+        )
         with self._lock:
             if self.draining:
                 self.rejected_draining += 1
+                admissions.labels(outcome="rejected-draining").inc()
                 raise QueueRefusal("draining", "service is draining")
             if len(self._pending) >= self.capacity:
                 self.rejected_full += 1
+                admissions.labels(outcome="rejected-full").inc()
                 raise QueueRefusal(
                     "full", f"queue full ({self.capacity} pending)"
                 )
             self.accepted += 1
+            admissions.labels(outcome="accepted").inc()
             self._pending.append(job)
             self._jobs[job.id] = job
             self._settled.notify_all()
@@ -163,9 +173,20 @@ class JobQueue:
             return self._jobs.get(job_id)
 
     def settle(self, job: Job, state: str) -> None:
+        from mythril_tpu.observe.registry import registry
+
+        reg = registry()
+        reg.counter(
+            "mtpu_service_jobs_settled_total",
+            "jobs reaching a terminal state, by state",
+        ).labels(state=state).inc()
         with self._lock:
             job.state = state
             job.finished_t = time.monotonic()
+            reg.histogram(
+                "mtpu_service_job_latency_seconds",
+                "submit-to-terminal latency",
+            ).observe(job.finished_t - job.created_t)
             self._settled.notify_all()
 
     def mark(self, job: Job, state: str) -> None:

@@ -11,6 +11,12 @@ Endpoints:
   GET  /healthz                liveness + draining flag
   GET  /stats                  queue depth, lane occupancy, wave rate,
                                warm-cache counters, degradation counts
+                               (schema_version-pinned)
+  GET  /metrics                the whole metrics registry in Prometheus
+                               text exposition format (observe/)
+  GET  /trace                  recent flight-recorder spans as JSON
+                               (?n=512; ?format=perfetto for a
+                               Perfetto-loadable trace document)
   POST /v1/drain               begin the graceful drain (also SIGTERM)
 
 Drain semantics (SIGTERM or /v1/drain): new submissions get 503, the
@@ -62,6 +68,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, status: int, body: str, content_type: str) -> None:
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _query(self) -> Tuple[str, Dict[str, str]]:
         path, _, query = self.path.partition("?")
         params = {}
@@ -86,6 +100,38 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/stats":
             self._reply(200, self.engine.stats())
+            return
+        if path == "/metrics":
+            # the whole registry, Prometheus text exposition (0.0.4)
+            from mythril_tpu import observe
+
+            self._reply_text(
+                200,
+                observe.registry().prometheus_text(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        if path == "/trace":
+            from mythril_tpu import observe
+            from mythril_tpu.observe.spans import flight_recorder
+
+            try:
+                n = min(int(params.get("n", 512)), 8192)
+            except ValueError:
+                n = 512
+            spans = flight_recorder().tail(n)
+            if params.get("format") == "perfetto":
+                self._reply(200, observe.to_perfetto(spans))
+                return
+            self._reply(
+                200,
+                {
+                    "schema_version": observe.SCHEMA_VERSION,
+                    "recorded": flight_recorder().recorded,
+                    "dropped": flight_recorder().dropped,
+                    "spans": [span.as_dict() for span in spans],
+                },
+            )
             return
         match = _JOB_PATH.match(path)
         if match:

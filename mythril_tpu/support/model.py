@@ -76,8 +76,13 @@ def get_model(
     )
     hit = _cache.get(key)
     if hit is not None:
+        from mythril_tpu.observe.solverstats import ORIGIN_MEMO, record_query
+
         _cache.move_to_end(key)
         status, model = hit
+        # attribution: the memo pre-empted a solve — the table's
+        # "memo" row is how many engine queries never reached a solver
+        record_query(ORIGIN_MEMO, str(status))
         if status == sat:
             return model
         if status == unsat:

@@ -2,9 +2,14 @@
 
 Round-2 verdict: "no counter splits host wall time into
 step/fork/solve, so the states/sec can't be diagnosed — instrument
-before optimizing." One process-wide singleton accumulates wall
-seconds per phase; the analyzer logs it next to the solver statistics
-(-v4) and ships it in the per-contract results.
+before optimizing." Originally this singleton kept its own defaultdict
+accumulators; since the unified telemetry layer (PR 7) the BACKING
+STORE is the process-wide metrics registry — one histogram
+``mtpu_phase_wall_seconds{phase=...}`` per phase, scraped at /metrics
+— and this class is a *delta view* over it: `reset()` takes a marker,
+`wall`/`count`/`as_dict()` report what accumulated since. The -v4 log
+lines and the per-contract result fields keep their exact shape; the
+duplicate accumulation path is gone.
 
 Phases and their relations:
   step         execute_state: one instruction on one path state
@@ -21,23 +26,65 @@ do solver calls cost" separately rather than summing to the total.
 from __future__ import annotations
 
 import time
-from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, Tuple
 
 from mythril_tpu.support.support_utils import Singleton
 
+_METRIC_NAME = "mtpu_phase_wall_seconds"
+
 
 class PhaseProfile(object, metaclass=Singleton):
-    """Wall-clock per analysis phase (not thread-safe, like every
-    other engine singleton — one analysis per process)."""
+    """Delta view over the registry's per-phase wall histograms.
+
+    Thread-safe now (the registry lock guards every update) — but the
+    reset/report cycle is still scoped like every other engine
+    singleton: one analysis per process at a time."""
 
     def __init__(self) -> None:
+        from mythril_tpu.observe.registry import registry
+
+        self._hist = registry().histogram(
+            _METRIC_NAME,
+            "host analysis wall seconds per pipeline phase",
+        )
+        self._marker: Dict[str, Tuple[float, int]] = {}
         self.reset()
 
+    # -- the backing totals (process-cumulative) -----------------------
+    def _totals(self) -> Dict[str, Tuple[float, int]]:
+        out: Dict[str, Tuple[float, int]] = {}
+        with self._hist._lock:
+            for key, row in self._hist._series.items():
+                phase = dict(key).get("phase", "?")
+                out[phase] = (row[1], row[2])
+        return out
+
     def reset(self) -> None:
-        self.wall: Dict[str, float] = defaultdict(float)
-        self.count: Dict[str, int] = defaultdict(int)
+        """Start a fresh per-contract window: the registry keeps its
+        cumulative series (the /metrics view), this view reports only
+        what lands after the marker."""
+        self._marker = self._totals()
+
+    # -- the per-window views (shape-compatible with the original) ----
+    @property
+    def wall(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for phase, (total, _count) in self._totals().items():
+            base = self._marker.get(phase, (0.0, 0))[0]
+            delta = total - base
+            if delta > 1e-12:
+                out[phase] = delta
+        return out
+
+    @property
+    def count(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for phase, (_total, total_n) in self._totals().items():
+            base = self._marker.get(phase, (0.0, 0))[1]
+            if total_n - base > 0:
+                out[phase] = total_n - base
+        return out
 
     @contextmanager
     def measure(self, phase: str):
@@ -45,31 +92,33 @@ class PhaseProfile(object, metaclass=Singleton):
         try:
             yield
         finally:
-            self.wall[phase] += time.perf_counter() - t0
-            self.count[phase] += 1
+            self._hist.labels(phase=phase).observe(
+                time.perf_counter() - t0
+            )
 
     def add(self, phase: str, seconds: float, n: int = 1) -> None:
-        self.wall[phase] += seconds
-        self.count[phase] += n
+        self._hist.labels(phase=phase).add_raw(seconds, n)
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
+        wall, count = self.wall, self.count
         return {
             phase: {
-                "wall_s": round(self.wall[phase], 3),
-                "count": self.count[phase],
+                "wall_s": round(wall.get(phase, 0.0), 3),
+                "count": count.get(phase, 0),
             }
-            for phase in sorted(self.wall)
+            for phase in sorted(set(wall) | set(count))
         }
 
     def __str__(self) -> str:
-        if not self.wall:
+        wall, count = self.wall, self.count
+        if not wall and not count:
             return "(no phases recorded)"
         lines = ["%-12s %10s %10s %12s" % ("phase", "wall s", "count", "avg ms")]
-        for phase in sorted(self.wall, key=self.wall.get, reverse=True):
-            n = max(1, self.count[phase])
+        for phase in sorted(wall, key=wall.get, reverse=True):
+            n = max(1, count.get(phase, 0))
             lines.append(
                 "%-12s %10.3f %10d %12.2f"
-                % (phase, self.wall[phase], self.count[phase],
-                   1000.0 * self.wall[phase] / n)
+                % (phase, wall[phase], count.get(phase, 0),
+                   1000.0 * wall[phase] / n)
             )
         return "\n".join(lines)

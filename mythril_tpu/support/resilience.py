@@ -83,6 +83,20 @@ class DegradationReason:
     PREPASS_FAILED = "prepass-failed"
 
 
+#: observers notified after every DegradationLog.record — the
+#: telemetry layer (mythril_tpu/observe) registers its flight-recorder
+#: auto-dump here. This module stays dependency-free: hooks are plain
+#: callables `(reason, site)` and a broken hook is contained.
+_DEGRADATION_HOOKS: List[Callable[[str, str], None]] = []
+
+
+def add_degradation_hook(fn: Callable[[str, str], None]) -> None:
+    """Register an observer called (outside the log's lock) after every
+    degradation record. Idempotent per function object."""
+    if fn not in _DEGRADATION_HOOKS:
+        _DEGRADATION_HOOKS.append(fn)
+
+
 class DegradationLog(object, metaclass=Singleton):
     """Process-global degradation record: full per-reason counts plus a
     bounded tail of detailed events (a hung corpus can degrade
@@ -126,6 +140,11 @@ class DegradationLog(object, metaclass=Singleton):
             f" ({contract})" if contract else "",
             f": {detail}" if detail else "",
         )
+        for hook in list(_DEGRADATION_HOOKS):
+            try:
+                hook(reason, site)
+            except Exception:  # telemetry must never sink the run
+                log.debug("degradation hook failed", exc_info=True)
 
     def marker(self) -> Dict[str, int]:
         """Snapshot for delta accounting (the log is process-global but

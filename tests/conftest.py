@@ -81,6 +81,14 @@ def pytest_configure(config):
         "CodeCache kernel eviction; CPU-only — runs in tier-1, "
         "selectable with -m specialize)",
     )
+    config.addinivalue_line(
+        "markers",
+        "observe: unified telemetry suite (mythril_tpu/observe: "
+        "metrics registry + Prometheus exposition, structured spans + "
+        "Perfetto export + flight recorder, solver attribution, "
+        "routing feature log, stats-merge policy; CPU-only — runs in "
+        "tier-1, selectable with -m observe)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

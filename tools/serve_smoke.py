@@ -108,6 +108,25 @@ def main() -> int:
     for t in threads:
         t.join()
     stats = client.stats()
+    # /metrics right after /stats: the exposition must parse and the
+    # key wave/pipeline/kernel/mesh series must be present and
+    # consistent (counters are monotone, so >= the /stats snapshot)
+    import urllib.request
+
+    metrics_text = (
+        urllib.request.urlopen(server.url + "/metrics").read().decode()
+    )
+
+    def metric_total(name: str):
+        total, found = 0.0, False
+        for line in metrics_text.splitlines():
+            if line.startswith("#"):
+                continue
+            if line.startswith(name + "{") or line.startswith(name + " "):
+                found = True
+                total += float(line.rsplit(" ", 1)[1])
+        return total if found else None
+
     warm_latencies = sorted(lat for lat, _ in warm.values())
     warm_p50 = statistics.median(warm_latencies)
 
@@ -127,6 +146,30 @@ def main() -> int:
         "drain": {},
     }
     try:
+        # -- telemetry exposition (ISSUE 7) ----------------------------
+        assert stats.get("schema_version") == 2, (
+            f"/stats schema_version missing/unexpected: "
+            f"{stats.get('schema_version')}"
+        )
+        for series in (
+            "mtpu_service_waves_total",
+            "mtpu_service_pipeline_overlapped_total",
+            "mtpu_service_wave_kind_total",
+            "mtpu_service_mesh_steals_total",
+            "mtpu_service_admissions_total",
+        ):
+            assert f"# TYPE {series} " in metrics_text, (
+                f"/metrics lost the {series} series"
+            )
+            assert metric_total(series) is not None, (
+                f"/metrics has no samples for {series}"
+            )
+        assert metric_total("mtpu_service_waves_total") >= (
+            stats["waves"]["count"]
+        ), "metrics wave counter behind the /stats snapshot"
+        assert metric_total("mtpu_service_admissions_total") >= 5, (
+            "admission counter did not track the submissions"
+        )
         assert cold_job["state"] == "done", f"cold job: {cold_job}"
         assert len(warm) == 4, f"expected 4 warm reports, got {len(warm)}"
         for job_id, (_, report) in warm.items():
@@ -164,6 +207,16 @@ def main() -> int:
             )
             assert kernel.get("fallbacks", 0) == 0, kernel
         assert drained, "drain did not complete"
+        # the drain's final flight-recorder flush: the span timeline
+        # must land beside the checkpoints as Perfetto-loadable JSON
+        dump = server.engine.flight_dump_path
+        assert dump and os.path.exists(dump), (
+            f"drain left no flight-recorder flush: {dump}"
+        )
+        with open(dump) as fp:
+            doc = json.load(fp)
+        assert doc.get("traceEvents"), "flight dump holds no spans"
+        summary["flight_dump"] = dump
         for job_id in drain_ids:
             job = server.engine.queue.get(job_id)
             assert job is not None, f"accepted job {job_id} vanished"
