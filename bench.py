@@ -811,9 +811,57 @@ def _emit(record: dict, stage: str) -> None:
     print(json.dumps(record), flush=True)
 
 
+#: run-scoped markers for the solver flight-recorder fields: every
+#: _emit reports the loss waterfall / capture count / cdcl-sat total
+#: over the SAME window (main() start), so
+#: sum(solver_loss_reasons.values()) == cdcl_sat_verdicts holds on
+#: every printed record
+_SOLVER_RUN_MARKER = None
+_CDCL_SAT_BASE = 0
+
+
+def _mark_solver_run() -> None:
+    global _SOLVER_RUN_MARKER, _CDCL_SAT_BASE
+    from mythril_tpu import observe
+    from mythril_tpu.laser.smt.solver.solver_statistics import (
+        SolverStatistics,
+    )
+
+    _SOLVER_RUN_MARKER = observe.solver_marker()
+    _CDCL_SAT_BASE = SolverStatistics().cdcl_sat_count
+
+
+def _solver_flight_fields(record: dict) -> None:
+    """The flight-recorder scorecard (ISSUE 8): host-won loss reasons,
+    captured-corpus size, and the matching run-scoped cdcl-sat count."""
+    if _SOLVER_RUN_MARKER is None:
+        return
+    try:
+        from mythril_tpu import observe
+        from mythril_tpu.laser.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        record["solver_loss_reasons"] = observe.loss_reasons(
+            since=_SOLVER_RUN_MARKER, verdict="sat"
+        )
+        record["solver_loss_reasons_all"] = observe.loss_reasons(
+            since=_SOLVER_RUN_MARKER
+        )
+        record["captured_queries"] = observe.captured_total(
+            since=_SOLVER_RUN_MARKER
+        )
+        record["cdcl_sat_verdicts"] = (
+            SolverStatistics().cdcl_sat_count - _CDCL_SAT_BASE
+        )
+    except Exception as e:
+        print(f"bench: solver flight fields failed: {e!r}", file=sys.stderr)
+
+
 def _refresh_headline(record: dict, dev: dict) -> None:
     """(Re)derive the cross-phase headline fields from the phase data
     currently in the record."""
+    _solver_flight_fields(record)
     record["value"] = round(dev["rate"], 1) if "rate" in dev else None
     vs_baseline = None
     if record.get("corpus_wall_s") and record.get("host_only_wall_s"):
@@ -856,7 +904,24 @@ def main(final_attempt: bool = False) -> None:
         # telemetry defaults (ISSUE 7): populated by the corpus legs
         "solver_attribution": {},
         "trace_overlap_frac": 0.0,
+        # flight-recorder defaults (ISSUE 8): refreshed at every emit
+        "solver_loss_reasons": {},
+        "captured_queries": 0,
+        "cdcl_sat_verdicts": 0,
     }
+    _mark_solver_run()
+    capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
+    if capture_dir:
+        # leave a hard-query corpus behind for solverlab tuning
+        # (ROADMAP item 1): every query this bench solves becomes a
+        # replayable artifact
+        try:
+            from mythril_tpu import observe as _observe
+
+            _observe.configure_capture(capture_dir)
+            record["capture_dir"] = capture_dir
+        except Exception as e:
+            print(f"bench: query capture unavailable: {e!r}", file=sys.stderr)
     if os.environ.get("MYTHRIL_BENCH_NO_OBSERVE"):
         # the telemetry-overhead differential leg: spans/attribution/
         # routing recording off, record fields stay at their defaults

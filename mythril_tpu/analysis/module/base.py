@@ -52,8 +52,13 @@ class DetectionModule(ABC):
         self.cache = set()
 
     def execute(self, target: GlobalState) -> Optional[List[Issue]]:
+        from mythril_tpu.observe.querylog import query_context
+
         log.debug("Entering analysis module: %s", self.__class__.__name__)
-        result = self._execute(target)
+        # solver queries issued inside a module carry the "module"
+        # origin in the query flight recorder (observe/querylog.py)
+        with query_context("module"):
+            result = self._execute(target)
         log.debug("Exiting analysis module: %s", self.__class__.__name__)
         return result
 

@@ -1,0 +1,371 @@
+"""`myth solverlab`: the offline solver replay lab.
+
+A corpus captured with ``--capture-queries DIR`` (observe/querylog.py)
+holds every solved SMT query as a content-addressed, replayable
+artifact. This module re-runs such a corpus against any engine matrix
+— host CDCL, the on-chip portfolio per shape bucket, or the full
+production race funnel — and reports per-engine verdict/wall/agreement
+tables plus the funnel-loss waterfall. Portfolio tuning (ROADMAP item
+1: "make the on-device solver actually win") iterates here in seconds
+on a fixed query set instead of re-running full corpus analyses in
+minutes.
+
+Engines:
+
+- ``host``    native CDCL alone (device gate closed), conflict-budgeted
+              so the replay verdict is a pure function of the query —
+              this leg must reproduce the live verdicts
+- ``device``  the portfolio alone: compile to the shape bucket, run the
+              stochastic local search, validate any witness by concrete
+              evaluation (an incomplete engine: "unknown" proves
+              nothing and counts as *incomplete*, not disagreement)
+- ``race``    the production funnel with the device gate forced open
+              (sprint -> race -> marathon), answering "would the race
+              win this query today?"
+
+``--shard I/N`` replays only the content-hash shard ``I`` — the same
+deterministic partition the corpus driver uses, so a mesh of N hosts
+replays a large corpus in parallel with no coordination.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mythril_tpu.observe import querylog
+
+log = logging.getLogger(__name__)
+
+REPORT_SCHEMA_VERSION = 1
+
+ENGINES = ("host", "device", "race")
+
+#: replay verdicts beyond the solver's sat/unsat/unknown
+UNSUPPORTED = "unsupported"  # outside the device language / limb cap
+INVALID = "invalid"  # witness failed the concrete soundness gate
+ERROR = "error"  # engine raised; the artifact names the query
+
+_DECIDED = ("sat", "unsat")
+
+
+def parse_shard(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``I/N`` -> (I, N); validates bounds."""
+    if not spec:
+        return None
+    try:
+        index_s, count_s = spec.split("/", 1)
+        index, count = int(index_s), int(count_s)
+    except ValueError:
+        raise ValueError(f"--shard wants I/N, got {spec!r}")
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(f"--shard index out of range: {spec!r}")
+    return index, count
+
+
+def shard_corpus(
+    corpus: List[Dict], shard: Optional[Tuple[int, int]]
+) -> List[Dict]:
+    """The deterministic content-hash partition (mesh replay)."""
+    if shard is None:
+        return corpus
+    index, count = shard
+    return [
+        a for a in corpus if int(a["sha"][:16], 16) % count == index
+    ]
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
+
+
+def _rebuild(artifact: Dict) -> List:
+    return querylog.deserialize_terms(artifact["program"])
+
+
+def _replay_host(lowered: List, timeout_ms: int) -> str:
+    """The CDCL alone, conflict-budgeted: deterministic given the
+    query whenever the wall valve doesn't fire (same contract as
+    --deterministic-solving)."""
+    from mythril_tpu.laser.smt.solver.solver import check_terms
+    from mythril_tpu.support.support_args import args as _args
+
+    restore = (_args.device_solving, _args.parallel_solving)
+    _args.device_solving = "never"
+    _args.parallel_solving = False
+    try:
+        verdict, _model = check_terms(
+            lowered, timeout_ms=timeout_ms, conflict_budget=timeout_ms * 8
+        )
+    finally:
+        _args.device_solving, _args.parallel_solving = restore
+    return verdict
+
+
+def _replay_device(
+    lowered: List, candidates: int, steps: int
+) -> Tuple[str, Optional[str]]:
+    """The portfolio alone; returns (verdict, loss_reason). A found
+    witness is believed only after concretely satisfying every root —
+    the same soundness gate production models pass."""
+    from mythril_tpu.laser.smt.evalterm import eval_term
+    from mythril_tpu.laser.smt.solver import portfolio
+
+    prog, compile_loss = portfolio.compile_program_ex(lowered)
+    if prog is None:
+        return UNSUPPORTED, compile_loss
+    if not prog.var_slots:
+        return UNSUPPORTED, querylog.LOSS_QUERY_TRIVIAL
+    assignment = portfolio.device_check(
+        lowered, candidates=candidates, steps=steps, prog=prog
+    )
+    if assignment is None:
+        return "unknown", querylog.LOSS_SLS_NONCONVERGED
+    try:
+        if all(eval_term(c, assignment) for c in lowered):
+            return "sat", None
+    except Exception:
+        log.debug("witness evaluation failed", exc_info=True)
+    return INVALID, querylog.LOSS_WITNESS_INVALID
+
+
+def _replay_race(lowered: List, timeout_ms: int) -> str:
+    """The production funnel with the device gate forced open."""
+    from mythril_tpu.laser.smt.solver.solver import check_terms
+    from mythril_tpu.support.support_args import args as _args
+
+    restore = (_args.device_solving, _args.parallel_solving)
+    _args.device_solving = "always"
+    _args.parallel_solving = True
+    try:
+        verdict, _model = check_terms(lowered, timeout_ms=timeout_ms)
+    finally:
+        _args.device_solving, _args.parallel_solving = restore
+    return verdict
+
+
+def _classify(live: str, replayed: str) -> str:
+    if replayed == live:
+        return "agree"
+    if replayed in _DECIDED and live in _DECIDED:
+        return "disagree"
+    return "incomplete"
+
+
+# ---------------------------------------------------------------------------
+# the lab
+# ---------------------------------------------------------------------------
+
+
+def waterfall(corpus: Sequence[Dict]) -> Dict:
+    """The funnel-loss report of a corpus as CAPTURED: loss reasons
+    overall and restricted to host-WON (sat) queries, origins,
+    shape-bucket population, per-engine live verdicts."""
+    losses: Dict[str, int] = {}
+    losses_sat: Dict[str, int] = {}
+    origins: Dict[str, int] = {}
+    buckets: Dict[str, int] = {}
+    verdicts: Dict[str, int] = {}
+    for artifact in corpus:
+        verdict = artifact.get("verdict", "unknown")
+        verdicts[verdict] = verdicts.get(verdict, 0) + 1
+        origin = artifact.get("origin", "?")
+        origins[origin] = origins.get(origin, 0) + 1
+        reason = artifact.get("loss_reason")
+        if reason:
+            losses[reason] = losses.get(reason, 0) + 1
+            if verdict == "sat":
+                losses_sat[reason] = losses_sat.get(reason, 0) + 1
+        bucket = artifact.get("bucket")
+        key = (
+            "n{nodes}/c{consts}/r{roots}/v{vars}/L{limbs}".format(**bucket)
+            if bucket
+            else artifact.get("compile_loss") or "uncompiled"
+        )
+        buckets[key] = buckets.get(key, 0) + 1
+    return {
+        "queries": len(corpus),
+        "live_verdicts": verdicts,
+        "origins": origins,
+        "buckets": buckets,
+        "loss_waterfall": losses,
+        "loss_waterfall_sat": losses_sat,
+    }
+
+
+def replay_corpus(
+    corpus: Sequence[Dict],
+    engines: Sequence[str] = ("host", "device"),
+    timeout_ms: int = 10_000,
+    candidates: int = 64,
+    steps: int = 512,
+) -> Dict:
+    """Re-run every artifact against `engines`; returns the report
+    dict (waterfall + per-engine verdict/wall/agreement tables +
+    disagreement details). Capture is disarmed for the duration so the
+    replay never mutates the corpus it reads."""
+    for engine in engines:
+        if engine not in ENGINES:
+            raise ValueError(f"unknown engine {engine!r} (have {ENGINES})")
+    report = waterfall(corpus)
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    report["engines"] = list(engines)
+    tables: Dict[str, Dict] = {
+        engine: {
+            "verdicts": {},
+            "wall_s": 0.0,
+            "agreement": {"agree": 0, "disagree": 0, "incomplete": 0},
+        }
+        for engine in engines
+    }
+    disagreements: List[Dict] = []
+
+    prev_capture = querylog.capture_dir()
+    querylog.configure_capture(None)
+    try:
+        for artifact in corpus:
+            live = artifact.get("verdict", "unknown")
+            try:
+                lowered = _rebuild(artifact)
+            except Exception as why:
+                log.warning(
+                    "artifact %s did not rebuild: %s", artifact["sha"], why
+                )
+                for engine in engines:
+                    table = tables[engine]
+                    table["verdicts"][ERROR] = (
+                        table["verdicts"].get(ERROR, 0) + 1
+                    )
+                    table["agreement"]["incomplete"] += 1
+                continue
+            row = {"sha": artifact["sha"], "live": live}
+            for engine in engines:
+                t0 = time.perf_counter()
+                try:
+                    if engine == "host":
+                        verdict = _replay_host(lowered, timeout_ms)
+                    elif engine == "device":
+                        verdict, _loss = _replay_device(
+                            lowered, candidates, steps
+                        )
+                    else:
+                        verdict = _replay_race(lowered, timeout_ms)
+                except Exception as why:
+                    log.debug(
+                        "engine %s failed on %s: %s",
+                        engine, artifact["sha"], why, exc_info=True,
+                    )
+                    verdict = ERROR
+                wall = time.perf_counter() - t0
+                table = tables[engine]
+                table["verdicts"][verdict] = (
+                    table["verdicts"].get(verdict, 0) + 1
+                )
+                table["wall_s"] += wall
+                outcome = _classify(live, verdict)
+                table["agreement"][outcome] += 1
+                row[engine] = verdict
+                if outcome == "disagree":
+                    row["disagree"] = True
+            if row.get("disagree") and len(disagreements) < 32:
+                disagreements.append(row)
+    finally:
+        querylog.configure_capture(prev_capture)
+
+    for engine, table in tables.items():
+        table["wall_s"] = round(table["wall_s"], 3)
+        n = len(corpus)
+        table["agreement_pct"] = (
+            round(100.0 * table["agreement"]["agree"] / n, 1) if n else 100.0
+        )
+    report["replay"] = tables
+    report["disagreements"] = disagreements
+    return report
+
+
+def run(
+    corpus_dir: str,
+    mode: str = "replay",
+    engines: Sequence[str] = ("host", "device"),
+    timeout_ms: int = 10_000,
+    candidates: int = 64,
+    steps: int = 512,
+    reason: Optional[str] = None,
+    origin: Optional[str] = None,
+    shard: Optional[str] = None,
+) -> Dict:
+    """Load + filter + shard a corpus, then replay (or just report)."""
+    corpus = querylog.load_corpus(corpus_dir, reason=reason, origin=origin)
+    corpus = shard_corpus(corpus, parse_shard(shard))
+    if mode == "report":
+        report = waterfall(corpus)
+        report["schema_version"] = REPORT_SCHEMA_VERSION
+    else:
+        report = replay_corpus(
+            corpus,
+            engines=engines,
+            timeout_ms=timeout_ms,
+            candidates=candidates,
+            steps=steps,
+        )
+    report["corpus_dir"] = corpus_dir
+    report["mode"] = mode
+    if reason or origin:
+        report["filter"] = {"reason": reason, "origin": origin}
+    if shard:
+        report["shard"] = shard
+    return report
+
+
+def render_text(report: Dict) -> str:
+    """The human view: waterfall + agreement tables."""
+    lines = [
+        "solverlab: {queries} quer{y} from {dir}".format(
+            queries=report["queries"],
+            y="y" if report["queries"] == 1 else "ies",
+            dir=report.get("corpus_dir", "?"),
+        )
+    ]
+    if report.get("filter"):
+        lines.append(f"  filter: {report['filter']}")
+    if report.get("shard"):
+        lines.append(f"  shard: {report['shard']}")
+    lines.append("  live verdicts: " + _fmt_counts(report["live_verdicts"]))
+    lines.append("  origins:       " + _fmt_counts(report["origins"]))
+    lines.append("  loss waterfall (device-lost verdicts):")
+    losses = report["loss_waterfall"]
+    sat_losses = report.get("loss_waterfall_sat", {})
+    for reason in sorted(losses, key=losses.get, reverse=True):
+        lines.append(
+            f"    {reason:<22} {losses[reason]:>6}"
+            f"   (host-won: {sat_losses.get(reason, 0)})"
+        )
+    if not losses:
+        lines.append("    (none recorded)")
+    lines.append("  shape buckets: " + _fmt_counts(report["buckets"]))
+    for engine, table in (report.get("replay") or {}).items():
+        agreement = table["agreement"]
+        lines.append(
+            f"  engine {engine:<7} verdicts "
+            f"{_fmt_counts(table['verdicts'])}  wall {table['wall_s']}s"
+        )
+        lines.append(
+            f"         {'':<7} agreement {table['agreement_pct']}% "
+            f"(agree {agreement['agree']} / disagree "
+            f"{agreement['disagree']} / incomplete "
+            f"{agreement['incomplete']})"
+        )
+    for row in report.get("disagreements") or []:
+        lines.append(f"  DISAGREE {row}")
+    return "\n".join(lines)
+
+
+def _fmt_counts(table: Dict[str, int]) -> str:
+    if not table:
+        return "(none)"
+    return " ".join(
+        f"{key}={table[key]}"
+        for key in sorted(table, key=table.get, reverse=True)
+    )

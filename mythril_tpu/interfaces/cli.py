@@ -60,6 +60,7 @@ COMMAND_LIST = (
         "lint",
         "serve",
         "submit",
+        "solverlab",
         "version",
         "truffle",
         "help",
@@ -400,6 +401,20 @@ ANALYZE_OPTION_FLAGS = [
                 "attribution, routing records): the zero-overhead "
                 "differential baseline — issue sets are identical "
                 "with and without"
+            ),
+        ),
+    ),
+    (
+        ("--capture-queries",),
+        dict(
+            default=None,
+            metavar="DIR",
+            help=(
+                "Solver query flight recorder: serialize every solved "
+                "SMT query into DIR as a content-addressed, replayable "
+                "artifact (lowered program + shape bucket + origin + "
+                "verdict/wall/loss-reason observations). Replay the "
+                "corpus offline with `myth solverlab`"
             ),
         ),
     ),
@@ -811,6 +826,83 @@ def build_parser() -> ArgumentParser:
         action="store_true",
         help="disable span/attribution/routing telemetry recording",
     )
+    serve.add_argument(
+        "--capture-queries",
+        default=None,
+        metavar="DIR",
+        help=(
+            "capture-at-serve: every SMT query the service solves "
+            "lands in DIR as a replayable artifact (myth solverlab); "
+            "live loss/capture counters at /stats solver.*"
+        ),
+    )
+
+    solverlab = subparsers.add_parser(
+        "solverlab",
+        help=(
+            "Offline solver replay lab: re-run a corpus captured with "
+            "--capture-queries against any engine matrix (host CDCL, "
+            "on-chip portfolio, full race funnel) with per-engine "
+            "agreement tables and the funnel-loss waterfall"
+        ),
+    )
+    solverlab.add_argument(
+        "mode",
+        choices=["replay", "report"],
+        nargs="?",
+        default="replay",
+        help=(
+            "replay: re-solve the corpus on the chosen engines; "
+            "report: the captured waterfall alone, no solving"
+        ),
+    )
+    solverlab.add_argument(
+        "--corpus", required=True, metavar="DIR",
+        help="the --capture-queries output directory to load",
+    )
+    solverlab.add_argument(
+        "--engines",
+        default="host,device",
+        help="comma list of host|device|race (default host,device)",
+    )
+    solverlab.add_argument(
+        "--filter",
+        default=None,
+        metavar="KEY=VALUE",
+        help=(
+            "replay only matching artifacts: reason=<LOSS_REASON> or "
+            "origin=<flip-frontier|module|memo-miss>"
+        ),
+    )
+    solverlab.add_argument(
+        "--shard",
+        default=None,
+        metavar="I/N",
+        help=(
+            "replay only this host's content-hash shard (run one "
+            "solverlab per host with I=0..N-1 for a mesh replay)"
+        ),
+    )
+    solverlab.add_argument(
+        "--timeout-ms", type=int, default=10_000,
+        help="per-query budget for the host/race engines",
+    )
+    solverlab.add_argument(
+        "--candidates", type=int, default=64,
+        help="portfolio candidates per query (device engine)",
+    )
+    solverlab.add_argument(
+        "--steps", type=int, default=512,
+        help="portfolio local-search steps (device engine)",
+    )
+    solverlab.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    solverlab.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 when any engine disagrees with a live verdict",
+    )
 
     submit = subparsers.add_parser(
         "submit",
@@ -1162,6 +1254,7 @@ def _run_analyze(disassembler, address, args):
         mesh_devices=args.devices,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
+        capture_queries=args.capture_queries,
     )
 
     if not disassembler.contracts:
@@ -1289,6 +1382,8 @@ def _cmd_serve(args: Namespace) -> None:
         observe.set_enabled(False)
     if args.observe_out:
         observe.configure(out_dir=args.observe_out)
+    if args.capture_queries:
+        observe.configure_capture(args.capture_queries)
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,
@@ -1305,6 +1400,53 @@ def _cmd_serve(args: Namespace) -> None:
         devices=args.devices,
     )
     serve_forever(config, host=args.host, port=args.port)
+    sys.exit()
+
+
+def _cmd_solverlab(args: Namespace) -> None:
+    """`myth solverlab`: replay a captured query corpus offline."""
+    from mythril_tpu.analysis import solverlab
+
+    reason = origin = None
+    if args.filter:
+        try:
+            key, value = args.filter.split("=", 1)
+        except ValueError:
+            log.error("--filter wants KEY=VALUE, got %r", args.filter)
+            sys.exit(1)
+        if key == "reason":
+            reason = value
+        elif key == "origin":
+            origin = value
+        else:
+            log.error("--filter key must be reason or origin, got %r", key)
+            sys.exit(1)
+    engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+    try:
+        report = solverlab.run(
+            args.corpus,
+            mode=args.mode,
+            engines=engines,
+            timeout_ms=args.timeout_ms,
+            candidates=args.candidates,
+            steps=args.steps,
+            reason=reason,
+            origin=origin,
+            shard=args.shard,
+        )
+    except (OSError, ValueError) as why:
+        log.error("solverlab: %s", why)
+        sys.exit(1)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(solverlab.render_text(report))
+    if args.strict:
+        disagreements = sum(
+            table["agreement"]["disagree"]
+            for table in (report.get("replay") or {}).values()
+        )
+        sys.exit(1 if disagreements else 0)
     sys.exit()
 
 
@@ -1365,6 +1507,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_serve(args)
     if args.command == "submit":
         _cmd_submit(args)
+    if args.command == "solverlab":
+        _cmd_solverlab(args)
     if args.command == "help":
         parser.print_help()
         sys.exit()

@@ -70,8 +70,10 @@ from mythril_tpu.laser.batch.symbolic import (
     sym_run,
     sym_run_donated,
 )
+from mythril_tpu.laser.smt.solver import capture as query_capture
 from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
 from mythril_tpu.laser.smt.solver.solver import lower
+from mythril_tpu.observe.querylog import QUERY_ORIGIN_FLIP, query_context
 from mythril_tpu.observe.solverstats import ORIGIN_DEVICE, record_query
 from mythril_tpu.observe.spans import flight_recorder, trace
 from mythril_tpu.support.model import get_model
@@ -1260,33 +1262,35 @@ class DeviceCorpusExplorer:
         # batched device dispatch (whose cost does not grow with count)
         sprint_cap_s = 5.0
         stopped = False
-        for i, conditions in enumerate(batch):
-            # a stop request bounds post-stop lock-held work to the
-            # query in flight — the owner may be waiting on a join
-            # deadline past which it stops honoring the lock protocol
-            if stopped or self._stop_requested():
-                stopped = True
-                capped.add(i)
-                continue
-            if time.perf_counter() - t0 > sprint_cap_s:
-                survivors.append(i)
-                capped.add(i)
-                continue
-            try:
-                model = get_model(
-                    tuple(conditions),
-                    enforce_execution_time=False,
-                    solver_timeout=2000,
-                )
-                self.stats.host_sat += 1
-                out[i] = dict(model.assignment)
-            except UnsatError:
-                pass
-            except SolverTimeOutException:
-                survivors.append(i)
-            except Exception as e:
-                log.debug("CDCL flip solve did not finish: %s", e)
-                survivors.append(i)
+        with query_context(QUERY_ORIGIN_FLIP):
+            for i, conditions in enumerate(batch):
+                # a stop request bounds post-stop lock-held work to the
+                # query in flight — the owner may be waiting on a join
+                # deadline past which it stops honoring the lock
+                # protocol
+                if stopped or self._stop_requested():
+                    stopped = True
+                    capped.add(i)
+                    continue
+                if time.perf_counter() - t0 > sprint_cap_s:
+                    survivors.append(i)
+                    capped.add(i)
+                    continue
+                try:
+                    model = get_model(
+                        tuple(conditions),
+                        enforce_execution_time=False,
+                        solver_timeout=2000,
+                    )
+                    self.stats.host_sat += 1
+                    out[i] = dict(model.assignment)
+                except UnsatError:
+                    pass
+                except SolverTimeOutException:
+                    survivors.append(i)
+                except Exception as e:
+                    log.debug("CDCL flip solve did not finish: %s", e)
+                    survivors.append(i)
 
         lowered_batch: List = []
         kept: List[int] = []
@@ -1325,18 +1329,19 @@ class DeviceCorpusExplorer:
             )
         dt = time.perf_counter() - t0
         per_query = dt / max(1, len(kept))
-        for i, assignment in zip(kept, found):
+        for qi, (i, assignment) in enumerate(zip(kept, found)):
             if assignment is not None:
                 self.stats.device_sat += 1
                 out[i] = assignment
             # solver attribution: these queries escalated past the CDCL
             # sprint onto the on-chip portfolio (hop 1); a miss is an
             # "unknown" — the portfolio is a sat-finder, not a decider
-            record_query(
-                ORIGIN_DEVICE,
-                "sat" if assignment is not None else "unknown",
-                per_query,
-                hop=1,
+            verdict = "sat" if assignment is not None else "unknown"
+            record_query(ORIGIN_DEVICE, verdict, per_query, hop=1)
+            # flight recorder: the batched dispatch bypasses
+            # check_terms, so these flip-frontier queries capture here
+            query_capture.capture_flip(
+                lowered_batch[qi], verdict=verdict, wall_s=per_query
             )
         self.stats.flip_solve_s += dt
 

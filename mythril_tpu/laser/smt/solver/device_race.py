@@ -129,6 +129,17 @@ class DeviceRace:
             return FAILED
         return self._assignment
 
+    def outcome(self) -> str:
+        """Where the race stands RIGHT NOW, without consuming it:
+        "pending" (portfolio still searching), "failed" (finished
+        without a witness), "witness" (finished with one). The loss
+        attribution reads this when the CDCL answers first — a
+        portfolio that had already come back empty is an
+        SLS_NONCONVERGED loss, not a RACE_LOST_TIMING one."""
+        if not self._done.is_set():
+            return "pending"
+        return "failed" if self._assignment is None else "witness"
+
     @property
     def started(self) -> bool:
         return self._started

@@ -84,6 +84,17 @@ def compile_program(
 ) -> Optional[Program]:
     """Flatten the constraint DAG into tensor-program arrays; None when
     an op falls outside the device language or widths exceed the cap."""
+    return compile_program_ex(lowered, max_limbs)[0]
+
+
+def compile_program_ex(
+    lowered: List[Term], max_limbs: int = 64
+) -> Tuple[Optional[Program], Optional[str]]:
+    """`compile_program` with the failure EXPLAINED: (program, None) on
+    success, (None, loss_reason) on a bail — the reason strings are the
+    flight recorder's taxonomy (observe/querylog.py): QUERY_TRIVIAL
+    (nothing to search), BUCKET_OVERFLOW (widths past the limb cap),
+    LOWERING_UNSUPPORTED (op outside the device language)."""
     order: List[Term] = []
     index: Dict[int, int] = {}
 
@@ -104,11 +115,11 @@ def compile_program(
                     stack.append((a, False))
 
     if not order:
-        return None
+        return None, "QUERY_TRIVIAL"
     max_width = max((t.width or 1) for t in order)
     L = max(16, _bucket((max_width + LIMB_BITS - 1) // LIMB_BITS, 16))
     if L > max_limbs:
-        return None
+        return None, "BUCKET_OVERFLOW"
 
     n = len(order)
     opcodes = np.zeros(n, dtype=np.int32)
@@ -194,7 +205,7 @@ def compile_program(
                 if isinstance(a, Term):
                     args[i, k] = index[a._id]
         else:
-            return None
+            return None, "LOWERING_UNSUPPORTED"
 
     roots = [index[c._id] for c in lowered]
 
@@ -227,7 +238,20 @@ def compile_program(
         roots_mask,
         L,
         n,
-    )
+    ), None
+
+
+def bucket_key(prog: Program) -> Dict[str, int]:
+    """The XLA shape bucket a compiled program lands in — the grouping
+    key the capture artifacts and `myth solverlab` report engines by
+    (one interpreter compiles per distinct bucket, not per query)."""
+    return {
+        "nodes": int(prog.opcodes.shape[0]),
+        "consts": int(prog.const_pool.shape[0]),
+        "roots": int(prog.roots.shape[0]),
+        "vars": int(_bucket(max(1, len(prog.var_slots)), 4)),
+        "limbs": int(prog.limbs),
+    }
 
 
 # ---------------------------------------------------------------------------

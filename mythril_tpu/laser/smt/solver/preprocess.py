@@ -461,7 +461,16 @@ def eliminate_uf_and_arrays(constraints: List[Term], recon: Recon) -> List[Term]
         if info is not None:
             recon.sel_apps.setdefault(info[0], []).append((info[2], f))
         else:
-            name, _w, args = _uf_info[f]
+            uinfo = _uf_info.get(f)
+            if uinfo is None:
+                # a var that merely MATCHES the fresh-name pattern but
+                # was never minted by this process — e.g. a replayed
+                # capture artifact (myth solverlab) whose lowered set
+                # carries another run's sel!/uf! vars WITH their
+                # consistency axioms already materialized. An opaque
+                # var needs no apps and no new axioms.
+                continue
+            name, _w, args = uinfo
             recon.uf_apps.setdefault(name, []).append((args, f))
     # pairwise read consistency per array (sorted app order: run-stable)
     for arr_name in sorted(recon.sel_apps):

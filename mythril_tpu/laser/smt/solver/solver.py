@@ -382,13 +382,20 @@ def device_solving_enabled() -> bool:
     return accelerator_present()
 
 
-#: thread-local channel the device-win sites mark so the telemetry
-#: wrapper below attributes the verdict to the right engine (the
-#: origin is decided deep inside the race/escape paths, the wall is
-#: measured at the entry)
+#: thread-local channel the device-win and funnel-exit sites mark so
+#: the telemetry wrapper below attributes the verdict to the right
+#: engine AND the right loss reason (the origin/loss are decided deep
+#: inside the race/escape paths, the wall is measured at the entry)
 import threading as _threading
 
 _QUERY_ORIGIN = _threading.local()
+
+
+def _set_loss(reason: str) -> None:
+    """Mark WHY the device portfolio will not own this query's verdict
+    (observe/querylog.py taxonomy); later sites overwrite — the reason
+    standing at the final verdict is the one recorded."""
+    _QUERY_ORIGIN.loss = reason
 
 
 def check_terms(
@@ -400,24 +407,48 @@ def check_terms(
     query telemetry: every verdict is tagged with its answering origin
     (host CDCL vs device portfolio), wall time, and escalation hop
     (observe/solverstats.py; the per-run attribution table lands in
-    bench records and report meta)."""
+    bench records and report meta). Host-answered verdicts
+    additionally carry a loss reason — why the device did NOT answer
+    (observe/querylog.py `mtpu_solver_loss_total`) — and, under
+    --capture-queries, the lowered query itself lands in the capture
+    corpus (laser/smt/solver/capture.py)."""
+    from mythril_tpu.observe import querylog
     from mythril_tpu.observe.solverstats import (
         ORIGIN_DEVICE,
         ORIGIN_HOST_CDCL,
         record_query,
     )
+    from mythril_tpu.laser.smt.solver import capture
 
     _QUERY_ORIGIN.origin = None
+    _QUERY_ORIGIN.loss = None
+    _QUERY_ORIGIN.counted_sat = False
+    capture.discard()
     t0 = time.perf_counter()
     verdict, model = _check_terms_impl(
         raw_constraints, timeout_ms, conflict_budget
     )
+    wall = time.perf_counter() - t0
     origin = getattr(_QUERY_ORIGIN, "origin", None) or ORIGIN_HOST_CDCL
-    record_query(
-        origin,
-        verdict,
-        time.perf_counter() - t0,
-        hop=1 if origin == ORIGIN_DEVICE else 0,
+    hop = 1 if origin == ORIGIN_DEVICE else 0
+    record_query(origin, verdict, wall, hop=hop)
+    loss = getattr(_QUERY_ORIGIN, "loss", None)
+    if origin == ORIGIN_HOST_CDCL:
+        if verdict == sat:
+            # pair the loss count EXACTLY with the legacy cdcl-sat
+            # counter (the bench acceptance: sum(loss reasons over sat)
+            # == cdcl_sat_verdicts) — the trivial early-sat paths bump
+            # neither
+            if getattr(_QUERY_ORIGIN, "counted_sat", False):
+                loss = loss or querylog.LOSS_UNCLASSIFIED
+                querylog.record_loss(loss, verdict=sat, site="check_terms")
+        elif loss is not None:
+            querylog.record_loss(loss, verdict=verdict, site="check_terms")
+    else:
+        loss = None  # the device won: nothing was lost
+    capture.capture_check(
+        verdict=verdict, engine=origin, wall_s=wall, hop=hop,
+        loss_reason=loss,
     )
     return verdict, model
 
@@ -432,7 +463,9 @@ def _check_terms_impl(
     pure function of the query whenever the wall valve doesn't fire —
     callers that must be reproducible (objective refinement) pass a
     budget sized to finish well inside their wall allowance."""
+    from mythril_tpu.observe import querylog
     from mythril_tpu.support import resilience
+    from mythril_tpu.laser.smt.solver import capture
 
     run_dl = resilience.run_deadline()
     if run_dl is not None:
@@ -445,13 +478,18 @@ def _check_terms_impl(
                 site="check_terms",
                 detail="run deadline expired before solve",
             )
+            _set_loss(querylog.LOSS_DEADLINE_EXPIRED)
             return unknown, None
         timeout_ms = run_dl.clamp_ms(timeout_ms)
     t_total = time.monotonic()
     lowered, recon = lower(raw_constraints)
+    if capture.capture_active():
+        capture.note_lowered(lowered)
     if any(c is terms.FALSE for c in lowered):
+        _set_loss(querylog.LOSS_QUERY_TRIVIAL)
         return unsat, None
     if not lowered:
+        _set_loss(querylog.LOSS_QUERY_TRIVIAL)
         return sat, _reconstruct({}, {}, recon, raw_constraints)
 
     blaster, native_session = _blast_session()
@@ -493,10 +531,12 @@ def _check_terms_impl(
         for c in lowered:
             root = blaster.blast_bool(c)
             if root == -1:  # constant false
+                _set_loss(querylog.LOSS_QUERY_TRIVIAL)
                 return unsat, None
             if root != 1:  # constant true contributes nothing
                 units.append(root)
     except (NotImplementedError, RecursionError):
+        _set_loss(querylog.LOSS_LOWERING_UNSUPPORTED)
         return unknown, None
     finally:
         sys.setrecursionlimit(old_limit)
@@ -520,6 +560,16 @@ def _check_terms_impl(
     from mythril_tpu.support.support_args import args as _glob_args
 
     deterministic = _glob_args.deterministic_solving
+    # default loss classification for sprint-answered verdicts (the
+    # easy majority): the device never got a chance — because the gate
+    # is administratively closed (flag off / CPU backend /
+    # deterministic mode forgoes the race entirely), or because the
+    # sprint was simply first
+    _set_loss(
+        querylog.LOSS_SPRINT_PREEMPTED
+        if device_solving_enabled() and not deterministic
+        else querylog.LOSS_GATE_DISABLED
+    )
     remaining = max(200, timeout_ms - int((time.monotonic() - t_total) * 1000))
     # In deterministic mode the conflict budget binds and the wall
     # valve must not (a load-variable valve could flip a verdict), so
@@ -555,6 +605,9 @@ def _check_terms_impl(
             # than ~8k conflicts/s still fall to the wall valve
             conflict_budget = timeout_ms * 8
         if deterministic or conflict_budget is not None:
+            # reproducible mode forgoes the race by design: the device
+            # gate is administratively closed for this query
+            _set_loss(querylog.LOSS_GATE_DISABLED)
             # the valve must not inherit the sprint's (load-variable)
             # wall consumption, or a hard query flips verdicts under
             # load — the budget above is the binding constraint, the
@@ -574,20 +627,30 @@ def _check_terms_impl(
             from mythril_tpu.laser.smt.solver import device_race
 
             race = None
-            if (
-                device_solving_enabled()
-                and len(lowered) >= 2
-                and device_race.race_available()
-            ):
+            if not device_solving_enabled():
+                _set_loss(querylog.LOSS_GATE_DISABLED)
+            elif len(lowered) < 2:
+                # below the race's minimum useful size: the cone would
+                # be the whole (tiny) query and the dispatch chain
+                # costs more than the marathon
+                _set_loss(querylog.LOSS_QUERY_TRIVIAL)
+            elif not device_race.race_available():
+                _set_loss(querylog.LOSS_RACE_NOT_STARTED)
+            else:
                 race = device_race.DeviceRace(_race_cone(lowered))
                 if not race.started:
                     race = None
+                    _set_loss(querylog.LOSS_RACE_NOT_STARTED)
             device_tried = race is not None
             while True:
                 if race is not None:
                     found = race.poll()
                     if found is device_race.FAILED:
+                        # the portfolio finished WITHOUT a witness —
+                        # distinct from a timing loss (satellite: the
+                        # race-loss waterfall)
                         SolverStatistics().race_losses += 1
+                        _set_loss(querylog.LOSS_SLS_NONCONVERGED)
                         race = None
                     elif found is not device_race.PENDING:
                         model = _reconstruct(
@@ -609,6 +672,7 @@ def _check_terms_impl(
                             _QUERY_ORIGIN.origin = "device-portfolio"
                             return sat, model
                         SolverStatistics().race_losses += 1
+                        _set_loss(querylog.LOSS_WITNESS_INVALID)
                         race = None  # invalid witness: back to CDCL
                         # the witness extension may have abandoned a
                         # wedged session; resync so the CDCL continues
@@ -620,6 +684,11 @@ def _check_terms_impl(
                         # the query's budget ran out with the race
                         # still searching: that IS a loss
                         SolverStatistics().race_losses += 1
+                        _set_loss(
+                            querylog.LOSS_SLS_NONCONVERGED
+                            if race.outcome() == "failed"
+                            else querylog.LOSS_RACE_LOST_TIMING
+                        )
                     status = native_sat.UNKNOWN
                     break
                 # short slices only while a race could preempt the
@@ -631,7 +700,14 @@ def _check_terms_impl(
                 if status != native_sat.UNKNOWN:
                     if race is not None:
                         # the CDCL answered while a race was in flight
+                        # — "still searching" and "finished without a
+                        # witness, unpolled" are different losses
                         SolverStatistics().race_losses += 1
+                        _set_loss(
+                            querylog.LOSS_SLS_NONCONVERGED
+                            if race.outcome() == "failed"
+                            else querylog.LOSS_RACE_LOST_TIMING
+                        )
                     break
                 if race is None:
                     break  # full remaining budget spent in one call
@@ -649,8 +725,12 @@ def _check_terms_impl(
 
             from mythril_tpu.laser.smt.solver import portfolio
 
+            prog, compile_loss = portfolio.compile_program_ex(lowered)
+            if prog is None:
+                _set_loss(compile_loss or querylog.LOSS_LOWERING_UNSUPPORTED)
+                return unknown, None
             asn = portfolio.device_check(
-                lowered, n_devices=min(jax.device_count(), 8)
+                lowered, n_devices=min(jax.device_count(), 8), prog=prog
             )
             if asn is not None:
                 model = _reconstruct(asn, {}, recon, raw_constraints)
@@ -658,6 +738,9 @@ def _check_terms_impl(
                     SolverStatistics().device_sat_count += 1
                     _QUERY_ORIGIN.origin = "device-portfolio"
                     return sat, model
+                _set_loss(querylog.LOSS_WITNESS_INVALID)
+            else:
+                _set_loss(querylog.LOSS_SLS_NONCONVERGED)
         return unknown, None
 
     # decode CNF bits -> word-level assignment, restricted to the vars
@@ -668,6 +751,9 @@ def _check_terms_impl(
     if model is None:
         return unknown, None
     SolverStatistics().cdcl_sat_count += 1
+    # the flag pairs the loss-reason count to THIS counter 1:1 (the
+    # wrapper records the sat-loss only for counted verdicts)
+    _QUERY_ORIGIN.counted_sat = True
     return sat, model
 
 

@@ -2,6 +2,17 @@
 
 Reference parity: mythril/laser/smt/solver/solver_statistics.py:8-43
 (`SolverStatistics` singleton + `stat_smt_query` decorator).
+
+Since the solver flight recorder (PR 8) the singleton is a VIEW over
+the process-wide metrics registry — the same fold `support/
+phase_profile.py` got in PR 7. Every field is backed by an
+``mtpu_solver_stats_*`` series (scraped at ``/metrics`` beside the
+per-origin attribution), the singleton's private dict counters are
+gone, and ``stats.race_wins += 1`` at the legacy call sites
+(solver.py, svm.py, prepass.py, bench.py) lands directly in the
+registry. Like every legacy-backing view, the registry arithmetic
+stays on under ``--no-observe`` — bench scorecards and the repr never
+change with telemetry off.
 """
 
 from __future__ import annotations
@@ -12,27 +23,85 @@ from functools import wraps
 from mythril_tpu.support.support_utils import Singleton
 
 
+class _CounterField:
+    """One singleton field backed by a registry counter series.
+    Reads return the cumulative value; `+=`-style writes increment by
+    the delta (counters are monotone — a lower assignment is ignored,
+    and `reset_registry()` in tests starts every series over at 0)."""
+
+    def __init__(self, name, help_text="", labels=None, as_int=True):
+        self._name = name
+        self._help = help_text
+        self._labels = labels or {}
+        self._as_int = as_int
+
+    def _child(self):
+        from mythril_tpu.observe.registry import registry
+
+        metric = registry().counter(self._name, self._help)
+        return metric.labels(**self._labels) if self._labels else metric
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        value = self._child().value
+        return int(value) if self._as_int else value
+
+    def __set__(self, obj, value):
+        child = self._child()
+        delta = value - child.value
+        if delta > 0:
+            child.inc(delta)
+
+
 class SolverStatistics(object, metaclass=Singleton):
     """Solver query stats; enabled by the analyzer before fire_lasers."""
 
+    query_count = _CounterField(
+        "mtpu_solver_stats_queries_total",
+        "queries through the public Solver/Optimize surface",
+    )
+    solver_time = _CounterField(
+        "mtpu_solver_stats_wall_seconds_total",
+        "cumulative wall inside Solver.check",
+        as_int=False,
+    )
+    # where sat verdicts came from: the on-chip portfolio vs the
+    # native CDCL completeness path
+    device_sat_count = _CounterField(
+        "mtpu_solver_stats_sat_total",
+        "sat verdicts by deciding engine",
+        labels={"engine": "device-portfolio"},
+    )
+    cdcl_sat_count = _CounterField(
+        "mtpu_solver_stats_sat_total",
+        "sat verdicts by deciding engine",
+        labels={"engine": "host-cdcl"},
+    )
+    # queries never posed because the device prepass held a
+    # concrete execution of the branch direction — a sat
+    # certificate stronger than any solver answer
+    device_cert_count = _CounterField(
+        "mtpu_solver_stats_device_certs_total",
+        "queries pre-empted by device execution certificates",
+    )
+    # CPU-vs-TPU race outcomes (device_race.py): started races
+    # that the portfolio won vs ones the CDCL answered first (or
+    # the portfolio missed) — the honest scorecard VERDICT r4
+    # item 3 asked to put in the bench JSON
+    race_wins = _CounterField(
+        "mtpu_solver_stats_race_total",
+        "device-race outcomes",
+        labels={"outcome": "won"},
+    )
+    race_losses = _CounterField(
+        "mtpu_solver_stats_race_total",
+        "device-race outcomes",
+        labels={"outcome": "lost"},
+    )
+
     def __init__(self):
         self.enabled = False
-        self.query_count = 0
-        self.solver_time = 0.0
-        # where sat verdicts came from: the on-chip portfolio vs the
-        # native CDCL completeness path
-        self.device_sat_count = 0
-        self.cdcl_sat_count = 0
-        # queries never posed because the device prepass held a
-        # concrete execution of the branch direction — a sat
-        # certificate stronger than any solver answer
-        self.device_cert_count = 0
-        # CPU-vs-TPU race outcomes (device_race.py): started races
-        # that the portfolio won vs ones the CDCL answered first (or
-        # the portfolio missed) — the honest scorecard VERDICT r4
-        # item 3 asked to put in the bench JSON
-        self.race_wins = 0
-        self.race_losses = 0
 
     def __repr__(self):
         return (

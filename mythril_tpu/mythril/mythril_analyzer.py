@@ -53,6 +53,10 @@ _RUN_DEFAULTS = dict(
     # completion status) or fails hard, per on_timeout
     deadline=None,
     on_timeout="partial",
+    # solver query flight recorder (observe/querylog.py): when set,
+    # every solved SMT query serializes into this directory as a
+    # replayable artifact for `myth solverlab`
+    capture_queries=None,
 )
 
 #: options published to the global `args` bag for the deep layers
@@ -217,6 +221,8 @@ class MythrilAnalyzer:
         degradation_marker = resilience.DegradationLog().marker()
         from mythril_tpu import observe
 
+        if self.capture_queries:
+            observe.configure_capture(self.capture_queries)
         solver_marker = observe.solver_marker()
         if self.deadline is not None:
             resilience.set_run_deadline(self.deadline)
@@ -254,6 +260,20 @@ class MythrilAnalyzer:
         attribution = observe.solver_attribution(solver_marker)
         if attribution:
             report.meta["solver_attribution"] = attribution
+        # the flight recorder's loss waterfall: why host-answered
+        # queries were not device-answered (all verdicts + the
+        # host-WON restriction), plus how many queries the capture
+        # corpus banked this run
+        losses = observe.loss_reasons(since=solver_marker)
+        if losses:
+            report.meta["solver_loss_reasons"] = losses
+            report.meta["solver_loss_reasons_sat"] = observe.loss_reasons(
+                since=solver_marker, verdict="sat"
+            )
+        if self.capture_queries:
+            report.meta["captured_queries"] = observe.captured_total(
+                since=solver_marker
+            )
         reasons = resilience.DegradationLog().counts_since(degradation_marker)
         partial = any(not status["complete"] for status in completion)
         if reasons or partial:

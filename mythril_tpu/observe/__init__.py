@@ -34,6 +34,15 @@ import os
 import threading
 from typing import Optional
 
+from mythril_tpu.observe.querylog import (  # noqa: F401
+    LOSS_REASONS,
+    capture_enabled as query_capture_enabled,
+    captured_total,
+    configure_capture,
+    loss_reasons,
+    query_context,
+    record_loss,
+)
 from mythril_tpu.observe.registry import (  # noqa: F401 (public API)
     SCHEMA_VERSION,
     MetricsRegistry,

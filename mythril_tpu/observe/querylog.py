@@ -1,0 +1,493 @@
+"""Solver query flight recorder: capture every SMT query, explain
+every lost verdict.
+
+Every bench since r02 reports ``device_sat_verdicts ~ 0`` while the
+host CDCL answers thousands of queries. PR 7's per-origin attribution
+(`solverstats.py`) can count *who* won; this module records *why the
+device lost* — and, under ``--capture-queries DIR``, puts the queries
+themselves on disk as content-addressed, replayable artifacts so
+portfolio tuning (ROADMAP item 1) iterates on a fixed corpus offline
+(`myth solverlab`) instead of re-running full analyses.
+
+Three surfaces:
+
+- **Loss-reason taxonomy** — every host-won (and host-unknown) verdict
+  in the `check_terms` funnel is tagged with the reason the device
+  portfolio did not answer it, recorded as
+  ``mtpu_solver_loss_total{reason, verdict}``. Like the other
+  legacy-backing registry arithmetic, the counters stay on under
+  ``--no-observe`` so the bench waterfall never changes with telemetry
+  off. The catalog:
+
+  =====================  ==================================================
+  LOWERING_UNSUPPORTED   the query contains ops outside the device tensor
+                         language (or the host blaster fragment)
+  BUCKET_OVERFLOW        widths exceed the portfolio's limb cap — no
+                         shape bucket can hold the program
+  SLS_NONCONVERGED       the portfolio search finished without a witness
+                         (a miss proves nothing; the CDCL decided)
+  RACE_LOST_TIMING       the portfolio was still searching when the CDCL
+                         answered (or the query budget expired)
+  SPRINT_PREEMPTED       the conflict-budgeted CDCL sprint answered
+                         before any device attempt was affordable
+  GATE_DISABLED          device solving switched off (flag, CPU-only
+                         backend, or deterministic-solving mode)
+  RACE_NOT_STARTED       the race could not start (chip owned by an
+                         exploration, in-flight slot taken, no thread)
+  WITNESS_INVALID        a device witness failed the reconstruction /
+                         soundness gate and the CDCL re-decided
+  QUERY_TRIVIAL          answered before any CNF search (constant
+                         folding, empty set, sub-race-size query)
+  DEADLINE_EXPIRED       the run deadline expired before the solve
+  UNCLASSIFIED           safety net — a funnel exit the taxonomy missed
+                         (a nonzero count is a bug)
+  =====================  ==================================================
+
+- **Query context** — a thread-local tag naming where a query
+  originated: ``flip-frontier`` (explorer flip solving), ``module``
+  (detection-module queries), ``memo-miss`` (bare get_model solves —
+  engine feasibility checks whose memo lookup missed).
+
+- **Capture** — `configure_capture(dir)` arms the recorder: each
+  solved query's LOWERED constraint set serializes to
+  ``<dir>/q-<sha256>.json`` with its shape-bucket key, origin,
+  per-engine verdict/wall/hop observations and loss reason. Artifacts
+  are content-addressed on a var-name-canonicalized encoding, so the
+  same query captured twice (or from two phases) lands in ONE file
+  with appended observations. Capture is off by default and the
+  disabled path is a single boolean check — `tools/serve_smoke.py`
+  pins that it adds zero registry series.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from mythril_tpu.observe.registry import registry
+
+log = logging.getLogger(__name__)
+
+#: artifact schema (bumped when the on-disk shape changes; solverlab
+#: refuses to replay a newer major schema)
+ARTIFACT_SCHEMA_VERSION = 1
+
+# -- the loss-reason taxonomy (see module docstring) -----------------------
+LOSS_LOWERING_UNSUPPORTED = "LOWERING_UNSUPPORTED"
+LOSS_BUCKET_OVERFLOW = "BUCKET_OVERFLOW"
+LOSS_SLS_NONCONVERGED = "SLS_NONCONVERGED"
+LOSS_RACE_LOST_TIMING = "RACE_LOST_TIMING"
+LOSS_SPRINT_PREEMPTED = "SPRINT_PREEMPTED"
+LOSS_GATE_DISABLED = "GATE_DISABLED"
+LOSS_RACE_NOT_STARTED = "RACE_NOT_STARTED"
+LOSS_WITNESS_INVALID = "WITNESS_INVALID"
+LOSS_QUERY_TRIVIAL = "QUERY_TRIVIAL"
+LOSS_DEADLINE_EXPIRED = "DEADLINE_EXPIRED"
+LOSS_UNCLASSIFIED = "UNCLASSIFIED"
+
+LOSS_REASONS = (
+    LOSS_LOWERING_UNSUPPORTED,
+    LOSS_BUCKET_OVERFLOW,
+    LOSS_SLS_NONCONVERGED,
+    LOSS_RACE_LOST_TIMING,
+    LOSS_SPRINT_PREEMPTED,
+    LOSS_GATE_DISABLED,
+    LOSS_RACE_NOT_STARTED,
+    LOSS_WITNESS_INVALID,
+    LOSS_QUERY_TRIVIAL,
+    LOSS_DEADLINE_EXPIRED,
+    LOSS_UNCLASSIFIED,
+)
+
+#: the query-origin labels (where a query came FROM, as opposed to the
+#: solverstats origin of who ANSWERED it)
+QUERY_ORIGIN_FLIP = "flip-frontier"
+QUERY_ORIGIN_MODULE = "module"
+QUERY_ORIGIN_MEMO_MISS = "memo-miss"
+
+
+_LOSS = None
+_CAPTURED = None
+
+
+def _metrics():
+    global _LOSS, _CAPTURED
+    if _LOSS is None:
+        reg = registry()
+        _LOSS = reg.counter(
+            "mtpu_solver_loss_total",
+            "host-answered solver verdicts by device-loss reason",
+        )
+        _CAPTURED = reg.counter(
+            "mtpu_solver_captured_queries_total",
+            "solver queries captured to the flight-recorder corpus",
+        )
+    return _LOSS, _CAPTURED
+
+
+def record_loss(reason: str, verdict: str, site: str = "") -> None:
+    """Count one host-answered verdict against the loss taxonomy.
+    Registry arithmetic that backs the bench waterfall and `/stats
+    solver.loss.*` — deliberately NOT gated on the observe switch, so
+    ``sum(solver_loss_reasons) == cdcl_sat_verdicts`` holds on every
+    bench record."""
+    loss, _captured = _metrics()
+    loss.labels(reason=reason or LOSS_UNCLASSIFIED, verdict=verdict).inc()
+
+
+def loss_reasons(
+    since: Optional[Dict] = None, verdict: Optional[str] = None
+) -> Dict[str, int]:
+    """The waterfall: {reason: count}, whole-process or as a delta
+    since a registry `marker()`; `verdict="sat"` restricts to
+    host-WON queries (the acceptance-criteria view)."""
+    _metrics()
+    reg = registry()
+    snap = reg.since(since) if since is not None else reg.snapshot()
+    out: Dict[str, int] = {}
+    for key, value in (snap.get("mtpu_solver_loss_total") or {}).items():
+        labels = dict(key)
+        if verdict is not None and labels.get("verdict") != verdict:
+            continue
+        reason = labels.get("reason", LOSS_UNCLASSIFIED)
+        out[reason] = out.get(reason, 0) + int(value)
+    return out
+
+
+def captured_total(since: Optional[Dict] = None) -> int:
+    """Queries captured to disk (process total or delta)."""
+    _metrics()
+    reg = registry()
+    snap = reg.since(since) if since is not None else reg.snapshot()
+    return int(
+        sum((snap.get("mtpu_solver_captured_queries_total") or {}).values())
+    )
+
+
+# ---------------------------------------------------------------------------
+# query context: where did this query come from
+# ---------------------------------------------------------------------------
+
+_CTX = threading.local()
+
+
+def current_origin() -> str:
+    """The innermost query-context tag; bare solves (engine
+    feasibility checks) default to memo-miss — they reached the solver
+    because the get_model memo missed."""
+    stack = getattr(_CTX, "stack", None)
+    return stack[-1] if stack else QUERY_ORIGIN_MEMO_MISS
+
+
+@contextmanager
+def query_context(origin: str, only_if_root: bool = False):
+    """Tag queries issued inside the block with `origin`. With
+    `only_if_root` the tag applies only when no enclosing context set
+    one (get_model's memo-miss default must not mask the module/flip
+    tags of its callers)."""
+    stack = getattr(_CTX, "stack", None)
+    if stack is None:
+        stack = _CTX.stack = []
+    if only_if_root and stack:
+        yield
+        return
+    stack.append(origin)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# capture configuration
+# ---------------------------------------------------------------------------
+
+_CAPTURE_DIR: Optional[str] = None
+_CAPTURE_MU = threading.Lock()
+#: per-artifact observation cap: a hot memo-missing query re-posed
+#: hundreds of times must not grow its artifact unboundedly
+MAX_OBSERVATIONS = 16
+
+
+def configure_capture(out_dir: Optional[str]) -> None:
+    """Arm (or, with None, disarm) query capture into `out_dir`."""
+    global _CAPTURE_DIR
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    _CAPTURE_DIR = out_dir or None
+
+
+def capture_dir() -> Optional[str]:
+    return _CAPTURE_DIR
+
+
+def capture_enabled() -> bool:
+    return _CAPTURE_DIR is not None
+
+
+# ---------------------------------------------------------------------------
+# term (de)serialization: the replayable program
+# ---------------------------------------------------------------------------
+
+
+def serialize_terms(lowered) -> Dict:
+    """Flatten a lowered constraint set into a JSON-able DAG: one node
+    per interned term in topological order, term args as ["t", idx],
+    ints as ["i", n], names as ["s", name]. Raises NotImplementedError
+    on payloads outside (Term | int | str) — post-`lower` sets never
+    hold any."""
+    from mythril_tpu.laser.smt.terms import Term
+
+    order: List = []
+    index: Dict[int, int] = {}
+    for root in lowered:
+        stack = [(root, False)]
+        while stack:
+            node, expanded = stack.pop()
+            if node._id in index:
+                continue
+            if expanded:
+                if node._id not in index:
+                    index[node._id] = len(order)
+                    order.append(node)
+                continue
+            stack.append((node, True))
+            for a in node.args:
+                if isinstance(a, Term) and a._id not in index:
+                    stack.append((a, False))
+
+    nodes = []
+    for t in order:
+        args = []
+        for a in t.args:
+            if isinstance(a, Term):
+                args.append(["t", index[a._id]])
+            elif isinstance(a, bool):
+                args.append(["i", int(a)])
+            elif isinstance(a, int):
+                args.append(["i", a])
+            elif isinstance(a, str):
+                args.append(["s", a])
+            else:
+                raise NotImplementedError(
+                    f"unserializable payload {type(a).__name__} in {t.op}"
+                )
+        nodes.append({"op": t.op, "w": t.width or 0, "a": args})
+    return {
+        "nodes": nodes,
+        "roots": [index[c._id] for c in lowered],
+    }
+
+
+def content_address(doc: Dict) -> str:
+    """sha256 of the program with var NAMES canonicalized to their
+    first-occurrence index: the preprocessor's gensym'd fresh names
+    (select/UF elimination) differ run to run, but the query they
+    encode is the same query — and must dedup to the same artifact."""
+    rename: Dict[str, str] = {}
+    canon_nodes = []
+    for node in doc["nodes"]:
+        args = []
+        for kind, value in node["a"]:
+            if kind == "s":
+                if value not in rename:
+                    rename[value] = f"v{len(rename)}"
+                value = rename[value]
+            args.append([kind, value])
+        canon_nodes.append([node["op"], node["w"], args])
+    blob = json.dumps(
+        [canon_nodes, doc["roots"]], separators=(",", ":"), sort_keys=True
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def deserialize_terms(doc: Dict) -> List:
+    """Rebuild real (interned) terms from a serialized program; the
+    constructors re-apply their canonicalizations, so the rebuilt set
+    is semantically the captured set even across processes."""
+    from mythril_tpu.laser.smt import terms
+
+    built: List = []
+
+    def arg(spec):
+        kind, value = spec
+        return built[value] if kind == "t" else value
+
+    for node in doc["nodes"]:
+        op, w, raw = node["op"], node["w"], node["a"]
+        a = [arg(s) for s in raw]
+        if op == "const":
+            t = terms.bv_const(a[0], w)
+        elif op == "var":
+            t = terms.bv_var(a[0], w)
+        elif op == "bvar":
+            t = terms.bool_var(a[0])
+        elif op == "true":
+            t = terms.TRUE
+        elif op == "false":
+            t = terms.FALSE
+        elif op == "extract":
+            t = terms.extract(a[0], a[1], a[2])
+        elif op in ("zext", "sext"):
+            t = getattr(terms, op)(a[0], a[1])
+        elif op == "ite":
+            t = terms.ite(a[0], a[1], a[2])
+        elif op in ("band", "bor"):
+            t = getattr(terms, op)(*a)
+        elif op == "bnot":
+            t = terms.bnot(a[0])
+        elif op == "not":
+            t = terms.bvnot(a[0])
+        elif op in _BIN_OPS:
+            t = _BIN_OPS[op](a[0], a[1])
+        else:
+            raise NotImplementedError(f"cannot rebuild op {op!r}")
+        built.append(t)
+    return [built[i] for i in doc["roots"]]
+
+
+def _bin_ops():
+    from mythril_tpu.laser.smt import terms
+
+    return {
+        "add": terms.add, "sub": terms.sub, "mul": terms.mul,
+        "udiv": terms.udiv, "urem": terms.urem, "sdiv": terms.sdiv,
+        "srem": terms.srem, "and": terms.bvand, "or": terms.bvor,
+        "xor": terms.bvxor, "shl": terms.shl, "lshr": terms.lshr,
+        "ashr": terms.ashr, "concat": terms.concat, "eq": terms.eq,
+        "ult": terms.ult, "ule": terms.ule, "slt": terms.slt,
+        "sle": terms.sle, "bxor": terms.bxor,
+    }
+
+
+class _LazyBin(dict):
+    def __missing__(self, key):
+        self.update(_bin_ops())
+        return dict.__getitem__(self, key)
+
+    def __contains__(self, key):
+        if not len(self):
+            self.update(_bin_ops())
+        return dict.__contains__(self, key)
+
+
+_BIN_OPS = _LazyBin()
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+
+def _bucket_info(lowered):
+    """(shape-bucket key, compile-loss reason) of the query as the
+    portfolio sees it — the bucket the replay lab groups engines by."""
+    from mythril_tpu.laser.smt.solver import portfolio
+
+    prog, reason = portfolio.compile_program_ex(lowered)
+    if prog is None:
+        return None, reason
+    return portfolio.bucket_key(prog), None
+
+
+def capture_query(
+    lowered,
+    engine: str,
+    verdict: str,
+    wall_s: float = 0.0,
+    hop: int = 0,
+    loss_reason: Optional[str] = None,
+    site: str = "",
+    origin: Optional[str] = None,
+) -> Optional[str]:
+    """Serialize one solved query into the capture corpus (no-op when
+    capture is off). Returns the artifact path, or None. Never raises:
+    capture must never sink a query."""
+    out_dir = _CAPTURE_DIR
+    if out_dir is None or not lowered:
+        # a fully-propagated (empty) query is a trivial sat — there is
+        # nothing to replay
+        return None
+    try:
+        doc = serialize_terms(lowered)
+        sha = content_address(doc)
+        observation = {
+            "engine": engine,
+            "verdict": verdict,
+            "wall_s": round(float(wall_s), 6),
+            "hop": int(hop),
+            "loss_reason": loss_reason,
+            "site": site,
+        }
+        path = os.path.join(out_dir, f"q-{sha}.json")
+        with _CAPTURE_MU:
+            if os.path.exists(path):
+                with open(path) as fp:
+                    artifact = json.load(fp)
+            else:
+                bucket, compile_loss = _bucket_info(lowered)
+                artifact = {
+                    "schema_version": ARTIFACT_SCHEMA_VERSION,
+                    "kind": "mtpu-solver-query",
+                    "sha": sha,
+                    "origin": origin or current_origin(),
+                    "n_constraints": len(doc["roots"]),
+                    "n_nodes": len(doc["nodes"]),
+                    "bucket": bucket,
+                    "compile_loss": compile_loss,
+                    "program": doc,
+                    "observations": [],
+                }
+            obs = artifact["observations"]
+            if len(obs) < MAX_OBSERVATIONS:
+                obs.append(observation)
+            else:
+                obs[-1] = observation
+            artifact["verdict"] = verdict
+            artifact["loss_reason"] = loss_reason
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fp:
+                json.dump(artifact, fp, sort_keys=True)
+            os.replace(tmp, path)
+        _loss, captured = _metrics()
+        captured.labels(origin=artifact["origin"]).inc()
+        return path
+    except Exception:
+        log.debug("query capture failed", exc_info=True)
+        return None
+
+
+def load_corpus(
+    corpus_dir: str,
+    reason: Optional[str] = None,
+    origin: Optional[str] = None,
+) -> List[Dict]:
+    """Load a captured corpus (sorted by content address), optionally
+    filtered by last loss reason and/or query origin."""
+    out: List[Dict] = []
+    for name in sorted(os.listdir(corpus_dir)):
+        if not (name.startswith("q-") and name.endswith(".json")):
+            continue
+        path = os.path.join(corpus_dir, name)
+        try:
+            with open(path) as fp:
+                artifact = json.load(fp)
+        except Exception:
+            log.warning("unreadable capture artifact skipped: %s", path)
+            continue
+        if artifact.get("kind") != "mtpu-solver-query":
+            continue
+        if int(artifact.get("schema_version", 0)) > ARTIFACT_SCHEMA_VERSION:
+            log.warning("artifact %s has a newer schema; skipped", name)
+            continue
+        if reason is not None and artifact.get("loss_reason") != reason:
+            continue
+        if origin is not None and artifact.get("origin") != origin:
+            continue
+        out.append(artifact)
+    return out

@@ -1692,6 +1692,36 @@ class AnalysisEngine:
         out["cache_misses"] = out.pop("misses")
         return out
 
+    @staticmethod
+    def _solver_stats(snap: Dict) -> Dict:
+        """`/stats solver.*`: the query flight recorder's live view —
+        the loss waterfall (why host-answered queries were not
+        device-answered), the host-WON restriction, and the capture
+        corpus state (observe/querylog.py). Process-wide series, not
+        per-engine: the solver funnel is shared."""
+        from mythril_tpu.observe import querylog
+
+        loss: Dict[str, int] = {}
+        loss_sat: Dict[str, int] = {}
+        for key, value in (snap.get("mtpu_solver_loss_total") or {}).items():
+            labels = dict(key)
+            reason = labels.get("reason", "?")
+            loss[reason] = loss.get(reason, 0) + int(value)
+            if labels.get("verdict") == "sat":
+                loss_sat[reason] = loss_sat.get(reason, 0) + int(value)
+        return {
+            "loss": loss,
+            "loss_sat": loss_sat,
+            "captured_queries": int(
+                sum(
+                    (
+                        snap.get("mtpu_solver_captured_queries_total") or {}
+                    ).values()
+                )
+            ),
+            "capture_dir": querylog.capture_dir(),
+        }
+
     def stats(self) -> Dict:
         """The /stats tree. The wave-loop counters all come out of ONE
         registry snapshot (a single lock acquisition), so the numbers
@@ -1813,6 +1843,7 @@ class AnalysisEngine:
                 ),
             },
             "kernel": self._kernel_stats(),
+            "solver": self._solver_stats(snap),
             "host_pool": {
                 "workers": max(1, self.cfg.host_workers),
                 "inflight": len(self._host_inflight),

@@ -96,10 +96,15 @@ def get_model(
         s.minimize(e)
     for e in maximize:
         s.maximize(e)
+    from mythril_tpu.observe.querylog import query_context
     from mythril_tpu.support.phase_profile import PhaseProfile
 
     with PhaseProfile().measure("solve"):
-        result = s.check()
+        # flight-recorder origin: a bare get_model solve is a memo
+        # miss (engine feasibility checks); module/flip-frontier
+        # callers already tagged the context and keep their tag
+        with query_context("memo-miss", only_if_root=True):
+            result = s.check()
     if result == sat:
         model = s.model()
         _store(key, (sat, model))

@@ -89,6 +89,14 @@ def pytest_configure(config):
         "routing feature log, stats-merge policy; CPU-only — runs in "
         "tier-1, selectable with -m observe)",
     )
+    config.addinivalue_line(
+        "markers",
+        "solverlab: solver query flight recorder + replay lab suite "
+        "(observe/querylog capture artifacts + loss-reason taxonomy, "
+        "solver funnel classification, myth solverlab replay "
+        "agreement; CPU-only — runs in tier-1, selectable with "
+        "-m solverlab)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

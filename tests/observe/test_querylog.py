@@ -1,0 +1,389 @@
+"""Solver query flight recorder suite (observe/querylog.py +
+laser/smt/solver/capture.py; tier-1 `solverlab` marker).
+
+Pins the ISSUE-8 capture half:
+- serialize/deserialize roundtrip: rebuilt queries decide identically,
+  content addresses are stable and var-name-canonical;
+- the on-disk artifact schema golden + same-query dedup;
+- loss-reason classification at every funnel exit site (gate off,
+  sprint preemption, deterministic mode, trivial queries, the race
+  losses — nonconverged vs timing vs invalid witness — via stubbed
+  races), and the accounting identity: one sat-loss per CDCL sat;
+- capture disabled by default, and the disabled path adds no registry
+  series;
+- loss counters are legacy-backing registry arithmetic: they stay on
+  under --no-observe.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from mythril_tpu import observe
+from mythril_tpu.laser.smt import terms
+from mythril_tpu.laser.smt.solver import device_race, native_sat
+from mythril_tpu.laser.smt.solver.solver import (
+    check_terms,
+    reset_blast_session,
+    sat,
+    unsat,
+)
+from mythril_tpu.laser.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.observe import querylog
+
+pytestmark = pytest.mark.solverlab
+
+_UNIQ = [0]
+
+
+def _vars(n=1, width=16):
+    """Fresh var names per call: the persistent blast session and the
+    get_model memo key on names, and tests must not share state."""
+    _UNIQ[0] += 1
+    return [
+        terms.bv_var(f"qlv{_UNIQ[0]}_{i}", width) for i in range(n)
+    ]
+
+
+def _range_query(lo=3, hi=9, width=16):
+    (x,) = _vars(1, width)
+    return [
+        terms.ult(terms.bv_const(lo, width), x),
+        terms.ult(x, terms.bv_const(hi, width)),
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    from mythril_tpu.support.support_args import args
+
+    restore = (args.device_solving, args.parallel_solving,
+               args.deterministic_solving)
+    querylog.configure_capture(None)
+    yield
+    (args.device_solving, args.parallel_solving,
+     args.deterministic_solving) = restore
+    querylog.configure_capture(None)
+    observe.set_enabled(True)
+
+
+# -- serialization ----------------------------------------------------------
+
+
+def test_roundtrip_preserves_verdicts():
+    from mythril_tpu.laser.smt.solver.preprocess import lower
+
+    query = _range_query()
+    live, _ = check_terms(query)
+    lowered, _recon = lower(query)
+    doc = querylog.serialize_terms(lowered)
+    rebuilt = querylog.deserialize_terms(doc)
+    replayed, _ = check_terms(rebuilt)
+    assert live == replayed == sat
+
+    # an unsat query roundtrips to unsat
+    (y,) = _vars()
+    contradiction = [
+        terms.ult(y, terms.bv_const(3, 16)),
+        terms.ult(terms.bv_const(7, 16), y),
+    ]
+    assert check_terms(contradiction)[0] == unsat
+    doc2 = querylog.serialize_terms(contradiction)
+    assert check_terms(querylog.deserialize_terms(doc2))[0] == unsat
+
+
+def test_roundtrip_covers_the_lowered_op_surface():
+    """Every op family the preprocessor can leave behind survives the
+    roundtrip as the SAME interned term."""
+    x, y = _vars(2, 64)
+    b = terms.bool_var(f"qlb{_UNIQ[0]}")
+    query = [
+        terms.eq(
+            terms.add(terms.mul(x, y), terms.udiv(x, terms.bv_const(3, 64))),
+            terms.bvxor(terms.shl(x, terms.bv_const(2, 64)), terms.bvnot(y)),
+        ),
+        terms.band(
+            b,
+            terms.bor(
+                terms.slt(terms.sext(terms.extract(7, 0, x), 8), y),
+                terms.ule(terms.concat(terms.extract(15, 8, x),
+                                       terms.extract(7, 0, y)), x),
+            ),
+        ),
+        terms.eq(
+            terms.ite(b, terms.urem(x, terms.bv_const(5, 64)),
+                      terms.ashr(y, terms.bv_const(1, 64))),
+            terms.zext(terms.extract(31, 0, x), 32),
+        ),
+    ]
+    doc = querylog.serialize_terms(query)
+    rebuilt = querylog.deserialize_terms(doc)
+    # interning makes identity the strongest possible equality
+    assert all(a is b_ for a, b_ in zip(query, rebuilt))
+
+
+def test_content_address_stable_and_name_canonical():
+    query = _range_query()
+    doc = querylog.serialize_terms(query)
+    assert querylog.content_address(doc) == querylog.content_address(
+        querylog.serialize_terms(query)
+    )
+    # same shape under different var NAMES -> same address (the
+    # preprocessor gensyms fresh names run to run)
+    (z,) = _vars()
+    renamed = [
+        terms.ult(terms.bv_const(3, 16), z),
+        terms.ult(z, terms.bv_const(9, 16)),
+    ]
+    assert querylog.content_address(
+        querylog.serialize_terms(renamed)
+    ) == querylog.content_address(doc)
+    # a different CONSTANT is a different query
+    (w,) = _vars()
+    other = [
+        terms.ult(terms.bv_const(4, 16), w),
+        terms.ult(w, terms.bv_const(9, 16)),
+    ]
+    assert querylog.content_address(
+        querylog.serialize_terms(other)
+    ) != querylog.content_address(doc)
+
+
+# -- capture ----------------------------------------------------------------
+
+
+def test_capture_disabled_by_default(tmp_path):
+    assert not querylog.capture_enabled()
+    marker = observe.registry().marker()
+    check_terms(_range_query())
+    delta = observe.registry().since(marker)
+    assert not delta.get("mtpu_solver_captured_queries_total")
+
+
+def test_artifact_schema_and_dedup(tmp_path):
+    querylog.configure_capture(str(tmp_path))
+    query = _range_query()
+    check_terms(query)
+    check_terms(query)  # identical content -> one artifact, two obs
+    files = glob.glob(str(tmp_path / "q-*.json"))
+    assert len(files) == 1
+    with open(files[0]) as fp:
+        artifact = json.load(fp)
+    assert artifact["schema_version"] == querylog.ARTIFACT_SCHEMA_VERSION
+    assert artifact["kind"] == "mtpu-solver-query"
+    assert os.path.basename(files[0]) == f"q-{artifact['sha']}.json"
+    assert artifact["origin"] == "memo-miss"  # bare check_terms
+    assert artifact["verdict"] == sat
+    assert artifact["loss_reason"]  # host-won: reason is non-empty
+    assert artifact["n_constraints"] == 2
+    assert set(artifact["bucket"]) == {
+        "nodes", "consts", "roots", "vars", "limbs"
+    }
+    assert artifact["compile_loss"] is None
+    assert len(artifact["observations"]) == 2
+    obs = artifact["observations"][0]
+    assert set(obs) == {
+        "engine", "verdict", "wall_s", "hop", "loss_reason", "site"
+    }
+    assert obs["engine"] == "host-cdcl"
+    # the corpus loader round-trips it
+    corpus = querylog.load_corpus(str(tmp_path))
+    assert len(corpus) == 1 and corpus[0]["sha"] == artifact["sha"]
+
+
+def test_capture_respects_query_context(tmp_path):
+    querylog.configure_capture(str(tmp_path))
+    with querylog.query_context("flip-frontier"):
+        check_terms(_range_query(lo=3, hi=9))
+    with querylog.query_context("module"):
+        # memo-miss must NOT mask an enclosing module tag
+        with querylog.query_context("memo-miss", only_if_root=True):
+            check_terms(_range_query(lo=4, hi=11))
+    origins = sorted(
+        a["origin"] for a in querylog.load_corpus(str(tmp_path))
+    )
+    assert origins == ["flip-frontier", "module"]
+
+
+def test_dedup_keeps_the_first_origin(tmp_path):
+    """Structurally-identical queries from two contexts land in ONE
+    content-addressed artifact; the origin recorded is the first
+    capturer's (observations keep accruing)."""
+    querylog.configure_capture(str(tmp_path))
+    with querylog.query_context("flip-frontier"):
+        check_terms(_range_query(lo=5, hi=12))
+    with querylog.query_context("module"):
+        check_terms(_range_query(lo=5, hi=12))  # same canonical shape
+    corpus = querylog.load_corpus(str(tmp_path))
+    assert len(corpus) == 1
+    assert corpus[0]["origin"] == "flip-frontier"
+    assert len(corpus[0]["observations"]) == 2
+
+
+# -- loss classification at the funnel exits --------------------------------
+
+
+def _sat_losses(marker):
+    return querylog.loss_reasons(since=marker, verdict="sat")
+
+
+def test_gate_disabled_and_accounting_identity():
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "never"
+    marker = observe.registry().marker()
+    base = SolverStatistics().cdcl_sat_count
+    check_terms(_range_query())
+    check_terms(_range_query())
+    losses = _sat_losses(marker)
+    assert losses == {"GATE_DISABLED": 2}
+    assert sum(losses.values()) == SolverStatistics().cdcl_sat_count - base
+
+
+def test_sprint_preempted_when_gate_open():
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "always"
+    marker = observe.registry().marker()
+    check_terms(_range_query())
+    assert _sat_losses(marker) == {"SPRINT_PREEMPTED": 1}
+
+
+def test_deterministic_mode_counts_as_gate_disabled():
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "always"
+    args.deterministic_solving = True
+    marker = observe.registry().marker()
+    check_terms(_range_query())
+    assert _sat_losses(marker) == {"GATE_DISABLED": 1}
+
+
+def test_trivial_unsat_is_not_a_loss():
+    marker = observe.registry().marker()
+    base = SolverStatistics().cdcl_sat_count
+    verdict, _ = check_terms([terms.FALSE])
+    assert verdict == unsat
+    assert _sat_losses(marker) == {}
+    all_losses = querylog.loss_reasons(since=marker)
+    assert all_losses == {"QUERY_TRIVIAL": 1}
+    assert SolverStatistics().cdcl_sat_count == base
+
+
+def _force_sprint_unknown(monkeypatch):
+    """First native solve (the sprint) comes back UNKNOWN; later calls
+    run for real — the query drops into the race/marathon branch."""
+    real = native_sat.SolverSession.solve
+    calls = {"n": 0}
+
+    def fake(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return native_sat.UNKNOWN, None
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(native_sat.SolverSession, "solve", fake)
+
+
+def _race_stub(poll_result, outcome):
+    class StubRace:
+        started = True
+
+        def __init__(self, lowered, **kw):
+            pass
+
+        def poll(self):
+            return poll_result
+
+        def outcome(self):
+            return outcome
+
+    return StubRace
+
+
+def test_race_nonconverged_vs_timing_vs_invalid(monkeypatch):
+    """The satellite pin: race_losses split into 'portfolio finished
+    without a witness' (SLS_NONCONVERGED), 'still running when the
+    CDCL answered' (RACE_LOST_TIMING), and 'witness failed the gate'
+    (WITNESS_INVALID)."""
+    from mythril_tpu.laser.smt.solver import solver as solver_mod
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "always"
+
+    del solver_mod  # the impl imports device_race afresh per query
+    cases = [
+        (_race_stub(device_race.FAILED, "failed"), "SLS_NONCONVERGED"),
+        (_race_stub(device_race.PENDING, "pending"), "RACE_LOST_TIMING"),
+        (_race_stub({"bogus_var": 1}, "witness"), "WITNESS_INVALID"),
+    ]
+    for stub, expected in cases:
+        _force_sprint_unknown(monkeypatch)
+        monkeypatch.setattr(device_race, "DeviceRace", stub, raising=True)
+        losses_before = SolverStatistics().race_losses
+        marker = observe.registry().marker()
+        verdict, _ = check_terms(_range_query())
+        assert verdict == sat
+        assert _sat_losses(marker) == {expected: 1}, expected
+        assert SolverStatistics().race_losses == losses_before + 1
+        monkeypatch.undo()
+        reset_blast_session()
+
+
+def test_race_not_started_when_chip_busy(monkeypatch):
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "always"
+    _force_sprint_unknown(monkeypatch)
+    monkeypatch.setattr(device_race, "race_available", lambda: False)
+    marker = observe.registry().marker()
+    verdict, _ = check_terms(_range_query())
+    assert verdict == sat
+    assert _sat_losses(marker) == {"RACE_NOT_STARTED": 1}
+    monkeypatch.undo()
+    reset_blast_session()
+
+
+def test_loss_counters_survive_no_observe():
+    """record_loss is legacy-backing registry arithmetic: the bench
+    identity must hold with telemetry off."""
+    from mythril_tpu.support.support_args import args
+
+    args.device_solving = "never"
+    observe.set_enabled(False)
+    try:
+        marker = observe.registry().marker()
+        check_terms(_range_query())
+        assert _sat_losses(marker) == {"GATE_DISABLED": 1}
+    finally:
+        observe.set_enabled(True)
+
+
+# -- the folded SolverStatistics singleton ----------------------------------
+
+
+def test_solver_statistics_is_a_registry_view():
+    stats = SolverStatistics()
+    reg = observe.registry()
+    before = stats.device_cert_count
+    stats.device_cert_count += 2
+    assert stats.device_cert_count == before + 2
+    assert (
+        reg.value("mtpu_solver_stats_device_certs_total") == before + 2
+    )
+    wins_before = reg.value("mtpu_solver_stats_race_total", outcome="won")
+    stats.race_wins += 1
+    assert reg.value(
+        "mtpu_solver_stats_race_total", outcome="won"
+    ) == wins_before + 1
+    # the repr keeps its legacy shape
+    text = repr(stats)
+    assert text.startswith("Solver statistics:")
+    for line in (
+        "Query count:", "Solver time:",
+        "Sat verdicts from device portfolio:", "Sat verdicts from CDCL:",
+        "Device races won/lost:",
+    ):
+        assert line in text

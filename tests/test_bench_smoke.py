@@ -25,11 +25,14 @@ def _parsed_lines(stdout: str):
     return out
 
 
-def test_bench_emits_headline_record_inside_budget():
+def test_bench_emits_headline_record_inside_budget(tmp_path):
     """A tiny-budget bench run must exit 0 within the window and print
     at least one complete parseable record (corpus phases report
     budget-skipped rather than eating the wall), with the mesh fields
-    present."""
+    present — plus the ISSUE-8 flight-recorder fields: the loss
+    waterfall balances the run's cdcl-sat count exactly, and
+    MYTHRIL_BENCH_CAPTURE_DIR leaves a replayable corpus behind."""
+    capture_dir = str(tmp_path / "qcorpus")
     env = dict(
         os.environ,
         MYTHRIL_BENCH_BUDGET_S="70",
@@ -38,6 +41,7 @@ def test_bench_emits_headline_record_inside_budget():
         MYTHRIL_BENCH_STEPS="64",
         MYTHRIL_BENCH_CONTRACTS="2",
         MYTHRIL_BENCH_PAIRS="0",  # toy run: headline phases only
+        MYTHRIL_BENCH_CAPTURE_DIR=capture_dir,
         JAX_PLATFORMS="cpu",
     )
     proc = subprocess.run(
@@ -60,10 +64,27 @@ def test_bench_emits_headline_record_inside_budget():
     for field in (
         "metric", "value", "unit", "vs_baseline", "bench_wall_s",
         "mesh_devices", "steal_count", "static_prune_rate",
+        "solver_loss_reasons", "captured_queries", "cdcl_sat_verdicts",
     ):
         assert field in final, f"missing {field}"
     assert final["corpus"] == "disabled"
     assert final["bench_wall_s"] <= 70 + 45  # the budget held
+    # the flight-recorder accounting identity (ISSUE 8 acceptance):
+    # every host-won query carries exactly one loss reason
+    assert sum(final["solver_loss_reasons"].values()) == (
+        final["cdcl_sat_verdicts"]
+    ), final["solver_loss_reasons"]
+    # the capture corpus landed beside the record (dedup can fold
+    # repeat queries into fewer files than captures; a budget-starved
+    # toy run that solved nothing leaves an armed-but-empty dir)
+    assert final.get("capture_dir") == capture_dir
+    artifacts = [
+        name
+        for name in os.listdir(capture_dir)
+        if name.startswith("q-") and name.endswith(".json")
+    ]
+    assert (len(artifacts) > 0) == (final["captured_queries"] > 0)
+    assert len(artifacts) <= max(1, final["captured_queries"])
 
 
 @pytest.mark.slow

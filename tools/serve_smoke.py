@@ -170,6 +170,27 @@ def main() -> int:
         assert metric_total("mtpu_service_admissions_total") >= 5, (
             "admission counter did not track the submissions"
         )
+        # -- query flight recorder (ISSUE 8): capture OFF must stay
+        # free — no capture series materializes in the registry, the
+        # /stats solver block reports a disarmed recorder, and the
+        # disabled hook is a boolean check costing well under 1% of
+        # any request's wall
+        assert "mtpu_solver_captured_queries_total" not in metrics_text, (
+            "--capture-queries off still materialized capture series"
+        )
+        solver_block = stats.get("solver", {})
+        assert solver_block.get("capture_dir") is None, solver_block
+        assert solver_block.get("captured_queries", 0) == 0, solver_block
+        from mythril_tpu.laser.smt.solver import capture as query_capture
+
+        t_hook = time.monotonic()
+        for _ in range(100_000):
+            query_capture.capture_active()
+        hook_s = time.monotonic() - t_hook
+        assert hook_s < 0.01 * cold_s, (
+            f"disabled capture hook cost {hook_s:.3f}s per 100k checks — "
+            f"not <1% of the {cold_s:.2f}s cold request"
+        )
         assert cold_job["state"] == "done", f"cold job: {cold_job}"
         assert len(warm) == 4, f"expected 4 warm reports, got {len(warm)}"
         for job_id, (_, report) in warm.items():
