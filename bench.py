@@ -818,10 +818,11 @@ def _emit(record: dict, stage: str) -> None:
 #: every printed record
 _SOLVER_RUN_MARKER = None
 _CDCL_SAT_BASE = 0
+_DEVICE_SAT_BASE = 0
 
 
 def _mark_solver_run() -> None:
-    global _SOLVER_RUN_MARKER, _CDCL_SAT_BASE
+    global _SOLVER_RUN_MARKER, _CDCL_SAT_BASE, _DEVICE_SAT_BASE
     from mythril_tpu import observe
     from mythril_tpu.laser.smt.solver.solver_statistics import (
         SolverStatistics,
@@ -829,6 +830,7 @@ def _mark_solver_run() -> None:
 
     _SOLVER_RUN_MARKER = observe.solver_marker()
     _CDCL_SAT_BASE = SolverStatistics().cdcl_sat_count
+    _DEVICE_SAT_BASE = SolverStatistics().device_sat_count
 
 
 def _solver_flight_fields(record: dict) -> None:
@@ -851,8 +853,18 @@ def _solver_flight_fields(record: dict) -> None:
         record["captured_queries"] = observe.captured_total(
             since=_SOLVER_RUN_MARKER
         )
-        record["cdcl_sat_verdicts"] = (
-            SolverStatistics().cdcl_sat_count - _CDCL_SAT_BASE
+        cdcl_sats = SolverStatistics().cdcl_sat_count - _CDCL_SAT_BASE
+        device_sats = (
+            SolverStatistics().device_sat_count - _DEVICE_SAT_BASE
+        )
+        record["cdcl_sat_verdicts"] = cdcl_sats
+        record["device_sat_verdicts"] = device_sats
+        # the ISSUE-9 acceptance headline: what fraction of this run's
+        # SAT verdicts the accelerator OWNED (device-first funnel
+        # target: > 0.5, up from 0.0 in BENCH_r02-r04)
+        total_sats = cdcl_sats + device_sats
+        record["device_verdict_share"] = (
+            round(device_sats / total_sats, 3) if total_sats else 0.0
         )
     except Exception as e:
         print(f"bench: solver flight fields failed: {e!r}", file=sys.stderr)
@@ -908,6 +920,10 @@ def main(final_attempt: bool = False) -> None:
         "solver_loss_reasons": {},
         "captured_queries": 0,
         "cdcl_sat_verdicts": 0,
+        # device-first funnel scorecard (ISSUE 9): refreshed at every
+        # emit — device_sat / (device_sat + cdcl_sat) over the run
+        "device_sat_verdicts": 0,
+        "device_verdict_share": 0.0,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")

@@ -15,9 +15,11 @@ Engines:
 - ``host``    native CDCL alone (device gate closed), conflict-budgeted
               so the replay verdict is a pure function of the query —
               this leg must reproduce the live verdicts
-- ``device``  the portfolio alone: compile to the shape bucket, run the
-              stochastic local search, validate any witness by concrete
-              evaluation (an incomplete engine: "unknown" proves
+- ``device``  the device funnel alone: compile to the shape bucket,
+              exhaustively enumerate small complete spaces (a genuine
+              unsat verdict), else the diversified stochastic local
+              search plus the cube-and-conquer fan; any witness is
+              validated by concrete evaluation ("unknown" proves
               nothing and counts as *incomplete*, not disagreement)
 - ``race``    the production funnel with the device gate forced open
               (sprint -> race -> marathon), answering "would the race
@@ -107,9 +109,11 @@ def _replay_host(lowered: List, timeout_ms: int) -> str:
 def _replay_device(
     lowered: List, candidates: int, steps: int
 ) -> Tuple[str, Optional[str]]:
-    """The portfolio alone; returns (verdict, loss_reason). A found
-    witness is believed only after concretely satisfying every root —
-    the same soundness gate production models pass."""
+    """The device funnel alone (enumeration + diversified SLS +
+    cube-and-conquer, no host rungs); returns (verdict, loss_reason).
+    A found witness is believed only after concretely satisfying every
+    root — the same validation gate production models pass — and a
+    complete enumeration's unsat is a genuine device-owned verdict."""
     from mythril_tpu.laser.smt.evalterm import eval_term
     from mythril_tpu.laser.smt.solver import portfolio
 
@@ -118,11 +122,14 @@ def _replay_device(
         return UNSUPPORTED, compile_loss
     if not prog.var_slots:
         return UNSUPPORTED, querylog.LOSS_QUERY_TRIVIAL
-    assignment = portfolio.device_check(
-        lowered, candidates=candidates, steps=steps, prog=prog
-    )
-    if assignment is None:
-        return "unknown", querylog.LOSS_SLS_NONCONVERGED
+    verdict = portfolio.device_solve_batch(
+        [lowered], candidates=candidates, steps=steps
+    )[0]
+    if verdict.status == "unsat":
+        return "unsat", None
+    if verdict.status != "sat":
+        return "unknown", verdict.loss
+    assignment = verdict.assignment
     try:
         if all(eval_term(c, assignment) for c in lowered):
             return "sat", None
@@ -285,6 +292,130 @@ def replay_corpus(
     return report
 
 
+# ---------------------------------------------------------------------------
+# portfolio tuning: `myth solverlab tune`
+# ---------------------------------------------------------------------------
+
+#: the tunable diversified-portfolio knobs and their sweep axes. The
+#: committed winners live in portfolio.PORTFOLIO_DEFAULTS — re-run the
+#: tune against a fresh capture before changing them by hand.
+TUNE_GRID: Dict[str, List] = {
+    "noise_lo": [0.0, 0.02, 0.08],
+    "noise_hi": [0.2, 0.4, 0.6],
+    "greedy_frac": [0.25, 0.5, 0.75],
+    "restart_base": [12, 24, 48],
+    "seeded_frac": [0.0, 0.25, 0.5],
+    "cube_depth": [0, 2, 3, 4],
+    "first_pass_steps": [96, 192, 384],
+}
+
+
+def _tune_trial(
+    lowered_queries: List[List], candidates: int, knobs: Dict
+) -> Dict:
+    """One sweep point: replay the device funnel over the corpus under
+    `portfolio_overrides(**knobs)`. Scored on decided queries (sat +
+    device-owned unsat, witnesses validated inside device_solve_batch)
+    then wall — more verdicts first, faster second."""
+    from mythril_tpu.laser.smt.solver import portfolio
+
+    t0 = time.perf_counter()
+    with portfolio.portfolio_overrides(**knobs):
+        verdicts = portfolio.device_solve_batch(
+            lowered_queries, candidates=candidates
+        )
+    wall = time.perf_counter() - t0
+    sat_n = sum(1 for v in verdicts if v.status == "sat")
+    unsat_n = sum(1 for v in verdicts if v.status == "unsat")
+    return {
+        "knobs": dict(knobs),
+        "sat": sat_n,
+        "unsat": unsat_n,
+        "decided": sat_n + unsat_n,
+        "unknown": len(verdicts) - sat_n - unsat_n,
+        "wall_s": round(wall, 3),
+    }
+
+
+def tune_corpus(
+    corpus: Sequence[Dict],
+    trials: int = 12,
+    sweep: str = "random",
+    seed: int = 1,
+    candidates: int = 64,
+) -> Dict:
+    """Sweep the portfolio knobs over a captured corpus and rank the
+    results — the offline lab that derives PORTFOLIO_DEFAULTS.
+
+    ``sweep="grid"`` walks one knob at a time off the current defaults
+    (a coordinate sweep: len(axis) trials per knob, `trials` ignored);
+    ``sweep="random"`` samples `trials` random grid combinations.
+    Every trial recompiles the search kernels (the knobs are
+    trace-time constants), so this is replay-lab cost by design —
+    run it offline, commit the winner."""
+    import random as _random
+
+    from mythril_tpu.laser.smt.solver import portfolio
+
+    lowered_queries: List[List] = []
+    for artifact in corpus:
+        try:
+            lowered_queries.append(_rebuild(artifact))
+        except Exception as why:
+            log.warning(
+                "artifact %s did not rebuild: %s", artifact["sha"], why
+            )
+    report: Dict = {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "queries": len(lowered_queries),
+        "sweep": sweep,
+        "defaults": dict(portfolio.PORTFOLIO_DEFAULTS),
+    }
+    results: List[Dict] = []
+    if not lowered_queries:
+        report["trials"] = results
+        return report
+    # the committed defaults are always trial 0 — the bar to beat
+    baseline = _tune_trial(lowered_queries, candidates, {})
+    baseline["baseline"] = True
+    results.append(baseline)
+    if sweep == "grid":
+        for knob, axis in sorted(TUNE_GRID.items()):
+            for value in axis:
+                if value == portfolio.PORTFOLIO_DEFAULTS.get(knob):
+                    continue  # the baseline already covers it
+                results.append(
+                    _tune_trial(
+                        lowered_queries, candidates, {knob: value}
+                    )
+                )
+    elif sweep == "random":
+        rng = _random.Random(seed)
+        seen = set()
+        for _ in range(max(0, trials)):
+            knobs = {
+                knob: rng.choice(axis)
+                for knob, axis in sorted(TUNE_GRID.items())
+            }
+            key = tuple(sorted(knobs.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            results.append(_tune_trial(lowered_queries, candidates, knobs))
+    else:
+        raise ValueError(f"unknown sweep {sweep!r} (grid|random)")
+    ranked = sorted(
+        results, key=lambda r: (-r["decided"], r["wall_s"])
+    )
+    report["trials"] = ranked
+    report["best"] = ranked[0]
+    report["beats_baseline"] = (
+        ranked[0] is not baseline
+        and ranked[0]["decided"] > baseline["decided"]
+    )
+    return report
+
+
 def run(
     corpus_dir: str,
     mode: str = "replay",
@@ -295,13 +426,24 @@ def run(
     reason: Optional[str] = None,
     origin: Optional[str] = None,
     shard: Optional[str] = None,
+    trials: int = 12,
+    sweep: str = "random",
+    tune_seed: int = 1,
 ) -> Dict:
-    """Load + filter + shard a corpus, then replay (or just report)."""
+    """Load + filter + shard a corpus, then replay, tune, or report."""
     corpus = querylog.load_corpus(corpus_dir, reason=reason, origin=origin)
     corpus = shard_corpus(corpus, parse_shard(shard))
     if mode == "report":
         report = waterfall(corpus)
         report["schema_version"] = REPORT_SCHEMA_VERSION
+    elif mode == "tune":
+        report = tune_corpus(
+            corpus,
+            trials=trials,
+            sweep=sweep,
+            seed=tune_seed,
+            candidates=candidates,
+        )
     else:
         report = replay_corpus(
             corpus,
@@ -319,8 +461,50 @@ def run(
     return report
 
 
+def render_tune_text(report: Dict) -> str:
+    """The human view of a tune sweep: the ranked knob table."""
+    lines = [
+        "solverlab tune: {q} quer{y} from {d} ({s} sweep)".format(
+            q=report.get("queries", 0),
+            y="y" if report.get("queries") == 1 else "ies",
+            d=report.get("corpus_dir", "?"),
+            s=report.get("sweep", "?"),
+        )
+    ]
+    trials = report.get("trials") or []
+    if not trials:
+        lines.append("  (no replayable queries in the corpus)")
+        return "\n".join(lines)
+    lines.append(
+        f"  {'rank':<5}{'decided':<9}{'sat':<6}{'unsat':<7}"
+        f"{'wall_s':<9}knobs"
+    )
+    for rank, row in enumerate(trials, 1):
+        tag = " (baseline: committed defaults)" if row.get("baseline") else ""
+        knobs = (
+            " ".join(
+                f"{k}={v}" for k, v in sorted(row["knobs"].items())
+            )
+            or "-"
+        )
+        lines.append(
+            f"  {rank:<5}{row['decided']:<9}{row['sat']:<6}"
+            f"{row['unsat']:<7}{row['wall_s']:<9}{knobs}{tag}"
+        )
+    if report.get("beats_baseline"):
+        lines.append(
+            "  -> the winner BEATS the committed defaults: consider "
+            "updating portfolio.PORTFOLIO_DEFAULTS"
+        )
+    else:
+        lines.append("  -> the committed defaults hold")
+    return "\n".join(lines)
+
+
 def render_text(report: Dict) -> str:
     """The human view: waterfall + agreement tables."""
+    if report.get("mode") == "tune" or "trials" in report:
+        return render_tune_text(report)
     lines = [
         "solverlab: {queries} quer{y} from {dir}".format(
             queries=report["queries"],

@@ -366,6 +366,36 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--host-first-funnel",),
+        dict(
+            action="store_true",
+            help=(
+                "Restore the legacy host-first solver funnel: the "
+                "per-query CDCL sprint sees every flip query before "
+                "the batched device dispatch. Default is the "
+                "device-first funnel (diversified SLS portfolio + "
+                "enumeration + cube-and-conquer first, host CDCL as "
+                "the escalation ladder) — this flag is the parity "
+                "differential baseline for a suspected funnel bug"
+            ),
+        ),
+    ),
+    (
+        ("--sprint-cap-s",),
+        dict(
+            type=float,
+            default=None,
+            metavar="SECONDS",
+            help=(
+                "Wall cap for the escalation ladder's host-CDCL pass "
+                "over one wave's flip survivors (default 5.0, env "
+                "MYTHRIL_SPRINT_CAP_S); capped queries are recorded "
+                "SPRINT_PREEMPTED with the actual cap in the loss "
+                "artifact and retried next wave"
+            ),
+        ),
+    ),
+    (
         ("--trace-out",),
         dict(
             default=None,
@@ -848,12 +878,16 @@ def build_parser() -> ArgumentParser:
     )
     solverlab.add_argument(
         "mode",
-        choices=["replay", "report"],
+        choices=["replay", "report", "tune"],
         nargs="?",
         default="replay",
         help=(
             "replay: re-solve the corpus on the chosen engines; "
-            "report: the captured waterfall alone, no solving"
+            "report: the captured waterfall alone, no solving; "
+            "tune: grid/random sweep of the diversified-portfolio "
+            "knobs (noise, restart schedule, cube depth, lane split) "
+            "over the corpus with a ranked results table — the lab "
+            "that derives portfolio.PORTFOLIO_DEFAULTS"
         ),
     )
     solverlab.add_argument(
@@ -902,6 +936,24 @@ def build_parser() -> ArgumentParser:
         "--strict",
         action="store_true",
         help="exit 1 when any engine disagrees with a live verdict",
+    )
+    solverlab.add_argument(
+        "--trials", type=int, default=12,
+        help="tune mode: random-sweep sample count (default 12)",
+    )
+    solverlab.add_argument(
+        "--sweep",
+        choices=["random", "grid"],
+        default="random",
+        help=(
+            "tune mode: 'random' samples --trials grid combinations, "
+            "'grid' walks one knob at a time off the committed "
+            "defaults"
+        ),
+    )
+    solverlab.add_argument(
+        "--tune-seed", type=int, default=1,
+        help="tune mode: random-sweep seed (deterministic trials)",
     )
 
     submit = subparsers.add_parser(
@@ -1255,6 +1307,8 @@ def _run_analyze(disassembler, address, args):
         deadline=args.deadline,
         on_timeout=args.on_timeout,
         capture_queries=args.capture_queries,
+        device_first=not args.host_first_funnel,
+        sprint_cap_s=args.sprint_cap_s,
     )
 
     if not disassembler.contracts:
@@ -1433,6 +1487,9 @@ def _cmd_solverlab(args: Namespace) -> None:
             reason=reason,
             origin=origin,
             shard=args.shard,
+            trials=args.trials,
+            sweep=args.sweep,
+            tune_seed=args.tune_seed,
         )
     except (OSError, ValueError) as why:
         log.error("solverlab: %s", why)

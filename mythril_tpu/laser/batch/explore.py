@@ -71,7 +71,7 @@ from mythril_tpu.laser.batch.symbolic import (
     sym_run_donated,
 )
 from mythril_tpu.laser.smt.solver import capture as query_capture
-from mythril_tpu.laser.smt.solver.portfolio import device_check_batch
+from mythril_tpu.laser.smt.solver.portfolio import device_solve_batch
 from mythril_tpu.laser.smt.solver.solver import lower
 from mythril_tpu.observe.querylog import QUERY_ORIGIN_FLIP, query_context
 from mythril_tpu.observe.solverstats import ORIGIN_DEVICE, record_query
@@ -147,10 +147,17 @@ class ExploreStats:
         self.arena_nodes = 0
         self.forks_tried = 0
         self.forks_feasible = 0
-        # flip-witness sources, in cost order: the incremental CDCL
-        # session answers first (host_sat); the on-chip portfolio is
-        # the escape hatch for queries it can't finish (device_sat)
+        # flip-witness sources in the DEVICE-FIRST funnel (ISSUE 9):
+        # the batched on-chip dispatch answers first (device_sat, and
+        # device_unsat for enumeration-owned unsats); the incremental
+        # CDCL session is the escalation ladder behind it (host_sat)
         self.device_sat = 0
+        self.device_unsat = 0
+        #: queries decided by exhaustive enumeration (complete small
+        #: spaces — the only device-owned unsat mode)
+        self.device_enumerated = 0
+        #: queries whose witness came from the cube-and-conquer fan
+        self.device_cube_sat = 0
         self.host_sat = 0
         self.branches_covered = 0
         self.carries_banked = 0  # mutating end states promoted to tx N+1
@@ -249,6 +256,9 @@ MERGE_POLICY: Dict[str, str] = {
     "forks_tried": "sum",
     "forks_feasible": "sum",
     "device_sat": "sum",
+    "device_unsat": "sum",
+    "device_enumerated": "sum",
+    "device_cube_sat": "sum",
     "host_sat": "sum",
     "branches_covered": "sum",
     "carries_banked": "sum",
@@ -1236,34 +1246,77 @@ class DeviceCorpusExplorer:
         return stripes
 
     # -- solving -------------------------------------------------------
-    def _sprint_flips(self, batch):
-        """CDCL-sprint pass over a wave's flip batch (condition
-        tuples). MUST run under the host lock in overlapped mode: the
-        incremental CDCL session, the term arena, and `lower` are all
-        process-global. Returns (assignments, capped, lowered, kept):
-        position-aligned assignments, the index set that never got a
-        real attempt (time cap / stop), and the lowered survivor
-        queries + their indices for the lock-free device stage.
+    def _sprint_cap_s(self) -> float:
+        """The escalation ladder's wall cap for one wave's host-CDCL
+        pass (args.sprint_cap_s, seeded from MYTHRIL_SPRINT_CAP_S;
+        previously a hardcoded 5.0)."""
+        from mythril_tpu.support.support_args import args as _flags
 
-        Flip queries are small byte-level calldata constraints; the
-        incremental CDCL session answers them in microseconds, so every
-        query gets a CDCL sprint first; the ones it cannot finish get
-        lowered here and solved on device afterwards."""
+        try:
+            return max(0.0, float(getattr(_flags, "sprint_cap_s", 5.0)))
+        except (TypeError, ValueError):
+            return 5.0
+
+    def _device_first(self) -> bool:
+        """Funnel order (ISSUE 9): device-first batched dispatch with
+        the CDCL sprint demoted to an escalation ladder, vs the legacy
+        host-first order (--host-first-funnel, the parity baseline)."""
+        from mythril_tpu.support.support_args import args as _flags
+
+        return bool(getattr(_flags, "device_first", True))
+
+    def _lower_flips(self, batch, indices=None):
+        """Lower flip queries for the device stage. MUST run under the
+        host lock in overlapped mode (the term arena and `lower` are
+        process-global). Returns (lowered queries, their indices into
+        `batch`); queries that fail to lower are simply absent — the
+        escalation ladder still sees them."""
+        if indices is None:
+            indices = range(len(batch))
+        lowered_batch: List = []
+        kept: List[int] = []
+        for i in indices:
+            try:
+                lowered, _ = lower([c.raw for c in batch[i]])
+            except Exception as e:
+                log.debug("lowering failed: %s", e)
+                continue
+            lowered_batch.append(lowered)
+            kept.append(i)
+        return lowered_batch, kept
+
+    def _sprint_flips(self, batch, out, skip=frozenset()):
+        """Host-CDCL pass over a wave's flip batch (condition tuples).
+        In the device-first funnel this is the ESCALATION ladder: it
+        runs after the batched device dispatch and only sees the
+        device's UNKNOWN survivors (`skip` holds the device-answered
+        indices). MUST run under the host lock in overlapped mode: the
+        incremental CDCL session, the term arena, and `lower` are all
+        process-global.
+
+        Writes assignments into `out` in place; returns (capped,
+        survivors): the index set that never got a REAL attempt
+        (wall cap / stop request — retried next wave, and recorded
+        SPRINT_PREEMPTED with the actual cap in the loss artifact),
+        and the attempted-but-undecided indices (solver timeouts —
+        the legacy host-first order hands these to the device)."""
         t0 = time.perf_counter()
         sprint_span = trace(
             "flip.solve.host", track=self.fault_domain, queries=len(batch)
         )
         sprint_span.__enter__()
-        out: List[Optional[Dict[str, int]]] = [None] * len(batch)
         survivors: List[int] = []
         capped: set = set()
-        # the sprint pass is time-capped as a whole: once hard queries
-        # have eaten this much wall, the rest skip straight to the
-        # batched device dispatch (whose cost does not grow with count)
-        sprint_cap_s = 5.0
+        # the pass is time-capped as a whole: once hard queries have
+        # eaten this much wall, the rest are recorded preempted and
+        # retried next wave (device-first: they already had their
+        # batched device attempt this wave)
+        sprint_cap_s = self._sprint_cap_s()
         stopped = False
         with query_context(QUERY_ORIGIN_FLIP):
             for i, conditions in enumerate(batch):
+                if i in skip or out[i] is not None:
+                    continue
                 # a stop request bounds post-stop lock-held work to the
                 # query in flight — the owner may be waiting on a join
                 # deadline past which it stops honoring the lock
@@ -1275,6 +1328,21 @@ class DeviceCorpusExplorer:
                 if time.perf_counter() - t0 > sprint_cap_s:
                     survivors.append(i)
                     capped.add(i)
+                    # the loss artifact names the cap that preempted
+                    # the query (the tuning knob for the ladder)
+                    try:
+                        lowered, _ = lower([c.raw for c in conditions])
+                        query_capture.capture_flip(
+                            lowered,
+                            verdict="unknown",
+                            wall_s=0.0,
+                            engine="host-cdcl",
+                            site="sprint_flips",
+                            loss_reason="SPRINT_PREEMPTED",
+                            detail={"sprint_cap_s": sprint_cap_s},
+                        )
+                    except Exception:
+                        log.debug("sprint-cap capture failed", exc_info=True)
                     continue
                 try:
                     model = get_model(
@@ -1291,59 +1359,96 @@ class DeviceCorpusExplorer:
                 except Exception as e:
                     log.debug("CDCL flip solve did not finish: %s", e)
                     survivors.append(i)
-
-        lowered_batch: List = []
-        kept: List[int] = []
-        if survivors and not stopped:
-            for i in survivors:
-                try:
-                    lowered, _ = lower([c.raw for c in batch[i]])
-                except Exception as e:
-                    log.debug("lowering failed: %s", e)
-                    continue
-                lowered_batch.append(lowered)
-                kept.append(i)
         sprint_span.__exit__(None, None, None)
+        if stopped:
+            # post-stop, undecided queries get no further stage this
+            # wave (bounded lock-held work); capped ones stay
+            # retriable, timeouts keep their attempt
+            survivors = []
         self.stats.flip_solve_s += time.perf_counter() - t0
-        return out, capped, lowered_batch, kept
+        return capped, survivors
 
-    def _device_flips(self, out, lowered_batch, kept):
-        """The lock-free stage: ONE batched device dispatch for every
-        sprint survivor — on a link where a dispatch chain costs
-        seconds, the portfolio is only affordable at batch granularity,
-        and a wave is exactly a batch (docs/roadmap.md: the device's
-        solving shape). Holding the host lock here would block the
-        owner's analyses on pure device work."""
+    def _device_flips(self, out, lowered_batch, kept, device_first=True):
+        """The lock-free device stage: ONE batched dispatch for the
+        whole wave's flip frontier (device-first funnel) — on a link
+        where a dispatch chain costs seconds, the portfolio is only
+        affordable at batch granularity, and its cost does not grow
+        with query count. The dispatch runs the diversified SLS
+        portfolio, exhaustive enumeration of small spaces, and the
+        cube-and-conquer fan (portfolio.device_solve_batch); every
+        SAT is witness-validated before it counts, and enumeration
+        UNSATs are device-OWNED verdicts that never escalate. Holding
+        the host lock here would block the owner's analyses on pure
+        device work.
+
+        Writes witnesses into `out`; returns (answered, unsat): the
+        device-decided index sets (the escalation ladder skips both).
+        """
+        answered: set = set()
+        unsat: set = set()
         if not lowered_batch:
-            return
+            return answered, unsat
         t0 = time.perf_counter()
+        n_dev = 1
+        devices = None
+        if self.mesh is not None:
+            devices = list(np.asarray(self.mesh.devices).flat)
+            n_dev = len(devices)
         with trace(
             "flip.solve.device",
             track=self.fault_domain,
             queries=len(lowered_batch),
         ):
-            found = device_check_batch(
+            # the legacy (host-first) baseline mirrors the old device
+            # stage: full per-query step budget, no cube fan — the
+            # parity differential compares funnels, not knob sets
+            verdicts = device_solve_batch(
                 lowered_batch,
                 candidates=self.portfolio_candidates,
-                steps=self.portfolio_steps,
+                steps=None if device_first else self.portfolio_steps,
+                cube_depth=None if device_first else 0,
+                n_devices=n_dev,
+                devices=devices,
             )
+        from mythril_tpu.laser.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
         dt = time.perf_counter() - t0
         per_query = dt / max(1, len(kept))
-        for qi, (i, assignment) in enumerate(zip(kept, found)):
-            if assignment is not None:
+        for qi, (i, verdict) in enumerate(zip(kept, verdicts)):
+            if verdict.status == "sat":
                 self.stats.device_sat += 1
-                out[i] = assignment
-            # solver attribution: these queries escalated past the CDCL
-            # sprint onto the on-chip portfolio (hop 1); a miss is an
-            # "unknown" — the portfolio is a sat-finder, not a decider
-            verdict = "sat" if assignment is not None else "unknown"
-            record_query(ORIGIN_DEVICE, verdict, per_query, hop=1)
+                # the process-wide engine scorecard: flip witnesses are
+                # device-OWNED sat verdicts (bench device_verdict_share)
+                SolverStatistics().device_sat_count += 1
+                out[i] = verdict.assignment
+                answered.add(i)
+            elif verdict.status == "unsat":
+                # a complete enumeration exhausted the space: the
+                # device owns this unsat — no host escalation
+                self.stats.device_unsat += 1
+                answered.add(i)
+                unsat.add(i)
+            if verdict.via == "enum":
+                self.stats.device_enumerated += 1
+            elif verdict.via == "cube":
+                self.stats.device_cube_sat += 1
+            # solver attribution: the device is the funnel's FIRST
+            # rung now (hop 0); the sprint ladder behind it is hop 1
+            record_query(ORIGIN_DEVICE, verdict.status, per_query, hop=0)
             # flight recorder: the batched dispatch bypasses
             # check_terms, so these flip-frontier queries capture here
             query_capture.capture_flip(
-                lowered_batch[qi], verdict=verdict, wall_s=per_query
+                lowered_batch[qi],
+                verdict=verdict.status,
+                wall_s=per_query,
+                hop=0,
+                loss_reason=verdict.loss,
+                detail={"via": verdict.via} if verdict.via else None,
             )
         self.stats.flip_solve_s += dt
+        return answered, unsat
 
     def _witness_bytes(self, assignment: Dict[str, int]) -> bytes:
         data = bytearray(self.calldata_len)
@@ -2145,6 +2250,7 @@ class DeviceCorpusExplorer:
         props = getattr(self, "_pending_props", [])
         self._pending_props = []
         guard = self.host_lock if self.host_lock is not None else nullcontext()
+        device_first = self._device_first()
         self.lock_wanted.set()
         try:
             with guard:
@@ -2153,18 +2259,54 @@ class DeviceCorpusExplorer:
                     for ci in range(len(self.tracks))
                 ]
                 flat = [c for cands in per_contract for c in cands]
-                # property-steering queries ride the same sprint batch
-                # as the flips (same cost model, same device escape)
-                solved, capped, lowered_batch, kept = self._sprint_flips(
-                    [cond for _, cond, _ in flat] + [p[2] for p in props]
-                )
+                # property-steering queries ride the same funnel batch
+                # as the flips (same cost model, same device dispatch)
+                batch = [cond for _, cond, _ in flat] + [p[2] for p in props]
+                solved: List[Optional[Dict[str, int]]] = [None] * len(batch)
+                if device_first:
+                    # INVERTED funnel (ISSUE 9): lower the WHOLE
+                    # frontier under the lock, so the one batched
+                    # device dispatch — whose cost does not grow with
+                    # query count — fires first, lock-free
+                    lowered_batch, kept = self._lower_flips(batch)
+                else:
+                    # legacy host-first order (the parity baseline):
+                    # the sprint sees everything, the device only its
+                    # survivors
+                    capped, survivors = self._sprint_flips(batch, solved)
+                    lowered_batch, kept = self._lower_flips(
+                        batch, indices=survivors
+                    )
         finally:
             self.lock_wanted.clear()
-        self._device_flips(solved, lowered_batch, kept)
+        device_unsat: set = set()
+        if device_first:
+            answered, device_unsat = self._device_flips(
+                solved, lowered_batch, kept
+            )
+            # the ESCALATION ladder: host CDCL only sees the device's
+            # unknown survivors (and the queries that never lowered)
+            self.lock_wanted.set()
+            try:
+                with guard:
+                    capped, _survivors = self._sprint_flips(
+                        batch, solved, skip=answered
+                    )
+            finally:
+                self.lock_wanted.clear()
+        else:
+            _answered, device_unsat = self._device_flips(
+                solved, lowered_batch, kept, device_first=False
+            )
         # a capped query that the device also failed to answer (or that
         # never compiled) had no genuine attempt; sprint-attempted and
-        # device-answered ones are spoken for
-        retriable = {i for i in capped if solved[i] is None and i < len(flat)}
+        # device-answered ones (including device-owned unsats) are
+        # spoken for
+        retriable = {
+            i
+            for i in capped
+            if solved[i] is None and i not in device_unsat and i < len(flat)
+        }
         # steering witnesses: calldata that makes a banked call site
         # target the attacker — seeded below, confirmed concretely by
         # the next wave's event bank

@@ -71,19 +71,25 @@ def capture_flip(
     wall_s: float,
     hop: int = 1,
     loss_reason: Optional[str] = None,
+    engine: str = "device-portfolio",
+    site: str = "device_solve_batch",
+    detail: Optional[dict] = None,
 ) -> None:
-    """Capture one flip-frontier query solved by the batched device
-    dispatch (`explore._device_flips` — it bypasses `check_terms`, so
-    the wrapper hook never sees it)."""
+    """Capture one flip-frontier query from the explorer's funnel —
+    the batched device dispatch AND the escalation ladder's
+    sprint-cap exits bypass `check_terms`, so the wrapper hook never
+    sees them. `detail` carries e.g. the actual sprint cap behind a
+    SPRINT_PREEMPTED loss."""
     if not capture_active():
         return
     querylog.capture_query(
         lowered,
-        engine="device-portfolio",
+        engine=engine,
         verdict=verdict,
         wall_s=wall_s,
         hop=hop,
         loss_reason=loss_reason,
-        site="device_check_batch",
+        site=site,
         origin=querylog.QUERY_ORIGIN_FLIP,
+        detail=detail,
     )

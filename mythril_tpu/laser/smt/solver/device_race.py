@@ -28,9 +28,30 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 log = logging.getLogger(__name__)
+
+_MARGIN = None
+
+
+def _margin_histogram():
+    """``mtpu_solver_race_margin_seconds``: how long AFTER the host's
+    answer the device race produced its witness (0 = the witness was
+    already sitting unpolled when the host answered). The near-miss
+    histogram is the tuning signal for the funnel's escalation grace
+    window (PORTFOLIO_DEFAULTS["race_grace_ms"])."""
+    global _MARGIN
+    if _MARGIN is None:
+        from mythril_tpu.observe.registry import registry
+
+        _MARGIN = registry().histogram(
+            "mtpu_solver_race_margin_seconds",
+            "device-race witness arrival relative to the host's answer "
+            "(seconds late; 0 = ready but unpolled)",
+        )
+    return _MARGIN
 
 
 class _BusyCounter:
@@ -90,6 +111,10 @@ class DeviceRace:
     ) -> None:
         self._done = threading.Event()
         self._assignment: Optional[Dict[str, int]] = None
+        self._t_done: Optional[float] = None
+        self._host_answered_at: Optional[float] = None
+        self._margin_recorded = False
+        self._margin_mu = threading.Lock()
         self._started = _INFLIGHT.acquire(blocking=False)
         if not self._started:
             self._done.set()
@@ -119,8 +144,45 @@ class DeviceRace:
             log.debug("device race attempt failed: %s", why)
             self._assignment = None
         finally:
+            self._t_done = time.monotonic()
             self._done.set()
             _INFLIGHT.release()
+            # the host may already have answered (note_host_answered):
+            # a witness landing NOW is the near-miss the margin
+            # histogram measures
+            self._maybe_record_margin()
+
+    def note_host_answered(self) -> None:
+        """The host claimed this query's verdict while the race was in
+        flight (or finished unpolled). Stamps the loss time so the
+        device's margin — how late its witness arrived — lands in
+        ``mtpu_solver_race_margin_seconds`` whenever the portfolio
+        does produce one, even minutes later on the daemon thread."""
+        if self._host_answered_at is None:
+            self._host_answered_at = time.monotonic()
+        self._maybe_record_margin()
+
+    def _maybe_record_margin(self) -> None:
+        """Record the near-miss margin exactly once, from whichever
+        side (worker finish / host answer) arrives second. Only races
+        that DID produce a witness record one — an empty finish is an
+        SLS_NONCONVERGED loss, not a timing near-miss."""
+        with self._margin_mu:
+            if (
+                self._margin_recorded
+                or self._host_answered_at is None
+                or not self._done.is_set()
+                or self._assignment is None
+            ):
+                return
+            self._margin_recorded = True
+            margin = max(
+                0.0, (self._t_done or 0.0) - self._host_answered_at
+            )
+        try:
+            _margin_histogram().observe(margin)
+        except Exception:  # telemetry must never sink a query
+            log.debug("race margin record failed", exc_info=True)
 
     def poll(self):
         if not self._done.is_set():
@@ -135,7 +197,12 @@ class DeviceRace:
         without a witness), "witness" (finished with one). The loss
         attribution reads this when the CDCL answers first — a
         portfolio that had already come back empty is an
-        SLS_NONCONVERGED loss, not a RACE_LOST_TIMING one."""
+        SLS_NONCONVERGED loss, while BOTH "pending" and "witness" are
+        RACE_LOST_TIMING: a race the device wins after the host
+        answered lost on timing, with its margin recorded in
+        ``mtpu_solver_race_margin_seconds`` via note_host_answered()
+        (pre-ISSUE-9 this near-miss was indistinguishable from a race
+        that never came back)."""
         if not self._done.is_set():
             return "pending"
         return "failed" if self._assignment is None else "witness"

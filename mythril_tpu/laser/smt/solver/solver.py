@@ -382,6 +382,28 @@ def device_solving_enabled() -> bool:
     return accelerator_present()
 
 
+def _race_grace_s() -> float:
+    """The funnel's escalation threshold: how long the host HOLDS a
+    verdict it just found while a device race is still in flight,
+    giving the accelerator the chance to own it. Tuned from the
+    ``mtpu_solver_race_margin_seconds`` near-miss histogram
+    (PORTFOLIO_DEFAULTS; MYTHRIL_RACE_GRACE_MS overrides)."""
+    import os
+
+    from mythril_tpu.laser.smt.solver.portfolio import PORTFOLIO_DEFAULTS
+
+    raw = os.environ.get("MYTHRIL_RACE_GRACE_MS")
+    try:
+        ms = (
+            float(raw)
+            if raw is not None
+            else float(PORTFOLIO_DEFAULTS["race_grace_ms"])
+        )
+    except ValueError:
+        ms = float(PORTFOLIO_DEFAULTS["race_grace_ms"])
+    return max(0.0, ms) / 1000.0
+
+
 #: thread-local channel the device-win and funnel-exit sites mark so
 #: the telemetry wrapper below attributes the verdict to the right
 #: engine AND the right loss reason (the origin/loss are decided deep
@@ -699,15 +721,54 @@ def _check_terms_impl(
                 status, bits = _native_solve(units, max(200, slice_ms))
                 if status != native_sat.UNKNOWN:
                     if race is not None:
-                        # the CDCL answered while a race was in flight
-                        # — "still searching" and "finished without a
-                        # witness, unpolled" are different losses
+                        # Device-first verdict ownership: the host
+                        # HOLDS a sat answer for the escalation grace
+                        # window while the race is still in flight —
+                        # a witness arriving inside it is the device's
+                        # verdict (validated like any other). Unsat
+                        # can never be ceded: the race cone is a
+                        # subset, its witness proves nothing there.
+                        grace_invalid = False
+                        if status == native_sat.SAT:
+                            g_dl = time.monotonic() + _race_grace_s()
+                            found = race.poll()
+                            while (
+                                found is device_race.PENDING
+                                and time.monotonic() < g_dl
+                            ):
+                                time.sleep(0.002)
+                                found = race.poll()
+                            if found not in (
+                                device_race.PENDING,
+                                device_race.FAILED,
+                            ):
+                                model = _reconstruct(
+                                    found, {}, recon, raw_constraints
+                                )
+                                if model is not None:
+                                    SolverStatistics().device_sat_count += 1
+                                    SolverStatistics().race_wins += 1
+                                    _QUERY_ORIGIN.origin = (
+                                        "device-portfolio"
+                                    )
+                                    return sat, model
+                                grace_invalid = True
+                        # the host keeps the verdict: stamp the loss
+                        # time so a witness landing later records its
+                        # near-miss margin (the grace-tuning signal),
+                        # and split "still searching" from "finished
+                        # empty, unpolled" from "witness failed the
+                        # gate" — different losses
+                        note = getattr(race, "note_host_answered", None)
+                        if note is not None:
+                            note()
                         SolverStatistics().race_losses += 1
-                        _set_loss(
-                            querylog.LOSS_SLS_NONCONVERGED
-                            if race.outcome() == "failed"
-                            else querylog.LOSS_RACE_LOST_TIMING
-                        )
+                        if grace_invalid:
+                            _set_loss(querylog.LOSS_WITNESS_INVALID)
+                        elif race.outcome() == "failed":
+                            _set_loss(querylog.LOSS_SLS_NONCONVERGED)
+                        else:
+                            _set_loss(querylog.LOSS_RACE_LOST_TIMING)
                     break
                 if race is None:
                     break  # full remaining budget spent in one call

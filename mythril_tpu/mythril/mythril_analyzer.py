@@ -75,6 +75,10 @@ _GLOBAL_DEFAULTS = dict(
     pipeline=True,
     specialize=True,
     mesh_devices=None,
+    # device-first solver funnel (ISSUE 9): batched device dispatch
+    # before the CDCL sprint on the explorer's flip frontier
+    # (--host-first-funnel restores the legacy order)
+    device_first=True,
 )
 
 
@@ -103,6 +107,11 @@ class MythrilAnalyzer:
             setattr(self, field, options.pop(field, default))
         for field, default in _GLOBAL_DEFAULTS.items():
             setattr(args, field, options.pop(field, default))
+        # the sprint cap keeps its env-seeded default
+        # (MYTHRIL_SPRINT_CAP_S) unless explicitly overridden
+        sprint_cap_s = options.pop("sprint_cap_s", None)
+        if sprint_cap_s is not None:
+            args.sprint_cap_s = float(sprint_cap_s)
         if options:
             raise TypeError(f"unknown analyzer options: {sorted(options)}")
 

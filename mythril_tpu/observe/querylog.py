@@ -404,10 +404,13 @@ def capture_query(
     loss_reason: Optional[str] = None,
     site: str = "",
     origin: Optional[str] = None,
+    detail: Optional[Dict] = None,
 ) -> Optional[str]:
     """Serialize one solved query into the capture corpus (no-op when
-    capture is off). Returns the artifact path, or None. Never raises:
-    capture must never sink a query."""
+    capture is off). `detail` is a small JSON-able dict attached to
+    the observation (e.g. the actual sprint cap behind a
+    SPRINT_PREEMPTED loss). Returns the artifact path, or None. Never
+    raises: capture must never sink a query."""
     out_dir = _CAPTURE_DIR
     if out_dir is None or not lowered:
         # a fully-propagated (empty) query is a trivial sat — there is
@@ -424,6 +427,8 @@ def capture_query(
             "loss_reason": loss_reason,
             "site": site,
         }
+        if detail:
+            observation["detail"] = dict(detail)
         path = os.path.join(out_dir, f"q-{sha}.json")
         with _CAPTURE_MU:
             if os.path.exists(path):

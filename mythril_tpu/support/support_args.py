@@ -7,7 +7,19 @@ model, svm exec loop, solver timeouts) without explicit plumbing.
 
 from __future__ import annotations
 
+import os
+
 from mythril_tpu.support.support_utils import Singleton
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 
 class Args(object, metaclass=Singleton):
@@ -56,6 +68,21 @@ class Args(object, metaclass=Singleton):
         # donated arena buffers. Off = the lock-step schedule, the
         # differential baseline for a suspected pipelining bug.
         self.pipeline = True
+        # Device-first solver funnel (ISSUE 9): the explorer's flip
+        # frontier goes to ONE batched device dispatch first
+        # (diversified SLS portfolio + enumeration + cube-and-conquer)
+        # and the per-query CDCL sprint becomes the escalation ladder
+        # that only sees device UNKNOWN survivors. Off = the legacy
+        # host-first order — the parity-differential baseline for a
+        # suspected funnel bug (CLI --host-first-funnel).
+        self.device_first = True
+        # The escalation ladder's wall cap, in seconds, for the
+        # host-CDCL sprint pass over one wave's survivors (previously
+        # a hardcoded 5.0 in explore._sprint_flips). Queries past the
+        # cap are recorded SPRINT_PREEMPTED with the actual cap in
+        # the loss artifact and retried next wave.
+        # (CLI --sprint-cap-s, env MYTHRIL_SPRINT_CAP_S.)
+        self.sprint_cap_s = _env_float("MYTHRIL_SPRINT_CAP_S", 5.0)
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to

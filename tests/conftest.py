@@ -39,6 +39,15 @@ from mythril_tpu.support.support_args import args as _support_args  # noqa: E402
 
 _support_args.specialize = False
 
+# The device-first solver funnel is likewise OFF by default under the
+# test harness: the product default is on, but the batched diversified
+# SLS dispatch pays a fresh XLA compile per stacked shape bucket, and
+# running it for EVERY wave's flip frontier across the whole suite
+# would not fit tier-1's window on 1 CPU core. The dedicated suite
+# (tests/laser/test_solverperf.py, `-m solverperf`) re-enables it and
+# pins the inverted-vs-legacy funnel differentials.
+_support_args.device_first = False
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -96,6 +105,14 @@ def pytest_configure(config):
         "solver funnel classification, myth solverlab replay "
         "agreement; CPU-only — runs in tier-1, selectable with "
         "-m solverlab)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "solverperf: device-first solver funnel suite (inverted-vs-"
+        "legacy parity differential, deterministic heterogeneous lane "
+        "seeding, cube-split/merge + exhausted-cube unsat, witness "
+        "validation, sprint-cap knob, race-margin histogram; "
+        "CPU-only — runs in tier-1, selectable with -m solverperf)",
     )
 
 

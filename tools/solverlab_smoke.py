@@ -40,9 +40,16 @@ GATED = "60003560f81c604214600d57005b600160005500"
 
 
 def main() -> int:
+    import os
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # persistent XLA cache: the inverted-funnel leg compiles the
+    # batched diversified-search kernel once per shape class
+    os.makedirs("/tmp/mtpu_xla_cache", exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/mtpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from mythril_tpu import observe
     from mythril_tpu.analysis import solverlab
@@ -62,7 +69,12 @@ def main() -> int:
     clear_cache()
     querylog.configure_capture(corpus_dir)
 
-    # -- live run 1: the explorer's flip-frontier queries ---------------
+    # -- live run 1: the explorer's flip-frontier queries through the
+    # INVERTED funnel (device-first is the product default: the
+    # batched device dispatch answers before any host CDCL sprint) ---
+    from mythril_tpu.support.support_args import args as _flags
+
+    assert _flags.device_first, "device-first funnel must be the default"
     explorer = DeviceCorpusExplorer(
         [GATED, KILLABLE],
         lanes_per_contract=8,
@@ -71,6 +83,7 @@ def main() -> int:
         transaction_count=1,
     )
     explorer.run()
+    device_owned = explorer.stats.device_sat + explorer.stats.device_unsat
 
     # -- live run 2: the host walk's module + memo-miss queries ---------
     results = analyze_corpus(
@@ -96,9 +109,20 @@ def main() -> int:
         "origins": origins,
         "loss_reasons_sat": losses_sat,
         "cdcl_sat_verdicts": cdcl_sats,
+        "device_owned_verdicts": device_owned,
         "live_issues": sum(len(r["issues"]) for r in results),
     }
     try:
+        # -- 0. the inverted-funnel leg (ISSUE 9): the device-first
+        # dispatch must OWN verdicts on the fault-suite corpus — the
+        # host sprint is the escalation ladder, not the answer path
+        assert device_owned > 0, (
+            f"inverted funnel produced zero device-owned verdicts "
+            f"(device_sat={explorer.stats.device_sat}, "
+            f"device_unsat={explorer.stats.device_unsat}, "
+            f"host_sat={explorer.stats.host_sat})"
+        )
+
         # -- 1. per-origin coverage ------------------------------------
         assert corpus, "the live runs captured no queries at all"
         for origin in ("flip-frontier", "module", "memo-miss"):
@@ -172,8 +196,9 @@ def main() -> int:
 
     print(
         f"smoke OK in {time.monotonic() - t_start:.1f}s: "
-        f"{len(corpus)} queries captured ({origins}), host replay "
-        f"agreed 100% twice, sat-loss sum {sum(losses_sat.values())} == "
+        f"{len(corpus)} queries captured ({origins}), inverted funnel "
+        f"owned {device_owned} verdicts, host replay agreed 100% "
+        f"twice, sat-loss sum {sum(losses_sat.values())} == "
         f"cdcl sats {cdcl_sats}, capture-off added zero series"
     )
     return 0
