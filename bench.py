@@ -287,16 +287,41 @@ def bench_static_prune() -> dict:
     contracts = synth_bench_corpus(CONV_CONTRACTS)
     t0 = time.perf_counter()
     pruned = total = dead_selectors = dead_directions = 0
+    mounted_semantic = mounted_opcode = registered = 0
+    static_answerable = 0
+    taint_wall_ms = 0.0
     for code, _creation, _name in contracts:
         summary = summary_for(code)
         pruned += summary.prune_units
         total += summary.total_units
         dead_selectors += len(summary.dead_selectors)
         dead_directions += len(summary.prune_directions())
+        # the semantic-vs-opcode screen A/B (the strictly-reduces
+        # acceptance reads both rates) + the triage-tier population
+        sem_app, sem_skip = summary.applicable_modules()
+        opc_app, _opc_skip = summary.applicable_modules(semantic=False)
+        mounted_semantic += len(sem_app)
+        mounted_opcode += len(opc_app)
+        registered += len(sem_app) + len(sem_skip)
+        static_answerable += bool(summary.static_answerable)
+        if summary.taint is not None:
+            taint_wall_ms += summary.taint.wall_ms
     return {
         "static_prune_rate": round(pruned / total, 4) if total else 0.0,
         "static_dead_selectors": dead_selectors,
         "static_dead_directions": dead_directions,
+        "screen_mount_rate_opcode": (
+            round(mounted_opcode / registered, 4) if registered else 0.0
+        ),
+        "screen_mount_rate_semantic": (
+            round(mounted_semantic / registered, 4) if registered else 0.0
+        ),
+        "static_answer_rate": (
+            round(static_answerable / len(contracts), 4)
+            if contracts
+            else 0.0
+        ),
+        "static_taint_wall_s": round(taint_wall_ms / 1e3, 3),
         "static_wall_s": round(time.perf_counter() - t0, 3),
     }
 
@@ -951,6 +976,9 @@ def main(final_attempt: bool = False) -> None:
     except Exception as e:
         print(f"bench: static-prune half failed: {e!r}", file=sys.stderr)
         record["static_prune_rate"] = None
+        record["static_answer_rate"] = None
+        record["screen_mount_rate_opcode"] = None
+        record["screen_mount_rate_semantic"] = None
 
     dev = {}
     try:

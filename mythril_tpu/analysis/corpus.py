@@ -665,6 +665,79 @@ def _owned_result(code, creation_code, name, outcome, address) -> Dict:
     }
 
 
+def _static_answer_result(name: str, summary, wall_s: float) -> Dict:
+    """The result slot for a statically-answered contract: the
+    semantic screen (analysis/static taint + sink predicates) proved
+    that NO detection module can fire, so the empty issue set IS the
+    analysis — no device wave, no host walk, no solver. Same shape as
+    an analyzed result so report builders need no special case; the
+    `static_answered` flag routes it in the routing feature log and
+    the report meta."""
+    return {
+        "name": name,
+        "issues": [],
+        "states": 0,
+        "device_prepass": None,
+        "phases": {},
+        "precovered_skips": 0,
+        "wall_s": round(wall_s, 6),
+        "error": None,
+        "static_answered": True,
+        "static_analysis": {
+            "code_hash": summary.code_hash,
+            "static_answerable": True,
+            "modules_applicable": 0,
+            "wall_ms": summary.wall_ms,
+        },
+    }
+
+
+def _static_triage(
+    contracts: List[Tuple[str, str, str]]
+) -> Dict[int, Dict]:
+    """{index: static-answer result} for every corpus row the
+    semantic screen settles outright. Runs BEFORE the device prepass
+    so answered contracts never occupy a lane; any per-contract
+    failure simply keeps that contract on the full path."""
+    from mythril_tpu.analysis.static import summary_for
+    from mythril_tpu.observe.registry import registry
+
+    out: Dict[int, Dict] = {}
+    counter = registry().counter(
+        "mtpu_static_answered_total",
+        "contracts settled by the static-answer triage tier",
+    )
+    for i, (code, creation_code, name) in enumerate(contracts):
+        if creation_code:
+            # a deploying row executes creation code too — the
+            # runtime-only proof does not cover it
+            continue
+        norm = code[2:] if code.startswith("0x") else code
+        if len(norm) < 4:
+            continue
+        t0 = time.perf_counter()
+        try:
+            summary = summary_for(norm)
+            if summary.static_answerable:
+                out[i] = _static_answer_result(
+                    name, summary, time.perf_counter() - t0
+                )
+                counter.inc()
+        except Exception:
+            log.debug(
+                "static triage failed for %s; full path", name,
+                exc_info=True,
+            )
+    if out:
+        log.info(
+            "Static triage answered %d/%d contract(s) without "
+            "dispatch",
+            len(out),
+            len(contracts),
+        )
+    return out
+
+
 def _skipped_result(name: str, reason: str) -> Dict:
     """The result slot for a contract the supervisor never analyzed
     (deadline expiry / SIGTERM): same shape as an analyzed result so
@@ -887,6 +960,19 @@ def analyze_corpus(
 
         use_device = accelerator_present()
 
+    # the static-answer triage tier: contracts the semantic screen
+    # settles are answered HERE (microseconds) and excluded from the
+    # device prepass — the prepass sees their rows as non-runnable so
+    # the index mapping every consumer shares stays intact
+    from mythril_tpu.analysis.static import static_answer_enabled
+
+    static_answers: Dict[int, Dict] = (
+        _static_triage(contracts) if static_answer_enabled() else {}
+    )
+    prepass_rows = list(contracts)
+    for i in static_answers:
+        prepass_rows[i] = ("", contracts[i][1], contracts[i][2])
+
     single_process = processes <= 1 or len(contracts) == 1
 
     def payload(code, creation_code, name, worker_device, outcome):
@@ -933,10 +1019,10 @@ def analyze_corpus(
         # outcome injected.
         if use_device and len(contracts) > 1 and (
             _effective_cpus() > 1
-            or len(_runnable_rows(contracts)) >= OVERLAP_MIN_CORPUS
+            or len(_runnable_rows(prepass_rows)) >= OVERLAP_MIN_CORPUS
         ):
             pre = OverlappedPrepass(
-                contracts,
+                prepass_rows,
                 address,
                 transaction_count,
                 device_budget_s,
@@ -970,7 +1056,7 @@ def analyze_corpus(
             # per wave), so by 2x the budget the prepass has finished
             # on its own and the drain is a no-op instead of a
             # main-thread stall on pure device work.
-            n_run = max(1, len(_runnable_rows(contracts)))
+            n_run = max(1, len(_runnable_rows(prepass_rows)))
             overlap_window_s = (
                 2.0 if n_run >= OVERLAP_MIN_CORPUS else 1.25
             ) * resolve_prepass_budget_s(
@@ -1008,6 +1094,13 @@ def analyze_corpus(
                                 deadline
                             )
                         code, creation_code, name = contracts[i]
+                        if i in static_answers:
+                            # statically answered: the empty issue set
+                            # is the analysis — it even survives a
+                            # deadline halt (it costs microseconds)
+                            slots[i] = static_answers[i]
+                            progressed = True
+                            continue
                         if halt_reason is not None:
                             slots[i] = _skipped_result(name, halt_reason)
                             progressed = True
@@ -1069,7 +1162,7 @@ def analyze_corpus(
         else:
             if use_device:
                 prepass = corpus_device_prepass(
-                    contracts,
+                    prepass_rows,
                     budget_s=device_budget_s,
                     address=address,
                     transaction_count=transaction_count,
@@ -1086,6 +1179,9 @@ def analyze_corpus(
                 resilience.inject("corpus.contract")
                 if halt_reason is None:
                     halt_reason = resilience.interrupted_reason(deadline)
+                if i in static_answers:
+                    results.append(static_answers[i])
+                    continue
                 if halt_reason is not None:
                     # device-owned evidence survives the halt: synthesis
                     # is cheap (no walk, no solver), so an owned
@@ -1130,14 +1226,15 @@ def analyze_corpus(
         # the whole pool on a timeout.
         payloads = [
             payload(code, creation_code, name, False, None)
-            for code, creation_code, name in contracts
+            for i, (code, creation_code, name) in enumerate(contracts)
+            if i not in static_answers
         ]
         ctx = mp.get_context("spawn")  # fresh singletons per worker
         with ctx.Pool(processes=processes) as pool:
             walked = pool.imap(_analyze_one, payloads)
             if use_device:
                 prepass = corpus_device_prepass(
-                    contracts,
+                    prepass_rows,
                     budget_s=device_budget_s,
                     address=address,
                     transaction_count=transaction_count,
@@ -1147,7 +1244,10 @@ def analyze_corpus(
                 )
             results = []
             halt_reason = None
-            for code, _creation, name in contracts:
+            for i, (code, _creation, name) in enumerate(contracts):
+                if i in static_answers:
+                    results.append(static_answers[i])
+                    continue
                 if halt_reason is None:
                     halt_reason = resilience.interrupted_reason(deadline)
                 if halt_reason is None:

@@ -375,6 +375,42 @@ def deadweight_contract(seed: int = 0) -> str:
     return bytes(code).hex()
 
 
+def clean_contract(seed: int = 0) -> str:
+    """A provably-clean runtime — the static-answer triage tier's
+    positive shape. Two-selector dispatcher whose bodies do only what
+    the semantic screen can discharge: constant-slot SSTORE (the
+    arbitrary-write sentinel is unsatisfiable), constant non-wrapping
+    ADD (no overflow annotation possible), constant MSTORE with no
+    LOG1/marker (the UserAssertions evidence test), constant jump
+    targets throughout. Every detection module screens off, so the
+    triage tier answers it with an empty issue set — which IS its
+    true issue set, keeping the prune differential trivially equal."""
+    sel1 = (0xC0FFEE00 + seed) & 0xFFFFFFFF
+    sel2 = (0x0DDBA110 + seed * 3) & 0xFFFFFFFF
+    store_fn, return_fn = 0x1A, 0x24
+    code = bytearray(
+        [0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63]
+    )  # selector = CALLDATALOAD(0) >> 224; DUP1; PUSH4
+    code += sel1.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, store_fn, 0x57])  # EQ; PUSH1 a; JUMPI
+    code += bytes([0x63]) + sel2.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, return_fn, 0x57])  # EQ; PUSH1 b; JUMPI
+    code += bytes([0x00])  # STOP (no match)
+    assert len(code) == store_fn
+    # a: sstore(0, 1 + (2 + k))  — constant, non-wrapping
+    code += bytes(
+        [0x5B, 0x60, 0x01, 0x60, 0x02 + (seed % 16), 0x01,
+         0x60, 0x00, 0x55, 0x00]
+    )
+    assert len(code) == return_fn
+    # b: return mem[0:32] = 42 — a constant MSTORE, no marker word
+    code += bytes(
+        [0x5B, 0x60, 0x2A, 0x60, 0x00, 0x52, 0x60, 0x20, 0x60, 0x00,
+         0xF3]
+    )
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,
@@ -382,6 +418,7 @@ def synth_bench_corpus(
     degraders: int = 4,
     wides: int = 6,
     deadweights: int = 2,
+    cleans: int = 2,
     inputs: Optional[Path] = None,
 ) -> List[Tuple[str, str, str]]:
     """The round-5 benchmark corpus: fixture constant-mutants plus
@@ -392,7 +429,10 @@ def synth_bench_corpus(
     closure), and the static prune layer in one measured run."""
     rng = random.Random(seed)
     corpus = synth_corpus(
-        max(0, n_contracts - loops - degraders - wides - deadweights),
+        max(
+            0,
+            n_contracts - loops - degraders - wides - deadweights - cleans,
+        ),
         seed=seed,
         inputs=inputs,
     )
@@ -406,6 +446,8 @@ def synth_bench_corpus(
         corpus.append((wide_contract(6 + (k % 3), seed=k), "", f"wide#{k}"))
     for k in range(deadweights):
         corpus.append((deadweight_contract(seed=k), "", f"deadweight#{k}"))
+    for k in range(cleans):
+        corpus.append((clean_contract(seed=k), "", f"clean#{k}"))
     rng.shuffle(corpus)
     return corpus[:n_contracts]
 

@@ -1,6 +1,6 @@
 """Host-side static bytecode analysis (the pre-dispatch layer).
 
-Four cooperating analyses over one shared IR (the disassembler's
+Cooperating analyses over one shared IR (the disassembler's
 instruction list), run once per code hash BEFORE any arena lane is
 seeded or any detection module is mounted:
 
@@ -13,14 +13,30 @@ seeded or any detection module is mounted:
    targets are stack constants, flags definite stack-underflow and
    const-invalid-jumpdest blocks, and constant-folds JUMPI conditions
    into statically-dead branch directions.
-3. **Detector pre-screen** (`screen.py`) — per-module opcode/feature
-   signatures over the reachable instruction set, so
+3. **Attacker-taint fixpoint** (`taint.py`) — a second worklist pass
+   propagating an attacker-influence lattice (calldata/caller/
+   callvalue/returndata sources; conservative joins through memory,
+   storage and the stack window) to the detector sinks: jump targets
+   and branch guards, call targets/values, SSTORE slots, SELFDESTRUCT
+   beneficiaries, LOG1 topics, ORIGIN-in-comparison.
+4. **Value sets** (`vsa.py`) — the constant half of the sink facts
+   distilled into resolved CALL/DELEGATECALL targets (ROADMAP item
+   4's cross-contract facts), constant storage slots, and the
+   UserAssertions marker/topic evidence.
+5. **Detector pre-screen** (`screen.py`) — per-module opcode/feature
+   signatures over the reachable instruction set PLUS semantic sink
+   predicates over the taint/value-set facts, so
    `analysis/security.py` loads only modules that can possibly fire
-   on this contract.
-4. **Prune feed** (`summary.py` StaticSummary) — consumed by
+   on this contract. When every module screens off the contract is
+   `static_answerable`: the static-answer triage tier settles it
+   with an empty issue set at service admission / corpus dispatch.
+6. **Prune feed** (`summary.py` StaticSummary) — consumed by
    `laser/batch/seeds.py` (dispatcher seeds for statically-inert
    functions are dropped) and `laser/batch/explore.py` (dead branch
-   directions never enter the flip frontier).
+   directions never enter the flip frontier); also exports
+   per-selector function fingerprints (item 3's incremental
+   re-analysis key) and the taint lint checks behind
+   `myth lint --fail-on`.
 
 The whole pass is pure host work (no jax, no device): `myth lint`
 runs it standalone, `myth analyze`/`myth serve` run it as an always-on
@@ -39,15 +55,27 @@ from __future__ import annotations
 from mythril_tpu.analysis.static.cfg import BasicBlock, recover_cfg
 from mythril_tpu.analysis.static.screen import (
     MODULE_SIGNATURES,
+    SINK_PREDICATES,
     screen_modules,
 )
 from mythril_tpu.analysis.static.summary import (
+    LINT_CHECKS,
+    LINT_SCHEMA_VERSION,
     StaticSummary,
     analyze_bytecode,
     clear_static_cache,
     static_cache_stats,
     summary_for,
 )
+from mythril_tpu.analysis.static.taint import (
+    TAINT_ATTACKER,
+    TAINT_CALLER,
+    TAINT_ORIGIN,
+    TAINT_UNKNOWN,
+    TaintResult,
+    run_taint,
+)
+from mythril_tpu.analysis.static.vsa import ValueSets, value_sets
 
 
 def static_prune_enabled() -> bool:
@@ -57,15 +85,40 @@ def static_prune_enabled() -> bool:
     return bool(getattr(args, "static_prune", True))
 
 
+def static_answer_enabled() -> bool:
+    """The static-answer triage tier's switch: rides the static-prune
+    flag (off under --no-static-prune — full-mount parity) plus its
+    own `args.static_answer` knob (the test conftest turns the tier
+    off so wave/walk-mechanics suites keep their subject; the product
+    default is on)."""
+    from mythril_tpu.support.support_args import args
+
+    return static_prune_enabled() and bool(
+        getattr(args, "static_answer", True)
+    )
+
+
 __all__ = [
     "BasicBlock",
+    "LINT_CHECKS",
+    "LINT_SCHEMA_VERSION",
     "MODULE_SIGNATURES",
+    "SINK_PREDICATES",
     "StaticSummary",
+    "TAINT_ATTACKER",
+    "TAINT_CALLER",
+    "TAINT_ORIGIN",
+    "TAINT_UNKNOWN",
+    "TaintResult",
+    "ValueSets",
     "analyze_bytecode",
     "clear_static_cache",
     "recover_cfg",
+    "run_taint",
     "screen_modules",
+    "static_answer_enabled",
     "static_cache_stats",
     "static_prune_enabled",
     "summary_for",
+    "value_sets",
 ]

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
@@ -33,8 +34,43 @@ from typing import Dict, List, Optional, Set, Tuple
 from mythril_tpu.analysis.static.cfg import CFG, recover_cfg
 from mythril_tpu.analysis.static.dataflow import DataflowResult, run_dataflow
 from mythril_tpu.analysis.static.screen import screen_modules
+from mythril_tpu.analysis.static.taint import (
+    TAINT_ATTACKER,
+    TaintResult,
+    run_taint,
+)
+from mythril_tpu.analysis.static.vsa import ValueSets, value_sets
 
 log = logging.getLogger(__name__)
+
+#: `lint_dict()` payload version, pinned by the lint CLI tests. Bump
+#: on any key-set change. v2: taint/value-set facts, per-selector
+#: fingerprints, resolved call targets, semantic screen split, the
+#: taint lint checks, and the schema_version field itself.
+LINT_SCHEMA_VERSION = 2
+
+#: every check `findings()` can emit — the CLI validates `--fail-on`
+#: against this set so a typo'd check name errors instead of silently
+#: never firing
+LINT_CHECKS = frozenset(
+    [
+        "unreachable-code",
+        "invalid-jump-target",
+        "stack-underflow",
+        "dead-branch",
+        "inert-function",
+        "tainted-jump-target",
+        "tainted-delegatecall-target",
+        "tx-origin-as-auth",
+        "unprotected-selfdestruct",
+    ]
+)
+
+#: per-selector fingerprint subgraph bound: a dispatcher entry whose
+#: resolved subgraph exceeds this is left unfingerprinted (the
+#: incremental-reanalysis consumer treats "no fingerprint" as "always
+#: re-analyze")
+FINGERPRINT_MAX_BLOCKS = 512
 
 #: opcodes an inert (prunable) subgraph may contain: pure stack/data
 #: shuffling plus control flow. Anything a detection module hooks, the
@@ -108,6 +144,26 @@ class StaticSummary:
         self.inert_directions: Set[Tuple[int, bool]] = set()
         self._classify_dead_selectors()
 
+        # the attacker-taint fixpoint + its value-set distillation
+        # (taint.py / vsa.py): the semantic half of the detector
+        # screen, the static-answer triage predicate, and the facts
+        # behind the taint lint checks. Failure is a conservative
+        # fallback (`taint=None` -> opcode screen decides), never an
+        # error surface.
+        self.taint: Optional[TaintResult] = None
+        try:
+            self.taint = run_taint(self.cfg, self.flow)
+        except Exception:
+            log.debug("taint pass failed; opcode-screen fallback",
+                      exc_info=True)
+        self.vsa: ValueSets = value_sets(self.taint, code)
+        #: per-selector content hashes of each function's reachable
+        #: subgraph — the dedup key incremental re-analysis (ROADMAP
+        #: item 3) diffs against
+        self.function_fingerprints: Dict[str, str] = (
+            self._function_fingerprints()
+        )
+
         #: mutable prune observability (seeds.py increments)
         self.seeds_dropped = 0
         self.wall_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -119,9 +175,32 @@ class StaticSummary:
         (dispatcher entry of a statically-dead function)."""
         return self.dead_directions | self.inert_directions
 
-    def applicable_modules(self) -> Tuple[List[str], List[str]]:
-        """(applicable, skipped) detection-module class names."""
-        return screen_modules(self.features)
+    def applicable_modules(
+        self, semantic: bool = True
+    ) -> Tuple[List[str], List[str]]:
+        """(applicable, skipped) detection-module class names.
+
+        `semantic=True` (default) layers the per-module sink
+        predicates over the opcode signatures; `semantic=False` is
+        the opcode-only view (the bench reports both rates)."""
+        if not semantic:
+            return screen_modules(self.features)
+        return screen_modules(
+            self.features, taint=self.taint, vsa=self.vsa
+        )
+
+    @property
+    def static_answerable(self) -> bool:
+        """True when the semantic screen proves that NO detection
+        module can fire on this code: the static-answer triage tier
+        settles such a contract with an empty issue set at service
+        admission / corpus dispatch, without ever touching the device.
+        Requires a COMPLETE taint fixpoint — any bail keeps the
+        contract on the full path."""
+        if self.incomplete or self.taint is None or self.taint.incomplete:
+            return False
+        applicable, _skipped = self.applicable_modules()
+        return not applicable
 
     @property
     def prune_units(self) -> int:
@@ -283,10 +362,76 @@ class StaticSummary:
                 return False
         return True
 
+    def _function_fingerprints(self) -> Dict[str, str]:
+        """selector hex -> content hash of the function's reachable
+        subgraph (blocks discovered over resolved edges from the
+        dispatcher entry, dead directions honored; bytes hashed are
+        each block's opcode names + immediates in block-start order).
+        An entry whose subgraph hits an unresolved jump or the block
+        cap gets NO fingerprint — "content unknown, always
+        re-analyze"."""
+        if self.incomplete:
+            return {}
+        out: Dict[str, str] = {}
+        for entry in self.dispatcher:
+            blocks = self._subgraph_blocks(entry.entry_pc)
+            if blocks is None:
+                continue
+            digest = hashlib.sha256()
+            for start in sorted(blocks):
+                for ins in self.cfg.blocks[start].instructions:
+                    digest.update(ins.opcode.encode())
+                    if ins.argument:
+                        digest.update(ins.argument.encode())
+            out["0x" + entry.selector.hex()] = digest.hexdigest()[:16]
+        return out
+
+    def _subgraph_blocks(self, entry_pc: int) -> Optional[Set[int]]:
+        """Block starts reachable from `entry_pc` over RESOLVED edges,
+        or None when the subgraph cannot be bounded (unresolved jump /
+        cap). Same traversal discipline as `_subgraph_inert`, without
+        the opcode restrictions."""
+        if entry_pc not in self.cfg.blocks:
+            return None
+        seen: Set[int] = set()
+        work = [entry_pc]
+        while work:
+            start = work.pop()
+            if start in seen:
+                continue
+            seen.add(start)
+            if len(seen) > FINGERPRINT_MAX_BLOCKS:
+                return None
+            block = self.cfg.blocks[start]
+            terminator = block.terminator
+            if terminator in ("JUMP", "JUMPI"):
+                pc = block.end
+                if pc in self.flow.unresolved_jumps:
+                    return None
+                target = self.flow.resolved_jumps.get(pc)
+                dead = {
+                    d for p, d in self.flow.dead_directions if p == pc
+                }
+                if target is not None and not (
+                    terminator == "JUMPI" and True in dead
+                ):
+                    if target in self.cfg.blocks:
+                        work.append(target)
+                if terminator == "JUMPI" and False not in dead:
+                    nxt = self.cfg.block_after(start)
+                    if nxt is not None:
+                        work.append(nxt.start)
+            elif terminator == "FALL":
+                nxt = self.cfg.block_after(start)
+                if nxt is not None:
+                    work.append(nxt.start)
+        return seen
+
     # -- rendering ------------------------------------------------------
     def stats(self) -> Dict:
         applicable, skipped = self.applicable_modules()
-        return {
+        opcode_applicable, _ = self.applicable_modules(semantic=False)
+        out = {
             "code_hash": self.code_hash,
             "code_len": self.code_len,
             "instructions": self.n_instructions,
@@ -303,12 +448,43 @@ class StaticSummary:
             "dead_selectors": len(self.dead_selectors),
             "underflow_blocks": len(self.flow.underflow_blocks),
             "modules_applicable": len(applicable),
+            # the opcode-only count beside the semantic one: the
+            # bench's strictly-reduces acceptance reads both
+            "modules_applicable_opcode": len(opcode_applicable),
             "modules_skipped": sorted(skipped),
+            "modules_skipped_semantic": sorted(
+                set(opcode_applicable) - set(applicable)
+            ),
             "prune_rate": self.prune_rate,
             "seeds_dropped": self.seeds_dropped,
+            "static_answerable": self.static_answerable,
             "incomplete": self.incomplete,
             "wall_ms": self.wall_ms,
+            # per-selector subgraph fingerprints + resolved call
+            # targets / constant slots: the enabling facts for ROADMAP
+            # items 3 (incremental re-analysis) and 4 (cross-contract)
+            "function_fingerprints": dict(self.function_fingerprints),
+            "fingerprint_count": len(self.function_fingerprints),
         }
+        out.update(self.vsa.stats())
+        if self.taint is not None:
+            out["taint"] = {
+                "incomplete": self.taint.incomplete,
+                "wall_ms": self.taint.wall_ms,
+                "density": self.taint.taint_density,
+                "sinks": self.taint.sink_counts(),
+                "tainted_sinks": self.taint.tainted_sink_counts(),
+                "origin_in_condition": bool(
+                    self.taint.origin_condition_pcs
+                ),
+                "caller_in_condition": bool(
+                    self.taint.caller_condition_pcs
+                ),
+                "arith_unsafe_sites": len(self.taint.arith_unsafe_pcs),
+            }
+        else:
+            out["taint"] = {"incomplete": True}
+        return out
 
     def findings(self) -> List[Dict]:
         """Pure static findings for `myth lint` (informational — the
@@ -373,10 +549,77 @@ class StaticSummary:
                         "addresses": [entry.entry_pc],
                     }
                 )
+        out.extend(self._taint_findings())
+        return out
+
+    def _taint_findings(self) -> List[Dict]:
+        """The taint lint checks: informational flow facts from the
+        attacker-taint fixpoint (ATTACKER-bit sinks only — the same
+        facts drive the semantic screen, rendered here for humans/CI
+        via `myth lint --fail-on`)."""
+        taint = self.taint
+        if taint is None or taint.incomplete:
+            return []
+        out: List[Dict] = []
+        jump_pcs = taint.tainted_jump_pcs()
+        if jump_pcs:
+            out.append(
+                {
+                    "check": "tainted-jump-target",
+                    "detail": (
+                        f"{len(jump_pcs)} jump(s) whose destination is "
+                        "influenced by attacker-controlled input "
+                        "(calldata/caller/callvalue)"
+                    ),
+                    "addresses": jump_pcs[:16],
+                }
+            )
+        dc_pcs = taint.tainted_call_sites(kind="DELEGATECALL")
+        if dc_pcs:
+            out.append(
+                {
+                    "check": "tainted-delegatecall-target",
+                    "detail": (
+                        f"{len(dc_pcs)} DELEGATECALL(s) whose target "
+                        "address is influenced by attacker-controlled "
+                        "input — callee code executes in this "
+                        "contract's storage context"
+                    ),
+                    "addresses": dc_pcs[:16],
+                }
+            )
+        if taint.origin_condition_pcs:
+            out.append(
+                {
+                    "check": "tx-origin-as-auth",
+                    "detail": (
+                        "tx.origin reaches "
+                        f"{len(taint.origin_condition_pcs)} branch "
+                        "guard(s) — origin-based authorization is "
+                        "phishable; use msg.sender"
+                    ),
+                    "addresses": sorted(taint.origin_condition_pcs)[:16],
+                }
+            )
+        if taint.selfdestruct_sites and not (
+            taint.caller_condition_pcs or taint.origin_condition_pcs
+        ):
+            out.append(
+                {
+                    "check": "unprotected-selfdestruct",
+                    "detail": (
+                        "SELFDESTRUCT is reachable and no branch in "
+                        "the contract compares msg.sender or "
+                        "tx.origin — nothing gates who may kill it"
+                    ),
+                    "addresses": sorted(taint.selfdestruct_sites)[:16],
+                }
+            )
         return out
 
     def lint_dict(self, name: str = "") -> Dict:
         out = {"contract": name} if name else {}
+        out["schema_version"] = LINT_SCHEMA_VERSION
         out.update(self.stats())
         out["findings"] = self.findings()
         return out
@@ -387,6 +630,9 @@ class StaticSummary:
 # ---------------------------------------------------------------------------
 _CACHE: "OrderedDict[str, StaticSummary]" = OrderedDict()
 _CACHE_CAP = 256
+#: the cache is shared across threads (service HTTP admission, wave
+#: thread, host-pool workers); one lock keeps the OrderedDict sane
+_CACHE_LOCK = threading.Lock()
 _HITS = 0
 _MISSES = 0
 
@@ -408,29 +654,33 @@ def analyze_bytecode(code) -> StaticSummary:
 
 
 def summary_for(code) -> StaticSummary:
-    """Cached-by-code-hash static analysis."""
+    """Cached-by-code-hash static analysis (thread-safe)."""
     global _HITS, _MISSES
     raw = _as_bytes(code)
     key = hashlib.sha256(raw).hexdigest()
-    hit = _CACHE.get(key)
-    if hit is not None:
-        _HITS += 1
-        _CACHE.move_to_end(key)
-        return hit
-    _MISSES += 1
+    with _CACHE_LOCK:
+        hit = _CACHE.get(key)
+        if hit is not None:
+            _HITS += 1
+            _CACHE.move_to_end(key)
+            return hit
     summary = StaticSummary(raw)
-    _CACHE[key] = summary
-    while len(_CACHE) > _CACHE_CAP:
-        _CACHE.popitem(last=False)
+    with _CACHE_LOCK:
+        _MISSES += 1
+        _CACHE[key] = summary
+        while len(_CACHE) > _CACHE_CAP:
+            _CACHE.popitem(last=False)
     return summary
 
 
 def clear_static_cache() -> None:
     global _HITS, _MISSES
-    _CACHE.clear()
-    _HITS = 0
-    _MISSES = 0
+    with _CACHE_LOCK:
+        _CACHE.clear()
+        _HITS = 0
+        _MISSES = 0
 
 
 def static_cache_stats() -> Dict:
-    return {"size": len(_CACHE), "hits": _HITS, "misses": _MISSES}
+    with _CACHE_LOCK:
+        return {"size": len(_CACHE), "hits": _HITS, "misses": _MISSES}

@@ -206,16 +206,24 @@ class SymExecWrapper:
             )
 
             self.static_summary = summary_for(runtime)
-            features = set(self.static_summary.features)
             if deploys:
                 # creation code executes under the same hooks; its
                 # linear sweep over-approximates (embedded runtime
                 # decodes as instructions), which only ADDS features —
-                # conservative in the right direction
+                # conservative in the right direction. The semantic
+                # sink predicates only hold for the runtime body, so a
+                # deploying analysis screens on opcodes alone.
+                features = set(self.static_summary.features)
                 features |= summary_for(
                     getattr(contract, "creation_code", "") or ""
                 ).features
-            applicable, skipped = screen_modules(features)
+                applicable, skipped = screen_modules(features)
+            else:
+                # runtime-only: the semantic screen (opcode signature
+                # AND the taint/value-set sink predicate) decides
+                applicable, skipped = (
+                    self.static_summary.applicable_modules()
+                )
             self.static_screen = set(applicable)
             stats = self.static_summary.stats()
             stats["modules_skipped"] = sorted(skipped)

@@ -744,6 +744,20 @@ def build_parser() -> ArgumentParser:
         formatter_class=RawTextHelpFormatter,
     )
     lint.add_argument("solidity_files", **SOLIDITY_FILES_ARG)
+    lint.add_argument(
+        "--fail-on",
+        action="append",
+        metavar="CHECK",
+        default=None,
+        help=(
+            "exit nonzero when the named lint check fires on any "
+            "contract (repeatable) — makes `myth lint` usable as a "
+            "CI gate. Checks: unreachable-code, invalid-jump-target, "
+            "stack-underflow, dead-branch, inert-function, "
+            "tainted-jump-target, tainted-delegatecall-target, "
+            "tx-origin-as-auth, unprotected-selfdestruct"
+        ),
+    )
 
     serve = subparsers.add_parser(
         "serve",
@@ -827,6 +841,24 @@ def build_parser() -> ArgumentParser:
             "disable contract-specialized step kernels (phase "
             "pruning + superblock fusion); every wave runs the "
             "generic interpreter"
+        ),
+    )
+    serve.add_argument(
+        "--no-static-prune",
+        action="store_true",
+        help=(
+            "disable the static layer for the whole service (detector "
+            "pre-screen, seed mask, static-answer triage) — the "
+            "full-mount parity baseline"
+        ),
+    )
+    serve.add_argument(
+        "--no-static-answer",
+        action="store_true",
+        help=(
+            "keep the static prepass but disable ONLY the "
+            "static-answer triage tier: provably-clean submissions go "
+            "through the full wave/walk path anyway"
         ),
     )
     serve.add_argument(
@@ -1157,8 +1189,23 @@ def _run_pro(disassembler, address, args):
 
 def _run_lint(disassembler, address, args):
     """`myth lint`: the static layer alone — per contract, CFG/prune
-    stats plus the pure static findings. Never touches the device."""
-    from mythril_tpu.analysis.static import summary_for
+    stats plus the pure static findings (schema_version pins the
+    payload). `--fail-on CHECK` turns a named check into a CI gate:
+    the command exits 1 when it fires anywhere. Never touches the
+    device."""
+    from mythril_tpu.analysis.static import LINT_CHECKS, summary_for
+
+    fail_on = set(args.fail_on or [])
+    unknown_checks = fail_on - LINT_CHECKS
+    if unknown_checks:
+        exit_with_error(
+            args.outform,
+            "unknown --fail-on check(s): {} (known: {})".format(
+                ", ".join(sorted(unknown_checks)),
+                ", ".join(sorted(LINT_CHECKS)),
+            ),
+            exit_code=2,
+        )
 
     rows = []
     for contract in disassembler.contracts:
@@ -1173,8 +1220,19 @@ def _run_lint(disassembler, address, args):
             )
         rows.append(summary.lint_dict(name=contract.name))
 
+    fired = sorted(
+        {
+            finding["check"]
+            for row in rows
+            for finding in row["findings"]
+            if finding["check"] in fail_on
+        }
+    )
+
     if args.outform in ("json", "jsonv2"):
         print(json.dumps(rows, sort_keys=True))
+        if fired:
+            sys.exit(1)
         return
     for row in rows:
         print(f"Static analysis: {row['contract']} ({row['code_hash']})")
@@ -1200,6 +1258,21 @@ def _run_lint(disassembler, address, args):
                 " ({})".format(", ".join(skipped)) if skipped else "",
             )
         )
+        taint = row.get("taint") or {}
+        if taint and not taint.get("incomplete"):
+            print(
+                "  taint: density {density}, {n_calls} resolved call "
+                "target(s), {n_fp} function fingerprint(s){answer}".format(
+                    density=taint.get("density"),
+                    n_calls=row.get("resolved_call_target_count", 0),
+                    n_fp=row.get("fingerprint_count", 0),
+                    answer=(
+                        "; statically answerable"
+                        if row.get("static_answerable")
+                        else ""
+                    ),
+                )
+            )
         if row["findings"]:
             print("  findings:")
             for finding in row["findings"]:
@@ -1207,6 +1280,11 @@ def _run_lint(disassembler, address, args):
                     "    - [{check}] {detail}".format(**finding)
                 )
         print("  wall: {wall_ms} ms".format(**row))
+    if fired:
+        print(
+            "lint: --fail-on check(s) fired: {}".format(", ".join(fired))
+        )
+        sys.exit(1)
 
 
 def _run_disassemble(disassembler, address, args):
@@ -1438,6 +1516,12 @@ def _cmd_serve(args: Namespace) -> None:
         observe.configure(out_dir=args.observe_out)
     if args.capture_queries:
         observe.configure_capture(args.capture_queries)
+    if args.no_static_prune:
+        # the process-wide switch: host walks in the service pool read
+        # the same flag bag, so the parity baseline really mounts all
+        from mythril_tpu.support.support_args import args as support_args
+
+        support_args.static_prune = False
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,
@@ -1452,6 +1536,9 @@ def _cmd_serve(args: Namespace) -> None:
         pipeline=not args.no_pipeline,
         specialize=not args.no_specialize,
         devices=args.devices,
+        static_answer=not (
+            args.no_static_answer or args.no_static_prune
+        ),
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()

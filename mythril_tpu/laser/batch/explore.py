@@ -181,6 +181,10 @@ class ExploreStats:
         self.static_pruned_flips = 0
         self.static_seeds_dropped = 0
         self.static_summaries = 0
+        #: contracts whose semantic screen proved NO detection module
+        #: can fire (summary.static_answerable) — the population the
+        #: static-answer triage tier settles without any device work
+        self.static_answered = 0
         # -- kernel specialization observability (specialize.py) ------
         #: 1 when the waves ran a contract-specialized kernel
         self.specialized = 0
@@ -269,6 +273,7 @@ MERGE_POLICY: Dict[str, str] = {
     "static_pruned_flips": "sum",
     "static_seeds_dropped": "sum",
     "static_summaries": "sum",
+    "static_answered": "sum",
     "specialized": "max",
     "spec_pruned_phases": "max",
     "spec_fused_steps": "sum",
@@ -950,6 +955,11 @@ class DeviceCorpusExplorer:
         self.stats.pipelined = int(self.pipeline)
         self.stats.static_summaries = sum(
             1 for t in self.tracks if t.static is not None
+        )
+        self.stats.static_answered = sum(
+            1
+            for t in self.tracks
+            if t.static is not None and t.static.static_answerable
         )
         self._phase_allowance: Optional[float] = None
 

@@ -53,6 +53,12 @@ from mythril_tpu.observe.routing import (  # noqa: F401
     features_for as routing_features_for,
 )
 from mythril_tpu.observe.routing import outcome_for as routing_outcome_for  # noqa: F401,E501
+from mythril_tpu.observe.routing import (  # noqa: F401
+    parse_record as parse_routing_record,
+)
+from mythril_tpu.observe.routing import (  # noqa: F401
+    read_records as read_routing_records,
+)
 from mythril_tpu.observe.routing import routing_log  # noqa: F401
 from mythril_tpu.observe.solverstats import (  # noqa: F401
     ORIGIN_DEVICE,

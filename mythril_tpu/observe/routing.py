@@ -25,12 +25,29 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional
 
-from mythril_tpu.observe.registry import SCHEMA_VERSION
+#: routing-record schema version — the routing log's OWN version, no
+#: longer tied to the registry's. v2 adds the taint/value-set feature
+#: block (taint_density, per-sink-kind tainted counts, resolved call
+#: targets, fingerprint count, static answerability) and the
+#: "static-answer" route. v1 records parse through `read_records` /
+#: `parse_record` unchanged (absent v2 features read as None).
+SCHEMA_VERSION = 2
 
 #: every record carries exactly these top-level keys (the JSONL golden
 #: test pins them)
 RECORD_KEYS = (
     "schema_version", "contract", "code_hash", "features", "outcome",
+)
+
+#: feature keys added by schema v2 (the back-compat reader fills them
+#: with None for v1 records so a trainer sees one column set)
+V2_FEATURE_KEYS = (
+    "taint_density",
+    "tainted_sinks",
+    "sink_counts",
+    "resolved_call_targets",
+    "fingerprints",
+    "static_answerable",
 )
 
 
@@ -140,6 +157,24 @@ def features_for(code_hex: str, summary=None) -> Dict:
                 dead_selectors=row.get("dead_selectors"),
                 dead_directions=row.get("dead_directions"),
                 modules_screened=row.get("modules_applicable"),
+                # schema v2: the taint/value-set block — how
+                # attacker-steerable the contract is, how much of its
+                # call/storage surface is constant, and whether the
+                # triage tier can settle it outright (the single
+                # strongest routing feature: cost zero)
+                taint_density=(row.get("taint") or {}).get("density"),
+                tainted_sinks=(
+                    sum(
+                        ((row.get("taint") or {}).get("tainted_sinks")
+                         or {}).values()
+                    )
+                ),
+                sink_counts=(row.get("taint") or {}).get("sinks"),
+                resolved_call_targets=row.get(
+                    "resolved_call_target_count"
+                ),
+                fingerprints=row.get("fingerprint_count"),
+                static_answerable=row.get("static_answerable"),
             )
         except Exception:
             pass
@@ -163,6 +198,8 @@ def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
     device ran)."""
     if result.get("skipped"):
         route = "skipped"
+    elif result.get("static_answered"):
+        route = "static-answer"
     elif result.get("owned"):
         route = "device-owned"
     else:
@@ -181,4 +218,55 @@ def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
         out["device_sat"] = stats.get("device_sat", 0)
         out["host_sat"] = stats.get("host_sat", 0)
         out["device_steps"] = stats.get("device_steps", 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the tail reader (trainer-side): version-tolerant JSONL parsing
+# ---------------------------------------------------------------------------
+def parse_record(line_or_obj) -> Dict:
+    """One routing record from a JSONL line (or an already-decoded
+    dict), normalized to the CURRENT schema: v1 records (no taint
+    block) come back with every `V2_FEATURE_KEYS` column present and
+    None — a trainer reads one column set across a mixed log. Raises
+    ValueError on junk or a record from a FUTURE schema."""
+    rec = (
+        json.loads(line_or_obj)
+        if isinstance(line_or_obj, (str, bytes))
+        else dict(line_or_obj)
+    )
+    if not isinstance(rec, dict):
+        raise ValueError("routing record is not an object")
+    missing = [k for k in RECORD_KEYS if k not in rec]
+    if missing:
+        raise ValueError(f"routing record missing keys: {missing}")
+    version = int(rec["schema_version"])
+    if version > SCHEMA_VERSION:
+        raise ValueError(
+            f"routing record schema v{version} is newer than this "
+            f"reader (v{SCHEMA_VERSION})"
+        )
+    features = dict(rec.get("features") or {})
+    for key in V2_FEATURE_KEYS:
+        features.setdefault(key, None)
+    rec["features"] = features
+    return rec
+
+
+def read_records(path: str, n: Optional[int] = None) -> List[Dict]:
+    """The last `n` (default: all) records of a routing JSONL file,
+    each normalized by `parse_record`. Unparseable lines are skipped,
+    not fatal — a half-written tail line must not sink the trainer."""
+    out: List[Dict] = []
+    with open(path) as fp:
+        lines = fp.read().splitlines()
+    if n is not None:
+        lines = lines[-n:]
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            out.append(parse_record(line))
+        except ValueError:
+            continue
     return out

@@ -101,6 +101,7 @@ class ServiceConfig:
         devices: int = 1,
         specialize: bool = True,
         specialize_warmup: str = "background",
+        static_answer: bool = True,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -139,6 +140,14 @@ class ServiceConfig:
         #: the code LRU. `myth serve --no-specialize` restores the
         #: generic interpreter.
         self.specialize = specialize
+        #: the static-answer triage tier at admission: a submission
+        #: whose semantic screen (analysis/static taint + sink
+        #: predicates) proves NO detection module can fire settles
+        #: DONE with an empty issue set before it ever reaches the
+        #: queue — no wave, no walk, no lane. Also gated by the
+        #: process-wide static flags (`--no-static-prune` restores
+        #: full-mount parity).
+        self.static_answer = static_answer
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -514,6 +523,11 @@ class AnalysisEngine:
             "mtpu_service_static_seeds_dropped_total",
             "dispatcher seeds masked by the static prune",
         ).labels(**lab)
+        self._c_static_answered = reg.counter(
+            "mtpu_service_static_answered_total",
+            "submissions settled by the static-answer triage tier "
+            "(no device dispatch, no host walk)",
+        ).labels(**lab)
         self._c_wave_kind = reg.counter(
             "mtpu_service_wave_kind_total",
             "waves by kernel kind (specialized vs generic)",
@@ -559,7 +573,8 @@ class AnalysisEngine:
         # wait for the first wave to learn the series names)
         for child in (
             self._c_waves, self._c_device_steps, self._c_host_completed,
-            self._c_rebuckets, self._c_static_seeds, self._c_spec_waves,
+            self._c_rebuckets, self._c_static_seeds,
+            self._c_static_answered, self._c_spec_waves,
             self._c_generic_waves, self._c_fused, self._c_fallbacks,
             self._c_overlapped, self._c_multi_job, self._c_mesh_steals,
             self._c_mesh_rebalance,
@@ -654,9 +669,53 @@ class AnalysisEngine:
         return self
 
     def submit(self, job: Job) -> Job:
+        if self._try_static_answer(job):
+            return job
         self.queue.submit(job)  # raises QueueRefusal on backpressure
         self._wake.set()
         return job
+
+    def _try_static_answer(self, job: Job) -> bool:
+        """The static-answer triage tier at admission (runs on the
+        HTTP thread — pure host work, microseconds warm): when the
+        semantic screen proves NO detection module can fire on this
+        code, the job settles DONE with an empty issue set before it
+        ever reaches the queue. False keeps the job on the full
+        wave/walk path; QueueRefusal propagates when draining."""
+        from mythril_tpu.analysis.static import static_answer_enabled
+
+        if not (self.cfg.static_answer and static_answer_enabled()):
+            return False
+        try:
+            from mythril_tpu.analysis.static import summary_for
+
+            summary = summary_for(job.code)
+            if not summary.static_answerable:
+                return False
+        except Exception:
+            log.debug("static triage failed; full path", exc_info=True)
+            return False
+        self.queue.register(job)  # raises QueueRefusal when draining
+        self._c_static_answered.inc()
+        now = time.monotonic()
+        job.report = {
+            "job_id": job.id,
+            "code_hash": CodeCache.code_hash(job.code),
+            "static_answered": True,
+            "issues": [],
+            "static": {
+                "modules_applicable": 0,
+                "static_answerable": True,
+                "wall_ms": summary.wall_ms,
+            },
+            "timings": {
+                "queued_s": 0.0,
+                "device_s": 0.0,
+                "total_s": round(now - job.created_t, 6),
+            },
+        }
+        self.queue.settle(job, JobState.DONE)
+        return True
 
     @property
     def draining(self) -> bool:
@@ -1841,6 +1900,10 @@ class AnalysisEngine:
                 "seeds_dropped": int(
                     sv("mtpu_service_static_seeds_dropped_total")
                 ),
+                "static_answered": int(
+                    sv("mtpu_service_static_answered_total")
+                ),
+                "answer_enabled": bool(self.cfg.static_answer),
             },
             "kernel": self._kernel_stats(),
             "solver": self._solver_stats(snap),

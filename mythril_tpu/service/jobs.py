@@ -148,6 +148,29 @@ class JobQueue:
             self._jobs[job.id] = job
             self._settled.notify_all()
 
+    def register(self, job: Job) -> None:
+        """Admit `job` into the registry WITHOUT a pending-queue slot:
+        the static-answer triage path — the job is about to be settled
+        DONE by the caller and will never occupy the arena, so a full
+        queue is no reason to refuse it. Draining still refuses (the
+        service is going away)."""
+        from mythril_tpu.observe.registry import registry
+
+        admissions = registry().counter(
+            "mtpu_service_admissions_total",
+            "service job admissions by outcome "
+            "(accepted / rejected-full / rejected-draining)",
+        )
+        with self._lock:
+            if self.draining:
+                self.rejected_draining += 1
+                admissions.labels(outcome="rejected-draining").inc()
+                raise QueueRefusal("draining", "service is draining")
+            self.accepted += 1
+            admissions.labels(outcome="accepted").inc()
+            self._jobs[job.id] = job
+            self._settled.notify_all()
+
     def claim(self, limit: int) -> List[Job]:
         """Pop up to `limit` queued jobs for arena admission (FIFO) and
         mark them RUNNING. The engine calls this between waves."""

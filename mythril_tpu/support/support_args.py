@@ -54,6 +54,14 @@ class Args(object, metaclass=Singleton):
         # flip-frontier prune. On by default; the flag exists so a
         # suspected wrong prune is one switch away from a differential.
         self.static_prune = True
+        # Static-answer triage tier (analysis/static taint + screen):
+        # a contract whose semantic screen proves NO detection module
+        # can fire is answered with an empty issue set at service
+        # admission / corpus dispatch — no device, no walk. Rides the
+        # static_prune flag (off under --no-static-prune) plus this
+        # knob; the test conftest turns it off so wave/walk-mechanics
+        # suites keep their subject.
+        self.static_answer = True
         # Kernel specialization (CLI --no-specialize,
         # laser/batch/specialize.py): per-contract step kernels
         # compiled from the static layer's reachable-opcode signature

@@ -124,7 +124,13 @@ def test_golden_fixture_suicide():
     applicable, skipped = summary.applicable_modules()
     assert "AccidentallyKillable" in applicable
     assert "EtherThief" in skipped  # no CALL anywhere in the code
-    assert "IntegerArithmetics" in applicable
+    # the opcode layer keeps IntegerArithmetics (ADD is present); the
+    # semantic layer proves every arith site constant and non-wrapping
+    # and skips it — the fixture's golden issue set (SWC-106 only)
+    # confirms the module never fired here
+    opcode_applicable, _ = summary.applicable_modules(semantic=False)
+    assert "IntegerArithmetics" in opcode_applicable
+    assert "IntegerArithmetics" in skipped
 
 
 def test_golden_fixture_overflow():

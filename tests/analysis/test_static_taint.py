@@ -1,0 +1,403 @@
+"""Taint & value-set static layer: propagation goldens, the semantic
+detector screen's soundness sweep, the static-answer triage tier, the
+taint lint checks, and the routing-schema back-compat.
+
+Tier-1 via the `taint` marker (tox -e taint runs it alone).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import (
+    clean_contract,
+    deadweight_contract,
+)
+from mythril_tpu.analysis.static import (
+    LINT_CHECKS,
+    LINT_SCHEMA_VERSION,
+    TAINT_ATTACKER,
+    analyze_bytecode,
+    screen_modules,
+    summary_for,
+)
+from mythril_tpu.analysis.static.vsa import ATTACKER_ADDRESS
+from mythril_tpu.support.support_args import args as support_args
+
+from tests.analysis.test_module_positive_fixtures import FIXTURES
+
+pytestmark = pytest.mark.taint
+
+
+def _fixture(name: str) -> str:
+    from mythril_tpu.analysis.goldens import GOLDEN_FIXTURES
+
+    return (GOLDEN_FIXTURES / f"{name}.sol.o").read_text().strip()
+
+
+def _checks(summary):
+    return {f["check"] for f in summary.findings()}
+
+
+# -- taint propagation goldens ----------------------------------------------
+def test_calldata_taints_jump_target():
+    # CALLDATALOAD(0); JUMP; JUMPDEST; STOP
+    summary = analyze_bytecode("600035565b00")
+    taint = summary.taint
+    assert not taint.incomplete
+    assert taint.jump_targets == {3: (None, TAINT_ATTACKER)}
+    assert taint.tainted_jump_pcs() == [3]
+    assert "tainted-jump-target" in _checks(summary)
+
+
+def test_caller_taints_delegatecall_target():
+    # PUSH1 0 x4; CALLDATALOAD(0); PUSH2 gas; DELEGATECALL; POP; STOP
+    summary = analyze_bytecode(
+        "6000600060006000" + "600035" + "61ffff" + "f45000"
+    )
+    taint = summary.taint
+    (site,) = taint.call_sites.values()
+    assert site["kind"] == "DELEGATECALL"
+    assert site["target"][1] & TAINT_ATTACKER
+    assert site["value"] is None  # DELEGATECALL carries no value
+    assert "tainted-delegatecall-target" in _checks(summary)
+
+
+def test_mload_after_tainted_mstore_joins():
+    # MSTORE(0, CALLDATALOAD(0)); JUMP(MLOAD(0)) — the taint must
+    # survive the memory round-trip even though the constant does not
+    summary = analyze_bytecode("600035600052600051565b00")
+    taint = summary.taint
+    jump_pc = max(taint.jump_targets)
+    assert taint.jump_targets[jump_pc][0] is None
+    assert taint.jump_targets[jump_pc][1] & TAINT_ATTACKER
+
+
+def test_sload_of_tainted_written_slot_joins():
+    # SSTORE(0, CALLDATALOAD(0)); SSTORE(1, SLOAD(0)) — the second
+    # store's VALUE carries the attacker bit through storage
+    summary = analyze_bytecode("600035600055600054600155" + "00")
+    taint = summary.taint
+    values = sorted(taint.sstore_values.items())
+    assert values[0][1][1] & TAINT_ATTACKER  # the direct store
+    assert values[1][1][1] & TAINT_ATTACKER  # through the slot
+    # slots themselves are constants: the arbitrary-write screen holds
+    assert all(v[0] is not None for v in taint.sstore_slots.values())
+
+
+def test_origin_reaches_condition():
+    # ORIGIN; CALLER; EQ; PUSH1 7; JUMPI; STOP; JUMPDEST; STOP
+    summary = analyze_bytecode("3233146007" + "57005b00")
+    taint = summary.taint
+    assert taint.origin_condition_pcs == [5]
+    assert taint.caller_condition_pcs == [5]
+    assert taint.origin_compare_pcs == [2]
+    assert "tx-origin-as-auth" in _checks(summary)
+    # guarded: a CALLER/ORIGIN comparison exists, so a selfdestruct
+    # behind it would NOT be flagged unprotected
+    assert "unprotected-selfdestruct" not in _checks(summary)
+
+
+def test_unprotected_selfdestruct_flagged():
+    summary = analyze_bytecode("33ff")  # CALLER; SUICIDE — no guard
+    assert "unprotected-selfdestruct" in _checks(summary)
+    assert 1 in summary.taint.selfdestruct_sites
+
+
+def test_constant_facts_resolved():
+    """The value-set half: constant call targets and storage slots."""
+    # CALL(gas=0xffff, to=0x1234, value=0, ...); SSTORE(5, 1); STOP
+    summary = analyze_bytecode(
+        "6000600060006000" + "6000" + "611234" + "61ffff" + "f150"
+        + "6001600555" + "00"
+    )
+    assert list(summary.vsa.resolved_call_targets.values()) == [0x1234]
+    assert summary.vsa.constant_storage_writes == {5}
+    stats = summary.stats()
+    assert stats["resolved_call_target_count"] == 1
+    assert stats["constant_storage_slots"] == ["0x5"]
+
+
+def test_function_fingerprints_stable_and_content_sensitive():
+    code_a = clean_contract(0)
+    summary_a = analyze_bytecode(code_a)
+    assert len(summary_a.function_fingerprints) == 2
+    # deterministic across rebuilds
+    assert (
+        analyze_bytecode(code_a).function_fingerprints
+        == summary_a.function_fingerprints
+    )
+    # a different body (seed bumps the stored constant) changes the
+    # touched function's fingerprint
+    summary_b = analyze_bytecode(clean_contract(1))
+    fp_a = set(summary_a.function_fingerprints.values())
+    fp_b = set(summary_b.function_fingerprints.values())
+    assert fp_a != fp_b
+
+
+# -- the semantic screen ----------------------------------------------------
+@pytest.mark.parametrize("module", sorted(FIXTURES))
+def test_screen_soundness_sweep(module):
+    """THE soundness pin: the semantic screen must never skip the
+    module that fires on its own positive fixture."""
+    code, _swc = FIXTURES[module]
+    summary = analyze_bytecode(code)
+    applicable, _skipped = summary.applicable_modules()
+    assert module in applicable, (
+        f"semantic screen skipped {module} on its own positive fixture"
+    )
+
+
+def test_semantic_screen_only_narrows():
+    """Layering: semantic ⊆ opcode for every fixture — the predicate
+    layer can only remove mounts, never add them."""
+    for module, (code, _swc) in FIXTURES.items():
+        summary = analyze_bytecode(code)
+        semantic, _ = summary.applicable_modules()
+        opcode, _ = summary.applicable_modules(semantic=False)
+        assert set(semantic) <= set(opcode), module
+
+
+def test_user_assertions_screen_differential_on_exceptions():
+    """The satellite fix for the dead MSTORE screen: on the real
+    `exceptions` fixture (MSTORE-heavy, no AssertionFailed LOG1, no
+    marker word) the opcode screen mounts UserAssertions and the
+    semantic screen does not — and the golden issue set (four
+    Exception State findings, all from the Exceptions module) proves
+    the skip changes nothing."""
+    summary = summary_for(_fixture("exceptions"))
+    opcode_applicable, _ = summary.applicable_modules(semantic=False)
+    semantic_applicable, _ = summary.applicable_modules()
+    assert "UserAssertions" in opcode_applicable
+    assert "UserAssertions" not in semantic_applicable
+    # the module the fixture's findings DO come from stays mounted
+    assert "Exceptions" in semantic_applicable
+
+
+def test_user_assertions_end_to_end_differential_on_exceptions():
+    """End-to-end half of the differential: analyzing the exceptions
+    fixture with ONLY UserAssertions requested yields the same (empty)
+    issue set whether the semantic screen skips the module (prune on)
+    or the full mount runs it (prune off)."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    contracts = [(_fixture("exceptions"), "", "Exceptions")]
+
+    def leg(static_prune: bool):
+        previous = support_args.static_prune
+        support_args.static_prune = static_prune
+        try:
+            return analyze_corpus(
+                contracts,
+                transaction_count=1,
+                execution_timeout=8,
+                processes=1,
+                use_device=False,
+                modules=["UserAssertions"],
+            )
+        finally:
+            support_args.static_prune = previous
+
+    screened = leg(True)
+    unscreened = leg(False)
+    assert all(r["error"] is None for r in screened + unscreened)
+    assert _fingerprints(screened) == _fingerprints(unscreened) == set()
+
+
+def test_user_assertions_mounts_on_log_topic_and_marker():
+    # its positive fixture: PUSH32 topic; LOG1
+    log_code, _ = FIXTURES["UserAssertions"]
+    applicable, _ = analyze_bytecode(log_code).applicable_modules()
+    assert "UserAssertions" in applicable
+    # the MythX marker word anywhere in the code keeps the module too
+    marker_code = "7f" + "cafe" * 15 + "0000" + "600052" + "00"
+    applicable, _ = analyze_bytecode(marker_code).applicable_modules()
+    assert "UserAssertions" in applicable
+
+
+def test_screen_attacker_address_constant_still_mounts():
+    """A CONSTANT delegatecall target equal to the attacker actor
+    still satisfies `target == ACTORS.attacker` — must mount."""
+    push_attacker = "73" + f"{ATTACKER_ADDRESS:040x}"
+    code = "6000600060006000" + push_attacker + "61ffff" + "f45000"
+    applicable, _ = analyze_bytecode(code).applicable_modules()
+    assert "ArbitraryDelegateCall" in applicable
+
+
+def test_screen_falls_back_on_incomplete_taint():
+    summary = analyze_bytecode(clean_contract(0))
+    assert summary.static_answerable
+    summary.taint.incomplete = True  # simulate a bail
+    applicable, _ = summary.applicable_modules()
+    opcode_applicable, _ = summary.applicable_modules(semantic=False)
+    assert applicable == opcode_applicable  # opcode screen decides
+    assert not summary.static_answerable
+
+
+def test_screen_modules_without_taint_is_opcode_only():
+    applicable, skipped = screen_modules({"SSTORE", "PUSH1", "STOP"})
+    assert "ArbitraryStorage" in applicable
+
+
+# -- the static-answer triage tier ------------------------------------------
+def test_clean_contract_is_answerable_and_deadweight_is_not():
+    assert analyze_bytecode(clean_contract(0)).static_answerable
+    # deadweight keeps a real SWC-110 (guarded INVALID): never triaged
+    assert not analyze_bytecode(deadweight_contract(0)).static_answerable
+
+
+def test_lint_dict_schema_version_and_check_registry():
+    row = analyze_bytecode(clean_contract(0)).lint_dict(name="clean")
+    assert row["schema_version"] == LINT_SCHEMA_VERSION
+    assert row["static_answerable"] is True
+    assert row["fingerprint_count"] == 2
+    # every emitted check is registered (the --fail-on validator)
+    for code in ("33ff", "600035565b00", deadweight_contract(0)):
+        for finding in analyze_bytecode(code).findings():
+            assert finding["check"] in LINT_CHECKS
+
+
+def _fingerprints(results):
+    return {
+        (r["name"], i["swc-id"], i["address"])
+        for r in results
+        for i in r["issues"]
+    }
+
+
+def test_corpus_triage_differential():
+    """analyze_corpus with the triage tier on: the clean contract is
+    answered statically (empty issues, no walk), everything else
+    walks — and the ISSUE SET matches the tier-off run exactly."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+
+    contracts = [
+        (clean_contract(0), "", "Clean"),
+        ("33ff", "", "Killable"),
+    ]
+
+    def leg(static_answer: bool):
+        previous = support_args.static_answer
+        support_args.static_answer = static_answer
+        try:
+            return analyze_corpus(
+                contracts,
+                transaction_count=1,
+                execution_timeout=8,
+                processes=1,
+                use_device=False,
+            )
+        finally:
+            support_args.static_answer = previous
+
+    triaged = leg(True)
+    walked = leg(False)
+    assert all(r["error"] is None for r in triaged + walked)
+    assert _fingerprints(triaged) == _fingerprints(walked)
+    clean_result = next(r for r in triaged if r["name"] == "Clean")
+    assert clean_result["static_answered"] is True
+    assert clean_result["issues"] == []
+    assert clean_result["states"] == 0  # no walk happened
+    assert clean_result["complete"] is True
+    # the killable contract went through the full path and found SWC-106
+    assert any(swc == "106" for _, swc, _ in _fingerprints(triaged))
+    # the tier-off leg actually walked the clean contract
+    walked_clean = next(r for r in walked if r["name"] == "Clean")
+    assert not walked_clean.get("static_answered")
+
+
+def test_triage_respects_no_static_prune():
+    """--no-static-prune restores full-mount parity: with the prune
+    layer off the triage tier must never fire even when
+    args.static_answer is on."""
+    from mythril_tpu.analysis.static import static_answer_enabled
+
+    prev_answer = support_args.static_answer
+    prev_prune = support_args.static_prune
+    support_args.static_answer = True
+    try:
+        support_args.static_prune = False
+        assert not static_answer_enabled()
+        support_args.static_prune = True
+        assert static_answer_enabled()
+    finally:
+        support_args.static_answer = prev_answer
+        support_args.static_prune = prev_prune
+
+
+def test_explorer_counts_answerable_tracks():
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    explorer = DeviceCorpusExplorer(
+        [clean_contract(0), deadweight_contract(0)], waves=1
+    )
+    assert explorer.stats.static_summaries == 2
+    assert explorer.stats.static_answered == 1
+
+
+# -- routing schema v2 ------------------------------------------------------
+def test_routing_features_carry_taint_block():
+    from mythril_tpu.observe.routing import features_for
+
+    feats = features_for(clean_contract(0))
+    assert feats["static_answerable"] is True
+    # the dispatcher's selector compares are calldata-tainted JUMPI
+    # guards, so density is nonzero even on the clean shape — what
+    # makes it CLEAN is that no sink predicate holds, not zero taint
+    assert 0.0 < feats["taint_density"] < 1.0
+    assert feats["fingerprints"] == 2
+    assert feats["resolved_call_targets"] == 0
+
+
+def test_routing_v1_records_parse_in_tail_reader(tmp_path):
+    """The back-compat pin: a v1 JSONL line (no taint features) parses
+    through the tail reader and comes back normalized to the v2
+    column set."""
+    from mythril_tpu.observe.routing import (
+        SCHEMA_VERSION,
+        V2_FEATURE_KEYS,
+        parse_record,
+        read_records,
+    )
+
+    assert SCHEMA_VERSION == 2
+    v1 = {
+        "schema_version": 1,
+        "contract": "Legacy",
+        "code_hash": "ab" * 32,
+        "features": {
+            "code_bytes": 11,
+            "storage_op_density": 0.1,
+            "call_op_density": 0.0,
+        },
+        "outcome": {"route": "host-walk", "issues": 0},
+    }
+    v2 = dict(v1, schema_version=2, contract="Fresh")
+    v2["features"] = dict(
+        v1["features"], taint_density=0.5, static_answerable=False,
+        tainted_sinks=3, resolved_call_targets=1, fingerprints=2,
+    )
+    path = tmp_path / "routing_features.jsonl"
+    path.write_text(
+        json.dumps(v1) + "\n" + json.dumps(v2) + "\n" + "{broken\n"
+    )
+    records = read_records(str(path))
+    assert [r["contract"] for r in records] == ["Legacy", "Fresh"]
+    legacy = records[0]
+    for key in V2_FEATURE_KEYS:
+        assert key in legacy["features"]
+    assert legacy["features"]["taint_density"] is None
+    assert records[1]["features"]["taint_density"] == 0.5
+    # a FUTURE schema refuses instead of mis-parsing
+    with pytest.raises(ValueError):
+        parse_record(json.dumps(dict(v1, schema_version=99)))
+
+
+def test_routing_route_classification_static_answer():
+    from mythril_tpu.observe.routing import outcome_for
+
+    assert (
+        outcome_for({"static_answered": True})["route"] == "static-answer"
+    )

@@ -48,6 +48,17 @@ _support_args.specialize = False
 # pins the inverted-vs-legacy funnel differentials.
 _support_args.device_first = False
 
+# The static-answer TRIAGE TIER is OFF by default under the test
+# harness (the product default is on): many suites pin wave/walk
+# mechanics on tiny synthetic contracts that are provably clean, and
+# triage would answer those jobs before the machinery under test ever
+# runs. The semantic detector SCREEN itself stays ON (it rides
+# static_prune) — its soundness is pinned by the module positive
+# fixtures across the whole suite. The dedicated taint suite
+# (tests/analysis/test_static_taint.py, `-m taint`) and the service
+# triage test re-enable the tier and pin its behavior.
+_support_args.static_answer = False
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -113,6 +124,14 @@ def pytest_configure(config):
         "seeding, cube-split/merge + exhausted-cube unsat, witness "
         "validation, sprint-cap knob, race-margin histogram; "
         "CPU-only — runs in tier-1, selectable with -m solverperf)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "taint: taint & value-set static layer suite (attacker-taint "
+        "fixpoint goldens, semantic screen soundness sweep over every "
+        "module positive fixture, static-answer triage differential, "
+        "taint lint checks, routing schema back-compat; host-only, "
+        "fast — runs in tier-1, selectable with -m taint)",
     )
 
 

@@ -354,9 +354,15 @@ def test_routing_record_jsonl_schema(tmp_path):
         parsed = json.loads(line.strip().splitlines()[-1])
     finally:
         observe.configure(out_dir=None)
+    from mythril_tpu.observe.routing import (
+        SCHEMA_VERSION as ROUTING_SCHEMA_VERSION,
+    )
+
     assert tuple(sorted(parsed)) == tuple(sorted(RECORD_KEYS))
     assert parsed == json.loads(json.dumps(rec, sort_keys=True))
-    assert parsed["schema_version"] == SCHEMA_VERSION
+    # the routing log versions its records independently of the
+    # registry schema (v2 added the taint/value-set feature block)
+    assert parsed["schema_version"] == ROUTING_SCHEMA_VERSION
     feats = parsed["features"]
     # the cost-model features ROADMAP item 5 trains on
     for key in ("code_bytes", "storage_op_density", "call_op_density"):

@@ -1,0 +1,128 @@
+"""The static-answer triage tier at service admission: a submission
+the semantic screen proves clean settles DONE before it ever reaches
+the queue — no wave dispatch, no arena lane, no host walk.
+
+Engine-less servers throughout (start_engine=False): the triage path
+runs on the HTTP thread inside `AnalysisEngine.submit`, so a job that
+completes here PROVABLY never saw a device dispatch — the wave thread
+does not exist. CPU-only, sub-second.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import clean_contract
+from mythril_tpu.service.client import ServiceClient, ServiceError
+from mythril_tpu.service.engine import ServiceConfig
+from mythril_tpu.service.server import AnalysisServer
+from mythril_tpu.support.support_args import args as support_args
+
+pytestmark = [pytest.mark.service, pytest.mark.taint]
+
+#: CALLER; SELFDESTRUCT — never statically answerable
+KILLABLE = "33ff"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    steps_per_wave=64,
+    queue_capacity=4,
+    host_walk=False,
+)
+
+
+@pytest.fixture()
+def triage_enabled():
+    previous = support_args.static_answer
+    support_args.static_answer = True  # the conftest turns it off
+    yield
+    support_args.static_answer = previous
+
+
+@pytest.fixture()
+def server(triage_enabled):
+    srv = AnalysisServer(
+        ServiceConfig(**CFG), start_engine=False
+    ).start()
+    yield srv
+    srv.close()
+
+
+def test_clean_submission_settles_without_device_dispatch(server):
+    client = ServiceClient(server.url)
+    job_id = client.submit(clean_contract(0))
+    job = client.job(job_id)
+    # already terminal: no wave thread even exists on this server
+    assert job["state"] == "done"
+    report = job["report"]
+    assert report["static_answered"] is True
+    assert report["issues"] == []
+    assert "device" not in report  # no wave block — none ever ran
+    assert report["static"]["modules_applicable"] == 0
+    stats = client.stats()
+    assert stats["static"]["static_answered"] == 1
+    assert stats["static"]["answer_enabled"] is True
+    assert stats["waves"]["count"] == 0
+    assert stats["queue"]["jobs"].get("done") == 1
+
+
+def test_unanswerable_submission_queues_normally(server):
+    client = ServiceClient(server.url)
+    job_id = client.submit(KILLABLE)
+    job = client.job(job_id)
+    assert job["state"] == "queued"  # engine-less: stays queued
+    assert client.stats()["static"]["static_answered"] == 0
+
+
+def test_triage_skips_full_queue_backpressure(server):
+    """Answered jobs never occupy a queue slot, so they keep settling
+    even when the pending queue is FULL — triage is admission
+    capacity, not arena capacity."""
+    client = ServiceClient(server.url)
+    for _ in range(CFG["queue_capacity"]):
+        client.submit(KILLABLE)
+    with pytest.raises(ServiceError):
+        client.submit(KILLABLE)  # 429: the queue is full
+    job_id = client.submit(clean_contract(1))
+    assert client.job(job_id)["state"] == "done"
+
+
+def test_config_knob_disables_triage(triage_enabled):
+    srv = AnalysisServer(
+        ServiceConfig(**dict(CFG, static_answer=False)),
+        start_engine=False,
+    ).start()
+    try:
+        client = ServiceClient(srv.url)
+        job_id = client.submit(clean_contract(0))
+        assert client.job(job_id)["state"] == "queued"
+        stats = client.stats()
+        assert stats["static"]["static_answered"] == 0
+        assert stats["static"]["answer_enabled"] is False
+    finally:
+        srv.close()
+
+
+def test_args_flag_disables_triage(server):
+    """--no-static-prune parity: with the process-wide static layer
+    off, the triage tier must not fire regardless of the service
+    config."""
+    client = ServiceClient(server.url)
+    previous = support_args.static_prune
+    support_args.static_prune = False
+    try:
+        job_id = client.submit(clean_contract(2))
+        assert client.job(job_id)["state"] == "queued"
+    finally:
+        support_args.static_prune = previous
+
+
+def test_draining_refuses_triaged_submissions(triage_enabled):
+    srv = AnalysisServer(
+        ServiceConfig(**CFG), start_engine=False
+    ).start()
+    client = ServiceClient(srv.url)
+    srv.engine.drain(timeout_s=5.0)
+    with pytest.raises(ServiceError):
+        client.submit(clean_contract(0))  # 503: draining

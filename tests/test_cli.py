@@ -148,6 +148,38 @@ def test_lint_json_output():
     assert "dead-branch" in checks
 
 
+def test_lint_schema_version_and_taint_findings():
+    out = run_myth(
+        "lint", "-c", "600035565b00", "--bin-runtime", "-o", "json"
+    )
+    rows = json.loads(out.stdout)
+    assert rows[0]["schema_version"] >= 2
+    checks = {f["check"] for f in rows[0]["findings"]}
+    assert "tainted-jump-target" in checks
+    assert out.returncode == 0
+
+
+def test_lint_fail_on_gates_the_exit_code():
+    # the check fires: CI-gate exit 1
+    out = run_myth(
+        "lint", "-c", "33ff", "--bin-runtime",
+        "--fail-on", "unprotected-selfdestruct",
+    )
+    assert out.returncode == 1
+    assert "unprotected-selfdestruct" in out.stdout
+    # the check does not fire on this code: exit 0
+    out = run_myth(
+        "lint", "-c", "33ff", "--bin-runtime",
+        "--fail-on", "tainted-delegatecall-target",
+    )
+    assert out.returncode == 0
+    # an unknown check name is an input error, not a silent pass
+    out = run_myth(
+        "lint", "-c", "33ff", "--bin-runtime", "--fail-on", "no-such-check"
+    )
+    assert out.returncode == 2
+
+
 def test_analyze_no_static_prune_flag_parity():
     """--no-static-prune must change nothing but the wasted work: the
     jsonv2 issue list is identical with the prepass on and off."""

@@ -3,11 +3,22 @@
 
 Runs the static analysis (analysis/static) over every bundled fixture
 plus the synthetic benchmark shapes and FAILS (exit 1) on any
-static-summary exception — the CI tripwire for a CFG/dataflow
+static-summary exception — the CI tripwire for a CFG/dataflow/taint
 regression. No device, no jax ops; the whole sweep is milliseconds.
 
+Also enforces the taint-layer budget and the triage tier's liveness:
+
+- the taint pass must stay SUB-SECOND per contract across the sweep
+  (a pathological fixpoint would silently tax every service
+  admission);
+- `static_answer_rate` must be > 0 on the bench corpus (the clean
+  shapes exist precisely so the triage tier always has a population —
+  a zero rate means the semantic screen regressed into mounting
+  everything).
+
 Prints one JSON line: per-corpus aggregates (prune rate, dead code,
-screen narrowing) plus any failures.
+screen narrowing both ways, answer rate, taint wall) plus any
+failures.
 
 Usage: python tools/lint_smoke.py
 """
@@ -22,6 +33,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+#: the per-contract taint budget (seconds) — admission-path work
+TAINT_BUDGET_S = 1.0
+
 
 def main() -> int:
     from mythril_tpu.analysis.corpusgen import (
@@ -30,41 +44,78 @@ def main() -> int:
     )
     from mythril_tpu.analysis.static import analyze_bytecode
 
-    rows = [(name, code) for name, code in load_fixtures()]
-    rows += [
+    bench_rows = [
         (name, code) for code, _creation, name in synth_bench_corpus(32)
     ]
+    rows = [(name, code) for name, code in load_fixtures()] + bench_rows
     if not rows:
         print(json.dumps({"error": "no corpus found"}))
         return 1
 
     failures = []
     pruned = total = dead_instructions = instructions = 0
-    modules_skipped = 0
+    modules_skipped = modules_skipped_semantic = 0
+    taint_max_ms = 0.0
+    bench_answerable = 0
+    bench_names = {name for name, _ in bench_rows}
     t0 = time.perf_counter()
     for name, code in rows:
         try:
             summary = analyze_bytecode(code)
             # exercise every surface myth lint renders
-            summary.lint_dict(name=name)
+            row = summary.lint_dict(name=name)
+            assert row["schema_version"] >= 2, row
             applicable, skipped = summary.applicable_modules()
-            assert applicable, f"{name}: screen emptied the module list"
+            opcode_applicable, _ = summary.applicable_modules(
+                semantic=False
+            )
+            assert set(applicable) <= set(opcode_applicable), (
+                f"{name}: semantic screen mounted a module the opcode "
+                "screen rejected"
+            )
             pruned += summary.prune_units
             total += summary.total_units
             dead_instructions += summary.dead_instructions
             instructions += summary.n_instructions
             modules_skipped += len(skipped)
+            modules_skipped_semantic += len(opcode_applicable) - len(
+                applicable
+            )
+            if summary.taint is not None:
+                taint_max_ms = max(taint_max_ms, summary.taint.wall_ms)
+                assert summary.taint.wall_ms < TAINT_BUDGET_S * 1e3, (
+                    f"{name}: taint pass took {summary.taint.wall_ms}ms "
+                    f"(budget {TAINT_BUDGET_S}s)"
+                )
+            if name in bench_names and summary.static_answerable:
+                bench_answerable += 1
         except Exception:
             failures.append(
                 {"contract": name, "error": traceback.format_exc(limit=3)}
             )
+    static_answer_rate = (
+        round(bench_answerable / len(bench_rows), 4) if bench_rows else 0.0
+    )
+    if not failures and static_answer_rate <= 0.0:
+        failures.append(
+            {
+                "contract": "<bench-corpus>",
+                "error": (
+                    "static_answer_rate is 0 on the bench corpus — the "
+                    "triage tier answers nothing"
+                ),
+            }
+        )
     record = {
         "contracts": len(rows),
         "failures": len(failures),
         "static_prune_rate": round(pruned / total, 4) if total else 0.0,
+        "static_answer_rate": static_answer_rate,
         "dead_instructions": dead_instructions,
         "instructions": instructions,
         "modules_skipped_total": modules_skipped,
+        "modules_skipped_semantic": modules_skipped_semantic,
+        "taint_max_ms": round(taint_max_ms, 3),
         "wall_s": round(time.perf_counter() - t0, 3),
     }
     if failures:
