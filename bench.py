@@ -825,6 +825,85 @@ def bench_device_default_path(budget_s: int = 210) -> dict:
     return out
 
 
+def bench_store(budget_s: int = 150) -> dict:
+    """The duplicate-heavy verdict-store leg (mythril_tpu/store): a
+    COLD corpus of base contracts analyzes host-only with write-back,
+    then a WARM corpus of exact duplicates plus one-selector forks
+    runs against the same store directory. At real traffic most
+    submissions are the warm shape — the leg measures what the store
+    refunds: `store_hit_rate` (exact settles / warm corpus),
+    `incremental_rate` (fingerprint-diff re-analyses), and
+    `warm_hit_p50_s` (median settle wall of an exact hit — the
+    admission-tier latency a repeat job pays instead of a full
+    pipeline)."""
+    import statistics
+    import tempfile
+
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.analysis.corpusgen import (
+        deadweight_contract,
+        fork_contract,
+    )
+
+    store_dir = tempfile.mkdtemp(prefix="myth-bench-store-")
+    bases = [
+        (fork_contract(0, 0), "", "storebase#0"),
+        (fork_contract(1, 0), "", "storebase#1"),
+        (deadweight_contract(0), "", "storebase#2"),
+    ]
+    leg_deadline = max(30.0, budget_s * 0.45)
+    t0 = time.monotonic()
+    analyze_corpus(
+        bases,
+        execution_timeout=8,
+        processes=1,
+        use_device=False,
+        store_dir=store_dir,
+        deadline_s=leg_deadline,
+    )
+    cold_wall = time.monotonic() - t0
+    # warm traffic: every base resubmitted byte-for-byte, plus a fork
+    # of base#0 whose SECOND function is untouched (one-selector
+    # mutation — the incremental tier's population)
+    warm_corpus = [
+        (code, "", f"{name}#dupe") for code, _c, name in bases
+    ] + [(fork_contract(0, 1), "", "storefork#0")]
+    t1 = time.monotonic()
+    warm = analyze_corpus(
+        warm_corpus,
+        execution_timeout=8,
+        processes=1,
+        use_device=False,
+        store_dir=store_dir,
+        deadline_s=leg_deadline,
+    )
+    warm_wall = time.monotonic() - t1
+    hits = [r for r in warm if r and r.get("store_hit")]
+    incrementals = [
+        r for r in warm if r and r.get("store_incremental")
+    ]
+    out = {
+        "store_hit_rate": round(len(hits) / len(warm_corpus), 3),
+        "incremental_rate": round(
+            len(incrementals) / len(warm_corpus), 3
+        ),
+        "warm_hit_p50_s": (
+            round(
+                statistics.median(
+                    [r.get("wall_s") or 0.0 for r in hits]
+                ),
+                6,
+            )
+            if hits
+            else None
+        ),
+        "store_cold_wall_s": round(cold_wall, 3),
+        "store_warm_wall_s": round(warm_wall, 3),
+    }
+    print(f"bench: store leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -949,6 +1028,11 @@ def main(final_attempt: bool = False) -> None:
         # emit — device_sat / (device_sat + cdcl_sat) over the run
         "device_sat_verdicts": 0,
         "device_verdict_share": 0.0,
+        # verdict-store scorecard (ISSUE 11): the duplicate-heavy leg
+        # fills these; None = the leg never ran
+        "store_hit_rate": None,
+        "incremental_rate": None,
+        "warm_hit_p50_s": None,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1082,6 +1166,27 @@ def main(final_attempt: bool = False) -> None:
             record.update(conv.summarize(strict=False))
             spread_error = why
             break
+
+    # -- duplicate-heavy verdict-store leg ----------------------------
+    if _budget_left() < 90:
+        record.setdefault("store", "budget-skipped")
+        print("bench: store leg skipped (budget spent)", file=sys.stderr)
+    else:
+        try:
+            record.update(
+                _with_deadline(
+                    lambda: bench_store(
+                        budget_s=max(45, min(150, int(_budget_left() - 60)))
+                    ),
+                    max(60, min(180, int(_budget_left() - 45))),
+                )
+            )
+        except _Deadline:
+            record["store"] = "deadline"
+            print("bench: store leg hit its deadline", file=sys.stderr)
+        except Exception as e:
+            record["store"] = "failed"
+            print(f"bench: store leg failed: {e!r}", file=sys.stderr)
 
     if _budget_left() < 60:
         record.setdefault("default_path", "budget-skipped")

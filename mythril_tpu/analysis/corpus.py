@@ -147,6 +147,7 @@ def corpus_device_prepass(
     deadline=None,
     checkpoint_path=None,
     mesh_groups: Optional[int] = None,
+    selector_masks: Optional[Dict[int, Tuple]] = None,
 ) -> Dict[int, Dict]:
     """One striped device exploration over the corpus; returns
     {contract_index: single-contract prepass outcome} for injection
@@ -159,7 +160,14 @@ def corpus_device_prepass(
     engine: the corpus shards over N device groups at admission, each
     group runs its own wave engine in its own failure domain, and a
     drained group steals pending contracts/frontiers from the most
-    loaded one (parallel/scheduler.py)."""
+    loaded one (parallel/scheduler.py).
+
+    `selector_masks` ({contract index: (unchanged selector bytes,
+    entry directions)}, mythril_tpu/store) restricts specific
+    contracts' exploration to their CHANGED functions — the verdict
+    store's incremental tier. The mesh scheduler path drops the masks
+    (pure optimization; sharded index bookkeeping isn't worth the
+    coupling there yet)."""
     runnable = _runnable_rows(contracts)
     if not runnable:
         return {}
@@ -231,6 +239,15 @@ def corpus_device_prepass(
         from mythril_tpu.laser.batch.explore import required_calldata_len
 
         at_scale = len(runnable) >= OVERLAP_MIN_CORPUS
+        # translate contract-index masks to track indices (the
+        # explorer only sees the runnable rows)
+        track_masks = None
+        if selector_masks:
+            track_masks = {
+                ti: selector_masks[idx]
+                for ti, (idx, _code) in enumerate(runnable)
+                if idx in selector_masks
+            }
         explorer = DeviceCorpusExplorer(
             [code for _, code in runnable],
             calldata_len=max(
@@ -260,6 +277,7 @@ def corpus_device_prepass(
             publish=translate,
             deadline=deadline,
             checkpoint_path=checkpoint_path,
+            selector_masks=track_masks,
         )
         if lock_wanted is not None:
             explorer.lock_wanted = lock_wanted
@@ -449,6 +467,7 @@ class OverlappedPrepass:
         ownership: bool = False,
         deadline=None,
         mesh_groups: Optional[int] = None,
+        selector_masks: Optional[Dict[int, Tuple]] = None,
     ) -> None:
         import threading
 
@@ -478,6 +497,7 @@ class OverlappedPrepass:
                     ownership=ownership,
                     deadline=deadline,
                     mesh_groups=mesh_groups,
+                    selector_masks=selector_masks,
                 )
             )
 
@@ -693,12 +713,15 @@ def _static_answer_result(name: str, summary, wall_s: float) -> Dict:
 
 
 def _static_triage(
-    contracts: List[Tuple[str, str, str]]
+    contracts: List[Tuple[str, str, str]],
+    skip: Optional[frozenset] = None,
 ) -> Dict[int, Dict]:
     """{index: static-answer result} for every corpus row the
     semantic screen settles outright. Runs BEFORE the device prepass
     so answered contracts never occupy a lane; any per-contract
-    failure simply keeps that contract on the full path."""
+    failure simply keeps that contract on the full path. `skip` rows
+    (already settled by an earlier tier — the verdict store) are
+    never re-examined."""
     from mythril_tpu.analysis.static import summary_for
     from mythril_tpu.observe.registry import registry
 
@@ -708,6 +731,8 @@ def _static_triage(
         "contracts settled by the static-answer triage tier",
     )
     for i, (code, creation_code, name) in enumerate(contracts):
+        if skip and i in skip:
+            continue
         if creation_code:
             # a deploying row executes creation code too — the
             # runtime-only proof does not cover it
@@ -736,6 +761,193 @@ def _static_triage(
             len(contracts),
         )
     return out
+
+
+def _store_hit_result(name: str, entry, wall_s: float) -> Dict:
+    """The result slot for an exact verdict-store hit: the banked
+    issue set IS the analysis — no device wave, no host walk, no
+    solver. Same shape as an analyzed result; the `store_hit` flag
+    routes it in the routing feature log and the report meta."""
+    return {
+        "name": name,
+        "issues": entry.issues,
+        "states": 0,
+        "device_prepass": None,
+        "phases": {},
+        "precovered_skips": 0,
+        "wall_s": round(wall_s, 6),
+        "error": None,
+        "store_hit": True,
+        "store": {
+            "code_hash": entry.code_hash,
+            "config_fingerprint": entry.config_fp,
+            "provenance": entry.provenance,
+        },
+    }
+
+
+def _store_triage(
+    contracts: List[Tuple[str, str, str]], vstore, config_fp: str
+) -> Tuple[Dict[int, Dict], Dict[int, object]]:
+    """({index: exact-hit result}, {index: IncrementalPlan}) from the
+    verdict store (mythril_tpu/store). Runs BEFORE the static triage
+    and the device prepass, so hit contracts never occupy a lane and
+    incremental contracts explore only their changed selectors. Every
+    doubt bails that contract to the full path — a store problem can
+    cost speed, never correctness."""
+    answers: Dict[int, Dict] = {}
+    plans: Dict[int, object] = {}
+    if vstore is None:
+        return answers, plans
+    from mythril_tpu.analysis.static import (
+        static_prune_enabled,
+        summary_for,
+    )
+    from mythril_tpu.store import (
+        IncrementalBail,
+        code_hash_hex,
+        plan_incremental,
+    )
+
+    for i, (code, creation_code, name) in enumerate(contracts):
+        if creation_code:
+            # a deploying row executes creation code too — the
+            # runtime-keyed verdict does not cover it
+            continue
+        norm = code[2:] if code.startswith("0x") else code
+        if len(norm) < 8:
+            continue
+        t0 = time.perf_counter()
+        code_hash = code_hash_hex(norm)
+        try:
+            entry = vstore.get(code_hash, config_fp)
+        except Exception:
+            log.debug("store lookup failed for %s", name, exc_info=True)
+            continue
+        if entry is not None:
+            answers[i] = _store_hit_result(
+                name, entry, time.perf_counter() - t0
+            )
+            continue
+        if not static_prune_enabled():
+            continue  # the diff needs the static layer's fingerprints
+        try:
+            summary = summary_for(norm, config_fp=config_fp)
+            nearest = vstore.nearest(
+                config_fp,
+                summary.function_fingerprints,
+                exclude_code_hash=code_hash,
+            )
+            if nearest is None:
+                continue
+            plans[i] = plan_incremental(summary, nearest)
+            log.info(
+                "Store incremental plan for %s: %d changed / %d "
+                "unchanged selector(s), %d banked issue(s)",
+                name,
+                len(plans[i].changed),
+                len(plans[i].unchanged),
+                len(plans[i].banked_issues),
+            )
+        except IncrementalBail as bail:
+            log.info(
+                "Store incremental bail for %s: %s (full analysis)",
+                name,
+                bail.reason,
+            )
+        except Exception:
+            log.debug(
+                "store incremental planning failed for %s", name,
+                exc_info=True,
+            )
+    if answers:
+        log.info(
+            "Verdict store settled %d/%d contract(s) at admission",
+            len(answers),
+            len(contracts),
+        )
+    return answers, plans
+
+
+def _apply_incremental(result: Optional[Dict], plan) -> Optional[Dict]:
+    """Fold one incremental plan's banked issues into the fresh
+    (changed-selector-restricted) result and flag the route."""
+    if result is None or result.get("error"):
+        return result
+    from mythril_tpu.store import merge_banked_issues
+
+    added = merge_banked_issues(result.setdefault("issues", []), plan.banked_issues)
+    result["store_incremental"] = True
+    result["store"] = dict(plan.as_dict(), banked_merged=added)
+    return result
+
+
+def _store_writeback(
+    results: List[Optional[Dict]],
+    contracts: List[Tuple[str, str, str]],
+    prepass: Dict[int, Dict],
+    vstore,
+    config_fp: str,
+) -> int:
+    """Tier 3: persist every COMPLETE full analysis (including
+    incremental ones — a fork's merged verdict is a first-class entry
+    for the next fork). Store-hit and statically-answered rows are not
+    re-written (their verdicts are already cheap or present); partial,
+    skipped, and errored rows never are."""
+    if vstore is None:
+        return 0
+    from mythril_tpu.analysis.static import (
+        static_prune_enabled,
+        summary_for,
+    )
+    from mythril_tpu.store import (
+        banks_from_outcome,
+        code_hash_hex,
+        provenance,
+        static_export,
+    )
+
+    written = 0
+    for i, (code, creation_code, name) in enumerate(contracts):
+        result = results[i] if i < len(results) else None
+        if (
+            result is None
+            or creation_code
+            or not result.get("complete")
+            or result.get("store_hit")
+            or result.get("static_answered")
+            or result.get("skipped")
+        ):
+            continue
+        norm = code[2:] if code.startswith("0x") else code
+        if len(norm) < 8:
+            continue
+        summary = None
+        if static_prune_enabled():
+            try:
+                summary = summary_for(norm, config_fp=config_fp)
+            except Exception:
+                summary = None
+        try:
+            path = vstore.put(
+                code_hash_hex(norm),
+                config_fp,
+                issues=result.get("issues") or [],
+                static=static_export(summary),
+                banks=banks_from_outcome(prepass.get(i)),
+                provenance=provenance(
+                    wall_s=result.get("wall_s"),
+                    computed_by="corpus",
+                    incremental=bool(result.get("store_incremental")),
+                ),
+            )
+            written += bool(path)
+        except Exception:
+            log.debug("store write-back failed for %s", name,
+                      exc_info=True)
+    if written:
+        log.info("Verdict store banked %d verdict(s)", written)
+    return written
 
 
 def _skipped_result(name: str, reason: str) -> Dict:
@@ -891,6 +1103,8 @@ def analyze_corpus(
     deadline_s: Optional[float] = None,
     on_timeout: str = "partial",
     devices: Optional[int] = None,
+    store_dir: Optional[str] = None,
+    store: Optional[bool] = None,
     _flag_scoped: bool = False,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
@@ -949,6 +1163,8 @@ def analyze_corpus(
                 deadline_s=deadline_s,
                 on_timeout=on_timeout,
                 devices=devices,
+                store_dir=store_dir,
+                store=store,
                 _flag_scoped=True,
             )
         finally:
@@ -960,17 +1176,49 @@ def analyze_corpus(
 
         use_device = accelerator_present()
 
+    # tier 1+2 of the verdict store (mythril_tpu/store): exact
+    # (codehash, config-fingerprint) hits settle HERE in microseconds
+    # with the banked issue set; near-duplicates get an incremental
+    # plan that masks their unchanged selectors out of the device
+    # exploration and pre-banks the untouched functions' issues
+    from mythril_tpu.analysis.static import static_answer_enabled
+    from mythril_tpu.analysis.static.summary import (
+        analysis_config_fingerprint,
+    )
+
+    config_fp = analysis_config_fingerprint(
+        modules=modules,
+        transaction_count=transaction_count,
+        solver_timeout=solver_timeout,
+        create_timeout=create_timeout,
+    )
+    vstore = None
+    if store is not False:
+        try:
+            from mythril_tpu.store import configured_store
+
+            vstore = configured_store(store_dir)
+        except Exception:
+            log.debug("verdict store unavailable", exc_info=True)
+    store_answers, store_plans = _store_triage(
+        contracts, vstore, config_fp
+    )
+    selector_masks = {
+        i: (plan.mask_selectors, plan.mask_directions)
+        for i, plan in store_plans.items()
+    } or None
+
     # the static-answer triage tier: contracts the semantic screen
     # settles are answered HERE (microseconds) and excluded from the
     # device prepass — the prepass sees their rows as non-runnable so
     # the index mapping every consumer shares stays intact
-    from mythril_tpu.analysis.static import static_answer_enabled
-
     static_answers: Dict[int, Dict] = (
-        _static_triage(contracts) if static_answer_enabled() else {}
+        _static_triage(contracts, skip=frozenset(store_answers))
+        if static_answer_enabled()
+        else {}
     )
     prepass_rows = list(contracts)
-    for i in static_answers:
+    for i in list(static_answers) + list(store_answers):
         prepass_rows[i] = ("", contracts[i][1], contracts[i][2])
 
     single_process = processes <= 1 or len(contracts) == 1
@@ -1030,6 +1278,7 @@ def analyze_corpus(
                 ownership=_ownership_enabled(use_device),
                 deadline=deadline,
                 mesh_groups=devices,
+                selector_masks=selector_masks,
             )
             # Smallest code first: cheap analyses (which converge well
             # inside their budgets regardless of contention) soak up
@@ -1094,6 +1343,13 @@ def analyze_corpus(
                                 deadline
                             )
                         code, creation_code, name = contracts[i]
+                        if i in store_answers:
+                            # exact store hit: the banked verdict is
+                            # the analysis — survives a deadline halt
+                            # like the static answers below
+                            slots[i] = store_answers[i]
+                            progressed = True
+                            continue
                         if i in static_answers:
                             # statically answered: the empty issue set
                             # is the analysis — it even survives a
@@ -1112,6 +1368,12 @@ def analyze_corpus(
                         if time.perf_counter() - t_overlap > overlap_window_s:
                             pre.drain()
                         outcome, device_ok = pre.outcome_for(i)
+                        if outcome is None and i in store_plans:
+                            # no device outcome (yet): the store's
+                            # banked coverage for the unchanged
+                            # selectors pre-empts walk feasibility
+                            # queries instead
+                            outcome = store_plans[i].injected_outcome
                         if own and _outcome_owns(outcome):
                             # device-complete contract: evidence IS
                             # the analysis; no walk, no lock, no
@@ -1121,6 +1383,10 @@ def analyze_corpus(
                                 address,
                             )
                             if owned_res is not None:
+                                if i in store_plans:
+                                    owned_res = _apply_incremental(
+                                        owned_res, store_plans[i]
+                                    )
                                 slots[i] = owned_res
                                 progressed = True
                                 continue
@@ -1144,6 +1410,10 @@ def analyze_corpus(
                                     use_device and device_ok,
                                     outcome,
                                 )
+                            )
+                        if i in store_plans:
+                            slots[i] = _apply_incremental(
+                                slots[i], store_plans[i]
                             )
                         pre.yield_lock()
                         progressed = True
@@ -1171,6 +1441,7 @@ def analyze_corpus(
                     deadline=deadline,
                     stop_event=resilience.shutdown_event(),
                     mesh_groups=devices,
+                    selector_masks=selector_masks,
                 )
             own = _ownership_enabled(use_device)
             results = []
@@ -1179,6 +1450,9 @@ def analyze_corpus(
                 resilience.inject("corpus.contract")
                 if halt_reason is None:
                     halt_reason = resilience.interrupted_reason(deadline)
+                if i in store_answers:
+                    results.append(store_answers[i])
+                    continue
                 if i in static_answers:
                     results.append(static_answers[i])
                     continue
@@ -1207,14 +1481,21 @@ def analyze_corpus(
                     else None
                 )
                 if owned_res is None:
+                    outcome = prepass.get(i)
+                    if outcome is None and i in store_plans:
+                        outcome = store_plans[i].injected_outcome
                     owned_res = _analyze_one(
                         payload(
                             code,
                             creation_code,
                             name,
                             use_device,
-                            prepass.get(i),
+                            outcome,
                         )
+                    )
+                if i in store_plans:
+                    owned_res = _apply_incremental(
+                        owned_res, store_plans[i]
                     )
                 results.append(owned_res)
     else:
@@ -1225,9 +1506,19 @@ def analyze_corpus(
         # tail skipped — map_async's all-or-nothing get() would lose
         # the whole pool on a timeout.
         payloads = [
-            payload(code, creation_code, name, False, None)
+            payload(
+                code,
+                creation_code,
+                name,
+                False,
+                (
+                    store_plans[i].injected_outcome
+                    if i in store_plans
+                    else None
+                ),
+            )
             for i, (code, creation_code, name) in enumerate(contracts)
-            if i not in static_answers
+            if i not in static_answers and i not in store_answers
         ]
         ctx = mp.get_context("spawn")  # fresh singletons per worker
         with ctx.Pool(processes=processes) as pool:
@@ -1241,10 +1532,14 @@ def analyze_corpus(
                     deadline=deadline,
                     stop_event=resilience.shutdown_event(),
                     mesh_groups=devices,
+                    selector_masks=selector_masks,
                 )
             results = []
             halt_reason = None
             for i, (code, _creation, name) in enumerate(contracts):
+                if i in store_answers:
+                    results.append(store_answers[i])
+                    continue
                 if i in static_answers:
                     results.append(static_answers[i])
                     continue
@@ -1252,12 +1547,16 @@ def analyze_corpus(
                     halt_reason = resilience.interrupted_reason(deadline)
                 if halt_reason is None:
                     try:
-                        if deadline is None:
-                            results.append(walked.next())
-                        else:
-                            results.append(
-                                walked.next(max(0.1, deadline.remaining))
+                        walked_res = (
+                            walked.next()
+                            if deadline is None
+                            else walked.next(max(0.1, deadline.remaining))
+                        )
+                        if i in store_plans:
+                            walked_res = _apply_incremental(
+                                walked_res, store_plans[i]
                             )
+                        results.append(walked_res)
                         continue
                     except mp.TimeoutError:
                         halt_reason = (
@@ -1281,6 +1580,11 @@ def analyze_corpus(
             not result.get("skipped") and result.get("error") is None
         )
         skipped += bool(result.get("skipped"))
+    # tier 3: every completed full analysis becomes a store entry —
+    # the write that turns this run's compute into the next run's
+    # admission-time answer
+    if vstore is not None:
+        _store_writeback(results, contracts, prepass, vstore, config_fp)
     _emit_routing_records(results, contracts)
     if skipped and on_timeout == "fail":
         from mythril_tpu.exceptions import DeadlineExpiredError

@@ -411,6 +411,49 @@ def clean_contract(seed: int = 0) -> str:
     return bytes(code).hex()
 
 
+def fork_contract(seed: int = 0, variant: int = 0) -> str:
+    """A two-function dispatcher whose fork axis is ONE selector: the
+    verdict store's incremental-reanalysis shape. `seed` fixes the
+    selectors (all variants of one seed share them); `variant` mutates
+    fn A's body constants only (its SSTORE value and INVALID-guard
+    magic), so between two variants exactly fn A's subgraph
+    fingerprint changes while fn B — which carries its own guarded
+    INVALID (SWC-110) and touches no storage — stays byte-identical.
+    fn B reads no storage, so the incremental diff's cross-selector
+    state-flow bail stays quiet and its banked issues are mergeable.
+
+        fn A: sstore(0, C_v); if (cd[4..35] == magic_v) INVALID
+        fn B: if (cd[4..35] == 0xbb) INVALID
+    """
+    sel1 = (0xF0CACC1A + seed) & 0xFFFFFFFF
+    sel2 = (0xBA5EBA11 + seed * 5) & 0xFFFFFFFF
+    fn_a, fn_b = 26, 44
+    fail_a, fail_b = 42, 55
+    code = bytearray(
+        [0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63]
+    )  # selector = CALLDATALOAD(0) >> 224; DUP1; PUSH4
+    code += sel1.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, fn_a, 0x57])  # EQ; PUSH1 a; JUMPI
+    code += bytes([0x63]) + sel2.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, fn_b, 0x57])  # EQ; PUSH1 b; JUMPI
+    code += bytes([0x00])  # STOP (no match)
+    assert len(code) == fn_a
+    code += bytes([0x5B, 0x60, 0x10 + (variant % 0xE0)])  # PUSH1 C_v
+    code += bytes([0x60, 0x00, 0x55])  # sstore(0, C_v)
+    code += bytes([0x60, 0x04, 0x35])  # CALLDATALOAD(4)
+    code += bytes([0x60, 0xA0 + ((seed + variant) % 0x5F), 0x14])
+    code += bytes([0x60, fail_a, 0x57, 0x00])  # JUMPI fail_a; STOP
+    assert len(code) == fail_a
+    code += bytes([0x5B, 0xFE])  # fail_a: JUMPDEST; INVALID
+    assert len(code) == fn_b
+    code += bytes([0x5B, 0x60, 0x04, 0x35])  # b: CALLDATALOAD(4)
+    code += bytes([0x60, 0xBB, 0x14])  # == 0xbb ?
+    code += bytes([0x60, fail_b, 0x57, 0x00])  # JUMPI fail_b; STOP
+    assert len(code) == fail_b
+    code += bytes([0x5B, 0xFE])  # fail_b: JUMPDEST; INVALID
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,
@@ -419,6 +462,8 @@ def synth_bench_corpus(
     wides: int = 6,
     deadweights: int = 2,
     cleans: int = 2,
+    dupes: int = 0,
+    forks: int = 0,
     inputs: Optional[Path] = None,
 ) -> List[Tuple[str, str, str]]:
     """The round-5 benchmark corpus: fixture constant-mutants plus
@@ -431,7 +476,14 @@ def synth_bench_corpus(
     corpus = synth_corpus(
         max(
             0,
-            n_contracts - loops - degraders - wides - deadweights - cleans,
+            n_contracts
+            - loops
+            - degraders
+            - wides
+            - deadweights
+            - cleans
+            - dupes
+            - forks,
         ),
         seed=seed,
         inputs=inputs,
@@ -448,6 +500,21 @@ def synth_bench_corpus(
         corpus.append((deadweight_contract(seed=k), "", f"deadweight#{k}"))
     for k in range(cleans):
         corpus.append((clean_contract(seed=k), "", f"clean#{k}"))
+    # the verdict-store population (mythril_tpu/store): `dupes` exact
+    # byte-for-byte copies of earlier rows (the exact-hit tier's
+    # repeat traffic) and `forks` single-selector-mutated fork pairs
+    # (base variant + mutant variant — the incremental tier's
+    # fingerprint-diff traffic)
+    base_rows = [row for row in corpus if row[0]] or [
+        (fork_contract(0, 0), "", "storebase#0")
+    ]
+    for k in range(dupes):
+        src = base_rows[k % len(base_rows)]
+        corpus.append((src[0], "", f"{src[2]}#dupe{k}"))
+    for k in range(forks):
+        corpus.append(
+            (fork_contract(seed=k // 2, variant=k % 2), "", f"fork#{k}")
+        )
     rng.shuffle(corpus)
     return corpus[:n_contracts]
 

@@ -62,6 +62,7 @@ from mythril_tpu.analysis.static.summary import (
     LINT_CHECKS,
     LINT_SCHEMA_VERSION,
     StaticSummary,
+    analysis_config_fingerprint,
     analyze_bytecode,
     clear_static_cache,
     static_cache_stats,
@@ -111,6 +112,7 @@ __all__ = [
     "TAINT_UNKNOWN",
     "TaintResult",
     "ValueSets",
+    "analysis_config_fingerprint",
     "analyze_bytecode",
     "clear_static_cache",
     "recover_cfg",

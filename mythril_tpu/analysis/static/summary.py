@@ -72,6 +72,53 @@ LINT_CHECKS = frozenset(
 #: re-analyze")
 FINGERPRINT_MAX_BLOCKS = 512
 
+
+def analysis_config_fingerprint(
+    modules=None,
+    transaction_count: Optional[int] = None,
+    solver_timeout: Optional[int] = None,
+    create_timeout: Optional[int] = None,
+    creating: bool = False,
+    extra: Optional[Dict] = None,
+) -> str:
+    """Content hash of everything VERDICT-relevant about the analysis
+    configuration: two runs with the same code and the same fingerprint
+    may share a verdict; any knob that could change the issue set must
+    be in here. Hashed: the mythril_tpu version, the transaction count,
+    the mounted-module set (None = the full registry), the per-query
+    solver timeout, the create-tx budget, whether a create transaction
+    runs at all, and the static-layer switches (a --no-static-prune
+    verdict mounts more modules than a pruned one). Deliberately NOT
+    hashed: the execution/wall budgets — they bound completeness, not
+    soundness, and keying on them would shatter the store across every
+    deadline setting.
+
+    This is the shared key half of the cross-run verdict store
+    (mythril_tpu/store) AND the in-memory `summary_for` cache: a
+    StaticSummary's applicable-module verdict depends on the module
+    registry in force, so the same code under two module sets must not
+    alias one cache slot."""
+    from mythril_tpu import __version__
+    from mythril_tpu.support.support_args import args as _flags
+
+    if solver_timeout is None:
+        solver_timeout = getattr(_flags, "solver_timeout", None)
+    parts = [
+        f"v={__version__}",
+        f"tx={2 if transaction_count is None else int(transaction_count)}",
+        "mods={}".format(
+            "*" if modules is None else ",".join(sorted(modules))
+        ),
+        f"st={solver_timeout}",
+        f"ct={create_timeout}",
+        f"create={int(bool(creating))}",
+        f"sp={int(bool(getattr(_flags, 'static_prune', True)))}",
+        f"sa={int(bool(getattr(_flags, 'static_answer', True)))}",
+    ]
+    if extra:
+        parts.extend(f"{k}={extra[k]}" for k in sorted(extra))
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
 #: opcodes an inert (prunable) subgraph may contain: pure stack/data
 #: shuffling plus control flow. Anything a detection module hooks, the
 #: device evidence bank records (arith wraps, storage access, calls,
@@ -386,6 +433,39 @@ class StaticSummary:
             out["0x" + entry.selector.hex()] = digest.hexdigest()[:16]
         return out
 
+    def selector_subgraphs(self) -> Dict[str, List[Tuple[int, int]]]:
+        """selector hex -> sorted [start, end] byte spans of the
+        blocks in that function's resolved subgraph (the same blocks
+        `_function_fingerprints` hashes). The verdict store's
+        incremental diff uses these spans to attribute banked issues
+        and covered branches to selectors: an address inside exactly
+        one selector's spans belongs to that function; addresses in
+        shared or dispatcher code attribute to no selector and stay
+        conservative. Entries without a bounded subgraph are absent —
+        same "content unknown" contract as the fingerprints."""
+        out: Dict[str, List[Tuple[int, int]]] = {}
+        if self.incomplete:
+            return out
+        for entry in self.dispatcher:
+            blocks = self._subgraph_blocks(entry.entry_pc)
+            if blocks is None:
+                continue
+            out["0x" + entry.selector.hex()] = sorted(
+                (start, self.cfg.blocks[start].end)
+                for start in blocks
+            )
+        return out
+
+    def selector_entry_directions(self) -> Dict[str, Tuple[int, bool]]:
+        """selector hex -> the (jumpi_pc, taken) dispatcher direction
+        that ENTERS the function body — what the incremental
+        re-analysis masks to keep an unchanged selector's flips out of
+        the frontier."""
+        return {
+            "0x" + entry.selector.hex(): (entry.jumpi_pc, entry.entry_taken)
+            for entry in self.dispatcher
+        }
+
     def _subgraph_blocks(self, entry_pc: int) -> Optional[Set[int]]:
         """Block starts reachable from `entry_pc` over RESOLVED edges,
         or None when the subgraph cannot be bounded (unresolved jump /
@@ -653,11 +733,19 @@ def analyze_bytecode(code) -> StaticSummary:
     return StaticSummary(_as_bytes(code))
 
 
-def summary_for(code) -> StaticSummary:
-    """Cached-by-code-hash static analysis (thread-safe)."""
+def summary_for(code, config_fp: Optional[str] = None) -> StaticSummary:
+    """Cached static analysis (thread-safe). The cache key is
+    (code hash, analysis-config fingerprint): a summary's
+    applicable-module/static-answerable VERDICT depends on the module
+    set and static flags in force, so the same code under two configs
+    must occupy two slots — the same key discipline the persistent
+    verdict store uses. `config_fp` defaults to the current global
+    configuration's fingerprint."""
     global _HITS, _MISSES
     raw = _as_bytes(code)
-    key = hashlib.sha256(raw).hexdigest()
+    if config_fp is None:
+        config_fp = analysis_config_fingerprint()
+    key = hashlib.sha256(raw).hexdigest() + ":" + config_fp
     with _CACHE_LOCK:
         hit = _CACHE.get(key)
         if hit is not None:

@@ -340,6 +340,34 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--store",),
+        dict(
+            default=None,
+            metavar="DIR",
+            help=(
+                "Cross-run verdict store directory (env "
+                "MYTHRIL_STORE_DIR): repeat contracts settle from the "
+                "banked (codehash, config-fingerprint) verdict, "
+                "near-duplicate forks re-analyze only their changed "
+                "selectors, and completed analyses write their "
+                "verdicts back"
+            ),
+        ),
+    ),
+    (
+        ("--no-store",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable the verdict store entirely (no lookups, no "
+                "incremental re-analysis, no write-back) even when a "
+                "directory is configured — the parity-differential "
+                "baseline for a suspected stale or wrong cached "
+                "verdict"
+            ),
+        ),
+    ),
+    (
         ("--no-pipeline",),
         dict(
             action="store_true",
@@ -898,6 +926,27 @@ def build_parser() -> ArgumentParser:
             "live loss/capture counters at /stats solver.*"
         ),
     )
+    serve.add_argument(
+        "--store",
+        default=None,
+        metavar="DIR",
+        help=(
+            "cross-run verdict store (env MYTHRIL_STORE_DIR): repeat "
+            "submissions settle DONE at admission from the banked "
+            "(codehash, config-fingerprint) verdict — no queue slot, "
+            "no wave — and completed walks write back; share one DIR "
+            "across replicas so any of them answers any repeat "
+            "(/stats store.*)"
+        ),
+    )
+    serve.add_argument(
+        "--no-store",
+        action="store_true",
+        help=(
+            "disable the verdict store tier (no admission lookups, "
+            "no write-back) even when a directory is configured"
+        ),
+    )
 
     solverlab = subparsers.add_parser(
         "solverlab",
@@ -1387,6 +1436,10 @@ def _run_analyze(disassembler, address, args):
         capture_queries=args.capture_queries,
         device_first=not args.host_first_funnel,
         sprint_cap_s=args.sprint_cap_s,
+        store_dir=(
+            args.store or os.environ.get("MYTHRIL_STORE_DIR") or None
+        ),
+        store=not args.no_store,
     )
 
     if not disassembler.contracts:
@@ -1539,6 +1592,10 @@ def _cmd_serve(args: Namespace) -> None:
         static_answer=not (
             args.no_static_answer or args.no_static_prune
         ),
+        store_dir=(
+            args.store or os.environ.get("MYTHRIL_STORE_DIR") or None
+        ),
+        store=not args.no_store,
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()

@@ -185,6 +185,11 @@ class ExploreStats:
         #: can fire (summary.static_answerable) — the population the
         #: static-answer triage tier settles without any device work
         self.static_answered = 0
+        # verdict-store incremental re-analysis (mythril_tpu/store):
+        # unchanged-fork selectors whose dispatcher seeds and entry
+        # flips this exploration masked — lanes spent only on changed
+        # functions
+        self.store_masked_selectors = 0
         # -- kernel specialization observability (specialize.py) ------
         #: 1 when the waves ran a contract-specialized kernel
         self.specialized = 0
@@ -274,6 +279,7 @@ MERGE_POLICY: Dict[str, str] = {
     "static_seeds_dropped": "sum",
     "static_summaries": "sum",
     "static_answered": "sum",
+    "store_masked_selectors": "sum",
     "specialized": "max",
     "spec_pruned_phases": "max",
     "spec_fused_steps": "sum",
@@ -870,6 +876,7 @@ class DeviceCorpusExplorer:
         devices=None,
         fault_domain: Optional[str] = None,
         specialize: Optional[bool] = None,
+        selector_masks: Optional[Dict[int, Tuple]] = None,
     ) -> None:
         from mythril_tpu.laser.batch import ensure_compile_cache
         from mythril_tpu.laser.batch.seeds import code_cap_bucket
@@ -880,6 +887,13 @@ class DeviceCorpusExplorer:
             _ContractTrack(c[2:] if c.startswith("0x") else c) for c in codes_hex
         ]
         self.codes = [bytes.fromhex(t.code_hex) for t in self.tracks]
+        #: verdict-store incremental masks (mythril_tpu/store/diff.py):
+        #: {track index: (frozenset of unchanged selector bytes,
+        #: frozenset of their (jumpi_pc, taken) entry directions)} —
+        #: those selectors' dispatcher seeds and entry flips are
+        #: pruned exactly like statically-dead ones, so this
+        #: exploration spends lanes only on a fork's CHANGED functions
+        self.selector_masks = dict(selector_masks or {})
         self._attach_static_feeds()
         self.lanes_per_contract = lanes_per_contract
         self.calldata_len = calldata_len
@@ -960,6 +974,15 @@ class DeviceCorpusExplorer:
             1
             for t in self.tracks
             if t.static is not None and t.static.static_answerable
+        )
+        # selectors actually masked (a mask on a track whose static
+        # feed failed never attached, so count from the feeds)
+        from mythril_tpu.store.diff import SelectorMaskFeed as _MaskFeed
+
+        self.stats.store_masked_selectors = sum(
+            len(t.static.mask_selectors)
+            for t in self.tracks
+            if isinstance(t.static, _MaskFeed)
         )
         self._phase_allowance: Optional[float] = None
 
@@ -1066,9 +1089,19 @@ class DeviceCorpusExplorer:
             return
         from mythril_tpu.analysis.static import summary_for
 
-        for track in self.tracks:
+        for ti, track in enumerate(self.tracks):
             try:
                 track.static = summary_for(track.code_hex)
+                mask = self.selector_masks.get(ti)
+                if mask is not None:
+                    # wrap the summary so the unchanged-fork selectors
+                    # read as dead to seeding AND to the flip frontier
+                    from mythril_tpu.store.diff import SelectorMaskFeed
+
+                    sels, directions = mask
+                    track.static = SelectorMaskFeed(
+                        track.static, sels, directions
+                    )
                 track.static_dead = frozenset(
                     track.static.prune_directions()
                 )

@@ -79,6 +79,11 @@ _GLOBAL_DEFAULTS = dict(
     # before the CDCL sprint on the explorer's flip frontier
     # (--host-first-funnel restores the legacy order)
     device_first=True,
+    # cross-run verdict store (mythril_tpu/store, --store DIR /
+    # --no-store): deep layers and the corpus driver read these from
+    # the flag bag
+    store_dir=__import__("os").environ.get("MYTHRIL_STORE_DIR") or None,
+    store=True,
 )
 
 
@@ -391,6 +396,12 @@ class MythrilAnalyzer:
             completion.append(
                 {"contract": contract.name, "complete": not crashed}
             )
+            if not crashed:
+                self._store_writeback(
+                    contract, issues, outcome,
+                    _time.perf_counter() - t_contract,
+                    modules, transaction_count,
+                )
             self._routing_record(
                 contract, issues, crashed,
                 _time.perf_counter() - t_contract,
@@ -400,6 +411,69 @@ class MythrilAnalyzer:
 
             log.info("Host phase profile: \n%s", str(PhaseProfile()))
         return collected, crashes, execution_info, completion
+
+    def _store_writeback(
+        self,
+        contract,
+        issues: List[Issue],
+        outcome,
+        wall_s: float,
+        modules: Optional[List[str]] = None,
+        transaction_count: Optional[int] = None,
+    ) -> None:
+        """Tier 3 of the verdict store on the one-shot CLI path: a
+        cleanly-completed contract banks its verdict (keyed on its
+        RUNTIME code + the run's config fingerprint) so a later
+        `myth serve` / corpus run settles the repeat at admission.
+        Deploying analyses are not banked — their verdict covers
+        creation code the runtime key doesn't."""
+        from mythril_tpu.store import configured_store
+
+        try:
+            vstore = configured_store()
+        except Exception:
+            return
+        if vstore is None:
+            return
+        runtime = (contract.code or "").removeprefix("0x")
+        if len(runtime) < 8 or getattr(contract, "creation_code", ""):
+            return
+        try:
+            from mythril_tpu.analysis.static import (
+                static_prune_enabled,
+                summary_for,
+            )
+            from mythril_tpu.analysis.static.summary import (
+                analysis_config_fingerprint,
+            )
+            from mythril_tpu.store import (
+                banks_from_outcome,
+                code_hash_hex,
+                provenance,
+                static_export,
+            )
+
+            config_fp = analysis_config_fingerprint(
+                modules=modules,
+                transaction_count=transaction_count,
+                create_timeout=self.create_timeout,
+            )
+            summary = None
+            if static_prune_enabled():
+                summary = summary_for(runtime, config_fp=config_fp)
+            vstore.put(
+                code_hash_hex(runtime),
+                config_fp,
+                issues=[issue.as_dict for issue in issues],
+                static=static_export(summary),
+                banks=banks_from_outcome(outcome),
+                provenance=provenance(
+                    wall_s=wall_s, computed_by="analyzer"
+                ),
+            )
+        except Exception:
+            log.debug("store write-back failed for %s", contract.name,
+                      exc_info=True)
 
     @staticmethod
     def _routing_record(

@@ -198,8 +198,17 @@ def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
     device ran)."""
     if result.get("skipped"):
         route = "skipped"
+    elif result.get("store_hit"):
+        # settled at admission from the cross-run verdict store —
+        # near-zero cost, the cache economics the item-5 cost model
+        # must see (routes are open-ended; schema stays v2)
+        route = "store-hit"
     elif result.get("static_answered"):
         route = "static-answer"
+    elif result.get("store_incremental"):
+        # fingerprint-diff re-analysis: only changed selectors paid
+        # for compute, banked issues covered the rest
+        route = "store-incremental"
     elif result.get("owned"):
         route = "device-owned"
     else:

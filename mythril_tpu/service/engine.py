@@ -102,6 +102,8 @@ class ServiceConfig:
         specialize: bool = True,
         specialize_warmup: str = "background",
         static_answer: bool = True,
+        store_dir: Optional[str] = None,
+        store: bool = True,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -148,6 +150,15 @@ class ServiceConfig:
         #: process-wide static flags (`--no-static-prune` restores
         #: full-mount parity).
         self.static_answer = static_answer
+        #: cross-run verdict store (mythril_tpu/store, `myth serve
+        #: --store DIR`): repeat submissions — same codehash, same
+        #: analysis-config fingerprint — settle DONE at admission with
+        #: the banked issue set (registry-only admission, no queue
+        #: slot, no wave, no walk), and every completed walk writes
+        #: its verdict back. `--no-store` (store=False) disables the
+        #: tier even with a directory configured.
+        self.store_dir = store_dir
+        self.store = store
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -528,6 +539,15 @@ class AnalysisEngine:
             "submissions settled by the static-answer triage tier "
             "(no device dispatch, no host walk)",
         ).labels(**lab)
+        self._c_store_answered = reg.counter(
+            "mtpu_service_store_answered_total",
+            "submissions settled by the verdict store at admission "
+            "(no queue slot, no wave, no walk)",
+        ).labels(**lab)
+        self._c_store_writebacks = reg.counter(
+            "mtpu_service_store_writebacks_total",
+            "completed walks persisted into the verdict store",
+        ).labels(**lab)
         self._c_wave_kind = reg.counter(
             "mtpu_service_wave_kind_total",
             "waves by kernel kind (specialized vs generic)",
@@ -574,7 +594,8 @@ class AnalysisEngine:
         for child in (
             self._c_waves, self._c_device_steps, self._c_host_completed,
             self._c_rebuckets, self._c_static_seeds,
-            self._c_static_answered, self._c_spec_waves,
+            self._c_static_answered, self._c_store_answered,
+            self._c_store_writebacks, self._c_spec_waves,
             self._c_generic_waves, self._c_fused, self._c_fallbacks,
             self._c_overlapped, self._c_multi_job, self._c_mesh_steals,
             self._c_mesh_rebalance,
@@ -595,6 +616,27 @@ class AnalysisEngine:
         self._last_wave_t: Optional[float] = None
         self._wave_cold_s: Optional[float] = None
         self._wave_warm_ema_s: Optional[float] = None
+        # -- cross-run verdict store (mythril_tpu/store) ---------------
+        # one fingerprint per engine: the service's verdict-relevant
+        # config is fixed at construction, so repeats hash once
+        self.vstore = None
+        self._config_fp: Optional[str] = None
+        if self.cfg.store:
+            try:
+                from mythril_tpu.analysis.static.summary import (
+                    analysis_config_fingerprint,
+                )
+                from mythril_tpu.store import configured_store
+
+                self.vstore = configured_store(self.cfg.store_dir)
+                if self.vstore is not None:
+                    self._config_fp = analysis_config_fingerprint(
+                        transaction_count=self.cfg.transaction_count,
+                        create_timeout=self.cfg.create_timeout,
+                    )
+            except Exception:
+                log.warning("verdict store unavailable", exc_info=True)
+                self.vstore = None
         self._checkpoint_dir: Optional[str] = self.cfg.checkpoint_dir
         self._drained = threading.Event()
         self._draining = False
@@ -669,11 +711,55 @@ class AnalysisEngine:
         return self
 
     def submit(self, job: Job) -> Job:
+        if self._try_store_hit(job):
+            return job
         if self._try_static_answer(job):
             return job
         self.queue.submit(job)  # raises QueueRefusal on backpressure
         self._wake.set()
         return job
+
+    def _try_store_hit(self, job: Job) -> bool:
+        """The verdict-store exact-hit tier at admission (HTTP thread,
+        one hash + one file read warm): a submission whose (codehash,
+        config fingerprint) is banked settles DONE with the stored
+        issue set before it ever reaches the queue — registry-only
+        admission, exactly like the static-answer tier below it. False
+        keeps the job on the full path; QueueRefusal propagates when
+        draining."""
+        from mythril_tpu.store import store_enabled
+
+        if self.vstore is None or not store_enabled():
+            return False
+        try:
+            entry = self.vstore.get(
+                CodeCache.code_hash(job.code), self._config_fp
+            )
+        except Exception:
+            log.debug("store lookup failed; full path", exc_info=True)
+            return False
+        if entry is None:
+            return False
+        self.queue.register(job)  # raises QueueRefusal when draining
+        self._c_store_answered.inc()
+        now = time.monotonic()
+        job.report = {
+            "job_id": job.id,
+            "code_hash": entry.code_hash,
+            "store_hit": True,
+            "issues": entry.issues,
+            "store": {
+                "config_fingerprint": entry.config_fp,
+                "provenance": entry.provenance,
+            },
+            "timings": {
+                "queued_s": 0.0,
+                "device_s": 0.0,
+                "total_s": round(now - job.created_t, 6),
+            },
+        }
+        self.queue.settle(job, JobState.DONE)
+        return True
 
     def _try_static_answer(self, job: Job) -> bool:
         """The static-answer triage tier at admission (runs on the
@@ -1658,6 +1744,44 @@ class AnalysisEngine:
         report["timings"]["total_s"] = round(now - job.created_t, 3)
         job.report = report
         self.queue.settle(job, state)
+        if state == JobState.DONE:
+            self._store_writeback(job, report, outcome)
+
+    def _store_writeback(
+        self, job: Job, report: Dict, outcome: Dict
+    ) -> None:
+        """Tier 3: a job that completed its host walk cleanly (no
+        error, no degradation) banks its verdict + the wave phase's
+        evidence for future admissions. Device-only reports (host walk
+        off) are NOT banked — the store must never serve a weaker
+        verdict than a full analysis would compute."""
+        if self.vstore is None or report.get("host") is None:
+            return
+        if report["host"].get("error") or job.degraded:
+            return
+        try:
+            from mythril_tpu.store import (
+                banks_from_outcome,
+                provenance,
+                static_export,
+            )
+
+            summary = self.code_cache.static_summary(job.code)
+            self.vstore.put(
+                CodeCache.code_hash(job.code),
+                self._config_fp,
+                issues=report.get("issues") or [],
+                static=static_export(summary),
+                banks=banks_from_outcome(outcome),
+                provenance=provenance(
+                    wall_s=report["timings"].get("total_s"),
+                    computed_by=f"service:{self._eid}",
+                ),
+            )
+            self._c_store_writebacks.inc()
+        except Exception:
+            log.debug("store write-back failed for job %s", job.id,
+                      exc_info=True)
 
     # -- drain checkpoints ----------------------------------------------
     def checkpoint_dir(self) -> str:
@@ -1895,6 +2019,26 @@ class AnalysisEngine:
                     for g in self.alloc.occupancy()["groups"]
                 ],
             },
+            "store": dict(
+                (
+                    self.vstore.stats()
+                    if self.vstore is not None
+                    else {
+                        "hits": 0,
+                        "near_hits": 0,
+                        "misses": 0,
+                        "writes": 0,
+                        "bytes": 0,
+                        "evictions": 0,
+                        "corrupt": 0,
+                    }
+                ),
+                enabled=self.vstore is not None,
+                answered=int(sv("mtpu_service_store_answered_total")),
+                writebacks=int(
+                    sv("mtpu_service_store_writebacks_total")
+                ),
+            ),
             "static": {
                 "summaries_cached": self.code_cache.static_summaries,
                 "seeds_dropped": int(

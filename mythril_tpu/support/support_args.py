@@ -91,6 +91,16 @@ class Args(object, metaclass=Singleton):
         # the loss artifact and retried next wave.
         # (CLI --sprint-cap-s, env MYTHRIL_SPRINT_CAP_S.)
         self.sprint_cap_s = _env_float("MYTHRIL_SPRINT_CAP_S", 5.0)
+        # Cross-run verdict store (mythril_tpu/store, CLI --store DIR /
+        # --no-store): a persistent (codehash, config-fingerprint) ->
+        # verdict map. With a directory set, repeat submissions settle
+        # from the store at admission, near-duplicate forks re-analyze
+        # only changed selectors, and every completed full analysis
+        # writes its verdict back. store_dir=None = no persistence;
+        # store=False (--no-store) disables the whole tier even with a
+        # directory configured — the parity-differential baseline.
+        self.store_dir = os.environ.get("MYTHRIL_STORE_DIR") or None
+        self.store = True
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to

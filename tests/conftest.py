@@ -127,6 +127,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "store: cross-run verdict store suite (mythril_tpu/store: "
+        "content-addressed entries + config fingerprints, exact-hit "
+        "settle at corpus/service admission, fingerprint-diff "
+        "incremental re-analysis differential, corrupt-entry refusal, "
+        "concurrent writers, --no-store parity; CPU-only — runs in "
+        "tier-1, selectable with -m store)",
+    )
+    config.addinivalue_line(
+        "markers",
         "taint: taint & value-set static layer suite (attacker-taint "
         "fixpoint goldens, semantic screen soundness sweep over every "
         "module positive fixture, static-answer triage differential, "

@@ -1,0 +1,143 @@
+"""The verdict-store exact-hit tier at `myth serve` admission.
+
+Engine-less servers throughout (start_engine=False): the hit path
+runs on the HTTP thread inside `AnalysisEngine.submit`, so a job that
+settles here PROVABLY paid zero queue slots and zero explorer waves —
+the wave thread does not exist. CPU-only, sub-second."""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import fork_contract
+from mythril_tpu.analysis.static import analysis_config_fingerprint
+from mythril_tpu.service.client import ServiceClient, ServiceError
+from mythril_tpu.service.engine import ServiceConfig
+from mythril_tpu.service.server import AnalysisServer
+from mythril_tpu.store import close_stores, code_hash_hex, open_store
+
+pytestmark = [pytest.mark.service, pytest.mark.store]
+
+BANKED = fork_contract(7, 0)
+#: CALLER; SELFDESTRUCT — never banked, never statically answerable
+UNSEEN = "33ff"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    steps_per_wave=64,
+    queue_capacity=4,
+    host_walk=False,
+)
+
+ISSUES = [
+    {
+        "address": 43,
+        "swc-id": "110",
+        "title": "Banked issue",
+        "contract": "banked",
+        "function": "_function_0xf0cacc21",
+        "description": "d",
+        "severity": "Medium",
+        "min_gas_used": 0,
+        "max_gas_used": 1,
+        "sourceMap": None,
+        "tx_sequence": None,
+    }
+]
+
+
+@pytest.fixture()
+def store_dir(tmp_path):
+    """A store pre-seeded with BANKED's verdict under the fingerprint
+    the engine will compute for this ServiceConfig."""
+    directory = str(tmp_path / "vstore")
+    cfg = ServiceConfig(**CFG)
+    fingerprint = analysis_config_fingerprint(
+        transaction_count=cfg.transaction_count,
+        create_timeout=cfg.create_timeout,
+    )
+    open_store(directory).put(
+        code_hash_hex(BANKED),
+        fingerprint,
+        issues=ISSUES,
+        provenance={"computed_by": "test-seeder", "wall_s": 12.0},
+    )
+    yield directory
+    close_stores()
+
+
+@pytest.fixture()
+def server(store_dir):
+    srv = AnalysisServer(
+        ServiceConfig(store_dir=store_dir, **CFG), start_engine=False
+    ).start()
+    yield srv
+    srv.close()
+
+
+def test_repeat_submission_settles_at_admission(server):
+    client = ServiceClient(server.url)
+    job_id = client.submit(BANKED)
+    job = client.job(job_id)
+    # already terminal: no wave thread even exists on this server
+    assert job["state"] == "done"
+    report = job["report"]
+    assert report["store_hit"] is True
+    assert report["issues"] == ISSUES
+    assert report["store"]["provenance"]["computed_by"] == "test-seeder"
+    assert "device" not in report  # no wave block — none ever ran
+    stats = client.stats()
+    assert stats["store"]["enabled"] is True
+    assert stats["store"]["answered"] == 1
+    assert stats["store"]["hits"] == 1
+    assert stats["waves"]["count"] == 0
+    assert stats["queue"]["jobs"].get("done") == 1
+
+
+def test_unseen_code_queues_normally(server):
+    client = ServiceClient(server.url)
+    job_id = client.submit(UNSEEN)
+    assert client.job(job_id)["state"] == "queued"
+    stats = client.stats()
+    assert stats["store"]["answered"] == 0
+    assert stats["store"]["misses"] >= 1
+
+
+def test_hit_skips_full_queue_backpressure(server):
+    """Store hits never occupy a queue slot, so repeats keep settling
+    even when the pending queue is FULL — exactly the static-answer
+    tier's admission contract."""
+    client = ServiceClient(server.url)
+    for _ in range(CFG["queue_capacity"]):
+        client.submit(UNSEEN)
+    with pytest.raises(ServiceError):
+        client.submit(UNSEEN)  # 429: the queue is full
+    job_id = client.submit(BANKED)
+    assert client.job(job_id)["state"] == "done"
+
+
+def test_no_store_config_disables_tier(store_dir):
+    srv = AnalysisServer(
+        ServiceConfig(store_dir=store_dir, store=False, **CFG),
+        start_engine=False,
+    ).start()
+    try:
+        client = ServiceClient(srv.url)
+        job_id = client.submit(BANKED)
+        assert client.job(job_id)["state"] == "queued"
+        stats = client.stats()
+        assert stats["store"]["enabled"] is False
+        assert stats["store"]["answered"] == 0
+    finally:
+        srv.close()
+
+
+def test_draining_refuses_store_hits(store_dir):
+    srv = AnalysisServer(
+        ServiceConfig(store_dir=store_dir, **CFG), start_engine=False
+    ).start()
+    client = ServiceClient(srv.url)
+    srv.engine.drain(timeout_s=5.0)
+    with pytest.raises(ServiceError):
+        client.submit(BANKED)  # 503: draining

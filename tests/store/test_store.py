@@ -1,0 +1,275 @@
+"""Verdict store unit suite: entry lifecycle, key discipline,
+corruption refusal, concurrency, eviction, and the incremental diff's
+plan/bail logic. Pure host work — no jax, no device."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import fork_contract
+from mythril_tpu.analysis.static import (
+    analysis_config_fingerprint,
+    clear_static_cache,
+    summary_for,
+)
+from mythril_tpu.laser.batch.seeds import dispatcher_seeds
+from mythril_tpu.store import (
+    IncrementalBail,
+    SelectorMaskFeed,
+    VerdictStore,
+    close_stores,
+    code_hash_hex,
+    merge_banked_issues,
+    plan_incremental,
+    static_export,
+)
+
+pytestmark = pytest.mark.store
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store_cache():
+    yield
+    close_stores()
+
+
+def _issue(address: int, swc: str = "110") -> dict:
+    return {
+        "address": address,
+        "swc-id": swc,
+        "title": "Test issue",
+        "contract": "t",
+        "function": "f",
+        "description": "d",
+        "severity": "Medium",
+        "min_gas_used": 0,
+        "max_gas_used": 1,
+        "sourceMap": None,
+        "tx_sequence": None,
+    }
+
+
+def _store(tmp_path, **kw) -> VerdictStore:
+    return VerdictStore(str(tmp_path / "vstore"), **kw)
+
+
+BASE = fork_contract(0, 0)
+FORK = fork_contract(0, 1)
+FP = "a" * 16
+
+
+def test_put_get_roundtrip(tmp_path):
+    store = _store(tmp_path)
+    key = code_hash_hex(BASE)
+    summary = summary_for(BASE)
+    path = store.put(
+        key, FP, issues=[_issue(43)], static=static_export(summary),
+        provenance={"computed_by": "test", "wall_s": 1.5},
+    )
+    assert path and os.path.exists(path)
+    entry = store.get(key, FP)
+    assert entry is not None
+    assert entry.issues == [_issue(43)]
+    assert entry.fingerprints == summary.function_fingerprints
+    assert entry.provenance["computed_by"] == "test"
+    assert entry.code_len == summary.code_len
+    assert store.stats()["hits"] == 1
+    # a reopened store (fresh process) finds the same entry
+    close_stores()
+    reopened = VerdictStore(store.dir)
+    assert reopened.get(key, FP) is not None
+
+
+def test_miss_is_counted(tmp_path):
+    store = _store(tmp_path)
+    assert store.get("00" * 32, FP) is None
+    assert store.stats()["misses"] == 1
+
+
+def test_config_fingerprint_distinguishes_module_sets(tmp_path):
+    """The satellite regression: same code, different module set ->
+    DISTINCT verdicts, in both the persistent store and the in-memory
+    summary LRU."""
+    fp_all = analysis_config_fingerprint(modules=None)
+    fp_one = analysis_config_fingerprint(modules=["TxOrigin"])
+    assert fp_all != fp_one
+    store = _store(tmp_path)
+    key = code_hash_hex(BASE)
+    store.put(key, fp_all, issues=[_issue(43)])
+    # the all-modules verdict must NOT answer a restricted-modules run
+    assert store.get(key, fp_one) is None
+    assert store.get(key, fp_all) is not None
+    # the summary LRU keys the same way: no cross-config aliasing
+    clear_static_cache()
+    s_all = summary_for(BASE, config_fp=fp_all)
+    s_one = summary_for(BASE, config_fp=fp_one)
+    assert s_all is not s_one
+    assert summary_for(BASE, config_fp=fp_all) is s_all
+
+
+def test_config_fingerprint_covers_tx_count_and_version():
+    assert analysis_config_fingerprint(
+        transaction_count=1
+    ) != analysis_config_fingerprint(transaction_count=2)
+    assert analysis_config_fingerprint(
+        solver_timeout=1
+    ) != analysis_config_fingerprint(solver_timeout=2)
+
+
+def test_corrupt_entry_refused(tmp_path):
+    store = _store(tmp_path)
+    key = code_hash_hex(BASE)
+    path = store.put(key, FP, issues=[_issue(43)])
+    with open(path, "w") as fp:
+        fp.write("{not json")
+    close_stores()
+    fresh = VerdictStore(store.dir)
+    base_corrupt = fresh.corrupt  # the open-time scan refuses it too
+    assert fresh.get(key, FP) is None
+    assert fresh.corrupt > 0 and fresh.corrupt >= base_corrupt
+    assert fresh.stats()["misses"] >= 1
+
+
+def test_tampered_payload_refused(tmp_path):
+    store = _store(tmp_path)
+    key = code_hash_hex(BASE)
+    path = store.put(key, FP, issues=[_issue(43)])
+    with open(path) as fp:
+        data = json.load(fp)
+    data["issues"] = []  # verdict swapped, checksum now stale
+    with open(path, "w") as fp:
+        json.dump(data, fp)
+    assert store.get(key, FP) is None
+    assert store.corrupt >= 1
+
+
+def test_mismatched_key_refused(tmp_path):
+    """An entry moved to another key's filename (sync glitch, tamper)
+    must never be served under the wrong key."""
+    store = _store(tmp_path)
+    key_a, key_b = code_hash_hex(BASE), code_hash_hex(FORK)
+    path_a = store.put(key_a, FP, issues=[_issue(43)])
+    path_b = store.put(key_b, FP, issues=[_issue(56)])
+    # overwrite B's file with A's bytes: internally-consistent entry,
+    # wrong address
+    with open(path_a) as fp:
+        blob = fp.read()
+    with open(path_b, "w") as fp:
+        fp.write(blob)
+    assert store.get(key_b, FP) is None
+    assert store.corrupt >= 1
+
+
+def test_concurrent_writers_never_corrupt(tmp_path):
+    store = _store(tmp_path)
+    errors = []
+
+    def writer(k: int) -> None:
+        try:
+            for i in range(8):
+                # half the threads fight over ONE key, half write
+                # distinct keys
+                key = code_hash_hex(f"{'00' if k % 2 else '11'}")
+                store.put(
+                    key, FP, issues=[_issue(i)],
+                    provenance={"writer": k, "round": i},
+                )
+        except Exception as why:  # pragma: no cover
+            errors.append(why)
+
+    threads = [
+        threading.Thread(target=writer, args=(k,)) for k in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    close_stores()
+    fresh = VerdictStore(store.dir)
+    assert fresh.corrupt == 0  # every surviving entry verifies
+    assert fresh.get(code_hash_hex("00"), FP) is not None
+    assert fresh.get(code_hash_hex("11"), FP) is not None
+
+
+def test_eviction_bounds_entries(tmp_path):
+    store = _store(tmp_path, capacity=2)
+    for i in range(5):
+        store.put(code_hash_hex(f"{i:02x}"), FP, issues=[])
+    assert len(store) <= 2
+    assert store.stats()["evictions"] >= 3
+
+
+# -- the incremental diff ------------------------------------------------
+def _entry_for(store, code_hex: str, issues) -> object:
+    key = code_hash_hex(code_hex)
+    store.put(
+        key, FP, issues=issues, static=static_export(summary_for(code_hex))
+    )
+    return store.get(key, FP)
+
+
+def test_plan_masks_only_unchanged_selector(tmp_path):
+    store = _store(tmp_path)
+    entry = _entry_for(store, BASE, [_issue(43), _issue(56)])
+    plan = plan_incremental(summary_for(FORK), entry)
+    assert plan.changed == {"0xf0cacc1a"}
+    assert plan.unchanged == {"0xba5eba11"}
+    assert plan.mask_selectors == {bytes.fromhex("ba5eba11")}
+    # the banked issue is fn B's (56); fn A's (43) is the fresh
+    # analysis's job
+    assert [i["address"] for i in plan.banked_issues] == [56]
+    # and the mask feed actually drops fn B's dispatcher seeds
+    feed = plan.mask_feed(summary_for(FORK))
+    seeds = dispatcher_seeds(FORK, 68, prune=feed)
+    assert feed.seeds_dropped == 2
+    assert not any(s.startswith(bytes.fromhex("ba5eba11")) for s in seeds)
+    assert any(s.startswith(bytes.fromhex("f0cacc1a")) for s in seeds)
+
+
+def test_plan_bails_without_fingerprints(tmp_path):
+    store = _store(tmp_path)
+    key = code_hash_hex(BASE)
+    store.put(key, FP, issues=[_issue(43)])  # no static export
+    entry = store.get(key, FP)
+    with pytest.raises(IncrementalBail) as raised:
+        plan_incremental(summary_for(FORK), entry)
+    assert raised.value.reason == "fingerprints-absent"
+
+
+def test_plan_bails_on_cross_selector_state_flow(tmp_path):
+    """fn B patched to SLOAD: a changed fn A (SSTORE) can now alter
+    what unchanged fn B observes, so the banked fn-B verdict could be
+    stale — the plan must refuse."""
+    patch = bytes.fromhex("600435")  # CALLDATALOAD(4) in fn B...
+    sload = bytes.fromhex("600054")  # ...becomes PUSH1 0; SLOAD
+    base = bytes.fromhex(fork_contract(3, 0))
+    fork = bytes.fromhex(fork_contract(3, 1))
+    fn_b = 44
+    assert base[fn_b + 1 : fn_b + 4] == patch
+    base = base[: fn_b + 1] + sload + base[fn_b + 4 :]
+    fork = fork[: fn_b + 1] + sload + fork[fn_b + 4 :]
+    store = _store(tmp_path)
+    entry = _entry_for(store, base.hex(), [_issue(43)])
+    with pytest.raises(IncrementalBail) as raised:
+        plan_incremental(summary_for(fork.hex()), entry)
+    assert raised.value.reason == "cross-selector-state-flow"
+
+
+def test_merge_banked_issues_dedupes():
+    issues = [_issue(56)]
+    added = merge_banked_issues(issues, [_issue(56), _issue(99)])
+    assert added == 1
+    assert [i["address"] for i in issues] == [56, 99]
+
+
+def test_mask_feed_delegates(tmp_path):
+    summary = summary_for(BASE)
+    feed = SelectorMaskFeed(summary, set(), set())
+    assert feed.features == summary.features
+    assert feed.code_hash == summary.code_hash
+    assert feed.prune_directions() == summary.prune_directions()

@@ -1,0 +1,154 @@
+"""The verdict store's corpus tiers, end to end and host-only:
+exact-hit settle, incremental-vs-full issue differential on a fork
+corpus, write-back, and --no-store parity. CPU-only, no device — the
+walk is the verdict source, which makes the differential exact."""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.analysis.corpus import analyze_corpus
+from mythril_tpu.analysis.corpusgen import fork_contract
+from mythril_tpu.store import close_stores, open_store
+
+pytestmark = pytest.mark.store
+
+BASE = fork_contract(0, 0)
+FORK = fork_contract(0, 1)
+
+KW = dict(execution_timeout=8, processes=1, use_device=False)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store_cache():
+    yield
+    close_stores()
+
+
+def _issue_set(result):
+    return sorted(
+        (i.get("address"), i.get("swc-id")) for i in result["issues"]
+    )
+
+
+@pytest.fixture(scope="module")
+def cold_runs():
+    """Cold full-analysis baselines, computed once: the base contract
+    and the fork, each with NO store in play."""
+    base = analyze_corpus([(BASE, "", "base")], store=False, **KW)[0]
+    fork = analyze_corpus([(FORK, "", "fork")], store=False, **KW)[0]
+    assert base["complete"] and fork["complete"]
+    assert base["issues"] and fork["issues"]
+    return base, fork
+
+
+def test_exact_hit_and_incremental_differential(tmp_path, cold_runs):
+    cold_base, cold_fork = cold_runs
+    store_dir = str(tmp_path / "vstore")
+    # cold leg: full analysis + write-back
+    first = analyze_corpus(
+        [(BASE, "", "base")], store_dir=store_dir, **KW
+    )[0]
+    assert not first.get("store_hit")
+    assert _issue_set(first) == _issue_set(cold_base)
+    assert len(open_store(store_dir)) == 1
+    # warm leg: the duplicate settles at admission, the one-selector
+    # fork re-analyzes incrementally
+    warm = analyze_corpus(
+        [(BASE, "", "base#dupe"), (FORK, "", "fork")],
+        store_dir=store_dir,
+        **KW,
+    )
+    dupe, fork = warm
+    assert dupe["store_hit"] is True
+    assert dupe["states"] == 0  # no walk, no explorer
+    assert _issue_set(dupe) == _issue_set(cold_base)
+    assert fork["store_incremental"] is True
+    assert fork["store"]["changed_selectors"] == ["0xf0cacc1a"]
+    assert fork["store"]["unchanged_selectors"] == ["0xba5eba11"]
+    # THE acceptance differential: incremental issue set == a cold
+    # full run of the fork
+    assert _issue_set(fork) == _issue_set(cold_fork)
+    # routing sees the cache economics
+    from mythril_tpu.observe.routing import outcome_for
+
+    assert outcome_for(dupe)["route"] == "store-hit"
+    assert outcome_for(fork)["route"] == "store-incremental"
+
+
+def test_no_store_parity(tmp_path, cold_runs):
+    """--no-store: identical issue sets, no store flags, nothing
+    written — the parity baseline for a suspected stale verdict."""
+    cold_base, _ = cold_runs
+    store_dir = str(tmp_path / "vstore")
+    analyze_corpus([(BASE, "", "base")], store_dir=store_dir, **KW)
+    repeat = analyze_corpus(
+        [(BASE, "", "base")], store_dir=store_dir, store=False, **KW
+    )[0]
+    assert not repeat.get("store_hit")
+    assert not repeat.get("store_incremental")
+    assert _issue_set(repeat) == _issue_set(cold_base)
+    # the flag-bag switch is honored too (CLI --no-store path)
+    from mythril_tpu.support.support_args import args as support_args
+
+    previous = support_args.store
+    support_args.store = False
+    try:
+        flagged = analyze_corpus(
+            [(BASE, "", "base")], store_dir=store_dir, **KW
+        )[0]
+    finally:
+        support_args.store = previous
+    assert not flagged.get("store_hit")
+    assert _issue_set(flagged) == _issue_set(cold_base)
+
+
+def test_incremental_bail_falls_back_to_full(tmp_path, cold_runs):
+    """A store whose entry lacks fingerprints cannot diff — the fork
+    must silently take the full path with the same issues."""
+    _, cold_fork = cold_runs
+    store_dir = str(tmp_path / "vstore")
+    from mythril_tpu.analysis.static import (
+        analysis_config_fingerprint,
+        summary_for,
+    )
+    from mythril_tpu.store import code_hash_hex
+
+    store = open_store(store_dir)
+    # the fingerprint the corpus run will compute (its defaults)
+    config_fp = analysis_config_fingerprint(
+        transaction_count=2, create_timeout=10
+    )
+    # an entry WITH fingerprints (so the near-duplicate probe finds
+    # it) but WITHOUT selector spans: plan_incremental must bail and
+    # the fork must take the full path
+    store.put(
+        code_hash_hex(BASE),
+        config_fp,
+        issues=[{"address": 1, "swc-id": "110"}],
+        static={
+            "code_len": 57,
+            "function_fingerprints": dict(
+                summary_for(BASE).function_fingerprints
+            ),
+        },
+    )
+    result = analyze_corpus(
+        [(FORK, "", "fork")], store_dir=store_dir, **KW
+    )[0]
+    assert not result.get("store_incremental")
+    assert _issue_set(result) == _issue_set(cold_fork)
+
+
+def test_writeback_skips_incomplete(tmp_path):
+    """A deadline-skipped contract must never bank a (partial)
+    verdict."""
+    store_dir = str(tmp_path / "vstore")
+    results = analyze_corpus(
+        [(BASE, "", "base")],
+        store_dir=store_dir,
+        deadline_s=0.000001,  # expired before the first contract
+        **KW,
+    )
+    assert results[0].get("skipped")
+    assert len(open_store(store_dir)) == 0
