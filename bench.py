@@ -912,7 +912,29 @@ def _emit(record: dict, stage: str) -> None:
     line supersedes earlier ones."""
     record["bench_emit"] = stage
     record["bench_wall_s"] = round(time.monotonic() - _BENCH_T0, 1)
+    _device_saturation_fields(record)
     print(json.dumps(record), flush=True)
+
+
+def _device_saturation_fields(record: dict) -> None:
+    """The devicemon sample at emit time (ISSUE 12): how full the
+    hardware was when this record closed — device memory where the
+    backend reports it, process RSS everywhere, cumulative wave
+    overlap/idle fractions."""
+    try:
+        from mythril_tpu import observe
+
+        sample = observe.device_monitor().sample()
+    except Exception as e:
+        print(f"bench: device sample failed: {e!r}", file=sys.stderr)
+        return
+    record["device_host_rss_bytes"] = sample.get("host_rss_bytes")
+    record["device_mem_bytes_in_use"] = sum(
+        row.get("bytes_in_use") or 0
+        for row in (sample.get("memory") or {}).values()
+    ) or None
+    record["device_wave_overlap_frac"] = sample.get("wave_overlap_frac")
+    record["device_idle_frac"] = sample.get("idle_frac")
 
 
 #: run-scoped markers for the solver flight-recorder fields: every

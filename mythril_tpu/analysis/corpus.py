@@ -1569,6 +1569,14 @@ def analyze_corpus(
                 pool.terminate()
     if prepass:
         _merge_prepass_witnesses(results, contracts, prepass, address)
+    try:
+        # one saturation sample at the run boundary: batch runs get
+        # the same mtpu_device_* gauges the serve sampler keeps live
+        from mythril_tpu import observe as _observe
+
+        _observe.device_monitor().sample()
+    except Exception:
+        log.debug("device monitor sample failed", exc_info=True)
     skipped = 0
     for result in results:
         if result is None:
@@ -1621,11 +1629,31 @@ def _emit_routing_records(
                 ).hexdigest()
             except ValueError:
                 digest = ""
+            outcome = observe.routing_outcome_for(result)
+            # every record gets a journey skeleton: corpus analyses
+            # have no HTTP job id, so the id is minted here and the
+            # route lands as the timeline's middle tier — the same
+            # features ⨝ route ⨝ outcome ⨝ timeline join key the
+            # service emits (observe/journey.py)
+            journey_id = observe.new_journey_id()
+            observe.journey_event(
+                journey_id, "admission", "corpus", contract=name,
+            )
+            observe.journey_event(
+                journey_id, outcome.get("route", "?"), "routed",
+                wall_s=outcome.get("wall_s"),
+            )
+            observe.journey_event(
+                journey_id, "settle",
+                "done" if not outcome.get("error") else "failed",
+                issues=outcome.get("issues"),
+            )
             observe.routing_log().record(
                 contract=name,
                 code_hash=digest,
                 features=observe.routing_features_for(code_norm),
-                outcome=observe.routing_outcome_for(result),
+                outcome=outcome,
+                journey_id=journey_id,
             )
         except Exception:
             log.debug("routing record failed for %s", name, exc_info=True)

@@ -61,6 +61,7 @@ COMMAND_LIST = (
         "serve",
         "submit",
         "solverlab",
+        "observe",
         "version",
         "truffle",
         "help",
@@ -947,6 +948,100 @@ def build_parser() -> ArgumentParser:
             "no write-back) even when a directory is configured"
         ),
     )
+    serve.add_argument(
+        "--no-arena-warmup",
+        action="store_true",
+        help=(
+            "skip the background arena warmup compile at startup: "
+            "the service reports ready immediately and the FIRST "
+            "request pays the kernel compile (default: warm up off "
+            "the serving path; /healthz readiness reports "
+            "arena-warming until the compile lands)"
+        ),
+    )
+    serve.add_argument(
+        "--health-interval",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help=(
+            "cadence of the health/device sampler thread (SLO burn "
+            "rates, mtpu_health_state, mtpu_device_* gauges)"
+        ),
+    )
+
+    observe_cmd = subparsers.add_parser(
+        "observe",
+        help=(
+            "Operator tooling over the telemetry layer: a live "
+            "terminal view of a running service (top), a static "
+            "digest from metrics/routing/journey artifacts (report), "
+            "and a bench-record trajectory/regression differ "
+            "(compare)"
+        ),
+    )
+    observe_cmd.add_argument(
+        "observe_mode",
+        choices=["top", "report", "compare"],
+        metavar="MODE",
+        help="top | report | compare",
+    )
+    observe_cmd.add_argument(
+        "records",
+        nargs="*",
+        metavar="BENCH.json",
+        help="compare: two or more BENCH_r*.json records, oldest first",
+    )
+    observe_cmd.add_argument(
+        "--url",
+        default="http://127.0.0.1:7341",
+        help="running `myth serve` base URL (top, report)",
+    )
+    observe_cmd.add_argument(
+        "--interval", type=float, default=2.0,
+        help="top: seconds between refreshes",
+    )
+    observe_cmd.add_argument(
+        "--count", type=int, default=0,
+        help="top: frames to render before exiting (0 = until ^C)",
+    )
+    observe_cmd.add_argument(
+        "--metrics", default=None, metavar="FILE",
+        help="report: a saved /metrics snapshot instead of a live URL",
+    )
+    observe_cmd.add_argument(
+        "--routing", default=None, metavar="FILE",
+        help="report: a routing_features.jsonl to fold in",
+    )
+    observe_cmd.add_argument(
+        "--format",
+        choices=["markdown", "html"],
+        default="markdown",
+        dest="report_format",
+        help="report: output format",
+    )
+    observe_cmd.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="report: write to FILE instead of stdout",
+    )
+    observe_cmd.add_argument(
+        "--fail-on-regression",
+        action="store_true",
+        help=(
+            "compare: exit nonzero when a stable field moves the "
+            "wrong way past its threshold between adjacent records"
+        ),
+    )
+    observe_cmd.add_argument(
+        "--threshold-scale", type=float, default=1.0,
+        help=(
+            "compare: multiply every stable field's regression "
+            "threshold (loosen or tighten the gate)"
+        ),
+    )
+    observe_cmd.add_argument(
+        "--json", action="store_true", help="emit machine-readable JSON"
+    )
 
     solverlab = subparsers.add_parser(
         "solverlab",
@@ -1596,8 +1691,103 @@ def _cmd_serve(args: Namespace) -> None:
             args.store or os.environ.get("MYTHRIL_STORE_DIR") or None
         ),
         store=not args.no_store,
+        arena_warmup=not args.no_arena_warmup,
+        health_interval_s=args.health_interval,
     )
     serve_forever(config, host=args.host, port=args.port)
+    sys.exit()
+
+
+def _cmd_observe(args: Namespace) -> None:
+    """`myth observe top|report|compare`: operator tooling over the
+    telemetry layer (observe/opstool.py holds the logic)."""
+    import time as _time
+    import urllib.request
+
+    from mythril_tpu.observe import opstool
+
+    def _fetch(path: str, parse_json: bool):
+        with urllib.request.urlopen(args.url.rstrip("/") + path,
+                                    timeout=10.0) as response:
+            body = response.read().decode()
+        return json.loads(body) if parse_json else body
+
+    if args.observe_mode == "top":
+        frames = 0
+        try:
+            while True:
+                stats = _fetch("/stats", True)
+                metrics = opstool.parse_prometheus(_fetch("/metrics", False))
+                frame = opstool.render_top(stats, metrics)
+                if args.json:
+                    print(json.dumps({"stats": stats}, sort_keys=True))
+                else:
+                    print("\033[2J\033[H" + frame, flush=True)
+                frames += 1
+                if args.count and frames >= args.count:
+                    break
+                _time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            pass
+        except OSError as why:
+            log.error("observe top: %s unreachable: %s", args.url, why)
+            sys.exit(1)
+        sys.exit()
+
+    if args.observe_mode == "report":
+        metrics = stats = None
+        routing_records = journeys = None
+        try:
+            if args.metrics:
+                with open(args.metrics) as fp:
+                    metrics = opstool.parse_prometheus(fp.read())
+            else:
+                metrics = opstool.parse_prometheus(_fetch("/metrics", False))
+                stats = _fetch("/stats", True)
+        except OSError as why:
+            log.error("observe report: no metrics source: %s", why)
+            sys.exit(1)
+        if args.routing:
+            from mythril_tpu.observe.routing import read_records
+
+            try:
+                routing_records = read_records(args.routing)
+            except OSError as why:
+                log.error("observe report: %s", why)
+                sys.exit(1)
+        body = opstool.render_report(
+            metrics=metrics,
+            routing_records=routing_records,
+            journeys=journeys,
+            stats=stats,
+            fmt=args.report_format,
+        )
+        if args.out:
+            with open(args.out, "w") as fp:
+                fp.write(body)
+            print(f"observe report written to {args.out}")
+        else:
+            print(body)
+        sys.exit()
+
+    # compare
+    if len(args.records) < 2:
+        log.error("observe compare wants two or more BENCH_r*.json records")
+        sys.exit(2)
+    try:
+        records = [opstool.load_bench_record(p) for p in args.records]
+    except (OSError, ValueError) as why:
+        log.error("observe compare: %s", why)
+        sys.exit(2)
+    result = opstool.compare_records(
+        records, threshold_scale=args.threshold_scale
+    )
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        print(opstool.render_compare(result))
+    if args.fail_on_regression and result["regressions"]:
+        sys.exit(1)
     sys.exit()
 
 
@@ -1710,6 +1900,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_submit(args)
     if args.command == "solverlab":
         _cmd_solverlab(args)
+    if args.command == "observe":
+        _cmd_observe(args)
     if args.command == "help":
         parser.print_help()
         sys.exit()

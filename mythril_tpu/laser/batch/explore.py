@@ -347,6 +347,15 @@ def publish_explore_stats(stats: Dict) -> None:
                 f"mtpu_explore_{key}_max",
                 f"ExploreStats.{key}, process high-water mark",
             ).set_max(value)
+        elif key in ("wave_overlap_ratio", "device_idle_frac"):
+            # derived ratios promoted to LIVE gauges (last run wins —
+            # a ratio has no meaningful sum): the devicemon sampler
+            # additionally recomputes the cumulative view from the
+            # summed inputs as mtpu_device_{wave_overlap,idle}_frac
+            reg.gauge(
+                f"mtpu_explore_{key}",
+                f"ExploreStats.{key}, most recent exploration",
+            ).set(value)
 
 
 def required_calldata_len(

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 log = logging.getLogger(__name__)
 
 _MARGIN = None
+_MARGIN_REG = None
 
 
 def _margin_histogram():
@@ -42,14 +43,19 @@ def _margin_histogram():
     already sitting unpolled when the host answered). The near-miss
     histogram is the tuning signal for the funnel's escalation grace
     window (PORTFOLIO_DEFAULTS["race_grace_ms"])."""
-    global _MARGIN
-    if _MARGIN is None:
-        from mythril_tpu.observe.registry import registry
+    global _MARGIN, _MARGIN_REG
+    from mythril_tpu.observe.registry import (
+        SOLVER_WALL_BUCKETS,
+        registry,
+    )
 
+    if _MARGIN is None or _MARGIN_REG is not registry():
+        _MARGIN_REG = registry()
         _MARGIN = registry().histogram(
             "mtpu_solver_race_margin_seconds",
             "device-race witness arrival relative to the host's answer "
             "(seconds late; 0 = ready but unpolled)",
+            buckets=SOLVER_WALL_BUCKETS,
         )
     return _MARGIN
 

@@ -238,6 +238,7 @@ class MythrilAnalyzer:
         if self.capture_queries:
             observe.configure_capture(self.capture_queries)
         solver_marker = observe.solver_marker()
+        self._journey_ids: List = []
         if self.deadline is not None:
             resilience.set_run_deadline(self.deadline)
         pre = self._corpus_prepass(transaction_count)
@@ -288,6 +289,18 @@ class MythrilAnalyzer:
             report.meta["captured_queries"] = observe.captured_total(
                 since=solver_marker
             )
+        # per-contract tier-ladder journeys (observe/journey.py): the
+        # jsonv2 meta carries each contract's timeline skeleton, keyed
+        # by the journey_id the routing JSONL also carries — the
+        # offline features ⨝ route ⨝ outcome ⨝ timeline join
+        journeys = []
+        for name, journey_id in self._journey_ids:
+            doc = observe.assemble_journey(journey_id)
+            if doc is not None:
+                doc["contract"] = name
+                journeys.append(doc)
+        if journeys:
+            report.meta["journeys"] = journeys
         reasons = resilience.DegradationLog().counts_since(degradation_marker)
         partial = any(not status["complete"] for status in completion)
         if reasons or partial:
@@ -402,10 +415,12 @@ class MythrilAnalyzer:
                     _time.perf_counter() - t_contract,
                     modules, transaction_count,
                 )
-            self._routing_record(
+            journey_id = self._routing_record(
                 contract, issues, crashed,
                 _time.perf_counter() - t_contract,
             )
+            if journey_id is not None:
+                self._journey_ids.append((contract.name, journey_id))
             log.info("Solver statistics: \n%s", str(SolverStatistics()))
             from mythril_tpu.support.phase_profile import PhaseProfile
 
@@ -478,14 +493,16 @@ class MythrilAnalyzer:
     @staticmethod
     def _routing_record(
         contract, issues: List[Issue], crashed: bool, wall_s: float
-    ) -> None:
+    ) -> Optional[str]:
         """One routing-feature record per analyzed contract on the CLI
         path (the corpus driver emits its own): static features joined
-        with the walk's wall/issue outcome (observe/routing.py)."""
+        with the walk's wall/issue outcome (observe/routing.py), keyed
+        by a freshly minted journey_id whose skeleton timeline also
+        lands in the journey log — the jsonv2 meta attaches it."""
         from mythril_tpu import observe
 
         if not observe.enabled():
-            return
+            return None
         try:
             import hashlib
 
@@ -497,22 +514,40 @@ class MythrilAnalyzer:
                 digest = hashlib.sha256(bytes.fromhex(code)).hexdigest()
             except ValueError:
                 digest = ""
+            outcome = observe.routing_outcome_for(
+                {
+                    "name": contract.name,
+                    "issues": [None] * len(issues),
+                    "wall_s": round(wall_s, 3),
+                    "error": "crash" if crashed else None,
+                    "complete": not crashed,
+                }
+            )
+            journey_id = observe.new_journey_id()
+            observe.journey_event(
+                journey_id, "admission", "analyze",
+                contract=contract.name,
+            )
+            observe.journey_event(
+                journey_id, outcome.get("route", "?"), "routed",
+                wall_s=outcome.get("wall_s"),
+            )
+            observe.journey_event(
+                journey_id, "settle",
+                "failed" if crashed else "done",
+                issues=len(issues),
+            )
             observe.routing_log().record(
                 contract=contract.name,
                 code_hash=digest,
                 features=observe.routing_features_for(code),
-                outcome=observe.routing_outcome_for(
-                    {
-                        "name": contract.name,
-                        "issues": [None] * len(issues),
-                        "wall_s": round(wall_s, 3),
-                        "error": "crash" if crashed else None,
-                        "complete": not crashed,
-                    }
-                ),
+                outcome=outcome,
+                journey_id=journey_id,
             )
+            return journey_id
         except Exception:
             log.debug("routing record failed", exc_info=True)
+        return None
 
     def _merge_prepass_issues(
         self, final: dict, collected: List[Issue]

@@ -43,11 +43,31 @@ from mythril_tpu.observe.querylog import (  # noqa: F401
     query_context,
     record_loss,
 )
+from mythril_tpu.observe.devicemon import (  # noqa: F401
+    DeviceMonitor,
+    device_monitor,
+)
+from mythril_tpu.observe.journey import (  # noqa: F401
+    JourneyLog,
+    assemble as assemble_journey,
+    journey_event,
+    journey_log,
+    new_journey_id,
+    tier_sequence,
+)
 from mythril_tpu.observe.registry import (  # noqa: F401 (public API)
+    LATENCY_BUCKETS,
     SCHEMA_VERSION,
+    SOLVER_WALL_BUCKETS,
     MetricsRegistry,
     registry,
     reset_registry,
+)
+from mythril_tpu.observe.slo import (  # noqa: F401
+    HealthMonitor,
+    Objective,
+    SloEngine,
+    default_objectives,
 )
 from mythril_tpu.observe.routing import (  # noqa: F401
     features_for as routing_features_for,

@@ -1,0 +1,443 @@
+"""`myth observe` operator tooling: the live top view, the static
+digest report, and the bench-record trajectory/regression differ.
+
+Three subcommands, all built on pure functions this module exposes so
+the tests drive them without a terminal or an HTTP server:
+
+- **top** — poll a running replica's ``/stats`` + ``/metrics`` and
+  render a one-screen operator view: health state, queue/arena
+  saturation, wave throughput, tier mix, solver funnel, device
+  gauges.
+- **report** — a markdown/HTML digest from a metrics snapshot (file
+  or live scrape), the routing JSONL tail, and recent journeys: what
+  the replica spent its life doing, for a postmortem or a capacity
+  review.
+- **compare** — diff BENCH_r* records into a trajectory table over
+  the fields marked STABLE (backend-independent ratios and rates);
+  ``--fail-on-regression`` exits nonzero when a stable field moves
+  the wrong way past its threshold. Cross-backend fields
+  (``device_verdict_share``, raw step rates, absolute walls) are
+  carried in the table but never gated — the r05-vs-r06 CPU/TPU swap
+  is the canonical counterexample.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Tuple
+
+#: (field, direction, relative threshold) rows gated by
+#: `--fail-on-regression`. Direction "higher" fails when the newer
+#: value drops more than threshold below the older; "lower" the
+#: mirror. Thresholds are loose on noisy measurements, tight on
+#: deterministic ones. Fields absent from either record are skipped
+#: (the schema grew over rounds).
+STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
+    ("scaling_ratio_4x_steps", "higher", 0.15),
+    ("specialize_speedup", "higher", 0.15),
+    ("store_hit_rate", "higher", 0.10),
+    ("incremental_rate", "higher", 0.10),
+    ("warm_hit_p50_s", "lower", 0.50),
+    ("static_answer_rate", "higher", 0.25),
+    ("static_prune_rate", "higher", 0.50),
+    ("screen_mount_rate_semantic", "lower", 0.25),
+    ("default_path_issues", "higher", 0.0),
+    ("trace_overlap_frac", "higher", 0.25),
+)
+
+#: cross-backend / absolute-wall fields shown in the trajectory table
+#: but exempt from the gate (r05 ran on TPU v5 lite, r06 on a
+#: CPU-only container — raw rates are not comparable across rounds)
+EXEMPT_FIELDS: Tuple[str, ...] = (
+    "value", "vs_baseline", "device_verdict_share",
+    "device_sat_verdicts", "cdcl_sat_verdicts", "contracts_per_sec",
+    "corpus_wall_s", "host_only_wall_s", "specialized_step_rate",
+    "generic_step_rate", "batch_steps_per_sec", "hbm_demand_gbps",
+    "hbm_utilization_pct", "mfu_pct", "kernel_compile_s",
+    "hard_solve_speedup",
+)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text parsing (the scrape side of top/report)
+# ---------------------------------------------------------------------------
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)$"
+)
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[Tuple, float]]:
+    """Prometheus text exposition -> {family: {label-key: value}}.
+    Histogram _bucket/_sum/_count samples keep their suffixed family
+    names; the label key is the sorted (k, v) tuple the registry
+    uses."""
+    out: Dict[str, Dict[Tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _SAMPLE.match(line)
+        if not match:
+            continue
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\"))
+            for k, v in _LABEL.findall(match.group("labels") or "")
+        ))
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        out.setdefault(match.group("name"), {})[labels] = value
+    return out
+
+
+def family_total(
+    metrics: Dict[str, Dict], name: str, **labels
+) -> float:
+    """Sum of every sample of `name` whose labels contain `labels`."""
+    want = set(labels.items())
+    return sum(
+        v for key, v in (metrics.get(name) or {}).items()
+        if want <= set(key)
+    )
+
+
+# ---------------------------------------------------------------------------
+# top
+# ---------------------------------------------------------------------------
+def _bar(fraction: float, width: int = 20) -> str:
+    fraction = max(0.0, min(1.0, fraction))
+    filled = int(round(fraction * width))
+    return "[" + "#" * filled + "." * (width - filled) + "]"
+
+
+def render_top(
+    stats: Dict, metrics: Optional[Dict[str, Dict]] = None
+) -> str:
+    """One operator screen from a /stats payload (+ an optional parsed
+    /metrics scrape for the health/device gauges)."""
+    lines: List[str] = []
+    health = stats.get("health") or {}
+    state = health.get("state", "?")
+    ready = health.get("ready")
+    reasons = (
+        health.get("reasons") or []
+    ) + (health.get("not_ready_reasons") or [])
+    lines.append(
+        f"health   {state.upper():9s} ready={ready} "
+        f"uptime={stats.get('uptime_s', '?')}s"
+        + (f"  reasons: {', '.join(reasons)}" if reasons else "")
+    )
+    for status in health.get("objectives") or []:
+        lines.append(
+            "  slo %-24s %-9s burn %6.2f / %6.2f (short/long)"
+            % (
+                status.get("objective"), status.get("state"),
+                status.get("burn_short", 0.0),
+                status.get("burn_long", 0.0),
+            )
+        )
+    queue = stats.get("queue") or {}
+    depth, cap = queue.get("depth", 0), max(1, queue.get("capacity", 1))
+    lines.append(
+        f"queue    {_bar(depth / cap)} {depth}/{cap} "
+        f"accepted={queue.get('accepted', 0)} "
+        f"429={queue.get('rejected_full', 0)} "
+        f"503={queue.get('rejected_draining', 0)}"
+    )
+    arena = stats.get("arena") or {}
+    lanes, busy = max(1, arena.get("lanes", 1)), arena.get("lanes_busy", 0)
+    lines.append(
+        f"arena    {_bar(busy / lanes)} {busy}/{lanes} lanes, "
+        f"jobs={arena.get('jobs_resident', 0)} "
+        f"(max {arena.get('max_jobs_resident', 0)})"
+    )
+    waves = stats.get("waves") or {}
+    lines.append(
+        f"waves    {waves.get('count', 0)} total @ "
+        f"{waves.get('rate_per_s', 0.0)}/s, warm "
+        f"{waves.get('warm_wave_s')}s (cold {waves.get('cold_wave_s')}s)"
+    )
+    jobs = queue.get("jobs") or {}
+    tier_mix = []
+    store = stats.get("store") or {}
+    static = stats.get("static") or {}
+    tier_mix.append(f"store-hit={store.get('answered', 0)}")
+    tier_mix.append(f"static-answer={static.get('static_answered', 0)}")
+    tier_mix.append(f"done={jobs.get('done', 0)}")
+    tier_mix.append(f"failed={jobs.get('failed', 0)}")
+    lines.append("tiers    " + " ".join(tier_mix))
+    solver = stats.get("solver") or {}
+    if solver.get("loss"):
+        top_loss = sorted(
+            solver["loss"].items(), key=lambda kv: -kv[1]
+        )[:3]
+        lines.append(
+            "solver   loss: "
+            + ", ".join(f"{k}={v}" for k, v in top_loss)
+        )
+    device = stats.get("device") or {}
+    if device:
+        bits = []
+        if "arena" in device:
+            bits.append(f"occupancy={device['arena'].get('occupancy')}")
+        if "host_rss_bytes" in device:
+            bits.append(
+                f"rss={device['host_rss_bytes'] / (1 << 20):.0f}MiB"
+            )
+        if "wave_overlap_frac" in device:
+            bits.append(f"overlap={device['wave_overlap_frac']}")
+        if "kernel_cache" in device:
+            bits.append(
+                f"kernels={device['kernel_cache'].get('size')} "
+                f"(pinned {device['kernel_cache'].get('pinned')})"
+            )
+        lines.append("device   " + " ".join(bits))
+    if metrics:
+        state_value = family_total(metrics, "mtpu_health_state")
+        lines.append(
+            f"metrics  mtpu_health_state={int(state_value)} "
+            f"families={len(metrics)}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+def render_report(
+    metrics: Optional[Dict[str, Dict]] = None,
+    routing_records: Optional[List[Dict]] = None,
+    journeys: Optional[List[Dict]] = None,
+    stats: Optional[Dict] = None,
+    fmt: str = "markdown",
+) -> str:
+    """The static digest: route mix and wall percentiles from the
+    routing JSONL, health/device gauges from a metrics snapshot,
+    journey tails. Markdown by default; fmt="html" wraps the same
+    body in a minimal page."""
+    lines: List[str] = ["# myth observe report", ""]
+    if stats:
+        health = stats.get("health") or {}
+        lines += [
+            "## Health",
+            "",
+            f"- state: **{health.get('state', '?')}** "
+            f"(ready={health.get('ready')})",
+            f"- uptime: {stats.get('uptime_s')}s, "
+            f"draining: {stats.get('draining')}",
+            "",
+        ]
+        for status in health.get("objectives") or []:
+            lines.append(
+                f"- objective `{status.get('objective')}`: "
+                f"{status.get('state')} "
+                f"(burn {status.get('burn_short')}/{status.get('burn_long')})"
+            )
+        lines.append("")
+    if metrics:
+        lines += ["## Metrics snapshot", ""]
+        rows = [
+            ("health state", family_total(metrics, "mtpu_health_state")),
+            ("jobs settled",
+             family_total(metrics, "mtpu_service_jobs_settled_total")),
+            ("waves", family_total(metrics, "mtpu_service_waves_total")),
+            ("store answered",
+             family_total(metrics, "mtpu_service_store_answered_total")),
+            ("static answered",
+             family_total(metrics, "mtpu_service_static_answered_total")),
+            ("solver queries",
+             family_total(metrics, "mtpu_solver_queries_total")),
+            ("device arena occupancy",
+             family_total(metrics, "mtpu_device_arena_occupancy")),
+        ]
+        lines.append("| series | value |")
+        lines.append("|---|---|")
+        for label, value in rows:
+            lines.append(f"| {label} | {value:g} |")
+        lines.append("")
+    if routing_records:
+        routes: Dict[str, int] = {}
+        walls: List[float] = []
+        for rec in routing_records:
+            outcome = rec.get("outcome") or {}
+            routes[outcome.get("route", "?")] = (
+                routes.get(outcome.get("route", "?"), 0) + 1
+            )
+            if isinstance(outcome.get("wall_s"), (int, float)):
+                walls.append(float(outcome["wall_s"]))
+        lines += ["## Routing mix", "", "| route | contracts |", "|---|---|"]
+        for route, n in sorted(routes.items(), key=lambda kv: -kv[1]):
+            lines.append(f"| {route} | {n} |")
+        if walls:
+            walls.sort()
+            lines.append("")
+            lines.append(
+                f"wall p50 {walls[len(walls) // 2]:.3f}s, "
+                f"p95 {walls[int(len(walls) * 0.95) - 1]:.3f}s "
+                f"over {len(walls)} contracts"
+            )
+        lines.append("")
+    if journeys:
+        lines += ["## Recent journeys", ""]
+        for doc in journeys[-8:]:
+            lines.append(
+                f"- `{doc.get('journey_id')}`: "
+                f"{' -> '.join(doc.get('tiers') or [])} "
+                f"({doc.get('wall_s')}s)"
+            )
+        lines.append("")
+    body = "\n".join(lines)
+    if fmt == "html":
+        paragraphs = body.replace("&", "&amp;").replace("<", "&lt;")
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>myth observe report</title></head><body><pre>"
+            + paragraphs + "</pre></body></html>"
+        )
+    return body
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+def load_bench_record(path: str) -> Tuple[str, Optional[Dict]]:
+    """(label, parsed-record) from a BENCH_r*.json file. Accepts the
+    driver envelope ({"n", "parsed", ...}) or a bare parsed dict;
+    parsed=None (a timed-out round) comes back None and the caller
+    skips it with a note."""
+    with open(path) as fp:
+        doc = json.load(fp)
+    if isinstance(doc, dict) and "parsed" in doc:
+        label = f"r{doc.get('n'):02d}" if doc.get("n") else path
+        return label, doc["parsed"]
+    return path, doc if isinstance(doc, dict) else None
+
+
+def compare_records(
+    records: List[Tuple[str, Optional[Dict]]],
+    threshold_scale: float = 1.0,
+) -> Dict:
+    """Trajectory + regression analysis over two or more records (in
+    chronological order). Gating is adjacent-pair over STABLE_FIELDS;
+    `threshold_scale` multiplies every per-field threshold (CI can
+    loosen or tighten the gate without editing the table)."""
+    present = [(label, rec) for label, rec in records if rec]
+    skipped = [label for label, rec in records if not rec]
+    fields: List[str] = []
+    seen = set()
+    for name, _dir, _thr in STABLE_FIELDS:
+        fields.append(name)
+        seen.add(name)
+    for _label, rec in present:
+        for key in rec:
+            value = rec[key]
+            if (
+                key not in seen
+                and isinstance(value, (int, float))
+                and not isinstance(value, bool)
+            ):
+                fields.append(key)
+                seen.add(key)
+    trajectory = {
+        name: [
+            rec.get(name) if isinstance(rec.get(name), (int, float))
+            and not isinstance(rec.get(name), bool) else None
+            for _label, rec in present
+        ]
+        for name in fields
+    }
+    regressions: List[Dict] = []
+    directions = {name: (d, t) for name, d, t in STABLE_FIELDS}
+    for i in range(1, len(present)):
+        old_label, old = present[i - 1]
+        new_label, new = present[i]
+        for name, (direction, base_thr) in directions.items():
+            before, after = old.get(name), new.get(name)
+            if not isinstance(before, (int, float)) or not isinstance(
+                after, (int, float)
+            ):
+                continue
+            thr = base_thr * threshold_scale
+            if direction == "higher":
+                floor = before * (1.0 - thr)
+                bad = after < floor - 1e-12
+            else:
+                ceiling = before * (1.0 + thr)
+                bad = after > ceiling + 1e-12
+            if bad:
+                regressions.append({
+                    "field": name,
+                    "from": old_label,
+                    "to": new_label,
+                    "before": before,
+                    "after": after,
+                    "direction": direction,
+                    "threshold": thr,
+                })
+    return {
+        "labels": [label for label, _rec in present],
+        "skipped": skipped,
+        "trajectory": trajectory,
+        "regressions": regressions,
+        "stable_fields": [name for name, _d, _t in STABLE_FIELDS],
+        "exempt_fields": list(EXEMPT_FIELDS),
+    }
+
+
+def render_compare(result: Dict) -> str:
+    labels = result["labels"]
+    lines = [
+        "bench trajectory over " + " -> ".join(labels)
+        + (
+            f"  (skipped, no parsed record: {', '.join(result['skipped'])})"
+            if result["skipped"] else ""
+        ),
+        "",
+        "%-34s %s  gate" % ("field", "  ".join("%12s" % x for x in labels)),
+    ]
+    stable = set(result["stable_fields"])
+    exempt = set(result["exempt_fields"])
+    regressed = {r["field"] for r in result["regressions"]}
+
+    def fmt(value) -> str:
+        if value is None:
+            return "%12s" % "-"
+        if isinstance(value, float):
+            return "%12.4g" % value
+        return "%12d" % value
+
+    for name, values in result["trajectory"].items():
+        if all(v is None for v in values):
+            continue
+        if name in regressed:
+            gate = "REGRESSED"
+        elif name in stable:
+            gate = "stable"
+        elif name in exempt:
+            gate = "exempt"
+        else:
+            gate = ""
+        lines.append(
+            "%-34s %s  %s"
+            % (name, "  ".join(fmt(v) for v in values), gate)
+        )
+    if result["regressions"]:
+        lines.append("")
+        for reg in result["regressions"]:
+            lines.append(
+                "REGRESSION %s: %s %g -> %g (%s-is-better, "
+                "threshold %.0f%%)"
+                % (
+                    reg["field"], f"{reg['from']}->{reg['to']}",
+                    reg["before"], reg["after"], reg["direction"],
+                    reg["threshold"] * 100,
+                )
+            )
+    else:
+        lines.append("")
+        lines.append("no regressions on stable fields")
+    return "\n".join(lines)

@@ -113,12 +113,16 @@ QUERY_ORIGIN_MEMO_MISS = "memo-miss"
 
 _LOSS = None
 _CAPTURED = None
+_METRICS_REG = None
 
 
 def _metrics():
-    global _LOSS, _CAPTURED
-    if _LOSS is None:
-        reg = registry()
+    # handles re-resolve when the registry instance changes
+    # (reset_registry in tests) — a cached child writing to an
+    # orphaned registry is a silent telemetry sink
+    global _LOSS, _CAPTURED, _METRICS_REG
+    if _LOSS is None or _METRICS_REG is not registry():
+        reg = _METRICS_REG = registry()
         _LOSS = reg.counter(
             "mtpu_solver_loss_total",
             "host-answered solver verdicts by device-loss reason",

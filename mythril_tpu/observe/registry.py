@@ -48,6 +48,21 @@ DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0,
 )
 
+#: job-latency ladder: the settle spectrum spans ~1.9ms verdict-store
+#: hits to ~21s cold host walks (BENCH_r06), so the warm tiers need
+#: sub-5ms resolution the default ladder crushes into one bucket
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+#: solver-wall ladder: memo hits are microseconds, CDCL marathons tens
+#: of seconds — two extra decades below the default ladder's floor
+SOLVER_WALL_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+    1.0, 5.0, 10.0, 30.0,
+)
+
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
@@ -244,6 +259,17 @@ class MetricsRegistry:
                 raise ValueError(
                     f"metric {name!r} already registered as {metric.kind}"
                 )
+            elif (
+                kind == HISTOGRAM
+                and tuple(buckets) != metric.buckets
+                and tuple(buckets) != DEFAULT_BUCKETS
+            ):
+                # per-metric bucket override on re-registration: adopt
+                # the explicit ladder while the series is still empty
+                # (bucket counts would be meaningless across a switch);
+                # once observations exist the first ladder wins
+                if not metric._series:
+                    metric.buckets = tuple(buckets)
             return metric
 
     def counter(self, name: str, help_text: str = "") -> Metric:
@@ -291,6 +317,14 @@ class MetricsRegistry:
             except Exception:  # a broken collector must not sink /stats
                 pass
         return out
+
+    def buckets_of(self, name: str) -> Tuple[float, ...]:
+        """A histogram's bucket bounds (DEFAULT_BUCKETS for unknown
+        names) — snapshot consumers (the SLO engine) pair these with
+        the snapshot's bucket counts."""
+        with self._lock:
+            metric = self._metrics.get(name)
+            return metric.buckets if metric is not None else DEFAULT_BUCKETS
 
     def value(self, name: str, **labels) -> float:
         metric = self._metrics.get(name)

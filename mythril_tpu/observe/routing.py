@@ -29,14 +29,19 @@ from typing import Dict, List, Optional
 #: longer tied to the registry's. v2 adds the taint/value-set feature
 #: block (taint_density, per-sink-kind tainted counts, resolved call
 #: targets, fingerprint count, static answerability) and the
-#: "static-answer" route. v1 records parse through `read_records` /
-#: `parse_record` unchanged (absent v2 features read as None).
-SCHEMA_VERSION = 2
+#: "static-answer" route. v3 adds the top-level ``journey_id`` — the
+#: key that joins a record to its tier-ladder timeline
+#: (observe/journey.py), so features ⨝ route ⨝ outcome ⨝ timeline
+#: joins offline. v1/v2 records parse through `read_records` /
+#: `parse_record` unchanged (absent features read as None; absent
+#: journey_id reads as None).
+SCHEMA_VERSION = 3
 
 #: every record carries exactly these top-level keys (the JSONL golden
-#: test pins them)
+#: test pins them); ``journey_id`` may be None for pre-v3 records
 RECORD_KEYS = (
     "schema_version", "contract", "code_hash", "features", "outcome",
+    "journey_id",
 )
 
 #: feature keys added by schema v2 (the back-compat reader fills them
@@ -65,6 +70,7 @@ class RoutingLog:
         code_hash: str,
         features: Dict,
         outcome: Dict,
+        journey_id: Optional[str] = None,
     ) -> Dict:
         from mythril_tpu import observe
 
@@ -74,6 +80,7 @@ class RoutingLog:
             "code_hash": code_hash,
             "features": features,
             "outcome": outcome,
+            "journey_id": journey_id,
         }
         if not observe.enabled():
             return rec
@@ -119,7 +126,9 @@ def features_for(code_hex: str, summary=None) -> Dict:
     """The static feature vector for one contract. Uses the cached
     StaticSummary when available (CFG sizes, dead selectors, screened
     modules); degrades to byte-scan features when the static layer is
-    off or failed — the record always exists."""
+    off or failed — the record always exists. Pass ``summary=False``
+    to skip the summary build outright (the microsecond admission
+    tiers must not pay a CFG recovery for a telemetry row)."""
     code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
     try:
         code = bytes.fromhex(code_hex)
@@ -135,7 +144,9 @@ def features_for(code_hex: str, summary=None) -> Dict:
             sum(code.count(bytes([op])) for op in _CALL_OPS) / n, 5
         ),
     }
-    if summary is None:
+    if summary is False:
+        summary = None
+    elif summary is None:
         try:
             from mythril_tpu.analysis.static import (
                 static_prune_enabled,
@@ -246,6 +257,7 @@ def parse_record(line_or_obj) -> Dict:
     )
     if not isinstance(rec, dict):
         raise ValueError("routing record is not an object")
+    rec.setdefault("journey_id", None)  # pre-v3 records carry none
     missing = [k for k in RECORD_KEYS if k not in rec]
     if missing:
         raise ValueError(f"routing record missing keys: {missing}")

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from mythril_tpu.observe.registry import registry
+from mythril_tpu.observe.registry import SOLVER_WALL_BUCKETS, registry
 
 #: the stable origin labels (see module docstring)
 ORIGIN_MEMO = "memo"
@@ -39,13 +39,18 @@ ORIGIN_Z3 = "host-z3"
 
 _QUERIES = None
 _WALL = None
+_WALL_HIST = None
 _ESCALATIONS = None
+_METRICS_REG = None
 
 
 def _metrics():
-    global _QUERIES, _WALL, _ESCALATIONS
-    if _QUERIES is None:
-        reg = registry()
+    # handles re-resolve when the registry instance changes
+    # (reset_registry in tests) — a cached child writing to an
+    # orphaned registry is a silent telemetry sink
+    global _QUERIES, _WALL, _WALL_HIST, _ESCALATIONS, _METRICS_REG
+    if _QUERIES is None or _METRICS_REG is not registry():
+        reg = _METRICS_REG = registry()
         _QUERIES = reg.counter(
             "mtpu_solver_queries_total",
             "SAT/SMT queries by answering origin and verdict",
@@ -53,6 +58,14 @@ def _metrics():
         _WALL = reg.counter(
             "mtpu_solver_wall_seconds_total",
             "solver wall seconds by answering origin",
+        )
+        # per-query wall distribution on its own ladder: memo hits
+        # are microseconds, CDCL marathons tens of seconds — the
+        # default bucket ladder crushes the warm end into one bucket
+        _WALL_HIST = reg.histogram(
+            "mtpu_solver_query_seconds",
+            "per-query solver wall by answering origin",
+            buckets=SOLVER_WALL_BUCKETS,
         )
         _ESCALATIONS = reg.counter(
             "mtpu_solver_escalations_total",
@@ -75,6 +88,7 @@ def record_query(
     queries.labels(origin=origin, verdict=verdict).inc()
     if wall_s:
         wall.labels(origin=origin).inc(wall_s)
+        _WALL_HIST.labels(origin=origin).observe(wall_s)
     if hop > 0:
         escalations.labels(origin=origin).inc(hop)
 

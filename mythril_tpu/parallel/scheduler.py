@@ -290,7 +290,35 @@ class CorpusScheduler:
     def _take(self, gid: int) -> List[WorkItem]:
         with self._mu:
             queue = self.ledgers[gid].queue
-            return [queue.popleft() for _ in range(min(self.chunk, len(queue)))]
+            out = [
+                queue.popleft()
+                for _ in range(min(self.chunk, len(queue)))
+            ]
+        self._publish_saturation()
+        return out
+
+    def _publish_saturation(self) -> None:
+        """Per-group backlog depth as live mtpu_device_* gauges (the
+        chunk boundary is the natural sampling point): the saturation
+        view the devicemon/`myth observe top` surface reads for mesh
+        runs — a group whose backlog stays deep while another sits at
+        zero is a steal/assignment problem, visible without logs."""
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            depth_gauge = registry().gauge(
+                "mtpu_device_group_backlog",
+                "pending work items per device group",
+            )
+            with self._mu:
+                depths = [
+                    (led.group.label, len(led.queue))
+                    for led in self.ledgers
+                ]
+            for label, depth in depths:
+                depth_gauge.labels(group=label).set(depth)
+        except Exception:  # telemetry must never sink a chunk
+            pass
 
     def _steal(self, gid: int) -> List[WorkItem]:
         """Take up to half of the most-loaded group's pending queue

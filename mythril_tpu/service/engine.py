@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from mythril_tpu import observe
+from mythril_tpu.observe import journey
 from mythril_tpu.observe.registry import _label_key
 from mythril_tpu.observe.spans import flight_recorder, trace
 from mythril_tpu.service.jobs import Job, JobQueue, JobState
@@ -54,8 +55,9 @@ from mythril_tpu.service.lane_allocator import LaneAllocator
 log = logging.getLogger(__name__)
 
 #: /stats payload schema version: smoke tools pin it and the key set
-#: it covers. Bump on any shape change.
-STATS_SCHEMA_VERSION = 2
+#: it covers. Bump on any shape change. v3 adds the `health` (SLO
+#: state machine) and `device` (saturation sampler) blocks.
+STATS_SCHEMA_VERSION = 3
 
 #: engine-instance serial for the registry label (tests run many
 #: engines per process; each gets its own series)
@@ -104,6 +106,8 @@ class ServiceConfig:
         static_answer: bool = True,
         store_dir: Optional[str] = None,
         store: bool = True,
+        arena_warmup: bool = False,
+        health_interval_s: float = 2.0,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -159,6 +163,15 @@ class ServiceConfig:
         #: tier even with a directory configured.
         self.store_dir = store_dir
         self.store = store
+        #: arena warmup (myth serve default ON, tests default OFF):
+        #: `start()` launches a background all-halt wave of the real
+        #: dispatch shape, so the generic kernel compiles before the
+        #: first request and /healthz readiness reports
+        #: `arena-warming` until it lands — the warming half of the
+        #: readiness/liveness split
+        self.arena_warmup = arena_warmup
+        #: cadence of the health/device sampler thread the server runs
+        self.health_interval_s = health_interval_s
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -643,6 +656,26 @@ class AnalysisEngine:
         #: where the drain's final flight-recorder flush landed (None
         #: until drained; /stats observe.flight_dump mirrors it)
         self.flight_dump_path: Optional[str] = None
+        # -- health state machine (observe/slo.py) ---------------------
+        # the SLO engine samples the shared registry; the monitor folds
+        # objective burn with this engine's lifecycle facts into the
+        # ok/degraded/redlined machine /healthz and mtpu_health_state
+        # export. Warming is set immediately when arena warmup is off.
+        self._warm_done = threading.Event()
+        if not self.cfg.arena_warmup:
+            self._warm_done.set()
+        self.health = observe.HealthMonitor(
+            warming_fn=lambda: not self._warm_done.is_set(),
+            compiling_fn=lambda: any(
+                t.is_alive() for t in self._warmup_threads
+            ),
+            draining_fn=lambda: self._draining,
+            saturation_fn=self._saturation_reasons,
+        )
+        # the device monitor reads this engine's arena occupancy (the
+        # newest engine owns the source; tests run many engines per
+        # process and the live serve runs one)
+        observe.device_monitor().set_arena_source(self.alloc.occupancy)
 
     # -- legacy counter names (views over the registry series) ---------
     @property
@@ -702,15 +735,74 @@ class AnalysisEngine:
         return int(self._c_mesh_rebalance.value)
 
     # -- lifecycle -----------------------------------------------------
+    def _saturation_reasons(self) -> List[str]:
+        """Live redline facts for the health monitor: a full admission
+        queue means the replica is refusing work RIGHT NOW — the
+        federation front should stop routing here before the SLO
+        windows even notice."""
+        from mythril_tpu.observe import slo
+
+        reasons: List[str] = []
+        if self.queue.depth() >= self.queue.capacity:
+            reasons.append(slo.REDLINE_QUEUE_SATURATED)
+        return reasons
+
+    def _arena_warmup(self) -> None:
+        """Compile the generic wave kernel OFF the serving path: one
+        all-halt wave of the exact dispatch shape, so the first real
+        request rides a warm executable and readiness truthfully says
+        when. Failure still flips readiness — a broken warmup must
+        not wedge the replica not-ready forever (the first real wave
+        will surface the fault with attribution)."""
+        try:
+            import jax
+
+            from mythril_tpu.laser.batch.run import run
+            from mythril_tpu.laser.batch.state import make_batch
+
+            n = self.alloc.n_lanes
+            batch = make_batch(
+                n,
+                code_ids=np.full((n,), self.cfg.stripes, np.int32),
+                calldata=[b""] * n,
+                caller=DEFAULT_CALLER,
+                address=DEFAULT_ADDRESS,
+                timestamp=0x5BFA4639,
+                number=0x66E393,
+                gasprice=0x773594000,
+            )
+            with trace("service.arena.warmup", track="service"):
+                _out, steps = run(
+                    batch,
+                    self._table(),
+                    max_steps=self.cfg.steps_per_wave,
+                    track_coverage=True,
+                )
+                jax.block_until_ready(steps)
+        except Exception:
+            log.warning("arena warmup failed", exc_info=True)
+        finally:
+            self._warm_done.set()
+
     def start(self) -> "AnalysisEngine":
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._loop, name="myth-serve-waves", daemon=True
             )
             self._thread.start()
+            if self.cfg.arena_warmup and not self._warm_done.is_set():
+                threading.Thread(
+                    target=self._arena_warmup,
+                    name="myth-arena-warmup",
+                    daemon=True,
+                ).start()
         return self
 
     def submit(self, job: Job) -> Job:
+        observe.journey_event(
+            job.journey_id, journey.TIER_ADMISSION, "submitted",
+            code_len=len(job.code),
+        )
         if self._try_store_hit(job):
             return job
         if self._try_static_answer(job):
@@ -742,9 +834,14 @@ class AnalysisEngine:
             return False
         self.queue.register(job)  # raises QueueRefusal when draining
         self._c_store_answered.inc()
+        observe.journey_event(
+            job.journey_id, journey.TIER_STORE_HIT, "banked-verdict",
+            issues=len(entry.issues or ()),
+        )
         now = time.monotonic()
         job.report = {
             "job_id": job.id,
+            "journey_id": job.journey_id,
             "code_hash": entry.code_hash,
             "store_hit": True,
             "issues": entry.issues,
@@ -759,6 +856,7 @@ class AnalysisEngine:
             },
         }
         self.queue.settle(job, JobState.DONE)
+        self._routing_record(job, route="store-hit")
         return True
 
     def _try_static_answer(self, job: Job) -> bool:
@@ -783,9 +881,14 @@ class AnalysisEngine:
             return False
         self.queue.register(job)  # raises QueueRefusal when draining
         self._c_static_answered.inc()
+        observe.journey_event(
+            job.journey_id, journey.TIER_STATIC_ANSWER, "screened-clean",
+            wall_ms=summary.wall_ms,
+        )
         now = time.monotonic()
         job.report = {
             "job_id": job.id,
+            "journey_id": job.journey_id,
             "code_hash": CodeCache.code_hash(job.code),
             "static_answered": True,
             "issues": [],
@@ -801,7 +904,45 @@ class AnalysisEngine:
             },
         }
         self.queue.settle(job, JobState.DONE)
+        self._routing_record(job, route="static-answer")
         return True
+
+    def _routing_record(self, job: Job, route: Optional[str] = None) -> None:
+        """One routing-feature record per settled service job: the
+        same features ⨝ route ⨝ outcome row the corpus driver emits,
+        carrying the journey_id so the offline trainer joins the
+        timeline too. Service traffic is training data — the cost
+        model must see the cache economics of real request streams."""
+        if not observe.enabled():
+            return
+        try:
+            report = job.report or {}
+            result = {
+                "issues": report.get("issues") or [],
+                "wall_s": (report.get("timings") or {}).get("total_s"),
+                "error": job.error,
+                "complete": job.error is None,
+                "store_hit": route == "store-hit",
+                "static_answered": route == "static-answer",
+            }
+            # the store-hit tier settles in microseconds: its record
+            # must not pay a CFG recovery for feature columns
+            summary = (
+                False
+                if route == "store-hit"
+                else self.code_cache.static_summary(job.code)
+            )
+            observe.routing_log().record(
+                contract=f"job-{job.id}",
+                code_hash=CodeCache.code_hash(job.code),
+                features=observe.routing_features_for(
+                    job.code.hex(), summary=summary
+                ),
+                outcome=observe.routing_outcome_for(result),
+                journey_id=job.journey_id,
+            )
+        except Exception:
+            log.debug("service routing record failed", exc_info=True)
 
     @property
     def draining(self) -> bool:
@@ -871,6 +1012,12 @@ class AnalysisEngine:
             except Exception:
                 log.debug("drain flight-recorder flush failed",
                           exc_info=True)
+        # release the saturation source if this engine still owns it
+        # (tests run many engines; the sampler must not keep reading a
+        # drained allocator as "the" arena)
+        monitor = observe.device_monitor()
+        if monitor._arena_source == self.alloc.occupancy:
+            monitor.set_arena_source(None)
         self._drained.set()
 
     def close(self) -> None:
@@ -950,6 +1097,11 @@ class AnalysisEngine:
             self._c_static_seeds.inc(track.static_seeds_dropped)
             self._install_code(track)
             self._tracks[job.id] = track
+            observe.journey_event(
+                job.journey_id, journey.TIER_LANE_GRANT, "granted",
+                stripes=len(granted), lanes=len(lanes),
+                group=self.alloc.group_of(granted[0]),
+            )
         if self.mesh is not None:
             self._rebalance()
 
@@ -1203,6 +1355,10 @@ class AnalysisEngine:
         for track in self._tracks.values():
             inputs = track.next_inputs()
             wave_inputs[track.job.id] = inputs
+            observe.journey_event(
+                track.job.journey_id, journey.TIER_WAVE, "dispatch",
+                wave=track.waves_done + 1,
+            )
             for lane, data in zip(track.lanes, inputs):
                 code_ids[lane] = track.code_row
                 calldata[lane] = data
@@ -1407,6 +1563,12 @@ class AnalysisEngine:
         """Post-harvest settlement shared by the single-arena and mesh
         paths: deadline expiry, wave cap, staleness."""
         track.job.waves = track.waves_done
+        observe.journey_event(
+            track.job.journey_id, journey.TIER_WAVE, "harvest",
+            wave=track.waves_done,
+            covered_branches=len(track.covered),
+            stale_waves=track.stale_waves,
+        )
         max_waves = track.job.max_waves or self.cfg.max_waves
         expired = (
             track.job.deadline is not None and track.job.deadline.expired
@@ -1678,15 +1840,43 @@ class AnalysisEngine:
             outcome,
             None,  # deterministic_solving
         )
+        observe.journey_event(
+            job.journey_id, journey.TIER_HOST_WALK, "start",
+            timeout_s=timeout,
+        )
+        solver_before = observe.solver_marker()
         try:
             # host symbolic state (term arena, CDCL session) is
             # process-global: in-process workers serialize here
             with HOST_SYMBOLIC_LOCK:
-                result = analyze_one_payload(payload)
+                with trace(
+                    "service.host.walk", track="service", job=job.id
+                ):
+                    result = analyze_one_payload(payload)
         except CancelledError:
             raise
         except Exception as why:  # analyze_one_payload already catches;
             result = {"issues": [], "states": 0, "error": str(why)}
+        # the walk ran under HOST_SYMBOLIC_LOCK, so the attribution
+        # delta is this job's: the ladder hops (device-first vs CDCL)
+        # land on the timeline as one solver-tier event
+        try:
+            attribution = observe.solver_attribution(solver_before)
+            if attribution:
+                observe.journey_event(
+                    job.journey_id, journey.TIER_SOLVER, "escalations",
+                    **{
+                        origin: row["queries"]
+                        for origin, row in attribution.items()
+                    },
+                )
+        except Exception:
+            log.debug("journey solver attribution failed", exc_info=True)
+        observe.journey_event(
+            job.journey_id, journey.TIER_HOST_WALK, "done",
+            issues=len(result.get("issues") or ()),
+            states=result.get("states", 0),
+        )
         self._host_inflight.pop(job.id, None)
         self._c_host_completed.inc()
         self._finalize(job, track, outcome, host_result=result)
@@ -1701,6 +1891,7 @@ class AnalysisEngine:
         )
         report = {
             "job_id": job.id,
+            "journey_id": job.journey_id,
             "code_hash": CodeCache.code_hash(job.code),
             "device": {
                 "waves": outcome["stats"]["waves"],
@@ -1743,6 +1934,10 @@ class AnalysisEngine:
             report["degraded"] = list(job.degraded)
         report["timings"]["total_s"] = round(now - job.created_t, 3)
         job.report = report
+        # the routing record lands BEFORE the settle wakes long-poll
+        # waiters: a client that sees the terminal state must find the
+        # record (and its journey_id) already in the JSONL
+        self._routing_record(job)
         self.queue.settle(job, state)
         if state == JobState.DONE:
             self._store_writeback(job, report, outcome)
@@ -2061,5 +2256,7 @@ class AnalysisEngine:
                 "spans_recorded": flight_recorder().recorded,
                 "flight_dump": getattr(self, "flight_dump_path", None),
             },
+            "health": self.health.healthz_payload(),
+            "device": observe.device_monitor().latest(),
             "degradation": DegradationLog().counts_since(self._deg_marker),
         }

@@ -23,6 +23,8 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from mythril_tpu.observe import journey
+from mythril_tpu.observe.journey import journey_event
 from mythril_tpu.support.resilience import Deadline
 
 
@@ -72,6 +74,9 @@ class Job:
         self.checkpoint_path: Optional[str] = None
         self.waves = 0
         self.degraded: List[str] = []
+        #: the tier-ladder timeline key (observe/journey.py): service
+        #: jobs reuse the job id so /v1/jobs/<id>/trace needs no map
+        self.journey_id = self.id
 
     @property
     def terminal(self) -> bool:
@@ -147,6 +152,10 @@ class JobQueue:
             self._pending.append(job)
             self._jobs[job.id] = job
             self._settled.notify_all()
+        journey_event(
+            job.journey_id, journey.TIER_QUEUED, "enqueued",
+            depth=len(self._pending),
+        )
 
     def register(self, job: Job) -> None:
         """Admit `job` into the registry WITHOUT a pending-queue slot:
@@ -181,6 +190,11 @@ class JobQueue:
                 job.state = JobState.RUNNING
                 job.started_t = time.monotonic()
                 out.append(job)
+        for job in out:
+            journey_event(
+                job.journey_id, journey.TIER_QUEUED, "claimed",
+                queued_s=round(job.started_t - job.created_t, 6),
+            )
         return out
 
     def unclaim(self, job: Job) -> None:
@@ -196,7 +210,7 @@ class JobQueue:
             return self._jobs.get(job_id)
 
     def settle(self, job: Job, state: str) -> None:
-        from mythril_tpu.observe.registry import registry
+        from mythril_tpu.observe.registry import LATENCY_BUCKETS, registry
 
         reg = registry()
         reg.counter(
@@ -206,10 +220,21 @@ class JobQueue:
         with self._lock:
             job.state = state
             job.finished_t = time.monotonic()
+            # the warm-tier ladder: settle latency spans ~1.9ms store
+            # hits to ~21s cold walks, so the histogram gets its own
+            # sub-5ms-resolving buckets (ISSUE 12)
             reg.histogram(
                 "mtpu_service_job_latency_seconds",
                 "submit-to-terminal latency",
+                buckets=LATENCY_BUCKETS,
             ).observe(job.finished_t - job.created_t)
+            # the settle tier event lands BEFORE waiters wake: a
+            # client that saw the terminal state must find the full
+            # journey at /v1/jobs/<id>/trace
+            journey_event(
+                job.journey_id, journey.TIER_SETTLE, state,
+                latency_s=round(job.finished_t - job.created_t, 6),
+            )
             self._settled.notify_all()
 
     def mark(self, job: Job, state: str) -> None:

@@ -34,6 +34,7 @@ import logging
 import re
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -42,7 +43,7 @@ from mythril_tpu.service.jobs import Job, QueueRefusal
 
 log = logging.getLogger(__name__)
 
-_JOB_PATH = re.compile(r"^/v1/jobs/([0-9a-f]{12})(/report)?$")
+_JOB_PATH = re.compile(r"^/v1/jobs/([0-9a-f]{12})(/report|/trace)?$")
 
 #: QueueRefusal.reason -> HTTP status
 _REFUSAL_STATUS = {"full": 429, "draining": 503}
@@ -89,14 +90,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path, params = self._query()
         if path == "/healthz":
-            self._reply(
-                200,
-                {
-                    "ok": True,
-                    "draining": self.engine.draining,
-                    "uptime_s": self.engine.stats()["uptime_s"],
-                },
+            # the readiness/liveness split: the payload always carries
+            # the full health machine (liveness = the process answered
+            # at all); `?ready=1` turns the STATUS CODE into the
+            # readiness probe a fleet front / load balancer keys on —
+            # 503 while warming, compiling, draining, or redlined,
+            # with the enumerated reason in the body
+            payload = self.engine.health.healthz_payload()
+            payload["draining"] = self.engine.draining
+            payload["uptime_s"] = round(
+                time.monotonic() - self.engine.started_t, 3
             )
+            status = 200
+            if params.get("ready") and not payload["ready"]:
+                status = 503
+            self._reply(status, payload)
             return
         if path == "/stats":
             self._reply(200, self.engine.stats())
@@ -135,8 +143,32 @@ class _Handler(BaseHTTPRequestHandler):
             return
         match = _JOB_PATH.match(path)
         if match:
-            job_id, want_report = match.group(1), bool(match.group(2))
-            if want_report:
+            job_id, sub = match.group(1), match.group(2) or ""
+            if sub == "/trace":
+                # the tier-ladder journey (observe/journey.py): what
+                # happened to this job, in order, with timestamps
+                from mythril_tpu import observe
+
+                job = self.engine.queue.get(job_id)
+                if job is None:
+                    self._reply(404, {"error": f"unknown job {job_id}"})
+                    return
+                doc = observe.assemble_journey(job.journey_id)
+                if doc is None:
+                    from mythril_tpu.observe import journey as _journey
+
+                    doc = {
+                        "schema_version": _journey.SCHEMA_VERSION,
+                        "journey_id": job.journey_id,
+                        "tiers": [],
+                        "tier_dwell_s": {},
+                        "events": [],
+                        "wall_s": 0.0,
+                    }
+                doc["state"] = job.state
+                self._reply(200, doc)
+                return
+            if sub == "/report":
                 wait_s = min(float(params.get("wait_s", 30.0)), 300.0)
                 job = self.engine.queue.wait_terminal(job_id, wait_s)
             else:
@@ -206,6 +238,8 @@ class AnalysisServer:
         self._http_thread: Optional[threading.Thread] = None
         self._start_engine = start_engine
         self._closed = False
+        self._sampler_stop = threading.Event()
+        self._sampler: Optional[threading.Thread] = None
 
     @property
     def host(self) -> str:
@@ -229,6 +263,33 @@ class AnalysisServer:
                 daemon=True,
             )
             self._http_thread.start()
+        if self._sampler is None:
+            # the health/saturation sampler: rolls the SLO engine and
+            # the device monitor on a clock so mtpu_health_state and
+            # mtpu_device_* stay live without a scrape in the loop
+            from mythril_tpu import observe
+
+            def _sample_loop():
+                while not self._sampler_stop.wait(
+                    self.engine.cfg.health_interval_s
+                ):
+                    try:
+                        self.engine.health.sample()
+                        observe.device_monitor().sample()
+                    except Exception:  # telemetry never sinks serving
+                        log.debug("observe sampler tick failed",
+                                  exc_info=True)
+
+            try:  # one synchronous tick: the first scrape sees gauges
+                self.engine.health.sample()
+                observe.device_monitor().sample()
+            except Exception:
+                log.debug("initial observe sample failed", exc_info=True)
+            self._sampler = threading.Thread(
+                target=_sample_loop, name="myth-observe-sampler",
+                daemon=True,
+            )
+            self._sampler.start()
         return self
 
     def install_signal_handlers(self) -> None:
@@ -262,6 +323,7 @@ class AnalysisServer:
         if self._closed:
             return
         self._closed = True
+        self._sampler_stop.set()
         self.engine.drain()
         self._httpd.shutdown()
         self._httpd.server_close()

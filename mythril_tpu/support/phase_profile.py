@@ -42,14 +42,26 @@ class PhaseProfile(object, metaclass=Singleton):
     singleton: one analysis per process at a time."""
 
     def __init__(self) -> None:
-        from mythril_tpu.observe.registry import registry
-
-        self._hist = registry().histogram(
-            _METRIC_NAME,
-            "host analysis wall seconds per pipeline phase",
-        )
+        self._backing_reg = None
+        self._backing_hist = None
         self._marker: Dict[str, Tuple[float, int]] = {}
         self.reset()
+
+    @property
+    def _hist(self):
+        """The backing registry histogram, re-resolved when the
+        registry instance changes (reset_registry in tests) — this
+        singleton outlives any one registry."""
+        from mythril_tpu.observe.registry import registry
+
+        reg = registry()
+        if self._backing_hist is None or self._backing_reg is not reg:
+            self._backing_reg = reg
+            self._backing_hist = reg.histogram(
+                _METRIC_NAME,
+                "host analysis wall seconds per pipeline phase",
+            )
+        return self._backing_hist
 
     # -- the backing totals (process-cumulative) -----------------------
     def _totals(self) -> Dict[str, Tuple[float, int]]:

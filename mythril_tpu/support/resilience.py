@@ -113,6 +113,18 @@ class DegradationLog(object, metaclass=Singleton):
     def record(
         self, reason: str, site: str = "", detail: str = "", contract: str = ""
     ) -> None:
+        try:
+            # the registry mirror (reason label only — site/contract
+            # stay out of the label set to bound cardinality): the SLO
+            # engine's wave-abandon objective burns against this
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_degradations_total",
+                "degradation events by reason (resilience taxonomy)",
+            ).labels(reason=reason).inc()
+        except Exception:
+            pass  # telemetry must never sink the degradation record
         with self._lock:
             self.counts[reason] = self.counts.get(reason, 0) + 1
             self.events.append(

@@ -352,9 +352,9 @@ def test_routing_features_carry_taint_block():
 
 
 def test_routing_v1_records_parse_in_tail_reader(tmp_path):
-    """The back-compat pin: a v1 JSONL line (no taint features) parses
-    through the tail reader and comes back normalized to the v2
-    column set."""
+    """The back-compat pin: a v1 JSONL line (no taint features, no
+    journey_id) parses through the tail reader and comes back
+    normalized to the current column set (v3: + journey_id)."""
     from mythril_tpu.observe.routing import (
         SCHEMA_VERSION,
         V2_FEATURE_KEYS,
@@ -362,7 +362,7 @@ def test_routing_v1_records_parse_in_tail_reader(tmp_path):
         read_records,
     )
 
-    assert SCHEMA_VERSION == 2
+    assert SCHEMA_VERSION == 3
     v1 = {
         "schema_version": 1,
         "contract": "Legacy",
@@ -390,6 +390,8 @@ def test_routing_v1_records_parse_in_tail_reader(tmp_path):
         assert key in legacy["features"]
     assert legacy["features"]["taint_density"] is None
     assert records[1]["features"]["taint_density"] == 0.5
+    # v3 normalization: pre-journey records read journey_id None
+    assert legacy["journey_id"] is None
     # a FUTURE schema refuses instead of mis-parsing
     with pytest.raises(ValueError):
         parse_record(json.dumps(dict(v1, schema_version=99)))

@@ -1,0 +1,109 @@
+"""Metric-cardinality budget guard (tier-1 `observe` marker).
+
+Label explosions are the classic Prometheus regression: a label that
+accidentally carries a job id, a code hash, or a per-request value
+grows the registry without bound and kills every scrape. Nothing
+guarded it until now. This test runs a REAL serve + analyze pass
+against a fresh registry and then asserts every metric family's
+label-set count stays inside a declared budget — adding a high-
+cardinality label becomes a test failure, not a production incident.
+
+The budgets are deliberately tight for this workload (one engine, a
+handful of jobs, one analyzed contract): a family that needs more
+series than its budget here is carrying a per-request label."""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.observe.registry import registry, reset_registry
+from mythril_tpu.service.client import ServiceClient
+from mythril_tpu.service.engine import ServiceConfig
+from mythril_tpu.service.server import AnalysisServer
+
+pytestmark = [pytest.mark.observe, pytest.mark.service]
+
+#: tiny branching contract (full wave path, no findings needed)
+WRITER = "6001600055600160015560026000f3"
+#: CALLER; SELFDESTRUCT — analyzable in one short walk
+KILLABLE = "33ff"
+
+#: per-family label-set budgets for THIS workload; everything not
+#: listed gets the default. A budget is the declared cardinality
+#: contract, not a generous ceiling — tighten when in doubt.
+DEFAULT_BUDGET = 16
+BUDGETS = {
+    # reason x verdict waterfall (loss taxonomy is ~a dozen reasons)
+    "mtpu_solver_loss_total": 48,
+    # origin x verdict
+    "mtpu_solver_queries_total": 24,
+    # per-phase wall histogram (fixed phase vocabulary)
+    "mtpu_phase_wall_seconds": 24,
+    # objective x window burn gauges
+    "mtpu_health_burn_rate": 24,
+    # explorer counter families are label-less but numerous — they
+    # appear as one series each and ride the default budget
+}
+
+
+def test_registry_cardinality_stays_inside_budget():
+    reset_registry()
+    try:
+        # -- the serve half: admission, waves, settle, health --------
+        config = ServiceConfig(
+            stripes=2,
+            lanes_per_stripe=4,
+            steps_per_wave=32,
+            max_waves=1,
+            queue_capacity=4,
+            host_walk=False,
+            coalesce_wait_s=0.02,
+            idle_wait_s=0.02,
+            health_interval_s=0.1,
+        )
+        server = AnalysisServer(config).start()
+        try:
+            client = ServiceClient(server.url)
+            for code in (WRITER, KILLABLE):
+                job_id = client.submit(code)
+                report = client.report(job_id, wait_s=120.0)
+                assert report["state"] == "done", report
+        finally:
+            server.close()
+
+        # -- the analyze half: host walk, solver, routing record -----
+        from mythril_tpu.analysis.corpus import analyze_corpus
+
+        results = analyze_corpus(
+            [(KILLABLE, "", "Killable")],
+            execution_timeout=8,
+            create_timeout=5,
+            processes=1,
+            use_device=False,
+        )
+        assert results and results[0].get("error") is None
+
+        snap = registry().snapshot()
+        assert snap, "the run registered nothing — wrong registry?"
+        over_budget = {
+            name: len(series)
+            for name, series in snap.items()
+            if len(series) > BUDGETS.get(name, DEFAULT_BUDGET)
+        }
+        assert not over_budget, (
+            "metric families exceeded their cardinality budget "
+            f"(label explosion?): {over_budget}"
+        )
+        # the run must actually have exercised the families the guard
+        # exists for — an empty snapshot proves nothing
+        for expected in (
+            "mtpu_service_waves_total",
+            "mtpu_service_jobs_settled_total",
+            "mtpu_service_job_latency_seconds",
+            "mtpu_health_state",
+        ):
+            assert expected in snap, f"{expected} missing from the run"
+    finally:
+        # later suites get a fresh registry either way; engines from
+        # this test keep writing to their own (orphaned) instance
+        reset_registry()

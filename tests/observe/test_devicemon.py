@@ -1,0 +1,92 @@
+"""Device saturation sampler (observe/devicemon.py, tier-1 `observe`
+marker): mtpu_device_* gauges on the CPU backend, the arena-occupancy
+source contract, kernel-cache gauges, the live wave overlap/idle
+fractions, and the exposition shape. CPU-only, sub-second."""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.observe.devicemon import DeviceMonitor, device_monitor
+from mythril_tpu.observe.registry import MetricsRegistry
+
+pytestmark = pytest.mark.observe
+
+
+def test_sample_publishes_cpu_backend_gauges():
+    """The acceptance floor: mtpu_device_* gauges exist on the CPU
+    backend — host RSS and device count always, memory only where the
+    backend reports it."""
+    reg = MetricsRegistry()
+    monitor = DeviceMonitor(reg=reg)
+    sample = monitor.sample()
+    assert sample["devices"] >= 1
+    assert sample["host_rss_bytes"] > 0
+    assert reg.value("mtpu_device_count") >= 1
+    assert reg.value("mtpu_device_host_rss_bytes") > 0
+    text = reg.prometheus_text()
+    assert "# TYPE mtpu_device_count gauge" in text
+    assert "# TYPE mtpu_device_host_rss_bytes gauge" in text
+    assert monitor.latest() == sample
+
+
+def test_arena_source_occupancy_gauges():
+    reg = MetricsRegistry()
+    monitor = DeviceMonitor(reg=reg)
+    monitor.set_arena_source(
+        lambda: {"lanes": 32, "lanes_busy": 24, "jobs_resident": 3}
+    )
+    sample = monitor.sample()
+    assert sample["arena"]["occupancy"] == 0.75
+    assert reg.value("mtpu_device_arena_lanes") == 32
+    assert reg.value("mtpu_device_arena_lanes_busy") == 24
+    assert reg.value("mtpu_device_arena_occupancy") == 0.75
+    assert reg.value("mtpu_device_arena_jobs_resident") == 3
+    # a broken source loses its block, never the sample
+    monitor.set_arena_source(lambda: 1 / 0)
+    sample = monitor.sample()
+    assert "arena" not in sample
+    assert sample["host_rss_bytes"] > 0
+
+
+def test_wave_fractions_recomputed_from_explore_counters():
+    reg = MetricsRegistry()
+    monitor = DeviceMonitor(reg=reg)
+    reg.counter("mtpu_explore_device_busy_s_total").inc(10.0)
+    reg.counter("mtpu_explore_wave_overlap_s_total").inc(4.0)
+    reg.counter("mtpu_explore_wall_s_total").inc(20.0)
+    sample = monitor.sample()
+    assert sample["wave_overlap_frac"] == pytest.approx(0.4)
+    assert sample["idle_frac"] == pytest.approx(0.5)
+    assert reg.value("mtpu_device_wave_overlap_frac") == pytest.approx(0.4)
+    assert reg.value("mtpu_device_idle_frac") == pytest.approx(0.5)
+
+
+def test_explore_publish_promotes_derived_ratio_gauges():
+    """publish_explore_stats now lands the per-run derived ratios as
+    live gauges (last run wins) beside the summed counters."""
+    from mythril_tpu.laser.batch.explore import publish_explore_stats
+    from mythril_tpu.observe.registry import registry
+
+    publish_explore_stats(
+        {"wave_overlap_ratio": 0.62, "device_idle_frac": 0.08}
+    )
+    assert registry().value(
+        "mtpu_explore_wave_overlap_ratio"
+    ) == pytest.approx(0.62)
+    assert registry().value(
+        "mtpu_explore_device_idle_frac"
+    ) == pytest.approx(0.08)
+
+
+def test_process_monitor_is_shared():
+    assert device_monitor() is device_monitor()
+
+
+def test_kernel_cache_gauges_present():
+    reg = MetricsRegistry()
+    sample = DeviceMonitor(reg=reg).sample()
+    assert "kernel_cache" in sample
+    text = reg.prometheus_text()
+    assert "# TYPE mtpu_device_kernel_cache_size gauge" in text
+    assert "# TYPE mtpu_device_kernel_compiles_in_flight gauge" in text

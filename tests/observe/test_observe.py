@@ -159,6 +159,97 @@ def test_collector_samples_merge_into_snapshot():
 
 
 # ---------------------------------------------------------------------------
+# per-metric histogram bucket overrides (ISSUE 12)
+# ---------------------------------------------------------------------------
+def test_histogram_bucket_override_semantics():
+    from mythril_tpu.observe.registry import DEFAULT_BUCKETS
+
+    reg = MetricsRegistry()
+    # a default-bucket registration followed by an explicit override
+    # while the series is still empty: the override wins
+    h = reg.histogram("ob_wall_seconds")
+    assert h.buckets == DEFAULT_BUCKETS
+    h = reg.histogram("ob_wall_seconds", buckets=(0.001, 0.01, 0.1))
+    assert h.buckets == (0.001, 0.01, 0.1)
+    assert reg.buckets_of("ob_wall_seconds") == (0.001, 0.01, 0.1)
+    # a later DEFAULT-bucket re-registration (a generic call site)
+    # never clobbers the explicit ladder
+    h = reg.histogram("ob_wall_seconds")
+    assert h.buckets == (0.001, 0.01, 0.1)
+    # once observations exist, a conflicting explicit ladder is
+    # ignored — bucket counts are meaningless across a switch
+    h.observe(0.05)
+    h = reg.histogram("ob_wall_seconds", buckets=(1.0, 2.0))
+    assert h.buckets == (0.001, 0.01, 0.1)
+
+
+def test_job_latency_rebucket_exposition_golden():
+    """The re-bucketed job-latency ladder: a ~1.9ms store hit and a
+    ~21s cold walk (the BENCH_r06 spectrum) land in DISTINCT buckets
+    — the default ladder crushed everything under 5ms into one. The
+    exposition is pinned exactly."""
+    from mythril_tpu.observe.registry import LATENCY_BUCKETS
+
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "jl_latency_seconds", "submit-to-terminal latency",
+        buckets=LATENCY_BUCKETS,
+    )
+    h.observe(0.0019)  # the warm store hit
+    h.observe(0.0021)  # a second warm settle
+    h.observe(21.0)  # the cold walk
+    text = reg.prometheus_text()
+    assert text == (
+        "# HELP jl_latency_seconds submit-to-terminal latency\n"
+        "# TYPE jl_latency_seconds histogram\n"
+        'jl_latency_seconds_bucket{le="0.0005"} 0\n'
+        'jl_latency_seconds_bucket{le="0.001"} 0\n'
+        'jl_latency_seconds_bucket{le="0.002"} 1\n'
+        'jl_latency_seconds_bucket{le="0.005"} 2\n'
+        'jl_latency_seconds_bucket{le="0.01"} 2\n'
+        'jl_latency_seconds_bucket{le="0.025"} 2\n'
+        'jl_latency_seconds_bucket{le="0.05"} 2\n'
+        'jl_latency_seconds_bucket{le="0.1"} 2\n'
+        'jl_latency_seconds_bucket{le="0.25"} 2\n'
+        'jl_latency_seconds_bucket{le="0.5"} 2\n'
+        'jl_latency_seconds_bucket{le="1"} 2\n'
+        'jl_latency_seconds_bucket{le="2.5"} 2\n'
+        'jl_latency_seconds_bucket{le="5"} 2\n'
+        'jl_latency_seconds_bucket{le="10"} 2\n'
+        'jl_latency_seconds_bucket{le="30"} 3\n'
+        'jl_latency_seconds_bucket{le="60"} 3\n'
+        'jl_latency_seconds_bucket{le="120"} 3\n'
+        'jl_latency_seconds_bucket{le="+Inf"} 3\n'
+        "jl_latency_seconds_sum 21.004\n"
+        "jl_latency_seconds_count 3\n"
+    )
+
+
+def test_service_and_solver_histograms_ride_their_ladders():
+    """The two production histograms the satellite re-buckets: the
+    service job-latency series and the per-query solver wall."""
+    from mythril_tpu.observe.registry import (
+        LATENCY_BUCKETS,
+        SOLVER_WALL_BUCKETS,
+        registry as global_registry,
+    )
+    from mythril_tpu.service.jobs import Job, JobQueue
+
+    queue = JobQueue(4)
+    job = Job(code_hex="6001")
+    queue.submit(job)
+    queue.settle(job, "done")
+    assert global_registry().buckets_of(
+        "mtpu_service_job_latency_seconds"
+    ) == LATENCY_BUCKETS
+
+    observe.record_query("host-cdcl", "sat", wall_s=0.002)
+    assert global_registry().buckets_of(
+        "mtpu_solver_query_seconds"
+    ) == SOLVER_WALL_BUCKETS
+
+
+# ---------------------------------------------------------------------------
 # spans + flight recorder
 # ---------------------------------------------------------------------------
 def test_span_nesting_and_ordering_under_threads():

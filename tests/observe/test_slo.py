@@ -1,0 +1,258 @@
+"""The tier-ladder SLO engine + health state machine (observe/slo.py,
+tier-1 `observe` marker).
+
+Pins: burn-rate arithmetic for ratio and latency objectives over a
+synthetic registry with a fake clock, the multi-window flap damper,
+the ok -> degraded -> redlined -> ok transitions, the enumerated
+readiness reasons, and the mtpu_health_* gauge exports. CPU-only,
+no service, sub-second."""
+
+from __future__ import annotations
+
+import pytest
+
+from mythril_tpu.observe.registry import (
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+)
+from mythril_tpu.observe.slo import (
+    NOT_READY_DRAINING,
+    NOT_READY_KERNEL_WARMUP,
+    NOT_READY_WARMING,
+    STATE_DEGRADED,
+    STATE_OK,
+    STATE_REDLINED,
+    HealthMonitor,
+    Objective,
+    SloEngine,
+    quantile_from_buckets,
+)
+
+pytestmark = pytest.mark.observe
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def ratio_engine(reg, clock, budget=0.1, **kw):
+    objective = Objective(
+        name="avail",
+        kind="ratio",
+        budget=budget,
+        numerator=("bad_total", {"outcome": "bad"}),
+        denominator=("all_total", {}),
+    )
+    return objective, SloEngine(
+        [objective], short_window_s=10.0, long_window_s=60.0,
+        redline_burn=10.0, reg=reg, clock=clock, **kw
+    )
+
+
+def test_ratio_objective_burn_and_states():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    _obj, engine = ratio_engine(reg, clock, budget=0.1)
+    bad = reg.counter("bad_total").labels(outcome="bad")
+    total = reg.counter("all_total")
+
+    # the FIRST sample has no window: zero burn regardless of what
+    # the registry accumulated before this engine existed
+    bad.inc(3)
+    total.inc(3)
+    (status,) = engine.sample()
+    assert status.state == STATE_OK and status.burn_short == 0.0
+
+    # healthy traffic: 100 events, 1 bad -> fraction 0.01, burn 0.1
+    total.inc(100)
+    bad.inc(1)
+    clock.advance(1.0)
+    (status,) = engine.sample()
+    assert status.state == STATE_OK
+    assert status.burn_short == pytest.approx(0.1)
+
+    # near-budget traffic: 9% bad in the short window (the earlier
+    # samples age out of it) -> burn just under 1.0
+    clock.advance(11.0)
+    total.inc(100)
+    bad.inc(9)
+    (status,) = engine.sample()
+    assert status.burn_short == pytest.approx(0.9, abs=0.01)
+
+    # a bad storm: 100% bad events -> burn 10 on the short window,
+    # and once the long window agrees the state redlines
+    for _ in range(4):
+        clock.advance(2.0)
+        total.inc(50)
+        bad.inc(50)
+        (status,) = engine.sample()
+    assert status.burn_short >= 10.0
+    assert status.state in (STATE_DEGRADED, STATE_REDLINED)
+
+    # recovery: clean traffic drains the short window first
+    for _ in range(8):
+        clock.advance(2.0)
+        total.inc(200)
+        (status,) = engine.sample()
+    assert status.state == STATE_OK
+
+
+def test_multi_window_damps_one_sample_spike():
+    """A single bad burst inside an otherwise long clean history must
+    NOT degrade: the long window has not burned."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    _obj, engine = ratio_engine(reg, clock, budget=0.01)
+    total = reg.counter("all_total")
+    bad = reg.counter("bad_total").labels(outcome="bad")
+    # a minute of clean traffic fills the long window
+    for _ in range(30):
+        clock.advance(2.0)
+        total.inc(100)
+        engine.sample()
+    # one hot sample: 50% bad for one tick
+    clock.advance(2.0)
+    total.inc(10)
+    bad.inc(5)
+    (status,) = engine.sample()
+    assert status.burn_short > 1.0
+    assert status.burn_long < 1.0  # diluted by the clean hour
+    assert status.state == STATE_OK
+
+
+def test_latency_objective_counts_threshold_violations():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    objective = Objective(
+        name="settle-p95",
+        kind="latency",
+        budget=0.05,
+        metric="lat_seconds",
+        threshold_s=1.0,
+    )
+    engine = SloEngine(
+        [objective], short_window_s=10.0, long_window_s=60.0,
+        reg=reg, clock=clock,
+    )
+    engine.sample()  # the windowless first sample primes the ring
+    clock.advance(1.0)
+    hist = reg.histogram("lat_seconds", buckets=LATENCY_BUCKETS)
+    for _ in range(95):
+        hist.observe(0.01)
+    for _ in range(5):
+        hist.observe(20.0)
+    clock.advance(1.0)
+    (status,) = engine.sample()
+    # 5/100 above 1.0s at budget 0.05 -> burn exactly 1.0
+    assert status.burn_short == pytest.approx(1.0)
+    assert status.p95 is not None
+    # now a stall: everything lands above the threshold (the clean
+    # batch ages out of the short window)
+    clock.advance(9.0)
+    for _ in range(50):
+        hist.observe(30.0)
+    (status,) = engine.sample()
+    assert status.burn_short == pytest.approx(20.0)  # 100% / 5%
+    assert status.p95 > 1.0
+
+
+def test_idle_replica_reports_zero_burn():
+    """min_events: a replica with no traffic is healthy, not
+    divide-by-zero degraded."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    _obj, engine = ratio_engine(reg, clock)
+    clock.advance(5.0)
+    (status,) = engine.sample()
+    assert status.state == STATE_OK
+    assert status.burn_short == 0.0 and status.total == 0.0
+
+
+def test_quantile_interpolation():
+    bounds = (1.0, 2.0, 4.0)
+    counts = [10, 10, 0, 0]  # 20 observations, all <= 2.0
+    assert quantile_from_buckets(bounds, counts, 0.5) == pytest.approx(1.0)
+    p95 = quantile_from_buckets(bounds, counts, 0.95)
+    assert 1.0 < p95 <= 2.0
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.95) is None
+
+
+def test_health_monitor_readiness_reasons_and_gauges():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    _obj, engine = ratio_engine(reg, clock)
+    flags = {"warming": True, "compiling": False, "draining": False}
+    monitor = HealthMonitor(
+        slo=engine,
+        warming_fn=lambda: flags["warming"],
+        compiling_fn=lambda: flags["compiling"],
+        draining_fn=lambda: flags["draining"],
+        reg=reg,
+    )
+    payload = monitor.sample()
+    assert payload["ok"] is True  # liveness holds while warming
+    assert payload["ready"] is False
+    assert payload["not_ready_reasons"] == [NOT_READY_WARMING]
+    assert reg.value("mtpu_health_ready") == 0.0
+
+    flags["warming"] = False
+    flags["compiling"] = True
+    payload = monitor.sample()
+    assert payload["not_ready_reasons"] == [NOT_READY_KERNEL_WARMUP]
+
+    flags["compiling"] = False
+    payload = monitor.sample()
+    assert payload["ready"] is True and payload["state"] == STATE_OK
+    assert reg.value("mtpu_health_state") == 0.0
+    assert reg.value("mtpu_health_ready") == 1.0
+
+    flags["draining"] = True
+    payload = monitor.sample()
+    assert payload["ready"] is False
+    assert payload["not_ready_reasons"] == [NOT_READY_DRAINING]
+
+
+def test_health_monitor_redlines_on_slo_burn_and_saturation():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    _obj, engine = ratio_engine(reg, clock, budget=0.01)
+    saturated: list = []
+    monitor = HealthMonitor(
+        slo=engine, saturation_fn=lambda: list(saturated), reg=reg
+    )
+    total = reg.counter("all_total")
+    bad = reg.counter("bad_total").labels(outcome="bad")
+    for _ in range(6):
+        clock.advance(2.0)
+        total.inc(100)
+        bad.inc(100)
+        payload = monitor.sample()
+    assert payload["state"] == STATE_REDLINED
+    assert any(
+        r.startswith("slo-burn:avail") for r in payload["reasons"]
+    )
+    assert payload["ready"] is False
+    assert reg.value("mtpu_health_state") == 2.0
+    # burn-rate gauges exported per objective x window
+    assert reg.value(
+        "mtpu_health_burn_rate", objective="avail", window="short"
+    ) >= 10.0
+
+    # saturation reasons redline independently of the SLO windows
+    saturated.append("queue-saturated")
+    reg2 = MetricsRegistry()
+    monitor2 = HealthMonitor(
+        slo=SloEngine([], reg=reg2, clock=clock),
+        saturation_fn=lambda: list(saturated),
+        reg=reg2,
+    )
+    payload = monitor2.sample()
+    assert payload["state"] == STATE_REDLINED
+    assert "queue-saturated" in payload["reasons"]

@@ -81,17 +81,39 @@ def main() -> int:
         max_waves=args.waves,
         host_walk=False,  # the smoke measures the service path itself
         coalesce_wait_s=0.1,
+        arena_warmup=True,  # the readiness machine under test
+        health_interval_s=0.25,
     )
     server = AnalysisServer(config).start()
     server.install_signal_handlers()  # the SIGTERM drain under test
     client = ServiceClient(server.url)
     t_start = time.monotonic()
 
+    # -- 0. health state machine: not-ready while the arena warms ------
+    # start() launched the warmup compile microseconds ago; the compile
+    # is orders of magnitude slower than this first poll
+    health_boot = client.healthz()
+    warming_seen = (
+        not health_boot["ready"]
+        and "arena-warming" in health_boot["not_ready_reasons"]
+    ) or server.engine._warm_done.is_set()  # lost the (huge) race
+
     # -- 1. cold request: pays the kernel compile ----------------------
     t0 = time.monotonic()
     cold_id = client.submit(codes[0])
+    # readiness must flip BEFORE the first job settles: the warmup
+    # compile lands, then the job still needs its waves + settle
+    t_ready = None
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline:
+        if client.healthz()["ready"]:
+            t_ready = time.monotonic()
+            break
+        time.sleep(0.1)
     cold_job = client.report(cold_id, wait_s=300.0)
-    cold_s = time.monotonic() - t0
+    t_settled = time.monotonic()
+    cold_s = t_settled - t0
+    health_serving = client.healthz()
 
     # -- 2. four concurrent warm requests ------------------------------
     warm: dict = {}
@@ -130,9 +152,25 @@ def main() -> int:
     warm_latencies = sorted(lat for lat, _ in warm.values())
     warm_p50 = statistics.median(warm_latencies)
 
+    # the journey endpoint on a full-path job: the cold request walked
+    # the whole ladder, so its tier sequence must say so
+    trace_doc = client._request(f"/v1/jobs/{cold_id}/trace")
+
     # -- 3. SIGTERM drain with work still in the pipe -------------------
     drain_ids = [client.submit(code) for code in codes[:2]]
     os.kill(os.getpid(), signal.SIGTERM)
+    # while the drain runs, readiness must report the draining reason
+    # (the HTTP listener stays up until the drain completes)
+    drain_health = None
+    for _ in range(50):
+        try:
+            h = client.healthz()
+        except Exception:
+            break  # drain already completed and closed the listener
+        if h.get("draining"):
+            drain_health = h
+            break
+        time.sleep(0.05)
     drained = server.drained(timeout_s=180.0)
 
     summary = {
@@ -146,8 +184,42 @@ def main() -> int:
         "drain": {},
     }
     try:
+        # -- health state machine (ISSUE 12) ---------------------------
+        assert warming_seen, (
+            f"boot /healthz never reported arena-warming: {health_boot}"
+        )
+        assert t_ready is not None, "readiness never flipped true"
+        assert t_ready <= t_settled, (
+            "readiness flipped AFTER the first job settled"
+        )
+        assert health_serving["ready"] is True, health_serving
+        assert health_serving["state"] in ("ok", "degraded"), (
+            health_serving
+        )
+        assert "# TYPE mtpu_health_state gauge" in metrics_text, (
+            "/metrics lost the mtpu_health_state gauge"
+        )
+        assert metric_total("mtpu_health_state") is not None
+        # device saturation gauges on the CPU backend (acceptance)
+        for series in (
+            "mtpu_device_arena_lanes",
+            "mtpu_device_host_rss_bytes",
+        ):
+            assert f"# TYPE {series} gauge" in metrics_text, (
+                f"/metrics lost the {series} saturation gauge"
+            )
+        assert drain_health is None or (
+            drain_health["ready"] is False
+            and "draining" in drain_health["not_ready_reasons"]
+        ), f"draining healthz lacks the reason: {drain_health}"
+        # the cold job's journey: the full ladder, in order
+        tiers = trace_doc.get("tiers") or []
+        assert tiers[:1] == ["admission"], trace_doc
+        assert "wave" in tiers and tiers[-1] == "settle", tiers
+        assert "queued" in tiers and "lane-grant" in tiers, tiers
+        summary["journey_tiers"] = tiers
         # -- telemetry exposition (ISSUE 7) ----------------------------
-        assert stats.get("schema_version") == 2, (
+        assert stats.get("schema_version") == 3, (
             f"/stats schema_version missing/unexpected: "
             f"{stats.get('schema_version')}"
         )
