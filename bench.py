@@ -247,7 +247,9 @@ def bench_specialize_ab(dev: dict) -> dict:
 
     def timed(max_steps: int):
         t0 = time.perf_counter()
-        out, steps, fused = kern.run(batch, code, fuse, max_steps=max_steps)
+        out, steps, fused, _blocks = kern.run(
+            batch, code, fuse, max_steps=max_steps
+        )
         sync = int(np.asarray(out.pc).sum())  # forced readback
         n_fused = int(fused)
         dt = time.perf_counter() - t0
@@ -271,6 +273,107 @@ def bench_specialize_ab(dev: dict) -> dict:
         out["generic_step_rate"] = round(dev["rate"], 1)
         out["specialize_speedup"] = round(spec_rate / dev["rate"], 3)
     print(f"bench: specialize A/B {out}", file=sys.stderr)
+    return out
+
+
+#: the blockjit A/B's own lane count: the SPEEDUP is a ratio of two
+#: legs at the same shape, so it does not need the headline's 16k
+#: lanes — a smaller shape keeps both compiles + runs inside the leg
+#: deadline on a 1-core host
+BJ_LANES = int(os.environ.get("MYTHRIL_BENCH_BJ_LANES", "2048"))
+
+
+def _blockjit_workload(n_lanes: int):
+    """The block-JIT A/B workload: an arithmetic/compare/bitwise loop
+    body — the straight-line chains PR-6 fusion cannot advance (every
+    ALU op breaks a PUSH/DUP/SWAP run) but block lowering can. One
+    CFG block per pass: JUMPDEST; (MUL, ADD, XOR, DUP/EQ/POP mix);
+    JUMP — the dominant compiled-Solidity shape for hashing/math-heavy
+    function bodies."""
+    import numpy as np
+
+    from mythril_tpu.laser.batch.state import make_batch, make_code_table
+
+    body = bytes([
+        0x60, 0x01,        # PUSH1 1 (seed)
+        0x5B,              # 2: JUMPDEST  — loop head
+        0x60, 0x03, 0x02,  # PUSH1 3; MUL
+        0x60, 0x07, 0x01,  # PUSH1 7; ADD
+        0x60, 0x55, 0x18,  # PUSH1 0x55; XOR
+        0x80, 0x60, 0x2A,  # DUP1; PUSH1 42
+        0x10, 0x50,        # LT; POP
+        0x80, 0x19, 0x16,  # DUP1; NOT; AND
+        0x60, 0x02, 0x56,  # PUSH1 2; JUMP
+    ])
+    code = make_code_table([body])
+    rng = np.random.default_rng(1)
+    calldata = [rng.integers(0, 256, 36, dtype=np.uint8).tobytes()
+                for _ in range(n_lanes)]
+    batch = make_batch(n_lanes, calldata=calldata)
+    return batch, code, body
+
+
+def bench_blockjit_ab() -> dict:
+    """Specialized-vs-blockjit step-throughput A/B (ISSUE 13): the
+    SAME ALU-dense workload timed on the PR-6 specialized kernel
+    (phase pruning + superblock fusion, block_depth=0) and on the
+    block-JIT kernel (whole lowered CFG blocks per iteration). Both
+    legs count executed EVM instructions per second (full steps x
+    lanes + substep-advanced instructions), so the speedup is the
+    honest blocks-vs-stack-shuffles ratio the acceptance gates on."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from mythril_tpu.laser.batch import blockjit as bj_mod
+    from mythril_tpu.laser.batch import ensure_compile_cache
+    from mythril_tpu.laser.batch import specialize as spec_mod
+
+    ensure_compile_cache()  # both legs' compiles persist across runs
+    batch, code, raw = _blockjit_workload(BJ_LANES)
+    cap = code.ops.shape[1] - 33
+    signature = spec_mod.signature_for(raw)
+    fuse_on = spec_mod.fuse_profitable(raw)
+    spec_phases = spec_mod.phases_for(signature, fuse=fuse_on)
+    depth = bj_mod.block_depth_for(raw)
+    bj_phases = spec_mod.phases_for(
+        signature, fuse=fuse_on, block_depth=depth
+    )
+    fuse_tbl = jnp.asarray(spec_mod.build_fuse_table([raw], cap))
+    block_tbl = jnp.asarray(bj_mod.build_block_table([raw], cap))
+    bstats = bj_mod.block_stats(raw)
+
+    def timed(phases, tbl, max_steps: int):
+        kern = spec_mod.kernel_cache().get(phases)
+        t0 = time.perf_counter()
+        out, steps, subs, blocks = kern.run(
+            batch, code, tbl, max_steps=max_steps
+        )
+        sync = int(np.asarray(out.pc).sum())  # forced readback
+        n_subs, n_blocks = int(subs), int(blocks)
+        dt = time.perf_counter() - t0
+        assert sync >= 0
+        return dt, int(steps), n_subs, n_blocks
+
+    # warmup both compiles, then time
+    timed(spec_phases, fuse_tbl, N_STEPS)
+    timed(bj_phases, block_tbl, N_STEPS)
+    s_dt, s_steps, s_subs, _ = timed(spec_phases, fuse_tbl, N_STEPS)
+    b_dt, b_steps, b_subs, b_blocks = timed(bj_phases, block_tbl, N_STEPS)
+    spec_rate = (BJ_LANES * s_steps + s_subs) / s_dt
+    bj_rate = (BJ_LANES * b_steps + b_subs) / b_dt
+    out = {
+        "blockjit_step_rate": round(bj_rate, 1),
+        "blockjit_wall_s": round(b_dt, 3),
+        "blockjit_substep_steps": b_subs,
+        "blockjit_block_rate": round(b_blocks / b_dt, 1),
+        "blockjit_speedup": round(bj_rate / spec_rate, 3),
+        "blockjit_depth": depth,
+        "blockjit_fallback_blocks": bstats["blocks_unlowered"],
+        "blockjit_lowered_density": bstats["lowered_density"],
+        "spec_leg_step_rate": round(spec_rate, 1),
+    }
+    print(f"bench: blockjit A/B {out}", file=sys.stderr)
     return out
 
 
@@ -1137,6 +1240,25 @@ def main(final_attempt: bool = False) -> None:
         except Exception as e:
             record["specialize_ab"] = "failed"
             print(f"bench: specialize A/B failed: {e!r}", file=sys.stderr)
+
+    # -- specialized-vs-blockjit step-throughput A/B (ISSUE 13) -------
+    if _budget_left() < 120:
+        record["blockjit_ab"] = "budget-skipped"
+        print("bench: blockjit A/B skipped", file=sys.stderr)
+    else:
+        try:
+            record.update(
+                _with_deadline(
+                    bench_blockjit_ab,
+                    max(30, min(300, int(_budget_left() - 60))),
+                )
+            )
+        except _Deadline:
+            record["blockjit_ab"] = "deadline"
+            print("bench: blockjit A/B hit its deadline", file=sys.stderr)
+        except Exception as e:
+            record["blockjit_ab"] = "failed"
+            print(f"bench: blockjit A/B failed: {e!r}", file=sys.stderr)
 
     # -- headline convergence pair (bounded by the headline window) ---
     conv = None

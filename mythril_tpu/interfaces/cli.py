@@ -395,6 +395,19 @@ ANALYZE_OPTION_FLAGS = [
         ),
     ),
     (
+        ("--no-blockjit",),
+        dict(
+            action="store_true",
+            help=(
+                "Disable the block-level JIT (whole CFG basic blocks "
+                "advanced per kernel iteration): specialized kernels "
+                "fall back to PR-6 superblock fusion only — the "
+                "differential baseline for a suspected block-lowering "
+                "bug (env: MYTHRIL_NO_BLOCKJIT=1)"
+            ),
+        ),
+    ),
+    (
         ("--host-first-funnel",),
         dict(
             action="store_true",
@@ -870,6 +883,14 @@ def build_parser() -> ArgumentParser:
             "disable contract-specialized step kernels (phase "
             "pruning + superblock fusion); every wave runs the "
             "generic interpreter"
+        ),
+    )
+    serve.add_argument(
+        "--no-blockjit",
+        action="store_true",
+        help=(
+            "disable the block-level JIT; specialized kernels keep "
+            "superblock fusion only (env: MYTHRIL_NO_BLOCKJIT=1)"
         ),
     )
     serve.add_argument(
@@ -1525,6 +1546,7 @@ def _run_analyze(disassembler, address, args):
         static_prune=not args.no_static_prune,
         pipeline=not args.no_pipeline,
         specialize=not args.no_specialize,
+        blockjit=not args.no_blockjit,
         mesh_devices=args.devices,
         deadline=args.deadline,
         on_timeout=args.on_timeout,
@@ -1670,6 +1692,12 @@ def _cmd_serve(args: Namespace) -> None:
         from mythril_tpu.support.support_args import args as support_args
 
         support_args.static_prune = False
+    if args.no_blockjit:
+        # the process-wide switch: blockjit_enabled() consumers
+        # outside the engine config (CodeCache feeds) read the bag
+        from mythril_tpu.support.support_args import args as support_args
+
+        support_args.blockjit = False
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,
@@ -1683,6 +1711,7 @@ def _cmd_serve(args: Namespace) -> None:
         checkpoint_dir=args.checkpoint_dir,
         pipeline=not args.no_pipeline,
         specialize=not args.no_specialize,
+        blockjit=not args.no_blockjit,
         devices=args.devices,
         static_answer=not (
             args.no_static_answer or args.no_static_prune

@@ -202,6 +202,21 @@ class ExploreStats:
         #: wave retries that fell back to the generic kernel (the
         #: resilience ladder never re-dispatches specialized)
         self.spec_fallbacks = 0
+        # -- block-level JIT observability (blockjit.py) ---------------
+        #: instructions advanced by block substeps ON TOP of the
+        #: full-step active count (the blockjit twin of
+        #: spec_fused_steps — a wave counts into one or the other,
+        #: never both)
+        self.blockjit_steps = 0
+        #: lowered basic blocks entered through a block head by a
+        #: block substep
+        self.blockjit_blocks = 0
+        #: basic blocks across this exploration's contracts that the
+        #: lowering classified NOT lowerable (calls, storage/memory
+        #: effects, env reads, unresolved jumps, foreign opcodes) —
+        #: those blocks run on the generic per-opcode step, attributed
+        #: here, never silently mis-executed
+        self.blockjit_fallbacks = 0
         #: this explorer's kernel-cache lookups (process-wide LRU)
         self.kernel_cache_hits = 0
         self.kernel_cache_misses = 0
@@ -284,6 +299,9 @@ MERGE_POLICY: Dict[str, str] = {
     "spec_pruned_phases": "max",
     "spec_fused_steps": "sum",
     "spec_fallbacks": "sum",
+    "blockjit_steps": "sum",
+    "blockjit_blocks": "sum",
+    "blockjit_fallbacks": "sum",
     "kernel_cache_hits": "sum",
     "kernel_cache_misses": "sum",
     "kernel_compile_s": "sum",
@@ -837,7 +855,8 @@ class _Inflight:
     """A dispatched, not-yet-harvested wave."""
 
     __slots__ = (
-        "payload", "out", "steps", "active", "fused", "dispatch_t", "failed",
+        "payload", "out", "steps", "active", "fused", "blocks",
+        "dispatch_t", "failed",
     )
 
     def __init__(self, payload: _WavePayload) -> None:
@@ -845,7 +864,8 @@ class _Inflight:
         self.out = None
         self.steps = None
         self.active = None
-        self.fused = None  # fused-substep lane-steps (specialized waves)
+        self.fused = None  # substep lane-steps (specialized waves)
+        self.blocks = None  # lowered blocks entered (blockjit waves)
         self.dispatch_t = None
         self.failed = None
 
@@ -1046,17 +1066,39 @@ class DeviceCorpusExplorer:
             specialize = specialize_enabled()
         if specialize:
             try:
+                from mythril_tpu.laser.batch import blockjit as _bj
                 from mythril_tpu.laser.batch import specialize as _spec
 
+                blockjit_on = _bj.blockjit_enabled()
                 for track, code in zip(self.tracks, self.codes):
                     track.phases = _spec.phases_for(
                         _spec.signature_for(code, track.static),
-                        fuse=_spec.fuse_profitable(code),
+                        fuse=_spec.fuse_profitable(code, track.static),
+                        block_depth=(
+                            _bj.block_depth_for(code, track.static)
+                            if blockjit_on
+                            else 0
+                        ),
                     )
                 self.kernel_phases = _spec.union_phases(
                     [t.phases for t in self.tracks]
                 )
-                fuse_np = _spec.build_fuse_table(self.codes, cap)
+                summaries = [t.static for t in self.tracks]
+                if self.kernel_phases.block_depth > 0:
+                    # the block-program table replaces the fuse table:
+                    # its rows carry the fusible marks too, so fusion
+                    # rides the block substeps for every lane
+                    fuse_np = _bj.build_block_table(
+                        self.codes, cap, summaries
+                    )
+                    self.stats.blockjit_fallbacks = sum(
+                        _bj.block_stats(code, static)["blocks_unlowered"]
+                        for code, static in zip(self.codes, summaries)
+                    )
+                else:
+                    fuse_np = _spec.build_fuse_table(
+                        self.codes, cap, summaries
+                    )
                 import jax.numpy as jnp
 
                 self._fuse_tbl = jnp.asarray(fuse_np)
@@ -1725,8 +1767,9 @@ class DeviceCorpusExplorer:
                     sym = self._cold_sym(payload)
                 if self._kernel is not None:
                     # the contract-specialized kernel: pruned phases +
-                    # fused superblock substeps (specialize.py)
-                    fl.out, fl.steps, fl.active, fl.fused = (
+                    # block/superblock substeps (specialize.py,
+                    # blockjit.py)
+                    fl.out, fl.steps, fl.active, fl.fused, fl.blocks = (
                         self._kernel.sym_run(
                             sym,
                             self.code_table,
@@ -1797,7 +1840,7 @@ class DeviceCorpusExplorer:
         from mythril_tpu.support import resilience
 
         wait0 = time.perf_counter()
-        fused = None
+        fused = blocks = None
         with trace(
             "wave.harvest",
             track=self.fault_domain,
@@ -1809,6 +1852,7 @@ class DeviceCorpusExplorer:
                     jax.block_until_ready(fl.steps)
                     out, steps, active = fl.out, fl.steps, fl.active
                     fused = fl.fused
+                    blocks = fl.blocks
                 except Exception as why:
                     if not resilience.is_device_fault(why):
                         raise
@@ -1843,13 +1887,23 @@ class DeviceCorpusExplorer:
         self.stats.waves += 1
         self.stats.device_steps += int(active)
         if fused is not None:
-            # instructions the fused substeps advanced beyond the
-            # full-step active count (specialized waves only) — kept
-            # BESIDE device_steps, whose active-lanes-per-full-step
-            # semantics the utilization comparison against
-            # device_steps_raw pins; total instructions executed is
-            # device_steps + spec_fused_steps
-            self.stats.spec_fused_steps += int(fused)
+            # instructions the substeps advanced beyond the full-step
+            # active count (specialized waves only) — kept BESIDE
+            # device_steps, whose active-lanes-per-full-step semantics
+            # the utilization comparison against device_steps_raw
+            # pins; total instructions executed is device_steps +
+            # spec_fused_steps + blockjit_steps. A blockjit wave's
+            # substeps count into blockjit_steps, a fuse-only wave's
+            # into spec_fused_steps — one or the other, never both.
+            if (
+                self.kernel_phases is not None
+                and self.kernel_phases.block_depth > 0
+            ):
+                self.stats.blockjit_steps += int(fused)
+                if blocks is not None:
+                    self.stats.blockjit_blocks += int(blocks)
+            else:
+                self.stats.spec_fused_steps += int(fused)
         self.stats.device_steps_raw += int(steps) * len(fl.payload.flat)
         self.stats.evidence_bytes += view.bytes_fetched
         self.stats.evidence_bytes_full += view.bytes_full

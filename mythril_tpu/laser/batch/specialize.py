@@ -124,22 +124,32 @@ def signature_for(code: bytes, summary=None) -> frozenset:
     return frozenset(names)
 
 
-def phases_for(signature: Iterable[str], fuse: bool = True) -> PhaseSet:
+def phases_for(
+    signature: Iterable[str], fuse: bool = True, block_depth: int = 0
+) -> PhaseSet:
     """The opcode-set pruning decision: a phase stays lowered iff the
     signature reaches at least one of its opcodes. This IS the
     specialization bucket — phase-granular on purpose, so contracts
-    differing only inside a phase share one compiled kernel."""
+    differing only inside a phase share one compiled kernel.
+    `block_depth` > 0 swaps the fused substeps for block substeps
+    (blockjit.py) and is part of the bucket key — the block-program
+    keys the phase-bucket KernelCache carries."""
     signature = set(signature)
     flags = {
         flag: any(opname in signature for opname in ops)
         for flag, ops in PHASE_OPS.items()
     }
-    return PhaseSet(**flags, fuse_depth=FUSE_DEPTH if fuse else 0)
+    return PhaseSet(
+        **flags,
+        fuse_depth=FUSE_DEPTH if fuse else 0,
+        block_depth=int(block_depth),
+    )
 
 
 def union_phases(phase_sets: Iterable[PhaseSet]) -> PhaseSet:
     """The bucket of a multi-contract wave: a phase is lowered iff ANY
-    striped contract needs it (sound for every lane)."""
+    striped contract needs it (sound for every lane), and the substep
+    depths take the max — a non-profiting lane just skips substeps."""
     phase_sets = list(phase_sets)
     if not phase_sets:
         return GENERIC_PHASES
@@ -148,16 +158,46 @@ def union_phases(phase_sets: Iterable[PhaseSet]) -> PhaseSet:
         for name in PHASE_FLAGS
     }
     return PhaseSet(
-        **merged, fuse_depth=max(ph.fuse_depth for ph in phase_sets)
+        **merged,
+        fuse_depth=max(ph.fuse_depth for ph in phase_sets),
+        block_depth=max(ph.block_depth for ph in phase_sets),
     )
 
 
-def build_fuse_row(code: bytes, code_cap: int) -> np.ndarray:
+#: fusible opcode NAMES (the CFG-walk twin of _FUSE_BYTES)
+_FUSE_NAMES = frozenset(
+    [f"PUSH{i}" for i in range(1, 33)]
+    + [f"DUP{i}" for i in range(1, 17)]
+    + [f"SWAP{i}" for i in range(1, 17)]
+    + ["POP", "JUMPDEST"]
+)
+
+
+def _summary_cfg(summary):
+    """The static summary's recovered CFG, or None (no summary, or a
+    feed without one)."""
+    if summary is None:
+        return None
+    return getattr(summary, "cfg", None)
+
+
+def build_fuse_row(code: bytes, code_cap: int, summary=None) -> np.ndarray:
     """u8[code_cap]: 1 at every pc whose instruction is fusible — the
     superblock membership table. Runs of consecutive 1s (in execution
     order, PUSH immediates skipped) are the superblocks the fused
-    substeps advance; boundaries fall at the first non-fusible op."""
+    substeps advance; boundaries fall at the first non-fusible op.
+
+    With a static summary the marks come from ITS CFG's instruction
+    list (so fusion and the block JIT agree on instruction alignment
+    and block boundaries — one decomposition, two consumers); the raw
+    PUSH-following sweep is the summary-less fallback."""
     row = np.zeros((code_cap,), np.uint8)
+    cfg = _summary_cfg(summary)
+    if cfg is not None:
+        for ins in cfg.instructions:
+            if ins.opcode in _FUSE_NAMES and ins.address < code_cap:
+                row[ins.address] = 1
+        return row
     pc, n = 0, len(code)
     while pc < n and pc < code_cap:
         op = code[pc]
@@ -167,15 +207,46 @@ def build_fuse_row(code: bytes, code_cap: int) -> np.ndarray:
     return row
 
 
-def build_fuse_table(codes: List[bytes], code_cap: int) -> np.ndarray:
+def build_fuse_table(
+    codes: List[bytes], code_cap: int, summaries: Optional[List] = None
+) -> np.ndarray:
     """One fuse row per CodeTable row, same row order."""
-    return np.stack([build_fuse_row(code, code_cap) for code in codes])
+    if summaries is None:
+        summaries = [None] * len(codes)
+    return np.stack(
+        [
+            build_fuse_row(code, code_cap, summary)
+            for code, summary in zip(codes, summaries)
+        ]
+    )
 
 
-def fuse_run_lengths(code: bytes) -> List[tuple]:
+def fuse_run_lengths(code: bytes, summary=None) -> List[tuple]:
     """(start_pc, n_ops) of every maximal fusible run — the superblock
     boundaries, exposed for the golden tests and `myth lint`-style
-    introspection (not used on the hot path)."""
+    introspection (not used on the hot path).
+
+    With a static summary the runs are derived from its CFG's basic
+    blocks — a run never crosses a block boundary, so the superblock
+    decomposition and the block JIT's lowering agree on where blocks
+    start. The raw linear sweep (runs bounded only by non-fusible
+    ops) is the summary-less fallback."""
+    cfg = _summary_cfg(summary)
+    if cfg is not None:
+        out: List[tuple] = []
+        for start in sorted(cfg.blocks):
+            run_start, count = None, 0
+            for ins in cfg.blocks[start].instructions:
+                if ins.opcode in _FUSE_NAMES:
+                    if run_start is None:
+                        run_start, count = ins.address, 0
+                    count += 1
+                elif run_start is not None:
+                    out.append((run_start, count))
+                    run_start = None
+            if run_start is not None:
+                out.append((run_start, count))
+        return out
     out = []
     pc, n = 0, len(code)
     start, count = None, 0
@@ -205,22 +276,29 @@ def fuse_run_lengths(code: bytes) -> List[tuple]:
 FUSE_DENSITY_MIN = 0.25
 
 
-def fuse_profitable(code: bytes) -> bool:
+def fuse_profitable(code: bytes, summary=None) -> bool:
     """The per-contract fusion decision: enable superblock substeps
     only when enough of the instruction stream sits in runs of >= 2
     fusible ops (singleton runs advance nothing a full step wouldn't).
     A multi-contract wave fuses iff ANY striped contract profits
     (union_phases takes the max fuse_depth) — non-profiting lanes just
-    skip the substeps."""
-    pc, n, total = 0, len(code), 0
-    while pc < n:
-        op = code[pc]
-        total += 1
-        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    skip the substeps. With a static summary the run decomposition is
+    CFG-block-bounded (fuse_run_lengths)."""
+    cfg = _summary_cfg(summary)
+    if cfg is not None:
+        total = len(cfg.instructions)
+    else:
+        pc, n, total = 0, len(code), 0
+        while pc < n:
+            op = code[pc]
+            total += 1
+            pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
     if not total:
         return False
     fused = sum(
-        length for _start, length in fuse_run_lengths(code) if length >= 2
+        length
+        for _start, length in fuse_run_lengths(code, summary)
+        if length >= 2
     )
     return fused / total >= FUSE_DENSITY_MIN
 
@@ -246,12 +324,16 @@ def fused_substep(batch: StateBatch, code: CodeTable, fuse_tbl,
         batch.code_id[:, None], pc_safe[:, None] + jnp.arange(33)[None, :]
     ]
     op = code_win[:, 0].astype(jnp.int32)
+    # exactly the fusible-op mark (blockjit.ROW_FUSE == 1): a
+    # block-program row's ROW_BODY/ROW_HEAD pcs may carry ALU ops this
+    # substep has no semantics for, so a table mix-up degrades to
+    # "skip" (the full step executes the op), never to mis-execution
     fuse_ok = (
         fuse_tbl[
             batch.code_id,
             jnp.clip(batch.pc, 0, fuse_tbl.shape[1] - 1),
         ]
-        != 0
+        == 1
     )
     live = (
         (batch.status == Status.RUNNING)
@@ -381,68 +463,122 @@ def sym_fused_substep(symb, code: CodeTable, fuse_tbl,
 def _spec_run_impl(batch: StateBatch, code: CodeTable, fuse,
                    max_steps: int = 4096, track_coverage: bool = True,
                    phases: Optional[PhaseSet] = None):
-    """The concrete specialized loop: one pruned full step plus
-    `fuse_depth - 1` fused substeps per iteration. Returns
-    (out, full_steps, fused_lane_steps)."""
+    """The concrete specialized loop: one pruned full step plus —
+    per iteration — `block_depth` block substeps (blockjit.py; `fuse`
+    is then the block-program table) or `fuse_depth - 1` fused
+    substeps (`fuse` is the superblock membership table). Returns
+    (out, full_steps, substep_lane_steps, blocks_entered)."""
     import jax.numpy as jnp
     from jax import lax
 
     fuse_depth = phases.fuse_depth if phases is not None else 0
+    block_depth = phases.block_depth if phases is not None else 0
 
     def cond(carry):
-        b, i, _fused = carry
+        b, i, _fused, _blocks = carry
         return (i < max_steps) & jnp.any(b.status == Status.RUNNING)
 
     def body(carry):
-        b, i, fused = carry
-        b = step(b, code, track_coverage=track_coverage, phases=phases)
-        for _ in range(max(0, fuse_depth - 1)):
-            b, n_exec, *_ = fused_substep(
-                b, code, fuse, track_coverage=track_coverage
+        b, i, fused, blocks = carry
+        if block_depth > 0:
+            from mythril_tpu.laser.batch.blockjit import (
+                ROW_HEAD,
+                block_substep,
             )
-            fused = fused + n_exec
-        return b, i + 1, fused
 
-    out, steps, fused = lax.while_loop(
-        cond, body, (batch, jnp.int32(0), jnp.int32(0))
+            # lowered-block entries: lanes sitting AT a block head now
+            # (the full step consumes the head; substeps count the
+            # heads reached mid-iteration across fall-through edges)
+            row = fuse[
+                b.code_id, jnp.clip(b.pc, 0, fuse.shape[1] - 1)
+            ]
+            blocks = blocks + jnp.sum(
+                (
+                    (b.status == Status.RUNNING) & (row == ROW_HEAD)
+                ).astype(jnp.int32)
+            )
+            b = step(b, code, track_coverage=track_coverage, phases=phases)
+            for _ in range(block_depth):
+                b, n_exec, n_blk, _ = block_substep(
+                    b, code, fuse, track_coverage=track_coverage,
+                    phases=phases,
+                )
+                fused = fused + n_exec
+                blocks = blocks + n_blk
+        else:
+            b = step(b, code, track_coverage=track_coverage, phases=phases)
+            for _ in range(max(0, fuse_depth - 1)):
+                b, n_exec, *_ = fused_substep(
+                    b, code, fuse, track_coverage=track_coverage
+                )
+                fused = fused + n_exec
+        return b, i + 1, fused, blocks
+
+    out, steps, fused, blocks = lax.while_loop(
+        cond, body, (batch, jnp.int32(0), jnp.int32(0), jnp.int32(0))
     )
-    return out, steps, fused
+    return out, steps, fused, blocks
 
 
 def _spec_sym_run_impl(symb, code: CodeTable, fuse,
                        max_steps: int = 2048,
                        phases: Optional[PhaseSet] = None):
     """The symbolic specialized loop (the explorer's wave kernel).
-    Returns (out, full_steps, active_lane_steps, fused_lane_steps) —
-    `active` keeps the generic loop's semantics (RUNNING lanes per
-    full step); `fused` counts the extra instructions the substeps
-    advanced on top."""
+    Returns (out, full_steps, active_lane_steps, substep_lane_steps,
+    blocks_entered) — `active` keeps the generic loop's semantics
+    (RUNNING lanes per full step); the substep counter tallies the
+    extra instructions the block/fused substeps advanced on top."""
     import jax.numpy as jnp
     from jax import lax
 
     from mythril_tpu.laser.batch.symbolic import sym_step
 
     fuse_depth = phases.fuse_depth if phases is not None else 0
+    block_depth = phases.block_depth if phases is not None else 0
 
     def cond(carry):
-        s, i, _active, _fused = carry
+        s, i, _active, _fused, _blocks = carry
         return (i < max_steps) & jnp.any(s.base.status == Status.RUNNING)
 
     def body(carry):
-        s, i, active, fused = carry
+        s, i, active, fused, blocks = carry
         active = active + jnp.sum(
             (s.base.status == Status.RUNNING).astype(jnp.int32)
         )
-        s = sym_step(s, code, phases=phases)
-        for _ in range(max(0, fuse_depth - 1)):
-            s, n_exec = sym_fused_substep(s, code, fuse)
-            fused = fused + n_exec
-        return s, i + 1, active, fused
+        if block_depth > 0:
+            from mythril_tpu.laser.batch.blockjit import (
+                ROW_HEAD,
+                sym_block_substep,
+            )
 
-    out, steps, active, fused = lax.while_loop(
-        cond, body, (symb, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            row = fuse[
+                s.base.code_id,
+                jnp.clip(s.base.pc, 0, fuse.shape[1] - 1),
+            ]
+            blocks = blocks + jnp.sum(
+                (
+                    (s.base.status == Status.RUNNING) & (row == ROW_HEAD)
+                ).astype(jnp.int32)
+            )
+            s = sym_step(s, code, phases=phases)
+            for _ in range(block_depth):
+                s, n_exec, n_blk = sym_block_substep(
+                    s, code, fuse, phases=phases
+                )
+                fused = fused + n_exec
+                blocks = blocks + n_blk
+        else:
+            s = sym_step(s, code, phases=phases)
+            for _ in range(max(0, fuse_depth - 1)):
+                s, n_exec = sym_fused_substep(s, code, fuse)
+                fused = fused + n_exec
+        return s, i + 1, active, fused, blocks
+
+    out, steps, active, fused, blocks = lax.while_loop(
+        cond, body,
+        (symb, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
     )
-    return out, steps, active, fused
+    return out, steps, active, fused, blocks
 
 
 # ---------------------------------------------------------------------------
@@ -541,8 +677,10 @@ class SpecializedKernel:
 
     def run(self, batch, code, fuse, max_steps, track_coverage=True,
             donate=False):
-        """(out, full_steps, fused_lane_steps) — the service's wave
-        entry point."""
+        """(out, full_steps, substep_lane_steps, blocks_entered) —
+        the service's wave entry point. `fuse` is the block-program
+        table when this bucket's block_depth > 0, the superblock
+        membership table otherwise."""
         if self._run is None:
             raise RuntimeError("specialized kernel was dropped")
         fn = self._run_donated if donate else self._run
@@ -553,8 +691,8 @@ class SpecializedKernel:
         )
 
     def sym_run(self, symb, code, fuse, max_steps, donate=False):
-        """(out, full_steps, active, fused) — the explorer's wave
-        entry point."""
+        """(out, full_steps, active, substep_steps, blocks_entered) —
+        the explorer's wave entry point."""
         if self._sym is None:
             raise RuntimeError("specialized kernel was dropped")
         fn = self._sym_donated if donate else self._sym

@@ -157,7 +157,12 @@ class PhaseSet(NamedTuple):
 
     All-True == the generic kernel. `fuse_depth` > 1 additionally runs
     that many fused-substep micro-iterations per full step (superblock
-    fusion, specialize.py)."""
+    fusion, specialize.py). `block_depth` > 0 runs that many
+    block-substep micro-iterations instead — the block-level JIT
+    (laser/batch/blockjit.py), whose lowered op set is a superset of
+    the fusible one, so it subsumes fusion when on. Both are part of
+    the specialization-bucket key: a blockjit kernel and a fuse-only
+    kernel over the same phase flags are distinct compiles."""
 
     calls: bool = True
     extcodesize: bool = True
@@ -183,6 +188,7 @@ class PhaseSet(NamedTuple):
     logs: bool = True
     selfdestruct: bool = True
     fuse_depth: int = 0
+    block_depth: int = 0
 
     @property
     def pruned(self):
@@ -194,7 +200,9 @@ class PhaseSet(NamedTuple):
 
 #: the boolean phase fields, in declaration order
 PHASE_FLAGS = tuple(
-    name for name in PhaseSet._fields if name != "fuse_depth"
+    name
+    for name in PhaseSet._fields
+    if name not in ("fuse_depth", "block_depth")
 )
 
 #: phase flag -> the opcode names that phase (and only that phase)

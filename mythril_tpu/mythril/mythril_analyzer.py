@@ -74,6 +74,12 @@ _GLOBAL_DEFAULTS = dict(
     static_prune=True,
     pipeline=True,
     specialize=True,
+    # None = leave the flag bag as-is (the CLI always passes the
+    # explicit value; programmatic/test constructions keep the
+    # harness default — blockjit compiles per bucket, so silently
+    # re-enabling it under the test conftest would re-add the compile
+    # cost the conftest exists to avoid)
+    blockjit=None,
     mesh_devices=None,
     # device-first solver funnel (ISSUE 9): batched device dispatch
     # before the CDCL sprint on the explorer's flip frontier
@@ -111,7 +117,10 @@ class MythrilAnalyzer:
         for field, default in _RUN_DEFAULTS.items():
             setattr(self, field, options.pop(field, default))
         for field, default in _GLOBAL_DEFAULTS.items():
-            setattr(args, field, options.pop(field, default))
+            value = options.pop(field, default)
+            if value is None and field == "blockjit":
+                continue  # None = keep the bag's current value
+            setattr(args, field, value)
         # the sprint cap keeps its env-seeded default
         # (MYTHRIL_SPRINT_CAP_S) unless explicitly overridden
         sprint_cap_s = options.pop("sprint_cap_s", None)

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     ("scaling_ratio_4x_steps", "higher", 0.15),
     ("specialize_speedup", "higher", 0.15),
+    ("blockjit_speedup", "higher", 0.15),
     ("store_hit_rate", "higher", 0.10),
     ("incremental_rate", "higher", 0.10),
     ("warm_hit_p50_s", "lower", 0.50),
@@ -53,6 +54,7 @@ EXEMPT_FIELDS: Tuple[str, ...] = (
     "value", "vs_baseline", "device_verdict_share",
     "device_sat_verdicts", "cdcl_sat_verdicts", "contracts_per_sec",
     "corpus_wall_s", "host_only_wall_s", "specialized_step_rate",
+    "blockjit_step_rate", "blockjit_block_rate", "spec_leg_step_rate",
     "generic_step_rate", "batch_steps_per_sec", "hbm_demand_gbps",
     "hbm_utilization_pct", "mfu_pct", "kernel_compile_s",
     "hard_solve_speedup",

@@ -103,6 +103,7 @@ class ServiceConfig:
         devices: int = 1,
         specialize: bool = True,
         specialize_warmup: str = "background",
+        blockjit: bool = True,
         static_answer: bool = True,
         store_dir: Optional[str] = None,
         store: bool = True,
@@ -146,6 +147,12 @@ class ServiceConfig:
         #: the code LRU. `myth serve --no-specialize` restores the
         #: generic interpreter.
         self.specialize = specialize
+        #: block-level JIT (laser/batch/blockjit.py): specialized
+        #: kernels advance whole lowered CFG basic blocks per
+        #: iteration; per-code block-program rows ride the CodeCache
+        #: specialization feed. `myth serve --no-blockjit` keeps the
+        #: PR-6 fuse-only kernels.
+        self.blockjit = blockjit
         #: the static-answer triage tier at admission: a submission
         #: whose semantic screen (analysis/static taint + sink
         #: predicates) proves NO detection module can fire settles
@@ -196,9 +203,14 @@ class CodeCache:
     contract still pins the same bucket) — a compiled-kernel slot
     never leaks past its LRU entry."""
 
-    def __init__(self, code_cap: int, capacity: int = 64) -> None:
+    def __init__(
+        self, code_cap: int, capacity: int = 64, blockjit: bool = True
+    ) -> None:
         self.code_cap = code_cap
         self.capacity = max(1, capacity)
+        #: engine-level blockjit gate (ServiceConfig.blockjit) — ANDed
+        #: with the process-wide blockjit_enabled() switch
+        self.blockjit = blockjit
         self._rows: "OrderedDict[str, list]" = OrderedDict()
         self.hits = 0
         self.misses = 0
@@ -280,27 +292,43 @@ class CodeCache:
 
     def spec_for(self, code: bytes) -> Optional[Dict]:
         """The code's specialization feed from the same LRU entry:
-        {"phases": PhaseSet, "fuse_row": u8[code_cap], "kernel":
-        pinned SpecializedKernel} — built (and the kernel compiled
-        lazily on its first wave) once per resident code hash, so warm
-        resubmissions dispatch with zero compile latency. None when
+        {"phases": PhaseSet, "fuse_row": u8[code_cap], "block_row":
+        u8[code_cap], "kernel": pinned SpecializedKernel} — built (and
+        the kernel compiled lazily on its first wave) once per
+        resident code hash, so warm resubmissions dispatch with zero
+        compile latency AND zero table-sweep cost (the fuse/block
+        rows were previously rebuilt per wave). None when
         specialization is off or the feed build failed."""
         entry = self._entry(code)
         feeds = entry[3]
         if feeds["spec"] is None:
             try:
+                from mythril_tpu.laser.batch import blockjit as _bj
                 from mythril_tpu.laser.batch import specialize as _spec
 
                 if not _spec.specialize_enabled():
                     return None
                 summary = self.static_summary(code)
+                blockjit_on = self.blockjit and _bj.blockjit_enabled()
                 phases = _spec.phases_for(
                     _spec.signature_for(code, summary),
-                    fuse=_spec.fuse_profitable(code),
+                    fuse=_spec.fuse_profitable(code, summary),
+                    block_depth=(
+                        _bj.block_depth_for(code, summary)
+                        if blockjit_on
+                        else 0
+                    ),
                 )
                 feeds["spec"] = {
                     "phases": phases,
-                    "fuse_row": _spec.build_fuse_row(code, self.code_cap),
+                    "fuse_row": _spec.build_fuse_row(
+                        code, self.code_cap, summary
+                    ),
+                    "block_row": (
+                        _bj.build_block_row(code, self.code_cap, summary)
+                        if blockjit_on
+                        else None
+                    ),
                     "kernel": _spec.kernel_cache().acquire(phases),
                 }
                 self.kernels_pinned += 1
@@ -499,15 +527,21 @@ class AnalysisEngine:
         #: registry — /stats mesh.* reads the snapshot)
         self._group_tables: Dict = {}
         self.code_cap = code_cap_bucket(1, floor=self.cfg.code_cap)
-        self.code_cache = CodeCache(self.code_cap, self.cfg.code_cache_cap)
+        self.code_cache = CodeCache(
+            self.code_cap, self.cfg.code_cache_cap,
+            blockjit=self.cfg.blockjit,
+        )
         self._tracks: "OrderedDict[str, _JobTrack]" = OrderedDict()
         self._arena_ops: Optional[np.ndarray] = None
         self._arena_jd: Optional[np.ndarray] = None
         self._arena_len: Optional[np.ndarray] = None
         self._arena_fuse: Optional[np.ndarray] = None
+        self._arena_block: Optional[np.ndarray] = None
         self._code_table = None
         self._fuse_table = None
+        self._block_table = None
         self._group_fuse: Dict = {}
+        self._group_block: Dict = {}
         self._table_dirty = True
         self._rebuild_arena_rows()
         self._lock = threading.Lock()
@@ -572,6 +606,11 @@ class AnalysisEngine:
         self._c_fused = reg.counter(
             "mtpu_service_fused_steps_total",
             "instructions advanced by fused substeps",
+        ).labels(**lab)
+        self._c_blocks = reg.counter(
+            "mtpu_service_blockjit_blocks_total",
+            "lowered basic blocks entered by block substeps "
+            "(block-level JIT)",
         ).labels(**lab)
         self._c_fallbacks = reg.counter(
             "mtpu_service_kernel_fallbacks_total",
@@ -1029,9 +1068,11 @@ class AnalysisEngine:
         self._arena_ops = np.zeros((rows, self.code_cap + 33), np.uint8)
         self._arena_jd = np.zeros((rows, self.code_cap), bool)
         self._arena_len = np.zeros((rows,), np.int32)
-        # per-row superblock fuse tables (specialize.py): the halt row
-        # stays all-zero (idle lanes never fuse)
+        # per-row superblock fuse + block-program tables (specialize
+        # .py / blockjit.py): the halt row stays all-zero (idle lanes
+        # never fuse or block-step)
         self._arena_fuse = np.zeros((rows, self.code_cap), np.uint8)
+        self._arena_block = np.zeros((rows, self.code_cap), np.uint8)
         self._table_dirty = True
 
     def _install_code(self, track: _JobTrack) -> None:
@@ -1043,6 +1084,12 @@ class AnalysisEngine:
             track.spec["fuse_row"]
             if track.spec is not None
             else 0
+        )
+        block_row = (
+            track.spec.get("block_row") if track.spec is not None else None
+        )
+        self._arena_block[track.code_row] = (
+            block_row if block_row is not None else 0
         )
         self._table_dirty = True
 
@@ -1165,9 +1212,11 @@ class AnalysisEngine:
                 jnp.asarray(self._arena_len),
             )
             self._fuse_table = jnp.asarray(self._arena_fuse)
+            self._block_table = jnp.asarray(self._arena_block)
             self._table_dirty = False
             self._group_tables.clear()
             self._group_fuse.clear()
+            self._group_block.clear()
         if device is None:
             return self._code_table
         # per-group replica: a group's wave must find its table on its
@@ -1193,6 +1242,27 @@ class AnalysisEngine:
             cached = jax.device_put(self._fuse_table, device)
             self._group_fuse[device] = cached
         return cached
+
+    def _block(self, device=None):
+        """The block-program table matching `_table()` (same dirty
+        lifecycle) — the substep table of a blockjit bucket."""
+        if device is None:
+            return self._block_table
+        cached = self._group_block.get(device)
+        if cached is None:
+            import jax
+
+            cached = jax.device_put(self._block_table, device)
+            self._group_block[device] = cached
+        return cached
+
+    def _substep_table(self, phases, device=None):
+        """The substep table matching a wave bucket: the block-program
+        rows for a blockjit bucket, the superblock fuse rows
+        otherwise."""
+        if phases is not None and phases.block_depth > 0:
+            return self._block(device)
+        return self._fuse(device)
 
     def _wave_kernel(self, job_ids, batch, table, donate) -> Optional[Tuple]:
         """(kernel, phases) for this wave, or None for a generic wave.
@@ -1248,7 +1318,11 @@ class AnalysisEngine:
                 return
             self._kernel_warming.add(warm_id)
         n = batch.pc.shape[0]
-        fuse = self._fuse_table
+        fuse = (
+            self._block_table
+            if kernel.phases.block_depth > 0
+            else self._fuse_table
+        )
         steps = self.cfg.steps_per_wave
 
         def _warm():
@@ -1381,6 +1455,7 @@ class AnalysisEngine:
             "out": None,
             "steps": None,
             "fused": None,
+            "blocks": None,
             "spec": False,
             "failed": None,
             "t0": time.perf_counter(),
@@ -1403,15 +1478,16 @@ class AnalysisEngine:
                     kernel, _phases = spec
                     record["spec"] = True
                     self._c_spec_waves.inc()
-                    record["out"], record["steps"], record["fused"] = (
-                        kernel.run(
-                            batch,
-                            table,
-                            self._fuse(),
-                            max_steps=self.cfg.steps_per_wave,
-                            track_coverage=True,
-                            donate=donate,
-                        )
+                    (
+                        record["out"], record["steps"], record["fused"],
+                        record["blocks"],
+                    ) = kernel.run(
+                        batch,
+                        table,
+                        self._substep_table(_phases),
+                        max_steps=self.cfg.steps_per_wave,
+                        track_coverage=True,
+                        donate=donate,
                     )
                 else:
                     self._c_generic_waves.inc()
@@ -1488,6 +1564,7 @@ class AnalysisEngine:
                 "out": None,
                 "steps": None,
                 "fused": None,
+                "blocks": None,
                 "spec": False,
                 "failed": None,
             }
@@ -1506,10 +1583,13 @@ class AnalysisEngine:
                     kernel, _phases = spec
                     self._c_spec_waves.inc()
                     grec["spec"] = True
-                    grec["out"], grec["steps"], grec["fused"] = kernel.run(
+                    (
+                        grec["out"], grec["steps"], grec["fused"],
+                        grec["blocks"],
+                    ) = kernel.run(
                         batch,
                         table,
-                        self._fuse(device),
+                        self._substep_table(_phases, device),
                         max_steps=self.cfg.steps_per_wave,
                         track_coverage=True,
                         donate=donate,
@@ -1618,6 +1698,8 @@ class AnalysisEngine:
             out, steps = record["out"], record["steps"]
             if record.get("fused") is not None:
                 self._c_fused.inc(int(record["fused"]))
+            if record.get("blocks") is not None:
+                self._c_blocks.inc(int(record["blocks"]))
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
@@ -1694,6 +1776,8 @@ class AnalysisEngine:
                 out, steps = grec["out"], grec["steps"]
                 if grec.get("fused") is not None:
                     self._c_fused.inc(int(grec["fused"]))
+                if grec.get("blocks") is not None:
+                    self._c_blocks.inc(int(grec["blocks"]))
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
@@ -2053,6 +2137,8 @@ class AnalysisEngine:
             specialize_enabled,
         )
 
+        from mythril_tpu.laser.batch.blockjit import blockjit_enabled
+
         out = {
             "enabled": bool(self.cfg.specialize) and specialize_enabled(),
             "warmup": self.cfg.specialize_warmup,
@@ -2060,6 +2146,13 @@ class AnalysisEngine:
             "spec_waves": self.spec_waves,
             "generic_waves": self.generic_waves,
             "fused_steps": self.kernel_fused_steps,
+            "blockjit": (
+                bool(self.cfg.specialize)
+                and specialize_enabled()
+                and bool(self.cfg.blockjit)
+                and blockjit_enabled()
+            ),
+            "blockjit_blocks": int(self._c_blocks.value),
             "fallbacks": self.kernel_fallbacks,
             "pinned_codes": self.code_cache.kernels_pinned
             - self.code_cache.kernels_released,

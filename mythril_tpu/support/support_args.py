@@ -70,6 +70,15 @@ class Args(object, metaclass=Singleton):
         # generic interpreter — the differential baseline for a
         # suspected specialization bug.
         self.specialize = True
+        # Block-level JIT (CLI --no-blockjit, env MYTHRIL_NO_BLOCKJIT,
+        # laser/batch/blockjit.py): whole CFG basic blocks advanced by
+        # block substeps inside the specialized kernels — stack-effect
+        # summarized, block-gas metered, with the same UNSUPPORTED-
+        # degrade net. Rides the specialize flag (no specialized
+        # kernel, no blockjit); off restores the PR-6 fuse-only
+        # kernels — the differential baseline for a suspected
+        # block-lowering bug.
+        self.blockjit = True
         # Pipelined wave engine (CLI --no-pipeline): double-buffered
         # async wave dispatch — up to two waves in flight, host
         # evidence-consume/flip-solving overlapping device execution,

@@ -39,6 +39,13 @@ from mythril_tpu.support.support_args import args as _support_args  # noqa: E402
 
 _support_args.specialize = False
 
+# The block-level JIT rides the specialize flag (no specialized
+# kernel, no block substeps) but is ALSO off explicitly: the blockjit
+# suite (tests/laser/test_blockjit.py, `-m blockjit`) re-enables both
+# and pins the blockjit-vs-generic differentials; product/bench
+# default is on.
+_support_args.blockjit = False
+
 # The device-first solver funnel is likewise OFF by default under the
 # test harness: the product default is on, but the batched diversified
 # SLS dispatch pays a fresh XLA compile per stacked shape bucket, and
@@ -100,6 +107,14 @@ def pytest_configure(config):
         "kernels: phase pruning, superblock fusion, compile cache, "
         "CodeCache kernel eviction; CPU-only — runs in tier-1, "
         "selectable with -m specialize)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "blockjit: block-level JIT suite (laser/batch/blockjit.py: "
+        "block-summary goldens, block-program tables, blockjit-vs-"
+        "generic differentials, mid-block OOG replay, kernel-cache "
+        "block-key pin/evict, --no-blockjit parity; CPU-only — runs "
+        "in tier-1, selectable with -m blockjit)",
     )
     config.addinivalue_line(
         "markers",

@@ -188,7 +188,9 @@ def test_specialized_concrete_kernel_matches_generic():
     table, fuse, phases, batch = _eq_setup()
     g_out, _ = run(batch, table, max_steps=64)
     kern = sp.kernel_cache().get(phases)
-    s_out, _steps, fused = kern.run(batch, table, fuse, max_steps=64)
+    s_out, _steps, fused, _blocks = kern.run(
+        batch, table, fuse, max_steps=64
+    )
     assert int(fused) > 0  # the fused substeps actually advanced work
     for i, (x, y) in enumerate(
         zip(jax.tree.flatten(g_out)[0], jax.tree.flatten(s_out)[0])
@@ -200,7 +202,7 @@ def test_specialized_sym_kernel_matches_generic():
     table, fuse, phases, batch = _eq_setup()
     g_out, _s, _a = sym_run(make_sym_batch(batch), table, max_steps=64)
     kern = sp.kernel_cache().get(phases)
-    s_out, _s2, _a2, fused = kern.sym_run(
+    s_out, _s2, _a2, fused, _blocks = kern.sym_run(
         make_sym_batch(batch), table, fuse, max_steps=64
     )
     assert int(fused) > 0
