@@ -1007,6 +1007,63 @@ def bench_store(budget_s: int = 150) -> dict:
     return out
 
 
+def bench_journal(rounds: int = 48) -> dict:
+    """Durable-journal (WAL) overhead on the warm admission tier
+    (ISSUE 14): an engine-less service settling static-answer
+    submissions — the fastest settle path the service has, so the
+    per-record WAL cost shows up at its worst — with the journal on
+    vs off. `journal_overhead_frac` = (p50_on - p50_off) / p50_off
+    over that path; instant-tier settle records are written unsynced
+    by design, so this measures the buffered-write cost (the fsync'd
+    full-path figure is gated in tools/chaos_smoke.py against the
+    warm wave p50). `journal_admit_p50_s` is the durable (fsync'd)
+    admission record alone — the latency a queued submission pays for
+    the crash-safety guarantee."""
+    import statistics
+    import tempfile
+
+    from mythril_tpu.analysis.corpusgen import clean_contract
+    from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+    from mythril_tpu.service.jobs import Job
+
+    def leg(journal_dir):
+        engine = AnalysisEngine(ServiceConfig(
+            stripes=2, lanes_per_stripe=2, host_walk=False,
+            queue_capacity=rounds * 2 + 8, journal_dir=journal_dir,
+        ))
+        walls = []
+        for i in range(rounds):
+            t0 = time.perf_counter()
+            engine.submit(Job(clean_contract(i % 8)))
+            walls.append(time.perf_counter() - t0)
+        admits = []
+        for _ in range(rounds // 2):
+            t0 = time.perf_counter()
+            engine.submit(Job("33ff"))  # queue path: fsync'd admit
+            admits.append(time.perf_counter() - t0)
+        # drop the first rounds (summary-cache warmup) per leg
+        return (
+            statistics.median(walls[8:]),
+            statistics.median(admits),
+        )
+
+    p50_off, admit_off = leg(None)
+    with tempfile.TemporaryDirectory(prefix="myth-bench-wal-") as jd:
+        p50_on, admit_on = leg(jd)
+    out = {
+        "journal_overhead_frac": (
+            round(max(0.0, (p50_on - p50_off)) / p50_off, 4)
+            if p50_off
+            else None
+        ),
+        "journal_warm_p50_off_s": round(p50_off, 6),
+        "journal_warm_p50_on_s": round(p50_on, 6),
+        "journal_admit_p50_s": round(admit_on, 6),
+    }
+    print(f"bench: journal leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -1016,6 +1073,14 @@ def _emit(record: dict, stage: str) -> None:
     record["bench_emit"] = stage
     record["bench_wall_s"] = round(time.monotonic() - _BENCH_T0, 1)
     _device_saturation_fields(record)
+    # tier circuit-breaker scorecard (ISSUE 14): cumulative trips
+    # across every tier at emit time — a healthy run reports 0
+    try:
+        from mythril_tpu.support.breaker import trips_total
+
+        record["breaker_trips"] = trips_total()
+    except Exception:
+        pass
     print(json.dumps(record), flush=True)
 
 
@@ -1158,6 +1223,11 @@ def main(final_attempt: bool = False) -> None:
         "store_hit_rate": None,
         "incremental_rate": None,
         "warm_hit_p50_s": None,
+        # crash-safety scorecard (ISSUE 14): journal WAL overhead on
+        # the warm admission tier + cumulative breaker trips
+        # (refreshed at every emit; a healthy run reports 0 trips)
+        "journal_overhead_frac": None,
+        "breaker_trips": 0,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1188,6 +1258,12 @@ def main(final_attempt: bool = False) -> None:
         record["static_answer_rate"] = None
         record["screen_mount_rate_opcode"] = None
         record["screen_mount_rate_semantic"] = None
+
+    try:
+        record.update(bench_journal())
+        print("bench: journal leg done", file=sys.stderr)
+    except Exception as e:
+        print(f"bench: journal leg failed: {e!r}", file=sys.stderr)
 
     dev = {}
     try:

@@ -454,6 +454,38 @@ def fork_contract(seed: int = 0, variant: int = 0) -> str:
     return bytes(code).hex()
 
 
+def poison_contract(seed: int = 0) -> str:
+    """The quarantine differential's poison fixture: a syntactically
+    ordinary dispatcher (one storage-writing function ending in a
+    guarded INVALID, so a normal analysis WOULD report SWC-110) whose
+    selectors are distinctive per seed. The contract is behaviorally
+    benign — what makes it "poison" in the chaos tests is the harness:
+    wave faults are injected while (and only while) this contract is
+    resident, modelling a contract whose lowering reliably wedges the
+    device. The differential then asserts every OTHER contract's
+    issue set is identical with and without the poison in the corpus,
+    and the poison itself settles FAILED with
+    DegradationReason.QUARANTINED."""
+    fn_at = 22
+    fail_at = 38
+    sel = (0xBADC0FFE + seed * 0x11) & 0xFFFFFFFF
+    code = bytearray(
+        [0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63]
+    )  # selector = CALLDATALOAD(0) >> 224; DUP1; PUSH4
+    code += sel.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, fn_at, 0x57])  # EQ; PUSH1 fn; JUMPI
+    code += bytes([0x60, 0x00, 0x80, 0xFD])  # no match: revert(0,0)
+    while len(code) < fn_at:
+        code += bytes([0x00])
+    code += bytes([0x5B, 0x60, 0x01 + (seed % 16), 0x60, 0x00, 0x55])
+    code += bytes([0x60, 0x04, 0x35])  # CALLDATALOAD(4)
+    code += bytes([0x60, 0xC3, 0x14])  # == 0xc3 ?
+    code += bytes([0x60, fail_at, 0x57, 0x00])  # JUMPI fail; STOP
+    assert len(code) == fail_at
+    code += bytes([0x5B, 0xFE])  # fail: JUMPDEST; INVALID (SWC-110)
+    return bytes(code).hex()
+
+
 def synth_bench_corpus(
     n_contracts: int,
     seed: int = 2024,

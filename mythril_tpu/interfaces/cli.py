@@ -970,6 +970,49 @@ def build_parser() -> ArgumentParser:
         ),
     )
     serve.add_argument(
+        "--journal",
+        default=None,
+        metavar="DIR",
+        help=(
+            "durable job journal: every job transition is an "
+            "fsync'd append-only WAL record under DIR, so a "
+            "SIGKILL/OOM mid-wave loses zero acknowledged jobs "
+            "(restart with --recover to replay)"
+        ),
+    )
+    serve.add_argument(
+        "--recover",
+        action="store_true",
+        help=(
+            "replay the --journal DIR at startup: terminal jobs are "
+            "adopted as queryable history, non-terminal jobs "
+            "re-admitted (deduping through the verdict store), and "
+            "jobs in flight at a crash take a quarantine strike"
+        ),
+    )
+    serve.add_argument(
+        "--no-breakers",
+        action="store_true",
+        help=(
+            "disable the tier circuit breakers (device dispatch, "
+            "device-first solving, kernel compile, store I/O): every "
+            "tier re-enters its full retry ladder per job — the "
+            "pre-breaker differential baseline"
+        ),
+    )
+    serve.add_argument(
+        "--quarantine-strikes",
+        type=int,
+        default=2,
+        metavar="N",
+        help=(
+            "wave-fault strikes before a codehash is quarantined "
+            "(settled FAILED at admission, denylisted for the "
+            "process lifetime); one strike short of N the job runs "
+            "in a solo wave"
+        ),
+    )
+    serve.add_argument(
         "--no-arena-warmup",
         action="store_true",
         help=(
@@ -1176,6 +1219,16 @@ def build_parser() -> ArgumentParser:
         "--no-host-walk",
         action="store_true",
         help="ask for a device-only report",
+    )
+    submit.add_argument(
+        "--idempotency-key",
+        default=None,
+        metavar="KEY",
+        help=(
+            "dedupe key for this submission (default: a fresh UUID); "
+            "a resubmit with the same key — e.g. after a server "
+            "restart — maps to the existing job instead of re-running"
+        ),
     )
     submit.add_argument(
         "--no-wait",
@@ -1698,6 +1751,13 @@ def _cmd_serve(args: Namespace) -> None:
         from mythril_tpu.support.support_args import args as support_args
 
         support_args.blockjit = False
+    if args.no_breakers:
+        # the process-wide switch: the device-solve and kernel-compile
+        # breakers sit below the engine config (explore.py,
+        # specialize.py, store.py all read the bag)
+        from mythril_tpu.support.support_args import args as support_args
+
+        support_args.breakers = False
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,
@@ -1722,6 +1782,10 @@ def _cmd_serve(args: Namespace) -> None:
         store=not args.no_store,
         arena_warmup=not args.no_arena_warmup,
         health_interval_s=args.health_interval,
+        journal_dir=args.journal,
+        recover=args.recover,
+        breakers=not args.no_breakers,
+        quarantine_strikes=args.quarantine_strikes,
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()
@@ -1892,6 +1956,7 @@ def _cmd_submit(args: Namespace) -> None:
             max_waves=args.max_waves,
             deadline_s=args.deadline,
             host_walk=False if args.no_host_walk else None,
+            idempotency_key=args.idempotency_key,
         )
         if args.no_wait:
             print(json.dumps({"job_id": job_id}))

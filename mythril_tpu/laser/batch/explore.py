@@ -1354,10 +1354,26 @@ class DeviceCorpusExplorer:
     def _device_first(self) -> bool:
         """Funnel order (ISSUE 9): device-first batched dispatch with
         the CDCL sprint demoted to an escalation ladder, vs the legacy
-        host-first order (--host-first-funnel, the parity baseline)."""
+        host-first order (--host-first-funnel, the parity baseline).
+        An OPEN device-solve breaker forces the host-first order too:
+        the sprint answers first and the device stage is skipped
+        outright (`_device_flips` gates), so a sick accelerator is
+        routed around instead of re-failing per wave."""
         from mythril_tpu.support.support_args import args as _flags
 
-        return bool(getattr(_flags, "device_first", True))
+        return bool(
+            getattr(_flags, "device_first", True)
+        ) and self._device_solve_allowed()
+
+    @staticmethod
+    def _device_solve_allowed() -> bool:
+        """The device-solve tier breaker's verdict (support/breaker
+        .py); True when the breaker layer is disabled."""
+        from mythril_tpu.support import breaker as _cb
+
+        if not _cb.breakers_enabled():
+            return True
+        return _cb.breaker(_cb.TIER_DEVICE_SOLVE).allow()
 
     def _lower_flips(self, batch, indices=None):
         """Lower flip queries for the device stage. MUST run under the
@@ -1482,28 +1498,57 @@ class DeviceCorpusExplorer:
         unsat: set = set()
         if not lowered_batch:
             return answered, unsat
+        if not self._device_solve_allowed():
+            # breaker open: the whole frontier goes to the escalation
+            # ladder (host CDCL) — no doomed device dispatch
+            return answered, unsat
         t0 = time.perf_counter()
         n_dev = 1
         devices = None
         if self.mesh is not None:
             devices = list(np.asarray(self.mesh.devices).flat)
             n_dev = len(devices)
-        with trace(
-            "flip.solve.device",
-            track=self.fault_domain,
-            queries=len(lowered_batch),
-        ):
-            # the legacy (host-first) baseline mirrors the old device
-            # stage: full per-query step budget, no cube fan — the
-            # parity differential compares funnels, not knob sets
-            verdicts = device_solve_batch(
-                lowered_batch,
-                candidates=self.portfolio_candidates,
-                steps=None if device_first else self.portfolio_steps,
-                cube_depth=None if device_first else 0,
-                n_devices=n_dev,
-                devices=devices,
+        try:
+            with trace(
+                "flip.solve.device",
+                track=self.fault_domain,
+                queries=len(lowered_batch),
+            ):
+                # the legacy (host-first) baseline mirrors the old
+                # device stage: full per-query step budget, no cube
+                # fan — the parity differential compares funnels, not
+                # knob sets
+                verdicts = device_solve_batch(
+                    lowered_batch,
+                    candidates=self.portfolio_candidates,
+                    steps=None if device_first else self.portfolio_steps,
+                    cube_depth=None if device_first else 0,
+                    n_devices=n_dev,
+                    devices=devices,
+                )
+        except Exception as why:
+            from mythril_tpu.support import breaker as _cb
+            from mythril_tpu.support import resilience as _res
+
+            if not _res.is_device_fault(why):
+                raise
+            # a faulted solver dispatch degrades this wave's frontier
+            # to the host ladder and feeds the breaker — repeated
+            # faults trip it open and later waves skip the stage
+            if _cb.breakers_enabled():
+                _cb.breaker(_cb.TIER_DEVICE_SOLVE).record_failure(
+                    str(why)
+                )
+            _res.DegradationLog().record(
+                _res.DegradationReason.DEVICE_DISPATCH_FAILED,
+                site="flip.solve.device",
+                detail=str(why),
             )
+            return answered, unsat
+        from mythril_tpu.support import breaker as _cb
+
+        if _cb.breakers_enabled():
+            _cb.breaker(_cb.TIER_DEVICE_SOLVE).record_success()
         from mythril_tpu.laser.smt.solver.solver_statistics import (
             SolverStatistics,
         )

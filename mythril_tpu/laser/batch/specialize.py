@@ -629,7 +629,11 @@ class SpecializedKernel:
     def _timed(self, key, fn, *args, **kwargs):
         """First call per (entry, shape) is trace+compile-dominated
         (jit compiles synchronously, dispatch is async): its wall is
-        the honest compile-latency figure the bench/stats report."""
+        the honest compile-latency figure the bench/stats report. The
+        cold path feeds the kernel-tier circuit breaker
+        (support/breaker.py): repeated compile failures trip it open
+        and the service falls back to the generic interpreter instead
+        of re-paying a doomed compile per wave."""
         self.calls += 1
         if key in self._warm:
             return fn(*args, **kwargs)
@@ -638,7 +642,19 @@ class SpecializedKernel:
             _COMPILING += 1
         t0 = time.perf_counter()
         try:
-            return fn(*args, **kwargs)
+            from mythril_tpu.support import breaker as _cb
+            from mythril_tpu.support.resilience import inject
+
+            try:
+                inject("kernel.compile")
+                result = fn(*args, **kwargs)
+            except Exception as why:
+                if _cb.breakers_enabled():
+                    _cb.breaker(_cb.TIER_KERNEL).record_failure(str(why))
+                raise
+            if _cb.breakers_enabled():
+                _cb.breaker(_cb.TIER_KERNEL).record_success()
+            return result
         finally:
             t1 = time.perf_counter()
             self.compile_s += t1 - t0

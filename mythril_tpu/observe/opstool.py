@@ -40,6 +40,10 @@ STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     ("store_hit_rate", "higher", 0.10),
     ("incremental_rate", "higher", 0.10),
     ("warm_hit_p50_s", "lower", 0.50),
+    # journal WAL overhead on the warm admission tier (ISSUE 14):
+    # tiny absolute values, so the relative gate is loose — it exists
+    # to catch the overhead DOUBLING, not wobbling
+    ("journal_overhead_frac", "lower", 1.0),
     ("static_answer_rate", "higher", 0.25),
     ("static_prune_rate", "higher", 0.50),
     ("screen_mount_rate_semantic", "lower", 0.25),

@@ -209,6 +209,11 @@ def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
     device ran)."""
     if result.get("skipped"):
         route = "skipped"
+    elif result.get("quarantined"):
+        # a denylisted poison codehash settled FAILED at admission
+        # (service quarantine) — blast-radius containment, zero
+        # compute spent; the trainer must see these as their own class
+        route = "quarantined"
     elif result.get("store_hit"):
         # settled at admission from the cross-run verdict store —
         # near-zero cost, the cache economics the item-5 cost model

@@ -54,10 +54,16 @@ HEALTH_STATES = (STATE_OK, STATE_DEGRADED, STATE_REDLINED)
 REDLINE_SLO_BURN = "slo-burn"
 REDLINE_QUEUE_SATURATED = "queue-saturated"
 REDLINE_DEVICE_SATURATED = "device-saturated"
+#: prefix form `breaker-open:<tier>`: a tier circuit breaker
+#: (support/breaker.py) is OPEN and the replica is serving through
+#: its fallback ladder — the federation front should route around it
+#: until the breaker's half-open probe recovers
+REDLINE_BREAKER_OPEN = "breaker-open"
 REDLINE_REASONS = (
     REDLINE_SLO_BURN,
     REDLINE_QUEUE_SATURATED,
     REDLINE_DEVICE_SATURATED,
+    REDLINE_BREAKER_OPEN,
 )
 
 #: the enumerated not-ready vocabulary for the readiness half of

@@ -1,10 +1,22 @@
-"""Thin stdlib client for the analysis service (`myth submit`)."""
+"""Thin stdlib client for the analysis service (`myth submit`).
+
+Connection resilience: a refused or reset connection — the server
+restarting under its crash-recovery journal, a load balancer blip —
+is retried with capped exponential backoff instead of surfacing on
+the first attempt. `submit` mints an idempotency key BEFORE the first
+try and sends it on every retry, so a submit whose response was lost
+mid-restart dedupes server-side (the journal seeds the key index
+across restarts) instead of double-running the job.
+"""
 
 from __future__ import annotations
 
+import http.client
 import json
+import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import Dict, Optional
 
 
@@ -18,33 +30,76 @@ class ServiceError(Exception):
         self.payload = payload
 
 
+def _retriable(why: Exception) -> bool:
+    """Connection-level failures worth a retry: refused (server not
+    up yet / restarting), reset (server died mid-exchange), dropped
+    without a status line. HTTP errors are NOT retried here — the
+    server answered; backpressure handling is the caller's policy."""
+    if isinstance(why, urllib.error.HTTPError):
+        return False
+    if isinstance(why, urllib.error.URLError):
+        why = why.reason if isinstance(why.reason, Exception) else why
+    return isinstance(
+        why,
+        (
+            ConnectionRefusedError,
+            ConnectionResetError,
+            ConnectionAbortedError,
+            BrokenPipeError,
+            http.client.RemoteDisconnected,
+            http.client.BadStatusLine,
+        ),
+    )
+
+
 class ServiceClient:
-    def __init__(self, url: str, timeout_s: float = 10.0) -> None:
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float = 10.0,
+        retries: int = 3,
+        backoff_s: float = 0.2,
+        max_backoff_s: float = 2.0,
+    ) -> None:
         self.url = url.rstrip("/")
         self.timeout_s = timeout_s
+        #: connection-failure retries per request (0 = fail fast)
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
 
     def _request(
         self, path: str, body: Optional[Dict] = None,
         timeout_s: Optional[float] = None,
     ) -> Dict:
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            self.url + path,
-            data=data,
-            headers={"Content-Type": "application/json"} if data else {},
-            method="POST" if data is not None else "GET",
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout_s or self.timeout_s
-            ) as response:
-                return json.loads(response.read() or b"{}")
-        except urllib.error.HTTPError as why:
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.url + path,
+                data=data,
+                headers=(
+                    {"Content-Type": "application/json"} if data else {}
+                ),
+                method="POST" if data is not None else "GET",
+            )
             try:
-                payload = json.loads(why.read() or b"{}")
-            except Exception:
-                payload = {}
-            raise ServiceError(why.code, payload) from why
+                with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s
+                ) as response:
+                    return json.loads(response.read() or b"{}")
+            except urllib.error.HTTPError as why:
+                try:
+                    payload = json.loads(why.read() or b"{}")
+                except Exception:
+                    payload = {}
+                raise ServiceError(why.code, payload) from why
+            except Exception as why:
+                if attempt >= self.retries or not _retriable(why):
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2.0, self.max_backoff_s)
+        raise AssertionError("unreachable")  # the loop returns/raises
 
     def submit(
         self,
@@ -53,8 +108,14 @@ class ServiceClient:
         deadline_s: Optional[float] = None,
         host_walk: Optional[bool] = None,
         lanes: Optional[int] = None,
+        idempotency_key: Optional[str] = None,
     ) -> str:
-        body = {"code": code_hex}
+        # the key is minted BEFORE the first attempt: every retry of
+        # this logical submission carries the same one, so a response
+        # lost to a reset/restart dedupes instead of double-running
+        if idempotency_key is None:
+            idempotency_key = uuid.uuid4().hex
+        body = {"code": code_hex, "idempotency_key": idempotency_key}
         for key, value in (
             ("max_waves", max_waves),
             ("deadline_s", deadline_s),

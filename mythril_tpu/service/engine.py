@@ -56,8 +56,10 @@ log = logging.getLogger(__name__)
 
 #: /stats payload schema version: smoke tools pin it and the key set
 #: it covers. Bump on any shape change. v3 adds the `health` (SLO
-#: state machine) and `device` (saturation sampler) blocks.
-STATS_SCHEMA_VERSION = 3
+#: state machine) and `device` (saturation sampler) blocks. v4 adds
+#: `journal` (durable WAL + recovery counters), `breaker` (tier
+#: circuit-breaker board), and `quarantine` (poison-job strikes).
+STATS_SCHEMA_VERSION = 4
 
 #: engine-instance serial for the registry label (tests run many
 #: engines per process; each gets its own series)
@@ -109,6 +111,11 @@ class ServiceConfig:
         store: bool = True,
         arena_warmup: bool = False,
         health_interval_s: float = 2.0,
+        journal_dir: Optional[str] = None,
+        recover: bool = False,
+        journal_fsync: bool = True,
+        breakers: bool = True,
+        quarantine_strikes: int = 2,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -179,6 +186,30 @@ class ServiceConfig:
         self.arena_warmup = arena_warmup
         #: cadence of the health/device sampler thread the server runs
         self.health_interval_s = health_interval_s
+        #: durable job journal (`myth serve --journal DIR`,
+        #: service/journal.py): every transition is an fsync'd WAL
+        #: record, so a SIGKILL/OOM mid-wave loses zero acknowledged
+        #: jobs. `recover` (`--recover`) replays prior segments at
+        #: startup: terminal jobs are adopted as history, non-terminal
+        #: jobs re-admitted (deduping through the verdict store), and
+        #: jobs in flight at the crash marker take a quarantine strike.
+        self.journal_dir = journal_dir
+        self.recover = recover
+        self.journal_fsync = journal_fsync
+        #: tier circuit breakers (support/breaker.py, `--no-breakers`):
+        #: device dispatch, device-first solving, kernel compile, and
+        #: store I/O each trip open on repeated failure and route down
+        #: their existing fallback ladder instead of re-failing per
+        #: job. ANDed with the process-wide support_args.breakers.
+        self.breakers = breakers
+        #: poison-job quarantine: a codehash implicated in this many
+        #: wave faults (async-fault attribution + crash-implication
+        #: strikes at recovery) settles FAILED with
+        #: DegradationReason.QUARANTINED at admission for the rest of
+        #: the process life; one strike short of that, the job is
+        #: isolated to a SOLO wave so a poison contract cannot take
+        #: innocent neighbors down with it.
+        self.quarantine_strikes = max(1, int(quarantine_strikes))
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -636,6 +667,20 @@ class AnalysisEngine:
             "mtpu_service_mesh_rebalance_bytes_total",
             "bytes re-uploaded by job migrations",
         ).labels(**lab)
+        self._c_quarantined = reg.counter(
+            "mtpu_quarantined_total",
+            "jobs settled FAILED by the poison-job quarantine "
+            "(denylisted codehash or strike threshold reached)",
+        ).labels(**lab)
+        self._c_recovered = reg.counter(
+            "mtpu_journal_recovered_jobs_total",
+            "non-terminal journaled jobs re-admitted at recovery",
+        ).labels(**lab)
+        self._c_recovery_deduped = reg.counter(
+            "mtpu_journal_recovery_deduped_total",
+            "recovered jobs settled instantly through the verdict "
+            "store instead of re-running",
+        ).labels(**lab)
         self._c_group_waves = reg.counter(
             "mtpu_service_group_waves_total",
             "waves dispatched per device group",
@@ -650,7 +695,8 @@ class AnalysisEngine:
             self._c_store_writebacks, self._c_spec_waves,
             self._c_generic_waves, self._c_fused, self._c_fallbacks,
             self._c_overlapped, self._c_multi_job, self._c_mesh_steals,
-            self._c_mesh_rebalance,
+            self._c_mesh_rebalance, self._c_quarantined,
+            self._c_recovered, self._c_recovery_deduped,
         ):
             child.inc(0)
         self._g_inflight.set(0)
@@ -715,6 +761,33 @@ class AnalysisEngine:
         # newest engine owns the source; tests run many engines per
         # process and the live serve runs one)
         observe.device_monitor().set_arena_source(self.alloc.occupancy)
+        # -- poison-job quarantine ------------------------------------
+        # strike counters by codehash (wave-fault attribution +
+        # crash-implication at recovery) and the process-lifetime
+        # denylist; a clean DONE settle clears a codehash's strikes
+        self._strikes: Dict[str, int] = {}
+        self._denylist: set = set()
+        #: idempotency-key -> job id (seeded from the journal at
+        #: recovery): a retried submit with a known key maps to the
+        #: existing job instead of double-running
+        self._idem: Dict[str, str] = {}
+        # -- durable job journal (service/journal.py) -----------------
+        self.journal = None
+        if self.cfg.journal_dir:
+            try:
+                from mythril_tpu.service.journal import JobJournal
+
+                self.journal = JobJournal(
+                    self.cfg.journal_dir, fsync=self.cfg.journal_fsync
+                )
+            except OSError:
+                log.warning("job journal unavailable", exc_info=True)
+        self.queue.journal = self.journal
+        if self.journal is not None and self.cfg.recover:
+            try:
+                self._recover_from_journal()
+            except Exception:
+                log.exception("journal recovery failed; serving fresh")
 
     # -- legacy counter names (views over the registry series) ---------
     @property
@@ -784,6 +857,15 @@ class AnalysisEngine:
         reasons: List[str] = []
         if self.queue.depth() >= self.queue.capacity:
             reasons.append(slo.REDLINE_QUEUE_SATURATED)
+        # open tier breakers (support/breaker.py): the replica is
+        # serving through a fallback ladder — enumerated so the
+        # federation front can route around it until the half-open
+        # probe recovers
+        if self.cfg.breakers:
+            from mythril_tpu.support import breaker as cb
+
+            if cb.breakers_enabled():
+                reasons.extend(cb.open_reasons())
         return reasons
 
     def _arena_warmup(self) -> None:
@@ -838,10 +920,27 @@ class AnalysisEngine:
         return self
 
     def submit(self, job: Job) -> Job:
+        """Admit `job` through the tier ladder; returns the CANONICAL
+        job — which is an earlier one when the submission carried an
+        idempotency key the service has already seen (a client retry
+        after a dropped connection or a server restart must map back
+        to the same job, never double-run)."""
+        from mythril_tpu.support.resilience import inject
+
+        inject("service.admit")
+        key = job.idempotency_key
+        if key:
+            existing = self.queue.get(self._idem.get(key, ""))
+            if existing is not None:
+                return existing
         observe.journey_event(
             job.journey_id, journey.TIER_ADMISSION, "submitted",
             code_len=len(job.code),
         )
+        if key:
+            self._idem[key] = job.id
+        if self._try_quarantine(job):
+            return job
         if self._try_store_hit(job):
             return job
         if self._try_static_answer(job):
@@ -849,6 +948,196 @@ class AnalysisEngine:
         self.queue.submit(job)  # raises QueueRefusal on backpressure
         self._wake.set()
         return job
+
+    # -- poison-job quarantine -----------------------------------------
+    def _strike(self, code_hash: str) -> int:
+        """One wave-fault (or crash-implication) strike against a
+        codehash; returns the new count."""
+        count = self._strikes.get(code_hash, 0) + 1
+        self._strikes[code_hash] = count
+        return count
+
+    def _is_quarantined(self, code_hash: str) -> bool:
+        return (
+            code_hash in self._denylist
+            or self._strikes.get(code_hash, 0)
+            >= self.cfg.quarantine_strikes
+        )
+
+    def _is_suspect(self, code_hash: str) -> bool:
+        """One strike short of quarantine: the job still runs, but
+        ISOLATED to a solo wave — a poison contract must not take
+        innocent arena neighbors down with its next fault."""
+        return self._strikes.get(code_hash, 0) >= 1
+
+    def _quarantine_job(self, job: Job, code_hash: str) -> None:
+        """Settle `job` FAILED with the QUARANTINED degradation and
+        denylist its codehash for the process lifetime. The job must
+        already be registered in the queue."""
+        from mythril_tpu.support.resilience import (
+            DegradationLog,
+            DegradationReason,
+        )
+
+        self._denylist.add(code_hash)
+        self._c_quarantined.inc()
+        job.degraded.append(DegradationReason.QUARANTINED)
+        job.error = (
+            job.error
+            or "codehash quarantined after repeated wave faults"
+        )
+        DegradationLog().record(
+            DegradationReason.QUARANTINED,
+            site="service-quarantine",
+            contract=job.id,
+            detail=code_hash[:16],
+        )
+        observe.journey_event(
+            job.journey_id, journey.TIER_ADMISSION, "quarantined",
+            code_hash=code_hash[:16],
+        )
+        job.report = {
+            "job_id": job.id,
+            "journey_id": job.journey_id,
+            "code_hash": code_hash,
+            "quarantined": True,
+            "issues": [],
+        }
+        self.queue.settle(job, JobState.FAILED)
+        self._routing_record(job, route="quarantined")
+
+    def _try_quarantine(self, job: Job) -> bool:
+        """The quarantine gate at admission: a denylisted (or
+        strike-threshold) codehash settles FAILED instantly —
+        registry-only admission, no queue slot, no wave, no chance to
+        crash the arena again. False lets the job continue down the
+        tier ladder; QueueRefusal propagates when draining."""
+        code_hash = CodeCache.code_hash(job.code)
+        if not self._is_quarantined(code_hash):
+            return False
+        self.queue.register(job)  # raises QueueRefusal when draining
+        self._quarantine_job(job, code_hash)
+        return True
+
+    # -- tier circuit breakers -----------------------------------------
+    def _breaker(self, tier: str):
+        """The tier's process-wide breaker, or None when the layer is
+        off (config knob AND the --no-breakers flag bag switch)."""
+        from mythril_tpu.support import breaker as cb
+
+        if not (self.cfg.breakers and cb.breakers_enabled()):
+            return None
+        return cb.breaker(tier)
+
+    def _breaker_allow(self, tier: str) -> bool:
+        br = self._breaker(tier)
+        return True if br is None else br.allow()
+
+    def _breaker_record(self, tier: str, ok: bool, detail: str = "") -> None:
+        br = self._breaker(tier)
+        if br is None:
+            return
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure(detail)
+
+    # -- journal recovery ----------------------------------------------
+    def _recover_from_journal(self) -> None:
+        """Replay prior journal segments: adopt terminal jobs as
+        queryable history (reports re-attached from the verdict store
+        when banked), strike crash-implicated in-flight jobs, re-admit
+        everything non-terminal through the normal tier ladder (the
+        store dedupes already-computed verdicts in microseconds), then
+        compact the old segments away."""
+        from mythril_tpu.service.journal import EVENT_SETTLED
+
+        replay = self.journal.replay_prior()
+        if not replay.records:
+            return
+        # crash-implication strikes BEFORE re-admission: a job that
+        # was on the device when the process died runs solo this time
+        # (and quarantines if it was already striked)
+        implicated = replay.crash_implicated()
+        for jj in implicated:
+            if jj.code_hash:
+                self._strike(jj.code_hash)
+        log.info(
+            "journal recovery: %d records across %d segments, %d jobs "
+            "(%d crash-implicated)%s",
+            replay.records, len(replay.segments), len(replay.jobs),
+            len(implicated),
+            "" if replay.clean_shutdown else " — UNCLEAN shutdown",
+        )
+        for jj in replay.jobs.values():
+            if jj.idempotency_key:
+                self._idem[jj.idempotency_key] = jj.job_id
+            if not jj.terminal:
+                continue
+            # terminal: adopt as history + re-journal one compact
+            # settled line so the NEXT recovery survives compaction
+            job = Job(code_hex=jj.code_hex or "00")
+            job.id = jj.job_id
+            job.journey_id = jj.job_id
+            job.idempotency_key = jj.idempotency_key
+            job.recovered = True
+            job.state = jj.state
+            if (
+                jj.state == JobState.DONE
+                and self.vstore is not None
+                and jj.code_hash
+            ):
+                try:
+                    entry = self.vstore.get(jj.code_hash, self._config_fp)
+                except Exception:
+                    entry = None
+                if entry is not None:
+                    job.report = {
+                        "job_id": job.id,
+                        "journey_id": job.journey_id,
+                        "code_hash": jj.code_hash,
+                        "store_hit": True,
+                        "recovered": True,
+                        "issues": entry.issues,
+                    }
+            self.queue.adopt(job)
+            self.journal.append(
+                EVENT_SETTLED, sync=False, job_id=jj.job_id,
+                state=jj.state, code_hash=jj.code_hash,
+                key=jj.idempotency_key,
+            )
+        for jj in replay.nonterminal():
+            if not jj.code_hex:
+                continue  # never durably admitted: nothing to re-run
+            try:
+                params = jj.params or {}
+                job = Job(
+                    code_hex=jj.code_hex,
+                    max_waves=params.get("max_waves"),
+                    deadline_s=params.get("deadline_s"),
+                    host_walk=params.get("host_walk"),
+                    lanes=params.get("lanes"),
+                    idempotency_key=jj.idempotency_key,
+                )
+            except ValueError:
+                continue
+            job.id = jj.job_id
+            job.journey_id = jj.job_id
+            job.recovered = True
+            if jj.idempotency_key:
+                self._idem[jj.idempotency_key] = job.id
+            try:
+                self.submit(job)
+            except Exception:
+                log.warning(
+                    "recovery re-admission refused for job %s",
+                    jj.job_id, exc_info=True,
+                )
+                continue
+            self._c_recovered.inc()
+            if job.terminal and (job.report or {}).get("store_hit"):
+                self._c_recovery_deduped.inc()
+        self.journal.compact()
 
     def _try_store_hit(self, job: Job) -> bool:
         """The verdict-store exact-hit tier at admission (HTTP thread,
@@ -963,12 +1252,14 @@ class AnalysisEngine:
                 "complete": job.error is None,
                 "store_hit": route == "store-hit",
                 "static_answered": route == "static-answer",
+                "quarantined": route == "quarantined",
             }
-            # the store-hit tier settles in microseconds: its record
-            # must not pay a CFG recovery for feature columns
+            # the store-hit/quarantine tiers settle in microseconds:
+            # their records must not pay a CFG recovery for feature
+            # columns
             summary = (
                 False
-                if route == "store-hit"
+                if route in ("store-hit", "quarantined")
                 else self.code_cache.static_summary(job.code)
             )
             observe.routing_log().record(
@@ -1057,6 +1348,12 @@ class AnalysisEngine:
         monitor = observe.device_monitor()
         if monitor._arena_source == self.alloc.occupancy:
             monitor.set_arena_source(None)
+        # the journal's clean-shutdown marker: every accepted job is
+        # terminal (completed or checkpointed) at this point, so a
+        # recovery of this journal re-admits nothing and strikes nobody
+        if self.journal is not None:
+            self.journal.mark_drain()
+            self.journal.close()
         self._drained.set()
 
     def close(self) -> None:
@@ -1113,11 +1410,26 @@ class AnalysisEngine:
         """Between waves: pull queued jobs into free stripes (striped
         over the device groups least-loaded-first when --devices > 1),
         then rebalance residents onto any group the admissions left
-        idle."""
+        idle. A SUSPECT job (one quarantine strike — implicated in a
+        wave fault or a crash) is only ever admitted into an EMPTY
+        arena and blocks co-admissions while resident: its next fault
+        must take down nobody else."""
+        if any(
+            self._is_suspect(CodeCache.code_hash(t.job.code))
+            for t in self._tracks.values()
+        ):
+            return  # a solo wave is in progress; nobody rides along
         free = self.alloc.stripes - self.alloc.occupancy()["stripes_busy"]
         if free <= 0:
             return
-        for job in self.queue.claim(free):
+        claimed = self.queue.claim(free)
+        stop_at: Optional[int] = None
+        for idx, job in enumerate(claimed):
+            suspect = self._is_suspect(CodeCache.code_hash(job.code))
+            if suspect and self._tracks:
+                # the suspect waits for an empty arena
+                stop_at = idx
+                break
             n_stripes = self.alloc.stripes_needed(
                 job.lanes or self.cfg.lanes_per_stripe
             )
@@ -1126,7 +1438,7 @@ class AnalysisEngine:
                 n_stripes = self.alloc.stripes_per_group
             granted = self.alloc.allocate(job.id, n_stripes)
             if granted is None:
-                self.queue.unclaim(job)
+                stop_at = idx
                 break
             self._ensure_code_cap(job.code)
             lanes = [
@@ -1147,8 +1459,18 @@ class AnalysisEngine:
             observe.journey_event(
                 job.journey_id, journey.TIER_LANE_GRANT, "granted",
                 stripes=len(granted), lanes=len(lanes),
-                group=self.alloc.group_of(granted[0]),
+                group=self.alloc.group_of(granted[0]), solo=suspect,
             )
+            if suspect:
+                # a solo wave: admit nobody else alongside
+                stop_at = idx + 1
+                break
+        if stop_at is not None:
+            # hand unplaced claims back in reverse so the queue keeps
+            # its FIFO order (unclaim inserts at the head)
+            for job in reversed(claimed[stop_at:]):
+                if job.id not in self._tracks:
+                    self.queue.unclaim(job)
         if self.mesh is not None:
             self._rebalance()
 
@@ -1281,6 +1603,11 @@ class AnalysisEngine:
         from mythril_tpu.laser.batch import specialize as _spec
 
         if not _spec.specialize_enabled():
+            return None
+        if not self._breaker_allow("kernel"):
+            # the kernel-compile breaker is open: the specialized tier
+            # is routed around — every wave runs the (already-warm)
+            # generic interpreter until the half-open probe recovers
             return None
         feeds = []
         for jid in job_ids:
@@ -1421,6 +1748,23 @@ class AnalysisEngine:
         self._admit()
         if not self._tracks:
             return None
+        if not self._breaker_allow("device"):
+            # the device-tier breaker is OPEN: route every resident
+            # job's device phase straight down the ladder to the host
+            # walk — zero doomed dispatches, zero per-job retry cost.
+            # The half-open probe (after recovery_s) re-enters the
+            # normal dispatch below and its outcome moves the breaker.
+            for track in list(self._tracks.values()):
+                del self._tracks[track.job.id]
+                self.alloc.release(track.stripes)
+                track.job.device_done_t = time.monotonic()
+                track.job.degraded.append("breaker-open:device")
+                observe.journey_event(
+                    track.job.journey_id, journey.TIER_WAVE,
+                    "breaker-skip",
+                )
+                self._dispatch_host(track)
+            return None
         halt_row = self.cfg.stripes
         n = self.alloc.n_lanes
         code_ids = np.full((n,), halt_row, np.int32)
@@ -1436,6 +1780,11 @@ class AnalysisEngine:
             for lane, data in zip(track.lanes, inputs):
                 code_ids[lane] = track.code_row
                 calldata[lane] = data
+        if self.journal is not None:
+            # WAL ordering: the intent record lands before the device
+            # does anything — a crash during this wave implicates
+            # exactly these jobs at recovery
+            self.journal.wave_dispatched(list(wave_inputs))
         if self.mesh is not None:
             return self._dispatch_wave_mesh(code_ids, calldata, wave_inputs)
         batch = make_batch(
@@ -1463,6 +1812,7 @@ class AnalysisEngine:
         try:
             import jax
 
+            resilience.inject("service.dispatch")
             with trace(
                 "service.wave.dispatch", track="service",
                 jobs=len(wave_inputs),
@@ -1577,6 +1927,7 @@ class AnalysisEngine:
                 if gid == group.gid
             ]
             try:
+                resilience.inject("service.dispatch")
                 table = self._table(device)
                 spec = self._wave_kernel(group_jobs, batch, table, donate)
                 if spec is not None:
@@ -1680,6 +2031,7 @@ class AnalysisEngine:
         if record.get("groups") is not None:
             return self._harvest_wave_mesh(record)
         try:
+            resilience.inject("service.harvest")
             if record["failed"] is not None:
                 raise record["failed"]
             # asynchronous XLA faults surface HERE, attributed to the
@@ -1700,9 +2052,11 @@ class AnalysisEngine:
                 self._c_fused.inc(int(record["fused"]))
             if record.get("blocks") is not None:
                 self._c_blocks.inc(int(record["blocks"]))
+            self._breaker_record("device", True)
         except Exception as why:
             if not resilience.is_device_fault(why):
                 raise
+            self._breaker_record("device", False, str(why))
             resilience.DegradationLog().record(
                 resilience.DegradationReason.ASYNC_DEVICE_FAULT,
                 site="service-wave",
@@ -1770,6 +2124,7 @@ class AnalysisEngine:
         for grec in record["groups"]:
             gid = grec["gid"]
             try:
+                resilience.inject("service.harvest")
                 if grec["failed"] is not None:
                     raise grec["failed"]
                 jax.block_until_ready(grec["steps"])
@@ -1778,9 +2133,11 @@ class AnalysisEngine:
                     self._c_fused.inc(int(grec["fused"]))
                 if grec.get("blocks") is not None:
                     self._c_blocks.inc(int(grec["blocks"]))
+                self._breaker_record("device", True)
             except Exception as why:
                 if not resilience.is_device_fault(why):
                     raise
+                self._breaker_record("device", False, str(why))
                 resilience.DegradationLog().record(
                     resilience.DegradationReason.ASYNC_DEVICE_FAULT,
                     site=f"service-wave/mesh-g{gid}",
@@ -1864,7 +2221,7 @@ class AnalysisEngine:
             track = self._tracks.pop(jid)
             self.alloc.release(track.stripes)
             track.job.error = f"device wave failed in mesh-g{gid}: {why}"
-            self.queue.settle(track.job, JobState.FAILED)
+            self._fail_with_strike(track.job)
 
     def _fail_wave(self, why: Exception) -> None:
         """A wave died past run_resilient's whole escalation ladder:
@@ -1884,7 +2241,22 @@ class AnalysisEngine:
             del self._tracks[track.job.id]
             self.alloc.release(track.stripes)
             track.job.error = f"device wave failed: {why}"
-            self.queue.settle(track.job, JobState.FAILED)
+            self._fail_with_strike(track.job)
+
+    def _fail_with_strike(self, job: Job) -> None:
+        """Settle a wave-faulted job FAILED with quarantine
+        attribution: every job resident in the dead wave takes a
+        strike (a poison contract and its innocent neighbors are
+        indistinguishable HERE — the solo-wave isolation on the next
+        submission is what tells them apart: innocents pass their solo
+        wave and the strike clears; the poison faults again and
+        quarantines)."""
+        code_hash = CodeCache.code_hash(job.code)
+        strikes = self._strike(code_hash)
+        if strikes >= self.cfg.quarantine_strikes:
+            self._quarantine_job(job, code_hash)
+            return
+        self.queue.settle(job, JobState.FAILED)
 
     # -- host phase ----------------------------------------------------
     def _dispatch_host(self, track: _JobTrack) -> None:
@@ -2024,6 +2396,10 @@ class AnalysisEngine:
         self._routing_record(job)
         self.queue.settle(job, state)
         if state == JobState.DONE:
+            # a clean completion clears any quarantine strikes: an
+            # innocent job implicated in a shared-wave fault proves
+            # itself by passing its solo wave
+            self._strikes.pop(CodeCache.code_hash(job.code), None)
             self._store_writeback(job, report, outcome)
 
     def _store_writeback(
@@ -2162,6 +2538,18 @@ class AnalysisEngine:
         out["cache_hits"] = out.pop("hits")
         out["cache_misses"] = out.pop("misses")
         return out
+
+    def _breaker_stats(self) -> Dict:
+        """`/stats breaker.*`: the tier circuit-breaker board
+        (support/breaker.py) — per-tier state/trip/failure counters,
+        process-wide (the tiers are shared, not per-engine)."""
+        from mythril_tpu.support import breaker as cb
+
+        enabled = bool(self.cfg.breakers) and cb.breakers_enabled()
+        return {
+            "enabled": enabled,
+            "tiers": cb.board_stats() if enabled else {},
+        }
 
     @staticmethod
     def _solver_stats(snap: Dict) -> Dict:
@@ -2336,6 +2724,26 @@ class AnalysisEngine:
                     sv("mtpu_service_static_answered_total")
                 ),
                 "answer_enabled": bool(self.cfg.static_answer),
+            },
+            "journal": dict(
+                (
+                    self.journal.stats()
+                    if self.journal is not None
+                    else {"enabled": False}
+                ),
+                recovered_jobs=int(
+                    sv("mtpu_journal_recovered_jobs_total")
+                ),
+                recovery_deduped=int(
+                    sv("mtpu_journal_recovery_deduped_total")
+                ),
+            ),
+            "breaker": self._breaker_stats(),
+            "quarantine": {
+                "strikes": dict(self._strikes),
+                "denylisted": len(self._denylist),
+                "strike_threshold": self.cfg.quarantine_strikes,
+                "quarantined": int(sv("mtpu_quarantined_total")),
             },
             "kernel": self._kernel_stats(),
             "solver": self._solver_stats(snap),

@@ -50,6 +50,7 @@ class Job:
         deadline_s: Optional[float] = None,
         host_walk: Optional[bool] = None,
         lanes: Optional[int] = None,
+        idempotency_key: Optional[str] = None,
     ) -> None:
         code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
         self.code = bytes.fromhex(code_hex)  # raises ValueError on junk
@@ -74,6 +75,17 @@ class Job:
         self.checkpoint_path: Optional[str] = None
         self.waves = 0
         self.degraded: List[str] = []
+        #: client-supplied dedupe key (service/journal.py): a retried
+        #: submit — same key — after a connection drop or a server
+        #: restart maps back to the SAME job instead of double-running
+        self.idempotency_key = idempotency_key
+        #: True once the journal holds this job's durable `admitted`
+        #: record (the settle record then fsyncs too; instant-tier
+        #: settles of never-admitted jobs are written unsynced)
+        self.journaled_admit = False
+        #: True for jobs reconstructed from a journal replay — their
+        #: reports may have been re-attached from the verdict store
+        self.recovered = False
         #: the tier-ladder timeline key (observe/journey.py): service
         #: jobs reuse the job id so /v1/jobs/<id>/trace needs no map
         self.journey_id = self.id
@@ -99,6 +111,8 @@ class Job:
             out["checkpoint"] = self.checkpoint_path
         if self.degraded:
             out["degraded"] = list(self.degraded)
+        if self.recovered:
+            out["recovered"] = True
         if self.report is not None:
             out["report"] = self.report
         return out
@@ -125,6 +139,12 @@ class JobQueue:
         self.accepted = 0
         self.rejected_full = 0
         self.rejected_draining = 0
+        #: the durable job journal (service/journal.py), set by the
+        #: engine when `--journal DIR` is in force. Appends happen
+        #: OUTSIDE the queue lock (an fsync must not block the HTTP
+        #: threads) and the admitted record lands BEFORE submit
+        #: returns — an acknowledged job is on disk first.
+        self.journal = None
 
     def submit(self, job: Job) -> None:
         """Admit `job` or raise QueueRefusal with the backpressure
@@ -152,6 +172,10 @@ class JobQueue:
             self._pending.append(job)
             self._jobs[job.id] = job
             self._settled.notify_all()
+        if self.journal is not None:
+            # the WAL half of the admission contract: the fsync'd
+            # record lands before the caller can acknowledge the job
+            job.journaled_admit = self.journal.job_admitted(job)
         journey_event(
             job.journey_id, journey.TIER_QUEUED, "enqueued",
             depth=len(self._pending),
@@ -180,6 +204,15 @@ class JobQueue:
             self._jobs[job.id] = job
             self._settled.notify_all()
 
+    def adopt(self, job: Job) -> None:
+        """Install an already-terminal job into the registry without
+        admission accounting — journal recovery re-materializing a job
+        that settled in a previous process life, so GET /v1/jobs/<id>
+        keeps answering across a crash. Never queues, never refuses."""
+        with self._lock:
+            self._jobs[job.id] = job
+            self._settled.notify_all()
+
     def claim(self, limit: int) -> List[Job]:
         """Pop up to `limit` queued jobs for arena admission (FIFO) and
         mark them RUNNING. The engine calls this between waves."""
@@ -190,6 +223,8 @@ class JobQueue:
                 job.state = JobState.RUNNING
                 job.started_t = time.monotonic()
                 out.append(job)
+        if out and self.journal is not None:
+            self.journal.jobs_claimed([job.id for job in out])
         for job in out:
             journey_event(
                 job.journey_id, journey.TIER_QUEUED, "claimed",
@@ -217,6 +252,14 @@ class JobQueue:
             "mtpu_service_jobs_settled_total",
             "jobs reaching a terminal state, by state",
         ).labels(state=state).inc()
+        if self.journal is not None:
+            # outside the lock (the fsync must not block waiters); an
+            # instant-tier settle of a never-admitted job skips the
+            # fsync — the verdict was already delivered, the line is
+            # only post-crash GET history
+            self.journal.job_settled(
+                job, state, sync=job.journaled_admit
+            )
         with self._lock:
             job.state = state
             job.finished_t = time.monotonic()

@@ -1,0 +1,420 @@
+"""Durable job journal: an append-only, fsync'd write-ahead log of
+every service job transition, and the replay that makes `myth serve`
+crash-consistent.
+
+The drain path (SIGTERM) already loses nothing — but a SIGKILL, an
+OOM kill, or a wedged device that takes the process down mid-wave
+silently loses every acknowledged job: the queue and the job registry
+are pure memory. This module is the standard WAL fix, the same
+at-least-once discipline distributed symbolic executors (Manticore's
+distributed exploration, PAPERS.md) and serving stacks rely on:
+
+- every transition is appended as one JSON line to the current
+  segment (``wal-NNNNNN.jsonl`` under the journal directory) and
+  fsync'd BEFORE the client sees the 202 — an acknowledged job is on
+  disk or it was never acknowledged;
+- on restart (`myth serve --journal DIR --recover`) the engine
+  replays every prior segment: jobs whose last event is terminal are
+  adopted as history (their banked verdict re-attached from the
+  PR-11 store when available), non-terminal jobs are re-admitted —
+  deduping through the verdict store so an already-computed verdict
+  settles in microseconds instead of re-running — and jobs that were
+  IN FLIGHT at the crash get a crash-implication strike toward the
+  poison-job quarantine (engine.py);
+- after a successful replay the prior segments are compacted away:
+  terminal jobs are re-journaled as one compact ``settled`` line in
+  the fresh segment, re-admitted jobs re-journal their own
+  ``admitted`` lines, and only then are the old files unlinked.
+
+Event vocabulary (docs/architecture.md has the schema table):
+
+  admitted    full code hex + submit params + idempotency key —
+              everything recovery needs to re-run the job
+  claimed     job ids popped from the queue into the arena
+  dispatched  job ids riding one device wave (one line per wave)
+  settled     terminal state + code hash + idempotency key
+  drain       the clean-shutdown marker; a journal whose last line is
+              anything else records a crash
+
+Torn tail lines (the crash landed mid-append) are tolerated: replay
+stops that segment at the first unparseable line and counts it.
+
+A failed append (disk full, injected ``service.journal.write``
+fault) NEVER fails admission: the journal degrades to non-durable for
+the rest of its life, records `DegradationReason.JOURNAL_DEGRADED`
+once, and keeps serving — crash-safety is honestly reported as lost
+(`/stats journal.degraded`), not faked.
+
+The instant admission tiers (store-hit / static-answer / quarantine)
+settle in microseconds; their single ``settled`` line is written
+WITHOUT an fsync (``sync=False``) — the work was already delivered to
+the client, and losing the line merely loses post-crash GET history,
+never work. Full-path events always fsync.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+#: journal record schema — bump on any key-set change; replay refuses
+#: records from a NEWER schema (a rolled-back replica must not
+#: misparse a newer writer's log) and tolerates older ones
+JOURNAL_SCHEMA_VERSION = 1
+
+EVENT_ADMITTED = "admitted"
+EVENT_CLAIMED = "claimed"
+EVENT_DISPATCHED = "dispatched"
+EVENT_SETTLED = "settled"
+EVENT_DRAIN = "drain"
+
+#: job states replay treats as terminal (JobState.TERMINAL mirror —
+#: kept local so replay never imports the service stack)
+TERMINAL_STATES = ("done", "failed", "checkpointed")
+
+_SEGMENT_RE = re.compile(r"^wal-(\d{6})\.jsonl$")
+
+
+class JournaledJob:
+    """One job's replayed journal state."""
+
+    __slots__ = (
+        "job_id", "code_hex", "code_hash", "params", "idempotency_key",
+        "state", "inflight", "events",
+    )
+
+    def __init__(self, job_id: str) -> None:
+        self.job_id = job_id
+        self.code_hex: Optional[str] = None
+        self.code_hash: Optional[str] = None
+        self.params: Dict = {}
+        self.idempotency_key: Optional[str] = None
+        self.state: Optional[str] = None  # last settled state
+        self.inflight = False  # claimed/dispatched after last settle
+        self.events: List[str] = []
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+
+class JournalReplay:
+    """The parsed content of every prior segment."""
+
+    def __init__(self) -> None:
+        self.jobs: "Dict[str, JournaledJob]" = {}
+        self.records = 0
+        self.torn_lines = 0
+        self.clean_shutdown = False
+        self.segments: List[str] = []
+
+    def _job(self, job_id: str) -> JournaledJob:
+        job = self.jobs.get(job_id)
+        if job is None:
+            job = JournaledJob(job_id)
+            self.jobs[job_id] = job
+        return job
+
+    def consume(self, rec: Dict) -> None:
+        event = rec.get("event")
+        self.records += 1
+        self.clean_shutdown = event == EVENT_DRAIN
+        if event == EVENT_ADMITTED:
+            job = self._job(rec["job_id"])
+            job.code_hex = rec.get("code")
+            job.code_hash = rec.get("code_hash") or job.code_hash
+            job.params = dict(rec.get("params") or {})
+            job.idempotency_key = rec.get("key") or job.idempotency_key
+            job.events.append(event)
+        elif event in (EVENT_CLAIMED, EVENT_DISPATCHED):
+            for job_id in rec.get("job_ids") or ():
+                job = self._job(job_id)
+                job.inflight = True
+                job.events.append(event)
+        elif event == EVENT_SETTLED:
+            job = self._job(rec["job_id"])
+            job.state = rec.get("state")
+            job.code_hash = rec.get("code_hash") or job.code_hash
+            job.idempotency_key = rec.get("key") or job.idempotency_key
+            job.inflight = False
+            job.events.append(event)
+
+    def nonterminal(self) -> List[JournaledJob]:
+        """Jobs that must be re-admitted, in journal order."""
+        return [j for j in self.jobs.values() if not j.terminal]
+
+    def crash_implicated(self) -> List[JournaledJob]:
+        """Jobs in flight at the crash marker — claimed or dispatched
+        with no settle, in a journal that did NOT end with the drain
+        marker. These take a quarantine strike: whatever killed the
+        process mid-wave, they were on the device when it happened."""
+        if self.clean_shutdown:
+            return []
+        return [
+            j for j in self.jobs.values() if j.inflight and not j.terminal
+        ]
+
+
+class JobJournal:
+    """The append half: one writer per process, one fresh segment per
+    process lifetime."""
+
+    def __init__(self, directory: str, fsync: bool = True) -> None:
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.fsync = fsync
+        self._mu = threading.Lock()
+        self._prior = self._existing_segments()
+        serial = 1
+        if self._prior:
+            serial = (
+                int(_SEGMENT_RE.match(
+                    os.path.basename(self._prior[-1])
+                ).group(1))
+                + 1
+            )
+        self.path = os.path.join(self.dir, f"wal-{serial:06d}.jsonl")
+        self._fp = open(self.path, "a")
+        # -- /stats counters (registry doubles below) ------------------
+        self.appends = 0
+        self.bytes_written = 0
+        self.errors = 0
+        self.degraded = False
+        self.wall_s = 0.0  # cumulative append+fsync wall (overhead
+        # accounting: the chaos harness gates journal cost per settled
+        # job against the warm p50)
+        self._closed = False
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            reg = registry()
+            self._c_appends = reg.counter(
+                "mtpu_journal_appends_total",
+                "job-journal records appended",
+            )
+            self._c_bytes = reg.counter(
+                "mtpu_journal_bytes_total", "job-journal bytes appended"
+            )
+            self._c_errors = reg.counter(
+                "mtpu_journal_errors_total",
+                "failed journal appends (the journal degrades to "
+                "non-durable; admission never fails)",
+            )
+            for c in (self._c_appends, self._c_bytes, self._c_errors):
+                c.inc(0)
+        except Exception:
+            self._c_appends = self._c_bytes = self._c_errors = None
+
+    # -- segments ------------------------------------------------------
+    def _existing_segments(self) -> List[str]:
+        try:
+            names = sorted(
+                n for n in os.listdir(self.dir) if _SEGMENT_RE.match(n)
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    # -- append half ---------------------------------------------------
+    def append(self, event: str, sync: Optional[bool] = None, **fields) -> bool:
+        """Append one record; True when it is durably (or, with
+        sync=False, at least OS-buffered) on disk. A failure degrades
+        the journal to non-durable for the rest of its life and
+        records JOURNAL_DEGRADED — it never raises into admission."""
+        if self.degraded or self._closed:
+            return False
+        rec = dict(fields)
+        rec["schema"] = JOURNAL_SCHEMA_VERSION
+        rec["ts"] = time.time()
+        rec["event"] = event
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        t0 = time.perf_counter()
+        try:
+            with self._mu:
+                from mythril_tpu.support.resilience import inject
+
+                inject("service.journal.write")
+                self._fp.write(line)
+                self._fp.flush()
+                if self.fsync and (sync is None or sync):
+                    os.fsync(self._fp.fileno())
+        except Exception as why:
+            self.errors += 1
+            if self._c_errors is not None:
+                self._c_errors.inc()
+            self.degraded = True
+            try:
+                from mythril_tpu.support.resilience import (
+                    DegradationLog,
+                    DegradationReason,
+                )
+
+                DegradationLog().record(
+                    DegradationReason.JOURNAL_DEGRADED,
+                    site="service.journal.write",
+                    detail=str(why),
+                )
+            except Exception:
+                log.warning("journal degraded to non-durable: %s", why)
+            return False
+        finally:
+            self.wall_s += time.perf_counter() - t0
+        self.appends += 1
+        self.bytes_written += len(line)
+        if self._c_appends is not None:
+            self._c_appends.inc()
+            self._c_bytes.inc(len(line))
+        return True
+
+    def job_admitted(self, job) -> bool:
+        """The durable admission record — fsync'd BEFORE the caller
+        acknowledges the job."""
+        return self.append(
+            EVENT_ADMITTED,
+            job_id=job.id,
+            code=job.code.hex(),
+            code_hash=_code_hash(job.code),
+            key=getattr(job, "idempotency_key", None),
+            params={
+                "max_waves": job.max_waves,
+                "deadline_s": (
+                    job.deadline.budget_s if job.deadline else None
+                ),
+                "host_walk": job.host_walk,
+                "lanes": job.lanes,
+            },
+        )
+
+    def jobs_claimed(self, job_ids: List[str]) -> bool:
+        """Unsynced: claim/dispatch records feed the crash-implication
+        HEURISTIC (which jobs were on the device), not the no-loss
+        guarantee — that lives entirely in the fsync'd admitted and
+        settled records. Losing a buffered claim line to a crash can
+        only under-strike, never lose a job."""
+        if not job_ids:
+            return True
+        return self.append(EVENT_CLAIMED, sync=False, job_ids=list(job_ids))
+
+    def wave_dispatched(self, job_ids: List[str]) -> bool:
+        if not job_ids:
+            return True
+        return self.append(
+            EVENT_DISPATCHED, sync=False, job_ids=list(job_ids)
+        )
+
+    def job_settled(self, job, state: str, sync: bool = True) -> bool:
+        return self.append(
+            EVENT_SETTLED,
+            sync=sync,
+            job_id=job.id,
+            state=state,
+            code_hash=_code_hash(job.code),
+            key=getattr(job, "idempotency_key", None),
+        )
+
+    def mark_drain(self) -> bool:
+        """The clean-shutdown marker (a replay that finds it last
+        knows no job was in flight)."""
+        return self.append(EVENT_DRAIN)
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._fp.close()
+                except OSError:
+                    pass
+
+    # -- replay half ---------------------------------------------------
+    def replay_prior(self) -> JournalReplay:
+        """Parse every segment that predates this writer's own."""
+        return replay_segments(self._prior)
+
+    def compact(self) -> int:
+        """Unlink the prior segments (call AFTER recovery has
+        re-journaled what still matters into the fresh segment).
+        Returns the number of files removed."""
+        removed = 0
+        for path in self._prior:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                continue
+        self._prior = []
+        return removed
+
+    def stats(self) -> Dict:
+        return {
+            "enabled": True,
+            "dir": self.dir,
+            "segment": os.path.basename(self.path),
+            "appends": self.appends,
+            "bytes": self.bytes_written,
+            "errors": self.errors,
+            "degraded": self.degraded,
+            "wall_s": round(self.wall_s, 6),
+            "fsync": self.fsync,
+        }
+
+
+def replay_segments(paths: List[str]) -> JournalReplay:
+    """Parse journal segments in order, tolerating torn tail lines
+    (the crash landed mid-append) and refusing newer-schema records."""
+    replay = JournalReplay()
+    for path in paths:
+        replay.segments.append(path)
+        try:
+            with open(path) as fp:
+                lines = fp.read().splitlines()
+        except OSError as why:
+            log.warning("journal segment %s unreadable: %s", path, why)
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError("record is not an object")
+                if int(rec.get("schema", 1)) > JOURNAL_SCHEMA_VERSION:
+                    raise ValueError("record schema newer than reader")
+            except ValueError:
+                # a torn append: everything after it in THIS segment
+                # is suspect; later segments are separate writers
+                replay.torn_lines += 1
+                log.warning(
+                    "journal segment %s: torn record, stopping the "
+                    "segment here", path,
+                )
+                break
+            replay.consume(rec)
+    return replay
+
+
+def replay_dir(directory: str) -> JournalReplay:
+    """Replay every segment under `directory` (read-only helper for
+    tools and tests; the engine goes through JobJournal.replay_prior
+    so its own fresh segment is excluded)."""
+    directory = os.path.abspath(directory)
+    try:
+        names = sorted(
+            n for n in os.listdir(directory) if _SEGMENT_RE.match(n)
+        )
+    except OSError:
+        return JournalReplay()
+    return replay_segments([os.path.join(directory, n) for n in names])
+
+
+def _code_hash(code: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(code).hexdigest()

@@ -203,19 +203,27 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s=body.get("deadline_s"),
                 host_walk=body.get("host_walk"),
                 lanes=body.get("lanes"),
+                idempotency_key=body.get("idempotency_key"),
             )
         except (KeyError, ValueError, TypeError) as why:
             self._reply(400, {"error": f"bad request: {why}"})
             return
         try:
-            self.engine.submit(job)
+            # submit returns the CANONICAL job: a known idempotency
+            # key maps a retried submit back to the existing job (the
+            # journal seeds the key index across restarts) instead of
+            # double-running it
+            canonical = self.engine.submit(job)
         except QueueRefusal as refusal:
             self._reply(
                 _REFUSAL_STATUS.get(refusal.reason, 503),
                 {"error": str(refusal), "reason": refusal.reason},
             )
             return
-        self._reply(202, {"job_id": job.id, "state": job.state})
+        payload = {"job_id": canonical.id, "state": canonical.state}
+        if canonical.id != job.id:
+            payload["deduped"] = True
+        self._reply(202, payload)
 
 
 class AnalysisServer:

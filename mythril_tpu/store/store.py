@@ -217,13 +217,45 @@ class VerdictStore:
             log.warning("verdict store refused entry %s: %s", path, why)
             return None
 
+    # -- the store-tier circuit breaker ----------------------------------
+    @staticmethod
+    def _breaker():
+        """The store-tier breaker (support/breaker.py), or None when
+        the layer is off. An OPEN breaker turns every lookup into a
+        miss and every write into a no-op — the tier ladder's
+        store->miss rung with memory, so a dead disk is not re-probed
+        per job."""
+        from mythril_tpu.support import breaker as cb
+
+        if not cb.breakers_enabled():
+            return None
+        return cb.breaker(cb.TIER_STORE)
+
     # -- lookups ---------------------------------------------------------
     def get(self, code_hash: str, config_fp: str) -> Optional[StoreEntry]:
         """Exact hit or None. A refused (corrupt/mismatched) entry is
         a miss — never a partial answer."""
+        br = self._breaker()
+        if br is not None and not br.allow():
+            with self._mu:
+                self.misses += 1
+            self._c["misses"].inc()
+            return None
         name = f"{_entry_key(code_hash, config_fp)}.json"
         path = os.path.join(self.entries_dir, name)
-        if not os.path.exists(path):
+        try:
+            from mythril_tpu.support.resilience import inject
+
+            inject("store.read")
+            exists = os.path.exists(path)
+        except Exception as why:
+            if br is not None:
+                br.record_failure(str(why))
+            with self._mu:
+                self.misses += 1
+            self._c["misses"].inc()
+            return None
+        if not exists:
             with self._mu:
                 self.misses += 1
             self._c["misses"].inc()
@@ -252,6 +284,8 @@ class VerdictStore:
             self.hits += 1
             self._remember(entry, name)
         self._c["hits"].inc()
+        if br is not None:
+            br.record_success()
         return entry
 
     def nearest(
@@ -339,21 +373,47 @@ class VerdictStore:
             ),
         }
         entry["payload_sha"] = _payload_sha(entry)
+        br = self._breaker()
+        if br is not None and not br.allow():
+            return None  # the write tier is open: degrade to no-op
         name = f"{_entry_key(code_hash, config_fp)}.json"
         path = os.path.join(self.entries_dir, name)
         blob = json.dumps(entry, sort_keys=True)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
+            from mythril_tpu.support.resilience import inject
+
+            inject("store.write")
             with open(tmp, "w") as fp:
                 fp.write(blob)
+                # durability before visibility: the entry's bytes are
+                # on the platter BEFORE the rename publishes it — a
+                # crash can leave a stale tmp file, never a published
+                # entry whose content is still in the page cache
+                fp.flush()
+                os.fsync(fp.fileno())
             os.replace(tmp, path)  # atomic: readers see old or new
-        except OSError as why:
+            # ... and the rename itself: fsync the parent directory so
+            # the entry survives a power cut after put() returns
+            try:
+                dir_fd = os.open(self.entries_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except OSError:
+                pass  # not every filesystem supports directory fsync
+        except Exception as why:
             log.warning("verdict store write failed for %s: %s", name, why)
+            if br is not None:
+                br.record_failure(str(why))
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
             return None
+        if br is not None:
+            br.record_success()
         with self._mu:
             self.writes += 1
             self.bytes_written += len(blob)

@@ -81,6 +81,17 @@ class DegradationReason:
     INTERRUPTED = "interrupted"
     CONTRACT_SKIPPED = "contract-skipped"
     PREPASS_FAILED = "prepass-failed"
+    #: a poison job — implicated in repeated wave faults (in-process
+    #: strike counter fed by wave-fault attribution plus a
+    #: crash-implication strike at journal recovery) — was isolated to
+    #: a solo wave, failed again, and is now settled FAILED with its
+    #: codehash denylisted for the process lifetime (service/engine.py)
+    QUARANTINED = "quarantined"
+    #: a journal append failed (disk full, injected fault): the job
+    #: journal degrades to NON-DURABLE for the rest of its life and
+    #: admission keeps working — crash-safety is reported lost, never
+    #: traded for availability (service/journal.py)
+    JOURNAL_DEGRADED = "journal-degraded"
 
 
 #: observers notified after every DegradationLog.record — the

@@ -110,6 +110,14 @@ class Args(object, metaclass=Singleton):
         # directory configured — the parity-differential baseline.
         self.store_dir = os.environ.get("MYTHRIL_STORE_DIR") or None
         self.store = True
+        # Tier circuit breakers (support/breaker.py, CLI
+        # --no-breakers): a persistently failing tier (device
+        # dispatch, device-first solving, kernel compile, store I/O)
+        # trips open and is routed around via the existing fallback
+        # ladder instead of re-failing per job; half-open probes close
+        # it when the tier recovers. Off restores the pre-breaker
+        # behavior — the differential baseline.
+        self.breakers = True
         # Reproducible-report mode (CLI --deterministic-solving; the
         # golden harness pins it): marathon solves get a conflict
         # budget derived from the query timeout instead of running to

@@ -151,6 +151,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "chaos: crash-safe serving suite (durable job journal append/"
+        "replay, recovery re-admission with store dedupe, poison-job "
+        "quarantine strike escalation, tier circuit-breaker "
+        "transitions and ladder fallback, journal-fault degradation; "
+        "CPU-only — runs in tier-1, selectable with -m chaos; the "
+        "subprocess SIGKILL harness is tools/chaos_smoke.py via "
+        "[testenv:chaos])",
+    )
+    config.addinivalue_line(
+        "markers",
         "taint: taint & value-set static layer suite (attacker-taint "
         "fixpoint goldens, semantic screen soundness sweep over every "
         "module positive fixture, static-answer triage differential, "

@@ -1,0 +1,640 @@
+"""Crash-safe serving suite (`-m chaos`): the durable job journal,
+journal recovery with verdict-store dedupe, poison-job quarantine,
+and the tier circuit breakers.
+
+Engine-less servers wherever the machinery under test lives at
+admission (journal WAL ordering, recovery re-admission, quarantine
+denylist, idempotency dedupe); small started engines where a real
+wave fault is the subject (strike escalation, the device-tier breaker
+ladder). The subprocess SIGKILL-mid-wave harness — the half that
+needs a process to actually die — is tools/chaos_smoke.py, wired as
+tox [testenv:chaos]. CPU-only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import poison_contract
+from mythril_tpu.exceptions import InjectedFault
+from mythril_tpu.service.client import ServiceClient
+from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+from mythril_tpu.service.jobs import Job, JobState
+from mythril_tpu.service.journal import (
+    JobJournal,
+    replay_dir,
+)
+from mythril_tpu.service.server import AnalysisServer
+from mythril_tpu.store import open_store
+from mythril_tpu.support import breaker as cb
+from mythril_tpu.support.resilience import (
+    DegradationLog,
+    DegradationReason,
+    arm_fault,
+    disarm_faults,
+)
+from mythril_tpu.support.support_args import args as support_args
+
+pytestmark = [pytest.mark.chaos, pytest.mark.service]
+
+#: the fault-suite shapes (tests/laser/test_pipeline.py)
+KILLABLE = "33ff"
+WRITER = "6001600055600060015500"
+BRANCHER = "600035600757005b600160005500"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    steps_per_wave=64,
+    max_waves=1,
+    queue_capacity=8,
+    host_walk=False,
+    coalesce_wait_s=0.02,
+    idle_wait_s=0.02,
+)
+
+
+def code_hash(code_hex: str) -> str:
+    return hashlib.sha256(bytes.fromhex(code_hex)).hexdigest()
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Breakers and armed faults are process-global: every test gets
+    a fresh board and leaves none armed."""
+    cb.reset_all()
+    disarm_faults()
+    yield
+    cb.reset_all()
+    disarm_faults()
+
+
+def _engine(tmp_path, **overrides) -> AnalysisEngine:
+    cfg = dict(CFG)
+    cfg.update(overrides)
+    return AnalysisEngine(ServiceConfig(**cfg))
+
+
+def _wait_terminal(engine, job_id, timeout_s=60.0):
+    job = engine.queue.wait_terminal(job_id, timeout_s)
+    assert job is not None and job.terminal, (
+        f"job {job_id} not terminal: {job and job.state}"
+    )
+    return job
+
+
+# -- 1. journal append/replay round-trip ------------------------------------
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    jd = str(tmp_path / "wal")
+    journal = JobJournal(jd)
+    job = Job(KILLABLE, max_waves=3, idempotency_key="key-1")
+    assert journal.job_admitted(job)
+    assert journal.jobs_claimed([job.id])
+    assert journal.wave_dispatched([job.id])
+    done = Job(WRITER, idempotency_key="key-2")
+    assert journal.job_admitted(done)
+    assert journal.job_settled(done, JobState.DONE)
+    journal.close()
+
+    replay = replay_dir(jd)
+    assert replay.records == 5
+    assert not replay.clean_shutdown  # no drain marker: a crash
+    inflight = replay.jobs[job.id]
+    assert inflight.code_hex == KILLABLE
+    assert inflight.params["max_waves"] == 3
+    assert inflight.idempotency_key == "key-1"
+    assert inflight.inflight and not inflight.terminal
+    settled = replay.jobs[done.id]
+    assert settled.terminal and settled.state == JobState.DONE
+    assert settled.code_hash == code_hash(WRITER)
+    assert [inflight] == replay.crash_implicated()
+
+    # a drain marker flips the crash classification
+    journal2 = JobJournal(jd)
+    journal2.mark_drain()
+    journal2.close()
+    replay = replay_dir(jd)
+    assert replay.clean_shutdown
+    assert replay.crash_implicated() == []
+
+
+def test_journal_replay_tolerates_torn_tail(tmp_path):
+    jd = str(tmp_path / "wal")
+    journal = JobJournal(jd)
+    job = Job(KILLABLE)
+    journal.job_admitted(job)
+    journal.close()
+    # the crash landed mid-append: a torn half-record at the tail
+    with open(journal.path, "a") as fp:
+        fp.write('{"event": "settl')
+    replay = replay_dir(jd)
+    assert replay.torn_lines == 1
+    assert replay.records == 1  # the good record still replays
+    assert job.id in replay.jobs
+
+
+# -- 2. recovery re-admission + store dedupe --------------------------------
+
+
+def test_recovery_readmits_and_dedupes_through_store(tmp_path):
+    jd = str(tmp_path / "wal")
+    sd = str(tmp_path / "store")
+    cfg = dict(CFG, journal_dir=jd, store_dir=sd)
+    engine = AnalysisEngine(ServiceConfig(**cfg))  # never started
+    job = Job(KILLABLE, idempotency_key="idem-r1")
+    engine.submit(job)
+    assert job.state == JobState.QUEUED and job.journaled_admit
+    # bank the verdict the re-run would compute (the PR-11 store is
+    # what recovery dedupes through)
+    open_store(sd).put(
+        code_hash(KILLABLE), engine._config_fp,
+        issues=[{"title": "banked"}],
+    )
+    del engine  # the process "dies" (no drain marker was written)
+
+    recovered = AnalysisEngine(
+        ServiceConfig(**dict(cfg, recover=True))
+    )
+    back = recovered.queue.get(job.id)
+    assert back is not None, "acknowledged job lost across the crash"
+    assert back.recovered and back.state == JobState.DONE
+    assert back.report["store_hit"] is True
+    assert back.report["issues"] == [{"title": "banked"}]
+    stats = recovered.stats()
+    assert stats["journal"]["recovered_jobs"] == 1
+    assert stats["journal"]["recovery_deduped"] == 1
+    # the idempotency index survived the restart
+    retry = recovered.submit(Job(KILLABLE, idempotency_key="idem-r1"))
+    assert retry.id == job.id
+    # prior segments compacted into the fresh one
+    assert len(
+        [n for n in os.listdir(jd) if n.startswith("wal-")]
+    ) == 1
+
+
+def test_recovery_adopts_terminal_jobs_as_history(tmp_path):
+    jd = str(tmp_path / "wal")
+    cfg = dict(CFG, journal_dir=jd)
+    engine = AnalysisEngine(ServiceConfig(**cfg))
+    job = Job(KILLABLE)
+    engine.submit(job)
+    engine.queue.settle(job, JobState.DONE)
+    del engine
+
+    recovered = AnalysisEngine(ServiceConfig(**dict(cfg, recover=True)))
+    back = recovered.queue.get(job.id)
+    assert back is not None and back.state == JobState.DONE
+    assert back.recovered
+    # nothing re-ran: the job was already terminal in the journal
+    assert recovered.stats()["journal"]["recovered_jobs"] == 0
+
+
+# -- 3. crash implication + quarantine --------------------------------------
+
+
+def test_crash_implicated_job_quarantines_at_strike_threshold(tmp_path):
+    """A job that was ON THE DEVICE when the process died takes a
+    crash-implication strike at recovery; at the strike threshold the
+    re-admission settles FAILED + QUARANTINED instead of crashing the
+    same wave forever."""
+    jd = str(tmp_path / "wal")
+    journal = JobJournal(jd)
+    job = Job(poison_contract(0))
+    journal.job_admitted(job)
+    journal.jobs_claimed([job.id])
+    journal.wave_dispatched([job.id])
+    journal.close()  # no drain marker: SIGKILL mid-wave
+
+    engine = AnalysisEngine(ServiceConfig(**dict(
+        CFG, journal_dir=jd, recover=True, quarantine_strikes=1,
+    )))
+    back = engine.queue.get(job.id)
+    assert back is not None and back.state == JobState.FAILED
+    assert DegradationReason.QUARANTINED in back.degraded
+    assert back.report["quarantined"] is True
+    stats = engine.stats()
+    assert stats["quarantine"]["denylisted"] == 1
+    assert stats["quarantine"]["quarantined"] == 1
+
+
+def test_crash_implication_below_threshold_readmits_with_strike(tmp_path):
+    jd = str(tmp_path / "wal")
+    journal = JobJournal(jd)
+    job = Job(poison_contract(1))
+    journal.job_admitted(job)
+    journal.wave_dispatched([job.id])
+    journal.close()
+
+    engine = AnalysisEngine(ServiceConfig(**dict(
+        CFG, journal_dir=jd, recover=True, quarantine_strikes=2,
+    )))
+    back = engine.queue.get(job.id)
+    assert back is not None and back.state == JobState.QUEUED
+    assert engine._strikes[code_hash(poison_contract(1))] == 1
+    assert engine._is_suspect(code_hash(poison_contract(1)))
+
+
+def test_quarantine_strike_escalation_solo_then_failed(tmp_path):
+    """The live escalation: wave fault -> strike 1 (FAILED, codehash
+    now suspect) -> resubmission runs SOLO and faults again -> strike
+    2 settles FAILED with QUARANTINED + denylists -> a third submit
+    settles instantly at admission with no wave at all."""
+    poison = poison_contract(2)
+    engine = _engine(
+        tmp_path, stripes=1, lanes_per_stripe=2, quarantine_strikes=2,
+    ).start()
+    try:
+        # every device attempt faults while armed: the dispatch AND
+        # the whole resilience ladder underneath it (one dispatch
+        # fault per submission — the pipelined loop can dispatch a
+        # second wave for the same job before the first harvest)
+        arm_fault(
+            "service.dispatch", times=1,
+            exc=InjectedFault("device.dispatch.poisoned"),
+        )
+        arm_fault("device.dispatch", times=9999)
+        first = engine.submit(Job(poison))
+        job1 = _wait_terminal(engine, first.id)
+        assert job1.state == JobState.FAILED
+        assert DegradationReason.QUARANTINED not in job1.degraded
+        assert engine._strikes[code_hash(poison)] == 1
+
+        arm_fault(
+            "service.dispatch", times=1,
+            exc=InjectedFault("device.dispatch.poisoned"),
+        )
+        second = engine.submit(Job(poison))  # runs solo (suspect)
+        job2 = _wait_terminal(engine, second.id)
+        assert job2.state == JobState.FAILED
+        assert DegradationReason.QUARANTINED in job2.degraded
+        disarm_faults()
+
+        waves_before = engine.waves_total
+        third = engine.submit(Job(poison))
+        # settled synchronously at admission: no wave ran for it
+        assert third.state == JobState.FAILED
+        assert DegradationReason.QUARANTINED in third.degraded
+        assert engine.waves_total == waves_before
+        stats = engine.stats()
+        assert stats["quarantine"]["quarantined"] >= 2
+        assert stats["quarantine"]["denylisted"] == 1
+    finally:
+        disarm_faults()
+        engine.drain(timeout_s=30.0)
+
+
+def test_suspect_job_is_isolated_to_a_solo_wave(tmp_path):
+    """A striked codehash never shares the arena: submit a suspect and
+    an innocent together; the arena must never hold both at once (the
+    innocent still completes)."""
+    poison = poison_contract(3)
+    engine = _engine(tmp_path, stripes=2, lanes_per_stripe=2).start()
+    try:
+        engine._strike(code_hash(poison))  # suspect, below threshold
+        suspect = engine.submit(Job(poison))
+        innocent = engine.submit(Job(WRITER))
+        _wait_terminal(engine, suspect.id)
+        _wait_terminal(engine, innocent.id)
+        assert innocent.state == JobState.DONE
+        # with 2 stripes these two WOULD have shared a wave; the solo
+        # gate kept residency at one job at a time
+        assert engine.alloc.occupancy()["max_jobs_resident"] == 1
+        # the suspect passed its solo wave: the strike cleared
+        assert code_hash(poison) not in engine._strikes
+    finally:
+        engine.drain(timeout_s=30.0)
+
+
+def test_quarantine_corpus_differential(tmp_path):
+    """The acceptance differential: a corpus containing one
+    repeat-crashing contract completes with every OTHER contract's
+    issue-bearing outcome identical to a run without the poison, and
+    the poison settles FAILED with QUARANTINED."""
+    poison = poison_contract(4)
+    innocents = [KILLABLE, WRITER, BRANCHER]
+
+    def outcome(job):
+        device = (job.report or {}).get("device") or {}
+        return (
+            device.get("covered_branches"),
+            tuple(sorted((device.get("triggers") or {}).items())),
+        )
+
+    def run_corpus(with_poison: bool):
+        engine = _engine(
+            tmp_path, stripes=1, lanes_per_stripe=2,
+            quarantine_strikes=2,
+        ).start()
+        results = {}
+        poison_jobs = []
+        try:
+            order = (
+                [poison] + innocents[:1] + [poison] + innocents[1:]
+                if with_poison
+                else list(innocents)
+            )
+            for code in order:
+                if code == poison:
+                    # the poison's waves fault while it is resident
+                    # (sequential submission keeps the blast radius
+                    # attribution unambiguous here; shared-wave
+                    # attribution is the solo-isolation test's job)
+                    arm_fault(
+                        "service.dispatch", times=1,
+                        exc=InjectedFault("device.dispatch.poison"),
+                    )
+                    arm_fault("device.dispatch", times=9999)
+                job = engine.submit(Job(code))
+                _wait_terminal(engine, job.id, timeout_s=120.0)
+                if code == poison:
+                    disarm_faults()
+                    poison_jobs.append(job)
+                else:
+                    results[code] = outcome(job)
+            return results, poison_jobs
+        finally:
+            disarm_faults()
+            engine.drain(timeout_s=30.0)
+
+    with_p, poison_jobs = run_corpus(with_poison=True)
+    cb.reset_all()
+    without_p, _ = run_corpus(with_poison=False)
+    # every innocent's issue-bearing outcome is untouched by the
+    # poison's presence
+    assert with_p == without_p
+    # and the poison escalated: second failure quarantined it
+    assert [j.state for j in poison_jobs] == ["failed", "failed"]
+    assert DegradationReason.QUARANTINED in poison_jobs[-1].degraded
+
+
+# -- 4. tier circuit breakers ------------------------------------------------
+
+
+def test_breaker_state_machine_transitions():
+    clock = [0.0]
+    br = cb.CircuitBreaker(
+        "test-tier", failure_threshold=3, recovery_s=10.0,
+        clock=lambda: clock[0],
+    )
+    assert br.allow() and br.state == cb.STATE_CLOSED
+    br.record_failure()
+    br.record_failure()
+    assert br.state == cb.STATE_CLOSED  # below the threshold
+    br.record_failure()
+    assert br.state == cb.STATE_OPEN and not br.allow()
+    assert br.trips == 1
+    clock[0] = 9.0
+    assert not br.allow()  # recovery clock still running
+    clock[0] = 10.5
+    assert br.allow() and br.state == cb.STATE_HALF_OPEN
+    br.record_failure()  # the probe failed: re-open, re-arm
+    assert br.state == cb.STATE_OPEN and br.trips == 2
+    clock[0] = 21.0
+    assert br.allow() and br.state == cb.STATE_HALF_OPEN
+    br.record_success()  # healthy probe: closed, counters reset
+    assert br.state == cb.STATE_CLOSED and br.allow()
+    # a success resets the consecutive count
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == cb.STATE_CLOSED
+
+
+def test_breaker_failure_rate_trips_without_consecutive_run():
+    br = cb.CircuitBreaker(
+        "rate-tier", failure_threshold=100, window=4,
+        rate_threshold=0.5, recovery_s=10.0,
+    )
+    for _ in range(3):
+        br.record_failure()
+        br.record_success()
+    # window [F,S,F,S] -> rate 0.5 >= threshold on a full window
+    assert br.state == cb.STATE_OPEN
+
+
+def test_device_breaker_open_serves_through_host_ladder(tmp_path):
+    """The acceptance shape: with the device-dispatch breaker open the
+    service KEEPS SERVING — jobs route straight down the ladder (zero
+    waves) — and /healthz reports the enumerated breaker-open:device
+    reason."""
+    engine = _engine(tmp_path).start()
+    try:
+        cb.breaker(cb.TIER_DEVICE).force_open()
+        job = engine.submit(Job(WRITER))
+        done = _wait_terminal(engine, job.id)
+        assert done.state == JobState.DONE
+        assert "breaker-open:device" in done.degraded
+        assert done.report["device"]["waves"] == 0  # never dispatched
+        assert engine.waves_total == 0
+        payload = engine.health.healthz_payload()
+        assert payload["state"] == "redlined"
+        assert "breaker-open:device" in payload["reasons"]
+        assert payload["ready"] is False
+        stats = engine.stats()
+        assert stats["breaker"]["enabled"] is True
+        assert stats["breaker"]["tiers"]["device"]["state"] == "open"
+    finally:
+        engine.drain(timeout_s=30.0)
+
+
+def test_device_breaker_trips_on_wave_faults_and_recovers(tmp_path):
+    """closed -> open on a real injected wave fault (threshold 1),
+    then the half-open probe wave closes it again once the faults
+    stop."""
+    # the trip fires at the harvest fault, ~1s BEFORE the doomed
+    # resilience ladder finishes — the recovery window must outlast
+    # the ladder for the open state to be observable
+    cb.configure(cb.TIER_DEVICE, failure_threshold=1, recovery_s=4.0)
+    engine = _engine(tmp_path, stripes=1, lanes_per_stripe=2).start()
+    try:
+        arm_fault(
+            "service.dispatch", times=1,
+            exc=InjectedFault("device.dispatch.wedged"),
+        )
+        arm_fault("device.dispatch", times=9999)
+        failed = engine.submit(Job(BRANCHER))
+        _wait_terminal(engine, failed.id)
+        assert failed.state == JobState.FAILED
+        assert cb.breaker(cb.TIER_DEVICE).state == cb.STATE_OPEN
+        assert cb.breaker(cb.TIER_DEVICE).trips == 1
+        disarm_faults()
+
+        # inside the recovery window jobs still settle via the ladder
+        skipped = engine.submit(Job(WRITER))
+        _wait_terminal(engine, skipped.id)
+        assert skipped.state == JobState.DONE
+        assert skipped.report["device"]["waves"] == 0  # routed around
+
+        # past recovery_s: the next wave is a half-open probe
+        deadline = time.monotonic() + 10.0
+        while (
+            cb.breaker(cb.TIER_DEVICE).state == cb.STATE_OPEN
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        probe = engine.submit(Job(WRITER))
+        _wait_terminal(engine, probe.id)
+        assert probe.state == JobState.DONE
+        assert probe.report["device"]["waves"] >= 1
+        assert cb.breaker(cb.TIER_DEVICE).state == cb.STATE_CLOSED
+    finally:
+        disarm_faults()
+        engine.drain(timeout_s=30.0)
+
+
+def test_kernel_breaker_open_forces_generic_waves(tmp_path):
+    prev = support_args.specialize
+    support_args.specialize = True  # the conftest turns it off
+    engine = _engine(tmp_path, specialize=True).start()
+    try:
+        cb.breaker(cb.TIER_KERNEL).force_open()
+        job = engine.submit(Job(WRITER))
+        done = _wait_terminal(engine, job.id)
+        assert done.state == JobState.DONE
+        # every wave ran the generic interpreter: the specialized
+        # tier was routed around (no compile paid), not retried
+        assert engine.spec_waves == 0
+        assert engine.generic_waves >= 1
+    finally:
+        support_args.specialize = prev
+        engine.drain(timeout_s=30.0)
+
+
+def test_store_breaker_open_degrades_to_miss(tmp_path):
+    sd = str(tmp_path / "store")
+    store = open_store(sd)
+    assert store.put("a" * 64, "fp", issues=[]) is not None
+    cb.breaker(cb.TIER_STORE).force_open()
+    assert store.get("a" * 64, "fp") is None  # hit becomes a miss
+    assert store.put("b" * 64, "fp", issues=[]) is None  # write no-op
+    cb.reset_all()
+    assert store.get("a" * 64, "fp") is not None  # the entry survived
+
+
+def test_store_write_fault_feeds_breaker_and_degrades(tmp_path):
+    sd = str(tmp_path / "faulty-store")
+    store = open_store(sd)
+    cb.configure(cb.TIER_STORE, failure_threshold=2, recovery_s=30.0)
+    arm_fault("store.write", times=2)
+    assert store.put("c" * 64, "fp", issues=[]) is None
+    assert store.put("d" * 64, "fp", issues=[]) is None
+    assert cb.breaker(cb.TIER_STORE).state == cb.STATE_OPEN
+    disarm_faults()
+    # open breaker: writes stay no-ops without touching the disk
+    assert store.put("e" * 64, "fp", issues=[]) is None
+
+
+def test_breaker_open_device_solve_matches_host_first_funnel():
+    """Ladder-fallback parity: an OPEN device-solve breaker must
+    produce the same issue-bearing outcomes as --host-first-funnel —
+    the breaker routes down the same ladder the flag selects."""
+    from mythril_tpu.laser.batch.explore import DeviceCorpusExplorer
+
+    def fingerprint(contract):
+        return (
+            tuple(map(tuple, contract["covered_branches"])),
+            {
+                kind: tuple(sorted(t["pc"] for t in bucket))
+                for kind, bucket in contract["triggers"].items()
+            },
+        )
+
+    codes = [KILLABLE, WRITER, BRANCHER]
+    kw = dict(
+        lanes_per_contract=8, waves=3, steps_per_wave=64,
+        transaction_count=1, seed=7,
+    )
+    prev = support_args.device_first
+    try:
+        support_args.device_first = True
+        cb.breaker(cb.TIER_DEVICE_SOLVE).force_open()
+        ex_open = DeviceCorpusExplorer(codes, **kw)
+        run_open = ex_open.run()
+        # the open breaker kept the device solver out entirely
+        assert ex_open.stats.device_sat + ex_open.stats.device_unsat == 0
+        cb.reset_all()
+
+        support_args.device_first = False
+        ex_host = DeviceCorpusExplorer(codes, **kw)
+        run_host = ex_host.run()
+    finally:
+        support_args.device_first = prev
+    for a, b in zip(run_open["contracts"], run_host["contracts"]):
+        assert fingerprint(a) == fingerprint(b)
+
+
+def test_no_breakers_flag_disables_the_layer(tmp_path):
+    prev = support_args.breakers
+    support_args.breakers = False
+    try:
+        cb.breaker(cb.TIER_DEVICE).force_open()
+        assert cb.allow(cb.TIER_DEVICE)  # the switch wins
+        assert cb.open_reasons() == [] or not cb.breakers_enabled()
+        engine = _engine(tmp_path)
+        assert engine.stats()["breaker"]["enabled"] is False
+    finally:
+        support_args.breakers = prev
+
+
+# -- 5. journal fault degradation -------------------------------------------
+
+
+def test_journal_write_fault_degrades_to_nondurable(tmp_path):
+    jd = str(tmp_path / "wal")
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, journal_dir=jd))
+    )  # never started
+    marker = DegradationLog().marker()
+    arm_fault("service.journal.write", times=1)
+    job = engine.submit(Job(KILLABLE))
+    # admission SUCCEEDED despite the dead journal...
+    assert job.state == JobState.QUEUED
+    assert job.journaled_admit is False
+    # ...and the loss of durability is recorded, not hidden
+    assert engine.journal.degraded is True
+    counts = DegradationLog().counts_since(marker)
+    assert counts.get(DegradationReason.JOURNAL_DEGRADED) == 1
+    stats = engine.stats()
+    assert stats["journal"]["degraded"] is True
+    assert stats["journal"]["errors"] == 1
+
+
+# -- 6. idempotency ----------------------------------------------------------
+
+
+def test_idempotent_resubmit_over_http(tmp_path):
+    server = AnalysisServer(
+        ServiceConfig(**CFG), start_engine=False
+    ).start()
+    try:
+        client = ServiceClient(server.url)
+        job_id = client.submit(KILLABLE, idempotency_key="same-key")
+        again = client.submit(KILLABLE, idempotency_key="same-key")
+        assert again == job_id
+        # distinct keys are distinct jobs
+        other = client.submit(KILLABLE, idempotency_key="other-key")
+        assert other != job_id
+        assert client.stats()["queue"]["depth"] == 2
+    finally:
+        server.close()
+
+
+def test_client_retries_connection_refused():
+    """The client retries refused connections with backoff instead of
+    failing the first attempt (a restarting server looks exactly like
+    this); after the retries it surfaces the real error."""
+    client = ServiceClient(
+        "http://127.0.0.1:1", retries=2, backoff_s=0.01,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as excinfo:
+        client.stats()
+    assert time.monotonic() - t0 >= 0.02  # both backoffs slept
+    assert not isinstance(excinfo.value, AssertionError)

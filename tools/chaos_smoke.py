@@ -1,0 +1,312 @@
+"""Kill-mid-wave chaos harness for the crash-safe analysis service.
+
+The contract under test (ISSUE 14 acceptance): a `myth serve` replica
+running with `--journal DIR` that is SIGKILLed in the middle of an
+in-flight wave, then restarted with `--recover`, settles 100% of the
+jobs it had acknowledged before the kill — re-run, or deduped through
+the shared verdict store — with zero duplicate side effects, and the
+journal's warm-path overhead stays under 5% of the warm p50.
+
+Flow (parent process):
+
+1. spawn child 1: an in-process service (this script with --child —
+   the CLI path needs a jax-platform pin this container only honors
+   via jax.config) on an ephemeral port with a journal + store dir;
+2. submit a batch with idempotency keys: wait for the first jobs to
+   settle DONE (their verdicts write back to the store), leave the
+   rest acknowledged but queued/in-flight;
+3. SIGKILL the child while /stats shows unfinished work;
+4. spawn child 2 over the same dirs with --recover;
+5. assert: every acknowledged job id still exists and reaches DONE
+   (the pre-settled ones are adopted history, the in-flight ones
+   re-ran or deduped); a duplicate submission of a settled contract
+   settles via the store in milliseconds; resubmitting a settled
+   job's idempotency key maps to the SAME job id (duplicate-settle
+   idempotency — no double run); journal wall per settled job is
+   under 5% of the measured warm p50.
+
+Usage:
+    python tools/chaos_smoke.py          # the full harness
+    python tools/chaos_smoke.py --child ... (internal)
+
+Exits 0 on success; prints the failing assertion and exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: distinct non-statically-answerable shapes (full wave path) — the
+#: fault-suite contracts plus seeded poison-fixture variants
+def corpus() -> list:
+    from mythril_tpu.analysis.corpusgen import poison_contract
+
+    return [
+        "33ff",  # CALLER; SELFDESTRUCT
+        "6001600055600060015500",  # storage writer
+        "600035600757005b600160005500",  # brancher
+        poison_contract(7),
+        poison_contract(8),
+    ]
+
+
+def child_main(args) -> int:
+    """The service process: jax pinned to CPU, tiny arena, journal +
+    store wired, URL printed for the parent to parse."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs("/tmp/mtpu_xla_cache", exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/mtpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from mythril_tpu.service.engine import ServiceConfig
+    from mythril_tpu.service.server import AnalysisServer
+
+    config = ServiceConfig(
+        stripes=2,
+        lanes_per_stripe=4,
+        steps_per_wave=256,
+        max_waves=3,
+        queue_capacity=16,
+        host_walk=True,  # settled verdicts must write back to the store
+        execution_timeout=3,
+        transaction_count=1,
+        coalesce_wait_s=0.05,
+        idle_wait_s=0.1,
+        journal_dir=args.journal,
+        recover=args.recover,
+        store_dir=args.store,
+    )
+    server = AnalysisServer(config).start()
+    server.install_signal_handlers()
+    print(f"CHAOS-URL {server.url}", flush=True)
+    try:
+        server.drained(timeout_s=None)
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    return 0
+
+
+def spawn_child(journal: str, store: str, recover: bool):
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--journal", journal, "--store", store,
+    ]
+    if recover:
+        cmd.append("--recover")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    deadline = time.monotonic() + 120.0
+    url = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"child died at startup (rc {proc.returncode})"
+                )
+            continue
+        if line.startswith("CHAOS-URL "):
+            url = line.split(None, 1)[1].strip()
+            break
+    if url is None:
+        proc.kill()
+        raise RuntimeError("child never printed its URL")
+    return proc, url
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--journal", default=None)
+    parser.add_argument("--store", default=None)
+    parser.add_argument("--recover", action="store_true")
+    args = parser.parse_args()
+    if args.child:
+        return child_main(args)
+
+    import tempfile
+
+    from mythril_tpu.service.client import ServiceClient
+
+    t_start = time.monotonic()
+    root = tempfile.mkdtemp(prefix="myth-chaos-")
+    journal_dir = os.path.join(root, "journal")
+    store_dir = os.path.join(root, "store")
+    codes = corpus()
+    summary: dict = {"root": root}
+
+    # -- phase 1: serve, settle some, kill mid-wave ---------------------
+    child, url = spawn_child(journal_dir, store_dir, recover=False)
+    client = ServiceClient(url, retries=5, backoff_s=0.2)
+    acknowledged: dict = {}  # job_id -> (code, idempotency_key)
+    try:
+        # settle the first two jobs completely (their verdicts bank)
+        settled_pre_kill = []
+        for i, code in enumerate(codes[:2]):
+            key = f"chaos-settled-{i}"
+            job_id = client.submit(code, idempotency_key=key)
+            acknowledged[job_id] = (code, key)
+            report = client.report(job_id, wait_s=240.0)
+            assert report["state"] == "done", (
+                f"pre-kill job {job_id}: {report}"
+            )
+            settled_pre_kill.append(job_id)
+        # acknowledge the rest WITHOUT waiting: these are the jobs the
+        # kill threatens. One duplicates a settled contract — after
+        # recovery it must dedupe through the store, not re-run.
+        inflight_ids = []
+        for i, code in enumerate(codes[2:] + [codes[0]]):
+            key = f"chaos-inflight-{i}"
+            job_id = client.submit(code, idempotency_key=key)
+            acknowledged[job_id] = (code, key)
+            inflight_ids.append(job_id)
+        # wait until work is genuinely in flight (resident or queued)
+        deadline = time.monotonic() + 60.0
+        mid_wave = False
+        while time.monotonic() < deadline:
+            stats = client.stats()
+            busy = stats["arena"]["stripes_busy"]
+            if busy > 0:
+                mid_wave = True
+                break
+            time.sleep(0.02)
+        summary["killed_mid_wave"] = mid_wave
+        summary["acknowledged"] = len(acknowledged)
+    finally:
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+
+    # -- phase 2: recover, assert zero acknowledged-job loss ------------
+    child2, url2 = spawn_child(journal_dir, store_dir, recover=True)
+    client2 = ServiceClient(url2, retries=5, backoff_s=0.2)
+    try:
+        lost, states = [], {}
+        for job_id, (code, key) in acknowledged.items():
+            doc = None
+            try:
+                doc = client2.report(job_id, wait_s=300.0)
+            except Exception as why:
+                lost.append((job_id, f"unreachable: {why}"))
+                continue
+            states[job_id] = doc.get("state")
+            if doc.get("state") != "done":
+                lost.append((job_id, doc.get("state")))
+        summary["post_recovery_states"] = states
+        stats2 = client2.stats()
+        summary["journal"] = stats2["journal"]
+        summary["store"] = {
+            k: stats2["store"].get(k)
+            for k in ("hits", "writes", "answered", "writebacks")
+        }
+
+        # -- duplicate-settle idempotency + store dedupe ----------------
+        # (a) same idempotency key as a settled pre-kill job -> the
+        # SAME job id comes back, no new job, no re-run
+        sid = settled_pre_kill[0]
+        code0, key0 = acknowledged[sid]
+        again = client2.submit(code0, idempotency_key=key0)
+        # (b) a FRESH submission of a settled contract's code settles
+        # through the verdict store in milliseconds — the banked
+        # verdict, zero waves
+        t0 = time.monotonic()
+        dup_id = client2.submit(code0, idempotency_key="chaos-fresh-dup")
+        dup = client2.report(dup_id, wait_s=30.0)
+        dup_wall = time.monotonic() - t0
+        summary["dup_settle_s"] = round(dup_wall, 4)
+
+        # -- warm p50 + journal overhead --------------------------------
+        # fresh contracts each round: the FULL warm path (waves + host
+        # walk on a warm kernel), not a store-hit — that is the warm
+        # p50 the 5% journal-overhead acceptance is defined against
+        from mythril_tpu.analysis.corpusgen import poison_contract
+
+        warm = []
+        for i in range(3):
+            t0 = time.monotonic()
+            job_id = client2.submit(
+                poison_contract(100 + i),
+                idempotency_key=f"chaos-warm-{i}",
+            )
+            client2.report(job_id, wait_s=240.0)
+            warm.append(time.monotonic() - t0)
+        warm_p50 = statistics.median(warm)
+        stats3 = client2.stats()
+        jstats = stats3["journal"]
+        settled_total = sum(
+            n
+            for state, n in stats3["queue"]["jobs"].items()
+            if state in ("done", "failed", "checkpointed")
+        )
+        journal_per_job = (
+            jstats["wall_s"] / max(1, settled_total)
+        )
+        summary["warm_p50_s"] = round(warm_p50, 4)
+        summary["journal_wall_per_job_s"] = round(journal_per_job, 6)
+        summary["journal_overhead_frac"] = round(
+            journal_per_job / warm_p50, 4
+        ) if warm_p50 else None
+
+        # -- the assertions ---------------------------------------------
+        assert summary["killed_mid_wave"], (
+            "the kill never caught work in flight — arena stayed idle"
+        )
+        assert not lost, f"acknowledged jobs lost across the kill: {lost}"
+        assert stats2["journal"]["enabled"], stats2["journal"]
+        assert again == sid, (
+            f"idempotent resubmit minted a NEW job {again} != {sid}"
+        )
+        assert dup["state"] == "done", dup
+        assert dup["report"].get("store_hit"), (
+            f"duplicate re-ran instead of deduping: {dup['report']}"
+        )
+        # zero duplicate side effects: the store holds ONE entry per
+        # (codehash, config) by construction; the dedupe above proves
+        # the duplicate touched no queue slot and ran no wave
+        assert dup_wall < 5.0, f"dup settle took {dup_wall:.2f}s"
+        assert journal_per_job < 0.05 * warm_p50, (
+            f"journal overhead {journal_per_job * 1000:.2f}ms/job is "
+            f">= 5% of warm p50 {warm_p50 * 1000:.1f}ms"
+        )
+        client2.drain()
+    except AssertionError as why:
+        print(
+            f"chaos smoke FAILED after "
+            f"{time.monotonic() - t_start:.1f}s: {why}",
+            file=sys.stderr,
+        )
+        print(json.dumps(summary, indent=2), file=sys.stderr)
+        os.kill(child2.pid, signal.SIGKILL)
+        return 1
+    finally:
+        try:
+            child2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            child2.kill()
+
+    print(
+        f"chaos smoke OK in {time.monotonic() - t_start:.1f}s: "
+        f"{summary['acknowledged']} acknowledged jobs all settled "
+        f"across a SIGKILL (dup settle {summary['dup_settle_s']}s, "
+        f"journal {summary['journal_wall_per_job_s'] * 1000:.2f}ms/job "
+        f"vs warm p50 {summary['warm_p50_s']}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,6 +156,52 @@ def main() -> int:
     # the whole ladder, so its tier sequence must say so
     trace_doc = client._request(f"/v1/jobs/{cold_id}/trace")
 
+    # -- 2b. device-breaker trip -> ladder fallback -> half-open
+    # recovery (ISSUE 14): an injected wave fault trips the breaker
+    # (threshold 1), the next job settles THROUGH the ladder with
+    # zero waves while /healthz names the reason, and once the
+    # recovery clock runs a half-open probe wave closes it again
+    from mythril_tpu.analysis.corpusgen import poison_contract
+    from mythril_tpu.exceptions import InjectedFault
+    from mythril_tpu.support import breaker as cb
+    from mythril_tpu.support.resilience import arm_fault, disarm_faults
+
+    # generous recovery window: the wave thread can stall several
+    # seconds in the faulted wave's containment ladder before the
+    # skip path gets its first chance to run
+    cb.configure("device", failure_threshold=1, recovery_s=30.0)
+    # one dispatch fault: the resilience ladder CONTAINS it (the
+    # retry succeeds, the job survives) but the breaker records the
+    # wave fault and trips at threshold 1
+    arm_fault(
+        "service.dispatch", times=1,
+        exc=InjectedFault("device.dispatch.smoke-wedge"),
+    )
+    tripped_id = client.submit(poison_contract(42))
+    # observe the OPEN state promptly (it softens to half-open after
+    # recovery_s): poll for the trip, then grab state + healthz and
+    # push the ladder job through while the window is still open
+    trip_deadline = time.monotonic() + 60.0
+    while (
+        cb.breaker("device").trips < 1
+        and time.monotonic() < trip_deadline
+    ):
+        time.sleep(0.05)
+    breaker_open_state = cb.breaker("device").state
+    breaker_health = client.healthz()
+    ladder_id = client.submit(poison_contract(43))
+    ladder = client.report(ladder_id, wait_s=120.0)
+    tripped = client.report(tripped_id, wait_s=120.0)
+    disarm_faults()
+    # shrink the recovery clock so the half-open probe leg doesn't
+    # idle out the remaining window
+    cb.breaker("device").recovery_s = 0.1
+    while cb.breaker("device").state == "open":
+        time.sleep(0.1)
+    probe_id = client.submit(poison_contract(44))
+    probe = client.report(probe_id, wait_s=120.0)
+    breaker_final = cb.breaker("device").stats()
+
     # -- 3. SIGTERM drain with work still in the pipe -------------------
     drain_ids = [client.submit(code) for code in codes[:2]]
     os.kill(os.getpid(), signal.SIGTERM)
@@ -218,8 +264,26 @@ def main() -> int:
         assert "wave" in tiers and tiers[-1] == "settle", tiers
         assert "queued" in tiers and "lane-grant" in tiers, tiers
         summary["journey_tiers"] = tiers
+        # -- breaker trip / ladder / half-open recovery (ISSUE 14) -----
+        # the faulted wave was contained by the retry ladder (the job
+        # survived) AND the breaker remembered the fault
+        assert tripped["state"] == "done", tripped
+        assert breaker_open_state == "open", breaker_open_state
+        assert "breaker-open:device" in breaker_health.get(
+            "reasons", []
+        ), f"healthz lost the breaker reason: {breaker_health}"
+        assert breaker_health["ready"] is False, breaker_health
+        assert ladder["state"] == "done", ladder
+        assert ladder["report"]["device"]["waves"] == 0, (
+            f"breaker-open job still dispatched a wave: {ladder}"
+        )
+        assert probe["state"] == "done", probe
+        assert probe["report"]["device"]["waves"] >= 1, probe
+        assert breaker_final["state"] == "closed", breaker_final
+        assert breaker_final["trips"] >= 1, breaker_final
+        summary["breaker"] = breaker_final
         # -- telemetry exposition (ISSUE 7) ----------------------------
-        assert stats.get("schema_version") == 3, (
+        assert stats.get("schema_version") == 4, (
             f"/stats schema_version missing/unexpected: "
             f"{stats.get('schema_version')}"
         )
