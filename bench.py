@@ -1064,6 +1064,154 @@ def bench_journal(rounds: int = 48) -> dict:
     return out
 
 
+def bench_fleet(jobs_per_leg: int = 6) -> dict:
+    """Federated serving leg (ISSUE 15): in-process `myth serve`
+    replicas behind a FleetFront.
+
+    - `fleet_throughput_scale`: wall for the same full-wave job mix
+      through a 1-replica front vs a 2-replica front (>= ~1 says the
+      front stripes instead of serializing; true scaling needs real
+      parallel hardware — on a 1-core CPU container the two engines
+      time-slice, so the gate threshold is loose);
+    - `fleet_failover_p50_s`: p50 of death-detection -> settle for
+      jobs re-routed off a SIGKILLed-equivalent replica whose
+      verdicts were already banked in the fleet-shared store — the
+      reroute-after-restart-settles-in-microseconds claim, measured;
+    - `fleet_reroute_dedup_rate`: deduped / rerouted on that leg.
+    """
+    import statistics
+    import tempfile
+
+    from mythril_tpu.fleet import FleetConfig, FleetFront
+    from mythril_tpu.service.engine import ServiceConfig
+    from mythril_tpu.service.server import AnalysisServer
+
+    # module-applicable shapes (never static-answered: the jobs must
+    # genuinely ride waves, or the legs only measure HTTP overhead)
+    codes = [
+        "33ff",  # selfdestruct(caller)
+        "32ff",  # selfdestruct(origin)
+        "336000556000ff",  # caller -> storage, then selfdestruct
+    ]
+    cfg = dict(
+        stripes=2, lanes_per_stripe=4, steps_per_wave=128, max_waves=2,
+        queue_capacity=32, host_walk=False, coalesce_wait_s=0.02,
+        idle_wait_s=0.05,
+    )
+    fleet_kw = dict(
+        probe_interval_s=0.2, failure_threshold=2, recovery_s=300.0
+    )
+
+    def throughput(n_replicas: int) -> float:
+        servers = [
+            AnalysisServer(ServiceConfig(**cfg)).start()
+            for _ in range(n_replicas)
+        ]
+        front = FleetFront(
+            FleetConfig([s.url for s in servers], **fleet_kw)
+        ).start()
+        try:
+            # warm the wave kernel off the clock (shared compile cache
+            # across replicas/legs: identical arena shape)
+            warm = front.submit(codes[0], idempotency_key="fl-warm")
+            front.report(warm.id, wait_s=240.0)
+            t0 = time.perf_counter()
+            batch = [
+                front.submit(
+                    codes[i % len(codes)],
+                    idempotency_key=f"fl-tp{n_replicas}-{i}",
+                )
+                for i in range(jobs_per_leg)
+            ]
+            for job in batch:
+                doc = front.report(job.id, wait_s=240.0)
+                assert doc["state"] == "done", doc
+            return time.perf_counter() - t0
+        finally:
+            front.close()
+            for s in servers:
+                s.close()
+
+    t1 = throughput(1)
+    t2 = throughput(2)
+
+    # -- failover leg: banked verdicts re-route in microseconds -------
+    # host_walk=True here: only a completed host walk writes its
+    # verdict back to the shared store, and the banked verdict is what
+    # the re-route dedupes through
+    store_dir = tempfile.mkdtemp(prefix="myth-bench-fleet-")
+    fo_cfg = dict(cfg, host_walk=True)
+    victim = AnalysisServer(
+        ServiceConfig(store_dir=store_dir, **fo_cfg)
+    ).start()
+    survivor = AnalysisServer(
+        ServiceConfig(store_dir=store_dir, **fo_cfg)
+    ).start()
+    front = FleetFront(
+        FleetConfig([victim.url, survivor.url], **fleet_kw)
+    ).start()
+    try:
+        batch = []
+        for i in range(jobs_per_leg):
+            job = front.submit(
+                codes[i % len(codes)], idempotency_key=f"fl-fo{i}"
+            )
+            batch.append(job)
+        # wait until every job settled ON ITS REPLICA (polling the
+        # replicas directly: the front still believes them in-flight,
+        # which is exactly the crash window)
+        server_of = {"r0": victim, "r1": survivor}
+        deadline = time.monotonic() + 240.0
+        for job in batch:
+            client = server_of[job.replica].engine.queue
+            while time.monotonic() < deadline:
+                remote = client.get(job.remote_id)
+                if remote is not None and remote.terminal:
+                    break
+                time.sleep(0.02)
+        kill_t = time.monotonic()
+        victim._httpd.shutdown()
+        victim._httpd.server_close()
+        while front.failovers == 0 and time.monotonic() - kill_t < 30:
+            front.check_replicas()
+        walls = []
+        for job in batch:
+            doc = front.report(job.id, wait_s=60.0)
+            assert doc["state"] == "done", doc
+            if job.rerouted and job.finished_t is not None and (
+                job.failover_t is not None
+            ):
+                walls.append(job.finished_t - job.failover_t)
+        fleet = front.stats()["fleet"]
+        out = {
+            "fleet_throughput_scale": (
+                round(t1 / t2, 3) if t2 else None
+            ),
+            "fleet_throughput_1r_wall_s": round(t1, 3),
+            "fleet_throughput_2r_wall_s": round(t2, 3),
+            "fleet_failover_p50_s": (
+                round(statistics.median(walls), 6) if walls else None
+            ),
+            "fleet_reroute_dedup_rate": (
+                round(fleet["reroute_deduped"] / fleet["rerouted"], 3)
+                if fleet["rerouted"]
+                else None
+            ),
+            "fleet_rerouted_jobs": fleet["rerouted"],
+        }
+    finally:
+        front.close()
+        survivor.close()
+        try:
+            victim.engine._draining = True
+            victim.engine._drained.set()
+            victim.close()
+        except Exception:
+            pass
+    print(f"bench: fleet leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -1228,6 +1376,11 @@ def main(final_attempt: bool = False) -> None:
         # (refreshed at every emit; a healthy run reports 0 trips)
         "journal_overhead_frac": None,
         "breaker_trips": 0,
+        # federated-serving scorecard (ISSUE 15): the fleet leg fills
+        # these; None = the leg never ran
+        "fleet_throughput_scale": None,
+        "fleet_failover_p50_s": None,
+        "fleet_reroute_dedup_rate": None,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1264,6 +1417,22 @@ def main(final_attempt: bool = False) -> None:
         print("bench: journal leg done", file=sys.stderr)
     except Exception as e:
         print(f"bench: journal leg failed: {e!r}", file=sys.stderr)
+
+    if _budget_left() > 240 and not os.environ.get(
+        "MYTHRIL_BENCH_NO_FLEET"
+    ):
+        try:
+            record.update(
+                _with_deadline(
+                    bench_fleet,
+                    max(60, min(300, int(_budget_left() - 120))),
+                )
+            )
+            print("bench: fleet leg done", file=sys.stderr)
+        except _Deadline:
+            print("bench: fleet leg hit the budget", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: fleet leg failed: {e!r}", file=sys.stderr)
 
     dev = {}
     try:

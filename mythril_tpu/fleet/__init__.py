@@ -1,0 +1,45 @@
+"""Federated multi-host serving: the fleet front (ROADMAP item 2).
+
+One `myth serve` replica owns one arena and one device mesh; a fleet
+is N replicas behind a thin admission/routing front (`myth fleet`)
+that treats each replica as a failure domain:
+
+- health-driven routing — every replica is probed at
+  ``/healthz?ready=1`` (the PR-12 readiness split) and work only
+  routes to replicas that answer 200; draining/redlined replicas are
+  routed around, and when NO replica accepts, the front sheds with
+  503 + ``Retry-After`` instead of queueing unboundedly;
+- replica-death detection + failover — probe timeouts and
+  connection-refused streaks feed a per-replica circuit breaker
+  (support/breaker.py); a breaker tripping open fails the replica's
+  in-flight jobs over to survivors, each resubmission carrying its
+  ORIGINAL idempotency key so the journal/store dedup path (PR 14 /
+  PR 11) settles already-computed work in microseconds;
+- a fleet-shared verdict store — replicas started over one ``--store``
+  directory answer each other's repeats (store/store.py is
+  concurrent-writer tolerant for exactly this);
+- cross-host rebalancing — a DRAINING replica's unfinished jobs are
+  pulled through ``GET /v1/frontier/export`` (the
+  ``export_frontier()/seed_frontier()`` handoff the multi-chip
+  scheduler already proved at device-group scope, promoted to hosts)
+  and reseeded into survivors so exploration continues instead of
+  restarting.
+
+The front deliberately REUSES the single-host code paths: jobs.py
+``Job``/``QueueRefusal`` for admission, client.py for the data plane,
+journal.py for its own crash safety, observe/slo.py for the health
+vocabulary (``replica-lost:<name>`` / ``fleet-degraded`` /
+``fleet-saturated``)."""
+
+from mythril_tpu.fleet.front import FleetConfig, FleetFront, FleetJob
+from mythril_tpu.fleet.replica import Replica
+from mythril_tpu.fleet.server import FleetServer, serve_fleet
+
+__all__ = [
+    "FleetConfig",
+    "FleetFront",
+    "FleetJob",
+    "Replica",
+    "FleetServer",
+    "serve_fleet",
+]

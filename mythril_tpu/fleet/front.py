@@ -1,0 +1,888 @@
+"""The fleet front: admission, health-driven routing, failover.
+
+The front is deliberately thin — it owns NO arena, NO queue of its
+own beyond the routing table, and never recomputes a verdict. It
+stripes submissions across replicas (least-loaded among the routable
+ones), remembers which replica owns which job, and reacts to two
+kinds of replica trouble:
+
+- **death** (per-replica breaker tripped open on probe
+  timeouts/connection-refused streaks): every non-terminal job
+  assigned to the dead replica is resubmitted to a survivor carrying
+  its ORIGINAL idempotency key. The survivor's admission tier ladder
+  does the heavy lifting: a fleet-shared verdict store answers
+  already-computed work in microseconds (`store-hit`), and the
+  journal-seeded idempotency index dedupes a replica that comes back
+  mid-failover. Re-routed work is never recomputed when any copy of
+  the answer exists anywhere in the fleet.
+- **draining** (the replica's own readiness probe says so): the
+  front pulls ``GET /v1/frontier/export`` — unfinished jobs with
+  their live exploration frontiers (the `export_frontier()/
+  seed_frontier()` handoff promoted from device groups to hosts) —
+  and reseeds each into a survivor, so a rolling restart hands its
+  exploration forward instead of abandoning it.
+
+When NO replica accepts work the front sheds with
+``QueueRefusal("saturated")`` — the HTTP layer turns that into 503 +
+``Retry-After`` — rather than queueing unboundedly; the single-host
+admission contract (jobs.py), one level up.
+
+Crash safety mirrors `myth serve --journal`: every routed admission
+is an fsync'd journal record (service/journal.py, reused verbatim)
+holding the code, the idempotency key, and the replica assignment;
+``myth fleet --recover`` replays it, re-attaches live jobs to their
+replicas, and lets the first monitor tick fail over whatever died
+with the front."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.fleet.replica import Replica
+from mythril_tpu.observe.slo import (
+    REDLINE_FLEET_DEGRADED,
+    REDLINE_FLEET_SATURATED,
+    REDLINE_REPLICA_LOST,
+    STATE_DEGRADED,
+    STATE_OK,
+    STATE_REDLINED,
+)
+from mythril_tpu.service.client import ServiceError
+from mythril_tpu.service.jobs import Job, JobState, QueueRefusal
+
+log = logging.getLogger(__name__)
+
+#: /fleet/stats schema
+FLEET_STATS_SCHEMA_VERSION = 1
+
+#: Retry-After (seconds) on a fleet-wide shed: longer than a single
+#: replica's queue-full hint — the whole fleet being saturated clears
+#: slower than one queue
+DEFAULT_RETRY_AFTER_S = 2
+
+
+class FleetConfig:
+    """Front knobs. `replica_urls` is the only required input."""
+
+    def __init__(
+        self,
+        replica_urls: List[str],
+        probe_interval_s: float = 1.0,
+        probe_timeout_s: float = 2.0,
+        data_timeout_s: float = 15.0,
+        failure_threshold: int = 3,
+        recovery_s: float = 5.0,
+        retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+        journal_dir: Optional[str] = None,
+        recover: bool = False,
+        store_dir: Optional[str] = None,
+    ) -> None:
+        if not replica_urls:
+            raise ValueError("a fleet needs at least one --replica URL")
+        self.replica_urls = list(replica_urls)
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.data_timeout_s = data_timeout_s
+        #: consecutive failed probes before a replica counts as dead
+        self.failure_threshold = failure_threshold
+        #: seconds before a dead replica's breaker half-opens (a
+        #: restarted replica rejoins after one healthy probe)
+        self.recovery_s = recovery_s
+        self.retry_after_s = retry_after_s
+        self.journal_dir = journal_dir
+        self.recover = recover
+        #: the fleet-shared verdict-store directory (informational:
+        #: replicas mount it themselves via `myth serve --store`; the
+        #: front surfaces it in /fleet/stats so an operator can see
+        #: the fleet is actually sharing one)
+        self.store_dir = store_dir
+
+
+class FleetJob:
+    """One submission's routing record: which replica owns it, under
+    which remote id, and how it settled. The CODE ITSELF is validated
+    (and normalized) through the service-side Job — the fleet front
+    reuses the single-host admission contract instead of growing a
+    second parser."""
+
+    def __init__(
+        self,
+        code_hex: str,
+        params: Optional[Dict] = None,
+        idempotency_key: Optional[str] = None,
+        fleet_id: Optional[str] = None,
+    ) -> None:
+        probe = Job(code_hex=code_hex)  # raises ValueError on junk
+        self.code_hex = probe.code.hex()
+        self.code_len = len(probe.code)
+        self.id = fleet_id or uuid.uuid4().hex[:12]
+        self.params = {
+            k: v
+            for k, v in (params or {}).items()
+            if k in ("max_waves", "deadline_s", "host_walk", "lanes")
+            and v is not None
+        }
+        self.idempotency_key = idempotency_key or uuid.uuid4().hex
+        self.replica: Optional[str] = None
+        self.remote_id: Optional[str] = None
+        self.state = JobState.QUEUED
+        self.report_doc: Optional[Dict] = None
+        self.created_t = time.monotonic()
+        self.finished_t: Optional[float] = None
+        self.resubmits = 0
+        self.rerouted = False
+        self.reroute_deduped = False
+        self.frontier_handoff = False
+        #: set when a failover reassigns this job (failover-latency
+        #: histogram measures reassignment -> settle)
+        self.failover_t: Optional[float] = None
+        self.recovered = False
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in JobState.TERMINAL
+
+    # journal duck-typing (JobJournal.job_admitted/job_settled read
+    # these; the fleet job IS the journal's job)
+    @property
+    def code(self) -> bytes:
+        return bytes.fromhex(self.code_hex)
+
+    @property
+    def deadline(self):
+        return None
+
+    @property
+    def max_waves(self):
+        return self.params.get("max_waves")
+
+    @property
+    def host_walk(self):
+        return self.params.get("host_walk")
+
+    @property
+    def lanes(self):
+        return self.params.get("lanes")
+
+    def as_dict(self) -> Dict:
+        out = {
+            "job_id": self.id,
+            "state": self.state,
+            "replica": self.replica,
+            "remote_id": self.remote_id,
+            "code_len": self.code_len,
+            "age_s": round(time.monotonic() - self.created_t, 3),
+            "resubmits": self.resubmits,
+        }
+        if self.finished_t is not None:
+            out["latency_s"] = round(self.finished_t - self.created_t, 3)
+        if self.rerouted:
+            out["rerouted"] = True
+        if self.reroute_deduped:
+            out["reroute_deduped"] = True
+        if self.frontier_handoff:
+            out["frontier_handoff"] = True
+        if self.recovered:
+            out["recovered"] = True
+        if self.report_doc is not None:
+            out["report"] = self.report_doc.get("report")
+            if self.report_doc.get("error"):
+                out["error"] = self.report_doc["error"]
+        return out
+
+
+class FleetFront:
+    """Routing table + replica monitor + failover engine."""
+
+    def __init__(self, config: FleetConfig) -> None:
+        self.cfg = config
+        self.replicas: Dict[str, Replica] = {}
+        for i, url in enumerate(config.replica_urls):
+            name = f"r{i}"
+            self.replicas[name] = Replica(
+                name,
+                url,
+                probe_timeout_s=config.probe_timeout_s,
+                data_timeout_s=config.data_timeout_s,
+                failure_threshold=config.failure_threshold,
+                recovery_s=config.recovery_s,
+            )
+        self._mu = threading.Lock()
+        self._jobs: Dict[str, FleetJob] = {}
+        self._idem: Dict[str, str] = {}  # idempotency key -> fleet id
+        self._rr = 0  # round-robin tiebreak
+        self._draining = False
+        self.started_t = time.monotonic()
+        # lifetime counters (registry doubles in _count)
+        self.submitted = 0
+        self.deduped = 0
+        self.shed = 0
+        self.failovers = 0
+        self.rerouted = 0
+        self.reroute_dedup = 0
+        self.frontier_handoffs = 0
+        #: replicas whose current death was already failed over (reset
+        #: when the replica comes back — a second death fails over again)
+        self._failed_over: set = set()
+        #: replicas whose current drain was already rebalanced
+        self._rebalanced: set = set()
+        self.journal = None
+        if config.journal_dir:
+            from mythril_tpu.service.journal import JobJournal
+
+            self.journal = JobJournal(config.journal_dir)
+            if config.recover:
+                self._recover()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FleetFront":
+        """One synchronous probe sweep (routing works before the first
+        monitor tick), then the monitor thread."""
+        for replica in self.replicas.values():
+            replica.probe()
+        if self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="myth-fleet-monitor",
+                daemon=True,
+            )
+            self._monitor.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        if self.journal is not None:
+            self.journal.mark_drain()
+            self.journal.close()
+
+    def drain(self) -> None:
+        """Stop accepting; in-flight jobs keep settling through their
+        replicas (the front only ever routed — there is nothing to
+        checkpoint here)."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- admission / routing -------------------------------------------
+    def _candidates(self, exclude: Optional[str] = None) -> List[Replica]:
+        """Routable replicas, least-loaded first (round-robin breaks
+        ties so equal-load replicas share work)."""
+        with self._mu:
+            self._rr += 1
+            rr = self._rr
+        rows = [
+            r for r in self.replicas.values()
+            if r.routable and r.name != exclude
+        ]
+        order = list(self.replicas)
+        return sorted(
+            rows,
+            key=lambda r: (
+                r.load(),
+                (order.index(r.name) + rr) % max(1, len(order)),
+            ),
+        )
+
+    def submit(
+        self,
+        code_hex: str,
+        params: Optional[Dict] = None,
+        idempotency_key: Optional[str] = None,
+        frontier: Optional[Dict] = None,
+    ) -> FleetJob:
+        """Route one submission; returns the CANONICAL fleet job (an
+        earlier one when the idempotency key is known — the same
+        contract AnalysisEngine.submit keeps). Raises ValueError on
+        junk code and QueueRefusal when draining or the whole fleet is
+        saturated."""
+        return self.submit_ex(
+            code_hex,
+            params=params,
+            idempotency_key=idempotency_key,
+            frontier=frontier,
+        )[0]
+
+    def submit_ex(
+        self,
+        code_hex: str,
+        params: Optional[Dict] = None,
+        idempotency_key: Optional[str] = None,
+        frontier: Optional[Dict] = None,
+    ) -> "Tuple[FleetJob, bool]":
+        """`submit` plus the dedup fact: (job, True) when the
+        idempotency key mapped back to an existing fleet job."""
+        if self._draining:
+            raise QueueRefusal("draining", "fleet front is draining")
+        job = FleetJob(
+            code_hex, params=params, idempotency_key=idempotency_key
+        )
+        with self._mu:
+            known = self._idem.get(job.idempotency_key)
+            if known is not None and known in self._jobs:
+                self.deduped += 1
+                self._count("submissions", outcome="deduped")
+                return self._jobs[known], True
+            # placeholder BEFORE the forward: a concurrent same-key
+            # submit maps here instead of double-routing
+            self._idem[job.idempotency_key] = job.id
+            self._jobs[job.id] = job
+        try:
+            self._route(job, frontier=frontier)
+        except Exception:
+            # QueueRefusal (fleet saturated) or a 400-class replica
+            # answer: either way the job never existed — forget it so
+            # a later retry of the key routes fresh
+            with self._mu:
+                self._jobs.pop(job.id, None)
+                self._idem.pop(job.idempotency_key, None)
+                self.shed += 1
+            self._count("submissions", outcome="shed")
+            raise
+        with self._mu:
+            self.submitted += 1
+        self._count("submissions", outcome="routed")
+        return job, False
+
+    def _route(
+        self,
+        job: FleetJob,
+        frontier: Optional[Dict] = None,
+        exclude: Optional[str] = None,
+    ) -> None:
+        """Forward `job` to the first candidate that accepts it. Every
+        refusal feeds the replica's breaker/occupancy view; exhausting
+        the candidates raises QueueRefusal("saturated")."""
+        candidates = self._candidates(exclude=exclude)
+        for replica in candidates:
+            try:
+                payload = replica.data.submit_ex(
+                    job.code_hex,
+                    idempotency_key=job.idempotency_key,
+                    frontier=frontier,
+                    **job.params,
+                )
+            except ServiceError as why:
+                if why.status in (429, 503):
+                    # backpressure: an honest answer, not death — the
+                    # next probe refreshes readiness; just move on
+                    log.info(
+                        "fleet: %s refused job %s (%d); trying next",
+                        replica.name, job.id, why.status,
+                    )
+                    continue
+                raise  # 400-class: the submission itself is bad
+            except Exception as why:
+                # connection-level: death evidence, and move on
+                replica.breaker.record_failure(str(why))
+                log.warning(
+                    "fleet: %s unreachable routing job %s: %s",
+                    replica.name, job.id, why,
+                )
+                continue
+            replica.routed += 1
+            with self._mu:
+                job.replica = replica.name
+                job.remote_id = payload.get("job_id")
+                job.state = payload.get("state", JobState.QUEUED)
+            self._count("routed", replica=replica.name)
+            if self.journal is not None:
+                # the fsync'd routing record lands before the caller
+                # acknowledges the job (the WAL half of admission,
+                # same as jobs.py): code + key + replica assignment is
+                # everything --recover needs
+                self.journal.append(
+                    "admitted",
+                    job_id=job.id,
+                    code=job.code_hex,
+                    key=job.idempotency_key,
+                    params=dict(
+                        job.params,
+                        replica=replica.name,
+                        remote_id=job.remote_id,
+                    ),
+                )
+            if job.terminal or payload.get("deduped"):
+                # the replica settled it AT admission (store hit /
+                # static answer) or already knew the key
+                self._poll_once(job)
+            return
+        raise QueueRefusal(
+            "saturated",
+            f"no routable replica accepted the job "
+            f"({len(candidates)} candidates)",
+        )
+
+    # -- job reads ------------------------------------------------------
+    def get(self, fleet_id: str) -> Optional[FleetJob]:
+        with self._mu:
+            return self._jobs.get(fleet_id)
+
+    def job_doc(self, fleet_id: str) -> Optional[Dict]:
+        job = self.get(fleet_id)
+        if job is None:
+            return None
+        if not job.terminal or job.report_doc is None:
+            self._poll_once(job)
+        return job.as_dict()
+
+    def report(self, fleet_id: str, wait_s: float = 30.0) -> Optional[Dict]:
+        """Long-poll until the fleet job is terminal. Polls the owning
+        replica in SHORT hops (not one long remote poll) so a mid-wait
+        failover re-targets the next hop at the survivor."""
+        job = self.get(fleet_id)
+        if job is None:
+            return None
+        if job.terminal and job.report_doc is None:
+            self._poll_once(job)  # fetch the report the settle implied
+        end = time.monotonic() + max(0.0, wait_s)
+        while not job.terminal:
+            left = end - time.monotonic()
+            if left <= 0:
+                break
+            self._poll_once(job, wait_s=min(2.0, left))
+            if job.terminal:
+                break
+            time.sleep(min(0.05, max(0.0, end - time.monotonic())))
+        return job.as_dict()
+
+    def _poll_once(self, job: FleetJob, wait_s: float = 0.0) -> None:
+        """One status hop to the owning replica; terminal answers are
+        recorded. A connection failure feeds the death breaker — the
+        monitor (or this very poll, next iteration) re-routes."""
+        with self._mu:
+            name, remote_id = job.replica, job.remote_id
+        replica = self.replicas.get(name) if name else None
+        if replica is None or remote_id is None:
+            return
+        try:
+            if wait_s > 0:
+                doc = replica.data.report(remote_id, wait_s=wait_s)
+            else:
+                doc = replica.data.job(remote_id)
+        except ServiceError as why:
+            if why.status == 404:
+                # the replica restarted WITHOUT its journal (or the
+                # journal lost the job): re-route it like a death
+                log.warning(
+                    "fleet: %s forgot job %s (remote %s); re-routing",
+                    name, job.id, remote_id,
+                )
+                self._reroute([job], exclude=name)
+            return
+        except Exception as why:
+            replica.breaker.record_failure(str(why))
+            self._maybe_failover(replica)
+            return
+        state = doc.get("state")
+        if state in JobState.TERMINAL:
+            self._note_terminal(job, doc)
+        elif state:
+            with self._mu:
+                job.state = state
+
+    def _note_terminal(self, job: FleetJob, doc: Dict) -> None:
+        with self._mu:
+            # keyed on the DOC, not the state: the submit payload can
+            # mark the job terminal (an instant-tier settle) before
+            # the full report doc has been fetched
+            if job.report_doc is not None:
+                return
+            job.state = doc["state"]
+            job.report_doc = doc
+            job.finished_t = time.monotonic()
+        self._count("jobs_settled", state=job.state)
+        if job.failover_t is not None:
+            self._observe_failover_latency(
+                job.finished_t - job.failover_t
+            )
+        if self.journal is not None:
+            self.journal.job_settled(job, job.state, sync=False)
+
+    # -- monitoring / failover -----------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            try:
+                self.check_replicas()
+            except Exception:  # the monitor must never die
+                log.exception("fleet monitor tick failed")
+
+    def check_replicas(self) -> None:
+        """One monitor tick: probe everyone, then react — failover the
+        dead, rebalance the draining. Public so tests and the smoke
+        can tick deterministically."""
+        for replica in self.replicas.values():
+            replica.probe()
+        self._export_fleet_gauges()
+        for replica in self.replicas.values():
+            if not replica.alive:
+                self._maybe_failover(replica)
+            else:
+                self._failed_over.discard(replica.name)
+                if replica.draining:
+                    self._maybe_rebalance(replica)
+                else:
+                    self._rebalanced.discard(replica.name)
+
+    def _maybe_failover(self, replica: Replica) -> None:
+        """Fail over `replica`'s in-flight jobs once per death (a
+        replica that recovers and dies again is failed over again).
+        The latch check-and-set is atomic: the monitor tick and a
+        poll-path connection failure can race here, and the victims
+        must be swept exactly once per death."""
+        if replica.alive:
+            return
+        with self._mu:
+            if replica.name in self._failed_over:
+                return
+            self._failed_over.add(replica.name)
+            victims = [
+                j for j in self._jobs.values()
+                if j.replica == replica.name and not j.terminal
+            ]
+        self.failovers += 1
+        self._count("failovers", replica=replica.name)
+        log.warning(
+            "fleet: replica %s LOST (%s) — failing over %d in-flight "
+            "job(s)", replica.name, replica.url, len(victims),
+        )
+        if victims:
+            self._reroute(victims, exclude=replica.name)
+
+    def _reroute(
+        self, victims: List[FleetJob], exclude: Optional[str] = None
+    ) -> None:
+        """Resubmit each victim to a survivor with its ORIGINAL
+        idempotency key: the fleet-shared store / journal-seeded key
+        index on the survivor answers already-computed work instantly
+        (reroute-dedup), anything else re-runs. A victim no survivor
+        accepts stays assigned — the next monitor tick retries."""
+        t0 = time.monotonic()
+        for job in victims:
+            try:
+                payload = None
+                for survivor in self._candidates(exclude=exclude):
+                    try:
+                        payload = survivor.data.submit_ex(
+                            job.code_hex,
+                            idempotency_key=job.idempotency_key,
+                            **job.params,
+                        )
+                    except ServiceError as why:
+                        if why.status in (429, 503):
+                            continue
+                        raise
+                    except Exception as why:
+                        survivor.breaker.record_failure(str(why))
+                        continue
+                    survivor.routed += 1
+                    with self._mu:
+                        job.replica = survivor.name
+                        job.remote_id = payload.get("job_id")
+                        job.state = payload.get(
+                            "state", JobState.QUEUED
+                        )
+                        job.resubmits += 1
+                        job.rerouted = True
+                        job.failover_t = t0
+                        self.rerouted += 1
+                    self._count("jobs_rerouted", replica=survivor.name)
+                    if self.journal is not None:
+                        self.journal.append(
+                            "admitted",
+                            job_id=job.id,
+                            code=job.code_hex,
+                            key=job.idempotency_key,
+                            params=dict(
+                                job.params,
+                                replica=survivor.name,
+                                remote_id=job.remote_id,
+                            ),
+                        )
+                    if payload.get("deduped") or payload.get(
+                        "state"
+                    ) in JobState.TERMINAL:
+                        # settled at admission (fleet-shared store /
+                        # known key): the microseconds path the whole
+                        # design exists for
+                        with self._mu:
+                            job.reroute_deduped = True
+                            self.reroute_dedup += 1
+                        self._count("reroute_deduped")
+                        self._poll_once(job)
+                    break
+                if payload is None:
+                    log.warning(
+                        "fleet: no survivor accepted job %s; will "
+                        "retry next tick", job.id,
+                    )
+            except Exception:
+                log.exception("fleet: reroute failed for job %s", job.id)
+
+    def _maybe_rebalance(self, replica: Replica) -> None:
+        """Pull a DRAINING replica's unfinished jobs through
+        /v1/frontier/export and reseed them into survivors (once per
+        drain)."""
+        with self._mu:
+            if replica.name in self._rebalanced:
+                return
+            self._rebalanced.add(replica.name)
+        try:
+            export = replica.data.frontier_export()
+        except Exception as why:
+            log.warning(
+                "fleet: frontier export from draining %s failed: %s",
+                replica.name, why,
+            )
+            return
+        docs = export.get("jobs") or []
+        if not docs:
+            return
+        log.info(
+            "fleet: rebalancing %d job(s) off draining replica %s",
+            len(docs), replica.name,
+        )
+        for doc in docs:
+            key = doc.get("idempotency_key")
+            with self._mu:
+                fleet_id = self._idem.get(key) if key else None
+                job = self._jobs.get(fleet_id) if fleet_id else None
+            if job is None:
+                # a job submitted straight to the replica: adopt it so
+                # the handoff covers direct traffic too
+                try:
+                    job = FleetJob(
+                        doc.get("code") or "",
+                        params=doc.get("params"),
+                        idempotency_key=key,
+                    )
+                except ValueError:
+                    continue
+                with self._mu:
+                    self._jobs[job.id] = job
+                    self._idem[job.idempotency_key] = job.id
+            if job.terminal:
+                continue
+            frontier = doc.get("frontier")
+            for survivor in self._candidates(exclude=replica.name):
+                try:
+                    payload = survivor.data.submit_ex(
+                        job.code_hex,
+                        idempotency_key=job.idempotency_key,
+                        frontier=frontier,
+                        **job.params,
+                    )
+                except ServiceError as why:
+                    # backpressure: try the next survivor; anything
+                    # else (a 400-class verdict on the handoff doc)
+                    # abandons THIS job, never the whole sweep
+                    if why.status in (429, 503):
+                        continue
+                    log.warning(
+                        "fleet: %s refused handoff of job %s: %s",
+                        survivor.name, job.id, why,
+                    )
+                    break
+                except Exception as why:
+                    survivor.breaker.record_failure(str(why))
+                    continue
+                survivor.routed += 1
+                with self._mu:
+                    job.replica = survivor.name
+                    job.remote_id = payload.get("job_id")
+                    job.state = payload.get("state", JobState.QUEUED)
+                    job.resubmits += 1
+                    job.frontier_handoff = True
+                    self.frontier_handoffs += 1
+                self._count(
+                    "frontier_handoffs", replica=survivor.name
+                )
+                if self.journal is not None:
+                    self.journal.append(
+                        "admitted",
+                        job_id=job.id,
+                        code=job.code_hex,
+                        key=job.idempotency_key,
+                        params=dict(
+                            job.params,
+                            replica=survivor.name,
+                            remote_id=job.remote_id,
+                        ),
+                    )
+                break
+
+    # -- recovery -------------------------------------------------------
+    def _recover(self) -> None:
+        """Replay the front's own journal: terminal jobs become
+        queryable history, live jobs re-attach to their recorded
+        replica (the first monitor tick fails over any replica that
+        died with the front), then compact."""
+        from mythril_tpu.service.journal import EVENT_SETTLED
+
+        replay = self.journal.replay_prior()
+        if not replay.records:
+            return
+        recovered = 0
+        for jj in replay.jobs.values():
+            if not jj.code_hex:
+                continue
+            try:
+                job = FleetJob(
+                    jj.code_hex,
+                    params=jj.params,
+                    idempotency_key=jj.idempotency_key,
+                    fleet_id=jj.job_id,
+                )
+            except ValueError:
+                continue
+            job.recovered = True
+            job.replica = (jj.params or {}).get("replica")
+            job.remote_id = (jj.params or {}).get("remote_id")
+            if jj.terminal:
+                job.state = jj.state
+                self.journal.append(
+                    EVENT_SETTLED, sync=False, job_id=jj.job_id,
+                    state=jj.state, key=jj.idempotency_key,
+                )
+            else:
+                self.journal.append(
+                    "admitted", job_id=job.id, code=job.code_hex,
+                    key=job.idempotency_key,
+                    params=dict(
+                        job.params, replica=job.replica,
+                        remote_id=job.remote_id,
+                    ),
+                )
+            with self._mu:
+                self._jobs[job.id] = job
+                self._idem[job.idempotency_key] = job.id
+            recovered += 1
+        self.journal.compact()
+        log.info(
+            "fleet recovery: %d job(s) re-attached from the journal%s",
+            recovered,
+            "" if replay.clean_shutdown else " — UNCLEAN shutdown",
+        )
+
+    # -- health / stats -------------------------------------------------
+    def health(self) -> Dict:
+        """The front's /healthz payload, in the replica vocabulary so
+        one probe grammar covers the whole topology: `replica-lost:
+        <name>` per dead replica, `fleet-degraded` while any replica
+        is unroutable, `fleet-saturated` (redlined, not ready) when
+        nobody accepts work."""
+        dead = [r.name for r in self.replicas.values() if not r.alive]
+        unroutable = [
+            r.name for r in self.replicas.values() if not r.routable
+        ]
+        routable = len(self.replicas) - len(unroutable)
+        reasons = [f"{REDLINE_REPLICA_LOST}:{n}" for n in dead]
+        state = STATE_OK
+        ready = routable > 0 and not self._draining
+        if unroutable:
+            state = STATE_DEGRADED
+            reasons.append(REDLINE_FLEET_DEGRADED)
+        if routable == 0:
+            state = STATE_REDLINED
+            reasons.append(REDLINE_FLEET_SATURATED)
+        not_ready = []
+        if self._draining:
+            not_ready.append("draining")
+        if routable == 0:
+            not_ready.append(REDLINE_FLEET_SATURATED)
+        return {
+            "ok": True,
+            "fleet": True,
+            "state": state,
+            "reasons": reasons,
+            "ready": ready,
+            "not_ready_reasons": not_ready,
+            "replicas": len(self.replicas),
+            "routable_replicas": routable,
+            "draining": self._draining,
+        }
+
+    def stats(self) -> Dict:
+        with self._mu:
+            jobs_by_state: Dict[str, int] = {}
+            for job in self._jobs.values():
+                jobs_by_state[job.state] = (
+                    jobs_by_state.get(job.state, 0) + 1
+                )
+            fleet = {
+                "submitted": self.submitted,
+                "deduped": self.deduped,
+                "shed": self.shed,
+                "failovers": self.failovers,
+                "rerouted": self.rerouted,
+                "reroute_deduped": self.reroute_dedup,
+                "frontier_handoffs": self.frontier_handoffs,
+                "jobs": jobs_by_state,
+                "tracked_jobs": len(self._jobs),
+                "store_dir": self.cfg.store_dir,
+            }
+        return {
+            "schema_version": FLEET_STATS_SCHEMA_VERSION,
+            "uptime_s": round(time.monotonic() - self.started_t, 3),
+            "draining": self._draining,
+            "health": self.health(),
+            "fleet": fleet,
+            "replicas": [
+                r.stats() for r in self.replicas.values()
+            ],
+            "journal": (
+                self.journal.stats()
+                if self.journal is not None
+                else {"enabled": False}
+            ),
+        }
+
+    # -- telemetry ------------------------------------------------------
+    def _count(self, name: str, **labels) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            counter = registry().counter(
+                f"mtpu_fleet_{name}_total",
+                f"fleet front {name.replace('_', ' ')}",
+            )
+            (counter.labels(**labels) if labels else counter).inc()
+        except Exception:
+            pass
+
+    def _observe_failover_latency(self, seconds: float) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().histogram(
+                "mtpu_fleet_failover_seconds",
+                "replica-death detection to re-routed-job settle",
+            ).observe(seconds)
+        except Exception:
+            pass
+
+    def _export_fleet_gauges(self) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            reg = registry()
+            reg.gauge(
+                "mtpu_fleet_replicas", "configured fleet size"
+            ).set(len(self.replicas))
+            reg.gauge(
+                "mtpu_fleet_routable_replicas",
+                "replicas currently accepting new work",
+            ).set(
+                sum(1 for r in self.replicas.values() if r.routable)
+            )
+        except Exception:
+            pass

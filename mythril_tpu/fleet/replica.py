@@ -1,0 +1,219 @@
+"""One replica as the fleet front sees it: a probed health state, a
+death breaker, and the two HTTP clients (probe + data plane).
+
+A replica is ROUTABLE when three independent facts line up: its death
+breaker is not open (the host answers at all), its last
+``/healthz?ready=1`` probe came back 200 (the replica itself says
+"route new work here" — warming, draining, and redlined replicas say
+503 with the enumerated reason), and it is not draining. The probe
+never trusts a stale answer: routing reads the last probe, and the
+monitor loop refreshes it on a clock tight enough that a dying
+replica is detected within a few probe intervals.
+
+Death detection is the per-replica `support/breaker.py` instance —
+the same closed → open → half-open machine the tier ladders use, here
+fed by probe outcomes: a connection refused/timeout is a failure, ANY
+HTTP answer (including a 503 readiness refusal) is liveness and
+counts as success. `failure_threshold` consecutive failed probes trip
+the breaker open — that is the front's "replica lost" fact — and the
+half-open probe after `recovery_s` lets a restarted replica rejoin
+without operator action."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from mythril_tpu.service.client import ServiceClient, ServiceError
+from mythril_tpu.support.breaker import STATE_OPEN, CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+
+class Replica:
+    """Fleet-front-side state for one `myth serve` replica."""
+
+    def __init__(
+        self,
+        name: str,
+        url: str,
+        probe_timeout_s: float = 2.0,
+        data_timeout_s: float = 15.0,
+        failure_threshold: int = 3,
+        recovery_s: float = 5.0,
+    ) -> None:
+        self.name = name
+        self.url = url.rstrip("/")
+        #: the probe client fails FAST (no retries, short timeout):
+        #: a probe that hangs is itself death evidence
+        self.probe_client = ServiceClient(
+            self.url, timeout_s=probe_timeout_s, retries=0,
+            honor_retry_after=False,
+        )
+        #: the data plane: submissions/polls. One connection-level
+        #: retry only — the FRONT owns failover policy; a refusal here
+        #: means "try another replica", not "wait and hope"
+        self.data = ServiceClient(
+            self.url, timeout_s=data_timeout_s, retries=1,
+            backoff_s=0.1, honor_retry_after=False,
+        )
+        #: the death breaker: its tier name puts
+        #: `mtpu_breaker_state{tier="replica:<name>"}` on /metrics and
+        #: `breaker-open:replica:<name>` in the open_reasons() feed
+        self.breaker = CircuitBreaker(
+            f"replica:{name}",
+            failure_threshold=failure_threshold,
+            recovery_s=recovery_s,
+        )
+        self._mu = threading.Lock()
+        self.health: Dict = {}
+        self.ready = False
+        self.draining = False
+        self.queue_depth = 0
+        self.queue_capacity = 1
+        self.lanes_busy = 0
+        self.lanes = 1
+        self.jobs_by_state: Dict[str, int] = {}
+        self.probes = 0
+        self.probe_failures = 0
+        self.last_probe_t: Optional[float] = None
+        self.last_ok_t: Optional[float] = None
+        #: front bookkeeping: routed submissions (lifetime)
+        self.routed = 0
+
+    # -- state ---------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        """The host answers HTTP at all (death breaker not open)."""
+        return self.breaker.state != STATE_OPEN
+
+    @property
+    def routable(self) -> bool:
+        """Route new work here? Alive AND the replica's own readiness
+        probe said 200 AND it is not draining away."""
+        return self.alive and self.ready and not self.draining
+
+    @property
+    def health_state(self) -> str:
+        return self.health.get("state", "unknown")
+
+    # -- probing -------------------------------------------------------
+    def probe(self) -> bool:
+        """One health/occupancy probe. Returns True when the replica
+        ANSWERED (readiness aside); False on connection-level death
+        evidence (which also feeds the breaker)."""
+        with self._mu:
+            self.probes += 1
+            self.last_probe_t = time.monotonic()
+        try:
+            payload = self.probe_client.healthz(ready=True)
+        except ServiceError as why:
+            # the replica answered: alive. 503 is the readiness
+            # refusal contract; anything else is unexpected but still
+            # a live process.
+            payload = why.payload if isinstance(why.payload, dict) else {}
+            payload.setdefault("ready", False)
+        except Exception as why:
+            with self._mu:
+                self.probe_failures += 1
+                self.ready = False
+            self.breaker.record_failure(str(why))
+            self._count_probe(ok=False)
+            self._export()
+            return False
+        self.breaker.record_success()
+        with self._mu:
+            self.health = payload
+            self.ready = bool(payload.get("ready"))
+            self.draining = bool(payload.get("draining")) or (
+                "draining" in (payload.get("not_ready_reasons") or [])
+            )
+            self.last_ok_t = time.monotonic()
+        if self.ready:
+            self._refresh_occupancy()
+        self._count_probe(ok=True)
+        self._export()
+        return True
+
+    def _refresh_occupancy(self) -> None:
+        """The routing inputs: queue depth + arena occupancy from
+        /stats (least-loaded striping wants live numbers; a failed
+        refresh keeps the stale ones — routing degrades to round-robin
+        fairness, never to an exception)."""
+        try:
+            stats = self.probe_client.stats()
+        except Exception:
+            return
+        queue = stats.get("queue") or {}
+        arena = stats.get("arena") or {}
+        with self._mu:
+            self.queue_depth = int(queue.get("depth") or 0)
+            self.queue_capacity = max(1, int(queue.get("capacity") or 1))
+            self.lanes_busy = int(arena.get("lanes_busy") or 0)
+            self.lanes = max(1, int(arena.get("lanes") or 1))
+            self.jobs_by_state = dict(queue.get("jobs") or {})
+
+    def load(self) -> float:
+        """The routing score: fraction of queue + arena in use (lower
+        routes first)."""
+        with self._mu:
+            return (
+                self.queue_depth / self.queue_capacity
+                + self.lanes_busy / self.lanes
+            )
+
+    # -- telemetry -----------------------------------------------------
+    def _count_probe(self, ok: bool) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_fleet_probes_total",
+                "fleet replica health probes, by replica and outcome",
+            ).labels(
+                replica=self.name, outcome="ok" if ok else "failed"
+            ).inc()
+        except Exception:
+            pass
+
+    def _export(self) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            reg = registry()
+            reg.gauge(
+                "mtpu_fleet_replica_up",
+                "1 while the replica's death breaker is not open",
+            ).labels(replica=self.name).set(1.0 if self.alive else 0.0)
+            reg.gauge(
+                "mtpu_fleet_replica_ready",
+                "1 while the replica's own readiness probe says 200",
+            ).labels(replica=self.name).set(1.0 if self.ready else 0.0)
+        except Exception:
+            pass
+
+    def stats(self) -> Dict:
+        with self._mu:
+            return {
+                "name": self.name,
+                "url": self.url,
+                "alive": self.alive,
+                "ready": self.ready,
+                "routable": self.routable,
+                "draining": self.draining,
+                "state": self.health_state,
+                "not_ready_reasons": list(
+                    self.health.get("not_ready_reasons") or []
+                ),
+                "breaker": self.breaker.stats(),
+                "queue_depth": self.queue_depth,
+                "queue_capacity": self.queue_capacity,
+                "lanes_busy": self.lanes_busy,
+                "lanes": self.lanes,
+                "jobs": dict(self.jobs_by_state),
+                "probes": self.probes,
+                "probe_failures": self.probe_failures,
+                "routed": self.routed,
+            }

@@ -1,0 +1,272 @@
+"""HTTP face of the fleet front (`myth fleet`).
+
+Same stdlib stack and largely the same surface as the single-replica
+server (service/server.py), so every existing client — `myth submit`,
+`myth observe top`, the smoke harnesses — points at a fleet front
+unchanged:
+
+  POST /v1/jobs                submit; routed to a healthy replica.
+                               202 {job_id, replica}; 503 +
+                               Retry-After when the WHOLE fleet is
+                               saturated or draining
+  GET  /v1/jobs/<id>           fleet job status (+ replica's report
+                               when terminal)
+  GET  /v1/jobs/<id>/report    long-poll until terminal (?wait_s=30);
+                               survives a mid-poll failover
+  GET  /healthz                fleet health in the replica vocabulary
+                               (?ready=1 -> 503 + Retry-After while
+                               no replica accepts work)
+  GET  /fleet/stats            per-replica health/occupancy rows +
+                               fleet counters (also served at /stats
+                               so `myth observe top` just works)
+  GET  /metrics                the front's own registry (mtpu_fleet_*
+                               + per-replica breaker states)
+  POST /v1/drain               stop accepting; in-flight jobs keep
+                               settling through their replicas
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from mythril_tpu.fleet.front import FleetConfig, FleetFront
+from mythril_tpu.service.client import ServiceError
+from mythril_tpu.service.jobs import QueueRefusal
+
+log = logging.getLogger(__name__)
+
+_JOB_PATH = re.compile(r"^/v1/jobs/([0-9a-f]{12})(/report)?$")
+
+#: QueueRefusal.reason -> HTTP status ("saturated" is the fleet-wide
+#: shed: every replica refused or is unroutable)
+_REFUSAL_STATUS = {"full": 429, "draining": 503, "saturated": 503}
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def front(self) -> FleetFront:
+        return self.server.front  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):
+        log.debug("fleet http: " + fmt, *args)
+
+    def _reply(
+        self, status: int, payload: Dict,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _query(self) -> Tuple[str, Dict[str, str]]:
+        path, _, query = self.path.partition("?")
+        params = {}
+        for pair in query.split("&"):
+            if "=" in pair:
+                key, _, value = pair.partition("=")
+                params[key] = value
+        return path, params
+
+    def _retry_after(self) -> Dict[str, str]:
+        return {"Retry-After": str(int(self.front.cfg.retry_after_s))}
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802
+        path, params = self._query()
+        if path == "/healthz":
+            payload = self.front.health()
+            payload["uptime_s"] = round(
+                time.monotonic() - self.front.started_t, 3
+            )
+            status, headers = 200, None
+            if params.get("ready") and not payload["ready"]:
+                status, headers = 503, self._retry_after()
+            self._reply(status, payload, headers=headers)
+            return
+        if path in ("/fleet/stats", "/stats"):
+            self._reply(200, self.front.stats())
+            return
+        if path == "/metrics":
+            from mythril_tpu import observe
+
+            body = observe.registry().prometheus_text().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        match = _JOB_PATH.match(path)
+        if match:
+            job_id, sub = match.group(1), match.group(2) or ""
+            if sub == "/report":
+                wait_s = min(float(params.get("wait_s", 30.0)), 300.0)
+                doc = self.front.report(job_id, wait_s=wait_s)
+            else:
+                doc = self.front.job_doc(job_id)
+            if doc is None:
+                self._reply(404, {"error": f"unknown job {job_id}"})
+                return
+            self._reply(200, doc)
+            return
+        self._reply(404, {"error": f"no route {path}"})
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        path, _ = self._query()
+        if path == "/v1/drain":
+            self.front.drain()
+            self._reply(202, {"draining": True})
+            return
+        if path != "/v1/jobs":
+            self._reply(404, {"error": f"no route {path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            code = body["code"]
+        except (KeyError, ValueError, TypeError) as why:
+            self._reply(400, {"error": f"bad request: {why}"})
+            return
+        try:
+            job, deduped = self.front.submit_ex(
+                code,
+                params={
+                    k: body.get(k)
+                    for k in (
+                        "max_waves", "deadline_s", "host_walk", "lanes",
+                    )
+                },
+                idempotency_key=body.get("idempotency_key"),
+                frontier=body.get("frontier"),
+            )
+        except ValueError as why:
+            self._reply(400, {"error": f"bad request: {why}"})
+            return
+        except QueueRefusal as refusal:
+            self._reply(
+                _REFUSAL_STATUS.get(refusal.reason, 503),
+                {"error": str(refusal), "reason": refusal.reason},
+                headers=self._retry_after(),
+            )
+            return
+        except ServiceError as why:
+            # a replica's 400-class verdict on the submission itself
+            self._reply(why.status, why.payload or {"error": str(why)})
+            return
+        payload = {
+            "job_id": job.id,
+            "state": job.state,
+            "replica": job.replica,
+        }
+        if deduped:
+            payload["deduped"] = True
+        self._reply(202, payload)
+
+
+class FleetServer:
+    """Front + HTTP listener; `myth fleet` runs it until drained,
+    tests run it in-process (port 0 picks a free port)."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.front = FleetFront(config)
+        self._httpd = ThreadingHTTPServer((host, port), _FleetHandler)
+        self._httpd.front = self.front  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._http_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FleetServer":
+        self.front.start()
+        if self._http_thread is None:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="myth-fleet-http",
+                daemon=True,
+            )
+            self._http_thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.front.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_fleet(
+    config: FleetConfig,
+    host: str = "127.0.0.1",
+    port: int = 7340,
+) -> None:
+    """The `myth fleet` entry: run until interrupted."""
+    import signal
+
+    server = FleetServer(config, host=host, port=port).start()
+    stop = threading.Event()
+
+    def _stop_handler(signum, frame):
+        log.info("signal %s: stopping the fleet front", signum)
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _stop_handler)
+        except (ValueError, OSError):
+            continue
+    print(
+        f"myth fleet: listening on {server.url} — "
+        f"{len(server.front.replicas)} replica(s): "
+        + ", ".join(
+            r.url for r in server.front.replicas.values()
+        ),
+        flush=True,
+    )
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    print("myth fleet: stopped, bye", flush=True)

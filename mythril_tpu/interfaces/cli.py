@@ -59,6 +59,7 @@ COMMAND_LIST = (
         "list-detectors",
         "lint",
         "serve",
+        "fleet",
         "submit",
         "solverlab",
         "observe",
@@ -1034,6 +1035,113 @@ def build_parser() -> ArgumentParser:
         ),
     )
 
+    fleet = subparsers.add_parser(
+        "fleet",
+        help=(
+            "Run the federated serving front: health-routed admission "
+            "over N `myth serve` replicas with replica-death failover "
+            "(idempotency-keyed resubmission dedupes through the "
+            "fleet-shared verdict store), drain-time frontier "
+            "rebalancing, and 503+Retry-After load shedding when the "
+            "whole fleet is saturated"
+        ),
+    )
+    fleet.add_argument(
+        "--replica",
+        action="append",
+        dest="replicas",
+        metavar="URL",
+        default=None,
+        help=(
+            "a `myth serve` replica base URL (repeat per replica); "
+            "replicas should share one --store directory so any of "
+            "them answers any repeat"
+        ),
+    )
+    fleet.add_argument("--host", default="127.0.0.1", help="bind address")
+    fleet.add_argument(
+        "--port", type=int, default=7340, help="listen port"
+    )
+    fleet.add_argument(
+        "--probe-interval",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="cadence of the replica health/occupancy probe loop",
+    )
+    fleet.add_argument(
+        "--probe-timeout",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help=(
+            "per-probe timeout; a hung probe counts as a failure "
+            "toward the replica's death breaker"
+        ),
+    )
+    fleet.add_argument(
+        "--failover-threshold",
+        type=int,
+        default=3,
+        metavar="N",
+        help=(
+            "consecutive failed probes before a replica's death "
+            "breaker trips open and its in-flight jobs fail over to "
+            "survivors"
+        ),
+    )
+    fleet.add_argument(
+        "--recovery-s",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help=(
+            "seconds before a dead replica's breaker half-opens (a "
+            "restarted replica rejoins after one healthy probe)"
+        ),
+    )
+    fleet.add_argument(
+        "--retry-after",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help=(
+            "the Retry-After hint on fleet-wide 503 sheds (no "
+            "routable replica accepted the submission)"
+        ),
+    )
+    fleet.add_argument(
+        "--journal",
+        default=None,
+        metavar="DIR",
+        help=(
+            "the front's own durable routing journal (same WAL as "
+            "`myth serve --journal`): every routed admission is "
+            "fsync'd with its code, idempotency key, and replica "
+            "assignment before the 202"
+        ),
+    )
+    fleet.add_argument(
+        "--recover",
+        action="store_true",
+        help=(
+            "replay the routing journal at startup: live jobs "
+            "re-attach to their replicas, and the first probe sweep "
+            "fails over whatever died with the front"
+        ),
+    )
+    fleet.add_argument(
+        "--store",
+        default=None,
+        metavar="DIR",
+        help=(
+            "the fleet-shared verdict-store directory (informational "
+            "— replicas mount it themselves via `myth serve --store`; "
+            "surfaced in /fleet/stats so operators can verify the "
+            "fleet shares one)"
+        ),
+    )
+
     observe_cmd = subparsers.add_parser(
         "observe",
         help=(
@@ -1058,8 +1166,14 @@ def build_parser() -> ArgumentParser:
     )
     observe_cmd.add_argument(
         "--url",
-        default="http://127.0.0.1:7341",
-        help="running `myth serve` base URL (top, report)",
+        action="append",
+        default=None,
+        help=(
+            "running `myth serve` (or `myth fleet`) base URL; repeat "
+            "for a per-replica fleet view — top renders one "
+            "health/occupancy column set per target (default "
+            "http://127.0.0.1:7341)"
+        ),
     )
     observe_cmd.add_argument(
         "--interval", type=float, default=2.0,
@@ -1791,6 +1905,32 @@ def _cmd_serve(args: Namespace) -> None:
     sys.exit()
 
 
+def _cmd_fleet(args: Namespace) -> None:
+    """`myth fleet`: run the federated serving front over N `myth
+    serve` replicas until interrupted."""
+    from mythril_tpu.fleet import FleetConfig, serve_fleet
+
+    if not args.replicas:
+        log.error(
+            "myth fleet wants at least one --replica URL (a running "
+            "`myth serve` instance)"
+        )
+        sys.exit(2)
+    config = FleetConfig(
+        replica_urls=args.replicas,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        failure_threshold=args.failover_threshold,
+        recovery_s=args.recovery_s,
+        retry_after_s=args.retry_after,
+        journal_dir=args.journal,
+        recover=args.recover,
+        store_dir=args.store,
+    )
+    serve_fleet(config, host=args.host, port=args.port)
+    sys.exit()
+
+
 def _cmd_observe(args: Namespace) -> None:
     """`myth observe top|report|compare`: operator tooling over the
     telemetry layer (observe/opstool.py holds the logic)."""
@@ -1799,8 +1939,11 @@ def _cmd_observe(args: Namespace) -> None:
 
     from mythril_tpu.observe import opstool
 
-    def _fetch(path: str, parse_json: bool):
-        with urllib.request.urlopen(args.url.rstrip("/") + path,
+    urls = args.url or ["http://127.0.0.1:7341"]
+
+    def _fetch(path: str, parse_json: bool, url: str = None):
+        base = (url or urls[0]).rstrip("/")
+        with urllib.request.urlopen(base + path,
                                     timeout=10.0) as response:
             body = response.read().decode()
         return json.loads(body) if parse_json else body
@@ -1809,13 +1952,45 @@ def _cmd_observe(args: Namespace) -> None:
         frames = 0
         try:
             while True:
-                stats = _fetch("/stats", True)
-                metrics = opstool.parse_prometheus(_fetch("/metrics", False))
-                frame = opstool.render_top(stats, metrics)
-                if args.json:
-                    print(json.dumps({"stats": stats}, sort_keys=True))
+                if len(urls) > 1:
+                    # the fleet operator view: one row of columns per
+                    # replica target; an unreachable target renders
+                    # DOWN instead of sinking the whole frame
+                    rows = []
+                    for url in urls:
+                        try:
+                            stats = _fetch("/stats", True, url=url)
+                            metrics = opstool.parse_prometheus(
+                                _fetch("/metrics", False, url=url)
+                            )
+                        except OSError:
+                            stats = metrics = None
+                        rows.append((url, stats, metrics))
+                    frame = opstool.render_top_multi(rows)
+                    if args.json:
+                        print(json.dumps(
+                            {
+                                "targets": {
+                                    url: stats
+                                    for url, stats, _m in rows
+                                }
+                            },
+                            sort_keys=True,
+                        ))
+                    else:
+                        print("\033[2J\033[H" + frame, flush=True)
                 else:
-                    print("\033[2J\033[H" + frame, flush=True)
+                    stats = _fetch("/stats", True)
+                    metrics = opstool.parse_prometheus(
+                        _fetch("/metrics", False)
+                    )
+                    frame = opstool.render_top(stats, metrics)
+                    if args.json:
+                        print(json.dumps(
+                            {"stats": stats}, sort_keys=True
+                        ))
+                    else:
+                        print("\033[2J\033[H" + frame, flush=True)
                 frames += 1
                 if args.count and frames >= args.count:
                     break
@@ -1823,7 +1998,7 @@ def _cmd_observe(args: Namespace) -> None:
         except KeyboardInterrupt:
             pass
         except OSError as why:
-            log.error("observe top: %s unreachable: %s", args.url, why)
+            log.error("observe top: %s unreachable: %s", urls[0], why)
             sys.exit(1)
         sys.exit()
 
@@ -1990,6 +2165,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_list_detectors(args)
     if args.command == "serve":
         _cmd_serve(args)
+    if args.command == "fleet":
+        _cmd_fleet(args)
     if args.command == "submit":
         _cmd_submit(args)
     if args.command == "solverlab":

@@ -44,6 +44,11 @@ STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     # tiny absolute values, so the relative gate is loose — it exists
     # to catch the overhead DOUBLING, not wobbling
     ("journal_overhead_frac", "lower", 1.0),
+    # fleet ratios (ISSUE 15): dedup rate is deterministic on the
+    # bench's duplicate-heavy failover leg; throughput scale (2
+    # replicas vs 1) wobbles with host load, so the gate is loose
+    ("fleet_reroute_dedup_rate", "higher", 0.25),
+    ("fleet_throughput_scale", "higher", 0.35),
     ("static_answer_rate", "higher", 0.25),
     ("static_prune_rate", "higher", 0.50),
     ("screen_mount_rate_semantic", "lower", 0.25),
@@ -61,7 +66,8 @@ EXEMPT_FIELDS: Tuple[str, ...] = (
     "blockjit_step_rate", "blockjit_block_rate", "spec_leg_step_rate",
     "generic_step_rate", "batch_steps_per_sec", "hbm_demand_gbps",
     "hbm_utilization_pct", "mfu_pct", "kernel_compile_s",
-    "hard_solve_speedup",
+    "hard_solve_speedup", "fleet_failover_p50_s",
+    "fleet_throughput_1r_wall_s", "fleet_throughput_2r_wall_s",
 )
 
 
@@ -209,6 +215,61 @@ def render_top(
             f"families={len(metrics)}"
         )
     return "\n".join(lines)
+
+
+def render_top_multi(
+    rows: List[Tuple[str, Optional[Dict], Optional[Dict]]],
+) -> str:
+    """The fleet operator view: one health/occupancy column set per
+    target. `rows` is (label, /stats payload or None, parsed /metrics
+    or None) — a None stats renders the target as DOWN (the whole
+    point of the view is seeing which replica is gone). A target that
+    is itself a fleet front (its /stats carries a `fleet` block) gets
+    its fleet counters as a detail line under the table."""
+    header = (
+        f"{'target':38s} {'health':9s} {'ready':5s} {'queue':9s} "
+        f"{'lanes':9s} {'waves':6s} {'done/fail':9s} {'store':5s}"
+    )
+    lines = [header, "-" * len(header)]
+    details: List[str] = []
+    for label, stats, metrics in rows:
+        name = label if len(label) <= 38 else "..." + label[-35:]
+        if stats is None:
+            lines.append(f"{name:38s} {'DOWN':9s} {'-':5s}")
+            continue
+        health = stats.get("health") or {}
+        state = str(health.get("state", "?")).upper()
+        ready = "yes" if health.get("ready") else "no"
+        queue = stats.get("queue") or {}
+        arena = stats.get("arena") or {}
+        jobs = queue.get("jobs") or {}
+        store = stats.get("store") or {}
+        lines.append(
+            f"{name:38s} {state:9s} {ready:5s} "
+            f"{queue.get('depth', 0)}/{queue.get('capacity', 0):<7} "
+            f"{arena.get('lanes_busy', 0)}/{arena.get('lanes', 0):<7} "
+            f"{(stats.get('waves') or {}).get('count', 0):<6} "
+            f"{jobs.get('done', 0)}/{jobs.get('failed', 0):<7} "
+            f"{store.get('answered', store.get('hits', 0))}"
+        )
+        reasons = (
+            (health.get("reasons") or [])
+            + (health.get("not_ready_reasons") or [])
+        )
+        if reasons:
+            details.append(f"  {name}: " + ", ".join(reasons))
+        fleet = stats.get("fleet")
+        if fleet:
+            details.append(
+                f"  {name}: fleet submitted={fleet.get('submitted', 0)} "
+                f"shed={fleet.get('shed', 0)} "
+                f"failovers={fleet.get('failovers', 0)} "
+                f"rerouted={fleet.get('rerouted', 0)} "
+                f"reroute-deduped={fleet.get('reroute_deduped', 0)} "
+                f"frontier-handoffs="
+                f"{fleet.get('frontier_handoffs', 0)}"
+            )
+    return "\n".join(lines + details)
 
 
 # ---------------------------------------------------------------------------

@@ -59,11 +59,24 @@ REDLINE_DEVICE_SATURATED = "device-saturated"
 #: its fallback ladder — the federation front should route around it
 #: until the breaker's half-open probe recovers
 REDLINE_BREAKER_OPEN = "breaker-open"
+#: fleet-front vocabulary (fleet/front.py): prefix form
+#: `replica-lost:<name>` — a replica's death breaker tripped open
+#: (probe timeouts / connection-refused streak) and its in-flight
+#: jobs were failed over to survivors; `fleet-degraded` — at least
+#: one replica is unroutable but the fleet still has ready capacity;
+#: `fleet-saturated` — NO replica is accepting work and the front is
+#: shedding submissions with Retry-After
+REDLINE_REPLICA_LOST = "replica-lost"
+REDLINE_FLEET_DEGRADED = "fleet-degraded"
+REDLINE_FLEET_SATURATED = "fleet-saturated"
 REDLINE_REASONS = (
     REDLINE_SLO_BURN,
     REDLINE_QUEUE_SATURATED,
     REDLINE_DEVICE_SATURATED,
     REDLINE_BREAKER_OPEN,
+    REDLINE_REPLICA_LOST,
+    REDLINE_FLEET_DEGRADED,
+    REDLINE_FLEET_SATURATED,
 )
 
 #: the enumerated not-ready vocabulary for the readiness half of

@@ -422,6 +422,9 @@ class _JobTrack:
         )
         self.corpus: List[bytes] = list(self.seeds)
         self.covered: set = set()
+        #: True when a donor replica's frontier seeded this track (the
+        #: cross-host rebalance handoff; rides into the job report)
+        self.frontier_seeded = False
         self.pc_seen: Optional[np.ndarray] = None
         self.triggers: Dict[str, List[Dict]] = {}
         self.waves_done = 0
@@ -429,6 +432,55 @@ class _JobTrack:
         self.degraded_lanes = 0
         self.lane_steps = 0
         self.rng = random.Random(int(job.id, 16))
+        if job.frontier:
+            try:
+                self.seed_frontier(job.frontier)
+            except Exception:
+                log.warning(
+                    "job %s: donor frontier refused; exploring from "
+                    "scratch", job.id, exc_info=True,
+                )
+
+    def seed_frontier(self, frontier: Dict) -> None:
+        """Install a donor replica's exported frontier (the
+        explore.py `export_frontier` shape, hex-encoded for the HTTP
+        hop) BEFORE this track's first wave: the donor's covered
+        branch directions stay covered and its parent inputs lead the
+        mutation corpus — the service-tier mirror of
+        DeviceCorpusExplorer.seed_frontier, so a rebalanced job
+        continues the donor's exploration instead of restarting."""
+        self.covered |= {
+            (int(pc), bool(taken))
+            for pc, taken in frontier.get("covered") or []
+        }
+        inputs = []
+        for data in frontier.get("parent_inputs") or []:
+            try:
+                inputs.append(
+                    bytes.fromhex(data) if isinstance(data, str)
+                    else bytes(data)
+                )
+            except (ValueError, TypeError):
+                continue
+        if inputs:
+            self.corpus = inputs + self.corpus
+        self.frontier_seeded = True
+
+    def export_frontier(self) -> Dict:
+        """Pack this track's live frontier for a host handoff to
+        another replica — the same keys DeviceCorpusExplorer.
+        export_frontier packs (explore.py), with byte payloads
+        hex-encoded so the doc rides GET /v1/frontier/export."""
+        return {
+            "code_hex": self.job.code.hex(),
+            "covered": [
+                [int(pc), bool(taken)]
+                for pc, taken in sorted(self.covered)
+            ],
+            "attempted": [],
+            "parent_inputs": [d.hex() for d in self.corpus[-64:]],
+            "carries": [],
+        }
 
     def next_inputs(self) -> List[bytes]:
         """One calldata per owned lane: dispatcher seeds first, then
@@ -1277,6 +1329,57 @@ class AnalysisEngine:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def export_frontiers(self) -> Dict:
+        """The GET /v1/frontier/export payload: every non-terminal job
+        with enough context to re-run on another replica — resident
+        jobs carry their live track frontier (covered directions +
+        corpus tail), queued jobs pass along whatever donor frontier
+        they arrived with. The fleet front resubmits each doc to a
+        survivor with the ORIGINAL idempotency key; the frontier seeds
+        the survivor's track (Job.frontier) so exploration continues
+        where this replica left off. Tracks are owned by the wave
+        thread; by the time a front asks (the replica is draining) the
+        loop is winding down, and a marginally stale frontier only
+        costs re-exploration, never correctness."""
+        docs = []
+        for job in self.queue.nonterminal():
+            track = self._tracks.get(job.id)
+            try:
+                frontier = (
+                    track.export_frontier()
+                    if track is not None
+                    else dict(
+                        job.frontier
+                        or {"code_hex": job.code.hex()}
+                    )
+                )
+            except Exception:
+                log.warning(
+                    "frontier export failed for job %s", job.id,
+                    exc_info=True,
+                )
+                frontier = {"code_hex": job.code.hex()}
+            docs.append({
+                "job_id": job.id,
+                "state": job.state,
+                "code": job.code.hex(),
+                "idempotency_key": job.idempotency_key,
+                "params": {
+                    "max_waves": job.max_waves,
+                    "deadline_s": (
+                        job.deadline.budget_s if job.deadline else None
+                    ),
+                    "host_walk": job.host_walk,
+                    "lanes": job.lanes,
+                },
+                "frontier": frontier,
+            })
+        return {
+            "schema_version": 1,
+            "draining": self._draining,
+            "jobs": docs,
+        }
 
     def drain(self, timeout_s: float = 120.0) -> None:
         """The SIGTERM contract: refuse new work, finish the in-flight

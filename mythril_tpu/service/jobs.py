@@ -51,6 +51,7 @@ class Job:
         host_walk: Optional[bool] = None,
         lanes: Optional[int] = None,
         idempotency_key: Optional[str] = None,
+        frontier: Optional[Dict] = None,
     ) -> None:
         code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
         self.code = bytes.fromhex(code_hex)  # raises ValueError on junk
@@ -89,6 +90,12 @@ class Job:
         #: the tier-ladder timeline key (observe/journey.py): service
         #: jobs reuse the job id so /v1/jobs/<id>/trace needs no map
         self.journey_id = self.id
+        #: a donor replica's exploration frontier (the shape
+        #: explore.py export_frontier packs / GET /v1/frontier/export
+        #: serves): covered branch directions + parent inputs seeded
+        #: into this job's track so a rebalanced job CONTINUES the
+        #: donor's exploration instead of restarting it
+        self.frontier = frontier
 
     @property
     def terminal(self) -> bool:
@@ -243,6 +250,13 @@ class JobQueue:
     def get(self, job_id: str) -> Optional[Job]:
         with self._lock:
             return self._jobs.get(job_id)
+
+    def nonterminal(self) -> List[Job]:
+        """Every accepted job not yet in a terminal state, in
+        admission order — the population GET /v1/frontier/export hands
+        to the fleet front for cross-host rebalancing."""
+        with self._lock:
+            return [j for j in self._jobs.values() if not j.terminal]
 
     def settle(self, job: Job, state: str) -> None:
         from mythril_tpu.observe.registry import LATENCY_BUCKETS, registry

@@ -48,6 +48,12 @@ _JOB_PATH = re.compile(r"^/v1/jobs/([0-9a-f]{12})(/report|/trace)?$")
 #: QueueRefusal.reason -> HTTP status
 _REFUSAL_STATUS = {"full": 429, "draining": 503}
 
+#: Retry-After hints (seconds) riding every backpressure answer: a
+#: full queue clears as soon as the next wave settles jobs (come back
+#: quickly); a draining replica is going away (find another one — the
+#: fleet front reads exactly this to pace its shed/retry policy)
+_RETRY_AFTER = {"full": 1, "draining": 5}
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -61,11 +67,16 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging, quietly
         log.debug("http: " + fmt, *args)
 
-    def _reply(self, status: int, payload: Dict) -> None:
+    def _reply(
+        self, status: int, payload: Dict,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -102,9 +113,31 @@ class _Handler(BaseHTTPRequestHandler):
                 time.monotonic() - self.engine.started_t, 3
             )
             status = 200
+            headers = None
             if params.get("ready") and not payload["ready"]:
                 status = 503
-            self._reply(status, payload)
+                headers = {"Retry-After": str(
+                    _RETRY_AFTER["draining"]
+                    if payload["draining"]
+                    else _RETRY_AFTER["full"]
+                )}
+            self._reply(status, payload, headers=headers)
+            return
+        if path == "/v1/frontier/export":
+            # the cross-host rebalance handoff: a DRAINING replica's
+            # unfinished jobs, each with its live exploration frontier
+            # (explore.py export_frontier shape) so a survivor seeded
+            # with it CONTINUES this replica's work. Guarded: a healthy
+            # replica refuses (its jobs are not up for grabs) unless
+            # the caller forces the export (tests, operator tooling).
+            if not (self.engine.draining or params.get("force")):
+                self._reply(
+                    409,
+                    {"error": "replica is not draining "
+                     "(pass ?force=1 to export anyway)"},
+                )
+                return
+            self._reply(200, self.engine.export_frontiers())
             return
         if path == "/stats":
             self._reply(200, self.engine.stats())
@@ -204,6 +237,7 @@ class _Handler(BaseHTTPRequestHandler):
                 host_walk=body.get("host_walk"),
                 lanes=body.get("lanes"),
                 idempotency_key=body.get("idempotency_key"),
+                frontier=body.get("frontier"),
             )
         except (KeyError, ValueError, TypeError) as why:
             self._reply(400, {"error": f"bad request: {why}"})
@@ -218,6 +252,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(
                 _REFUSAL_STATUS.get(refusal.reason, 503),
                 {"error": str(refusal), "reason": refusal.reason},
+                headers={"Retry-After": str(
+                    _RETRY_AFTER.get(refusal.reason, 1)
+                )},
             )
             return
         payload = {"job_id": canonical.id, "state": canonical.state}

@@ -168,8 +168,13 @@ class VerdictStore:
     def _scan(self) -> None:
         """Build the in-memory indexes from the directory. Unreadable
         or invalid entries are skipped (and counted) — one corrupt
-        file must not take the store down."""
-        for name in sorted(os.listdir(self.entries_dir)):
+        file must not take the store down, and entries another replica
+        evicts mid-scan simply don't make the index."""
+        try:
+            names = sorted(os.listdir(self.entries_dir))
+        except OSError:
+            return
+        for name in names:
             if not name.endswith(".json"):
                 continue
             entry = self._load(os.path.join(self.entries_dir, name))
@@ -189,7 +194,10 @@ class VerdictStore:
         """Read + verify one entry file; None (counted corrupt) on any
         refusal. A half-written file cannot exist (atomic rename), but
         a truncated disk, a hand-edited file, or a newer writer all
-        land here."""
+        land here. A file that VANISHED between listing and open —
+        another replica's eviction sweep beat us to it, routine once
+        the directory is fleet-shared — is not corruption: None, no
+        counter, no log noise."""
         try:
             with open(path) as fp:
                 data = json.load(fp)
@@ -211,6 +219,10 @@ class VerdictStore:
                     "tampered entry)"
                 )
             return StoreEntry(data, path)
+        except FileNotFoundError:
+            log.debug("store entry %s vanished mid-read (concurrent "
+                      "evictor); treating as a miss", path)
+            return None
         except (OSError, ValueError, KeyError, TypeError) as why:
             self.corrupt += 1
             self._c["corrupt"].inc()
@@ -424,15 +436,31 @@ class VerdictStore:
         return path
 
     def _evict(self) -> None:
-        """Unlink oldest-mtime entries past the capacity cap."""
+        """Unlink oldest-mtime entries past the capacity cap.
+
+        Fleet-shared directories make every step racy: another
+        replica's sweep can unlink any file between our listdir and
+        the stat, or win the unlink itself. Each row is therefore
+        statted under its own guard (a vanished file simply isn't a
+        candidate) and a lost unlink race books nothing — the entry is
+        gone either way, and exactly one sweep counts the eviction."""
         try:
-            rows = [
-                (os.path.getmtime(os.path.join(self.entries_dir, n)), n)
-                for n in os.listdir(self.entries_dir)
+            names = [
+                n for n in os.listdir(self.entries_dir)
                 if n.endswith(".json")
             ]
         except OSError:
             return
+        rows = []
+        for name in names:
+            try:
+                rows.append(
+                    (os.path.getmtime(
+                        os.path.join(self.entries_dir, name)
+                    ), name)
+                )
+            except OSError:
+                continue  # vanished mid-scan: already evicted
         excess = len(rows) - self.capacity
         if excess <= 0:
             return
@@ -451,9 +479,14 @@ class VerdictStore:
 
     # -- introspection ---------------------------------------------------
     def __len__(self) -> int:
-        return sum(
-            1 for n in os.listdir(self.entries_dir) if n.endswith(".json")
-        )
+        try:
+            return sum(
+                1
+                for n in os.listdir(self.entries_dir)
+                if n.endswith(".json")
+            )
+        except OSError:
+            return 0
 
     def stats(self) -> Dict:
         with self._mu:

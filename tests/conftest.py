@@ -161,6 +161,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet: federated serving suite (mythril_tpu/fleet: health-"
+        "routed admission over N replicas, replica-death failover "
+        "with idempotency-keyed reroute dedupe through the shared "
+        "verdict store, drain-time frontier handoff, fleet-wide load "
+        "shedding with Retry-After, front journal recovery; CPU-only, "
+        "engine-less servers — runs in tier-1, selectable with "
+        "-m fleet; the subprocess kill-one-replica harness is "
+        "tools/fleet_smoke.py via [testenv:fleet])",
+    )
+    config.addinivalue_line(
+        "markers",
         "taint: taint & value-set static layer suite (attacker-taint "
         "fixpoint goldens, semantic screen soundness sweep over every "
         "module positive fixture, static-answer triage differential, "

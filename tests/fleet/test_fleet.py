@@ -1,0 +1,684 @@
+"""Federated serving suite (mythril_tpu/fleet): health-routed
+admission, replica-death failover with idempotency-keyed reroute
+dedupe through the fleet-shared verdict store, drain-time frontier
+handoff, fleet-wide shedding with Retry-After, front journal recovery.
+
+Engine-less replicas throughout (start_engine=False, the service-test
+idiom): a submitted job is ACKNOWLEDGED and stays queued forever —
+exactly the in-flight population a failover must not lose — and the
+verdict-store admission tier still settles instantly, which is how a
+survivor answers re-routed work in microseconds without this suite
+ever paying a device wave. The subprocess SIGKILL harness with real
+waves is tools/fleet_smoke.py ([testenv:fleet])."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mythril_tpu.fleet import FleetConfig, FleetFront, FleetServer
+from mythril_tpu.fleet.front import FleetJob
+from mythril_tpu.service.client import ServiceClient, ServiceError
+from mythril_tpu.service.engine import ServiceConfig, _JobTrack
+from mythril_tpu.service.jobs import Job, QueueRefusal
+from mythril_tpu.service.server import AnalysisServer
+from mythril_tpu.store.store import code_hash_hex
+
+pytestmark = [pytest.mark.fleet, pytest.mark.service]
+
+#: CALLER SELFDESTRUCT — module-applicable, never static-answered
+KILLABLE = "33ff"
+#: storage writer — a second distinct full-path shape
+WRITER = "6001600055600060015500"
+#: CALLDATALOAD(0) branch into a storage write
+BRANCHER = "600035600757005b600160005500"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    queue_capacity=8,
+    host_walk=False,
+)
+
+#: monitor runs manually (check_replicas) in most tests: no timing
+#: races, every probe deterministic
+FLEET_KW = dict(
+    probe_interval_s=30.0, failure_threshold=2, recovery_s=60.0
+)
+
+
+def replica_server(tmp_path, store=None, **over):
+    cfg = dict(CFG, **over)
+    if store is not None:
+        cfg["store_dir"] = str(store)
+    return AnalysisServer(
+        ServiceConfig(**cfg), start_engine=False
+    ).start()
+
+
+def enter_drain_window(server):
+    """Put an engine-less replica into the mid-drain window: /healthz
+    reports draining (ready=1 -> 503), admission refuses, but nothing
+    has been checkpointed yet — the state a front rebalances from.
+    `_drained` is pre-set so the fixture close() never blocks waiting
+    on a wave thread that was never started."""
+    server.engine._draining = True
+    server.engine._drained.set()
+
+
+def kill(server):
+    """The in-process SIGKILL stand-in: the HTTP listener vanishes
+    mid-flight — every later connection is refused, nothing is
+    drained, nothing checkpointed."""
+    server._httpd.shutdown()
+    server._httpd.server_close()
+
+
+def bank(server, code_hex, issues=None):
+    """Write `code_hex`'s verdict into the replica's (shared) store
+    the way a completed walk on ANY replica would have."""
+    engine = server.engine
+    engine.vstore.put(
+        code_hash_hex(code_hex),
+        engine._config_fp,
+        issues=issues or [{"title": "banked", "swc-id": "106"}],
+    )
+
+
+# ---------------------------------------------------------------------------
+# routing respects health state
+# ---------------------------------------------------------------------------
+def test_routing_skips_draining_replica(tmp_path):
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url, b.url], **FLEET_KW)).start()
+    try:
+        # r0 enters the mid-drain window: /healthz?ready=1 says 503
+        enter_drain_window(a)
+        front.check_replicas()
+        assert not front.replicas["r0"].routable
+        assert front.replicas["r0"].alive  # answered: alive, not dead
+        for i in range(4):
+            job, _ = front.submit_ex(KILLABLE, idempotency_key=f"k{i}")
+            assert job.replica == "r1"
+        assert front.replicas["r1"].routed == 4
+        assert front.replicas["r0"].routed == 0
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+def test_least_loaded_striping(tmp_path):
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url, b.url], **FLEET_KW)).start()
+    try:
+        for i in range(6):
+            front.submit_ex(KILLABLE, idempotency_key=f"s{i}")
+            front.check_replicas()  # refresh occupancy between routes
+        # both replicas carry work: striping, not pile-on
+        assert front.replicas["r0"].routed >= 1
+        assert front.replicas["r1"].routed >= 1
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+def test_fleet_shed_when_nobody_routable(tmp_path):
+    a = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url], **FLEET_KW)).start()
+    try:
+        enter_drain_window(a)
+        front.check_replicas()
+        with pytest.raises(QueueRefusal) as refusal:
+            front.submit(KILLABLE)
+        assert refusal.value.reason == "saturated"
+        assert front.shed == 1
+        health = front.health()
+        assert health["state"] == "redlined"
+        assert "fleet-saturated" in health["reasons"]
+    finally:
+        front.close()
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# replica death: failover with zero acknowledged-job loss
+# ---------------------------------------------------------------------------
+def test_kill_one_replica_zero_acknowledged_loss(tmp_path):
+    store = tmp_path / "store"
+    a = replica_server(tmp_path, store=store)
+    b = replica_server(tmp_path, store=store)
+    front = FleetFront(FleetConfig([a.url, b.url], **FLEET_KW)).start()
+    try:
+        codes = [KILLABLE, WRITER, BRANCHER]
+        jobs = []
+        for i, code in enumerate(codes * 2):  # 6 acknowledged jobs
+            job, _ = front.submit_ex(code, idempotency_key=f"ack{i}")
+            jobs.append(job)
+        dead_name = jobs[0].replica
+        victims = [j for j in jobs if j.replica == dead_name]
+        assert victims, "striping should land work on both replicas"
+        dead, survivor = (a, b) if dead_name == "r0" else (b, a)
+        # the fleet-shared store already holds every verdict (some
+        # other replica computed them earlier)
+        for code in codes:
+            bank(survivor, code)
+        kill(dead)
+        for _ in range(3):  # breaker wants 2 consecutive failures
+            front.check_replicas()
+        assert not front.replicas[dead_name].alive
+        # zero acknowledged-job loss: the victims settle through the
+        # survivor's store tier (microseconds); the non-victims are
+        # still safely queued on their LIVE replica (engine-less
+        # servers never run waves — polling them would only wait out
+        # the long-poll budget)
+        for job in jobs:
+            if job in victims:
+                doc = front.report(job.id, wait_s=10.0)
+                assert doc["state"] == "done", doc
+                assert doc.get("rerouted") is True
+                assert doc.get("reroute_deduped") is True
+                assert doc["report"]["issues"], doc
+            else:
+                doc = front.job_doc(job.id)
+                assert doc["state"] == "queued", doc
+                assert front.replicas[doc["replica"]].alive
+        stats = front.stats()["fleet"]
+        assert stats["failovers"] == 1
+        assert stats["rerouted"] == len(victims)
+        assert stats["reroute_deduped"] == len(victims)
+        health = front.health()
+        assert f"replica-lost:{dead_name}" in health["reasons"]
+        assert "fleet-degraded" in health["reasons"]
+        assert health["ready"] is True  # the survivor still serves
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+def test_idempotent_submit_dedupes_at_the_front(tmp_path):
+    a = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url], **FLEET_KW)).start()
+    try:
+        one, dd1 = front.submit_ex(KILLABLE, idempotency_key="same")
+        two, dd2 = front.submit_ex(KILLABLE, idempotency_key="same")
+        assert not dd1 and dd2
+        assert one.id == two.id
+        assert front.deduped == 1
+        # only ONE remote job exists
+        assert a.engine.queue.get(one.remote_id) is not None
+        assert (
+            a.engine.queue.jobs_by_state().get("queued", 0) == 1
+        )
+    finally:
+        front.close()
+        a.close()
+
+
+def test_recovered_replica_rejoins_and_second_death_fails_over(tmp_path):
+    """A replica that comes BACK clears its failed-over latch: the
+    next death triggers a fresh failover instead of being ignored."""
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    front = FleetFront(
+        FleetConfig(
+            [a.url, b.url], probe_interval_s=30.0,
+            failure_threshold=2, recovery_s=0.05,
+        )
+    ).start()
+    try:
+        kill(b)
+        for _ in range(3):
+            front.check_replicas()
+        assert front.failovers == 1
+        assert "r1" in front._failed_over
+        # r1 restarts on a fresh port = a fresh server object; rebind
+        # the front's URL view to it (the operator would restart on
+        # the SAME port; the front only cares that probes succeed)
+        b2 = replica_server(tmp_path)
+        rep = front.replicas["r1"]
+        rep.url = b2.url
+        rep.probe_client = ServiceClient(
+            b2.url, timeout_s=2.0, retries=0, honor_retry_after=False
+        )
+        rep.data = ServiceClient(
+            b2.url, timeout_s=15.0, retries=1, honor_retry_after=False
+        )
+        time.sleep(0.06)  # past recovery_s: breaker half-opens
+        front.check_replicas()
+        assert rep.alive and rep.routable
+        assert "r1" not in front._failed_over
+        kill(b2)
+        for _ in range(3):
+            front.check_replicas()
+        assert front.failovers == 2
+    finally:
+        front.close()
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# frontier export / seed
+# ---------------------------------------------------------------------------
+def test_frontier_export_guard_and_shape(tmp_path):
+    a = replica_server(tmp_path)
+    client = ServiceClient(a.url, retries=0, honor_retry_after=False)
+    try:
+        client.submit(KILLABLE, idempotency_key="f1")
+        with pytest.raises(ServiceError) as refused:
+            client.frontier_export()
+        assert refused.value.status == 409
+        doc = client.frontier_export(force=True)
+        assert doc["schema_version"] == 1
+        assert len(doc["jobs"]) == 1
+        row = doc["jobs"][0]
+        assert row["idempotency_key"] == "f1"
+        assert row["code"] == KILLABLE
+        assert row["state"] == "queued"
+        assert set(row["params"]) == {
+            "max_waves", "deadline_s", "host_walk", "lanes",
+        }
+        # a queued job has no track: the frontier is just the code
+        assert row["frontier"]["code_hex"] == KILLABLE
+    finally:
+        a.close()
+
+
+def test_frontier_http_roundtrip_seeds_the_new_job(tmp_path):
+    """Export from a draining replica, resubmit to another with the
+    frontier attached: the new Job carries it and a track built from
+    that job continues the donor's coverage."""
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    try:
+        client_a = ServiceClient(a.url, retries=0)
+        client_a.submit(BRANCHER, idempotency_key="h1")
+        enter_drain_window(a)
+        export = ServiceClient(a.url, retries=0).frontier_export()
+        assert export["draining"] is True
+        row = export["jobs"][0]
+        # enrich the frontier the way a resident track would have
+        frontier = dict(
+            row["frontier"],
+            covered=[[7, True], [7, False]],
+            parent_inputs=["ff" * 8],
+        )
+        payload = ServiceClient(b.url, retries=0).submit_ex(
+            BRANCHER, idempotency_key="h1", frontier=frontier
+        )
+        remote = b.engine.queue.get(payload["job_id"])
+        assert remote.frontier == frontier
+        track = _JobTrack(remote, [0], [0, 1], 68)
+        assert (7, True) in track.covered
+        assert (7, False) in track.covered
+        assert b"\xff" * 8 in track.corpus
+        assert track.frontier_seeded
+    finally:
+        a.close()
+        b.close()
+
+
+def test_track_export_frontier_roundtrips():
+    job = Job(code_hex=BRANCHER)
+    track = _JobTrack(job, [0], [0, 1], 68)
+    track.covered = {(7, True)}
+    track.corpus.append(b"\x01\x02")
+    doc = track.export_frontier()
+    assert doc["code_hex"] == BRANCHER
+    assert [7, True] in doc["covered"]
+    assert "0102" in doc["parent_inputs"]
+    # seed it into a fresh track: coverage + corpus continue
+    job2 = Job(code_hex=BRANCHER, frontier=doc)
+    track2 = _JobTrack(job2, [0], [0, 1], 68)
+    assert (7, True) in track2.covered
+    assert b"\x01\x02" in track2.corpus
+
+
+def test_draining_replica_hands_jobs_to_survivor(tmp_path):
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url, b.url], **FLEET_KW)).start()
+    try:
+        job, _ = front.submit_ex(KILLABLE, idempotency_key="d1")
+        donor_name = job.replica
+        donor = a if donor_name == "r0" else b
+        survivor = b if donor is a else a
+        enter_drain_window(donor)
+        front.check_replicas()
+        assert job.frontier_handoff is True
+        assert job.replica != donor_name
+        assert survivor.engine.queue.get(job.remote_id) is not None
+        assert front.frontier_handoffs == 1
+        # the handoff runs ONCE per drain
+        front.check_replicas()
+        assert front.frontier_handoffs == 1
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet-shared store
+# ---------------------------------------------------------------------------
+def test_fleet_store_hit_from_replica_that_never_saw_the_contract(
+    tmp_path,
+):
+    """Replica A computed (banked) the verdict; the front routes the
+    repeat to replica B over the SAME store directory — B answers
+    instantly from the shared store although it never analyzed the
+    contract."""
+    store = tmp_path / "store"
+    a = replica_server(tmp_path, store=store)
+    b = replica_server(tmp_path, store=store)
+    bank(a, WRITER, issues=[{"title": "fleet-shared"}])
+    front = FleetFront(FleetConfig([b.url], **FLEET_KW)).start()
+    try:
+        job, _ = front.submit_ex(WRITER, idempotency_key="shared")
+        doc = front.report(job.id, wait_s=5.0)
+        assert doc["state"] == "done"
+        assert doc["report"]["store_hit"] is True
+        assert doc["report"]["issues"] == [{"title": "fleet-shared"}]
+        assert b.engine.vstore.hits >= 1
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After (satellite): server emits, client honors
+# ---------------------------------------------------------------------------
+def test_refusals_carry_retry_after(tmp_path):
+    a = AnalysisServer(
+        ServiceConfig(**dict(CFG, queue_capacity=1)), start_engine=False
+    ).start()
+    try:
+        client = ServiceClient(a.url, retries=0, honor_retry_after=False)
+        client.submit(KILLABLE)
+        with pytest.raises(ServiceError) as full:
+            client.submit(WRITER)
+        assert full.value.status == 429
+        assert full.value.retry_after == 1.0
+        a.engine.queue.draining = True
+        with pytest.raises(ServiceError) as draining:
+            client.submit(BRANCHER)
+        assert draining.value.status == 503
+        assert draining.value.retry_after == 5.0
+    finally:
+        a.close()
+
+
+def test_healthz_ready_503_carries_retry_after(tmp_path):
+    a = replica_server(tmp_path)
+    try:
+        enter_drain_window(a)
+        with pytest.raises(ServiceError) as refused:
+            ServiceClient(a.url, retries=0).healthz(ready=True)
+        assert refused.value.status == 503
+        assert refused.value.retry_after == 5.0
+        assert refused.value.payload.get("ready") is False
+    finally:
+        a.close()
+
+
+def test_client_honors_retry_after_hint():
+    """A 503 with Retry-After is retried after the server's hint
+    (capped), not surfaced — the fixed-exponential path is only the
+    fallback for hintless errors."""
+    import http.server
+
+    hits = []
+
+    class Flaky(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits.append(time.monotonic())
+            if len(hits) == 1:
+                body = b'{"error":"busy"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0.05")
+            else:
+                body = b'{"ok":true}'
+                self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    stub = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    try:
+        client = ServiceClient(
+            f"http://127.0.0.1:{stub.server_address[1]}", retries=2
+        )
+        assert client._request("/healthz") == {"ok": True}
+        assert len(hits) == 2
+        assert hits[1] - hits[0] >= 0.05
+        # honoring OFF: the refusal surfaces immediately, hint attached
+        hits.clear()
+        strict = ServiceClient(
+            f"http://127.0.0.1:{stub.server_address[1]}",
+            retries=2, honor_retry_after=False,
+        )
+        with pytest.raises(ServiceError) as refused:
+            strict._request("/healthz")
+        assert refused.value.retry_after == 0.05
+        assert len(hits) == 1
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet HTTP face
+# ---------------------------------------------------------------------------
+def test_fleet_http_submit_report_stats_healthz(tmp_path):
+    store = tmp_path / "store"
+    a = replica_server(tmp_path, store=store)
+    bank(a, KILLABLE)
+    fleet = FleetServer(FleetConfig([a.url], **FLEET_KW)).start()
+    try:
+        client = ServiceClient(fleet.url)
+        payload = client.submit_ex(KILLABLE, idempotency_key="http1")
+        assert payload["replica"] == "r0"
+        doc = client.report(payload["job_id"], wait_s=5.0)
+        assert doc["state"] == "done"
+        assert doc["report"]["issues"]
+        # idempotent resubmit over HTTP says deduped
+        again = client.submit_ex(KILLABLE, idempotency_key="http1")
+        assert again["job_id"] == payload["job_id"]
+        assert again.get("deduped") is True
+        stats = client.stats()
+        assert stats["fleet"]["submitted"] == 1
+        assert stats["replicas"][0]["name"] == "r0"
+        health = client.healthz()
+        assert health["fleet"] is True and health["ready"] is True
+        # unknown job -> 404
+        with pytest.raises(ServiceError) as missing:
+            client.job("0" * 12)
+        assert missing.value.status == 404
+        # /metrics exposes the fleet series
+        text = urllib.request.urlopen(fleet.url + "/metrics").read(
+        ).decode()
+        assert "mtpu_fleet_submissions_total" in text
+        assert "mtpu_fleet_replica_up" in text
+    finally:
+        fleet.close()
+        a.close()
+
+
+def test_fleet_http_shed_is_503_with_retry_after(tmp_path):
+    a = replica_server(tmp_path)
+    fleet = FleetServer(
+        FleetConfig([a.url], retry_after_s=3, **FLEET_KW)
+    ).start()
+    try:
+        enter_drain_window(a)
+        fleet.front.check_replicas()
+        client = ServiceClient(fleet.url, retries=0,
+                               honor_retry_after=False)
+        with pytest.raises(ServiceError) as shed:
+            client.submit(KILLABLE)
+        assert shed.value.status == 503
+        assert shed.value.payload.get("reason") == "saturated"
+        assert shed.value.retry_after == 3.0
+        with pytest.raises(ServiceError) as probe:
+            client.healthz(ready=True)
+        assert probe.value.status == 503
+        assert probe.value.retry_after == 3.0
+    finally:
+        fleet.close()
+        a.close()
+
+
+def test_front_never_routes_to_a_503_replica(tmp_path):
+    """The acceptance wording, pinned directly: a replica whose
+    /healthz?ready=1 answers 503 receives ZERO submissions while a
+    200 replica exists."""
+    a = replica_server(tmp_path)
+    b = replica_server(tmp_path)
+    front = FleetFront(FleetConfig([a.url, b.url], **FLEET_KW)).start()
+    try:
+        enter_drain_window(b)  # r1 probes 503 from here on
+        front.check_replicas()
+        before = b.engine.queue.accepted
+        for i in range(6):
+            job, _ = front.submit_ex(KILLABLE, idempotency_key=f"n{i}")
+            assert job.replica == "r0"
+        assert b.engine.queue.accepted == before
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# front journal + recovery
+# ---------------------------------------------------------------------------
+def test_front_journal_recovery_reattaches_jobs(tmp_path):
+    a = replica_server(tmp_path)
+    journal_dir = str(tmp_path / "fleet-journal")
+    front = FleetFront(
+        FleetConfig([a.url], journal_dir=journal_dir, **FLEET_KW)
+    ).start()
+    job, _ = front.submit_ex(KILLABLE, idempotency_key="rec1")
+    remote_id = job.remote_id
+    front.close()  # clean shutdown; the journal holds the assignment
+    try:
+        front2 = FleetFront(
+            FleetConfig(
+                [a.url], journal_dir=journal_dir, recover=True,
+                **FLEET_KW,
+            )
+        ).start()
+        try:
+            recovered = front2.get(job.id)
+            assert recovered is not None and recovered.recovered
+            assert recovered.replica == "r0"
+            assert recovered.remote_id == remote_id
+            assert recovered.idempotency_key == "rec1"
+            # the idempotency index recovered too
+            again, deduped = front2.submit_ex(
+                KILLABLE, idempotency_key="rec1"
+            )
+            assert deduped and again.id == job.id
+            # live status still flows from the replica
+            assert front2.job_doc(job.id)["state"] == "queued"
+        finally:
+            front2.close()
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# operator view: myth observe top over multiple targets
+# ---------------------------------------------------------------------------
+def test_render_top_multi_columns_and_down_rows():
+    from mythril_tpu.observe.opstool import render_top_multi
+
+    stats = {
+        "health": {"state": "ok", "ready": True},
+        "queue": {"depth": 2, "capacity": 8, "jobs": {"done": 3}},
+        "arena": {"lanes": 8, "lanes_busy": 4},
+        "waves": {"count": 7},
+        "store": {"answered": 5},
+    }
+    fleet_stats = {
+        "health": {
+            "state": "degraded",
+            "ready": True,
+            "reasons": ["replica-lost:r1", "fleet-degraded"],
+        },
+        "fleet": {
+            "submitted": 9, "shed": 1, "failovers": 1,
+            "rerouted": 2, "reroute_deduped": 2,
+            "frontier_handoffs": 0,
+        },
+    }
+    out = render_top_multi([
+        ("http://127.0.0.1:7341", stats, None),
+        ("http://127.0.0.1:7342", None, None),
+        ("http://127.0.0.1:7340", fleet_stats, None),
+    ])
+    lines = out.splitlines()
+    assert lines[0].startswith("target")
+    assert any("2/8" in line and "4/8" in line for line in lines)
+    assert any("DOWN" in line for line in lines)
+    assert any("replica-lost:r1" in line for line in lines)
+    assert any("reroute-deduped=2" in line for line in lines)
+
+
+@pytest.mark.slow  # subprocess CLI = a full jax import; tox -e fleet
+def test_observe_top_multi_url_cli(tmp_path):
+    """`myth observe top --url A --url B --count 1 --json` renders one
+    frame with a per-target payload and exits 0."""
+    import subprocess
+    import sys
+
+    a = replica_server(tmp_path)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "mythril_tpu", "observe", "top",
+                "--url", a.url,
+                "--url", "http://127.0.0.1:1",  # unreachable: DOWN row
+                "--count", "1", "--json",
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        frame = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert a.url in frame["targets"]
+        assert frame["targets"][a.url]["queue"]["capacity"] == 8
+        assert frame["targets"]["http://127.0.0.1:1"] is None
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# vocabulary pins
+# ---------------------------------------------------------------------------
+def test_fleet_redline_vocabulary_registered():
+    from mythril_tpu.observe import slo
+
+    assert slo.REDLINE_REPLICA_LOST in slo.REDLINE_REASONS
+    assert slo.REDLINE_FLEET_DEGRADED in slo.REDLINE_REASONS
+    assert slo.REDLINE_FLEET_SATURATED in slo.REDLINE_REASONS
+
+
+def test_fleet_job_validates_code_like_the_service():
+    with pytest.raises(ValueError):
+        FleetJob("zz-not-hex")
+    with pytest.raises(ValueError):
+        FleetJob("")
+    job = FleetJob("0x33ff")
+    assert job.code_hex == "33ff" and job.code_len == 2

@@ -183,7 +183,9 @@ def test_trace_unknown_job_is_404():
 def test_healthz_readiness_split_and_draining_reason():
     srv = AnalysisServer(ServiceConfig(**CFG), start_engine=False).start()
     try:
-        client = ServiceClient(srv.url)
+        # honoring OFF: the ready-probe 503 below carries Retry-After
+        # (ISSUE 15); the default client would retry-sleep through it
+        client = ServiceClient(srv.url, honor_retry_after=False)
         health = client.healthz()
         assert health["ok"] is True
         assert health["state"] in ("ok", "degraded")

@@ -152,11 +152,16 @@ def test_queue_full_answers_429():
         ServiceConfig(**dict(CFG, queue_capacity=1)), start_engine=False
     ).start()
     try:
-        client = ServiceClient(srv.url)
+        # honoring OFF: the default client would retry the 429 after
+        # the server's Retry-After hint (ISSUE 15) and book one
+        # rejection per attempt — this test pins the single-refusal
+        # accounting, the honoring behavior is pinned in tests/fleet
+        client = ServiceClient(srv.url, honor_retry_after=False)
         client.submit(WRITER)
         with pytest.raises(ServiceError) as refusal:
             client.submit(KILLABLE)
         assert refusal.value.status == 429
+        assert refusal.value.retry_after == 1.0
         assert client.stats()["queue"]["rejected_full"] == 1
     finally:
         srv.close()
@@ -170,7 +175,9 @@ def test_drain_checkpoints_every_accepted_job(tmp_path):
         ServiceConfig(**dict(CFG, checkpoint_dir=str(tmp_path))),
         start_engine=False,  # jobs stay queued: the pure drain path
     ).start()
-    client = ServiceClient(srv.url)
+    # honoring OFF: the 503 below carries Retry-After (ISSUE 15) and
+    # the default client would sleep through three futile retries
+    client = ServiceClient(srv.url, honor_retry_after=False)
     ids = [client.submit(WRITER), client.submit(BRANCHER)]
     srv.engine.drain()
     try:

@@ -50,7 +50,7 @@ def server(triage_enabled):
 
 
 def test_clean_submission_settles_without_device_dispatch(server):
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     job_id = client.submit(clean_contract(0))
     job = client.job(job_id)
     # already terminal: no wave thread even exists on this server
@@ -68,7 +68,7 @@ def test_clean_submission_settles_without_device_dispatch(server):
 
 
 def test_unanswerable_submission_queues_normally(server):
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     job_id = client.submit(KILLABLE)
     job = client.job(job_id)
     assert job["state"] == "queued"  # engine-less: stays queued
@@ -79,7 +79,7 @@ def test_triage_skips_full_queue_backpressure(server):
     """Answered jobs never occupy a queue slot, so they keep settling
     even when the pending queue is FULL — triage is admission
     capacity, not arena capacity."""
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     for _ in range(CFG["queue_capacity"]):
         client.submit(KILLABLE)
     with pytest.raises(ServiceError):
@@ -94,7 +94,7 @@ def test_config_knob_disables_triage(triage_enabled):
         start_engine=False,
     ).start()
     try:
-        client = ServiceClient(srv.url)
+        client = ServiceClient(srv.url, honor_retry_after=False)
         job_id = client.submit(clean_contract(0))
         assert client.job(job_id)["state"] == "queued"
         stats = client.stats()
@@ -108,7 +108,7 @@ def test_args_flag_disables_triage(server):
     """--no-static-prune parity: with the process-wide static layer
     off, the triage tier must not fire regardless of the service
     config."""
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     previous = support_args.static_prune
     support_args.static_prune = False
     try:
@@ -122,7 +122,7 @@ def test_draining_refuses_triaged_submissions(triage_enabled):
     srv = AnalysisServer(
         ServiceConfig(**CFG), start_engine=False
     ).start()
-    client = ServiceClient(srv.url)
+    client = ServiceClient(srv.url, honor_retry_after=False)
     srv.engine.drain(timeout_s=5.0)
     with pytest.raises(ServiceError):
         client.submit(clean_contract(0))  # 503: draining

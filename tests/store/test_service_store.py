@@ -77,7 +77,7 @@ def server(store_dir):
 
 
 def test_repeat_submission_settles_at_admission(server):
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     job_id = client.submit(BANKED)
     job = client.job(job_id)
     # already terminal: no wave thread even exists on this server
@@ -96,7 +96,7 @@ def test_repeat_submission_settles_at_admission(server):
 
 
 def test_unseen_code_queues_normally(server):
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     job_id = client.submit(UNSEEN)
     assert client.job(job_id)["state"] == "queued"
     stats = client.stats()
@@ -108,7 +108,7 @@ def test_hit_skips_full_queue_backpressure(server):
     """Store hits never occupy a queue slot, so repeats keep settling
     even when the pending queue is FULL — exactly the static-answer
     tier's admission contract."""
-    client = ServiceClient(server.url)
+    client = ServiceClient(server.url, honor_retry_after=False)
     for _ in range(CFG["queue_capacity"]):
         client.submit(UNSEEN)
     with pytest.raises(ServiceError):
@@ -123,7 +123,7 @@ def test_no_store_config_disables_tier(store_dir):
         start_engine=False,
     ).start()
     try:
-        client = ServiceClient(srv.url)
+        client = ServiceClient(srv.url, honor_retry_after=False)
         job_id = client.submit(BANKED)
         assert client.job(job_id)["state"] == "queued"
         stats = client.stats()
@@ -137,7 +137,7 @@ def test_draining_refuses_store_hits(store_dir):
     srv = AnalysisServer(
         ServiceConfig(store_dir=store_dir, **CFG), start_engine=False
     ).start()
-    client = ServiceClient(srv.url)
+    client = ServiceClient(srv.url, honor_retry_after=False)
     srv.engine.drain(timeout_s=5.0)
     with pytest.raises(ServiceError):
         client.submit(BANKED)  # 503: draining

@@ -273,3 +273,87 @@ def test_mask_feed_delegates(tmp_path):
     assert feed.features == summary.features
     assert feed.code_hash == summary.code_hash
     assert feed.prune_directions() == summary.prune_directions()
+
+
+# -- fleet-shared directories: concurrent multi-replica writers ----------
+# (ISSUE 15: several `myth serve` replicas mount ONE store directory;
+# any replica's eviction sweep can unlink any file at any moment, so
+# ENOENT mid-scan / mid-evict / mid-get must read as "already gone",
+# never as corruption, and never raise.)
+def test_second_replica_instance_reads_and_evicts_same_directory(
+    tmp_path,
+):
+    a = _store(tmp_path)
+    b = VerdictStore(a.dir)  # a second replica over the SAME files
+    a.put(code_hash_hex("aa"), FP, issues=[_issue(1)])
+    # b never wrote the entry; the key-derived filename finds it
+    assert b.get(code_hash_hex("aa"), FP) is not None
+    # b evicts the file out from under a: a's next get is a clean miss
+    os.unlink(os.path.join(a.entries_dir, os.listdir(a.entries_dir)[0]))
+    before_corrupt = a.corrupt
+    assert a.get(code_hash_hex("aa"), FP) is None
+    assert a.corrupt == before_corrupt  # vanished, not corrupt
+
+
+def test_evict_tolerates_entries_vanishing_mid_sweep(
+    tmp_path, monkeypatch
+):
+    store = _store(tmp_path, capacity=2)
+    for i in range(4):
+        store.put(code_hash_hex(f"{i:02x}"), FP, issues=[])
+    # one surviving file vanishes between listdir and the stat (the
+    # other replica's sweep won the race)
+    victim = sorted(
+        n for n in os.listdir(store.entries_dir) if n.endswith(".json")
+    )[0]
+    real_getmtime = os.path.getmtime
+
+    def racy_getmtime(path):
+        if os.path.basename(path) == victim:
+            raise FileNotFoundError(path)
+        return real_getmtime(path)
+
+    monkeypatch.setattr(os.path, "getmtime", racy_getmtime)
+    store.put(code_hash_hex("fe"), FP, issues=[])  # triggers _evict
+    assert len(store) <= 3  # the sweep still ran, minus the racer
+
+
+def test_scan_tolerates_entries_vanishing_mid_open(
+    tmp_path, monkeypatch
+):
+    seed = _store(tmp_path)
+    for i in range(3):
+        seed.put(code_hash_hex(f"{i:02x}"), FP, issues=[])
+    names = sorted(
+        n for n in os.listdir(seed.entries_dir) if n.endswith(".json")
+    )
+    victim = os.path.join(seed.entries_dir, names[0])
+    real_open = open
+
+    def racy_open(path, *args, **kwargs):
+        if path == victim:
+            raise FileNotFoundError(path)
+        return real_open(path, *args, **kwargs)
+
+    monkeypatch.setattr("builtins.open", racy_open)
+    fresh = VerdictStore(seed.dir)  # open-time _scan hits the race
+    assert fresh.corrupt == 0  # vanished entries are not corruption
+    monkeypatch.undo()
+    assert fresh.get(code_hash_hex("01"), FP) is not None
+
+
+def test_get_tolerates_entry_vanishing_after_exists_check(
+    tmp_path, monkeypatch
+):
+    store = _store(tmp_path)
+    key = code_hash_hex("ab")
+    store.put(key, FP, issues=[_issue(2)])
+    # exists() says yes, then the file is gone before the read — the
+    # narrow window a concurrent evictor can win
+    monkeypatch.setattr(os.path, "exists", lambda path: True)
+    name = os.listdir(store.entries_dir)[0]
+    os.unlink(os.path.join(store.entries_dir, name))
+    before = (store.corrupt, store.misses)
+    assert store.get(key, FP) is None
+    assert store.corrupt == before[0]
+    assert store.misses == before[1] + 1
