@@ -1212,6 +1212,139 @@ def bench_fleet(jobs_per_leg: int = 6) -> dict:
     return out
 
 
+def bench_chainstream(blocks: int = 30, per_block: int = 2) -> dict:
+    """Chain-head streaming leg (ISSUE 16): a ChainWatcher over an
+    in-process scripted chain (fake clients under the REAL
+    RpcEndpoint/RpcPool/cursor/triage machinery; no network, no
+    front — the fleet handoff is the fleet leg's problem).
+
+    - `ingest_static_rate`: distinct contracts static-triaged per
+      second on the ingest path (line-rate triage under a burst of
+      `blocks * per_block` fresh deployments);
+    - `alert_p50_s`: p50 block-seen -> alert-fired (gated: the SLO
+      story wants it far under any real block time);
+    - `head_lag_blocks_max`: deepest backlog observed while draining
+      the burst with a bounded per-tick backfill batch;
+    - `reorg_recovery_s`: wall for a 3-block reorg to resolve —
+      rollback + retraction + canonical re-ingest to the new head.
+    """
+    import hashlib as _hashlib
+    import statistics
+    import tempfile
+
+    from mythril_tpu.chainstream import ChainWatcher, RpcEndpoint, RpcPool
+    from mythril_tpu.chainstream import WatchConfig
+    from mythril_tpu.ethereum.interface.rpc.exceptions import (
+        RpcErrorResponse,
+    )
+
+    def _sha(text):
+        return "0x" + _hashlib.sha256(text.encode()).hexdigest()
+
+    class _Chain:
+        def __init__(self):
+            self.blocks, self.codes, self.receipts = [], {}, {}
+            self.add_block()
+
+        def add_block(self, deployments=(), salt="main"):
+            number = len(self.blocks)
+            parent = (
+                self.blocks[-1]["hash"] if self.blocks
+                else "0x" + "0" * 64
+            )
+            txs = []
+            for i, (address, code_hex) in enumerate(deployments):
+                txh = _sha(f"tx:{number}:{i}:{salt}")
+                txs.append({"hash": txh, "to": None, "input": "0x"})
+                self.receipts[txh] = {"contractAddress": address}
+                self.codes[address.lower()] = "0x" + code_hex
+            self.blocks.append({
+                "number": hex(number),
+                "hash": _sha(f"block:{number}:{salt}"),
+                "parentHash": parent,
+                "transactions": txs,
+            })
+
+    class _Client:
+        def __init__(self, chain):
+            self.chain = chain
+
+        def eth_blockNumber(self, timeout_s=None):
+            return len(self.chain.blocks) - 1
+
+        def eth_getBlockByNumber(self, block, tx_objects=True,
+                                 timeout_s=None):
+            number = block if isinstance(block, int) else int(block, 16)
+            if 0 <= number < len(self.chain.blocks):
+                return self.chain.blocks[number]
+            raise RpcErrorResponse(-32001, "unknown block")
+
+        def eth_getTransactionReceipt(self, tx_hash, timeout_s=None):
+            return self.chain.receipts[tx_hash]
+
+        def eth_getCode(self, address, default_block="latest",
+                        timeout_s=None):
+            return self.chain.codes.get(address.lower(), "0x")
+
+    chain = _Chain()
+    pool = RpcPool([RpcEndpoint("e0", _Client(chain), retries=0)])
+    state = tempfile.mkdtemp(prefix="myth-bench-stream-")
+    watcher = ChainWatcher(
+        pool, state,
+        config=WatchConfig(start_block=0, backfill_batch=8),
+    )
+    watcher.tick()  # genesis + static-layer warmup off the clock
+
+    # -- ingest burst: every deployment a DISTINCT bytecode ------------
+    n_contracts = 0
+    for b in range(blocks):
+        deployments = []
+        for j in range(per_block):
+            i = b * per_block + j
+            # PUSH1 i PUSH1 0 SSTORE CALLER SELFDESTRUCT — distinct
+            # code hash per contract, module-applicable (survivor)
+            code = f"60{i % 256:02x}60005533ff"
+            deployments.append((_sha(f"bench-dep:{i}")[:42], code))
+            n_contracts += 1
+        chain.add_block(deployments=deployments)
+    lag_max = 0
+    t0 = time.perf_counter()
+    while watcher.head_lag() != 0 or watcher.head != len(chain.blocks) - 1:
+        watcher.tick()
+        lag_max = max(lag_max, watcher.head_lag() or 0)
+    ingest_wall = time.perf_counter() - t0
+    latencies = sorted(
+        a.latency_s for a in watcher.alerts.alerts()
+        if a.latency_s is not None
+    )
+
+    # -- 3-block reorg recovery ----------------------------------------
+    chain.blocks = chain.blocks[:-3]
+    for _ in range(4):  # the fork wins by one
+        chain.add_block(salt="fork")
+    t0 = time.perf_counter()
+    while (
+        watcher.cursor.tip() is None
+        or watcher.cursor.tip().block_hash != chain.blocks[-1]["hash"]
+    ):
+        watcher.tick()
+    reorg_wall = time.perf_counter() - t0
+    watcher.close()
+    out = {
+        "ingest_static_rate": (
+            round(n_contracts / ingest_wall, 1) if ingest_wall else None
+        ),
+        "alert_p50_s": (
+            round(statistics.median(latencies), 6) if latencies else None
+        ),
+        "head_lag_blocks_max": lag_max,
+        "reorg_recovery_s": round(reorg_wall, 6),
+        "chainstream_reorgs": watcher.reorgs,
+    }
+    print(f"bench: chainstream leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -1381,6 +1514,12 @@ def main(final_attempt: bool = False) -> None:
         "fleet_throughput_scale": None,
         "fleet_failover_p50_s": None,
         "fleet_reroute_dedup_rate": None,
+        # chain-head streaming scorecard (ISSUE 16): the chainstream
+        # leg fills these; None = the leg never ran
+        "alert_p50_s": None,
+        "head_lag_blocks_max": None,
+        "reorg_recovery_s": None,
+        "ingest_static_rate": None,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1417,6 +1556,16 @@ def main(final_attempt: bool = False) -> None:
         print("bench: journal leg done", file=sys.stderr)
     except Exception as e:
         print(f"bench: journal leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        record.update(
+            _with_deadline(bench_chainstream, 120)
+        )
+        print("bench: chainstream leg done", file=sys.stderr)
+    except _Deadline:
+        print("bench: chainstream leg hit its deadline", file=sys.stderr)
+    except Exception as e:
+        print(f"bench: chainstream leg failed: {e!r}", file=sys.stderr)
 
     if _budget_left() > 240 and not os.environ.get(
         "MYTHRIL_BENCH_NO_FLEET"

@@ -1,0 +1,64 @@
+"""Reorg-safe chain-head streaming into the warm fleet (`myth watch`).
+
+The package turns the scan-era pull model (a user submits bytecode)
+into a push model (the chain head streams deployments at the warm
+service) without giving up any of the serving guarantees:
+
+- `rpcpool`  — multi-endpoint failover with per-endpoint death
+  breakers and quorum-checked head tracking;
+- `cursor`   — the fsync'd (number, hash) journal: crash recovery,
+  parent-hash reorg detection, rollback with a durable orphan record;
+- `triage`   — line-rate static screening + content-derived
+  idempotency keys for the fleet handoff;
+- `alerts`   — append-only alert log with the fired / retracted /
+  superseded lifecycle;
+- `watcher`  — the tick loop tying them together under the PR-12
+  health machine (`rpc-endpoints-down`, `head-lag`,
+  `backfill-saturated` redlines).
+"""
+
+from mythril_tpu.chainstream.alerts import (
+    ALERT_STATUSES,
+    Alert,
+    AlertSink,
+    alert_id_for,
+)
+from mythril_tpu.chainstream.cursor import (
+    CursorEntry,
+    CursorJournal,
+    replay_dir,
+)
+from mythril_tpu.chainstream.rpcpool import (
+    AllEndpointsDown,
+    RpcEndpoint,
+    RpcPool,
+)
+from mythril_tpu.chainstream.triage import (
+    StaticTriage,
+    TriageVerdict,
+    idempotency_key_for,
+)
+from mythril_tpu.chainstream.watcher import (
+    ChainWatcher,
+    WatchConfig,
+    chainstream_objectives,
+)
+
+__all__ = [
+    "ALERT_STATUSES",
+    "Alert",
+    "AlertSink",
+    "AllEndpointsDown",
+    "ChainWatcher",
+    "CursorEntry",
+    "CursorJournal",
+    "RpcEndpoint",
+    "RpcPool",
+    "StaticTriage",
+    "TriageVerdict",
+    "WatchConfig",
+    "alert_id_for",
+    "chainstream_objectives",
+    "idempotency_key_for",
+    "replay_dir",
+]

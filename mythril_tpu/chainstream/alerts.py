@@ -1,0 +1,340 @@
+"""Append-only alert log with a reorg-aware lifecycle.
+
+An alert in a chain-head stream is not a one-shot print: the block
+that produced it can be orphaned minutes later, and the static-tier
+verdict that fired it can be refined by the fleet's full analysis.
+So every alert is an append-only record stream with three lifecycle
+events:
+
+  fired       the static triage (or a fleet verdict) flagged a fresh
+              deployment/upgrade; carries the content-derived alert
+              id, the block coordinates, the findings, and the
+              block-seen -> fired latency the SLO gates on
+  retracted   the alert's block was orphaned by a reorg — consumers
+              must treat the alert as if it never happened (the
+              contract may not exist on the canonical chain)
+  superseded  the fleet's full tier-ladder verdict replaced the
+              static-tier findings (deeper evidence, either way)
+
+Alert ids are content-derived — ``sha256(codehash:block_hash)`` — so
+at-least-once redelivery after a crash (`--recover` re-ingests the
+cursor tip) maps onto the SAME id and `fire` dedupes instead of
+double-alerting: the no-duplicate-side-effects half of the recovery
+contract.
+
+Series: ``mtpu_chainstream_alerts_total{status}`` and the
+``mtpu_chainstream_alert_latency_seconds`` histogram (fired alerts
+only — the p50 the bench leg and the block-time SLO read).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+ALERT_SCHEMA_VERSION = 1
+
+STATUS_FIRED = "fired"
+STATUS_RETRACTED = "retracted"
+STATUS_SUPERSEDED = "superseded"
+ALERT_STATUSES = (STATUS_FIRED, STATUS_RETRACTED, STATUS_SUPERSEDED)
+
+
+def alert_id_for(code_hash: str, block_hash: str) -> str:
+    """Content-derived id: the same (code, block) redelivered after a
+    crash or a failover maps to the same alert."""
+    return hashlib.sha256(
+        f"{code_hash}:{block_hash}".encode()
+    ).hexdigest()[:24]
+
+
+class Alert:
+    """One alert's live state (the log holds its event history)."""
+
+    __slots__ = (
+        "id", "code_hash", "address", "block_number", "block_hash",
+        "kind", "source", "findings", "status", "fired_t", "latency_s",
+    )
+
+    def __init__(
+        self,
+        alert_id: str,
+        code_hash: str,
+        address: str,
+        block_number: int,
+        block_hash: str,
+        kind: str,
+        source: str,
+        findings: List[str],
+        latency_s: Optional[float] = None,
+    ) -> None:
+        self.id = alert_id
+        self.code_hash = code_hash
+        self.address = address
+        self.block_number = int(block_number)
+        self.block_hash = block_hash
+        self.kind = kind  # "deployment" | "proxy-upgrade"
+        self.source = source  # "static" | "fleet"
+        self.findings = list(findings)
+        self.status = STATUS_FIRED
+        self.fired_t = time.monotonic()
+        self.latency_s = latency_s
+
+    def as_dict(self) -> Dict:
+        return {
+            "alert_id": self.id,
+            "code_hash": self.code_hash,
+            "address": self.address,
+            "block_number": self.block_number,
+            "block_hash": self.block_hash,
+            "kind": self.kind,
+            "source": self.source,
+            "findings": list(self.findings),
+            "status": self.status,
+            "latency_s": self.latency_s,
+        }
+
+
+class AlertSink:
+    """The append half + the in-memory index retraction needs."""
+
+    def __init__(self, path: str, fsync: bool = True) -> None:
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.fsync = fsync
+        self._mu = threading.Lock()
+        self._fp = open(self.path, "a")
+        #: alert id -> Alert (live view over the whole log)
+        self._alerts: Dict[str, Alert] = {}
+        #: block hash -> alert ids fired from that block (retraction)
+        self._by_block: Dict[str, List[str]] = {}
+        self.fired = 0
+        self.retracted = 0
+        self.superseded = 0
+        self.deduped = 0
+        self.errors = 0
+        self.degraded = False
+        self._closed = False
+
+    # -- append --------------------------------------------------------
+    def _append(self, event: str, payload: Dict) -> bool:
+        if self.degraded or self._closed:
+            return False
+        rec = dict(payload)
+        rec["schema"] = ALERT_SCHEMA_VERSION
+        rec["ts"] = time.time()
+        rec["event"] = event
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        try:
+            with self._mu:
+                self._fp.write(line)
+                self._fp.flush()
+                if self.fsync:
+                    os.fsync(self._fp.fileno())
+        except Exception as why:
+            self.errors += 1
+            self.degraded = True
+            log.warning("alert log degraded to non-durable: %s", why)
+            return False
+        return True
+
+    def fire(
+        self,
+        code_hash: str,
+        address: str,
+        block_number: int,
+        block_hash: str,
+        kind: str,
+        findings: List[str],
+        source: str = "static",
+        latency_s: Optional[float] = None,
+    ) -> Alert:
+        """Fire (or dedupe) one alert. A second fire of the same
+        content-derived id — crash redelivery, failover replay — is
+        absorbed: the existing alert is returned and no record is
+        appended, so at-least-once upstream becomes exactly-once in
+        the log."""
+        alert_id = alert_id_for(code_hash, block_hash)
+        with self._mu:
+            known = self._alerts.get(alert_id)
+        if known is not None:
+            self.deduped += 1
+            return known
+        alert = Alert(
+            alert_id, code_hash, address, block_number, block_hash,
+            kind, source, findings, latency_s=latency_s,
+        )
+        self._append(STATUS_FIRED, alert.as_dict())
+        with self._mu:
+            self._alerts[alert_id] = alert
+            self._by_block.setdefault(block_hash, []).append(alert_id)
+        self.fired += 1
+        self._count(STATUS_FIRED)
+        if latency_s is not None:
+            self._observe_latency(latency_s)
+        return alert
+
+    def retract_blocks(
+        self, block_hashes: List[str], reason: str = "reorg"
+    ) -> int:
+        """Retract every FIRED/SUPERSEDED alert from the orphaned
+        blocks (a reorg rolled them off the canonical chain)."""
+        retracted = 0
+        for block_hash in block_hashes:
+            with self._mu:
+                ids = list(self._by_block.get(block_hash) or ())
+            for alert_id in ids:
+                alert = self._alerts.get(alert_id)
+                if alert is None or alert.status == STATUS_RETRACTED:
+                    continue
+                alert.status = STATUS_RETRACTED
+                self._append(STATUS_RETRACTED, {
+                    "alert_id": alert_id,
+                    "block_hash": block_hash,
+                    "reason": reason,
+                })
+                retracted += 1
+                self.retracted += 1
+                self._count(STATUS_RETRACTED)
+        return retracted
+
+    def supersede(
+        self, alert_id: str, findings: List[str], source: str = "fleet"
+    ) -> Optional[Alert]:
+        """Replace an alert's static-tier findings with the fleet's
+        full verdict. A retracted alert stays retracted (its block is
+        gone; a late fleet report must not resurrect it)."""
+        with self._mu:
+            alert = self._alerts.get(alert_id)
+        if alert is None or alert.status == STATUS_RETRACTED:
+            return None
+        alert.status = STATUS_SUPERSEDED
+        alert.findings = list(findings)
+        alert.source = source
+        self._append(STATUS_SUPERSEDED, {
+            "alert_id": alert_id,
+            "findings": list(findings),
+            "source": source,
+        })
+        self.superseded += 1
+        self._count(STATUS_SUPERSEDED)
+        return alert
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._fp.close()
+                except OSError:
+                    pass
+
+    # -- reads ---------------------------------------------------------
+    def get(self, alert_id: str) -> Optional[Alert]:
+        with self._mu:
+            return self._alerts.get(alert_id)
+
+    def alerts(self, status: Optional[str] = None) -> List[Alert]:
+        with self._mu:
+            rows = list(self._alerts.values())
+        if status is not None:
+            rows = [a for a in rows if a.status == status]
+        return rows
+
+    # -- recovery ------------------------------------------------------
+    def recover(self) -> int:
+        """Rebuild the live index from the log (called before any
+        fire on `--recover`): fired -> indexed, retracted/superseded
+        -> status replayed. Returns the number of alerts indexed."""
+        try:
+            with open(self.path) as fp:
+                lines = fp.read().splitlines()
+        except OSError:
+            return 0
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError
+                if int(rec.get("schema", 1)) > ALERT_SCHEMA_VERSION:
+                    raise ValueError
+            except ValueError:
+                break  # torn tail: everything after is suspect
+            event = rec.get("event")
+            alert_id = rec.get("alert_id")
+            if event == STATUS_FIRED and alert_id:
+                alert = Alert(
+                    alert_id,
+                    rec.get("code_hash") or "",
+                    rec.get("address") or "",
+                    rec.get("block_number") or 0,
+                    rec.get("block_hash") or "",
+                    rec.get("kind") or "deployment",
+                    rec.get("source") or "static",
+                    rec.get("findings") or [],
+                    latency_s=rec.get("latency_s"),
+                )
+                with self._mu:
+                    self._alerts[alert_id] = alert
+                    self._by_block.setdefault(
+                        alert.block_hash, []
+                    ).append(alert_id)
+            elif event == STATUS_RETRACTED and alert_id:
+                alert = self._alerts.get(alert_id)
+                if alert is not None:
+                    alert.status = STATUS_RETRACTED
+            elif event == STATUS_SUPERSEDED and alert_id:
+                alert = self._alerts.get(alert_id)
+                if alert is not None and alert.status != STATUS_RETRACTED:
+                    alert.status = STATUS_SUPERSEDED
+                    alert.findings = list(rec.get("findings") or [])
+                    alert.source = rec.get("source") or alert.source
+        with self._mu:
+            return len(self._alerts)
+
+    # -- telemetry ------------------------------------------------------
+    def _count(self, status: str) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_chainstream_alerts_total",
+                "chainstream alert lifecycle events, by status",
+            ).labels(status=status).inc()
+        except Exception:
+            pass
+
+    def _observe_latency(self, seconds: float) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().histogram(
+                "mtpu_chainstream_alert_latency_seconds",
+                "block first seen to alert fired (the block-time SLO "
+                "input)",
+            ).observe(seconds)
+        except Exception:
+            pass
+
+    def stats(self) -> Dict:
+        with self._mu:
+            live = len(self._alerts)
+        return {
+            "path": self.path,
+            "fired": self.fired,
+            "retracted": self.retracted,
+            "superseded": self.superseded,
+            "deduped": self.deduped,
+            "tracked": live,
+            "errors": self.errors,
+            "degraded": self.degraded,
+        }

@@ -1,0 +1,321 @@
+"""Reorg-safe, crash-safe chain cursor: the fsync'd journal of where
+the stream is and which block hashes it believed on the way there.
+
+Same WAL discipline as `service/journal.py` (append-only fsync'd
+jsonl segments, fresh segment per writer, torn-tail tolerance,
+compaction after recovery), specialized to the chain-head stream:
+
+- every `advance` appends ``(block_number, block_hash, parent_hash)``
+  and fsyncs BEFORE the block's results are surfaced — a crash
+  between the append and the surface redelivers the tip block on
+  `--recover` (at-least-once; content-derived idempotency keys and
+  the verdict store make the redelivery settle in microseconds);
+- the in-memory tail keeps the last `max_depth` entries — the hash
+  chain reorg detection walks: an incoming block whose parent hash
+  does not match the recorded tip means the chain forked under us,
+  and the common ancestor is found against exactly this tail;
+- `rollback_to` truncates the tail and appends a fsync'd ``rollback``
+  record with the orphaned entries, so recovery after a crash
+  mid-reorg replays the SAME world view — orphaned block hashes are
+  never silently re-trusted;
+- replay rebuilds the tail from the records in order (rollbacks
+  re-truncate during replay), so the recovered cursor is exactly the
+  pre-crash cursor.
+
+Like the job journal, a failed append degrades the cursor to
+non-durable rather than stalling the stream; the degradation is
+honestly reported in `stats()` and the watcher's health payload.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+CURSOR_SCHEMA_VERSION = 1
+
+EVENT_ADVANCE = "advance"
+EVENT_ROLLBACK = "rollback"
+EVENT_DRAIN = "drain"
+
+_SEGMENT_RE = re.compile(r"^cursor-(\d{6})\.jsonl$")
+
+
+class CursorEntry:
+    """One believed (number, hash) link of the followed chain."""
+
+    __slots__ = ("number", "block_hash", "parent_hash")
+
+    def __init__(self, number: int, block_hash: str,
+                 parent_hash: Optional[str] = None) -> None:
+        self.number = int(number)
+        self.block_hash = block_hash
+        self.parent_hash = parent_hash
+
+    def as_dict(self) -> Dict:
+        return {
+            "number": self.number,
+            "hash": self.block_hash,
+            "parent": self.parent_hash,
+        }
+
+
+class CursorJournal:
+    """Append half + in-memory tail chain + replay."""
+
+    def __init__(
+        self,
+        directory: str,
+        fsync: bool = True,
+        max_depth: int = 64,
+    ) -> None:
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.fsync = fsync
+        #: how deep a reorg the tail can resolve; deeper ones force a
+        #: full resync from (head - max_depth)
+        self.max_depth = max(2, int(max_depth))
+        self._mu = threading.Lock()
+        self._chain: "Deque[CursorEntry]" = deque(maxlen=self.max_depth)
+        self._prior = self._existing_segments()
+        serial = 1
+        if self._prior:
+            serial = (
+                int(_SEGMENT_RE.match(
+                    os.path.basename(self._prior[-1])
+                ).group(1))
+                + 1
+            )
+        self.path = os.path.join(self.dir, f"cursor-{serial:06d}.jsonl")
+        self._fp = open(self.path, "a")
+        self.appends = 0
+        self.errors = 0
+        self.degraded = False
+        self.rollbacks = 0
+        self.clean_shutdown: Optional[bool] = None
+        self._closed = False
+
+    # -- segments ------------------------------------------------------
+    def _existing_segments(self) -> List[str]:
+        try:
+            names = sorted(
+                n for n in os.listdir(self.dir) if _SEGMENT_RE.match(n)
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    # -- append half ---------------------------------------------------
+    def _append(self, event: str, **fields) -> bool:
+        if self.degraded or self._closed:
+            return False
+        rec = dict(fields)
+        rec["schema"] = CURSOR_SCHEMA_VERSION
+        rec["ts"] = time.time()
+        rec["event"] = event
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        try:
+            with self._mu:
+                from mythril_tpu.support.resilience import inject
+
+                inject("chainstream.cursor.write")
+                self._fp.write(line)
+                self._fp.flush()
+                if self.fsync:
+                    os.fsync(self._fp.fileno())
+        except Exception as why:
+            self.errors += 1
+            self.degraded = True
+            log.warning("cursor journal degraded to non-durable: %s", why)
+            return False
+        self.appends += 1
+        return True
+
+    def advance(self, number: int, block_hash: str,
+                parent_hash: Optional[str] = None) -> bool:
+        """Record one accepted block. MUST be called before the
+        block's results are surfaced — the at-least-once contract
+        hangs on the cursor never trailing the side effects."""
+        entry = CursorEntry(number, block_hash, parent_hash)
+        durable = self._append(
+            EVENT_ADVANCE,
+            number=entry.number,
+            hash=entry.block_hash,
+            parent=entry.parent_hash,
+        )
+        with self._mu:
+            self._chain.append(entry)
+        return durable
+
+    def rollback_to(self, number: int) -> List[CursorEntry]:
+        """Truncate the tail back to `number` (the common ancestor);
+        returns the ORPHANED entries, newest last. The rollback record
+        is fsync'd before the orphans are returned, so alert
+        retraction never outruns the durable cursor."""
+        with self._mu:
+            orphaned: List[CursorEntry] = []
+            while self._chain and self._chain[-1].number > number:
+                orphaned.append(self._chain.pop())
+            orphaned.reverse()
+        if orphaned:
+            self.rollbacks += 1
+            self._append(
+                EVENT_ROLLBACK,
+                to_number=number,
+                depth=len(orphaned),
+                orphaned=[e.as_dict() for e in orphaned],
+            )
+        return orphaned
+
+    def mark_drain(self) -> bool:
+        return self._append(EVENT_DRAIN)
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._fp.close()
+                except OSError:
+                    pass
+
+    # -- reads ---------------------------------------------------------
+    def tip(self) -> Optional[CursorEntry]:
+        with self._mu:
+            return self._chain[-1] if self._chain else None
+
+    def entry_at(self, number: int) -> Optional[CursorEntry]:
+        with self._mu:
+            for entry in reversed(self._chain):
+                if entry.number == number:
+                    return entry
+                if entry.number < number:
+                    break
+        return None
+
+    def chain(self) -> List[CursorEntry]:
+        with self._mu:
+            return list(self._chain)
+
+    # -- replay half ---------------------------------------------------
+    def recover(self) -> Dict:
+        """Replay every prior segment into the in-memory tail, then
+        compact: the recovered chain is re-journaled into the fresh
+        segment and the old files unlinked. Returns recovery facts
+        (records, torn lines, clean_shutdown, tip)."""
+        facts = replay_segments(self._prior, max_depth=self.max_depth)
+        with self._mu:
+            self._chain = facts["chain"]
+        self.clean_shutdown = facts["clean_shutdown"]
+        for entry in list(facts["chain"]):
+            self._append(
+                EVENT_ADVANCE,
+                number=entry.number,
+                hash=entry.block_hash,
+                parent=entry.parent_hash,
+            )
+        removed = 0
+        for path in self._prior:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                continue
+        self._prior = []
+        tip = self.tip()
+        return {
+            "records": facts["records"],
+            "torn_lines": facts["torn_lines"],
+            "clean_shutdown": facts["clean_shutdown"],
+            "rollbacks": facts["rollbacks"],
+            "compacted_segments": removed,
+            "tip": tip.as_dict() if tip else None,
+        }
+
+    def stats(self) -> Dict:
+        tip = self.tip()
+        return {
+            "dir": self.dir,
+            "segment": os.path.basename(self.path),
+            "appends": self.appends,
+            "errors": self.errors,
+            "degraded": self.degraded,
+            "rollbacks": self.rollbacks,
+            "depth": len(self._chain),
+            "max_depth": self.max_depth,
+            "tip": tip.as_dict() if tip else None,
+            "fsync": self.fsync,
+        }
+
+
+def replay_segments(paths: List[str], max_depth: int = 64) -> Dict:
+    """Parse cursor segments in order, tolerating torn tail lines and
+    refusing newer-schema records (same rules as the job journal)."""
+    chain: "Deque[CursorEntry]" = deque(maxlen=max_depth)
+    records = torn = rollbacks = 0
+    clean = False
+    for path in paths:
+        try:
+            with open(path) as fp:
+                lines = fp.read().splitlines()
+        except OSError as why:
+            log.warning("cursor segment %s unreadable: %s", path, why)
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError("record is not an object")
+                if int(rec.get("schema", 1)) > CURSOR_SCHEMA_VERSION:
+                    raise ValueError("record schema newer than reader")
+            except ValueError:
+                torn += 1
+                log.warning(
+                    "cursor segment %s: torn record, stopping the "
+                    "segment here", path,
+                )
+                break
+            records += 1
+            event = rec.get("event")
+            clean = event == EVENT_DRAIN
+            if event == EVENT_ADVANCE:
+                chain.append(CursorEntry(
+                    rec["number"], rec["hash"], rec.get("parent")
+                ))
+            elif event == EVENT_ROLLBACK:
+                rollbacks += 1
+                to_number = int(rec.get("to_number", -1))
+                while chain and chain[-1].number > to_number:
+                    chain.pop()
+    return {
+        "chain": chain,
+        "records": records,
+        "torn_lines": torn,
+        "rollbacks": rollbacks,
+        "clean_shutdown": clean,
+    }
+
+
+def replay_dir(directory: str, max_depth: int = 64) -> Dict:
+    """Read-only replay of every segment under `directory` (tools and
+    tests; the watcher goes through CursorJournal.recover)."""
+    directory = os.path.abspath(directory)
+    try:
+        names = sorted(
+            n for n in os.listdir(directory) if _SEGMENT_RE.match(n)
+        )
+    except OSError:
+        return replay_segments([])
+    return replay_segments(
+        [os.path.join(directory, n) for n in names], max_depth=max_depth
+    )

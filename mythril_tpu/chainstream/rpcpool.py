@@ -1,0 +1,299 @@
+"""Hardened multi-endpoint RPC client pool for chain-head streaming.
+
+The fault domain here is OUTSIDE the process: execution-client RPC
+endpoints drop connections, lag behind the chain head, lie briefly
+during reorgs, and rate-limit. One endpoint must never be able to
+stall or fork the stream, so the pool wraps N `EthJsonRpc`-shaped
+clients with the same machinery the fleet front wraps replicas in:
+
+- a per-endpoint **death breaker** (`support/breaker.py`, tier name
+  ``rpc:<name>`` — its state rides /metrics as
+  ``mtpu_breaker_state{tier="rpc:<name>"}``), fed ONLY by transport
+  failures (`RpcTransportError`): an in-band JSON-RPC error means the
+  endpoint is alive and must not count toward death;
+- **bounded per-request cost** — every call carries the client's
+  request timeout plus a capped-exponential retry ladder per
+  endpoint, then fails over to the next endpoint healthiest-first;
+- **quorum-checked head tracking** — `poll_heads()` asks every
+  breaker-admitted endpoint for its head; the consensus head is the
+  `quorum`-th highest live answer, so a stalled or lagging endpoint
+  cannot drag the stream backward and a single lying endpoint cannot
+  fork it forward past quorum. (The hash-chain check in
+  `chainstream/cursor.py` is the second fork defense: a head that
+  does not link onto the cursor's recorded parent hash is treated as
+  a reorg and cross-checked block by block.)
+
+All endpoints dead -> `AllEndpointsDown`, which the watcher folds
+into the ``rpc-endpoints-down`` redline.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from mythril_tpu.ethereum.interface.rpc.client import EthJsonRpc
+from mythril_tpu.ethereum.interface.rpc.exceptions import (
+    EthJsonRpcError,
+    RpcErrorResponse,
+    RpcTransportError,
+)
+from mythril_tpu.support.breaker import STATE_OPEN, CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+
+class AllEndpointsDown(EthJsonRpcError):
+    """No breaker-admitted endpoint delivered an answer: the stream
+    is stalled on the outside world (the `rpc-endpoints-down`
+    redline)."""
+
+
+class RpcEndpoint:
+    """One execution-client endpoint: client + death breaker + head
+    tracking."""
+
+    def __init__(
+        self,
+        name: str,
+        client,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+        max_backoff_s: float = 1.0,
+        failure_threshold: int = 3,
+        recovery_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.client = client
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._clock = clock
+        #: the same three-state machine the fleet wraps replicas in;
+        #: the tier name lands on /metrics and in open_reasons()
+        self.breaker = CircuitBreaker(
+            f"rpc:{name}",
+            failure_threshold=failure_threshold,
+            recovery_s=recovery_s,
+            clock=clock,
+        )
+        self.head: Optional[int] = None
+        self.head_t: Optional[float] = None
+        self.calls = 0
+        self.transport_failures = 0
+        self.rpc_errors = 0
+
+    @property
+    def alive(self) -> bool:
+        return self.breaker.state != STATE_OPEN
+
+    def call(self, method: str, *params, timeout_s=None):
+        """One RPC through this endpoint with the capped-exponential
+        retry ladder. Transport failures feed the breaker; in-band
+        RPC errors do not (the endpoint answered)."""
+        delay = self.backoff_s
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            self.calls += 1
+            try:
+                fn = getattr(self.client, method)
+                result = fn(*params, timeout_s=timeout_s)
+            except RpcErrorResponse as why:
+                self.rpc_errors += 1
+                self.breaker.record_success()  # alive, just unhelpful
+                raise
+            except RpcTransportError as why:
+                self.transport_failures += 1
+                self.breaker.record_failure(f"{method}: {why}")
+                last = why
+                if attempt >= self.retries or not self.breaker.allow():
+                    break
+                time.sleep(delay)
+                delay = min(delay * 2.0, self.max_backoff_s)
+                continue
+            self.breaker.record_success()
+            return result
+        raise last if last is not None else AllEndpointsDown(self.name)
+
+    def stats(self) -> Dict:
+        return {
+            "name": self.name,
+            "alive": self.alive,
+            "head": self.head,
+            "calls": self.calls,
+            "transport_failures": self.transport_failures,
+            "rpc_errors": self.rpc_errors,
+            "breaker": self.breaker.stats(),
+        }
+
+
+class RpcPool:
+    """Failover + quorum head tracking over N endpoints."""
+
+    def __init__(
+        self,
+        endpoints: List[RpcEndpoint],
+        quorum: int = 1,
+    ) -> None:
+        if not endpoints:
+            raise ValueError("the pool needs at least one RPC endpoint")
+        self.endpoints = list(endpoints)
+        #: how many live endpoints must be AT OR PAST a height before
+        #: it counts as the consensus head (clamped to the live count
+        #: so a degraded pool keeps streaming on what survives)
+        self.quorum = max(1, int(quorum))
+        self._mu = threading.Lock()
+        self.head_polls = 0
+        self.failovers = 0
+
+    @classmethod
+    def from_urls(
+        cls,
+        urls: List[str],
+        timeout_s: float = 5.0,
+        quorum: int = 1,
+        failure_threshold: int = 3,
+        recovery_s: float = 5.0,
+    ) -> "RpcPool":
+        endpoints = [
+            RpcEndpoint(
+                f"e{i}",
+                EthJsonRpc.from_url(url, timeout_s=timeout_s),
+                failure_threshold=failure_threshold,
+                recovery_s=recovery_s,
+            )
+            for i, url in enumerate(urls)
+        ]
+        return cls(endpoints, quorum=quorum)
+
+    # -- head tracking -------------------------------------------------
+    def poll_heads(self) -> Optional[int]:
+        """One sweep of eth_blockNumber over the breaker-admitted
+        endpoints; returns the consensus head (None while nobody
+        answers). Exports per-endpoint up/head gauges."""
+        with self._mu:
+            self.head_polls += 1
+        heads: List[int] = []
+        for ep in self.endpoints:
+            if not ep.breaker.allow():
+                continue
+            try:
+                ep.head = int(ep.call("eth_blockNumber"))
+                ep.head_t = time.monotonic()
+                heads.append(ep.head)
+            except EthJsonRpcError:
+                continue
+        self._export_gauges()
+        if not heads:
+            return None
+        heads.sort(reverse=True)
+        # the quorum-th highest live answer: one endpoint racing ahead
+        # (or lying) cannot move the consensus past what `quorum`
+        # endpoints confirm; one lagging endpoint cannot hold it back
+        return heads[min(self.quorum, len(heads)) - 1]
+
+    def up_count(self) -> int:
+        return sum(1 for ep in self.endpoints if ep.alive)
+
+    def open_reasons(self) -> List[str]:
+        """`breaker-open:rpc:<name>` per dead endpoint (the health
+        payload's per-endpoint detail under the pool-level
+        `rpc-endpoints-down` redline)."""
+        return [
+            f"breaker-open:rpc:{ep.name}"
+            for ep in self.endpoints
+            if not ep.alive
+        ]
+
+    # -- failover calls ------------------------------------------------
+    def _order(self) -> List[RpcEndpoint]:
+        """Breaker-admitted endpoints, freshest head first (the
+        endpoint most likely to know about the block being asked
+        for), dead ones excluded."""
+        rows = [ep for ep in self.endpoints if ep.breaker.allow()]
+        return sorted(
+            rows,
+            key=lambda ep: (-(ep.head or 0), ep.transport_failures),
+        )
+
+    def call(self, method: str, *params, timeout_s=None):
+        """Route one RPC to the healthiest endpoint, failing over on
+        transport errors. An in-band `RpcErrorResponse` is retried on
+        the next endpoint too (one node's 'unknown block' is often
+        another's lag), but if EVERY endpoint answers with an error
+        the LAST one propagates — the method itself is the problem."""
+        last: Optional[Exception] = None
+        candidates = self._order()
+        for i, ep in enumerate(candidates):
+            try:
+                result = ep.call(method, *params, timeout_s=timeout_s)
+                if i > 0:
+                    with self._mu:
+                        self.failovers += 1
+                return result
+            except (RpcTransportError, RpcErrorResponse) as why:
+                last = why
+                continue
+        if isinstance(last, RpcErrorResponse):
+            raise last
+        raise AllEndpointsDown(
+            f"{method}: no live endpoint answered "
+            f"({len(candidates)} admitted, last: {last})"
+        )
+
+    # -- the chainstream surface ---------------------------------------
+    def get_block(self, number: int, tx_objects: bool = True):
+        """Block `number` with transactions, or None when no endpoint
+        knows it yet (the head raced ahead of propagation — the
+        caller just waits a tick)."""
+        try:
+            return self.call(
+                "eth_getBlockByNumber", number, tx_objects
+            )
+        except RpcErrorResponse:
+            return None
+
+    def get_code(self, address: str) -> Optional[bytes]:
+        code = self.call("eth_getCode", address)
+        if not code or code == "0x":
+            return None
+        return bytes.fromhex(code[2:] if code.startswith("0x") else code)
+
+    def get_receipt(self, tx_hash: str):
+        try:
+            return self.call("eth_getTransactionReceipt", tx_hash)
+        except RpcErrorResponse:
+            return None
+
+    # -- telemetry ------------------------------------------------------
+    def _export_gauges(self) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            reg = registry()
+            up = reg.gauge(
+                "mtpu_chainstream_endpoint_up",
+                "1 while the RPC endpoint's death breaker is not open",
+            )
+            head = reg.gauge(
+                "mtpu_chainstream_endpoint_head",
+                "last chain head reported by the RPC endpoint",
+            )
+            for ep in self.endpoints:
+                up.labels(endpoint=ep.name).set(1.0 if ep.alive else 0.0)
+                if ep.head is not None:
+                    head.labels(endpoint=ep.name).set(float(ep.head))
+        except Exception:  # telemetry must never sink the stream
+            pass
+
+    def stats(self) -> Dict:
+        return {
+            "endpoints": [ep.stats() for ep in self.endpoints],
+            "up": self.up_count(),
+            "quorum": self.quorum,
+            "head_polls": self.head_polls,
+            "failovers": self.failovers,
+        }

@@ -1,0 +1,156 @@
+"""Line-rate static triage for the ingest path.
+
+Every fresh deployment (and proxy-upgrade implementation) pulled off
+the chain head goes through the SAME host-side static ladder the
+service runs at admission — `analysis/static/summary_for` (CFG +
+dataflow + taint + screen), cached by code hash — but here it serves
+a different master: the cursor must keep pace with block production
+even when a burst lands hundreds of deployments in one tick. So the
+triage verdict is computed inline (pure host work, microseconds to
+low milliseconds per contract) and decides three things:
+
+- **findings now**: the applicable-module list IS the static-tier
+  alert payload — what could fire on this bytecode;
+- **survivor or settled**: `static_answerable` code (the semantic
+  screen proves no module can fire) is settled at line rate and
+  never reaches the fleet;
+- **idempotency key**: content-derived — ``chainstream:<codehash>``
+  — so the same bytecode seen twice (redeploys, crash redelivery,
+  reorg re-ingest, two proxies upgrading to one implementation) maps
+  to ONE fleet job, and the fleet-shared verdict store turns the
+  duplicate into an instant-tier settle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def code_hash_of(code: bytes) -> str:
+    """Same content hash the service engine keys its CodeCache and
+    verdict store on (sha256 hex) — the triage key, the idempotency
+    key, and the store key must all agree."""
+    return hashlib.sha256(code).hexdigest()
+
+
+def idempotency_key_for(code_hash: str) -> str:
+    """Content-derived fleet idempotency key: one logical job per
+    distinct bytecode, however many times the stream surfaces it."""
+    return f"chainstream:{code_hash}"
+
+
+class TriageVerdict:
+    """The ingest-path decision for one contract."""
+
+    __slots__ = (
+        "code_hash", "findings", "survivor", "idempotency_key",
+        "static_answerable", "incomplete", "elapsed_s",
+    )
+
+    def __init__(
+        self,
+        code_hash: str,
+        findings: List[str],
+        survivor: bool,
+        static_answerable: bool,
+        incomplete: bool,
+        elapsed_s: float,
+    ) -> None:
+        self.code_hash = code_hash
+        self.findings = list(findings)
+        self.survivor = survivor
+        self.static_answerable = static_answerable
+        self.incomplete = incomplete
+        self.elapsed_s = elapsed_s
+        self.idempotency_key = idempotency_key_for(code_hash)
+
+    def as_dict(self) -> Dict:
+        return {
+            "code_hash": self.code_hash,
+            "findings": list(self.findings),
+            "survivor": self.survivor,
+            "static_answerable": self.static_answerable,
+            "incomplete": self.incomplete,
+            "elapsed_s": self.elapsed_s,
+        }
+
+
+class StaticTriage:
+    """summary_for over the stream, with a seen-codehash shortcut.
+
+    The lru inside `summary_for` already dedupes by code content;
+    the extra `_seen` map here keeps the VERDICT (including the
+    survivor decision) so re-ingest after a reorg or recovery does
+    not even re-enter the static layer."""
+
+    def __init__(self, max_seen: int = 8192) -> None:
+        self.max_seen = int(max_seen)
+        self._seen: Dict[str, TriageVerdict] = {}
+        self.triaged = 0
+        self.settled_static = 0
+        self.survivors = 0
+        self.failures = 0
+
+    def triage(self, code: bytes) -> TriageVerdict:
+        digest = code_hash_of(code)
+        known = self._seen.get(digest)
+        if known is not None:
+            return known
+        started = time.monotonic()
+        try:
+            from mythril_tpu.analysis.static import summary_for
+
+            summary = summary_for(code)
+            applicable, _skipped = summary.applicable_modules()
+            answerable = summary.static_answerable
+            incomplete = bool(summary.incomplete)
+        except Exception as why:
+            # a bytecode the static layer chokes on is by definition
+            # interesting: keep it a survivor with no static findings
+            self.failures += 1
+            log.warning("static triage failed (%s); forwarding", why)
+            applicable, answerable, incomplete = [], False, True
+        verdict = TriageVerdict(
+            digest,
+            findings=applicable,
+            survivor=not answerable,
+            static_answerable=answerable,
+            incomplete=incomplete,
+            elapsed_s=time.monotonic() - started,
+        )
+        self.triaged += 1
+        if answerable:
+            self.settled_static += 1
+        else:
+            self.survivors += 1
+        if len(self._seen) >= self.max_seen:
+            self._seen.clear()  # burst-bounded; summary_for still caches
+        self._seen[digest] = verdict
+        self._count(verdict)
+        return verdict
+
+    def _count(self, verdict: TriageVerdict) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            outcome = "static" if verdict.static_answerable else "survivor"
+            registry().counter(
+                "mtpu_chainstream_triage_total",
+                "chainstream static triage outcomes",
+            ).labels(outcome=outcome).inc()
+        except Exception:
+            pass
+
+    def stats(self) -> Dict:
+        return {
+            "triaged": self.triaged,
+            "settled_static": self.settled_static,
+            "survivors": self.survivors,
+            "failures": self.failures,
+            "seen": len(self._seen),
+        }

@@ -1,0 +1,641 @@
+"""ChainWatcher: the reorg-safe chain-head tick loop.
+
+One `tick()` is the whole ingestion contract, in order:
+
+1. **consensus head** — `RpcPool.poll_heads()` (quorum-checked; a
+   dead or lying endpoint cannot move it);
+2. **backfill** — walk the cursor forward block by block, bounded by
+   `backfill_batch` per tick so one giant gap cannot monopolize a
+   tick; the head-lag gauge is the honest backlog;
+3. **reorg detection** — every fetched block's ``parentHash`` must
+   match the cursor tip's recorded hash. A mismatch means the chain
+   forked under us: walk the canonical chain backward against the
+   cursor tail to the common ancestor, `rollback_to` it (fsync'd
+   BEFORE anything else happens), retract every alert fired from the
+   orphaned blocks, and re-ingest the canonical replacements —
+   content-derived idempotency keys turn the re-ingest of unchanged
+   contracts into dedupes;
+4. **ingest** — `cursor.advance` is fsync'd BEFORE the block's
+   deployments are surfaced (the at-least-once half of the crash
+   contract; `recover()` redelivers the tip block, and the alert
+   sink's content-derived ids absorb the redelivery); then creation
+   transactions (``to == null`` -> receipt ``contractAddress``) and
+   proxy upgrades (``upgradeTo``/``upgradeToAndCall`` selectors) are
+   pulled, `eth_getCode`'d, and static-triaged at line rate;
+5. **alert + submit** — every triaged contract fires a static-tier
+   alert immediately; survivors are submitted to the fleet front
+   under their content-derived idempotency key with deadline-aware
+   shedding — a saturated or dead front degrades the alert to its
+   static-only verdict (counted, never silent) instead of blocking
+   the cursor;
+6. **supersede** — previously submitted fleet jobs are polled; a
+   terminal verdict replaces the static findings on the alert.
+
+Health rides the PR-12 machinery: a `HealthMonitor` with
+chainstream-shaped objectives (alert-latency p50 under the block-time
+budget, shed share) and a saturation_fn emitting the three new
+redlines — ``rpc-endpoints-down``, ``head-lag``,
+``backfill-saturated``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mythril_tpu.chainstream.alerts import AlertSink
+from mythril_tpu.chainstream.cursor import CursorEntry, CursorJournal
+from mythril_tpu.chainstream.rpcpool import AllEndpointsDown, RpcPool
+from mythril_tpu.chainstream.triage import StaticTriage, TriageVerdict
+from mythril_tpu.observe.slo import (
+    REDLINE_BACKFILL_SATURATED,
+    REDLINE_HEAD_LAG,
+    REDLINE_RPC_ENDPOINTS_DOWN,
+    HealthMonitor,
+    Objective,
+    SloEngine,
+)
+
+log = logging.getLogger(__name__)
+
+#: EIP-1967-era proxy upgrade entrypoints; the implementation address
+#: is the first (left-zero-padded) calldata word after the selector
+SELECTOR_UPGRADE_TO = "3659cfe6"  # upgradeTo(address)
+SELECTOR_UPGRADE_TO_AND_CALL = "4f1ef286"  # upgradeToAndCall(address,bytes,..)
+
+KIND_DEPLOYMENT = "deployment"
+KIND_PROXY_UPGRADE = "proxy-upgrade"
+
+
+def _hex_int(value) -> Optional[int]:
+    if value is None:
+        return None
+    if isinstance(value, int):
+        return value
+    try:
+        return int(str(value), 16 if str(value).startswith("0x") else 10)
+    except ValueError:
+        return None
+
+
+def _upgrade_target(calldata: str) -> Optional[str]:
+    """Implementation address out of upgradeTo/upgradeToAndCall
+    calldata, or None when the word is malformed."""
+    data = calldata[2:] if calldata.startswith("0x") else calldata
+    word = data[8:72]  # first 32-byte argument after the selector
+    if len(word) != 64:
+        return None
+    try:
+        int(word, 16)
+    except ValueError:
+        return None
+    return "0x" + word[24:]  # low 20 bytes
+
+
+def chainstream_objectives(alert_budget_s: float) -> List[Objective]:
+    """The watcher's SLO set: alert p50 under the block-time budget,
+    and shedding must stay the exception."""
+    return [
+        Objective(
+            name="alert-latency-p50",
+            kind="latency",
+            metric="mtpu_chainstream_alert_latency_seconds",
+            threshold_s=alert_budget_s,
+            budget=0.5,
+            description=(
+                "half of alerts fire within one block-time budget of "
+                "the block being seen"
+            ),
+            min_events=2,
+        ),
+        Objective(
+            name="survivor-shed-share",
+            kind="ratio",
+            numerator=("mtpu_chainstream_submissions_total",
+                       {"outcome": "shed"}),
+            denominator=("mtpu_chainstream_submissions_total", {}),
+            budget=0.25,
+            description=(
+                "under a quarter of fleet-worthy survivors degraded "
+                "to static-only verdicts"
+            ),
+            min_events=4,
+        ),
+    ]
+
+
+class WatchConfig:
+    """Knobs for one watcher (all have streaming-shaped defaults)."""
+
+    def __init__(
+        self,
+        poll_interval_s: float = 2.0,
+        backfill_batch: int = 16,
+        max_reorg_depth: int = 64,
+        start_block: Optional[int] = None,
+        alert_budget_s: float = 12.0,
+        submit_deadline_s: float = 30.0,
+        submit_budget_s: float = 2.0,
+        head_lag_redline: int = 64,
+        fsync: bool = True,
+    ) -> None:
+        self.poll_interval_s = poll_interval_s
+        #: blocks ingested per tick, max — bounds one tick's latency
+        #: so a deep backfill cannot starve head-following
+        self.backfill_batch = max(1, int(backfill_batch))
+        self.max_reorg_depth = max(2, int(max_reorg_depth))
+        self.start_block = start_block
+        #: the block-time budget the alert-latency p50 is gated on
+        self.alert_budget_s = alert_budget_s
+        #: deadline_s handed to the fleet for survivor jobs
+        self.submit_deadline_s = submit_deadline_s
+        #: wall budget for ONE submit attempt; past it the survivor
+        #: is shed to its static-only verdict (the cursor never waits)
+        self.submit_budget_s = submit_budget_s
+        self.head_lag_redline = max(1, int(head_lag_redline))
+        self.fsync = fsync
+
+
+class ChainWatcher:
+    """The stream: pool + cursor + triage + alerts + fleet front."""
+
+    def __init__(
+        self,
+        pool: RpcPool,
+        state_dir: str,
+        front=None,
+        config: Optional[WatchConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.pool = pool
+        self.config = config or WatchConfig()
+        self.front = front  # ServiceClient-shaped, or None (static-only)
+        self._clock = clock
+        self.state_dir = os.path.abspath(state_dir)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.cursor = CursorJournal(
+            os.path.join(self.state_dir, "cursor"),
+            fsync=self.config.fsync,
+            max_depth=self.config.max_reorg_depth,
+        )
+        self.alerts = AlertSink(
+            os.path.join(self.state_dir, "alerts.jsonl"),
+            fsync=self.config.fsync,
+        )
+        self.triage = StaticTriage()
+        #: fleet job id -> alert id, polled for terminal verdicts
+        self._pending: Dict[str, str] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self.head: Optional[int] = None
+        self.ticks = 0
+        self.blocks_ingested = 0
+        self.reorgs = 0
+        self.deepest_reorg = 0
+        self.submitted = 0
+        self.deduped = 0
+        self.shed = 0
+        self.superseded = 0
+        self.recovered: Optional[Dict] = None
+        self.health = HealthMonitor(
+            slo=SloEngine(
+                objectives=chainstream_objectives(
+                    self.config.alert_budget_s
+                ),
+                clock=clock,
+            ),
+            saturation_fn=self._saturation_reasons,
+        )
+
+    # -- recovery ------------------------------------------------------
+    def recover(self) -> Dict:
+        """Resume a crashed stream: replay the cursor segments and
+        the alert log, then REDELIVER the tip block — a crash between
+        `cursor.advance` and the block's alerts means the tip's side
+        effects may be missing, and at-least-once is the contract.
+        The alert sink's content-derived ids turn an already-complete
+        tip into pure dedupes."""
+        facts = self.cursor.recover()
+        facts["alerts_indexed"] = self.alerts.recover()
+        tip = self.cursor.tip()
+        facts["redelivered"] = False
+        if tip is not None and not facts["clean_shutdown"]:
+            block = self.pool.get_block(tip.number)
+            if block and _same_hash(block.get("hash"), tip.block_hash):
+                self._surface_block(block, self._clock())
+                facts["redelivered"] = True
+            # a tip that no longer matches the canonical chain is a
+            # reorg that happened while we were dead; the first tick's
+            # parent-hash check resolves it through the normal path
+        self.recovered = facts
+        return facts
+
+    # -- the tick ------------------------------------------------------
+    def tick(self) -> Dict:
+        """One full poll-backfill-ingest pass; never raises on
+        outside-world failures (they land in health instead)."""
+        self.ticks += 1
+        head = self.pool.poll_heads()
+        if head is not None:
+            self.head = head
+        tick_facts = {
+            "head": self.head,
+            "ingested": 0,
+            "reorg_depth": 0,
+            "shed": 0,
+        }
+        if self.head is None:
+            self._export_gauges()
+            return tick_facts  # rpc-endpoints-down carries the alarm
+        nxt = self._next_number()
+        budget = self.config.backfill_batch
+        if nxt > self.head:
+            # nothing new to pull — but a same-height reorg replaces
+            # the tip WITHOUT growing the chain, so verify the tip is
+            # still canonical before declaring this tick idle
+            tip = self.cursor.tip()
+            if tip is not None and tip.number <= self.head:
+                try:
+                    canonical = self.pool.get_block(tip.number)
+                except AllEndpointsDown:
+                    canonical = None  # the redline carries the alarm
+                if canonical is not None and not _same_hash(
+                    canonical.get("hash"), tip.block_hash
+                ):
+                    depth = self._handle_reorg(canonical)
+                    tick_facts["reorg_depth"] = depth
+                    nxt = self._next_number()
+        while budget > 0 and nxt <= self.head:
+            try:
+                block = self.pool.get_block(nxt)
+            except AllEndpointsDown:
+                break  # the rpc-endpoints-down redline carries the alarm
+            if block is None:
+                break  # head outran propagation; next tick catches up
+            tip = self.cursor.tip()
+            if tip is not None and not _same_hash(
+                block.get("parentHash"), tip.block_hash
+            ):
+                depth = self._handle_reorg(block)
+                tick_facts["reorg_depth"] = max(
+                    tick_facts["reorg_depth"], depth
+                )
+                nxt = self._next_number()
+                budget -= 1
+                continue
+            self._ingest_block(block)
+            tick_facts["ingested"] += 1
+            nxt += 1
+            budget -= 1
+        shed_before = self.shed
+        self._poll_pending()
+        tick_facts["shed"] = self.shed - shed_before
+        self._export_gauges()
+        try:
+            self.health.sample()
+        except Exception:  # telemetry never sinks the stream
+            pass
+        return tick_facts
+
+    def _next_number(self) -> int:
+        tip = self.cursor.tip()
+        if tip is not None:
+            return tip.number + 1
+        if self.config.start_block is not None:
+            return int(self.config.start_block)
+        return self.head if self.head is not None else 0
+
+    # -- reorg ---------------------------------------------------------
+    def _handle_reorg(self, block: Dict) -> int:
+        """`block`'s parent does not link onto the cursor tip: find
+        the common ancestor by walking the CANONICAL chain backward
+        against the recorded tail, then rollback + retract. Returns
+        the reorg depth (0 when the ancestor search failed and the
+        stream chose to wait for the next tick instead of guessing)."""
+        tail = self.cursor.chain()
+        by_number = {entry.number: entry for entry in tail}
+        ancestor: Optional[int] = None
+        number = _hex_int(block.get("number"))
+        probe_hash = block.get("parentHash")
+        probe_number = (number or 0) - 1
+        for _ in range(self.config.max_reorg_depth):
+            recorded = by_number.get(probe_number)
+            if recorded is None:
+                break  # ran off the recorded tail
+            if _same_hash(probe_hash, recorded.block_hash):
+                ancestor = probe_number
+                break
+            try:
+                canonical = self.pool.get_block(probe_number)
+            except AllEndpointsDown:
+                canonical = None
+            if canonical is None:
+                return 0  # cannot see the fork point yet; wait
+            probe_hash = canonical.get("parentHash")
+            probe_number -= 1
+        if ancestor is None:
+            # deeper than the recorded tail: drop everything recorded
+            # and resync — every tracked alert from the tail retracts
+            ancestor = tail[0].number - 1 if tail else probe_number
+        orphaned = self.cursor.rollback_to(ancestor)
+        depth = len(orphaned)
+        if depth:
+            self.reorgs += 1
+            self.deepest_reorg = max(self.deepest_reorg, depth)
+            retracted = self.alerts.retract_blocks(
+                [entry.block_hash for entry in orphaned]
+            )
+            self._count_reorg(depth)
+            log.warning(
+                "reorg: rolled back %d block(s) to #%d, retracted %d "
+                "alert(s)", depth, ancestor, retracted,
+            )
+        return depth
+
+    # -- ingest --------------------------------------------------------
+    def _ingest_block(self, block: Dict) -> None:
+        """Advance the cursor (fsync'd), THEN surface the block."""
+        number = _hex_int(block.get("number")) or 0
+        self.cursor.advance(
+            number, block.get("hash"), block.get("parentHash")
+        )
+        self.blocks_ingested += 1
+        self._count_block("advance")
+        self._surface_block(block, self._clock())
+
+    def _surface_block(self, block: Dict, seen_t: float) -> None:
+        number = _hex_int(block.get("number")) or 0
+        block_hash = block.get("hash") or ""
+        for address, kind in self._extract_targets(block):
+            code = None
+            try:
+                code = self.pool.get_code(address)
+            except Exception as why:
+                log.warning(
+                    "eth_getCode(%s) failed mid-ingest: %s", address, why
+                )
+            if not code:
+                continue
+            verdict = self.triage.triage(code)
+            alert = self.alerts.fire(
+                verdict.code_hash,
+                address,
+                number,
+                block_hash,
+                kind,
+                verdict.findings,
+                latency_s=max(0.0, self._clock() - seen_t),
+            )
+            if verdict.survivor:
+                self._submit_survivor(alert.id, code, verdict)
+
+    def _extract_targets(self, block: Dict) -> List[Tuple[str, str]]:
+        """(address, kind) pairs a block surfaces: contract creations
+        (null `to` -> the receipt's contractAddress) and proxy
+        upgrades (selector match -> implementation address from
+        calldata, no receipt fetch needed)."""
+        out: List[Tuple[str, str]] = []
+        for tx in block.get("transactions") or ():
+            if not isinstance(tx, dict):
+                continue  # hash-only transaction listing: nothing to do
+            if not tx.get("to"):
+                receipt = None
+                try:
+                    receipt = self.pool.get_receipt(tx.get("hash"))
+                except Exception as why:
+                    log.warning("receipt fetch failed: %s", why)
+                address = (receipt or {}).get("contractAddress")
+                if address:
+                    out.append((address, KIND_DEPLOYMENT))
+                continue
+            data = tx.get("input") or ""
+            body = data[2:] if data.startswith("0x") else data
+            if body[:8].lower() in (
+                SELECTOR_UPGRADE_TO, SELECTOR_UPGRADE_TO_AND_CALL
+            ):
+                target = _upgrade_target(data)
+                if target:
+                    out.append((target, KIND_PROXY_UPGRADE))
+        return out
+
+    # -- fleet submission ----------------------------------------------
+    def _submit_survivor(
+        self, alert_id: str, code: bytes, verdict: TriageVerdict
+    ) -> None:
+        """Hand a survivor to the fleet front under its
+        content-derived idempotency key, with deadline-aware
+        shedding: any refusal, saturation, or slow front degrades to
+        the already-fired static verdict. The cursor NEVER waits on
+        the fleet."""
+        if self.front is None:
+            return
+        started = self._clock()
+        try:
+            payload = self.front.submit_ex(
+                code.hex(),
+                deadline_s=self.config.submit_deadline_s,
+                idempotency_key=verdict.idempotency_key,
+            )
+        except Exception as why:
+            self.shed += 1
+            self._count_submission("shed")
+            log.warning(
+                "fleet submit shed (static-only verdict stands): %s", why
+            )
+            return
+        elapsed = self._clock() - started
+        if elapsed > self.config.submit_budget_s:
+            log.warning(
+                "fleet submit took %.2fs (budget %.2fs); the front is "
+                "slow", elapsed, self.config.submit_budget_s,
+            )
+        job_id = payload.get("job_id")
+        if payload.get("deduped"):
+            self.deduped += 1
+            self._count_submission("deduped")
+        else:
+            self.submitted += 1
+            self._count_submission("submitted")
+        if job_id:
+            with self._mu:
+                self._pending[job_id] = alert_id
+
+    def _poll_pending(self) -> None:
+        """Non-blocking sweep of outstanding fleet jobs; terminal
+        ones supersede their alert's static findings."""
+        if self.front is None:
+            return
+        with self._mu:
+            pending = list(self._pending.items())
+        for job_id, alert_id in pending:
+            try:
+                job = self.front.job(job_id)
+            except Exception:
+                continue  # front unwell; the jobs keep until it heals
+            state = job.get("state")
+            if state not in ("done", "failed", "checkpointed"):
+                continue
+            findings = [
+                str(
+                    issue.get("title")
+                    or issue.get("swc-id")
+                    or issue.get("swc_id")
+                    or issue
+                )
+                for issue in job.get("issues") or ()
+            ]
+            if state != "done":
+                findings.append(f"fleet:{state}")
+            self.alerts.supersede(alert_id, findings, source="fleet")
+            self.superseded += 1
+            with self._mu:
+                self._pending.pop(job_id, None)
+
+    # -- health --------------------------------------------------------
+    def head_lag(self) -> Optional[int]:
+        tip = self.cursor.tip()
+        if self.head is None:
+            return None
+        if tip is None:
+            return 0
+        return max(0, self.head - tip.number)
+
+    def _saturation_reasons(self) -> List[str]:
+        reasons: List[str] = []
+        if self.pool.up_count() == 0:
+            reasons.append(REDLINE_RPC_ENDPOINTS_DOWN)
+            reasons.extend(self.pool.open_reasons())
+        lag = self.head_lag()
+        if lag is not None and lag > self.config.head_lag_redline:
+            reasons.append(REDLINE_HEAD_LAG)
+        if (
+            lag is not None
+            and lag > self.config.backfill_batch
+            and self.ticks > 1
+        ):
+            # backfilling flat out and the gap still exceeds one full
+            # tick's worth of ingestion
+            reasons.append(REDLINE_BACKFILL_SATURATED)
+        return reasons
+
+    # -- loop ----------------------------------------------------------
+    def run_forever(
+        self, max_ticks: Optional[int] = None
+    ) -> None:
+        """The CLI loop: tick, sleep the poll interval, repeat until
+        stopped (or `max_ticks` for tools)."""
+        ticks = 0
+        while not self._stop.is_set():
+            self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            self._stop.wait(self.config.poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.stop()
+        self.cursor.mark_drain()
+        self.cursor.close()
+        self.alerts.close()
+
+    # -- telemetry ------------------------------------------------------
+    def _export_gauges(self) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            reg = registry()
+            if self.head is not None:
+                reg.gauge(
+                    "mtpu_chainstream_head",
+                    "quorum-consensus chain head",
+                ).set(float(self.head))
+            tip = self.cursor.tip()
+            if tip is not None:
+                reg.gauge(
+                    "mtpu_chainstream_cursor",
+                    "last durably ingested block number",
+                ).set(float(tip.number))
+            lag = self.head_lag()
+            if lag is not None:
+                reg.gauge(
+                    "mtpu_chainstream_head_lag_blocks",
+                    "consensus head minus cursor tip",
+                ).set(float(lag))
+        except Exception:
+            pass
+
+    def _count_block(self, event: str) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_chainstream_blocks_total",
+                "blocks handled by the stream, by event",
+            ).labels(event=event).inc()
+        except Exception:
+            pass
+
+    def _count_reorg(self, depth: int) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_chainstream_reorgs_total",
+                "reorgs resolved by the cursor",
+            ).inc()
+            registry().histogram(
+                "mtpu_chainstream_reorg_depth",
+                "blocks rolled back per reorg",
+            ).observe(float(depth))
+        except Exception:
+            pass
+
+    def _count_submission(self, outcome: str) -> None:
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_chainstream_submissions_total",
+                "survivor handoffs to the fleet front, by outcome",
+            ).labels(outcome=outcome).inc()
+        except Exception:
+            pass
+
+    def stats(self) -> Dict:
+        with self._mu:
+            pending = len(self._pending)
+        return {
+            "head": self.head,
+            "head_lag": self.head_lag(),
+            "ticks": self.ticks,
+            "blocks_ingested": self.blocks_ingested,
+            "reorgs": self.reorgs,
+            "deepest_reorg": self.deepest_reorg,
+            "submitted": self.submitted,
+            "deduped": self.deduped,
+            "shed": self.shed,
+            "superseded": self.superseded,
+            "pending_jobs": pending,
+            "recovered": self.recovered,
+            "cursor": self.cursor.stats(),
+            "alerts": self.alerts.stats(),
+            "triage": self.triage.stats(),
+            "pool": self.pool.stats(),
+        }
+
+
+def _same_hash(a: Optional[str], b: Optional[str]) -> bool:
+    if a is None or b is None:
+        return False
+
+    def _norm(h: str) -> str:
+        h = h.lower()
+        return h[2:] if h.startswith("0x") else h
+
+    return _norm(a) == _norm(b)

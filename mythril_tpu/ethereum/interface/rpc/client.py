@@ -1,9 +1,29 @@
-"""Minimal Ethereum JSON-RPC client.
+"""Ethereum JSON-RPC client, hardened for service use.
 
 Reference parity: mythril/ethereum/interface/rpc/client.py:30-88 —
 the `eth_*` methods the analyzer actually uses (code / storage /
 balance reads and a few block queries), with infura/ganache presets
 handled by MythrilConfig.
+
+Service hardening (ISSUE 16): the scan-era client was best-effort —
+no request timeout (a stalled endpoint hung the caller forever), one
+adapter mounted on a malformed prefix (so connection pooling and the
+transport retries silently never applied), and every failure flavor
+collapsed into the same exception. A chain-head ingestion pipeline
+polls this client once per block forever, so:
+
+- **per-request timeout** — `timeout_s` at construction, overridable
+  per call on every `eth_*` method; an endpoint that stops answering
+  costs one bounded timeout, not a wedged stream;
+- **connection reuse** — the pooled adapter is mounted on the
+  ``http://``/``https://`` scheme prefixes (what requests actually
+  matches mounts against), so the keep-alive socket survives across
+  the poll loop instead of a fresh TCP+TLS handshake per block;
+- **typed failures** — transport trouble raises `RpcTransportError`
+  subclasses (breaker food: the endpoint did not deliver), an in-band
+  JSON-RPC ``error`` member raises `RpcErrorResponse` (the endpoint
+  is alive; NOT death evidence). `chainstream/rpcpool.py` routes on
+  exactly this distinction.
 """
 
 from __future__ import annotations
@@ -14,19 +34,26 @@ import logging
 import requests
 from requests.adapters import HTTPAdapter
 from requests.exceptions import ConnectionError as RequestsConnectionError
+from requests.exceptions import RequestException
+from requests.exceptions import Timeout as RequestsTimeout
 
 from mythril_tpu.ethereum.interface.rpc.exceptions import (
     BadJsonError,
     BadResponseError,
     BadStatusCodeError,
     ConnectionError,
+    RpcErrorResponse,
+    TimeoutError,
 )
 
 log = logging.getLogger(__name__)
 
 GETH_DEFAULT_RPC_PORT = 8545
-MAX_RETRIES = 3
+#: transport-level (urllib3) retries per request; the pool/breaker
+#: layer above owns the real retry policy, so keep this shallow
+MAX_RETRIES = 1
 JSON_MEDIA_TYPE = "application/json"
+DEFAULT_TIMEOUT_S = 10.0
 
 BLOCK_TAGS = ("earliest", "latest", "pending")
 
@@ -46,77 +73,152 @@ def validate_block(block) -> str:
 
 
 class EthJsonRpc:
-    """JSON-RPC over HTTP(S)."""
+    """JSON-RPC over HTTP(S) with bounded, typed failure modes."""
 
-    def __init__(self, host="localhost", port=GETH_DEFAULT_RPC_PORT, tls=False):
+    def __init__(
+        self,
+        host="localhost",
+        port=GETH_DEFAULT_RPC_PORT,
+        tls=False,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+    ):
         self.host = host
         self.port = port
         self.tls = tls
+        self.timeout_s = float(timeout_s)
         self.session = requests.Session()
-        self.session.mount(self.host, HTTPAdapter(max_retries=MAX_RETRIES))
+        # mount the pooled adapter on the SCHEME prefixes — mounting
+        # on the bare hostname (the scan-era bug) never matched, so
+        # neither pooling nor transport retries applied
+        adapter = HTTPAdapter(max_retries=MAX_RETRIES)
+        self.session.mount("http://", adapter)
+        self.session.mount("https://", adapter)
 
-    def _call(self, method, params=None, _id=1):
+    @classmethod
+    def from_url(cls, url: str, timeout_s: float = DEFAULT_TIMEOUT_S):
+        """Build a client from a base URL (`myth watch --rpc URL`):
+        scheme picks tls, a missing port stays None (the scheme
+        default)."""
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url if "://" in url else f"http://{url}")
+        host = parts.hostname or "localhost"
+        port = parts.port
+        if parts.path and parts.path != "/":
+            # a path component (infura-style project routes): fold the
+            # port in front of it so `url` reassembles correctly
+            if port:
+                host = f"{host}:{port}"
+                port = None
+            host = host + parts.path.rstrip("/")
+        return cls(
+            host=host,
+            port=port,
+            tls=parts.scheme == "https",
+            timeout_s=timeout_s,
+        )
+
+    @property
+    def url(self) -> str:
+        scheme = "https" if self.tls else "http"
+        if not self.host:
+            return scheme
+        if self.port:
+            return f"{scheme}://{self.host}:{self.port}"
+        return f"{scheme}://{self.host}"
+
+    def _call(self, method, params=None, _id=1, timeout_s=None):
         params = params or []
         data = {"jsonrpc": "2.0", "method": method, "params": params, "id": _id}
-        scheme = "https" if self.tls else "http"
-        if self.host:
-            url = (
-                f"{scheme}://{self.host}:{self.port}"
-                if self.port
-                else f"{scheme}://{self.host}"
-            )
-        else:
-            url = scheme
-
         headers = {"Content-Type": JSON_MEDIA_TYPE}
         log.debug("rpc send: %s", json.dumps(data))
         try:
-            r = self.session.post(url, headers=headers, data=json.dumps(data))
+            r = self.session.post(
+                self.url,
+                headers=headers,
+                data=json.dumps(data),
+                timeout=timeout_s or self.timeout_s,
+            )
+        except RequestsTimeout:
+            raise TimeoutError(
+                f"{method} exceeded {timeout_s or self.timeout_s}s"
+            )
         except RequestsConnectionError:
-            raise ConnectionError
+            raise ConnectionError(f"{self.url} unreachable")
+        except RequestException as why:
+            raise ConnectionError(str(why))
         if r.status_code // 100 != 2:
             raise BadStatusCodeError(r.status_code)
         try:
             response = r.json()
         except ValueError:
             raise BadJsonError(r.text)
-        try:
-            return response["result"]
-        except KeyError:
+        if not isinstance(response, dict):
             raise BadResponseError(response)
+        if "result" in response:
+            return response["result"]
+        error = response.get("error")
+        if isinstance(error, dict):
+            # the endpoint is ALIVE — the method failed in-band; this
+            # must not feed an endpoint death breaker
+            raise RpcErrorResponse(
+                error.get("code"), error.get("message"), error.get("data")
+            )
+        raise BadResponseError(response)
 
     def close(self):
         self.session.close()
 
     # -- the eth_* surface the analyzer uses ---------------------------
-    def eth_getCode(self, address, default_block="latest"):
-        return self._call("eth_getCode", [address, validate_block(default_block)])
+    def eth_getCode(self, address, default_block="latest", timeout_s=None):
+        return self._call(
+            "eth_getCode",
+            [address, validate_block(default_block)],
+            timeout_s=timeout_s,
+        )
 
-    def eth_getBalance(self, address, default_block="latest"):
+    def eth_getBalance(self, address, default_block="latest", timeout_s=None):
         return hex_to_dec(
-            self._call("eth_getBalance", [address, validate_block(default_block)])
+            self._call(
+                "eth_getBalance",
+                [address, validate_block(default_block)],
+                timeout_s=timeout_s,
+            )
         )
 
-    def eth_getStorageAt(self, address, position=0, block="latest"):
+    def eth_getStorageAt(
+        self, address, position=0, block="latest", timeout_s=None
+    ):
         return self._call(
-            "eth_getStorageAt", [address, hex(position), validate_block(block)]
+            "eth_getStorageAt",
+            [address, hex(position), validate_block(block)],
+            timeout_s=timeout_s,
         )
 
-    def eth_blockNumber(self):
-        return hex_to_dec(self._call("eth_blockNumber"))
+    def eth_blockNumber(self, timeout_s=None):
+        return hex_to_dec(self._call("eth_blockNumber", timeout_s=timeout_s))
 
-    def eth_getBlockByNumber(self, block, tx_objects=True):
+    def eth_getBlockByNumber(self, block, tx_objects=True, timeout_s=None):
         return self._call(
-            "eth_getBlockByNumber", [validate_block(block), tx_objects]
+            "eth_getBlockByNumber",
+            [validate_block(block), tx_objects],
+            timeout_s=timeout_s,
         )
 
-    def eth_getTransactionReceipt(self, tx_hash):
-        return self._call("eth_getTransactionReceipt", [tx_hash])
+    def eth_getTransactionReceipt(self, tx_hash, timeout_s=None):
+        return self._call(
+            "eth_getTransactionReceipt", [tx_hash], timeout_s=timeout_s
+        )
 
-    def eth_call(self, to_address, data=None, default_block="latest"):
+    def eth_call(self, to_address, data=None, default_block="latest",
+                 timeout_s=None):
         data = data or {}
         obj = {"to": to_address, "data": data}
-        return self._call("eth_call", [obj, validate_block(default_block)])
+        return self._call(
+            "eth_call",
+            [obj, validate_block(default_block)],
+            timeout_s=timeout_s,
+        )
 
-    def web3_clientVersion(self):
-        return self._call("web3_clientVersion")
+    def web3_clientVersion(self, timeout_s=None):
+        return self._call("web3_clientVersion", timeout_s=timeout_s)

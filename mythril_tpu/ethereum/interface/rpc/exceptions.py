@@ -1,22 +1,65 @@
 """RPC client exceptions (reference:
-mythril/ethereum/interface/rpc/exceptions.py)."""
+mythril/ethereum/interface/rpc/exceptions.py — extended for service
+use).
+
+The scan-era client lumped every failure into one bag; a breaker-fed
+ingestion pipeline (chainstream/rpcpool.py) needs to tell the two
+failure families apart:
+
+- **RpcTransportError** — the endpoint did not deliver a usable
+  answer: connection refused/reset, request timeout, a non-2xx HTTP
+  status, a body that is not JSON. Death evidence; feeds the
+  endpoint's circuit breaker and triggers failover to another
+  endpoint.
+- **RpcErrorResponse** — the endpoint answered the JSON-RPC protocol
+  correctly but the METHOD failed (the ``error`` member: unknown
+  block, execution reverted, rate-limit verdicts expressed in-band).
+  The endpoint is alive; retrying another endpoint may still help,
+  but the breaker must NOT count it as death.
+
+The legacy names (ConnectionError, BadStatusCodeError, BadJsonError,
+BadResponseError) keep their meaning and are re-parented under the
+new split, so existing ``except`` clauses keep working.
+"""
 
 
 class EthJsonRpcError(Exception):
     """Base RPC error."""
 
 
-class ConnectionError(EthJsonRpcError):
-    """Could not reach the RPC endpoint."""
+class RpcTransportError(EthJsonRpcError):
+    """The endpoint failed to deliver a usable JSON-RPC answer
+    (connection, timeout, HTTP status, or body decode failure) —
+    death evidence for the endpoint's breaker."""
 
 
-class BadStatusCodeError(EthJsonRpcError):
+class ConnectionError(RpcTransportError):  # noqa: A001 — reference name
+    """Could not reach the RPC endpoint (refused/reset/timeout)."""
+
+
+class TimeoutError(ConnectionError):  # noqa: A001 — reference style
+    """The request exceeded its per-call timeout budget."""
+
+
+class BadStatusCodeError(RpcTransportError):
     """Non-2xx HTTP status."""
 
 
-class BadJsonError(EthJsonRpcError):
+class BadJsonError(RpcTransportError):
     """Response body was not JSON."""
 
 
+class RpcErrorResponse(EthJsonRpcError):
+    """The JSON-RPC ``error`` member: the endpoint is alive but the
+    method failed. Carries the protocol code/message so callers can
+    distinguish rate limiting from genuine method errors."""
+
+    def __init__(self, code, message, data=None):
+        super().__init__(f"RPC error {code}: {message}")
+        self.code = code
+        self.message = message
+        self.data = data
+
+
 class BadResponseError(EthJsonRpcError):
-    """JSON response missing the result field."""
+    """JSON response missing both the result and error fields."""

@@ -16,6 +16,7 @@ import argparse
 import json
 import logging
 import os
+import signal
 import sys
 import traceback
 from argparse import ArgumentParser, Namespace, RawTextHelpFormatter
@@ -60,6 +61,7 @@ COMMAND_LIST = (
         "lint",
         "serve",
         "fleet",
+        "watch",
         "submit",
         "solverlab",
         "observe",
@@ -1142,6 +1144,147 @@ def build_parser() -> ArgumentParser:
         ),
     )
 
+    watch = subparsers.add_parser(
+        "watch",
+        help=(
+            "Stream the chain head into the warm service: follow new "
+            "blocks over one or more JSON-RPC endpoints (failover + "
+            "quorum head tracking), static-triage fresh deployments "
+            "and proxy upgrades at line rate, hand survivors to a "
+            "`myth fleet`/`myth serve` front under content-derived "
+            "idempotency keys, and keep a crash-safe reorg-aware "
+            "cursor with a fired/retracted/superseded alert log"
+        ),
+    )
+    watch.add_argument(
+        "--rpc",
+        action="append",
+        dest="rpc_urls",
+        metavar="URL",
+        default=None,
+        help=(
+            "an execution-client JSON-RPC endpoint (repeat per "
+            "endpoint for failover; one endpoint dying must never "
+            "stall the stream)"
+        ),
+    )
+    watch.add_argument(
+        "--front",
+        default=None,
+        metavar="URL",
+        help=(
+            "a `myth fleet` or `myth serve` base URL; survivors of "
+            "the static triage are submitted there (omit for "
+            "static-only alerting)"
+        ),
+    )
+    watch.add_argument(
+        "--state",
+        default="./chainstream",
+        metavar="DIR",
+        help=(
+            "the watcher's durable state: the fsync'd cursor journal "
+            "and the append-only alert log live here"
+        ),
+    )
+    watch.add_argument(
+        "--recover",
+        action="store_true",
+        help=(
+            "replay the cursor journal and alert log at startup and "
+            "resume from the recorded tip (at-least-once: the tip "
+            "block is redelivered; content-derived alert ids and "
+            "idempotency keys absorb the duplicates)"
+        ),
+    )
+    watch.add_argument(
+        "--quorum",
+        type=int,
+        default=1,
+        metavar="N",
+        help=(
+            "endpoints that must confirm a height before it counts "
+            "as the consensus head (a single racing or lying "
+            "endpoint cannot move a quorum of 2+)"
+        ),
+    )
+    watch.add_argument(
+        "--poll-interval",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="seconds between chain-head polls",
+    )
+    watch.add_argument(
+        "--rpc-timeout",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help="per-request RPC timeout (every call is bounded)",
+    )
+    watch.add_argument(
+        "--start-block",
+        type=int,
+        default=None,
+        metavar="N",
+        help=(
+            "first block to ingest on a fresh cursor (default: the "
+            "consensus head at startup)"
+        ),
+    )
+    watch.add_argument(
+        "--backfill-batch",
+        type=int,
+        default=16,
+        metavar="N",
+        help=(
+            "max blocks ingested per tick; bounds tick latency so a "
+            "deep gap backfills without starving head-following"
+        ),
+    )
+    watch.add_argument(
+        "--max-reorg-depth",
+        type=int,
+        default=64,
+        metavar="N",
+        help=(
+            "cursor tail depth — the deepest reorg resolvable "
+            "against recorded hashes; deeper forks force a resync"
+        ),
+    )
+    watch.add_argument(
+        "--alert-budget",
+        type=float,
+        default=12.0,
+        metavar="SECONDS",
+        help=(
+            "the block-time budget: the alert-latency SLO wants the "
+            "p50 block-seen-to-alert under this"
+        ),
+    )
+    watch.add_argument(
+        "--submit-deadline",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="per-job wall budget handed to the fleet for survivors",
+    )
+    watch.add_argument(
+        "--ticks",
+        type=int,
+        default=0,
+        metavar="N",
+        help="exit after N ticks (0 = run until interrupted)",
+    )
+    watch.add_argument(
+        "--no-fsync",
+        action="store_true",
+        help=(
+            "skip the per-record fsync on the cursor/alert logs "
+            "(testing only; crash safety depends on the fsync)"
+        ),
+    )
+
     observe_cmd = subparsers.add_parser(
         "observe",
         help=(
@@ -1319,6 +1462,26 @@ def build_parser() -> ArgumentParser:
         "--url",
         default="http://127.0.0.1:7341",
         help="service base URL",
+    )
+    submit.add_argument(
+        "--address",
+        default=None,
+        metavar="ADDRESS",
+        help=(
+            "submit the DEPLOYED code at this on-chain address "
+            "instead of -c/-f bytecode (fetched over --rpc-url via "
+            "eth_getCode; rides the same CodeCache/triage/store path "
+            "as a pasted payload)"
+        ),
+    )
+    submit.add_argument(
+        "--rpc-url",
+        default=None,
+        metavar="URL",
+        help=(
+            "execution-client JSON-RPC endpoint for --address "
+            "(e.g. http://127.0.0.1:8545)"
+        ),
     )
     submit.add_argument(
         "--max-waves", type=int, default=None, help="device waves override"
@@ -2109,19 +2272,101 @@ def _cmd_solverlab(args: Namespace) -> None:
     sys.exit()
 
 
+def _cmd_watch(args: Namespace) -> None:
+    """`myth watch`: stream the chain head into the warm service
+    until interrupted (or for --ticks ticks)."""
+    from mythril_tpu.chainstream import ChainWatcher, RpcPool, WatchConfig
+
+    if not args.rpc_urls:
+        log.error(
+            "myth watch wants at least one --rpc URL (an "
+            "execution-client JSON-RPC endpoint)"
+        )
+        sys.exit(2)
+    pool = RpcPool.from_urls(
+        args.rpc_urls,
+        timeout_s=args.rpc_timeout,
+        quorum=args.quorum,
+    )
+    front = None
+    if args.front:
+        from mythril_tpu.service.client import ServiceClient
+
+        front = ServiceClient(args.front)
+    watcher = ChainWatcher(
+        pool,
+        args.state,
+        front=front,
+        config=WatchConfig(
+            poll_interval_s=args.poll_interval,
+            backfill_batch=args.backfill_batch,
+            max_reorg_depth=args.max_reorg_depth,
+            start_block=args.start_block,
+            alert_budget_s=args.alert_budget,
+            submit_deadline_s=args.submit_deadline,
+            fsync=not args.no_fsync,
+        ),
+    )
+    if args.recover:
+        facts = watcher.recover()
+        log.info(
+            "chainstream recovered: %d record(s), tip %s, "
+            "redelivered=%s",
+            facts["records"], facts["tip"], facts["redelivered"],
+        )
+
+    def _drain(signum, frame):  # noqa: ARG001 (signal signature)
+        watcher.stop()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    try:
+        watcher.run_forever(
+            max_ticks=args.ticks if args.ticks > 0 else None
+        )
+    finally:
+        watcher.close()
+        print(json.dumps(watcher.stats(), sort_keys=True, default=str))
+    sys.exit()
+
+
 def _cmd_submit(args: Namespace) -> None:
     """`myth submit`: send bytecode to a running service, print the
     report (or the job id with --no-wait) as JSON."""
     from mythril_tpu.service.client import ServiceClient, ServiceError
 
-    if args.code:
+    if args.address:
+        # the on-chain entry into the warm path: eth_getCode through
+        # the same DynLoader the symbolic engine uses, then the bytes
+        # ride the normal submission road (CodeCache, static triage,
+        # verdict store) exactly like a pasted payload
+        if not args.rpc_url:
+            log.error("--address wants --rpc-url RPC_ENDPOINT")
+            sys.exit(1)
+        from mythril_tpu.ethereum.interface.rpc.client import EthJsonRpc
+        from mythril_tpu.ethereum.interface.rpc.exceptions import (
+            EthJsonRpcError,
+        )
+        from mythril_tpu.support.loader import DynLoader
+
+        loader = DynLoader(EthJsonRpc.from_url(args.rpc_url))
+        try:
+            deployed = loader.deployed_code(args.address)
+        except EthJsonRpcError as why:
+            log.error("eth_getCode(%s) failed: %s", args.address, why)
+            sys.exit(1)
+        if deployed is None:
+            log.error("no code at %s", args.address)
+            sys.exit(1)
+        blob = deployed.hex()
+    elif args.code:
         blob = args.code
     elif args.codefile:
         blob = "".join(line.strip() for line in args.codefile if line.strip())
     else:
         log.error(
-            "No input bytecode. Provide EVM code via -c BYTECODE or "
-            "-f BYTECODE_FILE"
+            "No input bytecode. Provide EVM code via -c BYTECODE, "
+            "-f BYTECODE_FILE, or --address ADDRESS --rpc-url URL"
         )
         sys.exit(1)
     client = ServiceClient(args.url)
@@ -2167,6 +2412,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_serve(args)
     if args.command == "fleet":
         _cmd_fleet(args)
+    if args.command == "watch":
+        _cmd_watch(args)
     if args.command == "submit":
         _cmd_submit(args)
     if args.command == "solverlab":

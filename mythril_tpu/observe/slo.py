@@ -69,6 +69,16 @@ REDLINE_BREAKER_OPEN = "breaker-open"
 REDLINE_REPLICA_LOST = "replica-lost"
 REDLINE_FLEET_DEGRADED = "fleet-degraded"
 REDLINE_FLEET_SATURATED = "fleet-saturated"
+#: chainstream vocabulary (chainstream/watcher.py): `rpc-endpoints-
+#: down` — every configured execution-client endpoint's death breaker
+#: is open and the head stream is stalled; `head-lag` — the cursor
+#: has fallen more than the configured block budget behind the quorum
+#: chain head; `backfill-saturated` — the gap between cursor and head
+#: exceeds the backfill window (alerting latency can no longer meet
+#: the block-time SLO until the backlog drains)
+REDLINE_RPC_ENDPOINTS_DOWN = "rpc-endpoints-down"
+REDLINE_HEAD_LAG = "head-lag"
+REDLINE_BACKFILL_SATURATED = "backfill-saturated"
 REDLINE_REASONS = (
     REDLINE_SLO_BURN,
     REDLINE_QUEUE_SATURATED,
@@ -77,6 +87,9 @@ REDLINE_REASONS = (
     REDLINE_REPLICA_LOST,
     REDLINE_FLEET_DEGRADED,
     REDLINE_FLEET_SATURATED,
+    REDLINE_RPC_ENDPOINTS_DOWN,
+    REDLINE_HEAD_LAG,
+    REDLINE_BACKFILL_SATURATED,
 )
 
 #: the enumerated not-ready vocabulary for the readiness half of

@@ -75,6 +75,28 @@ class DynLoader:
         return self._client().eth_getBalance(address)
 
     @lru_cache(maxsize=MEMO_SLOTS)
+    def deployed_code(self, address) -> Optional[bytes]:
+        """Raw runtime bytecode of the contract at `address`, or None
+        for malformed addresses and codeless accounts.
+
+        This is the on-chain entry into the WARM service path
+        (ISSUE 16 / ROADMAP item 1): the bytes returned here are
+        submitted to `myth serve`/`myth fleet` exactly like a client
+        payload, so a streamed deployment rides the same
+        CodeCache/disassembly-row/static-summary ladder — and the
+        same content-addressed verdict store — as submitted code.
+        `dynld` keeps returning the host-side Disassembly view for
+        the symbolic engine's CALL resolution."""
+        client = self._client()
+        canonical = _canonical_address(address)
+        if canonical is None:
+            return None
+        code = client.eth_getCode(canonical)
+        if not code or code == "0x":
+            return None
+        return bytes.fromhex(code[2:] if code.startswith("0x") else code)
+
+    @lru_cache(maxsize=MEMO_SLOTS)
     def dynld(self, dependency_address) -> Optional[Disassembly]:
         """Code of the contract at `dependency_address`, disassembled;
         None for malformed addresses and codeless accounts."""

@@ -172,6 +172,19 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "chainstream: reorg-safe chain-head streaming suite "
+        "(mythril_tpu/chainstream: multi-endpoint RPC failover with "
+        "death breakers + quorum heads, crash-safe cursor journal "
+        "with reorg rollback, line-rate static triage, "
+        "fired/retracted/superseded alert log, fleet survivor "
+        "handoff with content-derived idempotency keys; scripted "
+        "in-process fake chain, no network — runs in tier-1, "
+        "selectable with -m chainstream; the subprocess "
+        "SIGKILL+reorg harness is tools/chainstream_smoke.py via "
+        "[testenv:chainstream])",
+    )
+    config.addinivalue_line(
+        "markers",
         "taint: taint & value-set static layer suite (attacker-taint "
         "fixpoint goldens, semantic screen soundness sweep over every "
         "module positive fixture, static-answer triage differential, "
