@@ -1345,6 +1345,86 @@ def bench_chainstream(blocks: int = 30, per_block: int = 2) -> dict:
     return out
 
 
+def bench_compileplane() -> dict:
+    """Zero-cold-start compile plane leg (ISSUE 17): bake a one-bucket
+    kernel pack for a tiny dispatch shape, then measure both boot
+    paths on the SAME arena avals —
+
+    - `cold_ready_no_pack_s`: the in-process compile a packless
+      replica pays before its first wave (the bake's own compile wall,
+      which IS that compile);
+    - `cold_ready_pack_s`: mount the pack + run the first wave off the
+      deserialized AOT executable, zero in-process compiles;
+    - `kernel_pack_hit_rate` (gated): pack hits over pack-consulting
+      lookups — 1.0 on this leg, a drop means the load path broke;
+    - `aot_load_p50_s`: p50 artifact deserialize wall.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from mythril_tpu.compileplane.pack import (
+        _arena_for,
+        bake_service_pack,
+        service_shape,
+    )
+    from mythril_tpu.compileplane.plane import configure_plane, reset_plane
+    from mythril_tpu.laser.batch.run import (
+        clear_aot_generic,
+        generic_aot_stats,
+        wave_run,
+    )
+
+    shape_args = dict(
+        stripes=2, lanes_per_stripe=2, steps_per_wave=32, code_cap=32
+    )
+    shape = service_shape(**shape_args)
+    pack_dir = tempfile.mkdtemp(prefix="myth-bench-pack-")
+    reset_plane()
+    clear_aot_generic()
+    try:
+        manifest = bake_service_pack(pack_dir, [None], **shape_args)
+        cold_no_pack = manifest["baked"][0]["wall_s"]
+
+        # a "fresh replica": no plane, no AOT table, no jit caches
+        reset_plane()
+        clear_aot_generic()
+        jax.clear_caches()
+        plane = configure_plane(pack_dirs=(pack_dir,))
+        t0 = time.perf_counter()
+        mounted = plane.mount_packs()
+        batch, table, _substep = _arena_for(shape)
+        out_state = wave_run(
+            batch,
+            table,
+            max_steps=shape["steps_per_wave"],
+            track_coverage=True,
+            donate=False,
+        )
+        jax.block_until_ready(out_state[1])
+        cold_pack = time.perf_counter() - t0
+        stats = plane.stats()
+        out = {
+            "cold_ready_no_pack_s": round(cold_no_pack, 3),
+            "cold_ready_pack_s": round(cold_pack, 3),
+            "compileplane_speedup": (
+                round(cold_no_pack / cold_pack, 2) if cold_pack else None
+            ),
+            "kernel_pack_hit_rate": stats["kernel_pack_hit_rate"],
+            "aot_load_p50_s": stats["aot_load_p50_s"],
+            "compileplane_artifacts": manifest["artifacts"],
+            "compileplane_mounted": mounted["mounted"],
+            "compileplane_inproc_compiles": generic_aot_stats()["compiles"],
+        }
+    finally:
+        reset_plane()
+        clear_aot_generic()
+        shutil.rmtree(pack_dir, ignore_errors=True)
+    print(f"bench: compileplane leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -1520,6 +1600,12 @@ def main(final_attempt: bool = False) -> None:
         "head_lag_blocks_max": None,
         "reorg_recovery_s": None,
         "ingest_static_rate": None,
+        # compile-plane scorecard (ISSUE 17): the compileplane leg
+        # fills these; None = the leg never ran
+        "cold_ready_no_pack_s": None,
+        "cold_ready_pack_s": None,
+        "kernel_pack_hit_rate": None,
+        "aot_load_p50_s": None,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1566,6 +1652,22 @@ def main(final_attempt: bool = False) -> None:
         print("bench: chainstream leg hit its deadline", file=sys.stderr)
     except Exception as e:
         print(f"bench: chainstream leg failed: {e!r}", file=sys.stderr)
+
+    # the compile-plane leg runs EARLY (it clears the jit caches to
+    # simulate a fresh replica — later legs recompile their own shapes
+    # regardless, earlier ones must not have theirs dropped mid-use)
+    if _budget_left() > 240 and not os.environ.get(
+        "MYTHRIL_BENCH_NO_COMPILEPLANE"
+    ):
+        try:
+            record.update(
+                _with_deadline(bench_compileplane, 180)
+            )
+            print("bench: compileplane leg done", file=sys.stderr)
+        except _Deadline:
+            print("bench: compileplane leg hit its deadline", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: compileplane leg failed: {e!r}", file=sys.stderr)
 
     if _budget_left() > 240 and not os.environ.get(
         "MYTHRIL_BENCH_NO_FLEET"

@@ -1,0 +1,45 @@
+"""The persistent compile plane: AOT kernel artifacts across processes.
+
+BENCH_r07 pins `kernel_compile_s` at ~101.5s against a ~30s corpus
+walk — XLA compilation, not execution, dominates every cold process,
+and every fleet replica and every `--recover` restart pays it again
+from scratch. This package applies the verdict store's
+content-addressed key discipline (store/store.py) to compiled
+executables themselves:
+
+- `fingerprint`  — the backend fingerprint (jax/jaxlib versions,
+  platform, device kind, XLA flags, limb width) that keys every
+  artifact so stale executables are refused, never mis-loaded.
+- `keys`         — specialization-bucket + dispatch-entry digests: the
+  (PhaseSet bucket, arena avals, statics) triple that names one XLA
+  executable.
+- `aot`          — JAX AOT export/import (`jit.lower().compile()` +
+  executable serialization) with a per-reason `AotUnsupported`
+  taxonomy so CPU-only / unsupported backends degrade to in-process
+  compile, never fail.
+- `cache`        — the on-disk artifact cache: atomic tmp+rename
+  writes, checksum/schema verification with REFUSED counting,
+  LRU-by-mtime eviction, fleet-shared-directory ENOENT tolerance.
+- `pack`         — the prebaked kernel-pack format + baking (`myth
+  kernels bake|warm|ls|gc`): hot buckets compiled into one directory
+  ahead of time, mounted at `myth serve --kernel-pack DIR` boot.
+- `plane`        — the process-wide facade every compile site
+  consults: breaker-wrapped (TIER_COMPILEPLANE) load-before-compile
+  and write-back-after, pack mounting, `mtpu_compileplane_*` stats.
+"""
+
+from mythril_tpu.compileplane.aot import (  # noqa: F401
+    AotUnsupported,
+    aot_enabled,
+)
+from mythril_tpu.compileplane.cache import ArtifactCache  # noqa: F401
+from mythril_tpu.compileplane.fingerprint import (  # noqa: F401
+    backend_fingerprint,
+    fingerprint_hex,
+)
+from mythril_tpu.compileplane.plane import (  # noqa: F401
+    CompilePlane,
+    active_plane,
+    configure_plane,
+    reset_plane,
+)

@@ -1,0 +1,323 @@
+"""The on-disk executable artifact cache.
+
+The verdict store's entry discipline (store/store.py), applied to
+binary XLA artifacts: one file per artifact under ``DIR/artifacts/``,
+named by the content-addressed key (keys.artifact_key), written
+atomically (tmp + fsync + ``os.replace`` + parent-dir fsync) so
+fleet-shared directories — several `myth serve` replicas and a bake
+job over one pack — can never interleave bytes.
+
+File format: one JSON header line (schema version, key, bucket, entry
+digest, backend fingerprint, blob checksum/length, provenance)
+followed by the raw serialized-executable payload. Readers verify
+four things before an artifact counts as a hit: the filename matches
+the header's own key, the schema version is known, the payload
+checksum and length match, and the header fingerprint matches the
+reader's backend. Anything else is REFUSED and counted
+(`mtpu_compileplane_corrupt_total`), never loaded — a stale artifact
+recompiles, it does not mis-execute.
+
+Eviction: a soft artifact cap, oldest-mtime first (reads refresh
+mtime, so the policy is LRU-by-access). A file that vanishes between
+listing and open is another replica's eviction, not corruption.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: artifact header schema — readers refuse NEWER versions (a rolled
+#: back replica must not misparse a newer writer's artifacts)
+ARTIFACT_SCHEMA_VERSION = 1
+
+#: soft cap on resident artifacts (kernel blobs are MB-scale; the cap
+#: is deliberately far below the verdict store's)
+DEFAULT_CAPACITY = 256
+
+_EXT = ".aotx"
+
+
+def _blob_sha(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def _counters():
+    """The process-wide mtpu_compileplane_* cache counters — shared by
+    every ArtifactCache instance (cache dir + mounted packs)."""
+    from mythril_tpu.observe.registry import registry
+
+    reg = registry()
+    return {
+        name: reg.counter(
+            f"mtpu_compileplane_{name}_total",
+            f"compile-plane artifact cache {label}",
+        )
+        for name, label in (
+            ("hits", "artifact hits (verified loads)"),
+            ("misses", "lookups with no usable artifact"),
+            ("writes", "artifacts written back"),
+            ("bytes", "artifact bytes written"),
+            ("evictions", "artifacts evicted at the capacity cap"),
+            ("corrupt", "artifacts refused "
+                        "(checksum/key/schema/fingerprint)"),
+        )
+    }
+
+
+class ArtifactCache:
+    """Persistent key -> (header, executable bytes) map."""
+
+    def __init__(
+        self, directory: str, capacity: int = DEFAULT_CAPACITY
+    ) -> None:
+        self.dir = os.path.abspath(directory)
+        self.artifacts_dir = os.path.join(self.dir, "artifacts")
+        os.makedirs(self.artifacts_dir, exist_ok=True)
+        self.capacity = max(1, int(capacity))
+        self._mu = threading.Lock()
+        # -- /stats counters (registry doubles) ------------------------
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+        self.bytes_written = 0
+        self.evictions = 0
+        self.corrupt = 0
+        self._c = _counters()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.artifacts_dir, f"{key}{_EXT}")
+
+    # -- reads -----------------------------------------------------------
+    def _refuse(self, path: str, why: str) -> None:
+        with self._mu:
+            self.corrupt += 1
+        self._c["corrupt"].inc()
+        log.warning("compile plane refused artifact %s: %s", path, why)
+
+    def read(
+        self, key: str, expected_fp: Optional[str] = None
+    ) -> Optional[Tuple[Dict, bytes]]:
+        """Verified (header, payload) or None. A refused artifact is a
+        miss that recompiles — never a partial or mismatched load. A
+        file that VANISHED mid-read (another replica's eviction sweep
+        in a fleet-shared directory) is a plain miss: no counter, no
+        log noise."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as fp:
+                header_line = fp.readline()
+                payload = fp.read()
+        except FileNotFoundError:
+            with self._mu:
+                self.misses += 1
+            self._c["misses"].inc()
+            return None
+        except OSError as why:
+            self._refuse(path, str(why))
+            with self._mu:
+                self.misses += 1
+            self._c["misses"].inc()
+            return None
+        try:
+            header = json.loads(header_line)
+            if not isinstance(header, dict):
+                raise ValueError("header is not an object")
+            version = int(header.get("schema_version", -1))
+            if version > ARTIFACT_SCHEMA_VERSION:
+                raise ValueError(
+                    f"artifact schema v{version} is newer than this reader"
+                )
+            if header.get("key") != key:
+                raise ValueError(
+                    "artifact key does not match its filename (moved or "
+                    "tampered artifact)"
+                )
+            if int(header.get("blob_len", -1)) != len(payload):
+                raise ValueError("payload truncated")
+            if header.get("blob_sha") != _blob_sha(payload):
+                raise ValueError("payload checksum mismatch")
+            if (
+                expected_fp is not None
+                and header.get("fingerprint_hex") != expected_fp
+            ):
+                raise ValueError(
+                    "backend fingerprint mismatch (stale toolchain/"
+                    "device artifact)"
+                )
+        except (ValueError, KeyError, TypeError) as why:
+            self._refuse(path, str(why))
+            with self._mu:
+                self.misses += 1
+            self._c["misses"].inc()
+            return None
+        try:
+            os.utime(path)  # LRU freshness for the eviction sweep
+        except OSError:
+            pass
+        with self._mu:
+            self.hits += 1
+        self._c["hits"].inc()
+        return header, payload
+
+    # -- writes ----------------------------------------------------------
+    def write(
+        self,
+        key: str,
+        bucket: Dict,
+        digest: str,
+        fingerprint: Dict,
+        fp_hex: str,
+        payload: bytes,
+        extra: Optional[Dict] = None,
+    ) -> Optional[str]:
+        """Persist one artifact; returns the path (None on failure — a
+        full disk degrades the plane to compile-only, it never sinks
+        the wave). Last writer wins per key, which is safe: same key
+        means same program on the same backend."""
+        header = {
+            "schema_version": ARTIFACT_SCHEMA_VERSION,
+            "key": key,
+            "bucket": bucket,
+            "entry": digest,
+            "fingerprint": fingerprint,
+            "fingerprint_hex": fp_hex,
+            "blob_sha": _blob_sha(payload),
+            "blob_len": len(payload),
+            "provenance": dict(
+                {
+                    "host": socket.gethostname(),
+                    "pid": os.getpid(),
+                    "created_at": time.time(),
+                },
+                **(extra or {}),
+            ),
+        }
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            from mythril_tpu.support.resilience import inject
+
+            inject("compileplane.write")
+            with open(tmp, "wb") as fp:
+                fp.write(json.dumps(header, sort_keys=True).encode())
+                fp.write(b"\n")
+                fp.write(payload)
+                # durability before visibility (store.py discipline)
+                fp.flush()
+                os.fsync(fp.fileno())
+            os.replace(tmp, path)
+            try:
+                dir_fd = os.open(self.artifacts_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except OSError:
+                pass  # not every filesystem supports directory fsync
+        except Exception as why:
+            log.warning("compile plane write failed for %s: %s", key, why)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        with self._mu:
+            self.writes += 1
+            self.bytes_written += len(payload)
+        self._c["writes"].inc()
+        self._c["bytes"].inc(len(payload))
+        self.evict()
+        return path
+
+    # -- eviction --------------------------------------------------------
+    def evict(self, capacity: Optional[int] = None) -> int:
+        """Unlink oldest-mtime artifacts past the cap; returns how
+        many went. Fleet-race tolerant exactly like the store: a row
+        that vanishes mid-scan isn't a candidate, a lost unlink race
+        books nothing."""
+        cap = self.capacity if capacity is None else max(0, int(capacity))
+        try:
+            names = [
+                n for n in os.listdir(self.artifacts_dir)
+                if n.endswith(_EXT)
+            ]
+        except OSError:
+            return 0
+        rows = []
+        for name in names:
+            try:
+                rows.append(
+                    (
+                        os.path.getmtime(
+                            os.path.join(self.artifacts_dir, name)
+                        ),
+                        name,
+                    )
+                )
+            except OSError:
+                continue  # vanished mid-scan: already evicted
+        excess = len(rows) - cap
+        if excess <= 0:
+            return 0
+        gone = 0
+        for _mtime, name in sorted(rows)[:excess]:
+            try:
+                os.unlink(os.path.join(self.artifacts_dir, name))
+            except OSError:
+                continue
+            gone += 1
+            with self._mu:
+                self.evictions += 1
+            self._c["evictions"].inc()
+        return gone
+
+    # -- introspection ---------------------------------------------------
+    def keys(self) -> List[str]:
+        try:
+            return sorted(
+                n[: -len(_EXT)]
+                for n in os.listdir(self.artifacts_dir)
+                if n.endswith(_EXT)
+            )
+        except OSError:
+            return []
+
+    def headers(self) -> List[Dict]:
+        """Every readable artifact header (no payload verification —
+        `myth kernels ls` introspection, not the load path)."""
+        out = []
+        for key in self.keys():
+            try:
+                with open(self._path(key), "rb") as fp:
+                    header = json.loads(fp.readline())
+                if isinstance(header, dict):
+                    out.append(header)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def stats(self) -> Dict:
+        with self._mu:
+            return {
+                "dir": self.dir,
+                "artifacts": len(self),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "writes": self.writes,
+                "bytes": self.bytes_written,
+                "evictions": self.evictions,
+                "corrupt": self.corrupt,
+            }

@@ -1,0 +1,102 @@
+"""Artifact keys: bucket + dispatch-entry digests.
+
+One XLA executable is named by three facts:
+
+- the **specialization bucket** — the `step.PhaseSet` (pruned phase
+  names + fuse_depth + block_depth), or "generic" for the unpruned
+  interpreter. Encoded as pruned-NAMES so the key survives PhaseSet
+  field reordering.
+- the **dispatch entry** — the entry kind (run / sym / generic),
+  donation, the static jit arguments (max_steps, track_coverage,
+  unroll — these are BAKED into the executable, unlike the in-process
+  warm key), and the avals (shape + dtype) of every dynamic leaf —
+  arena shape, lane count, code table rows, calldata/stack/mem caps
+  all ride here.
+- the **backend fingerprint** (fingerprint.py).
+
+The digest deliberately covers MORE than `SpecializedKernel.run_key`:
+the in-process warm set only gates "has this jit object traced this
+shape", while an AOT executable with a different `max_steps` is a
+different program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from mythril_tpu.laser.batch.step import PHASE_FLAGS, PhaseSet
+
+#: artifact-key schema — part of every digest so a key-scheme change
+#: orphans old artifacts instead of colliding with them
+KEY_SCHEMA = 1
+
+
+def bucket_key(phases: Optional[PhaseSet]) -> Dict:
+    """The JSON-able bucket identity: None == the generic kernel."""
+    if phases is None:
+        return {"kind": "generic"}
+    return {
+        "kind": "spec",
+        "pruned": sorted(phases.pruned),
+        "fuse_depth": int(phases.fuse_depth),
+        "block_depth": int(phases.block_depth),
+    }
+
+
+def phases_from_bucket(bucket: Dict) -> Optional[PhaseSet]:
+    """Invert bucket_key — the bake CLI reconstructs PhaseSets from
+    manifest/bucket-list JSON. Unknown pruned names are ignored (a
+    newer writer's phase flag this build doesn't have cannot be
+    pruned here)."""
+    if not bucket or bucket.get("kind") == "generic":
+        return None
+    pruned = set(bucket.get("pruned") or ())
+    flags = {name: name not in pruned for name in PHASE_FLAGS}
+    return PhaseSet(
+        **flags,
+        fuse_depth=int(bucket.get("fuse_depth", 0)),
+        block_depth=int(bucket.get("block_depth", 0)),
+    )
+
+
+def _avals(dyn_args: Tuple) -> list:
+    """(shape, dtype) of every dynamic leaf, in pytree order — the
+    shape identity the executable was traced for. Values never enter
+    the key: the kernels are value-independent by construction."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(dyn_args):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        out.append([list(int(d) for d in shape), dtype])
+    return out
+
+
+def entry_digest(
+    kind: str, donate: bool, statics: Dict[str, Any], dyn_args: Tuple
+) -> str:
+    """The dispatch-entry digest (bucket and fingerprint ride the
+    artifact key separately)."""
+    body = {
+        "schema": KEY_SCHEMA,
+        "kind": kind,
+        "donate": bool(donate),
+        "statics": {k: statics[k] for k in sorted(statics)},
+        "avals": _avals(dyn_args),
+    }
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()
+    ).hexdigest()[:24]
+
+
+def artifact_key(bucket: Dict, digest: str, fp_hex: str) -> str:
+    """The content-addressed artifact name: one executable per
+    (bucket, entry, backend). Doubles as the on-disk filename stem."""
+    body = json.dumps(
+        {"bucket": bucket, "entry": digest, "fp": fp_hex},
+        sort_keys=True,
+    )
+    return hashlib.sha256(body.encode()).hexdigest()[:40]

@@ -1,0 +1,399 @@
+"""The compile-plane facade every compile site consults.
+
+One `CompilePlane` per process (configure_plane/active_plane), over an
+optional read-write artifact cache directory (`myth serve
+--kernel-cache DIR`) plus zero or more read-only prebaked kernel
+packs (`--kernel-pack DIR`, pack.py). The dispatch sites —
+`SpecializedKernel` (laser/batch/specialize.py) and the generic
+`wave_run` (laser/batch/run.py) — call `load()` before compiling and
+`store()` after, so a fresh replica whose buckets were baked ahead of
+time reaches readiness with ZERO in-process compiles of packed
+buckets.
+
+Everything is breaker-wrapped (support/breaker.py TIER_COMPILEPLANE):
+a sick artifact directory turns every load into a miss and every
+store into a no-op — the fallback is today's in-process compile, with
+the half-open probe re-admitting the tier when it recovers. AOT
+capability misses (`AotUnsupported`) are NOT breaker failures; they
+are counted per-reason in `mtpu_compileplane_unsupported_total` and
+degrade the same way.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.compileplane import aot
+from mythril_tpu.compileplane.cache import DEFAULT_CAPACITY, ArtifactCache
+from mythril_tpu.compileplane.fingerprint import (
+    backend_fingerprint,
+    fingerprint_hex,
+)
+from mythril_tpu.compileplane.keys import artifact_key, bucket_key
+
+log = logging.getLogger(__name__)
+
+#: packs are read-only at serve time: never evicted by this process
+_PACK_CAPACITY = 1 << 30
+
+
+class CompilePlane:
+    """Process-wide load-before-compile / write-back-after facade."""
+
+    def __init__(
+        self,
+        cache_dir: Optional[str] = None,
+        pack_dirs: Tuple[str, ...] = (),
+        capacity: int = DEFAULT_CAPACITY,
+    ) -> None:
+        self.fingerprint = backend_fingerprint()
+        self.fp_hex = fingerprint_hex(self.fingerprint)
+        self.cache = (
+            ArtifactCache(cache_dir, capacity) if cache_dir else None
+        )
+        self.packs: List[ArtifactCache] = [
+            ArtifactCache(d, capacity=_PACK_CAPACITY)
+            for d in pack_dirs
+            if d
+        ]
+        self._mu = threading.Lock()
+        #: key -> loaded executable (mount_packs preloads; load fills)
+        self._mem: Dict[str, object] = {}
+        #: mounted-but-not-yet-dispatched keys: the FIRST lookup of a
+        #: mounted executable is a cold lookup the pack answered, and
+        #: books as a pack hit (hit_rate would otherwise read 0 on a
+        #: fully packed boot); later lookups are mem re-uses
+        self._mounted_cold: set = set()
+        # -- /stats counters -------------------------------------------
+        self.mem_hits = 0
+        self.pack_hits = 0
+        self.cache_hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.store_failures = 0
+        self.mounted = 0
+        self.mount_refused = 0
+        self.unsupported: Dict[str, int] = {}
+        self._load_s: List[float] = []
+
+    # -- plumbing --------------------------------------------------------
+    def usable(self) -> bool:
+        """Is there any point consulting this plane? (AOT on and at
+        least one artifact source configured.)"""
+        return aot.aot_enabled() and (
+            self.cache is not None or bool(self.packs)
+        )
+
+    @staticmethod
+    def _breaker():
+        from mythril_tpu.support import breaker as cb
+
+        if not cb.breakers_enabled():
+            return None
+        return cb.breaker(cb.TIER_COMPILEPLANE)
+
+    def note_unsupported(self, reason: str) -> None:
+        """Book one AOT capability miss, attributed by reason."""
+        with self._mu:
+            self.unsupported[reason] = self.unsupported.get(reason, 0) + 1
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().counter(
+                "mtpu_compileplane_unsupported_total",
+                "AOT export/import capability misses, by reason",
+            ).labels(reason=reason).inc()
+        except Exception:
+            pass
+
+    def _observe_load(self, dt: float) -> None:
+        with self._mu:
+            self._load_s.append(dt)
+            if len(self._load_s) > 4096:
+                del self._load_s[: len(self._load_s) // 2]
+        try:
+            from mythril_tpu.observe.registry import registry
+
+            registry().histogram(
+                "mtpu_compileplane_load_seconds",
+                "AOT executable deserialize+load wall per artifact",
+            ).observe(dt)
+        except Exception:
+            pass
+
+    def key_for(self, phases, digest: str) -> str:
+        return artifact_key(bucket_key(phases), digest, self.fp_hex)
+
+    def preloaded(self, phases, digest: str) -> bool:
+        """Is this entry already resident (mounted or loaded)? The
+        service's readiness fast path asks this about the warmup
+        entry."""
+        with self._mu:
+            return self.key_for(phases, digest) in self._mem
+
+    # -- the load-before-compile path ------------------------------------
+    def load(self, phases, digest: str):
+        """The executable for (bucket, entry) or None — from the
+        in-memory mount table, then the packs, then the cache. Every
+        refusal (checksum, schema, fingerprint) is a recompile-shaped
+        miss, never a mis-load."""
+        if not aot.aot_enabled():
+            self.note_unsupported(aot.REASON_DISABLED)
+            return None
+        key = self.key_for(phases, digest)
+        with self._mu:
+            hit = self._mem.get(key)
+            if hit is not None:
+                if key in self._mounted_cold:
+                    self._mounted_cold.discard(key)
+                    self.pack_hits += 1
+                else:
+                    self.mem_hits += 1
+                return hit
+        br = self._breaker()
+        if br is not None and not br.allow():
+            with self._mu:
+                self.misses += 1
+            return None
+        found = None
+        from_pack = False
+        try:
+            from mythril_tpu.support.resilience import inject
+
+            inject("compileplane.read")
+            for source in self.packs:
+                found = source.read(key, expected_fp=self.fp_hex)
+                if found is not None:
+                    from_pack = True
+                    break
+            if found is None and self.cache is not None:
+                found = self.cache.read(key, expected_fp=self.fp_hex)
+        except Exception as why:
+            if br is not None:
+                br.record_failure(str(why))
+            with self._mu:
+                self.misses += 1
+            return None
+        if found is None:
+            with self._mu:
+                self.misses += 1
+            if br is not None:
+                br.record_success()
+            return None
+        _header, payload = found
+        t0 = time.perf_counter()
+        try:
+            executable = aot.load_serialized(payload)
+        except aot.AotUnsupported as why:
+            self.note_unsupported(why.reason)
+            with self._mu:
+                self.misses += 1
+            return None
+        self._observe_load(time.perf_counter() - t0)
+        with self._mu:
+            self._mem[key] = executable
+            if from_pack:
+                self.pack_hits += 1
+            else:
+                self.cache_hits += 1
+        if br is not None:
+            br.record_success()
+        return executable
+
+    # -- the write-back-after path ---------------------------------------
+    def store(
+        self, phases, digest: str, compiled, extra: Optional[Dict] = None
+    ) -> Optional[str]:
+        """Serialize + persist one freshly compiled executable into
+        the cache directory (packs are read-only at serve time);
+        returns the path or None — a failed store never sinks the
+        wave that compiled it."""
+        if self.cache is None:
+            return None
+        if not aot.aot_enabled():
+            self.note_unsupported(aot.REASON_DISABLED)
+            return None
+        br = self._breaker()
+        if br is not None and not br.allow():
+            return None
+        try:
+            payload = aot.serialize_compiled(compiled)
+            # trial roundtrip before persisting: XLA:CPU serializes an
+            # executable it LOADED from the jax persistent compilation
+            # cache into a stub missing its function symbols
+            # ("Symbols not found" on deserialize) — such a blob must
+            # never reach disk, where every consumer would refuse it
+            aot.load_serialized(payload)
+        except aot.AotUnsupported as why:
+            # a capability miss, not tier sickness: attributed, no trip
+            self.note_unsupported(why.reason)
+            return None
+        key = self.key_for(phases, digest)
+        path = self.cache.write(
+            key,
+            bucket_key(phases),
+            digest,
+            self.fingerprint,
+            self.fp_hex,
+            payload,
+            extra=extra,
+        )
+        if path is None:
+            with self._mu:
+                self.store_failures += 1
+            if br is not None:
+                br.record_failure("artifact write failed")
+            return None
+        with self._mu:
+            self.stores += 1
+            self._mem[key] = compiled
+        if br is not None:
+            br.record_success()
+        return path
+
+    # -- pack mounting ---------------------------------------------------
+    def mount_packs(self) -> Dict:
+        """Pre-deserialize every fingerprint-matching pack artifact
+        into the in-memory table, so packed buckets dispatch without
+        touching disk OR the compiler. Called synchronously at `myth
+        serve` boot, BEFORE the server binds — the boot order the
+        pack-readiness contract pins (tests/service). Mismatched or
+        corrupt artifacts are refused and counted; the replica serves
+        anyway (those buckets compile in-process as before)."""
+        if self.packs and not aot.aot_enabled():
+            # --no-aot / MYTHRIL_NO_AOT wins over --kernel-pack: the
+            # pack is ignored with an attributed reason, not half-used
+            self.note_unsupported(aot.REASON_DISABLED)
+            log.info("kernel packs present but AOT is disabled; ignoring")
+            return {
+                "packs": [p.dir for p in self.packs],
+                "mounted": 0,
+                "refused": 0,
+                "disabled": True,
+            }
+        mounted = refused = 0
+        for pack in self.packs:
+            for key in pack.keys():
+                got = pack.read(key, expected_fp=self.fp_hex)
+                if got is None:
+                    refused += 1
+                    continue
+                _header, payload = got
+                t0 = time.perf_counter()
+                try:
+                    executable = aot.load_serialized(payload)
+                except aot.AotUnsupported as why:
+                    self.note_unsupported(why.reason)
+                    refused += 1
+                    continue
+                self._observe_load(time.perf_counter() - t0)
+                with self._mu:
+                    if key not in self._mem:
+                        self._mem[key] = executable
+                        self._mounted_cold.add(key)
+                        mounted += 1
+        with self._mu:
+            self.mounted += mounted
+            self.mount_refused += refused
+        summary = {
+            "packs": [p.dir for p in self.packs],
+            "mounted": mounted,
+            "refused": refused,
+        }
+        if self.packs:
+            log.info(
+                "kernel packs mounted: %d executable(s) resident, "
+                "%d refused", mounted, refused,
+            )
+        return summary
+
+    # -- introspection ---------------------------------------------------
+    def hit_rate(self) -> float:
+        """Fraction of cold lookups the packs answered — the bench's
+        `kernel_pack_hit_rate` (mem hits excluded: those are re-uses
+        of an already-answered lookup)."""
+        with self._mu:
+            total = self.pack_hits + self.cache_hits + self.misses
+            return self.pack_hits / total if total else 0.0
+
+    def load_p50_s(self) -> float:
+        with self._mu:
+            if not self._load_s:
+                return 0.0
+            ordered = sorted(self._load_s)
+            return ordered[len(ordered) // 2]
+
+    def stats(self) -> Dict:
+        with self._mu:
+            unsupported = dict(self.unsupported)
+            out = {
+                "enabled": aot.aot_enabled(),
+                "fingerprint": self.fp_hex,
+                "cache_dir": self.cache.dir if self.cache else None,
+                "pack_dirs": [p.dir for p in self.packs],
+                "resident": len(self._mem),
+                "mounted": self.mounted,
+                "mount_refused": self.mount_refused,
+                "mem_hits": self.mem_hits,
+                "pack_hits": self.pack_hits,
+                "cache_hits": self.cache_hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "store_failures": self.store_failures,
+                "unsupported": unsupported,
+            }
+        out["kernel_pack_hit_rate"] = round(self.hit_rate(), 4)
+        out["aot_load_p50_s"] = round(self.load_p50_s(), 6)
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the process-wide plane
+# ---------------------------------------------------------------------------
+_PLANE: Optional[CompilePlane] = None
+_PLANE_MU = threading.Lock()
+
+
+def configure_plane(
+    cache_dir: Optional[str] = None,
+    pack_dirs: Tuple[str, ...] = (),
+    capacity: int = DEFAULT_CAPACITY,
+) -> Optional[CompilePlane]:
+    """Install the process-wide plane (replacing any previous one);
+    None — and no plane — when neither a cache directory nor a pack
+    is configured."""
+    global _PLANE
+    with _PLANE_MU:
+        if not cache_dir and not any(pack_dirs):
+            _PLANE = None
+            return None
+        _PLANE = CompilePlane(
+            cache_dir=cache_dir,
+            pack_dirs=tuple(d for d in pack_dirs if d),
+            capacity=capacity,
+        )
+        return _PLANE
+
+
+def active_plane() -> Optional[CompilePlane]:
+    return _PLANE
+
+
+def install_plane(plane: Optional[CompilePlane]) -> Optional[CompilePlane]:
+    """Swap the process plane, returning the previous one (the bake
+    CLI scopes a pack-directory plane around its compiles)."""
+    global _PLANE
+    with _PLANE_MU:
+        previous = _PLANE
+        _PLANE = plane
+        return previous
+
+
+def reset_plane() -> None:
+    """Test hook: forget the plane (artifacts stay on disk)."""
+    install_plane(None)

@@ -80,6 +80,7 @@ class FleetConfig:
         journal_dir: Optional[str] = None,
         recover: bool = False,
         store_dir: Optional[str] = None,
+        kernel_pack_dir: Optional[str] = None,
     ) -> None:
         if not replica_urls:
             raise ValueError("a fleet needs at least one --replica URL")
@@ -100,6 +101,11 @@ class FleetConfig:
         #: front surfaces it in /fleet/stats so an operator can see
         #: the fleet is actually sharing one)
         self.store_dir = store_dir
+        #: the fleet-shared prebaked kernel-pack directory (same
+        #: contract as store_dir: replicas mount it via `myth serve
+        #: --kernel-pack`; surfaced in /fleet/stats so an operator can
+        #: see every replica boots warm from the same pack)
+        self.kernel_pack_dir = kernel_pack_dir
 
 
 class FleetJob:
@@ -829,6 +835,7 @@ class FleetFront:
                 "jobs": jobs_by_state,
                 "tracked_jobs": len(self._jobs),
                 "store_dir": self.cfg.store_dir,
+                "kernel_pack_dir": self.cfg.kernel_pack_dir,
             }
         return {
             "schema_version": FLEET_STATS_SCHEMA_VERSION,

@@ -62,6 +62,7 @@ COMMAND_LIST = (
         "serve",
         "fleet",
         "watch",
+        "kernels",
         "submit",
         "solverlab",
         "observe",
@@ -1036,6 +1037,39 @@ def build_parser() -> ArgumentParser:
             "rates, mtpu_health_state, mtpu_device_* gauges)"
         ),
     )
+    serve.add_argument(
+        "--kernel-pack",
+        default=None,
+        metavar="DIR",
+        help=(
+            "prebaked kernel pack (`myth kernels bake`): mounted "
+            "synchronously at boot, before the server binds, so "
+            "packed buckets dispatch with ZERO in-process compiles "
+            "and /healthz readiness clears without waiting out the "
+            "compile clock; share one DIR across replicas"
+        ),
+    )
+    serve.add_argument(
+        "--kernel-cache",
+        default=None,
+        metavar="DIR",
+        help=(
+            "persistent compile-artifact cache (env "
+            "MYTHRIL_KERNEL_CACHE): every kernel compiled in-process "
+            "is AOT-exported here and loaded back on the next boot "
+            "instead of recompiling; safe to share across replicas "
+            "(content-addressed, atomic writes)"
+        ),
+    )
+    serve.add_argument(
+        "--no-aot",
+        action="store_true",
+        help=(
+            "disable AOT export/import (env MYTHRIL_NO_AOT=1): every "
+            "compile site uses the plain in-process jit path — the "
+            "parity-differential baseline for a suspected AOT bug"
+        ),
+    )
 
     fleet = subparsers.add_parser(
         "fleet",
@@ -1142,6 +1176,124 @@ def build_parser() -> ArgumentParser:
             "surfaced in /fleet/stats so operators can verify the "
             "fleet shares one)"
         ),
+    )
+    fleet.add_argument(
+        "--kernel-pack",
+        default=None,
+        metavar="DIR",
+        help=(
+            "the fleet-shared prebaked kernel-pack directory (same "
+            "contract as --store: replicas mount it via `myth serve "
+            "--kernel-pack`; surfaced in /fleet/stats so operators "
+            "can verify every replica boots warm from one pack)"
+        ),
+    )
+
+    kernels = subparsers.add_parser(
+        "kernels",
+        help=(
+            "Kernel-pack tooling over the persistent compile plane: "
+            "bake hot specialization buckets into a prebaked pack "
+            "ahead of time (bake), preflight-load a pack under this "
+            "backend fingerprint (warm), inspect artifacts (ls), and "
+            "LRU-trim / drop stale artifacts (gc). A baked pack "
+            "mounts at `myth serve --kernel-pack DIR` for "
+            "zero-compile cold starts"
+        ),
+    )
+    kernels.add_argument(
+        "kernels_mode",
+        choices=["bake", "warm", "ls", "gc"],
+        metavar="MODE",
+        help="bake | warm | ls | gc",
+    )
+    kernels.add_argument(
+        "pack_dir",
+        metavar="DIR",
+        help="the pack directory (created by bake if missing)",
+    )
+    kernels.add_argument(
+        "--corpus",
+        action="append",
+        default=None,
+        metavar="PATH",
+        help=(
+            "bake: contract file or directory (hex or raw EVM bytes) "
+            "to mine specialization buckets from; repeatable"
+        ),
+    )
+    kernels.add_argument(
+        "--routing",
+        action="append",
+        default=None,
+        metavar="FILE",
+        help=(
+            "bake: routing_features.jsonl from a running service "
+            "(--observe-out): rows carrying a phase_bucket feature "
+            "contribute their buckets; repeatable"
+        ),
+    )
+    kernels.add_argument(
+        "--buckets",
+        action="append",
+        default=None,
+        metavar="FILE",
+        help=(
+            "bake: explicit bucket-list JSON (a list — or "
+            '{"buckets": [...]} — of bucket records as `myth kernels '
+            "ls` prints them); repeatable"
+        ),
+    )
+    kernels.add_argument(
+        "--stripes",
+        type=int,
+        default=4,
+        help="bake: target arena stripes (match the serve flags)",
+    )
+    kernels.add_argument(
+        "--lanes-per-stripe",
+        type=int,
+        default=8,
+        help="bake: target device lanes per stripe",
+    )
+    kernels.add_argument(
+        "--steps-per-wave",
+        type=int,
+        default=256,
+        help="bake: target EVM steps per wave",
+    )
+    kernels.add_argument(
+        "--code-cap",
+        type=int,
+        default=2048,
+        help="bake: target code-capacity floor (pow2-bucketed)",
+    )
+    kernels.add_argument(
+        "--generic-only",
+        action="store_true",
+        help=(
+            "bake: only the generic interpreter kernel (no bucket "
+            "mining) — covers the arena warmup and unspecialized "
+            "waves, the minimum useful pack"
+        ),
+    )
+    kernels.add_argument(
+        "--capacity",
+        type=int,
+        default=256,
+        help="gc: artifact count to LRU-trim the directory down to",
+    )
+    kernels.add_argument(
+        "--drop-stale",
+        action="store_true",
+        help=(
+            "gc: also unlink artifacts whose fingerprint does not "
+            "match this backend (orphaned by a toolchain upgrade)"
+        ),
+    )
+    kernels.add_argument(
+        "--json", action="store_true", help="emit machine-readable JSON",
+        dest="kernels_json",
     )
 
     watch = subparsers.add_parser(
@@ -2035,6 +2187,12 @@ def _cmd_serve(args: Namespace) -> None:
         from mythril_tpu.support.support_args import args as support_args
 
         support_args.breakers = False
+    if args.no_aot:
+        # the process-wide switch: wave_run/SpecializedKernel consult
+        # aot_enabled() below the engine config
+        from mythril_tpu.support.support_args import args as support_args
+
+        support_args.aot = False
     config = ServiceConfig(
         stripes=args.stripes,
         lanes_per_stripe=args.lanes_per_stripe,
@@ -2063,6 +2221,12 @@ def _cmd_serve(args: Namespace) -> None:
         recover=args.recover,
         breakers=not args.no_breakers,
         quarantine_strikes=args.quarantine_strikes,
+        kernel_pack=args.kernel_pack,
+        kernel_cache_dir=(
+            args.kernel_cache
+            or os.environ.get("MYTHRIL_KERNEL_CACHE")
+            or None
+        ),
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()
@@ -2089,8 +2253,69 @@ def _cmd_fleet(args: Namespace) -> None:
         journal_dir=args.journal,
         recover=args.recover,
         store_dir=args.store,
+        kernel_pack_dir=args.kernel_pack,
     )
     serve_fleet(config, host=args.host, port=args.port)
+    sys.exit()
+
+
+def _cmd_kernels(args: Namespace) -> None:
+    """`myth kernels bake|warm|ls|gc`: kernel-pack tooling over the
+    persistent compile plane (compileplane/pack.py holds the logic)."""
+    from mythril_tpu.compileplane import pack as kpack
+
+    def _emit(doc: Dict) -> None:
+        print(json.dumps(doc, sort_keys=True, indent=None
+                         if args.kernels_json else 2))
+
+    if args.kernels_mode == "bake":
+        buckets = (
+            [None]
+            if args.generic_only
+            else kpack.mine_buckets(
+                corpus=args.corpus or (),
+                routing=args.routing or (),
+                bucket_files=args.buckets or (),
+            )
+        )
+        log.info(
+            "baking %d bucket(s) for a %dx%d arena",
+            len(buckets), args.stripes, args.lanes_per_stripe,
+        )
+
+        def _progress(row: Dict) -> None:
+            log.info(
+                "baked %s donate=%s in %.1fs",
+                row["bucket"], row["donate"], row["wall_s"],
+            )
+
+        manifest = kpack.bake_service_pack(
+            args.pack_dir,
+            buckets,
+            stripes=args.stripes,
+            lanes_per_stripe=args.lanes_per_stripe,
+            steps_per_wave=args.steps_per_wave,
+            code_cap=args.code_cap,
+            progress=_progress,
+        )
+        _emit(manifest)
+    elif args.kernels_mode == "warm":
+        report = kpack.verify_pack(args.pack_dir)
+        _emit(report)
+        if report["refused"] and not report["loadable"]:
+            # nothing in the pack loads under this backend: the
+            # deploy preflight should fail loudly, not mount a no-op
+            sys.exit(1)
+    elif args.kernels_mode == "ls":
+        _emit(kpack.list_pack(args.pack_dir))
+    elif args.kernels_mode == "gc":
+        _emit(
+            kpack.gc_pack(
+                args.pack_dir,
+                capacity=args.capacity,
+                drop_stale=args.drop_stale,
+            )
+        )
     sys.exit()
 
 
@@ -2414,6 +2639,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_fleet(args)
     if args.command == "watch":
         _cmd_watch(args)
+    if args.command == "kernels":
+        _cmd_kernels(args)
     if args.command == "submit":
         _cmd_submit(args)
     if args.command == "solverlab":

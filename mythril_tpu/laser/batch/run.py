@@ -57,6 +57,114 @@ run_donated = functools.partial(
     donate_argnums=(0,))(_run_impl)
 
 
+# ---------------------------------------------------------------------------
+# the compile-plane-aware generic wave entry
+# ---------------------------------------------------------------------------
+#: entry digest -> AOT executable. `.lower().compile()` does NOT
+#: populate a jit object's dispatch cache, so plane-loaded/compiled
+#: executables dispatch through this map, never by re-calling `run`
+#: (which would silently recompile).
+_AOT_GENERIC = {}
+#: in-process trace+compiles of the generic wave entry THROUGH the
+#: plane path (the pack smoke asserts this stays 0 on a packed boot)
+_GENERIC_COMPILES = 0
+
+
+def _active_plane():
+    try:
+        from mythril_tpu.compileplane.plane import active_plane
+    except Exception:
+        return None
+    plane = active_plane()
+    if plane is None or not plane.usable():
+        return None
+    return plane
+
+
+def wave_run(batch: StateBatch, code: CodeTable, max_steps: int = 4096,
+             unroll: int = 1, track_coverage: bool = True,
+             donate: bool = False):
+    """The generic wave entry the service dispatches: consult the
+    compile plane (compileplane/plane.py) before compiling in-process,
+    write back after. With no plane configured — or AOT unsupported —
+    this is exactly `run`/`run_donated`, bit for bit."""
+    fn = run_donated if donate else run
+    statics = {
+        "max_steps": int(max_steps),
+        "unroll": int(unroll),
+        "track_coverage": bool(track_coverage),
+    }
+    plane = _active_plane()
+    if plane is None:
+        return fn(batch, code, **statics)
+    from mythril_tpu.compileplane import aot
+    from mythril_tpu.compileplane.keys import entry_digest
+
+    digest = entry_digest("generic", donate, statics, (batch, code))
+    cached = _AOT_GENERIC.get(digest)
+    if cached is not None:
+        return cached(batch, code)
+    loaded = plane.load(None, digest)
+    if loaded is not None:
+        _AOT_GENERIC[digest] = loaded
+        return loaded(batch, code)
+    global _GENERIC_COMPILES
+    _GENERIC_COMPILES += 1
+    try:
+        compiled = fn.lower(batch, code, **statics).compile()
+    except Exception:
+        # AOT lowering failed where plain jit might still work: an
+        # attributed capability miss, then today's path
+        plane.note_unsupported(aot.REASON_LOWER)
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "generic AOT lower/compile failed; jit fallback",
+            exc_info=True,
+        )
+        return fn(batch, code, **statics)
+    _AOT_GENERIC[digest] = compiled
+    plane.store(None, digest, compiled)
+    return compiled(batch, code)
+
+
+def wave_entry_digest(batch, code, max_steps: int, unroll: int = 1,
+                      track_coverage: bool = True,
+                      donate: bool = False) -> str:
+    """The entry digest `wave_run` would dispatch for these avals —
+    the service's pack-readiness probe asks the plane about it
+    without dispatching anything."""
+    from mythril_tpu.compileplane.keys import entry_digest
+
+    return entry_digest(
+        "generic",
+        donate,
+        {
+            "max_steps": int(max_steps),
+            "unroll": int(unroll),
+            "track_coverage": bool(track_coverage),
+        },
+        (batch, code),
+    )
+
+
+def generic_aot_stats() -> dict:
+    """{entries, compiles} of the generic plane path (test/smoke
+    introspection)."""
+    return {
+        "entries": len(_AOT_GENERIC),
+        "compiles": _GENERIC_COMPILES,
+    }
+
+
+def clear_aot_generic() -> None:
+    """Test hook: drop the AOT dispatch map and reset the compile
+    counter."""
+    global _GENERIC_COMPILES
+    _AOT_GENERIC.clear()
+    _GENERIC_COMPILES = 0
+
+
 def run_resilient(
     batch: StateBatch,
     code: CodeTable,

@@ -597,9 +597,20 @@ class SpecializedKernel:
 
         self.phases = phases
         self.refs = 0
+        #: in-flight background-warmup pins (KernelCache.pin_warmup):
+        #: capacity eviction may UNMAP a warmup-pinned entry but must
+        #: not drop its executables under the compiling thread
+        self.warm_refs = 0
         self.calls = 0
         self.compile_s = 0.0
         self._warm = set()
+        #: entry digest -> AOT executable (compile plane). AOT
+        #: executables never dispatch through the jit objects —
+        #: `.lower().compile()` does not populate the jit cache, so a
+        #: loaded artifact re-entering `fn(...)` would recompile.
+        self._aot: Dict = {}
+        self.plane_hits = 0
+        self.plane_stores = 0
         self._run = jax.jit(
             _spec_run_impl,
             static_argnames=("max_steps", "track_coverage", "phases"),
@@ -691,6 +702,66 @@ class SpecializedKernel:
     def is_warm(self, key) -> bool:
         return key in self._warm
 
+    @staticmethod
+    def _plane():
+        """The active compile plane, or None (not configured, AOT
+        off, or the package itself unavailable)."""
+        try:
+            from mythril_tpu.compileplane.plane import active_plane
+        except Exception:
+            return None
+        plane = active_plane()
+        if plane is None or not plane.usable():
+            return None
+        return plane
+
+    def _dispatch(self, kind, donate, fn, key, dyn, statics):
+        """One wave dispatch through the compile-plane ladder:
+        AOT-map hit -> plane load -> AOT compile + write-back -> plain
+        jit (plane off / AOT unsupported). The jit path is exactly
+        today's behavior; the AOT paths dispatch the SAME lowered
+        program through its own executable handle."""
+        plane = self._plane()
+        digest = None
+        if plane is not None:
+            from mythril_tpu.compileplane.keys import entry_digest
+
+            digest = entry_digest(kind, donate, statics, dyn)
+            cached = self._aot.get(digest)
+            if cached is not None:
+                self.calls += 1
+                return cached(*dyn)
+            if key not in self._warm:
+                loaded = plane.load(self.phases, digest)
+                if loaded is not None:
+                    self._aot[digest] = loaded
+                    self._warm.add(key)
+                    self.calls += 1
+                    self.plane_hits += 1
+                    return loaded(*dyn)
+        full_statics = dict(statics, phases=self.phases)
+        if plane is None or key in self._warm:
+            return self._timed(key, fn, *dyn, **full_statics)
+
+        def _compile_and_run():
+            from mythril_tpu.compileplane import aot as _aot
+
+            try:
+                compiled = fn.lower(*dyn, **full_statics).compile()
+            except Exception:
+                plane.note_unsupported(_aot.REASON_LOWER)
+                log.debug(
+                    "AOT lower/compile failed for %s; jit fallback",
+                    kind, exc_info=True,
+                )
+                return fn(*dyn, **full_statics)
+            self._aot[digest] = compiled
+            if plane.store(self.phases, digest, compiled) is not None:
+                self.plane_stores += 1
+            return compiled(*dyn)
+
+        return self._timed(key, _compile_and_run)
+
     def run(self, batch, code, fuse, max_steps, track_coverage=True,
             donate=False):
         """(out, full_steps, substep_lane_steps, blocks_entered) —
@@ -701,9 +772,12 @@ class SpecializedKernel:
             raise RuntimeError("specialized kernel was dropped")
         fn = self._run_donated if donate else self._run
         key = self.run_key(batch, code, donate)
-        return self._timed(
-            key, fn, batch, code, fuse, max_steps=max_steps,
-            track_coverage=track_coverage, phases=self.phases,
+        return self._dispatch(
+            "run", donate, fn, key, (batch, code, fuse),
+            {
+                "max_steps": int(max_steps),
+                "track_coverage": bool(track_coverage),
+            },
         )
 
     def sym_run(self, symb, code, fuse, max_steps, donate=False):
@@ -715,9 +789,9 @@ class SpecializedKernel:
         base = symb.base
         key = ("sym", donate, base.pc.shape[0], base.mem.shape[1],
                base.stack.shape[1], code.ops.shape)
-        return self._timed(
-            key, fn, symb, code, fuse, max_steps=max_steps,
-            phases=self.phases,
+        return self._dispatch(
+            "sym", donate, fn, key, (symb, code, fuse),
+            {"max_steps": int(max_steps)},
         )
 
     def drop(self) -> None:
@@ -726,6 +800,7 @@ class SpecializedKernel:
         entry."""
         self._run = self._run_donated = None
         self._sym = self._sym_donated = None
+        self._aot.clear()
 
 
 _CACHE_MU = threading.Lock()
@@ -747,6 +822,12 @@ class KernelCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: entries evicted from the map while a background warmup
+        #: compile still held them (mtpu_kernel_cache_inflight_
+        #: evictions_total): the drop is DEFERRED to release_warmup —
+        #: re-pin-or-discard is deterministic, never a mid-compile
+        #: use-after-drop
+        self.inflight_evictions = 0
 
     def get(self, phases: PhaseSet) -> SpecializedKernel:
         from mythril_tpu.observe.registry import registry
@@ -786,14 +867,47 @@ class KernelCache:
             return
         with _CACHE_MU:
             kernel.refs = max(0, kernel.refs - 1)
-            if kernel.refs == 0 and kernel.phases not in self._entries:
+            if (
+                kernel.refs == 0
+                and kernel.warm_refs == 0
+                and self._entries.get(kernel.phases) is not kernel
+            ):
                 # last pin of an evicted entry: executables go now
                 kernel.drop()
             else:
                 self._evict_over_capacity()
 
+    def pin_warmup(self, kernel: SpecializedKernel) -> SpecializedKernel:
+        """Pin `kernel` for the duration of a background warmup
+        compile. A warmup pin does NOT protect the entry's cache slot
+        (capacity pressure may still unmap it — the service must not
+        be able to wedge the cache full of half-warm buckets), but it
+        DOES defer the executable drop until release_warmup: the
+        compiling thread's handle stays valid deterministically."""
+        with _CACHE_MU:
+            kernel.warm_refs += 1
+        return kernel
+
+    def release_warmup(self, kernel: Optional[SpecializedKernel]) -> None:
+        """The warmup thread's release: if the entry was evicted
+        mid-compile (and nothing else pins it), the freshly compiled
+        executables are discarded HERE — the deterministic
+        discard-and-release half of the re-pin-or-discard contract."""
+        if kernel is None:
+            return
+        with _CACHE_MU:
+            kernel.warm_refs = max(0, kernel.warm_refs - 1)
+            if (
+                kernel.warm_refs == 0
+                and kernel.refs == 0
+                and self._entries.get(kernel.phases) is not kernel
+            ):
+                kernel.drop()
+
     def _evict_over_capacity(self) -> None:
-        # under _CACHE_MU; pinned entries are skipped, not dropped
+        # under _CACHE_MU; service-pinned entries are skipped, not
+        # dropped. A warmup-only-pinned entry IS unmapped (counted
+        # below) but its drop is deferred to release_warmup.
         over = len(self._entries) - self.capacity
         if over <= 0:
             return
@@ -804,9 +918,22 @@ class KernelCache:
             if kernel.refs > 0:
                 continue
             del self._entries[phases]
-            kernel.drop()
             self.evictions += 1
             over -= 1
+            if kernel.warm_refs > 0:
+                self.inflight_evictions += 1
+                try:
+                    from mythril_tpu.observe.registry import registry
+
+                    registry().counter(
+                        "mtpu_kernel_cache_inflight_evictions_total",
+                        "buckets evicted while their background "
+                        "warmup compile was still in flight",
+                    ).inc()
+                except Exception:
+                    pass
+            else:
+                kernel.drop()
 
     def stats(self) -> Dict:
         with _CACHE_MU:
@@ -817,10 +944,13 @@ class KernelCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "inflight_evictions": self.inflight_evictions,
                 "pinned": sum(1 for k in entries if k.refs > 0),
                 "compiles": sum(k.compiles for k in entries),
                 "compiles_in_flight": _COMPILING,
                 "compile_s": round(sum(k.compile_s for k in entries), 3),
+                "plane_hits": sum(k.plane_hits for k in entries),
+                "plane_stores": sum(k.plane_stores for k in entries),
             }
 
     def clear(self) -> None:
@@ -829,6 +959,7 @@ class KernelCache:
                 kernel.drop()
             self._entries.clear()
             self.hits = self.misses = self.evictions = 0
+            self.inflight_evictions = 0
 
 
 _KERNELS = KernelCache()

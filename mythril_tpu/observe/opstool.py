@@ -53,6 +53,11 @@ STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     # in-process leg, so the gate is loose — it catches the triage or
     # alert path gaining an order of magnitude, not scheduler wobble
     ("alert_p50_s", "lower", 0.50),
+    # compile plane (ISSUE 17): pack hits over pack-consulting lookups
+    # on the bench's bake->mount->first-wave leg — deterministic 1.0,
+    # any drop means the artifact load path broke (absent in pre-r08
+    # records: non-numeric values are exempt from the gate)
+    ("kernel_pack_hit_rate", "higher", 0.10),
     ("static_answer_rate", "higher", 0.25),
     ("static_prune_rate", "higher", 0.50),
     ("screen_mount_rate_semantic", "lower", 0.25),

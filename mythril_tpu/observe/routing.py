@@ -198,6 +198,13 @@ def features_for(code_hex: str, summary=None) -> Dict:
         )
         feats["phase_bucket_pruned"] = len(phases.pruned)
         feats["fuse_profitable"] = bool(phases.fuse_depth)
+        # the FULL specialization bucket (not just its size): `myth
+        # kernels bake --routing` mines these rows to prebake the
+        # kernels live traffic actually dispatched (features are
+        # open-ended — absent in old records reads as None)
+        from mythril_tpu.compileplane.keys import bucket_key
+
+        feats["phase_bucket"] = bucket_key(phases)
     except Exception:
         pass
     return feats

@@ -116,6 +116,8 @@ class ServiceConfig:
         journal_fsync: bool = True,
         breakers: bool = True,
         quarantine_strikes: int = 2,
+        kernel_pack: Optional[str] = None,
+        kernel_cache_dir: Optional[str] = None,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -210,6 +212,15 @@ class ServiceConfig:
         #: isolated to a SOLO wave so a poison contract cannot take
         #: innocent neighbors down with it.
         self.quarantine_strikes = max(1, int(quarantine_strikes))
+        #: persistent compile plane (mythril_tpu/compileplane):
+        #: `kernel_pack` (`myth serve --kernel-pack DIR`) mounts a
+        #: prebaked kernel pack at boot — packed buckets dispatch
+        #: AOT-loaded executables with zero in-process compiles;
+        #: `kernel_cache_dir` (`--kernel-cache DIR`) adds a read-write
+        #: artifact cache every compile writes back into, so the NEXT
+        #: replica on this (fleet-shared) directory starts warm.
+        self.kernel_pack = kernel_pack
+        self.kernel_cache_dir = kernel_cache_dir
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -798,8 +809,39 @@ class AnalysisEngine:
         # objective burn with this engine's lifecycle facts into the
         # ok/degraded/redlined machine /healthz and mtpu_health_state
         # export. Warming is set immediately when arena warmup is off.
+        # -- persistent compile plane (mythril_tpu/compileplane) -------
+        # mounted SYNCHRONOUSLY, before the health monitor exists and
+        # before the server can bind: the boot order the pack
+        # readiness contract pins (mount -> serve -> ready). A pack
+        # failure degrades to plain in-process compiles — it must
+        # never stop the replica from serving.
+        self._pack_mounted: Dict = {}
+        if self.cfg.kernel_pack or self.cfg.kernel_cache_dir:
+            try:
+                from mythril_tpu.compileplane.plane import configure_plane
+
+                plane = configure_plane(
+                    cache_dir=self.cfg.kernel_cache_dir,
+                    pack_dirs=(
+                        (self.cfg.kernel_pack,)
+                        if self.cfg.kernel_pack
+                        else ()
+                    ),
+                )
+                if plane is not None and self.cfg.kernel_pack:
+                    self._pack_mounted = plane.mount_packs()
+            except Exception:
+                log.warning(
+                    "kernel pack mount failed; compiling in-process",
+                    exc_info=True,
+                )
         self._warm_done = threading.Event()
-        if not self.cfg.arena_warmup:
+        if not self.cfg.arena_warmup or self._pack_covers_warmup():
+            # no warmup configured — or the mounted pack already holds
+            # the generic warmup executable for this dispatch shape:
+            # a pack-warmed replica is ready as soon as the pack is
+            # mounted, it does not wait out a compile clock that will
+            # never tick
             self._warm_done.set()
         self.health = observe.HealthMonitor(
             warming_fn=lambda: not self._warm_done.is_set(),
@@ -920,36 +962,73 @@ class AnalysisEngine:
                 reasons.extend(cb.open_reasons())
         return reasons
 
+    def _warmup_batch(self):
+        """The all-halt batch of the exact dispatch shape — shared by
+        the warmup wave and the pack-coverage probe (identical avals
+        by construction)."""
+        from mythril_tpu.laser.batch.state import make_batch
+
+        n = self.alloc.n_lanes
+        return make_batch(
+            n,
+            code_ids=np.full((n,), self.cfg.stripes, np.int32),
+            calldata=[b""] * n,
+            caller=DEFAULT_CALLER,
+            address=DEFAULT_ADDRESS,
+            timestamp=0x5BFA4639,
+            number=0x66E393,
+            gasprice=0x773594000,
+        )
+
+    def _pack_covers_warmup(self) -> bool:
+        """Did the pack mount pre-load the generic wave executable for
+        THIS engine's dispatch shape? Then mounting WAS the warmup:
+        the first wave dispatches an already-resident executable and
+        readiness can clear immediately (the `--no-arena-warmup` +
+        `--kernel-pack` interaction contract in tests/service)."""
+        if not self._pack_mounted.get("mounted"):
+            return False
+        try:
+            from mythril_tpu.compileplane.plane import active_plane
+            from mythril_tpu.laser.batch.run import wave_entry_digest
+
+            plane = active_plane()
+            if plane is None:
+                return False
+            digest = wave_entry_digest(
+                self._warmup_batch(),
+                self._table(),
+                max_steps=self.cfg.steps_per_wave,
+                track_coverage=True,
+                donate=False,
+            )
+            return plane.preloaded(None, digest)
+        except Exception:
+            log.debug("pack warmup-coverage probe failed", exc_info=True)
+            return False
+
     def _arena_warmup(self) -> None:
         """Compile the generic wave kernel OFF the serving path: one
         all-halt wave of the exact dispatch shape, so the first real
         request rides a warm executable and readiness truthfully says
-        when. Failure still flips readiness — a broken warmup must
-        not wedge the replica not-ready forever (the first real wave
-        will surface the fault with attribution)."""
+        when. With a kernel pack mounted, the wave entry loads from
+        the plane instead of compiling — seconds, not minutes.
+        Failure still flips readiness — a broken warmup must not
+        wedge the replica not-ready forever (the first real wave will
+        surface the fault with attribution)."""
         try:
             import jax
 
-            from mythril_tpu.laser.batch.run import run
-            from mythril_tpu.laser.batch.state import make_batch
+            from mythril_tpu.laser.batch.run import wave_run
 
-            n = self.alloc.n_lanes
-            batch = make_batch(
-                n,
-                code_ids=np.full((n,), self.cfg.stripes, np.int32),
-                calldata=[b""] * n,
-                caller=DEFAULT_CALLER,
-                address=DEFAULT_ADDRESS,
-                timestamp=0x5BFA4639,
-                number=0x66E393,
-                gasprice=0x773594000,
-            )
+            batch = self._warmup_batch()
             with trace("service.arena.warmup", track="service"):
-                _out, steps = run(
+                _out, steps = wave_run(
                     batch,
                     self._table(),
                     max_steps=self.cfg.steps_per_wave,
                     track_coverage=True,
+                    donate=False,
                 )
                 jax.block_until_ready(steps)
         except Exception:
@@ -1754,6 +1833,13 @@ class AnalysisEngine:
             else self._fuse_table
         )
         steps = self.cfg.steps_per_wave
+        # Warmup-pin the kernel so a capacity eviction racing this
+        # thread cannot drop() executables mid-compile: eviction may
+        # still unmap the bucket (counted inflight), but the discard is
+        # deferred to release_warmup below — deterministic either way.
+        from mythril_tpu.laser.batch import specialize as _spec
+
+        _spec.kernel_cache().pin_warmup(kernel)
 
         def _warm():
             try:
@@ -1770,6 +1856,8 @@ class AnalysisEngine:
                 jnp.asarray(out[1]).block_until_ready()
             except Exception:
                 log.debug("kernel warmup failed", exc_info=True)
+            finally:
+                _spec.kernel_cache().release_warmup(kernel)
 
         thread = threading.Thread(
             target=_warm, name="myth-kernel-warmup", daemon=True
@@ -1840,7 +1928,7 @@ class AnalysisEngine:
         in-flight record the harvest half consumes. The host-side
         inputs ride the record so a faulted dispatch can be rebuilt
         and retried through the synchronous resilience ladder."""
-        from mythril_tpu.laser.batch.run import run, run_donated
+        from mythril_tpu.laser.batch.run import wave_run
         from mythril_tpu.laser.batch.state import make_batch
         from mythril_tpu.support import resilience
 
@@ -1944,12 +2032,12 @@ class AnalysisEngine:
                     )
                 else:
                     self._c_generic_waves.inc()
-                    runner = run_donated if donate else run
-                    record["out"], record["steps"] = runner(
+                    record["out"], record["steps"] = wave_run(
                         batch,
                         table,
                         max_steps=self.cfg.steps_per_wave,
                         track_coverage=True,
+                        donate=donate,
                     )
         except Exception as why:
             if not resilience.is_device_fault(why):
@@ -1968,7 +2056,7 @@ class AnalysisEngine:
         group _rebalance feeds next)."""
         import jax
 
-        from mythril_tpu.laser.batch.run import run, run_donated
+        from mythril_tpu.laser.batch.run import wave_run
         from mythril_tpu.laser.batch.state import make_batch
         from mythril_tpu.support import resilience
 
@@ -2050,12 +2138,12 @@ class AnalysisEngine:
                     )
                 else:
                     self._c_generic_waves.inc()
-                    runner = run_donated if donate else run
-                    grec["out"], grec["steps"] = runner(
+                    grec["out"], grec["steps"] = wave_run(
                         batch,
                         table,
                         max_steps=self.cfg.steps_per_wave,
                         track_coverage=True,
+                        donate=donate,
                     )
             except Exception as why:
                 if not resilience.is_device_fault(why):
@@ -2640,6 +2728,23 @@ class AnalysisEngine:
         # the cache's own counters under their /stats names
         out["cache_hits"] = out.pop("hits")
         out["cache_misses"] = out.pop("misses")
+        # the compile plane's scorecard (/stats kernel.compileplane.*):
+        # pack/cache hit split, AOT load latency, pack mount outcome —
+        # the smoke reads generic_aot.compiles to prove a packed boot
+        # compiled nothing in-process.
+        try:
+            from mythril_tpu.compileplane.plane import active_plane
+            from mythril_tpu.laser.batch.run import generic_aot_stats
+
+            plane = active_plane()
+            out["compileplane"] = (
+                dict(plane.stats(), pack_mount=self._pack_mounted)
+                if plane is not None
+                else {"enabled": False}
+            )
+            out["generic_aot"] = generic_aot_stats()
+        except Exception:
+            out["compileplane"] = {"enabled": False}
         return out
 
     def _breaker_stats(self) -> Dict:

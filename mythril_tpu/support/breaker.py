@@ -59,7 +59,14 @@ TIER_DEVICE = "device"  # service/corpus device wave dispatch
 TIER_DEVICE_SOLVE = "device-solve"  # device-first solver funnel
 TIER_KERNEL = "kernel"  # specialize/blockjit kernel compile
 TIER_STORE = "store"  # verdict-store reads/writes
-TIERS = (TIER_DEVICE, TIER_DEVICE_SOLVE, TIER_KERNEL, TIER_STORE)
+TIER_COMPILEPLANE = "compileplane"  # AOT artifact cache/pack I/O
+TIERS = (
+    TIER_DEVICE,
+    TIER_DEVICE_SOLVE,
+    TIER_KERNEL,
+    TIER_STORE,
+    TIER_COMPILEPLANE,
+)
 
 #: the redline-vocabulary prefix (observe/slo.py REDLINE_BREAKER_OPEN)
 REASON_PREFIX = "breaker-open"

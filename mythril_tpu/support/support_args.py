@@ -110,6 +110,17 @@ class Args(object, metaclass=Singleton):
         # directory configured — the parity-differential baseline.
         self.store_dir = os.environ.get("MYTHRIL_STORE_DIR") or None
         self.store = True
+        # Persistent compile plane (mythril_tpu/compileplane, CLI
+        # --kernel-cache DIR / --kernel-pack DIR / --no-aot, env
+        # MYTHRIL_NO_AOT): AOT-export compiled wave kernels into a
+        # content-addressed artifact cache and load them back before
+        # compiling in-process. aot=False (or the env knob) degrades
+        # every compile site to today's in-process jit path — the
+        # parity-differential baseline for a suspected AOT bug.
+        self.aot = True
+        self.kernel_cache_dir = (
+            os.environ.get("MYTHRIL_KERNEL_CACHE") or None
+        )
         # Tier circuit breakers (support/breaker.py, CLI
         # --no-breakers): a persistently failing tier (device
         # dispatch, device-first solving, kernel compile, store I/O)

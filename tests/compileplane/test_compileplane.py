@@ -1,0 +1,612 @@
+"""Persistent AOT compile plane (ISSUE 17): the content-addressed
+artifact cache, the load-before-compile/write-back-after plane facade,
+prebaked kernel packs, and the degrade ladder.
+
+The acceptance bar: an artifact survives a cache roundtrip bit-for-bit;
+every refusal class (checksum, truncation, filename/key mismatch,
+newer schema, backend fingerprint) produces a recompile-shaped MISS and
+never a mis-load; a baked pack loads in a FRESH process and produces
+bit-identical wave results with zero in-process compiles; MYTHRIL_NO_AOT
+degrades every site to the plain jit path with the reason attributed;
+concurrent writers never interleave bytes; eviction is LRU-by-access;
+and an open TIER_COMPILEPLANE breaker routes every load/store around
+the directory. Everything runs on CPU JAX.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.compileplane import aot
+from mythril_tpu.compileplane.cache import (
+    ARTIFACT_SCHEMA_VERSION,
+    ArtifactCache,
+)
+from mythril_tpu.compileplane.fingerprint import (
+    backend_fingerprint,
+    fingerprint_hex,
+)
+from mythril_tpu.compileplane.keys import (
+    artifact_key,
+    bucket_key,
+    entry_digest,
+    phases_from_bucket,
+)
+from mythril_tpu.compileplane.pack import (
+    bake_service_pack,
+    gc_pack,
+    list_pack,
+    mine_buckets,
+    read_manifest,
+    verify_pack,
+)
+from mythril_tpu.compileplane.plane import (
+    CompilePlane,
+    active_plane,
+    configure_plane,
+    install_plane,
+    reset_plane,
+)
+from mythril_tpu.laser.batch import specialize as sp
+from mythril_tpu.laser.batch.run import (
+    clear_aot_generic,
+    generic_aot_stats,
+    run,
+    wave_entry_digest,
+    wave_run,
+)
+from mythril_tpu.laser.batch.state import make_batch, make_code_table
+from mythril_tpu.support import breaker as cb
+from mythril_tpu.support.resilience import arm_fault, disarm_faults
+from mythril_tpu.support.support_args import args as support_args
+
+pytestmark = pytest.mark.compileplane
+
+#: the tiny bake shape every pack test targets (one generic compile
+#: per session, amortized by the module-scoped fixture below)
+SHAPE = dict(stripes=2, lanes_per_stripe=2, steps_per_wave=32,
+             code_cap=32)
+
+WRITER = "6001600055600060015500"
+
+
+def _pack_arena(shape, codes=None):
+    """(batch, table) of the exact avals a SHAPE-configured engine
+    dispatches (rows = stripes + 1 — the halt row rides the table).
+    Values are free: the kernels are value-independent, so any codes
+    of the right row count share one executable."""
+    n = shape["n_lanes"]
+    batch = make_batch(
+        n,
+        code_ids=np.full((n,), shape["stripes"], np.int32),
+        calldata=[b""] * n,
+    )
+    rows = shape["stripes"] + 1
+    table = make_code_table(
+        (codes or [b"\x00"]) * rows, code_cap=shape["code_cap"]
+    )
+    return batch, table
+
+
+def _service_shape_dict():
+    from mythril_tpu.compileplane.pack import service_shape
+
+    return service_shape(**SHAPE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with no plane, no generic AOT map,
+    no armed faults, and a closed compileplane breaker."""
+    reset_plane()
+    clear_aot_generic()
+    disarm_faults()
+    cb.reset_all()
+    yield
+    reset_plane()
+    clear_aot_generic()
+    disarm_faults()
+    cb.reset_all()
+
+
+def _write_ok(cache, payload=b"payload-bytes", phases=None,
+              digest="d" * 24):
+    fp = backend_fingerprint()
+    fph = fingerprint_hex(fp)
+    key = artifact_key(bucket_key(phases), digest, fph)
+    path = cache.write(key, bucket_key(phases), digest, fp, fph, payload)
+    assert path is not None
+    return key, fph, payload
+
+
+# -- the artifact cache ------------------------------------------------------
+def test_artifact_roundtrip(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, payload = _write_ok(cache, b"\x00\x01binary\xff" * 100)
+    got = cache.read(key, expected_fp=fph)
+    assert got is not None
+    header, blob = got
+    assert blob == b"\x00\x01binary\xff" * 100
+    assert header["key"] == key
+    assert header["schema_version"] == ARTIFACT_SCHEMA_VERSION
+    assert header["fingerprint_hex"] == fph
+    assert header["bucket"] == {"kind": "generic"}
+    assert header["provenance"]["pid"] == os.getpid()
+    assert cache.hits == 1 and cache.corrupt == 0
+
+
+def test_missing_artifact_is_plain_miss(tmp_path):
+    """A vanished file is another replica's eviction, not corruption:
+    no corrupt counter, no log noise — the fleet-shared contract."""
+    cache = ArtifactCache(str(tmp_path))
+    assert cache.read("f" * 40) is None
+    assert cache.misses == 1 and cache.corrupt == 0
+
+
+def test_checksum_refusal_recompiles_never_loads(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, _ = _write_ok(cache)
+    path = cache._path(key)
+    raw = open(path, "rb").read()
+    # flip one payload byte past the header line
+    cut = raw.index(b"\n") + 2
+    with open(path, "wb") as fp:
+        fp.write(raw[:cut] + bytes([raw[cut] ^ 0xFF]) + raw[cut + 1:])
+    assert cache.read(key, expected_fp=fph) is None
+    assert cache.corrupt == 1 and cache.misses == 1
+
+
+def test_truncated_payload_refused(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, _ = _write_ok(cache)
+    path = cache._path(key)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as fp:
+        fp.write(raw[:-3])
+    assert cache.read(key, expected_fp=fph) is None
+    assert cache.corrupt == 1
+
+
+def test_moved_artifact_key_mismatch_refused(tmp_path):
+    """A renamed/copied artifact whose header key disagrees with its
+    filename is tampering, not a hit."""
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, _ = _write_ok(cache)
+    other = "0" * 40
+    os.rename(cache._path(key), cache._path(other))
+    assert cache.read(other, expected_fp=fph) is None
+    assert cache.corrupt == 1
+
+
+def test_newer_schema_refused(tmp_path):
+    """A rolled-back replica must refuse a newer writer's artifacts,
+    not misparse them."""
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, payload = _write_ok(cache)
+    path = cache._path(key)
+    raw = open(path, "rb").read()
+    header = json.loads(raw[: raw.index(b"\n")])
+    header["schema_version"] = ARTIFACT_SCHEMA_VERSION + 1
+    with open(path, "wb") as fp:
+        fp.write(json.dumps(header, sort_keys=True).encode())
+        fp.write(b"\n")
+        fp.write(payload)
+    assert cache.read(key, expected_fp=fph) is None
+    assert cache.corrupt == 1
+
+
+def test_fingerprint_mismatch_refused(tmp_path):
+    """An artifact from another jax/jaxlib/device is stale, never
+    loaded — the toolchain-upgrade safety rail."""
+    cache = ArtifactCache(str(tmp_path))
+    key, fph, _ = _write_ok(cache)
+    assert cache.read(key, expected_fp="not-this-backend") is None
+    assert cache.corrupt == 1
+    # same artifact under the right fingerprint still loads
+    assert cache.read(key, expected_fp=fph) is not None
+
+
+def test_lru_eviction_by_access(tmp_path):
+    cache = ArtifactCache(str(tmp_path), capacity=2)
+    keys = []
+    for i in range(3):
+        digest = f"{i:024d}"
+        key, fph, _ = _write_ok(cache, payload=b"x", digest=digest)
+        keys.append(key)
+        # deterministic mtime order without sleeping
+        os.utime(cache._path(key), (1000 + i, 1000 + i))
+    cache.evict()
+    assert len(cache) == 2
+    assert not os.path.exists(cache._path(keys[0]))  # oldest went
+    # a READ refreshes mtime: keys[1] touched now outlives keys[2]
+    os.utime(cache._path(keys[2]), (2000, 2000))
+    assert cache.read(keys[1], expected_fp=fph) is not None
+    digest = "9" * 24
+    key4, _, _ = _write_ok(cache, payload=b"x", digest=digest)
+    assert len(cache) == 2
+    assert os.path.exists(cache._path(keys[1]))
+    assert not os.path.exists(cache._path(keys[2]))
+
+
+def test_concurrent_writers_never_interleave(tmp_path):
+    """N threads hammering the same directory (same and different
+    keys): every surviving artifact verifies — the atomic tmp+rename
+    discipline."""
+    cache = ArtifactCache(str(tmp_path), capacity=64)
+    fp = backend_fingerprint()
+    fph = fingerprint_hex(fp)
+    payloads = {
+        f"{i:024d}": bytes([i]) * (1000 + i) for i in range(8)
+    }
+    errors = []
+
+    def _hammer(seed):
+        try:
+            for rep in range(5):
+                for digest, payload in payloads.items():
+                    key = artifact_key(bucket_key(None), digest, fph)
+                    cache.write(key, bucket_key(None), digest, fp, fph,
+                                payload)
+        except Exception as why:  # pragma: no cover
+            errors.append(why)
+
+    threads = [threading.Thread(target=_hammer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for digest, payload in payloads.items():
+        key = artifact_key(bucket_key(None), digest, fph)
+        got = cache.read(key, expected_fp=fph)
+        assert got is not None and got[1] == payload
+    assert cache.corrupt == 0
+
+
+# -- keys --------------------------------------------------------------------
+def test_entry_digest_covers_statics_and_avals():
+    """max_steps/unroll/donate are BAKED into an AOT executable (unlike
+    the in-process warm key) — each must fork the digest; values must
+    not."""
+    a = jnp.zeros((4, 8), jnp.uint8)
+    b = jnp.ones((4, 8), jnp.uint8)
+    base = entry_digest("generic", False, {"max_steps": 64}, (a,))
+    assert entry_digest("generic", False, {"max_steps": 64}, (b,)) == base
+    assert entry_digest("generic", False, {"max_steps": 65}, (a,)) != base
+    assert entry_digest("generic", True, {"max_steps": 64}, (a,)) != base
+    assert entry_digest("run", False, {"max_steps": 64}, (a,)) != base
+    wide = jnp.zeros((4, 16), jnp.uint8)
+    assert entry_digest("generic", False, {"max_steps": 64}, (wide,)) != base
+
+
+def test_bucket_key_roundtrip():
+    phases = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    bucket = bucket_key(phases)
+    assert bucket["kind"] == "spec"
+    back = phases_from_bucket(bucket)
+    assert back == phases
+    assert bucket_key(None) == {"kind": "generic"}
+    assert phases_from_bucket({"kind": "generic"}) is None
+    # an unknown pruned name from a newer writer is ignored, not fatal
+    noisy = dict(bucket, pruned=list(bucket["pruned"]) + ["hoverboards"])
+    assert phases_from_bucket(noisy) is not None
+
+
+def test_fingerprint_covers_backend_identity():
+    fp = backend_fingerprint()
+    for field in ("jax", "jaxlib", "backend", "device_kind", "xla_flags"):
+        assert field in fp
+    assert fingerprint_hex(dict(fp, jax="999.0.0")) != fingerprint_hex(fp)
+
+
+# -- the plane facade --------------------------------------------------------
+def _tiny_compiled():
+    """A real XLA executable that compiles in milliseconds — the plane
+    plumbing doesn't care that it isn't a wave kernel."""
+    fn = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(8, dtype=jnp.int32)
+    return fn.lower(x).compile(), x
+
+
+def test_plane_store_then_fresh_plane_load(tmp_path):
+    compiled, x = _tiny_compiled()
+    plane = CompilePlane(cache_dir=str(tmp_path))
+    digest = entry_digest("generic", False, {"k": 1}, (x,))
+    assert plane.store(None, digest, compiled) is not None
+    assert plane.stores == 1
+
+    fresh = CompilePlane(cache_dir=str(tmp_path))
+    loaded = fresh.load(None, digest)
+    assert loaded is not None
+    assert fresh.cache_hits == 1 and fresh.misses == 0
+    np.testing.assert_array_equal(
+        np.asarray(loaded(x)), np.asarray(compiled(x))
+    )
+    # second load answers from memory, not disk
+    assert fresh.load(None, digest) is not None
+    assert fresh.mem_hits == 1
+    assert fresh.load(None, "0" * 24) is None
+    assert fresh.misses == 1
+
+
+def test_no_aot_env_disables_every_site(tmp_path, monkeypatch):
+    """MYTHRIL_NO_AOT: the plane refuses to play, the wave entry is
+    exactly the plain jit path, and the reason is attributed."""
+    monkeypatch.setenv("MYTHRIL_NO_AOT", "1")
+    plane = configure_plane(cache_dir=str(tmp_path))
+    assert not plane.usable()
+    compiled, x = _tiny_compiled()
+    digest = entry_digest("generic", False, {}, (x,))
+    assert plane.load(None, digest) is None
+    assert plane.store(None, digest, compiled) is None
+    assert plane.unsupported.get(aot.REASON_DISABLED, 0) == 2
+    assert len(plane.cache) == 0
+
+    # wave_run degrades to the plain path: no AOT entries, no
+    # artifacts (same avals as the pack shape, so the jit compile this
+    # pays is reused by the baseline differential below)
+    shape = _service_shape_dict()
+    batch, table = _pack_arena(shape, codes=[bytes.fromhex(WRITER)])
+    out, steps = wave_run(batch, table,
+                          max_steps=shape["steps_per_wave"],
+                          track_coverage=True, donate=False)
+    ref_out, ref_steps = run(batch, table,
+                             max_steps=shape["steps_per_wave"],
+                             track_coverage=True)
+    assert int(steps) == int(ref_steps)
+    np.testing.assert_array_equal(
+        np.asarray(out.status), np.asarray(ref_out.status)
+    )
+    assert generic_aot_stats() == {"entries": 0, "compiles": 0}
+    assert len(plane.cache) == 0
+
+
+def test_no_aot_flag_parity(tmp_path):
+    """The CLI --no-aot switch (support_args.aot) disables the plane
+    exactly like the env knob."""
+    before = support_args.aot
+    support_args.aot = False
+    try:
+        plane = configure_plane(cache_dir=str(tmp_path))
+        assert not plane.usable()
+        assert not aot.aot_enabled()
+    finally:
+        support_args.aot = before
+    assert aot.aot_enabled()
+
+
+def test_serialize_failure_attributed_not_breaker_failure(tmp_path):
+    """A capability miss (this object can't serialize) books a reason
+    and degrades; it is NOT tier sickness — the breaker stays
+    closed."""
+    plane = CompilePlane(cache_dir=str(tmp_path))
+    assert plane.store(None, "a" * 24, object()) is None
+    assert plane.unsupported.get(aot.REASON_SERIALIZE, 0) == 1
+    assert plane.store_failures == 0
+    assert cb.breaker(cb.TIER_COMPILEPLANE).state == cb.STATE_CLOSED
+
+
+def test_corrupt_blob_deserialize_refused(tmp_path):
+    """A verified-checksum artifact whose PAYLOAD isn't a serialized
+    executable (a bad bake, a cosmic ray that kept the sha) still
+    degrades to a miss with the reason attributed."""
+    plane = CompilePlane(cache_dir=str(tmp_path))
+    digest = "b" * 24
+    key = plane.key_for(None, digest)
+    plane.cache.write(
+        key, bucket_key(None), digest, plane.fingerprint, plane.fp_hex,
+        b"not a pickled executable",
+    )
+    assert plane.load(None, digest) is None
+    assert plane.unsupported.get(aot.REASON_DESERIALIZE, 0) == 1
+
+
+def test_breaker_open_routes_around_the_directory(tmp_path):
+    """An open TIER_COMPILEPLANE breaker: loads are misses, stores are
+    no-ops, nothing touches disk — the wave compiles in-process
+    exactly as before the plane existed."""
+    compiled, x = _tiny_compiled()
+    plane = CompilePlane(cache_dir=str(tmp_path))
+    digest = entry_digest("generic", False, {}, (x,))
+    assert plane.store(None, digest, compiled) is not None
+    cb.breaker(cb.TIER_COMPILEPLANE).force_open()
+    fresh = CompilePlane(cache_dir=str(tmp_path))
+    assert fresh.load(None, digest) is None  # artifact exists on disk
+    assert fresh.misses == 1 and fresh.cache_hits == 0
+    assert fresh.store(None, "c" * 24, compiled) is None
+    assert len(fresh.cache) == 1  # the no-op store wrote nothing
+
+
+def test_io_faults_trip_the_breaker_then_recover(tmp_path):
+    """Repeated read faults (the resilience injection site) count as
+    tier failures and trip the breaker open; a healthy probe closes
+    it."""
+    compiled, x = _tiny_compiled()
+    plane = CompilePlane(cache_dir=str(tmp_path))
+    digest = entry_digest("generic", False, {}, (x,))
+    plane.store(None, digest, compiled)
+    cb.configure(cb.TIER_COMPILEPLANE, failure_threshold=2,
+                 recovery_s=0.0)
+    fresh = CompilePlane(cache_dir=str(tmp_path))
+    arm_fault("compileplane.read", times=2)
+    assert fresh.load(None, digest) is None
+    assert fresh.load(None, digest) is None
+    assert cb.breaker(cb.TIER_COMPILEPLANE).state != cb.STATE_CLOSED
+    disarm_faults()
+    # recovery_s=0: the next attempt is the half-open probe; a healthy
+    # read closes the breaker and the artifact loads again
+    assert fresh.load(None, digest) is not None
+    assert cb.breaker(cb.TIER_COMPILEPLANE).state == cb.STATE_CLOSED
+
+
+# -- bucket mining -----------------------------------------------------------
+def test_mine_buckets_corpus_union_and_dedupe(tmp_path):
+    code_dir = tmp_path / "corpus"
+    code_dir.mkdir()
+    (code_dir / "writer.hex").write_text("0x" + WRITER)
+    (code_dir / "writer_again.hex").write_text(WRITER)
+    buckets = mine_buckets(corpus=[str(code_dir)])
+    assert None in buckets  # the generic kernel always rides along
+    spec = [b for b in buckets if b is not None]
+    assert spec  # duplicate contracts dedupe to one bucket (+ union)
+    keys = {json.dumps(bucket_key(b), sort_keys=True) for b in buckets}
+    assert len(keys) == len(buckets)
+
+
+def test_mine_buckets_routing_rows(tmp_path):
+    phases = sp.phases_for(sp.signature_for(bytes.fromhex(WRITER)))
+    rows = [
+        {"features": {"phase_bucket": bucket_key(phases)}},
+        {"features": {"phase_bucket_pruned": 3}},  # pre-plane record
+        {"not": "a routing row"},
+    ]
+    path = tmp_path / "routing_features.jsonl"
+    path.write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\nnot json\n"
+    )
+    buckets = mine_buckets(routing=[str(path)], include_generic=False,
+                           include_union=False)
+    assert buckets == [phases]
+
+
+def test_routing_features_carry_full_bucket():
+    """features_for emits the full phase_bucket dict the bake miner
+    reads — live traffic is minable without a capture corpus."""
+    from mythril_tpu.observe.routing import features_for
+
+    feats = features_for(WRITER)
+    bucket = feats.get("phase_bucket")
+    assert isinstance(bucket, dict) and bucket["kind"] == "spec"
+    assert phases_from_bucket(bucket) is not None
+
+
+# -- baking + the fresh-process differential ---------------------------------
+@pytest.fixture(scope="module")
+def baked_pack(tmp_path_factory):
+    """ONE real generic-kernel bake for the whole module (the compile
+    is the expensive part; every consumer below only loads)."""
+    pack_dir = str(tmp_path_factory.mktemp("pack"))
+    reset_plane()
+    clear_aot_generic()
+    manifest = bake_service_pack(pack_dir, [None], **SHAPE)
+    reset_plane()
+    clear_aot_generic()
+    return pack_dir, manifest
+
+
+def test_bake_manifest_and_tools(baked_pack):
+    pack_dir, manifest = baked_pack
+    assert manifest["artifacts"] >= 1
+    assert manifest["shape"]["n_lanes"] == (
+        SHAPE["stripes"] * SHAPE["lanes_per_stripe"]
+    )
+    assert manifest["fingerprint_hex"] == fingerprint_hex()
+    assert read_manifest(pack_dir)["buckets"] == [{"kind": "generic"}]
+
+    listing = list_pack(pack_dir)
+    assert listing["artifacts"] and listing["manifest"] is not None
+
+    report = verify_pack(pack_dir)
+    assert report["loadable"] >= 1 and report["refused"] == 0
+
+    gced = gc_pack(pack_dir, capacity=64, drop_stale=True)
+    assert gced["stale_dropped"] == 0 and gced["remaining"] >= 1
+
+
+def test_pack_mount_preloads_and_wave_hits(baked_pack):
+    pack_dir, _ = baked_pack
+    plane = configure_plane(pack_dirs=(pack_dir,))
+    mounted = plane.mount_packs()
+    assert mounted["mounted"] >= 1 and mounted["refused"] == 0
+
+    shape = read_manifest(pack_dir)["shape"]
+    batch, table = _pack_arena(shape)
+    digest = wave_entry_digest(
+        batch, table, max_steps=shape["steps_per_wave"],
+        track_coverage=True, donate=False,
+    )
+    assert plane.preloaded(None, digest)
+    out, steps = wave_run(
+        batch, table, max_steps=shape["steps_per_wave"],
+        track_coverage=True, donate=False,
+    )
+    jax.block_until_ready(steps)
+    # the pack answered: zero in-process compiles of the packed bucket
+    assert generic_aot_stats()["compiles"] == 0
+    assert plane.pack_hits + plane.mem_hits >= 1
+    assert plane.hit_rate() > 0.0
+    assert plane.stats()["kernel_pack_hit_rate"] > 0.0
+
+
+def test_pack_loads_in_fresh_process_bit_identical(baked_pack):
+    """The tentpole differential: a subprocess with a cold jit cache
+    mounts the pack, runs a wave through the plane with ZERO compiles,
+    and its results hash identically to this process's in-process
+    baseline."""
+    pack_dir, _ = baked_pack
+    shape = read_manifest(pack_dir)["shape"]
+
+    script = f"""
+import hashlib, json, sys
+import numpy as np
+from mythril_tpu.compileplane.pack import read_manifest
+from mythril_tpu.compileplane.plane import configure_plane
+from mythril_tpu.laser.batch.run import generic_aot_stats, wave_run
+from mythril_tpu.laser.batch.state import make_batch, make_code_table
+
+pack = {pack_dir!r}
+shape = read_manifest(pack)["shape"]
+plane = configure_plane(pack_dirs=(pack,))
+mounted = plane.mount_packs()
+n = shape["n_lanes"]
+batch = make_batch(
+    n, code_ids=np.full((n,), shape["stripes"], np.int32),
+    calldata=[b""] * n,
+)
+table = make_code_table(
+    [bytes.fromhex({WRITER!r})] * (shape["stripes"] + 1),
+    code_cap=shape["code_cap"],
+)
+out, steps = wave_run(batch, table, max_steps=shape["steps_per_wave"],
+                      track_coverage=True, donate=False)
+sha = hashlib.sha256()
+sha.update(np.asarray(out.status).tobytes())
+sha.update(np.asarray(out.pc).tobytes())
+sha.update(np.asarray(out.storage_vals).tobytes())
+print(json.dumps({{
+    "mounted": mounted["mounted"],
+    "compiles": generic_aot_stats()["compiles"],
+    "steps": int(steps),
+    "sha": sha.hexdigest(),
+}}))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    child = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert child["mounted"] >= 1
+    assert child["compiles"] == 0  # the zero-cold-start contract
+
+    # the in-process baseline over the SAME inputs, no plane at all
+    batch, table = _pack_arena(shape, codes=[bytes.fromhex(WRITER)])
+    out, steps = run(batch, table, max_steps=shape["steps_per_wave"],
+                     track_coverage=True)
+    sha = hashlib.sha256()
+    sha.update(np.asarray(out.status).tobytes())
+    sha.update(np.asarray(out.pc).tobytes())
+    sha.update(np.asarray(out.storage_vals).tobytes())
+    assert int(steps) == child["steps"]
+    assert sha.hexdigest() == child["sha"]

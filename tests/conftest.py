@@ -185,6 +185,18 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "compileplane: persistent AOT compile plane suite "
+        "(mythril_tpu/compileplane: artifact-cache roundtrip + "
+        "checksum/fingerprint/schema refusal, bake->fresh-plane load "
+        "bit-identical differential, MYTHRIL_NO_AOT fallback parity, "
+        "concurrent writers, LRU eviction, TIER_COMPILEPLANE breaker "
+        "fallback, pack-warmed service boot ordering; CPU-only — runs "
+        "in tier-1, selectable with -m compileplane; the subprocess "
+        "SIGKILL+restart harness is tools/compileplane_smoke.py via "
+        "[testenv:compileplane])",
+    )
+    config.addinivalue_line(
+        "markers",
         "taint: taint & value-set static layer suite (attacker-taint "
         "fixpoint goldens, semantic screen soundness sweep over every "
         "module positive fixture, static-answer triage differential, "

@@ -389,3 +389,55 @@ def test_service_no_specialize_runs_generic_waves():
         assert kernel["generic_waves"] >= 1
     finally:
         engine.close()
+
+
+def test_eviction_during_warmup_defers_drop_to_release():
+    """ISSUE-17 satellite: capacity eviction racing a background
+    warmup compile. The eviction may unmap the warmup-pinned entry
+    (counted as an inflight eviction) but must NOT drop its
+    executables under the compiling thread — the drop happens
+    deterministically at release_warmup, when nothing else holds it."""
+    cache = sp.KernelCache(capacity=1)
+    k1 = cache.get(PhaseSet(sha3=False))
+    cache.pin_warmup(k1)
+    k2 = cache.get(PhaseSet(exp=False))  # over capacity: k1 unmapped
+    stats = cache.stats()
+    assert stats["evictions"] == 1
+    assert stats["inflight_evictions"] == 1
+    # unmapped, but the compiling thread's handle is still live
+    assert k1._run is not None
+    assert cache._entries.get(k1.phases) is not k1  # slot is gone for real
+    # the warmup thread finishing is what frees the executables
+    cache.release_warmup(k1)
+    assert k1._run is None
+    # k2 was never evicted: untouched by any of this
+    assert k2._run is not None
+    assert cache.stats()["inflight_evictions"] == 1
+
+
+def test_warmup_pin_survives_when_not_evicted():
+    """The re-pin half of the contract: a warmup pin on an entry that
+    is NOT evicted leaves it mapped and live after release."""
+    cache = sp.KernelCache(capacity=4)
+    k1 = cache.get(PhaseSet(div=False))
+    cache.pin_warmup(k1)
+    cache.release_warmup(k1)
+    assert k1.warm_refs == 0
+    assert k1._run is not None
+    assert cache.get(k1.phases) is k1
+
+
+def test_inflight_eviction_bumps_registry_counter():
+    from mythril_tpu.observe.registry import registry
+
+    counter = registry().counter(
+        "mtpu_kernel_cache_inflight_evictions_total",
+        "buckets evicted while their background warmup compile was "
+        "still in flight",
+    )
+    before = counter.value
+    cache = sp.KernelCache(capacity=1)
+    k1 = cache.pin_warmup(cache.get(PhaseSet(sha3=False)))
+    cache.get(PhaseSet(exp=False))
+    assert counter.value == before + 1
+    cache.release_warmup(k1)

@@ -1,0 +1,145 @@
+"""Pack-warmed service boot ordering (ISSUE 17, satellite 2).
+
+The readiness contract `myth serve --kernel-pack DIR` pins:
+
+- the pack is mounted SYNCHRONOUSLY in engine __init__, before the
+  health monitor exists and before a server could bind;
+- a pack that covers the engine's generic warmup executable clears
+  `arena-warming` readiness immediately — no in-process compile clock;
+- `--no-arena-warmup` + `--kernel-pack` compose: ready at once, pack
+  still mounted and serving AOT executables to the first real wave;
+- without a pack, `arena_warmup=True` leaves readiness pending until
+  the warmup thread actually compiles;
+- a cache dir alone configures the plane but mounts nothing;
+- every mode degrades, never crashes: a bad pack dir boots a plain
+  engine.
+
+Engines here are constructed but never started — the contract under
+test is boot state, and construction alone must establish it.
+"""
+
+import pytest
+
+from mythril_tpu.compileplane.pack import bake_service_pack
+from mythril_tpu.compileplane.plane import active_plane, reset_plane
+from mythril_tpu.laser.batch import specialize as _spec
+from mythril_tpu.laser.batch.run import clear_aot_generic, generic_aot_stats
+from mythril_tpu.service.engine import AnalysisEngine, ServiceConfig
+from mythril_tpu.support import breaker as cb
+
+pytestmark = pytest.mark.compileplane
+
+#: tiny dispatch shape shared by the bake and every engine below —
+#: digests must match or the pack cannot cover the warmup
+SHAPE = dict(stripes=2, lanes_per_stripe=2, steps_per_wave=32, code_cap=32)
+
+CFG = dict(
+    stripes=SHAPE["stripes"],
+    lanes_per_stripe=SHAPE["lanes_per_stripe"],
+    steps_per_wave=SHAPE["steps_per_wave"],
+    code_cap=SHAPE["code_cap"],
+    host_walk=False,
+    pipeline=False,
+    specialize=False,
+    blockjit=False,
+    store=False,
+    breakers=False,
+)
+
+
+@pytest.fixture(scope="module")
+def baked_pack(tmp_path_factory):
+    pack_dir = str(tmp_path_factory.mktemp("bootpack") / "pack")
+    reset_plane()
+    clear_aot_generic()
+    manifest = bake_service_pack(pack_dir, [None], **SHAPE)
+    reset_plane()
+    assert manifest["artifacts"] >= 1
+    return pack_dir
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    reset_plane()
+    clear_aot_generic()
+    _spec.clear_kernel_cache()
+    cb.reset_all()
+    yield
+    reset_plane()
+    clear_aot_generic()
+    _spec.clear_kernel_cache()
+    cb.reset_all()
+
+
+def test_pack_boot_is_ready_before_any_compile(baked_pack):
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, arena_warmup=True, kernel_pack=baked_pack))
+    )
+    # mounted in __init__, before anything could have compiled
+    assert engine._pack_mounted["mounted"] >= 1
+    assert engine._pack_mounted["refused"] == 0
+    assert engine._pack_covers_warmup()
+    # readiness clears at construction: mounting WAS the warmup
+    assert engine._warm_done.is_set()
+    assert generic_aot_stats()["compiles"] == 0
+
+
+def test_pack_warmup_wave_runs_zero_compiles(baked_pack):
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, arena_warmup=True, kernel_pack=baked_pack))
+    )
+    engine._arena_warmup()  # the wave the warmup thread would run
+    assert generic_aot_stats()["compiles"] == 0
+    plane = active_plane()
+    assert plane is not None and plane.pack_hits >= 1
+
+
+def test_no_arena_warmup_composes_with_pack(baked_pack):
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, arena_warmup=False, kernel_pack=baked_pack))
+    )
+    assert engine._warm_done.is_set()
+    # the pack is not just decorative: still mounted, still consulted
+    assert engine._pack_mounted["mounted"] >= 1
+    assert active_plane() is not None
+
+
+def test_without_pack_warmup_stays_pending():
+    engine = AnalysisEngine(ServiceConfig(**dict(CFG, arena_warmup=True)))
+    # no pack, warmup requested: readiness must wait for the compile
+    assert not engine._warm_done.is_set()
+    assert engine._pack_mounted == {}
+
+
+def test_cache_dir_alone_configures_plane_without_mount(tmp_path):
+    engine = AnalysisEngine(
+        ServiceConfig(
+            **dict(CFG, arena_warmup=False, kernel_cache_dir=str(tmp_path))
+        )
+    )
+    plane = active_plane()
+    assert plane is not None and plane.cache is not None
+    assert engine._pack_mounted == {}
+    assert engine._warm_done.is_set()
+
+
+def test_bad_pack_dir_degrades_to_plain_boot(tmp_path):
+    bogus = str(tmp_path / "not-a-pack")
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, arena_warmup=False, kernel_pack=bogus))
+    )
+    # nothing mounted, nothing broken: the replica still serves
+    assert engine._pack_mounted.get("mounted", 0) == 0
+    assert engine._warm_done.is_set()
+
+
+def test_kernel_stats_surface_pack_state(baked_pack):
+    engine = AnalysisEngine(
+        ServiceConfig(**dict(CFG, arena_warmup=True, kernel_pack=baked_pack))
+    )
+    stats = engine._kernel_stats()
+    plane_stats = stats["compileplane"]
+    assert plane_stats["pack_mount"]["mounted"] >= 1
+    assert "kernel_pack_hit_rate" in plane_stats
+    assert "aot_load_p50_s" in plane_stats
+    assert stats["generic_aot"]["compiles"] == 0
