@@ -426,6 +426,55 @@ def bench_static_prune() -> dict:
         ),
         "static_taint_wall_s": round(taint_wall_ms / 1e3, 3),
         "static_wall_s": round(time.perf_counter() - t0, 3),
+        **bench_static_link(contracts),
+    }
+
+
+def bench_static_link(contracts) -> dict:
+    """The cross-contract linker leg (analysis/static/linkset): link
+    the bench corpus plus the known-positive fixture families and
+    report resolution quality. Headline fields:
+
+    - `link_resolve_rate`: resolved / total call-site edges (the
+      planted fixtures all resolve, organic corpus edges may not);
+    - `proxy_detect_rate`: detected proxies / planted proxies (2x
+      EIP-1967 + 2x EIP-1167 here — must be 1.0);
+    - `callgraph_fingerprint_hit_rate`: selectors that got a linked
+      fingerprint / all selectors (the rest carry link-unresolved /
+      link-cycle problems and can never serve a linked store hit);
+    - `static_link_wall_s`: the whole corpus-level link pass (the
+      admission-path budget: sub-second).
+    """
+    from mythril_tpu.analysis.corpusgen import (
+        cross_call_pair,
+        minimal_proxy,
+        proxy_pair,
+    )
+    from mythril_tpu.analysis.static import link_corpus
+
+    planted_proxies = 4
+    rows = list(contracts)
+    for k in range(2):
+        rows.extend(proxy_pair(seed=k, collide=bool(k % 2)))
+        rows.extend(minimal_proxy(seed=k))
+    rows.extend(cross_call_pair(seed=0))
+    t0 = time.perf_counter()
+    linkset = link_corpus(rows)
+    stats = linkset.stats()
+    data = linkset.resolve()
+    fps = sum(len(v) for v in data["linked_fingerprints"].values())
+    problems = sum(len(v) for v in data["link_problems"].values())
+    return {
+        "link_resolve_rate": stats["resolve_rate"],
+        "proxy_detect_rate": (
+            round(stats["proxies"] / planted_proxies, 4)
+        ),
+        "callgraph_fingerprint_hit_rate": (
+            round(fps / (fps + problems), 4) if fps + problems else 1.0
+        ),
+        "link_proxy_pairs": stats["proxy_pairs"],
+        "link_collisions": stats["collisions"],
+        "static_link_wall_s": round(time.perf_counter() - t0, 3),
     }
 
 
@@ -1636,6 +1685,10 @@ def main(final_attempt: bool = False) -> None:
         record["static_answer_rate"] = None
         record["screen_mount_rate_opcode"] = None
         record["screen_mount_rate_semantic"] = None
+        record["link_resolve_rate"] = None
+        record["proxy_detect_rate"] = None
+        record["callgraph_fingerprint_hit_rate"] = None
+        record["static_link_wall_s"] = None
 
     try:
         record.update(bench_journal())

@@ -787,14 +787,25 @@ def _store_hit_result(name: str, entry, wall_s: float) -> Dict:
 
 
 def _store_triage(
-    contracts: List[Tuple[str, str, str]], vstore, config_fp: str
+    contracts: List[Tuple[str, str, str]],
+    vstore,
+    config_fp: str,
+    linkset=None,
 ) -> Tuple[Dict[int, Dict], Dict[int, object]]:
     """({index: exact-hit result}, {index: IncrementalPlan}) from the
     verdict store (mythril_tpu/store). Runs BEFORE the static triage
     and the device prepass, so hit contracts never occupy a lane and
     incremental contracts explore only their changed selectors. Every
     doubt bails that contract to the full path — a store problem can
-    cost speed, never correctness."""
+    cost speed, never correctness.
+
+    With a corpus `linkset`, an exact codehash hit is additionally
+    checked against its stored CALL-GRAPH fingerprints: byte-identical
+    code whose resolved callee closure moved (implementation upgrade
+    behind an unchanged proxy) is NOT served the stale verdict — it
+    downgrades to a linked incremental plan re-analyzing only the
+    selectors whose closure changed, or to full analysis when the
+    linked diff cannot be trusted (link-unresolved / link-cycle)."""
     answers: Dict[int, Dict] = {}
     plans: Dict[int, object] = {}
     if vstore is None:
@@ -807,6 +818,7 @@ def _store_triage(
         IncrementalBail,
         code_hash_hex,
         plan_incremental,
+        plan_linked_incremental,
     )
 
     for i, (code, creation_code, name) in enumerate(contracts):
@@ -825,6 +837,16 @@ def _store_triage(
             log.debug("store lookup failed for %s", name, exc_info=True)
             continue
         if entry is not None:
+            if linkset is not None and entry.linked_fingerprints:
+                verdict = _linked_hit_verdict(
+                    norm, name, entry, linkset, config_fp,
+                    plan_linked_incremental, summary_for,
+                )
+                if verdict == "stale":
+                    continue  # full analysis; serving the hit is wrong
+                if verdict is not None:
+                    plans[i] = verdict
+                    continue
             answers[i] = _store_hit_result(
                 name, entry, time.perf_counter() - t0
             )
@@ -869,6 +891,62 @@ def _store_triage(
     return answers, plans
 
 
+def _linked_hit_verdict(
+    norm: str,
+    name: str,
+    entry,
+    linkset,
+    config_fp: str,
+    plan_linked_incremental,
+    summary_for,
+):
+    """Check an exact store hit against its call-graph fingerprints.
+    Returns None (hit stands), an IncrementalPlan (only the selectors
+    whose callee closure moved re-run; the rest is banked), or the
+    sentinel "stale" (closure moved but the diff cannot be trusted —
+    full analysis, never the stale verdict)."""
+    from mythril_tpu.store import IncrementalBail
+
+    try:
+        summary = summary_for(norm, config_fp=config_fp)
+    except Exception:
+        log.debug("summary failed for linked hit %s", name, exc_info=True)
+        return None
+    if summary.code_hash not in linkset.nodes:
+        return None  # row not linked: pre-link behavior
+    linked_now, problems = linkset.linked_fingerprints(summary.code_hash)
+    if linked_now == entry.linked_fingerprints and not problems:
+        return None  # closure identical everywhere
+    try:
+        plan = plan_linked_incremental(
+            summary, entry, linked_now, problems
+        )
+    except IncrementalBail as bail:
+        log.info(
+            "Linked store hit for %s cannot be diffed: %s "
+            "(full analysis)",
+            name,
+            bail.reason,
+        )
+        return "stale"
+    except Exception:
+        log.debug(
+            "linked incremental planning failed for %s", name,
+            exc_info=True,
+        )
+        return "stale"
+    if plan is None:
+        return None
+    log.info(
+        "Linked store hit for %s: callee closure moved for %d "
+        "selector(s); %d banked",
+        name,
+        len(plan.changed),
+        len(plan.unchanged),
+    )
+    return plan
+
+
 def _apply_incremental(result: Optional[Dict], plan) -> Optional[Dict]:
     """Fold one incremental plan's banked issues into the fresh
     (changed-selector-restricted) result and flag the route."""
@@ -888,6 +966,7 @@ def _store_writeback(
     prepass: Dict[int, Dict],
     vstore,
     config_fp: str,
+    linkset=None,
 ) -> int:
     """Tier 3: persist every COMPLETE full analysis (including
     incremental ones — a fork's merged verdict is a first-class entry
@@ -933,7 +1012,7 @@ def _store_writeback(
                 code_hash_hex(norm),
                 config_fp,
                 issues=result.get("issues") or [],
-                static=static_export(summary),
+                static=static_export(summary, linkset=linkset),
                 banks=banks_from_outcome(prepass.get(i)),
                 provenance=provenance(
                     wall_s=result.get("wall_s"),
@@ -1181,7 +1260,10 @@ def analyze_corpus(
     # with the banked issue set; near-duplicates get an incremental
     # plan that masks their unchanged selectors out of the device
     # exploration and pre-banks the untouched functions' issues
-    from mythril_tpu.analysis.static import static_answer_enabled
+    from mythril_tpu.analysis.static import (
+        static_answer_enabled,
+        static_prune_enabled,
+    )
     from mythril_tpu.analysis.static.summary import (
         analysis_config_fingerprint,
     )
@@ -1192,6 +1274,30 @@ def analyze_corpus(
         solver_timeout=solver_timeout,
         create_timeout=create_timeout,
     )
+    # corpus-mode cross-contract linking (analysis/static/linkset.py),
+    # BEFORE the store triage and the prepass: the resolved call graph
+    # feeds (a) the linked-fingerprint diff that catches "same proxy
+    # bytes, upgraded implementation" exact hits, (b) per-result link
+    # meta in the jsonv2 report, (c) routing-log v4 features
+    linkset = None
+    if static_prune_enabled() and contracts:
+        try:
+            from mythril_tpu.analysis.static import link_corpus
+
+            linkset = link_corpus(contracts)
+            link_stats = linkset.stats()
+            log.info(
+                "Link pass: %d node(s), %d/%d edge(s) resolved, "
+                "%d proxy pair(s) in %.1fms",
+                link_stats["nodes"],
+                link_stats["edges_resolved"],
+                link_stats["edges"],
+                link_stats["proxy_pairs"],
+                link_stats["wall_ms"],
+            )
+        except Exception:
+            linkset = None
+            log.debug("corpus link pass failed", exc_info=True)
     vstore = None
     if store is not False:
         try:
@@ -1201,7 +1307,7 @@ def analyze_corpus(
         except Exception:
             log.debug("verdict store unavailable", exc_info=True)
     store_answers, store_plans = _store_triage(
-        contracts, vstore, config_fp
+        contracts, vstore, config_fp, linkset=linkset
     )
     selector_masks = {
         i: (plan.mask_selectors, plan.mask_directions)
@@ -1592,8 +1698,13 @@ def analyze_corpus(
     # the write that turns this run's compute into the next run's
     # admission-time answer
     if vstore is not None:
-        _store_writeback(results, contracts, prepass, vstore, config_fp)
-    _emit_routing_records(results, contracts)
+        _store_writeback(
+            results, contracts, prepass, vstore, config_fp,
+            linkset=linkset,
+        )
+    if linkset is not None:
+        _attach_link_meta(results, contracts, linkset)
+    _emit_routing_records(results, contracts, linkset=linkset)
     if skipped and on_timeout == "fail":
         from mythril_tpu.exceptions import DeadlineExpiredError
 
@@ -1604,8 +1715,44 @@ def analyze_corpus(
     return results
 
 
+def _attach_link_meta(
+    results: List[Optional[Dict]],
+    contracts: List[Tuple[str, str, str]],
+    linkset,
+) -> None:
+    """Per-result cross-contract link facts for the jsonv2 report
+    meta (and anyone reading the raw result dicts): the compact node
+    block plus the corpus-level stats on every row — consumers of one
+    contract's report still see the resolve rate the graph achieved."""
+    run_stats = None
+    try:
+        run_stats = linkset.stats()
+    except Exception:
+        log.debug("link stats failed", exc_info=True)
+    import hashlib as _hashlib
+
+    for (code, _creation, _name), result in zip(contracts, results):
+        if result is None:
+            continue
+        try:
+            norm = code[2:] if code.startswith("0x") else code
+            code_hash = (
+                "0x" + _hashlib.sha256(bytes.fromhex(norm)).hexdigest()
+            )
+        except ValueError:
+            continue
+        meta = linkset.node_meta(code_hash)
+        if meta is None:
+            continue
+        result["link"] = meta
+        if run_stats is not None:
+            result["link_run"] = dict(run_stats)
+
+
 def _emit_routing_records(
-    results: List[Dict], contracts: List[Tuple[str, str, str]]
+    results: List[Dict],
+    contracts: List[Tuple[str, str, str]],
+    linkset=None,
 ) -> None:
     """One routing-feature record per analyzed contract
     (observe/routing.py): static features joined with the route taken
@@ -1648,10 +1795,18 @@ def _emit_routing_records(
                 "done" if not outcome.get("error") else "failed",
                 issues=outcome.get("issues"),
             )
+            link_meta = None
+            if linkset is not None:
+                try:
+                    link_meta = linkset.node_meta("0x" + digest)
+                except Exception:
+                    link_meta = None
             observe.routing_log().record(
                 contract=name,
                 code_hash=digest,
-                features=observe.routing_features_for(code_norm),
+                features=observe.routing_features_for(
+                    code_norm, link=link_meta
+                ),
                 outcome=outcome,
                 journey_id=journey_id,
             )

@@ -454,6 +454,152 @@ def fork_contract(seed: int = 0, variant: int = 0) -> str:
     return bytes(code).hex()
 
 
+def proxy_pair(
+    seed: int = 0, variant: int = 0, collide: bool = False
+) -> List[Tuple[str, str, str]]:
+    """An EIP-1967 proxy + implementation row pair, the linker's
+    known-positive population. The proxy's FORWARD selector does
+    `DELEGATECALL(gas, SLOAD(eip1967-impl-slot), calldata)` (the
+    proxy-slot provenance class); its ADMIN selector — the real
+    `upgradeTo` selector — stores a PUSH20 implementation address
+    into the slot (the runtime slot binding the linker resolves the
+    edge through) plus a slot-0 counter write, then ends in a guarded
+    INVALID so the store has an admin-attributed issue to bank.
+
+    The implementation's address rides its row NAME
+    (``impl#<seed>v<variant>@0x<addr>`` — the LinkSet address-book
+    convention); the address depends only on `seed`, so two variants
+    model an UPGRADE: same proxy bytes, same address, new callee code
+    (`variant` mutates the impl's stored constant and guard magic —
+    exactly one selector's linked fingerprint moves). `collide=True`
+    makes the implementation write slot 0 — the slot the proxy's
+    admin counter uses — lighting up `proxy-storage-collision`."""
+    from mythril_tpu.analysis.static.callgraph import EIP1967_IMPL_SLOT
+
+    sel_fwd = (0xCA11AB1E + seed) & 0xFFFFFFFF
+    sel_adm = 0x3659CFE6  # upgradeTo(address)
+    impl_addr = (0x1A << 152) | ((0xBEEF0000 + seed) & 0xFFFFFFFF)
+    fn_fwd, fn_adm, fail_adm = 26, 72, 143
+
+    proxy = bytearray([0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63])
+    proxy += sel_fwd.to_bytes(4, "big")
+    proxy += bytes([0x14, 0x60, fn_fwd, 0x57])
+    proxy += bytes([0x63]) + sel_adm.to_bytes(4, "big")
+    proxy += bytes([0x14, 0x60, fn_adm, 0x57])
+    proxy += bytes([0x00])  # no match: STOP
+    assert len(proxy) == fn_fwd
+    # forward: delegatecall(GAS, sload(impl_slot), 0, cds, 0, 0)
+    proxy += bytes([0x5B, 0x60, 0x00, 0x60, 0x00, 0x36, 0x60, 0x00])
+    proxy += bytes([0x7F]) + EIP1967_IMPL_SLOT.to_bytes(32, "big")
+    proxy += bytes([0x54, 0x5A, 0xF4, 0x50, 0x00])
+    assert len(proxy) == fn_adm
+    # admin: sstore(impl_slot, PUSH20 impl_addr); sstore(0, 1);
+    # guarded INVALID (the bankable SWC-110)
+    proxy += bytes([0x5B, 0x73]) + impl_addr.to_bytes(20, "big")
+    proxy += bytes([0x7F]) + EIP1967_IMPL_SLOT.to_bytes(32, "big")
+    proxy += bytes([0x55])
+    proxy += bytes([0x60, 0x01, 0x60, 0x00, 0x55])
+    proxy += bytes([0x60, 0x04, 0x35, 0x60, 0xAA, 0x14])
+    proxy += bytes([0x60, fail_adm, 0x57, 0x00])
+    assert len(proxy) == fail_adm
+    proxy += bytes([0x5B, 0xFE])
+
+    impl = _linked_leaf(
+        selector=sel_fwd,
+        value=0x10 + (variant % 0xE0),
+        slot=0x00 if collide else 0x01,
+        magic=0xA0 + ((seed + 7 * variant) % 0x5F),
+    )
+    return [
+        (bytes(proxy).hex(), "", f"proxy#{seed}"),
+        (impl, "", f"impl#{seed}v{variant}@0x{impl_addr:040x}"),
+    ]
+
+
+def minimal_proxy(seed: int = 0) -> List[Tuple[str, str, str]]:
+    """An EIP-1167 minimal proxy (the 45-byte literal runtime) plus
+    its constant callee — the `minimal-proxy` provenance class, where
+    the target address sits IN the bytecode, no taint pass needed."""
+    from mythril_tpu.analysis.static.callgraph import (
+        MINIMAL_PROXY_PREFIX,
+        MINIMAL_PROXY_SUFFIX,
+    )
+
+    target_addr = (0x2B << 152) | ((0xC10E0000 + seed) & 0xFFFFFFFF)
+    code = (
+        MINIMAL_PROXY_PREFIX
+        + target_addr.to_bytes(20, "big")
+        + MINIMAL_PROXY_SUFFIX
+    )
+    callee = _linked_leaf(
+        selector=(0xD00DFEED + seed) & 0xFFFFFFFF,
+        value=0x21 + (seed % 0x40),
+        slot=0x02,
+        magic=0xB1 + (seed % 0x4E),
+    )
+    return [
+        (code.hex(), "", f"minproxy#{seed}"),
+        (callee, "", f"mincallee#{seed}@0x{target_addr:040x}"),
+    ]
+
+
+def cross_call_pair(seed: int = 0) -> List[Tuple[str, str, str]]:
+    """A calls B at a constant (PUSH20) address with ATTACKER-tainted
+    calldata (CALLDATACOPY of the full input) and then branches on
+    the returned word (MLOAD 0 after the CALL) — the known positive
+    for BOTH `tainted-cross-contract-call-arg` (attacker bytes flow
+    into the callee's calldata through a `constant`-provenance edge)
+    and `untrusted-return-data-in-guard` (the post-call guard's
+    condition carries the ATTACKER|UNKNOWN memory-join signature)."""
+    b_addr = (0x3C << 152) | ((0xB0B00000 + seed) & 0xFFFFFFFF)
+    sel = (0xFEEDC0DE + seed) & 0xFFFFFFFF
+    fn_at, fail_at = 17, 64
+    code = bytearray([0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63])
+    code += sel.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, fn_at, 0x57, 0x00])
+    assert len(code) == fn_at
+    # calldatacopy(0, 0, cds)
+    code += bytes([0x5B, 0x36, 0x60, 0x00, 0x60, 0x00, 0x37])
+    # call(GAS, B, 0, 0, cds, 0, 32)
+    code += bytes([0x60, 0x20, 0x60, 0x00, 0x36, 0x60, 0x00, 0x60, 0x00])
+    code += bytes([0x73]) + b_addr.to_bytes(20, "big")
+    code += bytes([0x5A, 0xF1, 0x50])
+    # if (mload(0)) INVALID — guard on the callee's return word
+    code += bytes([0x60, 0x00, 0x51, 0x60, fail_at, 0x57, 0x00])
+    assert len(code) == fail_at
+    code += bytes([0x5B, 0xFE])
+    callee = _linked_leaf(
+        selector=(0x0B5E55ED + seed) & 0xFFFFFFFF,
+        value=0x31 + (seed % 0x40),
+        slot=0x03,
+        magic=0xC2 + (seed % 0x3D),
+    )
+    return [
+        (bytes(code).hex(), "", f"crosscaller#{seed}"),
+        (callee, "", f"crosscallee#{seed}@0x{b_addr:040x}"),
+    ]
+
+
+def _linked_leaf(
+    selector: int, value: int, slot: int, magic: int
+) -> str:
+    """The shared callee shape of the link fixtures: one-selector
+    dispatcher, `sstore(slot, value)`, then a guarded INVALID
+    (SWC-110) so every leaf has a findable issue and a per-variant
+    fingerprint axis (`value`/`magic`)."""
+    fn_at, fail_at = 17, 33
+    code = bytearray([0x60, 0x00, 0x35, 0x60, 0xE0, 0x1C, 0x80, 0x63])
+    code += selector.to_bytes(4, "big")
+    code += bytes([0x14, 0x60, fn_at, 0x57, 0x00])
+    assert len(code) == fn_at
+    code += bytes([0x5B, 0x60, value & 0xFF, 0x60, slot & 0xFF, 0x55])
+    code += bytes([0x60, 0x04, 0x35, 0x60, magic & 0xFF, 0x14])
+    code += bytes([0x60, fail_at, 0x57, 0x00])
+    assert len(code) == fail_at
+    code += bytes([0x5B, 0xFE])
+    return bytes(code).hex()
+
+
 def poison_contract(seed: int = 0) -> str:
     """The quarantine differential's poison fixture: a syntactically
     ordinary dispatcher (one storage-writing function ending in a
@@ -496,6 +642,9 @@ def synth_bench_corpus(
     cleans: int = 2,
     dupes: int = 0,
     forks: int = 0,
+    proxy_pairs: int = 0,
+    minimal_proxies: int = 0,
+    cross_call_pairs: int = 0,
     inputs: Optional[Path] = None,
 ) -> List[Tuple[str, str, str]]:
     """The round-5 benchmark corpus: fixture constant-mutants plus
@@ -515,7 +664,8 @@ def synth_bench_corpus(
             - deadweights
             - cleans
             - dupes
-            - forks,
+            - forks
+            - 2 * (proxy_pairs + minimal_proxies + cross_call_pairs),
         ),
         seed=seed,
         inputs=inputs,
@@ -547,6 +697,16 @@ def synth_bench_corpus(
         corpus.append(
             (fork_contract(seed=k // 2, variant=k % 2), "", f"fork#{k}")
         )
+    # the linker's known-positive population: EIP-1967 proxy pairs
+    # (every other one with a deliberate storage collision), EIP-1167
+    # minimal proxies, and tainted A-calls-B pairs — the bench link
+    # leg asserts these resolve
+    for k in range(proxy_pairs):
+        corpus.extend(proxy_pair(seed=k, variant=0, collide=bool(k % 2)))
+    for k in range(minimal_proxies):
+        corpus.extend(minimal_proxy(seed=k))
+    for k in range(cross_call_pairs):
+        corpus.extend(cross_call_pair(seed=k))
     rng.shuffle(corpus)
     return corpus[:n_contracts]
 

@@ -52,7 +52,23 @@ adaptation of both.
 
 from __future__ import annotations
 
+from mythril_tpu.analysis.static.callgraph import (
+    LINK_CHECKS,
+    PROXY_IMPL_SLOTS,
+    PROXY_SLOTS,
+    UPGRADE_SELECTORS,
+    ContractNode,
+    implementation_from_init_code,
+    link_node,
+    link_stat_counts,
+    minimal_proxy_target,
+)
 from mythril_tpu.analysis.static.cfg import BasicBlock, recover_cfg
+from mythril_tpu.analysis.static.linkset import (
+    GRAPH_SCHEMA_VERSION,
+    LinkSet,
+    link_corpus,
+)
 from mythril_tpu.analysis.static.screen import (
     MODULE_SIGNATURES,
     SINK_PREDICATES,
@@ -101,8 +117,15 @@ def static_answer_enabled() -> bool:
 
 __all__ = [
     "BasicBlock",
+    "ContractNode",
+    "GRAPH_SCHEMA_VERSION",
+    "LINK_CHECKS",
     "LINT_CHECKS",
     "LINT_SCHEMA_VERSION",
+    "LinkSet",
+    "PROXY_IMPL_SLOTS",
+    "PROXY_SLOTS",
+    "UPGRADE_SELECTORS",
     "MODULE_SIGNATURES",
     "SINK_PREDICATES",
     "StaticSummary",
@@ -115,6 +138,11 @@ __all__ = [
     "analysis_config_fingerprint",
     "analyze_bytecode",
     "clear_static_cache",
+    "implementation_from_init_code",
+    "link_corpus",
+    "link_node",
+    "link_stat_counts",
+    "minimal_proxy_target",
     "recover_cfg",
     "run_taint",
     "screen_modules",

@@ -31,6 +31,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
+from mythril_tpu.analysis.static import callgraph as _callgraph
 from mythril_tpu.analysis.static.cfg import CFG, recover_cfg
 from mythril_tpu.analysis.static.dataflow import DataflowResult, run_dataflow
 from mythril_tpu.analysis.static.screen import screen_modules
@@ -46,24 +47,30 @@ log = logging.getLogger(__name__)
 #: `lint_dict()` payload version, pinned by the lint CLI tests. Bump
 #: on any key-set change. v2: taint/value-set facts, per-selector
 #: fingerprints, resolved call targets, semantic screen split, the
-#: taint lint checks, and the schema_version field itself.
-LINT_SCHEMA_VERSION = 2
+#: taint lint checks, and the schema_version field itself. v3: the
+#: cross-contract link block (call-site provenance, proxy
+#: classification) and the four link lint checks.
+LINT_SCHEMA_VERSION = 3
 
 #: every check `findings()` can emit — the CLI validates `--fail-on`
 #: against this set so a typo'd check name errors instead of silently
-#: never firing
-LINT_CHECKS = frozenset(
-    [
-        "unreachable-code",
-        "invalid-jump-target",
-        "stack-underflow",
-        "dead-branch",
-        "inert-function",
-        "tainted-jump-target",
-        "tainted-delegatecall-target",
-        "tx-origin-as-auth",
-        "unprotected-selfdestruct",
-    ]
+#: never firing. The link checks live in `callgraph.LINK_CHECKS` so
+#: the linker and the lint surface can't drift.
+LINT_CHECKS = (
+    frozenset(
+        [
+            "unreachable-code",
+            "invalid-jump-target",
+            "stack-underflow",
+            "dead-branch",
+            "inert-function",
+            "tainted-jump-target",
+            "tainted-delegatecall-target",
+            "tx-origin-as-auth",
+            "unprotected-selfdestruct",
+        ]
+    )
+    | _callgraph.LINK_CHECKS
 )
 
 #: per-selector fingerprint subgraph bound: a dispatcher entry whose
@@ -210,6 +217,16 @@ class StaticSummary:
         self.function_fingerprints: Dict[str, str] = (
             self._function_fingerprints()
         )
+        #: per-contract half of the cross-contract linker: typed call
+        #: sites with target provenance + proxy classification. None
+        #: only if the link pass itself fails (linking degrades, the
+        #: summary never does).
+        self.link = None
+        try:
+            self.link = _callgraph.link_node(code, self)
+        except Exception:
+            log.debug("link pass failed; summary stays unlinked",
+                      exc_info=True)
 
         #: mutable prune observability (seeds.py increments)
         self.seeds_dropped = 0
@@ -564,6 +581,8 @@ class StaticSummary:
             }
         else:
             out["taint"] = {"incomplete": True}
+        if self.link is not None:
+            out["link"] = self.link.as_dict()
         return out
 
     def findings(self) -> List[Dict]:
@@ -630,6 +649,8 @@ class StaticSummary:
                     }
                 )
         out.extend(self._taint_findings())
+        if self.link is not None:
+            out.extend(self.link.findings())
         return out
 
     def _taint_findings(self) -> List[Dict]:

@@ -49,7 +49,7 @@ class TriageVerdict:
 
     __slots__ = (
         "code_hash", "findings", "survivor", "idempotency_key",
-        "static_answerable", "incomplete", "elapsed_s",
+        "static_answerable", "incomplete", "elapsed_s", "link",
     )
 
     def __init__(
@@ -60,6 +60,7 @@ class TriageVerdict:
         static_answerable: bool,
         incomplete: bool,
         elapsed_s: float,
+        link: Optional[Dict] = None,
     ) -> None:
         self.code_hash = code_hash
         self.findings = list(findings)
@@ -67,6 +68,11 @@ class TriageVerdict:
         self.static_answerable = static_answerable
         self.incomplete = incomplete
         self.elapsed_s = elapsed_s
+        #: the cross-contract link block (callgraph.ContractNode
+        #: compact facts) — proxy classification and call-site degree
+        #: ride the alert so a downstream pager sees "this is a proxy
+        #: pointing at upgradeable code" without re-deriving anything
+        self.link = dict(link) if link else None
         self.idempotency_key = idempotency_key_for(code_hash)
 
     def as_dict(self) -> Dict:
@@ -77,6 +83,7 @@ class TriageVerdict:
             "static_answerable": self.static_answerable,
             "incomplete": self.incomplete,
             "elapsed_s": self.elapsed_s,
+            "link": dict(self.link) if self.link else None,
         }
 
 
@@ -109,12 +116,29 @@ class StaticTriage:
             applicable, _skipped = summary.applicable_modules()
             answerable = summary.static_answerable
             incomplete = bool(summary.incomplete)
+            link = None
+            node = getattr(summary, "link", None)
+            if node is not None:
+                link = {
+                    "out_degree": node.out_degree,
+                    "delegatecall_sites": len(node.delegatecall_sites),
+                    "is_proxy": node.is_proxy,
+                    "proxy_kind": node.proxy_kind,
+                    "upgradeable": node.upgradeable,
+                    "provenance": node.provenance_counts(),
+                }
+                # the link lint checks ride the findings list beside
+                # the applicable-module names — one alert payload
+                applicable = list(applicable) + [
+                    row["check"] for row in node.findings()
+                ]
         except Exception as why:
             # a bytecode the static layer chokes on is by definition
             # interesting: keep it a survivor with no static findings
             self.failures += 1
             log.warning("static triage failed (%s); forwarding", why)
             applicable, answerable, incomplete = [], False, True
+            link = None
         verdict = TriageVerdict(
             digest,
             findings=applicable,
@@ -122,6 +146,7 @@ class StaticTriage:
             static_answerable=answerable,
             incomplete=incomplete,
             elapsed_s=time.monotonic() - started,
+            link=link,
         )
         self.triaged += 1
         if answerable:

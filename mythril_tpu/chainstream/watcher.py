@@ -62,12 +62,28 @@ from mythril_tpu.observe.slo import (
 log = logging.getLogger(__name__)
 
 #: EIP-1967-era proxy upgrade entrypoints; the implementation address
-#: is the first (left-zero-padded) calldata word after the selector
+#: is the first (left-zero-padded) calldata word after the selector.
+#: Derived from the static linker's table (callgraph.UPGRADE_SELECTORS)
+#: so the stream detector and the lint/graph layer cannot drift.
 SELECTOR_UPGRADE_TO = "3659cfe6"  # upgradeTo(address)
 SELECTOR_UPGRADE_TO_AND_CALL = "4f1ef286"  # upgradeToAndCall(address,bytes,..)
+try:
+    from mythril_tpu.analysis.static.callgraph import UPGRADE_SELECTORS
+
+    UPGRADE_SELECTOR_HEXES = frozenset(
+        key[2:].lower() for key in UPGRADE_SELECTORS
+    )
+except Exception:  # linker unavailable: the literals above stand alone
+    UPGRADE_SELECTOR_HEXES = frozenset(
+        [SELECTOR_UPGRADE_TO, SELECTOR_UPGRADE_TO_AND_CALL]
+    )
 
 KIND_DEPLOYMENT = "deployment"
 KIND_PROXY_UPGRADE = "proxy-upgrade"
+#: a deployment whose INIT CODE stores an implementation address into
+#: a named EIP-1967 slot directly (constructor-time proxy wiring — no
+#: upgradeTo call ever appears on-chain for these)
+KIND_PROXY_DEPLOYMENT = "proxy-deployment"
 
 
 def _hex_int(value) -> Optional[int]:
@@ -93,6 +109,22 @@ def _upgrade_target(calldata: str) -> Optional[str]:
     except ValueError:
         return None
     return "0x" + word[24:]  # low 20 bytes
+
+
+def _init_code_implementation(init_code: str) -> Optional[str]:
+    """The implementation address a deploy tx's init code bakes into
+    a named EIP-1967 slot (PUSH20 addr … PUSH32 impl-slot … SSTORE),
+    via the linker's shared matcher — or None. Never fatal: a weird
+    init code is just not a constructor-wired proxy."""
+    try:
+        from mythril_tpu.analysis.static.callgraph import (
+            implementation_from_init_code,
+        )
+
+        impl = implementation_from_init_code(init_code)
+    except Exception:
+        return None
+    return f"0x{impl:040x}" if impl else None
 
 
 def chainstream_objectives(alert_budget_s: float) -> List[Objective]:
@@ -394,9 +426,14 @@ class ChainWatcher:
 
     def _extract_targets(self, block: Dict) -> List[Tuple[str, str]]:
         """(address, kind) pairs a block surfaces: contract creations
-        (null `to` -> the receipt's contractAddress) and proxy
-        upgrades (selector match -> implementation address from
-        calldata, no receipt fetch needed)."""
+        (null `to` -> the receipt's contractAddress), constructor-time
+        proxy wiring (the deploy tx's init code stores an address into
+        a named EIP-1967 implementation slot — the linker's shared
+        pattern matcher, so proxies that never emit an upgradeTo call
+        still surface their implementation), and proxy upgrades
+        (selector match -> implementation address from calldata plus
+        the proxy itself, so the PAIR is triaged together and the
+        fleet sees proxy context beside the new implementation)."""
         out: List[Tuple[str, str]] = []
         for tx in block.get("transactions") or ():
             if not isinstance(tx, dict):
@@ -410,15 +447,21 @@ class ChainWatcher:
                 address = (receipt or {}).get("contractAddress")
                 if address:
                     out.append((address, KIND_DEPLOYMENT))
+                baked = _init_code_implementation(tx.get("input") or "")
+                if baked:
+                    out.append((baked, KIND_PROXY_DEPLOYMENT))
                 continue
             data = tx.get("input") or ""
             body = data[2:] if data.startswith("0x") else data
-            if body[:8].lower() in (
-                SELECTOR_UPGRADE_TO, SELECTOR_UPGRADE_TO_AND_CALL
-            ):
+            if body[:8].lower() in UPGRADE_SELECTOR_HEXES:
                 target = _upgrade_target(data)
                 if target:
                     out.append((target, KIND_PROXY_UPGRADE))
+                    # the unchanged proxy rides along: its verdict is
+                    # cached/stored, so the re-triage is near-free, and
+                    # the alert stream shows the pair, not an orphan
+                    # implementation
+                    out.append((tx["to"], KIND_PROXY_UPGRADE))
         return out
 
     # -- fleet submission ----------------------------------------------

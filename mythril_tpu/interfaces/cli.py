@@ -59,6 +59,7 @@ COMMAND_LIST = (
         "hash-to-address",
         "list-detectors",
         "lint",
+        "graph",
         "serve",
         "fleet",
         "watch",
@@ -801,8 +802,41 @@ def build_parser() -> ArgumentParser:
             "CI gate. Checks: unreachable-code, invalid-jump-target, "
             "stack-underflow, dead-branch, inert-function, "
             "tainted-jump-target, tainted-delegatecall-target, "
-            "tx-origin-as-auth, unprotected-selfdestruct"
+            "tx-origin-as-auth, unprotected-selfdestruct, "
+            "delegatecall-to-upgradeable-target, "
+            "proxy-storage-collision, tainted-cross-contract-call-arg, "
+            "untrusted-return-data-in-guard"
         ),
+    )
+
+    graph = subparsers.add_parser(
+        "graph",
+        help=(
+            "Cross-contract static linker: join every input "
+            "contract's call sites into one typed inter-contract call "
+            "graph (provenance-annotated edges, proxy pairing, "
+            "escape summaries, arena co-location plan) — pure host "
+            "work, sub-second, no device initialization. Deployment "
+            "addresses ride file/contract names as "
+            "'name@0x<40-hex-addr>'"
+        ),
+        formatter_class=RawTextHelpFormatter,
+    )
+    graph.add_argument(
+        "graph_inputs",
+        nargs="+",
+        metavar="DIR|FILE",
+        help=(
+            "directories and/or files of runtime bytecode hex "
+            "(.hex/.sol.o/.bin-runtime or raw hex files)"
+        ),
+    )
+    graph.add_argument(
+        "--json",
+        action="store_true",
+        dest="graph_json",
+        help="emit the full link-graph JSON payload (schema_version "
+        "pinned) instead of the human summary",
     )
 
     serve = subparsers.add_parser(
@@ -2618,6 +2652,165 @@ def _cmd_submit(args: Namespace) -> None:
     sys.exit()
 
 
+#: file suffixes `myth graph DIR` picks up when walking a directory
+#: (explicitly named files are always taken as-is)
+_GRAPH_SUFFIXES = (".hex", ".sol.o", ".bin-runtime", ".bin", ".evm", ".code")
+
+
+def _graph_inputs(paths):
+    """Expand `myth graph` positionals into (name, runtime_hex) rows.
+
+    Directories contribute their hex-bearing files (sorted, one
+    contract per file); files given directly are taken regardless of
+    suffix. The file stem is the contract name — a ``@0x<40 hex>``
+    suffix in it declares the deployment address for the link-time
+    address book (linkset.address_from_name)."""
+    files = []
+    for given in paths:
+        if os.path.isdir(given):
+            for entry in sorted(os.listdir(given)):
+                full = os.path.join(given, entry)
+                if os.path.isfile(full) and entry.endswith(_GRAPH_SUFFIXES):
+                    files.append(full)
+        elif os.path.isfile(given):
+            files.append(given)
+        else:
+            log.error("graph input not found: %s", given)
+            sys.exit(2)
+    rows = []
+    for path in files:
+        try:
+            with open(path) as handle:
+                blob = "".join(
+                    part for line in handle for part in line.split()
+                )
+        except OSError as why:
+            log.error("cannot read %s: %s", path, why)
+            sys.exit(2)
+        if blob.startswith("0x"):
+            blob = blob[2:]
+        if not blob:
+            log.warning("graph: %s is empty; skipped", path)
+            continue
+        try:
+            bytes.fromhex(blob)
+        except ValueError:
+            log.warning("graph: %s is not bytecode hex; skipped", path)
+            continue
+        name = os.path.basename(path)
+        for suffix in _GRAPH_SUFFIXES:
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        rows.append((name, blob))
+    return rows
+
+
+def _cmd_graph(args: Namespace) -> None:
+    """`myth graph DIR|FILE... [--json]` — cross-contract static
+    linker over runtime bytecode files: per-contract link facts join
+    into the typed call graph (provenance-tagged edges, proxy pairs,
+    storage-collision diff, escape summaries, linked fingerprints,
+    arena co-location plan). Pure host work — the static layer never
+    imports jax — so a fixture pair links in well under a second."""
+    from mythril_tpu.analysis.static import summary_for
+    from mythril_tpu.analysis.static.linkset import LinkSet
+
+    rows = _graph_inputs(args.graph_inputs)
+    if not rows:
+        log.error("graph: no bytecode inputs")
+        sys.exit(2)
+    linkset = LinkSet()
+    for name, blob in rows:
+        try:
+            linkset.add(name, bytes.fromhex(blob), summary_for(blob))
+        except Exception as why:
+            log.warning("graph: link pass skipped %s: %s", name, why)
+    if not linkset.nodes:
+        log.error("graph: no contract linked")
+        sys.exit(1)
+    payload = linkset.as_dict()
+    if args.graph_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        sys.exit()
+
+    names = linkset.names
+    stats = payload["stats"]
+    print(
+        "Link graph: {nodes} contract(s), {edges} call site(s) "
+        "({edges_resolved} resolved, resolve rate {resolve_rate})".format(
+            **stats
+        )
+    )
+    for edge in payload["edges"]:
+        target = (
+            names.get(edge["callee"], edge["callee"])
+            if edge["callee"]
+            else (edge["target_address"] or "?")
+        )
+        print(
+            "  {caller} pc {pc} {kind} [{selector}] --{provenance}--> "
+            "{target}{mark}".format(
+                caller=names.get(edge["caller"], edge["caller"]),
+                pc=edge["pc"],
+                kind=edge["kind"],
+                selector=edge["selector"],
+                provenance=edge["provenance"],
+                target=target,
+                mark="" if edge["resolved"] else " (unresolved)",
+            )
+        )
+    if payload["proxy_pairs"]:
+        print("Proxy pairs:")
+        for pair in payload["proxy_pairs"]:
+            print(
+                "  {proxy} --[{kind}{upgrade}]--> {impl}".format(
+                    proxy=names.get(pair["proxy"], pair["proxy"]),
+                    kind=pair["kind"],
+                    upgrade=", upgradeable" if pair["upgradeable"] else "",
+                    impl=names.get(
+                        pair["implementation"], pair["implementation"]
+                    ),
+                )
+            )
+    if payload["collisions"]:
+        print("Storage collisions:")
+        for row in payload["collisions"]:
+            print(
+                "  {proxy} / {impl}: slot(s) {slots}".format(
+                    proxy=names.get(row["proxy"], row["proxy"]),
+                    impl=names.get(
+                        row["implementation"], row["implementation"]
+                    ),
+                    slots=", ".join(row["slots"]),
+                )
+            )
+    if payload["findings"]:
+        print("Findings:")
+        for finding in payload["findings"]:
+            print(
+                "  - [{check}] {contract}: {detail}".format(**finding)
+            )
+    print("Arena co-location plan:")
+    for entry, callees in payload["arena_plan"].items():
+        print(
+            "  {entry}: {callees}".format(
+                entry=entry,
+                callees=(
+                    ", ".join(names.get(ch, ch) for ch in callees)
+                    if callees
+                    else "(self only)"
+                ),
+            )
+        )
+    print(
+        "Proxies: {proxies}, pairs: {proxy_pairs}, collisions: "
+        "{collisions}, escape widened: {escape_widened}, wall: "
+        "{wall_ms} ms".format(**stats)
+    )
+    sys.exit()
+
+
 def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
     if args.epic:
         here = os.path.dirname(os.path.realpath(__file__))
@@ -2647,6 +2840,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_solverlab(args)
     if args.command == "observe":
         _cmd_observe(args)
+    if args.command == "graph":
+        _cmd_graph(args)
     if args.command == "help":
         parser.print_help()
         sys.exit()

@@ -60,6 +60,10 @@ STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     ("kernel_pack_hit_rate", "higher", 0.10),
     ("static_answer_rate", "higher", 0.25),
     ("static_prune_rate", "higher", 0.50),
+    # cross-contract linker (ISSUE 18): the planted fixture families
+    # must keep resolving — the rate mixes in organic (unresolvable)
+    # corpus edges, so the gate is loose; absent in pre-r08 records
+    ("link_resolve_rate", "higher", 0.25),
     ("screen_mount_rate_semantic", "lower", 0.25),
     ("default_path_issues", "higher", 0.0),
     ("trace_overlap_frac", "higher", 0.25),

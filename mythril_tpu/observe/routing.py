@@ -32,10 +32,14 @@ from typing import Dict, List, Optional
 #: "static-answer" route. v3 adds the top-level ``journey_id`` — the
 #: key that joins a record to its tier-ladder timeline
 #: (observe/journey.py), so features ⨝ route ⨝ outcome ⨝ timeline
-#: joins offline. v1/v2 records parse through `read_records` /
-#: `parse_record` unchanged (absent features read as None; absent
-#: journey_id reads as None).
-SCHEMA_VERSION = 3
+#: joins offline. v4 adds the cross-contract link feature block
+#: (`V4_FEATURE_KEYS`): out-degree / resolved degree of the contract's
+#: static call graph node, proxy classification flags, and the escape
+#: -summary density — the "how entangled is this contract" axis the
+#: cost model needs once multi-account arenas exist. v1/v2/v3 records
+#: parse through `read_records` / `parse_record` unchanged (absent
+#: features read as None; absent journey_id reads as None).
+SCHEMA_VERSION = 4
 
 #: every record carries exactly these top-level keys (the JSONL golden
 #: test pins them); ``journey_id`` may be None for pre-v3 records
@@ -53,6 +57,17 @@ V2_FEATURE_KEYS = (
     "resolved_call_targets",
     "fingerprints",
     "static_answerable",
+)
+
+#: feature keys added by schema v4 (the cross-contract linker block;
+#: same None-fill back-compat rule as V2_FEATURE_KEYS)
+V4_FEATURE_KEYS = (
+    "link_out_degree",
+    "link_resolved_degree",
+    "link_is_proxy",
+    "link_proxy_kind",
+    "link_delegatecall_sites",
+    "link_escape_density",
 )
 
 
@@ -122,13 +137,20 @@ _STORAGE_OPS = (0x54, 0x55)  # SLOAD, SSTORE
 _CALL_OPS = (0xF1, 0xF2, 0xF4, 0xFA)  # CALL family
 
 
-def features_for(code_hex: str, summary=None) -> Dict:
+def features_for(code_hex: str, summary=None, link=None) -> Dict:
     """The static feature vector for one contract. Uses the cached
     StaticSummary when available (CFG sizes, dead selectors, screened
     modules); degrades to byte-scan features when the static layer is
     off or failed — the record always exists. Pass ``summary=False``
     to skip the summary build outright (the microsecond admission
-    tiers must not pay a CFG recovery for a telemetry row)."""
+    tiers must not pay a CFG recovery for a telemetry row).
+
+    ``link`` is an optional corpus-resolved link block
+    (LinkSet.node_meta): when given it fills the schema-v4 features
+    with graph-resolved values (resolved degree, escape density);
+    without it the per-contract half (out-degree, proxy flags) still
+    lands from the summary's own link node and the graph-level columns
+    stay None."""
     code_hex = code_hex[2:] if code_hex.startswith("0x") else code_hex
     try:
         code = bytes.fromhex(code_hex)
@@ -189,6 +211,25 @@ def features_for(code_hex: str, summary=None) -> Dict:
             )
         except Exception:
             pass
+    # schema v4: the cross-contract link block — corpus-resolved when
+    # a LinkSet rode along, per-contract (graph columns None) otherwise
+    link_row = link
+    if link_row is None and summary is not None:
+        node = getattr(summary, "link", None)
+        if node is not None:
+            try:
+                link_row = node.as_dict()
+            except Exception:
+                link_row = None
+    if link_row:
+        feats.update(
+            link_out_degree=link_row.get("out_degree"),
+            link_resolved_degree=link_row.get("resolved_degree"),
+            link_is_proxy=link_row.get("is_proxy"),
+            link_proxy_kind=link_row.get("proxy_kind"),
+            link_delegatecall_sites=link_row.get("delegatecall_sites"),
+            link_escape_density=link_row.get("escape_density"),
+        )
     try:
         from mythril_tpu.laser.batch import specialize as _spec
 
@@ -280,7 +321,7 @@ def parse_record(line_or_obj) -> Dict:
             f"reader (v{SCHEMA_VERSION})"
         )
     features = dict(rec.get("features") or {})
-    for key in V2_FEATURE_KEYS:
+    for key in V2_FEATURE_KEYS + V4_FEATURE_KEYS:
         features.setdefault(key, None)
     rec["features"] = features
     return rec

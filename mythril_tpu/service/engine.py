@@ -2694,6 +2694,16 @@ class AnalysisEngine:
             self.queue.settle(job, JobState.FAILED)
 
     # -- introspection --------------------------------------------------
+    def _link_stats(self) -> Dict:
+        """`static.link.*`: the linker's process-wide counters. Never
+        fatal — a missing linker reads as all-zero, not a 500."""
+        try:
+            from mythril_tpu.analysis.static import link_stat_counts
+
+            return dict(link_stat_counts())
+        except Exception:
+            return {}
+
     def _kernel_stats(self) -> Dict:
         """The specialization scorecard (/stats kernel.*): the
         process-wide compile cache (size, hits, misses, compiles in
@@ -2932,6 +2942,11 @@ class AnalysisEngine:
                     sv("mtpu_service_static_answered_total")
                 ),
                 "answer_enabled": bool(self.cfg.static_answer),
+                # the cross-contract linker's process-wide counters
+                # (analysis/static/callgraph.py): nodes/sites linked,
+                # provenance resolution, proxy pairing, escape
+                # widening — the `static.link.*` rows
+                "link": self._link_stats(),
             },
             "journal": dict(
                 (

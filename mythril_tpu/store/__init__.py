@@ -44,6 +44,7 @@ from mythril_tpu.store.diff import (  # noqa: F401
     SelectorMaskFeed,
     merge_banked_issues,
     plan_incremental,
+    plan_linked_incremental,
 )
 from mythril_tpu.store.store import (  # noqa: F401
     ENTRY_SCHEMA_VERSION,
@@ -76,34 +77,60 @@ def configured_store(directory: Optional[str] = None):
     return open_store(directory)
 
 
-def static_export(summary) -> Dict:
+def static_export(summary, linkset=None) -> Dict:
     """The StaticSummary slice a store entry carries: enough to diff a
     future fork against this verdict (fingerprints + selector block
-    spans) and to sanity-check pc stability (code_len)."""
+    spans), to sanity-check pc stability (code_len), and — when a
+    corpus `LinkSet` is in force — the CALL-GRAPH fingerprints
+    (selector -> hash of base fp + resolved callee closure) that let
+    a later run detect "same code, upgraded callee" and re-analyze
+    only the selectors whose closure moved."""
     if summary is None:
         return {}
     try:
-        return {
-            "code_len": summary.code_len,
-            "function_fingerprints": dict(summary.function_fingerprints),
-            "selector_spans": {
-                sel: [list(span) for span in spans]
-                for sel, spans in summary.selector_subgraphs().items()
-            },
-            "resolved_call_targets": {
-                str(pc): f"0x{target:040x}"
-                for pc, target in sorted(
-                    getattr(
-                        summary.vsa, "resolved_call_targets", {}
-                    ).items()
-                )
-            }
-            if getattr(summary, "vsa", None) is not None
-            else {},
-            "static_answerable": bool(summary.static_answerable),
-        }
+        out = _static_export_base(summary)
+        if linkset is not None and summary.link is not None:
+            linked, problems = linkset.linked_fingerprints(
+                summary.code_hash
+            )
+            if linked:
+                out["linked_fingerprints"] = linked
+            if problems:
+                out["link_problems"] = problems
+            meta = linkset.node_meta(summary.code_hash)
+            if meta is not None:
+                out["link"] = {
+                    "out_degree": meta.get("out_degree", 0),
+                    "resolved_degree": meta.get("resolved_degree", 0),
+                    "is_proxy": meta.get("is_proxy", False),
+                    "proxy_kind": meta.get("proxy_kind"),
+                    "escape_density": meta.get("escape_density", 0.0),
+                }
+        return out
     except Exception:
         return {}
+
+
+def _static_export_base(summary) -> Dict:
+    return {
+        "code_len": summary.code_len,
+        "function_fingerprints": dict(summary.function_fingerprints),
+        "selector_spans": {
+            sel: [list(span) for span in spans]
+            for sel, spans in summary.selector_subgraphs().items()
+        },
+        "resolved_call_targets": {
+            str(pc): f"0x{target:040x}"
+            for pc, target in sorted(
+                getattr(
+                    summary.vsa, "resolved_call_targets", {}
+                ).items()
+            )
+        }
+        if getattr(summary, "vsa", None) is not None
+        else {},
+        "static_answerable": bool(summary.static_answerable),
+    }
 
 
 def banks_from_outcome(outcome: Optional[Dict]) -> Dict:
@@ -158,6 +185,7 @@ __all__ = [
     "merge_banked_issues",
     "open_store",
     "plan_incremental",
+    "plan_linked_incremental",
     "provenance",
     "static_export",
     "store_enabled",

@@ -101,6 +101,7 @@ class IncrementalPlan:
         mask_directions: Set[Tuple[int, bool]],
         banked_issues: List[Dict],
         injected_outcome: Optional[Dict],
+        linked: bool = False,
     ) -> None:
         self.base_code_hash = base_code_hash
         self.changed = set(changed)
@@ -109,6 +110,10 @@ class IncrementalPlan:
         self.mask_directions = set(mask_directions)
         self.banked_issues = list(banked_issues)
         self.injected_outcome = injected_outcome
+        #: True when this plan came from a LINKED-fingerprint diff
+        #: (same codehash, moved callee closure) rather than a code
+        #: diff against a near-neighbor
+        self.linked = linked
 
     def mask_feed(self, static) -> SelectorMaskFeed:
         return SelectorMaskFeed(
@@ -122,6 +127,7 @@ class IncrementalPlan:
             "unchanged_selectors": sorted(self.unchanged),
             "banked_issues": len(self.banked_issues),
             "coverage_injected": bool(self.injected_outcome),
+            "linked": self.linked,
         }
 
 
@@ -294,6 +300,103 @@ def _injected_outcome(
             "partial": False,
         },
     }
+
+
+def plan_linked_incremental(
+    summary,
+    entry,
+    linked_now: Dict[str, str],
+    link_problems: Optional[Dict[str, str]] = None,
+) -> Optional[IncrementalPlan]:
+    """The CALL-GRAPH-fingerprint incremental plan: `summary`'s
+    contract has the SAME codehash as stored `entry` (an exact store
+    hit), but a callee behind one of its resolved call edges changed —
+    visible as a linked-fingerprint mismatch between `linked_now`
+    (the current LinkSet's selector -> linked fp for this contract)
+    and the fps persisted with the entry.
+
+    Returns None when every linked fingerprint matches (the exact hit
+    stands as-is), an IncrementalPlan re-analyzing only the selectors
+    whose callee closure moved, or raises IncrementalBail — including
+    the link-specific reasons ``link-unresolved`` / ``link-cycle``
+    when the current graph cannot pin a selector's closure.
+
+    The code being byte-identical relaxes one plan_incremental rule:
+    DELEGATECALL inside a CHANGED selector is the expected shape (the
+    proxy's forward function), not a bail — but it counts as a state
+    WRITE for the cross-selector staleness check, since the new
+    implementation may store anywhere."""
+    if summary is None or summary.incomplete:
+        raise IncrementalBail("summary-incomplete")
+    if summary.taint is None or summary.taint.incomplete:
+        raise IncrementalBail("taint-incomplete")
+    problems = dict(link_problems or {})
+    if problems:
+        # a selector whose closure crosses an unresolved edge or a
+        # cycle can never be proven unchanged — conservative full bail
+        raise IncrementalBail(sorted(set(problems.values()))[0])
+    old_linked = entry.linked_fingerprints
+    if not old_linked or not linked_now:
+        raise IncrementalBail("linked-fingerprints-absent")
+    new_fps = dict(summary.function_fingerprints)
+    if not new_fps:
+        raise IncrementalBail("fingerprints-absent")
+    new_dirs = summary.selector_entry_directions()
+    if set(new_dirs) - set(new_fps):
+        raise IncrementalBail("fingerprints-incomplete")
+    unchanged = {
+        sel
+        for sel in set(linked_now) & set(old_linked)
+        if linked_now[sel] == old_linked[sel]
+    }
+    changed = set(new_fps) - unchanged
+    if not changed:
+        return None  # closure identical everywhere: pure exact hit
+    if not unchanged:
+        raise IncrementalBail("no-shared-selectors")
+
+    changed_ops = _span_ops(summary, changed)
+    unchanged_ops = _span_ops(summary, unchanged)
+    if _ESCAPE_OPS & unchanged_ops:
+        # an unchanged selector's OWN delegatecall is pinned by its
+        # matching linked fp, but its matching fp cannot pin what a
+        # CHANGED selector's callee does to shared storage it reads —
+        # and with escape ops on the unchanged side the span-local
+        # issue attribution below loses meaning
+        raise IncrementalBail("delegatecall-in-reach")
+    writes = (_STATE_WRITE_OPS | _ESCAPE_OPS) & changed_ops
+    if writes and (_STATE_READ_OPS & unchanged_ops):
+        raise IncrementalBail("cross-selector-state-flow")
+
+    old_spans = entry.selector_spans
+    if not old_spans:
+        raise IncrementalBail("selector-spans-absent")
+    banked: List[Dict] = []
+    for issue in entry.issues:
+        address = issue.get("address")
+        if not isinstance(address, int):
+            raise IncrementalBail("unattributable-issue")
+        owners = _selectors_at(old_spans, address)
+        if not owners:
+            continue
+        if owners <= unchanged:
+            banked.append(dict(issue))
+
+    injected = _injected_outcome(summary, entry, unchanged, old_spans)
+    mask_selectors = {bytes.fromhex(sel[2:]) for sel in unchanged}
+    mask_directions = {
+        new_dirs[sel] for sel in unchanged if sel in new_dirs
+    }
+    return IncrementalPlan(
+        base_code_hash=entry.code_hash,
+        changed=changed,
+        unchanged=unchanged,
+        mask_selectors=mask_selectors,
+        mask_directions=mask_directions,
+        banked_issues=banked,
+        injected_outcome=injected,
+        linked=True,
+    )
 
 
 def merge_banked_issues(

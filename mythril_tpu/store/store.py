@@ -106,6 +106,18 @@ class StoreEntry:
         )
 
     @property
+    def linked_fingerprints(self) -> Dict[str, str]:
+        """selector -> call-graph fingerprint (base fp + resolved
+        callee closure). Empty for entries written before the linker
+        or outside corpus-link mode — consumers treat that as "no
+        link diffing possible" and fall back to the plain exact-hit
+        behavior."""
+        return dict(
+            (self.data.get("static") or {}).get("linked_fingerprints")
+            or {}
+        )
+
+    @property
     def code_len(self) -> int:
         return int((self.data.get("static") or {}).get("code_len") or 0)
 

@@ -1,0 +1,610 @@
+"""Cross-contract static linker suite: call-site provenance goldens,
+SCC-aware escape widening, proxy pairing + storage-collision diff,
+the linked-fingerprint invalidation differential through the verdict
+store, the `myth graph` JSON golden, the four link lint checks, and
+the routing-schema v3 -> v4 back-compat.
+
+Tier-1 via the `linker` marker (tox -e linker runs it alone).
+Host-only: the linker never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mythril_tpu.analysis.corpusgen import (
+    cross_call_pair,
+    minimal_proxy,
+    proxy_pair,
+    synth_bench_corpus,
+)
+from mythril_tpu.analysis.static import (
+    LINT_CHECKS,
+    LINT_SCHEMA_VERSION,
+    analyze_bytecode,
+    summary_for,
+)
+from mythril_tpu.analysis.static.callgraph import (
+    EIP1967_IMPL_SLOT,
+    LINK_CHECKS,
+    MINIMAL_PROXY_CALL_PC,
+    PROV_CONSTANT,
+    PROV_MINIMAL_PROXY,
+    PROV_PROXY_SLOT,
+    PROV_STORAGE_SLOT,
+    PROV_TAINTED,
+    implementation_from_init_code,
+    minimal_proxy_target,
+)
+from mythril_tpu.analysis.static.linkset import (
+    GRAPH_SCHEMA_VERSION,
+    LinkSet,
+    address_from_name,
+    link_corpus,
+)
+from mythril_tpu.analysis.static.taint import TAINT_ANY, TAINT_ATTACKER
+
+pytestmark = pytest.mark.linker
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _edges(linkset):
+    return linkset.resolve()["edges"]
+
+
+def _linkset_of(rows):
+    return link_corpus(rows)
+
+
+def _checks(summary):
+    return {f["check"] for f in summary.findings()}
+
+
+# -- provenance goldens ------------------------------------------------------
+def test_provenance_proxy_slot():
+    """An EIP-1967 slot-read DELEGATECALL resolves through the runtime
+    slot binding to the implementation declared at the book address."""
+    rows = proxy_pair(seed=0)
+    linkset = _linkset_of(rows)
+    (edge,) = _edges(linkset)
+    assert edge["kind"] == "DELEGATECALL"
+    assert edge["provenance"] == PROV_PROXY_SLOT
+    assert edge["resolved"] is True
+    assert edge["target_address"] == "0x" + rows[1][2].split("@0x")[1]
+    proxy_node = linkset.nodes[edge["caller"]]
+    assert proxy_node.proxy_kind == "eip1967"
+    assert proxy_node.upgradeable  # mounts upgradeTo + writes the slot
+    assert linkset.stats()["resolve_rate"] == 1.0
+
+
+def test_provenance_minimal_proxy():
+    """An EIP-1167 forwarder is recognized whole-code: the baked
+    implementation address resolves without any dataflow."""
+    rows = minimal_proxy(seed=0)
+    linkset = _linkset_of(rows)
+    (edge,) = _edges(linkset)
+    assert edge["kind"] == "DELEGATECALL"
+    assert edge["provenance"] == PROV_MINIMAL_PROXY
+    assert edge["pc"] == MINIMAL_PROXY_CALL_PC
+    assert edge["resolved"] is True
+    assert linkset.nodes[edge["caller"]].minimal_proxy is True
+    # the whole-code matcher round-trips the literal
+    code = bytes.fromhex(rows[0][0])
+    assert minimal_proxy_target(code) == int(edge["target_address"], 16)
+    assert minimal_proxy_target(bytes.fromhex(rows[1][0])) is None
+
+
+def test_provenance_constant():
+    """A PUSH20-literal CALL target is `constant` and resolves through
+    the address book."""
+    rows = cross_call_pair(seed=0)
+    linkset = _linkset_of(rows)
+    (edge,) = _edges(linkset)
+    assert edge["kind"] == "CALL"
+    assert edge["provenance"] == PROV_CONSTANT
+    assert edge["resolved"] is True
+    assert edge["callee"] in linkset.nodes
+    assert address_from_name(rows[1][2]) == int(edge["target_address"], 16)
+
+
+def test_provenance_tainted():
+    """A CALLDATALOAD-fed DELEGATECALL target is `tainted` and can
+    never resolve (any address is reachable)."""
+    # PUSH1 0 x4; CALLDATALOAD(0); PUSH2 gas; DELEGATECALL; POP; STOP
+    code_hex = "6000600060006000" + "600035" + "61ffff" + "f45000"
+    summary = analyze_bytecode(code_hex)
+    (site,) = summary.link.call_sites
+    assert site.provenance == PROV_TAINTED
+    assert site.target_taint & TAINT_ATTACKER
+    linkset = LinkSet()
+    linkset.add("t", bytes.fromhex(code_hex), summary)
+    (edge,) = _edges(linkset)
+    assert edge["resolved"] is False
+
+
+def test_provenance_storage_slot():
+    """A target read from an UNNAMED storage slot stays `storage-slot`
+    (not proxy-slot): the slot is pinned, the value is not."""
+    # PUSH1 0 x4; SLOAD(5); PUSH2 gas; DELEGATECALL; POP; STOP
+    summary = analyze_bytecode(
+        "6000600060006000" + "600554" + "61ffff" + "f45000"
+    )
+    (site,) = summary.link.call_sites
+    assert site.provenance == PROV_STORAGE_SLOT
+    assert site.slot == 5
+
+
+def test_implementation_from_init_code():
+    """The constructor-wiring matcher the watcher shares: PUSH20 addr
+    then PUSH32 named-impl-slot (SSTORE tail) -> the address; plain
+    init code -> None; Gnosis slot 0 deliberately unmatched."""
+    addr = 0xABC
+    wired = (
+        "73" + f"{addr:040x}" + "7f" + f"{EIP1967_IMPL_SLOT:064x}" + "55"
+    )
+    assert implementation_from_init_code(wired) == addr
+    assert implementation_from_init_code("0x" + wired) == addr
+    assert implementation_from_init_code("600160005500") is None
+    assert implementation_from_init_code("") is None
+    # slot 0 (Gnosis) is far too common in init code to be a wiring
+    slot0 = "73" + f"{addr:040x}" + "7f" + f"{0:064x}" + "55"
+    assert implementation_from_init_code(slot0) is None
+
+
+# -- SCC widening + closure problems ----------------------------------------
+def test_cycle_widens_escape_and_names_link_cycle():
+    """A two-contract call cycle: both members widen to TAINT_ANY and
+    every selector whose closure enters the cycle gets `link-cycle`
+    instead of a linked fingerprint — it never silently fingerprints."""
+    caller_a = cross_call_pair(seed=0)[0]
+    caller_b = cross_call_pair(seed=1)[0]
+    target_a = address_from_name(cross_call_pair(seed=0)[1][2])
+    target_b = address_from_name(cross_call_pair(seed=1)[1][2])
+    # a's baked target resolves to b, b's to a: a 2-cycle
+    rows = [
+        (caller_a[0], "", f"a@0x{target_b:040x}"),
+        (caller_b[0], "", f"b@0x{target_a:040x}"),
+    ]
+    linkset = _linkset_of(rows)
+    data = linkset.resolve()
+    assert len(data["cyclic"]) == 2
+    for ch in linkset.nodes:
+        escapes = data["escapes"][ch]
+        sel = next(s for s in escapes if s != "*")
+        assert escapes[sel]["mask"] == TAINT_ANY
+        assert escapes[sel]["widened"] is True
+        fps, problems = linkset.linked_fingerprints(ch)
+        assert problems.get(sel) == "link-cycle"
+        assert sel not in fps
+    assert data["stats"]["escape_widened"] >= 2
+
+
+def test_unresolved_edge_names_link_unresolved():
+    """The caller WITHOUT its callee in the corpus: the edge stays
+    unresolved and the selector's fingerprint is replaced by the
+    `link-unresolved` problem; adding the callee repairs both."""
+    rows = cross_call_pair(seed=2)
+    caller_only = _linkset_of(rows[:1])
+    (edge,) = _edges(caller_only)
+    assert edge["resolved"] is False
+    ch = edge["caller"]
+    fps, problems = caller_only.linked_fingerprints(ch)
+    sel = edge["selector"]
+    assert problems.get(sel) == "link-unresolved"
+    assert sel not in fps
+    whole = _linkset_of(rows)
+    fps2, problems2 = whole.linked_fingerprints(ch)
+    assert problems2 == {}
+    assert sel in fps2
+
+
+def test_escape_mask_carries_attacker_args():
+    """The cross-call caller CALLDATACOPYs calldata into call input:
+    its selector's escape mask carries the ATTACKER bit, and the
+    post-call MLOAD guard flags return_to_guard."""
+    rows = cross_call_pair(seed=0)
+    linkset = _linkset_of(rows)
+    data = linkset.resolve()
+    (edge,) = _edges(linkset)
+    row = data["escapes"][edge["caller"]][edge["selector"]]
+    assert row["mask"] & TAINT_ATTACKER
+    assert row["widened"] is False
+    assert row.get("return_to_guard") is True
+
+
+# -- proxy pairing + storage collision --------------------------------------
+def test_proxy_pair_and_collision_positive():
+    linkset = _linkset_of(proxy_pair(seed=1, collide=True))
+    data = linkset.resolve()
+    (pair,) = data["pairs"]
+    assert pair["kind"] == "eip1967"
+    assert pair["upgradeable"] is True
+    (collision,) = data["collisions"]
+    assert collision["proxy"] == pair["proxy"]
+    assert collision["slots"] == ["0x0"]
+    assert any(
+        f["check"] == "proxy-storage-collision" for f in linkset.findings()
+    )
+
+
+def test_proxy_pair_collision_negative():
+    """Disjoint slots (and the named proxy slots themselves) never
+    collide — the diff subtracts the slots CHOSEN not to clash."""
+    linkset = _linkset_of(proxy_pair(seed=2, collide=False))
+    data = linkset.resolve()
+    assert len(data["pairs"]) == 1
+    assert data["collisions"] == []
+    assert not any(
+        f["check"] == "proxy-storage-collision" for f in linkset.findings()
+    )
+
+
+def test_arena_plan_colocates_pair():
+    linkset = _linkset_of(proxy_pair(seed=0))
+    plan = linkset.arena_plan()
+    (edge,) = _edges(linkset)
+    assert plan[edge["caller"]] == [edge["callee"]]
+    assert plan[edge["callee"]] == []
+
+
+# -- linked fingerprints: the upgrade differential --------------------------
+def test_linked_fingerprint_moves_only_forward_selector():
+    """The unit half of the acceptance pin: swap the implementation
+    behind an unchanged proxy — the proxy's base code (hence base
+    fingerprints) is identical, and ONLY the forwarding selector's
+    linked fingerprint moves; the admin selector's stays put."""
+    before = _linkset_of(proxy_pair(seed=3, variant=0))
+    after = _linkset_of(proxy_pair(seed=3, variant=1))
+    proxy_ch = next(
+        ch for ch, node in before.nodes.items() if node.is_proxy
+    )
+    assert proxy_ch in after.nodes  # proxy bytecode unchanged
+    fps_before, prob_before = before.linked_fingerprints(proxy_ch)
+    fps_after, prob_after = after.linked_fingerprints(proxy_ch)
+    assert prob_before == prob_after == {}
+    assert set(fps_before) == set(fps_after)
+    moved = [s for s in fps_before if fps_before[s] != fps_after[s]]
+    forward = f"0x{(0xCA11AB1E + 3) & 0xFFFFFFFF:08x}"
+    assert moved == [forward]
+    assert fps_before["0x3659cfe6"] == fps_after["0x3659cfe6"]
+
+
+def test_store_linked_invalidation_differential(tmp_path):
+    """THE acceptance differential, end to end through the verdict
+    store: run 1 banks proxy+impl verdicts (with linked fingerprints);
+    run 2 swaps the implementation behind the UNCHANGED proxy at the
+    same deployment address. The proxy must settle incrementally —
+    re-analyzing only the forwarding selector whose callee closure
+    moved, banking the admin selector — and a third identical run must
+    settle both rows as exact hits (never a stale verdict)."""
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.store import close_stores, open_store
+
+    kw = dict(execution_timeout=8, processes=1, use_device=False)
+    store_dir = str(tmp_path / "vstore")
+    rows_v0 = proxy_pair(seed=5, variant=0)
+    rows_v1 = proxy_pair(seed=5, variant=1)
+    assert rows_v0[0] == rows_v1[0]  # the proxy row is byte-identical
+    try:
+        cold_proxy = analyze_corpus(
+            [rows_v1[0]], store=False, **kw
+        )[0]
+        first = analyze_corpus(rows_v0, store_dir=store_dir, **kw)
+        assert all(r["complete"] for r in first)
+        assert not any(r.get("store_hit") for r in first)
+        store = open_store(store_dir)
+        assert len(store) == 2
+        # the banked proxy entry carries the linked fingerprints
+        import hashlib
+
+        from mythril_tpu.analysis.static import (
+            analysis_config_fingerprint,
+        )
+
+        proxy_hash = hashlib.sha256(
+            bytes.fromhex(rows_v0[0][0])
+        ).hexdigest()
+        config_fp = analysis_config_fingerprint(
+            transaction_count=2, create_timeout=10
+        )
+        entry = store.get(proxy_hash, config_fp)
+        assert entry is not None and entry.linked_fingerprints
+
+        second = analyze_corpus(rows_v1, store_dir=store_dir, **kw)
+        proxy_res, impl_res = second
+        assert proxy_res["store_incremental"] is True
+        assert proxy_res["store"]["linked"] is True
+        forward = f"0x{(0xCA11AB1E + 5) & 0xFFFFFFFF:08x}"
+        assert proxy_res["store"]["changed_selectors"] == [forward]
+        assert "0x3659cfe6" in proxy_res["store"]["unchanged_selectors"]
+        # issue parity with a cold full run of the (unchanged) proxy
+        assert sorted(
+            (i.get("address"), i.get("swc-id"))
+            for i in proxy_res["issues"]
+        ) == sorted(
+            (i.get("address"), i.get("swc-id"))
+            for i in cold_proxy["issues"]
+        )
+        # the NEW implementation is a fresh codehash: full analysis
+        assert not impl_res.get("store_hit")
+        assert not impl_res.get("store_incremental")
+
+        third = analyze_corpus(rows_v1, store_dir=store_dir, **kw)
+        assert all(r.get("store_hit") for r in third)
+        # routing sees the linked route
+        from mythril_tpu.observe.routing import outcome_for
+
+        assert outcome_for(proxy_res)["route"] == "store-incremental"
+    finally:
+        close_stores()
+
+
+# -- myth graph CLI ---------------------------------------------------------
+def test_myth_graph_json_golden(tmp_path):
+    """`myth graph DIR --json` resolves every constant / proxy-slot /
+    minimal-proxy edge across the fixture pairs, sub-second, and emits
+    the pinned payload shape."""
+    rows = (
+        proxy_pair(seed=0) + minimal_proxy(seed=0) + cross_call_pair(seed=0)
+    )
+    for code_hex, _creation, name in rows:
+        (tmp_path / (name.replace("#", "_") + ".hex")).write_text(code_hex)
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "myth"),
+            "graph",
+            str(tmp_path),
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["schema_version"] == GRAPH_SCHEMA_VERSION
+    assert sorted(payload) == [
+        "arena_plan",
+        "collisions",
+        "contracts",
+        "edges",
+        "findings",
+        "proxy_pairs",
+        "schema_version",
+        "stats",
+    ]
+    assert len(payload["contracts"]) == 6
+    assert len(payload["edges"]) == 3
+    assert all(e["resolved"] for e in payload["edges"])
+    assert {e["provenance"] for e in payload["edges"]} == {
+        PROV_CONSTANT,
+        PROV_PROXY_SLOT,
+        PROV_MINIMAL_PROXY,
+    }
+    assert payload["stats"]["resolve_rate"] == 1.0
+    assert len(payload["proxy_pairs"]) == 2
+    # sub-second per pair, by a wide margin: the whole 6-contract link
+    assert payload["stats"]["wall_ms"] < 1000.0
+    # the arena co-location plan maps each forwarder onto its callee
+    plan = payload["arena_plan"]
+    assert any(callees for callees in plan.values())
+
+
+def test_myth_graph_human_output(tmp_path):
+    rows = proxy_pair(seed=0)
+    for code_hex, _creation, name in rows:
+        (tmp_path / (name.replace("#", "_") + ".hex")).write_text(code_hex)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "myth"), "graph", str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "Link graph:" in out.stdout
+    assert "proxy-slot" in out.stdout
+    assert "Proxy pairs:" in out.stdout
+    assert "Arena co-location plan:" in out.stdout
+
+
+# -- the four link lint checks ----------------------------------------------
+def test_link_checks_registered():
+    assert LINK_CHECKS <= LINT_CHECKS
+    assert LINT_SCHEMA_VERSION == 3
+    assert len(LINT_CHECKS) == 13
+
+
+def test_lint_delegatecall_to_upgradeable_target():
+    proxy_hex = proxy_pair(seed=0)[0][0]
+    assert "delegatecall-to-upgradeable-target" in _checks(
+        summary_for(proxy_hex)
+    )
+
+
+def test_lint_tainted_cross_contract_call_arg():
+    caller_hex = cross_call_pair(seed=0)[0][0]
+    assert "tainted-cross-contract-call-arg" in _checks(
+        summary_for(caller_hex)
+    )
+    # a minimal proxy forwards calldata BY DESIGN: never flagged
+    forwarder_hex = minimal_proxy(seed=0)[0][0]
+    assert "tainted-cross-contract-call-arg" not in _checks(
+        summary_for(forwarder_hex)
+    )
+
+
+def test_lint_untrusted_return_data_in_guard():
+    caller_hex = cross_call_pair(seed=0)[0][0]
+    assert "untrusted-return-data-in-guard" in _checks(
+        summary_for(caller_hex)
+    )
+    # the proxy never branches on returned memory
+    assert "untrusted-return-data-in-guard" not in _checks(
+        summary_for(proxy_pair(seed=0)[0][0])
+    )
+
+
+def test_lint_proxy_storage_collision_needs_the_pair():
+    """The pair-level check fires from LinkSet.findings() with both
+    row names attached — a single contract can never produce it."""
+    rows = proxy_pair(seed=7, collide=True)
+    assert "proxy-storage-collision" not in _checks(
+        summary_for(rows[0][0])
+    )
+    linkset = _linkset_of(rows)
+    (finding,) = [
+        f
+        for f in linkset.findings()
+        if f["check"] == "proxy-storage-collision"
+    ]
+    assert finding["contract"] == rows[0][2]
+    assert rows[1][2] in finding["detail"]
+
+
+# -- routing schema v4 ------------------------------------------------------
+def test_routing_v4_link_features_and_backcompat():
+    from mythril_tpu.observe.routing import (
+        SCHEMA_VERSION,
+        V4_FEATURE_KEYS,
+        features_for,
+        parse_record,
+    )
+
+    assert SCHEMA_VERSION == 4
+    rows = proxy_pair(seed=0)
+    linkset = _linkset_of(rows)
+    proxy_ch = next(
+        ch for ch, node in linkset.nodes.items() if node.is_proxy
+    )
+    feats = features_for(rows[0][0], link=linkset.node_meta(proxy_ch))
+    assert feats["link_is_proxy"] is True
+    assert feats["link_proxy_kind"] == "eip1967"
+    assert feats["link_out_degree"] == 1
+    assert feats["link_resolved_degree"] == 1
+    assert feats["link_delegatecall_sites"] == 1
+    assert isinstance(feats["link_escape_density"], float)
+    # v3 records (journey_id, no link block) None-fill the v4 columns
+    v3 = {
+        "schema_version": 3,
+        "contract": "Old",
+        "code_hash": "cd" * 32,
+        "features": {"code_bytes": 4},
+        "outcome": {"route": "host-walk"},
+        "journey_id": "j-1",
+    }
+    parsed = parse_record(json.dumps(v3))
+    for key in V4_FEATURE_KEYS:
+        assert parsed["features"][key] is None
+    assert parsed["journey_id"] == "j-1"
+
+
+# -- consumers: triage + watcher + corpusgen --------------------------------
+def test_chainstream_triage_carries_link_block():
+    from mythril_tpu.chainstream.triage import StaticTriage
+
+    triage = StaticTriage()
+    verdict = triage.triage(bytes.fromhex(proxy_pair(seed=0)[0][0]))
+    assert verdict.link is not None
+    assert verdict.link["is_proxy"] is True
+    assert verdict.link["proxy_kind"] == "eip1967"
+    assert verdict.link["upgradeable"] is True
+    assert "delegatecall-to-upgradeable-target" in verdict.findings
+    assert verdict.as_dict()["link"]["delegatecall_sites"] == 1
+
+
+def test_watcher_detects_constructor_wired_proxy():
+    """The satellite: a deploy tx whose INIT CODE stores an address
+    into the EIP-1967 impl slot surfaces BOTH the new contract and the
+    baked implementation (kind proxy-deployment) — no upgradeTo call
+    ever appears for these."""
+    from mythril_tpu.chainstream.watcher import (
+        KIND_DEPLOYMENT,
+        KIND_PROXY_DEPLOYMENT,
+        KIND_PROXY_UPGRADE,
+        UPGRADE_SELECTOR_HEXES,
+        ChainWatcher,
+        _init_code_implementation,
+    )
+
+    assert UPGRADE_SELECTOR_HEXES == {"3659cfe6", "4f1ef286"}
+    impl = 0xABC
+    wired = (
+        "0x73" + f"{impl:040x}" + "7f" + f"{EIP1967_IMPL_SLOT:064x}" + "55"
+    )
+    assert _init_code_implementation(wired) == f"0x{impl:040x}"
+    assert _init_code_implementation("0x600160005500") is None
+
+    class _Pool:
+        def get_receipt(self, _tx_hash):
+            return {"contractAddress": "0x" + "11" * 20}
+
+    class _Stub:
+        pool = _Pool()
+
+    block = {
+        "transactions": [
+            {"hash": "0xdead", "to": None, "input": wired},
+            {
+                "to": "0x" + "22" * 20,
+                "input": "0x3659cfe6" + f"{impl:064x}",
+            },
+        ]
+    }
+    targets = ChainWatcher._extract_targets(_Stub(), block)
+    assert ("0x" + "11" * 20, KIND_DEPLOYMENT) in targets
+    assert (f"0x{impl:040x}", KIND_PROXY_DEPLOYMENT) in targets
+    # an upgrade surfaces the implementation AND the proxy (the pair)
+    assert (f"0x{impl:040x}", KIND_PROXY_UPGRADE) in targets
+    assert ("0x" + "22" * 20, KIND_PROXY_UPGRADE) in targets
+
+
+def test_bench_corpus_carries_link_fixtures():
+    corpus = synth_bench_corpus(
+        32, proxy_pairs=1, minimal_proxies=1, cross_call_pairs=1
+    )
+    assert len(corpus) == 32
+    names = [name for _code, _creation, name in corpus]
+    assert any(n.startswith("proxy#") for n in names)
+    assert any(n.startswith("impl#") for n in names)
+    assert any(n.startswith("minproxy#") for n in names)
+    assert any(n.startswith("crosscaller#") for n in names)
+    # every fixture row links: the bench's resolve-rate headline is 1.0
+    fixture_rows = [
+        row
+        for row in corpus
+        if row[2].split("#")[0]
+        in ("proxy", "impl", "minproxy", "mincallee", "crosscaller", "crosscallee")
+    ]
+    linkset = _linkset_of(fixture_rows)
+    assert linkset.stats()["resolve_rate"] == 1.0
+
+
+def test_linker_is_jax_free():
+    """The static link plane must stay pure host work."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import sys;"
+                "import mythril_tpu.analysis.static.callgraph;"
+                "import mythril_tpu.analysis.static.linkset;"
+                "assert not any(m == 'jax' or m.startswith('jax.') "
+                "for m in sys.modules), 'jax leaked into the linker'"
+            ),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr

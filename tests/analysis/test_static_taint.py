@@ -354,7 +354,9 @@ def test_routing_features_carry_taint_block():
 def test_routing_v1_records_parse_in_tail_reader(tmp_path):
     """The back-compat pin: a v1 JSONL line (no taint features, no
     journey_id) parses through the tail reader and comes back
-    normalized to the current column set (v3: + journey_id)."""
+    normalized to the current column set (v3: + journey_id; v4: +
+    link features — their None-fill is pinned in
+    tests/analysis/test_callgraph.py)."""
     from mythril_tpu.observe.routing import (
         SCHEMA_VERSION,
         V2_FEATURE_KEYS,
@@ -362,7 +364,7 @@ def test_routing_v1_records_parse_in_tail_reader(tmp_path):
         read_records,
     )
 
-    assert SCHEMA_VERSION == 3
+    assert SCHEMA_VERSION == 4
     v1 = {
         "schema_version": 1,
         "contract": "Legacy",

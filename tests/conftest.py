@@ -203,6 +203,16 @@ def pytest_configure(config):
         "taint lint checks, routing schema back-compat; host-only, "
         "fast — runs in tier-1, selectable with -m taint)",
     )
+    config.addinivalue_line(
+        "markers",
+        "linker: cross-contract static linker suite (analysis/static/"
+        "callgraph + linkset: call-site provenance goldens, SCC escape "
+        "widening, proxy pairing + storage-collision diff, the linked-"
+        "fingerprint store-invalidation differential, `myth graph` "
+        "JSON golden, the four link lint checks, routing v3->v4 "
+        "back-compat; host-only, fast — runs in tier-1, selectable "
+        "with -m linker)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

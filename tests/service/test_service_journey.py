@@ -92,9 +92,10 @@ def test_store_hit_journey(tmp_path):
         assert doc["tiers"] == ["admission", "store-hit", "settle"]
         assert doc["schema_version"] == 1
         assert doc["state"] == "done"
-        # the JSONL join key: the service emitted a v3 routing record
+        # the JSONL join key: the service emitted a routing record
+        # (v4 since the cross-contract linker added link_* features)
         rec = routing_tail_for(job_id)
-        assert rec["schema_version"] == 3
+        assert rec["schema_version"] == 4
         assert rec["outcome"]["route"] == "store-hit"
     finally:
         srv.close()

@@ -16,6 +16,11 @@ Also enforces the taint-layer budget and the triage tier's liveness:
   a zero rate means the semantic screen regressed into mounting
   everything).
 
+And the cross-contract link leg: the known-positive fixture pairs
+(EIP-1967 proxy+impl, EIP-1167 minimal proxy, tainted A-calls-B) must
+ALL resolve through the LinkSet — link_resolve_rate 1.0, both proxy
+pairs found, sub-second for the whole corpus-level link pass.
+
 Prints one JSON line: per-corpus aggregates (prune rate, dead code,
 screen narrowing both ways, answer rate, taint wall) plus any
 failures.
@@ -35,6 +40,51 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 #: the per-contract taint budget (seconds) — admission-path work
 TAINT_BUDGET_S = 1.0
+
+#: the whole-corpus link-pass budget (seconds) — `myth graph` is a
+#: line-rate tool, and the corpus prepass runs this before triage
+LINK_BUDGET_S = 1.0
+
+
+def _link_leg(failures: list) -> dict:
+    """The linker smoke: link the known-positive fixture families and
+    assert every edge resolves, the pairs pair, and the collision
+    fixture collides — within the sub-second budget."""
+    from mythril_tpu.analysis.corpusgen import (
+        cross_call_pair,
+        minimal_proxy,
+        proxy_pair,
+    )
+    from mythril_tpu.analysis.static import link_corpus
+
+    rows = (
+        proxy_pair(seed=0, collide=False)
+        + proxy_pair(seed=1, collide=True)
+        + minimal_proxy(seed=0)
+        + cross_call_pair(seed=0)
+    )
+    try:
+        t0 = time.perf_counter()
+        linkset = link_corpus(rows)
+        stats = linkset.stats()
+        wall_s = time.perf_counter() - t0
+        assert stats["resolve_rate"] == 1.0, stats
+        assert stats["proxy_pairs"] == 3, stats  # 2x eip1967 + eip1167
+        assert stats["collisions"] == 1, stats  # the collide=True pair
+        assert wall_s < LINK_BUDGET_S, f"link pass took {wall_s:.3f}s"
+        checks = {f["check"] for f in linkset.findings()}
+        assert "delegatecall-to-upgradeable-target" in checks, checks
+        assert "proxy-storage-collision" in checks, checks
+        return {
+            "link_resolve_rate": stats["resolve_rate"],
+            "link_proxy_pairs": stats["proxy_pairs"],
+            "link_wall_s": round(wall_s, 3),
+        }
+    except Exception:
+        failures.append(
+            {"contract": "<link-leg>", "error": traceback.format_exc(limit=3)}
+        )
+        return {}
 
 
 def main() -> int:
@@ -106,9 +156,11 @@ def main() -> int:
                 ),
             }
         )
+    link_record = _link_leg(failures)
     record = {
         "contracts": len(rows),
         "failures": len(failures),
+        **link_record,
         "static_prune_rate": round(pruned / total, 4) if total else 0.0,
         "static_answer_rate": static_answer_rate,
         "dead_instructions": dead_instructions,
