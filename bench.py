@@ -1474,6 +1474,93 @@ def bench_compileplane() -> dict:
     return out
 
 
+def bench_router() -> dict:
+    """Routed-vs-uniform tier-ladder A/B (ISSUE 19), the data
+    flywheel demo in one leg:
+
+    1. UNIFORM leg: today's ladder over a mixed synthetic corpus
+       (every contract pays the device prepass), routing records
+       accumulating in-process;
+    2. train a router artifact FROM THAT LEG'S OWN records (`myth
+       route train` under the hood) — the flywheel's first turn;
+    3. ROUTED leg: the same corpus with the artifact mounted —
+       cheap-predicted contracts skip straight to the host walk, the
+       device budget concentrates on the rest, overruns promote.
+
+    Headline fields: `routed_speedup` (gated — uniform wall over
+    routed wall, must stay > 1), `routing_regret` (model-priced
+    seconds the uniform leg burnt on mispriced routes),
+    `router_artifact_version`."""
+    import shutil
+    import tempfile
+
+    from mythril_tpu import observe, routing
+    from mythril_tpu.analysis.corpus import analyze_corpus
+    from mythril_tpu.analysis.corpusgen import synth_bench_corpus
+    from mythril_tpu.support.model import clear_cache
+
+    contracts = synth_bench_corpus(max(8, min(CONV_CONTRACTS, 16)))
+
+    def _leg(router_dir=None, router_on=None):
+        clear_cache()
+        t0 = time.perf_counter()
+        results = analyze_corpus(
+            contracts,
+            transaction_count=1,
+            execution_timeout=8,
+            create_timeout=10,
+            use_device=True,  # the ladder under test, CPU backend or not
+            processes=1,
+            deadline_s=max(60, min(240, int(_budget_left() - 60))),
+            on_timeout="partial",
+            router_dir=router_dir,
+            router=router_on,
+        )
+        return time.perf_counter() - t0, results
+
+    log = observe.routing_log()
+    log.clear()
+    uniform_wall, _uniform = _leg(router_on=False)
+    records = log.tail(4096)
+    artifact_dir = tempfile.mkdtemp(prefix="myth-bench-router-")
+    try:
+        model = routing.train_model(records)  # ValueError when starved
+        routing.save_router(artifact_dir, model)
+        router = routing.load_router(artifact_dir)
+        if router is None:
+            raise RuntimeError("freshly saved router artifact refused")
+        regret = None
+        try:
+            regret = routing.evaluate_log(records, router)["regret_s"]
+        except Exception:
+            pass
+        log.clear()
+        routed_wall, routed = _leg(router_dir=artifact_dir, router_on=True)
+        out = {
+            "router_uniform_wall_s": round(uniform_wall, 2),
+            "router_routed_wall_s": round(routed_wall, 2),
+            "routed_speedup": (
+                round(uniform_wall / routed_wall, 3)
+                if routed_wall else None
+            ),
+            "routing_regret": (
+                round(regret, 3) if regret is not None else None
+            ),
+            "router_artifact_version": router.version,
+            "router_trained_rows": model["trained_rows"],
+            "router_routed_contracts": sum(
+                1 for r in routed if r.get("routed")
+            ),
+            "router_promoted_contracts": sum(
+                1 for r in routed if r.get("promoted")
+            ),
+        }
+    finally:
+        shutil.rmtree(artifact_dir, ignore_errors=True)
+    print(f"bench: router leg {out}", file=sys.stderr)
+    return out
+
+
 def _emit(record: dict, stage: str) -> None:
     """Print the one-line JSON record NOW. Called after the headline
     phases (transitions + one convergence pair) and again after every
@@ -1655,6 +1742,12 @@ def main(final_attempt: bool = False) -> None:
         "cold_ready_pack_s": None,
         "kernel_pack_hit_rate": None,
         "aot_load_p50_s": None,
+        # learned-router scorecard (ISSUE 19): the router A/B leg
+        # fills these; None = the leg never ran (the compare gate
+        # skips absent/None fields)
+        "routed_speedup": None,
+        "routing_regret": None,
+        "router_artifact_version": None,
     }
     _mark_solver_run()
     capture_dir = os.environ.get("MYTHRIL_BENCH_CAPTURE_DIR")
@@ -1721,6 +1814,24 @@ def main(final_attempt: bool = False) -> None:
             print("bench: compileplane leg hit its deadline", file=sys.stderr)
         except Exception as e:
             print(f"bench: compileplane leg failed: {e!r}", file=sys.stderr)
+
+    # the routed-vs-uniform tier-ladder A/B (ISSUE 19): two corpus
+    # passes on a trimmed corpus + an in-process train step between
+    if _budget_left() > 300 and not os.environ.get(
+        "MYTHRIL_BENCH_NO_ROUTER"
+    ):
+        try:
+            record.update(
+                _with_deadline(
+                    bench_router,
+                    max(120, min(600, int(_budget_left() - 120))),
+                )
+            )
+            print("bench: router leg done", file=sys.stderr)
+        except _Deadline:
+            print("bench: router leg hit its deadline", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: router leg failed: {e!r}", file=sys.stderr)
 
     if _budget_left() > 240 and not os.environ.get(
         "MYTHRIL_BENCH_NO_FLEET"

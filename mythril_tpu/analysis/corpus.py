@@ -1184,6 +1184,8 @@ def analyze_corpus(
     devices: Optional[int] = None,
     store_dir: Optional[str] = None,
     store: Optional[bool] = None,
+    router_dir: Optional[str] = None,
+    router: Optional[bool] = None,
     _flag_scoped: bool = False,
 ) -> List[Dict]:
     """Analyze `contracts` = [(runtime_code_hex, creation_code_hex,
@@ -1244,6 +1246,8 @@ def analyze_corpus(
                 devices=devices,
                 store_dir=store_dir,
                 store=store,
+                router_dir=router_dir,
+                router=router,
                 _flag_scoped=True,
             )
         finally:
@@ -1326,6 +1330,72 @@ def analyze_corpus(
     prepass_rows = list(contracts)
     for i in list(static_answers) + list(store_answers):
         prepass_rows[i] = ("", contracts[i][1], contracts[i][2])
+
+    # The learned tier-ladder router (mythril_tpu/routing): for every
+    # contract the triage tiers did NOT settle, price host-walk vs
+    # device-waves from the routing features and keep host-routed rows
+    # OUT of the device prepass — the prepass budget scales with the
+    # RUNNABLE row count, so cheap contracts the walk converges on in
+    # milliseconds stop billing device waves. Router absent / refused
+    # / --no-router: the plan stays empty and this whole block is a
+    # no-op — today's routes, bit for bit. Mis-routes are repaired
+    # in-flight by _promote_overruns below.
+    route_plan: Dict[int, str] = {}
+    route_decisions: Dict[int, object] = {}
+    corpus_router = None
+    if router is not False and use_device:
+        try:
+            from mythril_tpu.routing import router as _routing_rt
+
+            corpus_router = (
+                _routing_rt.load_router(router_dir)
+                if router_dir
+                else _routing_rt.configured_router()
+            )
+        except Exception:
+            corpus_router = None
+            log.debug("router load failed", exc_info=True)
+    if corpus_router is not None:
+        from mythril_tpu import observe as _obs
+
+        for i, (code, _creation, _name) in enumerate(contracts):
+            if i in static_answers or i in store_answers:
+                continue
+            code_norm = code[2:] if code.startswith("0x") else code
+            if len(code_norm) < 8:
+                continue  # not a runnable prepass row anyway
+            try:
+                link_meta = None
+                if linkset is not None:
+                    import hashlib as _hl
+
+                    link_meta = linkset.node_meta(
+                        "0x" + _hl.sha256(
+                            bytes.fromhex(code_norm)
+                        ).hexdigest()
+                    )
+                decision = corpus_router.decide(
+                    _obs.routing_features_for(code, link=link_meta),
+                    tiers=["host-walk", "device-waves"],
+                )
+            except Exception:
+                log.debug("route decision failed", exc_info=True)
+                continue
+            if decision is None:
+                continue
+            route_plan[i] = decision.route
+            route_decisions[i] = decision
+            if decision.route == "host-walk":
+                prepass_rows[i] = ("", contracts[i][1], contracts[i][2])
+        if route_plan:
+            log.info(
+                "Router v%d: %d host-walk / %d device-waves of %d "
+                "routable contract(s)",
+                corpus_router.version,
+                sum(1 for r in route_plan.values() if r == "host-walk"),
+                sum(1 for r in route_plan.values() if r == "device-waves"),
+                len(route_plan),
+            )
 
     single_process = processes <= 1 or len(contracts) == 1
 
@@ -1675,6 +1745,20 @@ def analyze_corpus(
                 pool.terminate()
     if prepass:
         _merge_prepass_witnesses(results, contracts, prepass, address)
+    if route_plan:
+        _promote_overruns(
+            results,
+            contracts,
+            route_plan,
+            route_decisions,
+            corpus_router,
+            address=address,
+            transaction_count=transaction_count,
+            execution_timeout=execution_timeout,
+            use_device=use_device,
+            devices=devices,
+            deadline=deadline,
+        )
     try:
         # one saturation sample at the run boundary: batch runs get
         # the same mtpu_device_* gauges the serve sampler keeps live
@@ -1704,6 +1788,15 @@ def analyze_corpus(
         )
     if linkset is not None:
         _attach_link_meta(results, contracts, linkset)
+    # router decisions feed their own training data (satellite 2):
+    # planned rows settle as routed-<tier> / promoted-<tier> in the
+    # routing JSONL. Stamped AFTER the store writeback so banked
+    # verdicts stay route-free (a store hit replays as store-hit).
+    for i, planned in route_plan.items():
+        result = results[i] if i < len(results) else None
+        if result is None or result.get("skipped") or result.get("promoted"):
+            continue
+        result["routed"] = planned
     _emit_routing_records(results, contracts, linkset=linkset)
     if skipped and on_timeout == "fail":
         from mythril_tpu.exceptions import DeadlineExpiredError
@@ -1812,6 +1905,79 @@ def _emit_routing_records(
             )
         except Exception:
             log.debug("routing record failed for %s", name, exc_info=True)
+
+
+def _promote_overruns(
+    results: List[Optional[Dict]],
+    contracts: List[Tuple[str, str, str]],
+    route_plan: Dict[int, str],
+    route_decisions: Dict[int, object],
+    corpus_router,
+    address: int,
+    transaction_count: int,
+    execution_timeout: int,
+    use_device: bool,
+    devices: Optional[int],
+    deadline,
+) -> None:
+    """The router's in-flight repair tier: a host-routed contract
+    whose walk errored or overran the decision's predicted budget
+    (`RouteDecision.budget_s` — slack times the predicted wall) was
+    mis-routed, so it gets the device waves it was denied: one small
+    prepass over just the overrun rows, witnesses merged in place, the
+    result stamped ``promoted`` (the routing record settles as
+    ``promoted-device-waves``, its own outcome class, so the trainer
+    prices the mis-route). Regret — wall actually burnt beyond the
+    budget — feeds mtpu_router_regret_seconds_total."""
+    from mythril_tpu.support import resilience
+
+    if not use_device or resilience.interrupted_reason(deadline) is not None:
+        return
+    overrun: List[int] = []
+    for i, planned in route_plan.items():
+        if planned != "host-walk":
+            continue
+        result = results[i] if i < len(results) else None
+        if result is None or result.get("skipped"):
+            continue
+        decision = route_decisions.get(i)
+        budget = decision.budget_s() if decision is not None else 0.0
+        wall = result.get("wall_s") or 0.0
+        if result.get("error") is not None or (budget and wall > budget):
+            overrun.append(i)
+            if corpus_router is not None and budget and wall > budget:
+                corpus_router.note_regret(wall - budget)
+    if not overrun:
+        return
+    promo_rows: List[Tuple[str, str, str]] = [
+        (
+            contracts[i][0] if i in overrun else "",
+            contracts[i][1],
+            contracts[i][2],
+        )
+        for i in range(len(contracts))
+    ]
+    try:
+        promo = corpus_device_prepass(
+            promo_rows,
+            address=address,
+            transaction_count=transaction_count,
+            execution_timeout=execution_timeout,
+            ownership=False,
+            deadline=deadline,
+            stop_event=resilience.shutdown_event(),
+            mesh_groups=devices,
+        )
+    except Exception:
+        log.debug("promotion prepass failed", exc_info=True)
+        return
+    _merge_prepass_witnesses(results, contracts, promo, address)
+    for i in overrun:
+        result = results[i]
+        if result is not None:
+            result["promoted"] = "device-waves"
+            if corpus_router is not None:
+                corpus_router.note_promotion("host-walk", "device-waves")
 
 
 def _merge_prepass_witnesses(

@@ -429,8 +429,38 @@ def run(
     trials: int = 12,
     sweep: str = "random",
     tune_seed: int = 1,
+    watch: bool = False,
+    watch_out: Optional[str] = None,
+    watch_interval_s: float = 30.0,
+    watch_min_new: int = 8,
+    watch_rounds: int = 0,
 ) -> Dict:
-    """Load + filter + shard a corpus, then replay, tune, or report."""
+    """Load + filter + shard a corpus, then replay, tune, or report.
+
+    ``mode="tune", watch=True`` is the continuous-self-tuning loop
+    (`myth solverlab tune --watch`): instead of one sweep it delegates
+    to routing/tuning.py's watcher, which re-tunes as the capture
+    corpus grows and promotes gate-passing winners as versioned
+    ``tuned-v<N>.json`` override artifacts in `watch_out`."""
+    if watch:
+        if mode != "tune":
+            raise ValueError("--watch only applies to `solverlab tune`")
+        from mythril_tpu.routing import tuning as _tuning
+
+        return _tuning.tune_watch(
+            corpus_dir,
+            watch_out or corpus_dir,
+            interval_s=watch_interval_s,
+            min_new=watch_min_new,
+            rounds=watch_rounds,
+            trials=trials,
+            sweep=sweep,
+            tune_seed=tune_seed,
+            candidates=candidates,
+            timeout_ms=timeout_ms,
+            reason=reason,
+            origin=origin,
+        )
     corpus = querylog.load_corpus(corpus_dir, reason=reason, origin=origin)
     corpus = shard_corpus(corpus, parse_shard(shard))
     if mode == "report":
@@ -501,8 +531,45 @@ def render_tune_text(report: Dict) -> str:
     return "\n".join(lines)
 
 
+def render_watch_text(report: Dict) -> str:
+    """The human view of a tune-watch run: one row per round."""
+    lines = [
+        "solverlab tune --watch: {d} -> {o}".format(
+            d=report.get("corpus_dir", "?"), o=report.get("out_dir", "?")
+        )
+    ]
+    for row in report.get("rounds") or []:
+        bits = [
+            f"round {row['round']}: {row['queries']} queries "
+            f"({row['new']} new)"
+        ]
+        if "beats_baseline" in row:
+            bits.append(
+                "winner beats baseline"
+                if row["beats_baseline"]
+                else "defaults hold"
+            )
+        gate = row.get("gate")
+        if gate:
+            bits.append(
+                "gate {}: agree {} / disagree {} / incomplete {}".format(
+                    "PASS" if gate["pass"] else "FAIL",
+                    gate["agree"], gate["disagree"], gate["incomplete"],
+                )
+            )
+        if row.get("promoted"):
+            bits.append(f"promoted -> {row['promoted']}")
+        lines.append("  " + "; ".join(bits))
+    lines.append(
+        f"  promoted artifact: {report.get('promoted') or '(none)'}"
+    )
+    return "\n".join(lines)
+
+
 def render_text(report: Dict) -> str:
     """The human view: waterfall + agreement tables."""
+    if report.get("mode") == "tune-watch":
+        return render_watch_text(report)
     if report.get("mode") == "tune" or "trials" in report:
         return render_tune_text(report)
     lines = [

@@ -81,6 +81,7 @@ class FleetConfig:
         recover: bool = False,
         store_dir: Optional[str] = None,
         kernel_pack_dir: Optional[str] = None,
+        router_dir: Optional[str] = None,
     ) -> None:
         if not replica_urls:
             raise ValueError("a fleet needs at least one --replica URL")
@@ -106,6 +107,12 @@ class FleetConfig:
         #: --kernel-pack`; surfaced in /fleet/stats so an operator can
         #: see every replica boots warm from the same pack)
         self.kernel_pack_dir = kernel_pack_dir
+        #: router artifact directory (mythril_tpu/routing): when a
+        #: `router-v<N>.json` verifies here, replica choice becomes
+        #: cost-informed — (occupancy + 1) x the replica's measured
+        #: settle EWMA — instead of raw least-loaded. Absent/refused
+        #: artifact -> today's (load, round-robin) order, bit-for-bit
+        self.router_dir = router_dir
 
 
 class FleetJob:
@@ -221,6 +228,23 @@ class FleetFront:
         self._jobs: Dict[str, FleetJob] = {}
         self._idem: Dict[str, str] = {}  # idempotency key -> fleet id
         self._rr = 0  # round-robin tiebreak
+        # cost-informed routing (mythril_tpu/routing): only mounted
+        # when an artifact VERIFIES — a missing/refused artifact keeps
+        # replica choice exactly least-loaded (parity with r18)
+        self._router = None
+        try:
+            from mythril_tpu.routing import router as _routing_rt
+
+            if config.router_dir:
+                self._router = _routing_rt.load_router(config.router_dir)
+            else:
+                self._router = _routing_rt.configured_router()
+        except Exception:
+            self._router = None
+        #: per-replica settle-latency EWMA (seconds), fed by
+        #: `_note_terminal`; read by `_candidates` when the router is
+        #: mounted
+        self._settle_ewma: Dict[str, float] = {}
         self._draining = False
         self.started_t = time.monotonic()
         # lifetime counters (registry doubles in _count)
@@ -281,16 +305,34 @@ class FleetFront:
 
     # -- admission / routing -------------------------------------------
     def _candidates(self, exclude: Optional[str] = None) -> List[Replica]:
-        """Routable replicas, least-loaded first (round-robin breaks
-        ties so equal-load replicas share work)."""
+        """Routable replicas, cheapest first. Without a mounted router
+        artifact this is EXACTLY the historical least-loaded order
+        (round-robin breaks ties so equal-load replicas share work).
+        With one, each replica is priced as expected drain time —
+        (occupancy + 1) x its measured settle EWMA — so a slow replica
+        with a short queue stops beating a fast replica with a deep
+        one. Replicas with no settle sample yet price at the fleet
+        median (first jobs still spread)."""
         with self._mu:
             self._rr += 1
             rr = self._rr
+            ewma = dict(self._settle_ewma)
         rows = [
             r for r in self.replicas.values()
             if r.routable and r.name != exclude
         ]
         order = list(self.replicas)
+        if self._router is not None and ewma:
+            known = sorted(ewma.values())
+            median = known[len(known) // 2]
+            return sorted(
+                rows,
+                key=lambda r: (
+                    (r.load() + 1) * ewma.get(r.name, median),
+                    r.load(),
+                    (order.index(r.name) + rr) % max(1, len(order)),
+                ),
+            )
         return sorted(
             rows,
             key=lambda r: (
@@ -506,6 +548,16 @@ class FleetFront:
             job.state = doc["state"]
             job.report_doc = doc
             job.finished_t = time.monotonic()
+            if job.replica:
+                # settle-latency EWMA feeds cost-informed routing;
+                # alpha .3 tracks a replica that slows down (noisy
+                # neighbor, thermal) within a few settles
+                latency = job.finished_t - job.created_t
+                prev = self._settle_ewma.get(job.replica)
+                self._settle_ewma[job.replica] = (
+                    latency if prev is None
+                    else 0.3 * latency + 0.7 * prev
+                )
         self._count("jobs_settled", state=job.state)
         if job.failover_t is not None:
             self._observe_failover_latency(
@@ -836,6 +888,17 @@ class FleetFront:
                 "tracked_jobs": len(self._jobs),
                 "store_dir": self.cfg.store_dir,
                 "kernel_pack_dir": self.cfg.kernel_pack_dir,
+                "router": {
+                    "mounted": self._router is not None,
+                    "version": (
+                        self._router.version
+                        if self._router is not None else None
+                    ),
+                    "settle_ewma_s": {
+                        name: round(v, 4)
+                        for name, v in self._settle_ewma.items()
+                    },
+                },
             }
         return {
             "schema_version": FLEET_STATS_SCHEMA_VERSION,

@@ -66,6 +66,7 @@ COMMAND_LIST = (
         "kernels",
         "submit",
         "solverlab",
+        "route",
         "observe",
         "version",
         "truffle",
@@ -1104,6 +1105,28 @@ def build_parser() -> ArgumentParser:
             "parity-differential baseline for a suspected AOT bug"
         ),
     )
+    serve.add_argument(
+        "--router",
+        default=None,
+        metavar="DIR",
+        help=(
+            "learned tier-ladder router artifacts (`myth route "
+            "train`; env MYTHRIL_ROUTER_DIR): admission prices each "
+            "job per tier from the routing-log cost model and sends "
+            "cheap-predicted work straight to the host walk; a tuned "
+            "solver-default artifact (`myth solverlab tune --watch`) "
+            "in the same DIR installs too. Absent/refused artifacts "
+            "keep today's ladder bit-for-bit"
+        ),
+    )
+    serve.add_argument(
+        "--no-router",
+        action="store_true",
+        help=(
+            "disable the learned router tier even when an artifact "
+            "directory is configured — the parity baseline"
+        ),
+    )
 
     fleet = subparsers.add_parser(
         "fleet",
@@ -1220,6 +1243,18 @@ def build_parser() -> ArgumentParser:
             "contract as --store: replicas mount it via `myth serve "
             "--kernel-pack`; surfaced in /fleet/stats so operators "
             "can verify every replica boots warm from one pack)"
+        ),
+    )
+    fleet.add_argument(
+        "--router",
+        default=None,
+        metavar="DIR",
+        help=(
+            "router artifact directory (`myth route train`): replica "
+            "choice becomes cost-informed — occupancy times the "
+            "replica's measured settle EWMA — instead of raw "
+            "least-loaded; absent/refused artifacts keep the "
+            "least-loaded order bit-for-bit"
         ),
     )
 
@@ -1521,6 +1556,14 @@ def build_parser() -> ArgumentParser:
         help="report: a routing_features.jsonl to fold in",
     )
     observe_cmd.add_argument(
+        "--tail", type=int, default=5000, metavar="N",
+        help=(
+            "report: read only the newest N routing records (bounded "
+            "backward read — a month-long log folds in without "
+            "loading it whole; 0 = the whole file)"
+        ),
+    )
+    observe_cmd.add_argument(
         "--format",
         choices=["markdown", "html"],
         default="markdown",
@@ -1637,6 +1680,90 @@ def build_parser() -> ArgumentParser:
     solverlab.add_argument(
         "--tune-seed", type=int, default=1,
         help="tune mode: random-sweep seed (deterministic trials)",
+    )
+    solverlab.add_argument(
+        "--watch",
+        action="store_true",
+        help=(
+            "tune mode: continuous self-tuning — re-sweep whenever "
+            "the capture corpus grows, gate the winner by 100%% "
+            "host-replay agreement, and promote it as a versioned "
+            "tuned-defaults artifact (`myth serve --router DIR` "
+            "installs it); the solver half of the data flywheel"
+        ),
+    )
+    solverlab.add_argument(
+        "--watch-out",
+        default=None,
+        metavar="DIR",
+        help=(
+            "--watch: where tuned-v<N>.json artifacts land "
+            "(default: the corpus directory itself)"
+        ),
+    )
+    solverlab.add_argument(
+        "--watch-interval", type=float, default=30.0,
+        metavar="SECONDS",
+        help="--watch: seconds between corpus re-scans",
+    )
+    solverlab.add_argument(
+        "--min-new", type=int, default=8, metavar="N",
+        help=(
+            "--watch: fresh captured queries required before a "
+            "re-sweep (the first sweep always runs)"
+        ),
+    )
+    solverlab.add_argument(
+        "--rounds", type=int, default=0, metavar="N",
+        help="--watch: exit after N scan rounds (0 = until ^C)",
+    )
+
+    route = subparsers.add_parser(
+        "route",
+        help=(
+            "Learned tier-ladder router lab over the routing JSONL: "
+            "train a per-tier cost model from accumulated logs into a "
+            "versioned router artifact (train), score an artifact's "
+            "regret/oracle-agreement against a log (eval), and "
+            "explain one contract's routing decision feature-by-"
+            "feature (explain). Artifacts mount at `myth serve "
+            "--router DIR` and `myth fleet --router DIR`"
+        ),
+    )
+    route.add_argument(
+        "route_mode",
+        choices=["train", "eval", "explain"],
+        metavar="MODE",
+        help="train | eval | explain",
+    )
+    route.add_argument(
+        "--log", required=True, metavar="FILE",
+        help="the routing_features.jsonl to learn from / score against",
+    )
+    route.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="train: where the router-v<N>.json artifact lands",
+    )
+    route.add_argument(
+        "--router", default=None, metavar="DIR",
+        help=(
+            "eval/explain: the artifact directory to load (default: "
+            "env MYTHRIL_ROUTER_DIR)"
+        ),
+    )
+    route.add_argument(
+        "--l2", type=float, default=1.0,
+        help="train: ridge/logistic L2 strength (default 1.0)",
+    )
+    route.add_argument(
+        "--select", default=None, metavar="NAME|HASH",
+        help=(
+            "explain: pick the record by contract name or code-hash "
+            "prefix (default: the last record in the log)"
+        ),
+    )
+    route.add_argument(
+        "--json", action="store_true", help="emit machine-readable JSON"
     )
 
     submit = subparsers.add_parser(
@@ -2261,6 +2388,12 @@ def _cmd_serve(args: Namespace) -> None:
             or os.environ.get("MYTHRIL_KERNEL_CACHE")
             or None
         ),
+        router_dir=(
+            args.router
+            or os.environ.get("MYTHRIL_ROUTER_DIR")
+            or None
+        ),
+        router=not args.no_router,
     )
     serve_forever(config, host=args.host, port=args.port)
     sys.exit()
@@ -2288,6 +2421,7 @@ def _cmd_fleet(args: Namespace) -> None:
         recover=args.recover,
         store_dir=args.store,
         kernel_pack_dir=args.kernel_pack,
+        router_dir=args.router,
     )
     serve_fleet(config, host=args.host, port=args.port)
     sys.exit()
@@ -2438,10 +2572,17 @@ def _cmd_observe(args: Namespace) -> None:
             log.error("observe report: no metrics source: %s", why)
             sys.exit(1)
         if args.routing:
-            from mythril_tpu.observe.routing import read_records
+            from mythril_tpu.observe.routing import (
+                read_records, tail_records,
+            )
 
             try:
-                routing_records = read_records(args.routing)
+                if args.tail and args.tail > 0:
+                    routing_records = tail_records(
+                        args.routing, args.tail
+                    )
+                else:
+                    routing_records = read_records(args.routing)
             except OSError as why:
                 log.error("observe report: %s", why)
                 sys.exit(1)
@@ -2514,6 +2655,11 @@ def _cmd_solverlab(args: Namespace) -> None:
             trials=args.trials,
             sweep=args.sweep,
             tune_seed=args.tune_seed,
+            watch=args.watch,
+            watch_out=args.watch_out,
+            watch_interval_s=args.watch_interval,
+            watch_min_new=args.min_new,
+            watch_rounds=args.rounds,
         )
     except (OSError, ValueError) as why:
         log.error("solverlab: %s", why)
@@ -2528,6 +2674,116 @@ def _cmd_solverlab(args: Namespace) -> None:
             for table in (report.get("replay") or {}).values()
         )
         sys.exit(1 if disagreements else 0)
+    sys.exit()
+
+
+def _cmd_route(args: Namespace) -> None:
+    """`myth route train|eval|explain`: the learned tier-ladder
+    router lab (mythril_tpu/routing holds the logic)."""
+    from mythril_tpu import routing
+    from mythril_tpu.observe.routing import read_records
+
+    try:
+        records = read_records(args.log)
+    except OSError as why:
+        log.error("route: cannot read %s: %s", args.log, why)
+        sys.exit(1)
+
+    if args.route_mode == "train":
+        if not args.out:
+            log.error("route train wants --out DIR for the artifact")
+            sys.exit(2)
+        try:
+            model = routing.train_model(records, lam=args.l2)
+        except ValueError as why:
+            log.error("route train: %s", why)
+            sys.exit(1)
+        path = routing.save_router(args.out, model)
+        summary = {
+            "artifact": path,
+            "trained_rows": model["trained_rows"],
+            "routes": {
+                name: {
+                    "n": head["n"],
+                    "mean_wall_s": round(head["mean_wall_s"], 4),
+                }
+                for name, head in model["routes"].items()
+            },
+        }
+        if args.json:
+            print(json.dumps(summary, sort_keys=True))
+        else:
+            print(f"router artifact written to {path}")
+            for name, head in sorted(summary["routes"].items()):
+                print(
+                    f"  {name}: {head['n']} rows, mean wall "
+                    f"{head['mean_wall_s']}s"
+                )
+        sys.exit()
+
+    router = None
+    try:
+        if args.router:
+            router = routing.load_router(args.router)
+        else:
+            router = routing.configured_router()
+    except Exception as why:
+        log.error("route: router load failed: %s", why)
+        sys.exit(1)
+    if router is None:
+        log.error(
+            "route %s wants a verifying artifact (--router DIR or "
+            "MYTHRIL_ROUTER_DIR)", args.route_mode,
+        )
+        sys.exit(1)
+
+    if args.route_mode == "eval":
+        report = routing.evaluate_log(records, router)
+        if args.json:
+            print(json.dumps(report, sort_keys=True))
+        else:
+            print(
+                f"router-v{report['router_version']} over "
+                f"{report['records']} records ({report['scored']} "
+                f"scored): regret {report['regret_s']:.3f}s, oracle "
+                f"agreement {report['oracle_agreement']:.2f}"
+            )
+            for name, row in sorted(report["per_route"].items()):
+                print(
+                    f"  {name}: n={row['n']} regret="
+                    f"{row['regret_s']:.3f}s oracle-agrees="
+                    f"{row['oracle_agrees']} observed-wall="
+                    f"{row['observed_wall_s']:.3f}s"
+                )
+        sys.exit()
+
+    # explain
+    from mythril_tpu.routing.evaluate import find_record
+
+    record = find_record(records, args.select)
+    if record is None:
+        log.error("route explain: no record matches %r", args.select)
+        sys.exit(1)
+    report = routing.explain_record(record, router)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(
+            f"{report['contract'] or report['code_hash']}: logged "
+            f"{report['logged_route']}, router-v"
+            f"{report['router_version']} picks {report['chosen_route']}"
+        )
+        for name, head in sorted(report["expected"].items()):
+            print(
+                f"  {name}: wall {head['wall_s']:.3f}s p_success "
+                f"{head['p_success']:.2f} cost {head['cost']:.3f}"
+            )
+        for name, rows in sorted(report["attributions"].items()):
+            top = ", ".join(
+                f"{row['feature']}={row['wall_contribution']:+.3f}"
+                for row in rows[:5]
+            )
+            print(f"  {name} drivers: {top}")
     sys.exit()
 
 
@@ -2838,6 +3094,8 @@ def parse_args_and_execute(parser: ArgumentParser, args: Namespace) -> None:
         _cmd_submit(args)
     if args.command == "solverlab":
         _cmd_solverlab(args)
+    if args.command == "route":
+        _cmd_route(args)
     if args.command == "observe":
         _cmd_observe(args)
     if args.command == "graph":

@@ -80,6 +80,53 @@ PORTFOLIO_DEFAULTS: Dict[str, float] = {
 }
 
 
+#: pristine copy of the COMMITTED defaults, for reset_tuned_defaults
+#: (the self-tuning flywheel swaps the live dict, tests swap it back)
+_FACTORY_DEFAULTS: Dict[str, float] = dict(PORTFOLIO_DEFAULTS)
+
+#: version of the installed tuned-override artifact (0 = committed
+#: defaults) — exported as the mtpu_router_tuned_version gauge
+_TUNED_VERSION = 0
+
+
+def install_tuned_defaults(knobs: Dict[str, float], version: int) -> None:
+    """Apply a tuned-v<N>.json override artifact (routing/tuning.py)
+    as the process defaults. Same trace-time-constant discipline as
+    `portfolio_overrides`: the kernel cache is dropped so the swap
+    recompiles rather than mismatches — kernel keys derive from the
+    knob values, so a stale kernel can never serve tuned traffic."""
+    global _TUNED_VERSION
+    unknown = set(knobs) - set(PORTFOLIO_DEFAULTS)
+    if unknown:
+        raise ValueError(f"unknown portfolio knobs: {sorted(unknown)}")
+    PORTFOLIO_DEFAULTS.update(knobs)
+    _TUNED_VERSION = int(version)
+    _eval_cache.clear()
+    try:
+        from mythril_tpu.observe.registry import registry
+
+        registry().gauge(
+            "mtpu_router_tuned_version",
+            "version of the installed tuned portfolio-override artifact "
+            "(0 = committed defaults)",
+        ).set(_TUNED_VERSION)
+    except Exception:
+        pass
+
+
+def reset_tuned_defaults() -> None:
+    """Back to the committed defaults (test isolation)."""
+    global _TUNED_VERSION
+    PORTFOLIO_DEFAULTS.clear()
+    PORTFOLIO_DEFAULTS.update(_FACTORY_DEFAULTS)
+    _TUNED_VERSION = 0
+    _eval_cache.clear()
+
+
+def tuned_version() -> int:
+    return _TUNED_VERSION
+
+
 @contextmanager
 def portfolio_overrides(**knobs):
     """Temporarily override PORTFOLIO_DEFAULTS (`myth solverlab tune`

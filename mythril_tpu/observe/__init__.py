@@ -79,6 +79,9 @@ from mythril_tpu.observe.routing import (  # noqa: F401
 from mythril_tpu.observe.routing import (  # noqa: F401
     read_records as read_routing_records,
 )
+from mythril_tpu.observe.routing import (  # noqa: F401
+    tail_records as tail_routing_records,
+)
 from mythril_tpu.observe.routing import routing_log  # noqa: F401
 from mythril_tpu.observe.solverstats import (  # noqa: F401
     ORIGIN_DEVICE,

@@ -64,6 +64,11 @@ STABLE_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     # must keep resolving — the rate mixes in organic (unresolvable)
     # corpus edges, so the gate is loose; absent in pre-r08 records
     ("link_resolve_rate", "higher", 0.25),
+    # learned tier router (ISSUE 19): routed-vs-uniform A/B on the
+    # bench's mixed corpus — the routed leg must keep beating the
+    # uniform one; wall ratios wobble with host load, so the gate is
+    # loose; absent in pre-r19 records (skipped, like the linker rate)
+    ("routed_speedup", "higher", 0.25),
     ("screen_mount_rate_semantic", "lower", 0.25),
     ("default_path_issues", "higher", 0.0),
     ("trace_overlap_frac", "higher", 0.25),
@@ -360,6 +365,79 @@ def render_report(
                 f"p95 {walls[int(len(walls) * 0.95) - 1]:.3f}s "
                 f"over {len(walls)} contracts"
             )
+        lines.append("")
+        # v4 linker feature columns — mean/max over the records that
+        # carry them (v2-era tails have them None-filled; coverage
+        # shows how much of the tail is post-linker)
+        try:
+            from mythril_tpu.observe.routing import V4_FEATURE_KEYS
+        except Exception:
+            V4_FEATURE_KEYS = ()
+        link_rows = []
+        for col in V4_FEATURE_KEYS:
+            vals = [
+                float(v)
+                for rec in routing_records
+                for v in [(rec.get("features") or {}).get(col)]
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)
+            ]
+            if vals:
+                link_rows.append(
+                    (col, sum(vals) / len(vals), max(vals), len(vals))
+                )
+        if link_rows:
+            lines += [
+                "## Link features",
+                "",
+                "| feature | mean | max | coverage |",
+                "|---|---|---|---|",
+            ]
+            for col, mean, peak, n in link_rows:
+                lines.append(
+                    f"| {col} | {mean:.3f} | {peak:g} "
+                    f"| {n}/{len(routing_records)} |"
+                )
+            lines.append("")
+        # router digest: artifact version, routed/promoted mix, and —
+        # when an artifact is mounted — model-priced regret over the
+        # tail (evaluate_log). No artifact -> the mix alone.
+        routed_n = sum(
+            n for route, n in routes.items()
+            if route.startswith("routed-")
+        )
+        promoted_n = sum(
+            n for route, n in routes.items()
+            if route.startswith("promoted-")
+        )
+        router = None
+        try:
+            from mythril_tpu.routing import (
+                configured_router, evaluate_log,
+            )
+
+            router = configured_router()
+        except Exception:
+            router = None
+        lines += ["## Router", ""]
+        if router is not None:
+            lines.append(f"- artifact: router-v{router.version}")
+        else:
+            lines.append("- artifact: none mounted")
+        lines.append(
+            f"- route mix: {routed_n} routed, {promoted_n} "
+            f"promoted (of {len(routing_records)} records)"
+        )
+        if router is not None:
+            try:
+                ev = evaluate_log(routing_records, router)
+                lines.append(
+                    f"- regret: {ev['regret_s']:.3f}s over "
+                    f"{ev['scored']} scored records, oracle "
+                    f"agreement {ev['oracle_agreement']:.2f}"
+                )
+            except Exception:
+                pass
         lines.append("")
     if journeys:
         lines += ["## Recent journeys", ""]

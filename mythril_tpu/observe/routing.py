@@ -273,6 +273,16 @@ def outcome_for(result: Dict, prepass_stats: Optional[Dict] = None) -> Dict:
         # fingerprint-diff re-analysis: only changed selectors paid
         # for compute, banked issues covered the rest
         route = "store-incremental"
+    elif result.get("promoted"):
+        # the cost-model router picked a tier, the tier overran its
+        # predicted budget, and the job was promoted mid-flight — its
+        # own outcome class so the trainer prices mis-routes
+        route = "promoted-" + str(result["promoted"])
+    elif result.get("routed"):
+        # the cost-model router's own decision (routing/router.py):
+        # recorded as routed-<tier> so the flywheel trains on its own
+        # traffic (model.normalize_route folds it back onto <tier>)
+        route = "routed-" + str(result["routed"])
     elif result.get("owned"):
         route = "device-owned"
     else:
@@ -327,20 +337,66 @@ def parse_record(line_or_obj) -> Dict:
     return rec
 
 
+def iter_records(path: str):
+    """Stream parsed records off a routing JSONL file one line at a
+    time — flywheel logs grow unboundedly under `myth watch`, and the
+    trainer must not hold the raw text in memory to read them.
+    Unparseable lines are skipped, not fatal — a half-written tail
+    line must not sink the trainer."""
+    with open(path) as fp:
+        for line in fp:
+            if not line.strip():
+                continue
+            try:
+                yield parse_record(line)
+            except ValueError:
+                continue
+
+
 def read_records(path: str, n: Optional[int] = None) -> List[Dict]:
     """The last `n` (default: all) records of a routing JSONL file,
-    each normalized by `parse_record`. Unparseable lines are skipped,
-    not fatal — a half-written tail line must not sink the trainer."""
-    out: List[Dict] = []
-    with open(path) as fp:
-        lines = fp.read().splitlines()
+    each normalized by `parse_record`. Streams the file (constant
+    memory for the unbounded-`n` case is the caller's problem; with
+    `n` the window is a bounded deque)."""
     if n is not None:
-        lines = lines[-n:]
-    for line in lines:
-        if not line.strip():
+        return list(deque(iter_records(path), maxlen=n))
+    return list(iter_records(path))
+
+
+def tail_records(path: str, n: int) -> List[Dict]:
+    """The last `n` records WITHOUT scanning the whole file: seek to
+    the tail and read backwards in blocks until `n` parseable lines
+    (plus one likely-partial head line) are in hand. `myth observe
+    report` reads a multi-GB watch log's tail in milliseconds with
+    this; `read_records(path, n)` is the always-correct slow path the
+    block scan falls back to semantically (same result, pinned by the
+    tests)."""
+    if n <= 0:
+        return []
+    block = 64 * 1024
+    with open(path, "rb") as fp:
+        fp.seek(0, os.SEEK_END)
+        end = fp.tell()
+        chunks: List[bytes] = []
+        pos = end
+        # n+1 newlines guarantee n COMPLETE lines even when the scan
+        # lands mid-line at the window head
+        while pos > 0 and b"".join(chunks).count(b"\n") <= n:
+            step = min(block, pos)
+            pos -= step
+            fp.seek(pos)
+            chunks.insert(0, fp.read(step))
+    buf = b"".join(chunks)
+    lines = buf.split(b"\n")
+    if pos > 0 and lines:
+        lines = lines[1:]  # drop the partial line the window cut
+    out: "deque[Dict]" = deque(maxlen=n)
+    for raw in lines:
+        line = raw.decode("utf-8", "replace").strip()
+        if not line:
             continue
         try:
             out.append(parse_record(line))
         except ValueError:
             continue
-    return out
+    return list(out)

@@ -1,0 +1,62 @@
+"""The learned tier-ladder router + solver self-tuning flywheel.
+
+ROADMAP item 3's "routing is where ``vs_baseline`` moves decisively
+above 1", closed as two loops over the training data the observe
+layer has been accumulating since PR 7:
+
+- **Cost-model router** (model.py / artifact.py / router.py): a
+  dependency-free regularized linear model per route over the
+  routing-JSONL v4 feature columns, predicting per-contract wall and
+  success probability for each tier of the ladder.  Trained offline
+  (``myth route train``), shipped as a versioned checksummed
+  ``router-v<N>.json`` artifact with the compile plane's
+  refusal-not-misload discipline, mounted at the three decision
+  points that already see the features (corpus triage, serve
+  admission, fleet replica choice) — and falling back to today's
+  heuristics bit-for-bit whenever the artifact is absent, stale or
+  refused.
+- **Solver self-tuning** (tuning.py): ``myth solverlab tune --watch``
+  incremental retuning over the accumulating ``--capture-queries``
+  corpus, emitting versioned ``tuned-v<N>.json`` PORTFOLIO_DEFAULTS
+  override artifacts that only promote after a 100% host-replay
+  agreement gate.
+
+Decisions, promotions, refusals and regret are all counted
+(``mtpu_router_*`` — see docs/observability.md)."""
+
+from __future__ import annotations
+
+from mythril_tpu.routing.artifact import (  # noqa: F401
+    ROUTER_SCHEMA_VERSION,
+    ArtifactRefused,
+    latest_router,
+    load_router_file,
+    router_versions,
+    save_router,
+)
+from mythril_tpu.routing.model import (  # noqa: F401
+    FEATURE_COLUMNS,
+    TRAINABLE_ROUTES,
+    feature_vector,
+    normalize_route,
+    train_model,
+)
+from mythril_tpu.routing.router import (  # noqa: F401
+    RouteDecision,
+    Router,
+    configure_router,
+    configured_router,
+    load_router,
+)
+from mythril_tpu.routing.evaluate import (  # noqa: F401
+    evaluate_log,
+    explain_record,
+)
+from mythril_tpu.routing.tuning import (  # noqa: F401
+    TUNED_SCHEMA_VERSION,
+    gate_overrides,
+    latest_tuned,
+    load_tuned_file,
+    maybe_install_tuned,
+    save_tuned,
+)

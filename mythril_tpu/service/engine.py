@@ -118,6 +118,8 @@ class ServiceConfig:
         quarantine_strikes: int = 2,
         kernel_pack: Optional[str] = None,
         kernel_cache_dir: Optional[str] = None,
+        router_dir: Optional[str] = None,
+        router: bool = True,
     ) -> None:
         self.stripes = stripes
         self.lanes_per_stripe = lanes_per_stripe
@@ -221,6 +223,15 @@ class ServiceConfig:
         #: replica on this (fleet-shared) directory starts warm.
         self.kernel_pack = kernel_pack
         self.kernel_cache_dir = kernel_cache_dir
+        #: learned tier-ladder router (mythril_tpu/routing, `myth
+        #: serve --router DIR`): admission prices host-walk vs
+        #: device-waves from a trained cost-model artifact and routes
+        #: host-cheap submissions straight to the walk pool (no queue
+        #: slot, no wave), with in-flight promotion back to the wave
+        #: queue on budget overrun. Absent/refused artifact or
+        #: `--no-router` (router=False): today's ladder, bit for bit.
+        self.router_dir = router_dir
+        self.router = router
         #: how a not-yet-compiled bucket is handled: "background"
         #: (default — the wave runs GENERIC while a warmup thread
         #: compiles the bucket off the serving path; no request ever
@@ -647,6 +658,27 @@ class AnalysisEngine:
             thread_name_prefix="myth-serve-host",
         )
         self._host_inflight: Dict[str, Tuple] = {}
+        # the learned tier-ladder router (mythril_tpu/routing): priced
+        # admission + in-flight promotion. None (no artifact, refused
+        # artifact, or router=False) keeps today's ladder bit-for-bit.
+        self._router = None
+        if self.cfg.router:
+            try:
+                from mythril_tpu.routing import router as _routing_rt
+                from mythril_tpu.routing import tuning as _routing_tune
+
+                self._router = (
+                    _routing_rt.load_router(self.cfg.router_dir)
+                    if self.cfg.router_dir
+                    else _routing_rt.configured_router()
+                )
+                # tuned portfolio-override artifacts ride the same
+                # directory: install the newest verifying one
+                if self.cfg.router_dir:
+                    _routing_tune.maybe_install_tuned(self.cfg.router_dir)
+            except Exception:
+                self._router = None
+                log.debug("router load failed", exc_info=True)
         self._deg_marker = DegradationLog().marker()
         # -- observability: the wave-loop counters are REGISTRY-backed
         # (mtpu_service_* series labeled by engine instance): every
@@ -1076,6 +1108,8 @@ class AnalysisEngine:
             return job
         if self._try_static_answer(job):
             return job
+        if self._try_routed_host(job):
+            return job
         self.queue.submit(job)  # raises QueueRefusal on backpressure
         self._wake.set()
         return job
@@ -1366,6 +1400,71 @@ class AnalysisEngine:
         self._routing_record(job, route="static-answer")
         return True
 
+    def _try_routed_host(self, job: Job) -> bool:
+        """The cost-model admission tier (mythril_tpu/routing): when
+        the loaded router prices this submission cheaper on the host
+        walk than on device waves, dispatch it STRAIGHT to the walk
+        pool — registry-only admission, no queue slot, no wave, the
+        arena stays free for wave-bound work. The walk runs clamped to
+        the decision's predicted budget; an overrun or error promotes
+        the job back onto the wave queue in `_finalize` (the routing
+        record then settles as promoted-device-waves). False keeps the
+        job on today's queue path — which is ALSO the answer whenever
+        no router is loaded, the walk pool is saturated, or the model
+        has no opinion, so router-off parity is structural."""
+        if self._router is None or not self.cfg.host_walk:
+            return False
+        if job.host_walk is False or job.frontier is not None:
+            return False
+        # cap direct dispatches at the walk pool's width: past that
+        # the queue's wave tier is the better wait anyway
+        if len(self._host_inflight) >= max(1, self.cfg.host_workers):
+            return False
+        try:
+            decision = self._router.decide(
+                observe.routing_features_for(
+                    job.code.hex(),
+                    summary=self.code_cache.static_summary(job.code),
+                ),
+                tiers=["host-walk", "device-waves"],
+            )
+        except Exception:
+            log.debug("route decision failed", exc_info=True)
+            return False
+        if decision is None or decision.route != "host-walk":
+            return False
+        self.queue.register(job)  # raises QueueRefusal when draining
+        job.routed = "host-walk"
+        job.route_budget_s = decision.budget_s()
+        pair = decision.expected.get("host-walk")
+        observe.journey_event(
+            job.journey_id, journey.TIER_ADMISSION, "routed",
+            route="host-walk",
+            predicted_wall_s=round(pair[0], 4) if pair else None,
+            budget_s=round(job.route_budget_s, 4),
+        )
+        now = time.monotonic()
+        job.started_t = now
+        job.device_done_t = now  # no device phase: host_s is the wall
+        self.queue.mark(job, JobState.ANALYZING)
+        # the injected-outcome shape the walk consumes (track.outcome's
+        # empty case): a zeroed ExploreStats, no coverage, no triggers
+        from mythril_tpu.laser.batch.explore import ExploreStats
+
+        outcome = {
+            "covered_branches": [],
+            "corpus_size": 0,
+            "triggers": {},
+            "evidence": [],
+            "device_complete": False,
+            "completeness_gates": {},
+            "degraded_lanes": 0,
+            "stats": ExploreStats().as_dict(),
+        }
+        future = self._pool.submit(self._host_task, job, None, outcome)
+        self._host_inflight[job.id] = (future, None, outcome)
+        return True
+
     def _routing_record(self, job: Job, route: Optional[str] = None) -> None:
         """One routing-feature record per settled service job: the
         same features ⨝ route ⨝ outcome row the corpus driver emits,
@@ -1384,6 +1483,11 @@ class AnalysisEngine:
                 "store_hit": route == "store-hit",
                 "static_answered": route == "static-answer",
                 "quarantined": route == "quarantined",
+                # the router's own vocabulary (satellite 2): a routed
+                # or promoted job settles as routed-<tier> /
+                # promoted-<tier> so decisions feed their training set
+                "routed": job.routed if route is None else None,
+                "promoted": job.promoted if route is None else None,
             }
             # the store-hit/quarantine tiers settle in microseconds:
             # their records must not pay a CFG recovery for feature
@@ -2470,6 +2574,12 @@ class AnalysisEngine:
         timeout = self.cfg.execution_timeout
         if job.deadline is not None:
             timeout = max(1, min(timeout, int(job.deadline.remaining)))
+        if track is None and job.routed and not job.promoted \
+                and job.route_budget_s:
+            # routed walk: clamp to the decision's budget, so a
+            # mis-route pays at most the predicted wall (plus slack)
+            # before `_finalize` promotes it onto the wave queue
+            timeout = max(1, min(timeout, int(job.route_budget_s + 0.999)))
         payload = (
             job.code.hex(),
             "",
@@ -2533,6 +2643,39 @@ class AnalysisEngine:
         host_result: Optional[Dict],
     ) -> None:
         now = time.monotonic()
+        # in-flight promotion (mythril_tpu/routing): a router-dispatched
+        # walk that errored or burned its whole clamped budget was
+        # mis-routed — instead of settling a truncated result, the job
+        # goes to the HEAD of the wave queue for the device tier it
+        # was denied. One promotion max (job.promoted latches), and the
+        # regret — wall burnt beyond the predicted budget — is counted.
+        if (
+            track is None
+            and job.routed
+            and not job.promoted
+            and self._router is not None
+            and host_result is not None
+            and not self.queue.draining
+            and (job.deadline is None or job.deadline.remaining > 1.0)
+        ):
+            wall = now - (job.started_t or job.created_t)
+            clamp = int((job.route_budget_s or 0) + 0.999)
+            if host_result.get("error") is not None or (
+                clamp and wall >= clamp - 0.05
+            ):
+                job.promoted = "device-waves"
+                job.error = None
+                self._router.note_promotion("host-walk", "device-waves")
+                if job.route_budget_s and wall > job.route_budget_s:
+                    self._router.note_regret(wall - job.route_budget_s)
+                observe.journey_event(
+                    job.journey_id, journey.TIER_ADMISSION, "promoted",
+                    route="device-waves", walk_wall_s=round(wall, 4),
+                )
+                job.device_done_t = None
+                self.queue.unclaim(job)
+                self._wake.set()
+                return
         device_s = (
             (job.device_done_t or now) - (job.started_t or job.created_t)
         )

@@ -213,6 +213,16 @@ def pytest_configure(config):
         "back-compat; host-only, fast — runs in tier-1, selectable "
         "with -m linker)",
     )
+    config.addinivalue_line(
+        "markers",
+        "router: learned tier-ladder router + solver self-tuning suite "
+        "(mythril_tpu/routing: artifact roundtrip/refusal/fallback, "
+        "train->eval determinism golden, routed service admission + "
+        "router-off parity + in-flight promotion-on-overrun, the "
+        "tuned-overrides replay-agreement gate and tune --watch loop, "
+        "cost-informed fleet replica choice differential; host-only, "
+        "fast — runs in tier-1, selectable with -m router)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,312 @@
+"""Router artifact + cost-model suite (mythril_tpu/routing): the
+train->save->load->decide roundtrip, the refusal ladder (corrupted /
+newer-schema / wrong-kind / renamed artifacts are REFUSED with a
+counted reason and the loader falls back to the newest older artifact
+or to heuristics — never a misload), train->eval determinism on a
+synthetic JSONL golden, and the observe-layer satellites (streaming
+read, bounded tail, the routed-/promoted- route vocabulary).
+
+Host-only, numpy-only, sub-second — runs in tier-1 via the `router`
+marker.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from mythril_tpu import routing
+from mythril_tpu.observe.registry import registry
+from mythril_tpu.observe.routing import (
+    iter_records,
+    outcome_for,
+    read_records,
+    tail_records,
+)
+from mythril_tpu.routing.artifact import load_router_file, router_versions
+
+pytestmark = pytest.mark.router
+
+
+def synthetic_records(n=60, seed=3):
+    """A deterministic mixed log, linearly separable on size: cheap
+    host-walks (fast), heavy device-owned runs, and the mis-route
+    class the flywheel trains on — heavy contracts that went to the
+    host tier and paid for it (what promotion traffic looks like)."""
+    records = []
+    for i in range(n):
+        kind = i % 3  # 0: cheap host, 1: heavy device, 2: heavy host
+        heavy = 0 if kind == 0 else 1
+        jitter = ((i * seed * 2654435761) % 1000) / 1000.0
+        if kind == 0:
+            route, wall = "host-walk", 0.1 + jitter / 10
+        elif kind == 1:
+            route, wall = "device-owned", 2.0 + jitter
+        else:
+            route, wall = "host-walk", 8.0 + jitter
+        features = {
+            "code_bytes": 200 + 4000 * heavy + int(40 * jitter),
+            "storage_op_density": 0.02 + 0.1 * heavy,
+            "call_op_density": 0.01,
+            "cfg_blocks": 4 + 60 * heavy,
+            "cfg_reachable_blocks": 4 + 50 * heavy,
+            "instructions": 100 + 2000 * heavy,
+            "selectors": 2 + 8 * heavy,
+            "dead_selectors": 0,
+            "dead_directions": 0,
+            "modules_screened": 3,
+            "taint_density": 0.1 * heavy,
+            "tainted_sinks": 2 * heavy,
+            "sink_counts": None,
+            "resolved_call_targets": heavy,
+            "fingerprints": 1,
+            "static_answerable": 0,
+            "link_out_degree": heavy,
+            "link_resolved_degree": heavy,
+            "link_is_proxy": 0,
+            "link_proxy_kind": None,
+            "link_delegatecall_sites": 0,
+            "link_escape_density": 0.0,
+            "phase_bucket_pruned": 0,
+            "fuse_profitable": heavy,
+            "phase_bucket": "bucket",
+        }
+        records.append({
+            "schema_version": 4,
+            "contract": f"c{i}",
+            "code_hash": f"{i:064x}",
+            "features": features,
+            "outcome": {
+                "route": route,
+                "wall_s": wall,
+                "issues": 0,
+                "states": 10,
+                "complete": True,
+                "error": None,
+            },
+            "journey_id": f"j{i}",
+        })
+    return records
+
+
+@pytest.fixture()
+def records():
+    return synthetic_records()
+
+
+@pytest.fixture()
+def artifact_dir(tmp_path, records):
+    model = routing.train_model(records)
+    routing.save_router(str(tmp_path), model)
+    return tmp_path
+
+
+# -- roundtrip ---------------------------------------------------------
+def test_train_save_load_decide_roundtrip(artifact_dir, records):
+    router = routing.load_router(str(artifact_dir))
+    assert router is not None
+    assert router.version == 1
+    assert set(router.routes()) == {"host-walk", "device-waves"}
+    cheap = records[0]["features"]
+    heavy = records[1]["features"]
+    assert router.decide(cheap).route == "host-walk"
+    decision = router.decide(heavy)
+    assert decision.route == "device-waves"
+    # the decision carries the full priced table + a usable budget
+    assert decision.cost("host-walk") is not None
+    assert decision.budget_s() >= 0.25
+
+
+def test_versions_increment_and_newest_wins(artifact_dir, records):
+    model = routing.train_model(records)
+    routing.save_router(str(artifact_dir), model)
+    versions = router_versions(str(artifact_dir))
+    assert [v for v, _p in versions] == [2, 1]
+    assert routing.load_router(str(artifact_dir)).version == 2
+
+
+def test_decide_respects_offered_tiers(artifact_dir, records):
+    router = routing.load_router(str(artifact_dir))
+    heavy = records[1]["features"]
+    forced = router.decide(heavy, tiers=["host-walk"])
+    assert forced.route == "host-walk"
+    assert router.decide(heavy, tiers=["no-such-tier"]) is None
+
+
+# -- refusal ladder ----------------------------------------------------
+def _corrupt(path):
+    doc = json.loads(path.read_text())
+    doc["model"]["trained_rows"] = 10_000  # checksum now stale
+    path.write_text(json.dumps(doc))
+
+
+def test_corrupted_artifact_falls_back_to_older(artifact_dir, records):
+    model = routing.train_model(records)
+    v2 = routing.save_router(str(artifact_dir), model)
+    _corrupt(artifact_dir / "router-v2.json")
+    base = registry().value("mtpu_router_refused_total", reason="checksum")
+    router = routing.load_router(str(artifact_dir))
+    assert router is not None and router.version == 1  # fell back
+    assert registry().value(
+        "mtpu_router_refused_total", reason="checksum"
+    ) == base + 1
+    assert v2.endswith("router-v2.json")
+
+
+def test_all_refused_means_heuristics_not_misload(artifact_dir):
+    _corrupt(artifact_dir / "router-v1.json")
+    assert routing.load_router(str(artifact_dir)) is None
+    assert registry().value("mtpu_router_artifact_version") == 0
+
+
+def test_newer_schema_refused(artifact_dir):
+    path = artifact_dir / "router-v1.json"
+    doc = json.loads(path.read_text())
+    doc["schema_version"] = routing.ROUTER_SCHEMA_VERSION + 1
+    path.write_text(json.dumps(doc))
+    with pytest.raises(routing.ArtifactRefused) as refused:
+        load_router_file(str(path))
+    assert refused.value.reason == "schema"
+    assert routing.load_router(str(artifact_dir)) is None
+
+
+def test_wrong_kind_refused(artifact_dir):
+    path = artifact_dir / "router-v1.json"
+    doc = json.loads(path.read_text())
+    doc["kind"] = "mtpu-kernel-pack"
+    path.write_text(json.dumps(doc))
+    with pytest.raises(routing.ArtifactRefused):
+        load_router_file(str(path))
+
+
+def test_renamed_artifact_version_mismatch_refused(artifact_dir):
+    (artifact_dir / "router-v1.json").rename(
+        artifact_dir / "router-v7.json"
+    )
+    with pytest.raises(routing.ArtifactRefused) as refused:
+        load_router_file(str(artifact_dir / "router-v7.json"))
+    assert refused.value.reason == "version"
+
+
+def test_junk_json_refused(artifact_dir):
+    (artifact_dir / "router-v1.json").write_text("{nope")
+    assert routing.load_router(str(artifact_dir)) is None
+
+
+def test_missing_directory_is_heuristics(tmp_path):
+    assert routing.load_router(str(tmp_path / "absent")) is None
+    assert routing.load_router(None) is None
+
+
+# -- determinism golden ------------------------------------------------
+def test_train_is_deterministic(records):
+    a = routing.train_model(records)
+    b = routing.train_model(list(records))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_train_eval_deterministic_golden(artifact_dir, records):
+    router = routing.load_router(str(artifact_dir))
+    one = routing.evaluate_log(records, router)
+    two = routing.evaluate_log(records, router)
+    assert one == two
+    assert one["records"] == len(records)
+    assert one["scored"] == len(records)
+    assert one["regret_s"] >= 0.0
+    assert 0.0 <= one["oracle_agreement"] <= 1.0
+    # the separable synthetic corpus: two thirds walked on the host
+    host = one["per_route"]["host-walk"]
+    assert host["n"] == 2 * len(records) // 3
+    assert one["per_route"]["device-waves"]["n"] == len(records) // 3
+
+
+def test_train_refuses_empty_log():
+    with pytest.raises(ValueError):
+        routing.train_model([])
+    with pytest.raises(ValueError):
+        # triage-tier routes carry no trainable signal
+        routing.train_model([
+            {"outcome": {"route": "store-hit", "wall_s": 0.001}},
+            {"outcome": {"route": "static-answer", "wall_s": 0.001}},
+        ])
+
+
+def test_explain_record_names_drivers(artifact_dir, records):
+    router = routing.load_router(str(artifact_dir))
+    report = routing.explain_record(records[0], router)
+    assert report["logged_route"] == "host-walk"
+    assert report["router_version"] == 1
+    assert set(report["expected"]) == {"host-walk", "device-waves"}
+    for rows in report["attributions"].values():
+        assert rows and "feature" in rows[0]
+
+
+def test_route_normalization_feeds_the_flywheel():
+    assert routing.normalize_route("routed-host-walk") == "host-walk"
+    assert routing.normalize_route("promoted-device-waves") == "device-waves"
+    assert routing.normalize_route("device-owned") == "device-waves"
+    assert routing.normalize_route("store-hit") is None
+    assert routing.normalize_route(None) is None
+
+
+# -- observe satellites ------------------------------------------------
+def test_outcome_for_routed_and_promoted_vocabulary():
+    base = {"issues": [], "states": 3, "error": None, "wall_s": 0.2}
+    routed = outcome_for(dict(base, routed="host-walk"))
+    assert routed["route"] == "routed-host-walk"
+    assert routed["wall_s"] == 0.2
+    promoted = outcome_for(
+        dict(base, routed="host-walk", promoted="device-waves")
+    )
+    assert promoted["route"] == "promoted-device-waves"
+    # schema stays v4: plain results keep today's vocabulary
+    assert outcome_for(dict(base))["route"] == "host-walk"
+    assert outcome_for(dict(base, owned=True))["route"] == "device-owned"
+
+
+def _write_log(path, records, junk=True):
+    with open(path, "w") as fp:
+        for i, rec in enumerate(records):
+            fp.write(json.dumps(rec) + "\n")
+            if junk and i == 1:
+                fp.write("not json\n\n")  # tolerated, skipped
+
+
+def test_tail_records_matches_streaming_tail(tmp_path, records):
+    path = str(tmp_path / "routing_features.jsonl")
+    _write_log(path, records)
+    assert tail_records(path, 10) == read_records(path)[-10:]
+    assert tail_records(path, 10_000) == read_records(path)
+    assert tail_records(path, 0) == []
+    assert list(iter_records(path)) == read_records(path)
+
+
+def test_read_records_bound(tmp_path, records):
+    path = str(tmp_path / "routing_features.jsonl")
+    _write_log(path, records, junk=False)
+    assert len(read_records(path, n=7)) == 7
+    assert read_records(path, n=7) == records[-7:]
+
+
+def test_budget_scales_with_predicted_wall():
+    d = len(routing.FEATURE_COLUMNS)
+    head = {
+        "n": 5, "mean_wall_s": 4.0,
+        "wall_w": [0.0] * d, "wall_b": math.log1p(4.0),
+        "succ_w": [0.0] * d, "succ_b": 30.0,
+    }
+    doc = {
+        "version": 9,
+        "model": {
+            "features": list(routing.FEATURE_COLUMNS),
+            "impute": [0.0] * d, "scale": [1.0] * d,
+            "routes": {"host-walk": head}, "trained_rows": 5,
+        },
+    }
+    router = routing.Router(doc)
+    decision = router.decide({}, tiers=["host-walk"])
+    assert decision.route == "host-walk"
+    assert decision.budget_s(slack=3.0) == pytest.approx(12.0, rel=1e-3)
+    assert decision.budget_s(slack=0.0) == 0.25  # the floor

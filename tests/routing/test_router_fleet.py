@@ -1,0 +1,145 @@
+"""Cost-informed replica choice at the fleet front: without a mounted
+router artifact `_candidates` is EXACTLY the historical least-loaded
+order (the parity half of the differential); with one, replicas are
+priced as expected drain time — (occupancy + 1) x the settle-latency
+EWMA `_note_terminal` measures — so a fast replica with a deep queue
+beats a slow one with a short queue. No replica processes exist:
+`_candidates` is exercised directly against stubbed load/EWMA state.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import pytest
+
+from mythril_tpu import routing
+from mythril_tpu.fleet.front import FleetConfig, FleetFront, FleetJob
+
+pytestmark = [pytest.mark.router, pytest.mark.fleet]
+
+URLS = [f"http://127.0.0.1:{7001 + i}" for i in range(3)]
+
+FLEET_KW = dict(probe_interval_s=30.0, failure_threshold=2, recovery_s=60.0)
+
+
+class StubReplica:
+    def __init__(self, name, load):
+        self.name = name
+        self.routable = True
+        self._load = load
+
+    def load(self):
+        return self._load
+
+
+def front_with(loads, router=None, ewma=None, **over):
+    front = FleetFront(FleetConfig(URLS, **dict(FLEET_KW, **over)))
+    front.replicas = {
+        f"r{i}": StubReplica(f"r{i}", load) for i, load in enumerate(loads)
+    }
+    front._router = router
+    front._settle_ewma = dict(ewma or {})
+    return front
+
+
+def order(front, exclude=None):
+    return [r.name for r in front._candidates(exclude=exclude)]
+
+
+def manual_router(tmp_path):
+    d = len(routing.FEATURE_COLUMNS)
+    head = {
+        "n": 4, "mean_wall_s": 1.0,
+        "wall_w": [0.0] * d, "wall_b": math.log1p(1.0),
+        "succ_w": [0.0] * d, "succ_b": 30.0,
+    }
+    model = {
+        "features": list(routing.FEATURE_COLUMNS),
+        "impute": [0.0] * d, "scale": [1.0] * d,
+        "routes": {"host-walk": head}, "trained_rows": 4,
+    }
+    routing.save_router(str(tmp_path / "router"), model)
+    return routing.load_router(str(tmp_path / "router"))
+
+
+# -- the differential --------------------------------------------------
+def test_no_router_is_least_loaded_order():
+    front = front_with([2, 0, 1])
+    assert order(front) == ["r1", "r2", "r0"]
+
+
+def test_router_without_samples_is_least_loaded_parity(tmp_path):
+    """A freshly mounted router changes NOTHING until real settles
+    feed the EWMA — both fronts must route bit-for-bit identically."""
+    plain = front_with([2, 0, 1])
+    routed = front_with([2, 0, 1], router=manual_router(tmp_path))
+    for exclude in (None, "r1", "r0"):
+        assert order(plain, exclude) == order(routed, exclude)
+
+
+def test_router_with_samples_prices_drain_time(tmp_path):
+    """r0: 3 queued jobs but 0.1s settles -> drain 0.4s. r1: empty
+    but 10s settles -> drain 10s. Least-loaded picks r1 (wrong);
+    the cost model picks r0."""
+    loads, ewma = [3, 0, 9], {"r0": 0.1, "r1": 10.0, "r2": 0.1}
+    assert order(front_with(loads))[0] == "r1"
+    routed = front_with(loads, router=manual_router(tmp_path), ewma=ewma)
+    assert order(routed) == ["r0", "r2", "r1"]
+
+
+def test_unsampled_replica_prices_at_fleet_median(tmp_path):
+    """r1 has no settle sample: it prices at the fleet median (4.0),
+    not at zero — a brand-new replica doesn't vacuum all traffic."""
+    routed = front_with(
+        [1, 0, 1],
+        router=manual_router(tmp_path),
+        ewma={"r0": 1.0, "r2": 4.0},
+    )
+    # r0: 2*1=2; r1: 1*4=4 (median); r2: 2*4=8
+    assert order(routed) == ["r0", "r1", "r2"]
+
+
+def test_exclude_still_honored_under_cost_routing(tmp_path):
+    routed = front_with(
+        [0, 0, 0],
+        router=manual_router(tmp_path),
+        ewma={"r0": 1.0, "r1": 2.0, "r2": 3.0},
+    )
+    assert order(routed, exclude="r0") == ["r1", "r2"]
+
+
+# -- the EWMA feed -----------------------------------------------------
+def _settle(front, replica, latency_s):
+    job = FleetJob("33ff")
+    job.replica = replica
+    job.created_t = time.monotonic() - latency_s
+    front._note_terminal(job, {"state": "done"})
+    return job
+
+
+def test_note_terminal_feeds_settle_ewma():
+    front = front_with([0, 0, 0])
+    _settle(front, "r0", 2.0)
+    assert front._settle_ewma["r0"] == pytest.approx(2.0, abs=0.1)
+    _settle(front, "r0", 4.0)
+    # alpha .3: 0.3*4 + 0.7*2 = 2.6
+    assert front._settle_ewma["r0"] == pytest.approx(2.6, abs=0.1)
+    assert "r1" not in front._settle_ewma
+
+
+def test_stats_surfaces_router_block(tmp_path):
+    front = FleetFront(
+        FleetConfig(URLS, router_dir=str(tmp_path / "missing"), **FLEET_KW)
+    )
+    block = front.stats()["fleet"]["router"]
+    assert block == {"mounted": False, "version": None, "settle_ewma_s": {}}
+
+    routed = FleetFront(FleetConfig(URLS, **FLEET_KW))
+    routed._router = manual_router(tmp_path)
+    routed._settle_ewma = {"r0": 1.23456}
+    block = routed.stats()["fleet"]["router"]
+    assert block["mounted"] is True
+    assert block["version"] == 1
+    assert block["settle_ewma_s"] == {"r0": 1.2346}

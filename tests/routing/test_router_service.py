@@ -1,0 +1,278 @@
+"""The cost-model router at `myth serve` admission, engine-less
+(start_engine=False): the routed tier runs on the walk pool straight
+from `submit` — a job that settles DONE here provably never saw a
+wave dispatch, because the wave thread does not exist.  Covers the
+routed fast path, the structural router-off / no-artifact / refused
+parity (the submission queues exactly like today), and the in-flight
+promotion ladder (`_finalize`): budget overrun or walk error sends a
+routed job to the HEAD of the wave queue, once.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import pytest
+
+from mythril_tpu import routing
+from mythril_tpu.observe.registry import registry
+from mythril_tpu.service.client import ServiceClient
+from mythril_tpu.service.engine import ServiceConfig
+from mythril_tpu.service.jobs import Job, JobState
+from mythril_tpu.service.server import AnalysisServer
+
+pytestmark = [pytest.mark.router, pytest.mark.service]
+
+#: CALLER; SELFDESTRUCT — a real (fast) host walk with a real issue
+KILLABLE = "33ff"
+
+CFG = dict(
+    stripes=2,
+    lanes_per_stripe=4,
+    steps_per_wave=64,
+    queue_capacity=4,
+    host_walk=True,
+)
+
+
+def manual_model(host_wall, device_wall):
+    """A hand-built cost model with flat per-route predictions —
+    deterministic routing without depending on trained weights."""
+    d = len(routing.FEATURE_COLUMNS)
+
+    def head(wall):
+        return {
+            "n": 10, "mean_wall_s": wall,
+            "wall_w": [0.0] * d, "wall_b": math.log1p(wall),
+            "succ_w": [0.0] * d, "succ_b": 30.0,
+        }
+
+    return {
+        "features": list(routing.FEATURE_COLUMNS),
+        "impute": [0.0] * d,
+        "scale": [1.0] * d,
+        "routes": {
+            "host-walk": head(host_wall),
+            "device-waves": head(device_wall),
+        },
+        "trained_rows": 20,
+    }
+
+
+def artifact_dir(tmp_path, host_wall=20.0, device_wall=50.0):
+    # host_wall=20 keeps the promotion budget (3x predicted) far above
+    # a cold-start walk's wall — promotion is exercised separately
+    directory = tmp_path / "router"
+    routing.save_router(str(directory), manual_model(host_wall, device_wall))
+    return str(directory)
+
+
+def start_server(**over):
+    return AnalysisServer(
+        ServiceConfig(**dict(CFG, **over)), start_engine=False
+    ).start()
+
+
+def wait_terminal(client, job_id, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        job = client.job(job_id)
+        if job["state"] in ("done", "failed"):
+            return job
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never settled: {client.job(job_id)}")
+
+
+# -- the routed admission tier -----------------------------------------
+def test_routed_submission_settles_on_walk_pool(tmp_path):
+    srv = start_server(router_dir=artifact_dir(tmp_path))
+    try:
+        assert srv.engine._router is not None
+        client = ServiceClient(srv.url, honor_retry_after=False)
+        job_id = client.submit(KILLABLE)
+        job = wait_terminal(client, job_id)
+        assert job["state"] == "done"
+        assert job["routed"] == "host-walk"
+        assert "promoted" not in job  # 2-byte walk beat its budget
+        report = job["report"]
+        assert report["device"]["waves"] == 0  # no wave thread exists
+        assert report["issues"]  # the suicide issue came off the walk
+        assert report["timings"]["device_s"] == 0.0
+    finally:
+        srv.close()
+
+
+def test_router_off_flag_is_todays_ladder(tmp_path):
+    """--no-router: same artifact present, flag off — the submission
+    queues exactly like today (engine-less: stays queued forever)."""
+    srv = start_server(router_dir=artifact_dir(tmp_path), router=False)
+    try:
+        assert srv.engine._router is None
+        client = ServiceClient(srv.url, honor_retry_after=False)
+        job = client.job(client.submit(KILLABLE))
+        assert job["state"] == "queued"
+        assert "routed" not in job
+    finally:
+        srv.close()
+
+
+def test_missing_artifact_is_todays_ladder(tmp_path):
+    srv = start_server(router_dir=str(tmp_path / "empty"))
+    try:
+        assert srv.engine._router is None
+        client = ServiceClient(srv.url, honor_retry_after=False)
+        assert client.job(client.submit(KILLABLE))["state"] == "queued"
+    finally:
+        srv.close()
+
+
+def test_refused_artifact_is_todays_ladder(tmp_path):
+    directory = artifact_dir(tmp_path)
+    path = tmp_path / "router" / "router-v1.json"
+    doc = json.loads(path.read_text())
+    doc["model"]["trained_rows"] = 999  # checksum now stale
+    path.write_text(json.dumps(doc))
+    srv = start_server(router_dir=directory)
+    try:
+        assert srv.engine._router is None  # refused, never mis-loaded
+        client = ServiceClient(srv.url, honor_retry_after=False)
+        assert client.job(client.submit(KILLABLE))["state"] == "queued"
+    finally:
+        srv.close()
+
+
+def test_device_priced_submission_keeps_queue_path(tmp_path):
+    """A model that prices the device tier cheaper must leave the
+    submission on the wave queue — routing only bypasses the queue
+    when the host walk wins."""
+    srv = start_server(
+        router_dir=artifact_dir(tmp_path, host_wall=50.0, device_wall=0.5)
+    )
+    try:
+        assert srv.engine._router is not None
+        client = ServiceClient(srv.url, honor_retry_after=False)
+        job = client.job(client.submit(KILLABLE))
+        assert job["state"] == "queued"
+        assert "routed" not in job
+    finally:
+        srv.close()
+
+
+# -- in-flight promotion (_finalize) -----------------------------------
+def _routed_job(engine, budget_s, wall_s):
+    """Register a fabricated routed job whose walk 'already ran' for
+    `wall_s` seconds against a `budget_s` budget."""
+    job = Job(KILLABLE)
+    engine.queue.register(job)
+    job.routed = "host-walk"
+    job.route_budget_s = budget_s
+    job.started_t = time.monotonic() - wall_s
+    job.state = JobState.ANALYZING
+    return job
+
+
+_OUTCOME = {
+    "stats": {"waves": 0, "device_steps": 0},
+    "covered_branches": [],
+    "triggers": {},
+    "degraded_lanes": 0,
+}
+
+
+def test_budget_overrun_promotes_to_wave_queue_head(tmp_path):
+    srv = start_server(router_dir=artifact_dir(tmp_path))
+    try:
+        engine = srv.engine
+        base = registry().value("mtpu_router_promotions_total")
+        job = _routed_job(engine, budget_s=0.5, wall_s=5.0)
+        engine._finalize(
+            job, None, dict(_OUTCOME),
+            host_result={"issues": [], "states": 7, "error": None},
+        )
+        assert job.promoted == "device-waves"
+        assert job.state == JobState.QUEUED
+        assert engine.queue._pending[0] is job  # HEAD, not tail
+        assert registry().value("mtpu_router_promotions_total") == base + 1
+        # regret = wall burnt beyond the predicted budget
+        assert registry().value("mtpu_router_regret_seconds_total") > 0
+    finally:
+        srv.close()
+
+
+def test_walk_error_promotes_even_under_budget(tmp_path):
+    srv = start_server(router_dir=artifact_dir(tmp_path))
+    try:
+        engine = srv.engine
+        job = _routed_job(engine, budget_s=30.0, wall_s=0.1)
+        engine._finalize(
+            job, None, dict(_OUTCOME),
+            host_result={"issues": [], "states": 0, "error": "solver oom"},
+        )
+        assert job.promoted == "device-waves"
+        assert job.error is None  # the error is retried on device, not kept
+        assert job.state == JobState.QUEUED
+    finally:
+        srv.close()
+
+
+def test_promotion_latches_once(tmp_path):
+    """One promotion max: a promoted job that fails its walk again
+    settles — it must not ping-pong on the queue forever."""
+    srv = start_server(router_dir=artifact_dir(tmp_path))
+    try:
+        engine = srv.engine
+        job = _routed_job(engine, budget_s=0.5, wall_s=5.0)
+        engine._finalize(
+            job, None, dict(_OUTCOME),
+            host_result={"issues": [], "states": 0, "error": "boom"},
+        )
+        assert job.promoted == "device-waves"
+        engine.queue.claim(1)  # the wave tier picks it back up
+        engine._finalize(
+            job, None, dict(_OUTCOME),
+            host_result={"issues": [], "states": 0, "error": "boom"},
+        )
+        assert job.state != JobState.QUEUED  # settled, no second lap
+    finally:
+        srv.close()
+
+
+def test_under_budget_clean_walk_settles_not_promotes(tmp_path):
+    srv = start_server(router_dir=artifact_dir(tmp_path))
+    try:
+        engine = srv.engine
+        job = _routed_job(engine, budget_s=30.0, wall_s=0.2)
+        engine._finalize(
+            job, None, dict(_OUTCOME),
+            host_result={"issues": [], "states": 5, "error": None},
+        )
+        assert job.promoted is None
+        assert job.state == JobState.DONE
+    finally:
+        srv.close()
+
+
+def test_tuned_artifact_installs_at_engine_init(tmp_path):
+    """A tuned-v<N>.json riding in the router directory lands on
+    PORTFOLIO_DEFAULTS when the engine mounts the router."""
+    from mythril_tpu.laser.smt.solver import portfolio
+
+    directory = artifact_dir(tmp_path)
+    knob = next(iter(portfolio.PORTFOLIO_DEFAULTS))
+    original = portfolio.PORTFOLIO_DEFAULTS[knob]
+    bumped = original + 1
+    routing.save_tuned(
+        directory, {knob: bumped},
+        gate={"queries": 4, "agree": 4, "disagree": 0, "pass": True},
+    )
+    try:
+        srv = start_server(router_dir=directory)
+        try:
+            assert portfolio.PORTFOLIO_DEFAULTS[knob] == bumped
+            assert portfolio.tuned_version() == 1
+        finally:
+            srv.close()
+    finally:
+        portfolio.reset_tuned_defaults()

@@ -1,0 +1,272 @@
+"""The second flywheel loop: tuned-v<N>.json PORTFOLIO_DEFAULTS
+override artifacts (save/load/refusal/install) and the `myth solverlab
+tune --watch` incremental loop — a sweep winner only promotes after
+beating the committed defaults AND a 100% host-replay agreement gate;
+one flipped verdict blocks promotion unconditionally.
+
+The solver internals (`solverlab._rebuild/_replay_host/_replay_device/
+_classify`, `tune_corpus`, `querylog.load_corpus`) are monkeypatched —
+this file tests the promotion machinery, not the solvers.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from mythril_tpu import routing
+from mythril_tpu.laser.smt.solver import portfolio
+from mythril_tpu.routing.tuning import load_tuned_file, tune_watch
+
+pytestmark = pytest.mark.router
+
+KNOB = sorted(portfolio.PORTFOLIO_DEFAULTS)[0]
+
+
+@pytest.fixture(autouse=True)
+def factory_defaults():
+    yield
+    portfolio.reset_tuned_defaults()
+
+
+def _gate(n=4):
+    return {"queries": n, "agree": n, "disagree": 0, "pass": True}
+
+
+# -- artifact layer ----------------------------------------------------
+def test_tuned_roundtrip_and_install(tmp_path):
+    original = portfolio.PORTFOLIO_DEFAULTS[KNOB]
+    path = routing.save_tuned(
+        str(tmp_path), {KNOB: original + 2}, gate=_gate()
+    )
+    doc = load_tuned_file(path)
+    assert doc["overrides"] == {KNOB: original + 2}
+    assert doc["gate"]["pass"] is True
+    assert routing.maybe_install_tuned(str(tmp_path)) == 1
+    assert portfolio.PORTFOLIO_DEFAULTS[KNOB] == original + 2
+    assert portfolio.tuned_version() == 1
+    portfolio.reset_tuned_defaults()
+    assert portfolio.PORTFOLIO_DEFAULTS[KNOB] == original
+    assert portfolio.tuned_version() == 0
+
+
+def test_save_tuned_rejects_unknown_knob(tmp_path):
+    with pytest.raises(ValueError):
+        routing.save_tuned(
+            str(tmp_path), {"no_such_knob": 1}, gate=_gate()
+        )
+
+
+def test_unknown_knob_artifact_refused_on_load(tmp_path):
+    """A newer writer's knob set must refuse, not partially apply."""
+    path = tmp_path / "tuned-v1.json"
+    original = portfolio.PORTFOLIO_DEFAULTS[KNOB]
+    saved = routing.save_tuned(
+        str(tmp_path), {KNOB: original + 1}, gate=_gate()
+    )
+    doc = json.loads(open(saved).read())
+    doc["overrides"]["knob_from_the_future"] = 7
+    from mythril_tpu.routing.artifact import checksum_doc
+
+    doc["checksum"] = checksum_doc(doc)  # checksum VALID — knob unknown
+    path.write_text(json.dumps(doc))
+    with pytest.raises(routing.ArtifactRefused) as refused:
+        load_tuned_file(str(path))
+    assert refused.value.reason == "unknown-knob"
+    assert routing.maybe_install_tuned(str(tmp_path)) is None
+    assert portfolio.PORTFOLIO_DEFAULTS[KNOB] == original
+
+
+def test_corrupted_tuned_refused_and_defaults_stand(tmp_path):
+    original = portfolio.PORTFOLIO_DEFAULTS[KNOB]
+    saved = routing.save_tuned(
+        str(tmp_path), {KNOB: original + 1}, gate=_gate()
+    )
+    doc = json.loads(open(saved).read())
+    doc["overrides"][KNOB] = original + 999  # checksum now stale
+    (tmp_path / "tuned-v1.json").write_text(json.dumps(doc))
+    assert routing.latest_tuned(str(tmp_path)) is None
+    assert routing.maybe_install_tuned(str(tmp_path)) is None
+    assert portfolio.PORTFOLIO_DEFAULTS[KNOB] == original
+
+
+def test_newer_tuned_schema_refused(tmp_path):
+    saved = routing.save_tuned(
+        str(tmp_path), {KNOB: portfolio.PORTFOLIO_DEFAULTS[KNOB]},
+        gate=_gate(),
+    )
+    doc = json.loads(open(saved).read())
+    doc["schema_version"] = routing.TUNED_SCHEMA_VERSION + 1
+    (tmp_path / "tuned-v1.json").write_text(json.dumps(doc))
+    with pytest.raises(routing.ArtifactRefused) as refused:
+        load_tuned_file(saved)
+    assert refused.value.reason == "schema"
+
+
+# -- the replay-agreement gate -----------------------------------------
+def _wire_solverlab(monkeypatch, verdicts):
+    """Stub the replay internals: `verdicts` maps query sha to the
+    _classify outcome its replay should produce."""
+    from mythril_tpu.analysis import solverlab
+
+    monkeypatch.setattr(solverlab, "_rebuild", lambda art: art["sha"])
+    monkeypatch.setattr(
+        solverlab, "_replay_host", lambda lowered, timeout_ms: ("sat", 1.0)
+    )
+    monkeypatch.setattr(
+        solverlab,
+        "_replay_device",
+        lambda lowered, candidates, steps: (("sat", 1.0), 0.0),
+    )
+    monkeypatch.setattr(
+        solverlab, "_classify", lambda host, tuned, _v=verdicts: "agree"
+    )
+    return solverlab
+
+
+def test_gate_passes_on_full_agreement(monkeypatch):
+    _wire_solverlab(monkeypatch, {})
+    corpus = [{"sha": f"q{i}"} for i in range(5)]
+    gate = routing.gate_overrides(corpus, {KNOB: 1})
+    assert gate["pass"] is True
+    assert gate["agree"] == 5 and gate["disagree"] == 0
+
+
+def test_single_disagreement_fails_the_gate(monkeypatch):
+    from mythril_tpu.analysis import solverlab
+
+    _wire_solverlab(monkeypatch, {})
+    flip = {"q2"}
+    monkeypatch.setattr(
+        solverlab,
+        "_classify",
+        lambda host, tuned: "disagree" if host == "FLIP" else "agree",
+    )
+    monkeypatch.setattr(
+        solverlab,
+        "_replay_host",
+        lambda lowered, timeout_ms: "FLIP" if lowered in flip else "sat",
+    )
+    corpus = [{"sha": f"q{i}"} for i in range(5)]
+    gate = routing.gate_overrides(corpus, {KNOB: 1})
+    assert gate["pass"] is False
+    assert gate["disagree"] == 1 and gate["agree"] == 4
+    assert gate["failures"][0]["sha"] == "q2"
+
+
+def test_incomplete_answers_do_not_block_promotion(monkeypatch):
+    from mythril_tpu.analysis import solverlab
+
+    _wire_solverlab(monkeypatch, {})
+    monkeypatch.setattr(
+        solverlab, "_classify", lambda host, tuned: "incomplete"
+    )
+    gate = routing.gate_overrides([{"sha": "q0"}], {KNOB: 1})
+    assert gate["incomplete"] == 1 and gate["disagree"] == 0
+    assert gate["pass"] is True  # honest unknowns cost wall, not soundness
+
+
+def test_empty_corpus_never_passes(monkeypatch):
+    _wire_solverlab(monkeypatch, {})
+    assert routing.gate_overrides([], {KNOB: 1})["pass"] is False
+
+
+# -- the watch loop ----------------------------------------------------
+def _wire_watch(monkeypatch, corpora, beats=True, agree=True):
+    """Stub the sweep + replay stack under tune_watch: `corpora` is
+    the sequence of corpus snapshots successive rounds observe."""
+    from mythril_tpu.analysis import solverlab
+    from mythril_tpu.observe import querylog
+
+    snapshots = iter(corpora)
+    last = {"corpus": corpora[-1]}
+
+    def load_corpus(directory, reason=None, origin=None):
+        try:
+            last["corpus"] = next(snapshots)
+        except StopIteration:
+            pass
+        return last["corpus"]
+
+    monkeypatch.setattr(querylog, "load_corpus", load_corpus)
+    monkeypatch.setattr(
+        solverlab,
+        "tune_corpus",
+        lambda corpus, **kw: {
+            "beats_baseline": beats,
+            "best": {"knobs": {KNOB: portfolio.PORTFOLIO_DEFAULTS[KNOB] + 1},
+                     "loss": 0.5},
+        },
+    )
+    _wire_solverlab(monkeypatch, {})
+    if not agree:
+        monkeypatch.setattr(
+            solverlab, "_classify", lambda host, tuned: "disagree"
+        )
+
+
+def test_watch_promotes_after_gate(tmp_path, monkeypatch):
+    corpus = [{"sha": f"q{i}"} for i in range(10)]
+    _wire_watch(monkeypatch, [corpus])
+    naps = []
+    out = tune_watch(
+        "unused", str(tmp_path), rounds=1, sleep=naps.append
+    )
+    assert out["sweeps"] == 1
+    assert out["promoted"] and out["promoted"].endswith("tuned-v1.json")
+    assert out["rounds"][0]["gate"]["pass"] is True
+    doc = load_tuned_file(out["promoted"])
+    assert doc["overrides"] == {KNOB: portfolio.PORTFOLIO_DEFAULTS[KNOB] + 1}
+    assert naps == []  # bounded rounds never slept
+
+
+def test_watch_gate_failure_blocks_promotion(tmp_path, monkeypatch):
+    corpus = [{"sha": f"q{i}"} for i in range(10)]
+    _wire_watch(monkeypatch, [corpus], agree=False)
+    out = tune_watch("unused", str(tmp_path), rounds=1, sleep=lambda s: None)
+    assert out["sweeps"] == 1
+    assert out["promoted"] is None
+    assert out["rounds"][0]["gate"]["pass"] is False
+    assert routing.latest_tuned(str(tmp_path)) is None
+
+
+def test_watch_loser_never_gated(tmp_path, monkeypatch):
+    corpus = [{"sha": "q0"}]
+    _wire_watch(monkeypatch, [corpus], beats=False)
+    out = tune_watch("unused", str(tmp_path), rounds=1, sleep=lambda s: None)
+    assert out["sweeps"] == 1
+    assert out["promoted"] is None
+    assert "gate" not in out["rounds"][0]  # the sweep lost; no replay paid
+
+
+def test_watch_waits_for_min_new(tmp_path, monkeypatch):
+    """Round 1 always sweeps; round 2 sees too few fresh queries and
+    skips; round 3 crosses min_new and sweeps again — the incremental
+    contract (+ per-sweep seed advance) in one run."""
+    from mythril_tpu.analysis import solverlab
+
+    base = [{"sha": f"q{i}"} for i in range(8)]
+    trickle = base + [{"sha": "q8"}]
+    flood = trickle + [{"sha": f"r{i}"} for i in range(4)]
+    _wire_watch(monkeypatch, [base, trickle, flood])
+    seeds = []
+    original = solverlab.tune_corpus
+
+    def spy(corpus, **kw):
+        seeds.append(kw.get("seed"))
+        return original(corpus, **kw)
+
+    monkeypatch.setattr(solverlab, "tune_corpus", spy)
+    naps = []
+    out = tune_watch(
+        "unused", str(tmp_path), interval_s=7.0, min_new=3, rounds=3,
+        sleep=naps.append,
+    )
+    assert out["sweeps"] == 2
+    # skipped round 2's q8 stays "new" until a sweep consumes it
+    assert [r["new"] for r in out["rounds"]] == [8, 1, 5]
+    assert seeds == [1, 2]  # tune_seed advances per SWEEP, not round
+    assert naps == [7.0, 7.0]
+    # two promotions: the second sweep versioned on top of the first
+    assert out["promoted"].endswith("tuned-v2.json")
